@@ -1,0 +1,13 @@
+"""Deterministic binary codec (clean-break replacement for go-amino).
+
+The reference serializes wire/disk structures with go-amino (*/codec.go
+throughout). This framework makes the clean break SURVEY.md section 7.3.2
+recommends: an explicit, deterministic, length-prefixed binary encoding
+(``tendermint_tpu.codec.binary``) for wire/disk, plus **fixed-width**
+canonical sign-bytes layouts (``tendermint_tpu.codec.signbytes``) so that
+N signatures over N messages form a rectangular (N, 160) u8 array -- the
+shape the TPU batch verifier consumes without ragged padding logic.
+"""
+
+from tendermint_tpu.codec.binary import Reader, Writer  # noqa: F401
+from tendermint_tpu.codec import signbytes  # noqa: F401

@@ -1,0 +1,17 @@
+"""L1 crypto: key interfaces, hashing, merkle trees, batch-verifier seam.
+
+Reference: crypto/ (crypto.go:22,29 PubKey/PrivKey interfaces,
+tmhash/hash.go, merkle/, ed25519/). The TPU difference: this package adds
+the ``BatchVerifier`` provider seam (crypto/batch.py) that the reference
+lacks entirely -- it is the plugin boundary through which VoteSet,
+ValidatorSet.verify_commit and the light client drain signature checks to
+the device (see BASELINE.json north_star).
+"""
+
+from tendermint_tpu.crypto.hash import sha256, address_hash, ADDRESS_SIZE  # noqa: F401
+from tendermint_tpu.crypto.keys import (  # noqa: F401
+    PubKey,
+    PrivKey,
+    Ed25519PrivKey,
+    Ed25519PubKey,
+)

@@ -1,0 +1,170 @@
+"""BatchVerifier: the device-boundary seam for signature verification.
+
+This interface does not exist in the reference -- v0.33.4 verifies every
+signature serially (crypto/ed25519/ed25519.go:151, looped at
+types/validator_set.go:641 and types/vote_set.go:201). Per the BASELINE
+north star, this seam is where VoteSet.add_vote, ValidatorSet
+.verify_commit and the light client drain (pubkey, msg, sig) triples into
+one batched device call, with the quorum tally fused on device.
+
+Providers:
+- "cpu": serial loop over host ed25519 (OpenSSL) -- the reference-parity
+  baseline and the fallback when no accelerator is present.
+- "tpu": vmap'd JAX ed25519 (tendermint_tpu.ops.ed25519), jit-compiled
+  once per (batch, msg-len) bucket, sharded over a device mesh when one is
+  configured (tendermint_tpu.parallel).
+
+Select via config ``crypto.provider`` or ``set_default_provider``.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+
+class BatchVerifier:
+    """Batch signature verification over rectangular u8 arrays."""
+
+    name = "abstract"
+
+    def verify_batch(
+        self,
+        pubkeys: np.ndarray,
+        msgs: np.ndarray,
+        sigs: np.ndarray,
+        msg_lens: Optional[np.ndarray] = None,
+    ) -> np.ndarray:
+        """pubkeys (N,32) u8, msgs (N,L) u8, sigs (N,64) u8 -> (N,) bool.
+
+        `msg_lens` (N,) gives each row's true message length when rows
+        are zero-padded to a common L; None means every row is exactly L
+        (the fixed-width sign-bytes hot path).
+        """
+        raise NotImplementedError
+
+    def verify_commit_batch(
+        self,
+        pubkeys: np.ndarray,
+        msgs: np.ndarray,
+        sigs: np.ndarray,
+        powers: np.ndarray,
+        counted: np.ndarray,
+    ) -> Tuple[np.ndarray, int]:
+        """Fused verify + voting-power tally.
+
+        `powers` (N,) int64 voting power per signer; `counted` (N,) bool --
+        whether this row's power counts toward the tally (e.g. votes for
+        the right BlockID). Returns (ok (N,) bool, talled power int where
+        ok & counted). Default composition; device providers fuse it.
+        """
+        ok = self.verify_batch(pubkeys, msgs, sigs)
+        talled = int(np.sum(np.where(ok & counted.astype(bool), powers, 0)))
+        return ok, talled
+
+
+class CPUBatchVerifier(BatchVerifier):
+    """Serial host verification -- reference-parity behavior."""
+
+    name = "cpu"
+
+    def verify_batch(self, pubkeys, msgs, sigs, msg_lens=None) -> np.ndarray:
+        from tendermint_tpu.crypto.keys import Ed25519PubKey
+
+        n = len(pubkeys)
+        out = np.zeros(n, dtype=bool)
+        for i in range(n):
+            try:
+                pk = Ed25519PubKey(bytes(bytearray(pubkeys[i])))
+            except ValueError:
+                continue
+            msg = bytes(bytearray(msgs[i]))
+            if msg_lens is not None:
+                msg = msg[: int(msg_lens[i])]
+            out[i] = pk.verify(msg, bytes(bytearray(sigs[i])))
+        return out
+
+
+class TPUBatchVerifier(BatchVerifier):
+    """Batched JAX ed25519 + fused tally on the accelerator."""
+
+    name = "tpu"
+
+    def __init__(self, mesh=None):
+        from tendermint_tpu.models import verifier as _verifier_model
+
+        self._model = _verifier_model.VerifierModel(mesh=mesh)
+
+    def verify_batch(self, pubkeys, msgs, sigs, msg_lens=None) -> np.ndarray:
+        return self._model.verify(pubkeys, msgs, sigs, msg_lens=msg_lens)
+
+    def verify_commit_batch(self, pubkeys, msgs, sigs, powers, counted):
+        return self._model.verify_commit(pubkeys, msgs, sigs, powers, counted)
+
+
+_lock = threading.Lock()
+_default: Optional[BatchVerifier] = None
+
+
+def get_default_provider() -> BatchVerifier:
+    global _default
+    with _lock:
+        if _default is None:
+            _default = CPUBatchVerifier()
+        return _default
+
+
+def set_default_provider(v: BatchVerifier) -> None:
+    global _default
+    with _lock:
+        _default = v
+
+
+def make_provider(name: str, mesh=None) -> BatchVerifier:
+    if name == "cpu":
+        return CPUBatchVerifier()
+    if name == "tpu":
+        return TPUBatchVerifier(mesh=mesh)
+    raise ValueError(f"unknown crypto provider {name!r}")
+
+
+# -- convenience for list-of-bytes call sites -------------------------------
+
+
+def pack_triples(
+    pubkeys: Sequence[bytes], msgs: Sequence[bytes], sigs: Sequence[bytes]
+) -> Tuple[np.ndarray, np.ndarray, np.ndarray, Optional[np.ndarray]]:
+    """Pack byte triples into rectangular u8 arrays.
+
+    Ragged messages are zero-padded to the max length and their true
+    lengths returned as `msg_lens` (None when already uniform -- the
+    fixed-width sign-bytes hot path).
+    """
+    n = len(pubkeys)
+    assert len(msgs) == n and len(sigs) == n
+    max_len = max((len(m) for m in msgs), default=0)
+    uniform = all(len(m) == max_len for m in msgs)
+    pk = np.zeros((n, 32), dtype=np.uint8)
+    mg = np.zeros((n, max_len), dtype=np.uint8)
+    sg = np.zeros((n, 64), dtype=np.uint8)
+    for i in range(n):
+        pk[i, : min(len(pubkeys[i]), 32)] = np.frombuffer(pubkeys[i][:32], dtype=np.uint8)
+        mg[i, : len(msgs[i])] = np.frombuffer(msgs[i], dtype=np.uint8)
+        sg[i, : min(len(sigs[i]), 64)] = np.frombuffer(sigs[i][:64], dtype=np.uint8)
+    lens = None if uniform else np.asarray([len(m) for m in msgs], dtype=np.int32)
+    return pk, mg, sg, lens
+
+
+def verify_many(
+    pubkeys: Sequence[bytes],
+    msgs: Sequence[bytes],
+    sigs: Sequence[bytes],
+    provider: Optional[BatchVerifier] = None,
+) -> List[bool]:
+    if not pubkeys:
+        return []
+    pk, mg, sg, lens = pack_triples(pubkeys, msgs, sigs)
+    v = provider or get_default_provider()
+    return [bool(b) for b in v.verify_batch(pk, mg, sg, msg_lens=lens)]

@@ -1,0 +1,22 @@
+"""SHA-256 hashing and truncated addresses.
+
+Reference: crypto/tmhash/hash.go -- Sum = sha256, SumTruncated = first 20
+bytes (crypto/tmhash/hash.go:62); addresses are SumTruncated(pubkey bytes)
+(crypto/ed25519/ed25519.go:142 region).
+"""
+
+from __future__ import annotations
+
+import hashlib
+
+HASH_SIZE = 32
+ADDRESS_SIZE = 20
+
+
+def sha256(data: bytes) -> bytes:
+    return hashlib.sha256(data).digest()
+
+
+def address_hash(data: bytes) -> bytes:
+    """First 20 bytes of sha256 (reference tmhash.SumTruncated)."""
+    return hashlib.sha256(data).digest()[:ADDRESS_SIZE]

@@ -1,0 +1,178 @@
+"""Key interfaces and ed25519 keys (host side).
+
+Reference: crypto/crypto.go:22,29 (PubKey/PrivKey interfaces),
+crypto/ed25519/ed25519.go (Sign :55, VerifyBytes :151 -- the serial hot
+path). Host-side sign/verify uses the `cryptography` package (OpenSSL);
+the batched device path lives in tendermint_tpu.ops.ed25519 and is
+selected through the BatchVerifier seam (crypto/batch.py).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import hmac
+import os
+from typing import Optional
+
+from cryptography.hazmat.primitives.asymmetric.ed25519 import (
+    Ed25519PrivateKey,
+    Ed25519PublicKey,
+)
+from cryptography.hazmat.primitives import serialization
+from cryptography.exceptions import InvalidSignature
+
+from tendermint_tpu.crypto.hash import address_hash
+
+ED25519_PUBKEY_SIZE = 32
+ED25519_PRIVKEY_SIZE = 64  # seed || pubkey, matching Go's ed25519.PrivateKey
+ED25519_SIGNATURE_SIZE = 64
+
+ED25519_TYPE = "ed25519"
+
+
+class PubKey:
+    """Reference crypto.PubKey: Address/Bytes/VerifyBytes/Equals."""
+
+    type_name: str = ""
+
+    def address(self) -> bytes:
+        raise NotImplementedError
+
+    def bytes(self) -> bytes:
+        raise NotImplementedError
+
+    def verify(self, msg: bytes, sig: bytes) -> bool:
+        raise NotImplementedError
+
+    def __eq__(self, other) -> bool:
+        return isinstance(other, PubKey) and self.bytes() == other.bytes()
+
+    def __hash__(self) -> int:
+        return hash(self.bytes())
+
+
+class PrivKey:
+    """Reference crypto.PrivKey: Bytes/Sign/PubKey/Equals."""
+
+    type_name: str = ""
+
+    def bytes(self) -> bytes:
+        raise NotImplementedError
+
+    def sign(self, msg: bytes) -> bytes:
+        raise NotImplementedError
+
+    def pub_key(self) -> PubKey:
+        raise NotImplementedError
+
+
+class Ed25519PubKey(PubKey):
+    type_name = ED25519_TYPE
+    __slots__ = ("_raw", "_pk")
+
+    def __init__(self, raw: bytes):
+        if len(raw) != ED25519_PUBKEY_SIZE:
+            raise ValueError(f"ed25519 pubkey must be {ED25519_PUBKEY_SIZE} bytes")
+        self._raw = bytes(raw)
+        self._pk: Optional[Ed25519PublicKey] = None
+
+    def address(self) -> bytes:
+        return address_hash(self._raw)
+
+    def bytes(self) -> bytes:
+        return self._raw
+
+    def verify(self, msg: bytes, sig: bytes) -> bool:
+        if len(sig) != ED25519_SIGNATURE_SIZE:
+            return False
+        if self._pk is None:
+            try:
+                self._pk = Ed25519PublicKey.from_public_bytes(self._raw)
+            except Exception:
+                return False
+        try:
+            self._pk.verify(sig, msg)
+            return True
+        except InvalidSignature:
+            return False
+
+    def __repr__(self) -> str:
+        return f"PubKeyEd25519{{{self._raw.hex()[:16]}…}}"
+
+
+class Ed25519PrivKey(PrivKey):
+    type_name = ED25519_TYPE
+    __slots__ = ("_seed", "_sk", "_pub")
+
+    def __init__(self, raw: bytes):
+        # Accept 32-byte seed or 64-byte seed||pub (Go layout).
+        if len(raw) == ED25519_PRIVKEY_SIZE:
+            seed = raw[:32]
+        elif len(raw) == 32:
+            seed = raw
+        else:
+            raise ValueError("ed25519 privkey must be 32 or 64 bytes")
+        self._seed = bytes(seed)
+        self._sk = Ed25519PrivateKey.from_private_bytes(self._seed)
+        pub_raw = self._sk.public_key().public_bytes(
+            serialization.Encoding.Raw, serialization.PublicFormat.Raw
+        )
+        self._pub = Ed25519PubKey(pub_raw)
+
+    @classmethod
+    def generate(cls) -> "Ed25519PrivKey":
+        return cls(os.urandom(32))
+
+    @classmethod
+    def from_secret(cls, secret: bytes) -> "Ed25519PrivKey":
+        """Deterministic key from a secret (reference GenPrivKeyFromSecret,
+        crypto/ed25519/ed25519.go:116 region -- sha256 of secret as seed).
+        Test fixtures only."""
+        return cls(hashlib.sha256(secret).digest())
+
+    def bytes(self) -> bytes:
+        return self._seed + self._pub.bytes()
+
+    def sign(self, msg: bytes) -> bytes:
+        return self._sk.sign(msg)
+
+    def pub_key(self) -> Ed25519PubKey:
+        return self._pub
+
+    def __eq__(self, other) -> bool:
+        return isinstance(other, Ed25519PrivKey) and hmac.compare_digest(
+            self.bytes(), other.bytes()
+        )
+
+    def __repr__(self) -> str:
+        return "PrivKeyEd25519{…}"
+
+
+# -- serialization of keys (type-prefixed, replaces amino registry) ---------
+
+_PUBKEY_TYPES = {}
+
+
+def register_pubkey_type(type_name: str, ctor) -> None:
+    _PUBKEY_TYPES[type_name] = ctor
+
+
+register_pubkey_type(ED25519_TYPE, Ed25519PubKey)
+
+
+def encode_pubkey(pk: PubKey) -> bytes:
+    from tendermint_tpu.codec.binary import Writer
+
+    return Writer().write_str(pk.type_name).write_bytes(pk.bytes()).bytes()
+
+
+def decode_pubkey(data: bytes) -> PubKey:
+    from tendermint_tpu.codec.binary import Reader
+
+    r = Reader(data)
+    type_name = r.read_str()
+    raw = r.read_bytes()
+    ctor = _PUBKEY_TYPES.get(type_name)
+    if ctor is None:
+        raise ValueError(f"unknown pubkey type {type_name!r}")
+    return ctor(raw)

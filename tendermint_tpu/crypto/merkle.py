@@ -1,0 +1,241 @@
+"""RFC-6962-style binary merkle tree and proofs.
+
+Reference: crypto/merkle/simple_tree.go:9 (SimpleHashFromByteSlices with
+0x00 leaf / 0x01 inner domain prefixes, split at the largest power of two
+strictly less than n), crypto/merkle/simple_proof.go:52 (SimpleProof with
+aunts path), crypto/merkle/proof.go:78 (ProofRuntime for app-defined
+multi-op proofs, used by the verifying light proxy).
+"""
+
+from __future__ import annotations
+
+import hashlib
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Sequence
+
+_LEAF_PREFIX = b"\x00"
+_INNER_PREFIX = b"\x01"
+
+
+def _sha(data: bytes) -> bytes:
+    return hashlib.sha256(data).digest()
+
+
+def leaf_hash(leaf: bytes) -> bytes:
+    return _sha(_LEAF_PREFIX + leaf)
+
+
+def inner_hash(left: bytes, right: bytes) -> bytes:
+    return _sha(_INNER_PREFIX + left + right)
+
+
+def _split_point(n: int) -> int:
+    """Largest power of two strictly less than n (simple_tree.go getSplitPoint)."""
+    if n < 1:
+        raise ValueError("n must be >= 1")
+    k = 1
+    while k * 2 < n:
+        k *= 2
+    return k
+
+
+def hash_from_byte_slices(items: Sequence[bytes]) -> bytes:
+    """Merkle root; empty input hashes to sha256 of empty (reference
+    emptyHash, simple_tree.go)."""
+    n = len(items)
+    if n == 0:
+        return _sha(b"")
+    if n == 1:
+        return leaf_hash(items[0])
+    k = _split_point(n)
+    return inner_hash(hash_from_byte_slices(items[:k]), hash_from_byte_slices(items[k:]))
+
+
+@dataclass
+class SimpleProof:
+    """Inclusion proof for item `index` of `total` (simple_proof.go:20)."""
+
+    total: int
+    index: int
+    leaf_hash: bytes
+    aunts: List[bytes] = field(default_factory=list)
+
+    def compute_root(self) -> bytes:
+        return _compute_from_aunts(self.index, self.total, self.leaf_hash, self.aunts)
+
+    def verify(self, root: bytes, leaf: bytes) -> None:
+        if self.total < 0 or self.index < 0:
+            raise ValueError("proof total/index must be non-negative")
+        if leaf_hash(leaf) != self.leaf_hash:
+            raise ValueError("leaf hash mismatch")
+        if self.compute_root() != root:
+            raise ValueError("proof root mismatch")
+
+
+def _compute_from_aunts(index: int, total: int, lh: bytes, aunts: List[bytes]) -> bytes:
+    if index >= total or index < 0 or total <= 0:
+        raise ValueError("bad index/total")
+    if total == 1:
+        if aunts:
+            raise ValueError("unexpected aunts")
+        return lh
+    if not aunts:
+        raise ValueError("missing aunts")
+    k = _split_point(total)
+    if index < k:
+        left = _compute_from_aunts(index, k, lh, aunts[:-1])
+        return inner_hash(left, aunts[-1])
+    right = _compute_from_aunts(index - k, total - k, lh, aunts[:-1])
+    return inner_hash(aunts[-1], right)
+
+
+def proofs_from_byte_slices(items: Sequence[bytes]) -> tuple:
+    """(root, [SimpleProof per item]) -- simple_proof.go SimpleProofsFromByteSlices."""
+    trails, root_node = _trails_from_byte_slices(list(items))
+    root = root_node.hash
+    proofs = []
+    for i, trail in enumerate(trails):
+        proofs.append(
+            SimpleProof(
+                total=len(items), index=i, leaf_hash=trail.hash, aunts=trail.flatten_aunts()
+            )
+        )
+    return root, proofs
+
+
+class _Node:
+    __slots__ = ("hash", "parent", "left", "right")
+
+    def __init__(self, h: bytes):
+        self.hash = h
+        self.parent = None
+        self.left = None  # sibling trail nodes
+        self.right = None
+
+    def flatten_aunts(self) -> List[bytes]:
+        out = []
+        node = self
+        while node is not None:
+            if node.left is not None:
+                out.append(node.left.hash)
+            elif node.right is not None:
+                out.append(node.right.hash)
+            node = node.parent
+        return out
+
+
+def _trails_from_byte_slices(items: List[bytes]):
+    n = len(items)
+    if n == 0:
+        return [], _Node(_sha(b""))
+    if n == 1:
+        node = _Node(leaf_hash(items[0]))
+        return [node], node
+    k = _split_point(n)
+    lefts, left_root = _trails_from_byte_slices(items[:k])
+    rights, right_root = _trails_from_byte_slices(items[k:])
+    root = _Node(inner_hash(left_root.hash, right_root.hash))
+    left_root.parent = root
+    left_root.right = right_root
+    right_root.parent = root
+    right_root.left = left_root
+    return lefts + rights, root
+
+
+# ---------------------------------------------------------------------------
+# Multi-op proof runtime (reference crypto/merkle/proof.go) -- lets apps
+# register custom proof-op decoders; used by the light client's verifying
+# RPC proxy for abci_query proofs.
+# ---------------------------------------------------------------------------
+
+
+class ProofOp:
+    """One step of a multi-store proof: (type, key, data)."""
+
+    def __init__(self, type_: str, key: bytes, data: bytes):
+        self.type = type_
+        self.key = key
+        self.data = data
+
+
+class ProofOperator:
+    def run(self, leaves: List[bytes]) -> List[bytes]:  # pragma: no cover - iface
+        raise NotImplementedError
+
+    def get_key(self) -> bytes:  # pragma: no cover - iface
+        raise NotImplementedError
+
+
+class ProofRuntime:
+    def __init__(self):
+        self._decoders: Dict[str, Callable[[ProofOp], ProofOperator]] = {}
+
+    def register_op_decoder(self, typ: str, dec: Callable[[ProofOp], ProofOperator]) -> None:
+        if typ in self._decoders:
+            raise ValueError(f"already registered: {typ}")
+        self._decoders[typ] = dec
+
+    def decode(self, op: ProofOp) -> ProofOperator:
+        dec = self._decoders.get(op.type)
+        if dec is None:
+            raise ValueError(f"unsupported proof op type: {op.type}")
+        return dec(op)
+
+    def verify_value(self, ops: List[ProofOp], root: bytes, keypath: List[bytes], value: bytes) -> None:
+        """Run the op chain from `value` up and compare against root."""
+        args = [value]
+        keys = list(keypath)
+        for op in ops:
+            operator = self.decode(op)
+            key = operator.get_key()
+            if key:
+                if not keys or keys[-1] != key:
+                    raise ValueError(f"key mismatch on proof op {op.type}")
+                keys.pop()
+            args = operator.run(args)
+        if keys:
+            raise ValueError("keypath not fully consumed")
+        if not args or args[0] != root:
+            raise ValueError("proof did not match root")
+
+
+class ValueOp(ProofOperator):
+    """The default leaf-level op: proves value at key under a simple tree."""
+
+    TYPE = "simple:v"
+
+    def __init__(self, key: bytes, proof: SimpleProof):
+        self.key = key
+        self.proof = proof
+
+    def get_key(self) -> bytes:
+        return self.key
+
+    def run(self, leaves: List[bytes]) -> List[bytes]:
+        if len(leaves) != 1:
+            raise ValueError("ValueOp expects one leaf")
+        vhash = _sha(leaves[0])
+        # leaf encodes (key, value-hash) deterministically
+        from tendermint_tpu.codec.binary import Writer
+
+        leaf = Writer().write_bytes(self.key).write_bytes(vhash).bytes()
+        if leaf_hash(leaf) != self.proof.leaf_hash:
+            raise ValueError("leaf mismatch")
+        return [self.proof.compute_root()]
+
+
+def default_proof_runtime() -> ProofRuntime:
+    rt = ProofRuntime()
+
+    def _dec(op: ProofOp) -> ProofOperator:
+        from tendermint_tpu.codec.binary import Reader
+
+        r = Reader(op.data)
+        total = r.read_uvarint()
+        index = r.read_uvarint()
+        lh = r.read_bytes()
+        aunts = [r.read_bytes() for _ in range(r.read_uvarint())]
+        return ValueOp(op.key, SimpleProof(total, index, lh, aunts))
+
+    rt.register_op_decoder(ValueOp.TYPE, _dec)
+    return rt

@@ -1,0 +1,37 @@
+"""L1 domain types (reference: types/).
+
+Block/Header/Commit/CommitSig (types/block.go), Vote (types/vote.go),
+VoteSet (types/vote_set.go), Validator/ValidatorSet
+(types/validator_set.go), PartSet (types/part_set.go), Evidence
+(types/evidence.go), GenesisDoc (types/genesis.go), ConsensusParams
+(types/params.go), EventBus (types/event_bus.go).
+"""
+
+from tendermint_tpu.types.tx import Tx, Txs  # noqa: F401
+from tendermint_tpu.types.validator import Validator  # noqa: F401
+from tendermint_tpu.types.validator_set import ValidatorSet  # noqa: F401
+from tendermint_tpu.types.vote import (  # noqa: F401
+    Vote,
+    PREVOTE_TYPE,
+    PRECOMMIT_TYPE,
+    is_vote_type_valid,
+)
+from tendermint_tpu.types.block import (  # noqa: F401
+    Block,
+    BlockID,
+    Commit,
+    CommitSig,
+    Header,
+    PartSetHeader,
+    BLOCK_ID_FLAG_ABSENT,
+    BLOCK_ID_FLAG_COMMIT,
+    BLOCK_ID_FLAG_NIL,
+)
+from tendermint_tpu.types.part_set import Part, PartSet, BLOCK_PART_SIZE  # noqa: F401
+from tendermint_tpu.types.vote_set import VoteSet  # noqa: F401
+from tendermint_tpu.types.params import ConsensusParams  # noqa: F401
+from tendermint_tpu.types.genesis import GenesisDoc, GenesisValidator  # noqa: F401
+from tendermint_tpu.types.evidence import DuplicateVoteEvidence, Evidence  # noqa: F401
+from tendermint_tpu.types.proposal import Proposal  # noqa: F401
+from tendermint_tpu.types.events import EventBus  # noqa: F401
+from tendermint_tpu.types.priv_validator import PrivValidator, MockPV  # noqa: F401

@@ -1,0 +1,529 @@
+"""Block, Header, Commit, CommitSig, BlockID.
+
+Reference: types/block.go -- Block :38, Header :282, Header.Hash :393
+(merkle root of 14 field encodings), Commit :572, CommitSig :468,
+Commit.VoteSignBytes :637, BlockID :957 region.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import List, Optional
+
+from tendermint_tpu.codec import signbytes
+from tendermint_tpu.codec.binary import Reader, Writer
+from tendermint_tpu.codec.signbytes import PRECOMMIT_TYPE
+from tendermint_tpu.crypto import merkle
+from tendermint_tpu.crypto.hash import sha256
+from tendermint_tpu.types.tx import Txs
+from tendermint_tpu.version import BLOCK_PROTOCOL
+
+MAX_HEADER_BYTES = 653
+
+# CommitSig BlockIDFlag (reference types/block.go:437-447)
+BLOCK_ID_FLAG_ABSENT = 1
+BLOCK_ID_FLAG_COMMIT = 2
+BLOCK_ID_FLAG_NIL = 3
+
+
+@dataclass
+class PartSetHeader:
+    total: int = 0
+    hash: bytes = b""
+
+    def is_zero(self) -> bool:
+        return self.total == 0 and len(self.hash) == 0
+
+    def validate_basic(self) -> Optional[str]:
+        if self.total < 0:
+            return "negative Total"
+        if len(self.hash) not in (0, 32):
+            return "wrong Hash size"
+        return None
+
+    def encode(self) -> bytes:
+        return Writer().write_u32(self.total).write_bytes(self.hash).bytes()
+
+    @classmethod
+    def decode(cls, data: bytes) -> "PartSetHeader":
+        r = Reader(data)
+        return cls(total=r.read_u32(), hash=r.read_bytes())
+
+    def __eq__(self, other) -> bool:
+        return (
+            isinstance(other, PartSetHeader)
+            and self.total == other.total
+            and self.hash == other.hash
+        )
+
+    def __repr__(self) -> str:
+        return f"{self.total}:{self.hash.hex()[:12]}"
+
+
+@dataclass
+class BlockID:
+    hash: bytes = b""
+    parts: PartSetHeader = field(default_factory=PartSetHeader)
+
+    def is_zero(self) -> bool:
+        return len(self.hash) == 0 and self.parts.is_zero()
+
+    def is_complete(self) -> bool:
+        return len(self.hash) == 32 and self.parts.total > 0 and len(self.parts.hash) == 32
+
+    def validate_basic(self) -> Optional[str]:
+        if len(self.hash) not in (0, 32):
+            return "wrong Hash"
+        err = self.parts.validate_basic()
+        if err:
+            return f"wrong PartsHeader: {err}"
+        return None
+
+    def key(self) -> bytes:
+        """Map key for vote tallies (reference BlockID.Key types/block.go:993)."""
+        return self.hash + self.parts.encode()
+
+    def encode(self) -> bytes:
+        return Writer().write_bytes(self.hash).write_bytes(self.parts.encode()).bytes()
+
+    @classmethod
+    def decode(cls, data: bytes) -> "BlockID":
+        r = Reader(data)
+        h = r.read_bytes()
+        ps = PartSetHeader.decode(r.read_bytes())
+        return cls(hash=h, parts=ps)
+
+    def __eq__(self, other) -> bool:
+        return (
+            isinstance(other, BlockID) and self.hash == other.hash and self.parts == other.parts
+        )
+
+    def __hash__(self) -> int:
+        return hash(self.key())
+
+    def __repr__(self) -> str:
+        return f"{self.hash.hex()[:12]}:{self.parts}"
+
+
+@dataclass
+class CommitSig:
+    """One validator's signature slot in a commit (types/block.go:468)."""
+
+    block_id_flag: int = BLOCK_ID_FLAG_ABSENT
+    validator_address: bytes = b""
+    timestamp_ns: int = 0
+    signature: bytes = b""
+
+    @classmethod
+    def absent(cls) -> "CommitSig":
+        return cls(block_id_flag=BLOCK_ID_FLAG_ABSENT)
+
+    def for_block(self) -> bool:
+        return self.block_id_flag == BLOCK_ID_FLAG_COMMIT
+
+    def absent_(self) -> bool:
+        return self.block_id_flag == BLOCK_ID_FLAG_ABSENT
+
+    def block_id(self, commit_block_id: BlockID) -> BlockID:
+        """Reconstruct the vote's BlockID from the flag
+        (reference CommitSig.BlockID types/block.go:530)."""
+        if self.block_id_flag == BLOCK_ID_FLAG_COMMIT:
+            return commit_block_id
+        return BlockID()
+
+    def validate_basic(self) -> Optional[str]:
+        if self.block_id_flag not in (
+            BLOCK_ID_FLAG_ABSENT,
+            BLOCK_ID_FLAG_COMMIT,
+            BLOCK_ID_FLAG_NIL,
+        ):
+            return f"unknown BlockIDFlag: {self.block_id_flag}"
+        if self.block_id_flag == BLOCK_ID_FLAG_ABSENT:
+            if self.validator_address:
+                return "validator address is present for absent CommitSig"
+            if self.signature:
+                return "signature is present for absent CommitSig"
+        else:
+            if len(self.validator_address) != 20:
+                return "expected ValidatorAddress size 20"
+            if not self.signature:
+                return "signature is missing"
+            if len(self.signature) > 64:
+                return "signature too big"
+        return None
+
+    def encode(self) -> bytes:
+        w = Writer()
+        w.write_u8(self.block_id_flag)
+        w.write_bytes(self.validator_address)
+        w.write_i64(self.timestamp_ns)
+        w.write_bytes(self.signature)
+        return w.bytes()
+
+    @classmethod
+    def decode(cls, data: bytes) -> "CommitSig":
+        r = Reader(data)
+        return cls(r.read_u8(), r.read_bytes(), r.read_i64(), r.read_bytes())
+
+
+@dataclass
+class Commit:
+    """+2/3 precommits for a block (types/block.go:572)."""
+
+    height: int
+    round: int
+    block_id: BlockID
+    signatures: List[CommitSig]
+
+    _hash: Optional[bytes] = field(default=None, repr=False, compare=False)
+
+    def vote_sign_bytes(self, chain_id: str, idx: int) -> bytes:
+        """Canonical sign-bytes for signature `idx` (reference
+        Commit.VoteSignBytes types/block.go:637). Fixed 160-byte layout --
+        N of these stack into the (N,160) device batch."""
+        cs = self.signatures[idx]
+        bid = cs.block_id(self.block_id)
+        return signbytes.canonical_sign_bytes(
+            msg_type=PRECOMMIT_TYPE,
+            height=self.height,
+            round_=self.round,
+            block_hash=bid.hash,
+            parts_total=bid.parts.total,
+            parts_hash=bid.parts.hash,
+            timestamp_ns=cs.timestamp_ns,
+            chain_id=chain_id,
+        )
+
+    def size(self) -> int:
+        return len(self.signatures)
+
+    def is_commit(self) -> bool:
+        return len(self.signatures) > 0
+
+    def bit_array(self):
+        from tendermint_tpu.utils.bits import BitArray
+
+        ba = BitArray(len(self.signatures))
+        for i, cs in enumerate(self.signatures):
+            ba.set_index(i, not cs.absent_())
+        return ba
+
+    def hash(self) -> bytes:
+        if self._hash is None:
+            self._hash = merkle.hash_from_byte_slices(
+                [cs.encode() for cs in self.signatures]
+            )
+        return self._hash
+
+    def validate_basic(self) -> Optional[str]:
+        if self.height < 0:
+            return "negative Height"
+        if self.round < 0:
+            return "negative Round"
+        if self.height >= 1:
+            if self.block_id.is_zero():
+                return "commit cannot be for nil block"
+            if not self.signatures:
+                return "no signatures in commit"
+            for i, cs in enumerate(self.signatures):
+                err = cs.validate_basic()
+                if err:
+                    return f"wrong CommitSig #{i}: {err}"
+        return None
+
+    def encode(self) -> bytes:
+        w = Writer()
+        w.write_u64(self.height).write_i64(self.round)
+        w.write_bytes(self.block_id.encode())
+        w.write_uvarint(len(self.signatures))
+        for cs in self.signatures:
+            w.write_bytes(cs.encode())
+        return w.bytes()
+
+    @classmethod
+    def decode(cls, data: bytes) -> "Commit":
+        r = Reader(data)
+        height = r.read_u64()
+        rnd = r.read_i64()
+        bid = BlockID.decode(r.read_bytes())
+        n = r.read_uvarint()
+        sigs = [CommitSig.decode(r.read_bytes()) for _ in range(n)]
+        return cls(height, rnd, bid, sigs)
+
+    def __repr__(self) -> str:
+        return f"Commit{{h={self.height} r={self.round} bid={self.block_id} n={len(self.signatures)}}}"
+
+
+def new_commit(height: int, round_: int, block_id: BlockID, sigs: List[CommitSig]) -> Commit:
+    return Commit(height=height, round=round_, block_id=block_id, signatures=sigs)
+
+
+@dataclass
+class Header:
+    """Block header; hash is the merkle root of the 14 field encodings
+    (reference Header.Hash types/block.go:393)."""
+
+    chain_id: str = ""
+    height: int = 0
+    time_ns: int = 0
+    last_block_id: BlockID = field(default_factory=BlockID)
+    last_commit_hash: bytes = b""
+    data_hash: bytes = b""
+    validators_hash: bytes = b""
+    next_validators_hash: bytes = b""
+    consensus_hash: bytes = b""
+    app_hash: bytes = b""
+    last_results_hash: bytes = b""
+    evidence_hash: bytes = b""
+    proposer_address: bytes = b""
+    version_block: int = BLOCK_PROTOCOL
+    version_app: int = 0
+
+    def hash(self) -> Optional[bytes]:
+        # Reference returns nil if ValidatorsHash unset (header not complete).
+        if not self.validators_hash:
+            return None
+        fields = [
+            Writer().write_u64(self.version_block).write_u64(self.version_app).bytes(),
+            self.chain_id.encode("utf-8"),
+            Writer().write_u64(self.height).bytes(),
+            Writer().write_i64(self.time_ns).bytes(),
+            self.last_block_id.encode(),
+            self.last_commit_hash,
+            self.data_hash,
+            self.validators_hash,
+            self.next_validators_hash,
+            self.consensus_hash,
+            self.app_hash,
+            self.last_results_hash,
+            self.evidence_hash,
+            self.proposer_address,
+        ]
+        return merkle.hash_from_byte_slices(fields)
+
+    def validate_basic(self) -> Optional[str]:
+        if len(self.chain_id) > 50:
+            return "chainID is too long"
+        if self.height < 0:
+            return "negative Height"
+        if self.height == 0:
+            return "zero Height"
+        err = self.last_block_id.validate_basic()
+        if err:
+            return f"wrong LastBlockID: {err}"
+        for name, h in (
+            ("LastCommitHash", self.last_commit_hash),
+            ("DataHash", self.data_hash),
+            ("EvidenceHash", self.evidence_hash),
+            ("ValidatorsHash", self.validators_hash),
+            ("NextValidatorsHash", self.next_validators_hash),
+            ("ConsensusHash", self.consensus_hash),
+            ("LastResultsHash", self.last_results_hash),
+        ):
+            if len(h) not in (0, 32):
+                return f"wrong {name}"
+        if len(self.proposer_address) not in (0, 20):
+            return "invalid ProposerAddress length"
+        return None
+
+    def encode(self) -> bytes:
+        w = Writer()
+        w.write_u64(self.version_block).write_u64(self.version_app)
+        w.write_str(self.chain_id).write_u64(self.height).write_i64(self.time_ns)
+        w.write_bytes(self.last_block_id.encode())
+        for h in (
+            self.last_commit_hash,
+            self.data_hash,
+            self.validators_hash,
+            self.next_validators_hash,
+            self.consensus_hash,
+            self.app_hash,
+            self.last_results_hash,
+            self.evidence_hash,
+            self.proposer_address,
+        ):
+            w.write_bytes(h)
+        return w.bytes()
+
+    @classmethod
+    def decode(cls, data: bytes) -> "Header":
+        r = Reader(data)
+        vb = r.read_u64()
+        va = r.read_u64()
+        cid = r.read_str()
+        height = r.read_u64()
+        t = r.read_i64()
+        lbi = BlockID.decode(r.read_bytes())
+        (
+            lch,
+            dh,
+            vh,
+            nvh,
+            ch,
+            ah,
+            lrh,
+            eh,
+            pa,
+        ) = (r.read_bytes() for _ in range(9))
+        return cls(
+            chain_id=cid,
+            height=height,
+            time_ns=t,
+            last_block_id=lbi,
+            last_commit_hash=lch,
+            data_hash=dh,
+            validators_hash=vh,
+            next_validators_hash=nvh,
+            consensus_hash=ch,
+            app_hash=ah,
+            last_results_hash=lrh,
+            evidence_hash=eh,
+            proposer_address=pa,
+            version_block=vb,
+            version_app=va,
+        )
+
+
+@dataclass
+class Data:
+    """Block body: transactions (types/block.go Data)."""
+
+    txs: Txs = field(default_factory=Txs)
+    _hash: Optional[bytes] = field(default=None, repr=False, compare=False)
+
+    def hash(self) -> bytes:
+        if self._hash is None:
+            self._hash = self.txs.hash()
+        return self._hash
+
+    def encode(self) -> bytes:
+        w = Writer()
+        w.write_uvarint(len(self.txs))
+        for tx in self.txs:
+            w.write_bytes(bytes(tx))
+        return w.bytes()
+
+    @classmethod
+    def decode(cls, data: bytes) -> "Data":
+        r = Reader(data)
+        n = r.read_uvarint()
+        return cls(txs=Txs([r.read_bytes() for _ in range(n)]))
+
+
+@dataclass
+class EvidenceData:
+    evidence: list = field(default_factory=list)
+    _hash: Optional[bytes] = field(default=None, repr=False, compare=False)
+
+    def hash(self) -> bytes:
+        if self._hash is None:
+            self._hash = merkle.hash_from_byte_slices([ev.bytes_() for ev in self.evidence])
+        return self._hash
+
+    def encode(self) -> bytes:
+        from tendermint_tpu.types.evidence import encode_evidence
+
+        w = Writer()
+        w.write_uvarint(len(self.evidence))
+        for ev in self.evidence:
+            w.write_bytes(encode_evidence(ev))
+        return w.bytes()
+
+    @classmethod
+    def decode(cls, data: bytes) -> "EvidenceData":
+        from tendermint_tpu.types.evidence import decode_evidence
+
+        r = Reader(data)
+        n = r.read_uvarint()
+        return cls(evidence=[decode_evidence(r.read_bytes()) for _ in range(n)])
+
+
+@dataclass
+class Block:
+    header: Header
+    data: Data
+    evidence: EvidenceData
+    last_commit: Optional[Commit]
+
+    def hash(self) -> Optional[bytes]:
+        if self.last_commit is None and self.header.height > 1:
+            return None
+        self.fill_header()
+        return self.header.hash()
+
+    def fill_header(self) -> None:
+        """Populate derived header hashes (reference Block.fillHeader
+        types/block.go:98)."""
+        h = self.header
+        if not h.last_commit_hash and self.last_commit is not None:
+            h.last_commit_hash = self.last_commit.hash()
+        if not h.data_hash:
+            h.data_hash = self.data.hash()
+        if not h.evidence_hash:
+            h.evidence_hash = self.evidence.hash()
+
+    def validate_basic(self) -> Optional[str]:
+        err = self.header.validate_basic()
+        if err:
+            return f"invalid header: {err}"
+        if self.last_commit is None:
+            if self.header.height != 1:
+                return "nil LastCommit"
+        else:
+            err = self.last_commit.validate_basic()
+            if self.header.height > 1 and err:
+                return f"wrong LastCommit: {err}"
+            if self.last_commit.hash() != self.header.last_commit_hash:
+                return "wrong LastCommitHash"
+        if self.data.hash() != self.header.data_hash:
+            return "wrong DataHash"
+        if self.evidence.hash() != self.header.evidence_hash:
+            return "wrong EvidenceHash"
+        return None
+
+    def make_part_set(self, part_size: int = 65536):
+        from tendermint_tpu.types.part_set import PartSet
+
+        self.fill_header()
+        return PartSet.from_data(self.encode(), part_size)
+
+    def encode(self) -> bytes:
+        w = Writer()
+        w.write_bytes(self.header.encode())
+        w.write_bytes(self.data.encode())
+        w.write_bytes(self.evidence.encode())
+        if self.last_commit is None:
+            w.write_bool(False)
+        else:
+            w.write_bool(True).write_bytes(self.last_commit.encode())
+        return w.bytes()
+
+    @classmethod
+    def decode(cls, data: bytes) -> "Block":
+        r = Reader(data)
+        header = Header.decode(r.read_bytes())
+        body = Data.decode(r.read_bytes())
+        ev = EvidenceData.decode(r.read_bytes())
+        lc = Commit.decode(r.read_bytes()) if r.read_bool() else None
+        return cls(header=header, data=body, evidence=ev, last_commit=lc)
+
+    def __repr__(self) -> str:
+        h = self.hash()
+        return f"Block{{h={self.header.height} hash={h.hex()[:12] if h else None}}}"
+
+
+def make_block(
+    height: int,
+    txs: Txs,
+    last_commit: Optional[Commit],
+    evidence: list,
+) -> Block:
+    """Reference MakeBlock types/block.go:1004."""
+    block = Block(
+        header=Header(height=height),
+        data=Data(txs=txs),
+        evidence=EvidenceData(evidence=list(evidence)),
+        last_commit=last_commit,
+    )
+    block.fill_header()
+    return block

@@ -1,0 +1,148 @@
+"""EventBus: typed event publication over the query-filtered pubsub.
+
+Reference: types/event_bus.go (EventBus wraps libs/pubsub, tags every
+event with tm.event=<type> plus tx.height/tx.hash for txs) and
+types/events.go (event type constants).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+from tendermint_tpu.utils.pubsub import PubSubServer, Query, Subscription
+from tendermint_tpu.utils.service import Service
+
+# Event types (reference types/events.go:30-60)
+EVENT_NEW_BLOCK = "NewBlock"
+EVENT_NEW_BLOCK_HEADER = "NewBlockHeader"
+EVENT_NEW_ROUND_STEP = "NewRoundStep"
+EVENT_NEW_ROUND = "NewRound"
+EVENT_COMPLETE_PROPOSAL = "CompleteProposal"
+EVENT_POLKA = "Polka"
+EVENT_LOCK = "Lock"
+EVENT_RELOCK = "Relock"
+EVENT_UNLOCK = "Unlock"
+EVENT_TIMEOUT_PROPOSE = "TimeoutPropose"
+EVENT_TIMEOUT_WAIT = "TimeoutWait"
+EVENT_VOTE = "Vote"
+EVENT_VALID_BLOCK = "ValidBlock"
+EVENT_TX = "Tx"
+EVENT_VALIDATOR_SET_UPDATES = "ValidatorSetUpdates"
+
+EVENT_TYPE_KEY = "tm.event"
+TX_HASH_KEY = "tx.hash"
+TX_HEIGHT_KEY = "tx.height"
+
+
+def query_for_event(event_type: str) -> Query:
+    return Query(f"{EVENT_TYPE_KEY} = '{event_type}'")
+
+
+EVENT_QUERY_NEW_BLOCK = query_for_event(EVENT_NEW_BLOCK)
+EVENT_QUERY_NEW_BLOCK_HEADER = query_for_event(EVENT_NEW_BLOCK_HEADER)
+EVENT_QUERY_NEW_ROUND_STEP = query_for_event(EVENT_NEW_ROUND_STEP)
+EVENT_QUERY_VOTE = query_for_event(EVENT_VOTE)
+EVENT_QUERY_TX = query_for_event(EVENT_TX)
+EVENT_QUERY_VALIDATOR_SET_UPDATES = query_for_event(EVENT_VALIDATOR_SET_UPDATES)
+
+
+class EventBus(Service):
+    """Typed pub-sub bus carried by every node (reference event_bus.go:32)."""
+
+    def __init__(self):
+        super().__init__(name="EventBus")
+        self._server = PubSubServer(buffer_capacity=100)
+
+    async def subscribe(
+        self, subscriber: str, query: Query, capacity: Optional[int] = None
+    ) -> Subscription:
+        return await self._server.subscribe(subscriber, query, capacity)
+
+    async def unsubscribe(self, subscriber: str, query: Query) -> None:
+        await self._server.unsubscribe(subscriber, query)
+
+    async def unsubscribe_all(self, subscriber: str) -> None:
+        await self._server.unsubscribe_all(subscriber)
+
+    async def _publish(self, event_type: str, data: Any, extra_tags: Optional[Dict[str, List[str]]] = None) -> None:
+        tags: Dict[str, List[str]] = {EVENT_TYPE_KEY: [event_type]}
+        if extra_tags:
+            for k, vs in extra_tags.items():
+                tags.setdefault(k, []).extend(vs)
+        await self._server.publish(data, tags)
+
+    # -- typed publishers (reference event_bus.go:118-260) -----------------
+
+    async def publish_event_new_block(self, data: Any) -> None:
+        extra = _abci_event_tags(getattr(data, "result_begin_block", None)) or {}
+        _merge_tags(extra, _abci_event_tags(getattr(data, "result_end_block", None)))
+        await self._publish(EVENT_NEW_BLOCK, data, extra)
+
+    async def publish_event_new_block_header(self, data: Any) -> None:
+        await self._publish(EVENT_NEW_BLOCK_HEADER, data)
+
+    async def publish_event_vote(self, data: Any) -> None:
+        await self._publish(EVENT_VOTE, data)
+
+    async def publish_event_valid_block(self, data: Any) -> None:
+        await self._publish(EVENT_VALID_BLOCK, data)
+
+    async def publish_event_tx(self, data: Any) -> None:
+        """Tags: tx.height, tx.hash, plus every ABCI event k.v from the
+        DeliverTx response (reference PublishEventTx)."""
+        from tendermint_tpu.types.tx import tx_hash
+
+        tags: Dict[str, List[str]] = {}
+        result = getattr(data, "result", None)
+        _merge_tags(tags, _abci_event_tags(result))
+        tags[TX_HEIGHT_KEY] = [str(data.height)]
+        tags[TX_HASH_KEY] = [tx_hash(data.tx).hex().upper()]
+        await self._publish(EVENT_TX, data, tags)
+
+    async def publish_event_new_round_step(self, data: Any) -> None:
+        await self._publish(EVENT_NEW_ROUND_STEP, data)
+
+    async def publish_event_new_round(self, data: Any) -> None:
+        await self._publish(EVENT_NEW_ROUND, data)
+
+    async def publish_event_complete_proposal(self, data: Any) -> None:
+        await self._publish(EVENT_COMPLETE_PROPOSAL, data)
+
+    async def publish_event_polka(self, data: Any) -> None:
+        await self._publish(EVENT_POLKA, data)
+
+    async def publish_event_lock(self, data: Any) -> None:
+        await self._publish(EVENT_LOCK, data)
+
+    async def publish_event_unlock(self, data: Any) -> None:
+        await self._publish(EVENT_UNLOCK, data)
+
+    async def publish_event_timeout_propose(self, data: Any) -> None:
+        await self._publish(EVENT_TIMEOUT_PROPOSE, data)
+
+    async def publish_event_timeout_wait(self, data: Any) -> None:
+        await self._publish(EVENT_TIMEOUT_WAIT, data)
+
+    async def publish_event_validator_set_updates(self, data: Any) -> None:
+        await self._publish(EVENT_VALIDATOR_SET_UPDATES, data)
+
+
+def _abci_event_tags(result: Any) -> Dict[str, List[str]]:
+    """Flatten ABCI events ([{type, [{key,value}]}]) into query tags."""
+    tags: Dict[str, List[str]] = {}
+    if result is None:
+        return tags
+    for ev in getattr(result, "events", []) or []:
+        if not ev.type:
+            continue
+        for attr in ev.attributes:
+            if attr.key:
+                key = f"{ev.type}.{attr.key.decode() if isinstance(attr.key, bytes) else attr.key}"
+                val = attr.value.decode() if isinstance(attr.value, bytes) else str(attr.value)
+                tags.setdefault(key, []).append(val)
+    return tags
+
+
+def _merge_tags(dst: Dict[str, List[str]], src: Dict[str, List[str]]) -> None:
+    for k, vs in src.items():
+        dst.setdefault(k, []).extend(vs)

@@ -1,0 +1,131 @@
+"""Evidence of validator misbehavior (double signing).
+
+Reference: types/evidence.go (Evidence interface :37,
+DuplicateVoteEvidence :117, Verify :183, MaxEvidenceBytes :23).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional
+
+from tendermint_tpu.codec.binary import Reader, Writer
+from tendermint_tpu.crypto.hash import sha256
+from tendermint_tpu.crypto.keys import PubKey, decode_pubkey, encode_pubkey
+from tendermint_tpu.types.vote import Vote
+
+MAX_EVIDENCE_BYTES = 484 * 4
+
+
+class Evidence:
+    """Interface: Height/Time/Address/Bytes/Hash/Verify/Equal/ValidateBasic."""
+
+    def height(self) -> int:
+        raise NotImplementedError
+
+    def time_ns(self) -> int:
+        raise NotImplementedError
+
+    def address(self) -> bytes:
+        raise NotImplementedError
+
+    def bytes_(self) -> bytes:
+        raise NotImplementedError
+
+    def hash(self) -> bytes:
+        return sha256(self.bytes_())
+
+    def verify(self, chain_id: str, pub_key: PubKey) -> None:
+        raise NotImplementedError
+
+    def validate_basic(self) -> Optional[str]:
+        raise NotImplementedError
+
+
+@dataclass
+class DuplicateVoteEvidence(Evidence):
+    pub_key: PubKey
+    vote_a: Vote
+    vote_b: Vote
+
+    def height(self) -> int:
+        return self.vote_a.height
+
+    def time_ns(self) -> int:
+        return self.vote_a.timestamp_ns
+
+    def address(self) -> bytes:
+        return self.pub_key.address()
+
+    def bytes_(self) -> bytes:
+        w = Writer()
+        w.write_bytes(encode_pubkey(self.pub_key))
+        w.write_bytes(self.vote_a.encode())
+        w.write_bytes(self.vote_b.encode())
+        return w.bytes()
+
+    def verify(self, chain_id: str, pub_key: PubKey) -> None:
+        """Reference DuplicateVoteEvidence.Verify types/evidence.go:183:
+        same H/R/S/type, same validator, different block IDs, both
+        signatures valid for that validator's key."""
+        va, vb = self.vote_a, self.vote_b
+        if va.height != vb.height or va.round != vb.round or va.vote_type != vb.vote_type:
+            raise ValueError("duplicate votes must have same H/R/S")
+        if va.validator_address != vb.validator_address:
+            raise ValueError("duplicate votes must be from same validator")
+        if va.block_id == vb.block_id:
+            raise ValueError("duplicate votes must vote for different blocks")
+        if pub_key.bytes() != self.pub_key.bytes():
+            raise ValueError("evidence pubkey does not match provided pubkey")
+        if pub_key.address() != va.validator_address:
+            raise ValueError("address mismatch")
+        if not pub_key.verify(va.sign_bytes(chain_id), va.signature):
+            raise ValueError("invalid signature on vote A")
+        if not pub_key.verify(vb.sign_bytes(chain_id), vb.signature):
+            raise ValueError("invalid signature on vote B")
+
+    def equal(self, other: "Evidence") -> bool:
+        return isinstance(other, DuplicateVoteEvidence) and self.bytes_() == other.bytes_()
+
+    def validate_basic(self) -> Optional[str]:
+        if self.pub_key is None:
+            return "empty PubKey"
+        for name, v in (("A", self.vote_a), ("B", self.vote_b)):
+            if v is None:
+                return f"empty vote {name}"
+            err = v.validate_basic()
+            if err:
+                return f"invalid vote {name}: {err}"
+        return None
+
+    def __repr__(self) -> str:
+        return f"DuplicateVoteEvidence{{{self.vote_a} vs {self.vote_b}}}"
+
+
+_EVIDENCE_TYPES = {}
+
+
+def register_evidence_type(name: str, decoder) -> None:
+    _EVIDENCE_TYPES[name] = decoder
+
+
+def encode_evidence(ev: Evidence) -> bytes:
+    if isinstance(ev, DuplicateVoteEvidence):
+        return Writer().write_str("duplicate_vote").write_bytes(ev.bytes_()).bytes()
+    raise ValueError(f"unregistered evidence type {type(ev)}")
+
+
+def decode_evidence(data: bytes) -> Evidence:
+    r = Reader(data)
+    name = r.read_str()
+    body = r.read_bytes()
+    if name == "duplicate_vote":
+        rr = Reader(body)
+        pk = decode_pubkey(rr.read_bytes())
+        va = Vote.decode(rr.read_bytes())
+        vb = Vote.decode(rr.read_bytes())
+        return DuplicateVoteEvidence(pub_key=pk, vote_a=va, vote_b=vb)
+    dec = _EVIDENCE_TYPES.get(name)
+    if dec is None:
+        raise ValueError(f"unknown evidence type {name!r}")
+    return dec(body)

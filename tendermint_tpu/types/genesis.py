@@ -1,0 +1,150 @@
+"""GenesisDoc: the chain's initial conditions.
+
+Reference: types/genesis.go (GenesisDoc :38, ValidateAndComplete :65
+region, SaveAs, GenesisDocFromFile). JSON on disk like the reference.
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+import time
+from dataclasses import dataclass, field
+from typing import List, Optional
+
+from tendermint_tpu.crypto.hash import sha256
+from tendermint_tpu.crypto.keys import PubKey, decode_pubkey, encode_pubkey
+from tendermint_tpu.types.params import ConsensusParams
+from tendermint_tpu.types.validator import Validator
+
+MAX_CHAIN_ID_LEN = 50
+
+
+@dataclass
+class GenesisValidator:
+    pub_key: PubKey
+    power: int
+    name: str = ""
+    address: bytes = b""
+
+    def __post_init__(self):
+        if not self.address:
+            self.address = self.pub_key.address()
+
+
+@dataclass
+class GenesisDoc:
+    chain_id: str
+    genesis_time_ns: int = 0
+    consensus_params: Optional[ConsensusParams] = None
+    validators: List[GenesisValidator] = field(default_factory=list)
+    app_hash: bytes = b""
+    app_state: bytes = b"{}"
+
+    def validate_and_complete(self) -> None:
+        """Reference GenesisDoc.ValidateAndComplete."""
+        if not self.chain_id:
+            raise ValueError("genesis doc must include non-empty chain_id")
+        if len(self.chain_id) > MAX_CHAIN_ID_LEN:
+            raise ValueError(f"chain_id in genesis doc is too long (max {MAX_CHAIN_ID_LEN})")
+        if self.consensus_params is None:
+            self.consensus_params = ConsensusParams()
+        else:
+            err = self.consensus_params.validate()
+            if err:
+                raise ValueError(err)
+        for i, v in enumerate(self.validators):
+            if v.power == 0:
+                raise ValueError(f"genesis file cannot contain validators with no voting power: {i}")
+            if v.address and v.pub_key.address() != v.address:
+                raise ValueError(f"incorrect address for validator {i}")
+            if not v.address:
+                v.address = v.pub_key.address()
+        if self.genesis_time_ns == 0:
+            self.genesis_time_ns = time.time_ns()
+
+    def validator_hash(self) -> bytes:
+        from tendermint_tpu.types.validator_set import ValidatorSet
+
+        vs = ValidatorSet([Validator(v.pub_key, v.power) for v in self.validators])
+        return vs.hash()
+
+    def hash(self) -> bytes:
+        return sha256(self.to_json().encode())
+
+    # -- JSON --------------------------------------------------------------
+
+    def to_json(self) -> str:
+        cp = self.consensus_params or ConsensusParams()
+        doc = {
+            "genesis_time_ns": self.genesis_time_ns,
+            "chain_id": self.chain_id,
+            "consensus_params": {
+                "block": {
+                    "max_bytes": cp.block.max_bytes,
+                    "max_gas": cp.block.max_gas,
+                    "time_iota_ms": cp.block.time_iota_ms,
+                },
+                "evidence": {
+                    "max_age_num_blocks": cp.evidence.max_age_num_blocks,
+                    "max_age_duration_ns": cp.evidence.max_age_duration_ns,
+                },
+                "validator": {"pub_key_types": cp.validator.pub_key_types},
+            },
+            "validators": [
+                {
+                    "address": v.address.hex(),
+                    "pub_key": base64.b64encode(encode_pubkey(v.pub_key)).decode(),
+                    "power": str(v.power),
+                    "name": v.name,
+                }
+                for v in self.validators
+            ],
+            "app_hash": self.app_hash.hex(),
+            "app_state": json.loads(self.app_state.decode() or "{}"),
+        }
+        return json.dumps(doc, sort_keys=True, indent=2)
+
+    @classmethod
+    def from_json(cls, raw: str) -> "GenesisDoc":
+        doc = json.loads(raw)
+        from tendermint_tpu.types.params import (
+            BlockParams,
+            EvidenceParams,
+            ValidatorParams,
+        )
+
+        cp_doc = doc.get("consensus_params") or {}
+        cp = ConsensusParams(
+            block=BlockParams(**cp_doc.get("block", {})),
+            evidence=EvidenceParams(**cp_doc.get("evidence", {})),
+            validator=ValidatorParams(**cp_doc.get("validator", {})),
+        )
+        vals = [
+            GenesisValidator(
+                pub_key=decode_pubkey(base64.b64decode(v["pub_key"])),
+                power=int(v["power"]),
+                name=v.get("name", ""),
+                address=bytes.fromhex(v.get("address", "")),
+            )
+            for v in doc.get("validators", [])
+        ]
+        gd = cls(
+            chain_id=doc["chain_id"],
+            genesis_time_ns=doc.get("genesis_time_ns", 0),
+            consensus_params=cp,
+            validators=vals,
+            app_hash=bytes.fromhex(doc.get("app_hash", "")),
+            app_state=json.dumps(doc.get("app_state", {})).encode(),
+        )
+        gd.validate_and_complete()
+        return gd
+
+    def save_as(self, path: str) -> None:
+        with open(path, "w") as f:
+            f.write(self.to_json())
+
+    @classmethod
+    def from_file(cls, path: str) -> "GenesisDoc":
+        with open(path) as f:
+            return cls.from_json(f.read())

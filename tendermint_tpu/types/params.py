@@ -1,0 +1,96 @@
+"""Consensus parameters (block size, evidence age, allowed key types).
+
+Reference: types/params.go (ConsensusParams :26 region, DefaultConsensusParams,
+Validate, Update, Hash; MaxBlockSizeBytes 100MB :14, BlockPartSizeBytes :21).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field, replace
+from typing import List, Optional
+
+from tendermint_tpu.codec.binary import Reader, Writer
+from tendermint_tpu.crypto.hash import sha256
+
+MAX_BLOCK_SIZE_BYTES = 104857600  # 100MB
+
+
+@dataclass
+class BlockParams:
+    max_bytes: int = 22020096  # 21MB default (reference DefaultBlockParams)
+    max_gas: int = -1
+    time_iota_ms: int = 1000
+
+
+@dataclass
+class EvidenceParams:
+    max_age_num_blocks: int = 100000
+    max_age_duration_ns: int = 48 * 3600 * 10**9  # 48h
+
+
+@dataclass
+class ValidatorParams:
+    pub_key_types: List[str] = field(default_factory=lambda: ["ed25519"])
+
+
+@dataclass
+class ConsensusParams:
+    block: BlockParams = field(default_factory=BlockParams)
+    evidence: EvidenceParams = field(default_factory=EvidenceParams)
+    validator: ValidatorParams = field(default_factory=ValidatorParams)
+
+    def validate(self) -> Optional[str]:
+        if self.block.max_bytes <= 0:
+            return "block.MaxBytes must be greater than 0"
+        if self.block.max_bytes > MAX_BLOCK_SIZE_BYTES:
+            return f"block.MaxBytes is too big ({self.block.max_bytes})"
+        if self.block.max_gas < -1:
+            return "block.MaxGas must be >= -1"
+        if self.block.time_iota_ms <= 0:
+            return "block.TimeIotaMs must be greater than 0"
+        if self.evidence.max_age_num_blocks <= 0:
+            return "evidenceParams.MaxAgeNumBlocks must be greater than 0"
+        if self.evidence.max_age_duration_ns <= 0:
+            return "evidenceParams.MaxAgeDuration must be greater than 0"
+        if not self.validator.pub_key_types:
+            return "len(Validator.PubKeyTypes) must be greater than 0"
+        return None
+
+    def hash(self) -> bytes:
+        w = Writer()
+        w.write_i64(self.block.max_bytes).write_i64(self.block.max_gas)
+        w.write_i64(self.block.time_iota_ms)
+        w.write_i64(self.evidence.max_age_num_blocks)
+        w.write_i64(self.evidence.max_age_duration_ns)
+        w.write_uvarint(len(self.validator.pub_key_types))
+        for t in self.validator.pub_key_types:
+            w.write_str(t)
+        return sha256(w.bytes())
+
+    def update(self, changes: Optional["ConsensusParams"]) -> "ConsensusParams":
+        if changes is None:
+            return replace(self)
+        return ConsensusParams(
+            block=replace(changes.block),
+            evidence=replace(changes.evidence),
+            validator=ValidatorParams(list(changes.validator.pub_key_types)),
+        )
+
+    def encode(self) -> bytes:
+        w = Writer()
+        w.write_i64(self.block.max_bytes).write_i64(self.block.max_gas)
+        w.write_i64(self.block.time_iota_ms)
+        w.write_i64(self.evidence.max_age_num_blocks)
+        w.write_i64(self.evidence.max_age_duration_ns)
+        w.write_uvarint(len(self.validator.pub_key_types))
+        for t in self.validator.pub_key_types:
+            w.write_str(t)
+        return w.bytes()
+
+    @classmethod
+    def decode(cls, data: bytes) -> "ConsensusParams":
+        r = Reader(data)
+        b = BlockParams(r.read_i64(), r.read_i64(), r.read_i64())
+        e = EvidenceParams(r.read_i64(), r.read_i64())
+        v = ValidatorParams([r.read_str() for _ in range(r.read_uvarint())])
+        return cls(block=b, evidence=e, validator=v)

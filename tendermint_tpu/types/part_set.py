@@ -1,0 +1,151 @@
+"""PartSet: blocks split into merkle-proven 64KB parts for gossip.
+
+Reference: types/part_set.go (Part :18, PartSet :99, BlockPartSizeBytes
+65536 at types/params.go:21). Parts let peers transfer a proposed block
+in parallel chunks, each independently verifiable against the
+PartSetHeader hash in the proposal.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import List, Optional
+
+from tendermint_tpu.codec.binary import Reader, Writer
+from tendermint_tpu.crypto import merkle
+from tendermint_tpu.types.block import PartSetHeader
+from tendermint_tpu.utils.bits import BitArray
+
+BLOCK_PART_SIZE = 65536
+
+
+class ErrPartSetUnexpectedIndex(Exception):
+    pass
+
+
+class ErrPartSetInvalidProof(Exception):
+    pass
+
+
+@dataclass
+class Part:
+    index: int
+    bytes_: bytes
+    proof: merkle.SimpleProof
+
+    def validate_basic(self) -> Optional[str]:
+        if self.index < 0:
+            return "negative Index"
+        if len(self.bytes_) > BLOCK_PART_SIZE:
+            return "part bytes too big"
+        return None
+
+    def encode(self) -> bytes:
+        w = Writer()
+        w.write_i64(self.index)
+        w.write_bytes(self.bytes_)
+        w.write_i64(self.proof.total).write_i64(self.proof.index)
+        w.write_bytes(self.proof.leaf_hash)
+        w.write_uvarint(len(self.proof.aunts))
+        for a in self.proof.aunts:
+            w.write_bytes(a)
+        return w.bytes()
+
+    @classmethod
+    def decode(cls, data: bytes) -> "Part":
+        r = Reader(data)
+        idx = r.read_i64()
+        b = r.read_bytes(BLOCK_PART_SIZE + 64)
+        total = r.read_i64()
+        pidx = r.read_i64()
+        lh = r.read_bytes()
+        aunts = [r.read_bytes() for _ in range(r.read_uvarint())]
+        return cls(index=idx, bytes_=b, proof=merkle.SimpleProof(total, pidx, lh, aunts))
+
+
+class PartSet:
+    """Either built complete from data (proposer side) or assembled
+    incrementally from a header (receiver side)."""
+
+    def __init__(self, header: PartSetHeader):
+        self._header = header
+        self._parts: List[Optional[Part]] = [None] * header.total
+        self._mask = BitArray(header.total)
+        self._count = 0
+
+    # -- constructors ------------------------------------------------------
+
+    @classmethod
+    def from_data(cls, data: bytes, part_size: int = BLOCK_PART_SIZE) -> "PartSet":
+        total = max(1, (len(data) + part_size - 1) // part_size)
+        chunks = [data[i * part_size : (i + 1) * part_size] for i in range(total)]
+        root, proofs = merkle.proofs_from_byte_slices(chunks)
+        ps = cls(PartSetHeader(total=total, hash=root))
+        for i, chunk in enumerate(chunks):
+            ps._parts[i] = Part(index=i, bytes_=chunk, proof=proofs[i])
+            ps._mask.set_index(i, True)
+        ps._count = total
+        return ps
+
+    @classmethod
+    def new_from_header(cls, header: PartSetHeader) -> "PartSet":
+        return cls(header)
+
+    # -- accessors ---------------------------------------------------------
+
+    def header(self) -> PartSetHeader:
+        return self._header
+
+    def has_header(self, header: PartSetHeader) -> bool:
+        return self._header == header
+
+    def bit_array(self) -> BitArray:
+        return self._mask.copy()
+
+    def hash(self) -> bytes:
+        return self._header.hash
+
+    @property
+    def total(self) -> int:
+        return self._header.total
+
+    @property
+    def count(self) -> int:
+        return self._count
+
+    def is_complete(self) -> bool:
+        return self._count == self._header.total
+
+    def get_part(self, index: int) -> Optional[Part]:
+        if 0 <= index < len(self._parts):
+            return self._parts[index]
+        return None
+
+    # -- assembly ----------------------------------------------------------
+
+    def add_part(self, part: Part) -> bool:
+        """Add a received part after proof verification (reference
+        PartSet.AddPart types/part_set.go:218)."""
+        err = part.validate_basic()
+        if err:
+            raise ErrPartSetInvalidProof(err)
+        if part.index < 0 or part.index >= self._header.total:
+            raise ErrPartSetUnexpectedIndex(part.index)
+        if self._parts[part.index] is not None:
+            return False
+        try:
+            part.proof.verify(self._header.hash, part.bytes_)
+        except ValueError as e:
+            raise ErrPartSetInvalidProof(str(e))
+        self._parts[part.index] = part
+        self._mask.set_index(part.index, True)
+        self._count += 1
+        return True
+
+    def assemble(self) -> bytes:
+        if not self.is_complete():
+            raise ValueError("incomplete part set")
+        return b"".join(p.bytes_ for p in self._parts)  # type: ignore[union-attr]
+
+    def __repr__(self) -> str:
+        return f"PartSet{{{self._count}/{self._header.total}}}"

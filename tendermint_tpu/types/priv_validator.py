@@ -1,0 +1,63 @@
+"""PrivValidator interface + mock for tests.
+
+Reference: types/priv_validator.go (PrivValidator :13, MockPV :51,
+ErroringMockPV). The production FilePV with double-sign protection lives
+in tendermint_tpu.privval.
+"""
+
+from __future__ import annotations
+
+from tendermint_tpu.crypto.keys import Ed25519PrivKey, PubKey
+from tendermint_tpu.types.proposal import Proposal
+from tendermint_tpu.types.vote import Vote
+
+
+class PrivValidator:
+    def get_pub_key(self) -> PubKey:
+        raise NotImplementedError
+
+    def sign_vote(self, chain_id: str, vote: Vote) -> None:
+        """Sign and fill vote.signature (may raise)."""
+        raise NotImplementedError
+
+    def sign_proposal(self, chain_id: str, proposal: Proposal) -> None:
+        raise NotImplementedError
+
+
+class MockPV(PrivValidator):
+    """In-memory signer for tests; optionally misbehaving
+    (reference MockPV breakProposalSigning/breakVoteSigning)."""
+
+    def __init__(
+        self,
+        priv_key: Ed25519PrivKey = None,
+        break_proposal_signing: bool = False,
+        break_vote_signing: bool = False,
+    ):
+        self.priv_key = priv_key or Ed25519PrivKey.generate()
+        self.break_proposal_signing = break_proposal_signing
+        self.break_vote_signing = break_vote_signing
+
+    def get_pub_key(self) -> PubKey:
+        return self.priv_key.pub_key()
+
+    def sign_vote(self, chain_id: str, vote: Vote) -> None:
+        use_chain_id = "incorrect-chain-id" if self.break_vote_signing else chain_id
+        vote.signature = self.priv_key.sign(vote.sign_bytes(use_chain_id))
+
+    def sign_proposal(self, chain_id: str, proposal: Proposal) -> None:
+        use_chain_id = "incorrect-chain-id" if self.break_proposal_signing else chain_id
+        proposal.signature = self.priv_key.sign(proposal.sign_bytes(use_chain_id))
+
+    def address(self) -> bytes:
+        return self.get_pub_key().address()
+
+
+class ErroringMockPV(MockPV):
+    """Always fails to sign (reference ErroringMockPV)."""
+
+    def sign_vote(self, chain_id: str, vote: Vote) -> None:
+        raise RuntimeError("erroring mock private validator")
+
+    def sign_proposal(self, chain_id: str, proposal: Proposal) -> None:
+        raise RuntimeError("erroring mock private validator")

@@ -1,0 +1,78 @@
+"""Proposal: a proposed block at height/round with POL round.
+
+Reference: types/proposal.go (Proposal :16, SignBytes :62).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional
+
+from tendermint_tpu.codec import signbytes
+from tendermint_tpu.codec.binary import Reader, Writer
+from tendermint_tpu.codec.signbytes import PROPOSAL_TYPE
+from tendermint_tpu.types.block import BlockID
+
+
+@dataclass
+class Proposal:
+    height: int
+    round: int
+    pol_round: int  # -1 if no POL
+    block_id: BlockID
+    timestamp_ns: int
+    signature: bytes = b""
+
+    def sign_bytes(self, chain_id: str) -> bytes:
+        return signbytes.canonical_sign_bytes(
+            msg_type=PROPOSAL_TYPE,
+            height=self.height,
+            round_=self.round,
+            block_hash=self.block_id.hash,
+            parts_total=self.block_id.parts.total,
+            parts_hash=self.block_id.parts.hash,
+            timestamp_ns=self.timestamp_ns,
+            chain_id=chain_id,
+            pol_round=self.pol_round,
+        )
+
+    def validate_basic(self) -> Optional[str]:
+        if self.height < 0:
+            return "negative Height"
+        if self.round < 0:
+            return "negative Round"
+        if self.pol_round < -1:
+            return "negative POLRound (exception: -1)"
+        err = self.block_id.validate_basic()
+        if err:
+            return f"wrong BlockID: {err}"
+        if not self.block_id.is_complete():
+            return "BlockID must be complete"
+        if not self.signature:
+            return "signature is missing"
+        if len(self.signature) > 64:
+            return "signature too big"
+        return None
+
+    def encode(self) -> bytes:
+        w = Writer()
+        w.write_u64(self.height).write_i64(self.round).write_i64(self.pol_round)
+        w.write_bytes(self.block_id.encode())
+        w.write_i64(self.timestamp_ns)
+        w.write_bytes(self.signature)
+        return w.bytes()
+
+    @classmethod
+    def decode(cls, data: bytes) -> "Proposal":
+        r = Reader(data)
+        return cls(
+            height=r.read_u64(),
+            round=r.read_i64(),
+            pol_round=r.read_i64(),
+            block_id=BlockID.decode(r.read_bytes()),
+            timestamp_ns=r.read_i64(),
+            signature=r.read_bytes(),
+        )
+
+    def __repr__(self) -> str:
+        return f"Proposal{{{self.height}/{self.round} ({self.block_id}, POL:{self.pol_round})}}"

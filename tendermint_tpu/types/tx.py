@@ -1,0 +1,52 @@
+"""Transactions. Reference: types/tx.go (Tx.Hash :31, Txs.Hash :41,
+Txs.Proof :61 region).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+from tendermint_tpu.crypto import merkle
+from tendermint_tpu.crypto.hash import sha256
+
+Tx = bytes
+
+
+class Txs(list):
+    """List of raw txs with merkle hashing."""
+
+    def hash(self) -> bytes:
+        return merkle.hash_from_byte_slices([bytes(tx) for tx in self])
+
+    def index(self, tx: Tx) -> int:
+        for i, t in enumerate(self):
+            if bytes(t) == bytes(tx):
+                return i
+        return -1
+
+    def proof(self, i: int):
+        root, proofs = merkle.proofs_from_byte_slices([bytes(tx) for tx in self])
+        return TxProof(root_hash=root, data=bytes(self[i]), proof=proofs[i])
+
+
+def tx_hash(tx: Tx) -> bytes:
+    return sha256(bytes(tx))
+
+
+class TxProof:
+    def __init__(self, root_hash: bytes, data: bytes, proof: merkle.SimpleProof):
+        self.root_hash = root_hash
+        self.data = data
+        self.proof = proof
+
+    def leaf(self) -> bytes:
+        return self.data
+
+    def validate(self, data_hash: bytes) -> Optional[str]:
+        if data_hash != self.root_hash:
+            return "proof matches different data hash"
+        try:
+            self.proof.verify(self.root_hash, self.data)
+        except ValueError as e:
+            return str(e)
+        return None

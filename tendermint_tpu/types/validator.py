@@ -1,0 +1,79 @@
+"""Validator: address + pubkey + voting power + proposer priority.
+
+Reference: types/validator.go (Validator struct :13, CompareProposerPriority
+:74 region, Bytes for hashing).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field, replace
+from typing import Optional
+
+from tendermint_tpu.codec.binary import Reader, Writer
+from tendermint_tpu.crypto.keys import PubKey, decode_pubkey, encode_pubkey
+
+
+@dataclass
+class Validator:
+    pub_key: PubKey
+    voting_power: int
+    proposer_priority: int = 0
+    address: bytes = field(default=b"")
+
+    def __post_init__(self):
+        if not self.address:
+            self.address = self.pub_key.address()
+
+    def copy(self) -> "Validator":
+        return replace(self)
+
+    def compare_proposer_priority(self, other: "Validator") -> "Validator":
+        """Return the validator with higher priority; ties break by lower
+        address (reference types/validator.go:47-70)."""
+        if self.proposer_priority > other.proposer_priority:
+            return self
+        if self.proposer_priority < other.proposer_priority:
+            return other
+        if self.address < other.address:
+            return self
+        if self.address > other.address:
+            return other
+        raise AssertionError("same address in priority comparison")
+
+    def hash_bytes(self) -> bytes:
+        """Deterministic encoding for the validators merkle root
+        (reference Validator.Bytes types/validator.go:102 -- pubkey +
+        voting power only, NOT priority)."""
+        return (
+            Writer()
+            .write_bytes(encode_pubkey(self.pub_key))
+            .write_i64(self.voting_power)
+            .bytes()
+        )
+
+    def encode(self) -> bytes:
+        return (
+            Writer()
+            .write_bytes(encode_pubkey(self.pub_key))
+            .write_i64(self.voting_power)
+            .write_i64(self.proposer_priority)
+            .bytes()
+        )
+
+    @classmethod
+    def decode(cls, data: bytes) -> "Validator":
+        r = Reader(data)
+        pk = decode_pubkey(r.read_bytes())
+        power = r.read_i64()
+        prio = r.read_i64()
+        return cls(pub_key=pk, voting_power=power, proposer_priority=prio)
+
+    def __repr__(self) -> str:
+        return (
+            f"Validator{{{self.address.hex()[:12]} VP:{self.voting_power} "
+            f"A:{self.proposer_priority}}}"
+        )
+
+
+def new_validator(pub_key: PubKey, voting_power: int) -> Validator:
+    return Validator(pub_key=pub_key, voting_power=voting_power)

@@ -1,0 +1,452 @@
+"""ValidatorSet: ordered validators + proposer rotation + commit verification.
+
+Reference: types/validator_set.go -- ValidatorSet :42,
+IncrementProposerPriority :86, RescalePriorities :130,
+UpdateWithChangeSet :803 region, VerifyCommit :629, VerifyCommitTrusting
+:754.
+
+The TPU-first change: ``verify_commit`` / ``verify_commit_trusting`` do
+NOT loop ``pubkey.verify`` per signature like the reference
+(types/validator_set.go:641-668). They pack all present signatures into
+rectangular arrays and make ONE BatchVerifier call (device segment-sum
+tally fused), then replay the reference's sequential-early-return
+semantics over the returned ok/power vectors so acceptance is bit-for-bit
+identical to the serial loop.
+"""
+
+from __future__ import annotations
+
+from fractions import Fraction
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from tendermint_tpu.codec.binary import Reader, Writer
+from tendermint_tpu.crypto import merkle
+from tendermint_tpu.crypto.batch import BatchVerifier, get_default_provider
+from tendermint_tpu.types.validator import Validator
+
+MAX_TOTAL_VOTING_POWER = (1 << 63) // 8
+PRIORITY_WINDOW_SIZE_FACTOR = 2
+
+
+class ErrTotalVotingPowerOverflow(Exception):
+    pass
+
+
+class ErrNotEnoughVotingPower(Exception):
+    pass
+
+
+class ErrInvalidCommitSignature(Exception):
+    pass
+
+
+class ErrInvalidCommit(Exception):
+    pass
+
+
+class ValidatorSet:
+    def __init__(self, validators: Sequence[Validator]):
+        vals = [v.copy() for v in validators]
+        vals.sort(key=lambda v: v.address)
+        addrs = [v.address for v in vals]
+        if len(set(addrs)) != len(addrs):
+            raise ValueError("duplicate validator address")
+        self.validators: List[Validator] = vals
+        self.proposer: Optional[Validator] = None
+        self._total_voting_power: Optional[int] = None
+        self._addr_index: Dict[bytes, int] = {v.address: i for i, v in enumerate(vals)}
+        if vals:
+            self._update_total_voting_power()
+            self.increment_proposer_priority(1)
+
+    # -- basic accessors ---------------------------------------------------
+
+    def __len__(self) -> int:
+        return len(self.validators)
+
+    def size(self) -> int:
+        return len(self.validators)
+
+    def is_nil_or_empty(self) -> bool:
+        return len(self.validators) == 0
+
+    def has_address(self, addr: bytes) -> bool:
+        return addr in self._addr_index
+
+    def get_by_address(self, addr: bytes) -> Tuple[int, Optional[Validator]]:
+        i = self._addr_index.get(addr)
+        if i is None:
+            return -1, None
+        return i, self.validators[i]
+
+    def get_by_index(self, index: int) -> Tuple[bytes, Optional[Validator]]:
+        if index < 0 or index >= len(self.validators):
+            return b"", None
+        v = self.validators[index]
+        return v.address, v
+
+    def total_voting_power(self) -> int:
+        if self._total_voting_power is None:
+            self._update_total_voting_power()
+        return self._total_voting_power  # type: ignore[return-value]
+
+    def _update_total_voting_power(self) -> None:
+        total = 0
+        for v in self.validators:
+            total += v.voting_power
+            if total > MAX_TOTAL_VOTING_POWER:
+                raise ErrTotalVotingPowerOverflow(total)
+        self._total_voting_power = total
+
+    def copy(self) -> "ValidatorSet":
+        new = ValidatorSet.__new__(ValidatorSet)
+        new.validators = [v.copy() for v in self.validators]
+        new.proposer = self.proposer.copy() if self.proposer else None
+        new._total_voting_power = self._total_voting_power
+        new._addr_index = dict(self._addr_index)
+        return new
+
+    def hash(self) -> bytes:
+        """Merkle root over validator (pubkey, power) encodings
+        (reference ValidatorSet.Hash types/validator_set.go:307)."""
+        return merkle.hash_from_byte_slices([v.hash_bytes() for v in self.validators])
+
+    # -- proposer rotation (reference :86-:189) ---------------------------
+
+    def increment_proposer_priority(self, times: int) -> None:
+        if self.is_nil_or_empty():
+            raise ValueError("empty validator set")
+        if times <= 0:
+            raise ValueError("times must be positive")
+        diff_max = PRIORITY_WINDOW_SIZE_FACTOR * self.total_voting_power()
+        self.rescale_priorities(diff_max)
+        self._shift_by_avg_proposer_priority()
+        proposer = None
+        for _ in range(times):
+            proposer = self._increment_proposer_priority_once()
+        self.proposer = proposer
+
+    def _increment_proposer_priority_once(self) -> Validator:
+        for v in self.validators:
+            v.proposer_priority = _safe_add(v.proposer_priority, v.voting_power)
+        most = self._validator_with_most_priority()
+        most.proposer_priority = _safe_sub(most.proposer_priority, self.total_voting_power())
+        return most
+
+    def _validator_with_most_priority(self) -> Validator:
+        res = self.validators[0]
+        for v in self.validators[1:]:
+            res = res.compare_proposer_priority(v)
+        return res
+
+    def _compute_avg_proposer_priority(self) -> int:
+        n = len(self.validators)
+        total = sum(v.proposer_priority for v in self.validators)
+        # Reference uses big.Int.Div (Euclidean), which for positive n is
+        # floor division -- Python's // (types/validator_set.go:156).
+        return total // n
+
+    def _shift_by_avg_proposer_priority(self) -> None:
+        avg = self._compute_avg_proposer_priority()
+        for v in self.validators:
+            v.proposer_priority = _safe_sub(v.proposer_priority, avg)
+
+    def rescale_priorities(self, diff_max: int) -> None:
+        """Scale priorities so max-min <= diff_max (reference :130)."""
+        if diff_max <= 0:
+            return
+        diff = _compute_max_min_priority_diff(self.validators)
+        ratio = (diff + diff_max - 1) // diff_max if diff > 0 else 1
+        if diff > diff_max:
+            for v in self.validators:
+                # truncate toward zero like Go
+                p = v.proposer_priority
+                v.proposer_priority = -((-p) // ratio) if p < 0 else p // ratio
+
+    def get_proposer(self) -> Optional[Validator]:
+        if not self.validators:
+            return None
+        if self.proposer is None:
+            self.proposer = self._find_proposer()
+        return self.proposer.copy()
+
+    def _find_proposer(self) -> Validator:
+        res = None
+        for v in self.validators:
+            res = v if res is None else res.compare_proposer_priority(v)
+        return res  # type: ignore[return-value]
+
+    def copy_increment_proposer_priority(self, times: int) -> "ValidatorSet":
+        cp = self.copy()
+        cp.increment_proposer_priority(times)
+        return cp
+
+    # -- updates (reference UpdateWithChangeSet :803) ----------------------
+
+    def update_with_change_set(self, changes: Sequence[Validator]) -> None:
+        self._update_with_change_set(changes, allow_deletes=True)
+
+    def _update_with_change_set(self, changes: Sequence[Validator], allow_deletes: bool) -> None:
+        if not changes:
+            return
+        # verify: sorted-by-address unique changes, valid powers
+        seen = set()
+        updates, removals = [], []
+        for c in changes:
+            if c.address in seen:
+                raise ValueError(f"duplicate address in changes: {c.address.hex()}")
+            seen.add(c.address)
+            if c.voting_power < 0:
+                raise ValueError("voting power can't be negative")
+            if c.voting_power > MAX_TOTAL_VOTING_POWER:
+                raise ValueError("voting power too high")
+            if c.voting_power == 0:
+                if not allow_deletes:
+                    raise ValueError("can't delete validator in this context")
+                removals.append(c)
+            else:
+                updates.append(c)
+
+        # check removals exist
+        for c in removals:
+            if c.address not in self._addr_index:
+                raise ValueError(f"removing non-existent validator {c.address.hex()}")
+
+        # compute the new total power for priority assignment of new vals
+        by_addr = {v.address: v for v in self.validators}
+        new_total = self.total_voting_power()
+        for c in updates:
+            prev = by_addr.get(c.address)
+            new_total += c.voting_power - (prev.voting_power if prev else 0)
+        for c in removals:
+            new_total -= by_addr[c.address].voting_power
+        if new_total > MAX_TOTAL_VOTING_POWER:
+            raise ErrTotalVotingPowerOverflow(new_total)
+        if new_total <= 0:
+            raise ValueError("applying the changes would empty the validator set")
+
+        # apply: new validators get priority -(total + total>>3)
+        # (reference computeNewPriorities :744 -- -1.125 * new total power)
+        new_priority = -(new_total + (new_total >> 3))
+        for c in updates:
+            prev = by_addr.get(c.address)
+            if prev is not None:
+                prev.voting_power = c.voting_power
+            else:
+                v = c.copy()
+                v.proposer_priority = new_priority
+                by_addr[v.address] = v
+        for c in removals:
+            del by_addr[c.address]
+
+        vals = sorted(by_addr.values(), key=lambda v: v.address)
+        self.validators = vals
+        self._addr_index = {v.address: i for i, v in enumerate(vals)}
+        self._total_voting_power = None
+        self._update_total_voting_power()
+
+        # rescale and recenter, then recompute proposer
+        self.rescale_priorities(PRIORITY_WINDOW_SIZE_FACTOR * self.total_voting_power())
+        self._shift_by_avg_proposer_priority()
+        self.proposer = self._find_proposer()
+
+    # -- commit verification (THE hot path) --------------------------------
+
+    def _commit_batch_arrays(self, chain_id: str, commit, by_address: bool) -> Tuple:
+        """Pack a commit's present signatures into device-ready arrays.
+
+        `by_address=False` maps signature index i straight to validator i
+        (verify_commit: commit produced by THIS set); `by_address=True`
+        looks each signer up by address, skipping unknowns and rejecting
+        double-votes (verify_commit_trusting: commit from another set).
+
+        Returns (idxs, pubkeys(N,32), msgs(N,160), sigs(N,64),
+        powers(N,), counted(N,)) where idxs maps rows back to signature
+        indices.
+        """
+        idxs: List[int] = []
+        pks: List[bytes] = []
+        msgs: List[bytes] = []
+        sigs: List[bytes] = []
+        powers: List[int] = []
+        counted: List[bool] = []
+        seen_vals: Dict[int, int] = {}
+        for i, cs in enumerate(commit.signatures):
+            if cs.absent_():
+                continue
+            if by_address:
+                vi, val = self.get_by_address(cs.validator_address)
+                if val is None:
+                    continue
+                # Reject double votes by the same validator (reference :779).
+                if vi in seen_vals:
+                    raise ErrInvalidCommit(f"double vote from validator index {vi}")
+                seen_vals[vi] = i
+            else:
+                val = self.validators[i]
+            idxs.append(i)
+            pks.append(val.pub_key.bytes())
+            msgs.append(commit.vote_sign_bytes(chain_id, i))
+            sigs.append(cs.signature)
+            powers.append(val.voting_power)
+            counted.append(cs.for_block())
+        n = len(idxs)
+        pk = np.zeros((n, 32), dtype=np.uint8)
+        mg = np.zeros((n, 160), dtype=np.uint8)
+        sg = np.zeros((n, 64), dtype=np.uint8)
+        for r in range(n):
+            pk[r] = np.frombuffer(pks[r], dtype=np.uint8)
+            mg[r] = np.frombuffer(msgs[r], dtype=np.uint8)
+            sig = sigs[r][:64]
+            sg[r, : len(sig)] = np.frombuffer(sig, dtype=np.uint8)
+        return (
+            idxs,
+            pk,
+            mg,
+            sg,
+            np.asarray(powers, dtype=np.int64),
+            np.asarray(counted, dtype=bool),
+        )
+
+    def verify_commit(
+        self,
+        chain_id: str,
+        block_id,
+        height: int,
+        commit,
+        provider: Optional[BatchVerifier] = None,
+    ) -> None:
+        """Verify +2/3 of this set signed `block_id` at `height`.
+
+        Reference semantics (types/validator_set.go:629-668): iterate
+        signatures in order, fail on the first invalid signature, succeed
+        as soon as tallied for-block power exceeds 2/3 of total. Here the
+        signatures are verified in ONE device batch; the sequential
+        early-return acceptance is then replayed over the result vectors,
+        so the accepted language is identical.
+        """
+        if len(self.validators) != len(commit.signatures):
+            raise ErrInvalidCommit(
+                f"wrong set size: {len(self.validators)} vs {len(commit.signatures)}"
+            )
+        if height != commit.height:
+            raise ErrInvalidCommit(f"wrong height: {height} vs {commit.height}")
+        if block_id != commit.block_id:
+            raise ErrInvalidCommit(f"wrong block ID: {block_id} vs {commit.block_id}")
+
+        idxs, pk, mg, sg, powers, counted = self._commit_batch_arrays(
+            chain_id, commit, by_address=False
+        )
+        v = provider or get_default_provider()
+        ok, _talled = v.verify_commit_batch(pk, mg, sg, powers, counted)
+
+        voting_power_needed = self.total_voting_power() * 2 // 3
+        talled = 0
+        for r, i in enumerate(idxs):
+            if talled > voting_power_needed:
+                return  # quorum reached before this signature was needed
+            if not ok[r]:
+                raise ErrInvalidCommitSignature(
+                    f"wrong signature #{i} ({commit.signatures[i].validator_address.hex()})"
+                )
+            if counted[r]:
+                talled += int(powers[r])
+        if talled > voting_power_needed:
+            return
+        raise ErrNotEnoughVotingPower(f"have {talled}, need > {voting_power_needed}")
+
+    def verify_commit_trusting(
+        self,
+        chain_id: str,
+        commit,
+        trust_level: Fraction,
+        provider: Optional[BatchVerifier] = None,
+    ) -> None:
+        """Verify that `trust_level` (e.g. 1/3) of THIS set signed the
+        commit, looking validators up by address (the commit was produced
+        by a possibly different set). Reference VerifyCommitTrusting
+        types/validator_set.go:754; the trust level must be in [1/3, 1]
+        (reference ValidateTrustLevel, lite2/verifier.go)."""
+        if (
+            trust_level.denominator == 0
+            or trust_level.numerator * 3 < trust_level.denominator
+            or trust_level.numerator > trust_level.denominator
+        ):
+            raise ValueError(f"trust level must be within [1/3, 1], got {trust_level}")
+
+        idxs, pk, mg, sg, powers_arr, counted_arr = self._commit_batch_arrays(
+            chain_id, commit, by_address=True
+        )
+        v = provider or get_default_provider()
+        ok, _ = v.verify_commit_batch(pk, mg, sg, powers_arr, counted_arr)
+
+        total = self.total_voting_power()
+        needed = total * trust_level.numerator // trust_level.denominator
+        talled = 0
+        for r, i in enumerate(idxs):
+            if talled > needed:
+                return
+            if not ok[r]:
+                raise ErrInvalidCommitSignature(f"wrong signature #{i}")
+            if counted_arr[r]:
+                talled += int(powers_arr[r])
+        if talled > needed:
+            return
+        raise ErrNotEnoughVotingPower(f"have {talled}, need > {needed}")
+
+    # -- encoding ----------------------------------------------------------
+
+    def encode(self) -> bytes:
+        w = Writer()
+        w.write_uvarint(len(self.validators))
+        for v in self.validators:
+            w.write_bytes(v.encode())
+        if self.proposer is not None:
+            w.write_bool(True).write_bytes(self.proposer.address)
+        else:
+            w.write_bool(False)
+        return w.bytes()
+
+    @classmethod
+    def decode(cls, data: bytes) -> "ValidatorSet":
+        r = Reader(data)
+        n = r.read_uvarint()
+        vals = [Validator.decode(r.read_bytes()) for _ in range(n)]
+        vs = cls.__new__(cls)
+        vs.validators = sorted(vals, key=lambda v: v.address)
+        vs._addr_index = {v.address: i for i, v in enumerate(vs.validators)}
+        vs._total_voting_power = None
+        vs.proposer = None
+        if r.read_bool():
+            addr = r.read_bytes()
+            _, vs.proposer = vs.get_by_address(addr)
+        if vs.validators:
+            vs._update_total_voting_power()
+        return vs
+
+    def __eq__(self, other) -> bool:
+        return (
+            isinstance(other, ValidatorSet)
+            and [(v.address, v.voting_power) for v in self.validators]
+            == [(v.address, v.voting_power) for v in other.validators]
+        )
+
+    def __repr__(self) -> str:
+        return f"ValidatorSet{{n={len(self.validators)} power={self.total_voting_power()}}}"
+
+
+def _safe_add(a: int, b: int) -> int:
+    c = a + b
+    hi, lo = (1 << 63) - 1, -(1 << 63)
+    return hi if c > hi else lo if c < lo else c
+
+
+def _safe_sub(a: int, b: int) -> int:
+    return _safe_add(a, -b)
+
+
+def _compute_max_min_priority_diff(vals: List[Validator]) -> int:
+    ps = [v.proposer_priority for v in vals]
+    return max(ps) - min(ps)

@@ -1,0 +1,132 @@
+"""Vote: a prevote or precommit for a block.
+
+Reference: types/vote.go (Vote :48, SignBytes :83, Verify :124). Sign
+bytes here are the fixed 160-byte canonical layout
+(codec/signbytes.py) rather than amino CanonicalVote -- this is the
+rectangularization that lets commits batch onto the TPU.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import TYPE_CHECKING, Optional
+
+from tendermint_tpu.codec import signbytes
+from tendermint_tpu.codec.binary import Reader, Writer
+from tendermint_tpu.codec.signbytes import PRECOMMIT_TYPE, PREVOTE_TYPE
+
+if TYPE_CHECKING:
+    from tendermint_tpu.types.block import BlockID
+
+MAX_VOTE_BYTES = 512  # generous upper bound (reference MaxVoteBytes=223)
+
+
+def is_vote_type_valid(t: int) -> bool:
+    return t in (PREVOTE_TYPE, PRECOMMIT_TYPE)
+
+
+class ErrVoteInvalidSignature(Exception):
+    pass
+
+
+class ErrVoteInvalidValidatorAddress(Exception):
+    pass
+
+
+def now_ns() -> int:
+    return time.time_ns()
+
+
+@dataclass
+class Vote:
+    vote_type: int
+    height: int
+    round: int
+    block_id: "BlockID"  # may be zero BlockID for nil votes
+    timestamp_ns: int
+    validator_address: bytes
+    validator_index: int
+    signature: bytes = b""
+
+    def is_nil(self) -> bool:
+        return self.block_id.is_zero()
+
+    def sign_bytes(self, chain_id: str) -> bytes:
+        return signbytes.canonical_sign_bytes(
+            msg_type=self.vote_type,
+            height=self.height,
+            round_=self.round,
+            block_hash=self.block_id.hash,
+            parts_total=self.block_id.parts.total,
+            parts_hash=self.block_id.parts.hash,
+            timestamp_ns=self.timestamp_ns,
+            chain_id=chain_id,
+        )
+
+    def verify(self, chain_id: str, pub_key) -> None:
+        """Serial verify (reference Vote.Verify types/vote.go:124). The
+        batched path bypasses this via VoteSet's pending-queue drain."""
+        if pub_key.address() != self.validator_address:
+            raise ErrVoteInvalidValidatorAddress()
+        if not pub_key.verify(self.sign_bytes(chain_id), self.signature):
+            raise ErrVoteInvalidSignature()
+
+    def validate_basic(self) -> Optional[str]:
+        if not is_vote_type_valid(self.vote_type):
+            return "invalid vote type"
+        if self.height < 0:
+            return "negative height"
+        if self.round < 0:
+            return "negative round"
+        err = self.block_id.validate_basic()
+        if err:
+            return f"wrong BlockID: {err}"
+        if not self.block_id.is_zero() and not self.block_id.is_complete():
+            return "BlockID must be either empty or complete"
+        if len(self.validator_address) != 20:
+            return "expected ValidatorAddress size 20"
+        if self.validator_index < 0:
+            return "negative ValidatorIndex"
+        if not self.signature:
+            return "signature is missing"
+        if len(self.signature) > 64:
+            return "signature too big"
+        return None
+
+    # -- wire --------------------------------------------------------------
+
+    def encode(self) -> bytes:
+        from tendermint_tpu.types.block import BlockID  # noqa: F401
+
+        w = Writer()
+        w.write_u8(self.vote_type).write_u64(self.height).write_i64(self.round)
+        w.write_bytes(self.block_id.encode())
+        w.write_i64(self.timestamp_ns)
+        w.write_bytes(self.validator_address)
+        w.write_i64(self.validator_index)
+        w.write_bytes(self.signature)
+        return w.bytes()
+
+    @classmethod
+    def decode(cls, data: bytes) -> "Vote":
+        from tendermint_tpu.types.block import BlockID
+
+        r = Reader(data)
+        vt = r.read_u8()
+        height = r.read_u64()
+        rnd = r.read_i64()
+        bid = BlockID.decode(r.read_bytes())
+        ts = r.read_i64()
+        addr = r.read_bytes()
+        idx = r.read_i64()
+        sig = r.read_bytes()
+        return cls(vt, height, rnd, bid, ts, addr, idx, sig)
+
+    def __repr__(self) -> str:
+        t = "Prevote" if self.vote_type == PREVOTE_TYPE else "Precommit"
+        bh = self.block_id.hash.hex()[:12] if self.block_id.hash else "nil"
+        return (
+            f"Vote{{{self.validator_index}:{self.validator_address.hex()[:8]} "
+            f"{self.height}/{self.round}({t}) {bh}}}"
+        )

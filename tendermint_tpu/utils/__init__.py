@@ -1,0 +1,1 @@
+"""L0 support libraries (reference: libs/)."""

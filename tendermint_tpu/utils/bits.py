@@ -1,0 +1,130 @@
+"""BitArray: fixed-width bit vector used for vote/part tracking.
+
+Reference: libs/bits/bit_array.go:15 -- used by VoteSet (which peers have
+which votes), PartSet (which block parts we hold), and gossip routines
+(pick a random needed bit). numpy-backed so it can be handed straight to
+the TPU tally ops as a mask.
+"""
+
+from __future__ import annotations
+
+import random
+from typing import Iterator, List, Optional
+
+import numpy as np
+
+
+class BitArray:
+    __slots__ = ("bits", "_elems")
+
+    def __init__(self, bits: int):
+        if bits < 0:
+            raise ValueError("negative bit count")
+        self.bits = bits
+        self._elems = np.zeros(bits, dtype=bool)
+
+    # -- constructors ------------------------------------------------------
+
+    @classmethod
+    def from_bools(cls, bools: List[bool]) -> "BitArray":
+        ba = cls(len(bools))
+        ba._elems[:] = np.asarray(bools, dtype=bool)
+        return ba
+
+    @classmethod
+    def from_numpy(cls, arr: np.ndarray) -> "BitArray":
+        ba = cls(int(arr.shape[0]))
+        ba._elems[:] = arr.astype(bool)
+        return ba
+
+    def copy(self) -> "BitArray":
+        return BitArray.from_numpy(self._elems)
+
+    # -- access ------------------------------------------------------------
+
+    def get_index(self, i: int) -> bool:
+        if i >= self.bits or i < 0:
+            return False
+        return bool(self._elems[i])
+
+    def set_index(self, i: int, v: bool) -> bool:
+        if i >= self.bits or i < 0:
+            return False
+        self._elems[i] = v
+        return True
+
+    def __len__(self) -> int:
+        return self.bits
+
+    def __iter__(self) -> Iterator[bool]:
+        return iter(bool(b) for b in self._elems)
+
+    def as_numpy(self) -> np.ndarray:
+        return self._elems.copy()
+
+    # -- set algebra (reference bit_array.go Or/And/Sub/Not) ---------------
+
+    def or_(self, other: "BitArray") -> "BitArray":
+        n = max(self.bits, other.bits)
+        out = BitArray(n)
+        out._elems[: self.bits] = self._elems
+        out._elems[: other.bits] |= other._elems
+        return out
+
+    def and_(self, other: "BitArray") -> "BitArray":
+        n = min(self.bits, other.bits)
+        out = BitArray(n)
+        out._elems[:] = self._elems[:n] & other._elems[:n]
+        return out
+
+    def not_(self) -> "BitArray":
+        out = BitArray(self.bits)
+        out._elems[:] = ~self._elems
+        return out
+
+    def sub(self, other: "BitArray") -> "BitArray":
+        """Bits set in self but not in other (reference Sub semantics)."""
+        out = self.copy()
+        n = min(self.bits, other.bits)
+        out._elems[:n] &= ~other._elems[:n]
+        return out
+
+    def is_empty(self) -> bool:
+        return not bool(self._elems.any())
+
+    def is_full(self) -> bool:
+        return self.bits > 0 and bool(self._elems.all())
+
+    def num_true_bits(self) -> int:
+        return int(self._elems.sum())
+
+    def pick_random(self, rng: Optional[random.Random] = None) -> Optional[int]:
+        """Random index of a set bit, or None (reference PickRandom)."""
+        idxs = np.flatnonzero(self._elems)
+        if idxs.size == 0:
+            return None
+        r = rng or random
+        return int(idxs[r.randrange(idxs.size)])
+
+    # -- encoding ----------------------------------------------------------
+
+    def to_bytes(self) -> bytes:
+        return np.packbits(self._elems, bitorder="little").tobytes()
+
+    @classmethod
+    def from_bytes(cls, data: bytes, bits: int) -> "BitArray":
+        arr = np.unpackbits(np.frombuffer(data, dtype=np.uint8), bitorder="little")
+        return cls.from_numpy(arr[:bits])
+
+    def __eq__(self, other) -> bool:
+        return (
+            isinstance(other, BitArray)
+            and self.bits == other.bits
+            and bool(np.array_equal(self._elems, other._elems))
+        )
+
+    def __repr__(self) -> str:
+        s = "".join("x" if b else "_" for b in self._elems[:64])
+        if self.bits > 64:
+            s += "..."
+        return f"BA{{{self.bits}:{s}}}"

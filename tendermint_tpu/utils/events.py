@@ -1,0 +1,28 @@
+"""Synchronous in-process event switch (reactor-internal pubsub).
+
+Reference: libs/events/events.go EventSwitch -- the consensus state
+machine fires NewRoundStep/Vote/ProposalHeartbeat events into an
+EventSwitch consumed synchronously by the consensus reactor's broadcast
+routines (consensus/reactor.go:405,422). Listeners here are plain
+callables invoked inline, preserving the reference's synchronous
+semantics (and its determinism).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List
+
+
+class EventSwitch:
+    def __init__(self):
+        self._listeners: Dict[str, List[Callable[[Any], None]]] = {}
+
+    def add_listener(self, event: str, cb: Callable[[Any], None]) -> None:
+        self._listeners.setdefault(event, []).append(cb)
+
+    def remove_listeners(self, event: str) -> None:
+        self._listeners.pop(event, None)
+
+    def fire_event(self, event: str, data: Any = None) -> None:
+        for cb in self._listeners.get(event, []):
+            cb(data)

@@ -1,0 +1,42 @@
+"""Crash-point injection for the persistence/crash-recovery test harness.
+
+Reference: libs/fail/fail.go:27 -- ``fail.Fail()`` is called at numbered
+points in the commit path (consensus/state.go:1415,1429,1450,1472,1490 and
+state/execution.go:142,147,178,184); setting FAIL_TEST_INDEX=i makes the
+i-th call site os.Exit the process, and the bash rig
+test/persist/test_failure_indices.sh restarts the node and asserts
+recovery. Same contract here.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+_call_index = -1
+
+
+def reset() -> None:
+    global _call_index
+    _call_index = -1
+
+
+def env_index() -> int:
+    try:
+        return int(os.environ.get("FAIL_TEST_INDEX", "-1"))
+    except ValueError:
+        return -1
+
+
+def fail() -> None:
+    """Crash the process if FAIL_TEST_INDEX matches this call site's index.
+
+    Call sites are numbered in call order per process (0-based), exactly
+    like the reference's package-level callIndex counter.
+    """
+    global _call_index
+    _call_index += 1
+    if _call_index == env_index():
+        sys.stderr.write(f"*** fail-point {_call_index} triggered, exiting ***\n")
+        sys.stderr.flush()
+        os._exit(1)

@@ -1,0 +1,94 @@
+"""Structured logfmt-style logging with per-module level filtering.
+
+Reference: libs/log/tm_logger.go (go-kit logfmt logger) and
+libs/log/filter.go (per-module level filter parsed from the ``log_level``
+config string, default "main:info,state:info,*:error" at
+config/config.go:300).
+"""
+
+from __future__ import annotations
+
+import logging
+import sys
+import time
+from typing import Dict, Optional
+
+_LEVELS = {
+    "debug": logging.DEBUG,
+    "info": logging.INFO,
+    "error": logging.ERROR,
+    "none": logging.CRITICAL + 10,
+}
+
+
+class LogfmtFormatter(logging.Formatter):
+    def format(self, record: logging.LogRecord) -> str:
+        ts = time.strftime("%H:%M:%S", time.localtime(record.created))
+        msg = record.getMessage()
+        parts = [f"{record.levelname[0]}[{ts}]", msg, f"module={record.name}"]
+        kv = getattr(record, "kv", None)
+        if kv:
+            parts.extend(f"{k}={v}" for k, v in kv.items())
+        return " ".join(parts)
+
+
+class ModuleFilter(logging.Filter):
+    """Allow records according to a 'mod:lvl,mod:lvl,*:lvl' spec."""
+
+    def __init__(self, spec: str):
+        super().__init__()
+        self.levels: Dict[str, int] = {}
+        self.default = logging.INFO
+        for item in spec.split(","):
+            item = item.strip()
+            if not item:
+                continue
+            if ":" in item:
+                mod, lvl = item.rsplit(":", 1)
+            else:
+                mod, lvl = "*", item
+            level = _LEVELS.get(lvl.strip().lower(), logging.INFO)
+            if mod == "*":
+                self.default = level
+            else:
+                self.levels[mod.strip()] = level
+
+    def filter(self, record: logging.LogRecord) -> bool:
+        mod = record.name.split(".")[0]
+        return record.levelno >= self.levels.get(mod, self.default)
+
+
+def new_logger(
+    module: str,
+    level_spec: str = "main:info,state:info,*:error",
+    stream=None,
+    **bound,
+) -> logging.Logger:
+    """Create a logfmt logger for `module` honoring the level spec."""
+    logger = logging.getLogger(module)
+    logger.setLevel(logging.DEBUG)
+    if not logger.handlers:
+        h = logging.StreamHandler(stream or sys.stderr)
+        h.setFormatter(LogfmtFormatter())
+        h.addFilter(ModuleFilter(level_spec))
+        logger.addHandler(h)
+        logger.propagate = False
+    if bound:
+        return KVLoggerAdapter(logger, bound)  # type: ignore[return-value]
+    return logger
+
+
+class KVLoggerAdapter(logging.LoggerAdapter):
+    """`With(...)`-style bound key-values (reference tm_logger.With)."""
+
+    def process(self, msg, kwargs):
+        extra = kwargs.setdefault("extra", {})
+        kv = dict(self.extra or {})
+        kv.update(extra.get("kv", {}))
+        extra["kv"] = kv
+        return msg, kwargs
+
+    def with_(self, **kv) -> "KVLoggerAdapter":
+        merged = dict(self.extra or {})
+        merged.update(kv)
+        return KVLoggerAdapter(self.logger, merged)

@@ -1,0 +1,267 @@
+"""Query-filtered pubsub: the event plumbing behind EventBus, RPC
+/subscribe and the tx indexer.
+
+Reference: libs/pubsub/pubsub.go:91 (Server with per-subscriber buffered
+channels) and libs/pubsub/query (PEG query grammar like
+``tm.event = 'NewBlock' AND tx.height > 5``). The query language here
+supports the same operators: = != < <= > >= CONTAINS EXISTS, joined by
+AND, over string/number tag values.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import re
+from typing import Any, Dict, List, NamedTuple, Optional, Tuple
+
+
+# ---------------------------------------------------------------------------
+# Query language
+# ---------------------------------------------------------------------------
+
+_TOKEN_RE = re.compile(
+    r"\s*(?:(?P<op><=|>=|!=|=|<|>)|(?P<kw>AND|CONTAINS|EXISTS)\b"
+    r"|(?P<str>'(?:[^'\\]|\\.)*')|(?P<num>-?\d+(?:\.\d+)?)"
+    r"|(?P<ident>[A-Za-z_][\w.]*))"
+)
+
+
+class Condition(NamedTuple):
+    key: str
+    op: str  # '=', '!=', '<', '<=', '>', '>=', 'CONTAINS', 'EXISTS'
+    value: Any  # str or float; None for EXISTS
+
+
+class QueryError(ValueError):
+    pass
+
+
+def _tokenize(s: str) -> List[Tuple[str, str]]:
+    tokens, pos = [], 0
+    while pos < len(s):
+        if s[pos].isspace():
+            pos += 1
+            continue
+        m = _TOKEN_RE.match(s, pos)
+        if not m or m.start() != pos:
+            raise QueryError(f"bad query near {s[pos:pos+16]!r}")
+        pos = m.end()
+        for kind in ("op", "kw", "str", "num", "ident"):
+            v = m.group(kind)
+            if v is not None:
+                tokens.append((kind, v))
+                break
+    return tokens
+
+
+class Query:
+    """Conjunction of conditions over event tags.
+
+    Matching semantics follow the reference: a condition on key K matches
+    if ANY value indexed under K satisfies it (events carry multi-valued
+    tags); the query matches if all conditions match.
+    """
+
+    def __init__(self, source: str):
+        self.source = source.strip()
+        self.conditions: List[Condition] = self._parse(self.source)
+
+    @staticmethod
+    def _parse(src: str) -> List[Condition]:
+        if not src:
+            raise QueryError("empty query")
+        toks = _tokenize(src)
+        conds: List[Condition] = []
+        i = 0
+        while i < len(toks):
+            kind, val = toks[i]
+            if kind != "ident":
+                raise QueryError(f"expected key, got {val!r}")
+            key = val
+            i += 1
+            if i >= len(toks):
+                raise QueryError("truncated query")
+            kind, val = toks[i]
+            if kind == "kw" and val == "EXISTS":
+                conds.append(Condition(key, "EXISTS", None))
+                i += 1
+            elif kind == "kw" and val == "CONTAINS":
+                i += 1
+                if i >= len(toks):
+                    raise QueryError("truncated query after CONTAINS")
+                kind2, v2 = toks[i]
+                if kind2 != "str":
+                    raise QueryError("CONTAINS needs a string")
+                conds.append(Condition(key, "CONTAINS", _unquote(v2)))
+                i += 1
+            elif kind == "op":
+                op = val
+                i += 1
+                if i >= len(toks):
+                    raise QueryError(f"truncated query after {op!r}")
+                kind2, v2 = toks[i]
+                if kind2 == "str":
+                    conds.append(Condition(key, op, _unquote(v2)))
+                elif kind2 == "num":
+                    conds.append(Condition(key, op, float(v2)))
+                else:
+                    raise QueryError(f"bad value {v2!r}")
+                i += 1
+            else:
+                raise QueryError(f"expected operator after {key!r}")
+            if i < len(toks):
+                kind, val = toks[i]
+                if not (kind == "kw" and val == "AND"):
+                    raise QueryError(f"expected AND, got {val!r}")
+                i += 1
+        return conds
+
+    def matches(self, tags: Dict[str, List[str]]) -> bool:
+        for cond in self.conditions:
+            values = tags.get(cond.key)
+            if values is None:
+                return False
+            if cond.op == "EXISTS":
+                continue
+            if not any(_match_one(v, cond) for v in values):
+                return False
+        return True
+
+    def __eq__(self, other) -> bool:
+        return isinstance(other, Query) and self.source == other.source
+
+    def __hash__(self) -> int:
+        return hash(self.source)
+
+    def __repr__(self) -> str:
+        return f"Query({self.source!r})"
+
+
+def _unquote(s: str) -> str:
+    return s[1:-1].replace("\\'", "'")
+
+
+def _match_one(value: str, cond: Condition) -> bool:
+    op, want = cond.op, cond.value
+    if op == "CONTAINS":
+        return str(want) in value
+    if isinstance(want, float):
+        try:
+            have = float(value)
+        except ValueError:
+            return False
+    else:
+        have = value
+    if op == "=":
+        return have == want
+    if op == "!=":
+        return have != want
+    if op == "<":
+        return have < want
+    if op == "<=":
+        return have <= want
+    if op == ">":
+        return have > want
+    if op == ">=":
+        return have >= want
+    raise QueryError(f"unknown op {op}")
+
+
+EMPTY = "empty"
+
+
+class Message(NamedTuple):
+    data: Any
+    tags: Dict[str, List[str]]
+
+
+# ---------------------------------------------------------------------------
+# Server
+# ---------------------------------------------------------------------------
+
+
+class Subscription:
+    """A subscriber's buffered message stream.
+
+    Mirrors reference pubsub.Subscription: out channel + Cancelled with an
+    error. If the buffer overflows the subscription is cancelled with
+    ErrOutOfCapacity semantics rather than blocking the publisher.
+    """
+
+    def __init__(self, query: Query, capacity: int):
+        self.query = query
+        self._queue: asyncio.Queue = asyncio.Queue(maxsize=capacity)
+        self.cancelled = asyncio.Event()
+        self.err: Optional[str] = None
+
+    async def next(self) -> Message:
+        if self.cancelled.is_set() and self._queue.empty():
+            raise asyncio.CancelledError(self.err or "subscription cancelled")
+        get = asyncio.ensure_future(self._queue.get())
+        cancel = asyncio.ensure_future(self.cancelled.wait())
+        done, _ = await asyncio.wait({get, cancel}, return_when=asyncio.FIRST_COMPLETED)
+        if get in done:
+            cancel.cancel()
+            return get.result()
+        get.cancel()
+        raise asyncio.CancelledError(self.err or "subscription cancelled")
+
+    def _publish(self, msg: Message) -> bool:
+        try:
+            self._queue.put_nowait(msg)
+            return True
+        except asyncio.QueueFull:
+            return False
+
+    def _cancel(self, err: str) -> None:
+        self.err = err
+        self.cancelled.set()
+
+
+class PubSubServer:
+    """In-process query-filtered pubsub (reference pubsub.Server)."""
+
+    def __init__(self, buffer_capacity: int = 100):
+        self.buffer_capacity = buffer_capacity
+        # (client_id, query) -> Subscription
+        self._subs: Dict[Tuple[str, Query], Subscription] = {}
+
+    def num_clients(self) -> int:
+        return len({cid for cid, _ in self._subs})
+
+    def num_client_subscriptions(self, client_id: str) -> int:
+        return sum(1 for cid, _ in self._subs if cid == client_id)
+
+    async def subscribe(
+        self, client_id: str, query: Query, capacity: Optional[int] = None
+    ) -> Subscription:
+        key = (client_id, query)
+        if key in self._subs:
+            raise ValueError("already subscribed")
+        sub = Subscription(query, capacity or self.buffer_capacity)
+        self._subs[key] = sub
+        return sub
+
+    async def unsubscribe(self, client_id: str, query: Query) -> None:
+        sub = self._subs.pop((client_id, query), None)
+        if sub is None:
+            raise KeyError("subscription not found")
+        sub._cancel("unsubscribed")
+
+    async def unsubscribe_all(self, client_id: str) -> None:
+        keys = [k for k in self._subs if k[0] == client_id]
+        if not keys:
+            raise KeyError("subscription not found")
+        for k in keys:
+            self._subs.pop(k)._cancel("unsubscribed")
+
+    async def publish(self, data: Any, tags: Optional[Dict[str, List[str]]] = None) -> None:
+        tags = tags or {}
+        msg = Message(data, tags)
+        dead = []
+        for key, sub in self._subs.items():
+            if sub.query.matches(tags):
+                if not sub._publish(msg):
+                    dead.append(key)
+        for key in dead:
+            self._subs.pop(key)._cancel("out of capacity")

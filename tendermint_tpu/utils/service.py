@@ -1,0 +1,104 @@
+"""Service lifecycle, async-native.
+
+Reference: libs/service/service.go:24,97 -- every long-lived component in
+the reference embeds BaseService (Start/Stop/Reset/Quit with
+already-started/already-stopped guards). Here the equivalent is an asyncio
+task-owning base class: ``start()`` transitions to RUNNING and calls
+``on_start``; ``stop()`` cancels spawned tasks, calls ``on_stop`` and
+resolves ``wait_stopped()``.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from typing import Coroutine, List, Optional
+
+
+class ServiceError(Exception):
+    pass
+
+
+class AlreadyStartedError(ServiceError):
+    pass
+
+
+class AlreadyStoppedError(ServiceError):
+    pass
+
+
+class Service:
+    """Async service with start/stop lifecycle and owned-task tracking."""
+
+    def __init__(self, name: str = "", logger: Optional[logging.Logger] = None):
+        self.name = name or type(self).__name__
+        self.logger = logger or logging.getLogger(self.name)
+        self._started = False
+        self._stopped = False
+        self._tasks: List[asyncio.Task] = []
+        self._quit: Optional[asyncio.Event] = None
+
+    # -- lifecycle ---------------------------------------------------------
+
+    @property
+    def is_running(self) -> bool:
+        return self._started and not self._stopped
+
+    async def start(self) -> None:
+        if self._started:
+            raise AlreadyStartedError(self.name)
+        if self._stopped:
+            raise AlreadyStoppedError(self.name)
+        self._quit = asyncio.Event()
+        self._started = True
+        self.logger.debug("starting %s", self.name)
+        await self.on_start()
+
+    async def stop(self) -> None:
+        if self._stopped:
+            return
+        if not self._started:
+            self._stopped = True
+            return
+        self._stopped = True
+        self.logger.debug("stopping %s", self.name)
+        await self.on_stop()
+        for t in self._tasks:
+            t.cancel()
+        if self._tasks:
+            await asyncio.gather(*self._tasks, return_exceptions=True)
+        self._tasks.clear()
+        if self._quit is not None:
+            self._quit.set()
+
+    async def reset(self) -> None:
+        """Stop and rearm so the service can be started again."""
+        await self.stop()
+        self._started = False
+        self._stopped = False
+        self._quit = None
+
+    async def wait_stopped(self) -> None:
+        if self._quit is not None:
+            await self._quit.wait()
+
+    # -- hooks -------------------------------------------------------------
+
+    async def on_start(self) -> None:  # pragma: no cover - default no-op
+        pass
+
+    async def on_stop(self) -> None:  # pragma: no cover - default no-op
+        pass
+
+    # -- helpers -----------------------------------------------------------
+
+    def spawn(self, coro: Coroutine, name: str = "") -> asyncio.Task:
+        """Spawn a task owned by this service; cancelled on stop.
+
+        The goroutine-equivalent: reference services spawn goroutines that
+        select on Quit(); here tasks are cancelled and gathered on stop().
+        """
+        task = asyncio.create_task(coro, name=name or self.name)
+        self._tasks.append(task)
+        self._tasks = [t for t in self._tasks if not t.done()]
+        return task

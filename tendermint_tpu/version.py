@@ -1,0 +1,14 @@
+"""Version constants.
+
+Reference: version/version.go:23-32 (TMCoreSemVer "0.33.4", ABCISemVer
+"0.16.2", BlockProtocol 10, P2PProtocol 7).
+"""
+
+TM_CORE_SEMVER = "0.33.4-tpu.1"
+ABCI_SEMVER = "0.16.2"
+ABCI_VERSION = ABCI_SEMVER
+
+# Protocol versions (uint64 in the reference; plain ints here).
+BLOCK_PROTOCOL = 10
+P2P_PROTOCOL = 7
+APP_PROTOCOL = 0

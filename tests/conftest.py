@@ -1,0 +1,28 @@
+"""Test configuration.
+
+Forces JAX onto a virtual 8-device CPU mesh so multi-chip sharding paths
+compile and execute in CI without TPU hardware (the driver separately
+dry-runs the multichip path; real-TPU benchmarking happens via bench.py).
+Must run before jax is imported anywhere.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def cpu_mesh():
+    import jax
+    from jax.sharding import Mesh
+    import numpy as np
+
+    devs = np.array(jax.devices("cpu")[:8])
+    return Mesh(devs, ("batch",))

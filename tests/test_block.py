@@ -1,0 +1,120 @@
+"""Block/Header/Commit/PartSet round-trips and hashing."""
+
+import pytest
+
+from tendermint_tpu.types.block import (
+    Block,
+    BlockID,
+    Commit,
+    CommitSig,
+    Data,
+    EvidenceData,
+    Header,
+    PartSetHeader,
+    BLOCK_ID_FLAG_COMMIT,
+)
+from tendermint_tpu.types.part_set import PartSet, ErrPartSetInvalidProof, Part
+from tendermint_tpu.types.tx import Txs
+
+
+def make_header(height=3):
+    return Header(
+        chain_id="test-chain",
+        height=height,
+        time_ns=123456789,
+        last_block_id=BlockID(hash=b"\x01" * 32, parts=PartSetHeader(2, b"\x02" * 32)),
+        last_commit_hash=b"\x03" * 32,
+        data_hash=b"\x04" * 32,
+        validators_hash=b"\x05" * 32,
+        next_validators_hash=b"\x06" * 32,
+        consensus_hash=b"\x07" * 32,
+        app_hash=b"\x08" * 32,
+        last_results_hash=b"\x09" * 32,
+        evidence_hash=b"\x0a" * 32,
+        proposer_address=b"\x0b" * 20,
+    )
+
+
+def test_header_hash_deterministic():
+    h = make_header()
+    assert h.hash() == make_header().hash()
+    h2 = make_header()
+    h2.height = 4
+    assert h.hash() != h2.hash()
+
+
+def test_header_hash_nil_without_validators_hash():
+    h = make_header()
+    h.validators_hash = b""
+    assert h.hash() is None
+
+
+def test_header_roundtrip():
+    h = make_header()
+    h2 = Header.decode(h.encode())
+    assert h2 == h
+    assert h2.hash() == h.hash()
+
+
+def make_commit_fixture():
+    bid = BlockID(hash=b"\x42" * 32, parts=PartSetHeader(1, b"\x43" * 32))
+    sigs = [
+        CommitSig(BLOCK_ID_FLAG_COMMIT, bytes([i]) * 20, 1000 + i, bytes([i]) * 64)
+        for i in range(4)
+    ]
+    return Commit(height=5, round=0, block_id=bid, signatures=sigs)
+
+
+def test_commit_roundtrip():
+    c = make_commit_fixture()
+    c2 = Commit.decode(c.encode())
+    assert c2.height == c.height
+    assert c2.block_id == c.block_id
+    assert c2.hash() == c.hash()
+    assert c2.bit_array().num_true_bits() == 4
+
+
+def test_block_roundtrip_and_validate():
+    block = Block(
+        header=Header(chain_id="t", height=5, time_ns=1, validators_hash=b"\x05" * 32),
+        data=Data(txs=Txs([b"tx1", b"tx2"])),
+        evidence=EvidenceData(),
+        last_commit=make_commit_fixture(),
+    )
+    block.fill_header()
+    b2 = Block.decode(block.encode())
+    assert b2.header.height == 5
+    assert list(b2.data.txs) == [b"tx1", b"tx2"]
+    assert b2.hash() == block.hash()
+
+
+def test_part_set_roundtrip():
+    data = bytes(range(256)) * 1024  # 256 KB -> 4 parts
+    ps = PartSet.from_data(data, part_size=65536)
+    assert ps.total == 4
+    assert ps.is_complete()
+
+    ps2 = PartSet.new_from_header(ps.header())
+    assert not ps2.is_complete()
+    for i in range(ps.total):
+        assert ps2.add_part(ps.get_part(i))
+    assert ps2.is_complete()
+    assert ps2.assemble() == data
+
+
+def test_part_set_rejects_bad_proof():
+    data = b"x" * 200000
+    ps = PartSet.from_data(data, part_size=65536)
+    ps2 = PartSet.new_from_header(ps.header())
+    part = ps.get_part(0)
+    bad = Part(index=0, bytes_=b"corrupt" + part.bytes_[7:], proof=part.proof)
+    with pytest.raises(ErrPartSetInvalidProof):
+        ps2.add_part(bad)
+
+
+def test_txs_merkle_proof():
+    txs = Txs([b"a", b"bb", b"ccc"])
+    root = txs.hash()
+    proof = txs.proof(1)
+    assert proof.validate(root) is None
+    assert proof.validate(b"\x00" * 32) is not None

@@ -1,0 +1,118 @@
+"""Codec round-trips and sign-bytes golden vectors."""
+
+import struct
+
+from tendermint_tpu.codec.binary import Reader, Writer
+from tendermint_tpu.codec import signbytes
+from tendermint_tpu.codec.signbytes import (
+    PRECOMMIT_TYPE,
+    PREVOTE_TYPE,
+    SIGN_BYTES_LEN,
+    canonical_sign_bytes,
+)
+
+
+def test_varint_roundtrip():
+    for n in [0, 1, 127, 128, 300, 2**32, 2**63 - 1]:
+        w = Writer().write_uvarint(n)
+        assert Reader(w.bytes()).read_uvarint() == n
+    for n in [0, -1, 1, -300, 300, -(2**62), 2**62]:
+        w = Writer().write_varint(n)
+        assert Reader(w.bytes()).read_varint() == n
+
+
+def test_mixed_roundtrip():
+    w = Writer()
+    w.write_u8(7).write_u64(2**60).write_i64(-5).write_bool(True)
+    w.write_bytes(b"hello").write_str("chain-x")
+    r = Reader(w.bytes())
+    assert r.read_u8() == 7
+    assert r.read_u64() == 2**60
+    assert r.read_i64() == -5
+    assert r.read_bool() is True
+    assert r.read_bytes() == b"hello"
+    assert r.read_str() == "chain-x"
+    r.expect_done()
+
+
+def test_sign_bytes_fixed_width():
+    sb = canonical_sign_bytes(
+        msg_type=PRECOMMIT_TYPE,
+        height=12345,
+        round_=2,
+        block_hash=b"\xab" * 32,
+        parts_total=3,
+        parts_hash=b"\xcd" * 32,
+        timestamp_ns=1_700_000_000_000_000_000,
+        chain_id="test-chain",
+    )
+    assert len(sb) == SIGN_BYTES_LEN == 160
+    # deterministic
+    sb2 = canonical_sign_bytes(
+        msg_type=PRECOMMIT_TYPE,
+        height=12345,
+        round_=2,
+        block_hash=b"\xab" * 32,
+        parts_total=3,
+        parts_hash=b"\xcd" * 32,
+        timestamp_ns=1_700_000_000_000_000_000,
+        chain_id="test-chain",
+    )
+    assert sb == sb2
+
+
+def test_sign_bytes_field_offsets():
+    """Golden layout check -- the device kernel depends on these offsets."""
+    sb = canonical_sign_bytes(
+        msg_type=PREVOTE_TYPE,
+        height=7,
+        round_=1,
+        block_hash=b"\x11" * 32,
+        parts_total=9,
+        parts_hash=b"\x22" * 32,
+        timestamp_ns=42,
+        chain_id="c",
+    )
+    assert sb[0] == PREVOTE_TYPE
+    assert struct.unpack(">Q", sb[1:9])[0] == 7
+    assert struct.unpack(">q", sb[9:17])[0] == 1
+    assert struct.unpack(">q", sb[17:25])[0] == -1  # pol_round default
+    assert sb[25:57] == b"\x11" * 32
+    assert struct.unpack(">I", sb[57:61])[0] == 9
+    assert sb[61:93] == b"\x22" * 32
+    assert struct.unpack(">q", sb[93:101])[0] == 42
+    assert sb[101:133] == b"c" + b"\x00" * 31
+    assert sb[133:] == b"\x00" * 27
+
+
+def test_sign_bytes_differ_by_field():
+    base = dict(
+        msg_type=PRECOMMIT_TYPE,
+        height=1,
+        round_=0,
+        block_hash=b"\x01" * 32,
+        parts_total=1,
+        parts_hash=b"\x02" * 32,
+        timestamp_ns=1,
+        chain_id="a",
+    )
+    sb = canonical_sign_bytes(**base)
+    for key, val in [
+        ("height", 2),
+        ("round_", 1),
+        ("timestamp_ns", 2),
+        ("chain_id", "b"),
+        ("msg_type", PREVOTE_TYPE),
+    ]:
+        other = dict(base)
+        other[key] = val
+        assert canonical_sign_bytes(**other) != sb
+
+
+def test_long_chain_id_hashed():
+    long_id = "x" * 60
+    c = signbytes.chain_id_commitment(long_id)
+    assert len(c) == 32
+    import hashlib
+
+    assert c == hashlib.sha256(long_id.encode()).digest()

@@ -1,0 +1,60 @@
+"""Merkle tree + proofs (mirrors crypto/merkle/simple_tree_test.go)."""
+
+import hashlib
+
+import pytest
+
+from tendermint_tpu.crypto import merkle
+
+
+def test_empty_hash():
+    assert merkle.hash_from_byte_slices([]) == hashlib.sha256(b"").digest()
+
+
+def test_single_leaf():
+    item = b"hello"
+    expected = hashlib.sha256(b"\x00" + item).digest()
+    assert merkle.hash_from_byte_slices([item]) == expected
+
+
+def test_two_leaves():
+    a, b = b"a", b"b"
+    la = hashlib.sha256(b"\x00" + a).digest()
+    lb = hashlib.sha256(b"\x00" + b).digest()
+    expected = hashlib.sha256(b"\x01" + la + lb).digest()
+    assert merkle.hash_from_byte_slices([a, b]) == expected
+
+
+@pytest.mark.parametrize("n", [1, 2, 3, 4, 5, 7, 8, 9, 100])
+def test_proofs_verify(n):
+    items = [f"item{i}".encode() for i in range(n)]
+    root, proofs = merkle.proofs_from_byte_slices(items)
+    assert root == merkle.hash_from_byte_slices(items)
+    for i, item in enumerate(items):
+        proofs[i].verify(root, item)
+        assert proofs[i].total == n
+        assert proofs[i].index == i
+
+
+def test_proof_rejects_wrong_leaf():
+    items = [b"a", b"b", b"c"]
+    root, proofs = merkle.proofs_from_byte_slices(items)
+    with pytest.raises(ValueError):
+        proofs[0].verify(root, b"not-a")
+
+
+def test_proof_rejects_wrong_root():
+    items = [b"a", b"b", b"c"]
+    root, proofs = merkle.proofs_from_byte_slices(items)
+    bad_root = hashlib.sha256(b"x").digest()
+    with pytest.raises(ValueError):
+        proofs[1].verify(bad_root, b"b")
+
+
+def test_split_point():
+    assert merkle._split_point(2) == 1
+    assert merkle._split_point(3) == 2
+    assert merkle._split_point(4) == 2
+    assert merkle._split_point(5) == 4
+    assert merkle._split_point(8) == 4
+    assert merkle._split_point(9) == 8

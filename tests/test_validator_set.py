@@ -1,0 +1,229 @@
+"""ValidatorSet: proposer priority distribution, updates, verify_commit.
+
+Mirrors types/validator_set_test.go (proposer-priority properties,
+update semantics) and the VerifyCommit acceptance matrix.
+"""
+
+from fractions import Fraction
+
+import pytest
+
+from tendermint_tpu.codec.signbytes import PRECOMMIT_TYPE
+from tendermint_tpu.crypto.keys import Ed25519PrivKey
+from tendermint_tpu.types.block import (
+    BLOCK_ID_FLAG_ABSENT,
+    BLOCK_ID_FLAG_COMMIT,
+    BLOCK_ID_FLAG_NIL,
+    BlockID,
+    Commit,
+    CommitSig,
+    PartSetHeader,
+)
+from tendermint_tpu.types.validator import Validator
+from tendermint_tpu.types.validator_set import (
+    ErrInvalidCommitSignature,
+    ErrNotEnoughVotingPower,
+    ValidatorSet,
+)
+from tendermint_tpu.types.vote import Vote
+
+
+def make_vals(powers):
+    privs = [Ed25519PrivKey.from_secret(f"val{i}".encode()) for i in range(len(powers))]
+    vals = [Validator(p.pub_key(), pw) for p, pw in zip(privs, powers)]
+    vs = ValidatorSet(vals)
+    by_addr = {p.pub_key().address(): p for p in privs}
+    return vs, by_addr
+
+
+def make_commit(vs, by_addr, chain_id="test-chain", height=5, round_=0, bad_idx=None,
+                nil_idx=None, absent_idx=None):
+    block_id = BlockID(hash=b"\x42" * 32, parts=PartSetHeader(total=1, hash=b"\x43" * 32))
+    sigs = []
+    for i, val in enumerate(vs.validators):
+        if absent_idx is not None and i in absent_idx:
+            sigs.append(CommitSig.absent())
+            continue
+        is_nil = nil_idx is not None and i in nil_idx
+        vote_bid = BlockID() if is_nil else block_id
+        vote = Vote(
+            vote_type=PRECOMMIT_TYPE,
+            height=height,
+            round=round_,
+            block_id=vote_bid,
+            timestamp_ns=1000 + i,
+            validator_address=val.address,
+            validator_index=i,
+        )
+        priv = by_addr[val.address]
+        sig = priv.sign(vote.sign_bytes(chain_id))
+        if bad_idx is not None and i in bad_idx:
+            sig = bytes(64)
+        sigs.append(
+            CommitSig(
+                block_id_flag=BLOCK_ID_FLAG_NIL if is_nil else BLOCK_ID_FLAG_COMMIT,
+                validator_address=val.address,
+                timestamp_ns=1000 + i,
+                signature=sig,
+            )
+        )
+    return Commit(height=height, round=round_, block_id=block_id, signatures=sigs), block_id
+
+
+class TestProposerRotation:
+    def test_proposer_frequency_proportional_to_power(self):
+        vs, _ = make_vals([1, 2, 3])
+        counts = {}
+        for _ in range(600):
+            p = vs.get_proposer()
+            counts[p.address] = counts.get(p.address, 0) + 1
+            vs.increment_proposer_priority(1)
+        by_power = sorted(
+            (vs.validators[i].voting_power, counts.get(vs.validators[i].address, 0))
+            for i in range(3)
+        )
+        # frequencies should be proportional to voting power: 100/200/300
+        for power, cnt in by_power:
+            assert abs(cnt - power * 100) <= 3
+
+    def test_single_validator_always_proposer(self):
+        vs, _ = make_vals([10])
+        addr = vs.validators[0].address
+        for _ in range(5):
+            assert vs.get_proposer().address == addr
+            vs.increment_proposer_priority(1)
+
+    def test_priorities_stay_centered_and_bounded(self):
+        vs, _ = make_vals([1, 1, 1, 1000])
+        total = vs.total_voting_power()
+        for _ in range(200):
+            vs.increment_proposer_priority(1)
+            ps = [v.proposer_priority for v in vs.validators]
+            assert max(ps) - min(ps) <= 2 * total + total  # window bound
+
+    def test_copy_increment_does_not_mutate(self):
+        vs, _ = make_vals([1, 2, 3])
+        before = [(v.address, v.proposer_priority) for v in vs.validators]
+        vs.copy_increment_proposer_priority(3)
+        after = [(v.address, v.proposer_priority) for v in vs.validators]
+        assert before == after
+
+
+class TestUpdates:
+    def test_add_validator(self):
+        vs, _ = make_vals([10, 10])
+        new_priv = Ed25519PrivKey.from_secret(b"newval")
+        vs.update_with_change_set([Validator(new_priv.pub_key(), 5)])
+        assert vs.size() == 3
+        assert vs.total_voting_power() == 25
+        # new validator starts with lowest priority (not immediately proposer)
+        _, v = vs.get_by_address(new_priv.pub_key().address())
+        assert v.voting_power == 5
+
+    def test_remove_validator(self):
+        vs, _ = make_vals([10, 10, 10])
+        victim = vs.validators[0]
+        vs.update_with_change_set([Validator(victim.pub_key, 0)])
+        assert vs.size() == 2
+        assert not vs.has_address(victim.address)
+
+    def test_update_power(self):
+        vs, _ = make_vals([10, 10])
+        target = vs.validators[1]
+        vs.update_with_change_set([Validator(target.pub_key, 42)])
+        _, v = vs.get_by_address(target.address)
+        assert v.voting_power == 42
+        assert vs.total_voting_power() == 52
+
+    def test_remove_nonexistent_fails(self):
+        vs, _ = make_vals([10])
+        ghost = Ed25519PrivKey.from_secret(b"ghost")
+        with pytest.raises(ValueError):
+            vs.update_with_change_set([Validator(ghost.pub_key(), 0)])
+
+    def test_empty_set_fails(self):
+        vs, _ = make_vals([10])
+        with pytest.raises(ValueError):
+            vs.update_with_change_set([Validator(vs.validators[0].pub_key, 0)])
+
+    def test_hash_changes_with_set(self):
+        vs, _ = make_vals([10, 20])
+        h1 = vs.hash()
+        vs.update_with_change_set([Validator(vs.validators[0].pub_key, 11)])
+        assert vs.hash() != h1
+
+
+class TestVerifyCommit:
+    def test_valid_commit(self):
+        vs, by_addr = make_vals([1] * 4)
+        commit, bid = make_commit(vs, by_addr)
+        vs.verify_commit("test-chain", bid, 5, commit)
+
+    def test_wrong_height(self):
+        vs, by_addr = make_vals([1] * 4)
+        commit, bid = make_commit(vs, by_addr)
+        with pytest.raises(Exception):
+            vs.verify_commit("test-chain", bid, 6, commit)
+
+    def test_wrong_block_id(self):
+        vs, by_addr = make_vals([1] * 4)
+        commit, _ = make_commit(vs, by_addr)
+        other = BlockID(hash=b"\x99" * 32, parts=PartSetHeader(1, b"\x98" * 32))
+        with pytest.raises(Exception):
+            vs.verify_commit("test-chain", other, 5, commit)
+
+    def test_insufficient_power(self):
+        vs, by_addr = make_vals([1] * 4)
+        # two nil votes -> only 2/4 for block, not > 2/3
+        commit, bid = make_commit(vs, by_addr, nil_idx={2, 3})
+        with pytest.raises(ErrNotEnoughVotingPower):
+            vs.verify_commit("test-chain", bid, 5, commit)
+
+    def test_bad_signature_rejected(self):
+        vs, by_addr = make_vals([1] * 4)
+        commit, bid = make_commit(vs, by_addr, bad_idx={1})
+        with pytest.raises(ErrInvalidCommitSignature):
+            vs.verify_commit("test-chain", bid, 5, commit)
+
+    def test_bad_sig_after_quorum_ignored(self):
+        """Reference early-return semantics: an invalid signature after
+        quorum is crossed must NOT fail verification."""
+        vs, by_addr = make_vals([1] * 4)
+        # First 3 of 4 give quorum (3 > 2/3*4=2.66); corrupt the last.
+        commit, bid = make_commit(vs, by_addr, bad_idx={3})
+        vs.verify_commit("test-chain", bid, 5, commit)
+
+    def test_absent_votes_ok_with_quorum(self):
+        vs, by_addr = make_vals([1] * 4)
+        commit, bid = make_commit(vs, by_addr, absent_idx={0})
+        vs.verify_commit("test-chain", bid, 5, commit)
+
+    def test_wrong_chain_id(self):
+        vs, by_addr = make_vals([1] * 4)
+        commit, bid = make_commit(vs, by_addr)
+        with pytest.raises(ErrInvalidCommitSignature):
+            vs.verify_commit("other-chain", bid, 5, commit)
+
+    def test_trusting_one_third(self):
+        vs, by_addr = make_vals([1] * 4)
+        commit, bid = make_commit(vs, by_addr)
+        vs.verify_commit_trusting("test-chain", commit, Fraction(1, 3))
+
+    def test_trusting_unknown_validators_skipped(self):
+        vs, by_addr = make_vals([1] * 4)
+        commit, bid = make_commit(vs, by_addr)
+        # Verify against a larger set that contains the signers plus others
+        extra = [Ed25519PrivKey.from_secret(f"x{i}".encode()) for i in range(2)]
+        all_vals = [Validator(v.pub_key, v.voting_power) for v in vs.validators]
+        all_vals += [Validator(p.pub_key(), 1) for p in extra]
+        big = ValidatorSet(all_vals)
+        big.verify_commit_trusting("test-chain", commit, Fraction(1, 3))
+
+
+class TestEncoding:
+    def test_roundtrip(self):
+        vs, _ = make_vals([3, 5, 7])
+        data = vs.encode()
+        vs2 = ValidatorSet.decode(data)
+        assert vs == vs2
+        assert vs2.hash() == vs.hash()

@@ -1,0 +1,160 @@
+"""VoteSet tally semantics (mirrors types/vote_set_test.go)."""
+
+import pytest
+
+from tendermint_tpu.codec.signbytes import PRECOMMIT_TYPE, PREVOTE_TYPE
+from tendermint_tpu.crypto.keys import Ed25519PrivKey
+from tendermint_tpu.types.block import BlockID, PartSetHeader
+from tendermint_tpu.types.validator import Validator
+from tendermint_tpu.types.validator_set import ValidatorSet
+from tendermint_tpu.types.vote import Vote
+from tendermint_tpu.types.vote_set import ErrVoteConflictingVotes, VoteSet
+
+CHAIN = "test-chain"
+
+
+def setup_voteset(n=4, powers=None, vote_type=PREVOTE_TYPE):
+    powers = powers or [1] * n
+    privs = [Ed25519PrivKey.from_secret(f"vsv{i}".encode()) for i in range(n)]
+    vs = ValidatorSet([Validator(p.pub_key(), pw) for p, pw in zip(privs, powers)])
+    by_addr = {p.pub_key().address(): p for p in privs}
+    ordered = [by_addr[v.address] for v in vs.validators]
+    voteset = VoteSet(CHAIN, height=1, round_=0, signed_msg_type=vote_type, val_set=vs)
+    return voteset, vs, ordered
+
+
+def signed_vote(priv, idx, block_id, vote_type=PREVOTE_TYPE, height=1, round_=0, ts=None):
+    vote = Vote(
+        vote_type=vote_type,
+        height=height,
+        round=round_,
+        block_id=block_id,
+        timestamp_ns=ts if ts is not None else 7000 + idx,
+        validator_address=priv.pub_key().address(),
+        validator_index=idx,
+    )
+    vote.signature = priv.sign(vote.sign_bytes(CHAIN))
+    return vote
+
+
+BID = BlockID(hash=b"\x77" * 32, parts=PartSetHeader(total=1, hash=b"\x78" * 32))
+
+
+def test_add_vote_and_quorum():
+    voteset, vs, privs = setup_voteset(4)
+    for i in range(2):
+        assert voteset.add_vote(signed_vote(privs[i], i, BID))
+    assert not voteset.has_two_thirds_majority()
+    assert voteset.add_vote(signed_vote(privs[2], 2, BID))
+    assert voteset.has_two_thirds_majority()
+    maj, ok = voteset.two_thirds_majority()
+    assert ok and maj == BID
+
+
+def test_nil_votes_count_toward_any_not_block():
+    voteset, vs, privs = setup_voteset(4)
+    nil = BlockID()
+    for i in range(3):
+        assert voteset.add_vote(signed_vote(privs[i], i, nil))
+    assert voteset.has_two_thirds_any()
+    maj, ok = voteset.two_thirds_majority()
+    assert ok and maj == nil  # 2/3 for nil IS a polka for nil
+
+
+def test_wrong_height_rejected():
+    voteset, vs, privs = setup_voteset(4)
+    v = signed_vote(privs[0], 0, BID, height=2)
+    with pytest.raises(Exception):
+        voteset.add_vote(v)
+
+
+def test_bad_signature_rejected():
+    voteset, vs, privs = setup_voteset(4)
+    v = signed_vote(privs[0], 0, BID)
+    v.signature = bytes(64)
+    with pytest.raises(Exception):
+        voteset.add_vote(v)
+
+
+def test_wrong_index_address_rejected():
+    voteset, vs, privs = setup_voteset(4)
+    v = signed_vote(privs[0], 1, BID)  # index 1 but key 0's address
+    with pytest.raises(Exception):
+        voteset.add_vote(v)
+
+
+def test_duplicate_vote_not_added_again():
+    """Reference semantics: exact redelivery returns (added=False, nil err)."""
+    voteset, vs, privs = setup_voteset(4)
+    v = signed_vote(privs[0], 0, BID)
+    assert voteset.add_vote(v)
+    assert voteset.add_vote(v) is False  # no exception
+    assert voteset.sum == 1
+
+
+def test_conflicting_vote_raises():
+    voteset, vs, privs = setup_voteset(4)
+    assert voteset.add_vote(signed_vote(privs[0], 0, BID, ts=1))
+    other = BlockID(hash=b"\x99" * 32, parts=PartSetHeader(1, b"\x9a" * 32))
+    with pytest.raises(ErrVoteConflictingVotes):
+        voteset.add_vote(signed_vote(privs[0], 0, other, ts=2))
+
+
+def test_batched_ingest_matches_serial():
+    voteset_a, _, privs = setup_voteset(7)
+    voteset_b, _, _ = setup_voteset(7)
+    votes = [signed_vote(privs[i], i, BID) for i in range(7)]
+    # serial
+    for v in votes:
+        voteset_a.add_vote(v)
+    # batched
+    added, err = voteset_b.add_votes_batched(votes)
+    assert all(added) and err is None
+    assert voteset_a.sum == voteset_b.sum
+    assert voteset_a.maj23 == voteset_b.maj23
+    assert voteset_a.bit_array() == voteset_b.bit_array()
+
+
+def test_batched_ingest_flags_bad_rows():
+    voteset, _, privs = setup_voteset(5)
+    votes = [signed_vote(privs[i], i, BID) for i in range(5)]
+    votes[2].signature = bytes(64)
+    added, err = voteset.add_votes_batched(votes)
+    assert added == [True, True, False, True, True]
+    assert err is not None
+    assert voteset.sum == 4
+
+
+def test_weighted_quorum():
+    # powers 1,1,10: quorum needs > 8 => the big validator alone not enough
+    voteset, vs, privs = setup_voteset(3, powers=[1, 1, 10])
+    order = {v.address: i for i, v in enumerate(vs.validators)}
+    big_priv = None
+    for p in privs:
+        if vs.validators[order[p.pub_key().address()]].voting_power == 10:
+            big_priv = p
+    idx = order[big_priv.pub_key().address()]
+    voteset.add_vote(signed_vote(big_priv, idx, BID))
+    assert voteset.has_two_thirds_any()  # 10 > 2/3*12=8
+    assert voteset.has_two_thirds_majority()
+
+
+def test_make_commit():
+    voteset, vs, privs = setup_voteset(4, vote_type=PRECOMMIT_TYPE)
+    for i in range(3):
+        voteset.add_vote(signed_vote(privs[i], i, BID, vote_type=PRECOMMIT_TYPE))
+    commit = voteset.make_commit()
+    assert commit.height == 1
+    assert commit.block_id == BID
+    assert len(commit.signatures) == 4
+    assert sum(1 for cs in commit.signatures if cs.for_block()) == 3
+    # verify the commit against the validator set
+    vs.verify_commit(CHAIN, BID, 1, commit)
+
+
+def test_set_peer_maj23_conflict():
+    voteset, vs, privs = setup_voteset(4)
+    voteset.set_peer_maj23("peer1", BID)
+    other = BlockID(hash=b"\x55" * 32, parts=PartSetHeader(1, b"\x56" * 32))
+    with pytest.raises(ValueError):
+        voteset.set_peer_maj23("peer1", other)
