@@ -1,0 +1,120 @@
+"""Benchmark: batched ed25519 commit verification on the local accelerator.
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+
+The headline metric is VerifyCommit wall latency for a 10k-validator
+commit (BASELINE.json north star: <2ms on v5e-1, >=50x Go serial).
+vs_baseline is measured against the serial host verifier (OpenSSL via
+`cryptography` -- itself faster than Go's x/crypto, so the ratio is
+conservative vs the reference).
+
+Details go to stderr; stdout carries exactly the one JSON line.
+"""
+
+import json
+import os
+import sys
+import time
+
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/jax_cache")
+os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "1")
+
+import numpy as np
+
+
+def log(msg):
+    print(msg, file=sys.stderr, flush=True)
+
+
+def make_batch(n, msg_len=160, seed=1234):
+    """n rows of distinct valid (pubkey, msg, sig) triples, signed with a
+    small keyring (distinct messages per row)."""
+    from cryptography.hazmat.primitives import serialization
+    from cryptography.hazmat.primitives.asymmetric.ed25519 import Ed25519PrivateKey
+
+    rng = np.random.RandomState(seed)
+    n_keys = min(n, 64)
+    keys = [Ed25519PrivateKey.from_private_bytes(bytes(rng.bytes(32))) for _ in range(n_keys)]
+    pubs = [
+        k.public_key().public_bytes(
+            serialization.Encoding.Raw, serialization.PublicFormat.Raw
+        )
+        for k in keys
+    ]
+    pks = np.zeros((n, 32), dtype=np.uint8)
+    msgs = np.zeros((n, msg_len), dtype=np.uint8)
+    sigs = np.zeros((n, 64), dtype=np.uint8)
+    for i in range(n):
+        msg = rng.bytes(msg_len)
+        k = keys[i % n_keys]
+        pks[i] = np.frombuffer(pubs[i % n_keys], dtype=np.uint8)
+        msgs[i] = np.frombuffer(msg, dtype=np.uint8)
+        sigs[i] = np.frombuffer(k.sign(msg), dtype=np.uint8)
+    return pks, msgs, sigs
+
+
+def main():
+    import jax
+
+    from tendermint_tpu.models.verifier import VerifierModel
+
+    devs = jax.devices()
+    log(f"devices: {devs}")
+    model = VerifierModel()
+
+    n = 10000
+    pks, msgs, sigs = make_batch(n)
+    powers = np.full(n, 10, dtype=np.int64)
+    counted = np.ones(n, dtype=bool)
+
+    # -- serial host baseline (sampled) -----------------------------------
+    from tendermint_tpu.crypto.batch import CPUBatchVerifier
+
+    sample = 512
+    cpu = CPUBatchVerifier()
+    t0 = time.perf_counter()
+    ok_cpu = cpu.verify_batch(pks[:sample], msgs[:sample], sigs[:sample])
+    cpu_per_sig = (time.perf_counter() - t0) / sample
+    assert ok_cpu.all()
+    baseline_10k = cpu_per_sig * n
+    log(f"host serial: {cpu_per_sig*1e6:.1f} us/sig -> {baseline_10k*1e3:.1f} ms per 10k commit")
+
+    # -- device: compile/warm ---------------------------------------------
+    t0 = time.perf_counter()
+    ok, tally = model.verify_commit(pks, msgs, sigs, powers, counted)
+    warm = time.perf_counter() - t0
+    assert ok.all() and tally == n * 10, (int(ok.sum()), tally)
+    log(f"first call (compile+run): {warm:.1f} s")
+
+    # -- measure p50 over repeated runs -----------------------------------
+    times = []
+    for _ in range(10):
+        t0 = time.perf_counter()
+        ok, tally = model.verify_commit(pks, msgs, sigs, powers, counted)
+        times.append(time.perf_counter() - t0)
+    p50 = sorted(times)[len(times) // 2]
+    thr = n / p50
+    log(f"VerifyCommit@10k p50: {p50*1e3:.2f} ms  ({thr:,.0f} sigs/s)")
+    log(f"all times (ms): {[round(t*1e3,2) for t in times]}")
+
+    # negative control on the warm path
+    sigs_bad = sigs.copy()
+    sigs_bad[7, 3] ^= 1
+    ok_bad, _ = model.verify_commit(pks, msgs, sigs_bad, powers, counted)
+    assert not ok_bad[7] and ok_bad.sum() == n - 1
+
+    print(
+        json.dumps(
+            {
+                "metric": "verify_commit_p50_latency_10k_validators",
+                "value": round(p50 * 1e3, 3),
+                "unit": "ms",
+                "vs_baseline": round(baseline_10k / p50, 2),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,8 @@
+"""Jitted device programs ("models") built from tendermint_tpu.ops.
+
+The flagship model is the commit verifier: batched ed25519 + fused
+voting-power tally, compiled once per (padded batch size, message
+length) bucket and optionally sharded over a device mesh.
+"""
+
+from tendermint_tpu.models.verifier import VerifierModel  # noqa: F401

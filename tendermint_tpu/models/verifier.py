@@ -1,0 +1,165 @@
+"""VerifierModel: the jit-compiled, mesh-shardable batch verifier.
+
+Latency discipline for the <2ms VerifyCommit target (SURVEY.md section
+7.3.6): the kernel is compiled ONCE per (padded-N, msg-len) bucket and
+re-used; batch sizes are padded up to bucket boundaries so a live
+validator set of any size hits a warm executable. Padding rows carry an
+always-invalid signature and zero voting power, so they can't affect
+results.
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+import threading
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+
+# Persistent compilation cache: the verifier graph is large; pay compile
+# once per machine, not per process.
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/jax_cache")
+os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "1")
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+from tendermint_tpu.ops import ed25519 as ops_ed  # noqa: E402
+from tendermint_tpu.parallel import batch_sharding, pad_to_multiple, replicated_sharding  # noqa: E402
+
+# Batch-size buckets (padded row counts) to bound recompilation.
+_BUCKETS = [16, 64, 256, 1024, 4096, 16384]
+
+
+def _bucket(n: int, multiple: int) -> int:
+    for b in _BUCKETS:
+        if n <= b and b % multiple == 0:
+            return b
+    return pad_to_multiple(n, max(multiple, 16384))
+
+
+class VerifierModel:
+    def __init__(self, mesh=None):
+        self.mesh = mesh
+        self._lock = threading.Lock()
+        self._verify_fns: Dict[Tuple[int, int], object] = {}
+        self._tally_fns: Dict[Tuple[int, int], object] = {}
+
+    # -- compiled function cache ------------------------------------------
+
+    def _get_verify(self, n_pad: int, msg_len: int):
+        key = (n_pad, msg_len)
+        with self._lock:
+            fn = self._verify_fns.get(key)
+            if fn is None:
+                fn = self._compile_verify(msg_len)
+                self._verify_fns[key] = fn
+            return fn
+
+    def _compile_verify(self, msg_len: int):
+        if self.mesh is not None:
+            shard = batch_sharding(self.mesh)
+            return jax.jit(
+                ops_ed.verify_core,
+                in_shardings=(shard, shard, shard),
+                out_shardings=shard,
+            )
+        return jax.jit(ops_ed.verify_core)
+
+    def _get_tally(self, n_pad: int, msg_len: int):
+        key = (n_pad, msg_len)
+        with self._lock:
+            fn = self._tally_fns.get(key)
+            if fn is None:
+                if self.mesh is not None:
+                    shard = batch_sharding(self.mesh)
+                    rep = replicated_sharding(self.mesh)
+                    fn = jax.jit(
+                        ops_ed.verify_and_tally,
+                        in_shardings=(shard, shard, shard, shard, shard),
+                        out_shardings=(shard, rep),
+                    )
+                else:
+                    fn = jax.jit(ops_ed.verify_and_tally)
+                self._tally_fns[key] = fn
+            return fn
+
+    # -- padding ----------------------------------------------------------
+
+    def _pad_multiple(self) -> int:
+        if self.mesh is not None:
+            return int(np.prod(list(self.mesh.shape.values())))
+        return 1
+
+    def _pad(self, arr: np.ndarray, n_pad: int) -> np.ndarray:
+        n = arr.shape[0]
+        if n == n_pad:
+            return arr
+        pad = np.zeros((n_pad - n,) + arr.shape[1:], dtype=arr.dtype)
+        return np.concatenate([arr, pad], axis=0)
+
+    # -- public API --------------------------------------------------------
+
+    def verify(self, pubkeys, msgs, sigs, msg_lens=None) -> np.ndarray:
+        """(N,32) u8, (N,L) u8, (N,64) u8 -> (N,) bool numpy.
+
+        Ragged batches (msg_lens set with differing lengths) fall back to
+        the host path -- the consensus hot paths are always uniform.
+        """
+        n = int(pubkeys.shape[0])
+        if n == 0:
+            return np.zeros(0, dtype=bool)
+        if msg_lens is not None and len(set(int(x) for x in msg_lens)) > 1:
+            from tendermint_tpu.crypto.batch import CPUBatchVerifier
+
+            return CPUBatchVerifier().verify_batch(pubkeys, msgs, sigs, msg_lens)
+        msg_len = int(msgs.shape[1]) if msg_lens is None else int(msg_lens[0])
+        msgs = np.asarray(msgs)[:, :msg_len]
+        n_pad = _bucket(n, self._pad_multiple())
+        fn = self._get_verify(n_pad, msg_len)
+        ok = fn(
+            jnp.asarray(self._pad(np.asarray(pubkeys, dtype=np.uint8), n_pad)),
+            jnp.asarray(self._pad(np.asarray(msgs, dtype=np.uint8), n_pad)),
+            jnp.asarray(self._pad(np.asarray(sigs, dtype=np.uint8), n_pad)),
+        )
+        return np.asarray(ok)[:n]
+
+    def verify_commit(self, pubkeys, msgs, sigs, powers, counted) -> Tuple[np.ndarray, int]:
+        """Fused verify + tally; returns (ok (N,) bool, tallied power)."""
+        n = int(pubkeys.shape[0])
+        if n == 0:
+            return np.zeros(0, dtype=bool), 0
+        if n > ops_ed.MAX_TALLY_ROWS:
+            # Tally chunk sums would overflow int32; split the batch.
+            mid = n // 2
+            ok1, t1 = self.verify_commit(
+                pubkeys[:mid], msgs[:mid], sigs[:mid], powers[:mid], counted[:mid]
+            )
+            ok2, t2 = self.verify_commit(
+                pubkeys[mid:], msgs[mid:], sigs[mid:], powers[mid:], counted[mid:]
+            )
+            return np.concatenate([ok1, ok2]), t1 + t2
+        msg_len = int(msgs.shape[1])
+        n_pad = _bucket(n, self._pad_multiple())
+        fn = self._get_tally(n_pad, msg_len)
+        chunks = ops_ed.split_powers(powers)
+        ok, sums = fn(
+            jnp.asarray(self._pad(np.asarray(pubkeys, dtype=np.uint8), n_pad)),
+            jnp.asarray(self._pad(np.asarray(msgs, dtype=np.uint8), n_pad)),
+            jnp.asarray(self._pad(np.asarray(sigs, dtype=np.uint8), n_pad)),
+            jnp.asarray(self._pad(chunks, n_pad)),
+            jnp.asarray(self._pad(np.asarray(counted, dtype=bool), n_pad)),
+        )
+        return np.asarray(ok)[:n], ops_ed.combine_power_chunks(np.asarray(sums))
+
+    def warmup(self, sizes=(1024,), msg_len: int = 160) -> None:
+        """Pre-compile buckets so the first live commit pays no compile."""
+        for n in sizes:
+            pk = np.zeros((n, 32), dtype=np.uint8)
+            mg = np.zeros((n, msg_len), dtype=np.uint8)
+            sg = np.zeros((n, 64), dtype=np.uint8)
+            self.verify(pk, mg, sg)
+            self.verify_commit(
+                pk, mg, sg, np.ones(n, dtype=np.int64), np.ones(n, dtype=bool)
+            )

@@ -1,0 +1,16 @@
+"""TPU compute kernels: batched ed25519 verification + quorum tally.
+
+This package is the framework's device boundary. The reference verifies
+every signature serially on CPU (crypto/ed25519/ed25519.go:151); here the
+same check -- including its exact cofactorless acceptance semantics --
+runs as a single batched JAX program:
+
+- field:      GF(2^255-19) limb arithmetic (20 x 13-bit limbs, int32 --
+              native TPU VPU ops, no 64-bit emulation)
+- curve:      twisted Edwards point ops (complete addition, branch-free)
+- sha512:     batched SHA-512 with uint32 hi/lo pairs
+- sc:         scalar arithmetic mod the group order L
+- ed25519:    batch verify: encode([s]B - [k]A) == R
+- ref_ed25519: pure-Python reference used for differential tests and
+              host-side table precomputation
+"""

@@ -1,0 +1,98 @@
+"""Batched ed25519 verification -- the framework's north-star kernel.
+
+Replaces the reference's serial loop (crypto/ed25519/ed25519.go:151,
+looped per signature at types/validator_set.go:641 and
+types/vote_set.go:201) with ONE branch-free device program over a
+rectangular batch:
+
+    ok[i] = s_i < L
+          & decompress(A_i) succeeds
+          & encode([s_i]B + [k_i](-A_i)) == R_i    (byte equality)
+    with k_i = SHA512(R_i || A_i || M_i) mod L
+
+This is exactly Go x/crypto's cofactorless acceptance (R is never
+decompressed; non-canonical A.y accepted mod p), so a batch accepts a
+signature iff the reference's serial verifier does -- consensus-safe.
+
+The fused commit tally additionally sums voting power over verified
+rows (the reference's tally loop at types/validator_set.go:656),
+returning int32 chunk sums (TPU has no int64) recombined on host.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax.numpy as jnp
+
+from tendermint_tpu.ops import curve
+from tendermint_tpu.ops import sc
+from tendermint_tpu.ops.sha512 import sha512
+
+POWER_CHUNKS = 4
+POWER_CHUNK_BITS = 16
+# Max rows per tally: chunk sums stay below 2^31 (2^16 * 2^14 = 2^30).
+MAX_TALLY_ROWS = 1 << 14
+
+
+def verify_core(
+    pubkeys: jnp.ndarray, msgs: jnp.ndarray, sigs: jnp.ndarray
+) -> jnp.ndarray:
+    """(N,32) u8, (N,L) u8, (N,64) u8 -> (N,) bool."""
+    r_bytes = sigs[:, :32].astype(jnp.int32)
+    s_bytes = sigs[:, 32:].astype(jnp.int32)
+
+    s_ok = sc.is_canonical(s_bytes)
+    a_point, a_ok = curve.decompress(pubkeys)
+    neg_a = curve.negate(a_point)
+
+    preimage = jnp.concatenate(
+        [sigs[:, :32].astype(jnp.int32), pubkeys.astype(jnp.int32), msgs.astype(jnp.int32)],
+        axis=1,
+    )
+    k_bytes = sc.reduce512(sha512(preimage))
+
+    s_digits = curve.nibble_digits(s_bytes)
+    k_digits = curve.nibble_digits(k_bytes)
+    p = curve.double_scalar_mul_base(s_digits, k_digits, neg_a)
+    enc = curve.encode(p)
+    r_match = jnp.all(enc == r_bytes, axis=-1)
+    return r_match & a_ok & s_ok
+
+
+def split_powers(powers) -> jnp.ndarray:
+    """Host helper: (N,) int64 voting powers -> (N, 4) int32 16-bit
+    chunks (little-endian)."""
+    import numpy as np
+
+    p = np.asarray(powers, dtype=np.int64)
+    chunks = np.stack(
+        [(p >> (POWER_CHUNK_BITS * i)) & 0xFFFF for i in range(POWER_CHUNKS)], axis=-1
+    )
+    return chunks.astype(np.int32)
+
+
+def combine_power_chunks(chunk_sums) -> int:
+    """Host helper: (4,) int32 chunk sums -> python int total power."""
+    total = 0
+    for i in range(POWER_CHUNKS):
+        total += int(chunk_sums[i]) << (POWER_CHUNK_BITS * i)
+    return total
+
+
+def verify_and_tally(
+    pubkeys: jnp.ndarray,
+    msgs: jnp.ndarray,
+    sigs: jnp.ndarray,
+    power_chunks: jnp.ndarray,
+    counted: jnp.ndarray,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Fused verify + voting-power segment-sum.
+
+    power_chunks (N, 4) int32; counted (N,) bool. Returns (ok (N,) bool,
+    chunk_sums (4,) int32 summing power where ok & counted).
+    """
+    ok = verify_core(pubkeys, msgs, sigs)
+    mask = (ok & counted).astype(jnp.int32)
+    chunk_sums = jnp.sum(power_chunks * mask[:, None], axis=0)
+    return ok, chunk_sums

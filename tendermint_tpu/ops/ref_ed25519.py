@@ -1,0 +1,175 @@
+"""Pure-Python ed25519 reference implementation.
+
+Written from the curve equations (RFC 8032 math), used for:
+1. differential testing of the JAX device kernels, and
+2. host-side precomputation of base-point tables.
+
+Deliberately matches the acceptance semantics of Go x/crypto/ed25519
+(the reference's verifier, crypto/ed25519/ed25519.go:151):
+- reject s >= L (scMinimal)
+- cofactorless equation, checked by ENCODING comparison:
+  encode([s]B - [k]A) == R_bytes  (R is never decompressed)
+- A decompression masks the top bit, accepts non-canonical y >= p
+"""
+
+from __future__ import annotations
+
+import hashlib
+from typing import Optional, Tuple
+
+P = 2**255 - 19
+L = 2**252 + 27742317777372353535851937790883648493
+D = (-121665 * pow(121666, P - 2, P)) % P
+SQRT_M1 = pow(2, (P - 1) // 4, P)
+
+# Base point
+_BY = 4 * pow(5, P - 2, P) % P
+_BX = None  # computed below
+
+
+def _recover_x(y: int, sign: int) -> Optional[int]:
+    x2 = (y * y - 1) * pow(D * y * y + 1, P - 2, P) % P
+    if x2 == 0:
+        if sign:
+            # x=0 with sign bit: Go negates (no-op) and accepts.
+            return 0
+        return 0
+    x = pow(x2, (P + 3) // 8, P)
+    if (x * x - x2) % P != 0:
+        x = x * SQRT_M1 % P
+    if (x * x - x2) % P != 0:
+        return None
+    if x & 1 != sign:
+        x = P - x
+    return x
+
+
+_BX = _recover_x(_BY, 0)
+BASE = (_BX, _BY)
+
+# Extended coordinates (X, Y, Z, T) with x = X/Z, y = Y/Z, T = XY/Z.
+IDENT = (0, 1, 1, 0)
+
+
+def pt_add(p, q):
+    """Complete unified addition (add-2008-hwcd-3, a=-1)."""
+    X1, Y1, Z1, T1 = p
+    X2, Y2, Z2, T2 = q
+    A = (Y1 - X1) * (Y2 - X2) % P
+    B = (Y1 + X1) * (Y2 + X2) % P
+    C = T1 * 2 * D % P * T2 % P
+    Dd = Z1 * 2 * Z2 % P
+    E = B - A
+    F = Dd - C
+    G = Dd + C
+    H = B + A
+    return (E * F % P, G * H % P, F * G % P, E * H % P)
+
+
+def pt_double(p):
+    """dbl-2008-hwcd with a = -1."""
+    X1, Y1, Z1, _ = p
+    A = X1 * X1 % P
+    B = Y1 * Y1 % P
+    C = 2 * Z1 * Z1 % P
+    Dv = (-A) % P
+    E = ((X1 + Y1) * (X1 + Y1) - A - B) % P
+    G = (Dv + B) % P
+    F = (G - C) % P
+    H = (Dv - B) % P
+    return (E * F % P, G * H % P, F * G % P, E * H % P)
+
+
+def pt_neg(p):
+    X, Y, Z, T = p
+    return ((P - X) % P, Y, Z, (P - T) % P)
+
+
+def pt_mul(k: int, p) -> Tuple[int, int, int, int]:
+    acc = IDENT
+    while k > 0:
+        if k & 1:
+            acc = pt_add(acc, p)
+        p = pt_double(p)
+        k >>= 1
+    return acc
+
+
+def pt_from_affine(x: int, y: int):
+    return (x, y, 1, x * y % P)
+
+
+def pt_to_affine(p) -> Tuple[int, int]:
+    X, Y, Z, _ = p
+    zi = pow(Z, P - 2, P)
+    return (X * zi % P, Y * zi % P)
+
+
+def pt_encode(p) -> bytes:
+    x, y = pt_to_affine(p)
+    enc = y | ((x & 1) << 255)
+    return enc.to_bytes(32, "little")
+
+
+def pt_decode(data: bytes) -> Optional[Tuple[int, int, int, int]]:
+    """Decompress with Go x/crypto semantics: mask sign bit, do NOT
+    reject y >= p (the limbs just reduce mod p)."""
+    if len(data) != 32:
+        return None
+    n = int.from_bytes(data, "little")
+    sign = n >> 255
+    y = (n & ((1 << 255) - 1)) % P
+    x = _recover_x(y, sign)
+    if x is None:
+        return None
+    return pt_from_affine(x, y)
+
+
+def sc_reduce(data: bytes) -> int:
+    return int.from_bytes(data, "little") % L
+
+
+# -- signing / verification -------------------------------------------------
+
+
+def pubkey_from_seed(seed: bytes) -> bytes:
+    h = hashlib.sha512(seed).digest()
+    a = _clamp(h[:32])
+    return pt_encode(pt_mul(a, pt_from_affine(*BASE)))
+
+
+def _clamp(b: bytes) -> int:
+    a = bytearray(b)
+    a[0] &= 248
+    a[31] &= 127
+    a[31] |= 64
+    return int.from_bytes(bytes(a), "little")
+
+
+def sign(seed: bytes, msg: bytes) -> bytes:
+    h = hashlib.sha512(seed).digest()
+    a = _clamp(h[:32])
+    prefix = h[32:]
+    A = pt_encode(pt_mul(a, pt_from_affine(*BASE)))
+    r = sc_reduce(hashlib.sha512(prefix + msg).digest())
+    R = pt_encode(pt_mul(r, pt_from_affine(*BASE)))
+    k = sc_reduce(hashlib.sha512(R + A + msg).digest())
+    s = (r + k * a) % L
+    return R + s.to_bytes(32, "little")
+
+
+def verify(pubkey: bytes, msg: bytes, sig: bytes) -> bool:
+    """Go x/crypto acceptance: s < L; encode([s]B - [k]A) == R bytes."""
+    if len(sig) != 64 or len(pubkey) != 32:
+        return False
+    R_bytes, s_bytes = sig[:32], sig[32:]
+    s = int.from_bytes(s_bytes, "little")
+    if s >= L:
+        return False
+    A = pt_decode(pubkey)
+    if A is None:
+        return False
+    k = sc_reduce(hashlib.sha512(R_bytes + pubkey + msg).digest())
+    #  P = [s]B + [k](-A)
+    Pnt = pt_add(pt_mul(s, pt_from_affine(*BASE)), pt_mul(k, pt_neg(A)))
+    return pt_encode(Pnt) == R_bytes

@@ -1,0 +1,204 @@
+"""Batched SHA-512 with uint32 (hi, lo) word pairs.
+
+TPU has no native 64-bit integers; every 64-bit word is a pair of uint32
+arrays and the compression function is expressed in paired ops (add with
+carry, rotate across the pair). The round loop is a lax.scan over the 80
+round constants; message blocks are processed in a static Python loop
+(callers hash fixed-length inputs -- the ed25519 preimage for the
+consensus hot path is 224 bytes = exactly 2 blocks after padding).
+
+Used for the ed25519 challenge hash k = SHA512(R || A || M).
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+U32 = jnp.uint32
+
+_K = [
+    0x428A2F98D728AE22, 0x7137449123EF65CD, 0xB5C0FBCFEC4D3B2F, 0xE9B5DBA58189DBBC,
+    0x3956C25BF348B538, 0x59F111F1B605D019, 0x923F82A4AF194F9B, 0xAB1C5ED5DA6D8118,
+    0xD807AA98A3030242, 0x12835B0145706FBE, 0x243185BE4EE4B28C, 0x550C7DC3D5FFB4E2,
+    0x72BE5D74F27B896F, 0x80DEB1FE3B1696B1, 0x9BDC06A725C71235, 0xC19BF174CF692694,
+    0xE49B69C19EF14AD2, 0xEFBE4786384F25E3, 0x0FC19DC68B8CD5B5, 0x240CA1CC77AC9C65,
+    0x2DE92C6F592B0275, 0x4A7484AA6EA6E483, 0x5CB0A9DCBD41FBD4, 0x76F988DA831153B5,
+    0x983E5152EE66DFAB, 0xA831C66D2DB43210, 0xB00327C898FB213F, 0xBF597FC7BEEF0EE4,
+    0xC6E00BF33DA88FC2, 0xD5A79147930AA725, 0x06CA6351E003826F, 0x142929670A0E6E70,
+    0x27B70A8546D22FFC, 0x2E1B21385C26C926, 0x4D2C6DFC5AC42AED, 0x53380D139D95B3DF,
+    0x650A73548BAF63DE, 0x766A0ABB3C77B2A8, 0x81C2C92E47EDAEE6, 0x92722C851482353B,
+    0xA2BFE8A14CF10364, 0xA81A664BBC423001, 0xC24B8B70D0F89791, 0xC76C51A30654BE30,
+    0xD192E819D6EF5218, 0xD69906245565A910, 0xF40E35855771202A, 0x106AA07032BBD1B8,
+    0x19A4C116B8D2D0C8, 0x1E376C085141AB53, 0x2748774CDF8EEB99, 0x34B0BCB5E19B48A8,
+    0x391C0CB3C5C95A63, 0x4ED8AA4AE3418ACB, 0x5B9CCA4F7763E373, 0x682E6FF3D6B2B8A3,
+    0x748F82EE5DEFB2FC, 0x78A5636F43172F60, 0x84C87814A1F0AB72, 0x8CC702081A6439EC,
+    0x90BEFFFA23631E28, 0xA4506CEBDE82BDE9, 0xBEF9A3F7B2C67915, 0xC67178F2E372532B,
+    0xCA273ECEEA26619C, 0xD186B8C721C0C207, 0xEADA7DD6CDE0EB1E, 0xF57D4F7FEE6ED178,
+    0x06F067AA72176FBA, 0x0A637DC5A2C898A6, 0x113F9804BEF90DAE, 0x1B710B35131C471B,
+    0x28DB77F523047D84, 0x32CAAB7B40C72493, 0x3C9EBE0A15C9BEBC, 0x431D67C49C100D4C,
+    0x4CC5D4BECB3E42B6, 0x597F299CFC657E2A, 0x5FCB6FAB3AD6FAEC, 0x6C44198C4A475817,
+]
+
+_H0 = [
+    0x6A09E667F3BCC908, 0xBB67AE8584CAA73B, 0x3C6EF372FE94F82B, 0xA54FF53A5F1D36F1,
+    0x510E527FADE682D1, 0x9B05688C2B3E6C1F, 0x1F83D9ABFB41BD6B, 0x5BE0CD19137E2179,
+]
+
+_K_HI = jnp.asarray([k >> 32 for k in _K], dtype=U32)
+_K_LO = jnp.asarray([k & 0xFFFFFFFF for k in _K], dtype=U32)
+
+
+# 64-bit word = (hi, lo) uint32 pair ---------------------------------------
+
+
+def _add2(ah, al, bh, bl):
+    lo = al + bl
+    carry = (lo < al).astype(U32)
+    return ah + bh + carry, lo
+
+
+def _add3(ah, al, bh, bl, ch, cl):
+    h, lo = _add2(ah, al, bh, bl)
+    return _add2(h, lo, ch, cl)
+
+
+def _ror(ah, al, n: int):
+    """Rotate right by n (1..63)."""
+    if n == 32:
+        return al, ah
+    if n < 32:
+        hi = (ah >> n) | (al << (32 - n))
+        lo = (al >> n) | (ah << (32 - n))
+        return hi, lo
+    m = n - 32
+    hi = (al >> m) | (ah << (32 - m))
+    lo = (ah >> m) | (al << (32 - m))
+    return hi, lo
+
+
+def _shr(ah, al, n: int):
+    if n < 32:
+        return ah >> n, (al >> n) | (ah << (32 - n))
+    return jnp.zeros_like(ah), ah >> (n - 32)
+
+
+def _xor3(a, b, c):
+    return (a[0] ^ b[0] ^ c[0], a[1] ^ b[1] ^ c[1])
+
+
+def _big_sigma0(h, l):
+    return _xor3(_ror(h, l, 28), _ror(h, l, 34), _ror(h, l, 39))
+
+
+def _big_sigma1(h, l):
+    return _xor3(_ror(h, l, 14), _ror(h, l, 18), _ror(h, l, 41))
+
+
+def _small_sigma0(h, l):
+    return _xor3(_ror(h, l, 1), _ror(h, l, 8), _shr(h, l, 7))
+
+
+def _small_sigma1(h, l):
+    return _xor3(_ror(h, l, 19), _ror(h, l, 61), _shr(h, l, 6))
+
+
+def _compress(state, wh, wl):
+    """One block: state (8, 2, N) uint32; wh/wl (N, 16)."""
+    a = [(state[i][0], state[i][1]) for i in range(8)]
+
+    # Message schedule + rounds as one scan of 80 steps over a sliding
+    # 16-word window carried in the loop state.
+    def round_body(carry, xs):
+        words_h, words_l, st = carry
+        kh, kl, idx = xs
+        wh_t = words_h[0]
+        wl_t = words_l[0]
+        va, vb, vc, vd, ve, vf, vg, vh = st
+        s1 = _big_sigma1(*ve)
+        ch = (
+            (ve[0] & vf[0]) ^ (~ve[0] & vg[0]),
+            (ve[1] & vf[1]) ^ (~ve[1] & vg[1]),
+        )
+        t1h, t1l = _add3(*_add3(*vh, *s1, *ch), kh, kl, wh_t, wl_t)
+        s0 = _big_sigma0(*va)
+        maj = (
+            (va[0] & vb[0]) ^ (va[0] & vc[0]) ^ (vb[0] & vc[0]),
+            (va[1] & vb[1]) ^ (va[1] & vc[1]) ^ (vb[1] & vc[1]),
+        )
+        t2h, t2l = _add2(*s0, *maj)
+        new_e = _add2(*vd, t1h, t1l)
+        new_a = _add2(t1h, t1l, t2h, t2l)
+        st = (new_a, va, vb, vc, new_e, ve, vf, vg)
+        # extend schedule: w16 = ssigma1(w14) + w9 + ssigma0(w1) + w0
+        s0w = _small_sigma0(words_h[1], words_l[1])
+        s1w = _small_sigma1(words_h[14], words_l[14])
+        t = _add2(s1w[0], s1w[1], words_h[9], words_l[9])
+        t = _add2(*t, *s0w)
+        w16h, w16l = _add2(*t, wh_t, wl_t)
+        words_h = jnp.concatenate([words_h[1:], w16h[None]], axis=0)
+        words_l = jnp.concatenate([words_l[1:], w16l[None]], axis=0)
+        return (words_h, words_l, st), None
+
+    st0 = tuple(a)
+    words_h = jnp.swapaxes(wh, 0, 1)  # (16, N)
+    words_l = jnp.swapaxes(wl, 0, 1)
+    (_, _, st), _ = jax.lax.scan(
+        round_body,
+        (words_h, words_l, st0),
+        (_K_HI, _K_LO, jnp.arange(80)),
+    )
+    out = []
+    for i in range(8):
+        h, lo = _add2(state[i][0], state[i][1], st[i][0], st[i][1])
+        out.append((h, lo))
+    return out
+
+
+def sha512(msgs: jnp.ndarray) -> jnp.ndarray:
+    """Batched SHA-512 of uniform-length messages.
+
+    msgs: (N, L) u8/int32 byte values. L is static; padding is computed
+    at trace time. Returns (N, 64) int32 digest bytes.
+    """
+    n, length = msgs.shape
+    m = msgs.astype(jnp.uint32)
+    # pad: 0x80, zeros, 16-byte big-endian bit length
+    total = length + 1 + 16
+    blocks = (total + 127) // 128
+    padded_len = blocks * 128
+    bitlen = length * 8
+    pad = np.zeros(padded_len - length, dtype=np.uint32)
+    pad[0] = 0x80
+    for i in range(16):
+        pad[-1 - i] = (bitlen >> (8 * i)) & 0xFF
+    m = jnp.concatenate([m, jnp.broadcast_to(jnp.asarray(pad), (n, pad.shape[0]))], axis=1)
+
+    state = [
+        (
+            jnp.full((n,), h >> 32, dtype=U32),
+            jnp.full((n,), h & 0xFFFFFFFF, dtype=U32),
+        )
+        for h in _H0
+    ]
+    for b in range(blocks):
+        blk = m[:, b * 128 : (b + 1) * 128].reshape(n, 16, 8)
+        wh = (
+            (blk[:, :, 0] << 24) | (blk[:, :, 1] << 16) | (blk[:, :, 2] << 8) | blk[:, :, 3]
+        ).astype(U32)
+        wl = (
+            (blk[:, :, 4] << 24) | (blk[:, :, 5] << 16) | (blk[:, :, 6] << 8) | blk[:, :, 7]
+        ).astype(U32)
+        state = _compress(state, wh, wl)
+
+    # digest: 8 words big-endian
+    outs = []
+    for h, lo in state:
+        for word, in [(h,), (lo,)]:
+            outs.extend(
+                [(word >> 24) & 0xFF, (word >> 16) & 0xFF, (word >> 8) & 0xFF, word & 0xFF]
+            )
+    return jnp.stack(outs, axis=-1).astype(jnp.int32)

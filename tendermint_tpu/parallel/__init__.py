@@ -1,0 +1,17 @@
+"""Device-mesh parallelism for the crypto batch path.
+
+The reference scales vote verification by doing nothing -- one goroutine
+verifies serially (types/vote_set.go:201). Here the batch axis (signatures
+per commit / votes per ingest drain) shards over a jax.sharding.Mesh;
+XLA inserts the all-gather for the tally reduction over ICI. Multi-host
+deployments extend the same mesh across DCN (jax.distributed), while
+node-to-node consensus gossip stays on host TCP (see SURVEY.md section
+2.3: the protocol is latency-bound, not a collective).
+"""
+
+from tendermint_tpu.parallel.mesh import (  # noqa: F401
+    batch_sharding,
+    make_mesh,
+    replicated_sharding,
+    pad_to_multiple,
+)

@@ -259,34 +259,38 @@ class ValidatorSet:
 
         `by_address=False` maps signature index i straight to validator i
         (verify_commit: commit produced by THIS set); `by_address=True`
-        looks each signer up by address, skipping unknowns and rejecting
-        double-votes (verify_commit_trusting: commit from another set).
+        looks each signer up by address, skipping unknowns
+        (verify_commit_trusting: commit from another set).
 
-        Returns (idxs, pubkeys(N,32), msgs(N,160), sigs(N,64),
+        Returns (idxs, vals_idx, pubkeys(N,32), msgs(N,160), sigs(N,64),
         powers(N,), counted(N,)) where idxs maps rows back to signature
-        indices.
+        indices and vals_idx to validator indices (for duplicate-signer
+        detection during the sequential replay -- NOT here, so that a
+        duplicate after quorum doesn't reject like the reference doesn't).
         """
         idxs: List[int] = []
+        vals_idx: List[int] = []
         pks: List[bytes] = []
         msgs: List[bytes] = []
         sigs: List[bytes] = []
         powers: List[int] = []
         counted: List[bool] = []
-        seen_vals: Dict[int, int] = {}
         for i, cs in enumerate(commit.signatures):
             if cs.absent_():
                 continue
+            if len(cs.signature) > 64:
+                # reference MaxSignatureSize; must never be truncated into
+                # a valid 64-byte prefix (commit-hash malleability).
+                raise ErrInvalidCommit(f"signature #{i} too big ({len(cs.signature)})")
             if by_address:
                 vi, val = self.get_by_address(cs.validator_address)
                 if val is None:
                     continue
-                # Reject double votes by the same validator (reference :779).
-                if vi in seen_vals:
-                    raise ErrInvalidCommit(f"double vote from validator index {vi}")
-                seen_vals[vi] = i
             else:
+                vi = i
                 val = self.validators[i]
             idxs.append(i)
+            vals_idx.append(vi)
             pks.append(val.pub_key.bytes())
             msgs.append(commit.vote_sign_bytes(chain_id, i))
             sigs.append(cs.signature)
@@ -299,16 +303,28 @@ class ValidatorSet:
         for r in range(n):
             pk[r] = np.frombuffer(pks[r], dtype=np.uint8)
             mg[r] = np.frombuffer(msgs[r], dtype=np.uint8)
-            sig = sigs[r][:64]
-            sg[r, : len(sig)] = np.frombuffer(sig, dtype=np.uint8)
+            sg[r, : len(sigs[r])] = np.frombuffer(sigs[r], dtype=np.uint8)
         return (
             idxs,
+            vals_idx,
             pk,
             mg,
             sg,
             np.asarray(powers, dtype=np.int64),
             np.asarray(counted, dtype=bool),
         )
+
+    def _verify_commit_basic(self, commit, height: int, block_id) -> None:
+        """Shared pre-checks (reference verifyCommitBasic,
+        types/validator_set.go:813): structural validity, height and
+        BlockID match."""
+        err = commit.validate_basic()
+        if err:
+            raise ErrInvalidCommit(err)
+        if height != commit.height:
+            raise ErrInvalidCommit(f"wrong height: {height} vs {commit.height}")
+        if block_id != commit.block_id:
+            raise ErrInvalidCommit(f"wrong block ID: {block_id} vs {commit.block_id}")
 
     def verify_commit(
         self,
@@ -331,12 +347,9 @@ class ValidatorSet:
             raise ErrInvalidCommit(
                 f"wrong set size: {len(self.validators)} vs {len(commit.signatures)}"
             )
-        if height != commit.height:
-            raise ErrInvalidCommit(f"wrong height: {height} vs {commit.height}")
-        if block_id != commit.block_id:
-            raise ErrInvalidCommit(f"wrong block ID: {block_id} vs {commit.block_id}")
+        self._verify_commit_basic(commit, height, block_id)
 
-        idxs, pk, mg, sg, powers, counted = self._commit_batch_arrays(
+        idxs, _vals_idx, pk, mg, sg, powers, counted = self._commit_batch_arrays(
             chain_id, commit, by_address=False
         )
         v = provider or get_default_provider()
@@ -360,6 +373,8 @@ class ValidatorSet:
     def verify_commit_trusting(
         self,
         chain_id: str,
+        block_id,
+        height: int,
         commit,
         trust_level: Fraction,
         provider: Optional[BatchVerifier] = None,
@@ -367,16 +382,22 @@ class ValidatorSet:
         """Verify that `trust_level` (e.g. 1/3) of THIS set signed the
         commit, looking validators up by address (the commit was produced
         by a possibly different set). Reference VerifyCommitTrusting
-        types/validator_set.go:754; the trust level must be in [1/3, 1]
-        (reference ValidateTrustLevel, lite2/verifier.go)."""
+        types/validator_set.go:754 including verifyCommitBasic; the trust
+        level must be in [1/3, 1] (reference ValidateTrustLevel).
+
+        Duplicate-signer detection happens inside the sequential replay,
+        after the batched device verification, so a duplicate appearing
+        AFTER quorum does not reject -- matching the reference's
+        early-return loop exactly."""
         if (
             trust_level.denominator == 0
             or trust_level.numerator * 3 < trust_level.denominator
             or trust_level.numerator > trust_level.denominator
         ):
             raise ValueError(f"trust level must be within [1/3, 1], got {trust_level}")
+        self._verify_commit_basic(commit, height, block_id)
 
-        idxs, pk, mg, sg, powers_arr, counted_arr = self._commit_batch_arrays(
+        idxs, vals_idx, pk, mg, sg, powers_arr, counted_arr = self._commit_batch_arrays(
             chain_id, commit, by_address=True
         )
         v = provider or get_default_provider()
@@ -385,9 +406,14 @@ class ValidatorSet:
         total = self.total_voting_power()
         needed = total * trust_level.numerator // trust_level.denominator
         talled = 0
+        seen_vals: Dict[int, int] = {}
         for r, i in enumerate(idxs):
             if talled > needed:
                 return
+            vi = vals_idx[r]
+            if vi in seen_vals:
+                raise ErrInvalidCommit(f"double vote from validator index {vi}")
+            seen_vals[vi] = i
             if not ok[r]:
                 raise ErrInvalidCommitSignature(f"wrong signature #{i}")
             if counted_arr[r]:
@@ -414,6 +440,9 @@ class ValidatorSet:
         r = Reader(data)
         n = r.read_uvarint()
         vals = [Validator.decode(r.read_bytes()) for _ in range(n)]
+        addrs = [v.address for v in vals]
+        if len(set(addrs)) != len(addrs):
+            raise ValueError("duplicate validator address in encoded set")
         vs = cls.__new__(cls)
         vs.validators = sorted(vals, key=lambda v: v.address)
         vs._addr_index = {v.address: i for i, v in enumerate(vs.validators)}

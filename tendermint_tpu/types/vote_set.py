@@ -237,8 +237,14 @@ class VoteSet:
             return ErrVoteInvalidValidatorIndex(str(vote.validator_index))
         if addr != vote.validator_address:
             return ErrVoteInvalidValidatorAddress(vote.validator_address.hex())
-        # Already have an identical vote?
+        # Already have an identical vote? Check both the canonical slot and
+        # the per-block tracking (a conflicting vote routed through the
+        # SetPeerMaj23 path lives only in votes_by_block -- reference
+        # getVote, vote_set.go:193-208, consults both).
         existing = self.votes[vote.validator_index]
+        if existing is None or existing.block_id != vote.block_id:
+            bv = self.votes_by_block.get(vote.block_id.key())
+            existing = bv.get_by_index(vote.validator_index) if bv else None
         if existing is not None and existing.block_id == vote.block_id:
             if existing.signature != vote.signature:
                 return ErrVoteNonDeterministicSignature(repr(vote))

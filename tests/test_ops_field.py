@@ -1,0 +1,107 @@
+"""Differential tests: JAX field/scalar/hash primitives vs Python ints.
+
+Mirrors the role of Go's internal edwards25519 tests; ground truth is
+arbitrary-precision Python arithmetic.
+"""
+
+import random
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from tendermint_tpu.ops import field as F
+from tendermint_tpu.ops import sc
+
+rng = random.Random(7)
+P = F.P
+
+
+def batch_of(vals):
+    return jnp.stack([jnp.asarray(F.to_limbs(v)) for v in vals])
+
+
+@pytest.fixture(scope="module")
+def xy():
+    xs = [rng.randrange(P) for _ in range(16)]
+    ys = [rng.randrange(P) for _ in range(16)]
+    xs[:5] = [0, 1, P - 1, P - 19, 2**255 - 20]
+    ys[:5] = [0, P - 1, P - 1, 19, 1]
+    return xs, ys
+
+
+def test_mul_add_sub_square(xy):
+    xs, ys = xy
+    X, Y = batch_of(xs), batch_of(ys)
+    for op, pyop in [
+        (F.mul, lambda a, b: (a * b) % P),
+        (F.add, lambda a, b: (a + b) % P),
+        (F.sub, lambda a, b: (a - b) % P),
+    ]:
+        Z = np.asarray(op(X, Y))
+        for i in range(len(xs)):
+            assert F.from_limbs(Z[i]) == pyop(xs[i], ys[i])
+    Z = np.asarray(F.square(X))
+    for i in range(len(xs)):
+        assert F.from_limbs(Z[i]) == (xs[i] * xs[i]) % P
+
+
+def test_invert_and_pow(xy):
+    xs, _ = xy
+    X = batch_of(xs)
+    Z = np.asarray(F.invert(X))
+    for i, x in enumerate(xs):
+        if x:
+            assert F.from_limbs(Z[i]) == pow(x, P - 2, P)
+    Z = np.asarray(F.pow22523(X))
+    for i, x in enumerate(xs):
+        assert F.from_limbs(Z[i]) == pow(x, (P - 5) // 8, P)
+
+
+def test_bytes_roundtrip(xy):
+    xs, _ = xy
+    X = batch_of(xs)
+    B = np.asarray(F.to_bytes(X))
+    for i, x in enumerate(xs):
+        assert bytes(B[i].astype(np.uint8)) == (x % P).to_bytes(32, "little")
+    back = np.asarray(F.from_bytes(jnp.asarray(B)))
+    for i, x in enumerate(xs):
+        assert F.from_limbs(back[i]) == x % P
+
+
+def test_sc_reduce512():
+    L = sc.L
+    cases = [0, 1, L - 1, L, L + 1, 2 * L, 2**252, 2**512 - 1]
+    cases += [rng.randrange(2**512) for _ in range(8)]
+    arr = np.stack([np.frombuffer(c.to_bytes(64, "little"), dtype=np.uint8) for c in cases])
+    out = np.asarray(sc.reduce512(jnp.asarray(arr))).astype(np.uint8)
+    for i, c in enumerate(cases):
+        assert int.from_bytes(bytes(out[i]), "little") == c % L
+
+
+def test_sc_is_canonical():
+    L = sc.L
+    cases = [0, 1, L - 1, L, L + 1, 2**256 - 1] + [rng.randrange(2**256) for _ in range(8)]
+    arr = np.stack([np.frombuffer(c.to_bytes(32, "little"), dtype=np.uint8) for c in cases])
+    ok = np.asarray(sc.is_canonical(jnp.asarray(arr)))
+    for i, c in enumerate(cases):
+        assert bool(ok[i]) == (c < L)
+
+
+def test_sha512_matches_hashlib():
+    import hashlib
+
+    from tendermint_tpu.ops.sha512 import sha512
+
+    for length in [0, 111, 112, 224]:
+        msgs = np.stack(
+            [
+                np.frombuffer(bytes(rng.randrange(256) for _ in range(length)), dtype=np.uint8)
+                if length
+                else np.zeros(0, dtype=np.uint8)
+                for _ in range(4)
+            ]
+        )
+        out = np.asarray(sha512(jnp.asarray(msgs))).astype(np.uint8)
+        for i in range(4):
+            assert bytes(out[i]) == hashlib.sha512(bytes(msgs[i])).digest()

@@ -207,7 +207,7 @@ class TestVerifyCommit:
     def test_trusting_one_third(self):
         vs, by_addr = make_vals([1] * 4)
         commit, bid = make_commit(vs, by_addr)
-        vs.verify_commit_trusting("test-chain", commit, Fraction(1, 3))
+        vs.verify_commit_trusting("test-chain", bid, 5, commit, Fraction(1, 3))
 
     def test_trusting_unknown_validators_skipped(self):
         vs, by_addr = make_vals([1] * 4)
@@ -217,7 +217,41 @@ class TestVerifyCommit:
         all_vals = [Validator(v.pub_key, v.voting_power) for v in vs.validators]
         all_vals += [Validator(p.pub_key(), 1) for p in extra]
         big = ValidatorSet(all_vals)
-        big.verify_commit_trusting("test-chain", commit, Fraction(1, 3))
+        big.verify_commit_trusting("test-chain", bid, 5, commit, Fraction(1, 3))
+
+    def test_trusting_wrong_block_id_rejected(self):
+        """verify_commit_trusting must run verifyCommitBasic (review
+        finding: mismatched header/commit pairs must not pass)."""
+        vs, by_addr = make_vals([1] * 4)
+        commit, _ = make_commit(vs, by_addr)
+        other = BlockID(hash=b"\x99" * 32, parts=PartSetHeader(1, b"\x98" * 32))
+        with pytest.raises(Exception):
+            vs.verify_commit_trusting("test-chain", other, 5, commit, Fraction(1, 3))
+        with pytest.raises(Exception):
+            vs.verify_commit_trusting(
+                "test-chain", commit.block_id, 6, commit, Fraction(1, 3)
+            )
+
+    def test_oversized_signature_rejected(self):
+        """65-byte signature must not be truncated into a valid 64-byte
+        prefix (commit-hash malleability)."""
+        vs, by_addr = make_vals([1] * 4)
+        commit, bid = make_commit(vs, by_addr)
+        commit.signatures[0].signature = commit.signatures[0].signature + b"\x00"
+        with pytest.raises(Exception):
+            vs.verify_commit("test-chain", bid, 5, commit)
+
+    def test_decode_rejects_duplicate_addresses(self):
+        vs, _ = make_vals([3, 5])
+        from tendermint_tpu.codec.binary import Writer
+
+        w = Writer()
+        w.write_uvarint(2)
+        enc = vs.validators[0].encode()
+        w.write_bytes(enc).write_bytes(enc)
+        w.write_bool(False)
+        with pytest.raises(ValueError):
+            ValidatorSet.decode(w.bytes())
 
 
 class TestEncoding:
