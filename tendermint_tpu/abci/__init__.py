@@ -1,0 +1,12 @@
+"""ABCI: the application boundary (reference abci/).
+
+The protocol surface matches abci/types/application.go:11-26 --
+Info/SetOption/Query, CheckTx, InitChain/BeginBlock/DeliverTx/EndBlock/
+Commit -- carried over our deterministic codec instead of protobuf
+(clean-break wire format; see SURVEY.md §7.3 item 2).
+"""
+
+from tendermint_tpu.abci.application import Application
+from tendermint_tpu.abci import types
+
+__all__ = ["Application", "types"]

@@ -1,0 +1,69 @@
+"""Application interface (reference abci/types/application.go:11-26).
+
+Subclass and override; `BaseApplication` returns OK defaults so partial
+apps work (reference abci/types/application.go:38 BaseApplication).
+"""
+
+from __future__ import annotations
+
+from tendermint_tpu.abci import types as t
+
+
+class Application:
+    def info(self, req: t.RequestInfo) -> t.ResponseInfo:
+        return t.ResponseInfo()
+
+    def set_option(self, req: t.RequestSetOption) -> t.ResponseSetOption:
+        return t.ResponseSetOption()
+
+    def query(self, req: t.RequestQuery) -> t.ResponseQuery:
+        return t.ResponseQuery()
+
+    def check_tx(self, req: t.RequestCheckTx) -> t.ResponseCheckTx:
+        return t.ResponseCheckTx()
+
+    def init_chain(self, req: t.RequestInitChain) -> t.ResponseInitChain:
+        return t.ResponseInitChain()
+
+    def begin_block(self, req: t.RequestBeginBlock) -> t.ResponseBeginBlock:
+        return t.ResponseBeginBlock()
+
+    def deliver_tx(self, req: t.RequestDeliverTx) -> t.ResponseDeliverTx:
+        return t.ResponseDeliverTx()
+
+    def end_block(self, req: t.RequestEndBlock) -> t.ResponseEndBlock:
+        return t.ResponseEndBlock()
+
+    def commit(self) -> t.ResponseCommit:
+        return t.ResponseCommit()
+
+
+BaseApplication = Application
+
+
+def handle_request(app: Application, req):
+    """Dispatch one request to the app (shared by local client and socket
+    server; mirrors abci/server/socket_server.go handleRequest)."""
+    if isinstance(req, t.RequestEcho):
+        return t.ResponseEcho(req.message)
+    if isinstance(req, t.RequestFlush):
+        return t.ResponseFlush()
+    if isinstance(req, t.RequestInfo):
+        return app.info(req)
+    if isinstance(req, t.RequestSetOption):
+        return app.set_option(req)
+    if isinstance(req, t.RequestQuery):
+        return app.query(req)
+    if isinstance(req, t.RequestCheckTx):
+        return app.check_tx(req)
+    if isinstance(req, t.RequestInitChain):
+        return app.init_chain(req)
+    if isinstance(req, t.RequestBeginBlock):
+        return app.begin_block(req)
+    if isinstance(req, t.RequestDeliverTx):
+        return app.deliver_tx(req)
+    if isinstance(req, t.RequestEndBlock):
+        return app.end_block(req)
+    if isinstance(req, t.RequestCommit):
+        return app.commit()
+    raise ValueError(f"unknown request type {type(req).__name__}")

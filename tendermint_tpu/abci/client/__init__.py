@@ -1,0 +1,5 @@
+from tendermint_tpu.abci.client.base import ABCIClient, ReqRes
+from tendermint_tpu.abci.client.local import LocalClient
+from tendermint_tpu.abci.client.socket import SocketClient
+
+__all__ = ["ABCIClient", "ReqRes", "LocalClient", "SocketClient"]

@@ -1,0 +1,97 @@
+"""ABCI client interface (reference abci/client/client.go).
+
+Async (pipelined) calls return a `ReqRes` whose `.future` resolves when
+the response arrives; awaiting the `*_sync` helpers gives the reference's
+`*Sync` behavior. The response-callback hook mirrors
+`client.SetResponseCallback` (used by the mempool for CheckTx results).
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Callable, Optional
+
+from tendermint_tpu.abci import types as t
+from tendermint_tpu.utils.service import Service
+
+
+class ReqRes:
+    def __init__(self, request):
+        self.request = request
+        self.future: asyncio.Future = asyncio.get_running_loop().create_future()
+
+    def set_response(self, res) -> None:
+        if not self.future.done():
+            self.future.set_result(res)
+
+    async def wait(self):
+        res = await self.future
+        if isinstance(res, t.ResponseException):
+            raise ABCIClientError(res.error)
+        return res
+
+
+class ABCIClientError(Exception):
+    pass
+
+
+class ABCIClient(Service):
+    """Pipelined request API. Implementations guarantee FIFO response
+    ordering per connection (like the reference socket/local clients)."""
+
+    def __init__(self):
+        super().__init__()
+        self._res_cb: Optional[Callable[[object, object], None]] = None
+
+    def set_response_callback(self, cb: Callable[[object, object], None]) -> None:
+        self._res_cb = cb
+
+    def _notify(self, req, res) -> None:
+        if self._res_cb is not None:
+            self._res_cb(req, res)
+
+    # -- pipelined submissions --------------------------------------------
+    def send_async(self, req) -> ReqRes:
+        raise NotImplementedError
+
+    async def flush(self) -> None:
+        """Ensure all submitted requests have been delivered + answered."""
+        await self.send_async(t.RequestFlush()).wait()
+
+    # -- sync convenience (await completes when response arrives) ----------
+    async def echo_sync(self, message: str) -> t.ResponseEcho:
+        return await self.send_async(t.RequestEcho(message)).wait()
+
+    async def info_sync(self, req: t.RequestInfo) -> t.ResponseInfo:
+        return await self.send_async(req).wait()
+
+    async def set_option_sync(self, req: t.RequestSetOption) -> t.ResponseSetOption:
+        return await self.send_async(req).wait()
+
+    async def query_sync(self, req: t.RequestQuery) -> t.ResponseQuery:
+        return await self.send_async(req).wait()
+
+    async def check_tx_sync(self, req: t.RequestCheckTx) -> t.ResponseCheckTx:
+        return await self.send_async(req).wait()
+
+    async def init_chain_sync(self, req: t.RequestInitChain) -> t.ResponseInitChain:
+        return await self.send_async(req).wait()
+
+    async def begin_block_sync(self, req: t.RequestBeginBlock) -> t.ResponseBeginBlock:
+        return await self.send_async(req).wait()
+
+    async def deliver_tx_sync(self, req: t.RequestDeliverTx) -> t.ResponseDeliverTx:
+        return await self.send_async(req).wait()
+
+    async def end_block_sync(self, req: t.RequestEndBlock) -> t.ResponseEndBlock:
+        return await self.send_async(req).wait()
+
+    async def commit_sync(self) -> t.ResponseCommit:
+        return await self.send_async(t.RequestCommit()).wait()
+
+    # -- async aliases used by hot paths -----------------------------------
+    def check_tx_async(self, req: t.RequestCheckTx) -> ReqRes:
+        return self.send_async(req)
+
+    def deliver_tx_async(self, req: t.RequestDeliverTx) -> ReqRes:
+        return self.send_async(req)

@@ -1,0 +1,42 @@
+"""In-process ABCI client (reference abci/client/local_client.go:16).
+
+Calls the app directly under an asyncio lock -- the reference serializes
+with a shared mutex so the app never sees concurrent calls; the single
+event loop plus this lock gives the same guarantee even if the app
+callback awaits.
+"""
+
+from __future__ import annotations
+
+import asyncio
+
+from tendermint_tpu.abci import types as t  # noqa: F401 (exception wrapping)
+from tendermint_tpu.abci.application import Application, handle_request
+from tendermint_tpu.abci.client.base import ABCIClient, ReqRes
+
+
+class LocalClient(ABCIClient):
+    def __init__(self, app: Application, lock: asyncio.Lock = None):
+        super().__init__()
+        self._app = app
+        # shareable so multiple conns to one app serialize (local_client.go NewLocalClient)
+        self._lock = lock or asyncio.Lock()
+        self._pending = 0
+
+    def send_async(self, req) -> ReqRes:
+        # FIFO holds for every message type (flush included): tasks start in
+        # creation order and the lock queue is fair.
+        rr = ReqRes(req)
+        asyncio.ensure_future(self._run(rr))
+        return rr
+
+    async def _run(self, rr: ReqRes) -> None:
+        async with self._lock:
+            try:
+                res = handle_request(self._app, rr.request)
+                if asyncio.iscoroutine(res):
+                    res = await res
+            except Exception as e:  # app exception → ResponseException
+                res = t.ResponseException(f"{type(e).__name__}: {e}")
+        self._notify(rr.request, res)
+        rr.set_response(res)

@@ -1,0 +1,120 @@
+"""Socket ABCI client (reference abci/client/socket_client.go:29).
+
+Pipelined: requests are framed onto the TCP/unix stream as submitted;
+responses are matched FIFO (the reference asserts response type matches
+the head of reqSent; same check here).
+"""
+
+from __future__ import annotations
+
+import asyncio
+from collections import deque
+
+from tendermint_tpu.abci import codec
+from tendermint_tpu.abci import types as t
+from tendermint_tpu.abci.client.base import ABCIClient, ABCIClientError, ReqRes
+
+MAX_FRAME = 64 << 20
+
+
+def _matches(req, res) -> bool:
+    """FIFO sanity: response type must pair with the request type
+    (reference socket_client.go didExpectResponse check). Exceptions pair
+    with anything -- they surface as errors via ReqRes.wait."""
+    if isinstance(res, t.ResponseException):
+        return True
+    want = "Response" + type(req).__name__[len("Request") :]
+    return type(res).__name__ == want
+
+
+async def read_frame(reader: asyncio.StreamReader) -> bytes:
+    """Read one uvarint-length-prefixed frame."""
+    n = 0
+    shift = 0
+    while True:
+        b = await reader.readexactly(1)
+        n |= (b[0] & 0x7F) << shift
+        if not b[0] & 0x80:
+            break
+        shift += 7
+        if shift > 63:
+            raise ABCIClientError("frame length varint overflow")
+    if n > MAX_FRAME:
+        raise ABCIClientError(f"frame too large: {n}")
+    return await reader.readexactly(n)
+
+
+class SocketClient(ABCIClient):
+    def __init__(self, addr: str):
+        """addr: "tcp://host:port" or "unix:///path"."""
+        super().__init__()
+        self._addr = addr
+        self._reader: asyncio.StreamReader = None
+        self._writer: asyncio.StreamWriter = None
+        self._sent: deque = deque()
+        self._err: Exception = None
+
+    async def on_start(self) -> None:
+        if self._addr.startswith("unix://"):
+            self._reader, self._writer = await asyncio.open_unix_connection(
+                self._addr[len("unix://") :]
+            )
+        elif self._addr.startswith("tcp://"):
+            host, port = self._addr[len("tcp://") :].rsplit(":", 1)
+            self._reader, self._writer = await asyncio.open_connection(host, int(port))
+        else:
+            raise ABCIClientError(f"unsupported abci address {self._addr!r}")
+        self.spawn(self._recv_routine(), name="abci-recv")
+
+    async def on_stop(self) -> None:
+        if self._writer is not None:
+            self._writer.close()
+        self._fail_pending(ABCIClientError("client stopped"))
+
+    def _fail_pending(self, err: Exception) -> None:
+        while self._sent:
+            rr = self._sent.popleft()
+            if not rr.future.done():
+                rr.future.set_exception(err)
+
+    def send_async(self, req) -> ReqRes:
+        if self._err is not None:
+            raise self._err
+        frame = codec.encode_msg(req)  # encode BEFORE enqueue: a bad message
+        rr = ReqRes(req)               # must not desync FIFO matching
+        self._sent.append(rr)
+        self._writer.write(frame)
+        if isinstance(req, (t.RequestFlush, t.RequestCommit)):
+            # eager flush on barriers; otherwise rely on transport buffering
+            asyncio.ensure_future(self._drain())
+        return rr
+
+    async def _drain(self) -> None:
+        try:
+            await self._writer.drain()
+        except Exception:
+            pass
+
+    async def _recv_routine(self) -> None:
+        try:
+            while True:
+                frame = await read_frame(self._reader)
+                res = codec.decode_msg(frame)
+                if not self._sent:
+                    raise ABCIClientError("unexpected response with no pending request")
+                rr = self._sent.popleft()
+                if not _matches(rr.request, res):
+                    raise ABCIClientError(
+                        f"unexpected response type {type(res).__name__} "
+                        f"for request {type(rr.request).__name__}"
+                    )
+                self._notify(rr.request, res)
+                rr.set_response(res)
+        except (asyncio.IncompleteReadError, ConnectionError) as e:
+            self._err = ABCIClientError(f"connection lost: {e}")
+            self._fail_pending(self._err)
+        except asyncio.CancelledError:
+            raise
+        except Exception as e:
+            self._err = e if isinstance(e, ABCIClientError) else ABCIClientError(str(e))
+            self._fail_pending(self._err)

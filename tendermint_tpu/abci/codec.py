@@ -1,0 +1,234 @@
+"""ABCI socket wire format.
+
+Frame = uvarint(total_len) || tag(u8) || payload. One frame per message,
+mirroring the reference's length-prefixed protobuf framing
+(abci/types/messages.go WriteMessage/ReadMessage).
+"""
+
+from __future__ import annotations
+
+from tendermint_tpu.abci import types as t
+from tendermint_tpu.codec.binary import Reader, Writer
+
+# tag -> (cls, encode(w, msg), decode(r) -> msg)
+_REGISTRY = {}
+_TAG_BY_CLS = {}
+
+
+def _register(tag, cls, enc, dec):
+    _REGISTRY[tag] = (cls, enc, dec)
+    _TAG_BY_CLS[cls] = tag
+
+
+def _enc_none(w, m):
+    pass
+
+
+_register(0x01, t.RequestEcho, lambda w, m: w.write_str(m.message), lambda r: t.RequestEcho(r.read_str()))
+_register(0x02, t.RequestFlush, _enc_none, lambda r: t.RequestFlush())
+_register(
+    0x03,
+    t.RequestInfo,
+    lambda w, m: w.write_str(m.version).write_u64(m.block_version).write_u64(m.p2p_version),
+    lambda r: t.RequestInfo(r.read_str(), r.read_u64(), r.read_u64()),
+)
+_register(
+    0x04,
+    t.RequestSetOption,
+    lambda w, m: w.write_str(m.key).write_str(m.value),
+    lambda r: t.RequestSetOption(r.read_str(), r.read_str()),
+)
+
+
+def _enc_init_chain(w, m):
+    w.write_i64(m.time_ns).write_str(m.chain_id)
+    if m.consensus_params is None:
+        w.write_bool(False)
+    else:
+        w.write_bool(True).write_bytes(m.consensus_params.encode())
+    w.write_uvarint(len(m.validators))
+    for v in m.validators:
+        w.write_bytes(v.encode())
+    w.write_bytes(m.app_state_bytes)
+
+
+def _dec_init_chain(r):
+    time_ns = r.read_i64()
+    chain_id = r.read_str()
+    cp = t.ConsensusParamsUpdate.decode(r.read_bytes()) if r.read_bool() else None
+    vals = [t.ValidatorUpdate.decode(r.read_bytes()) for _ in range(r.read_uvarint())]
+    return t.RequestInitChain(time_ns, chain_id, cp, vals, r.read_bytes())
+
+
+_register(0x05, t.RequestInitChain, _enc_init_chain, _dec_init_chain)
+_register(
+    0x06,
+    t.RequestQuery,
+    lambda w, m: w.write_bytes(m.data).write_str(m.path).write_u64(m.height).write_bool(m.prove),
+    lambda r: t.RequestQuery(r.read_bytes(), r.read_str(), r.read_u64(), r.read_bool()),
+)
+
+
+def _enc_begin_block(w, m):
+    w.write_bytes(m.hash).write_bytes(m.header_bytes)
+    w.write_bytes(m.last_commit_info.encode())
+    w.write_uvarint(len(m.byzantine_validators))
+    for e in m.byzantine_validators:
+        w.write_bytes(e.encode())
+
+
+def _dec_begin_block(r):
+    return t.RequestBeginBlock(
+        r.read_bytes(),
+        r.read_bytes(),
+        t.LastCommitInfo.decode(r.read_bytes()),
+        [t.EvidenceInfo.decode(r.read_bytes()) for _ in range(r.read_uvarint())],
+    )
+
+
+_register(0x07, t.RequestBeginBlock, _enc_begin_block, _dec_begin_block)
+_register(
+    0x08,
+    t.RequestCheckTx,
+    lambda w, m: w.write_bytes(m.tx).write_u8(m.type),
+    lambda r: t.RequestCheckTx(r.read_bytes(), r.read_u8()),
+)
+_register(
+    0x09,
+    t.RequestDeliverTx,
+    lambda w, m: w.write_bytes(m.tx),
+    lambda r: t.RequestDeliverTx(r.read_bytes()),
+)
+_register(
+    0x0A,
+    t.RequestEndBlock,
+    lambda w, m: w.write_u64(m.height),
+    lambda r: t.RequestEndBlock(r.read_u64()),
+)
+_register(0x0B, t.RequestCommit, _enc_none, lambda r: t.RequestCommit())
+
+_register(
+    0x41,
+    t.ResponseException,
+    lambda w, m: w.write_str(m.error),
+    lambda r: t.ResponseException(r.read_str()),
+)
+_register(0x42, t.ResponseEcho, lambda w, m: w.write_str(m.message), lambda r: t.ResponseEcho(r.read_str()))
+_register(0x43, t.ResponseFlush, _enc_none, lambda r: t.ResponseFlush())
+_register(
+    0x44,
+    t.ResponseInfo,
+    lambda w, m: (
+        w.write_str(m.data)
+        .write_str(m.version)
+        .write_u64(m.app_version)
+        .write_u64(m.last_block_height)
+        .write_bytes(m.last_block_app_hash)
+    ),
+    lambda r: t.ResponseInfo(r.read_str(), r.read_str(), r.read_u64(), r.read_u64(), r.read_bytes()),
+)
+_register(
+    0x45,
+    t.ResponseSetOption,
+    lambda w, m: w.write_u32(m.code).write_str(m.log).write_str(m.info),
+    lambda r: t.ResponseSetOption(r.read_u32(), r.read_str(), r.read_str()),
+)
+
+
+def _enc_res_init_chain(w, m):
+    if m.consensus_params is None:
+        w.write_bool(False)
+    else:
+        w.write_bool(True).write_bytes(m.consensus_params.encode())
+    w.write_uvarint(len(m.validators))
+    for v in m.validators:
+        w.write_bytes(v.encode())
+
+
+def _dec_res_init_chain(r):
+    cp = t.ConsensusParamsUpdate.decode(r.read_bytes()) if r.read_bool() else None
+    return t.ResponseInitChain(
+        cp, [t.ValidatorUpdate.decode(r.read_bytes()) for _ in range(r.read_uvarint())]
+    )
+
+
+_register(0x46, t.ResponseInitChain, _enc_res_init_chain, _dec_res_init_chain)
+_register(
+    0x47,
+    t.ResponseQuery,
+    lambda w, m: (
+        w.write_u32(m.code)
+        .write_str(m.log)
+        .write_str(m.info)
+        .write_i64(m.index)
+        .write_bytes(m.key)
+        .write_bytes(m.value)
+        .write_bytes(m.proof_bytes)
+        .write_u64(m.height)
+        .write_str(m.codespace)
+    ),
+    lambda r: t.ResponseQuery(
+        r.read_u32(),
+        r.read_str(),
+        r.read_str(),
+        r.read_i64(),
+        r.read_bytes(),
+        r.read_bytes(),
+        r.read_bytes(),
+        r.read_u64(),
+        r.read_str(),
+    ),
+)
+
+
+def _enc_res_begin_block(w, m):
+    t._enc_events(w, m.events)
+
+
+_register(0x48, t.ResponseBeginBlock, _enc_res_begin_block, lambda r: t.ResponseBeginBlock(t._dec_events(r)))
+
+# CheckTx/DeliverTx share one wire shape, owned by types._TxResult
+_register(
+    0x49,
+    t.ResponseCheckTx,
+    lambda w, m: w.write_raw(m.encode()),
+    lambda r: t.ResponseCheckTx.decode(r.read_raw(r.remaining())),
+)
+_register(
+    0x4A,
+    t.ResponseDeliverTx,
+    lambda w, m: w.write_raw(m.encode()),
+    lambda r: t.ResponseDeliverTx.decode(r.read_raw(r.remaining())),
+)
+_register(
+    0x4B,
+    t.ResponseEndBlock,
+    lambda w, m: w.write_raw(m.encode()),
+    lambda r: t.ResponseEndBlock.decode(r.read_raw(r.remaining())),
+)
+_register(
+    0x4C,
+    t.ResponseCommit,
+    lambda w, m: w.write_bytes(m.data).write_u64(m.retain_height),
+    lambda r: t.ResponseCommit(r.read_bytes(), r.read_u64()),
+)
+
+
+def encode_msg(msg) -> bytes:
+    """One framed message: uvarint(len) || tag || payload."""
+    tag = _TAG_BY_CLS[type(msg)]
+    w = Writer()
+    _, enc, _ = _REGISTRY[tag]
+    enc(w, msg)
+    payload = w.bytes()
+    return Writer().write_uvarint(1 + len(payload)).write_u8(tag).write_raw(payload).bytes()
+
+
+def decode_msg(frame: bytes):
+    """Decode tag||payload (length prefix already stripped)."""
+    r = Reader(frame)
+    tag = r.read_u8()
+    if tag not in _REGISTRY:
+        raise ValueError(f"unknown abci message tag 0x{tag:02x}")
+    _, _, dec = _REGISTRY[tag]
+    return dec(r)

@@ -1,0 +1,4 @@
+from tendermint_tpu.abci.examples.counter import CounterApplication
+from tendermint_tpu.abci.examples.kvstore import KVStoreApplication, PersistentKVStoreApplication
+
+__all__ = ["CounterApplication", "KVStoreApplication", "PersistentKVStoreApplication"]

@@ -1,0 +1,68 @@
+"""counter example app (reference abci/example/counter/counter.go).
+
+Serial mode requires txs to be the big-endian count in order -- exercises
+CheckTx rejection + deterministic DeliverTx paths.
+"""
+
+from __future__ import annotations
+
+import struct
+
+from tendermint_tpu.abci import types as t
+from tendermint_tpu.abci.application import Application
+
+
+class CounterApplication(Application):
+    def __init__(self, serial: bool = False):
+        self.hash_count = 0
+        self.tx_count = 0
+        self.serial = serial
+
+    def info(self, req: t.RequestInfo) -> t.ResponseInfo:
+        return t.ResponseInfo(
+            data=f"{{\"hashes\":{self.hash_count},\"txs\":{self.tx_count}}}"
+        )
+
+    def set_option(self, req: t.RequestSetOption) -> t.ResponseSetOption:
+        if req.key == "serial" and req.value == "on":
+            self.serial = True
+        return t.ResponseSetOption()
+
+    def _tx_value(self, tx: bytes) -> int:
+        if len(tx) > 8:
+            return -1
+        return int.from_bytes(tx, "big")
+
+    def check_tx(self, req: t.RequestCheckTx) -> t.ResponseCheckTx:
+        if self.serial:
+            v = self._tx_value(req.tx)
+            if v < 0 or len(req.tx) > 8:
+                return t.ResponseCheckTx(code=1, log=f"invalid tx {req.tx!r}")
+            if v < self.tx_count:
+                return t.ResponseCheckTx(
+                    code=2, log=f"invalid nonce: got {v}, expected >= {self.tx_count}"
+                )
+        return t.ResponseCheckTx()
+
+    def deliver_tx(self, req: t.RequestDeliverTx) -> t.ResponseDeliverTx:
+        if self.serial:
+            v = self._tx_value(req.tx)
+            if v != self.tx_count:
+                return t.ResponseDeliverTx(
+                    code=2, log=f"invalid nonce: got {v}, expected {self.tx_count}"
+                )
+        self.tx_count += 1
+        return t.ResponseDeliverTx()
+
+    def commit(self) -> t.ResponseCommit:
+        self.hash_count += 1
+        if self.tx_count == 0:
+            return t.ResponseCommit(data=b"")
+        return t.ResponseCommit(data=struct.pack(">Q", self.tx_count))
+
+    def query(self, req: t.RequestQuery) -> t.ResponseQuery:
+        if req.path == "hash":
+            return t.ResponseQuery(value=str(self.hash_count).encode())
+        if req.path == "tx":
+            return t.ResponseQuery(value=str(self.tx_count).encode())
+        return t.ResponseQuery(code=1, log=f"invalid query path {req.path}")

@@ -1,0 +1,154 @@
+"""kvstore example app -- the universal test fixture.
+
+Reference: abci/example/kvstore/kvstore.go:63 (in-memory) and
+persistent_kvstore.go (validator-update aware). Tx format "key=value"
+(or tx used as both). App hash = big-endian size (kvstore.go:110 region);
+persistent variant handles "val:pubkeyB64!power" txs for validator-set
+changes like the reference's PersistentKVStoreApplication.
+"""
+
+from __future__ import annotations
+
+import base64
+import struct
+from typing import Dict, List, Optional
+
+from tendermint_tpu.abci import types as t
+from tendermint_tpu.abci.application import Application
+from tendermint_tpu.db import DB, MemDB
+
+VALIDATOR_TX_PREFIX = b"val:"
+
+
+class KVStoreApplication(Application):
+    def __init__(self, db: Optional[DB] = None):
+        self._db = db or MemDB()
+        self._size = 0
+        self._height = 0
+        self._app_hash = b""
+        self._load_state()
+
+    # -- state record ------------------------------------------------------
+
+    def _load_state(self) -> None:
+        raw = self._db.get(b"__state__")
+        if raw is not None:
+            self._height, self._size = struct.unpack(">QQ", raw[:16])
+            self._app_hash = raw[16:]
+
+    def _save_state(self) -> None:
+        self._db.set(
+            b"__state__", struct.pack(">QQ", self._height, self._size) + self._app_hash
+        )
+
+    # -- abci --------------------------------------------------------------
+
+    def info(self, req: t.RequestInfo) -> t.ResponseInfo:
+        return t.ResponseInfo(
+            data=f"{{\"size\":{self._size}}}",
+            version="kvstore-tpu-0.1.0",
+            app_version=1,
+            last_block_height=self._height,
+            last_block_app_hash=self._app_hash,
+        )
+
+    def check_tx(self, req: t.RequestCheckTx) -> t.ResponseCheckTx:
+        return t.ResponseCheckTx(code=t.CODE_TYPE_OK, gas_wanted=1)
+
+    def deliver_tx(self, req: t.RequestDeliverTx) -> t.ResponseDeliverTx:
+        if b"=" in req.tx:
+            key, value = req.tx.split(b"=", 1)
+        else:
+            key, value = req.tx, req.tx
+        self._db.set(b"kv:" + key, value)
+        self._size += 1
+        events = [
+            t.Event(
+                type="app",
+                attributes=[
+                    t.KVPair(b"creator", b"Cosmoshi Netowoko"),
+                    t.KVPair(b"key", key),
+                ],
+            )
+        ]
+        return t.ResponseDeliverTx(code=t.CODE_TYPE_OK, events=events)
+
+    def commit(self) -> t.ResponseCommit:
+        self._app_hash = struct.pack(">Q", self._size)
+        self._height += 1
+        self._save_state()
+        return t.ResponseCommit(data=self._app_hash)
+
+    def query(self, req: t.RequestQuery) -> t.ResponseQuery:
+        if req.path == "/store" or req.path == "":
+            value = self._db.get(b"kv:" + req.data)
+            return t.ResponseQuery(
+                code=t.CODE_TYPE_OK,
+                key=req.data,
+                value=value or b"",
+                log="exists" if value is not None else "does not exist",
+                height=self._height,
+            )
+        return t.ResponseQuery(code=1, log=f"unknown path {req.path}")
+
+
+class PersistentKVStoreApplication(KVStoreApplication):
+    """Adds validator-set updates via "val:<pubkey-b64>!<power>" txs
+    (reference persistent_kvstore.go:27 region)."""
+
+    def __init__(self, db: Optional[DB] = None):
+        super().__init__(db)
+        self._val_updates: List[t.ValidatorUpdate] = []
+        self._validators: Dict[bytes, int] = {}
+        self._load_validators()
+
+    def _load_validators(self) -> None:
+        for k, v in self._db.prefix_iterator(b"vu:"):
+            self._validators[k[3:]] = struct.unpack(">q", v)[0]
+
+    def init_chain(self, req: t.RequestInitChain) -> t.ResponseInitChain:
+        for vu in req.validators:
+            self._set_validator(vu)
+        return t.ResponseInitChain()
+
+    def begin_block(self, req: t.RequestBeginBlock) -> t.ResponseBeginBlock:
+        self._val_updates = []
+        return t.ResponseBeginBlock()
+
+    def deliver_tx(self, req: t.RequestDeliverTx) -> t.ResponseDeliverTx:
+        if req.tx.startswith(VALIDATOR_TX_PREFIX):
+            return self._exec_validator_tx(req.tx[len(VALIDATOR_TX_PREFIX) :])
+        return super().deliver_tx(req)
+
+    def _exec_validator_tx(self, tx: bytes) -> t.ResponseDeliverTx:
+        try:
+            pk_b64, power_s = tx.split(b"!", 1)
+            pub_key = base64.b64decode(pk_b64)
+            power = int(power_s)
+        except Exception:
+            return t.ResponseDeliverTx(
+                code=1, log=f"malformed validator tx {tx!r} (want val:pubkeyB64!power)"
+            )
+        vu = t.ValidatorUpdate(pub_key=pub_key, power=power)
+        self._set_validator(vu)
+        self._val_updates.append(vu)
+        return t.ResponseDeliverTx(code=t.CODE_TYPE_OK)
+
+    def _set_validator(self, vu: t.ValidatorUpdate) -> None:
+        if vu.power == 0:
+            self._validators.pop(vu.pub_key, None)
+            self._db.delete(b"vu:" + vu.pub_key)
+        else:
+            self._validators[vu.pub_key] = vu.power
+            self._db.set(b"vu:" + vu.pub_key, struct.pack(">q", vu.power))
+
+    def end_block(self, req: t.RequestEndBlock) -> t.ResponseEndBlock:
+        return t.ResponseEndBlock(validator_updates=list(self._val_updates))
+
+    def query(self, req: t.RequestQuery) -> t.ResponseQuery:
+        if req.path == "/val":
+            power = self._validators.get(req.data, 0)
+            return t.ResponseQuery(
+                code=t.CODE_TYPE_OK, key=req.data, value=struct.pack(">q", power)
+            )
+        return super().query(req)

@@ -1,0 +1,3 @@
+from tendermint_tpu.abci.server.socket import SocketServer
+
+__all__ = ["SocketServer"]

@@ -1,0 +1,103 @@
+"""ABCI socket server (reference abci/server/socket_server.go).
+
+Accepts many connections; each connection's requests execute strictly in
+order (one handler task per conn), with a shared app lock across conns --
+matching the reference's global app mutex.
+"""
+
+from __future__ import annotations
+
+import asyncio
+
+from tendermint_tpu.abci import codec
+from tendermint_tpu.abci import types as t
+from tendermint_tpu.abci.application import Application, handle_request
+from tendermint_tpu.abci.client.socket import read_frame
+from tendermint_tpu.utils.service import Service
+
+
+class SocketServer(Service):
+    def __init__(self, addr: str, app: Application):
+        super().__init__()
+        self._addr = addr
+        self._app = app
+        self._app_lock = asyncio.Lock()
+        self._server: asyncio.AbstractServer = None
+        self._conns = set()
+
+    @property
+    def listen_addr(self) -> str:
+        """Resolved address (useful when binding port 0 in tests)."""
+        if self._server is None or not self._server.sockets:
+            return self._addr
+        sock = self._server.sockets[0]
+        name = sock.getsockname()
+        if isinstance(name, tuple):
+            return f"tcp://{name[0]}:{name[1]}"
+        return f"unix://{name}"
+
+    async def on_start(self) -> None:
+        if self._addr.startswith("unix://"):
+            self._server = await asyncio.start_unix_server(
+                self._handle_conn, self._addr[len("unix://") :]
+            )
+        elif self._addr.startswith("tcp://"):
+            host, port = self._addr[len("tcp://") :].rsplit(":", 1)
+            self._server = await asyncio.start_server(self._handle_conn, host, int(port))
+        else:
+            raise ValueError(f"unsupported abci address {self._addr!r}")
+
+    async def on_stop(self) -> None:
+        if self._server is not None:
+            self._server.close()
+            # cancel live connection handlers BEFORE wait_closed: since
+            # py3.12 wait_closed blocks until handlers return, and ours
+            # loop until the peer disconnects.
+            for task in list(self._conns):
+                task.cancel()
+            if self._conns:
+                await asyncio.gather(*self._conns, return_exceptions=True)
+            await self._server.wait_closed()
+
+    async def _handle_conn(
+        self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter
+    ) -> None:
+        task = asyncio.current_task()
+        self._conns.add(task)
+        try:
+            while True:
+                frame = await read_frame(reader)
+                try:
+                    req = codec.decode_msg(frame)
+                except Exception as e:
+                    # malformed message: answer with an exception response
+                    # and drop the conn (reference socket_server.go recovers
+                    # the same way rather than killing the handler silently)
+                    writer.write(
+                        codec.encode_msg(t.ResponseException(f"decode error: {e}"))
+                    )
+                    await writer.drain()
+                    return
+                async with self._app_lock:
+                    try:
+                        res = handle_request(self._app, req)
+                        if asyncio.iscoroutine(res):
+                            res = await res
+                    except Exception as e:
+                        res = t.ResponseException(f"{type(e).__name__}: {e}")
+                writer.write(codec.encode_msg(res))
+                if isinstance(req, t.RequestFlush):
+                    await writer.drain()
+        except (asyncio.IncompleteReadError, ConnectionError):
+            pass
+        except asyncio.CancelledError:
+            pass
+        except Exception as e:  # oversized frame, bad varint, ...
+            try:
+                writer.write(codec.encode_msg(t.ResponseException(str(e))))
+                await writer.drain()
+            except Exception:
+                pass
+        finally:
+            self._conns.discard(task)
+            writer.close()

@@ -1,0 +1,430 @@
+"""ABCI message types (reference abci/types/types.pb.go, hand-modeled).
+
+Every Request*/Response* is a dataclass with encode()/decode() for the
+socket transport. The tagged-union framing lives in
+`tendermint_tpu.abci.codec`.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import List, Optional
+
+from tendermint_tpu.codec.binary import Reader, Writer
+
+CODE_TYPE_OK = 0
+
+
+# -- common ----------------------------------------------------------------
+
+
+@dataclass
+class KVPair:
+    key: bytes = b""
+    value: bytes = b""
+
+    def encode(self) -> bytes:
+        return Writer().write_bytes(self.key).write_bytes(self.value).bytes()
+
+    @classmethod
+    def decode(cls, data: bytes) -> "KVPair":
+        r = Reader(data)
+        return cls(r.read_bytes(), r.read_bytes())
+
+
+@dataclass
+class Event:
+    """DeliverTx/BeginBlock/EndBlock event (abci Event: type + attributes)."""
+
+    type: str = ""
+    attributes: List[KVPair] = field(default_factory=list)
+
+    def encode(self) -> bytes:
+        w = Writer().write_str(self.type).write_uvarint(len(self.attributes))
+        for a in self.attributes:
+            w.write_bytes(a.encode())
+        return w.bytes()
+
+    @classmethod
+    def decode(cls, data: bytes) -> "Event":
+        r = Reader(data)
+        t = r.read_str()
+        n = r.read_uvarint()
+        return cls(t, [KVPair.decode(r.read_bytes()) for _ in range(n)])
+
+
+def _enc_events(w: Writer, events: List[Event]) -> None:
+    w.write_uvarint(len(events))
+    for e in events:
+        w.write_bytes(e.encode())
+
+
+def _dec_events(r: Reader) -> List[Event]:
+    return [Event.decode(r.read_bytes()) for _ in range(r.read_uvarint())]
+
+
+@dataclass
+class ValidatorUpdate:
+    """EndBlock validator change (abci ValidatorUpdate: pubkey + power)."""
+
+    pub_key: bytes = b""  # registered-codec encoding (crypto.keys.encode_pubkey)
+    power: int = 0
+
+    def encode(self) -> bytes:
+        return Writer().write_bytes(self.pub_key).write_i64(self.power).bytes()
+
+    @classmethod
+    def decode(cls, data: bytes) -> "ValidatorUpdate":
+        r = Reader(data)
+        return cls(r.read_bytes(), r.read_i64())
+
+
+@dataclass
+class Validator:
+    """Identifies a validator to the app (address + power)."""
+
+    address: bytes = b""
+    power: int = 0
+
+    def encode(self) -> bytes:
+        return Writer().write_bytes(self.address).write_i64(self.power).bytes()
+
+    @classmethod
+    def decode(cls, data: bytes) -> "Validator":
+        r = Reader(data)
+        return cls(r.read_bytes(), r.read_i64())
+
+
+@dataclass
+class VoteInfo:
+    """LastCommitInfo entry: did this validator sign the last block."""
+
+    validator: Validator = field(default_factory=Validator)
+    signed_last_block: bool = False
+
+    def encode(self) -> bytes:
+        return (
+            Writer()
+            .write_bytes(self.validator.encode())
+            .write_bool(self.signed_last_block)
+            .bytes()
+        )
+
+    @classmethod
+    def decode(cls, data: bytes) -> "VoteInfo":
+        r = Reader(data)
+        return cls(Validator.decode(r.read_bytes()), r.read_bool())
+
+
+@dataclass
+class LastCommitInfo:
+    round: int = 0
+    votes: List[VoteInfo] = field(default_factory=list)
+
+    def encode(self) -> bytes:
+        w = Writer().write_i64(self.round).write_uvarint(len(self.votes))
+        for v in self.votes:
+            w.write_bytes(v.encode())
+        return w.bytes()
+
+    @classmethod
+    def decode(cls, data: bytes) -> "LastCommitInfo":
+        r = Reader(data)
+        rnd = r.read_i64()
+        return cls(rnd, [VoteInfo.decode(r.read_bytes()) for _ in range(r.read_uvarint())])
+
+
+@dataclass
+class EvidenceInfo:
+    """Byzantine-validator report passed in BeginBlock."""
+
+    type: str = ""
+    validator: Validator = field(default_factory=Validator)
+    height: int = 0
+    time_ns: int = 0
+    total_voting_power: int = 0
+
+    def encode(self) -> bytes:
+        return (
+            Writer()
+            .write_str(self.type)
+            .write_bytes(self.validator.encode())
+            .write_u64(self.height)
+            .write_i64(self.time_ns)
+            .write_i64(self.total_voting_power)
+            .bytes()
+        )
+
+    @classmethod
+    def decode(cls, data: bytes) -> "EvidenceInfo":
+        r = Reader(data)
+        return cls(
+            r.read_str(),
+            Validator.decode(r.read_bytes()),
+            r.read_u64(),
+            r.read_i64(),
+            r.read_i64(),
+        )
+
+
+@dataclass
+class ConsensusParamsUpdate:
+    """Subset-update of consensus params from EndBlock; None fields keep
+    current values (mirrors abci.ConsensusParams nullable sections)."""
+
+    max_block_bytes: Optional[int] = None
+    max_block_gas: Optional[int] = None
+    max_evidence_age_ns: Optional[int] = None
+    max_evidence_age_blocks: Optional[int] = None
+    pub_key_types: Optional[List[str]] = None
+
+    def encode(self) -> bytes:
+        w = Writer()
+        for v in (
+            self.max_block_bytes,
+            self.max_block_gas,
+            self.max_evidence_age_ns,
+            self.max_evidence_age_blocks,
+        ):
+            if v is None:
+                w.write_bool(False)
+            else:
+                w.write_bool(True).write_i64(v)
+        if self.pub_key_types is None:
+            w.write_bool(False)
+        else:
+            w.write_bool(True).write_uvarint(len(self.pub_key_types))
+            for t in self.pub_key_types:
+                w.write_str(t)
+        return w.bytes()
+
+    @classmethod
+    def decode(cls, data: bytes) -> "ConsensusParamsUpdate":
+        r = Reader(data)
+        vals = [r.read_i64() if r.read_bool() else None for _ in range(4)]
+        pkt = None
+        if r.read_bool():
+            pkt = [r.read_str() for _ in range(r.read_uvarint())]
+        return cls(*vals, pkt)
+
+
+# -- requests --------------------------------------------------------------
+
+
+@dataclass
+class RequestEcho:
+    message: str = ""
+
+
+@dataclass
+class RequestFlush:
+    pass
+
+
+@dataclass
+class RequestInfo:
+    version: str = ""
+    block_version: int = 0
+    p2p_version: int = 0
+
+
+@dataclass
+class RequestSetOption:
+    key: str = ""
+    value: str = ""
+
+
+@dataclass
+class RequestInitChain:
+    time_ns: int = 0
+    chain_id: str = ""
+    consensus_params: Optional[ConsensusParamsUpdate] = None
+    validators: List[ValidatorUpdate] = field(default_factory=list)
+    app_state_bytes: bytes = b""
+
+
+@dataclass
+class RequestQuery:
+    data: bytes = b""
+    path: str = ""
+    height: int = 0
+    prove: bool = False
+
+
+@dataclass
+class RequestBeginBlock:
+    hash: bytes = b""
+    header_bytes: bytes = b""  # encoded types.Header
+    last_commit_info: LastCommitInfo = field(default_factory=LastCommitInfo)
+    byzantine_validators: List[EvidenceInfo] = field(default_factory=list)
+
+
+CHECK_TX_NEW = 0
+CHECK_TX_RECHECK = 1
+
+
+@dataclass
+class RequestCheckTx:
+    tx: bytes = b""
+    type: int = CHECK_TX_NEW
+
+
+@dataclass
+class RequestDeliverTx:
+    tx: bytes = b""
+
+
+@dataclass
+class RequestEndBlock:
+    height: int = 0
+
+
+@dataclass
+class RequestCommit:
+    pass
+
+
+# -- responses -------------------------------------------------------------
+
+
+@dataclass
+class ResponseException:
+    error: str = ""
+
+
+@dataclass
+class ResponseEcho:
+    message: str = ""
+
+
+@dataclass
+class ResponseFlush:
+    pass
+
+
+@dataclass
+class ResponseInfo:
+    data: str = ""
+    version: str = ""
+    app_version: int = 0
+    last_block_height: int = 0
+    last_block_app_hash: bytes = b""
+
+
+@dataclass
+class ResponseSetOption:
+    code: int = CODE_TYPE_OK
+    log: str = ""
+    info: str = ""
+
+
+@dataclass
+class ResponseInitChain:
+    consensus_params: Optional[ConsensusParamsUpdate] = None
+    validators: List[ValidatorUpdate] = field(default_factory=list)
+
+
+@dataclass
+class ResponseQuery:
+    code: int = CODE_TYPE_OK
+    log: str = ""
+    info: str = ""
+    index: int = 0
+    key: bytes = b""
+    value: bytes = b""
+    proof_bytes: bytes = b""
+    height: int = 0
+    codespace: str = ""
+
+    def is_ok(self) -> bool:
+        return self.code == CODE_TYPE_OK
+
+
+@dataclass
+class ResponseBeginBlock:
+    events: List[Event] = field(default_factory=list)
+
+
+@dataclass
+class _TxResult:
+    """Shared CheckTx/DeliverTx result shape + single wire encoding."""
+
+    code: int = CODE_TYPE_OK
+    data: bytes = b""
+    log: str = ""
+    info: str = ""
+    gas_wanted: int = 0
+    gas_used: int = 0
+    events: List[Event] = field(default_factory=list)
+    codespace: str = ""
+
+    def is_ok(self) -> bool:
+        return self.code == CODE_TYPE_OK
+
+    def encode(self) -> bytes:
+        w = Writer()
+        w.write_u32(self.code).write_bytes(self.data).write_str(self.log)
+        w.write_str(self.info).write_i64(self.gas_wanted).write_i64(self.gas_used)
+        _enc_events(w, self.events)
+        w.write_str(self.codespace)
+        return w.bytes()
+
+    @classmethod
+    def decode(cls, data: bytes):
+        r = Reader(data)
+        return cls(
+            r.read_u32(),
+            r.read_bytes(),
+            r.read_str(),
+            r.read_str(),
+            r.read_i64(),
+            r.read_i64(),
+            _dec_events(r),
+            r.read_str(),
+        )
+
+
+@dataclass
+class ResponseCheckTx(_TxResult):
+    pass
+
+
+@dataclass
+class ResponseDeliverTx(_TxResult):
+    def result_hash_bytes(self) -> bytes:
+        """Deterministic encoding entering LastResultsHash: code+data only
+        (reference types/results.go NewResults -- non-deterministic fields
+        excluded)."""
+        return Writer().write_u32(self.code).write_bytes(self.data).bytes()
+
+
+@dataclass
+class ResponseEndBlock:
+    validator_updates: List[ValidatorUpdate] = field(default_factory=list)
+    consensus_param_updates: Optional[ConsensusParamsUpdate] = None
+    events: List[Event] = field(default_factory=list)
+
+    def encode(self) -> bytes:
+        w = Writer()
+        w.write_uvarint(len(self.validator_updates))
+        for v in self.validator_updates:
+            w.write_bytes(v.encode())
+        if self.consensus_param_updates is None:
+            w.write_bool(False)
+        else:
+            w.write_bool(True).write_bytes(self.consensus_param_updates.encode())
+        _enc_events(w, self.events)
+        return w.bytes()
+
+    @classmethod
+    def decode(cls, data: bytes) -> "ResponseEndBlock":
+        r = Reader(data)
+        vus = [ValidatorUpdate.decode(r.read_bytes()) for _ in range(r.read_uvarint())]
+        cpu = ConsensusParamsUpdate.decode(r.read_bytes()) if r.read_bool() else None
+        return cls(vus, cpu, _dec_events(r))
+
+
+@dataclass
+class ResponseCommit:
+    data: bytes = b""  # the app hash
+    retain_height: int = 0

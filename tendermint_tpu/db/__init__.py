@@ -1,0 +1,30 @@
+"""Key-value store abstraction (tm-db equivalent).
+
+The reference stores blocks/state/indexes through the `tm-db` interface
+(goleveldb by default). Here the interface is `DB` with two backends:
+`MemDB` (tests, ephemeral nodes) and `SQLiteDB` (persistent; stdlib,
+crash-safe WAL journaling -- fits the role goleveldb plays in the
+reference without a new native dependency).
+"""
+
+from tendermint_tpu.db.base import DB, Batch, Iterator
+from tendermint_tpu.db.memdb import MemDB
+from tendermint_tpu.db.sqlitedb import SQLiteDB
+
+_BACKENDS = {
+    "memdb": MemDB,
+    "sqlite": SQLiteDB,
+}
+
+
+def new_db(name: str, backend: str = "sqlite", dir: str = ".") -> DB:
+    """Open a named database (reference node/node.go:207 initDBs uses
+    DBContext{"blockstore"|"state"|...})."""
+    if backend not in _BACKENDS:
+        raise ValueError(f"unknown db backend: {backend!r} (have {sorted(_BACKENDS)})")
+    if backend == "memdb":
+        return MemDB()
+    return SQLiteDB(name, dir)
+
+
+__all__ = ["DB", "Batch", "Iterator", "MemDB", "SQLiteDB", "new_db"]

@@ -1,0 +1,131 @@
+"""DB interface contract (tm-db `DB`/`Batch`/`Iterator` equivalents).
+
+Semantics mirrored from the reference's tm-db dependency (used at
+store/store.go:33, state/store.go:71):
+- keys/values are bytes; empty or None keys are invalid
+- iterators cover [start, end) in byte order; None start/end = unbounded
+- batches apply atomically on write()
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, List, Optional, Tuple
+
+
+def check_key(key: bytes) -> None:
+    """Shared key validation: empty/None keys are invalid (the contract
+    stated in the module docstring; enforced by every backend)."""
+    if not key:
+        raise ValueError("nil or empty key")
+
+
+class DB:
+    def get(self, key: bytes) -> Optional[bytes]:
+        raise NotImplementedError
+
+    def has(self, key: bytes) -> bool:
+        return self.get(key) is not None
+
+    def set(self, key: bytes, value: bytes) -> None:
+        raise NotImplementedError
+
+    def set_sync(self, key: bytes, value: bytes) -> None:
+        self.set(key, value)
+        self.sync()
+
+    def delete(self, key: bytes) -> None:
+        raise NotImplementedError
+
+    def delete_sync(self, key: bytes) -> None:
+        self.delete(key)
+        self.sync()
+
+    def iterator(
+        self, start: Optional[bytes] = None, end: Optional[bytes] = None
+    ) -> "Iterator":
+        raise NotImplementedError
+
+    def reverse_iterator(
+        self, start: Optional[bytes] = None, end: Optional[bytes] = None
+    ) -> "Iterator":
+        raise NotImplementedError
+
+    def new_batch(self) -> "Batch":
+        return Batch(self)
+
+    def _apply_batch(self, ops, sync: bool) -> None:
+        # validate everything first so a bad op can't leave a half-applied
+        # batch (keeps the atomicity contract for non-transactional backends)
+        for op, key, value in ops:
+            check_key(key)
+            if op == "set" and value is None:
+                raise ValueError("nil value")
+        for op, key, value in ops:
+            if op == "set":
+                self.set(key, value)
+            else:
+                self.delete(key)
+        if sync:
+            self.sync()
+
+    def sync(self) -> None:
+        pass
+
+    def close(self) -> None:
+        pass
+
+    def stats(self) -> dict:
+        return {}
+
+    # iteration helper
+    def prefix_iterator(self, prefix: bytes) -> "Iterator":
+        return self.iterator(prefix, prefix_end(prefix))
+
+
+def prefix_end(prefix: bytes) -> Optional[bytes]:
+    """Smallest byte string greater than every key with this prefix."""
+    if not prefix:
+        return None
+    b = bytearray(prefix)
+    for i in reversed(range(len(b))):
+        if b[i] != 0xFF:
+            b[i] += 1
+            return bytes(b[: i + 1])
+    return None  # all 0xff: unbounded
+
+
+class Iterator:
+    """Iterates (key, value) pairs in order."""
+
+    def __init__(self, items: Iterable[Tuple[bytes, bytes]]):
+        self._it = iter(items)
+
+    def __iter__(self):
+        return self._it
+
+    def items(self) -> List[Tuple[bytes, bytes]]:
+        return list(self._it)
+
+
+class Batch:
+    """Atomic write batch; ops applied in order on write()."""
+
+    def __init__(self, db: DB):
+        self._db = db
+        self._ops: List[Tuple[str, bytes, Optional[bytes]]] = []
+
+    def set(self, key: bytes, value: bytes) -> "Batch":
+        self._ops.append(("set", key, value))
+        return self
+
+    def delete(self, key: bytes) -> "Batch":
+        self._ops.append(("del", key, None))
+        return self
+
+    def write(self) -> None:
+        self._db._apply_batch(self._ops, sync=False)
+        self._ops = []
+
+    def write_sync(self) -> None:
+        self._db._apply_batch(self._ops, sync=True)
+        self._ops = []

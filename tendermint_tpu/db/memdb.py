@@ -1,0 +1,62 @@
+"""In-memory DB backend (tm-db memdb equivalent) -- ordered via bisect."""
+
+from __future__ import annotations
+
+import bisect
+import threading
+from typing import Optional
+
+from tendermint_tpu.db.base import DB, Iterator, check_key
+
+
+class MemDB(DB):
+    def __init__(self):
+        self._data = {}
+        self._keys = []  # sorted
+        self._lock = threading.RLock()
+
+    def get(self, key: bytes) -> Optional[bytes]:
+        check_key(key)
+        with self._lock:
+            return self._data.get(key)
+
+    def set(self, key: bytes, value: bytes) -> None:
+        check_key(key)
+        if value is None:
+            raise ValueError("nil value")
+        with self._lock:
+            if key not in self._data:
+                bisect.insort(self._keys, key)
+            self._data[key] = bytes(value)
+
+    def delete(self, key: bytes) -> None:
+        check_key(key)
+        with self._lock:
+            if key in self._data:
+                del self._data[key]
+                i = bisect.bisect_left(self._keys, key)
+                del self._keys[i]
+
+    def _range(self, start: Optional[bytes], end: Optional[bytes]):
+        lo = bisect.bisect_left(self._keys, start) if start is not None else 0
+        hi = bisect.bisect_left(self._keys, end) if end is not None else len(self._keys)
+        return self._keys[lo:hi]
+
+    def iterator(self, start=None, end=None) -> Iterator:
+        with self._lock:
+            ks = self._range(start, end)
+            return Iterator([(k, self._data[k]) for k in ks])
+
+    def reverse_iterator(self, start=None, end=None) -> Iterator:
+        with self._lock:
+            ks = self._range(start, end)
+            return Iterator([(k, self._data[k]) for k in reversed(ks)])
+
+    def _apply_batch(self, ops, sync: bool) -> None:
+        with self._lock:
+            super()._apply_batch(ops, sync)
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {"keys": len(self._keys)}
+

@@ -1,0 +1,9 @@
+from tendermint_tpu.proxy.app_conn import AppConns, ClientCreator, local_client_creator, remote_client_creator, default_client_creator
+
+__all__ = [
+    "AppConns",
+    "ClientCreator",
+    "local_client_creator",
+    "remote_client_creator",
+    "default_client_creator",
+]

@@ -1,0 +1,77 @@
+"""Typed ABCI connections + client creators (reference proxy/).
+
+`AppConns` owns three client connections -- consensus, mempool, query --
+so CheckTx never blocks block execution (proxy/multi_app_conn.go:12,
+proxy/app_conn.go:11,23,33). A `ClientCreator` makes one client per
+connection (proxy/client.go).
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Callable
+
+from tendermint_tpu.abci.client import ABCIClient, LocalClient, SocketClient
+from tendermint_tpu.utils.service import Service
+
+ClientCreator = Callable[[], ABCIClient]
+
+
+def local_client_creator(app) -> ClientCreator:
+    """All conns share one app + one lock (proxy/client.go NewLocalClientCreator)."""
+    lock = asyncio.Lock()
+    return lambda: LocalClient(app, lock)
+
+
+def remote_client_creator(addr: str) -> ClientCreator:
+    return lambda: SocketClient(addr)
+
+
+def default_client_creator(app_spec, db_dir: str = ".") -> ClientCreator:
+    """Map an `abci` config value to a creator (proxy/client.go:66
+    DefaultClientCreator): "kvstore" | "persistent_kvstore" | "counter" |
+    "counter_serial" | "noop" | transport address."""
+    if app_spec == "kvstore":
+        from tendermint_tpu.abci.examples import KVStoreApplication
+
+        return local_client_creator(KVStoreApplication())
+    if app_spec == "persistent_kvstore":
+        from tendermint_tpu.abci.examples import PersistentKVStoreApplication
+        from tendermint_tpu.db import new_db
+
+        return local_client_creator(
+            PersistentKVStoreApplication(new_db("kvstore", "sqlite", db_dir))
+        )
+    if app_spec in ("counter", "counter_serial"):
+        from tendermint_tpu.abci.examples import CounterApplication
+
+        return local_client_creator(CounterApplication(serial=app_spec.endswith("serial")))
+    if app_spec == "noop":
+        from tendermint_tpu.abci.application import Application
+
+        return local_client_creator(Application())
+    return remote_client_creator(app_spec)
+
+
+class AppConns(Service):
+    """Starts/stops the three connections (proxy/multi_app_conn.go)."""
+
+    def __init__(self, creator: ClientCreator):
+        super().__init__()
+        self._creator = creator
+        self.consensus: ABCIClient = None
+        self.mempool: ABCIClient = None
+        self.query: ABCIClient = None
+
+    async def on_start(self) -> None:
+        self.query = self._creator()
+        await self.query.start()
+        self.mempool = self._creator()
+        await self.mempool.start()
+        self.consensus = self._creator()
+        await self.consensus.start()
+
+    async def on_stop(self) -> None:
+        for c in (self.consensus, self.mempool, self.query):
+            if c is not None:
+                await c.stop()

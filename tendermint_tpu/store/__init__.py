@@ -1,0 +1,3 @@
+from tendermint_tpu.store.block_store import BlockStore
+
+__all__ = ["BlockStore"]

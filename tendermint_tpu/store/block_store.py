@@ -1,0 +1,200 @@
+"""Block persistence (reference store/store.go).
+
+Layout (one record per key, deterministic codec):
+  meta:<h>   BlockMeta          (reference "H:%v", store/store.go:382)
+  part:<h>:<i>  block Part      ("P:%v:%v" :387)
+  cmt:<h>    Commit (canonical, from block.LastCommit of h+1; "C:%v" :392)
+  seen:<h>   SeenCommit         ("SC:%v" :397)
+  bsjson     store height/base  (BlockStoreStateJSON :402)
+
+Write ordering matches the reference's SaveBlock (store/store.go:270):
+parts + meta + commits in one atomic batch, then the store state -- so a
+crash never leaves a visible height without its block.
+"""
+
+from __future__ import annotations
+
+import struct
+import threading
+from typing import Optional
+
+from tendermint_tpu.codec.binary import Reader, Writer
+from tendermint_tpu.db import DB
+from tendermint_tpu.types.block import Block, BlockID, Commit
+from tendermint_tpu.types.block_meta import BlockMeta
+from tendermint_tpu.types.part_set import Part, PartSet
+
+_STATE_KEY = b"bsjson"
+
+
+def _h(prefix: bytes, height: int) -> bytes:
+    return prefix + struct.pack(">Q", height)
+
+
+def _meta_key(h: int) -> bytes:
+    return _h(b"meta:", h)
+
+
+def _part_key(h: int, i: int) -> bytes:
+    return _h(b"part:", h) + struct.pack(">I", i)
+
+
+def _commit_key(h: int) -> bytes:
+    return _h(b"cmt:", h)
+
+
+def _seen_commit_key(h: int) -> bytes:
+    return _h(b"seen:", h)
+
+
+class BlockStore:
+    """Stores blocks as part-sets keyed by height (store/store.go:33)."""
+
+    def __init__(self, db: DB):
+        self._db = db
+        self._mtx = threading.RLock()
+        base, height = self._load_state()
+        self._base = base
+        self._height = height
+
+    # -- state record ------------------------------------------------------
+
+    def _load_state(self):
+        raw = self._db.get(_STATE_KEY)
+        if raw is None:
+            return 0, 0
+        r = Reader(raw)
+        return r.read_u64(), r.read_u64()
+
+    def _save_state(self, batch=None) -> None:
+        w = Writer().write_u64(self._base).write_u64(self._height)
+        if batch is not None:
+            batch.set(_STATE_KEY, w.bytes())
+        else:
+            self._db.set_sync(_STATE_KEY, w.bytes())
+
+    @property
+    def base(self) -> int:
+        with self._mtx:
+            return self._base
+
+    @property
+    def height(self) -> int:
+        with self._mtx:
+            return self._height
+
+    def size(self) -> int:
+        with self._mtx:
+            return 0 if self._height == 0 else self._height - self._base + 1
+
+    # -- loads -------------------------------------------------------------
+
+    def load_block_meta(self, height: int) -> Optional[BlockMeta]:
+        raw = self._db.get(_meta_key(height))
+        return BlockMeta.decode(raw) if raw is not None else None
+
+    def load_block(self, height: int) -> Optional[Block]:
+        meta = self.load_block_meta(height)
+        if meta is None:
+            return None
+        parts = []
+        for i in range(meta.block_id.parts.total):
+            p = self.load_block_part(height, i)
+            if p is None:
+                return None
+            parts.append(p.bytes_)
+        return Block.decode(b"".join(parts))
+
+    def load_block_by_hash(self, block_hash: bytes) -> Optional[Block]:
+        # Scan metas (reference keeps a BH: hash→height index only in later
+        # versions; heights here are dense so scan is bounded by store size).
+        with self._mtx:
+            lo, hi = self._base, self._height
+        for h in range(hi, lo - 1, -1):
+            meta = self.load_block_meta(h)
+            if meta is not None and meta.block_id.hash == block_hash:
+                return self.load_block(h)
+        return None
+
+    def load_block_part(self, height: int, index: int) -> Optional[Part]:
+        raw = self._db.get(_part_key(height, index))
+        return Part.decode(raw) if raw is not None else None
+
+    def load_block_commit(self, height: int) -> Optional[Commit]:
+        """Canonical commit for block `height` (stored when h+1 is saved)."""
+        raw = self._db.get(_commit_key(height))
+        return Commit.decode(raw) if raw is not None else None
+
+    def load_seen_commit(self, height: int) -> Optional[Commit]:
+        """Locally-seen commit (possibly for a different round)."""
+        raw = self._db.get(_seen_commit_key(height))
+        return Commit.decode(raw) if raw is not None else None
+
+    # -- saves -------------------------------------------------------------
+
+    def save_block(self, block: Block, parts: PartSet, seen_commit: Commit) -> None:
+        """Persist block + parts + commits atomically (store/store.go:270)."""
+        if block is None:
+            raise ValueError("BlockStore can only save a non-nil block")
+        height = block.header.height
+        with self._mtx:
+            if self._height > 0 and height != self._height + 1:
+                raise ValueError(
+                    f"BlockStore can only save contiguous blocks. "
+                    f"Wanted {self._height + 1}, got {height}"
+                )
+            if not parts.is_complete():
+                raise ValueError("BlockStore can only save complete block part sets")
+
+            batch = self._db.new_batch()
+            block_id = BlockID(block.hash(), parts.header())
+            meta = BlockMeta(
+                block_id=block_id,
+                block_size=sum(len(parts.get_part(i).bytes_) for i in range(parts.total)),
+                header=block.header,
+                num_txs=len(block.data.txs),
+            )
+            batch.set(_meta_key(height), meta.encode())
+            for i in range(parts.total):
+                batch.set(_part_key(height, i), parts.get_part(i).encode())
+            if block.last_commit is not None:
+                batch.set(_commit_key(height - 1), block.last_commit.encode())
+            batch.set(_seen_commit_key(height), seen_commit.encode())
+
+            self._height = height
+            if self._base == 0:
+                self._base = height
+            self._save_state(batch)
+            batch.write_sync()
+
+    def save_seen_commit(self, height: int, seen_commit: Commit) -> None:
+        self._db.set_sync(_seen_commit_key(height), seen_commit.encode())
+
+    def prune_blocks(self, retain_height: int) -> int:
+        """Delete blocks below retain_height (store/store.go:197). Returns
+        number pruned."""
+        with self._mtx:
+            if retain_height <= 0:
+                raise ValueError("height must be greater than 0")
+            if retain_height > self._height:
+                raise ValueError(
+                    f"cannot prune beyond the latest height {self._height}"
+                )
+            if retain_height < self._base:
+                return 0
+            pruned = 0
+            batch = self._db.new_batch()
+            for h in range(self._base, retain_height):
+                meta = self.load_block_meta(h)
+                if meta is None:
+                    continue
+                batch.delete(_meta_key(h))
+                for i in range(meta.block_id.parts.total):
+                    batch.delete(_part_key(h, i))
+                batch.delete(_commit_key(h))
+                batch.delete(_seen_commit_key(h))
+                pruned += 1
+            self._base = retain_height
+            self._save_state(batch)
+            batch.write_sync()
+            return pruned

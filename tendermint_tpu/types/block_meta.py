@@ -1,0 +1,42 @@
+"""BlockMeta: header + block id/size summary (reference types/block_meta.go:8)."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from tendermint_tpu.codec.binary import Reader, Writer
+from tendermint_tpu.types.block import Block, BlockID, Header
+
+
+@dataclass
+class BlockMeta:
+    block_id: BlockID = field(default_factory=BlockID)
+    block_size: int = 0
+    header: Header = field(default_factory=Header)
+    num_txs: int = 0
+
+    @classmethod
+    def from_block(cls, block: Block, block_size: int) -> "BlockMeta":
+        return cls(
+            block_id=BlockID(block.hash(), block.make_part_set().header()),
+            block_size=block_size,
+            header=block.header,
+            num_txs=len(block.data.txs),
+        )
+
+    def encode(self) -> bytes:
+        w = Writer()
+        w.write_bytes(self.block_id.encode())
+        w.write_u64(self.block_size)
+        w.write_bytes(self.header.encode())
+        w.write_u64(self.num_txs)
+        return w.bytes()
+
+    @classmethod
+    def decode(cls, data: bytes) -> "BlockMeta":
+        r = Reader(data)
+        bid = BlockID.decode(r.read_bytes())
+        size = r.read_u64()
+        header = Header.decode(r.read_bytes())
+        num = r.read_u64()
+        return cls(block_id=bid, block_size=size, header=header, num_txs=num)

@@ -1,0 +1,238 @@
+"""ABCI conformance: codec round-trips, local + socket clients against
+kvstore/counter apps (mirrors abci/tests/test_app + client tests)."""
+
+import asyncio
+import struct
+
+import pytest
+
+from tendermint_tpu.abci import codec
+from tendermint_tpu.abci import types as t
+from tendermint_tpu.abci.client import LocalClient, SocketClient
+from tendermint_tpu.abci.examples import (
+    CounterApplication,
+    KVStoreApplication,
+    PersistentKVStoreApplication,
+)
+from tendermint_tpu.abci.server import SocketServer
+from tendermint_tpu.proxy import AppConns, local_client_creator
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+# -- codec -----------------------------------------------------------------
+
+
+ROUNDTRIP_MSGS = [
+    t.RequestEcho("hello"),
+    t.RequestFlush(),
+    t.RequestInfo("0.33.4", 10, 7),
+    t.RequestSetOption("serial", "on"),
+    t.RequestInitChain(
+        time_ns=123,
+        chain_id="test-chain",
+        consensus_params=t.ConsensusParamsUpdate(max_block_bytes=1024),
+        validators=[t.ValidatorUpdate(b"\x01" * 37, 10)],
+        app_state_bytes=b"{}",
+    ),
+    t.RequestQuery(b"key", "/store", 7, True),
+    t.RequestBeginBlock(
+        hash=b"\x09" * 32,
+        header_bytes=b"hdr",
+        last_commit_info=t.LastCommitInfo(
+            round=1, votes=[t.VoteInfo(t.Validator(b"\x02" * 20, 5), True)]
+        ),
+        byzantine_validators=[
+            t.EvidenceInfo("duplicate/vote", t.Validator(b"\x03" * 20, 9), 4, 99, 100)
+        ],
+    ),
+    t.RequestCheckTx(b"tx-bytes", t.CHECK_TX_RECHECK),
+    t.RequestDeliverTx(b"tx-bytes"),
+    t.RequestEndBlock(42),
+    t.RequestCommit(),
+    t.ResponseException("boom"),
+    t.ResponseEcho("hello"),
+    t.ResponseFlush(),
+    t.ResponseInfo("data", "v", 1, 10, b"\x01" * 8),
+    t.ResponseSetOption(0, "l", "i"),
+    t.ResponseInitChain(
+        consensus_params=t.ConsensusParamsUpdate(pub_key_types=["ed25519"]),
+        validators=[t.ValidatorUpdate(b"\x04" * 37, 3)],
+    ),
+    t.ResponseQuery(0, "log", "info", 2, b"k", b"v", b"proof", 7, "cs"),
+    t.ResponseBeginBlock([t.Event("e", [t.KVPair(b"a", b"b")])]),
+    t.ResponseCheckTx(1, b"d", "l", "i", 2, 1, [], "cs"),
+    t.ResponseDeliverTx(0, b"d", "l", "i", 2, 1, [t.Event("x", [])], ""),
+    t.ResponseEndBlock(
+        [t.ValidatorUpdate(b"\x05" * 37, 0)],
+        t.ConsensusParamsUpdate(max_block_gas=-1),
+        [t.Event("eb", [])],
+    ),
+    t.ResponseCommit(b"apphash", 3),
+]
+
+
+@pytest.mark.parametrize("msg", ROUNDTRIP_MSGS, ids=lambda m: type(m).__name__)
+def test_codec_roundtrip(msg):
+    framed = codec.encode_msg(msg)
+    # strip uvarint length prefix
+    n = 0
+    shift = 0
+    i = 0
+    while True:
+        b = framed[i]
+        n |= (b & 0x7F) << shift
+        i += 1
+        if not b & 0x80:
+            break
+        shift += 7
+    assert len(framed) - i == n
+    assert codec.decode_msg(framed[i:]) == msg
+
+
+# -- local client ----------------------------------------------------------
+
+
+def test_local_client_kvstore():
+    async def go():
+        app = KVStoreApplication()
+        cli = LocalClient(app)
+        await cli.start()
+        res = await cli.echo_sync("hi")
+        assert res.message == "hi"
+        info = await cli.info_sync(t.RequestInfo())
+        assert info.last_block_height == 0
+        d = await cli.deliver_tx_sync(t.RequestDeliverTx(b"name=satoshi"))
+        assert d.is_ok()
+        c = await cli.commit_sync()
+        assert c.data == struct.pack(">Q", 1)
+        q = await cli.query_sync(t.RequestQuery(data=b"name", path="/store"))
+        assert q.value == b"satoshi"
+        await cli.stop()
+
+    run(go())
+
+
+def test_local_client_pipelined_order():
+    async def go():
+        app = CounterApplication(serial=True)
+        cli = LocalClient(app)
+        await cli.start()
+        # pipeline 20 serial txs without awaiting in between
+        rrs = [
+            cli.deliver_tx_async(t.RequestDeliverTx(struct.pack(">Q", i).lstrip(b"\x00") or b""))
+            for i in range(20)
+        ]
+        await cli.flush()
+        for rr in rrs:
+            res = await rr.wait()
+            assert res.is_ok(), res.log
+        assert app.tx_count == 20
+        await cli.stop()
+
+    run(go())
+
+
+def test_exception_response():
+    class BadApp(KVStoreApplication):
+        def deliver_tx(self, req):
+            raise RuntimeError("kaboom")
+
+    async def go():
+        cli = LocalClient(BadApp())
+        await cli.start()
+        with pytest.raises(Exception, match="kaboom"):
+            await cli.deliver_tx_sync(t.RequestDeliverTx(b"x"))
+        await cli.stop()
+
+    run(go())
+
+
+# -- socket client/server --------------------------------------------------
+
+
+def test_socket_client_server_kvstore():
+    async def go():
+        app = KVStoreApplication()
+        srv = SocketServer("tcp://127.0.0.1:0", app)
+        await srv.start()
+        cli = SocketClient(srv.listen_addr)
+        await cli.start()
+
+        echo = await cli.echo_sync("ping")
+        assert echo.message == "ping"
+
+        rrs = [cli.deliver_tx_async(t.RequestDeliverTx(b"k%d=v%d" % (i, i))) for i in range(50)]
+        await cli.flush()
+        for rr in rrs:
+            assert (await rr.wait()).is_ok()
+        c = await cli.commit_sync()
+        assert c.data == struct.pack(">Q", 50)
+
+        q = await cli.query_sync(t.RequestQuery(data=b"k7", path="/store"))
+        assert q.value == b"v7"
+
+        await cli.stop()
+        await srv.stop()
+
+    run(go())
+
+
+def test_socket_response_callback():
+    async def go():
+        app = CounterApplication()
+        srv = SocketServer("tcp://127.0.0.1:0", app)
+        await srv.start()
+        cli = SocketClient(srv.listen_addr)
+        await cli.start()
+        seen = []
+        cli.set_response_callback(lambda req, res: seen.append((req, res)))
+        rr = cli.check_tx_async(t.RequestCheckTx(b"\x00"))
+        await cli.flush()
+        await rr.wait()
+        assert any(isinstance(r, t.RequestCheckTx) for r, _ in seen)
+        await cli.stop()
+        await srv.stop()
+
+    run(go())
+
+
+# -- persistent kvstore validator txs --------------------------------------
+
+
+def test_persistent_kvstore_val_updates():
+    import base64
+
+    app = PersistentKVStoreApplication()
+    app.begin_block(t.RequestBeginBlock())
+    pk = b"\x07" * 37
+    tx = b"val:" + base64.b64encode(pk) + b"!12"
+    res = app.deliver_tx(t.RequestDeliverTx(tx))
+    assert res.is_ok(), res.log
+    eb = app.end_block(t.RequestEndBlock(1))
+    assert eb.validator_updates == [t.ValidatorUpdate(pk, 12)]
+    q = app.query(t.RequestQuery(data=pk, path="/val"))
+    assert struct.unpack(">q", q.value)[0] == 12
+    # malformed
+    bad = app.deliver_tx(t.RequestDeliverTx(b"val:garbage"))
+    assert not bad.is_ok()
+
+
+# -- proxy -----------------------------------------------------------------
+
+
+def test_app_conns():
+    async def go():
+        app = KVStoreApplication()
+        conns = AppConns(local_client_creator(app))
+        await conns.start()
+        assert (await conns.query.info_sync(t.RequestInfo())).last_block_height == 0
+        d = await conns.consensus.deliver_tx_sync(t.RequestDeliverTx(b"a=b"))
+        assert d.is_ok()
+        ct = await conns.mempool.check_tx_sync(t.RequestCheckTx(b"zzz"))
+        assert ct.is_ok()
+        await conns.stop()
+
+    run(go())

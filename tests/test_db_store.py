@@ -1,0 +1,180 @@
+"""DB backends + BlockStore round-trip/prune tests (mirrors tm-db tests
+and store/store_test.go)."""
+
+import pytest
+
+from tendermint_tpu.db import MemDB, SQLiteDB, new_db
+from tendermint_tpu.db.base import prefix_end
+from tendermint_tpu.store import BlockStore
+from tendermint_tpu.types.block import (
+    BLOCK_ID_FLAG_COMMIT,
+    BlockID,
+    Commit,
+    CommitSig,
+    make_block,
+)
+from tendermint_tpu.types.tx import Txs
+
+
+@pytest.fixture(params=["memdb", "sqlite"])
+def db(request, tmp_path):
+    if request.param == "memdb":
+        yield MemDB()
+    else:
+        d = SQLiteDB("test", str(tmp_path))
+        yield d
+        d.close()
+
+
+class TestDB:
+    def test_get_set_delete(self, db):
+        assert db.get(b"a") is None
+        db.set(b"a", b"1")
+        assert db.get(b"a") == b"1"
+        assert db.has(b"a")
+        db.set(b"a", b"2")
+        assert db.get(b"a") == b"2"
+        db.delete(b"a")
+        assert db.get(b"a") is None
+        assert not db.has(b"a")
+
+    def test_empty_key_rejected(self, db):
+        with pytest.raises(ValueError):
+            db.set(b"", b"x")
+        with pytest.raises(ValueError):
+            db.get(b"")
+
+    def test_iterator_ordering(self, db):
+        keys = [b"a", b"ab", b"b", b"\x00x", b"\xff", b"m"]
+        for k in keys:
+            db.set(k, k + b"!")
+        got = [k for k, _ in db.iterator()]
+        assert got == sorted(keys)
+        rev = [k for k, _ in db.reverse_iterator()]
+        assert rev == sorted(keys, reverse=True)
+
+    def test_iterator_range(self, db):
+        for i in range(10):
+            db.set(bytes([i + 1]), b"v")
+        got = [k for k, _ in db.iterator(bytes([3]), bytes([7]))]
+        assert got == [bytes([i]) for i in range(3, 7)]
+
+    def test_prefix_iterator(self, db):
+        db.set(b"k:1", b"a")
+        db.set(b"k:2", b"b")
+        db.set(b"l:1", b"c")
+        assert [k for k, _ in db.prefix_iterator(b"k:")] == [b"k:1", b"k:2"]
+
+    def test_batch_atomic(self, db):
+        b = db.new_batch()
+        b.set(b"x", b"1").set(b"y", b"2").delete(b"x")
+        assert db.get(b"x") is None and db.get(b"y") is None
+        b.write_sync()
+        assert db.get(b"x") is None
+        assert db.get(b"y") == b"2"
+
+
+def test_prefix_end():
+    assert prefix_end(b"a") == b"b"
+    assert prefix_end(b"a\xff") == b"b"
+    assert prefix_end(b"\xff\xff") is None
+    assert prefix_end(b"") is None
+
+
+def test_sqlite_persistence(tmp_path):
+    d = SQLiteDB("p", str(tmp_path))
+    d.set(b"k", b"v")
+    d.close()
+    d2 = new_db("p", "sqlite", str(tmp_path))
+    assert d2.get(b"k") == b"v"
+    d2.close()
+
+
+# -- block store -----------------------------------------------------------
+
+
+def _make_chain_block(height, last_commit):
+    b = make_block(height, Txs([b"tx%d" % height]), last_commit, [])
+    # complete the header so Header.hash() is defined (store saves need it)
+    b.header.chain_id = "test-chain"
+    b.header.validators_hash = b"\x0a" * 32
+    b.header.next_validators_hash = b"\x0a" * 32
+    b.header.proposer_address = b"\x01" * 20
+    return b
+
+
+def _commit_for(block, round_=0):
+    bid = BlockID(block.hash(), block.make_part_set().header())
+    sig = CommitSig(
+        block_id_flag=BLOCK_ID_FLAG_COMMIT,
+        validator_address=b"\x01" * 20,
+        timestamp_ns=42,
+        signature=b"\x02" * 64,
+    )
+    return Commit(block.header.height, round_, bid, [sig])
+
+
+class TestBlockStore:
+    def test_save_load_roundtrip(self):
+        bs = BlockStore(MemDB())
+        assert bs.height == 0 and bs.base == 0
+
+        b1 = _make_chain_block(1, None)
+        c1 = _commit_for(b1)
+        bs.save_block(b1, b1.make_part_set(), c1)
+        assert bs.height == 1 and bs.base == 1
+
+        loaded = bs.load_block(1)
+        assert loaded.hash() == b1.hash()
+        assert loaded.data.txs == b1.data.txs
+
+        meta = bs.load_block_meta(1)
+        assert meta.block_id.hash == b1.hash()
+        assert meta.num_txs == 1
+
+        seen = bs.load_seen_commit(1)
+        assert seen.block_id.hash == b1.hash()
+        assert seen.signatures[0].timestamp_ns == 42
+
+        b2 = _make_chain_block(2, c1)
+        bs.save_block(b2, b2.make_part_set(), _commit_for(b2))
+        # canonical commit for h=1 comes from b2.LastCommit
+        assert bs.load_block_commit(1).block_id.hash == b1.hash()
+        assert bs.load_block_by_hash(b2.hash()).header.height == 2
+
+    def test_non_contiguous_rejected(self):
+        bs = BlockStore(MemDB())
+        b1 = _make_chain_block(1, None)
+        bs.save_block(b1, b1.make_part_set(), _commit_for(b1))
+        b3 = _make_chain_block(3, _commit_for(b1))
+        with pytest.raises(ValueError, match="contiguous"):
+            bs.save_block(b3, b3.make_part_set(), _commit_for(b3))
+
+    def test_reload_from_db(self, tmp_path):
+        db = SQLiteDB("bs", str(tmp_path))
+        bs = BlockStore(db)
+        b1 = _make_chain_block(1, None)
+        bs.save_block(b1, b1.make_part_set(), _commit_for(b1))
+        bs2 = BlockStore(db)
+        assert bs2.height == 1
+        assert bs2.load_block(1).hash() == b1.hash()
+        db.close()
+
+    def test_prune(self):
+        bs = BlockStore(MemDB())
+        last_commit = None
+        blocks = []
+        for h in range(1, 11):
+            b = _make_chain_block(h, last_commit)
+            bs.save_block(b, b.make_part_set(), _commit_for(b))
+            last_commit = _commit_for(b)
+            blocks.append(b)
+        assert bs.size() == 10
+        pruned = bs.prune_blocks(6)
+        assert pruned == 5
+        assert bs.base == 6 and bs.height == 10
+        assert bs.load_block(5) is None
+        assert bs.load_block_commit(5) is None  # no orphan commit records
+        assert bs.load_block(6) is not None
+        with pytest.raises(ValueError):
+            bs.prune_blocks(11)
