@@ -13,8 +13,7 @@ from collections import deque
 from tendermint_tpu.abci import codec
 from tendermint_tpu.abci import types as t
 from tendermint_tpu.abci.client.base import ABCIClient, ABCIClientError, ReqRes
-
-MAX_FRAME = 64 << 20
+from tendermint_tpu.abci.codec import MAX_FRAME, parse_addr
 
 
 def _matches(req, res) -> bool:
@@ -55,15 +54,11 @@ class SocketClient(ABCIClient):
         self._err: Exception = None
 
     async def on_start(self) -> None:
-        if self._addr.startswith("unix://"):
-            self._reader, self._writer = await asyncio.open_unix_connection(
-                self._addr[len("unix://") :]
-            )
-        elif self._addr.startswith("tcp://"):
-            host, port = self._addr[len("tcp://") :].rsplit(":", 1)
-            self._reader, self._writer = await asyncio.open_connection(host, int(port))
+        kind, target = parse_addr(self._addr)
+        if kind == "unix":
+            self._reader, self._writer = await asyncio.open_unix_connection(target)
         else:
-            raise ABCIClientError(f"unsupported abci address {self._addr!r}")
+            self._reader, self._writer = await asyncio.open_connection(*target)
         self.spawn(self._recv_routine(), name="abci-recv")
 
     async def on_stop(self) -> None:
@@ -80,10 +75,14 @@ class SocketClient(ABCIClient):
     def send_async(self, req) -> ReqRes:
         if self._err is not None:
             raise self._err
-        frame = codec.encode_msg(req)  # encode BEFORE enqueue: a bad message
-        rr = ReqRes(req)               # must not desync FIFO matching
-        self._sent.append(rr)
+        if self._writer is None:
+            raise ABCIClientError("client not started")
+        # encode + write BEFORE enqueue: a failure here must not leave an
+        # orphan entry that desyncs FIFO response matching
+        frame = codec.encode_msg(req)
         self._writer.write(frame)
+        rr = ReqRes(req)
+        self._sent.append(rr)
         if isinstance(req, (t.RequestFlush, t.RequestCommit)):
             # eager flush on barriers; otherwise rely on transport buffering
             asyncio.ensure_future(self._drain())

@@ -214,13 +214,22 @@ _register(
 )
 
 
+# shared frame cap, enforced symmetrically on encode and decode so a
+# locally-legal message can never be rejected as oversized by the peer
+MAX_FRAME = 64 << 20
+
+
 def encode_msg(msg) -> bytes:
     """One framed message: uvarint(len) || tag || payload."""
+    if type(msg) not in _TAG_BY_CLS:
+        raise ValueError(f"not an abci message: {type(msg).__name__}")
     tag = _TAG_BY_CLS[type(msg)]
     w = Writer()
     _, enc, _ = _REGISTRY[tag]
     enc(w, msg)
     payload = w.bytes()
+    if 1 + len(payload) > MAX_FRAME:
+        raise ValueError(f"abci message too large: {len(payload)} bytes")
     return Writer().write_uvarint(1 + len(payload)).write_u8(tag).write_raw(payload).bytes()
 
 
@@ -231,4 +240,16 @@ def decode_msg(frame: bytes):
     if tag not in _REGISTRY:
         raise ValueError(f"unknown abci message tag 0x{tag:02x}")
     _, _, dec = _REGISTRY[tag]
-    return dec(r)
+    msg = dec(r)
+    r.expect_done()  # trailing bytes = framing corruption or schema drift
+    return msg
+
+
+def parse_addr(addr: str):
+    """"tcp://host:port" → ("tcp", (host, port)); "unix:///p" → ("unix", path)."""
+    if addr.startswith("unix://"):
+        return "unix", addr[len("unix://") :]
+    if addr.startswith("tcp://"):
+        host, port = addr[len("tcp://") :].rsplit(":", 1)
+        return "tcp", (host, int(port))
+    raise ValueError(f"unsupported abci address {addr!r}")

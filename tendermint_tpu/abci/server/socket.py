@@ -37,15 +37,11 @@ class SocketServer(Service):
         return f"unix://{name}"
 
     async def on_start(self) -> None:
-        if self._addr.startswith("unix://"):
-            self._server = await asyncio.start_unix_server(
-                self._handle_conn, self._addr[len("unix://") :]
-            )
-        elif self._addr.startswith("tcp://"):
-            host, port = self._addr[len("tcp://") :].rsplit(":", 1)
-            self._server = await asyncio.start_server(self._handle_conn, host, int(port))
+        kind, target = codec.parse_addr(self._addr)
+        if kind == "unix":
+            self._server = await asyncio.start_unix_server(self._handle_conn, target)
         else:
-            raise ValueError(f"unsupported abci address {self._addr!r}")
+            self._server = await asyncio.start_server(self._handle_conn, *target)
 
     async def on_stop(self) -> None:
         if self._server is not None:

@@ -1,0 +1,13 @@
+from tendermint_tpu.state.state import State, state_from_genesis_doc
+from tendermint_tpu.state.store import ABCIResponses, StateStore
+from tendermint_tpu.state.execution import BlockExecutor
+from tendermint_tpu.state.validation import validate_block
+
+__all__ = [
+    "State",
+    "state_from_genesis_doc",
+    "StateStore",
+    "ABCIResponses",
+    "BlockExecutor",
+    "validate_block",
+]

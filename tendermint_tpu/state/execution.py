@@ -1,0 +1,368 @@
+"""BlockExecutor: the ONLY entry point for executing a committed block
+(reference state/execution.go:126 ApplyBlock).
+
+Pipeline (call stack SURVEY.md §3.2 commit path):
+  validate (TPU-batched LastCommit verify) → exec on ABCI consensus conn
+  (BeginBlock, DeliverTx×N pipelined, EndBlock) → save ABCIResponses →
+  update validators/params → Commit (mempool locked; app CommitSync;
+  mempool update+recheck) → save state → fire events.
+
+Fail-points (`utils.fail.fail()`) sit at the same places as the
+reference's (state/execution.go:142,147,178,184) for the crash matrix.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import List, Optional, Tuple
+
+from tendermint_tpu.abci import types as abci
+from tendermint_tpu.abci.client.base import ABCIClient
+from tendermint_tpu.state.state import State
+from tendermint_tpu.state.store import ABCIResponses, StateStore
+from tendermint_tpu.state.validation import validate_block
+from tendermint_tpu.types.block import Block, BlockID
+from tendermint_tpu.types.tx import Txs
+from tendermint_tpu.types.validator import Validator
+from tendermint_tpu.types.validator_set import ValidatorSet
+from tendermint_tpu.utils import fail
+from tendermint_tpu.utils.log import get_logger
+
+
+class BlockExecutionError(Exception):
+    pass
+
+
+class BlockExecutor:
+    def __init__(
+        self,
+        state_store: StateStore,
+        app_conn: ABCIClient,
+        mempool=None,
+        evidence_pool=None,
+        event_bus=None,
+        verifier=None,
+        metrics=None,
+        logger=None,
+    ):
+        self._store = state_store
+        self._app = app_conn
+        self._mempool = mempool
+        self._evpool = evidence_pool
+        self._event_bus = event_bus
+        self._verifier = verifier
+        self._metrics = metrics
+        self.logger = logger or get_logger("state")
+
+    # -- proposal construction (reference CreateProposalBlock
+    # state/execution.go:87) --------------------------------------------
+
+    def create_proposal_block(
+        self, height: int, state: State, commit, proposer_address: bytes
+    ) -> Tuple[Block, "object"]:
+        max_bytes = state.consensus_params.block.max_bytes
+        max_gas = state.consensus_params.block.max_gas
+        evidence = self._evpool.pending_evidence(max_bytes // 10) if self._evpool else []
+        txs = (
+            self._mempool.reap_max_bytes_max_gas(max_bytes, max_gas)
+            if self._mempool
+            else Txs()
+        )
+        block = state.make_block(height, txs, commit, evidence, proposer_address)
+        return block, block.make_part_set()
+
+    def validate_block(self, state: State, block: Block) -> None:
+        validate_block(state, block, verifier=self._verifier)
+
+    # -- apply (reference ApplyBlock state/execution.go:126) ---------------
+
+    async def apply_block(
+        self, state: State, block_id: BlockID, block: Block
+    ) -> Tuple[State, int]:
+        """Validate, execute and commit `block` against `state`. Returns
+        (new_state, retain_height). Raises on invalid blocks or app crash."""
+        t0 = time.perf_counter()
+        self.validate_block(state, block)
+
+        abci_responses = await exec_block_on_proxy_app(
+            self.logger, self._app, block, self._store, state.initial_height()
+        )
+
+        fail.fail()  # point: after exec, before saving responses
+        self._store.save_abci_responses(block.header.height, abci_responses)
+        fail.fail()  # point: responses saved, before state update
+
+        # validator updates from EndBlock
+        validator_updates = validator_updates_from_abci(
+            abci_responses.end_block.validator_updates
+        )
+        if validator_updates:
+            self.logger.info(
+                "updates to validators", updates=_short_updates(validator_updates)
+            )
+
+        new_state = update_state(
+            state, block_id, block.header, abci_responses, validator_updates
+        )
+
+        # lock mempool, commit app, update mempool (reference Commit :199)
+        app_hash, retain_height = await self._commit(new_state, block, abci_responses)
+
+        # evidence pool update
+        if self._evpool is not None:
+            self._evpool.update(block, new_state)
+
+        fail.fail()  # point: before SaveState
+        new_state.app_hash = app_hash
+        self._store.save(new_state)
+        fail.fail()  # point: state saved
+
+        if self._metrics is not None:
+            self._metrics.block_processing_time.observe(time.perf_counter() - t0)
+
+        await self._fire_events(block, block_id, abci_responses, validator_updates)
+        return new_state, retain_height
+
+    async def _commit(
+        self, state: State, block: Block, abci_responses: ABCIResponses
+    ) -> Tuple[bytes, int]:
+        """Reference Commit state/execution.go:199: mempool.Lock →
+        FlushAppConn → app CommitSync → mempool.Update → Unlock."""
+        if self._mempool is not None:
+            await self._mempool.lock()
+        try:
+            if self._mempool is not None:
+                await self._mempool.flush_app_conn()
+            res = await self._app.commit_sync()
+            self.logger.info(
+                "committed state",
+                height=block.header.height,
+                txs=len(block.data.txs),
+                app_hash=res.data.hex(),
+            )
+            if self._mempool is not None:
+                await self._mempool.update(
+                    block.header.height,
+                    block.data.txs,
+                    abci_responses.deliver_txs,
+                    pre_check=None,
+                    post_check=None,
+                )
+            return res.data, res.retain_height
+        finally:
+            if self._mempool is not None:
+                self._mempool.unlock()
+
+    async def _fire_events(
+        self, block: Block, block_id: BlockID, abci_responses: ABCIResponses, validator_updates
+    ) -> None:
+        """Reference fireEvents state/execution.go:188 region."""
+        if self._event_bus is None:
+            return
+        from tendermint_tpu.types.event_data import (
+            EventDataNewBlock,
+            EventDataNewBlockHeader,
+            EventDataTx,
+            EventDataValidatorSetUpdates,
+        )
+
+        await self._event_bus.publish_event_new_block(
+            EventDataNewBlock(
+                block=block,
+                result_begin_block=abci_responses.begin_block,
+                result_end_block=abci_responses.end_block,
+            )
+        )
+        await self._event_bus.publish_event_new_block_header(
+            EventDataNewBlockHeader(
+                header=block.header,
+                num_txs=len(block.data.txs),
+                result_begin_block=abci_responses.begin_block,
+                result_end_block=abci_responses.end_block,
+            )
+        )
+        for i, tx in enumerate(block.data.txs):
+            await self._event_bus.publish_event_tx(
+                EventDataTx(
+                    height=block.header.height,
+                    index=i,
+                    tx=bytes(tx),
+                    result=abci_responses.deliver_txs[i],
+                )
+            )
+        if validator_updates:
+            await self._event_bus.publish_event_validator_set_updates(
+                EventDataValidatorSetUpdates(validator_updates=validator_updates)
+            )
+
+
+# -- pure helpers ----------------------------------------------------------
+
+
+async def exec_block_on_proxy_app(
+    logger, app_conn: ABCIClient, block: Block, store, initial_height: int
+) -> ABCIResponses:
+    """BeginBlock → pipelined DeliverTx×N → EndBlock (reference
+    execBlockOnProxyApp state/execution.go:250-307). DeliverTx requests are
+    submitted without awaiting -- the asyncio equivalent of the
+    reference's async pipeline on the socket client."""
+    commit_info, byz_vals = get_begin_block_validator_info(block, store, initial_height)
+
+    begin = await app_conn.begin_block_sync(
+        abci.RequestBeginBlock(
+            hash=block.hash() or b"",
+            header_bytes=block.header.encode(),
+            last_commit_info=commit_info,
+            byzantine_validators=byz_vals,
+        )
+    )
+
+    rrs = [
+        app_conn.deliver_tx_async(abci.RequestDeliverTx(bytes(tx)))
+        for tx in block.data.txs
+    ]
+
+    end = await app_conn.end_block_sync(abci.RequestEndBlock(block.header.height))
+
+    deliver_txs: List[abci.ResponseDeliverTx] = []
+    invalid = 0
+    for rr in rrs:
+        res = await rr.wait()
+        if not res.is_ok():
+            invalid += 1
+        deliver_txs.append(res)
+    if invalid:
+        logger.info("invalid txs", count=invalid)
+    logger.info(
+        "executed block",
+        height=block.header.height,
+        valid_txs=len(deliver_txs) - invalid,
+        invalid_txs=invalid,
+    )
+    return ABCIResponses(deliver_txs=deliver_txs, end_block=end, begin_block=begin)
+
+
+def get_begin_block_validator_info(
+    block: Block, store, initial_height: int
+) -> Tuple[abci.LastCommitInfo, List[abci.EvidenceInfo]]:
+    """Build LastCommitInfo + byzantine validators for BeginBlock
+    (reference getBeginBlockValidatorInfo state/execution.go:310)."""
+    votes: List[abci.VoteInfo] = []
+    if block.header.height > initial_height and store is not None:
+        last_vals = store.load_validators(block.header.height - 1)
+        if last_vals is not None and block.last_commit is not None:
+            for i, cs in enumerate(block.last_commit.signatures):
+                _, val = last_vals.get_by_index(i)
+                if val is None:
+                    continue
+                votes.append(
+                    abci.VoteInfo(
+                        validator=abci.Validator(val.pub_key.address(), val.voting_power),
+                        signed_last_block=not cs.absent_(),
+                    )
+                )
+    byz: List[abci.EvidenceInfo] = []
+    if store is not None:
+        for ev in block.evidence.evidence:
+            vals = store.load_validators(ev.height())
+            power = 0
+            total = 0
+            if vals is not None:
+                _, v = vals.get_by_address(ev.address())
+                power = v.voting_power if v else 0
+                total = vals.total_voting_power()
+            byz.append(
+                abci.EvidenceInfo(
+                    type="duplicate/vote",
+                    validator=abci.Validator(ev.address(), power),
+                    height=ev.height(),
+                    time_ns=ev.time_ns(),
+                    total_voting_power=total,
+                )
+            )
+    round_ = block.last_commit.round if block.last_commit else 0
+    return abci.LastCommitInfo(round=round_, votes=votes), byz
+
+
+def validator_updates_from_abci(updates: List[abci.ValidatorUpdate]) -> List[Validator]:
+    """abci.ValidatorUpdate → types.Validator (reference
+    types.PB2TM.ValidatorUpdates)."""
+    from tendermint_tpu.crypto.keys import decode_pubkey
+
+    out = []
+    for u in updates:
+        if u.power < 0:
+            raise BlockExecutionError(f"voting power can't be negative: {u.power}")
+        out.append(Validator(decode_pubkey(u.pub_key), u.power))
+    return out
+
+
+def update_state(
+    state: State,
+    block_id: BlockID,
+    header,
+    abci_responses: ABCIResponses,
+    validator_updates: List[Validator],
+) -> State:
+    """Pure state transition (reference updateState state/execution.go:351).
+
+    NextValidators moves up by one height with proposer priorities
+    incremented; EndBlock updates apply to the set that takes effect at
+    H+2 (last_height_validators_changed = H+1+1)."""
+    n_val_set = state.next_validators.copy()
+    last_height_vals_changed = state.last_height_validators_changed
+    if validator_updates:
+        n_val_set.update_with_change_set(validator_updates)
+        last_height_vals_changed = header.height + 1 + 1
+
+    n_val_set.increment_proposer_priority(1)
+
+    params = state.consensus_params
+    last_height_params_changed = state.last_height_consensus_params_changed
+    cpu = abci_responses.end_block.consensus_param_updates
+    if cpu is not None:
+        params = apply_param_updates(params, cpu)
+        err = params.validate()
+        if err:
+            raise BlockExecutionError(f"error updating consensus params: {err}")
+        last_height_params_changed = header.height + 1
+
+    return State(
+        chain_id=state.chain_id,
+        last_block_height=header.height,
+        last_block_id=block_id,
+        last_block_time_ns=header.time_ns,
+        validators=state.next_validators.copy(),
+        next_validators=n_val_set,
+        last_validators=state.validators.copy(),
+        last_height_validators_changed=last_height_vals_changed,
+        consensus_params=params,
+        last_height_consensus_params_changed=last_height_params_changed,
+        last_results_hash=abci_responses.results_hash(),
+        app_hash=b"",  # set after Commit returns (reference does the same)
+        version_app=state.version_app,
+    )
+
+
+def apply_param_updates(params, cpu: abci.ConsensusParamsUpdate):
+    """ConsensusParams.update with an abci subset-update."""
+    from dataclasses import replace
+
+    block = params.block
+    evidence = params.evidence
+    validator = params.validator
+    if cpu.max_block_bytes is not None:
+        block = replace(block, max_bytes=cpu.max_block_bytes)
+    if cpu.max_block_gas is not None:
+        block = replace(block, max_gas=cpu.max_block_gas)
+    if cpu.max_evidence_age_ns is not None:
+        evidence = replace(evidence, max_age_duration_ns=cpu.max_evidence_age_ns)
+    if cpu.max_evidence_age_blocks is not None:
+        evidence = replace(evidence, max_age_num_blocks=cpu.max_evidence_age_blocks)
+    if cpu.pub_key_types is not None:
+        validator = replace(validator, pub_key_types=list(cpu.pub_key_types))
+    return replace(params, block=block, evidence=evidence, validator=validator)
+
+
+def _short_updates(updates: List[Validator]) -> str:
+    return ",".join(f"{v.address.hex()[:12]}:{v.voting_power}" for v in updates)

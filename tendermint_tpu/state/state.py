@@ -1,0 +1,212 @@
+"""Consensus state snapshot `State` (reference state/state.go:84 region).
+
+Immutable-by-convention: every ApplyBlock produces a NEW State via
+`update_state` (state/execution.go). Holds the validator-set window
+(last/current/next) and the app linkage hashes.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field, replace
+from typing import List, Optional
+
+from tendermint_tpu.codec.binary import Reader, Writer
+from tendermint_tpu.types.block import Block, BlockID, Commit, Data, EvidenceData, Header
+from tendermint_tpu.types.genesis import GenesisDoc
+from tendermint_tpu.types.params import ConsensusParams
+from tendermint_tpu.types.tx import Txs
+from tendermint_tpu.types.validator import Validator
+from tendermint_tpu.types.validator_set import ValidatorSet
+from tendermint_tpu.version import BLOCK_PROTOCOL
+
+# the height validator/params changes take effect relative to the block
+# that caused them (reference state/execution.go updateState: h+1+1)
+INIT_STATE_VERSION = 1
+
+
+@dataclass
+class State:
+    chain_id: str = ""
+    last_block_height: int = 0
+    last_block_id: BlockID = field(default_factory=BlockID)
+    last_block_time_ns: int = 0
+
+    # validator window (reference comments state/state.go:84):
+    # validators      -- used to validate block H
+    # next_validators -- will be used to validate block H+1
+    # last_validators -- validated block H-1 (used for LastCommitInfo)
+    validators: ValidatorSet = None
+    next_validators: ValidatorSet = None
+    last_validators: Optional[ValidatorSet] = None
+    last_height_validators_changed: int = 0
+
+    consensus_params: ConsensusParams = field(default_factory=ConsensusParams)
+    last_height_consensus_params_changed: int = 0
+
+    last_results_hash: bytes = b""
+    app_hash: bytes = b""
+
+    version_app: int = 0
+
+    def copy(self) -> "State":
+        return replace(
+            self,
+            last_block_id=replace(self.last_block_id),
+            validators=self.validators.copy() if self.validators else None,
+            next_validators=self.next_validators.copy() if self.next_validators else None,
+            last_validators=self.last_validators.copy() if self.last_validators else None,
+        )
+
+    def is_empty(self) -> bool:
+        return self.validators is None
+
+    def equals(self, other: "State") -> bool:
+        return self.encode() == other.encode()
+
+    # -- block construction (reference state.MakeBlock state/state.go:114) --
+
+    def make_block(
+        self,
+        height: int,
+        txs: Txs,
+        commit: Optional[Commit],
+        evidence: list,
+        proposer_address: bytes,
+        time_ns: Optional[int] = None,
+    ) -> Block:
+        if time_ns is None:
+            if height == self.initial_height():
+                time_ns = self.last_block_time_ns  # genesis time
+            else:
+                time_ns = median_time(commit, self.last_validators)
+        header = Header(
+            chain_id=self.chain_id,
+            height=height,
+            time_ns=time_ns,
+            last_block_id=self.last_block_id,
+            validators_hash=self.validators.hash(),
+            next_validators_hash=self.next_validators.hash(),
+            consensus_hash=self.consensus_params.hash(),
+            app_hash=self.app_hash,
+            last_results_hash=self.last_results_hash,
+            proposer_address=proposer_address,
+            version_block=BLOCK_PROTOCOL,
+            version_app=self.version_app,
+        )
+        block = Block(
+            header=header,
+            data=Data(txs=txs),
+            evidence=EvidenceData(evidence=list(evidence)),
+            last_commit=commit,
+        )
+        block.fill_header()
+        return block
+
+    def initial_height(self) -> int:
+        """First block height of this chain (reference assumes 1)."""
+        return 1
+
+    # -- encoding ----------------------------------------------------------
+
+    def encode(self) -> bytes:
+        w = Writer()
+        w.write_str(self.chain_id)
+        w.write_u64(self.last_block_height)
+        w.write_bytes(self.last_block_id.encode())
+        w.write_i64(self.last_block_time_ns)
+        w.write_bytes(self.validators.encode())
+        w.write_bytes(self.next_validators.encode())
+        if self.last_validators is None or self.last_validators.is_nil_or_empty():
+            w.write_bool(False)
+        else:
+            w.write_bool(True).write_bytes(self.last_validators.encode())
+        w.write_u64(self.last_height_validators_changed)
+        w.write_bytes(self.consensus_params.encode())
+        w.write_u64(self.last_height_consensus_params_changed)
+        w.write_bytes(self.last_results_hash)
+        w.write_bytes(self.app_hash)
+        w.write_u64(self.version_app)
+        return w.bytes()
+
+    @classmethod
+    def decode(cls, data: bytes) -> "State":
+        r = Reader(data)
+        chain_id = r.read_str()
+        lbh = r.read_u64()
+        lbi = BlockID.decode(r.read_bytes())
+        lbt = r.read_i64()
+        vals = ValidatorSet.decode(r.read_bytes())
+        nvals = ValidatorSet.decode(r.read_bytes())
+        lvals = ValidatorSet.decode(r.read_bytes()) if r.read_bool() else None
+        lhvc = r.read_u64()
+        params = ConsensusParams.decode(r.read_bytes())
+        lhpc = r.read_u64()
+        lrh = r.read_bytes()
+        ah = r.read_bytes()
+        va = r.read_u64()
+        return cls(
+            chain_id=chain_id,
+            last_block_height=lbh,
+            last_block_id=lbi,
+            last_block_time_ns=lbt,
+            validators=vals,
+            next_validators=nvals,
+            last_validators=lvals,
+            last_height_validators_changed=lhvc,
+            consensus_params=params,
+            last_height_consensus_params_changed=lhpc,
+            last_results_hash=lrh,
+            app_hash=ah,
+            version_app=va,
+        )
+
+
+def median_time(commit: Commit, validators: Optional[ValidatorSet]) -> int:
+    """Voting-power-weighted median of commit timestamps (reference
+    types.MedianTime types/time/time.go:33) -- the BFT time rule: with
+    +2/3 honest power the result is within honest bounds."""
+    if commit is None or validators is None or not commit.signatures:
+        return time.time_ns()
+    weighted = []
+    for i, cs in enumerate(commit.signatures):
+        if cs.absent_():
+            continue
+        _, val = validators.get_by_address(cs.validator_address)
+        if val is None:
+            continue
+        weighted.append((cs.timestamp_ns, val.voting_power))
+    if not weighted:
+        return time.time_ns()
+    weighted.sort()
+    total = sum(p for _, p in weighted)
+    median = (total + 1) // 2
+    acc = 0
+    for ts, p in weighted:
+        acc += p
+        if acc >= median:
+            return ts
+    return weighted[-1][0]
+
+
+def state_from_genesis_doc(genesis: GenesisDoc) -> State:
+    """Build height-0 state (reference sm.MakeGenesisState state/state.go:240)."""
+    genesis.validate_and_complete()
+    validators = ValidatorSet(
+        [Validator(gv.pub_key, gv.power) for gv in genesis.validators]
+    )
+    next_validators = validators.copy_increment_proposer_priority(1)
+    return State(
+        chain_id=genesis.chain_id,
+        last_block_height=0,
+        last_block_id=BlockID(),
+        last_block_time_ns=genesis.genesis_time_ns,
+        validators=validators,
+        next_validators=next_validators,
+        last_validators=None,
+        last_height_validators_changed=1,
+        consensus_params=genesis.consensus_params or ConsensusParams(),
+        last_height_consensus_params_changed=1,
+        last_results_hash=b"",
+        app_hash=genesis.app_hash,
+    )

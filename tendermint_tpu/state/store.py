@@ -1,0 +1,227 @@
+"""State persistence (reference state/store.go).
+
+Keys:
+  state            latest sm.State            (:38 stateKey)
+  vals:<h>         ValidatorsInfo per height  (calcValidatorsKey :21)
+  params:<h>       ConsensusParamsInfo        (calcConsensusParamsKey :26)
+  abcir:<h>        ABCIResponses              (calcABCIResponsesKey :31)
+
+Validator/params records use the reference's checkpoint scheme: full set
+stored when changed (or every CHECKPOINT_INTERVAL heights), otherwise a
+pointer to the last-changed height (state/store.go:172 region).
+"""
+
+from __future__ import annotations
+
+import struct
+from typing import List, Optional
+
+from tendermint_tpu.abci import types as abci
+from tendermint_tpu.codec.binary import Reader, Writer
+from tendermint_tpu.db import DB
+from tendermint_tpu.state.state import State
+from tendermint_tpu.types.params import ConsensusParams
+from tendermint_tpu.types.validator_set import ValidatorSet
+
+_STATE_KEY = b"state"
+CHECKPOINT_INTERVAL = 100000  # reference valSetCheckpointInterval state/store.go:209
+
+
+def _vals_key(h: int) -> bytes:
+    return b"vals:" + struct.pack(">Q", h)
+
+
+def _params_key(h: int) -> bytes:
+    return b"params:" + struct.pack(">Q", h)
+
+
+def _abci_responses_key(h: int) -> bytes:
+    return b"abcir:" + struct.pack(">Q", h)
+
+
+class ABCIResponses:
+    """DeliverTx/EndBlock/BeginBlock responses for one block, persisted so
+    replay can skip re-execution divergence (state/store.go:245 region)."""
+
+    def __init__(
+        self,
+        deliver_txs: Optional[List[abci.ResponseDeliverTx]] = None,
+        end_block: Optional[abci.ResponseEndBlock] = None,
+        begin_block: Optional[abci.ResponseBeginBlock] = None,
+    ):
+        self.deliver_txs = deliver_txs or []
+        self.end_block = end_block or abci.ResponseEndBlock()
+        self.begin_block = begin_block or abci.ResponseBeginBlock()
+
+    def results_hash(self) -> bytes:
+        """Merkle root of deterministic DeliverTx results -- becomes the
+        NEXT header's LastResultsHash (reference ABCIResponses.ResultsHash)."""
+        from tendermint_tpu.crypto import merkle
+
+        return merkle.hash_from_byte_slices(
+            [dtx.result_hash_bytes() for dtx in self.deliver_txs]
+        )
+
+    def encode(self) -> bytes:
+        w = Writer()
+        w.write_uvarint(len(self.deliver_txs))
+        for dtx in self.deliver_txs:
+            w.write_bytes(dtx.encode())
+        w.write_bytes(self.end_block.encode())
+        from tendermint_tpu.abci.codec import encode_msg
+
+        w.write_bytes(encode_msg(self.begin_block))
+        return w.bytes()
+
+    @classmethod
+    def decode(cls, data: bytes) -> "ABCIResponses":
+        from tendermint_tpu.abci.codec import decode_msg
+
+        r = Reader(data)
+        dtxs = [abci.ResponseDeliverTx.decode(r.read_bytes()) for _ in range(r.read_uvarint())]
+        eb = abci.ResponseEndBlock.decode(r.read_bytes())
+        bb_framed = r.read_bytes()
+        rr = Reader(bb_framed)
+        n = rr.read_uvarint()
+        bb = decode_msg(rr.read_raw(n))
+        return cls(dtxs, eb, bb)
+
+    def __eq__(self, other) -> bool:
+        return (
+            isinstance(other, ABCIResponses)
+            and self.deliver_txs == other.deliver_txs
+            and self.end_block == other.end_block
+            and self.begin_block == other.begin_block
+        )
+
+
+class StateStore:
+    def __init__(self, db: DB):
+        self._db = db
+
+    # -- state -------------------------------------------------------------
+
+    def load(self) -> Optional[State]:
+        raw = self._db.get(_STATE_KEY)
+        return State.decode(raw) if raw is not None else None
+
+    def save(self, state: State) -> None:
+        """Persist state + validator/params lookup records (reference
+        SaveState state/store.go:97: saves next_validators at h+1+1,
+        params at h+1)."""
+        next_height = state.last_block_height + 1
+        if next_height == 1:
+            # genesis bootstrap: validators for heights 1 and 2
+            self._save_validators(1, 1, state.validators)
+        self._save_validators(
+            next_height + 1, state.last_height_validators_changed, state.next_validators
+        )
+        self._save_params(
+            next_height, state.last_height_consensus_params_changed, state.consensus_params
+        )
+        self._db.set_sync(_STATE_KEY, state.encode())
+
+    # -- validators --------------------------------------------------------
+
+    def _save_validators(self, height: int, last_changed: int, vals: ValidatorSet) -> None:
+        w = Writer()
+        w.write_u64(last_changed)
+        if height == last_changed or height % CHECKPOINT_INTERVAL == 0:
+            w.write_bool(True).write_bytes(vals.encode())
+        else:
+            w.write_bool(False)
+        self._db.set(_vals_key(height), w.bytes())
+
+    def load_validators(self, height: int) -> Optional[ValidatorSet]:
+        """Validator set that validated block `height` (reference
+        LoadValidators state/store.go:298 incl. pointer-chase +
+        proposer-priority recompute)."""
+        raw = self._db.get(_vals_key(height))
+        if raw is None:
+            return None
+        r = Reader(raw)
+        last_changed = r.read_u64()
+        if r.read_bool():
+            return ValidatorSet.decode(r.read_bytes())
+        # pointer: full set lives at the last-changed (or checkpoint) height
+        raw2 = self._db.get(_vals_key(last_changed))
+        if raw2 is None:
+            raise ValueError(
+                f"validators at height {height} point to missing height {last_changed}"
+            )
+        r2 = Reader(raw2)
+        r2.read_u64()
+        if not r2.read_bool():
+            raise ValueError(f"validators record at {last_changed} is not a full set")
+        vals = ValidatorSet.decode(r2.read_bytes())
+        vals.increment_proposer_priority(height - last_changed)
+        return vals
+
+    # -- consensus params --------------------------------------------------
+
+    def _save_params(self, height: int, last_changed: int, params: ConsensusParams) -> None:
+        w = Writer()
+        w.write_u64(last_changed)
+        if height == last_changed:
+            w.write_bool(True).write_bytes(params.encode())
+        else:
+            w.write_bool(False)
+        self._db.set(_params_key(height), w.bytes())
+
+    def load_consensus_params(self, height: int) -> Optional[ConsensusParams]:
+        raw = self._db.get(_params_key(height))
+        if raw is None:
+            return None
+        r = Reader(raw)
+        last_changed = r.read_u64()
+        if r.read_bool():
+            return ConsensusParams.decode(r.read_bytes())
+        raw2 = self._db.get(_params_key(last_changed))
+        if raw2 is None:
+            raise ValueError(
+                f"params at height {height} point to missing height {last_changed}"
+            )
+        r2 = Reader(raw2)
+        r2.read_u64()
+        if not r2.read_bool():
+            raise ValueError(f"params record at {last_changed} is empty")
+        return ConsensusParams.decode(r2.read_bytes())
+
+    # -- abci responses ----------------------------------------------------
+
+    def save_abci_responses(self, height: int, responses: ABCIResponses) -> None:
+        self._db.set(_abci_responses_key(height), responses.encode())
+
+    def load_abci_responses(self, height: int) -> Optional[ABCIResponses]:
+        raw = self._db.get(_abci_responses_key(height))
+        return ABCIResponses.decode(raw) if raw is not None else None
+
+    # -- pruning -----------------------------------------------------------
+
+    def _pointer_target(self, key_fn, height: int) -> Optional[int]:
+        """If the record at `height` is a pointer, the full-record height
+        it references; None if absent or already full."""
+        raw = self._db.get(key_fn(height))
+        if raw is None:
+            return None
+        r = Reader(raw)
+        last_changed = r.read_u64()
+        return None if r.read_bool() else last_changed
+
+    def prune_states(self, base: int, retain_height: int) -> None:
+        """Delete vals/params/abci records in [base, retain_height)
+        (reference PruneStates state/store.go:139). Records at/above
+        retain_height may point to a full record below it -- those keep
+        heights are preserved, exactly like the reference's keepVals map."""
+        if retain_height <= base:
+            return
+        keep_vals = {self._pointer_target(_vals_key, retain_height)}
+        keep_params = {self._pointer_target(_params_key, retain_height)}
+        batch = self._db.new_batch()
+        for h in range(base, retain_height):
+            if h not in keep_vals:
+                batch.delete(_vals_key(h))
+            if h not in keep_params:
+                batch.delete(_params_key(h))
+            batch.delete(_abci_responses_key(h))
+        batch.write_sync()

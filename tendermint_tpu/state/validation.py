@@ -1,0 +1,129 @@
+"""Block validation against state (reference state/validation.go:17).
+
+The LastCommit check at :92 is the north-star hot path -- it calls
+`ValidatorSet.verify_commit`, which runs one batched device verification
+instead of the reference's serial per-signature loop
+(types/validator_set.go:641-668).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Optional
+
+from tendermint_tpu.state.state import State, median_time
+from tendermint_tpu.types.block import Block
+
+MAX_EVIDENCE_PER_BLOCK_DIVISOR = 1  # see max_evidence_per_block below
+
+
+class ValidationError(Exception):
+    pass
+
+
+def max_evidence_per_block(max_bytes: int):
+    """Reference types.MaxEvidencePerBlock: evidence capped to 1/10 of
+    max block bytes."""
+    max_ev_bytes = max_bytes // 10
+    from tendermint_tpu.types.evidence import MAX_EVIDENCE_BYTES
+
+    return max_ev_bytes // MAX_EVIDENCE_BYTES, max_ev_bytes
+
+
+def validate_block(state: State, block: Block, verifier=None) -> None:
+    """Reference validateBlock state/validation.go:17. Raises
+    ValidationError / commit-verification errors."""
+    err = block.validate_basic()
+    if err:
+        raise ValidationError(f"invalid block: {err}")
+
+    h = block.header
+    if h.version_block != state_block_version():
+        raise ValidationError(
+            f"wrong Block.Header.Version: expected {state_block_version()}, got {h.version_block}"
+        )
+    if h.version_app != state.version_app:
+        raise ValidationError(
+            f"wrong Block.Header.Version.App: expected {state.version_app}, got {h.version_app}"
+        )
+    if h.chain_id != state.chain_id:
+        raise ValidationError(
+            f"wrong Block.Header.ChainID: expected {state.chain_id}, got {h.chain_id}"
+        )
+    if h.height != state.last_block_height + 1:
+        raise ValidationError(
+            f"wrong Block.Header.Height: expected {state.last_block_height + 1}, got {h.height}"
+        )
+    if h.last_block_id != state.last_block_id:
+        raise ValidationError(
+            f"wrong Block.Header.LastBlockID: expected {state.last_block_id}, got {h.last_block_id}"
+        )
+
+    # app linkage
+    if h.app_hash != state.app_hash:
+        raise ValidationError(
+            f"wrong Block.Header.AppHash: expected {state.app_hash.hex()}, got {h.app_hash.hex()}"
+        )
+    if h.consensus_hash != state.consensus_params.hash():
+        raise ValidationError("wrong Block.Header.ConsensusHash")
+    if h.last_results_hash != state.last_results_hash:
+        raise ValidationError("wrong Block.Header.LastResultsHash")
+    if h.validators_hash != state.validators.hash():
+        raise ValidationError("wrong Block.Header.ValidatorsHash")
+    if h.next_validators_hash != state.next_validators.hash():
+        raise ValidationError("wrong Block.Header.NextValidatorsHash")
+
+    # LastCommit
+    if block.header.height == state.initial_height():
+        if block.last_commit is not None and len(block.last_commit.signatures) != 0:
+            raise ValidationError("initial block can't have LastCommit signatures")
+    else:
+        if block.last_commit is None:
+            raise ValidationError("nil LastCommit")
+        if len(block.last_commit.signatures) != state.last_validators.size():
+            raise ValidationError(
+                f"invalid block commit size: expected {state.last_validators.size()}, "
+                f"got {len(block.last_commit.signatures)}"
+            )
+        # ★ batched device verification (state/validation.go:92)
+        state.last_validators.verify_commit(
+            state.chain_id,
+            state.last_block_id,
+            block.header.height - 1,
+            block.last_commit,
+            provider=verifier,
+        )
+
+    # proposer must be in the current validator set (state/validation.go:141)
+    if not state.validators.has_address(h.proposer_address):
+        raise ValidationError(
+            f"block proposer {h.proposer_address.hex()} is not in the validator set"
+        )
+
+    # block time monotonicity (state/validation.go:118 region)
+    if block.header.height > state.initial_height():
+        if h.time_ns <= state.last_block_time_ns:
+            raise ValidationError(
+                f"block time {h.time_ns} not greater than last block time "
+                f"{state.last_block_time_ns}"
+            )
+        med = median_time(block.last_commit, state.last_validators)
+        if h.time_ns != med:
+            raise ValidationError(
+                f"invalid block time: {h.time_ns}, expected weighted median {med}"
+            )
+    elif block.header.height == state.initial_height():
+        if h.time_ns != state.last_block_time_ns:
+            raise ValidationError("block time for initial block must be genesis time")
+
+
+def state_block_version() -> int:
+    from tendermint_tpu.version import BLOCK_PROTOCOL
+
+    return BLOCK_PROTOCOL
+
+
+def validate_time_drift(time_ns: int, max_drift_ns: int = 10 * 1_000_000_000) -> Optional[str]:
+    if time_ns > time.time_ns() + max_drift_ns:
+        return "block from the future"
+    return None

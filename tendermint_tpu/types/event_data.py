@@ -1,0 +1,77 @@
+"""Event payload types published on the EventBus (reference types/events.go)."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, List, Optional
+
+
+@dataclass
+class EventDataNewBlock:
+    block: Any = None
+    result_begin_block: Any = None  # abci.ResponseBeginBlock
+    result_end_block: Any = None  # abci.ResponseEndBlock
+
+
+@dataclass
+class EventDataNewBlockHeader:
+    header: Any = None
+    num_txs: int = 0
+    result_begin_block: Any = None
+    result_end_block: Any = None
+
+
+@dataclass
+class EventDataTx:
+    height: int = 0
+    index: int = 0
+    tx: bytes = b""
+    result: Any = None  # abci.ResponseDeliverTx
+
+
+@dataclass
+class EventDataNewRound:
+    height: int = 0
+    round: int = 0
+    step: str = ""
+    proposer_address: bytes = b""
+
+
+@dataclass
+class EventDataRoundState:
+    height: int = 0
+    round: int = 0
+    step: str = ""
+    round_state: Any = None  # live *RoundState pointer equivalent
+
+
+@dataclass
+class EventDataCompleteProposal:
+    height: int = 0
+    round: int = 0
+    step: str = ""
+    block_id: Any = None
+
+
+@dataclass
+class EventDataVote:
+    vote: Any = None
+
+
+@dataclass
+class EventDataValidatorSetUpdates:
+    validator_updates: List[Any] = field(default_factory=list)
+
+
+@dataclass
+class EventDataString:
+    value: str = ""
+
+
+@dataclass
+class EventDataBlockSyncStatus:
+    complete: bool = False
+    height: int = 0
+
+
+EventData = Optional[Any]

@@ -78,12 +78,21 @@ def new_logger(
     return logger
 
 
+_STD_LOG_KWARGS = {"exc_info", "stack_info", "stacklevel", "extra"}
+
+
 class KVLoggerAdapter(logging.LoggerAdapter):
-    """`With(...)`-style bound key-values (reference tm_logger.With)."""
+    """`With(...)`-style bound key-values (reference tm_logger.With).
+
+    Also accepts free-form keyword pairs at call sites --
+    ``log.info("executed block", height=5)`` -- the go-kit calling
+    convention."""
 
     def process(self, msg, kwargs):
-        extra = kwargs.setdefault("extra", {})
         kv = dict(self.extra or {})
+        for k in [k for k in kwargs if k not in _STD_LOG_KWARGS]:
+            kv[k] = kwargs.pop(k)
+        extra = kwargs.setdefault("extra", {})
         kv.update(extra.get("kv", {}))
         extra["kv"] = kv
         return msg, kwargs
@@ -92,3 +101,8 @@ class KVLoggerAdapter(logging.LoggerAdapter):
         merged = dict(self.extra or {})
         merged.update(kv)
         return KVLoggerAdapter(self.logger, merged)
+
+
+def get_logger(module: str, **bound) -> KVLoggerAdapter:
+    """Logger that accepts key-value kwargs on every call."""
+    return KVLoggerAdapter(new_logger(module), bound)

