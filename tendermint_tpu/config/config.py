@@ -1,0 +1,458 @@
+"""Configuration tree for a node.
+
+Reference: config/config.go — master `Config` of 8 sections (:60-72) with
+Default*/Test* constructors and ValidateBasic; consensus timeouts at
+:749-800; p2p knobs :480; mempool :626 region; TOML rendering
+config/toml.go:55. Here the on-disk format is TOML written/parsed with
+the stdlib (tomllib for reads, a small renderer for writes) — no viper.
+
+Timeouts are stored in milliseconds (ints) like the reference's
+time.Duration fields; helpers return float seconds for asyncio.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field, fields, is_dataclass, replace
+from typing import List, Optional
+
+# -- directory layout (reference config/config.go:25-40) -------------------
+
+DEFAULT_CONFIG_DIR = "config"
+DEFAULT_DATA_DIR = "data"
+DEFAULT_CONFIG_FILE = "config.toml"
+DEFAULT_GENESIS_FILE = "genesis.json"
+DEFAULT_PRIVVAL_KEY_FILE = "priv_validator_key.json"
+DEFAULT_PRIVVAL_STATE_FILE = "priv_validator_state.json"
+DEFAULT_NODE_KEY_FILE = "node_key.json"
+DEFAULT_ADDR_BOOK_FILE = "addrbook.json"
+
+
+@dataclass
+class BaseConfig:
+    """Top-level options (reference BaseConfig config/config.go:137)."""
+
+    root_dir: str = ""
+    chain_id: str = ""  # filled from genesis at load
+    moniker: str = "anonymous"
+    fast_sync: bool = True
+    db_backend: str = "sqlite"  # sqlite | memdb
+    db_dir: str = DEFAULT_DATA_DIR
+    log_level: str = "main:info,state:info,*:error"
+    log_format: str = "plain"
+    genesis_file_name: str = os.path.join(DEFAULT_CONFIG_DIR, DEFAULT_GENESIS_FILE)
+    priv_validator_key_name: str = os.path.join(DEFAULT_CONFIG_DIR, DEFAULT_PRIVVAL_KEY_FILE)
+    priv_validator_state_name: str = os.path.join(DEFAULT_DATA_DIR, DEFAULT_PRIVVAL_STATE_FILE)
+    priv_validator_laddr: str = ""  # remote signer listen addr
+    node_key_name: str = os.path.join(DEFAULT_CONFIG_DIR, DEFAULT_NODE_KEY_FILE)
+    abci: str = "local"  # local | socket
+    proxy_app: str = "kvstore"  # app id for local, or tcp://... for socket
+    prof_laddr: str = ""
+    filter_peers: bool = False
+    # TPU crypto provider selection (the plugin seam BASELINE.json names)
+    crypto_provider: str = "tpu"  # tpu | cpu
+
+    def genesis_file(self) -> str:
+        return _rootify(self.genesis_file_name, self.root_dir)
+
+    def priv_validator_key_file(self) -> str:
+        return _rootify(self.priv_validator_key_name, self.root_dir)
+
+    def priv_validator_state_file(self) -> str:
+        return _rootify(self.priv_validator_state_name, self.root_dir)
+
+    def node_key_file(self) -> str:
+        return _rootify(self.node_key_name, self.root_dir)
+
+    def db_path(self) -> str:
+        return _rootify(self.db_dir, self.root_dir)
+
+    def validate_basic(self) -> Optional[str]:
+        if self.db_backend not in ("sqlite", "memdb"):
+            return f"unknown db_backend {self.db_backend!r}"
+        if self.abci not in ("local", "socket"):
+            return f"unknown abci transport {self.abci!r}"
+        return None
+
+
+@dataclass
+class RPCConfig:
+    """Reference RPCConfig config/config.go:326."""
+
+    root_dir: str = ""
+    laddr: str = "tcp://127.0.0.1:26657"
+    cors_allowed_origins: List[str] = field(default_factory=list)
+    cors_allowed_methods: List[str] = field(default_factory=lambda: ["HEAD", "GET", "POST"])
+    cors_allowed_headers: List[str] = field(
+        default_factory=lambda: ["Origin", "Accept", "Content-Type", "X-Requested-With", "X-Server-Time"]
+    )
+    grpc_laddr: str = ""
+    grpc_max_open_connections: int = 900
+    unsafe: bool = False
+    max_open_connections: int = 900
+    max_subscription_clients: int = 100
+    max_subscriptions_per_client: int = 5
+    timeout_broadcast_tx_commit_ms: int = 10_000
+    max_body_bytes: int = 1_000_000
+    max_header_bytes: int = 1 << 20
+
+    def validate_basic(self) -> Optional[str]:
+        if self.grpc_max_open_connections < 0:
+            return "grpc_max_open_connections can't be negative"
+        if self.max_open_connections < 0:
+            return "max_open_connections can't be negative"
+        if self.max_subscription_clients < 0:
+            return "max_subscription_clients can't be negative"
+        if self.max_subscriptions_per_client < 0:
+            return "max_subscriptions_per_client can't be negative"
+        if self.timeout_broadcast_tx_commit_ms < 0:
+            return "timeout_broadcast_tx_commit can't be negative"
+        if self.max_body_bytes < 0:
+            return "max_body_bytes can't be negative"
+        return None
+
+
+@dataclass
+class P2PConfig:
+    """Reference P2PConfig config/config.go:480."""
+
+    root_dir: str = ""
+    laddr: str = "tcp://0.0.0.0:26656"
+    external_address: str = ""
+    seeds: str = ""  # comma-separated
+    persistent_peers: str = ""
+    upnp: bool = False
+    addr_book_file: str = os.path.join(DEFAULT_CONFIG_DIR, DEFAULT_ADDR_BOOK_FILE)
+    addr_book_strict: bool = True
+    max_num_inbound_peers: int = 40
+    max_num_outbound_peers: int = 10
+    unconditional_peer_ids: str = ""
+    persistent_peers_max_dial_period_ms: int = 0
+    flush_throttle_timeout_ms: int = 100
+    max_packet_msg_payload_size: int = 1024
+    send_rate: int = 5_120_000  # bytes/s
+    recv_rate: int = 5_120_000
+    pex: bool = True
+    seed_mode: bool = False
+    private_peer_ids: str = ""
+    allow_duplicate_ip: bool = False
+    handshake_timeout_ms: int = 20_000
+    dial_timeout_ms: int = 3_000
+    test_fuzz: bool = False
+    test_fuzz_config: "FuzzConnConfig" = None  # type: ignore[assignment]
+
+    def __post_init__(self):
+        if self.test_fuzz_config is None:
+            self.test_fuzz_config = FuzzConnConfig()
+
+    def addr_book_path(self) -> str:
+        return _rootify(self.addr_book_file, self.root_dir)
+
+    def validate_basic(self) -> Optional[str]:
+        if self.max_num_inbound_peers < 0:
+            return "max_num_inbound_peers can't be negative"
+        if self.max_num_outbound_peers < 0:
+            return "max_num_outbound_peers can't be negative"
+        if self.flush_throttle_timeout_ms < 0:
+            return "flush_throttle_timeout can't be negative"
+        if self.max_packet_msg_payload_size < 0:
+            return "max_packet_msg_payload_size can't be negative"
+        if self.send_rate < 0:
+            return "send_rate can't be negative"
+        if self.recv_rate < 0:
+            return "recv_rate can't be negative"
+        return None
+
+
+@dataclass
+class FuzzConnConfig:
+    """Reference FuzzConnConfig config/config.go:626."""
+
+    mode: str = "drop"  # drop | delay
+    max_delay_ms: int = 3_000
+    prob_drop_rw: float = 0.2
+    prob_drop_conn: float = 0.0
+    prob_sleep: float = 0.0
+
+
+@dataclass
+class MempoolConfig:
+    """Reference MempoolConfig config/config.go:646."""
+
+    root_dir: str = ""
+    recheck: bool = True
+    broadcast: bool = True
+    wal_dir: str = ""
+    size: int = 5_000
+    max_txs_bytes: int = 1_073_741_824  # 1GB
+    cache_size: int = 10_000
+    max_tx_bytes: int = 1_048_576  # 1MB
+
+    def wal_dir_path(self) -> str:
+        return _rootify(self.wal_dir, self.root_dir) if self.wal_dir else ""
+
+    def wal_enabled(self) -> bool:
+        return self.wal_dir != ""
+
+    def validate_basic(self) -> Optional[str]:
+        if self.size < 0:
+            return "size can't be negative"
+        if self.max_txs_bytes < 0:
+            return "max_txs_bytes can't be negative"
+        if self.cache_size < 0:
+            return "cache_size can't be negative"
+        if self.max_tx_bytes < 0:
+            return "max_tx_bytes can't be negative"
+        return None
+
+
+@dataclass
+class FastSyncConfig:
+    """Reference FastSyncConfig config/config.go:708."""
+
+    version: str = "v2"
+
+    def validate_basic(self) -> Optional[str]:
+        if self.version not in ("v2",):
+            return f"unknown fastsync version {self.version!r}"
+        return None
+
+
+@dataclass
+class ConsensusConfig:
+    """Reference ConsensusConfig config/config.go:749-800. All *_ms
+    fields are milliseconds; *_delta_ms grow the timeout per round."""
+
+    root_dir: str = ""
+    wal_file_name: str = os.path.join(DEFAULT_DATA_DIR, "cs.wal", "wal")
+    timeout_propose_ms: int = 3_000
+    timeout_propose_delta_ms: int = 500
+    timeout_prevote_ms: int = 1_000
+    timeout_prevote_delta_ms: int = 500
+    timeout_precommit_ms: int = 1_000
+    timeout_precommit_delta_ms: int = 500
+    timeout_commit_ms: int = 1_000
+    skip_timeout_commit: bool = False
+    create_empty_blocks: bool = True
+    create_empty_blocks_interval_ms: int = 0
+    peer_gossip_sleep_duration_ms: int = 100
+    peer_query_maj23_sleep_duration_ms: int = 2_000
+
+    def wal_file(self) -> str:
+        return _rootify(self.wal_file_name, self.root_dir)
+
+    # -- timeout schedule (reference config/config.go:846-886) -------------
+
+    def propose_s(self, round_: int) -> float:
+        return (self.timeout_propose_ms + self.timeout_propose_delta_ms * round_) / 1000.0
+
+    def prevote_s(self, round_: int) -> float:
+        return (self.timeout_prevote_ms + self.timeout_prevote_delta_ms * round_) / 1000.0
+
+    def precommit_s(self, round_: int) -> float:
+        return (self.timeout_precommit_ms + self.timeout_precommit_delta_ms * round_) / 1000.0
+
+    def commit_s(self) -> float:
+        return self.timeout_commit_ms / 1000.0
+
+    def empty_blocks_interval_s(self) -> float:
+        return self.create_empty_blocks_interval_ms / 1000.0
+
+    def validate_basic(self) -> Optional[str]:
+        for name in (
+            "timeout_propose_ms",
+            "timeout_propose_delta_ms",
+            "timeout_prevote_ms",
+            "timeout_prevote_delta_ms",
+            "timeout_precommit_ms",
+            "timeout_precommit_delta_ms",
+            "timeout_commit_ms",
+            "create_empty_blocks_interval_ms",
+            "peer_gossip_sleep_duration_ms",
+            "peer_query_maj23_sleep_duration_ms",
+        ):
+            if getattr(self, name) < 0:
+                return f"{name} can't be negative"
+        return None
+
+
+@dataclass
+class TxIndexConfig:
+    """Reference TxIndexConfig config/config.go:898."""
+
+    indexer: str = "kv"  # kv | null
+    index_keys: str = ""
+    index_all_keys: bool = False
+
+
+@dataclass
+class InstrumentationConfig:
+    """Reference InstrumentationConfig config/config.go:935."""
+
+    prometheus: bool = False
+    prometheus_listen_addr: str = ":26660"
+    max_open_connections: int = 3
+    namespace: str = "tendermint"
+
+
+@dataclass
+class PrivValidatorConfig:
+    """Remote-signer client knobs (subset of BaseConfig in the reference,
+    split out for clarity)."""
+
+    laddr: str = ""
+
+
+@dataclass
+class Config:
+    """Reference Config config/config.go:60-72."""
+
+    base: BaseConfig = field(default_factory=BaseConfig)
+    rpc: RPCConfig = field(default_factory=RPCConfig)
+    p2p: P2PConfig = field(default_factory=P2PConfig)
+    mempool: MempoolConfig = field(default_factory=MempoolConfig)
+    fastsync: FastSyncConfig = field(default_factory=FastSyncConfig)
+    consensus: ConsensusConfig = field(default_factory=ConsensusConfig)
+    tx_index: TxIndexConfig = field(default_factory=TxIndexConfig)
+    instrumentation: InstrumentationConfig = field(default_factory=InstrumentationConfig)
+
+    def set_root(self, root: str) -> "Config":
+        self.base.root_dir = root
+        self.rpc.root_dir = root
+        self.p2p.root_dir = root
+        self.mempool.root_dir = root
+        self.consensus.root_dir = root
+        return self
+
+    @property
+    def root_dir(self) -> str:
+        return self.base.root_dir
+
+    def validate_basic(self) -> Optional[str]:
+        for name, sec in (
+            ("base", self.base),
+            ("rpc", self.rpc),
+            ("p2p", self.p2p),
+            ("mempool", self.mempool),
+            ("fastsync", self.fastsync),
+            ("consensus", self.consensus),
+        ):
+            err = sec.validate_basic()
+            if err:
+                return f"error in [{name}] section: {err}"
+        return None
+
+
+def default_config() -> Config:
+    return Config()
+
+
+def test_config() -> Config:
+    """Fast preset for tests (reference TestConfig config/config.go:107):
+    aggressive timeouts so in-process consensus nets converge quickly."""
+    cfg = Config()
+    cfg.base.chain_id = "tendermint_test"
+    cfg.base.proxy_app = "kvstore"
+    cfg.base.fast_sync = False
+    cfg.base.db_backend = "memdb"
+    cfg.rpc.laddr = "tcp://127.0.0.1:0"
+    cfg.p2p.laddr = "tcp://127.0.0.1:0"
+    cfg.p2p.allow_duplicate_ip = True
+    cfg.p2p.flush_throttle_timeout_ms = 10
+    cfg.consensus.timeout_propose_ms = 400
+    cfg.consensus.timeout_propose_delta_ms = 100
+    cfg.consensus.timeout_prevote_ms = 200
+    cfg.consensus.timeout_prevote_delta_ms = 100
+    cfg.consensus.timeout_precommit_ms = 200
+    cfg.consensus.timeout_precommit_delta_ms = 100
+    cfg.consensus.timeout_commit_ms = 20
+    cfg.consensus.skip_timeout_commit = True
+    cfg.consensus.peer_gossip_sleep_duration_ms = 5
+    cfg.consensus.peer_query_maj23_sleep_duration_ms = 250
+    return cfg
+
+
+# -- ensure directory layout (reference EnsureRoot config/toml.go:21) ------
+
+
+def ensure_root(root: str) -> None:
+    os.makedirs(os.path.join(root, DEFAULT_CONFIG_DIR), exist_ok=True)
+    os.makedirs(os.path.join(root, DEFAULT_DATA_DIR), exist_ok=True)
+
+
+# -- TOML round-trip -------------------------------------------------------
+
+_SECTIONS = (
+    ("rpc", "rpc"),
+    ("p2p", "p2p"),
+    ("mempool", "mempool"),
+    ("fastsync", "fastsync"),
+    ("consensus", "consensus"),
+    ("tx_index", "tx_index"),
+    ("instrumentation", "instrumentation"),
+)
+
+_SKIP_FIELDS = {"root_dir", "test_fuzz_config"}
+
+
+def _toml_value(v) -> str:
+    if isinstance(v, bool):
+        return "true" if v else "false"
+    if isinstance(v, (int, float)):
+        return repr(v)
+    if isinstance(v, str):
+        return '"' + v.replace("\\", "\\\\").replace('"', '\\"') + '"'
+    if isinstance(v, list):
+        return "[" + ", ".join(_toml_value(x) for x in v) + "]"
+    raise TypeError(f"unsupported TOML value {v!r}")
+
+
+def _render_section(obj, header: str) -> str:
+    lines = [f"[{header}]"] if header else []
+    for f in fields(obj):
+        if f.name in _SKIP_FIELDS:
+            continue
+        v = getattr(obj, f.name)
+        if is_dataclass(v):
+            continue
+        lines.append(f"{f.name} = {_toml_value(v)}")
+    return "\n".join(lines) + "\n"
+
+
+def write_config_file(path: str, cfg: Config) -> None:
+    """Render cfg to TOML (reference WriteConfigFile config/toml.go:55)."""
+    parts = [
+        "# Generated by tendermint_tpu. Millisecond durations use *_ms keys.\n",
+        _render_section(cfg.base, ""),
+    ]
+    for attr, header in _SECTIONS:
+        parts.append("\n" + _render_section(getattr(cfg, attr), header))
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    with open(path, "w") as fp:
+        fp.write("".join(parts))
+
+
+def load_config(path: str) -> Config:
+    import tomllib
+
+    with open(path, "rb") as fp:
+        raw = tomllib.load(fp)
+    cfg = Config()
+    _apply(cfg.base, {k: v for k, v in raw.items() if not isinstance(v, dict)})
+    for attr, header in _SECTIONS:
+        if header in raw:
+            _apply(getattr(cfg, attr), raw[header])
+    return cfg
+
+
+def _apply(obj, d: dict) -> None:
+    names = {f.name for f in fields(obj)}
+    for k, v in d.items():
+        if k in names and k not in _SKIP_FIELDS:
+            setattr(obj, k, v)
+
+
+def _rootify(path: str, root: str) -> str:
+    if os.path.isabs(path):
+        return path
+    return os.path.join(root, path)

@@ -1,0 +1,70 @@
+"""Config tree: defaults, validation, TOML round-trip.
+
+Mirrors reference config/config_test.go + toml_test.go.
+"""
+
+import os
+
+from tendermint_tpu.config import (
+    Config,
+    default_config,
+    load_config,
+    test_config,
+    write_config_file,
+)
+from tendermint_tpu.config.config import ensure_root
+
+
+def test_defaults_validate():
+    cfg = default_config()
+    assert cfg.validate_basic() is None
+    assert test_config().validate_basic() is None
+
+
+def test_bad_values_caught():
+    cfg = default_config()
+    cfg.base.db_backend = "leveldb-from-mars"
+    assert "db_backend" in cfg.validate_basic()
+    cfg = default_config()
+    cfg.consensus.timeout_propose_ms = -1
+    assert "consensus" in cfg.validate_basic()
+    cfg = default_config()
+    cfg.p2p.send_rate = -5
+    assert "p2p" in cfg.validate_basic()
+
+
+def test_timeout_schedule_grows_per_round():
+    cfg = default_config()
+    assert cfg.consensus.propose_s(0) == 3.0
+    assert cfg.consensus.propose_s(2) == 4.0
+    assert cfg.consensus.prevote_s(1) == 1.5
+
+
+def test_rootify():
+    cfg = default_config().set_root("/tmp/tmroot")
+    assert cfg.base.genesis_file() == "/tmp/tmroot/config/genesis.json"
+    assert cfg.consensus.wal_file() == "/tmp/tmroot/data/cs.wal/wal"
+    assert cfg.p2p.addr_book_path() == "/tmp/tmroot/config/addrbook.json"
+
+
+def test_toml_round_trip(tmp_path):
+    cfg = test_config()
+    cfg.base.moniker = 'node "7"'
+    cfg.rpc.cors_allowed_origins = ["*"]
+    path = str(tmp_path / "config" / "config.toml")
+    write_config_file(path, cfg)
+    got = load_config(path)
+    assert got.base.moniker == 'node "7"'
+    assert got.base.db_backend == "memdb"
+    assert got.consensus.timeout_commit_ms == 20
+    assert got.consensus.skip_timeout_commit is True
+    assert got.rpc.cors_allowed_origins == ["*"]
+    assert got.p2p.allow_duplicate_ip is True
+    assert got.validate_basic() is None
+
+
+def test_ensure_root(tmp_path):
+    root = str(tmp_path / "noderoot")
+    ensure_root(root)
+    assert os.path.isdir(os.path.join(root, "config"))
+    assert os.path.isdir(os.path.join(root, "data"))
