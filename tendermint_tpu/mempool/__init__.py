@@ -1,0 +1,17 @@
+from tendermint_tpu.mempool.mempool import (
+    ErrMempoolIsFull,
+    ErrTxInCache,
+    ErrTxTooLarge,
+    Mempool,
+    NopMempool,
+    TxCache,
+)
+
+__all__ = [
+    "ErrMempoolIsFull",
+    "ErrTxInCache",
+    "ErrTxTooLarge",
+    "Mempool",
+    "NopMempool",
+    "TxCache",
+]

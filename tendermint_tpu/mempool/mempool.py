@@ -1,0 +1,366 @@
+"""Mempool: pending-transaction pool with ABCI CheckTx admission.
+
+Reference: mempool/clist_mempool.go — CListMempool :33, CheckTx :213,
+resCbFirstTime :366, addTx :341, ReapMaxBytesMaxGas :471, Update :529,
+recheckTxs :591; cache mapAndList at mempool/cache.go region; interface
+mempool/mempool.go.
+
+The reference's concurrent linked list (clist) exists so per-peer
+broadcast goroutines can block on "next element". Here the pool is an
+insertion-ordered dict with a monotone per-entry sequence number plus an
+asyncio.Condition — `wait_for_next(seq)` is the clist `NextWait`
+equivalent for the gossip reactor, without a custom lock-free list (the
+event loop serializes mutation anyway).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import hashlib
+from collections import OrderedDict
+from typing import Callable, Dict, List, Optional
+
+from tendermint_tpu.abci import types as abci
+from tendermint_tpu.types.tx import Tx, Txs
+from tendermint_tpu.utils.log import get_logger
+
+
+class ErrTxInCache(Exception):
+    """Tx already in the cache (reference ErrTxInCache mempool/errors.go)."""
+
+
+class ErrTxTooLarge(Exception):
+    pass
+
+
+class ErrMempoolIsFull(Exception):
+    pass
+
+
+class ErrPreCheck(Exception):
+    pass
+
+
+def tx_key(tx: bytes) -> bytes:
+    """Cache/lookup key (reference TxKey mempool/mempool.go: sha256)."""
+    return hashlib.sha256(bytes(tx)).digest()
+
+
+class TxCache:
+    """LRU seen-tx cache (reference mapAndList cache, cache_size config)."""
+
+    def __init__(self, size: int):
+        self._size = size
+        self._map: "OrderedDict[bytes, None]" = OrderedDict()
+
+    def reset(self) -> None:
+        self._map.clear()
+
+    def push(self, tx: bytes) -> bool:
+        """Returns False if already present (and refreshes recency)."""
+        k = tx_key(tx)
+        if k in self._map:
+            self._map.move_to_end(k)
+            return False
+        self._map[k] = None
+        if len(self._map) > self._size:
+            self._map.popitem(last=False)
+        return True
+
+    def remove(self, tx: bytes) -> None:
+        self._map.pop(tx_key(tx), None)
+
+    def __contains__(self, tx: bytes) -> bool:
+        return tx_key(tx) in self._map
+
+
+class _MempoolTx:
+    """One pool entry (reference mempoolTx clist_mempool.go:765)."""
+
+    __slots__ = ("tx", "height", "gas_wanted", "seq", "senders")
+
+    def __init__(self, tx: bytes, height: int, gas_wanted: int, seq: int):
+        self.tx = tx
+        self.height = height  # height at which validated
+        self.gas_wanted = gas_wanted
+        self.seq = seq
+        self.senders: set = set()  # peer ids that sent us this tx
+
+
+class Mempool:
+    """Async mempool over the ABCI mempool connection."""
+
+    def __init__(
+        self,
+        config,
+        app_conn,
+        height: int = 0,
+        pre_check: Optional[Callable[[bytes], Optional[str]]] = None,
+        post_check: Optional[Callable[[bytes, abci.ResponseCheckTx], Optional[str]]] = None,
+        logger=None,
+    ):
+        self.config = config
+        self._app = app_conn
+        self.logger = logger or get_logger("mempool")
+        self._height = height
+        self._txs: "OrderedDict[bytes, _MempoolTx]" = OrderedDict()
+        self._txs_bytes = 0
+        self._seq = 0
+        self._cache = TxCache(config.cache_size)
+        self._pre_check = pre_check
+        self._post_check = post_check
+        # consensus lock: held around Commit + Update (reference Lock/Unlock)
+        self._update_lock = asyncio.Lock()
+        self._new_tx = asyncio.Condition()
+        # txs-available notification, fired at most once per height
+        # (reference notifyTxsAvailable :455)
+        self._txs_available: Optional[asyncio.Event] = None
+        self._notified_txs_available = False
+
+    # -- info --------------------------------------------------------------
+
+    def size(self) -> int:
+        return len(self._txs)
+
+    def txs_bytes(self) -> int:
+        return self._txs_bytes
+
+    def is_full(self, tx_size: int) -> tuple:
+        """(err or None) capacity check (reference isFull :203)."""
+        if len(self._txs) >= self.config.size:
+            return ErrMempoolIsFull(f"{len(self._txs)} >= {self.config.size}")
+        if self._txs_bytes + tx_size > self.config.max_txs_bytes:
+            return ErrMempoolIsFull(
+                f"bytes {self._txs_bytes}+{tx_size} > {self.config.max_txs_bytes}"
+            )
+        return None
+
+    def enable_txs_available(self) -> None:
+        """Consensus calls this when create_empty_blocks=false
+        (reference EnableTxsAvailable :447)."""
+        self._txs_available = asyncio.Event()
+
+    def txs_available(self) -> Optional[asyncio.Event]:
+        return self._txs_available
+
+    # -- admission (reference CheckTx :213) --------------------------------
+
+    async def check_tx(self, tx: bytes, sender: str = "") -> abci.ResponseCheckTx:
+        """Validate tx via the app and add to the pool if accepted.
+        Raises ErrTxInCache/ErrTxTooLarge/ErrMempoolIsFull/ErrPreCheck on
+        admission failure; returns the app's ResponseCheckTx otherwise
+        (rejected txs return with res.code != OK, not raised)."""
+        tx = bytes(tx)
+        if len(tx) > self.config.max_tx_bytes:
+            raise ErrTxTooLarge(f"{len(tx)} > {self.config.max_tx_bytes}")
+        err = self.is_full(len(tx))
+        if err is not None:
+            raise err
+        if self._pre_check is not None:
+            perr = self._pre_check(tx)
+            if perr is not None:
+                raise ErrPreCheck(perr)
+        if not self._cache.push(tx):
+            # record extra sender for an in-pool tx (reference :259-266)
+            entry = self._txs.get(tx_key(tx))
+            if entry is not None and sender:
+                entry.senders.add(sender)
+            raise ErrTxInCache()
+
+        try:
+            res = await self._app.check_tx_sync(abci.RequestCheckTx(tx=tx))
+        except Exception:
+            self._cache.remove(tx)
+            raise
+        await self._res_cb_first_time(tx, sender, res)
+        return res
+
+    async def _res_cb_first_time(
+        self, tx: bytes, sender: str, res: abci.ResponseCheckTx
+    ) -> None:
+        """reference resCbFirstTime :366."""
+        post_err = self._post_check(tx, res) if self._post_check else None
+        if res.is_ok() and post_err is None:
+            err = self.is_full(len(tx))
+            if err is not None:
+                self._cache.remove(tx)
+                raise err
+            self._seq += 1
+            entry = _MempoolTx(tx, self._height, res.gas_wanted, self._seq)
+            if sender:
+                entry.senders.add(sender)
+            self._txs[tx_key(tx)] = entry
+            self._txs_bytes += len(tx)
+            self.logger.debug(
+                "added good transaction", tx=tx_key(tx).hex()[:12], pool=len(self._txs)
+            )
+            self._notify_txs_available()
+            async with self._new_tx:
+                self._new_tx.notify_all()
+        else:
+            # ignore bad transaction; allow resubmission (reference :399)
+            self.logger.debug(
+                "rejected bad transaction", tx=tx_key(tx).hex()[:12], code=res.code,
+                post_check_err=str(post_err) if post_err else "",
+            )
+            self._cache.remove(tx)
+
+    def _notify_txs_available(self) -> None:
+        if self._txs_available is not None and not self._notified_txs_available:
+            self._notified_txs_available = True
+            self._txs_available.set()
+
+    # -- gossip iteration (clist NextWait equivalent) ----------------------
+
+    def next_after(self, seq: int) -> Optional[_MempoolTx]:
+        """First entry with seq > given, in insertion order."""
+        for entry in self._txs.values():
+            if entry.seq > seq:
+                return entry
+        return None
+
+    async def wait_for_next(self, seq: int) -> _MempoolTx:
+        """Block until an entry with seq > given exists."""
+        while True:
+            entry = self.next_after(seq)
+            if entry is not None:
+                return entry
+            async with self._new_tx:
+                await self._new_tx.wait()
+
+    # -- consensus-side API ------------------------------------------------
+
+    async def lock(self) -> None:
+        await self._update_lock.acquire()
+
+    def unlock(self) -> None:
+        self._update_lock.release()
+
+    async def flush_app_conn(self) -> None:
+        await self._app.flush()
+
+    def reap_max_bytes_max_gas(self, max_bytes: int, max_gas: int) -> Txs:
+        """Collect txs in order up to byte/gas limits (reference
+        ReapMaxBytesMaxGas :471). max_bytes/max_gas < 0 mean no cap."""
+        out: List[Tx] = []
+        total_bytes = 0
+        total_gas = 0
+        for entry in self._txs.values():
+            sz = len(entry.tx)
+            if max_bytes > -1 and total_bytes + sz > max_bytes:
+                break
+            new_gas = total_gas + entry.gas_wanted
+            if max_gas > -1 and new_gas > max_gas:
+                break
+            total_bytes += sz
+            total_gas = new_gas
+            out.append(Tx(entry.tx))
+        return Txs(out)
+
+    def reap_max_txs(self, n: int) -> Txs:
+        """First n txs (reference ReapMaxTxs :508)."""
+        if n < 0:
+            n = len(self._txs)
+        return Txs([Tx(e.tx) for _, e in zip(range(n), self._txs.values())])
+
+    async def update(
+        self,
+        height: int,
+        txs: Txs,
+        deliver_tx_responses: List[abci.ResponseDeliverTx],
+        pre_check=None,
+        post_check=None,
+    ) -> None:
+        """Called by BlockExecutor with the mempool LOCKED, after the app
+        commits block `height` (reference Update :529)."""
+        self._height = height
+        self._notified_txs_available = False
+        if pre_check is not None:
+            self._pre_check = pre_check
+        if post_check is not None:
+            self._post_check = post_check
+
+        for tx, res in zip(txs, deliver_tx_responses):
+            tx = bytes(tx)
+            if res.is_ok():
+                # committed: keep in cache to reject future resubmission
+                self._cache.push(tx)
+            else:
+                # invalid on-chain: allow resubmission later
+                self._cache.remove(tx)
+            entry = self._txs.pop(tx_key(tx), None)
+            if entry is not None:
+                self._txs_bytes -= len(entry.tx)
+
+        if self._txs:
+            if self.config.recheck:
+                self.logger.debug("recheck txs", num=len(self._txs), height=height)
+                await self._recheck_txs()
+            if self._txs:
+                self._notify_txs_available()
+
+    async def _recheck_txs(self) -> None:
+        """Re-validate every pool tx at the new app state (reference
+        recheckTxs :591): requests pipelined, responses applied in order."""
+        entries = list(self._txs.values())
+        reqres = [
+            self._app.check_tx_async(
+                abci.RequestCheckTx(tx=e.tx, type=abci.CHECK_TX_RECHECK)
+            )
+            for e in entries
+        ]
+        await self._app.flush()
+        for entry, rr in zip(entries, reqres):
+            res = await rr.wait()
+            post_err = self._post_check(entry.tx, res) if self._post_check else None
+            if not res.is_ok() or post_err is not None:
+                k = tx_key(entry.tx)
+                if self._txs.pop(k, None) is not None:
+                    self._txs_bytes -= len(entry.tx)
+                self._cache.remove(entry.tx)
+
+    async def flush(self) -> None:
+        """Drop everything (reference Flush :434; RPC unsafe_flush_mempool)."""
+        self._cache.reset()
+        self._txs.clear()
+        self._txs_bytes = 0
+
+
+class NopMempool:
+    """No-op mempool (reference mock/mempool.go) for blockchain-sync tests."""
+
+    def size(self) -> int:
+        return 0
+
+    def txs_bytes(self) -> int:
+        return 0
+
+    async def check_tx(self, tx: bytes, sender: str = ""):
+        raise ErrMempoolIsFull("nop mempool")
+
+    def reap_max_bytes_max_gas(self, max_bytes: int, max_gas: int) -> Txs:
+        return Txs()
+
+    def reap_max_txs(self, n: int) -> Txs:
+        return Txs()
+
+    async def lock(self) -> None:
+        pass
+
+    def unlock(self) -> None:
+        pass
+
+    async def flush_app_conn(self) -> None:
+        pass
+
+    async def update(self, height, txs, deliver_tx_responses, pre_check=None, post_check=None) -> None:
+        pass
+
+    async def flush(self) -> None:
+        pass
+
+    def enable_txs_available(self) -> None:
+        pass
+
+    def txs_available(self):
+        return None

@@ -1,0 +1,201 @@
+"""Mempool: admission, cache, reap, update+recheck.
+
+Mirrors reference mempool/clist_mempool_test.go (TestReapMaxBytesMaxGas,
+TestMempoolUpdate, TestTxsAvailable, TestSerialReap flavor, cache tests
+mempool/cache_test.go).
+"""
+
+import asyncio
+
+import pytest
+
+from tendermint_tpu.abci import types as abci
+from tendermint_tpu.abci.client.local import LocalClient
+from tendermint_tpu.abci.examples.counter import CounterApplication
+from tendermint_tpu.abci.examples.kvstore import KVStoreApplication
+from tendermint_tpu.config import MempoolConfig
+from tendermint_tpu.mempool import (
+    ErrMempoolIsFull,
+    ErrTxInCache,
+    ErrTxTooLarge,
+    Mempool,
+    TxCache,
+)
+from tendermint_tpu.types.tx import Txs
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+async def make_pool(app=None, **cfg_kwargs) -> Mempool:
+    app = app or KVStoreApplication()
+    client = LocalClient(app)
+    await client.start()
+    return Mempool(MempoolConfig(**cfg_kwargs), client)
+
+
+def tx_n(n: int, width: int = 8) -> bytes:
+    return n.to_bytes(width, "big")
+
+
+def test_check_tx_adds_and_dedups():
+    async def go():
+        pool = await make_pool()
+        res = await pool.check_tx(b"k=v")
+        assert res.is_ok()
+        assert pool.size() == 1 and pool.txs_bytes() == 3
+        with pytest.raises(ErrTxInCache):
+            await pool.check_tx(b"k=v")
+        assert pool.size() == 1
+
+    run(go())
+
+
+def test_check_tx_rejected_not_added():
+    async def go():
+        pool = await make_pool(CounterApplication(serial=True))
+        bad = b"123456789"  # >8 bytes → invalid for serial counter
+        res = await pool.check_tx(bad)
+        assert not res.is_ok()
+        assert pool.size() == 0
+        # rejected txs leave the cache → resubmission allowed
+        res2 = await pool.check_tx(bad)
+        assert not res2.is_ok()
+
+    run(go())
+
+
+def test_admission_limits():
+    async def go():
+        pool = await make_pool(max_tx_bytes=10)
+        with pytest.raises(ErrTxTooLarge):
+            await pool.check_tx(b"x" * 11)
+        pool2 = await make_pool(size=2)
+        await pool2.check_tx(b"a")
+        await pool2.check_tx(b"b")
+        with pytest.raises(ErrMempoolIsFull):
+            await pool2.check_tx(b"c")
+        pool3 = await make_pool(max_txs_bytes=5)
+        await pool3.check_tx(b"aaa")
+        with pytest.raises(ErrMempoolIsFull):
+            await pool3.check_tx(b"bbb")
+
+    run(go())
+
+
+def test_reap_max_bytes_max_gas():
+    async def go():
+        pool = await make_pool()
+        for i in range(20):
+            await pool.check_tx(tx_n(i))
+        # no caps
+        assert len(pool.reap_max_bytes_max_gas(-1, -1)) == 20
+        # byte cap: each tx is 8 bytes
+        assert len(pool.reap_max_bytes_max_gas(8 * 5, -1)) == 5
+        assert len(pool.reap_max_bytes_max_gas(3, -1)) == 0
+        # insertion order preserved
+        got = pool.reap_max_bytes_max_gas(8 * 3, -1)
+        assert [bytes(t) for t in got] == [tx_n(0), tx_n(1), tx_n(2)]
+        assert len(pool.reap_max_txs(7)) == 7
+
+    run(go())
+
+
+def test_update_removes_committed_and_rechecks():
+    async def go():
+        app = CounterApplication(serial=True)
+        pool = await make_pool(app)
+        for i in range(5):
+            await pool.check_tx(tx_n(i))
+        assert pool.size() == 5
+        # commit txs 0 and 1; app tx_count advances to 2
+        app.tx_count = 2
+        await pool.update(
+            1,
+            Txs([tx_n(0), tx_n(1)]),
+            [abci.ResponseDeliverTx(), abci.ResponseDeliverTx()],
+        )
+        # remaining 2,3,4 still valid (nonce >= 2)
+        assert pool.size() == 3
+        # committed tx stays cached → resubmission rejected
+        with pytest.raises(ErrTxInCache):
+            await pool.check_tx(tx_n(0))
+        # now app advances past 3: recheck drops stale nonces 2,3
+        app.tx_count = 4
+        await pool.update(2, Txs([]), [])
+        assert pool.size() == 1
+        assert bytes(pool.reap_max_txs(-1)[0]) == tx_n(4)
+
+    run(go())
+
+
+def test_update_invalid_tx_evicted_from_cache():
+    async def go():
+        pool = await make_pool()
+        tx = b"will-fail"
+        await pool.check_tx(tx)
+        await pool.update(1, Txs([tx]), [abci.ResponseDeliverTx(code=1)])
+        assert pool.size() == 0
+        # failed-on-chain tx may be resubmitted
+        res = await pool.check_tx(tx)
+        assert res.is_ok()
+
+    run(go())
+
+
+def test_txs_available_fires_once_per_height():
+    async def go():
+        pool = await make_pool()
+        pool.enable_txs_available()
+        ev = pool.txs_available()
+        assert not ev.is_set()
+        await pool.check_tx(b"t1")
+        assert ev.is_set()
+        ev.clear()
+        await pool.check_tx(b"t2")  # same height: no re-fire
+        assert not ev.is_set()
+        await pool.update(1, Txs([b"t1"]), [abci.ResponseDeliverTx()])
+        assert ev.is_set()  # pool still non-empty after update → re-notify
+
+    run(go())
+
+
+def test_wait_for_next_gossip_cursor():
+    async def go():
+        pool = await make_pool()
+        await pool.check_tx(b"a")
+        e1 = await pool.wait_for_next(0)
+        assert e1.tx == b"a"
+        waiter = asyncio.create_task(pool.wait_for_next(e1.seq))
+        await asyncio.sleep(0.01)
+        assert not waiter.done()
+        await pool.check_tx(b"b")
+        e2 = await asyncio.wait_for(waiter, 1)
+        assert e2.tx == b"b"
+
+    run(go())
+
+
+def test_tx_cache_lru():
+    c = TxCache(2)
+    assert c.push(b"a") and c.push(b"b")
+    assert not c.push(b"a")  # refreshes recency of a
+    assert c.push(b"c")  # evicts b (LRU)
+    assert b"b" not in c and b"a" in c and b"c" in c
+    c.remove(b"a")
+    assert b"a" not in c
+
+
+def test_lock_serializes_update():
+    async def go():
+        pool = await make_pool()
+        await pool.lock()
+        acquired = asyncio.create_task(pool.lock())
+        await asyncio.sleep(0.01)
+        assert not acquired.done()
+        pool.unlock()
+        await asyncio.wait_for(acquired, 1)
+        pool.unlock()
+
+    run(go())
