@@ -88,3 +88,16 @@ def canonical_sign_bytes(
     out += b"\x00" * (SIGN_BYTES_LEN - len(out))
     assert len(out) == SIGN_BYTES_LEN
     return out
+
+
+TIMESTAMP_OFFSET = 93
+
+
+def extract_timestamp_ns(sign_bytes: bytes) -> int:
+    """Read the i64 timestamp back out of canonical sign-bytes — used by
+    the privval only-differs-by-timestamp double-sign rule
+    (reference privval/file.go:393 decodes the full CanonicalVote; the
+    fixed layout makes this a field read)."""
+    if len(sign_bytes) != SIGN_BYTES_LEN:
+        raise ValueError(f"sign bytes must be {SIGN_BYTES_LEN} bytes")
+    return struct.unpack_from(">q", sign_bytes, TIMESTAMP_OFFSET)[0]

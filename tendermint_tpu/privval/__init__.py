@@ -1,0 +1,21 @@
+from tendermint_tpu.privval.file import (
+    FilePV,
+    FilePVKey,
+    FilePVLastSignState,
+    STEP_PRECOMMIT,
+    STEP_PREVOTE,
+    STEP_PROPOSAL,
+    load_file_pv,
+    load_or_gen_file_pv,
+)
+
+__all__ = [
+    "FilePV",
+    "FilePVKey",
+    "FilePVLastSignState",
+    "STEP_PRECOMMIT",
+    "STEP_PREVOTE",
+    "STEP_PROPOSAL",
+    "load_file_pv",
+    "load_or_gen_file_pv",
+]

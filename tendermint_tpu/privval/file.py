@@ -1,0 +1,324 @@
+"""File-backed private validator with double-sign protection.
+
+Reference: privval/file.go — FilePVKey :41, FilePVLastSignState :71 with
+CheckHRS :88, FilePV :145, signVote :246/:296 region, signProposal,
+checkVotesOnlyDifferByTimestamp :393. The last-sign state (height/round/
+step + sign-bytes + signature) is fsync'd to disk BEFORE a signature is
+released, so a crash cannot lead to signing a conflicting message after
+restart.
+
+Step ordering within one (H,R): proposal(1) < prevote(2) < precommit(3).
+Signing a message with an HRS lower than the persisted HRS is refused;
+equal HRS is allowed only when the sign-bytes match what was signed
+(re-broadcast) or differ solely in timestamp (the reference's
+only-differ-by-timestamp regeneration rule).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import tempfile
+from dataclasses import dataclass, field
+from typing import Optional, Tuple
+
+from tendermint_tpu.codec import signbytes
+from tendermint_tpu.crypto.keys import Ed25519PrivKey, Ed25519PubKey, PubKey
+from tendermint_tpu.types.priv_validator import PrivValidator
+from tendermint_tpu.types.proposal import Proposal
+from tendermint_tpu.types.vote import Vote
+
+STEP_NONE = 0
+STEP_PROPOSAL = 1
+STEP_PREVOTE = 2
+STEP_PRECOMMIT = 3
+
+_VOTE_TYPE_TO_STEP = {
+    signbytes.PREVOTE_TYPE: STEP_PREVOTE,
+    signbytes.PRECOMMIT_TYPE: STEP_PRECOMMIT,
+}
+
+
+class ErrDoubleSign(Exception):
+    """Refusing to sign: HRS regression or conflicting payload at same HRS."""
+
+
+def _atomic_write(path: str, data: str) -> None:
+    """Write+fsync via temp file then rename (reference tempfile.WriteFileAtomic)."""
+    d = os.path.dirname(path) or "."
+    fd, tmp = tempfile.mkstemp(dir=d, prefix=".pv-")
+    try:
+        with os.fdopen(fd, "w") as fp:
+            fp.write(data)
+            fp.flush()
+            os.fsync(fp.fileno())
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+
+
+@dataclass
+class FilePVKey:
+    """Immutable key part, stored separately from the mutable sign state
+    (reference FilePVKey privval/file.go:41; the v0.33 split key/state
+    layout)."""
+
+    address: bytes
+    pub_key: PubKey
+    priv_key: Ed25519PrivKey
+    file_path: str = ""
+
+    def save(self) -> None:
+        if not self.file_path:
+            raise ValueError("cannot save PV key: filePath not set")
+        doc = {
+            "address": self.address.hex(),
+            "pub_key": {"type": "ed25519", "value": self.pub_key.bytes().hex()},
+            "priv_key": {"type": "ed25519", "value": self.priv_key.bytes().hex()},
+        }
+        _atomic_write(self.file_path, json.dumps(doc, indent=2))
+
+    @classmethod
+    def load(cls, path: str) -> "FilePVKey":
+        with open(path) as fp:
+            doc = json.load(fp)
+        priv = Ed25519PrivKey(bytes.fromhex(doc["priv_key"]["value"]))
+        pub = Ed25519PubKey(bytes.fromhex(doc["pub_key"]["value"]))
+        if pub.bytes() != priv.pub_key().bytes():
+            raise ValueError("priv_validator key file: pub/priv key mismatch")
+        return cls(
+            address=bytes.fromhex(doc["address"]),
+            pub_key=pub,
+            priv_key=priv,
+            file_path=path,
+        )
+
+
+@dataclass
+class FilePVLastSignState:
+    """Mutable sign-state part (reference FilePVLastSignState :71)."""
+
+    height: int = 0
+    round: int = 0
+    step: int = STEP_NONE
+    signature: bytes = b""
+    sign_bytes: bytes = b""
+    file_path: str = ""
+
+    def check_hrs(self, height: int, round_: int, step: int) -> bool:
+        """Error on HRS regression; returns True if this exact HRS was
+        already signed (caller must then prove sameness) — reference
+        CheckHRS privval/file.go:88."""
+        if self.height > height:
+            raise ErrDoubleSign(f"height regression: {self.height} > {height}")
+        if self.height == height:
+            if self.round > round_:
+                raise ErrDoubleSign(f"round regression at H{height}: {self.round} > {round_}")
+            if self.round == round_:
+                if self.step > step:
+                    raise ErrDoubleSign(
+                        f"step regression at {height}/{round_}: {self.step} > {step}"
+                    )
+                if self.step == step:
+                    if not self.sign_bytes:
+                        raise ErrDoubleSign("no sign_bytes for repeated HRS")
+                    if not self.signature:
+                        raise RuntimeError("pv: sign_bytes present, signature absent")
+                    return True
+        return False
+
+    def save(self) -> None:
+        if not self.file_path:
+            raise ValueError("cannot save PV state: filePath not set")
+        doc = {
+            "height": self.height,
+            "round": self.round,
+            "step": self.step,
+            "signature": self.signature.hex(),
+            "sign_bytes": self.sign_bytes.hex(),
+        }
+        _atomic_write(self.file_path, json.dumps(doc, indent=2))
+
+    @classmethod
+    def load(cls, path: str) -> "FilePVLastSignState":
+        with open(path) as fp:
+            doc = json.load(fp)
+        return cls(
+            height=int(doc["height"]),
+            round=int(doc["round"]),
+            step=int(doc["step"]),
+            signature=bytes.fromhex(doc.get("signature", "")),
+            sign_bytes=bytes.fromhex(doc.get("sign_bytes", "")),
+            file_path=path,
+        )
+
+
+class FilePV(PrivValidator):
+    """Reference FilePV privval/file.go:145."""
+
+    def __init__(self, key: FilePVKey, last_sign_state: FilePVLastSignState):
+        self.key = key
+        self.last_sign_state = last_sign_state
+
+    # -- constructors ------------------------------------------------------
+
+    @classmethod
+    def generate(cls, key_file_path: str, state_file_path: str) -> "FilePV":
+        priv = Ed25519PrivKey.generate()
+        return cls.from_priv_key(priv, key_file_path, state_file_path)
+
+    @classmethod
+    def from_priv_key(
+        cls, priv: Ed25519PrivKey, key_file_path: str, state_file_path: str
+    ) -> "FilePV":
+        pub = priv.pub_key()
+        return cls(
+            FilePVKey(pub.address(), pub, priv, key_file_path),
+            FilePVLastSignState(file_path=state_file_path),
+        )
+
+    def save(self) -> None:
+        self.key.save()
+        self.last_sign_state.save()
+
+    def reset(self) -> None:
+        """Danger: wipes the sign state (reference Reset :233 — testing only)."""
+        self.last_sign_state = FilePVLastSignState(
+            file_path=self.last_sign_state.file_path
+        )
+        self.last_sign_state.save()
+
+    # -- PrivValidator -----------------------------------------------------
+
+    def get_pub_key(self) -> PubKey:
+        return self.key.pub_key
+
+    def address(self) -> bytes:
+        return self.key.address
+
+    def sign_vote(self, chain_id: str, vote: Vote) -> None:
+        step = _VOTE_TYPE_TO_STEP.get(vote.vote_type)
+        if step is None:
+            raise ValueError(f"unknown vote type {vote.vote_type}")
+        sig = self._sign_checked(
+            vote.height, vote.round, step, vote.sign_bytes(chain_id),
+            lambda ts: _vote_sign_bytes_at(vote, chain_id, ts),
+            vote.timestamp_ns,
+        )
+        if sig is None:
+            # same HRS, only timestamp differs: reuse persisted timestamp+sig
+            vote.timestamp_ns = self._last_timestamp()
+            vote.signature = self.last_sign_state.signature
+        else:
+            vote.signature = sig
+
+    def sign_proposal(self, chain_id: str, proposal: Proposal) -> None:
+        sig = self._sign_checked(
+            proposal.height, proposal.round, STEP_PROPOSAL,
+            proposal.sign_bytes(chain_id),
+            lambda ts: _proposal_sign_bytes_at(proposal, chain_id, ts),
+            proposal.timestamp_ns,
+        )
+        if sig is None:
+            proposal.timestamp_ns = self._last_timestamp()
+            proposal.signature = self.last_sign_state.signature
+        else:
+            proposal.signature = sig
+
+    # -- internals ---------------------------------------------------------
+
+    def _sign_checked(
+        self, height: int, round_: int, step: int, sign_bytes: bytes,
+        rebuild_at_ts, timestamp_ns: int,
+    ) -> Optional[bytes]:
+        """Returns a fresh signature, or None if the persisted one must be
+        reused (same HRS, differs only by timestamp). Raises ErrDoubleSign
+        on conflicts. Reference signVote/signProposal privval/file.go:296."""
+        lss = self.last_sign_state
+        same_hrs = lss.check_hrs(height, round_, step)
+        if same_hrs:
+            if sign_bytes == lss.sign_bytes:
+                return lss.signature  # exact re-broadcast
+            if self._only_differs_by_timestamp(lss.sign_bytes, rebuild_at_ts):
+                return None  # caller reuses persisted timestamp + signature
+            raise ErrDoubleSign(
+                f"conflicting data at {height}/{round_}/{step}"
+            )
+        sig = self.key.priv_key.sign(sign_bytes)
+        # persist BEFORE releasing the signature (crash safety)
+        lss.height = height
+        lss.round = round_
+        lss.step = step
+        lss.signature = sig
+        lss.sign_bytes = sign_bytes
+        lss.save()
+        return sig
+
+    def _only_differs_by_timestamp(self, last_sign_bytes: bytes, rebuild_at_ts) -> bool:
+        """True iff the new payload equals the persisted one after
+        substituting the persisted timestamp (reference
+        checkVotesOnlyDifferByTimestamp :393). The fixed-width sign-bytes
+        layout makes this a pure byte compare at the rebuilt message."""
+        ts = self._last_timestamp()
+        if ts is None:
+            return False
+        return rebuild_at_ts(ts) == last_sign_bytes
+
+    def _last_timestamp(self) -> Optional[int]:
+        sb = self.last_sign_state.sign_bytes
+        if not sb:
+            return None
+        return signbytes.extract_timestamp_ns(sb)
+
+    def __repr__(self) -> str:
+        lss = self.last_sign_state
+        return (
+            f"FilePV{{{self.key.address.hex()[:12]} "
+            f"LH:{lss.height} LR:{lss.round} LS:{lss.step}}}"
+        )
+
+
+def _vote_sign_bytes_at(vote: Vote, chain_id: str, ts: int) -> bytes:
+    return signbytes.canonical_sign_bytes(
+        msg_type=vote.vote_type,
+        height=vote.height,
+        round_=vote.round,
+        block_hash=vote.block_id.hash,
+        parts_total=vote.block_id.parts.total,
+        parts_hash=vote.block_id.parts.hash,
+        timestamp_ns=ts,
+        chain_id=chain_id,
+    )
+
+
+def _proposal_sign_bytes_at(proposal: Proposal, chain_id: str, ts: int) -> bytes:
+    return signbytes.canonical_sign_bytes(
+        msg_type=signbytes.PROPOSAL_TYPE,
+        height=proposal.height,
+        round_=proposal.round,
+        block_hash=proposal.block_id.hash,
+        parts_total=proposal.block_id.parts.total,
+        parts_hash=proposal.block_id.parts.hash,
+        timestamp_ns=ts,
+        chain_id=chain_id,
+        pol_round=proposal.pol_round,
+    )
+
+
+def load_file_pv(key_file_path: str, state_file_path: str) -> FilePV:
+    key = FilePVKey.load(key_file_path)
+    state = FilePVLastSignState.load(state_file_path)
+    return FilePV(key, state)
+
+
+def load_or_gen_file_pv(key_file_path: str, state_file_path: str) -> FilePV:
+    """Reference LoadOrGenFilePV privval/file.go:199."""
+    if os.path.exists(key_file_path):
+        return load_file_pv(key_file_path, state_file_path)
+    pv = FilePV.generate(key_file_path, state_file_path)
+    pv.save()
+    return pv

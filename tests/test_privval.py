@@ -1,0 +1,137 @@
+"""FilePV: persistence, double-sign protection, HRS rules.
+
+Mirrors reference privval/file_test.go (TestUnmarshalValidator flavor,
+TestSignVote, TestSignProposal, TestDiffersFromStale timestamp rule).
+"""
+
+import pytest
+
+from tendermint_tpu.codec.signbytes import PRECOMMIT_TYPE, PREVOTE_TYPE
+from tendermint_tpu.privval import FilePV, load_file_pv, load_or_gen_file_pv
+from tendermint_tpu.privval.file import STEP_PRECOMMIT, ErrDoubleSign
+from tendermint_tpu.types.block import BlockID, PartSetHeader
+from tendermint_tpu.types.vote import Vote
+from tendermint_tpu.types.proposal import Proposal
+
+CHAIN_ID = "test-chain-pv"
+
+
+def paths(tmp_path):
+    return str(tmp_path / "pv_key.json"), str(tmp_path / "pv_state.json")
+
+
+def make_block_id(seed: int = 1) -> BlockID:
+    return BlockID(
+        hash=bytes([seed]) * 32, parts=PartSetHeader(total=1, hash=bytes([seed + 1]) * 32)
+    )
+
+
+def make_vote(pv: FilePV, vtype=PREVOTE_TYPE, height=1, round_=0, block_id=None, ts=1000):
+    return Vote(
+        vote_type=vtype,
+        height=height,
+        round=round_,
+        block_id=block_id or make_block_id(),
+        timestamp_ns=ts,
+        validator_address=pv.address(),
+        validator_index=0,
+    )
+
+
+def test_gen_save_load_round_trip(tmp_path):
+    kf, sf = paths(tmp_path)
+    pv = load_or_gen_file_pv(kf, sf)
+    pv2 = load_or_gen_file_pv(kf, sf)  # second call loads, not regenerates
+    assert pv.address() == pv2.address()
+    assert pv.get_pub_key().bytes() == pv2.get_pub_key().bytes()
+
+
+def test_sign_vote_and_persist_state(tmp_path):
+    kf, sf = paths(tmp_path)
+    pv = load_or_gen_file_pv(kf, sf)
+    vote = make_vote(pv)
+    pv.sign_vote(CHAIN_ID, vote)
+    assert pv.get_pub_key().verify(vote.sign_bytes(CHAIN_ID), vote.signature)
+    # state persisted before signature release
+    reloaded = load_file_pv(kf, sf)
+    assert reloaded.last_sign_state.height == 1
+    assert reloaded.last_sign_state.signature == vote.signature
+
+
+def test_same_vote_rebroadcast_reuses_signature(tmp_path):
+    pv = load_or_gen_file_pv(*paths(tmp_path))
+    v1 = make_vote(pv)
+    pv.sign_vote(CHAIN_ID, v1)
+    v2 = make_vote(pv)
+    pv.sign_vote(CHAIN_ID, v2)
+    assert v2.signature == v1.signature
+
+
+def test_same_hrs_differs_only_by_timestamp_reuses(tmp_path):
+    pv = load_or_gen_file_pv(*paths(tmp_path))
+    v1 = make_vote(pv, ts=1000)
+    pv.sign_vote(CHAIN_ID, v1)
+    v2 = make_vote(pv, ts=999_999)
+    pv.sign_vote(CHAIN_ID, v2)
+    # signature AND timestamp come from the persisted state
+    assert v2.signature == v1.signature
+    assert v2.timestamp_ns == 1000
+
+
+def test_same_hrs_different_block_refused(tmp_path):
+    pv = load_or_gen_file_pv(*paths(tmp_path))
+    pv.sign_vote(CHAIN_ID, make_vote(pv, block_id=make_block_id(1)))
+    with pytest.raises(ErrDoubleSign):
+        pv.sign_vote(CHAIN_ID, make_vote(pv, block_id=make_block_id(7)))
+
+
+def test_hrs_regressions_refused(tmp_path):
+    pv = load_or_gen_file_pv(*paths(tmp_path))
+    pv.sign_vote(CHAIN_ID, make_vote(pv, vtype=PRECOMMIT_TYPE, height=2, round_=1))
+    assert pv.last_sign_state.step == STEP_PRECOMMIT
+    with pytest.raises(ErrDoubleSign):  # height regression
+        pv.sign_vote(CHAIN_ID, make_vote(pv, height=1, round_=5))
+    with pytest.raises(ErrDoubleSign):  # round regression
+        pv.sign_vote(CHAIN_ID, make_vote(pv, height=2, round_=0))
+    with pytest.raises(ErrDoubleSign):  # step regression (prevote after precommit)
+        pv.sign_vote(CHAIN_ID, make_vote(pv, vtype=PREVOTE_TYPE, height=2, round_=1))
+    # advancing is fine
+    pv.sign_vote(CHAIN_ID, make_vote(pv, height=3))
+
+
+def test_double_sign_protection_survives_restart(tmp_path):
+    kf, sf = paths(tmp_path)
+    pv = load_or_gen_file_pv(kf, sf)
+    pv.sign_vote(CHAIN_ID, make_vote(pv, block_id=make_block_id(1)))
+    # "crash" and reload from disk
+    pv2 = load_file_pv(kf, sf)
+    with pytest.raises(ErrDoubleSign):
+        pv2.sign_vote(CHAIN_ID, make_vote(pv2, block_id=make_block_id(9)))
+    # but the identical vote still re-signs to the same signature
+    v = make_vote(pv2, block_id=make_block_id(1))
+    pv2.sign_vote(CHAIN_ID, v)
+    assert pv2.get_pub_key().verify(v.sign_bytes(CHAIN_ID), v.signature)
+
+
+def test_proposal_signing_and_step_order(tmp_path):
+    pv = load_or_gen_file_pv(*paths(tmp_path))
+    prop = Proposal(
+        height=1, round=0, pol_round=-1, block_id=make_block_id(), timestamp_ns=500
+    )
+    pv.sign_proposal(CHAIN_ID, prop)
+    assert pv.get_pub_key().verify(prop.sign_bytes(CHAIN_ID), prop.signature)
+    # vote at same H/R allowed after proposal (step 1 → 2)
+    pv.sign_vote(CHAIN_ID, make_vote(pv))
+    # proposal after vote at same H/R refused (step 2 → 1)
+    with pytest.raises(ErrDoubleSign):
+        pv.sign_proposal(CHAIN_ID, prop)
+
+
+def test_reset_wipes_state(tmp_path):
+    kf, sf = paths(tmp_path)
+    pv = load_or_gen_file_pv(kf, sf)
+    pv.sign_vote(CHAIN_ID, make_vote(pv, height=10))
+    pv.reset()
+    pv2 = load_file_pv(kf, sf)
+    assert pv2.last_sign_state.height == 0
+    pv2.sign_vote(CHAIN_ID, make_vote(pv2, height=1))
