@@ -1,0 +1,5 @@
+"""Consensus: the Tendermint BFT state machine, WAL, and replay.
+
+Reference layer L5 (SURVEY.md §1): consensus/ — State (state.go:75),
+gossip reactor (reactor.go:38), WAL (wal.go:64), replay (replay.go:200).
+"""

@@ -1,0 +1,166 @@
+"""HeightVoteSet: all VoteSets (prevote+precommit per round) for one height.
+
+Reference: consensus/types/height_vote_set.go — HeightVoteSet :38,
+SetRound :84, AddVote :109, POLInfo :163, SetPeerMaj23 :185; peers may
+create at most 2 catchup rounds beyond current (:24-30,:121-132).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+from tendermint_tpu.codec.signbytes import PRECOMMIT_TYPE, PREVOTE_TYPE
+from tendermint_tpu.types.block import BlockID
+from tendermint_tpu.types.validator_set import ValidatorSet
+from tendermint_tpu.types.vote import Vote
+from tendermint_tpu.types.vote_set import VoteSet
+
+
+class ErrGotVoteFromUnwantedRound(Exception):
+    """Peer sent a vote for an unwanted round (reference
+    GotVoteFromUnwantedRoundError :222)."""
+
+
+class _RoundVoteSet:
+    __slots__ = ("prevotes", "precommits")
+
+    def __init__(self, prevotes: VoteSet, precommits: VoteSet):
+        self.prevotes = prevotes
+        self.precommits = precommits
+
+
+class HeightVoteSet:
+    def __init__(
+        self,
+        chain_id: str,
+        height: int,
+        val_set: ValidatorSet,
+        provider=None,
+    ):
+        self.chain_id = chain_id
+        self.height = height
+        self.val_set = val_set
+        self.provider = provider
+        self.round = 0
+        self._round_vote_sets: Dict[int, _RoundVoteSet] = {}
+        self._peer_catchup_rounds: Dict[str, List[int]] = {}
+        self._add_round(0)
+        self._add_round(1)
+
+    # -- round management --------------------------------------------------
+
+    def set_round(self, round_: int) -> None:
+        """Create missing round vote sets up to round_+1 (reference
+        SetRound :84)."""
+        new_round = max(self.round - 1, 0)
+        if self.round != 0 and round_ < new_round:
+            raise ValueError("SetRound() must increment round")
+        for r in range(new_round, round_ + 2):
+            if r not in self._round_vote_sets:
+                self._add_round(r)
+        self.round = round_
+
+    def _add_round(self, round_: int) -> None:
+        if round_ in self._round_vote_sets:
+            raise ValueError(f"add_round for existing round {round_}")
+        self._round_vote_sets[round_] = _RoundVoteSet(
+            prevotes=VoteSet(
+                self.chain_id, self.height, round_, PREVOTE_TYPE, self.val_set,
+                provider=self.provider,
+            ),
+            precommits=VoteSet(
+                self.chain_id, self.height, round_, PRECOMMIT_TYPE, self.val_set,
+                provider=self.provider,
+            ),
+        )
+
+    # -- adding ------------------------------------------------------------
+
+    def add_vote(self, vote: Vote, peer_id: str = "") -> bool:
+        """Add a vote; creates catchup-round sets for peers (max 2 rounds
+        per peer, reference :121-132). Raises on invalid votes, returns
+        False for unwanted rounds from over-quota peers."""
+        added, err = self.add_votes_batched([vote], peer_id=peer_id)
+        if err is not None:
+            raise err
+        return added[0]
+
+    def add_votes_batched(
+        self, votes: List[Vote], peer_id: str = ""
+    ) -> Tuple[List[bool], Optional[Exception]]:
+        """Batched ingest: group by (round,type) VoteSet, each group drains
+        through one device call (VoteSet.add_votes_batched)."""
+        added = [False] * len(votes)
+        first_err: Optional[Exception] = None
+        groups: Dict[Tuple[int, int], List[Tuple[int, Vote]]] = {}
+        for k, vote in enumerate(votes):
+            vs = self._vote_set_for(vote, peer_id)
+            if vs is None:
+                if first_err is None:
+                    first_err = ErrGotVoteFromUnwantedRound(
+                        f"round {vote.round} from peer {peer_id!r}"
+                    )
+                continue
+            groups.setdefault((vote.round, vote.vote_type), []).append((k, vote))
+        for (round_, vtype), items in groups.items():
+            vs = self._get_vote_set(round_, vtype)
+            flags, err = vs.add_votes_batched([v for _, v in items])
+            if err is not None and first_err is None:
+                first_err = err
+            for (k, _), f in zip(items, flags):
+                added[k] = f
+        return added, first_err
+
+    def _vote_set_for(self, vote: Vote, peer_id: str) -> Optional[VoteSet]:
+        if not (PREVOTE_TYPE == vote.vote_type or PRECOMMIT_TYPE == vote.vote_type):
+            return None
+        vs = self._get_vote_set(vote.round, vote.vote_type)
+        if vs is not None:
+            return vs
+        # unknown round: peers get up to 2 catchup rounds
+        rounds = self._peer_catchup_rounds.setdefault(peer_id, [])
+        if vote.round in rounds:
+            pass  # already allocated by this peer
+        elif len(rounds) < 2:
+            rounds.append(vote.round)
+        else:
+            return None
+        self._add_round(vote.round)
+        return self._get_vote_set(vote.round, vote.vote_type)
+
+    # -- accessors ---------------------------------------------------------
+
+    def _get_vote_set(self, round_: int, vote_type: int) -> Optional[VoteSet]:
+        rvs = self._round_vote_sets.get(round_)
+        if rvs is None:
+            return None
+        return rvs.prevotes if vote_type == PREVOTE_TYPE else rvs.precommits
+
+    def prevotes(self, round_: int) -> Optional[VoteSet]:
+        return self._get_vote_set(round_, PREVOTE_TYPE)
+
+    def precommits(self, round_: int) -> Optional[VoteSet]:
+        return self._get_vote_set(round_, PRECOMMIT_TYPE)
+
+    def pol_info(self) -> Tuple[int, Optional[BlockID]]:
+        """Highest round with a prevote +2/3 (reference POLInfo :163).
+        Returns (-1, None) if none."""
+        for r in range(self.round, -1, -1):
+            vs = self.prevotes(r)
+            if vs is not None:
+                block_id, ok = vs.two_thirds_majority()
+                if ok:
+                    return r, block_id
+        return -1, None
+
+    def set_peer_maj23(self, round_: int, vote_type: int, peer_id: str, block_id: BlockID) -> None:
+        """Reference SetPeerMaj23 :185."""
+        if vote_type not in (PREVOTE_TYPE, PRECOMMIT_TYPE):
+            raise ValueError(f"invalid vote type {vote_type}")
+        vs = self._get_vote_set(round_, vote_type)
+        if vs is None:
+            return
+        vs.set_peer_maj23(peer_id, block_id)
+
+    def __repr__(self) -> str:
+        return f"HeightVoteSet{{H:{self.height} R:{self.round} rounds:{sorted(self._round_vote_sets)}}}"

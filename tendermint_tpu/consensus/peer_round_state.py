@@ -1,0 +1,52 @@
+"""PeerRoundState: what we know about a peer's consensus state.
+
+Reference: consensus/types/peer_round_state.go:12. Maintained by the
+consensus reactor per peer, driven by NewRoundStep/HasVote/
+NewValidBlock/VoteSetBits messages; read by the gossip routines to pick
+what to send.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional
+
+from tendermint_tpu.types.block import BlockID, PartSetHeader
+from tendermint_tpu.utils.bits import BitArray
+
+
+@dataclass
+class PeerRoundState:
+    height: int = 0
+    round: int = -1
+    step: int = 0
+    start_time_ns: int = 0
+
+    proposal: bool = False  # peer has the proposal for this round
+    proposal_block_parts_header: Optional[PartSetHeader] = None
+    proposal_block_parts: Optional[BitArray] = None
+    proposal_pol_round: int = -1
+    proposal_pol: Optional[BitArray] = None  # nil until ProposalPOLMessage received
+
+    prevotes: Optional[BitArray] = None
+    precommits: Optional[BitArray] = None
+    last_commit_round: int = -1
+    last_commit: Optional[BitArray] = None
+    catchup_commit_round: int = -1
+    catchup_commit: Optional[BitArray] = None
+
+    def get_round_votes_bit_array(self, round_: int, vote_type: int) -> Optional[BitArray]:
+        """BitArray of votes we believe the peer has for height/round
+        (reference PeerState.getVoteBitArray consensus/reactor.go:893)."""
+        from tendermint_tpu.codec.signbytes import PRECOMMIT_TYPE, PREVOTE_TYPE
+
+        if self.round == round_:
+            return self.prevotes if vote_type == PREVOTE_TYPE else self.precommits
+        if self.catchup_commit_round == round_ and vote_type == PRECOMMIT_TYPE:
+            return self.catchup_commit
+        if self.proposal_pol_round == round_ and vote_type == PREVOTE_TYPE:
+            return self.proposal_pol
+        return None
+
+    def __repr__(self) -> str:
+        return f"PeerRoundState{{{self.height}/{self.round}/{self.step}}}"

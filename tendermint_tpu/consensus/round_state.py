@@ -1,0 +1,111 @@
+"""RoundState: the consensus-internal state snapshot for one height.
+
+Reference: consensus/types/round_state.go — RoundStepType :12-36,
+RoundState :67. All mutation happens on the single consensus task; the
+reactor reads copies.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import TYPE_CHECKING, Optional
+
+if TYPE_CHECKING:
+    from tendermint_tpu.consensus.height_vote_set import HeightVoteSet
+    from tendermint_tpu.types.block import Block
+    from tendermint_tpu.types.part_set import PartSet
+    from tendermint_tpu.types.proposal import Proposal
+    from tendermint_tpu.types.validator_set import ValidatorSet
+    from tendermint_tpu.types.vote_set import VoteSet
+
+# RoundStepType (reference round_state.go:12-24)
+STEP_NEW_HEIGHT = 1  # wait til commit_time + timeout_commit
+STEP_NEW_ROUND = 2  # setup new round and go to Propose
+STEP_PROPOSE = 3  # did propose, gossip proposal
+STEP_PREVOTE = 4  # did prevote, gossip prevotes
+STEP_PREVOTE_WAIT = 5  # did receive any +2/3 prevotes, wait for more
+STEP_PRECOMMIT = 6  # did precommit, gossip precommits
+STEP_PRECOMMIT_WAIT = 7  # did receive any +2/3 precommits, wait for more
+STEP_COMMIT = 8  # entered commit state machine
+
+_STEP_NAMES = {
+    STEP_NEW_HEIGHT: "NewHeight",
+    STEP_NEW_ROUND: "NewRound",
+    STEP_PROPOSE: "Propose",
+    STEP_PREVOTE: "Prevote",
+    STEP_PREVOTE_WAIT: "PrevoteWait",
+    STEP_PRECOMMIT: "Precommit",
+    STEP_PRECOMMIT_WAIT: "PrecommitWait",
+    STEP_COMMIT: "Commit",
+}
+
+
+def step_name(step: int) -> str:
+    return _STEP_NAMES.get(step, f"Unknown({step})")
+
+
+@dataclass
+class RoundState:
+    """Reference RoundState consensus/types/round_state.go:67."""
+
+    height: int = 0
+    round: int = 0
+    step: int = STEP_NEW_HEIGHT
+    start_time_ns: int = 0  # when the round started (height start for NewHeight)
+    commit_time_ns: int = 0  # when +2/3 commit was found
+
+    validators: Optional["ValidatorSet"] = None
+    proposal: Optional["Proposal"] = None
+    proposal_block: Optional["Block"] = None
+    proposal_block_parts: Optional["PartSet"] = None
+
+    locked_round: int = -1
+    locked_block: Optional["Block"] = None
+    locked_block_parts: Optional["PartSet"] = None
+
+    # Last known round with POL for non-nil valid block (reference :84-92);
+    # valid_* track the most recent +2/3 prevoted block.
+    valid_round: int = -1
+    valid_block: Optional["Block"] = None
+    valid_block_parts: Optional["PartSet"] = None
+
+    votes: Optional["HeightVoteSet"] = None
+    commit_round: int = -1
+    last_commit: Optional["VoteSet"] = None  # precommits for height-1
+    last_validators: Optional["ValidatorSet"] = None
+    triggered_timeout_precommit: bool = False
+
+    # -- helpers (reference round_state.go:110-142) ------------------------
+
+    def height_round_step(self) -> str:
+        return f"{self.height}/{self.round}/{step_name(self.step)}"
+
+    def proposal_block_id(self):
+        """BlockID of the current proposal block, if complete."""
+        from tendermint_tpu.types.block import BlockID
+
+        if self.proposal_block is None or self.proposal_block_parts is None:
+            return None
+        return BlockID(
+            hash=self.proposal_block.hash(),
+            parts=self.proposal_block_parts.header(),
+        )
+
+    def is_proposal_complete(self) -> bool:
+        """Reference isProposalComplete consensus/state.go:1018: proposal
+        present, block complete, and if POL round set, the POL prevotes
+        must have +2/3."""
+        if self.proposal is None or self.proposal_block is None:
+            return False
+        if self.proposal.pol_round < 0:
+            return True
+        assert self.votes is not None
+        return self.votes.prevotes(self.proposal.pol_round).has_two_thirds_majority()
+
+    def __repr__(self) -> str:
+        return f"RoundState{{{self.height_round_step()}}}"
+
+
+def now_ns() -> int:
+    return time.time_ns()

@@ -1,0 +1,106 @@
+"""HeightVoteSet round tracking + peer catchup quota.
+
+Mirrors reference consensus/types/height_vote_set_test.go.
+"""
+
+import pytest
+
+from tendermint_tpu.codec.signbytes import PRECOMMIT_TYPE, PREVOTE_TYPE
+from tendermint_tpu.consensus.height_vote_set import HeightVoteSet
+from tendermint_tpu.crypto.keys import Ed25519PrivKey
+from tendermint_tpu.types.block import BlockID, PartSetHeader
+from tendermint_tpu.types.validator import Validator
+from tendermint_tpu.types.validator_set import ValidatorSet
+from tendermint_tpu.types.vote import Vote
+
+CHAIN = "test-chain-hvs"
+BID = BlockID(hash=b"\x55" * 32, parts=PartSetHeader(total=1, hash=b"\x56" * 32))
+
+
+def setup(n=4):
+    privs = [Ed25519PrivKey.from_secret(f"hvs{i}".encode()) for i in range(n)]
+    vs = ValidatorSet([Validator(p.pub_key(), 10) for p in privs])
+    by_addr = {p.pub_key().address(): p for p in privs}
+    ordered = [by_addr[v.address] for v in vs.validators]
+    return HeightVoteSet(CHAIN, 1, vs), ordered
+
+
+def vote(priv, idx, round_, vtype=PREVOTE_TYPE, block_id=BID, ts=1000):
+    v = Vote(
+        vote_type=vtype,
+        height=1,
+        round=round_,
+        block_id=block_id,
+        timestamp_ns=ts,
+        validator_address=priv.pub_key().address(),
+        validator_index=idx,
+    )
+    v.signature = priv.sign(v.sign_bytes(CHAIN))
+    return v
+
+
+def test_current_and_next_round_accepted():
+    hvs, privs = setup()
+    assert hvs.add_vote(vote(privs[0], 0, 0))
+    assert hvs.add_vote(vote(privs[0], 0, 1))  # round+1 pre-created
+    assert hvs.prevotes(0).size() == 4
+    assert hvs.precommits(0) is not None
+
+
+def test_duplicate_not_added():
+    hvs, privs = setup()
+    v = vote(privs[0], 0, 0)
+    assert hvs.add_vote(v)
+    assert not hvs.add_vote(v)  # benign duplicate → added=False, no error
+
+
+def test_peer_catchup_round_quota():
+    """A peer may open at most 2 unwanted rounds (reference test)."""
+    hvs, privs = setup()
+    assert hvs.add_vote(vote(privs[0], 0, 5), peer_id="peer1")
+    assert hvs.add_vote(vote(privs[1], 1, 6), peer_id="peer1")
+    # third new round from same peer → unwanted-round error
+    with pytest.raises(Exception):
+        hvs.add_vote(vote(privs[2], 2, 7), peer_id="peer1")
+    # but another peer can still open it
+    assert hvs.add_vote(vote(privs[2], 2, 7), peer_id="peer2")
+
+
+def test_set_round_creates_sets():
+    hvs, privs = setup()
+    hvs.set_round(3)
+    for r in range(0, 5):
+        assert hvs.prevotes(r) is not None
+        assert hvs.precommits(r) is not None
+    assert hvs.add_vote(vote(privs[0], 0, 4))  # round+1 of new current
+
+
+def test_pol_info_finds_highest_polka_round():
+    hvs, privs = setup()
+    hvs.set_round(2)
+    assert hvs.pol_info() == (-1, None)
+    for i in range(3):
+        hvs.add_vote(vote(privs[i], i, 1))
+    r, bid = hvs.pol_info()
+    assert r == 1 and bid == BID
+
+
+def test_batched_ingest_groups_rounds_and_types():
+    hvs, privs = setup()
+    hvs.set_round(1)
+    votes = (
+        [vote(privs[i], i, 0) for i in range(3)]
+        + [vote(privs[i], i, 1) for i in range(3)]
+        + [vote(privs[i], i, 0, vtype=PRECOMMIT_TYPE) for i in range(3)]
+    )
+    added, err = hvs.add_votes_batched(votes)
+    assert err is None and all(added)
+    assert hvs.prevotes(0).has_two_thirds_majority()
+    assert hvs.prevotes(1).has_two_thirds_majority()
+    assert hvs.precommits(0).has_two_thirds_majority()
+
+
+def test_set_peer_maj23_routes():
+    hvs, privs = setup()
+    hvs.set_peer_maj23(0, PREVOTE_TYPE, "p", BID)
+    assert hvs.prevotes(0).peer_maj23s["p"] == BID
