@@ -1,0 +1,300 @@
+"""Consensus wire/WAL messages.
+
+Reference: consensus/reactor.go:1389 region (NewRoundStep, NewValidBlock,
+Proposal, ProposalPOL, BlockPart, Vote, HasVote, VoteSetMaj23,
+VoteSetBits messages registered in consensus/codec.go) and
+consensus/wal.go:36-58 (msgInfo, timeoutInfo, EndHeightMessage).
+
+Encoding is the deterministic length-prefixed binary codec used
+everywhere in this tree (codec/binary.py), one type-tag byte per
+message.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional
+
+from tendermint_tpu.codec.binary import Reader, Writer
+from tendermint_tpu.types.block import BlockID, PartSetHeader
+from tendermint_tpu.types.part_set import Part
+from tendermint_tpu.types.proposal import Proposal
+from tendermint_tpu.types.vote import Vote
+from tendermint_tpu.utils.bits import BitArray
+
+# type tags
+T_NEW_ROUND_STEP = 0x01
+T_NEW_VALID_BLOCK = 0x02
+T_PROPOSAL = 0x03
+T_PROPOSAL_POL = 0x04
+T_BLOCK_PART = 0x05
+T_VOTE = 0x06
+T_HAS_VOTE = 0x07
+T_VOTE_SET_MAJ23 = 0x08
+T_VOTE_SET_BITS = 0x09
+# WAL-only
+T_MSG_INFO = 0x20
+T_TIMEOUT_INFO = 0x21
+T_END_HEIGHT = 0x22
+
+
+def _w_bits(w: Writer, b: Optional[BitArray]) -> None:
+    if b is None:
+        w.write_bool(False)
+    else:
+        w.write_bool(True)
+        w.write_uvarint(len(b))
+        w.write_bytes(b.to_bytes())
+
+
+def _r_bits(r: Reader) -> Optional[BitArray]:
+    if not r.read_bool():
+        return None
+    n = r.read_uvarint()
+    return BitArray.from_bytes(r.read_bytes(), n)
+
+
+@dataclass
+class NewRoundStepMessage:
+    """Reference NewRoundStepMessage consensus/reactor.go:1389."""
+
+    height: int
+    round: int
+    step: int
+    seconds_since_start_time: int
+    last_commit_round: int
+
+    def encode_body(self, w: Writer) -> None:
+        w.write_u64(self.height).write_i64(self.round).write_u8(self.step)
+        w.write_i64(self.seconds_since_start_time).write_i64(self.last_commit_round)
+
+    @classmethod
+    def decode_body(cls, r: Reader) -> "NewRoundStepMessage":
+        return cls(r.read_u64(), r.read_i64(), r.read_u8(), r.read_i64(), r.read_i64())
+
+
+@dataclass
+class NewValidBlockMessage:
+    """Reference NewValidBlockMessage consensus/reactor.go:1404."""
+
+    height: int
+    round: int
+    block_parts_header: PartSetHeader
+    block_parts: BitArray
+    is_commit: bool
+
+    def encode_body(self, w: Writer) -> None:
+        w.write_u64(self.height).write_i64(self.round)
+        w.write_u32(self.block_parts_header.total).write_bytes(self.block_parts_header.hash)
+        _w_bits(w, self.block_parts)
+        w.write_bool(self.is_commit)
+
+    @classmethod
+    def decode_body(cls, r: Reader) -> "NewValidBlockMessage":
+        h = r.read_u64()
+        rd = r.read_i64()
+        psh = PartSetHeader(total=r.read_u32(), hash=r.read_bytes())
+        bits = _r_bits(r)
+        return cls(h, rd, psh, bits, r.read_bool())
+
+
+@dataclass
+class ProposalMessage:
+    proposal: Proposal
+
+    def encode_body(self, w: Writer) -> None:
+        w.write_bytes(self.proposal.encode())
+
+    @classmethod
+    def decode_body(cls, r: Reader) -> "ProposalMessage":
+        return cls(Proposal.decode(r.read_bytes()))
+
+
+@dataclass
+class ProposalPOLMessage:
+    """Reference ProposalPOLMessage consensus/reactor.go:1434."""
+
+    height: int
+    proposal_pol_round: int
+    proposal_pol: BitArray
+
+    def encode_body(self, w: Writer) -> None:
+        w.write_u64(self.height).write_i64(self.proposal_pol_round)
+        _w_bits(w, self.proposal_pol)
+
+    @classmethod
+    def decode_body(cls, r: Reader) -> "ProposalPOLMessage":
+        return cls(r.read_u64(), r.read_i64(), _r_bits(r))
+
+
+@dataclass
+class BlockPartMessage:
+    height: int
+    round: int
+    part: Part
+
+    def encode_body(self, w: Writer) -> None:
+        w.write_u64(self.height).write_i64(self.round)
+        w.write_bytes(self.part.encode())
+
+    @classmethod
+    def decode_body(cls, r: Reader) -> "BlockPartMessage":
+        return cls(r.read_u64(), r.read_i64(), Part.decode(r.read_bytes()))
+
+
+@dataclass
+class VoteMessage:
+    vote: Vote
+
+    def encode_body(self, w: Writer) -> None:
+        w.write_bytes(self.vote.encode())
+
+    @classmethod
+    def decode_body(cls, r: Reader) -> "VoteMessage":
+        return cls(Vote.decode(r.read_bytes()))
+
+
+@dataclass
+class HasVoteMessage:
+    height: int
+    round: int
+    vote_type: int
+    index: int
+
+    def encode_body(self, w: Writer) -> None:
+        w.write_u64(self.height).write_i64(self.round).write_u8(self.vote_type)
+        w.write_i64(self.index)
+
+    @classmethod
+    def decode_body(cls, r: Reader) -> "HasVoteMessage":
+        return cls(r.read_u64(), r.read_i64(), r.read_u8(), r.read_i64())
+
+
+@dataclass
+class VoteSetMaj23Message:
+    height: int
+    round: int
+    vote_type: int
+    block_id: BlockID
+
+    def encode_body(self, w: Writer) -> None:
+        w.write_u64(self.height).write_i64(self.round).write_u8(self.vote_type)
+        w.write_bytes(self.block_id.encode())
+
+    @classmethod
+    def decode_body(cls, r: Reader) -> "VoteSetMaj23Message":
+        return cls(r.read_u64(), r.read_i64(), r.read_u8(), BlockID.decode(r.read_bytes()))
+
+
+@dataclass
+class VoteSetBitsMessage:
+    height: int
+    round: int
+    vote_type: int
+    block_id: BlockID
+    votes: Optional[BitArray]
+
+    def encode_body(self, w: Writer) -> None:
+        w.write_u64(self.height).write_i64(self.round).write_u8(self.vote_type)
+        w.write_bytes(self.block_id.encode())
+        _w_bits(w, self.votes)
+
+    @classmethod
+    def decode_body(cls, r: Reader) -> "VoteSetBitsMessage":
+        return cls(
+            r.read_u64(), r.read_i64(), r.read_u8(),
+            BlockID.decode(r.read_bytes()), _r_bits(r),
+        )
+
+
+# -- WAL message wrappers (reference consensus/wal.go:36-58) ---------------
+
+
+@dataclass
+class MsgInfo:
+    """A consensus input message + where it came from ('' = internal)."""
+
+    msg: object
+    peer_id: str = ""
+
+    def encode_body(self, w: Writer) -> None:
+        w.write_str(self.peer_id)
+        w.write_bytes(encode_msg(self.msg))
+
+    @classmethod
+    def decode_body(cls, r: Reader) -> "MsgInfo":
+        peer = r.read_str()
+        return cls(decode_msg(r.read_bytes()), peer)
+
+
+@dataclass
+class TimeoutInfo:
+    """Reference timeoutInfo consensus/state.go:84."""
+
+    duration_ms: int
+    height: int
+    round: int
+    step: int
+
+    def encode_body(self, w: Writer) -> None:
+        w.write_i64(self.duration_ms).write_u64(self.height)
+        w.write_i64(self.round).write_u8(self.step)
+
+    @classmethod
+    def decode_body(cls, r: Reader) -> "TimeoutInfo":
+        return cls(r.read_i64(), r.read_u64(), r.read_i64(), r.read_u8())
+
+    def __repr__(self) -> str:
+        from tendermint_tpu.consensus.round_state import step_name
+
+        return f"TimeoutInfo{{{self.duration_ms}ms {self.height}/{self.round}/{step_name(self.step)}}}"
+
+
+@dataclass
+class EndHeightMessage:
+    """Written after a block is saved (reference consensus/wal.go:46)."""
+
+    height: int
+
+    def encode_body(self, w: Writer) -> None:
+        w.write_u64(self.height)
+
+    @classmethod
+    def decode_body(cls, r: Reader) -> "EndHeightMessage":
+        return cls(r.read_u64())
+
+
+_TAG_TO_CLS = {
+    T_NEW_ROUND_STEP: NewRoundStepMessage,
+    T_NEW_VALID_BLOCK: NewValidBlockMessage,
+    T_PROPOSAL: ProposalMessage,
+    T_PROPOSAL_POL: ProposalPOLMessage,
+    T_BLOCK_PART: BlockPartMessage,
+    T_VOTE: VoteMessage,
+    T_HAS_VOTE: HasVoteMessage,
+    T_VOTE_SET_MAJ23: VoteSetMaj23Message,
+    T_VOTE_SET_BITS: VoteSetBitsMessage,
+    T_MSG_INFO: MsgInfo,
+    T_TIMEOUT_INFO: TimeoutInfo,
+    T_END_HEIGHT: EndHeightMessage,
+}
+_CLS_TO_TAG = {cls: tag for tag, cls in _TAG_TO_CLS.items()}
+
+
+def encode_msg(msg) -> bytes:
+    tag = _CLS_TO_TAG.get(type(msg))
+    if tag is None:
+        raise TypeError(f"unregistered consensus message {type(msg).__name__}")
+    w = Writer()
+    w.write_u8(tag)
+    msg.encode_body(w)
+    return w.bytes()
+
+
+def decode_msg(data: bytes):
+    r = Reader(data)
+    tag = r.read_u8()
+    cls = _TAG_TO_CLS.get(tag)
+    if cls is None:
+        raise ValueError(f"unknown consensus message tag 0x{tag:02x}")
+    return cls.decode_body(r)

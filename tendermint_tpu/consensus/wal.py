@@ -1,0 +1,217 @@
+"""Consensus write-ahead log.
+
+Reference: consensus/wal.go — WAL interface :64, BaseWAL :75 over an
+autofile.Group, Write/WriteSync :184/:201, SearchForEndHeight :231,
+WALEncoder/WALDecoder :290 (4-byte CRC32c + 4-byte length framing,
+maxMsgSizeBytes 1MB), corruption-tolerant decode (DataCorruptionError)
+and wal_repair semantics (truncate at first corrupt record).
+
+Every consensus input is written BEFORE it is processed; internal
+messages and the ENDHEIGHT sentinel are fsync'd (WriteSync) so a crash
+can always be replayed deterministically from the last ENDHEIGHT.
+"""
+
+from __future__ import annotations
+
+import os
+import struct
+import zlib
+from typing import Iterator, Optional, Tuple
+
+from tendermint_tpu.consensus.messages import EndHeightMessage, decode_msg, encode_msg
+from tendermint_tpu.utils.log import get_logger
+
+MAX_MSG_SIZE = 1 << 20  # 1MB, reference wal.go maxMsgSizeBytes
+_HEADER = struct.Struct(">II")  # crc32, length
+
+
+class DataCorruptionError(Exception):
+    """CRC mismatch / truncated record (reference DataCorruptionError)."""
+
+
+class WALWriteError(Exception):
+    pass
+
+
+def _frame(data: bytes) -> bytes:
+    if len(data) > MAX_MSG_SIZE:
+        raise WALWriteError(f"msg is too big: {len(data)} > {MAX_MSG_SIZE}")
+    return _HEADER.pack(zlib.crc32(data) & 0xFFFFFFFF, len(data)) + data
+
+
+def _iter_records(fp) -> Iterator[Tuple[int, bytes]]:
+    """Yield (offset, payload). Raises DataCorruptionError on bad CRC or
+    over-size; stops cleanly at EOF/truncated tail header."""
+    while True:
+        offset = fp.tell()
+        hdr = fp.read(_HEADER.size)
+        if len(hdr) < _HEADER.size:
+            return  # clean EOF or truncated header → end of useful log
+        crc, length = _HEADER.unpack(hdr)
+        if length > MAX_MSG_SIZE:
+            raise DataCorruptionError(f"length {length} exceeds max at {offset}")
+        data = fp.read(length)
+        if len(data) < length:
+            raise DataCorruptionError(f"truncated record at {offset}")
+        if (zlib.crc32(data) & 0xFFFFFFFF) != crc:
+            raise DataCorruptionError(f"crc mismatch at {offset}")
+        yield offset, data
+
+
+class WAL:
+    """Interface (reference consensus/wal.go:64)."""
+
+    def write(self, msg) -> None:
+        raise NotImplementedError
+
+    def write_sync(self, msg) -> None:
+        raise NotImplementedError
+
+    def flush_and_sync(self) -> None:
+        raise NotImplementedError
+
+    def search_for_end_height(self, height: int):
+        raise NotImplementedError
+
+    def start(self) -> None:
+        pass
+
+    def stop(self) -> None:
+        pass
+
+
+class BaseWAL(WAL):
+    """File-backed WAL. The reference rotates via autofile.Group with
+    checkpoints; a single append-only file keeps identical crash
+    semantics (fsync ordering) — group rotation only bounds disk, which
+    `prune_to_height` covers by rewriting the tail."""
+
+    def __init__(self, path: str, logger=None):
+        self.path = path
+        self.logger = logger or get_logger("wal")
+        self._fp = None
+
+    def start(self) -> None:
+        os.makedirs(os.path.dirname(self.path) or ".", exist_ok=True)
+        # repair a corrupt tail before appending (reference: on decode
+        # error during catchup the WAL is truncated via wal_repair flow)
+        if os.path.exists(self.path):
+            self._truncate_corrupt_tail()
+        self._fp = open(self.path, "ab")
+        # a fresh WAL begins with ENDHEIGHT 0 (reference wal.go:108)
+        if self._fp.tell() == 0:
+            self.write_sync(EndHeightMessage(0))
+
+    def stop(self) -> None:
+        if self._fp is not None:
+            self.flush_and_sync()
+            self._fp.close()
+            self._fp = None
+
+    def _truncate_corrupt_tail(self) -> None:
+        good_end = 0
+        try:
+            with open(self.path, "rb") as fp:
+                for offset, data in _iter_records(fp):
+                    good_end = fp.tell()
+        except DataCorruptionError as e:
+            self.logger.error("WAL corrupt tail, truncating", err=str(e), keep=good_end)
+        size = os.path.getsize(self.path)
+        if good_end < size:
+            with open(self.path, "r+b") as fp:
+                fp.truncate(good_end)
+
+    # -- writing -----------------------------------------------------------
+
+    def write(self, msg) -> None:
+        """Buffered write (fsync deferred) — reference Write :184."""
+        if self._fp is None:
+            return
+        try:
+            self._fp.write(_frame(encode_msg(msg)))
+        except WALWriteError:
+            raise
+        except Exception as e:
+            raise WALWriteError(str(e))
+
+    def write_sync(self, msg) -> None:
+        """Write + flush + fsync before returning (reference WriteSync
+        :201) — used for internal messages and ENDHEIGHT."""
+        self.write(msg)
+        self.flush_and_sync()
+
+    def flush_and_sync(self) -> None:
+        if self._fp is None:
+            return
+        self._fp.flush()
+        os.fsync(self._fp.fileno())
+
+    # -- reading -----------------------------------------------------------
+
+    def iter_messages(self, strict: bool = True) -> Iterator[object]:
+        """Decode all messages. strict=False stops at the first corrupt
+        record instead of raising (crash-recovery read)."""
+        if not os.path.exists(self.path):
+            return
+        with open(self.path, "rb") as fp:
+            it = _iter_records(fp)
+            while True:
+                try:
+                    _, data = next(it)
+                except StopIteration:
+                    return
+                except DataCorruptionError:
+                    if strict:
+                        raise
+                    return
+                yield decode_msg(data)
+
+    def search_for_end_height(self, height: int) -> Tuple[Optional[list], bool]:
+        """Return (messages_after_ENDHEIGHT(height), found). The reference
+        returns a reader positioned after the sentinel
+        (SearchForEndHeight :231); we return the decoded tail."""
+        msgs_after: Optional[list] = None
+        for msg in self.iter_messages(strict=False):
+            if isinstance(msg, EndHeightMessage) and msg.height == height:
+                msgs_after = []
+            elif msgs_after is not None:
+                msgs_after.append(msg)
+        if msgs_after is None:
+            return None, False
+        return msgs_after, True
+
+    def prune_to_height(self, height: int) -> None:
+        """Drop records before ENDHEIGHT(height) — the disk-bounding
+        equivalent of autofile group rotation+checkpoint."""
+        msgs, found = self.search_for_end_height(height)
+        if not found:
+            return
+        was_open = self._fp is not None
+        if was_open:
+            self.stop()
+        tmp = self.path + ".pruned"
+        with open(tmp, "wb") as fp:
+            fp.write(_frame(encode_msg(EndHeightMessage(height))))
+            for m in msgs:
+                fp.write(_frame(encode_msg(m)))
+            fp.flush()
+            os.fsync(fp.fileno())
+        os.replace(tmp, self.path)
+        if was_open:
+            self._fp = open(self.path, "ab")
+
+
+class NilWAL(WAL):
+    """No-op WAL (reference nilWAL consensus/wal.go:404)."""
+
+    def write(self, msg) -> None:
+        pass
+
+    def write_sync(self, msg) -> None:
+        pass
+
+    def flush_and_sync(self) -> None:
+        pass
+
+    def search_for_end_height(self, height: int):
+        return None, False

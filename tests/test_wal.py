@@ -1,0 +1,161 @@
+"""WAL: framing, fsync write path, ENDHEIGHT search, corruption repair.
+
+Mirrors reference consensus/wal_test.go (TestWALWrite, TestWALSearchForEndHeight,
+TestWALTruncate flavor) + the wal_fuzz corruption tolerance.
+"""
+
+import os
+
+import pytest
+
+from tendermint_tpu.consensus.messages import (
+    EndHeightMessage,
+    MsgInfo,
+    TimeoutInfo,
+    VoteMessage,
+    decode_msg,
+    encode_msg,
+)
+from tendermint_tpu.consensus.wal import (
+    MAX_MSG_SIZE,
+    BaseWAL,
+    DataCorruptionError,
+    WALWriteError,
+    _frame,
+)
+from tendermint_tpu.types.block import BlockID, PartSetHeader
+from tendermint_tpu.types.vote import Vote
+
+
+def make_vote_msg(h=1, r=0) -> MsgInfo:
+    v = Vote(
+        vote_type=1,
+        height=h,
+        round=r,
+        block_id=BlockID(hash=b"\x11" * 32, parts=PartSetHeader(1, b"\x12" * 32)),
+        timestamp_ns=12345,
+        validator_address=b"\xaa" * 20,
+        validator_index=3,
+        signature=b"\x01" * 64,
+    )
+    return MsgInfo(VoteMessage(v), peer_id="peerX")
+
+
+def test_message_codec_round_trip():
+    for msg in (
+        make_vote_msg(),
+        TimeoutInfo(1500, 7, 2, 4),
+        EndHeightMessage(42),
+    ):
+        got = decode_msg(encode_msg(msg))
+        assert got == msg
+
+
+def test_write_and_read_back(tmp_path):
+    wal = BaseWAL(str(tmp_path / "wal"))
+    wal.start()
+    m1, m2 = make_vote_msg(1), TimeoutInfo(100, 1, 0, 3)
+    wal.write_sync(m1)
+    wal.write(m2)
+    wal.stop()
+    msgs = list(BaseWAL(str(tmp_path / "wal")).iter_messages())
+    # starts with the fresh-WAL ENDHEIGHT(0) sentinel
+    assert msgs[0] == EndHeightMessage(0)
+    assert msgs[1:] == [m1, m2]
+
+
+def test_oversize_message_refused(tmp_path):
+    wal = BaseWAL(str(tmp_path / "wal"))
+    wal.start()
+
+    class Huge:
+        pass
+
+    with pytest.raises(WALWriteError):
+        # frame() guards size; simulate via direct call
+        _frame(b"x" * (MAX_MSG_SIZE + 1))
+    wal.stop()
+
+
+def test_search_for_end_height(tmp_path):
+    wal = BaseWAL(str(tmp_path / "wal"))
+    wal.start()
+    for h in (1, 2, 3):
+        wal.write_sync(make_vote_msg(h))
+        wal.write_sync(EndHeightMessage(h))
+    tail = [make_vote_msg(4), TimeoutInfo(5, 4, 0, 3)]
+    for m in tail:
+        wal.write_sync(m)
+    wal.stop()
+
+    msgs, found = wal.search_for_end_height(2)
+    assert found
+    # everything after ENDHEIGHT(2): h3 vote, ENDHEIGHT(3), then the tail
+    assert msgs[0] == make_vote_msg(3)
+    assert msgs[1] == EndHeightMessage(3)
+    assert msgs[2:] == tail
+
+    _, found = wal.search_for_end_height(99)
+    assert not found
+
+
+def test_corrupt_tail_truncated_on_restart(tmp_path):
+    path = str(tmp_path / "wal")
+    wal = BaseWAL(path)
+    wal.start()
+    wal.write_sync(make_vote_msg(1))
+    wal.write_sync(EndHeightMessage(1))
+    wal.stop()
+    good_size = os.path.getsize(path)
+    # append garbage (simulates a crash mid-write)
+    with open(path, "ab") as fp:
+        fp.write(b"\xde\xad\xbe\xef" * 5)
+    # strict read must raise...
+    with pytest.raises(DataCorruptionError):
+        list(BaseWAL(path).iter_messages(strict=True))
+    # ...but restart repairs the tail and can append again
+    wal2 = BaseWAL(path)
+    wal2.start()
+    assert os.path.getsize(path) == good_size
+    wal2.write_sync(make_vote_msg(2))
+    wal2.stop()
+    msgs = list(BaseWAL(path).iter_messages())
+    assert msgs[-1] == make_vote_msg(2)
+
+
+def test_corrupt_middle_record_detected(tmp_path):
+    path = str(tmp_path / "wal")
+    wal = BaseWAL(path)
+    wal.start()
+    wal.write_sync(make_vote_msg(1))
+    wal.write_sync(make_vote_msg(2))
+    wal.stop()
+    # flip one byte inside the first vote's payload
+    with open(path, "r+b") as fp:
+        fp.seek(30)
+        b = fp.read(1)
+        fp.seek(30)
+        fp.write(bytes([b[0] ^ 0xFF]))
+    with pytest.raises(DataCorruptionError):
+        list(BaseWAL(path).iter_messages(strict=True))
+    # non-strict read stops before the corruption
+    msgs = list(BaseWAL(path).iter_messages(strict=False))
+    assert len(msgs) <= 1
+
+
+def test_prune_to_height(tmp_path):
+    path = str(tmp_path / "wal")
+    wal = BaseWAL(path)
+    wal.start()
+    for h in range(1, 6):
+        wal.write_sync(make_vote_msg(h))
+        wal.write_sync(EndHeightMessage(h))
+    wal.stop()
+    before = os.path.getsize(path)
+    wal.prune_to_height(4)
+    assert os.path.getsize(path) < before
+    msgs, found = wal.search_for_end_height(4)
+    assert found and msgs == [make_vote_msg(5), EndHeightMessage(5)]
+    # heights before the prune point are gone
+    _, found = wal.search_for_end_height(1)
+    assert not found
