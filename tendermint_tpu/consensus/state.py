@@ -1,0 +1,1024 @@
+"""The Tendermint BFT consensus state machine.
+
+Reference: consensus/state.go — State :75, receiveRoutine :602,
+handleMsg :678, handleTimeout :745, enterNewRound :815, enterPropose
+:895, defaultDecideProposal :968, enterPrevote :1063, defaultDoPrevote
+:1090, enterPrevoteWait :1137, enterPrecommit :1158, enterPrecommitWait
+:1262, enterCommit :1288, tryFinalizeCommit :1352, finalizeCommit :1381,
+defaultSetProposal :1599, addProposalBlockPart :1636, tryAddVote :1706,
+addVote :1751, signAddVote :1961.
+
+Concurrency model: ALL state transitions run on ONE asyncio task
+(`_receive_routine`) consuming a single FIFO queue of inputs — peer
+messages, our own (internal) messages, and fired timeouts. This is the
+reference's determinism-by-construction (consensus/state.go:602-675)
+with the queue merge made explicit. Every input is written to the WAL
+before it is processed; internal inputs and ENDHEIGHT are fsync'd.
+
+The decide_proposal / do_prevote / set_proposal function seams
+(reference consensus/state.go:124-126) stay overridable so byzantine
+tests can equivocate.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+from typing import Optional
+
+from tendermint_tpu.codec.signbytes import PRECOMMIT_TYPE, PREVOTE_TYPE
+from tendermint_tpu.consensus import round_state as rst
+from tendermint_tpu.consensus.height_vote_set import HeightVoteSet
+from tendermint_tpu.consensus.messages import (
+    BlockPartMessage,
+    EndHeightMessage,
+    MsgInfo,
+    ProposalMessage,
+    TimeoutInfo,
+    VoteMessage,
+)
+from tendermint_tpu.consensus.round_state import (
+    STEP_COMMIT,
+    STEP_NEW_HEIGHT,
+    STEP_NEW_ROUND,
+    STEP_PRECOMMIT,
+    STEP_PRECOMMIT_WAIT,
+    STEP_PREVOTE,
+    STEP_PREVOTE_WAIT,
+    STEP_PROPOSE,
+    RoundState,
+    step_name,
+)
+from tendermint_tpu.consensus.wal import WAL, BaseWAL, NilWAL
+from tendermint_tpu.privval.file import ErrDoubleSign
+from tendermint_tpu.state.state import State as SMState
+from tendermint_tpu.types.block import Block, BlockID, Commit
+from tendermint_tpu.types.part_set import PartSet
+from tendermint_tpu.types.proposal import Proposal
+from tendermint_tpu.types.vote import Vote
+from tendermint_tpu.types.vote_set import ErrVoteConflictingVotes, VoteSet
+from tendermint_tpu.utils import fail
+from tendermint_tpu.utils.events import EventSwitch
+from tendermint_tpu.utils.log import get_logger
+from tendermint_tpu.utils.service import Service
+
+# evsw event names (reference types/events.go internal eventswitch usage)
+EVENT_NEW_ROUND_STEP = "NewRoundStep"
+EVENT_VALID_BLOCK = "ValidBlock"
+EVENT_VOTE = "Vote"
+EVENT_HAS_VOTE = "HasVote"  # carries the added Vote, for reactor broadcast
+EVENT_COMMITTED = "Committed"
+
+
+def now_ns() -> int:
+    return time.time_ns()
+
+
+class ConsensusError(Exception):
+    pass
+
+
+class TimeoutTicker:
+    """One pending timeout at a time; a new schedule replaces the old
+    (reference consensus/ticker.go: timeoutRoutine overwrites the timer).
+    Fired timeouts land on the owner's input queue."""
+
+    def __init__(self, queue: asyncio.Queue):
+        self._queue = queue
+        self._timer: Optional[asyncio.TimerHandle] = None
+        self._pending: Optional[TimeoutInfo] = None
+
+    def schedule(self, ti: TimeoutInfo) -> None:
+        self.cancel()
+        self._pending = ti
+        loop = asyncio.get_running_loop()
+        self._timer = loop.call_later(max(ti.duration_ms, 0) / 1000.0, self._fire)
+
+    def _fire(self) -> None:
+        ti, self._pending, self._timer = self._pending, None, None
+        if ti is not None:
+            self._queue.put_nowait(ti)
+
+    def cancel(self) -> None:
+        if self._timer is not None:
+            self._timer.cancel()
+            self._timer = None
+        self._pending = None
+
+
+class ConsensusState(Service):
+    """Reference consensus.State (consensus/state.go:75)."""
+
+    def __init__(
+        self,
+        config,  # ConsensusConfig
+        state: SMState,
+        block_exec,
+        block_store,
+        mempool,
+        evidence_pool=None,
+        priv_validator=None,
+        event_bus=None,
+        wal: Optional[WAL] = None,
+        logger=None,
+    ):
+        super().__init__("consensus", logger=None)
+        self.logger = logger or get_logger("consensus")
+        self.config = config
+        self._block_exec = block_exec
+        self._block_store = block_store
+        self._mempool = mempool
+        self._evpool = evidence_pool
+        self._priv_validator = priv_validator
+        self._priv_validator_addr: Optional[bytes] = (
+            priv_validator.get_pub_key().address() if priv_validator else None
+        )
+        self.event_bus = event_bus
+        self.evsw = EventSwitch()
+
+        self.rs = RoundState()
+        self.state: SMState = SMState()  # set by update_to_state
+
+        # single merged input queue (MsgInfo | TimeoutInfo)
+        self._queue: asyncio.Queue = asyncio.Queue(maxsize=1000)
+        self.timeout_ticker = TimeoutTicker(self._queue)
+
+        self.wal: WAL = wal or NilWAL()
+        self.replay_mode = False  # catching up via WAL replay
+        self._done_first_block = asyncio.Event()
+        self.n_steps = 0  # transitions counter (reference nSteps, for tests)
+
+        # pluggable seams (reference state.go:124-126)
+        self.decide_proposal = self._default_decide_proposal
+        self.do_prevote = self._default_do_prevote
+        self.set_proposal = self._default_set_proposal
+
+        self.update_to_state(state)
+        self._reconstruct_last_commit_if_needed(state)
+
+    # ------------------------------------------------------------------
+    # lifecycle
+    # ------------------------------------------------------------------
+
+    async def on_start(self) -> None:
+        """Reference OnStart consensus/state.go:281: WAL catchup happens in
+        consensus.replay's catchup_replay before start; here we launch the
+        receive loop and schedule round 0."""
+        self.wal.start()
+        self.spawn(self._receive_routine())
+        self._schedule_round0()
+
+    async def on_stop(self) -> None:
+        self.timeout_ticker.cancel()
+        self.wal.stop()
+
+    # ------------------------------------------------------------------
+    # public input API (thread = event-loop safe: just enqueues)
+    # ------------------------------------------------------------------
+
+    def send_internal(self, msg) -> None:
+        """Our own proposals/parts/votes (reference sendInternalMessage)."""
+        self._queue.put_nowait(MsgInfo(msg, ""))
+
+    async def add_peer_message(self, msg, peer_id: str) -> None:
+        await self._queue.put(MsgInfo(msg, peer_id))
+
+    async def add_vote_from_peer(self, vote: Vote, peer_id: str) -> None:
+        await self.add_peer_message(VoteMessage(vote), peer_id)
+
+    def handle_txs_available(self) -> None:
+        """Mempool notification when create_empty_blocks=false (reference
+        handleTxsAvailable :731)."""
+        if not self.is_running:
+            return
+        if self.rs.step == STEP_NEW_HEIGHT:
+            # +1ms ensures we land after start_time
+            remaining_ms = max((self.rs.start_time_ns - now_ns()) // 1_000_000 + 1, 0)
+            self._schedule_timeout(remaining_ms, self.rs.height, 0, STEP_NEW_ROUND)
+        elif self.rs.step == STEP_NEW_ROUND:
+            asyncio.get_running_loop().create_task(
+                self._enter_propose(self.rs.height, 0)
+            )
+
+    async def wait_for_height(self, height: int, timeout_s: float = 30.0) -> None:
+        """Test/tooling helper: block until a height is committed."""
+        deadline = time.monotonic() + timeout_s
+        while self.state.last_block_height < height:
+            if time.monotonic() > deadline:
+                raise TimeoutError(
+                    f"height {height} not reached (at {self.state.last_block_height})"
+                )
+            await asyncio.sleep(0.01)
+
+    # ------------------------------------------------------------------
+    # state reset between heights
+    # ------------------------------------------------------------------
+
+    def update_to_state(self, state: SMState) -> None:
+        """Prepare RoundState for height state.last_block_height+1
+        (reference updateToState :499)."""
+        rs = self.rs
+        if rs.commit_round > -1 and 0 < rs.height and rs.height != state.last_block_height:
+            raise ConsensusError(
+                f"updateToState expected state height {rs.height}, got {state.last_block_height}"
+            )
+        if not self.state.is_empty() and self.state.last_block_height + 1 != rs.height:
+            raise ConsensusError(
+                f"inconsistent cs.state.LastBlockHeight+1 {self.state.last_block_height + 1} vs cs.Height {rs.height}"
+            )
+        # If state isn't further out than cs.state, just ignore (reference :517)
+        if not self.state.is_empty() and state.last_block_height <= self.state.last_block_height:
+            self.logger.info(
+                "ignoring updateToState()",
+                new_height=state.last_block_height + 1,
+                old_height=self.state.last_block_height + 1,
+            )
+            self._new_step()
+            return
+
+        # Reset fields based on state.
+        validators = state.validators
+        last_precommits: Optional[VoteSet] = None
+        if rs.commit_round > -1 and rs.votes is not None:
+            precommits = rs.votes.precommits(rs.commit_round)
+            if precommits is None or not precommits.has_two_thirds_majority():
+                raise ConsensusError("updateToState called with non-committed precommits")
+            last_precommits = precommits
+
+        height = state.last_block_height + 1
+        rs.height = height
+        rs.round = 0
+        rs.step = STEP_NEW_HEIGHT
+        if rs.commit_time_ns == 0:
+            rs.start_time_ns = now_ns() + int(self.config.commit_s() * 1e9)
+        else:
+            rs.start_time_ns = rs.commit_time_ns + int(self.config.commit_s() * 1e9)
+        rs.validators = validators
+        rs.proposal = None
+        rs.proposal_block = None
+        rs.proposal_block_parts = None
+        rs.locked_round = -1
+        rs.locked_block = None
+        rs.locked_block_parts = None
+        rs.valid_round = -1
+        rs.valid_block = None
+        rs.valid_block_parts = None
+        rs.votes = HeightVoteSet(state.chain_id, height, validators)
+        rs.commit_round = -1
+        rs.last_commit = last_precommits
+        rs.last_validators = state.last_validators
+        rs.triggered_timeout_precommit = False
+        rs.commit_time_ns = 0
+
+        self.state = state
+        self._new_step()
+
+    def _reconstruct_last_commit_if_needed(self, state: SMState) -> None:
+        """Rebuild rs.last_commit from the stored seen-commit after a
+        restart (reference reconstructLastCommit :470)."""
+        if state.last_block_height == 0 or self.rs.last_commit is not None:
+            return
+        seen = self._block_store.load_seen_commit(state.last_block_height) if self._block_store else None
+        if seen is None:
+            return
+        last_vals = (
+            self._block_exec.store().load_validators(state.last_block_height)
+            if hasattr(self._block_exec, "store")
+            else state.last_validators
+        )
+        if last_vals is None:
+            last_vals = state.last_validators
+        if last_vals is None:
+            return
+        vs = VoteSet(
+            state.chain_id, state.last_block_height, seen.round, PRECOMMIT_TYPE, last_vals
+        )
+        votes = []
+        for idx, cs_sig in enumerate(seen.signatures):
+            if cs_sig.absent_():
+                continue
+            votes.append(
+                Vote(
+                    vote_type=PRECOMMIT_TYPE,
+                    height=state.last_block_height,
+                    round=seen.round,
+                    block_id=cs_sig.block_id(seen.block_id),
+                    timestamp_ns=cs_sig.timestamp_ns,
+                    validator_address=cs_sig.validator_address,
+                    validator_index=idx,
+                    signature=cs_sig.signature,
+                )
+            )
+        added, err = vs.add_votes_batched(votes)
+        if err is not None or not vs.has_two_thirds_majority():
+            raise ConsensusError(f"failed to reconstruct LastCommit: {err}")
+        self.rs.last_commit = vs
+
+    def _new_step(self) -> None:
+        self.n_steps += 1
+        self.evsw.fire_event(EVENT_NEW_ROUND_STEP, self.rs)
+        if self.event_bus is not None and not self.replay_mode:
+            self._publish_soon(self.event_bus.publish_event_new_round_step(self.rs))
+
+    def _publish_soon(self, coro) -> None:
+        """Events are fire-and-forget; consensus never blocks on them."""
+        try:
+            asyncio.get_running_loop().create_task(coro)
+        except RuntimeError:
+            coro.close()  # no loop (constructor path): drop silently
+
+    # ------------------------------------------------------------------
+    # scheduling
+    # ------------------------------------------------------------------
+
+    def _schedule_round0(self) -> None:
+        sleep_ms = max((self.rs.start_time_ns - now_ns()) // 1_000_000, 0)
+        self._schedule_timeout(sleep_ms, self.rs.height, 0, STEP_NEW_HEIGHT)
+
+    def _schedule_timeout(self, duration_ms: int, height: int, round_: int, step: int) -> None:
+        self.timeout_ticker.schedule(TimeoutInfo(duration_ms, height, round_, step))
+
+    # ------------------------------------------------------------------
+    # the receive routine (reference receiveRoutine :602)
+    # ------------------------------------------------------------------
+
+    async def _receive_routine(self) -> None:
+        while True:
+            item = await self._queue.get()
+            try:
+                if isinstance(item, TimeoutInfo):
+                    self.wal.write(item)
+                    await self._handle_timeout(item)
+                elif isinstance(item, MsgInfo):
+                    if item.peer_id:
+                        self.wal.write(item)
+                    else:
+                        # internal: fsync before processing (reference :650)
+                        self.wal.write_sync(item)
+                    await self._handle_msg(item)
+                else:
+                    self.logger.error("unknown queue item", item=repr(item))
+            except asyncio.CancelledError:
+                raise
+            except Exception:
+                # Reference policy: consensus failure → halt, never limp
+                # (consensus/state.go:616-627). Log with stack and stop.
+                self.logger.exception("CONSENSUS FAILURE", rs=self.rs.height_round_step())
+                raise
+
+    async def _handle_msg(self, mi: MsgInfo) -> None:
+        msg, peer_id = mi.msg, mi.peer_id
+        if isinstance(msg, ProposalMessage):
+            await self.set_proposal(msg.proposal)
+        elif isinstance(msg, BlockPartMessage):
+            added = await self._add_proposal_block_part(msg, peer_id)
+            if added:
+                self.evsw.fire_event(EVENT_HAS_VOTE, None)  # wake gossip (block part)
+        elif isinstance(msg, VoteMessage):
+            await self._try_add_vote(msg.vote, peer_id)
+        else:
+            self.logger.error("unknown msg type", type=type(msg).__name__)
+
+    async def _handle_timeout(self, ti: TimeoutInfo) -> None:
+        """Reference handleTimeout :745."""
+        rs = self.rs
+        if ti.height != rs.height or ti.round < rs.round or (
+            ti.round == rs.round and ti.step < rs.step
+        ):
+            self.logger.debug("ignoring timeout for stale H/R/S", ti=repr(ti))
+            return
+        if ti.step == STEP_NEW_HEIGHT:
+            await self._enter_new_round(ti.height, 0)
+        elif ti.step == STEP_NEW_ROUND:
+            await self._enter_propose(ti.height, 0)
+        elif ti.step == STEP_PROPOSE:
+            if self.event_bus is not None and not self.replay_mode:
+                self._publish_soon(self.event_bus.publish_event_timeout_propose(rs))
+            await self._enter_prevote(ti.height, ti.round)
+        elif ti.step == STEP_PREVOTE_WAIT:
+            if self.event_bus is not None and not self.replay_mode:
+                self._publish_soon(self.event_bus.publish_event_timeout_wait(rs))
+            await self._enter_precommit(ti.height, ti.round)
+        elif ti.step == STEP_PRECOMMIT_WAIT:
+            if self.event_bus is not None and not self.replay_mode:
+                self._publish_soon(self.event_bus.publish_event_timeout_wait(rs))
+            await self._enter_precommit(ti.height, ti.round)
+            await self._enter_new_round(ti.height, ti.round + 1)
+        else:
+            raise ConsensusError(f"invalid timeout step {ti.step}")
+
+    # ------------------------------------------------------------------
+    # round entry functions
+    # ------------------------------------------------------------------
+
+    async def _enter_new_round(self, height: int, round_: int) -> None:
+        """Reference enterNewRound :815."""
+        rs = self.rs
+        if rs.height != height or round_ < rs.round or (
+            rs.round == round_ and rs.step != STEP_NEW_HEIGHT
+        ):
+            return
+        self.logger.info("enterNewRound", height=height, round=round_)
+
+        validators = rs.validators
+        if rs.round < round_:
+            validators = validators.copy()
+            validators.increment_proposer_priority(round_ - rs.round)
+        rs.validators = validators
+        rs.round = round_
+        rs.step = STEP_NEW_ROUND
+        if round_ != 0:
+            # round 0 keeps the proposal received during NewHeight
+            rs.proposal = None
+            rs.proposal_block = None
+            rs.proposal_block_parts = None
+        rs.triggered_timeout_precommit = False
+        rs.votes.set_round(round_ + 1)  # track next round too
+
+        if self.event_bus is not None and not self.replay_mode:
+            self._publish_soon(self.event_bus.publish_event_new_round(rs))
+        self._new_step()
+
+        wait_for_txs = (
+            not self.config.create_empty_blocks and round_ == 0 and not self._need_proof_block(height)
+        )
+        if wait_for_txs:
+            if self.config.create_empty_blocks_interval_ms > 0:
+                self._schedule_timeout(
+                    self.config.create_empty_blocks_interval_ms, height, round_, STEP_NEW_ROUND
+                )
+            # else: wait for handle_txs_available
+        else:
+            await self._enter_propose(height, round_)
+
+    def _need_proof_block(self, height: int) -> bool:
+        """App hash changed at the last block → must make a block so the
+        new app hash gets committed (reference needProofBlock :880)."""
+        if height == self.state.initial_height():
+            return True
+        last_meta = self._block_store.load_block_meta(height - 1) if self._block_store else None
+        if last_meta is None:
+            return False
+        return self.state.app_hash != last_meta.header.app_hash
+
+    async def _enter_propose(self, height: int, round_: int) -> None:
+        """Reference enterPropose :895."""
+        rs = self.rs
+        if rs.height != height or round_ < rs.round or (
+            rs.round == round_ and STEP_PROPOSE <= rs.step
+        ):
+            return
+        self.logger.debug("enterPropose", height=height, round=round_)
+
+        def done():
+            rs.round = round_
+            rs.step = STEP_PROPOSE
+            self._new_step()
+
+        try:
+            if self._priv_validator is not None and self._is_proposer(self._priv_validator_addr):
+                self.logger.info(
+                    "enterPropose: our turn to propose",
+                    proposer=self._priv_validator_addr.hex()[:12],
+                )
+                await self.decide_proposal(height, round_)
+        finally:
+            done()
+            # complete proposal may already be in (from gossip or ourselves)
+            if rs.is_proposal_complete():
+                await self._enter_prevote(height, rs.round)
+                return
+            self._schedule_timeout(
+                int(self.config.propose_s(round_) * 1000), height, round_, STEP_PROPOSE
+            )
+
+    def _is_proposer(self, address: Optional[bytes]) -> bool:
+        proposer = self.rs.validators.get_proposer()
+        return proposer is not None and address == proposer.address
+
+    async def _default_decide_proposal(self, height: int, round_: int) -> None:
+        """Reference defaultDecideProposal :968."""
+        rs = self.rs
+        if rs.valid_block is not None:
+            # If there is valid block, choose that (POL safety).
+            block, block_parts = rs.valid_block, rs.valid_block_parts
+        else:
+            block, block_parts = self._create_proposal_block()
+            if block is None:
+                return
+        # Flush WAL so our proposal is durable before broadcast.
+        self.wal.flush_and_sync()
+
+        block_id = BlockID(hash=block.hash(), parts=block_parts.header())
+        proposal = Proposal(
+            height=height, round=round_, pol_round=rs.valid_round,
+            block_id=block_id, timestamp_ns=now_ns(),
+        )
+        try:
+            self._priv_validator.sign_proposal(self.state.chain_id, proposal)
+        except Exception as e:
+            if not self.replay_mode:
+                self.logger.error("propose: error signing proposal", err=str(e))
+            return
+        self.send_internal(ProposalMessage(proposal))
+        for i in range(block_parts.total):
+            self.send_internal(BlockPartMessage(height, round_, block_parts.get_part(i)))
+        self.logger.info("signed proposal", height=height, round=round_, proposal=repr(proposal))
+
+    def _create_proposal_block(self):
+        """Reference createProposalBlock :1029."""
+        rs = self.rs
+        if rs.height == self.state.initial_height():
+            commit = Commit(height=0, round=0, block_id=BlockID(), signatures=[])
+        elif rs.last_commit is not None and rs.last_commit.has_two_thirds_majority():
+            commit = rs.last_commit.make_commit()
+        else:
+            self.logger.error("propose: cannot propose without commit for previous block")
+            return None, None
+        return self._block_exec.create_proposal_block(
+            rs.height, self.state, commit, self._priv_validator_addr
+        )
+
+    async def _enter_prevote(self, height: int, round_: int) -> None:
+        """Reference enterPrevote :1063."""
+        rs = self.rs
+        if rs.height != height or round_ < rs.round or (
+            rs.round == round_ and STEP_PREVOTE <= rs.step
+        ):
+            return
+        self.logger.debug("enterPrevote", height=height, round=round_)
+        rs.round = round_
+        rs.step = STEP_PREVOTE
+        self._new_step()
+        await self.do_prevote(height, round_)
+
+    async def _default_do_prevote(self, height: int, round_: int) -> None:
+        """Reference defaultDoPrevote :1090."""
+        rs = self.rs
+        if rs.locked_block is not None:
+            self.logger.debug("prevote: locked block")
+            await self._sign_add_vote(PREVOTE_TYPE, rs.locked_block.hash(), rs.locked_block_parts.header())
+            return
+        if rs.proposal_block is None:
+            self.logger.debug("prevote: ProposalBlock is nil")
+            await self._sign_add_vote(PREVOTE_TYPE, b"", None)
+            return
+        try:
+            self._block_exec.validate_block(self.state, rs.proposal_block)
+        except Exception as e:
+            self.logger.error("prevote: ProposalBlock is invalid", err=str(e))
+            await self._sign_add_vote(PREVOTE_TYPE, b"", None)
+            return
+        await self._sign_add_vote(
+            PREVOTE_TYPE, rs.proposal_block.hash(), rs.proposal_block_parts.header()
+        )
+
+    async def _enter_prevote_wait(self, height: int, round_: int) -> None:
+        """Reference enterPrevoteWait :1137."""
+        rs = self.rs
+        if rs.height != height or round_ < rs.round or (
+            rs.round == round_ and STEP_PREVOTE_WAIT <= rs.step
+        ):
+            return
+        prevotes = rs.votes.prevotes(round_)
+        if prevotes is None or not prevotes.has_two_thirds_any():
+            raise ConsensusError(
+                f"enterPrevoteWait({height}/{round_}) without +2/3 prevotes"
+            )
+        rs.round = round_
+        rs.step = STEP_PREVOTE_WAIT
+        self._new_step()
+        self._schedule_timeout(
+            int(self.config.prevote_s(round_) * 1000), height, round_, STEP_PREVOTE_WAIT
+        )
+
+    async def _enter_precommit(self, height: int, round_: int) -> None:
+        """Reference enterPrecommit :1158."""
+        rs = self.rs
+        if rs.height != height or round_ < rs.round or (
+            rs.round == round_ and STEP_PRECOMMIT <= rs.step
+        ):
+            return
+        self.logger.debug("enterPrecommit", height=height, round=round_)
+        rs.round = round_
+        rs.step = STEP_PRECOMMIT
+        self._new_step()
+
+        prevotes = rs.votes.prevotes(round_)
+        block_id, ok = prevotes.two_thirds_majority() if prevotes else (None, False)
+
+        if not ok:
+            # no polka: precommit nil
+            self.logger.debug("precommit: no +2/3 prevotes; precommitting nil")
+            await self._sign_add_vote(PRECOMMIT_TYPE, b"", None)
+            return
+
+        if self.event_bus is not None and not self.replay_mode:
+            self._publish_soon(self.event_bus.publish_event_polka(rs))
+
+        pol_round, _ = rs.votes.pol_info()
+        if pol_round < round_:
+            raise ConsensusError(f"POLRound {pol_round} < round {round_}")
+
+        if block_id.is_zero():
+            # +2/3 for nil: unlock and precommit nil
+            if rs.locked_block is not None:
+                rs.locked_round = -1
+                rs.locked_block = None
+                rs.locked_block_parts = None
+                if self.event_bus is not None and not self.replay_mode:
+                    self._publish_soon(self.event_bus.publish_event_unlock(rs))
+            await self._sign_add_vote(PRECOMMIT_TYPE, b"", None)
+            return
+
+        if rs.locked_block is not None and rs.locked_block.hash() == block_id.hash:
+            # relock
+            rs.locked_round = round_
+            if self.event_bus is not None and not self.replay_mode:
+                self._publish_soon(self.event_bus.publish_event_lock(rs))
+            await self._sign_add_vote(PRECOMMIT_TYPE, block_id.hash, block_id.parts)
+            return
+
+        if rs.proposal_block is not None and rs.proposal_block.hash() == block_id.hash:
+            # lock the proposal block (validate first!)
+            self._block_exec.validate_block(self.state, rs.proposal_block)
+            rs.locked_round = round_
+            rs.locked_block = rs.proposal_block
+            rs.locked_block_parts = rs.proposal_block_parts
+            if self.event_bus is not None and not self.replay_mode:
+                self._publish_soon(self.event_bus.publish_event_lock(rs))
+            await self._sign_add_vote(PRECOMMIT_TYPE, block_id.hash, block_id.parts)
+            return
+
+        # +2/3 for a block we don't have: unlock, fetch parts, precommit nil
+        rs.locked_round = -1
+        rs.locked_block = None
+        rs.locked_block_parts = None
+        if rs.proposal_block_parts is None or not rs.proposal_block_parts.has_header(block_id.parts):
+            rs.proposal_block = None
+            rs.proposal_block_parts = PartSet.new_from_header(block_id.parts)
+        if self.event_bus is not None and not self.replay_mode:
+            self._publish_soon(self.event_bus.publish_event_unlock(rs))
+        await self._sign_add_vote(PRECOMMIT_TYPE, b"", None)
+
+    async def _enter_precommit_wait(self, height: int, round_: int) -> None:
+        """Reference enterPrecommitWait :1262."""
+        rs = self.rs
+        if rs.height != height or round_ < rs.round or (
+            rs.round == round_ and rs.triggered_timeout_precommit
+        ):
+            return
+        precommits = rs.votes.precommits(round_)
+        if precommits is None or not precommits.has_two_thirds_any():
+            raise ConsensusError(
+                f"enterPrecommitWait({height}/{round_}) without +2/3 precommits"
+            )
+        rs.triggered_timeout_precommit = True
+        self._new_step()
+        self._schedule_timeout(
+            int(self.config.precommit_s(round_) * 1000), height, round_, STEP_PRECOMMIT_WAIT
+        )
+
+    async def _enter_commit(self, height: int, commit_round: int) -> None:
+        """Reference enterCommit :1288."""
+        rs = self.rs
+        if rs.height != height or STEP_COMMIT <= rs.step:
+            return
+        self.logger.info("enterCommit", height=height, commit_round=commit_round)
+
+        block_id, ok = rs.votes.precommits(commit_round).two_thirds_majority()
+        if not ok or block_id.is_zero():
+            raise ConsensusError("enterCommit expects +2/3 precommits for a block")
+
+        rs.step = STEP_COMMIT
+        rs.commit_round = commit_round
+        rs.commit_time_ns = now_ns()
+        self._new_step()
+
+        if rs.locked_block is not None and rs.locked_block.hash() == block_id.hash:
+            rs.proposal_block = rs.locked_block
+            rs.proposal_block_parts = rs.locked_block_parts
+        if rs.proposal_block is None or rs.proposal_block.hash() != block_id.hash:
+            if rs.proposal_block_parts is None or not rs.proposal_block_parts.has_header(block_id.parts):
+                if self.event_bus is not None and not self.replay_mode:
+                    self._publish_soon(self.event_bus.publish_event_valid_block(rs))
+                self.evsw.fire_event(EVENT_VALID_BLOCK, rs)
+                rs.proposal_block = None
+                rs.proposal_block_parts = PartSet.new_from_header(block_id.parts)
+            # else: we have the right parts header, keep collecting
+            return  # wait for the full block to arrive
+        await self._try_finalize_commit(height)
+
+    async def _try_finalize_commit(self, height: int) -> None:
+        """Reference tryFinalizeCommit :1352."""
+        rs = self.rs
+        if rs.height != height:
+            raise ConsensusError(f"tryFinalizeCommit at wrong height {height}")
+        block_id, ok = rs.votes.precommits(rs.commit_round).two_thirds_majority()
+        if not ok or block_id.is_zero():
+            self.logger.error("failed attempt to finalize: no +2/3 for block")
+            return
+        if rs.proposal_block is None or rs.proposal_block.hash() != block_id.hash:
+            self.logger.debug("failed attempt to finalize: block not yet complete")
+            return
+        await self._finalize_commit(height)
+
+    async def _finalize_commit(self, height: int) -> None:
+        """Reference finalizeCommit :1381. The fsync ordering here IS the
+        crash-recovery contract: save block → WAL ENDHEIGHT → ApplyBlock →
+        SaveState (SURVEY.md §5.4)."""
+        rs = self.rs
+        if rs.height != height or rs.step != STEP_COMMIT:
+            return
+        block_id, _ = rs.votes.precommits(rs.commit_round).two_thirds_majority()
+        block, block_parts = rs.proposal_block, rs.proposal_block_parts
+        if block is None or block.hash() != block_id.hash:
+            raise ConsensusError("cannot finalize: no/wrong proposal block")
+
+        self._block_exec.validate_block(self.state, block)
+        fail.fail()  # crash point 1: validated, nothing saved
+
+        if self._block_store.height < block.header.height:
+            seen_commit = rs.votes.precommits(rs.commit_round).make_commit()
+            self._block_store.save_block(block, block_parts, seen_commit)
+        fail.fail()  # crash point 2: block saved, no ENDHEIGHT
+
+        # ENDHEIGHT marks this height fully input-complete (fsync'd).
+        self.wal.write_sync(EndHeightMessage(height))
+        fail.fail()  # crash point 3: ENDHEIGHT written, not applied
+
+        state_copy = self.state.copy()
+        new_state, retain_height = await self._block_exec.apply_block(
+            state_copy, block_id, block
+        )
+        fail.fail()  # crash point 4: applied + state saved
+
+        if retain_height > 0:
+            try:
+                pruned = self._block_store.prune_blocks(retain_height)
+                self.logger.info("pruned blocks", count=pruned, retain=retain_height)
+            except Exception as e:
+                self.logger.error("failed to prune blocks", err=str(e))
+
+        self.evsw.fire_event(EVENT_COMMITTED, block)
+        self.update_to_state(new_state)
+        self._done_first_block.set()
+        self._schedule_round0()
+
+    # ------------------------------------------------------------------
+    # proposal handling
+    # ------------------------------------------------------------------
+
+    async def _default_set_proposal(self, proposal: Proposal) -> None:
+        """Reference defaultSetProposal :1599."""
+        rs = self.rs
+        if rs.proposal is not None or proposal.height != rs.height or proposal.round != rs.round:
+            return
+        if proposal.pol_round < -1 or (
+            proposal.pol_round >= 0 and proposal.pol_round >= proposal.round
+        ):
+            raise ConsensusError("invalid POLRound in proposal")
+        proposer = rs.validators.get_proposer()
+        if not proposer.pub_key.verify(
+            proposal.sign_bytes(self.state.chain_id), proposal.signature
+        ):
+            raise ConsensusError("invalid proposal signature")
+        rs.proposal = proposal
+        if rs.proposal_block_parts is None:
+            rs.proposal_block_parts = PartSet.new_from_header(proposal.block_id.parts)
+        self.logger.info("received proposal", proposal=repr(proposal))
+
+    async def _add_proposal_block_part(self, msg: BlockPartMessage, peer_id: str) -> bool:
+        """Reference addProposalBlockPart :1636."""
+        rs = self.rs
+        if msg.height != rs.height:
+            return False
+        if rs.proposal_block_parts is None:
+            return False  # no proposal yet; reference ignores too
+        added = rs.proposal_block_parts.add_part(msg.part)
+        if added and rs.proposal_block_parts.is_complete():
+            rs.proposal_block = Block.decode(rs.proposal_block_parts.assemble())
+            self.logger.info(
+                "received complete proposal block",
+                height=rs.proposal_block.header.height,
+                hash=rs.proposal_block.hash().hex()[:12],
+            )
+            if self.event_bus is not None and not self.replay_mode:
+                self._publish_soon(self.event_bus.publish_event_complete_proposal(rs))
+
+            # update valid block if a polka already exists for it
+            prevotes = rs.votes.prevotes(rs.round)
+            block_id, has_maj = prevotes.two_thirds_majority() if prevotes else (None, False)
+            if has_maj and not block_id.is_zero() and rs.valid_round < rs.round:
+                if rs.proposal_block.hash() == block_id.hash:
+                    rs.valid_round = rs.round
+                    rs.valid_block = rs.proposal_block
+                    rs.valid_block_parts = rs.proposal_block_parts
+
+            if rs.step <= STEP_PROPOSE and rs.is_proposal_complete():
+                await self._enter_prevote(rs.height, rs.round)
+                if has_maj:
+                    await self._enter_precommit(rs.height, rs.round)
+            elif rs.step == STEP_COMMIT:
+                await self._try_finalize_commit(rs.height)
+        return added
+
+    # ------------------------------------------------------------------
+    # vote handling
+    # ------------------------------------------------------------------
+
+    async def _try_add_vote(self, vote: Vote, peer_id: str) -> bool:
+        """Reference tryAddVote :1706: conflicting votes become evidence."""
+        try:
+            return await self._add_vote(vote, peer_id)
+        except ErrVoteConflictingVotes as e:
+            if self._priv_validator_addr == vote.validator_address:
+                self.logger.error(
+                    "found conflicting vote from ourselves; did you restart without the privval state file?",
+                    vote=repr(vote),
+                )
+                return False
+            if self._evpool is not None:
+                from tendermint_tpu.types.evidence import DuplicateVoteEvidence
+
+                _, val = self.rs.validators.get_by_address(vote.validator_address)
+                ev = DuplicateVoteEvidence(
+                    pub_key=val.pub_key, vote_a=e.vote_a, vote_b=e.vote_b
+                )
+                try:
+                    self._evpool.add_evidence(ev)
+                    self.logger.info("found and sent conflicting vote to evidence pool", ev=repr(ev))
+                except Exception as ee:
+                    self.logger.error("failed to add evidence", err=str(ee))
+            return False
+
+    async def _add_vote(self, vote: Vote, peer_id: str) -> bool:
+        """Reference addVote :1751."""
+        rs = self.rs
+
+        # precommit for previous height → LastCommit (reference :1760)
+        if vote.height + 1 == rs.height and vote.vote_type == PRECOMMIT_TYPE:
+            if rs.step != STEP_NEW_HEIGHT or rs.last_commit is None:
+                return False
+            added = rs.last_commit.add_vote(vote)
+            if not added:
+                return False
+            self.logger.debug("added to lastPrecommits", vote=repr(vote))
+            if self.event_bus is not None and not self.replay_mode:
+                self._publish_soon(self.event_bus.publish_event_vote(vote))
+            self.evsw.fire_event(EVENT_VOTE, vote)
+            # skip timeout commit if all precommits are in
+            if self.config.skip_timeout_commit and rs.last_commit.has_all():
+                await self._enter_new_round(rs.height, 0)
+            return True
+
+        if vote.height != rs.height:
+            self.logger.debug("vote ignored: wrong height", vote_h=vote.height, our_h=rs.height)
+            return False
+
+        added = rs.votes.add_vote(vote, peer_id)
+        if not added:
+            return False
+        if self.event_bus is not None and not self.replay_mode:
+            self._publish_soon(self.event_bus.publish_event_vote(vote))
+        self.evsw.fire_event(EVENT_VOTE, vote)
+
+        if vote.vote_type == PREVOTE_TYPE:
+            await self._on_prevote_added(vote)
+        else:
+            await self._on_precommit_added(vote)
+        return True
+
+    async def _on_prevote_added(self, vote: Vote) -> None:
+        """Prevote arrival transitions (reference addVote :1837-1896)."""
+        rs = self.rs
+        prevotes = rs.votes.prevotes(vote.round)
+        block_id, ok = prevotes.two_thirds_majority()
+        if ok:
+            # unlock on a later-round polka for a different block
+            if (
+                rs.locked_block is not None
+                and rs.locked_round < vote.round
+                and vote.round <= rs.round
+                and rs.locked_block.hash() != block_id.hash
+            ):
+                self.logger.info("unlocking because of POL", locked_round=rs.locked_round, pol_round=vote.round)
+                rs.locked_round = -1
+                rs.locked_block = None
+                rs.locked_block_parts = None
+                if self.event_bus is not None and not self.replay_mode:
+                    self._publish_soon(self.event_bus.publish_event_unlock(rs))
+            # update valid block
+            if not block_id.is_zero() and rs.valid_round < vote.round and vote.round == rs.round:
+                if rs.proposal_block is not None and rs.proposal_block.hash() == block_id.hash:
+                    rs.valid_round = vote.round
+                    rs.valid_block = rs.proposal_block
+                    rs.valid_block_parts = rs.proposal_block_parts
+                else:
+                    self.logger.debug("valid block we don't know about; set ProposalBlock=nil")
+                    rs.proposal_block = None
+                    if rs.proposal_block_parts is None or not rs.proposal_block_parts.has_header(block_id.parts):
+                        rs.proposal_block_parts = PartSet.new_from_header(block_id.parts)
+                self.evsw.fire_event(EVENT_VALID_BLOCK, rs)
+                if self.event_bus is not None and not self.replay_mode:
+                    self._publish_soon(self.event_bus.publish_event_valid_block(rs))
+
+        if rs.round < vote.round and prevotes.has_two_thirds_any():
+            await self._enter_new_round(rs.height, vote.round)
+        elif rs.round == vote.round and STEP_PREVOTE <= rs.step:
+            block_id2, ok2 = prevotes.two_thirds_majority()
+            if ok2 and (rs.is_proposal_complete() or block_id2.is_zero()):
+                await self._enter_precommit(rs.height, vote.round)
+            elif prevotes.has_two_thirds_any():
+                await self._enter_prevote_wait(rs.height, vote.round)
+        elif rs.proposal is not None and 0 <= rs.proposal.pol_round == vote.round:
+            if rs.is_proposal_complete():
+                await self._enter_prevote(rs.height, rs.round)
+
+    async def _on_precommit_added(self, vote: Vote) -> None:
+        """Precommit arrival transitions (reference addVote :1897-1940)."""
+        rs = self.rs
+        precommits = rs.votes.precommits(vote.round)
+        block_id, ok = precommits.two_thirds_majority()
+        if ok:
+            await self._enter_new_round(rs.height, vote.round)
+            await self._enter_precommit(rs.height, vote.round)
+            if not block_id.is_zero():
+                await self._enter_commit(rs.height, vote.round)
+                if self.config.skip_timeout_commit and precommits.has_all():
+                    await self._enter_new_round(rs.height, 0)
+            else:
+                await self._enter_precommit_wait(rs.height, vote.round)
+        elif rs.round <= vote.round and precommits.has_two_thirds_any():
+            await self._enter_new_round(rs.height, vote.round)
+            await self._enter_precommit_wait(rs.height, vote.round)
+
+    # ------------------------------------------------------------------
+    # signing
+    # ------------------------------------------------------------------
+
+    async def _sign_add_vote(
+        self, vote_type: int, block_hash: bytes, parts_header
+    ) -> Optional[Vote]:
+        """Reference signAddVote :1961."""
+        rs = self.rs
+        if self._priv_validator is None or not rs.validators.has_address(
+            self._priv_validator_addr
+        ):
+            return None
+        vote = self._sign_vote(vote_type, block_hash, parts_header)
+        if vote is not None:
+            self.send_internal(VoteMessage(vote))
+            self.logger.info("signed and pushed vote", vote=repr(vote))
+            return vote
+        if not self.replay_mode:
+            self.logger.error("failed signing vote", type=vote_type)
+        return None
+
+    def _sign_vote(self, vote_type: int, block_hash: bytes, parts_header) -> Optional[Vote]:
+        """Reference signVote :1922."""
+        from tendermint_tpu.types.block import PartSetHeader
+
+        rs = self.rs
+        idx, _val = rs.validators.get_by_address(self._priv_validator_addr)
+        block_id = BlockID(
+            hash=block_hash or b"",
+            parts=parts_header if parts_header is not None else PartSetHeader(),
+        )
+        vote = Vote(
+            vote_type=vote_type,
+            height=rs.height,
+            round=rs.round,
+            block_id=block_id,
+            timestamp_ns=self._vote_time(),
+            validator_address=self._priv_validator_addr,
+            validator_index=idx,
+        )
+        try:
+            self._priv_validator.sign_vote(self.state.chain_id, vote)
+        except ErrDoubleSign:
+            raise
+        except Exception as e:
+            self.logger.error("error signing vote", err=str(e))
+            return None
+        return vote
+
+    def _vote_time(self) -> int:
+        """Monotonic vote time: > last block time (reference voteTime
+        :1941 — minVoteTime = lastBlockTime + 1ms)."""
+        now = now_ns()
+        min_vote_time = self.state.last_block_time_ns + 1_000_000
+        return max(now, min_vote_time)
+
+    # ------------------------------------------------------------------
+    # introspection (used by reactor + RPC /dump_consensus_state)
+    # ------------------------------------------------------------------
+
+    def get_round_state(self) -> RoundState:
+        return self.rs
+
+    def height(self) -> int:
+        return self.rs.height
+
+    def __repr__(self) -> str:
+        return f"ConsensusState{{{self.rs.height_round_step()}}}"

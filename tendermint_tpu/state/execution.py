@@ -54,6 +54,9 @@ class BlockExecutor:
         self._metrics = metrics
         self.logger = logger or get_logger("state")
 
+    def store(self) -> StateStore:
+        return self._store
+
     # -- proposal construction (reference CreateProposalBlock
     # state/execution.go:87) --------------------------------------------
 

@@ -1,0 +1,133 @@
+"""In-process consensus test harness.
+
+The equivalent of reference consensus/common_test.go:647
+(randConsensusNet): N full consensus states, each with its own DB,
+kvstore app and priv validator, wired over an in-process loopback
+"switch" (every internal proposal/part/vote a node emits is also
+delivered to all other nodes' peer queues — a zero-latency stand-in for
+the gossip reactor, like p2p/test_util.go:81 MakeConnectedSwitches).
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import List, Optional
+
+from tendermint_tpu.abci.client.local import LocalClient
+from tendermint_tpu.abci.examples.kvstore import KVStoreApplication
+from tendermint_tpu.config import test_config
+from tendermint_tpu.consensus.messages import MsgInfo
+from tendermint_tpu.consensus.state import ConsensusState
+from tendermint_tpu.consensus.wal import NilWAL
+from tendermint_tpu.crypto.keys import Ed25519PrivKey
+from tendermint_tpu.db.memdb import MemDB
+from tendermint_tpu.mempool import Mempool
+from tendermint_tpu.state.execution import BlockExecutor
+from tendermint_tpu.state.state import state_from_genesis_doc
+from tendermint_tpu.state.store import StateStore
+from tendermint_tpu.store.block_store import BlockStore
+from tendermint_tpu.types.genesis import GenesisDoc, GenesisValidator
+from tendermint_tpu.types.priv_validator import MockPV
+
+CHAIN_ID = "cs-harness-chain"
+
+
+def make_genesis(n_vals: int, powers=None, time_ns: int = 1_700_000_000_000_000_000):
+    """Deterministic genesis + priv validators (reference
+    randGenesisDoc common_test.go:617)."""
+    privs = [MockPV(Ed25519PrivKey.from_secret(f"cs-harness-{i}".encode())) for i in range(n_vals)]
+    powers = powers or [10] * n_vals
+    gvs = [
+        GenesisValidator(
+            address=pv.address(), pub_key=pv.get_pub_key(), power=p, name=f"v{i}"
+        )
+        for i, (pv, p) in enumerate(zip(privs, powers))
+    ]
+    doc = GenesisDoc(chain_id=CHAIN_ID, genesis_time_ns=time_ns, validators=gvs)
+    # order privs to match the sorted validator set
+    state = state_from_genesis_doc(doc)
+    by_addr = {pv.address(): pv for pv in privs}
+    ordered = [by_addr[v.address] for v in state.validators.validators]
+    return doc, ordered
+
+
+class Node:
+    """One in-process consensus node."""
+
+    def __init__(self, cs: ConsensusState, app, mempool, block_store, state_store):
+        self.cs = cs
+        self.app = app
+        self.mempool = mempool
+        self.block_store = block_store
+        self.state_store = state_store
+
+
+async def make_node(
+    genesis: GenesisDoc,
+    pv: Optional[MockPV],
+    config=None,
+    app=None,
+    wal=None,
+) -> Node:
+    config = config or test_config().consensus
+    app = app or KVStoreApplication()
+    client = LocalClient(app)
+    await client.start()
+    from tendermint_tpu.config import MempoolConfig
+
+    mempool = Mempool(MempoolConfig(), client)
+    state_store = StateStore(MemDB())
+    block_store = BlockStore(MemDB())
+    state = state_from_genesis_doc(genesis)
+    state_store.save(state)
+    block_exec = BlockExecutor(state_store, client, mempool=mempool)
+    cs = ConsensusState(
+        config=config,
+        state=state,
+        block_exec=block_exec,
+        block_store=block_store,
+        mempool=mempool,
+        priv_validator=pv,
+        wal=wal or NilWAL(),
+    )
+    return Node(cs, app, mempool, block_store, state_store)
+
+
+def wire_loopback(nodes: List[Node]) -> None:
+    """Deliver every node's internal messages to all other nodes."""
+    for i, node in enumerate(nodes):
+        others = [n for j, n in enumerate(nodes) if j != i]
+        orig = node.cs.send_internal
+
+        def send(msg, _orig=orig, _others=others, _pid=f"node{i}"):
+            _orig(msg)
+            for other in _others:
+                other.cs._queue.put_nowait(MsgInfo(msg, _pid))
+
+        node.cs.send_internal = send
+
+
+async def start_network(
+    n_vals: int, config=None, app_factory=None, powers=None
+) -> List[Node]:
+    genesis, privs = make_genesis(n_vals, powers=powers)
+    nodes = []
+    for pv in privs:
+        nodes.append(
+            await make_node(
+                genesis, pv, config=config, app=app_factory() if app_factory else None
+            )
+        )
+    wire_loopback(nodes)
+    for node in nodes:
+        await node.cs.start()
+    return nodes
+
+
+async def stop_network(nodes: List[Node]) -> None:
+    for node in nodes:
+        await node.cs.stop()
+
+
+async def wait_for_height(nodes: List[Node], height: int, timeout_s: float = 30.0):
+    await asyncio.gather(*(n.cs.wait_for_height(height, timeout_s) for n in nodes))
