@@ -1,0 +1,276 @@
+"""Replay & handshake: crash recovery across WAL, stores, and the app.
+
+Reference: consensus/replay.go — Handshaker :211, Handshake :241,
+ReplayBlocks :285 (the store/state/app height decision table in the
+comments there), replayBlocks :421, replayBlock (applies via the real
+BlockExecutor), mockProxyApp :529 (serves recorded ABCIResponses);
+catchupReplay :100 (WAL → live state machine).
+
+Recovery invariant chain (SURVEY.md §5.4): block saved BEFORE ENDHEIGHT,
+ENDHEIGHT before ApplyBlock, state saved after. Handshake reconciles the
+app; WAL catchup reconciles the in-flight height.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+from tendermint_tpu.abci import types as abci
+from tendermint_tpu.abci.application import Application
+from tendermint_tpu.consensus.messages import EndHeightMessage, MsgInfo, TimeoutInfo
+from tendermint_tpu.crypto.keys import encode_pubkey
+from tendermint_tpu.state.execution import (
+    BlockExecutor,
+    exec_block_on_proxy_app,
+    validator_updates_from_abci,
+)
+from tendermint_tpu.state.state import State
+from tendermint_tpu.types.block import BlockID
+from tendermint_tpu.types.validator_set import ValidatorSet
+from tendermint_tpu.utils.log import get_logger
+
+
+class HandshakeError(Exception):
+    pass
+
+
+class ErrAppBlockHeightTooHigh(HandshakeError):
+    pass
+
+
+class Handshaker:
+    """Reference Handshaker consensus/replay.go:211."""
+
+    def __init__(self, state_store, state: State, block_store, genesis_doc, logger=None):
+        self._state_store = state_store
+        self._state = state
+        self._store = block_store
+        self._genesis = genesis_doc
+        self.logger = logger or get_logger("consensus")
+        self.n_blocks = 0  # blocks replayed into the app
+
+    async def handshake(self, app_conn) -> bytes:
+        """Sync the app with our stores; returns the reconciled app hash
+        (reference Handshake :241). `app_conn` is the consensus-purpose
+        ABCI client (used for Info here too, like the local setup)."""
+        res = await app_conn.info_sync(abci.RequestInfo(version="tpu"))
+        app_height = res.last_block_height
+        app_hash = res.last_block_app_hash
+        if app_height < 0:
+            raise HandshakeError(f"got negative last block height {app_height}")
+        self.logger.info(
+            "ABCI handshake", app_height=app_height, app_hash=app_hash.hex()[:16]
+        )
+        self._state.version_app = res.app_version
+        app_hash = await self.replay_blocks(self._state, app_hash, app_height, app_conn)
+        self.logger.info(
+            "completed ABCI handshake", app_height=app_height, replayed=self.n_blocks
+        )
+        return app_hash
+
+    async def replay_blocks(
+        self, state: State, app_hash: bytes, app_height: int, app_conn
+    ) -> bytes:
+        """Reference ReplayBlocks :285 (decision table)."""
+        store_height = self._store.height
+        state_height = state.last_block_height
+
+        # If the app has no state, run InitChain.
+        if app_height == 0:
+            validators = [
+                abci.ValidatorUpdate(encode_pubkey(gv.pub_key), gv.power)
+                for gv in self._genesis.validators
+            ]
+            req = abci.RequestInitChain(
+                time_ns=self._genesis.genesis_time_ns,
+                chain_id=self._genesis.chain_id,
+                validators=validators,
+                app_state_bytes=self._genesis.app_state,
+            )
+            res = await app_conn.init_chain_sync(req)
+            if state_height == 0:  # only update on genesis state
+                if res.validators:
+                    updates = validator_updates_from_abci(res.validators)
+                    state.validators = ValidatorSet(updates)
+                    state.next_validators = ValidatorSet(updates).copy_increment_proposer_priority(1)
+                elif not self._genesis.validators:
+                    raise HandshakeError("validator set is nil in genesis and still empty after InitChain")
+                self._state_store.save(state)
+                self._state = state
+
+        # First handle edge cases and constraints on the storeBlockHeight.
+        if store_height == 0:
+            _assert_app_hash_equals_on_genesis(app_hash, self._genesis)
+            return app_hash
+        if store_height < app_height:
+            raise ErrAppBlockHeightTooHigh(
+                f"app block height {app_height} > store height {store_height}"
+            )
+        if not (store_height == state_height or store_height == state_height + 1):
+            raise HandshakeError(
+                f"uncoverable store height {store_height} vs state height {state_height}"
+            )
+
+        if store_height == state_height:
+            # Tendermint ran Commit and saved the state. Maybe the app
+            # crashed earlier: just replay blocks up to store height.
+            return await self._replay_blocks(state, app_conn, app_height, store_height, False)
+
+        # store_height == state_height + 1: block saved but state not updated.
+        if app_height < state_height:
+            # app further behind: replay history, last block through the
+            # real executor (mutates state).
+            return await self._replay_blocks(state, app_conn, app_height, store_height, True)
+        if app_height == state_height:
+            # app and state both one block behind: apply the last block
+            # with the real executor.
+            self.logger.info("replay last block using real app")
+            state = await self._replay_last_block(state, app_conn)
+            self.n_blocks += 1
+            return state.app_hash
+        if app_height == store_height:
+            # app ran Commit for the last block but our state didn't save:
+            # replay against a mock app serving the recorded responses.
+            responses = self._state_store.load_abci_responses(store_height)
+            if responses is None:
+                raise HandshakeError(
+                    f"no ABCIResponses stored for height {store_height}"
+                )
+            mock_conn = await _mock_proxy_app(app_hash, responses)
+            self.logger.info("replay last block using mock app")
+            state = await self._replay_last_block(state, mock_conn)
+            self.n_blocks += 1
+            return state.app_hash
+        raise HandshakeError(
+            f"unreachable: store={store_height} state={state_height} app={app_height}"
+        )
+
+    async def _replay_blocks(
+        self, state: State, app_conn, app_height: int, store_height: int, mutate_state: bool
+    ) -> bytes:
+        """Reference replayBlocks :421: exec blocks app_height+1..store
+        (exclusive of the final one when mutate_state) directly against
+        the app — no state mutation; the final block goes through the real
+        executor when mutate_state."""
+        app_hash = b""
+        final_block = store_height
+        if mutate_state:
+            final_block -= 1
+        first = app_height + 1
+        for h in range(first, final_block + 1):
+            self.logger.info("applying block against app", height=h)
+            block = self._store.load_block(h)
+            if block is None:
+                raise HandshakeError(f"missing block {h} in store")
+            responses = await exec_block_on_proxy_app(
+                self.logger, app_conn, block, self._state_store, state.initial_height()
+            )
+            commit_res = await app_conn.commit_sync()
+            app_hash = commit_res.data
+            self.n_blocks += 1
+        if mutate_state:
+            state = await self._replay_last_block(state, app_conn)
+            self.n_blocks += 1
+            app_hash = state.app_hash
+        return app_hash
+
+    async def _replay_last_block(self, state: State, app_conn) -> State:
+        """Apply the stored block at state.height+1 via the real
+        BlockExecutor (events/mempool/evidence disabled — reference
+        replayBlock uses mock mempool/evpool)."""
+        height = state.last_block_height + 1
+        block = self._store.load_block(height)
+        meta = self._store.load_block_meta(height)
+        if block is None or meta is None:
+            raise HandshakeError(f"missing block {height} in store")
+        block_exec = BlockExecutor(
+            self._state_store, app_conn, mempool=None, evidence_pool=None,
+            logger=self.logger,
+        )
+        new_state, _ = await block_exec.apply_block(state, meta.block_id, block)
+        self._state = new_state
+        return new_state
+
+
+def _assert_app_hash_equals_on_genesis(app_hash: bytes, genesis) -> None:
+    if genesis.app_hash and app_hash != genesis.app_hash:
+        raise HandshakeError(
+            f"app hash {app_hash.hex()} does not match genesis app hash {genesis.app_hash.hex()}"
+        )
+
+
+class _MockReplayApp(Application):
+    """Serves recorded ABCIResponses (reference mockProxyApp
+    consensus/replay.go:529)."""
+
+    def __init__(self, app_hash: bytes, responses):
+        self._app_hash = app_hash
+        self._responses = responses
+        self._tx_index = 0
+
+    def deliver_tx(self, req):
+        r = self._responses.deliver_txs[self._tx_index]
+        self._tx_index += 1
+        return r
+
+    def end_block(self, req):
+        return self._responses.end_block
+
+    def begin_block(self, req):
+        return self._responses.begin_block or abci.ResponseBeginBlock()
+
+    def commit(self):
+        return abci.ResponseCommit(data=self._app_hash)
+
+
+async def _mock_proxy_app(app_hash: bytes, responses):
+    from tendermint_tpu.abci.client.local import LocalClient
+
+    client = LocalClient(_MockReplayApp(app_hash, responses))
+    await client.start()
+    return client
+
+
+# ---------------------------------------------------------------------------
+# WAL catchup into a live consensus state (reference catchupReplay :100)
+# ---------------------------------------------------------------------------
+
+
+async def catchup_replay(cs, cs_height: int) -> None:
+    """Replay WAL messages for the in-flight height into `cs`. Must run
+    before the receive loop starts consuming new inputs."""
+    cs.replay_mode = True
+    try:
+        # Ensure WAL is not ahead of us (ENDHEIGHT for cs_height would mean
+        # the block was fully committed — handshake should have caught up).
+        _, found = cs.wal.search_for_end_height(cs_height)
+        if found:
+            raise HandshakeError(
+                f"WAL should not contain #ENDHEIGHT {cs_height}"
+            )
+        msgs, found = cs.wal.search_for_end_height(cs_height - 1)
+        if not found and cs_height > cs.state.initial_height():
+            raise HandshakeError(
+                f"cannot replay height {cs_height}: WAL has no #ENDHEIGHT for {cs_height - 1}"
+            )
+        count = 0
+        for msg in msgs or []:
+            await _read_replay_message(cs, msg)
+            count += 1
+        cs.logger.info("WAL catchup complete", height=cs_height, replayed_msgs=count)
+    finally:
+        cs.replay_mode = False
+
+
+async def _read_replay_message(cs, msg) -> None:
+    """Reference readReplayMessage consensus/replay.go:43."""
+    if isinstance(msg, EndHeightMessage):
+        return  # defensive: tail ENDHEIGHTs are filtered by the search
+    if isinstance(msg, TimeoutInfo):
+        cs.logger.debug("replay: timeout", ti=repr(msg))
+        await cs._handle_timeout(msg)
+    elif isinstance(msg, MsgInfo):
+        cs.logger.debug("replay: msg", peer=msg.peer_id or "internal")
+        await cs._handle_msg(msg)
+    else:
+        raise HandshakeError(f"unknown WAL message {type(msg).__name__}")

@@ -145,6 +145,7 @@ class ConsensusState(Service):
 
         self.wal: WAL = wal or NilWAL()
         self.replay_mode = False  # catching up via WAL replay
+        self.do_wal_catchup = True
         self._done_first_block = asyncio.Event()
         self.n_steps = 0  # transitions counter (reference nSteps, for tests)
 
@@ -165,6 +166,17 @@ class ConsensusState(Service):
         consensus.replay's catchup_replay before start; here we launch the
         receive loop and schedule round 0."""
         self.wal.start()
+        if self.do_wal_catchup and not isinstance(self.wal, NilWAL):
+            from tendermint_tpu.consensus.replay import catchup_replay
+
+            try:
+                await catchup_replay(self, self.rs.height)
+            except Exception as e:
+                # Reference policy (consensus/state.go:328): log and start
+                # anyway — handshake already reconciled the stores.
+                self.logger.error(
+                    "error on catchup replay; proceeding to start anyway", err=str(e)
+                )
         self.spawn(self._receive_routine())
         self._schedule_round0()
 
@@ -996,10 +1008,11 @@ class ConsensusState(Service):
         )
         try:
             self._priv_validator.sign_vote(self.state.chain_id, vote)
-        except ErrDoubleSign:
-            raise
         except Exception as e:
-            self.logger.error("error signing vote", err=str(e))
+            # Includes ErrDoubleSign: refusing to sign is loss of OUR vote,
+            # not a consensus failure (reference signVote returns err).
+            if not self.replay_mode:
+                self.logger.error("error signing vote", err=str(e))
             return None
         return vote
 

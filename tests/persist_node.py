@@ -1,0 +1,113 @@
+"""Single-validator persistent node runner for the crash matrix.
+
+The python equivalent of the reference's crash rig
+(test/persist/test_failure_indices.sh:40): run a file-backed node until
+`target_height`; with FAIL_TEST_INDEX set the fail-points in the commit
+path crash the process mid-height, and the next run must recover via
+handshake + WAL catchup.
+
+Usage: python tests/persist_node.py <root_dir> <target_height> [--txs N]
+Exits 0 when target height is committed and app state matches stores.
+"""
+
+import asyncio
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from tendermint_tpu.abci.client.local import LocalClient
+from tendermint_tpu.abci.examples.kvstore import PersistentKVStoreApplication
+from tendermint_tpu.config import MempoolConfig, test_config
+from tendermint_tpu.consensus.replay import Handshaker
+from tendermint_tpu.consensus.state import ConsensusState
+from tendermint_tpu.consensus.wal import BaseWAL
+from tendermint_tpu.crypto.keys import Ed25519PrivKey
+from tendermint_tpu.db.sqlitedb import SQLiteDB
+from tendermint_tpu.mempool import Mempool
+from tendermint_tpu.privval import load_or_gen_file_pv
+from tendermint_tpu.state.execution import BlockExecutor
+from tendermint_tpu.state.state import state_from_genesis_doc
+from tendermint_tpu.state.store import StateStore
+from tendermint_tpu.store.block_store import BlockStore
+from tendermint_tpu.types.genesis import GenesisDoc, GenesisValidator
+from tendermint_tpu.types.priv_validator import MockPV
+
+CHAIN_ID = "persist-chain"
+
+
+async def main(root: str, target_height: int, n_txs: int) -> int:
+    os.makedirs(root, exist_ok=True)
+    pv = load_or_gen_file_pv(
+        os.path.join(root, "pv_key.json"), os.path.join(root, "pv_state.json")
+    )
+    genesis = GenesisDoc(
+        chain_id=CHAIN_ID,
+        genesis_time_ns=1_700_000_000_000_000_000,
+        validators=[
+            GenesisValidator(pub_key=pv.get_pub_key(), power=10, name="v0")
+        ],
+    )
+
+    app = PersistentKVStoreApplication(SQLiteDB("app", root))
+    client = LocalClient(app)
+    await client.start()
+
+    state_store = StateStore(SQLiteDB("state", root))
+    block_store = BlockStore(SQLiteDB("blocks", root))
+    state = state_store.load()
+    if state is None:
+        state = state_from_genesis_doc(genesis)
+        state_store.save(state)
+
+    # ABCI handshake: reconcile app with stores (replays blocks as needed)
+    handshaker = Handshaker(state_store, state, block_store, genesis)
+    await handshaker.handshake(client)
+    state = state_store.load()
+
+    mempool = Mempool(MempoolConfig(), client)
+    block_exec = BlockExecutor(state_store, client, mempool=mempool)
+    wal = BaseWAL(os.path.join(root, "cs.wal"))
+    cfg = test_config().consensus
+    cs = ConsensusState(
+        config=cfg,
+        state=state,
+        block_exec=block_exec,
+        block_store=block_store,
+        mempool=mempool,
+        priv_validator=pv,
+        wal=wal,
+    )
+    await cs.start()
+    # feed a few txs so blocks are non-trivial
+    for i in range(n_txs):
+        try:
+            await mempool.check_tx(f"k{i}={i}".encode())
+        except Exception:
+            pass
+    try:
+        await cs.wait_for_height(target_height, timeout_s=60)
+    finally:
+        await cs.stop()
+
+    # post-conditions: app caught up with the store
+    final_state = state_store.load()
+    assert final_state.last_block_height >= target_height, final_state.last_block_height
+    info = await client.info_sync(__import__("tendermint_tpu.abci.types", fromlist=["RequestInfo"]).RequestInfo())
+    # the app may be ONE block ahead if we stopped mid-commit (the next
+    # handshake reconciles exactly that window); never behind, never more
+    assert info.last_block_height in (
+        final_state.last_block_height,
+        final_state.last_block_height + 1,
+    ), (info.last_block_height, final_state.last_block_height)
+    if info.last_block_height == final_state.last_block_height:
+        assert info.last_block_app_hash == final_state.app_hash
+    print(f"OK height={final_state.last_block_height} app={info.last_block_height}")
+    return 0
+
+
+if __name__ == "__main__":
+    root = sys.argv[1]
+    target = int(sys.argv[2])
+    n_txs = int(sys.argv[4]) if len(sys.argv) > 4 and sys.argv[3] == "--txs" else 3
+    sys.exit(asyncio.run(main(root, target, n_txs)))
