@@ -1,0 +1,14 @@
+"""P2P: the distributed communication backend (reference layer L2).
+
+Reference: p2p/ — Switch (switch.go:69), MultiplexTransport
+(transport.go), MConnection priority channels (conn/connection.go:79),
+SecretConnection authenticated encryption (conn/secret_connection.go:60),
+NodeInfo handshake (node_info.go), NodeKey identity (key.go).
+
+asyncio TCP replaces goroutine-per-conn; the protocol stack (transport →
+secret conn → mconnection → switch/reactor dispatch) is preserved 1:1.
+"""
+
+from tendermint_tpu.p2p.key import NodeKey, node_id_from_pubkey
+from tendermint_tpu.p2p.netaddress import NetAddress
+from tendermint_tpu.p2p.node_info import NodeInfo

@@ -1,0 +1,2 @@
+from tendermint_tpu.p2p.conn.secret_connection import SecretConnection
+from tendermint_tpu.p2p.conn.connection import MConnection, ChannelDescriptor

@@ -1,0 +1,297 @@
+"""MConnection: multiplexes priority channels over one encrypted stream.
+
+Reference: p2p/conn/connection.go — MConnection :79, Channel struct :
+region, Send :348, sendRoutine :419, recvRoutine :553; PacketMsg framing
+:28 (1KB max payload), ping/pong keep-alive :46-47, 100ms flush
+throttle :38, sendRate/recvRate flow limits :43-44.
+
+Packets (one type byte + body):
+  PING / PONG              — keep-alive
+  MSG  chan(1) eof(1) len(2) payload — one ≤1024-byte chunk of a channel
+                             message; eof=1 marks the final chunk.
+
+The send scheduler picks the channel with the least
+recently-sent-bytes/priority ratio (reference sendPacketMsg :497) so
+high-priority channels (consensus) starve low-priority ones (mempool)
+under load, not vice versa.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import struct
+import time
+from dataclasses import dataclass
+from typing import Awaitable, Callable, Dict, List, Optional
+
+from tendermint_tpu.utils.log import get_logger
+
+MAX_PACKET_PAYLOAD = 1024
+_PKT_PING = 0x01
+_PKT_PONG = 0x02
+_PKT_MSG = 0x03
+
+DEFAULT_SEND_QUEUE_CAPACITY = 1
+DEFAULT_RECV_BUFFER_CAPACITY = 4096
+DEFAULT_RECV_MESSAGE_CAPACITY = 22 * 1024 * 1024  # reference :33
+
+
+@dataclass
+class ChannelDescriptor:
+    """Reference ChannelDescriptor conn/connection.go:631."""
+
+    id: int
+    priority: int = 1
+    send_queue_capacity: int = DEFAULT_SEND_QUEUE_CAPACITY
+    recv_message_capacity: int = DEFAULT_RECV_MESSAGE_CAPACITY
+
+
+class _Channel:
+    def __init__(self, desc: ChannelDescriptor):
+        self.desc = desc
+        self.send_queue: asyncio.Queue = asyncio.Queue(maxsize=max(desc.send_queue_capacity, 1))
+        self.sending: bytes = b""
+        self.sent_pos = 0
+        self.recently_sent = 0  # exponentially decayed byte count
+        self.recving: List[bytes] = []
+        self.recv_size = 0
+
+    def is_send_pending(self) -> bool:
+        return bool(self.sending) or not self.send_queue.empty()
+
+    def next_packet(self) -> Optional[bytes]:
+        """Build the next MSG packet for this channel, or None."""
+        if not self.sending:
+            try:
+                self.sending = self.send_queue.get_nowait()
+                self.sent_pos = 0
+            except asyncio.QueueEmpty:
+                return None
+        chunk = self.sending[self.sent_pos : self.sent_pos + MAX_PACKET_PAYLOAD]
+        self.sent_pos += len(chunk)
+        eof = 1 if self.sent_pos >= len(self.sending) else 0
+        if eof:
+            self.sending = b""
+            self.sent_pos = 0
+        self.recently_sent += len(chunk)
+        return struct.pack(">BBBH", _PKT_MSG, self.desc.id, eof, len(chunk)) + chunk
+
+
+class MConnection:
+    """One multiplexed connection. `conn` needs write(bytes)/read_exactly(n)
+    async methods (SecretConnection or a plain stream adapter)."""
+
+    def __init__(
+        self,
+        conn,
+        channel_descs: List[ChannelDescriptor],
+        on_receive: Callable[[int, bytes], Awaitable[None]],
+        on_error: Callable[[Exception], Awaitable[None]],
+        flush_throttle_ms: int = 100,
+        ping_interval_s: float = 60.0,
+        pong_timeout_s: float = 45.0,
+        send_rate: int = 5_120_000,
+        recv_rate: int = 5_120_000,
+        logger=None,
+    ):
+        self._conn = conn
+        self._channels: Dict[int, _Channel] = {
+            d.id: _Channel(d) for d in channel_descs
+        }
+        self._on_receive = on_receive
+        self._on_error = on_error
+        self._flush_throttle_s = flush_throttle_ms / 1000.0
+        self._ping_interval_s = ping_interval_s
+        self._pong_timeout_s = pong_timeout_s
+        self._send_rate = send_rate
+        self._recv_rate = recv_rate
+        self.logger = logger or get_logger("mconn")
+
+        self._send_event = asyncio.Event()
+        self._pong_pending = False
+        self._awaiting_pong_since: Optional[float] = None
+        self._tasks: List[asyncio.Task] = []
+        self._stopped = False
+
+    def start(self) -> None:
+        self._tasks = [
+            asyncio.create_task(self._send_routine()),
+            asyncio.create_task(self._recv_routine()),
+            asyncio.create_task(self._ping_routine()),
+        ]
+
+    async def stop(self) -> None:
+        if self._stopped:
+            return
+        self._stopped = True
+        # stop() may be reached from within our own recv/send task (error
+        # path: on_error → switch → peer.stop) — never cancel/await self.
+        cur = asyncio.current_task()
+        tasks = [t for t in self._tasks if t is not cur]
+        for t in tasks:
+            t.cancel()
+        await asyncio.gather(*tasks, return_exceptions=True)
+        self._conn.close()
+
+    # -- sending -----------------------------------------------------------
+
+    async def send(self, ch_id: int, msg: bytes) -> bool:
+        """Queue msg on channel; blocks while the channel queue is full
+        (reference Send :348)."""
+        ch = self._channels.get(ch_id)
+        if ch is None or self._stopped:
+            return False
+        await ch.send_queue.put(msg)
+        self._send_event.set()
+        return True
+
+    def try_send(self, ch_id: int, msg: bytes) -> bool:
+        """Non-blocking send (reference TrySend :380)."""
+        ch = self._channels.get(ch_id)
+        if ch is None or self._stopped:
+            return False
+        try:
+            ch.send_queue.put_nowait(msg)
+        except asyncio.QueueFull:
+            return False
+        self._send_event.set()
+        return True
+
+    def can_send(self, ch_id: int) -> bool:
+        ch = self._channels.get(ch_id)
+        return ch is not None and ch.send_queue.qsize() < ch.send_queue.maxsize
+
+    def _pick_channel(self) -> Optional[_Channel]:
+        """Least recently-sent/priority ratio among pending channels."""
+        best = None
+        best_ratio = None
+        for ch in self._channels.values():
+            if not ch.is_send_pending():
+                continue
+            ratio = ch.recently_sent / max(ch.desc.priority, 1)
+            if best is None or ratio < best_ratio:
+                best, best_ratio = ch, ratio
+        return best
+
+    async def _send_routine(self) -> None:
+        """Reference sendRoutine :419 + sendSomePacketMsgs rate logic."""
+        budget_window = 0.1  # refill send budget every 100ms
+        budget = self._send_rate * budget_window
+        try:
+            while True:
+                if self._pong_pending:
+                    self._pong_pending = False
+                    await self._conn.write(struct.pack(">B", _PKT_PONG))
+                ch = self._pick_channel()
+                if ch is None:
+                    # decay counters while idle; wait for work
+                    self._send_event.clear()
+                    for c in self._channels.values():
+                        c.recently_sent = int(c.recently_sent * 0.8)
+                    try:
+                        await asyncio.wait_for(
+                            self._send_event.wait(), self._flush_throttle_s
+                        )
+                    except asyncio.TimeoutError:
+                        pass
+                    continue
+                pkt = ch.next_packet()
+                if pkt is None:
+                    continue
+                await self._conn.write(pkt)
+                budget -= len(pkt)
+                if budget <= 0:
+                    await asyncio.sleep(budget_window)
+                    budget = self._send_rate * budget_window
+        except asyncio.CancelledError:
+            raise
+        except Exception as e:
+            if not self._stopped:
+                await self._on_error(e)
+
+    # -- receiving ---------------------------------------------------------
+
+    async def _recv_routine(self) -> None:
+        """Reference recvRoutine :553."""
+        recv_budget = float(self._recv_rate) * 0.1
+        try:
+            while True:
+                (pkt_type,) = struct.unpack(">B", await self._conn.read_exactly(1))
+                if pkt_type == _PKT_PING:
+                    self._pong_pending = True
+                    self._send_event.set()
+                elif pkt_type == _PKT_PONG:
+                    self._awaiting_pong_since = None
+                elif pkt_type == _PKT_MSG:
+                    hdr = await self._conn.read_exactly(4)
+                    ch_id, eof, length = struct.unpack(">BBH", hdr)
+                    if length > MAX_PACKET_PAYLOAD:
+                        raise ValueError(f"packet payload {length} > max")
+                    payload = await self._conn.read_exactly(length) if length else b""
+                    ch = self._channels.get(ch_id)
+                    if ch is None:
+                        raise ValueError(f"unknown channel {ch_id:#x}")
+                    ch.recving.append(payload)
+                    ch.recv_size += len(payload)
+                    if ch.recv_size > ch.desc.recv_message_capacity:
+                        raise ValueError(
+                            f"recv message exceeds capacity on channel {ch_id:#x}"
+                        )
+                    if eof:
+                        msg = b"".join(ch.recving)
+                        ch.recving = []
+                        ch.recv_size = 0
+                        await self._on_receive(ch_id, msg)
+                    recv_budget -= length + 5
+                    if recv_budget <= 0:
+                        await asyncio.sleep(0.1)
+                        recv_budget = float(self._recv_rate) * 0.1
+                else:
+                    raise ValueError(f"unknown packet type {pkt_type:#x}")
+        except asyncio.CancelledError:
+            raise
+        except (asyncio.IncompleteReadError, ConnectionError, OSError) as e:
+            if not self._stopped:
+                await self._on_error(e)
+        except Exception as e:
+            if not self._stopped:
+                await self._on_error(e)
+
+    async def _ping_routine(self) -> None:
+        try:
+            while True:
+                await asyncio.sleep(self._ping_interval_s)
+                if self._awaiting_pong_since is not None:
+                    if time.monotonic() - self._awaiting_pong_since > self._pong_timeout_s:
+                        await self._on_error(TimeoutError("pong timeout"))
+                        return
+                await self._conn.write(struct.pack(">B", _PKT_PING))
+                self._awaiting_pong_since = time.monotonic()
+        except asyncio.CancelledError:
+            raise
+        except Exception as e:
+            if not self._stopped:
+                await self._on_error(e)
+
+
+class StreamAdapter:
+    """Plain (unencrypted) asyncio stream with the SecretConnection I/O
+    surface — for tests and for the fuzz wrapper."""
+
+    def __init__(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter):
+        self._reader = reader
+        self._writer = writer
+
+    async def write(self, data: bytes) -> int:
+        self._writer.write(data)
+        await self._writer.drain()
+        return len(data)
+
+    async def read_exactly(self, n: int) -> bytes:
+        return await self._reader.readexactly(n)
+
+    def close(self) -> None:
+        try:
+            self._writer.close()
+        except Exception:
+            pass

@@ -1,0 +1,208 @@
+"""SecretConnection: STS-style authenticated encryption for peer links.
+
+Reference: p2p/conn/secret_connection.go:60 — X25519 ECDH → HKDF-SHA256
+into two directional ChaCha20-Poly1305 keys → 1024-byte sealed frames
+with incrementing 96-bit nonces → ed25519 identity proof over a
+transcript challenge. The reference uses a Merlin transcript; here the
+challenge is SHA-256 over a fixed-label transcript of both ephemerals —
+same binding properties, no Merlin dependency (wire format is
+clean-break everywhere in this tree).
+
+Frame layout: each sealed frame carries TOTAL_FRAME_SIZE (1024) bytes of
+plaintext: 4-byte big-endian data length + up to 1020 data bytes; sealed
+adds a 16-byte tag. Low-level sync pack/unpack functions are pure (for
+tests); the async class wraps an asyncio stream pair.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import hashlib
+import struct
+from typing import Optional, Tuple
+
+from cryptography.hazmat.primitives.asymmetric.x25519 import (
+    X25519PrivateKey,
+    X25519PublicKey,
+)
+from cryptography.hazmat.primitives.ciphers.aead import ChaCha20Poly1305
+from cryptography.hazmat.primitives.kdf.hkdf import HKDF
+from cryptography.hazmat.primitives import hashes, serialization
+
+from tendermint_tpu.codec.binary import Reader, Writer
+from tendermint_tpu.crypto.keys import Ed25519PubKey, PrivKey, PubKey
+
+TOTAL_FRAME_SIZE = 1024
+DATA_LEN_SIZE = 4
+DATA_MAX_SIZE = TOTAL_FRAME_SIZE - DATA_LEN_SIZE  # 1020
+TAG_SIZE = 16
+SEALED_FRAME_SIZE = TOTAL_FRAME_SIZE + TAG_SIZE
+
+_TRANSCRIPT_LABEL = b"TENDERMINT_TPU_SECRET_CONNECTION_TRANSCRIPT_HASH"
+_HKDF_INFO = b"TENDERMINT_TPU_SECRET_CONNECTION_KEY_AND_CHALLENGE_GEN"
+
+
+class ErrSharedSecretIsZero(Exception):
+    pass
+
+
+class AuthFailure(Exception):
+    pass
+
+
+class _Nonce:
+    """96-bit little-endian counter nonce (reference incrNonce)."""
+
+    __slots__ = ("n",)
+
+    def __init__(self) -> None:
+        self.n = 0
+
+    def use(self) -> bytes:
+        v = self.n.to_bytes(12, "little")
+        self.n += 1
+        if self.n >= 1 << 96:
+            raise OverflowError("nonce wrapped")
+        return v
+
+
+def _x25519_pub_bytes(pub: X25519PublicKey) -> bytes:
+    return pub.public_bytes(
+        serialization.Encoding.Raw, serialization.PublicFormat.Raw
+    )
+
+
+def derive_secrets(
+    shared: bytes, loc_ephemeral: bytes, rem_ephemeral: bytes, we_are_lower: bool
+) -> Tuple[bytes, bytes, bytes]:
+    """(recv_key, send_key, challenge). Key ordering is by sorted
+    ephemerals so both sides agree (reference deriveSecretsAndChallenge)."""
+    lo, hi = sorted((loc_ephemeral, rem_ephemeral))
+    okm = HKDF(
+        algorithm=hashes.SHA256(), length=96, salt=lo + hi, info=_HKDF_INFO
+    ).derive(shared)
+    key1, key2, challenge = okm[:32], okm[32:64], okm[64:96]
+    # the lexicographically-lower ephemeral's owner sends with key1
+    if we_are_lower:
+        send_key, recv_key = key1, key2
+    else:
+        send_key, recv_key = key2, key1
+    return recv_key, send_key, challenge
+
+
+def transcript_challenge(loc_eph: bytes, rem_eph: bytes) -> bytes:
+    lo, hi = sorted((loc_eph, rem_eph))
+    return hashlib.sha256(_TRANSCRIPT_LABEL + lo + hi).digest()
+
+
+class SecretConnection:
+    """Authenticated encrypted stream over (reader, writer)."""
+
+    def __init__(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter):
+        self._reader = reader
+        self._writer = writer
+        self._send_aead: Optional[ChaCha20Poly1305] = None
+        self._recv_aead: Optional[ChaCha20Poly1305] = None
+        self._send_nonce = _Nonce()
+        self._recv_nonce = _Nonce()
+        self._recv_buf = b""
+        self.remote_pubkey: Optional[PubKey] = None
+
+    @classmethod
+    async def make(
+        cls,
+        reader: asyncio.StreamReader,
+        writer: asyncio.StreamWriter,
+        local_priv: PrivKey,
+    ) -> "SecretConnection":
+        """Full handshake (reference MakeSecretConnection):
+        1. exchange ephemeral X25519 pubkeys (plaintext)
+        2. ECDH → HKDF → directional keys + challenge
+        3. exchange (identity pubkey, sig over challenge) ENCRYPTED
+        4. verify the peer's signature."""
+        sc = cls(reader, writer)
+        eph_priv = X25519PrivateKey.generate()
+        loc_eph = _x25519_pub_bytes(eph_priv.public_key())
+
+        # 1. plaintext ephemeral exchange (fixed 32 bytes each way)
+        writer.write(loc_eph)
+        await writer.drain()
+        rem_eph = await reader.readexactly(32)
+
+        # 2. shared secret + keys
+        shared = eph_priv.exchange(X25519PublicKey.from_public_bytes(rem_eph))
+        if shared == b"\x00" * 32:
+            raise ErrSharedSecretIsZero()
+        recv_key, send_key, _ = derive_secrets(
+            shared, loc_eph, rem_eph, we_are_lower=loc_eph == min(loc_eph, rem_eph)
+        )
+        sc._send_aead = ChaCha20Poly1305(send_key)
+        sc._recv_aead = ChaCha20Poly1305(recv_key)
+        challenge = transcript_challenge(loc_eph, rem_eph)
+
+        # 3. authenticate over the encrypted channel
+        sig = local_priv.sign(challenge)
+        w = Writer()
+        w.write_bytes(local_priv.pub_key().bytes()).write_bytes(sig)
+        await sc.write_msg(w.bytes())
+        auth = Reader(await sc.read_msg())
+        rem_pub_raw = auth.read_bytes()
+        rem_sig = auth.read_bytes()
+        rem_pub = Ed25519PubKey(rem_pub_raw)
+        if not rem_pub.verify(challenge, rem_sig):
+            raise AuthFailure("challenge verification failed")
+        sc.remote_pubkey = rem_pub
+        return sc
+
+    # -- framed I/O --------------------------------------------------------
+
+    async def write(self, data: bytes) -> int:
+        """Encrypt `data` into sealed frames (reference Write :219)."""
+        total = len(data)
+        while data:
+            chunk, data = data[:DATA_MAX_SIZE], data[DATA_MAX_SIZE:]
+            frame = struct.pack(">I", len(chunk)) + chunk
+            frame += b"\x00" * (TOTAL_FRAME_SIZE - len(frame))
+            sealed = self._send_aead.encrypt(self._send_nonce.use(), frame, None)
+            self._writer.write(sealed)
+        await self._writer.drain()
+        return total
+
+    async def read(self, n: int) -> bytes:
+        """Read up to n plaintext bytes (at least 1 unless EOF)."""
+        if not self._recv_buf:
+            sealed = await self._reader.readexactly(SEALED_FRAME_SIZE)
+            frame = self._recv_aead.decrypt(self._recv_nonce.use(), sealed, None)
+            (length,) = struct.unpack_from(">I", frame, 0)
+            if length > DATA_MAX_SIZE:
+                raise AuthFailure(f"frame length {length} > max")
+            self._recv_buf = frame[DATA_LEN_SIZE : DATA_LEN_SIZE + length]
+        out, self._recv_buf = self._recv_buf[:n], self._recv_buf[n:]
+        return out
+
+    async def read_exactly(self, n: int) -> bytes:
+        parts = []
+        got = 0
+        while got < n:
+            p = await self.read(n - got)
+            if not p:
+                raise asyncio.IncompleteReadError(b"".join(parts), n)
+            parts.append(p)
+            got += len(p)
+        return b"".join(parts)
+
+    # length-prefixed message helpers (used by handshake + transport)
+    async def write_msg(self, msg: bytes) -> None:
+        await self.write(struct.pack(">I", len(msg)) + msg)
+
+    async def read_msg(self, max_size: int = 1 << 22) -> bytes:
+        (length,) = struct.unpack(">I", await self.read_exactly(4))
+        if length > max_size:
+            raise AuthFailure(f"message size {length} > max {max_size}")
+        return await self.read_exactly(length)
+
+    def close(self) -> None:
+        try:
+            self._writer.close()
+        except Exception:
+            pass

@@ -1,0 +1,57 @@
+"""Node identity key.
+
+Reference: p2p/key.go — NodeKey (ed25519), ID = hex(address(pubkey))
+(:35 PubKeyToID, 20-byte address → 40-char hex string).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from dataclasses import dataclass
+
+from tendermint_tpu.crypto.keys import Ed25519PrivKey, PubKey
+
+ID_BYTE_LENGTH = 20
+
+
+def node_id_from_pubkey(pub_key: PubKey) -> str:
+    """Reference PubKeyToID p2p/key.go:35."""
+    return pub_key.address().hex()
+
+
+@dataclass
+class NodeKey:
+    priv_key: Ed25519PrivKey
+
+    @property
+    def id(self) -> str:
+        return node_id_from_pubkey(self.priv_key.pub_key())
+
+    def pub_key(self) -> PubKey:
+        return self.priv_key.pub_key()
+
+    def save_as(self, path: str) -> None:
+        doc = {"priv_key": {"type": "ed25519", "value": self.priv_key.bytes().hex()}}
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        with open(path, "w") as fp:
+            json.dump(doc, fp, indent=2)
+
+    @classmethod
+    def load(cls, path: str) -> "NodeKey":
+        with open(path) as fp:
+            doc = json.load(fp)
+        return cls(Ed25519PrivKey(bytes.fromhex(doc["priv_key"]["value"])))
+
+    @classmethod
+    def generate(cls) -> "NodeKey":
+        return cls(Ed25519PrivKey.generate())
+
+
+def load_or_gen_node_key(path: str) -> NodeKey:
+    """Reference LoadOrGenNodeKey p2p/key.go:65."""
+    if os.path.exists(path):
+        return NodeKey.load(path)
+    nk = NodeKey.generate()
+    nk.save_as(path)
+    return nk

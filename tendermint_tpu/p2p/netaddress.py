@@ -1,0 +1,86 @@
+"""Network address: `id@host:port`.
+
+Reference: p2p/netaddress.go — NetAddress :27, NewNetAddressString :61
+(ID validation), Routable/ReachabilityTo checks (simplified: private-net
+classification only, used by the address book's strict mode).
+"""
+
+from __future__ import annotations
+
+import ipaddress
+from dataclasses import dataclass
+from typing import Optional
+
+
+class ErrNetAddressInvalid(Exception):
+    pass
+
+
+@dataclass(frozen=True)
+class NetAddress:
+    id: str  # 40-hex node id, may be "" for addresses without identity
+    host: str
+    port: int
+
+    @classmethod
+    def parse(cls, addr: str) -> "NetAddress":
+        """Parse 'id@host:port' or 'host:port' (reference
+        NewNetAddressString p2p/netaddress.go:61)."""
+        s = addr
+        if s.startswith("tcp://"):
+            s = s[len("tcp://") :]
+        node_id = ""
+        if "@" in s:
+            node_id, s = s.split("@", 1)
+            if len(node_id) != 40 or not _is_hex(node_id):
+                raise ErrNetAddressInvalid(f"invalid node ID {node_id!r}")
+        if ":" not in s:
+            raise ErrNetAddressInvalid(f"missing port in {addr!r}")
+        host, port_s = s.rsplit(":", 1)
+        host = host.strip("[]")  # ipv6
+        try:
+            port = int(port_s)
+        except ValueError:
+            raise ErrNetAddressInvalid(f"invalid port {port_s!r}")
+        if not 0 <= port <= 65535:
+            raise ErrNetAddressInvalid(f"port out of range {port}")
+        if not host:
+            raise ErrNetAddressInvalid(f"empty host in {addr!r}")
+        return cls(node_id.lower(), host, port)
+
+    def dial_string(self) -> str:
+        return f"{self.host}:{self.port}"
+
+    def __str__(self) -> str:
+        if self.id:
+            return f"{self.id}@{self.host}:{self.port}"
+        return self.dial_string()
+
+    def routable(self) -> bool:
+        """Public-internet routable (reference Routable :291)."""
+        try:
+            ip = ipaddress.ip_address(self.host)
+        except ValueError:
+            return True  # hostnames assumed routable
+        return not (
+            ip.is_private or ip.is_loopback or ip.is_link_local
+            or ip.is_multicast or ip.is_unspecified
+        )
+
+    def local(self) -> bool:
+        try:
+            ip = ipaddress.ip_address(self.host)
+        except ValueError:
+            return False
+        return ip.is_loopback or ip.is_private
+
+    def same_id(self, other: "NetAddress") -> bool:
+        return bool(self.id) and self.id == other.id
+
+
+def _is_hex(s: str) -> bool:
+    try:
+        bytes.fromhex(s)
+        return True
+    except ValueError:
+        return False

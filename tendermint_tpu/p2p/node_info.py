@@ -1,0 +1,81 @@
+"""NodeInfo: the identity/compatibility record exchanged at handshake.
+
+Reference: p2p/node_info.go — DefaultNodeInfo :81, Validate :127,
+CompatibleWith :169 (same block protocol, same network, ≥1 common
+channel).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import List, Optional
+
+from tendermint_tpu.codec.binary import Reader, Writer
+from tendermint_tpu.version import BLOCK_PROTOCOL, P2P_PROTOCOL
+
+MAX_NUM_CHANNELS = 16
+MAX_MONIKER_LEN = 64
+
+
+@dataclass
+class NodeInfo:
+    node_id: str = ""
+    listen_addr: str = ""  # accepting incoming at (host:port or tcp://host:port)
+    network: str = ""  # chain id
+    version: str = ""
+    channels: bytes = b""  # byte per channel id
+    moniker: str = ""
+    protocol_p2p: int = P2P_PROTOCOL
+    protocol_block: int = BLOCK_PROTOCOL
+    # "other" (reference DefaultNodeInfoOther): tx_index on/off + rpc addr
+    tx_index: str = "on"
+    rpc_address: str = ""
+
+    def validate(self) -> Optional[str]:
+        if len(self.node_id) != 40:
+            return f"invalid node id {self.node_id!r}"
+        if len(self.channels) > MAX_NUM_CHANNELS:
+            return f"too many channels ({len(self.channels)})"
+        if len(set(self.channels)) != len(self.channels):
+            return "duplicate channel id"
+        if len(self.moniker) > MAX_MONIKER_LEN:
+            return "moniker too long"
+        return None
+
+    def compatible_with(self, other: "NodeInfo") -> Optional[str]:
+        """Reference CompatibleWith p2p/node_info.go:169."""
+        if self.protocol_block != other.protocol_block:
+            return (
+                f"peer is on a different block protocol: {other.protocol_block} "
+                f"(ours {self.protocol_block})"
+            )
+        if self.network != other.network:
+            return f"peer is on a different network: {other.network!r} (ours {self.network!r})"
+        if self.channels and other.channels:
+            if not set(self.channels) & set(other.channels):
+                return f"no common channels: {other.channels!r} vs {self.channels!r}"
+        return None
+
+    def encode(self) -> bytes:
+        w = Writer()
+        w.write_str(self.node_id).write_str(self.listen_addr).write_str(self.network)
+        w.write_str(self.version).write_bytes(self.channels).write_str(self.moniker)
+        w.write_u64(self.protocol_p2p).write_u64(self.protocol_block)
+        w.write_str(self.tx_index).write_str(self.rpc_address)
+        return w.bytes()
+
+    @classmethod
+    def decode(cls, data: bytes) -> "NodeInfo":
+        r = Reader(data)
+        return cls(
+            node_id=r.read_str(),
+            listen_addr=r.read_str(),
+            network=r.read_str(),
+            version=r.read_str(),
+            channels=r.read_bytes(),
+            moniker=r.read_str(),
+            protocol_p2p=r.read_u64(),
+            protocol_block=r.read_u64(),
+            tx_index=r.read_str(),
+            rpc_address=r.read_str(),
+        )

@@ -1,0 +1,107 @@
+"""Peer: one connected remote node.
+
+Reference: p2p/peer.go — Peer interface :18, peer struct :95; Send/
+TrySend route through the MConnection channel; per-peer key-value data
+(`Set/Get`) carries reactor state (consensus PeerState).
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Any, Dict, List, Optional
+
+from tendermint_tpu.p2p.conn.connection import ChannelDescriptor, MConnection
+from tendermint_tpu.p2p.netaddress import NetAddress
+from tendermint_tpu.p2p.node_info import NodeInfo
+from tendermint_tpu.p2p.transport import UpgradedConn
+from tendermint_tpu.utils.log import get_logger
+
+
+class Peer:
+    def __init__(
+        self,
+        up: UpgradedConn,
+        channel_descs: List[ChannelDescriptor],
+        on_receive,  # async (peer, ch_id, msg_bytes)
+        on_error,  # async (peer, err)
+        flush_throttle_ms: int = 100,
+        send_rate: int = 5_120_000,
+        recv_rate: int = 5_120_000,
+        logger=None,
+    ):
+        self._up = up
+        self.node_info = up.node_info
+        self.outbound = up.outbound
+        self.persistent = False
+        self.logger = logger or get_logger("p2p.peer")
+        self._data: Dict[str, Any] = {}
+        self._on_receive = on_receive
+        self._on_error = on_error
+        self.mconn = MConnection(
+            up.conn,
+            channel_descs,
+            on_receive=self._receive,
+            on_error=self._error,
+            flush_throttle_ms=flush_throttle_ms,
+            send_rate=send_rate,
+            recv_rate=recv_rate,
+            logger=self.logger,
+        )
+
+    # -- identity ----------------------------------------------------------
+
+    @property
+    def id(self) -> str:
+        return self.node_info.node_id
+
+    def socket_addr(self) -> NetAddress:
+        host, port = self._up.remote_addr
+        return NetAddress(self.id, host, port)
+
+    def listen_addr(self) -> Optional[NetAddress]:
+        """The address the peer claims to accept connections at."""
+        la = self.node_info.listen_addr
+        if not la:
+            return None
+        try:
+            addr = NetAddress.parse(f"{self.id}@{la}")
+        except Exception:
+            return None
+        # 0.0.0.0 listen → substitute the socket host
+        if addr.host in ("0.0.0.0", "::"):
+            addr = NetAddress(self.id, self._up.remote_addr[0], addr.port)
+        return addr
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self) -> None:
+        self.mconn.start()
+
+    async def stop(self) -> None:
+        await self.mconn.stop()
+
+    # -- messaging ---------------------------------------------------------
+
+    async def send(self, ch_id: int, msg: bytes) -> bool:
+        return await self.mconn.send(ch_id, msg)
+
+    def try_send(self, ch_id: int, msg: bytes) -> bool:
+        return self.mconn.try_send(ch_id, msg)
+
+    async def _receive(self, ch_id: int, msg: bytes) -> None:
+        await self._on_receive(self, ch_id, msg)
+
+    async def _error(self, err: Exception) -> None:
+        await self._on_error(self, err)
+
+    # -- per-peer data (reference Set/Get p2p/peer.go) ---------------------
+
+    def set(self, key: str, value: Any) -> None:
+        self._data[key] = value
+
+    def get(self, key: str) -> Any:
+        return self._data.get(key)
+
+    def __repr__(self) -> str:
+        arrow = "out" if self.outbound else "in"
+        return f"Peer{{{self.id[:12]} {arrow}}}"

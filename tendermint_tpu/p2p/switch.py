@@ -1,0 +1,243 @@
+"""Switch: peer lifecycle + reactor message dispatch.
+
+Reference: p2p/switch.go — Switch :69, AddReactor :206, Broadcast :262,
+StopPeerForError :323, reconnectToPeer :376 (exponential backoff),
+acceptRoutine :596, addPeer :770; Reactor interface p2p/base_reactor.go:15.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import random
+from typing import Dict, List, Optional
+
+from tendermint_tpu.p2p.conn.connection import ChannelDescriptor
+from tendermint_tpu.p2p.netaddress import NetAddress
+from tendermint_tpu.p2p.peer import Peer
+from tendermint_tpu.p2p.transport import Transport, UpgradedConn
+from tendermint_tpu.utils.log import get_logger
+from tendermint_tpu.utils.service import Service
+
+RECONNECT_ATTEMPTS = 20
+RECONNECT_BASE_S = 3.0
+
+
+class Reactor:
+    """Reference p2p.Reactor (base_reactor.go:15)."""
+
+    def __init__(self, name: str):
+        self.name = name
+        self.switch: Optional["Switch"] = None
+
+    def get_channels(self) -> List[ChannelDescriptor]:
+        raise NotImplementedError
+
+    async def start(self) -> None:
+        pass
+
+    async def stop(self) -> None:
+        pass
+
+    async def init_peer(self, peer: Peer) -> None:
+        """Called before the peer starts (reference InitPeer)."""
+
+    async def add_peer(self, peer: Peer) -> None:
+        """Called once the peer is started."""
+
+    async def remove_peer(self, peer: Peer, reason: str) -> None:
+        pass
+
+    async def receive(self, ch_id: int, peer: Peer, msg_bytes: bytes) -> None:
+        raise NotImplementedError
+
+
+class Switch(Service):
+    def __init__(
+        self,
+        transport: Transport,
+        config=None,  # P2PConfig
+        logger=None,
+    ):
+        super().__init__("p2p.switch")
+        self.logger = logger or get_logger("p2p.switch")
+        self.transport = transport
+        self.config = config
+        self.reactors: Dict[str, Reactor] = {}
+        self._reactors_by_ch: Dict[int, Reactor] = {}
+        self._channel_descs: List[ChannelDescriptor] = []
+        self.peers: Dict[str, Peer] = {}
+        self._dialing: set = set()
+        self._reconnecting: set = set()
+        self.persistent_peers: List[NetAddress] = []
+        self._max_inbound = config.max_num_inbound_peers if config else 40
+        self._max_outbound = config.max_num_outbound_peers if config else 10
+
+    # -- reactor registry --------------------------------------------------
+
+    def add_reactor(self, name: str, reactor: Reactor) -> Reactor:
+        for desc in reactor.get_channels():
+            if desc.id in self._reactors_by_ch:
+                raise ValueError(f"channel {desc.id:#x} already registered")
+            self._reactors_by_ch[desc.id] = reactor
+            self._channel_descs.append(desc)
+        self.reactors[name] = reactor
+        reactor.switch = self
+        return reactor
+
+    # -- lifecycle ---------------------------------------------------------
+
+    async def on_start(self) -> None:
+        for reactor in self.reactors.values():
+            await reactor.start()
+        self.spawn(self._accept_routine())
+
+    async def on_stop(self) -> None:
+        for peer in list(self.peers.values()):
+            await self._stop_and_remove_peer(peer, "switch stopping")
+        for reactor in self.reactors.values():
+            await reactor.stop()
+        await self.transport.close()
+
+    # -- peer management ---------------------------------------------------
+
+    def num_peers(self) -> tuple:
+        out = sum(1 for p in self.peers.values() if p.outbound)
+        return out, len(self.peers) - out  # (outbound, inbound)
+
+    async def _accept_routine(self) -> None:
+        while True:
+            up = await self.transport.accept()
+            _, inbound = self.num_peers()
+            if inbound >= self._max_inbound:
+                self.logger.info("rejecting inbound: full", id=up.node_id[:12])
+                up.conn.close()
+                continue
+            try:
+                await self._add_peer(up)
+            except Exception as e:
+                self.logger.error("failed to add inbound peer", err=str(e))
+                up.conn.close()
+
+    async def _add_peer(self, up: UpgradedConn) -> Peer:
+        if up.node_id in self.peers:
+            up.conn.close()
+            raise ValueError(f"duplicate peer {up.node_id[:12]}")
+        cfg = self.config
+        peer = Peer(
+            up,
+            self._channel_descs,
+            on_receive=self._on_peer_receive,
+            on_error=self._on_peer_error,
+            flush_throttle_ms=cfg.flush_throttle_timeout_ms if cfg else 100,
+            send_rate=cfg.send_rate if cfg else 5_120_000,
+            recv_rate=cfg.recv_rate if cfg else 5_120_000,
+        )
+        for reactor in self.reactors.values():
+            await reactor.init_peer(peer)
+        peer.start()
+        self.peers[peer.id] = peer
+        for reactor in self.reactors.values():
+            await reactor.add_peer(peer)
+        self.logger.info("added peer", peer=repr(peer), total=len(self.peers))
+        return peer
+
+    async def _on_peer_receive(self, peer: Peer, ch_id: int, msg: bytes) -> None:
+        reactor = self._reactors_by_ch.get(ch_id)
+        if reactor is None:
+            await self.stop_peer_for_error(peer, f"unknown channel {ch_id:#x}")
+            return
+        try:
+            await reactor.receive(ch_id, peer, msg)
+        except Exception as e:
+            self.logger.error(
+                "reactor receive error", reactor=reactor.name, err=repr(e)
+            )
+            await self.stop_peer_for_error(peer, f"receive error: {e}")
+
+    async def _on_peer_error(self, peer: Peer, err: Exception) -> None:
+        await self.stop_peer_for_error(peer, str(err))
+
+    async def stop_peer_for_error(self, peer: Peer, reason: str) -> None:
+        """Reference StopPeerForError :323 (+ persistent reconnect)."""
+        if peer.id not in self.peers:
+            return
+        self.logger.info("stopping peer for error", peer=repr(peer), err=reason)
+        await self._stop_and_remove_peer(peer, reason)
+        if peer.persistent:
+            addr = peer.listen_addr() or peer.socket_addr()
+            self.spawn(self._reconnect_to_peer(addr))
+
+    async def stop_peer_gracefully(self, peer: Peer) -> None:
+        await self._stop_and_remove_peer(peer, "graceful stop")
+
+    async def _stop_and_remove_peer(self, peer: Peer, reason: str) -> None:
+        self.peers.pop(peer.id, None)
+        await peer.stop()
+        for reactor in self.reactors.values():
+            await reactor.remove_peer(peer, reason)
+
+    # -- dialing -----------------------------------------------------------
+
+    async def dial_peer(self, addr: NetAddress, persistent: bool = False) -> Optional[Peer]:
+        if addr.id in self.peers or addr.id in self._dialing:
+            return None
+        if self.transport.listen_addr and addr.id == self.transport.listen_addr.id:
+            return None  # self
+        self._dialing.add(addr.id)
+        try:
+            up = await self.transport.dial(addr)
+            peer = await self._add_peer(up)
+            peer.persistent = persistent
+            return peer
+        finally:
+            self._dialing.discard(addr.id)
+
+    def dial_peers_async(self, addrs: List[NetAddress], persistent: bool = False) -> None:
+        """Reference DialPeersAsync :113 region."""
+        if persistent:
+            self.persistent_peers.extend(addrs)
+        for addr in addrs:
+            self.spawn(self._dial_with_retry(addr, persistent))
+
+    async def _dial_with_retry(self, addr: NetAddress, persistent: bool) -> None:
+        try:
+            await self.dial_peer(addr, persistent=persistent)
+        except Exception as e:
+            self.logger.info("dial failed", addr=str(addr), err=str(e))
+            if persistent:
+                await self._reconnect_to_peer(addr)
+
+    async def _reconnect_to_peer(self, addr: NetAddress) -> None:
+        """Exponential backoff reconnect (reference reconnectToPeer :376)."""
+        if addr.id in self._reconnecting:
+            return
+        self._reconnecting.add(addr.id)
+        try:
+            for attempt in range(RECONNECT_ATTEMPTS):
+                if not self.is_running:
+                    return
+                await asyncio.sleep(
+                    min(RECONNECT_BASE_S * (1.3 ** attempt), 60.0)
+                    * (0.8 + 0.4 * random.random())
+                )
+                if addr.id in self.peers:
+                    return
+                try:
+                    peer = await self.dial_peer(addr, persistent=True)
+                    if peer is not None or addr.id in self.peers:
+                        return
+                except Exception as e:
+                    self.logger.debug(
+                        "reconnect attempt failed", addr=str(addr), n=attempt, err=str(e)
+                    )
+            self.logger.error("gave up reconnecting", addr=str(addr))
+        finally:
+            self._reconnecting.discard(addr.id)
+
+    # -- broadcast ---------------------------------------------------------
+
+    def broadcast(self, ch_id: int, msg: bytes) -> None:
+        """Queue msg to every peer (reference Broadcast :262 — async sends,
+        no success guarantee)."""
+        for peer in list(self.peers.values()):
+            peer.try_send(ch_id, msg)

@@ -1,0 +1,156 @@
+"""Transport: TCP listen/dial upgraded to authenticated connections.
+
+Reference: p2p/transport.go — MultiplexTransport (accept/dial, upgrade to
+SecretConnection, NodeInfo exchange, timeouts, duplicate/ID checks),
+wired in node/node.go:416-483.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from dataclasses import dataclass
+from typing import Callable, Optional, Tuple
+
+from tendermint_tpu.p2p.conn.secret_connection import SecretConnection
+from tendermint_tpu.p2p.key import NodeKey, node_id_from_pubkey
+from tendermint_tpu.p2p.netaddress import NetAddress
+from tendermint_tpu.p2p.node_info import NodeInfo
+from tendermint_tpu.utils.log import get_logger
+
+
+class TransportError(Exception):
+    pass
+
+
+class ErrRejected(TransportError):
+    """Peer rejected during handshake (id mismatch, incompatible, filtered)."""
+
+
+@dataclass
+class UpgradedConn:
+    """An authenticated, identity-checked connection ready for MConnection."""
+
+    conn: SecretConnection
+    node_info: NodeInfo
+    remote_addr: Tuple[str, int]
+    outbound: bool
+
+    @property
+    def node_id(self) -> str:
+        return self.node_info.node_id
+
+
+class Transport:
+    """Reference MultiplexTransport p2p/transport.go."""
+
+    def __init__(
+        self,
+        node_key: NodeKey,
+        node_info_provider: Callable[[], NodeInfo],
+        handshake_timeout_s: float = 20.0,
+        dial_timeout_s: float = 3.0,
+        logger=None,
+    ):
+        self._node_key = node_key
+        self._node_info_provider = node_info_provider
+        self._handshake_timeout_s = handshake_timeout_s
+        self._dial_timeout_s = dial_timeout_s
+        self.logger = logger or get_logger("p2p.transport")
+        self._server: Optional[asyncio.base_events.Server] = None
+        self._accept_queue: asyncio.Queue = asyncio.Queue(maxsize=64)
+        self.listen_addr: Optional[NetAddress] = None
+
+    # -- listening ---------------------------------------------------------
+
+    async def listen(self, host: str = "127.0.0.1", port: int = 0) -> NetAddress:
+        self._server = await asyncio.start_server(self._handle_inbound, host, port)
+        sock = self._server.sockets[0]
+        actual_host, actual_port = sock.getsockname()[:2]
+        self.listen_addr = NetAddress(self._node_key.id, actual_host, actual_port)
+        self.logger.info("p2p listening", addr=str(self.listen_addr))
+        return self.listen_addr
+
+    async def _handle_inbound(
+        self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter
+    ) -> None:
+        peer_host, peer_port = writer.get_extra_info("peername")[:2]
+        try:
+            up = await asyncio.wait_for(
+                self._upgrade(reader, writer, expected_id="", outbound=False,
+                              remote_addr=(peer_host, peer_port)),
+                self._handshake_timeout_s,
+            )
+        except Exception as e:
+            self.logger.debug("inbound upgrade failed", err=str(e), host=peer_host)
+            writer.close()
+            return
+        try:
+            self._accept_queue.put_nowait(up)
+        except asyncio.QueueFull:
+            self.logger.error("accept queue full; dropping inbound peer")
+            up.conn.close()
+
+    async def accept(self) -> UpgradedConn:
+        """Next fully-upgraded inbound connection (reference acceptPeers)."""
+        return await self._accept_queue.get()
+
+    # -- dialing -----------------------------------------------------------
+
+    async def dial(self, addr: NetAddress) -> UpgradedConn:
+        try:
+            reader, writer = await asyncio.wait_for(
+                asyncio.open_connection(addr.host, addr.port), self._dial_timeout_s
+            )
+        except (OSError, asyncio.TimeoutError) as e:
+            raise TransportError(f"dial {addr}: {e}")
+        try:
+            return await asyncio.wait_for(
+                self._upgrade(reader, writer, expected_id=addr.id, outbound=True,
+                              remote_addr=(addr.host, addr.port)),
+                self._handshake_timeout_s,
+            )
+        except Exception:
+            writer.close()
+            raise
+
+    # -- upgrade -----------------------------------------------------------
+
+    async def _upgrade(
+        self,
+        reader: asyncio.StreamReader,
+        writer: asyncio.StreamWriter,
+        expected_id: str,
+        outbound: bool,
+        remote_addr: Tuple[str, int],
+    ) -> UpgradedConn:
+        """secret handshake → identity check → NodeInfo exchange →
+        compatibility check (reference upgrade p2p/transport.go:412)."""
+        sc = await SecretConnection.make(reader, writer, self._node_key.priv_key)
+        remote_id = node_id_from_pubkey(sc.remote_pubkey)
+        if expected_id and remote_id != expected_id:
+            raise ErrRejected(f"conn id {remote_id} != dialed id {expected_id}")
+
+        our_info = self._node_info_provider()
+        await sc.write_msg(our_info.encode())
+        their_info = NodeInfo.decode(await sc.read_msg(max_size=1 << 16))
+        err = their_info.validate()
+        if err:
+            raise ErrRejected(f"invalid NodeInfo: {err}")
+        if their_info.node_id != remote_id:
+            raise ErrRejected(
+                f"NodeInfo id {their_info.node_id} != conn id {remote_id}"
+            )
+        if their_info.node_id == our_info.node_id:
+            raise ErrRejected("self connection")
+        err = our_info.compatible_with(their_info)
+        if err:
+            raise ErrRejected(err)
+        return UpgradedConn(
+            conn=sc, node_info=their_info, remote_addr=remote_addr, outbound=outbound
+        )
+
+    async def close(self) -> None:
+        if self._server is not None:
+            self._server.close()
+            await self._server.wait_closed()
+            self._server = None

@@ -63,10 +63,13 @@ class Service:
         self._stopped = True
         self.logger.debug("stopping %s", self.name)
         await self.on_stop()
-        for t in self._tasks:
+        # a spawned task may itself trigger stop(); never cancel/await self
+        cur = asyncio.current_task()
+        tasks = [t for t in self._tasks if t is not cur]
+        for t in tasks:
             t.cancel()
-        if self._tasks:
-            await asyncio.gather(*self._tasks, return_exceptions=True)
+        if tasks:
+            await asyncio.gather(*tasks, return_exceptions=True)
         self._tasks.clear()
         if self._quit is not None:
             self._quit.set()

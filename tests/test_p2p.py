@@ -1,0 +1,298 @@
+"""P2P stack: secret connection, mconnection, transport, switch.
+
+Mirrors reference p2p/conn/secret_connection_test.go,
+p2p/conn/connection_test.go, p2p/transport_test.go, p2p/switch_test.go.
+"""
+
+import asyncio
+
+import pytest
+
+from tendermint_tpu.crypto.keys import Ed25519PrivKey
+from tendermint_tpu.p2p.conn.connection import (
+    ChannelDescriptor,
+    MConnection,
+    StreamAdapter,
+)
+from tendermint_tpu.p2p.conn.secret_connection import SecretConnection
+from tendermint_tpu.p2p.key import NodeKey, node_id_from_pubkey
+from tendermint_tpu.p2p.netaddress import ErrNetAddressInvalid, NetAddress
+from tendermint_tpu.p2p.node_info import NodeInfo
+from tendermint_tpu.p2p.switch import Reactor, Switch
+from tendermint_tpu.p2p.test_util import (
+    make_connected_switches,
+    make_node_key,
+    make_switch,
+    stop_switches,
+)
+from tendermint_tpu.p2p.transport import ErrRejected, Transport
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+async def tcp_pair():
+    """Two connected (reader, writer) stream pairs over localhost."""
+    ready = asyncio.Queue()
+
+    async def on_conn(r, w):
+        await ready.put((r, w))
+
+    server = await asyncio.start_server(on_conn, "127.0.0.1", 0)
+    host, port = server.sockets[0].getsockname()[:2]
+    client = await asyncio.open_connection(host, port)
+    server_side = await ready.get()
+    return client, server_side, server
+
+
+# -- NetAddress ------------------------------------------------------------
+
+
+def test_netaddress_parse():
+    a = NetAddress.parse("deadbeef" * 5 + "@1.2.3.4:26656")
+    assert a.id == "deadbeef" * 5 and a.host == "1.2.3.4" and a.port == 26656
+    assert str(a) == "deadbeef" * 5 + "@1.2.3.4:26656"
+    b = NetAddress.parse("tcp://127.0.0.1:0")
+    assert b.id == "" and b.port == 0
+    assert b.local() and not b.routable()
+    for bad in ("nope", "1.2.3.4:notaport", "xyz@1.2.3.4:26656", ":26656"):
+        with pytest.raises(ErrNetAddressInvalid):
+            NetAddress.parse(bad)
+
+
+# -- SecretConnection ------------------------------------------------------
+
+
+def test_secret_connection_handshake_and_roundtrip():
+    async def go():
+        (cr, cw), (sr, sw), server = await tcp_pair()
+        k1, k2 = Ed25519PrivKey.generate(), Ed25519PrivKey.generate()
+        sc1, sc2 = await asyncio.gather(
+            SecretConnection.make(cr, cw, k1), SecretConnection.make(sr, sw, k2)
+        )
+        # identity binding
+        assert sc1.remote_pubkey.bytes() == k2.pub_key().bytes()
+        assert sc2.remote_pubkey.bytes() == k1.pub_key().bytes()
+        # data both ways, including > frame-size payloads
+        big = bytes(range(256)) * 20  # 5120 bytes
+        await sc1.write(big)
+        assert await sc2.read_exactly(len(big)) == big
+        await sc2.write(b"pong")
+        assert await sc1.read_exactly(4) == b"pong"
+        sc1.close()
+        sc2.close()
+        server.close()
+
+    run(go())
+
+
+def test_secret_connection_tampering_detected():
+    async def go():
+        (cr, cw), (sr, sw), server = await tcp_pair()
+        k1, k2 = Ed25519PrivKey.generate(), Ed25519PrivKey.generate()
+        sc1, sc2 = await asyncio.gather(
+            SecretConnection.make(cr, cw, k1), SecretConnection.make(sr, sw, k2)
+        )
+        # write a frame, then corrupt ciphertext on the wire by writing
+        # garbage directly to the underlying transport
+        cw.write(b"\x00" * 1040)
+        await cw.drain()
+        with pytest.raises(Exception):
+            await sc2.read_exactly(1)
+        sc1.close()
+        sc2.close()
+        server.close()
+
+    run(go())
+
+
+# -- MConnection -----------------------------------------------------------
+
+
+def test_mconnection_multiplex_and_large_messages():
+    async def go():
+        (cr, cw), (sr, sw), server = await tcp_pair()
+        descs = [ChannelDescriptor(id=0x20, priority=5), ChannelDescriptor(id=0x30, priority=1)]
+        got = asyncio.Queue()
+        errs = []
+
+        async def on_recv(ch, msg):
+            await got.put((ch, msg))
+
+        async def on_err(e):
+            errs.append(e)
+
+        m1 = MConnection(StreamAdapter(cr, cw), descs, on_recv, on_err)
+        m2 = MConnection(StreamAdapter(sr, sw), descs, on_recv, on_err)
+        m1.start()
+        m2.start()
+        big = b"B" * 5000  # spans multiple 1KB packets
+        await m1.send(0x20, b"hello-consensus")
+        await m1.send(0x30, big)
+        r = [await asyncio.wait_for(got.get(), 5) for _ in range(2)]
+        assert (0x20, b"hello-consensus") in r
+        assert (0x30, big) in r
+        await m1.stop()
+        await m2.stop()
+        server.close()
+        assert not errs
+
+    run(go())
+
+
+# -- Transport -------------------------------------------------------------
+
+
+def make_transport(i: int, network="t-net", channels=b"\x20"):
+    nk = make_node_key(i)
+    t_ref = []
+
+    def info():
+        la = t_ref[0].listen_addr
+        return NodeInfo(
+            node_id=nk.id,
+            listen_addr=f"{la.host}:{la.port}" if la else "",
+            network=network,
+            version="1",
+            channels=channels,
+            moniker=f"t{i}",
+        )
+
+    t = Transport(nk, info)
+    t_ref.append(t)
+    return t, nk
+
+
+def test_transport_handshake_and_id_check():
+    async def go():
+        t1, nk1 = make_transport(1)
+        t2, nk2 = make_transport(2)
+        addr1 = await t1.listen("127.0.0.1", 0)
+        accept_task = asyncio.create_task(t1.accept())
+        up = await t2.dial(addr1)
+        assert up.node_info.node_id == nk1.id
+        inbound = await asyncio.wait_for(accept_task, 5)
+        assert inbound.node_info.node_id == nk2.id
+        up.conn.close()
+        inbound.conn.close()
+        await t1.close()
+
+        # dialing with a WRONG expected id is rejected
+        t3, _ = make_transport(3)
+        addr3 = await t3.listen("127.0.0.1", 0)
+        wrong = NetAddress(nk2.id, addr3.host, addr3.port)
+        with pytest.raises(ErrRejected):
+            await t2.dial(wrong)
+        await t3.close()
+
+    run(go())
+
+
+def test_transport_rejects_different_network():
+    async def go():
+        t1, _ = make_transport(1, network="net-A")
+        t2, _ = make_transport(2, network="net-B")
+        addr1 = await t1.listen("127.0.0.1", 0)
+        with pytest.raises(ErrRejected):
+            await t2.dial(addr1)
+        await t1.close()
+
+    run(go())
+
+
+# -- Switch ----------------------------------------------------------------
+
+
+class EchoReactor(Reactor):
+    """Records received messages; echoes on demand."""
+
+    CH = 0x99
+
+    def __init__(self, name="echo"):
+        super().__init__(name)
+        self.received = []
+        self.peers_added = []
+        self.peers_removed = []
+
+    def get_channels(self):
+        return [ChannelDescriptor(id=self.CH, priority=1, send_queue_capacity=10)]
+
+    async def add_peer(self, peer):
+        self.peers_added.append(peer.id)
+
+    async def remove_peer(self, peer, reason):
+        self.peers_removed.append(peer.id)
+
+    async def receive(self, ch_id, peer, msg_bytes):
+        self.received.append((peer.id, msg_bytes))
+
+
+def test_switch_broadcast():
+    async def go():
+        reactors = {}
+
+        def init(i, sw):
+            reactors[i] = sw.add_reactor("echo", EchoReactor())
+
+        switches = await make_connected_switches(3, init=init)
+        try:
+            switches[0].broadcast(EchoReactor.CH, b"blast")
+            for _ in range(300):
+                if len(reactors[1].received) and len(reactors[2].received):
+                    break
+                await asyncio.sleep(0.01)
+            assert (switches[0].transport.listen_addr.id, b"blast") in reactors[1].received
+            assert (switches[0].transport.listen_addr.id, b"blast") in reactors[2].received
+            assert not reactors[0].received
+        finally:
+            await stop_switches(switches)
+
+    run(go())
+
+
+def test_switch_peer_disconnect_notifies_reactors():
+    async def go():
+        reactors = {}
+
+        def init(i, sw):
+            reactors[i] = sw.add_reactor("echo", EchoReactor())
+
+        switches = await make_connected_switches(2, init=init)
+        try:
+            peer = next(iter(switches[0].peers.values()))
+            await switches[0].stop_peer_for_error(peer, "test kill")
+            assert len(switches[0].peers) == 0
+            assert reactors[0].peers_removed == [peer.id]
+            # other side notices the broken conn shortly
+            for _ in range(300):
+                if len(switches[1].peers) == 0:
+                    break
+                await asyncio.sleep(0.01)
+            assert len(switches[1].peers) == 0
+        finally:
+            await stop_switches(switches)
+
+    run(go())
+
+
+def test_switch_no_duplicate_peers():
+    async def go():
+        switches = await make_connected_switches(2)
+        try:
+            # second dial to the same peer is a no-op
+            got = await switches[0].dial_peer(switches[1].transport.listen_addr)
+            assert got is None
+            assert len(switches[0].peers) == 1
+        finally:
+            await stop_switches(switches)
+
+    run(go())
+
+
+def test_node_key_roundtrip(tmp_path):
+    nk = NodeKey.generate()
+    p = str(tmp_path / "node_key.json")
+    nk.save_as(p)
+    nk2 = NodeKey.load(p)
+    assert nk2.id == nk.id == node_id_from_pubkey(nk.pub_key())
