@@ -1,0 +1,247 @@
+"""PeerState: consensus-reactor bookkeeping for one peer.
+
+Reference: consensus/reactor.go — PeerState :846, SetHasProposal :946,
+SetHasProposalBlockPart :1028, PickSendVote :1036, getVoteBitArray :893,
+ensureVoteBitArrays :1132, SetHasVote :1182, ApplyNewRoundStepMessage
+:1197, ApplyNewValidBlockMessage :1246, ApplyProposalPOLMessage :1271,
+ApplyHasVoteMessage :1288, ApplyVoteSetBitsMessage :1300.
+"""
+
+from __future__ import annotations
+
+import random
+import time
+from typing import Optional
+
+from tendermint_tpu.codec.signbytes import PRECOMMIT_TYPE, PREVOTE_TYPE
+from tendermint_tpu.consensus.messages import (
+    HasVoteMessage,
+    NewRoundStepMessage,
+    NewValidBlockMessage,
+    ProposalPOLMessage,
+    VoteSetBitsMessage,
+)
+from tendermint_tpu.consensus.peer_round_state import PeerRoundState
+from tendermint_tpu.consensus.round_state import STEP_NEW_HEIGHT, STEP_PROPOSE
+from tendermint_tpu.types.block import Commit
+from tendermint_tpu.types.proposal import Proposal
+from tendermint_tpu.types.vote import Vote
+from tendermint_tpu.utils.bits import BitArray
+
+
+class PeerState:
+    def __init__(self, peer_id: str):
+        self.peer_id = peer_id
+        self.rs = PeerRoundState()
+
+    # -- proposal tracking -------------------------------------------------
+
+    def set_has_proposal(self, proposal: Proposal) -> None:
+        prs = self.rs
+        if prs.height != proposal.height or prs.round != proposal.round:
+            return
+        if prs.proposal:
+            return
+        prs.proposal = True
+        if prs.proposal_block_parts is not None:
+            return  # already tracked via NewValidBlock
+        prs.proposal_block_parts_header = proposal.block_id.parts
+        prs.proposal_block_parts = BitArray(proposal.block_id.parts.total)
+        prs.proposal_pol_round = proposal.pol_round
+        prs.proposal_pol = None  # until ProposalPOLMessage arrives
+
+    def init_proposal_block_parts(self, parts_header) -> None:
+        """Catchup: start tracking parts of an old committed block."""
+        prs = self.rs
+        if prs.proposal_block_parts is not None:
+            return
+        prs.proposal_block_parts_header = parts_header
+        prs.proposal_block_parts = BitArray(parts_header.total)
+
+    def set_has_proposal_block_part(self, height: int, round_: int, index: int) -> None:
+        prs = self.rs
+        if prs.height != height or prs.round != round_:
+            return
+        if prs.proposal_block_parts is None:
+            return
+        if 0 <= index < len(prs.proposal_block_parts):
+            prs.proposal_block_parts.set_index(index, True)
+
+    # -- vote tracking -----------------------------------------------------
+
+    def ensure_vote_bit_arrays(self, height: int, num_validators: int) -> None:
+        """Reference ensureVoteBitArrays :1132."""
+        prs = self.rs
+        if prs.height == height:
+            if prs.prevotes is None:
+                prs.prevotes = BitArray(num_validators)
+            if prs.precommits is None:
+                prs.precommits = BitArray(num_validators)
+            if prs.catchup_commit is None:
+                prs.catchup_commit = BitArray(num_validators)
+            if prs.proposal_pol is None:
+                prs.proposal_pol = BitArray(num_validators)
+        elif prs.height == height + 1:
+            if prs.last_commit is None:
+                prs.last_commit = BitArray(num_validators)
+
+    def set_has_vote(self, height: int, round_: int, vote_type: int, index: int) -> None:
+        arr = self._get_vote_bit_array(height, round_, vote_type)
+        if arr is not None and 0 <= index < len(arr):
+            arr.set_index(index, True)
+
+    def _get_vote_bit_array(self, height: int, round_: int, vote_type: int) -> Optional[BitArray]:
+        """Reference getVoteBitArray :893."""
+        prs = self.rs
+        if prs.height == height:
+            if prs.round == round_:
+                return prs.prevotes if vote_type == PREVOTE_TYPE else prs.precommits
+            if prs.catchup_commit_round == round_ and vote_type == PRECOMMIT_TYPE:
+                return prs.catchup_commit
+            if prs.proposal_pol_round == round_ and vote_type == PREVOTE_TYPE:
+                return prs.proposal_pol
+            return None
+        if prs.height == height + 1:
+            if prs.last_commit_round == round_ and vote_type == PRECOMMIT_TYPE:
+                return prs.last_commit
+            return None
+        return None
+
+    def pick_send_vote(self, votes) -> Optional[Vote]:
+        """Pick a random vote the peer needs (reference PickSendVote :1036
+        + PickVoteToSend :1059). `votes` is a VoteSet or _CommitVotes."""
+        size = votes.size()
+        if size == 0:
+            return None
+        height, round_, vote_type = votes.height, votes.round, votes.signed_msg_type
+        self.ensure_vote_bit_arrays(height, size)
+        ps_votes = self._get_vote_bit_array(height, round_, vote_type)
+        if ps_votes is None:
+            return None
+        needed = votes.bit_array().sub(ps_votes)
+        idx = needed.pick_random()
+        if idx is None:
+            return None
+        vote = votes.get_by_index(idx)
+        if vote is not None:
+            self.set_has_vote(height, round_, vote_type, idx)
+        return vote
+
+    def ensure_catchup_commit_round(self, height: int, round_: int, num_validators: int) -> None:
+        """Reference EnsureCatchupCommitRound :1107."""
+        prs = self.rs
+        if prs.height != height:
+            return
+        if prs.catchup_commit_round == round_:
+            return
+        prs.catchup_commit_round = round_
+        prs.catchup_commit = BitArray(num_validators)
+
+    # -- message application ----------------------------------------------
+
+    def apply_new_round_step(self, msg: NewRoundStepMessage) -> None:
+        """Reference ApplyNewRoundStepMessage :1197."""
+        prs = self.rs
+        ps_height, ps_round = prs.height, prs.round
+        ps_catchup_round = prs.catchup_commit_round
+        ps_precommits = prs.precommits
+
+        prs.height = msg.height
+        prs.round = msg.round
+        prs.step = msg.step
+        prs.start_time_ns = time.time_ns() - msg.seconds_since_start_time * 1_000_000_000
+
+        if ps_height != msg.height or ps_round != msg.round:
+            prs.proposal = False
+            prs.proposal_block_parts_header = None
+            prs.proposal_block_parts = None
+            prs.proposal_pol_round = -1
+            prs.proposal_pol = None
+            prs.prevotes = None
+            prs.precommits = None
+        if ps_height == msg.height and ps_round != msg.round and msg.round == ps_catchup_round:
+            # peer caught up to the round we have the catchup commit for
+            prs.precommits = prs.catchup_commit
+        if ps_height != msg.height:
+            if ps_height + 1 == msg.height and ps_round == msg.last_commit_round:
+                prs.last_commit_round = msg.last_commit_round
+                prs.last_commit = ps_precommits
+            else:
+                prs.last_commit_round = msg.last_commit_round
+                prs.last_commit = None
+            prs.catchup_commit_round = -1
+            prs.catchup_commit = None
+
+    def apply_new_valid_block(self, msg: NewValidBlockMessage) -> None:
+        """Reference ApplyNewValidBlockMessage :1246."""
+        prs = self.rs
+        if prs.height != msg.height:
+            return
+        if prs.round != msg.round and not msg.is_commit:
+            return
+        prs.proposal_block_parts_header = msg.block_parts_header
+        prs.proposal_block_parts = msg.block_parts
+
+    def apply_proposal_pol(self, msg: ProposalPOLMessage) -> None:
+        prs = self.rs
+        if prs.height != msg.height:
+            return
+        if prs.proposal_pol_round != msg.proposal_pol_round:
+            return
+        prs.proposal_pol = msg.proposal_pol
+
+    def apply_has_vote(self, msg: HasVoteMessage) -> None:
+        if self.rs.height != msg.height:
+            return
+        self.set_has_vote(msg.height, msg.round, msg.vote_type, msg.index)
+
+    def apply_vote_set_bits(self, msg: VoteSetBitsMessage, our_votes: Optional[BitArray]) -> None:
+        """Reference ApplyVoteSetBitsMessage :1300: if we know our own
+        maj23 votes for this BlockID, merge (peer-bits OR our-bits hint)."""
+        arr = self._get_vote_bit_array(msg.height, msg.round, msg.vote_type)
+        if arr is None or msg.votes is None:
+            return
+        if our_votes is None:
+            new = msg.votes
+        else:
+            # (their bits we can't infer) = votes - ours, then OR claimed
+            new = arr.sub(our_votes).or_(msg.votes)
+        for i in range(min(len(arr), len(new))):
+            arr.set_index(i, new.get_index(i))
+
+    def __repr__(self) -> str:
+        return f"PeerState{{{self.peer_id[:12]} {self.rs!r}}}"
+
+
+class CommitVotes:
+    """Adapter presenting a stored Commit as a pickable vote source
+    (reference uses types.Commit with PickSendVote via VoteSetReader)."""
+
+    def __init__(self, commit: Commit):
+        self._commit = commit
+        self.height = commit.height
+        self.round = commit.round
+        self.signed_msg_type = PRECOMMIT_TYPE
+
+    def size(self) -> int:
+        return len(self._commit.signatures)
+
+    def bit_array(self) -> BitArray:
+        return BitArray.from_bools(
+            [not s.absent_() for s in self._commit.signatures]
+        )
+
+    def get_by_index(self, idx: int) -> Optional[Vote]:
+        cs = self._commit.signatures[idx]
+        if cs.absent_():
+            return None
+        return Vote(
+            vote_type=PRECOMMIT_TYPE,
+            height=self._commit.height,
+            round=self._commit.round,
+            block_id=cs.block_id(self._commit.block_id),
+            timestamp_ns=cs.timestamp_ns,
+            validator_address=cs.validator_address,
+            validator_index=idx,
+            signature=cs.signature,
+        )

@@ -1,0 +1,426 @@
+"""Consensus reactor: gossips proposals, block parts, and votes.
+
+Reference: consensus/reactor.go — Reactor :38 with 4 p2p channels
+(State 0x20, Data 0x21, Vote 0x22, VoteSetBits 0x23; :23-27 and channel
+descriptors :131-160), Receive :214, broadcast evsw listeners :405/:422,
+gossipDataRoutine :467, gossipVotesRoutine :606, queryMaj23Routine :738.
+
+Per peer: three gossip asyncio tasks (data/votes/maj23) — the direct
+analog of the reference's three goroutines per peer.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+from typing import Dict, Optional
+
+from tendermint_tpu.codec.signbytes import PRECOMMIT_TYPE, PREVOTE_TYPE
+from tendermint_tpu.consensus import messages as m
+from tendermint_tpu.consensus.peer_state import CommitVotes, PeerState
+from tendermint_tpu.consensus.round_state import (
+    STEP_NEW_HEIGHT,
+    STEP_PRECOMMIT_WAIT,
+    STEP_PREVOTE_WAIT,
+    STEP_PROPOSE,
+)
+from tendermint_tpu.consensus.state import (
+    EVENT_COMMITTED,
+    EVENT_NEW_ROUND_STEP,
+    EVENT_VALID_BLOCK,
+    EVENT_VOTE,
+    ConsensusState,
+)
+from tendermint_tpu.p2p.conn.connection import ChannelDescriptor
+from tendermint_tpu.p2p.peer import Peer
+from tendermint_tpu.p2p.switch import Reactor
+from tendermint_tpu.utils.log import get_logger
+
+STATE_CHANNEL = 0x20
+DATA_CHANNEL = 0x21
+VOTE_CHANNEL = 0x22
+VOTE_SET_BITS_CHANNEL = 0x23
+
+PEER_STATE_KEY = "ConsensusReactor.peerState"
+
+
+class ConsensusReactor(Reactor):
+    def __init__(self, cs: ConsensusState, wait_sync: bool = False, logger=None):
+        super().__init__("consensus")
+        self.cs = cs
+        self.wait_sync = wait_sync  # fast-syncing: consensus dormant
+        self.logger = logger or get_logger("consensus.reactor")
+        self._peer_tasks: Dict[str, list] = {}
+        self._gossip_sleep_s = cs.config.peer_gossip_sleep_duration_ms / 1000.0
+        self._maj23_sleep_s = cs.config.peer_query_maj23_sleep_duration_ms / 1000.0
+
+    def get_channels(self):
+        """Reference channel descriptors consensus/reactor.go:131-160."""
+        return [
+            ChannelDescriptor(id=STATE_CHANNEL, priority=5, send_queue_capacity=100),
+            ChannelDescriptor(id=DATA_CHANNEL, priority=10, send_queue_capacity=100),
+            ChannelDescriptor(id=VOTE_CHANNEL, priority=5, send_queue_capacity=100),
+            ChannelDescriptor(id=VOTE_SET_BITS_CHANNEL, priority=1, send_queue_capacity=2),
+        ]
+
+    # -- lifecycle ---------------------------------------------------------
+
+    async def start(self) -> None:
+        self._subscribe_broadcast_events()
+        if not self.wait_sync:
+            await self.cs.start()
+
+    async def stop(self) -> None:
+        for tasks in self._peer_tasks.values():
+            for t in tasks:
+                t.cancel()
+        self._peer_tasks.clear()
+        if self.cs.is_running:
+            await self.cs.stop()
+
+    async def switch_to_consensus(self, state, skip_wal: bool = False) -> None:
+        """Fast sync complete → start the state machine (reference
+        SwitchToConsensus consensus/reactor.go:102)."""
+        self.cs.update_to_state(state)
+        self.wait_sync = False
+        self.cs._reconstruct_last_commit_if_needed(state)
+        await self.cs.start()
+
+    def _subscribe_broadcast_events(self) -> None:
+        """Reference subscribeToBroadcastEvents :405-434."""
+        self.cs.evsw.add_listener(EVENT_NEW_ROUND_STEP, self._broadcast_new_round_step)
+        self.cs.evsw.add_listener(EVENT_VALID_BLOCK, self._broadcast_new_valid_block)
+        self.cs.evsw.add_listener(EVENT_VOTE, self._broadcast_has_vote)
+
+    # -- broadcasts (sync callbacks from the consensus task) ---------------
+
+    def _make_round_step_msg(self) -> m.NewRoundStepMessage:
+        rs = self.cs.rs
+        return m.NewRoundStepMessage(
+            height=rs.height,
+            round=rs.round,
+            step=rs.step,
+            seconds_since_start_time=max(
+                0, int((time.time_ns() - rs.start_time_ns) / 1e9)
+            ),
+            last_commit_round=rs.last_commit.round if rs.last_commit else -1,
+        )
+
+    def _broadcast_new_round_step(self, _rs) -> None:
+        if self.switch is not None:
+            self.switch.broadcast(STATE_CHANNEL, m.encode_msg(self._make_round_step_msg()))
+
+    def _broadcast_new_valid_block(self, rs) -> None:
+        if self.switch is None or rs.proposal_block_parts is None:
+            return
+        msg = m.NewValidBlockMessage(
+            height=rs.height,
+            round=rs.round,
+            block_parts_header=rs.proposal_block_parts.header(),
+            block_parts=rs.proposal_block_parts.bit_array(),
+            is_commit=rs.step >= 8,  # STEP_COMMIT
+        )
+        self.switch.broadcast(STATE_CHANNEL, m.encode_msg(msg))
+
+    def _broadcast_has_vote(self, vote) -> None:
+        if self.switch is None or vote is None:
+            return
+        msg = m.HasVoteMessage(
+            height=vote.height, round=vote.round,
+            vote_type=vote.vote_type, index=vote.validator_index,
+        )
+        self.switch.broadcast(STATE_CHANNEL, m.encode_msg(msg))
+
+    # -- peer lifecycle ----------------------------------------------------
+
+    async def init_peer(self, peer: Peer) -> None:
+        peer.set(PEER_STATE_KEY, PeerState(peer.id))
+
+    async def add_peer(self, peer: Peer) -> None:
+        """Reference AddPeer :174: send our round step, spawn gossips."""
+        ps: PeerState = peer.get(PEER_STATE_KEY)
+        peer.try_send(STATE_CHANNEL, m.encode_msg(self._make_round_step_msg()))
+        self._peer_tasks[peer.id] = [
+            asyncio.create_task(self._gossip_data_routine(peer, ps)),
+            asyncio.create_task(self._gossip_votes_routine(peer, ps)),
+            asyncio.create_task(self._query_maj23_routine(peer, ps)),
+        ]
+
+    async def remove_peer(self, peer: Peer, reason: str) -> None:
+        for t in self._peer_tasks.pop(peer.id, []):
+            t.cancel()
+
+    # -- receive -----------------------------------------------------------
+
+    async def receive(self, ch_id: int, peer: Peer, msg_bytes: bytes) -> None:
+        """Reference Receive :214."""
+        msg = m.decode_msg(msg_bytes)
+        ps: Optional[PeerState] = peer.get(PEER_STATE_KEY)
+        if ps is None:
+            return
+        cs = self.cs
+
+        if ch_id == STATE_CHANNEL:
+            if isinstance(msg, m.NewRoundStepMessage):
+                ps.apply_new_round_step(msg)
+            elif isinstance(msg, m.NewValidBlockMessage):
+                ps.apply_new_valid_block(msg)
+            elif isinstance(msg, m.HasVoteMessage):
+                ps.apply_has_vote(msg)
+            elif isinstance(msg, m.VoteSetMaj23Message):
+                await self._handle_vote_set_maj23(peer, ps, msg)
+            else:
+                raise ValueError(f"unexpected state-channel message {type(msg).__name__}")
+        elif ch_id == DATA_CHANNEL:
+            if self.wait_sync:
+                return
+            if isinstance(msg, m.ProposalMessage):
+                ps.set_has_proposal(msg.proposal)
+                await cs.add_peer_message(msg, peer.id)
+            elif isinstance(msg, m.ProposalPOLMessage):
+                ps.apply_proposal_pol(msg)
+            elif isinstance(msg, m.BlockPartMessage):
+                ps.set_has_proposal_block_part(msg.height, msg.round, msg.part.index)
+                await cs.add_peer_message(msg, peer.id)
+            else:
+                raise ValueError(f"unexpected data-channel message {type(msg).__name__}")
+        elif ch_id == VOTE_CHANNEL:
+            if self.wait_sync:
+                return
+            if isinstance(msg, m.VoteMessage):
+                height = cs.rs.height
+                size = cs.rs.validators.size() if cs.rs.validators else 0
+                ps.ensure_vote_bit_arrays(height, size)
+                ps.ensure_vote_bit_arrays(height - 1, size)
+                ps.set_has_vote(
+                    msg.vote.height, msg.vote.round, msg.vote.vote_type,
+                    msg.vote.validator_index,
+                )
+                await cs.add_peer_message(msg, peer.id)
+            else:
+                raise ValueError(f"unexpected vote-channel message {type(msg).__name__}")
+        elif ch_id == VOTE_SET_BITS_CHANNEL:
+            if isinstance(msg, m.VoteSetBitsMessage):
+                if cs.rs.height == msg.height and cs.rs.votes is not None:
+                    vs = (
+                        cs.rs.votes.prevotes(msg.round)
+                        if msg.vote_type == PREVOTE_TYPE
+                        else cs.rs.votes.precommits(msg.round)
+                    )
+                    ours = vs.bit_array_by_block_id(msg.block_id) if vs else None
+                else:
+                    ours = None
+                ps.apply_vote_set_bits(msg, ours)
+            else:
+                raise ValueError(f"unexpected bits-channel message {type(msg).__name__}")
+        else:
+            raise ValueError(f"unknown channel {ch_id:#x}")
+
+    async def _handle_vote_set_maj23(self, peer: Peer, ps: PeerState, msg: m.VoteSetMaj23Message) -> None:
+        """Reference Receive StateChannel VoteSetMaj23 :232-260: record the
+        claim, respond with our bits for that BlockID on the bits channel."""
+        cs = self.cs
+        if cs.rs.height != msg.height or cs.rs.votes is None:
+            return
+        cs.rs.votes.set_peer_maj23(msg.round, msg.vote_type, peer.id, msg.block_id)
+        vs = (
+            cs.rs.votes.prevotes(msg.round)
+            if msg.vote_type == PREVOTE_TYPE
+            else cs.rs.votes.precommits(msg.round)
+        )
+        if vs is None:
+            return
+        our_bits = vs.bit_array_by_block_id(msg.block_id)
+        reply = m.VoteSetBitsMessage(
+            height=msg.height, round=msg.round, vote_type=msg.vote_type,
+            block_id=msg.block_id, votes=our_bits,
+        )
+        peer.try_send(VOTE_SET_BITS_CHANNEL, m.encode_msg(reply))
+
+    # -- gossip routines ---------------------------------------------------
+
+    async def _gossip_data_routine(self, peer: Peer, ps: PeerState) -> None:
+        """Reference gossipDataRoutine :467."""
+        try:
+            while True:
+                rs = self.cs.rs
+                prs = ps.rs
+                sent = False
+                if rs.height == prs.height:
+                    sent = await self._gossip_data_same_height(peer, ps)
+                elif (
+                    prs.height != 0
+                    and rs.height > prs.height
+                    and prs.height >= self.cs._block_store.base
+                ):
+                    sent = await self._gossip_data_catchup(peer, ps)
+                if not sent:
+                    await asyncio.sleep(self._gossip_sleep_s)
+        except asyncio.CancelledError:
+            raise
+        except Exception as e:
+            self.logger.error("gossip data routine died", peer=peer.id[:12], err=repr(e))
+
+    async def _gossip_data_same_height(self, peer: Peer, ps: PeerState) -> bool:
+        rs = self.cs.rs
+        prs = ps.rs
+        # 1. send a block part the peer lacks
+        if (
+            rs.proposal_block_parts is not None
+            and prs.proposal_block_parts is not None
+            and prs.proposal_block_parts_header == rs.proposal_block_parts.header()
+        ):
+            have = rs.proposal_block_parts.bit_array()
+            needed = have.sub(prs.proposal_block_parts)
+            idx = needed.pick_random()
+            if idx is not None:
+                part = rs.proposal_block_parts.get_part(idx)
+                if part is not None:
+                    msg = m.BlockPartMessage(rs.height, rs.round, part)
+                    if peer.try_send(DATA_CHANNEL, m.encode_msg(msg)):
+                        ps.set_has_proposal_block_part(prs.height, prs.round, idx)
+                        return True
+        # 2. send the proposal (+POL) if the peer doesn't have it
+        if rs.proposal is not None and not prs.proposal:
+            if peer.try_send(DATA_CHANNEL, m.encode_msg(m.ProposalMessage(rs.proposal))):
+                ps.set_has_proposal(rs.proposal)
+                if rs.proposal.pol_round >= 0 and rs.votes is not None:
+                    pol = rs.votes.prevotes(rs.proposal.pol_round)
+                    if pol is not None:
+                        peer.try_send(
+                            DATA_CHANNEL,
+                            m.encode_msg(
+                                m.ProposalPOLMessage(
+                                    rs.height, rs.proposal.pol_round, pol.bit_array()
+                                )
+                            ),
+                        )
+                return True
+        return False
+
+    async def _gossip_data_catchup(self, peer: Peer, ps: PeerState) -> bool:
+        """Reference gossipDataForCatchup :560: feed an old committed
+        block's parts to a lagging peer."""
+        prs = ps.rs
+        meta = self.cs._block_store.load_block_meta(prs.height)
+        if meta is None:
+            return False
+        if prs.proposal_block_parts is None:
+            ps.init_proposal_block_parts(meta.block_id.parts)
+            return False  # bitarray created; next pass sends
+        if prs.proposal_block_parts_header != meta.block_id.parts:
+            return False
+        needed = prs.proposal_block_parts.not_()
+        idx = needed.pick_random()
+        if idx is None:
+            return False
+        part = self.cs._block_store.load_block_part(prs.height, idx)
+        if part is None:
+            return False
+        msg = m.BlockPartMessage(prs.height, prs.round, part)
+        if peer.try_send(DATA_CHANNEL, m.encode_msg(msg)):
+            ps.set_has_proposal_block_part(prs.height, prs.round, idx)
+            return True
+        return False
+
+    async def _gossip_votes_routine(self, peer: Peer, ps: PeerState) -> None:
+        """Reference gossipVotesRoutine :606."""
+        try:
+            while True:
+                rs = self.cs.rs
+                prs = ps.rs
+                sent = False
+                if rs.height == prs.height:
+                    sent = self._gossip_votes_same_height(peer, ps)
+                elif prs.height != 0 and rs.height == prs.height + 1:
+                    # catchup via our last commit's precommits
+                    if rs.last_commit is not None:
+                        sent = self._pick_send_vote(peer, ps, rs.last_commit)
+                elif (
+                    prs.height != 0
+                    and rs.height >= prs.height + 2
+                    and prs.height >= self.cs._block_store.base
+                ):
+                    commit = self.cs._block_store.load_block_commit(prs.height)
+                    if commit is not None:
+                        sent = self._pick_send_vote(peer, ps, CommitVotes(commit))
+                if not sent:
+                    await asyncio.sleep(self._gossip_sleep_s)
+        except asyncio.CancelledError:
+            raise
+        except Exception as e:
+            self.logger.error("gossip votes routine died", peer=peer.id[:12], err=repr(e))
+
+    def _gossip_votes_same_height(self, peer: Peer, ps: PeerState) -> bool:
+        """Reference gossipVotesForHeight :669."""
+        rs = self.cs.rs
+        prs = ps.rs
+        votes = rs.votes
+        if votes is None:
+            return False
+        # peer is at NewHeight: feed it our last commit
+        if prs.step == STEP_NEW_HEIGHT and rs.last_commit is not None:
+            if self._pick_send_vote(peer, ps, rs.last_commit):
+                return True
+        # peer needs POL prevotes
+        if prs.step <= STEP_PROPOSE and 0 <= prs.proposal_pol_round:
+            pol = votes.prevotes(prs.proposal_pol_round)
+            if pol is not None and self._pick_send_vote(peer, ps, pol):
+                return True
+        # prevotes for the peer's round
+        if prs.step <= STEP_PREVOTE_WAIT and 0 <= prs.round <= rs.round:
+            pv = votes.prevotes(prs.round)
+            if pv is not None and self._pick_send_vote(peer, ps, pv):
+                return True
+        # precommits for the peer's round
+        if prs.step <= STEP_PRECOMMIT_WAIT and 0 <= prs.round <= rs.round:
+            pc = votes.precommits(prs.round)
+            if pc is not None and self._pick_send_vote(peer, ps, pc):
+                return True
+        # prevotes for any earlier peer round
+        if 0 <= prs.round <= rs.round:
+            pv = votes.prevotes(prs.round)
+            if pv is not None and self._pick_send_vote(peer, ps, pv):
+                return True
+        if 0 <= prs.proposal_pol_round:
+            pol = votes.prevotes(prs.proposal_pol_round)
+            if pol is not None and self._pick_send_vote(peer, ps, pol):
+                return True
+        return False
+
+    def _pick_send_vote(self, peer: Peer, ps: PeerState, votes) -> bool:
+        vote = ps.pick_send_vote(votes)
+        if vote is None:
+            return False
+        return peer.try_send(VOTE_CHANNEL, m.encode_msg(m.VoteMessage(vote)))
+
+    async def _query_maj23_routine(self, peer: Peer, ps: PeerState) -> None:
+        """Reference queryMaj23Routine :738: periodically tell peers about
+        our +2/3 observations so they can prove us wrong (via bits)."""
+        try:
+            while True:
+                await asyncio.sleep(self._maj23_sleep_s)
+                rs = self.cs.rs
+                prs = ps.rs
+                if rs.votes is None or rs.height != prs.height:
+                    continue
+                for vote_type, vs in (
+                    (PREVOTE_TYPE, rs.votes.prevotes(prs.round)),
+                    (PRECOMMIT_TYPE, rs.votes.precommits(prs.round)),
+                ):
+                    if vs is None:
+                        continue
+                    maj23, ok = vs.two_thirds_majority()
+                    if ok:
+                        peer.try_send(
+                            STATE_CHANNEL,
+                            m.encode_msg(
+                                m.VoteSetMaj23Message(
+                                    rs.height, prs.round, vote_type, maj23
+                                )
+                            ),
+                        )
+        except asyncio.CancelledError:
+            raise
+        except Exception as e:
+            self.logger.error("maj23 routine died", peer=peer.id[:12], err=repr(e))

@@ -1,0 +1,112 @@
+"""Consensus reactor over real p2p: gossip-driven multi-node consensus.
+
+Mirrors reference consensus/reactor_test.go — TestReactorBasic :97
+(N reactors over connected switches, all advance), vote/block-part
+gossip, and a lagging-peer catchup case.
+"""
+
+import asyncio
+
+import pytest
+
+from tendermint_tpu.consensus.reactor import ConsensusReactor
+from tendermint_tpu.p2p.test_util import make_connected_switches, stop_switches
+from tests.cs_harness import make_genesis, make_node
+
+CHAIN = "cs-harness-chain"
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+async def build_net(n, powers=None):
+    """N full nodes: consensus state + reactor + switch, fully meshed."""
+    genesis, privs = make_genesis(n, powers=powers)
+    nodes = [await make_node(genesis, pv) for pv in privs]
+    reactors = [ConsensusReactor(node.cs) for node in nodes]
+
+    def init(i, sw):
+        sw.add_reactor("consensus", reactors[i])
+
+    switches = await make_connected_switches(n, init=init, network=CHAIN)
+    return nodes, reactors, switches
+
+
+async def wait_heights(nodes, height, timeout_s=60):
+    await asyncio.gather(*(n.cs.wait_for_height(height, timeout_s) for n in nodes))
+
+
+def test_reactor_basic_4_nodes():
+    async def go():
+        nodes, reactors, switches = await build_net(4)
+        try:
+            await wait_heights(nodes, 3)
+            hashes = {n.block_store.load_block(2).hash() for n in nodes}
+            assert len(hashes) == 1
+            commit = nodes[0].block_store.load_seen_commit(2)
+            present = sum(1 for s in commit.signatures if not s.absent_())
+            assert present >= 3
+        finally:
+            await stop_switches(switches)
+
+    run(go())
+
+
+def test_reactor_with_txs():
+    async def go():
+        nodes, reactors, switches = await build_net(4)
+        try:
+            await nodes[1].mempool.check_tx(b"gossip=works")
+            # tx only reaches blocks when node 1 is the proposer OR via
+            # mempool gossip (not built yet) — wait for enough heights
+            # that node 1 proposes at least once
+            await wait_heights(nodes, 6, timeout_s=90)
+            committed = []
+            for h in range(1, nodes[0].block_store.height + 1):
+                blk = nodes[0].block_store.load_block(h)
+                committed += [bytes(t) for t in blk.data.txs]
+            assert b"gossip=works" in committed
+        finally:
+            await stop_switches(switches)
+
+    run(go())
+
+
+def test_reactor_peer_catchup_via_gossip():
+    """A node connected LATE catches up from peers' stored blocks
+    (gossip_data_catchup + CommitVotes path)."""
+
+    async def go():
+        genesis, privs = make_genesis(4)
+        # start only 3 validators (they have >2/3 and progress)
+        nodes = [await make_node(genesis, pv) for pv in privs]
+        reactors = [ConsensusReactor(n.cs) for n in nodes]
+
+        def init3(i, sw):
+            sw.add_reactor("consensus", reactors[i])
+
+        from tendermint_tpu.p2p.test_util import make_switch, connect_switches
+
+        switches = []
+        for i in range(3):
+            switches.append(
+                await make_switch(i, network=CHAIN, init=lambda s, _i=i: init3(_i, s))
+            )
+        for sw in switches:
+            await sw.start()
+        await connect_switches(switches)
+        try:
+            await wait_heights(nodes[:3], 3)
+            # now bring up the 4th node and connect it
+            sw4 = await make_switch(3, network=CHAIN, init=lambda s: init3(3, s))
+            await sw4.start()
+            switches.append(sw4)
+            for sw in switches[:3]:
+                await sw4.dial_peer(sw.transport.listen_addr)
+            # the late node catches up and joins consensus
+            await nodes[3].cs.wait_for_height(4, timeout_s=90)
+        finally:
+            await stop_switches(switches)
+
+    run(go())
