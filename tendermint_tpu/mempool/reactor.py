@@ -1,0 +1,88 @@
+"""Mempool reactor: gossips transactions to peers.
+
+Reference: mempool/reactor.go — Reactor :28, channel 0x30 (:24,
+MempoolChannel), Receive :160 (CheckTx with the sender recorded so we
+don't echo a tx back to its source), broadcastTxRoutine :193 (per-peer
+goroutine walking the clist; here the mempool's seq cursor), peer-height
+gating (don't send txs validated at a height the peer hasn't reached).
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Dict
+
+from tendermint_tpu.codec.binary import Reader, Writer
+from tendermint_tpu.mempool.mempool import ErrMempoolIsFull, ErrTxInCache, Mempool
+from tendermint_tpu.p2p.conn.connection import ChannelDescriptor
+from tendermint_tpu.p2p.peer import Peer
+from tendermint_tpu.p2p.switch import Reactor
+from tendermint_tpu.utils.log import get_logger
+
+MEMPOOL_CHANNEL = 0x30
+
+PEER_HEIGHT_KEY = "MempoolReactor.peerHeight"
+
+
+def encode_txs(txs) -> bytes:
+    w = Writer()
+    w.write_uvarint(len(txs))
+    for tx in txs:
+        w.write_bytes(bytes(tx))
+    return w.bytes()
+
+
+def decode_txs(data: bytes):
+    r = Reader(data)
+    return [r.read_bytes() for _ in range(r.read_uvarint())]
+
+
+class MempoolReactor(Reactor):
+    def __init__(self, config, mempool: Mempool, logger=None):
+        super().__init__("mempool")
+        self.config = config
+        self.mempool = mempool
+        self.logger = logger or get_logger("mempool.reactor")
+        self._peer_tasks: Dict[str, asyncio.Task] = {}
+
+    def get_channels(self):
+        return [ChannelDescriptor(id=MEMPOOL_CHANNEL, priority=1, send_queue_capacity=100)]
+
+    async def add_peer(self, peer: Peer) -> None:
+        if self.config.broadcast:
+            self._peer_tasks[peer.id] = asyncio.create_task(
+                self._broadcast_tx_routine(peer)
+            )
+
+    async def remove_peer(self, peer: Peer, reason: str) -> None:
+        t = self._peer_tasks.pop(peer.id, None)
+        if t is not None:
+            t.cancel()
+
+    async def receive(self, ch_id: int, peer: Peer, msg_bytes: bytes) -> None:
+        """Reference Receive :160."""
+        for tx in decode_txs(msg_bytes):
+            try:
+                await self.mempool.check_tx(tx, sender=peer.id)
+            except (ErrTxInCache, ErrMempoolIsFull):
+                pass  # benign
+            except Exception as e:
+                self.logger.debug("peer tx rejected", err=str(e))
+
+    async def _broadcast_tx_routine(self, peer: Peer) -> None:
+        """Reference broadcastTxRoutine :193: walk the pool in order,
+        skipping txs the peer sent us."""
+        seq = 0
+        try:
+            while True:
+                entry = await self.mempool.wait_for_next(seq)
+                seq = entry.seq
+                if peer.id in entry.senders:
+                    continue  # don't echo a tx to its source (reference :230)
+                ok = await peer.send(MEMPOOL_CHANNEL, encode_txs([entry.tx]))
+                if not ok:
+                    await asyncio.sleep(0.01)
+        except asyncio.CancelledError:
+            raise
+        except Exception as e:
+            self.logger.debug("broadcast tx routine ended", peer=peer.id[:12], err=str(e))

@@ -1,0 +1,75 @@
+"""Mempool tx gossip over p2p (mirrors mempool/reactor_test.go
+TestReactorBroadcastTxMessage)."""
+
+import asyncio
+
+from tendermint_tpu.consensus.reactor import ConsensusReactor
+from tendermint_tpu.mempool.reactor import MempoolReactor
+from tendermint_tpu.p2p.test_util import make_connected_switches, stop_switches
+from tendermint_tpu.config import MempoolConfig
+from tests.cs_harness import make_genesis, make_node
+
+CHAIN = "cs-harness-chain"
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+def test_txs_gossip_between_mempools():
+    async def go():
+        genesis, privs = make_genesis(2)
+        nodes = [await make_node(genesis, pv) for pv in privs]
+        mp_reactors = [MempoolReactor(MempoolConfig(), n.mempool) for n in nodes]
+
+        def init(i, sw):
+            sw.add_reactor("mempool", mp_reactors[i])
+
+        switches = await make_connected_switches(2, init=init, network=CHAIN)
+        try:
+            await nodes[0].mempool.check_tx(b"spread=me")
+            for _ in range(500):
+                if nodes[1].mempool.size() == 1:
+                    break
+                await asyncio.sleep(0.01)
+            assert nodes[1].mempool.size() == 1
+            assert bytes(nodes[1].mempool.reap_max_txs(1)[0]) == b"spread=me"
+            # no echo storm: node0 still has exactly 1
+            assert nodes[0].mempool.size() == 1
+        finally:
+            await stop_switches(switches)
+
+    run(go())
+
+
+def test_tx_committed_via_gossip_in_full_net():
+    """tx submitted on a non-proposer reaches a block quickly because the
+    mempool gossips it to whoever proposes next."""
+
+    async def go():
+        genesis, privs = make_genesis(3)
+        nodes = [await make_node(genesis, pv) for pv in privs]
+        cs_reactors = [ConsensusReactor(n.cs) for n in nodes]
+        mp_reactors = [MempoolReactor(MempoolConfig(), n.mempool) for n in nodes]
+
+        def init(i, sw):
+            sw.add_reactor("consensus", cs_reactors[i])
+            sw.add_reactor("mempool", mp_reactors[i])
+
+        switches = await make_connected_switches(3, init=init, network=CHAIN)
+        try:
+            await nodes[2].mempool.check_tx(b"fast=lane")
+            # must land within 2 heights of submission (gossip, not
+            # waiting for node2's own proposer turn)
+            await asyncio.gather(
+                *(n.cs.wait_for_height(3, timeout_s=60) for n in nodes)
+            )
+            committed = []
+            for h in range(1, nodes[0].block_store.height + 1):
+                blk = nodes[0].block_store.load_block(h)
+                committed += [bytes(t) for t in blk.data.txs]
+            assert b"fast=lane" in committed
+        finally:
+            await stop_switches(switches)
+
+    run(go())
