@@ -1,0 +1,2 @@
+from tendermint_tpu.evidence.pool import EvidencePool
+from tendermint_tpu.evidence.reactor import EvidenceReactor

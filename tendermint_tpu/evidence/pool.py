@@ -1,0 +1,176 @@
+"""Evidence pool: stores and validates misbehavior evidence.
+
+Reference: evidence/pool.go — Pool :23, AddEvidence :120, Update :95,
+MarkEvidenceAsCommitted :165, PendingEvidence :141, IsCommitted :176;
+store keys evidence/store.go (pending/committed prefixes, lookup key
+height/hash); verification via sm.VerifyEvidence state/validation.go:161
+(age window + validator existed at evidence height).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+from typing import List, Optional
+
+from tendermint_tpu.db.base import DB
+from tendermint_tpu.types.evidence import (
+    MAX_EVIDENCE_BYTES,
+    Evidence,
+    decode_evidence,
+    encode_evidence,
+)
+from tendermint_tpu.utils.log import get_logger
+
+_PENDING = b"ep:"
+_COMMITTED = b"ec:"
+
+
+def _key(prefix: bytes, ev: Evidence) -> bytes:
+    return prefix + ev.height().to_bytes(8, "big") + ev.hash()
+
+
+class ErrInvalidEvidence(Exception):
+    pass
+
+
+class ErrEvidenceAlreadySeen(Exception):
+    pass
+
+
+class EvidencePool:
+    def __init__(self, db: DB, state_store, block_store=None, logger=None):
+        self._db = db
+        self._state_store = state_store
+        self._block_store = block_store
+        self.logger = logger or get_logger("evidence")
+        self.state = state_store.load()
+        self._new_evidence = asyncio.Event() if _has_loop() else None
+        self._seq = 0
+        self._seqs: dict = {}  # hash -> insertion seq (gossip cursor)
+
+    # -- queries -----------------------------------------------------------
+
+    def pending_evidence(self, max_bytes: int = -1) -> List[Evidence]:
+        """Reference PendingEvidence :141 (maxBytes=-1: all)."""
+        out = []
+        total = 0
+        for _, raw in self._db.prefix_iterator(_PENDING):
+            ev = decode_evidence(raw)
+            sz = len(raw)
+            if max_bytes >= 0 and total + sz > max_bytes:
+                break
+            total += sz
+            out.append(ev)
+        return out
+
+    def is_pending(self, ev: Evidence) -> bool:
+        return self._db.get(_key(_PENDING, ev)) is not None
+
+    def is_committed(self, ev: Evidence) -> bool:
+        return self._db.get(_key(_COMMITTED, ev)) is not None
+
+    # -- adding ------------------------------------------------------------
+
+    def add_evidence(self, ev: Evidence) -> None:
+        """Verify + store as pending (reference AddEvidence :120).
+        Raises ErrEvidenceAlreadySeen / ErrInvalidEvidence."""
+        if self.is_committed(ev) or self.is_pending(ev):
+            raise ErrEvidenceAlreadySeen(repr(ev))
+        self.verify_evidence(ev)
+        self._seq += 1
+        self._seqs[ev.hash()] = self._seq
+        self._db.set(_key(_PENDING, ev), encode_evidence(ev))
+        self.logger.info("verified new evidence of byzantine behaviour", ev=repr(ev))
+        if self._new_evidence is not None:
+            self._new_evidence.set()
+
+    def verify_evidence(self, ev: Evidence) -> None:
+        """Reference sm.VerifyEvidence state/validation.go:161."""
+        state = self.state
+        height = state.last_block_height
+        ev_params = state.consensus_params.evidence
+
+        age_blocks = height - ev.height()
+        age_ns = state.last_block_time_ns - ev.time_ns()
+        if (
+            age_blocks > ev_params.max_age_num_blocks
+            and age_ns > ev_params.max_age_duration_ns
+        ):
+            raise ErrInvalidEvidence(
+                f"evidence from height {ev.height()} is too old"
+            )
+        if ev.height() > height:
+            raise ErrInvalidEvidence("evidence from the future")
+
+        vals = self._state_store.load_validators(ev.height())
+        if vals is None:
+            raise ErrInvalidEvidence(f"no validator set at height {ev.height()}")
+        _, val = vals.get_by_address(ev.address())
+        if val is None:
+            raise ErrInvalidEvidence(
+                f"address {ev.address().hex()[:12]} was not a validator at height {ev.height()}"
+            )
+        err = ev.validate_basic()
+        if err:
+            raise ErrInvalidEvidence(err)
+        try:
+            ev.verify(state.chain_id, val.pub_key)
+        except Exception as e:
+            raise ErrInvalidEvidence(str(e))
+
+    # -- block lifecycle ---------------------------------------------------
+
+    def update(self, block, state) -> None:
+        """After a block commits: mark its evidence committed, drop
+        expired pending (reference Update :95)."""
+        self.state = state
+        for ev in block.evidence.evidence:
+            self.mark_evidence_as_committed(ev)
+        self._remove_expired()
+
+    def mark_evidence_as_committed(self, ev: Evidence) -> None:
+        self._db.set(_key(_COMMITTED, ev), b"\x01")
+        self._db.delete(_key(_PENDING, ev))
+        self._seqs.pop(ev.hash(), None)
+
+    def _remove_expired(self) -> None:
+        state = self.state
+        params = state.consensus_params.evidence
+        for k, raw in list(self._db.prefix_iterator(_PENDING)):
+            ev = decode_evidence(raw)
+            if (
+                state.last_block_height - ev.height() > params.max_age_num_blocks
+                and state.last_block_time_ns - ev.time_ns() > params.max_age_duration_ns
+            ):
+                self._db.delete(k)
+                self._seqs.pop(ev.hash(), None)
+
+    # -- gossip cursor (same pattern as the mempool) -------------------------
+
+    def next_after(self, seq: int):
+        best = None
+        for _, raw in self._db.prefix_iterator(_PENDING):
+            ev = decode_evidence(raw)
+            s = self._seqs.get(ev.hash(), 0)
+            if s > seq and (best is None or s < best[0]):
+                best = (s, ev)
+        return best  # (seq, evidence) or None
+
+    async def wait_for_next(self, seq: int):
+        while True:
+            nxt = self.next_after(seq)
+            if nxt is not None:
+                return nxt
+            if self._new_evidence is None:
+                self._new_evidence = asyncio.Event()
+            self._new_evidence.clear()
+            await self._new_evidence.wait()
+
+
+def _has_loop() -> bool:
+    try:
+        asyncio.get_running_loop()
+        return True
+    except RuntimeError:
+        return False

@@ -1,0 +1,86 @@
+"""Evidence reactor: gossips pending evidence.
+
+Reference: evidence/reactor.go — Reactor :24, channel 0x38 (:18),
+Receive :71 (AddEvidence each), broadcastEvidenceRoutine :113 with
+peer-height gating (don't send evidence newer than what the peer can
+verify, :160 region).
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Dict, List
+
+from tendermint_tpu.codec.binary import Reader, Writer
+from tendermint_tpu.evidence.pool import (
+    ErrEvidenceAlreadySeen,
+    ErrInvalidEvidence,
+    EvidencePool,
+)
+from tendermint_tpu.p2p.conn.connection import ChannelDescriptor
+from tendermint_tpu.p2p.peer import Peer
+from tendermint_tpu.p2p.switch import Reactor
+from tendermint_tpu.types.evidence import decode_evidence, encode_evidence
+from tendermint_tpu.utils.log import get_logger
+
+EVIDENCE_CHANNEL = 0x38
+
+
+def encode_evidence_list(evs: List) -> bytes:
+    w = Writer()
+    w.write_uvarint(len(evs))
+    for ev in evs:
+        w.write_bytes(encode_evidence(ev))
+    return w.bytes()
+
+
+def decode_evidence_list(data: bytes) -> List:
+    r = Reader(data)
+    return [decode_evidence(r.read_bytes()) for _ in range(r.read_uvarint())]
+
+
+class EvidenceReactor(Reactor):
+    def __init__(self, pool: EvidencePool, logger=None):
+        super().__init__("evidence")
+        self.pool = pool
+        self.logger = logger or get_logger("evidence.reactor")
+        self._peer_tasks: Dict[str, asyncio.Task] = {}
+
+    def get_channels(self):
+        return [ChannelDescriptor(id=EVIDENCE_CHANNEL, priority=5, send_queue_capacity=100)]
+
+    async def add_peer(self, peer: Peer) -> None:
+        self._peer_tasks[peer.id] = asyncio.create_task(
+            self._broadcast_routine(peer)
+        )
+
+    async def remove_peer(self, peer: Peer, reason: str) -> None:
+        t = self._peer_tasks.pop(peer.id, None)
+        if t is not None:
+            t.cancel()
+
+    async def receive(self, ch_id: int, peer: Peer, msg_bytes: bytes) -> None:
+        """Reference Receive :71."""
+        for ev in decode_evidence_list(msg_bytes):
+            try:
+                self.pool.add_evidence(ev)
+            except ErrEvidenceAlreadySeen:
+                pass
+            except ErrInvalidEvidence as e:
+                self.logger.error("peer sent invalid evidence", peer=peer.id[:12], err=str(e))
+                if self.switch is not None:
+                    await self.switch.stop_peer_for_error(peer, f"invalid evidence: {e}")
+                return
+
+    async def _broadcast_routine(self, peer: Peer) -> None:
+        """Reference broadcastEvidenceRoutine :113."""
+        seq = 0
+        try:
+            while True:
+                nxt = await self.pool.wait_for_next(seq)
+                seq, ev = nxt
+                await peer.send(EVIDENCE_CHANNEL, encode_evidence_list([ev]))
+        except asyncio.CancelledError:
+            raise
+        except Exception as e:
+            self.logger.debug("evidence broadcast ended", peer=peer.id[:12], err=str(e))

@@ -1,0 +1,137 @@
+"""Evidence pool + reactor.
+
+Mirrors reference evidence/pool_test.go (TestEvidencePool, expiry) and
+evidence/reactor_test.go (TestReactorBroadcastEvidence).
+"""
+
+import asyncio
+
+import pytest
+
+from tendermint_tpu.codec.signbytes import PREVOTE_TYPE
+from tendermint_tpu.db.memdb import MemDB
+from tendermint_tpu.evidence import EvidencePool, EvidenceReactor
+from tendermint_tpu.evidence.pool import ErrEvidenceAlreadySeen, ErrInvalidEvidence
+from tendermint_tpu.p2p.test_util import make_connected_switches, stop_switches
+from tendermint_tpu.types.block import BlockID, PartSetHeader
+from tendermint_tpu.types.evidence import DuplicateVoteEvidence
+from tendermint_tpu.types.vote import Vote
+from tests.cs_harness import CHAIN_ID, make_genesis, make_node
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+def make_dupe_evidence(pv, idx=0, height=1, seed=1):
+    """Two conflicting prevotes signed by the same validator."""
+
+    def vote(tag):
+        v = Vote(
+            vote_type=PREVOTE_TYPE,
+            height=height,
+            round=0,
+            block_id=BlockID(
+                hash=bytes([tag]) * 32, parts=PartSetHeader(1, bytes([tag + 1]) * 32)
+            ),
+            timestamp_ns=1000,
+            validator_address=pv.address(),
+            validator_index=idx,
+        )
+        pv.sign_vote(CHAIN_ID, v)
+        return v
+
+    return DuplicateVoteEvidence(
+        pub_key=pv.get_pub_key(), vote_a=vote(seed), vote_b=vote(seed + 10)
+    )
+
+
+async def pool_with_chain(n_vals=1, heights=2):
+    """Run a real chain briefly so validators are persisted per height."""
+    genesis, privs = make_genesis(n_vals)
+    node = await make_node(genesis, privs[0])
+    await node.cs.start()
+    await node.cs.wait_for_height(heights, timeout_s=30)
+    await node.cs.stop()
+    pool = EvidencePool(MemDB(), node.state_store, node.block_store)
+    return pool, node, privs
+
+
+def test_add_verify_pending_committed():
+    async def go():
+        pool, node, privs = await pool_with_chain()
+        # find the validator's index in the set at height 1
+        vals = node.state_store.load_validators(1)
+        idx, _ = vals.get_by_address(privs[0].address())
+        ev = make_dupe_evidence(privs[0], idx=idx, height=1)
+        pool.add_evidence(ev)
+        assert pool.is_pending(ev)
+        assert [e.hash() for e in pool.pending_evidence()] == [ev.hash()]
+        with pytest.raises(ErrEvidenceAlreadySeen):
+            pool.add_evidence(ev)
+        # committing removes from pending
+        pool.mark_evidence_as_committed(ev)
+        assert not pool.is_pending(ev) and pool.is_committed(ev)
+        assert pool.pending_evidence() == []
+        with pytest.raises(ErrEvidenceAlreadySeen):
+            pool.add_evidence(ev)
+
+    run(go())
+
+
+def test_rejects_non_validator_and_future():
+    async def go():
+        pool, node, privs = await pool_with_chain()
+        from tendermint_tpu.types.priv_validator import MockPV
+
+        stranger = MockPV()
+        ev = make_dupe_evidence(stranger, idx=0, height=1)
+        with pytest.raises(ErrInvalidEvidence):
+            pool.add_evidence(ev)
+        vals = node.state_store.load_validators(1)
+        idx, _ = vals.get_by_address(privs[0].address())
+        future = make_dupe_evidence(privs[0], idx=idx, height=999)
+        with pytest.raises(ErrInvalidEvidence):
+            pool.add_evidence(future)
+
+    run(go())
+
+
+def test_rejects_tampered_signature():
+    async def go():
+        pool, node, privs = await pool_with_chain()
+        vals = node.state_store.load_validators(1)
+        idx, _ = vals.get_by_address(privs[0].address())
+        ev = make_dupe_evidence(privs[0], idx=idx, height=1)
+        ev.vote_b.signature = bytes(64)
+        with pytest.raises(ErrInvalidEvidence):
+            pool.add_evidence(ev)
+
+    run(go())
+
+
+def test_reactor_gossips_evidence():
+    async def go():
+        pool_a, node, privs = await pool_with_chain()
+        pool_b = EvidencePool(MemDB(), node.state_store, node.block_store)
+        reactors = [EvidenceReactor(pool_a), EvidenceReactor(pool_b)]
+
+        def init(i, sw):
+            sw.add_reactor("evidence", reactors[i])
+
+        switches = await make_connected_switches(2, init=init)
+        try:
+            vals = node.state_store.load_validators(1)
+            idx, _ = vals.get_by_address(privs[0].address())
+            ev = make_dupe_evidence(privs[0], idx=idx, height=1)
+            pool_a.add_evidence(ev)
+            for _ in range(500):
+                if pool_b.pending_evidence():
+                    break
+                await asyncio.sleep(0.01)
+            got = pool_b.pending_evidence()
+            assert len(got) == 1 and got[0].hash() == ev.hash()
+        finally:
+            await stop_switches(switches)
+
+    run(go())
