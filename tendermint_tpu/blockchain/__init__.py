@@ -1,0 +1,10 @@
+"""Fast sync: block catchup from peers.
+
+Reference: blockchain/v2/ (ADR-043 "riri-org" design) — the pure-function
+scheduler + processor state machines demuxed by the reactor
+(blockchain/v2/scheduler.go, processor.go, reactor.go:301). One engine
+here (the reference ships v0/v1/v2; v2 is the architecture to keep:
+deterministic, unit-testable without any network).
+"""
+
+from tendermint_tpu.blockchain.reactor import BlockchainReactor

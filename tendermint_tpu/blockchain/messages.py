@@ -1,0 +1,105 @@
+"""Blockchain (fast-sync) channel messages.
+
+Reference: blockchain/v2/types in codec — BlockRequest/BlockResponse/
+NoBlockResponse/StatusRequest/StatusResponse (bcproto), channel 0x40
+(blockchain/v0/reactor.go:20).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from tendermint_tpu.codec.binary import Reader, Writer
+from tendermint_tpu.types.block import Block
+
+T_BLOCK_REQUEST = 0x01
+T_BLOCK_RESPONSE = 0x02
+T_NO_BLOCK_RESPONSE = 0x03
+T_STATUS_REQUEST = 0x04
+T_STATUS_RESPONSE = 0x05
+
+
+@dataclass
+class BlockRequest:
+    height: int
+
+    def encode_body(self, w: Writer) -> None:
+        w.write_u64(self.height)
+
+    @classmethod
+    def decode_body(cls, r: Reader) -> "BlockRequest":
+        return cls(r.read_u64())
+
+
+@dataclass
+class BlockResponse:
+    block: Block
+
+    def encode_body(self, w: Writer) -> None:
+        w.write_bytes(self.block.encode())
+
+    @classmethod
+    def decode_body(cls, r: Reader) -> "BlockResponse":
+        return cls(Block.decode(r.read_bytes()))
+
+
+@dataclass
+class NoBlockResponse:
+    height: int
+
+    def encode_body(self, w: Writer) -> None:
+        w.write_u64(self.height)
+
+    @classmethod
+    def decode_body(cls, r: Reader) -> "NoBlockResponse":
+        return cls(r.read_u64())
+
+
+@dataclass
+class StatusRequest:
+    pass
+
+    def encode_body(self, w: Writer) -> None:
+        pass
+
+    @classmethod
+    def decode_body(cls, r: Reader) -> "StatusRequest":
+        return cls()
+
+
+@dataclass
+class StatusResponse:
+    height: int
+    base: int
+
+    def encode_body(self, w: Writer) -> None:
+        w.write_u64(self.height).write_u64(self.base)
+
+    @classmethod
+    def decode_body(cls, r: Reader) -> "StatusResponse":
+        return cls(r.read_u64(), r.read_u64())
+
+
+_TAGS = {
+    T_BLOCK_REQUEST: BlockRequest,
+    T_BLOCK_RESPONSE: BlockResponse,
+    T_NO_BLOCK_RESPONSE: NoBlockResponse,
+    T_STATUS_REQUEST: StatusRequest,
+    T_STATUS_RESPONSE: StatusResponse,
+}
+_CLS = {v: k for k, v in _TAGS.items()}
+
+
+def encode_msg(msg) -> bytes:
+    w = Writer()
+    w.write_u8(_CLS[type(msg)])
+    msg.encode_body(w)
+    return w.bytes()
+
+
+def decode_msg(data: bytes):
+    r = Reader(data)
+    cls = _TAGS.get(r.read_u8())
+    if cls is None:
+        raise ValueError("unknown blockchain message tag")
+    return cls.decode_body(r)

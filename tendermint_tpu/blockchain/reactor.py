@@ -1,0 +1,207 @@
+"""Fast-sync reactor: demuxes scheduler decisions, block transfer, and
+the verify+apply processor.
+
+Reference: blockchain/v2/reactor.go — demux :301; processor.go (verify
+first block with the SECOND block's LastCommit, then ApplyBlock —
+processor_context.go:42 uses state.Validators.VerifyCommit, which here
+is the TPU-batched path); channel 0x40 (v0/reactor.go:20);
+SwitchToConsensus handoff (consensus/reactor.go:102).
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Dict, Optional
+
+from tendermint_tpu.blockchain import messages as m
+from tendermint_tpu.blockchain.scheduler import Scheduler
+from tendermint_tpu.p2p.conn.connection import ChannelDescriptor
+from tendermint_tpu.p2p.peer import Peer
+from tendermint_tpu.p2p.switch import Reactor
+from tendermint_tpu.types.block import Block, BlockID
+from tendermint_tpu.utils.log import get_logger
+
+BLOCKCHAIN_CHANNEL = 0x40
+
+STATUS_UPDATE_INTERVAL_S = 10.0
+TRY_SYNC_INTERVAL_S = 0.01
+SWITCH_TO_CONSENSUS_INTERVAL_S = 1.0
+
+
+class BlockchainReactor(Reactor):
+    def __init__(
+        self,
+        state,
+        block_exec,
+        block_store,
+        fast_sync: bool,
+        consensus_reactor=None,  # given SwitchToConsensus when caught up
+        logger=None,
+    ):
+        super().__init__("blockchain")
+        self.logger = logger or get_logger("blockchain")
+        self.state = state
+        self._block_exec = block_exec
+        self._store = block_store
+        self.fast_sync = fast_sync
+        self._consensus_reactor = consensus_reactor
+        self.scheduler = Scheduler(initial_height=state.last_block_height + 1)
+        self._blocks: Dict[int, Block] = {}  # received, not yet applied
+        self._switched = False
+
+    def get_channels(self):
+        return [
+            ChannelDescriptor(
+                id=BLOCKCHAIN_CHANNEL, priority=10, send_queue_capacity=1000
+            )
+        ]
+
+    # -- lifecycle ---------------------------------------------------------
+
+    async def start(self) -> None:
+        if self.fast_sync:
+            self._task_pool = [
+                asyncio.create_task(self._request_routine()),
+                asyncio.create_task(self._process_routine()),
+            ]
+        else:
+            self._task_pool = []
+
+    async def stop(self) -> None:
+        for t in getattr(self, "_task_pool", []):
+            t.cancel()
+        await asyncio.gather(*getattr(self, "_task_pool", []), return_exceptions=True)
+
+    # -- peer management ---------------------------------------------------
+
+    async def add_peer(self, peer: Peer) -> None:
+        peer.try_send(
+            BLOCKCHAIN_CHANNEL,
+            m.encode_msg(m.StatusResponse(self._store.height, self._store.base)),
+        )
+        self.scheduler.add_peer(peer.id)
+
+    async def remove_peer(self, peer: Peer, reason: str) -> None:
+        for h in self.scheduler.remove_peer(peer.id):
+            self._blocks.pop(h, None)
+
+    # -- receive -----------------------------------------------------------
+
+    async def receive(self, ch_id: int, peer: Peer, msg_bytes: bytes) -> None:
+        msg = m.decode_msg(msg_bytes)
+        if isinstance(msg, m.StatusRequest):
+            peer.try_send(
+                BLOCKCHAIN_CHANNEL,
+                m.encode_msg(m.StatusResponse(self._store.height, self._store.base)),
+            )
+        elif isinstance(msg, m.StatusResponse):
+            self.scheduler.set_peer_range(peer.id, msg.base, msg.height)
+        elif isinstance(msg, m.BlockRequest):
+            block = self._store.load_block(msg.height)
+            if block is not None:
+                peer.try_send(BLOCKCHAIN_CHANNEL, m.encode_msg(m.BlockResponse(block)))
+            else:
+                peer.try_send(
+                    BLOCKCHAIN_CHANNEL, m.encode_msg(m.NoBlockResponse(msg.height))
+                )
+        elif isinstance(msg, m.BlockResponse):
+            if not self.fast_sync:
+                return
+            h = msg.block.header.height
+            if self.scheduler.block_received(peer.id, h):
+                self._blocks[h] = msg.block
+            else:
+                self.logger.debug("unsolicited block", height=h, peer=peer.id[:12])
+        elif isinstance(msg, m.NoBlockResponse):
+            self.logger.debug("peer has no block", height=msg.height, peer=peer.id[:12])
+        else:
+            raise ValueError(f"unknown blockchain message {type(msg).__name__}")
+
+    # -- routines ----------------------------------------------------------
+
+    async def _request_routine(self) -> None:
+        """Periodically: status-poll peers + hand out block requests."""
+        ticks = 0
+        try:
+            while True:
+                if self.switch is not None:
+                    if ticks % int(STATUS_UPDATE_INTERVAL_S / 0.25) == 0:
+                        self.switch.broadcast(
+                            BLOCKCHAIN_CHANNEL, m.encode_msg(m.StatusRequest())
+                        )
+                    for height, peer_id in self.scheduler.next_requests():
+                        peer = self.switch.peers.get(peer_id)
+                        if peer is not None:
+                            peer.try_send(
+                                BLOCKCHAIN_CHANNEL, m.encode_msg(m.BlockRequest(height))
+                            )
+                ticks += 1
+                await asyncio.sleep(0.25)
+        except asyncio.CancelledError:
+            raise
+        except Exception as e:
+            self.logger.error("request routine died", err=repr(e))
+
+    async def _process_routine(self) -> None:
+        """Verify+apply pairs of consecutive blocks (reference
+        poolRoutine trySync / v2 processor)."""
+        caught_up_since: Optional[float] = None
+        try:
+            while True:
+                progressed = await self._try_process_one()
+                if not progressed:
+                    if self.scheduler.is_caught_up():
+                        await self._switch_to_consensus()
+                        return
+                    await asyncio.sleep(TRY_SYNC_INTERVAL_S * 10)
+        except asyncio.CancelledError:
+            raise
+        except Exception as e:
+            self.logger.error("process routine died", err=repr(e))
+
+    async def _try_process_one(self) -> bool:
+        h = self.scheduler.height
+        first = self._blocks.get(h)
+        second = self._blocks.get(h + 1)
+        if first is None or second is None:
+            return False
+        first_parts = first.make_part_set()
+        first_id = BlockID(hash=first.hash(), parts=first_parts.header())
+        try:
+            # ★ HOT: one batched device call per commit (reference serial
+            # loop at types/validator_set.go:641, called from
+            # blockchain/*/reactor verify sites)
+            self.state.validators.verify_commit(
+                self.state.chain_id, first_id, first.header.height, second.last_commit
+            )
+        except Exception as e:
+            self.logger.error(
+                "invalid block; punishing peers", height=h, err=str(e)
+            )
+            bad = self.scheduler.processing_failed(h)
+            for pid in bad:
+                self._blocks.pop(h, None)
+                self._blocks.pop(h + 1, None)
+                peer = self.switch.peers.get(pid) if self.switch else None
+                if peer is not None:
+                    await self.switch.stop_peer_for_error(peer, f"bad block {h}: {e}")
+            return False
+
+        self._store.save_block(first, first_parts, second.last_commit)
+        self.state, _ = await self._block_exec.apply_block(self.state, first_id, first)
+        self.scheduler.block_processed(h)
+        del self._blocks[h]
+        return True
+
+    async def _switch_to_consensus(self) -> None:
+        """Reference bcR.SwitchToConsensus (v0 poolRoutine :285 region)."""
+        if self._switched:
+            return
+        self._switched = True
+        self.fast_sync = False
+        self.logger.info(
+            "fast sync complete; switching to consensus",
+            height=self.state.last_block_height,
+        )
+        if self._consensus_reactor is not None:
+            await self._consensus_reactor.switch_to_consensus(self.state)

@@ -1,0 +1,161 @@
+"""Fast-sync scheduler: pure peer/height bookkeeping.
+
+Reference: blockchain/v2/scheduler.go — a deterministic state machine
+with no I/O: peers report (base,height); the scheduler hands out block
+requests within a lookahead window, tracks pending/received per height,
+reassigns on peer loss/timeout, and reports when we're caught up. All
+methods are synchronous and side-effect free outside `self` — the payoff
+is table-driven unit tests with no network (scheduler_test.go:2223
+lines in the reference).
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Set, Tuple
+
+PEER_STATE_READY = "ready"
+PEER_STATE_REMOVED = "removed"
+
+
+@dataclass
+class _Peer:
+    peer_id: str
+    state: str = PEER_STATE_READY
+    base: int = 0
+    height: int = 0  # latest height the peer claims
+    pending: Set[int] = field(default_factory=set)
+
+
+class Scheduler:
+    def __init__(
+        self,
+        initial_height: int,
+        max_pending_per_peer: int = 10,
+        lookahead: int = 200,
+        request_timeout_s: float = 15.0,
+    ):
+        # next height not yet processed (blocks below are applied)
+        self.height = initial_height
+        self.max_pending_per_peer = max_pending_per_peer
+        self.lookahead = lookahead
+        self.request_timeout_s = request_timeout_s
+        self.peers: Dict[str, _Peer] = {}
+        self.pending: Dict[int, Tuple[str, float]] = {}  # height → (peer, t)
+        self.received: Dict[int, str] = {}  # height → peer holding the block
+
+    # -- peer events -------------------------------------------------------
+
+    def add_peer(self, peer_id: str) -> None:
+        if peer_id not in self.peers:
+            self.peers[peer_id] = _Peer(peer_id)
+
+    def set_peer_range(self, peer_id: str, base: int, height: int) -> None:
+        """StatusResponse from a peer (reference setPeerRange)."""
+        p = self.peers.get(peer_id)
+        if p is None or p.state != PEER_STATE_READY:
+            self.add_peer(peer_id)
+            p = self.peers[peer_id]
+        if height < p.height:
+            return  # peers never shrink; ignore stale
+        p.base, p.height = base, height
+
+    def remove_peer(self, peer_id: str) -> List[int]:
+        """Peer gone: return heights that must be re-requested."""
+        p = self.peers.pop(peer_id, None)
+        if p is None:
+            return []
+        lost = [h for h, (pid, _) in self.pending.items() if pid == peer_id]
+        for h in lost:
+            del self.pending[h]
+        # received blocks from this peer are kept (already validated shape)
+        return sorted(lost)
+
+    # -- request scheduling ------------------------------------------------
+
+    def max_peer_height(self) -> int:
+        return max((p.height for p in self.peers.values()), default=0)
+
+    def is_caught_up(self) -> bool:
+        """No peer claims a height beyond ours (reference pool
+        IsCaughtUp: within 1 of the best peer)."""
+        return bool(self.peers) and self.height >= self.max_peer_height()
+
+    def next_requests(self, now: Optional[float] = None) -> List[Tuple[int, str]]:
+        """Assign needed heights to available peers; returns new
+        (height, peer_id) request pairs."""
+        now = time.monotonic() if now is None else now
+        self._expire_timeouts(now)
+        out: List[Tuple[int, str]] = []
+        max_h = min(self.max_peer_height(), self.height + self.lookahead)
+        h = self.height
+        while h <= max_h:
+            if h not in self.pending and h not in self.received:
+                peer = self._pick_peer_for(h)
+                if peer is not None:
+                    peer.pending.add(h)
+                    self.pending[h] = (peer.peer_id, now)
+                    out.append((h, peer.peer_id))
+            h += 1
+        return out
+
+    def _pick_peer_for(self, height: int) -> Optional[_Peer]:
+        candidates = [
+            p
+            for p in self.peers.values()
+            if p.state == PEER_STATE_READY
+            and p.base <= height <= p.height
+            and len(p.pending) < self.max_pending_per_peer
+        ]
+        if not candidates:
+            return None
+        return min(candidates, key=lambda p: (len(p.pending), p.peer_id))
+
+    def _expire_timeouts(self, now: float) -> List[int]:
+        expired = [
+            h for h, (pid, t) in self.pending.items()
+            if now - t > self.request_timeout_s
+        ]
+        for h in expired:
+            pid, _ = self.pending.pop(h)
+            p = self.peers.get(pid)
+            if p is not None:
+                p.pending.discard(h)
+        return expired
+
+    # -- block events ------------------------------------------------------
+
+    def block_received(self, peer_id: str, height: int) -> bool:
+        """Returns False if this block wasn't requested from this peer
+        (unsolicited — reference errors the peer)."""
+        ent = self.pending.get(height)
+        if ent is None or ent[0] != peer_id:
+            return False
+        del self.pending[height]
+        p = self.peers.get(peer_id)
+        if p is not None:
+            p.pending.discard(height)
+        self.received[height] = peer_id
+        return True
+
+    def block_processed(self, height: int) -> None:
+        self.received.pop(height, None)
+        if height >= self.height:
+            self.height = height + 1
+
+    def processing_failed(self, height: int) -> List[str]:
+        """Verification failed at `height`: the peers that delivered
+        heights height and height+1 are suspect (reference: both peers
+        are errored, blocks redownloaded)."""
+        bad = []
+        for h in (height, height + 1):
+            pid = self.received.pop(h, None)
+            if pid is not None:
+                bad.append(pid)
+            pend = self.pending.pop(h, None)
+            if pend is not None:
+                bad.append(pend[0])
+        for pid in set(bad):
+            self.remove_peer(pid)
+        return sorted(set(bad))

@@ -114,6 +114,10 @@ class PeerState:
         if size == 0:
             return None
         height, round_, vote_type = votes.height, votes.round, votes.signed_msg_type
+        if votes.is_commit():
+            # the commit's round may differ from the peer's current round
+            # (reference PickVoteToSend: ensureCatchupCommitRound)
+            self.ensure_catchup_commit_round(height, round_, size)
         self.ensure_vote_bit_arrays(height, size)
         ps_votes = self._get_vote_bit_array(height, round_, vote_type)
         if ps_votes is None:
@@ -135,7 +139,10 @@ class PeerState:
         if prs.catchup_commit_round == round_:
             return
         prs.catchup_commit_round = round_
-        prs.catchup_commit = BitArray(num_validators)
+        if round_ == prs.round:
+            prs.catchup_commit = prs.precommits  # share the live array
+        else:
+            prs.catchup_commit = BitArray(num_validators)
 
     # -- message application ----------------------------------------------
 
@@ -225,6 +232,9 @@ class CommitVotes:
 
     def size(self) -> int:
         return len(self._commit.signatures)
+
+    def is_commit(self) -> bool:
+        return True
 
     def bit_array(self) -> BitArray:
         return BitArray.from_bools(

@@ -150,6 +150,12 @@ class VoteSet:
     def has_all(self) -> bool:
         return self.sum == self.val_set.total_voting_power()
 
+    def is_commit(self) -> bool:
+        """Reference VoteSet.IsCommit: precommits with a +2/3 block."""
+        from tendermint_tpu.codec.signbytes import PRECOMMIT_TYPE
+
+        return self.signed_msg_type == PRECOMMIT_TYPE and self.maj23 is not None
+
     # -- adding votes ------------------------------------------------------
 
     def add_vote(self, vote: Optional[Vote]) -> bool:

@@ -1,0 +1,190 @@
+"""Fast sync: pure scheduler FSM tests + end-to-end catchup.
+
+Mirrors reference blockchain/v2/scheduler_test.go (table-driven, no
+network) and blockchain/v0/reactor_test.go (sync a fresh node from a
+running chain, then switch to consensus).
+"""
+
+import asyncio
+
+import pytest
+
+from tendermint_tpu.blockchain.reactor import BlockchainReactor
+from tendermint_tpu.blockchain.scheduler import Scheduler
+from tendermint_tpu.consensus.reactor import ConsensusReactor
+from tendermint_tpu.p2p.test_util import (
+    connect_switches,
+    make_connected_switches,
+    make_switch,
+    stop_switches,
+)
+from tests.cs_harness import make_genesis, make_node
+
+CHAIN = "cs-harness-chain"
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+# -- scheduler (pure) ------------------------------------------------------
+
+
+def test_scheduler_assigns_heights_within_peer_ranges():
+    s = Scheduler(initial_height=1, max_pending_per_peer=2)
+    s.add_peer("a")
+    s.set_peer_range("a", 1, 5)
+    reqs = s.next_requests(now=0.0)
+    assert reqs == [(1, "a"), (2, "a")]  # capped by max_pending_per_peer
+    s.add_peer("b")
+    s.set_peer_range("b", 1, 5)
+    reqs = s.next_requests(now=0.0)
+    assert reqs == [(3, "b"), (4, "b")]
+    assert s.next_requests(now=0.0) == []  # everyone at capacity
+
+
+def test_scheduler_block_flow_and_progress():
+    s = Scheduler(initial_height=1)
+    s.add_peer("a")
+    s.set_peer_range("a", 1, 3)
+    reqs = dict(s.next_requests(now=0.0))
+    assert set(reqs) == {1, 2, 3}
+    assert s.block_received("a", 1)
+    assert not s.block_received("a", 1)  # duplicate
+    assert not s.block_received("b", 2)  # wrong peer
+    s.block_received("a", 2)
+    s.block_processed(1)
+    assert s.height == 2
+    assert not s.is_caught_up()
+    s.block_received("a", 3)
+    s.block_processed(2)
+    s.block_processed(3)
+    assert s.height == 4 and s.is_caught_up()
+
+
+def test_scheduler_peer_removal_requeues():
+    s = Scheduler(initial_height=1)
+    s.add_peer("a")
+    s.add_peer("b")
+    s.set_peer_range("a", 1, 4)
+    s.set_peer_range("b", 1, 4)
+    s.next_requests(now=0.0)
+    lost = s.remove_peer("a")
+    assert lost  # a had assignments
+    # lost heights get reassigned to b
+    reassigned = s.next_requests(now=0.0)
+    assert {h for h, _ in reassigned} == set(lost)
+    assert all(p == "b" for _, p in reassigned)
+
+
+def test_scheduler_timeout_requeues():
+    s = Scheduler(initial_height=1, request_timeout_s=5.0)
+    s.add_peer("a")
+    s.set_peer_range("a", 1, 2)
+    s.next_requests(now=100.0)
+    assert s.next_requests(now=101.0) == []  # still pending
+    reqs = s.next_requests(now=106.0)  # expired → reassigned
+    assert {h for h, _ in reqs} == {1, 2}
+
+
+def test_scheduler_processing_failure_punishes_both_deliverers():
+    s = Scheduler(initial_height=1)
+    s.add_peer("a")
+    s.add_peer("b")
+    s.set_peer_range("a", 1, 1)
+    s.set_peer_range("b", 2, 2)
+    s.next_requests(now=0.0)
+    s.block_received("a", 1)
+    s.block_received("b", 2)
+    bad = s.processing_failed(1)
+    assert set(bad) == {"a", "b"}
+    assert "a" not in s.peers and "b" not in s.peers
+
+
+def test_scheduler_respects_peer_base():
+    """A pruned peer (base > 1) must not be asked for heights below base."""
+    s = Scheduler(initial_height=1)
+    s.add_peer("pruned")
+    s.set_peer_range("pruned", 5, 10)
+    reqs = s.next_requests(now=0.0)
+    assert all(h >= 5 for h, _ in reqs)
+
+
+# -- end to end ------------------------------------------------------------
+
+
+def test_fast_sync_catchup_then_consensus():
+    """A fresh validator joins late, fast-syncs the chain from peers,
+    switches to consensus and participates."""
+
+    async def go():
+        from tendermint_tpu.config import test_config
+
+        # slow the chain (~2 blocks/s) so sync chases a gentle target;
+        # the default test preset commits every ~25ms
+        cfg = test_config().consensus
+        cfg.timeout_commit_ms = 400
+        cfg.skip_timeout_commit = False
+
+        genesis, privs = make_genesis(4)
+        nodes = [await make_node(genesis, pv, config=cfg) for pv in privs]
+
+        # 3 running nodes with consensus + blockchain(serving) reactors
+        cs_reactors = [ConsensusReactor(n.cs) for n in nodes[:3]]
+        bc_reactors = [
+            BlockchainReactor(
+                n.cs.state, None, n.block_store, fast_sync=False
+            )
+            for n in nodes[:3]
+        ]
+
+        def init3(i, sw):
+            sw.add_reactor("consensus", cs_reactors[i])
+            sw.add_reactor("blockchain", bc_reactors[i])
+
+        switches = []
+        for i in range(3):
+            switches.append(
+                await make_switch(i, network=CHAIN, init=lambda s, _i=i: init3(_i, s))
+            )
+        for sw in switches:
+            await sw.start()
+        await connect_switches(switches)
+        try:
+            await asyncio.gather(*(n.cs.wait_for_height(4, 60) for n in nodes[:3]))
+
+            # node 3 joins with fast sync enabled
+            late = nodes[3]
+            cs_r = ConsensusReactor(late.cs, wait_sync=True)
+            from tendermint_tpu.state.execution import BlockExecutor
+
+            bc_r = BlockchainReactor(
+                late.cs.state,
+                BlockExecutor(late.state_store, late.cs._block_exec._app, mempool=late.mempool),
+                late.block_store,
+                fast_sync=True,
+                consensus_reactor=cs_r,
+            )
+
+            def init_late(sw):
+                sw.add_reactor("consensus", cs_r)
+                sw.add_reactor("blockchain", bc_r)
+
+            sw4 = await make_switch(3, network=CHAIN, init=init_late)
+            await sw4.start()
+            switches.append(sw4)
+            for sw in switches[:3]:
+                await sw4.dial_peer(sw.transport.listen_addr)
+
+            # it catches up via block transfer and then participates
+            for _ in range(1000):
+                if not bc_r.fast_sync:
+                    break
+                await asyncio.sleep(0.02)
+            assert not bc_r.fast_sync, "never switched to consensus"
+            h = late.cs.state.last_block_height
+            await late.cs.wait_for_height(h + 2, timeout_s=60)
+        finally:
+            await stop_switches(switches)
+
+    run(go())
