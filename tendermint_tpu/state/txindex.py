@@ -1,0 +1,200 @@
+"""Transaction indexer: stores DeliverTx results for /tx and /tx_search.
+
+Reference: state/txindex/ — TxIndexer interface (indexer.go:12), kv
+backend (kv/kv.go: primary record under the tx hash + secondary keys
+"tag/value/height/index" for search), null backend, IndexerService
+(indexer_service.go:17) pumping EventBus tx events into the indexer.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import List, Optional
+
+from tendermint_tpu.abci import types as abci
+from tendermint_tpu.codec.binary import Reader, Writer
+from tendermint_tpu.db.base import DB
+from tendermint_tpu.utils.log import get_logger
+from tendermint_tpu.utils.pubsub import Query
+
+
+@dataclass
+class TxResult:
+    """Reference types.TxResult (types/events.go region)."""
+
+    height: int
+    index: int
+    tx: bytes
+    result: abci.ResponseDeliverTx
+
+    def encode(self) -> bytes:
+        w = Writer()
+        w.write_u64(self.height).write_u32(self.index).write_bytes(self.tx)
+        w.write_bytes(self.result.encode())
+        return w.bytes()
+
+    @classmethod
+    def decode(cls, data: bytes) -> "TxResult":
+        r = Reader(data)
+        return cls(
+            height=r.read_u64(),
+            index=r.read_u32(),
+            tx=r.read_bytes(),
+            result=abci.ResponseDeliverTx.decode(r.read_bytes()),
+        )
+
+
+class TxIndexer:
+    def index(self, result: TxResult) -> None:
+        raise NotImplementedError
+
+    def get(self, tx_hash: bytes) -> Optional[TxResult]:
+        raise NotImplementedError
+
+    def search(self, query: Query, limit: int = 100) -> List[TxResult]:
+        raise NotImplementedError
+
+
+class NullTxIndexer(TxIndexer):
+    """Reference null indexer."""
+
+    def index(self, result: TxResult) -> None:
+        pass
+
+    def get(self, tx_hash: bytes) -> Optional[TxResult]:
+        return None
+
+    def search(self, query: Query, limit: int = 100) -> List[TxResult]:
+        return []
+
+
+def tx_hash(tx: bytes) -> bytes:
+    import hashlib
+
+    return hashlib.sha256(bytes(tx)).digest()
+
+
+_PRIMARY = b"tx:"
+_TAG = b"tg:"
+
+
+def _tag_key(key: str, value: str, height: int, index: int) -> bytes:
+    return (
+        _TAG
+        + key.encode()
+        + b"\x00"
+        + value.encode()
+        + b"\x00"
+        + height.to_bytes(8, "big")
+        + index.to_bytes(4, "big")
+    )
+
+
+class KVTxIndexer(TxIndexer):
+    """Reference kv indexer (state/txindex/kv/kv.go)."""
+
+    def __init__(self, db: DB, index_all_keys: bool = True, index_keys: Optional[set] = None):
+        self._db = db
+        self._index_all = index_all_keys
+        self._index_keys = index_keys or set()
+
+    def index(self, result: TxResult) -> None:
+        h = tx_hash(result.tx)
+        batch = self._db.new_batch()
+        batch.set(_PRIMARY + h, result.encode())
+        # implicit tx.height tag (reference indexes tx.height always)
+        batch.set(
+            _tag_key("tx.height", str(result.height), result.height, result.index), h
+        )
+        for ev in result.result.events:
+            for attr in ev.attributes:
+                key = f"{ev.type}.{attr.key.decode(errors='replace')}"
+                if self._index_all or key in self._index_keys:
+                    batch.set(
+                        _tag_key(
+                            key, attr.value.decode(errors="replace"),
+                            result.height, result.index,
+                        ),
+                        h,
+                    )
+        batch.write()
+
+    def get(self, tx_hash_: bytes) -> Optional[TxResult]:
+        raw = self._db.get(_PRIMARY + tx_hash_)
+        return TxResult.decode(raw) if raw is not None else None
+
+    def search(self, query: Query, limit: int = 100) -> List[TxResult]:
+        """Conjunction of conditions; each condition produces a hash set
+        from its tag index; intersect (reference kv.go Search)."""
+        hash_sets = []
+        for cond in query.conditions:
+            matches = set()
+            prefix = _TAG + cond.key.encode() + b"\x00"
+            for k, v in self._db.prefix_iterator(prefix):
+                rest = k[len(prefix) :]
+                # layout: value + \x00 + height(8) + index(4)
+                value = rest[:-13].decode(errors="replace")
+                if _match_condition(value, cond):
+                    matches.add(bytes(v))
+            hash_sets.append(matches)
+        if not hash_sets:
+            return []
+        result_hashes = set.intersection(*hash_sets)
+        out = []
+        for h in result_hashes:
+            tr = self.get(h)
+            if tr is not None:
+                out.append(tr)
+        out.sort(key=lambda t: (t.height, t.index))
+        return out[:limit]
+
+
+def _match_condition(value: str, cond) -> bool:
+    from tendermint_tpu.utils.pubsub import _match_one
+
+    return _match_one(value, cond)
+
+
+class IndexerService:
+    """Pumps EventBus tx events into the indexer (reference
+    indexer_service.go:17). Subscribe must happen before blocks flow."""
+
+    SUBSCRIBER = "IndexerService"
+
+    def __init__(self, indexer: TxIndexer, event_bus, logger=None):
+        self._indexer = indexer
+        self._event_bus = event_bus
+        self.logger = logger or get_logger("txindex")
+        self._task = None
+
+    async def start(self) -> None:
+        import asyncio
+
+        from tendermint_tpu.types.events import query_for_event
+        from tendermint_tpu.types.events import EVENT_TX
+
+        self._sub = await self._event_bus.subscribe(
+            self.SUBSCRIBER, query_for_event(EVENT_TX), capacity=1000
+        )
+        self._task = asyncio.create_task(self._run())
+
+    async def stop(self) -> None:
+        if self._task is not None:
+            self._task.cancel()
+
+    async def _run(self) -> None:
+        import asyncio
+
+        try:
+            while True:
+                msg = await self._sub.next()
+                ed = msg.data  # EventDataTx
+                self._indexer.index(
+                    TxResult(
+                        height=ed.height, index=ed.index, tx=ed.tx, result=ed.result
+                    )
+                )
+        except asyncio.CancelledError:
+            raise
+        except Exception as e:
+            self.logger.error("indexer service died", err=repr(e))

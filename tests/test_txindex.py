@@ -1,0 +1,96 @@
+"""Tx indexer: index/get/search + IndexerService off the EventBus.
+
+Mirrors reference state/txindex/kv/kv_test.go (TestTxIndex,
+TestTxSearch) and indexer_service_test.go.
+"""
+
+import asyncio
+
+from tendermint_tpu.abci import types as abci
+from tendermint_tpu.db.memdb import MemDB
+from tendermint_tpu.state.txindex import (
+    IndexerService,
+    KVTxIndexer,
+    NullTxIndexer,
+    TxResult,
+    tx_hash,
+)
+from tendermint_tpu.utils.pubsub import Query
+
+
+def make_result(height, index, tx, events=None):
+    return TxResult(
+        height=height,
+        index=index,
+        tx=tx,
+        result=abci.ResponseDeliverTx(events=events or []),
+    )
+
+
+def ev(type_, **kv):
+    return abci.Event(
+        type=type_,
+        attributes=[abci.KVPair(k.encode(), str(v).encode()) for k, v in kv.items()],
+    )
+
+
+def test_index_and_get():
+    idx = KVTxIndexer(MemDB())
+    tr = make_result(5, 0, b"hello-tx", [ev("transfer", sender="alice")])
+    idx.index(tr)
+    got = idx.get(tx_hash(b"hello-tx"))
+    assert got is not None and got.height == 5 and got.tx == b"hello-tx"
+    assert idx.get(tx_hash(b"missing")) is None
+
+
+def test_search_by_height_and_tags():
+    idx = KVTxIndexer(MemDB())
+    idx.index(make_result(1, 0, b"tx-a", [ev("transfer", sender="alice", amount=10)]))
+    idx.index(make_result(1, 1, b"tx-b", [ev("transfer", sender="bob", amount=20)]))
+    idx.index(make_result(2, 0, b"tx-c", [ev("transfer", sender="alice", amount=30)]))
+
+    by_height = idx.search(Query("tx.height = 1"))
+    assert [t.tx for t in by_height] == [b"tx-a", b"tx-b"]
+
+    alice = idx.search(Query("transfer.sender = 'alice'"))
+    assert [t.tx for t in alice] == [b"tx-a", b"tx-c"]
+
+    both = idx.search(Query("transfer.sender = 'alice' AND tx.height = 2"))
+    assert [t.tx for t in both] == [b"tx-c"]
+
+    rng = idx.search(Query("transfer.amount > 15"))
+    assert sorted(t.tx for t in rng) == [b"tx-b", b"tx-c"]
+
+    assert idx.search(Query("transfer.sender = 'carol'")) == []
+
+
+def test_null_indexer():
+    idx = NullTxIndexer()
+    idx.index(make_result(1, 0, b"x"))
+    assert idx.get(tx_hash(b"x")) is None
+    assert idx.search(Query("tx.height = 1")) == []
+
+
+def test_indexer_service_off_event_bus():
+    async def go():
+        from tendermint_tpu.types.event_data import EventDataTx
+        from tendermint_tpu.types.events import EventBus
+
+        bus = EventBus()
+        await bus.start()
+        idx = KVTxIndexer(MemDB())
+        svc = IndexerService(idx, bus)
+        await svc.start()
+        await bus.publish_event_tx(
+            EventDataTx(height=3, index=0, tx=b"evt-tx", result=abci.ResponseDeliverTx())
+        )
+        for _ in range(100):
+            if idx.get(tx_hash(b"evt-tx")):
+                break
+            await asyncio.sleep(0.01)
+        got = idx.get(tx_hash(b"evt-tx"))
+        assert got is not None and got.height == 3
+        await svc.stop()
+        await bus.stop()
+
+    asyncio.run(go())
