@@ -1,0 +1,3 @@
+from tendermint_tpu.cli import main
+
+main()

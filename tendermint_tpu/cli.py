@@ -1,0 +1,274 @@
+"""Command-line interface.
+
+Reference: cmd/tendermint/ — main.go:20-43 registers init, node,
+testnet, gen_validator, gen_node_key, show_node_id, show_validator,
+unsafe_reset_all, version (cobra; argparse here). `--home` mirrors the
+reference's root-dir flag.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import os
+import shutil
+import signal
+import sys
+import time
+
+from tendermint_tpu.config import (
+    Config,
+    default_config,
+    load_config,
+    test_config,
+    write_config_file,
+)
+from tendermint_tpu.config.config import (
+    DEFAULT_CONFIG_DIR,
+    DEFAULT_CONFIG_FILE,
+    ensure_root,
+)
+from tendermint_tpu.crypto.keys import Ed25519PrivKey
+from tendermint_tpu.p2p.key import NodeKey, load_or_gen_node_key
+from tendermint_tpu.privval import load_or_gen_file_pv
+from tendermint_tpu.types.genesis import GenesisDoc, GenesisValidator
+from tendermint_tpu.version import TM_CORE_SEMVER
+
+DEFAULT_HOME = os.path.expanduser("~/.tendermint_tpu")
+
+
+def load_or_default_config(home: str) -> Config:
+    path = os.path.join(home, DEFAULT_CONFIG_DIR, DEFAULT_CONFIG_FILE)
+    cfg = load_config(path) if os.path.exists(path) else default_config()
+    cfg.set_root(home)
+    err = cfg.validate_basic()
+    if err:
+        raise SystemExit(f"invalid config: {err}")
+    return cfg
+
+
+# -- commands --------------------------------------------------------------
+
+
+def cmd_init(args) -> None:
+    """Reference commands/init.go: config + genesis + privval + node key."""
+    home = args.home
+    ensure_root(home)
+    cfg = load_or_default_config(home)
+    cfg_file = os.path.join(home, DEFAULT_CONFIG_DIR, DEFAULT_CONFIG_FILE)
+    if not os.path.exists(cfg_file):
+        write_config_file(cfg_file, cfg)
+
+    pv = load_or_gen_file_pv(
+        cfg.base.priv_validator_key_file(), cfg.base.priv_validator_state_file()
+    )
+    load_or_gen_node_key(cfg.base.node_key_file())
+
+    genesis_file = cfg.base.genesis_file()
+    if not os.path.exists(genesis_file):
+        doc = GenesisDoc(
+            chain_id=args.chain_id or f"test-chain-{os.urandom(3).hex()}",
+            genesis_time_ns=time.time_ns(),
+            validators=[
+                GenesisValidator(pub_key=pv.get_pub_key(), power=10, name="")
+            ],
+        )
+        doc.validate_and_complete()
+        doc.save_as(genesis_file)
+        print(f"Generated genesis file {genesis_file}")
+    print(f"Initialized node in {home}")
+
+
+def cmd_node(args) -> None:
+    """Reference commands/run_node.go."""
+    from tendermint_tpu.node import default_new_node
+    from tendermint_tpu.rpc_attach import attach_rpc
+
+    cfg = load_or_default_config(args.home)
+    if args.proxy_app:
+        cfg.base.proxy_app = args.proxy_app
+    if args.p2p_laddr:
+        cfg.p2p.laddr = args.p2p_laddr
+    if args.rpc_laddr:
+        cfg.rpc.laddr = args.rpc_laddr
+    if args.persistent_peers:
+        cfg.p2p.persistent_peers = args.persistent_peers
+
+    async def run() -> None:
+        node = default_new_node(cfg)
+        attach_rpc(node)
+        await node.start()
+        stop = asyncio.Event()
+        loop = asyncio.get_running_loop()
+        for sig in (signal.SIGINT, signal.SIGTERM):
+            loop.add_signal_handler(sig, stop.set)
+        print(f"node {node.node_key.id} started (chain {node.genesis_doc.chain_id})")
+        await stop.wait()
+        await node.stop()
+
+    asyncio.run(run())
+
+
+def cmd_version(args) -> None:
+    print(TM_CORE_SEMVER)
+
+
+def cmd_gen_validator(args) -> None:
+    """Print a fresh priv validator key json (reference gen_validator.go)."""
+    priv = Ed25519PrivKey.generate()
+    pub = priv.pub_key()
+    print(
+        json.dumps(
+            {
+                "address": pub.address().hex(),
+                "pub_key": {"type": "ed25519", "value": pub.bytes().hex()},
+                "priv_key": {"type": "ed25519", "value": priv.bytes().hex()},
+            },
+            indent=2,
+        )
+    )
+
+
+def cmd_gen_node_key(args) -> None:
+    cfg = load_or_default_config(args.home)
+    ensure_root(args.home)
+    nk = load_or_gen_node_key(cfg.base.node_key_file())
+    print(nk.id)
+
+
+def cmd_show_node_id(args) -> None:
+    cfg = load_or_default_config(args.home)
+    nk = NodeKey.load(cfg.base.node_key_file())
+    print(nk.id)
+
+
+def cmd_show_validator(args) -> None:
+    cfg = load_or_default_config(args.home)
+    from tendermint_tpu.privval import load_file_pv
+
+    pv = load_file_pv(
+        cfg.base.priv_validator_key_file(), cfg.base.priv_validator_state_file()
+    )
+    print(
+        json.dumps(
+            {"type": "ed25519", "value": pv.get_pub_key().bytes().hex()}, indent=2
+        )
+    )
+
+
+def cmd_unsafe_reset_all(args) -> None:
+    """Wipe data dir + reset privval state (reference reset_priv_validator.go)."""
+    cfg = load_or_default_config(args.home)
+    data_dir = cfg.base.db_path()
+    if os.path.isdir(data_dir):
+        for entry in os.listdir(data_dir):
+            p = os.path.join(data_dir, entry)
+            if os.path.basename(p) == os.path.basename(
+                cfg.base.priv_validator_state_file()
+            ):
+                continue
+            shutil.rmtree(p) if os.path.isdir(p) else os.unlink(p)
+    if os.path.exists(cfg.base.priv_validator_key_file()):
+        pv = load_or_gen_file_pv(
+            cfg.base.priv_validator_key_file(), cfg.base.priv_validator_state_file()
+        )
+        pv.reset()
+    print(f"Reset {data_dir}")
+
+
+def cmd_testnet(args) -> None:
+    """Generate N-node testnet config dirs (reference commands/testnet.go)."""
+    n = args.v
+    out = args.o
+    starting_port = args.starting_port
+    chain_id = args.chain_id or f"chain-{os.urandom(3).hex()}"
+
+    pvs = []
+    node_keys = []
+    for i in range(n):
+        home = os.path.join(out, f"node{i}")
+        ensure_root(home)
+        cfg = default_config().set_root(home)
+        pv = load_or_gen_file_pv(
+            cfg.base.priv_validator_key_file(), cfg.base.priv_validator_state_file()
+        )
+        pvs.append(pv)
+        node_keys.append(load_or_gen_node_key(cfg.base.node_key_file()))
+
+    genesis = GenesisDoc(
+        chain_id=chain_id,
+        genesis_time_ns=time.time_ns(),
+        validators=[
+            GenesisValidator(pub_key=pv.get_pub_key(), power=1, name=f"node{i}")
+            for i, pv in enumerate(pvs)
+        ],
+    )
+    genesis.validate_and_complete()
+
+    peers = ",".join(
+        f"{node_keys[i].id}@127.0.0.1:{starting_port + 2 * i}" for i in range(n)
+    )
+    for i in range(n):
+        home = os.path.join(out, f"node{i}")
+        cfg = default_config().set_root(home)
+        cfg.base.moniker = f"node{i}"
+        cfg.p2p.laddr = f"tcp://127.0.0.1:{starting_port + 2 * i}"
+        cfg.rpc.laddr = f"tcp://127.0.0.1:{starting_port + 2 * i + 1}"
+        cfg.p2p.persistent_peers = ",".join(
+            p for j, p in enumerate(peers.split(",")) if j != i
+        )
+        cfg.p2p.allow_duplicate_ip = True
+        write_config_file(
+            os.path.join(home, DEFAULT_CONFIG_DIR, DEFAULT_CONFIG_FILE), cfg
+        )
+        genesis.save_as(cfg.base.genesis_file())
+    print(f"Successfully initialized {n} node directories in {out}")
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="tendermint-tpu", description="TPU-native BFT state-machine replication"
+    )
+    p.add_argument("--home", default=os.environ.get("TMHOME", DEFAULT_HOME))
+    sub = p.add_subparsers(dest="command", required=True)
+
+    sp = sub.add_parser("init", help="initialize a node (config, genesis, keys)")
+    sp.add_argument("--chain-id", default="")
+    sp.set_defaults(func=cmd_init)
+
+    sp = sub.add_parser("node", help="run a node")
+    sp.add_argument("--proxy_app", default="")
+    sp.add_argument("--p2p.laddr", dest="p2p_laddr", default="")
+    sp.add_argument("--rpc.laddr", dest="rpc_laddr", default="")
+    sp.add_argument("--p2p.persistent_peers", dest="persistent_peers", default="")
+    sp.set_defaults(func=cmd_node)
+
+    for name, fn in (
+        ("version", cmd_version),
+        ("gen_validator", cmd_gen_validator),
+        ("gen_node_key", cmd_gen_node_key),
+        ("show_node_id", cmd_show_node_id),
+        ("show_validator", cmd_show_validator),
+        ("unsafe_reset_all", cmd_unsafe_reset_all),
+    ):
+        sp = sub.add_parser(name)
+        sp.set_defaults(func=fn)
+
+    sp = sub.add_parser("testnet", help="generate testnet config dirs")
+    sp.add_argument("--v", type=int, default=4, help="number of validators")
+    sp.add_argument("--o", default="./mytestnet", help="output directory")
+    sp.add_argument("--starting-port", type=int, default=26656)
+    sp.add_argument("--chain-id", default="")
+    sp.set_defaults(func=cmd_testnet)
+
+    return p
+
+
+def main(argv=None) -> None:
+    args = build_parser().parse_args(argv)
+    args.func(args)
+
+
+if __name__ == "__main__":
+    main()

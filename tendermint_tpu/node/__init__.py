@@ -1,0 +1,1 @@
+from tendermint_tpu.node.node import Node, default_new_node

@@ -1,0 +1,299 @@
+"""Node: constructor-injection of the entire stack.
+
+Reference: node/node.go — NewNode :565 (wiring order: DBs → state/genesis
+→ proxyApp → eventBus/indexer → handshake → mempool/evidence/blockExec →
+bcReactor → consensus reactor → transport → switch → dial persistent),
+DefaultNewNode :90, OnStart :760 (RPC before p2p), makeNodeInfo :1090.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import List, Optional
+
+from tendermint_tpu.abci.client.local import LocalClient
+from tendermint_tpu.blockchain.reactor import BlockchainReactor
+from tendermint_tpu.config import Config
+from tendermint_tpu.config.config import ensure_root
+from tendermint_tpu.consensus.reactor import ConsensusReactor
+from tendermint_tpu.consensus.replay import Handshaker
+from tendermint_tpu.consensus.state import ConsensusState
+from tendermint_tpu.consensus.wal import BaseWAL
+from tendermint_tpu.db.base import DB
+from tendermint_tpu.db.memdb import MemDB
+from tendermint_tpu.db.sqlitedb import SQLiteDB
+from tendermint_tpu.evidence import EvidencePool, EvidenceReactor
+from tendermint_tpu.mempool import Mempool
+from tendermint_tpu.mempool.reactor import MempoolReactor
+from tendermint_tpu.p2p.key import NodeKey, load_or_gen_node_key
+from tendermint_tpu.p2p.netaddress import NetAddress
+from tendermint_tpu.p2p.node_info import NodeInfo
+from tendermint_tpu.p2p.switch import Switch
+from tendermint_tpu.p2p.transport import Transport
+from tendermint_tpu.privval import load_or_gen_file_pv
+from tendermint_tpu.state.execution import BlockExecutor
+from tendermint_tpu.state.state import State, state_from_genesis_doc
+from tendermint_tpu.state.store import StateStore
+from tendermint_tpu.state.txindex import (
+    IndexerService,
+    KVTxIndexer,
+    NullTxIndexer,
+)
+from tendermint_tpu.store.block_store import BlockStore
+from tendermint_tpu.types.events import EventBus
+from tendermint_tpu.types.genesis import GenesisDoc
+from tendermint_tpu.utils.log import get_logger
+from tendermint_tpu.utils.service import Service
+from tendermint_tpu.version import TM_CORE_SEMVER
+
+
+def make_db(name: str, config: Config) -> DB:
+    if config.base.db_backend == "memdb":
+        return MemDB()
+    return SQLiteDB(name, config.base.db_path())
+
+
+def default_app(config: Config):
+    """Local in-process app from config.proxy_app (reference
+    proxy.DefaultClientCreator proxy/client.go:66)."""
+    spec = config.base.proxy_app
+    if spec == "kvstore":
+        from tendermint_tpu.abci.examples.kvstore import KVStoreApplication
+
+        return KVStoreApplication()
+    if spec == "persistent_kvstore":
+        from tendermint_tpu.abci.examples.kvstore import PersistentKVStoreApplication
+
+        return PersistentKVStoreApplication(make_db("app", config))
+    if spec == "counter":
+        from tendermint_tpu.abci.examples.counter import CounterApplication
+
+        return CounterApplication()
+    if spec == "noop":
+        from tendermint_tpu.abci.application import Application
+
+        return Application()
+    raise ValueError(f"unknown local proxy_app {spec!r} (socket transport: todo)")
+
+
+class Node(Service):
+    """Reference node.Node (node/node.go:60 region)."""
+
+    def __init__(
+        self,
+        config: Config,
+        genesis_doc: GenesisDoc,
+        priv_validator,
+        node_key: NodeKey,
+        app=None,
+        logger=None,
+    ):
+        super().__init__("node")
+        self.config = config
+        self.genesis_doc = genesis_doc
+        self.node_key = node_key
+        self.logger = logger or get_logger("node")
+
+        # -- storage -------------------------------------------------------
+        self.block_store = BlockStore(make_db("blockstore", config))
+        self.state_store = StateStore(make_db("state", config))
+        state = self.state_store.load()
+        if state is None:
+            state = state_from_genesis_doc(genesis_doc)
+            self.state_store.save(state)
+
+        # -- app -----------------------------------------------------------
+        self.app = app if app is not None else default_app(config)
+        self.proxy_app = LocalClient(self.app)
+
+        # -- event bus + indexer --------------------------------------------
+        self.event_bus = EventBus()
+        if config.tx_index.indexer == "kv":
+            self.tx_indexer = KVTxIndexer(
+                make_db("tx_index", config),
+                index_all_keys=config.tx_index.index_all_keys or not config.tx_index.index_keys,
+                index_keys=set(
+                    k.strip() for k in config.tx_index.index_keys.split(",") if k.strip()
+                ),
+            )
+        else:
+            self.tx_indexer = NullTxIndexer()
+        self.indexer_service = IndexerService(self.tx_indexer, self.event_bus)
+
+        self._state_at_boot = state
+        self.priv_validator = priv_validator
+
+        # -- mempool / evidence / exec (wired in on_start after handshake) --
+        self.mempool = Mempool(config.mempool, self.proxy_app)
+        self.evidence_pool = EvidencePool(
+            make_db("evidence", config), self.state_store, self.block_store
+        )
+        self.block_exec = BlockExecutor(
+            self.state_store,
+            self.proxy_app,
+            mempool=self.mempool,
+            evidence_pool=self.evidence_pool,
+            event_bus=self.event_bus,
+        )
+
+        self.consensus_state: Optional[ConsensusState] = None
+        self.consensus_reactor: Optional[ConsensusReactor] = None
+        self.bc_reactor: Optional[BlockchainReactor] = None
+        self.mempool_reactor = MempoolReactor(config.mempool, self.mempool)
+        self.evidence_reactor = EvidenceReactor(self.evidence_pool)
+
+        # -- p2p -----------------------------------------------------------
+        self.transport = Transport(
+            node_key,
+            self._make_node_info,
+            handshake_timeout_s=config.p2p.handshake_timeout_ms / 1000.0,
+            dial_timeout_s=config.p2p.dial_timeout_ms / 1000.0,
+        )
+        self.switch = Switch(self.transport, config=config.p2p)
+
+        self.rpc_server = None  # attached by configure_rpc when rpc is enabled
+
+    def _make_node_info(self) -> NodeInfo:
+        from tendermint_tpu.blockchain.reactor import BLOCKCHAIN_CHANNEL
+        from tendermint_tpu.consensus.reactor import (
+            DATA_CHANNEL,
+            STATE_CHANNEL,
+            VOTE_CHANNEL,
+            VOTE_SET_BITS_CHANNEL,
+        )
+        from tendermint_tpu.evidence.reactor import EVIDENCE_CHANNEL
+        from tendermint_tpu.mempool.reactor import MEMPOOL_CHANNEL
+
+        la = self.transport.listen_addr
+        return NodeInfo(
+            node_id=self.node_key.id,
+            listen_addr=f"{la.host}:{la.port}" if la else "",
+            network=self.genesis_doc.chain_id,
+            version=TM_CORE_SEMVER,
+            channels=bytes(
+                [
+                    BLOCKCHAIN_CHANNEL,
+                    STATE_CHANNEL,
+                    DATA_CHANNEL,
+                    VOTE_CHANNEL,
+                    VOTE_SET_BITS_CHANNEL,
+                    MEMPOOL_CHANNEL,
+                    EVIDENCE_CHANNEL,
+                ]
+            ),
+            moniker=self.config.base.moniker,
+            tx_index="on" if self.config.tx_index.indexer != "null" else "off",
+            rpc_address=self.config.rpc.laddr,
+        )
+
+    # -- lifecycle ---------------------------------------------------------
+
+    async def on_start(self) -> None:
+        """Reference OnStart node/node.go:760 (plus the NewNode steps that
+        must run inside the event loop: app conns, handshake)."""
+        await self.proxy_app.start()
+        await self.event_bus.start()
+        await self.indexer_service.start()
+
+        # ABCI handshake: replay blocks into the app as needed
+        handshaker = Handshaker(
+            self.state_store, self._state_at_boot, self.block_store, self.genesis_doc,
+            logger=self.logger,
+        )
+        await handshaker.handshake(self.proxy_app)
+        state = self.state_store.load()
+        self.evidence_pool.state = state
+
+        # decide fast sync: only if we have peers to sync from and we are
+        # not the sole validator (reference onlyValidatorIsUs node/node.go:314)
+        fast_sync = self.config.base.fast_sync and not self._only_validator_is_us(state)
+
+        self.consensus_state = ConsensusState(
+            config=self.config.consensus,
+            state=state,
+            block_exec=self.block_exec,
+            block_store=self.block_store,
+            mempool=self.mempool,
+            evidence_pool=self.evidence_pool,
+            priv_validator=self.priv_validator,
+            event_bus=self.event_bus,
+            wal=BaseWAL(self.config.consensus.wal_file()),
+        )
+        if not self.config.consensus.create_empty_blocks:
+            self.mempool.enable_txs_available()
+            self.spawn(self._txs_available_pump())
+        self.consensus_reactor = ConsensusReactor(
+            self.consensus_state, wait_sync=fast_sync
+        )
+        self.bc_reactor = BlockchainReactor(
+            state,
+            self.block_exec,
+            self.block_store,
+            fast_sync=fast_sync,
+            consensus_reactor=self.consensus_reactor,
+        )
+        self.switch.add_reactor("blockchain", self.bc_reactor)
+        self.switch.add_reactor("consensus", self.consensus_reactor)
+        self.switch.add_reactor("mempool", self.mempool_reactor)
+        self.switch.add_reactor("evidence", self.evidence_reactor)
+
+        # RPC first, then p2p (reference :760 comment: "we may expose the
+        # RPC without starting the switch")
+        if self.rpc_server is not None:
+            await self.rpc_server.start()
+
+        addr = NetAddress.parse(self.config.p2p.laddr)
+        await self.transport.listen(addr.host, addr.port)
+        await self.switch.start()
+
+        persistent = [
+            NetAddress.parse(a.strip())
+            for a in self.config.p2p.persistent_peers.split(",")
+            if a.strip()
+        ]
+        if persistent:
+            self.switch.dial_peers_async(persistent, persistent=True)
+
+    async def _txs_available_pump(self) -> None:
+        """Forward mempool txs-available into consensus (reference
+        node wires mempool.TxsAvailable() into cs)."""
+        import asyncio
+
+        ev = self.mempool.txs_available()
+        while True:
+            await ev.wait()
+            ev.clear()
+            if self.consensus_state is not None:
+                self.consensus_state.handle_txs_available()
+
+    def _only_validator_is_us(self, state: State) -> bool:
+        if self.priv_validator is None:
+            return False
+        if state.validators.size() != 1:
+            return False
+        addr, _ = state.validators.get_by_index(0)
+        return addr == self.priv_validator.get_pub_key().address()
+
+    async def on_stop(self) -> None:
+        await self.switch.stop()
+        if self.rpc_server is not None:
+            await self.rpc_server.stop()
+        await self.indexer_service.stop()
+        await self.event_bus.stop()
+        await self.proxy_app.stop()
+
+    # -- accessors (used by RPC) -------------------------------------------
+
+    def is_listening(self) -> bool:
+        return self.transport.listen_addr is not None
+
+
+def default_new_node(config: Config, app=None, logger=None) -> Node:
+    """Reference DefaultNewNode node/node.go:90: load node key, privval,
+    genesis from the config-rooted files."""
+    node_key = load_or_gen_node_key(config.base.node_key_file())
+    pv = load_or_gen_file_pv(
+        config.base.priv_validator_key_file(), config.base.priv_validator_state_file()
+    )
+    genesis = GenesisDoc.from_file(config.base.genesis_file())
+    return Node(config, genesis, pv, node_key, app=app, logger=logger)

@@ -1,0 +1,15 @@
+"""RPC attach hook: binds the JSON-RPC server to a node when available.
+
+Placeholder until the rpc package lands; cli.cmd_node imports this so
+node startup works with or without RPC.
+"""
+
+from __future__ import annotations
+
+
+def attach_rpc(node) -> None:
+    try:
+        from tendermint_tpu.rpc.server import RPCServer
+    except ImportError:
+        return
+    node.rpc_server = RPCServer(node)
