@@ -1,0 +1,8 @@
+"""RPC: JSON-RPC 2.0 over HTTP + WebSocket.
+
+Reference: rpc/ — ~35 routes (rpc/core/routes.go:10-47) served by a
+home-grown JSON-RPC library (rpc/lib/server/rpc_func.go) with HTTP POST,
+GET-with-query-params, and WebSocket transports; event subscriptions
+over WS (rpc/lib/server/ws_handler.go). Stdlib-only here (asyncio
+streams + a minimal RFC6455 implementation).
+"""

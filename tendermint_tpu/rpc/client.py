@@ -1,0 +1,186 @@
+"""RPC clients: HTTP JSON-RPC + WebSocket subscriptions + in-process Local.
+
+Reference: rpc/client/ — Client interface (interface.go:34), HTTP
+implementation (http/), Local (local/, calls handlers directly — used by
+tests and the light client's node-local provider), WSClient
+(rpc/lib/client/ws_client.go).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import base64
+import hashlib
+import json
+import os
+import struct
+from typing import Any, Dict, Optional
+
+from tendermint_tpu.p2p.netaddress import NetAddress
+from tendermint_tpu.rpc.core import RPCCore, RPCError
+from tendermint_tpu.rpc.server import _ws_frame, _ws_read_frame
+
+
+class HTTPClient:
+    """JSON-RPC over HTTP POST (reference rpc/client/http)."""
+
+    def __init__(self, addr: str):
+        a = NetAddress.parse(addr.replace("http://", ""))
+        self.host, self.port = a.host, a.port
+        self._id = 0
+
+    async def call(self, method: str, **params) -> Any:
+        self._id += 1
+        body = json.dumps(
+            {"jsonrpc": "2.0", "id": self._id, "method": method, "params": params}
+        ).encode()
+        reader, writer = await asyncio.open_connection(self.host, self.port)
+        try:
+            writer.write(
+                b"POST / HTTP/1.1\r\nHost: rpc\r\nContent-Type: application/json\r\n"
+                b"Connection: close\r\n"
+                + f"Content-Length: {len(body)}\r\n\r\n".encode()
+                + body
+            )
+            await writer.drain()
+            status = await reader.readline()
+            headers = {}
+            while True:
+                line = await reader.readline()
+                if line in (b"\r\n", b"\n", b""):
+                    break
+                k, v = line.decode().split(":", 1)
+                headers[k.strip().lower()] = v.strip()
+            length = int(headers.get("content-length", "0"))
+            raw = await reader.readexactly(length)
+        finally:
+            writer.close()
+        doc = json.loads(raw)
+        if doc.get("error"):
+            e = doc["error"]
+            raise RPCError(e.get("message", "rpc error"), code=e.get("code", -32000))
+        return doc["result"]
+
+    def __getattr__(self, name: str):
+        if name.startswith("_"):
+            raise AttributeError(name)
+
+        async def route(**params):
+            return await self.call(name, **params)
+
+        return route
+
+
+class WSClient:
+    """WebSocket JSON-RPC client with subscription support."""
+
+    def __init__(self, addr: str):
+        a = NetAddress.parse(addr.replace("ws://", "").replace("http://", ""))
+        self.host, self.port = a.host, a.port
+        self._id = 0
+        self._reader = None
+        self._writer = None
+        self.events: asyncio.Queue = asyncio.Queue()
+        self._responses: Dict[int, asyncio.Future] = {}
+        self._pump_task = None
+
+    async def connect(self) -> None:
+        self._reader, self._writer = await asyncio.open_connection(self.host, self.port)
+        key = base64.b64encode(os.urandom(16)).decode()
+        self._writer.write(
+            f"GET /websocket HTTP/1.1\r\nHost: {self.host}\r\n"
+            f"Upgrade: websocket\r\nConnection: Upgrade\r\n"
+            f"Sec-WebSocket-Key: {key}\r\nSec-WebSocket-Version: 13\r\n\r\n".encode()
+        )
+        await self._writer.drain()
+        status = await self._reader.readline()
+        if b"101" not in status:
+            raise ConnectionError(f"ws upgrade failed: {status!r}")
+        while True:
+            line = await self._reader.readline()
+            if line in (b"\r\n", b"\n", b""):
+                break
+        self._pump_task = asyncio.create_task(self._pump())
+
+    async def _pump(self) -> None:
+        try:
+            while True:
+                opcode, payload = await _ws_read_frame(self._reader)
+                if opcode == 0x8:
+                    break
+                if opcode not in (0x1, 0x2):
+                    continue
+                doc = json.loads(payload)
+                id_ = doc.get("id")
+                fut = self._responses.pop(id_, None)
+                if fut is not None and not fut.done():
+                    fut.set_result(doc)
+                else:
+                    await self.events.put(doc)  # subscription push
+        except (asyncio.IncompleteReadError, ConnectionError):
+            pass
+
+    async def call(self, method: str, **params) -> Any:
+        self._id += 1
+        id_ = self._id
+        fut: asyncio.Future = asyncio.get_running_loop().create_future()
+        self._responses[id_] = fut
+        payload = json.dumps(
+            {"jsonrpc": "2.0", "id": id_, "method": method, "params": params}
+        ).encode()
+        self._writer.write(_mask_frame(payload))
+        await self._writer.drain()
+        doc = await asyncio.wait_for(fut, 10)
+        if doc.get("error"):
+            e = doc["error"]
+            raise RPCError(e.get("message"), code=e.get("code", -32000))
+        return doc.get("result")
+
+    async def subscribe(self, query: str) -> None:
+        await self.call("subscribe", query=query)
+
+    async def next_event(self, timeout_s: float = 10.0) -> Dict[str, Any]:
+        doc = await asyncio.wait_for(self.events.get(), timeout_s)
+        return doc.get("result", {})
+
+    async def close(self) -> None:
+        if self._pump_task is not None:
+            self._pump_task.cancel()
+        if self._writer is not None:
+            self._writer.close()
+
+
+def _mask_frame(payload: bytes) -> bytes:
+    """Client→server frame (masked, RFC6455 §5.3)."""
+    mask = os.urandom(4)
+    masked = bytes(c ^ mask[i % 4] for i, c in enumerate(payload))
+    n = len(payload)
+    header = bytes([0x81])
+    if n < 126:
+        header += bytes([0x80 | n])
+    elif n < (1 << 16):
+        header += bytes([0x80 | 126]) + struct.pack(">H", n)
+    else:
+        header += bytes([0x80 | 127]) + struct.pack(">Q", n)
+    return header + mask + masked
+
+
+class LocalClient:
+    """In-process client calling RPCCore directly (reference
+    rpc/client/local)."""
+
+    def __init__(self, node):
+        self.core = RPCCore(node)
+        self.node = node
+
+    async def call(self, method: str, **params) -> Any:
+        return await self.core.call(method, params)
+
+    def __getattr__(self, name: str):
+        if name.startswith("_") or name in ("core", "node"):
+            raise AttributeError(name)
+
+        async def route(**params):
+            return await self.core.call(name, params)
+
+        return route

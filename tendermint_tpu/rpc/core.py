@@ -1,0 +1,530 @@
+"""RPC route implementations.
+
+Reference: rpc/core/ — routes.go:10-47 lists the route table; handlers in
+status.go, blocks.go, mempool.go (broadcast_tx_* :23,:35,:56), abci.go,
+consensus.go, tx.go, net.go, events.go, evidence.go. Handlers here read
+the live node the same way (the reference injects via rpc/core/pipe.go
+globals; constructor injection here).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+from typing import Any, Dict, List, Optional
+
+from tendermint_tpu.abci import types as abci
+from tendermint_tpu.rpc.encoding import (
+    block_id_json,
+    block_json,
+    block_meta_json,
+    commit_json,
+    header_json,
+    hx,
+    tx_result_json,
+    validator_json,
+)
+from tendermint_tpu.version import TM_CORE_SEMVER
+
+
+class RPCError(Exception):
+    def __init__(self, message: str, code: int = -32000, data=None):
+        super().__init__(message)
+        self.code = code
+        self.data = data
+
+
+def _bytes_arg(v, name: str) -> bytes:
+    """Accept hex (with/without 0x) or raw bytes."""
+    if isinstance(v, bytes):
+        return v
+    if isinstance(v, str):
+        s = v[2:] if v.startswith("0x") else v
+        try:
+            return bytes.fromhex(s)
+        except ValueError:
+            raise RPCError(f"invalid hex for {name}: {v!r}", code=-32602)
+    raise RPCError(f"invalid {name}", code=-32602)
+
+
+def _int_arg(v, name: str, default=None) -> Optional[int]:
+    if v is None:
+        return default
+    try:
+        return int(v)
+    except (TypeError, ValueError):
+        raise RPCError(f"invalid int for {name}: {v!r}", code=-32602)
+
+
+def event_data_json(data) -> Dict[str, Any]:
+    """Best-effort JSON for event payloads (NewBlock/Tx/...)."""
+    from tendermint_tpu.types import event_data as ed
+
+    if isinstance(data, ed.EventDataTx):
+        return {
+            "type": "tx",
+            "height": data.height,
+            "index": data.index,
+            "tx": hx(data.tx),
+            "result": tx_result_json(data.result),
+        }
+    if hasattr(data, "block") and data.block is not None:
+        return {"type": "new_block", "block": block_json(data.block)}
+    if hasattr(data, "header"):
+        return {"type": "new_block_header", "header": header_json(data.header)}
+    if hasattr(data, "height_round_step"):
+        return {"type": "round_state", "hrs": data.height_round_step()}
+    return {"type": type(data).__name__}
+
+
+class RPCCore:
+    def __init__(self, node):
+        self.node = node
+        self._routes = {
+            "health": self.health,
+            "status": self.status,
+            "net_info": self.net_info,
+            "genesis": self.genesis,
+            "blockchain": self.blockchain_info,
+            "block": self.block,
+            "block_by_hash": self.block_by_hash,
+            "block_results": self.block_results,
+            "commit": self.commit,
+            "validators": self.validators,
+            "consensus_state": self.consensus_state,
+            "dump_consensus_state": self.dump_consensus_state,
+            "consensus_params": self.consensus_params,
+            "unconfirmed_txs": self.unconfirmed_txs,
+            "num_unconfirmed_txs": self.num_unconfirmed_txs,
+            "broadcast_tx_async": self.broadcast_tx_async,
+            "broadcast_tx_sync": self.broadcast_tx_sync,
+            "broadcast_tx_commit": self.broadcast_tx_commit,
+            "abci_query": self.abci_query,
+            "abci_info": self.abci_info,
+            "tx": self.tx,
+            "tx_search": self.tx_search,
+            "broadcast_evidence": self.broadcast_evidence,
+            "unsafe_flush_mempool": self.unsafe_flush_mempool,
+        }
+
+    def routes(self) -> List[str]:
+        return list(self._routes)
+
+    async def call(self, name: str, params: Dict[str, Any]):
+        handler = self._routes.get(name)
+        if handler is None:
+            raise RPCError(f"unknown method {name!r}", code=-32601)
+        return await handler(**params)
+
+    # -- info routes -------------------------------------------------------
+
+    async def health(self) -> Dict[str, Any]:
+        return {}
+
+    async def status(self) -> Dict[str, Any]:
+        """Reference rpc/core/status.go."""
+        node = self.node
+        latest_height = node.block_store.height
+        latest_meta = node.block_store.load_block_meta(latest_height)
+        pv = node.priv_validator
+        cs = node.consensus_state
+        return {
+            "node_info": {
+                "id": node.node_key.id,
+                "listen_addr": str(node.transport.listen_addr or ""),
+                "network": node.genesis_doc.chain_id,
+                "version": TM_CORE_SEMVER,
+                "moniker": node.config.base.moniker,
+            },
+            "sync_info": {
+                "latest_block_hash": hx(latest_meta.block_id.hash) if latest_meta else "",
+                "latest_app_hash": hx(cs.state.app_hash) if cs else "",
+                "latest_block_height": latest_height,
+                "latest_block_time_ns": latest_meta.header.time_ns if latest_meta else 0,
+                "earliest_block_height": node.block_store.base,
+                "catching_up": bool(node.bc_reactor and node.bc_reactor.fast_sync),
+            },
+            "validator_info": {
+                "address": hx(pv.get_pub_key().address()) if pv else "",
+                "pub_key": {"type": "ed25519", "value": hx(pv.get_pub_key().bytes())} if pv else None,
+                "voting_power": self._our_voting_power(),
+            },
+        }
+
+    def _our_voting_power(self) -> int:
+        node = self.node
+        if node.priv_validator is None or node.consensus_state is None:
+            return 0
+        vals = node.consensus_state.state.validators
+        _, val = vals.get_by_address(node.priv_validator.get_pub_key().address())
+        return val.voting_power if val else 0
+
+    async def net_info(self) -> Dict[str, Any]:
+        sw = self.node.switch
+        return {
+            "listening": self.node.is_listening(),
+            "listeners": [str(self.node.transport.listen_addr or "")],
+            "n_peers": len(sw.peers),
+            "peers": [
+                {
+                    "node_info": {
+                        "id": p.id,
+                        "listen_addr": p.node_info.listen_addr,
+                        "moniker": p.node_info.moniker,
+                    },
+                    "is_outbound": p.outbound,
+                    "remote_ip": p.socket_addr().host,
+                }
+                for p in sw.peers.values()
+            ],
+        }
+
+    async def genesis(self) -> Dict[str, Any]:
+        import json as _json
+
+        return {"genesis": _json.loads(self.node.genesis_doc.to_json())}
+
+    # -- block routes ------------------------------------------------------
+
+    def _normalize_height(self, height) -> int:
+        store = self.node.block_store
+        h = _int_arg(height, "height")
+        if h is None or h == 0:
+            return store.height
+        if h < 0:
+            raise RPCError("height must be non-negative")
+        if h < store.base:
+            raise RPCError(f"height {h} is below base {store.base}")
+        if h > store.height:
+            raise RPCError(f"height {h} must be <= {store.height}")
+        return h
+
+    async def blockchain_info(self, minHeight=None, maxHeight=None) -> Dict[str, Any]:
+        """Reference rpc/core/blocks.go BlockchainInfo (20-block pages)."""
+        store = self.node.block_store
+        max_h = _int_arg(maxHeight, "maxHeight", 0) or store.height
+        max_h = min(max_h, store.height)
+        min_h = _int_arg(minHeight, "minHeight", 0) or max(store.base, max_h - 19)
+        min_h = max(min_h, store.base, max_h - 19)
+        metas = []
+        for h in range(max_h, min_h - 1, -1):
+            meta = store.load_block_meta(h)
+            if meta is not None:
+                metas.append(block_meta_json(meta))
+        return {"last_height": store.height, "block_metas": metas}
+
+    async def block(self, height=None) -> Dict[str, Any]:
+        h = self._normalize_height(height)
+        store = self.node.block_store
+        blk = store.load_block(h)
+        meta = store.load_block_meta(h)
+        if blk is None:
+            raise RPCError(f"block {h} not found")
+        return {"block_id": block_id_json(meta.block_id), "block": block_json(blk)}
+
+    async def block_by_hash(self, hash=None) -> Dict[str, Any]:
+        blk = self.node.block_store.load_block_by_hash(_bytes_arg(hash, "hash"))
+        if blk is None:
+            return {"block_id": None, "block": None}
+        meta = self.node.block_store.load_block_meta(blk.header.height)
+        return {"block_id": block_id_json(meta.block_id), "block": block_json(blk)}
+
+    async def block_results(self, height=None) -> Dict[str, Any]:
+        h = self._normalize_height(height)
+        res = self.node.state_store.load_abci_responses(h)
+        if res is None:
+            raise RPCError(f"no results for height {h}")
+        return {
+            "height": h,
+            "txs_results": [tx_result_json(r) for r in res.deliver_txs],
+            "validator_updates": [
+                {"pub_key": hx(u.pub_key), "power": u.power}
+                for u in res.end_block.validator_updates
+            ],
+        }
+
+    async def commit(self, height=None) -> Dict[str, Any]:
+        h = self._normalize_height(height)
+        store = self.node.block_store
+        meta = store.load_block_meta(h)
+        if meta is None:
+            raise RPCError(f"block {h} not found")
+        if h == store.height:
+            commit = store.load_seen_commit(h)
+            canonical = False
+        else:
+            commit = store.load_block_commit(h)
+            canonical = True
+        return {
+            "signed_header": {
+                "header": header_json(meta.header),
+                "commit": commit_json(commit) if commit else None,
+            },
+            "canonical": canonical,
+        }
+
+    async def validators(self, height=None, page=1, perPage=100) -> Dict[str, Any]:
+        h = self._normalize_height(height)
+        vals = self.node.state_store.load_validators(h)
+        if vals is None:
+            raise RPCError(f"no validator set at height {h}")
+        page = max(1, _int_arg(page, "page", 1))
+        per_page = min(max(1, _int_arg(perPage, "perPage", 100)), 100)
+        start = (page - 1) * per_page
+        return {
+            "block_height": h,
+            "validators": [validator_json(v) for v in vals.validators[start : start + per_page]],
+            "count": min(per_page, max(0, vals.size() - start)),
+            "total": vals.size(),
+        }
+
+    # -- consensus routes --------------------------------------------------
+
+    async def consensus_state(self) -> Dict[str, Any]:
+        cs = self.node.consensus_state
+        if cs is None:
+            raise RPCError("consensus not started")
+        rs = cs.rs
+        return {
+            "round_state": {
+                "height_round_step": rs.height_round_step(),
+                "start_time_ns": rs.start_time_ns,
+                "proposal_block_hash": hx(rs.proposal_block.hash()) if rs.proposal_block else "",
+                "locked_block_hash": hx(rs.locked_block.hash()) if rs.locked_block else "",
+                "valid_block_hash": hx(rs.valid_block.hash()) if rs.valid_block else "",
+            }
+        }
+
+    async def dump_consensus_state(self) -> Dict[str, Any]:
+        cs = self.node.consensus_state
+        if cs is None:
+            raise RPCError("consensus not started")
+        rs = cs.rs
+        votes = []
+        if rs.votes is not None:
+            for r in range(rs.round + 1):
+                pv = rs.votes.prevotes(r)
+                pc = rs.votes.precommits(r)
+                votes.append(
+                    {
+                        "round": r,
+                        "prevotes": repr(pv) if pv else None,
+                        "precommits": repr(pc) if pc else None,
+                    }
+                )
+        peers = []
+        from tendermint_tpu.consensus.reactor import PEER_STATE_KEY
+
+        for p in self.node.switch.peers.values():
+            ps = p.get(PEER_STATE_KEY)
+            peers.append(
+                {"node_address": p.id, "peer_state": repr(ps.rs) if ps else None}
+            )
+        return {
+            "round_state": {
+                "height_round_step": rs.height_round_step(),
+                "votes": votes,
+                "validators": [validator_json(v) for v in rs.validators.validators]
+                if rs.validators
+                else [],
+            },
+            "peers": peers,
+        }
+
+    async def consensus_params(self, height=None) -> Dict[str, Any]:
+        cs = self.node.consensus_state
+        params = cs.state.consensus_params if cs else None
+        if params is None:
+            raise RPCError("consensus not started")
+        return {
+            "block_height": cs.state.last_block_height,
+            "consensus_params": {
+                "block": {
+                    "max_bytes": params.block.max_bytes,
+                    "max_gas": params.block.max_gas,
+                },
+                "evidence": {
+                    "max_age_num_blocks": params.evidence.max_age_num_blocks,
+                    "max_age_duration_ns": params.evidence.max_age_duration_ns,
+                },
+            },
+        }
+
+    # -- mempool routes ----------------------------------------------------
+
+    async def unconfirmed_txs(self, limit=30) -> Dict[str, Any]:
+        limit = min(max(1, _int_arg(limit, "limit", 30)), 100)
+        txs = self.node.mempool.reap_max_txs(limit)
+        return {
+            "n_txs": len(txs),
+            "total": self.node.mempool.size(),
+            "total_bytes": self.node.mempool.txs_bytes(),
+            "txs": [hx(bytes(t)) for t in txs],
+        }
+
+    async def num_unconfirmed_txs(self) -> Dict[str, Any]:
+        return {
+            "n_txs": self.node.mempool.size(),
+            "total": self.node.mempool.size(),
+            "total_bytes": self.node.mempool.txs_bytes(),
+        }
+
+    async def broadcast_tx_async(self, tx=None) -> Dict[str, Any]:
+        """Reference mempool.go:23 — returns immediately."""
+        raw = _bytes_arg(tx, "tx")
+        asyncio.ensure_future(self._checktx_quiet(raw))
+        from tendermint_tpu.state.txindex import tx_hash
+
+        return {"code": 0, "data": "", "log": "", "hash": hx(tx_hash(raw))}
+
+    async def _checktx_quiet(self, raw: bytes) -> None:
+        try:
+            await self.node.mempool.check_tx(raw)
+        except Exception:
+            pass
+
+    async def broadcast_tx_sync(self, tx=None) -> Dict[str, Any]:
+        """Reference mempool.go:35 — waits for CheckTx."""
+        raw = _bytes_arg(tx, "tx")
+        from tendermint_tpu.mempool.mempool import ErrTxInCache
+        from tendermint_tpu.state.txindex import tx_hash
+
+        try:
+            res = await self.node.mempool.check_tx(raw)
+        except ErrTxInCache:
+            raise RPCError("tx already exists in cache")
+        except Exception as e:
+            raise RPCError(f"tx rejected: {e}")
+        return {
+            "code": res.code,
+            "data": hx(res.data),
+            "log": res.log,
+            "hash": hx(tx_hash(raw)),
+        }
+
+    async def broadcast_tx_commit(self, tx=None) -> Dict[str, Any]:
+        """Reference mempool.go:56 — waits for the tx to be committed."""
+        from tendermint_tpu.state.txindex import tx_hash
+        from tendermint_tpu.types.events import EVENT_TX, query_for_event
+
+        raw = _bytes_arg(tx, "tx")
+        h = tx_hash(raw)
+        subscriber = f"tx-commit-{h.hex()[:16]}-{time.monotonic_ns()}"
+        sub = await self.node.event_bus.subscribe(
+            subscriber, query_for_event(EVENT_TX), capacity=100
+        )
+        try:
+            res = await self.node.mempool.check_tx(raw)
+            if not res.is_ok():
+                return {
+                    "check_tx": tx_result_json(res),
+                    "deliver_tx": None,
+                    "hash": hx(h),
+                    "height": 0,
+                }
+            timeout_s = self.node.config.rpc.timeout_broadcast_tx_commit_ms / 1000.0
+            deadline = time.monotonic() + timeout_s
+            while True:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    raise RPCError("timed out waiting for tx to be included in a block")
+                try:
+                    msg = await asyncio.wait_for(sub.next(), remaining)
+                except asyncio.TimeoutError:
+                    raise RPCError("timed out waiting for tx to be included in a block")
+                ed = msg.data
+                if bytes(ed.tx) == raw:
+                    return {
+                        "check_tx": tx_result_json(res),
+                        "deliver_tx": tx_result_json(ed.result),
+                        "hash": hx(h),
+                        "height": ed.height,
+                    }
+        finally:
+            await self.node.event_bus.unsubscribe_all(subscriber)
+
+    async def unsafe_flush_mempool(self) -> Dict[str, Any]:
+        await self.node.mempool.flush()
+        return {}
+
+    # -- abci routes -------------------------------------------------------
+
+    async def abci_query(self, path="", data=None, height=0, prove=False) -> Dict[str, Any]:
+        res = await self.node.proxy_app.query_sync(
+            abci.RequestQuery(
+                data=_bytes_arg(data, "data") if data else b"",
+                path=path,
+                height=_int_arg(height, "height", 0),
+                prove=bool(prove),
+            )
+        )
+        return {
+            "response": {
+                "code": res.code,
+                "log": res.log,
+                "info": res.info,
+                "index": res.index,
+                "key": hx(res.key),
+                "value": hx(res.value),
+                "height": res.height,
+            }
+        }
+
+    async def abci_info(self) -> Dict[str, Any]:
+        res = await self.node.proxy_app.info_sync(abci.RequestInfo(version=TM_CORE_SEMVER))
+        return {
+            "response": {
+                "data": res.data,
+                "version": res.version,
+                "app_version": res.app_version,
+                "last_block_height": res.last_block_height,
+                "last_block_app_hash": hx(res.last_block_app_hash),
+            }
+        }
+
+    # -- tx routes ---------------------------------------------------------
+
+    async def tx(self, hash=None, prove=False) -> Dict[str, Any]:
+        h = _bytes_arg(hash, "hash")
+        r = self.node.tx_indexer.get(h)
+        if r is None:
+            raise RPCError(f"tx {hx(h)} not found")
+        return {
+            "hash": hx(h),
+            "height": r.height,
+            "index": r.index,
+            "tx_result": tx_result_json(r.result),
+            "tx": hx(r.tx),
+        }
+
+    async def tx_search(self, query="", prove=False, page=1, per_page=30) -> Dict[str, Any]:
+        from tendermint_tpu.state.txindex import tx_hash
+        from tendermint_tpu.utils.pubsub import Query
+
+        results = self.node.tx_indexer.search(Query(query), limit=10000)
+        page = max(1, _int_arg(page, "page", 1))
+        per_page = min(max(1, _int_arg(per_page, "per_page", 30)), 100)
+        start = (page - 1) * per_page
+        chunk = results[start : start + per_page]
+        return {
+            "txs": [
+                {
+                    "hash": hx(tx_hash(r.tx)),
+                    "height": r.height,
+                    "index": r.index,
+                    "tx_result": tx_result_json(r.result),
+                    "tx": hx(r.tx),
+                }
+                for r in chunk
+            ],
+            "total_count": len(results),
+        }
+
+    # -- evidence ----------------------------------------------------------
+
+    async def broadcast_evidence(self, evidence=None) -> Dict[str, Any]:
+        from tendermint_tpu.types.evidence import decode_evidence
+
+        ev = decode_evidence(_bytes_arg(evidence, "evidence"))
+        self.node.evidence_pool.add_evidence(ev)
+        return {"hash": hx(ev.hash())}

@@ -1,0 +1,109 @@
+"""JSON encoding of domain objects for RPC responses.
+
+Reference: the amino-JSON encodings in rpc/core/types (ResultStatus,
+ResultBlock, ...). Bytes are hex strings here (clean break from amino's
+base64); heights/ints are JSON numbers.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+
+def hx(b: Optional[bytes]) -> str:
+    return (b or b"").hex().upper()
+
+
+def part_set_header_json(psh) -> Dict[str, Any]:
+    return {"total": psh.total, "hash": hx(psh.hash)}
+
+
+def block_id_json(bid) -> Dict[str, Any]:
+    return {"hash": hx(bid.hash), "parts": part_set_header_json(bid.parts)}
+
+
+def header_json(h) -> Dict[str, Any]:
+    return {
+        "chain_id": h.chain_id,
+        "height": h.height,
+        "time_ns": h.time_ns,
+        "last_block_id": block_id_json(h.last_block_id),
+        "last_commit_hash": hx(h.last_commit_hash),
+        "data_hash": hx(h.data_hash),
+        "validators_hash": hx(h.validators_hash),
+        "next_validators_hash": hx(h.next_validators_hash),
+        "consensus_hash": hx(h.consensus_hash),
+        "app_hash": hx(h.app_hash),
+        "last_results_hash": hx(h.last_results_hash),
+        "evidence_hash": hx(h.evidence_hash),
+        "proposer_address": hx(h.proposer_address),
+        "version": {"block": h.version_block, "app": h.version_app},
+    }
+
+
+def commit_sig_json(cs) -> Dict[str, Any]:
+    return {
+        "block_id_flag": cs.block_id_flag,
+        "validator_address": hx(cs.validator_address),
+        "timestamp_ns": cs.timestamp_ns,
+        "signature": hx(cs.signature),
+    }
+
+
+def commit_json(c) -> Dict[str, Any]:
+    return {
+        "height": c.height,
+        "round": c.round,
+        "block_id": block_id_json(c.block_id),
+        "signatures": [commit_sig_json(s) for s in c.signatures],
+    }
+
+
+def block_json(b) -> Dict[str, Any]:
+    return {
+        "header": header_json(b.header),
+        "data": {"txs": [hx(bytes(t)) for t in b.data.txs]},
+        "evidence": {"evidence": []},
+        "last_commit": commit_json(b.last_commit) if b.last_commit else None,
+    }
+
+
+def block_meta_json(m) -> Dict[str, Any]:
+    return {
+        "block_id": block_id_json(m.block_id),
+        "block_size": m.block_size,
+        "header": header_json(m.header),
+        "num_txs": m.num_txs,
+    }
+
+
+def validator_json(v) -> Dict[str, Any]:
+    return {
+        "address": hx(v.address),
+        "pub_key": {"type": "ed25519", "value": hx(v.pub_key.bytes())},
+        "voting_power": v.voting_power,
+        "proposer_priority": v.proposer_priority,
+    }
+
+
+def tx_result_json(r) -> Dict[str, Any]:
+    return {
+        "code": r.code,
+        "data": hx(r.data),
+        "log": r.log,
+        "info": r.info,
+        "gas_wanted": r.gas_wanted,
+        "gas_used": r.gas_used,
+        "events": [
+            {
+                "type": e.type,
+                "attributes": [
+                    {"key": a.key.decode(errors="replace"),
+                     "value": a.value.decode(errors="replace")}
+                    for a in e.attributes
+                ],
+            }
+            for e in r.events
+        ],
+        "codespace": r.codespace,
+    }

@@ -1,0 +1,277 @@
+"""JSON-RPC 2.0 server over HTTP + WebSocket (stdlib only).
+
+Reference: rpc/lib/server/ — http_json_handler (POST JSON-RPC),
+handleURI (GET with query params, rpc_func.go:44 region), ws_handler.go
+(WebSocket JSON-RPC incl. subscribe/unsubscribe). The route table comes
+from rpc/core/routes.go via tendermint_tpu.rpc.core.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import base64
+import hashlib
+import json
+import struct
+from typing import Any, Dict, Optional
+from urllib.parse import parse_qsl, urlparse
+
+from tendermint_tpu.p2p.netaddress import NetAddress
+from tendermint_tpu.rpc.core import RPCCore, RPCError
+from tendermint_tpu.utils.log import get_logger
+
+_WS_GUID = "258EAFA5-E914-47DA-95CA-C5AB0DC85B11"
+
+
+def _rpc_response(id_, result=None, error=None) -> bytes:
+    doc: Dict[str, Any] = {"jsonrpc": "2.0", "id": id_}
+    if error is not None:
+        doc["error"] = error
+    else:
+        doc["result"] = result
+    return json.dumps(doc).encode()
+
+
+class RPCServer:
+    def __init__(self, node, laddr: Optional[str] = None, logger=None):
+        self.node = node
+        self.core = RPCCore(node)
+        self.logger = logger or get_logger("rpc")
+        self._laddr = laddr or node.config.rpc.laddr
+        self._server: Optional[asyncio.base_events.Server] = None
+        self.listen_addr: Optional[NetAddress] = None
+        self._ws_counter = 0
+
+    async def start(self) -> None:
+        addr = NetAddress.parse(self._laddr)
+        self._server = await asyncio.start_server(self._handle_conn, addr.host, addr.port)
+        host, port = self._server.sockets[0].getsockname()[:2]
+        self.listen_addr = NetAddress("", host, port)
+        self.logger.info("RPC listening", addr=f"http://{host}:{port}")
+
+    async def stop(self) -> None:
+        if self._server is not None:
+            self._server.close()
+            await self._server.wait_closed()
+            self._server = None
+
+    # -- connection handling ------------------------------------------------
+
+    async def _handle_conn(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter) -> None:
+        try:
+            while True:
+                req = await self._read_http_request(reader)
+                if req is None:
+                    break
+                method, target, headers, body = req
+                if headers.get("upgrade", "").lower() == "websocket":
+                    await self._handle_websocket(reader, writer, headers)
+                    break
+                resp = await self._dispatch_http(method, target, body)
+                keep = headers.get("connection", "keep-alive").lower() != "close"
+                writer.write(
+                    b"HTTP/1.1 200 OK\r\nContent-Type: application/json\r\n"
+                    + f"Content-Length: {len(resp)}\r\n".encode()
+                    + (b"" if keep else b"Connection: close\r\n")
+                    + b"\r\n"
+                    + resp
+                )
+                await writer.drain()
+                if not keep:
+                    break
+        except (asyncio.IncompleteReadError, ConnectionError):
+            pass
+        except Exception as e:
+            self.logger.debug("rpc conn error", err=repr(e))
+        finally:
+            try:
+                writer.close()
+            except Exception:
+                pass
+
+    async def _read_http_request(self, reader):
+        line = await reader.readline()
+        if not line:
+            return None
+        try:
+            method, target, _ = line.decode().split(" ", 2)
+        except ValueError:
+            return None
+        headers: Dict[str, str] = {}
+        while True:
+            h = await reader.readline()
+            if h in (b"\r\n", b"\n", b""):
+                break
+            if b":" in h:
+                k, v = h.decode().split(":", 1)
+                headers[k.strip().lower()] = v.strip()
+        body = b""
+        length = int(headers.get("content-length", "0") or 0)
+        if length:
+            body = await reader.readexactly(length)
+        return method, target, headers, body
+
+    async def _dispatch_http(self, method: str, target: str, body: bytes) -> bytes:
+        if method == "POST":
+            try:
+                doc = json.loads(body or b"{}")
+            except json.JSONDecodeError as e:
+                return _rpc_response(None, error={"code": -32700, "message": f"parse error: {e}"})
+            if isinstance(doc, list):  # batch
+                parts = [await self._call_one(d) for d in doc]
+                return b"[" + b",".join(parts) + b"]"
+            return await self._call_one(doc)
+        # GET: /route?key=val  (reference handleURI)
+        url = urlparse(target)
+        name = url.path.strip("/")
+        if not name:
+            return json.dumps({"routes": sorted(self.core.routes())}).encode()
+        params = {k: _parse_uri_value(v) for k, v in parse_qsl(url.query)}
+        return await self._call_one({"id": -1, "method": name, "params": params})
+
+    async def _call_one(self, doc: Dict[str, Any]) -> bytes:
+        id_ = doc.get("id")
+        name = doc.get("method", "")
+        params = doc.get("params") or {}
+        try:
+            result = await self.core.call(name, params)
+            return _rpc_response(id_, result=result)
+        except RPCError as e:
+            return _rpc_response(id_, error={"code": e.code, "message": str(e), "data": e.data})
+        except Exception as e:
+            self.logger.error("rpc handler error", method=name, err=repr(e))
+            return _rpc_response(id_, error={"code": -32603, "message": f"internal error: {e}"})
+
+    # -- websocket ----------------------------------------------------------
+
+    async def _handle_websocket(self, reader, writer, headers) -> None:
+        """Reference ws_handler.go: JSON-RPC over WS + event subscriptions."""
+        key = headers.get("sec-websocket-key", "")
+        accept = base64.b64encode(
+            hashlib.sha1((key + _WS_GUID).encode()).digest()
+        ).decode()
+        writer.write(
+            b"HTTP/1.1 101 Switching Protocols\r\nUpgrade: websocket\r\n"
+            b"Connection: Upgrade\r\n"
+            + f"Sec-WebSocket-Accept: {accept}\r\n\r\n".encode()
+        )
+        await writer.drain()
+        self._ws_counter += 1
+        client_id = f"ws-{self._ws_counter}"
+        send_lock = asyncio.Lock()
+        pump_tasks = []
+
+        async def push(payload: bytes) -> None:
+            async with send_lock:
+                writer.write(_ws_frame(payload))
+                await writer.drain()
+
+        try:
+            while True:
+                opcode, payload = await _ws_read_frame(reader)
+                if opcode == 0x8:  # close
+                    break
+                if opcode == 0x9:  # ping → pong
+                    async with send_lock:
+                        writer.write(_ws_frame(payload, opcode=0xA))
+                        await writer.drain()
+                    continue
+                if opcode not in (0x1, 0x2):
+                    continue
+                doc = json.loads(payload)
+                name = doc.get("method", "")
+                if name == "subscribe":
+                    task = await self._ws_subscribe(client_id, doc, push)
+                    if task is not None:
+                        pump_tasks.append(task)
+                    continue
+                resp = await self._call_one(doc)
+                await push(resp)
+        except (asyncio.IncompleteReadError, ConnectionError):
+            pass
+        finally:
+            for t in pump_tasks:
+                t.cancel()
+            try:
+                await self.node.event_bus.unsubscribe_all(client_id)
+            except Exception:
+                pass
+
+    async def _ws_subscribe(self, client_id, doc, push):
+        from tendermint_tpu.utils.pubsub import Query
+
+        id_ = doc.get("id")
+        query_s = (doc.get("params") or {}).get("query", "")
+        try:
+            query = Query(query_s)
+            sub = await self.node.event_bus.subscribe(client_id, query, capacity=100)
+        except Exception as e:
+            await push(_rpc_response(id_, error={"code": -32602, "message": str(e)}))
+            return None
+        await push(_rpc_response(id_, result={}))
+
+        async def pump():
+            from tendermint_tpu.rpc.core import event_data_json
+
+            try:
+                while True:
+                    msg = await sub.next()
+                    await push(
+                        _rpc_response(
+                            id_,
+                            result={
+                                "query": query_s,
+                                "data": event_data_json(msg.data),
+                                "events": msg.tags,
+                            },
+                        )
+                    )
+            except asyncio.CancelledError:
+                raise
+            except Exception:
+                pass
+
+        return asyncio.create_task(pump())
+
+
+def _parse_uri_value(v: str):
+    """GET params arrive as strings; JSON-decode scalars when possible
+    (reference rpc/lib arg decoding: quoted strings / numbers / hex)."""
+    if v.startswith("0x"):
+        return v  # hex strings stay strings; handlers decode
+    try:
+        return json.loads(v)
+    except (json.JSONDecodeError, ValueError):
+        return v
+
+
+# -- minimal RFC6455 frames -------------------------------------------------
+
+
+def _ws_frame(payload: bytes, opcode: int = 0x1) -> bytes:
+    """Server→client frame (unmasked)."""
+    header = bytes([0x80 | opcode])
+    n = len(payload)
+    if n < 126:
+        header += bytes([n])
+    elif n < (1 << 16):
+        header += bytes([126]) + struct.pack(">H", n)
+    else:
+        header += bytes([127]) + struct.pack(">Q", n)
+    return header + payload
+
+
+async def _ws_read_frame(reader):
+    b0, b1 = await reader.readexactly(2)
+    opcode = b0 & 0x0F
+    masked = b1 & 0x80
+    length = b1 & 0x7F
+    if length == 126:
+        (length,) = struct.unpack(">H", await reader.readexactly(2))
+    elif length == 127:
+        (length,) = struct.unpack(">Q", await reader.readexactly(8))
+    mask = await reader.readexactly(4) if masked else b""
+    payload = await reader.readexactly(length) if length else b""
+    if masked:
+        payload = bytes(c ^ mask[i % 4] for i, c in enumerate(payload))
+    return opcode, payload

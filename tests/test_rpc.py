@@ -1,0 +1,182 @@
+"""RPC server + clients against a live single-validator node.
+
+Mirrors reference rpc/client/rpc_test.go (status, block, commit,
+broadcast_tx_*, abci_query, tx, tx_search) and ws events tests.
+"""
+
+import asyncio
+import json
+
+import pytest
+
+from tendermint_tpu.cli import main as cli_main
+from tendermint_tpu.config import load_config
+from tendermint_tpu.node import default_new_node
+from tendermint_tpu.rpc.client import HTTPClient, WSClient
+from tendermint_tpu.rpc.core import RPCError
+from tendermint_tpu.rpc.server import RPCServer
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+async def start_node(tmp_path):
+    import os
+
+    home = str(tmp_path / "rpcnode")
+    cli_main(["--home", home, "init", "--chain-id", "rpc-chain"])
+    cfg = load_config(os.path.join(home, "config/config.toml")).set_root(home)
+    cfg.base.db_backend = "memdb"
+    cfg.p2p.laddr = "tcp://127.0.0.1:0"
+    cfg.rpc.laddr = "tcp://127.0.0.1:0"
+    cfg.consensus.timeout_commit_ms = 80
+    cfg.consensus.skip_timeout_commit = True
+    node = default_new_node(cfg)
+    node.rpc_server = RPCServer(node)
+    await node.start()
+    await node.consensus_state.wait_for_height(2, timeout_s=30)
+    addr = node.rpc_server.listen_addr
+    return node, HTTPClient(f"{addr.host}:{addr.port}")
+
+
+def test_info_routes(tmp_path):
+    async def go():
+        node, c = await start_node(tmp_path)
+        try:
+            assert await c.health() == {}
+            st = await c.status()
+            assert st["node_info"]["network"] == "rpc-chain"
+            assert st["sync_info"]["latest_block_height"] >= 2
+            assert not st["sync_info"]["catching_up"]
+            assert st["validator_info"]["voting_power"] == 10
+
+            ni = await c.net_info()
+            assert ni["listening"] and ni["n_peers"] == 0
+
+            gen = await c.genesis()
+            assert gen["genesis"]["chain_id"] == "rpc-chain"
+
+            ai = await c.abci_info()
+            assert ai["response"]["last_block_height"] >= 1
+        finally:
+            await node.stop()
+
+    run(go())
+
+
+def test_block_routes(tmp_path):
+    async def go():
+        node, c = await start_node(tmp_path)
+        try:
+            b2 = await c.block(height=2)
+            assert b2["block"]["header"]["height"] == 2
+            assert b2["block"]["header"]["chain_id"] == "rpc-chain"
+            # by hash round-trips
+            bh = await c.block_by_hash(hash=b2["block_id"]["hash"])
+            assert bh["block"]["header"]["height"] == 2
+
+            bc = await c.blockchain()
+            assert bc["last_height"] >= 2
+            assert bc["block_metas"][0]["header"]["height"] == bc["last_height"]
+
+            cm = await c.commit(height=2)
+            assert cm["signed_header"]["commit"]["height"] == 2
+            assert cm["canonical"] is True
+
+            vals = await c.validators(height=2)
+            assert vals["total"] == 1 and len(vals["validators"]) == 1
+
+            with pytest.raises(RPCError):
+                await c.block(height=10**9)
+        finally:
+            await node.stop()
+
+    run(go())
+
+
+def test_broadcast_tx_and_search(tmp_path):
+    async def go():
+        node, c = await start_node(tmp_path)
+        try:
+            res = await c.broadcast_tx_commit(tx=b"rpc=yes".hex())
+            assert res["deliver_tx"]["code"] == 0
+            assert res["height"] > 0
+            # indexed and searchable
+            got = await c.tx(hash=res["hash"])
+            assert got["height"] == res["height"]
+            found = await c.tx_search(query=f"tx.height = {res['height']}")
+            assert found["total_count"] >= 1
+
+            # sync broadcast
+            res2 = await c.broadcast_tx_sync(tx=b"rpc=again".hex())
+            assert res2["code"] == 0
+            # dup rejected from cache
+            with pytest.raises(RPCError):
+                await c.broadcast_tx_sync(tx=b"rpc=again".hex())
+
+            # app query sees committed value
+            await asyncio.sleep(0.5)
+            q = await c.abci_query(path="/store", data=b"rpc".hex())
+            assert bytes.fromhex(q["response"]["value"]) == b"yes"
+
+            unconfirmed = await c.num_unconfirmed_txs()
+            assert "n_txs" in unconfirmed
+        finally:
+            await node.stop()
+
+    run(go())
+
+
+def test_consensus_routes(tmp_path):
+    async def go():
+        node, c = await start_node(tmp_path)
+        try:
+            cs = await c.consensus_state()
+            assert "/" in cs["round_state"]["height_round_step"]
+            dump = await c.dump_consensus_state()
+            assert dump["round_state"]["validators"]
+            params = await c.consensus_params()
+            assert params["consensus_params"]["block"]["max_bytes"] > 0
+        finally:
+            await node.stop()
+
+    run(go())
+
+
+def test_uri_get_requests(tmp_path):
+    async def go():
+        node, c = await start_node(tmp_path)
+        try:
+            # raw GET with query params (reference URI transport)
+            reader, writer = await asyncio.open_connection(c.host, c.port)
+            writer.write(b"GET /block?height=1 HTTP/1.1\r\nHost: x\r\nConnection: close\r\n\r\n")
+            await writer.drain()
+            raw = await reader.read()
+            writer.close()
+            body = raw.split(b"\r\n\r\n", 1)[1]
+            doc = json.loads(body)
+            assert doc["result"]["block"]["header"]["height"] == 1
+        finally:
+            await node.stop()
+
+    run(go())
+
+
+def test_websocket_subscription(tmp_path):
+    async def go():
+        node, c = await start_node(tmp_path)
+        try:
+            ws = WSClient(f"{c.host}:{c.port}")
+            await ws.connect()
+            await ws.subscribe("tm.event = 'NewBlock'")
+            ev = await ws.next_event(timeout_s=10)
+            assert ev["data"]["type"] == "new_block"
+            # status also works over ws
+            st = await ws.call("status")
+            assert st["node_info"]["network"] == "rpc-chain"
+            await ws.close()
+        finally:
+            await node.stop()
+
+    run(go())
