@@ -109,11 +109,11 @@ def test_broadcast_tx_and_search(tmp_path):
             assert found["total_count"] >= 1
 
             # sync broadcast
-            res2 = await c.broadcast_tx_sync(tx=b"rpc=again".hex())
+            res2 = await c.broadcast_tx_sync(tx=b"rpc2=again".hex())
             assert res2["code"] == 0
             # dup rejected from cache
             with pytest.raises(RPCError):
-                await c.broadcast_tx_sync(tx=b"rpc=again".hex())
+                await c.broadcast_tx_sync(tx=b"rpc2=again".hex())
 
             # app query sees committed value
             await asyncio.sleep(0.5)
