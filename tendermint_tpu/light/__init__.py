@@ -1,0 +1,17 @@
+"""Light client (reference lite2/ semantics).
+
+Verifier (adjacent / non-adjacent with 1/3 trust / backwards), bisection
+client with witness cross-checking and a trusted store, providers (rpc /
+mock), verifying proxy. The commit checks run through the TPU-batched
+`ValidatorSet.verify_commit[_trusting]` — the reference's serial loops
+at lite2/verifier.go:60,:76,:131 are each one device call here.
+"""
+
+from tendermint_tpu.light.types import SignedHeader, TrustOptions
+from tendermint_tpu.light.verifier import (
+    verify,
+    verify_adjacent,
+    verify_backwards,
+    verify_non_adjacent,
+)
+from tendermint_tpu.light.client import LightClient

@@ -1,0 +1,212 @@
+"""Light client: sequential + bisection verification with witnesses.
+
+Reference: lite2/client.go — Client :120, initialization from
+TrustOptions :275 region, VerifyHeaderAtHeight :480, verifyHeader :550,
+sequence :620, bisection :687 (pivot at 9/16, client.go:30-31),
+backwards :883, compareNewHeaderWithWitnesses :931, RemovePrimary/
+witness replacement :1034, AutoUpdate/prune.
+"""
+
+from __future__ import annotations
+
+import time
+from fractions import Fraction
+from typing import List, Optional, Tuple
+
+from tendermint_tpu.light import verifier
+from tendermint_tpu.light.provider import Provider
+from tendermint_tpu.light.store import TrustedStore
+from tendermint_tpu.light.types import DEFAULT_TRUST_LEVEL, SignedHeader, TrustOptions
+from tendermint_tpu.types.validator_set import ValidatorSet
+from tendermint_tpu.utils.log import get_logger
+
+# reference client.go:30-31: bisect at 9/16 (not 1/2) — skew towards the
+# new header since valsets change slowly
+_BISECTION_NUM = 9
+_BISECTION_DEN = 16
+
+
+class LightClientError(Exception):
+    pass
+
+
+class ErrConflictingHeaders(LightClientError):
+    """A witness reported a different header — possible fork!"""
+
+    def __init__(self, witness_idx: int, msg: str):
+        super().__init__(msg)
+        self.witness_idx = witness_idx
+
+
+class LightClient:
+    def __init__(
+        self,
+        chain_id: str,
+        trust_options: TrustOptions,
+        primary: Provider,
+        witnesses: List[Provider],
+        store: TrustedStore,
+        trust_level: Fraction = DEFAULT_TRUST_LEVEL,
+        max_retry_attempts: int = 5,
+        logger=None,
+    ):
+        err = trust_options.validate()
+        if err:
+            raise ValueError(err)
+        self.chain_id = chain_id
+        self.trusting_period_ns = trust_options.period_ns
+        self.trust_options = trust_options
+        self.trust_level = trust_level
+        self.primary = primary
+        self.witnesses = list(witnesses)
+        self.store = store
+        self.logger = logger or get_logger("light")
+        self._initialized = False
+
+    # -- initialization ----------------------------------------------------
+
+    async def initialize(self, now_ns: Optional[int] = None) -> None:
+        """Fetch+verify the trusted header from the primary (reference
+        initializeWithTrustOptions :275)."""
+        if self._initialized:
+            return
+        h = self.store.signed_header(self.trust_options.height)
+        if h is None:
+            sh = await self.primary.signed_header(self.trust_options.height)
+            if sh.hash() != self.trust_options.hash:
+                raise LightClientError(
+                    f"expected header hash {self.trust_options.hash.hex()[:12]}, "
+                    f"got {sh.hash().hex()[:12]}"
+                )
+            vals = await self.primary.validator_set(sh.height)
+            if sh.header.validators_hash != vals.hash():
+                raise LightClientError("validators mismatch at trusted height")
+            # ★ one batched device call
+            vals.verify_commit(self.chain_id, sh.block_id(), sh.height, sh.commit)
+            self.store.save(sh, vals)
+        self._initialized = True
+
+    # -- public API --------------------------------------------------------
+
+    async def verify_header_at_height(
+        self, height: int, now_ns: Optional[int] = None
+    ) -> SignedHeader:
+        """Reference VerifyHeaderAtHeight :480 (0 = latest)."""
+        await self.initialize(now_ns)
+        now = time.time_ns() if now_ns is None else now_ns
+        latest_trusted_h = self.store.latest_height()
+        if height != 0 and height <= latest_trusted_h:
+            existing = self.store.signed_header(height)
+            if existing is not None:
+                return existing
+            return await self._backwards(height, now)
+        sh = await self.primary.signed_header(height)
+        if sh.height <= latest_trusted_h:
+            got = self.store.signed_header(sh.height)
+            return got if got is not None else sh
+        await self._verify_header(sh, now)
+        return sh
+
+    async def update(self, now_ns: Optional[int] = None) -> Optional[SignedHeader]:
+        """Verify the latest header (reference Update :445)."""
+        return await self.verify_header_at_height(0, now_ns)
+
+    def trusted_height(self) -> int:
+        return self.store.latest_height()
+
+    # -- core verification -------------------------------------------------
+
+    async def _verify_header(self, new_header: SignedHeader, now: int) -> None:
+        """Reference verifyHeader :550 → bisection :687."""
+        latest = self.store.latest()
+        if latest is None:
+            raise LightClientError("no trusted state; call initialize")
+        trusted_sh, trusted_vals = latest
+        new_vals = await self.primary.validator_set(new_header.height)
+        await self._bisection(trusted_sh, trusted_vals, new_header, new_vals, now)
+        await self._compare_with_witnesses(new_header)
+
+    async def _bisection(
+        self,
+        trusted_sh: SignedHeader,
+        trusted_vals: ValidatorSet,
+        new_header: SignedHeader,
+        new_vals: ValidatorSet,
+        now: int,
+    ) -> None:
+        """Reference bisection :687: try to jump straight to the target;
+        on ErrNewValSetCantBeTrusted pivot at 9/16 of the gap."""
+        headers_cache = {new_header.height: (new_header, new_vals)}
+        cur_sh, cur_vals = trusted_sh, trusted_vals
+        target = new_header.height
+        depth_guard = 0
+        while cur_sh.height < target:
+            depth_guard += 1
+            if depth_guard > 128:
+                raise LightClientError("bisection did not converge")
+            try_h = target
+            while True:
+                sh, vals = headers_cache.get(try_h, (None, None))
+                if sh is None:
+                    sh = await self.primary.signed_header(try_h)
+                    vals = await self.primary.validator_set(try_h)
+                    headers_cache[try_h] = (sh, vals)
+                try:
+                    verifier.verify(
+                        self.chain_id, cur_sh, cur_vals, sh, vals,
+                        self.trusting_period_ns, self.trust_level, now_ns=now,
+                    )
+                    self.store.save(sh, vals)
+                    cur_sh, cur_vals = sh, vals
+                    break
+                except verifier.ErrNewValSetCantBeTrusted:
+                    # pivot closer to the trusted header (9/16 rule)
+                    gap = try_h - cur_sh.height
+                    pivot = cur_sh.height + gap * _BISECTION_NUM // _BISECTION_DEN
+                    if pivot <= cur_sh.height or pivot >= try_h:
+                        pivot = cur_sh.height + 1
+                    if pivot == try_h:
+                        raise
+                    self.logger.debug(
+                        "bisection pivot", frm=cur_sh.height, to=try_h, pivot=pivot
+                    )
+                    try_h = pivot
+
+    async def _backwards(self, height: int, now: int) -> SignedHeader:
+        """Reference backwards :883: walk the hash chain down from the
+        earliest trusted header — no signature checks needed."""
+        first_h = self.store.first_height()
+        cur = self.store.signed_header(first_h)
+        if cur is None or height >= first_h:
+            raise LightClientError(f"cannot get header at height {height}")
+        while cur.height > height + 1:
+            prev = await self.primary.signed_header(cur.height - 1)
+            verifier.verify_backwards(self.chain_id, prev, cur)
+            cur = prev
+        target = await self.primary.signed_header(height)
+        verifier.verify_backwards(self.chain_id, target, cur)
+        return target
+
+    # -- witnesses ---------------------------------------------------------
+
+    async def _compare_with_witnesses(self, sh: SignedHeader) -> None:
+        """Reference compareNewHeaderWithWitnesses :931: every witness
+        must agree on the header hash; disagreement is fork evidence."""
+        for i, witness in enumerate(self.witnesses):
+            try:
+                alt = await witness.signed_header(sh.height)
+            except Exception as e:
+                self.logger.info("witness unavailable", idx=i, err=str(e))
+                continue
+            if alt.hash() != sh.hash():
+                raise ErrConflictingHeaders(
+                    i,
+                    f"witness {i} has header {alt.hash().hex()[:12]} at height "
+                    f"{sh.height}, primary has {sh.hash().hex()[:12]} — FORK?",
+                )
+
+    def remove_witness(self, idx: int) -> None:
+        self.witnesses.pop(idx)
+
+    def prune(self, keep: int = 1000) -> int:
+        return self.store.prune(keep)

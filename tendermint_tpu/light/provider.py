@@ -1,0 +1,172 @@
+"""Light-client providers: where signed headers and validator sets come
+from.
+
+Reference: lite2/provider/ — Provider interface (provider.go:9), http
+provider (http/http.go via the RPC client's /commit and /validators),
+mock provider (mock/mock.go, deterministic fixtures).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+from tendermint_tpu.light.types import SignedHeader
+from tendermint_tpu.types.validator_set import ValidatorSet
+
+
+class ProviderError(Exception):
+    pass
+
+
+class ErrSignedHeaderNotFound(ProviderError):
+    pass
+
+
+class ErrValidatorSetNotFound(ProviderError):
+    pass
+
+
+class Provider:
+    chain_id: str = ""
+
+    async def signed_header(self, height: int) -> SignedHeader:
+        """height=0 means latest."""
+        raise NotImplementedError
+
+    async def validator_set(self, height: int) -> ValidatorSet:
+        raise NotImplementedError
+
+
+class MockProvider(Provider):
+    """Reference lite2/provider/mock."""
+
+    def __init__(self, chain_id: str, headers: Dict[int, SignedHeader], vals: Dict[int, ValidatorSet]):
+        self.chain_id = chain_id
+        self._headers = dict(headers)
+        self._vals = dict(vals)
+
+    async def signed_header(self, height: int) -> SignedHeader:
+        if height == 0 and self._headers:
+            height = max(self._headers)
+        sh = self._headers.get(height)
+        if sh is None:
+            raise ErrSignedHeaderNotFound(str(height))
+        return sh
+
+    async def validator_set(self, height: int) -> ValidatorSet:
+        vs = self._vals.get(height)
+        if vs is None:
+            raise ErrValidatorSetNotFound(str(height))
+        return vs
+
+
+class NodeProvider(Provider):
+    """Provider over a live in-process node (the Local-RPC analog)."""
+
+    def __init__(self, node):
+        self._node = node
+        self.chain_id = node.genesis_doc.chain_id
+
+    async def signed_header(self, height: int) -> SignedHeader:
+        store = self._node.block_store
+        h = height or store.height
+        meta = store.load_block_meta(h)
+        commit = (
+            store.load_seen_commit(h) if h == store.height else store.load_block_commit(h)
+        )
+        if meta is None or commit is None:
+            raise ErrSignedHeaderNotFound(str(h))
+        return SignedHeader(meta.header, commit)
+
+    async def validator_set(self, height: int) -> ValidatorSet:
+        vs = self._node.state_store.load_validators(height)
+        if vs is None:
+            raise ErrValidatorSetNotFound(str(height))
+        return vs
+
+
+class HTTPProvider(Provider):
+    """Reference lite2/provider/http: /commit + /validators routes."""
+
+    def __init__(self, chain_id: str, rpc_client):
+        self.chain_id = chain_id
+        self._client = rpc_client
+
+    async def signed_header(self, height: int) -> SignedHeader:
+        from tendermint_tpu.types.block import (
+            BlockID,
+            Commit,
+            CommitSig,
+            Header,
+            PartSetHeader,
+        )
+
+        res = await self._client.commit(height=height or None)
+        sh = res["signed_header"]
+        if sh.get("commit") is None:
+            raise ErrSignedHeaderNotFound(str(height))
+        h = sh["header"]
+        c = sh["commit"]
+
+        def b(x):
+            return bytes.fromhex(x) if x else b""
+
+        header = Header(
+            chain_id=h["chain_id"],
+            height=h["height"],
+            time_ns=h["time_ns"],
+            last_block_id=BlockID(
+                b(h["last_block_id"]["hash"]),
+                PartSetHeader(
+                    h["last_block_id"]["parts"]["total"],
+                    b(h["last_block_id"]["parts"]["hash"]),
+                ),
+            ),
+            last_commit_hash=b(h["last_commit_hash"]),
+            data_hash=b(h["data_hash"]),
+            validators_hash=b(h["validators_hash"]),
+            next_validators_hash=b(h["next_validators_hash"]),
+            consensus_hash=b(h["consensus_hash"]),
+            app_hash=b(h["app_hash"]),
+            last_results_hash=b(h["last_results_hash"]),
+            evidence_hash=b(h["evidence_hash"]),
+            proposer_address=b(h["proposer_address"]),
+            version_block=h["version"]["block"],
+            version_app=h["version"]["app"],
+        )
+        commit = Commit(
+            height=c["height"],
+            round=c["round"],
+            block_id=BlockID(
+                b(c["block_id"]["hash"]),
+                PartSetHeader(
+                    c["block_id"]["parts"]["total"], b(c["block_id"]["parts"]["hash"])
+                ),
+            ),
+            signatures=[
+                CommitSig(
+                    block_id_flag=s["block_id_flag"],
+                    validator_address=b(s["validator_address"]),
+                    timestamp_ns=s["timestamp_ns"],
+                    signature=b(s["signature"]),
+                )
+                for s in c["signatures"]
+            ],
+        )
+        return SignedHeader(header, commit)
+
+    async def validator_set(self, height: int) -> ValidatorSet:
+        from tendermint_tpu.crypto.keys import Ed25519PubKey
+        from tendermint_tpu.types.validator import Validator
+
+        res = await self._client.validators(height=height, perPage=100)
+        vals = []
+        for v in res["validators"]:
+            pub = Ed25519PubKey(bytes.fromhex(v["pub_key"]["value"]))
+            val = Validator(pub, v["voting_power"])
+            val.proposer_priority = v["proposer_priority"]
+            vals.append(val)
+        if not vals:
+            raise ErrValidatorSetNotFound(str(height))
+        vs = ValidatorSet(vals)
+        return vs

@@ -1,0 +1,69 @@
+"""Trusted store: persisted signed headers + validator sets.
+
+Reference: lite2/store/ — Store interface (store.go:9), db
+implementation (db/db.go: SignedHeader + ValidatorSet per height,
+LightBlock iteration, prune).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+from tendermint_tpu.db.base import DB
+from tendermint_tpu.light.types import SignedHeader
+from tendermint_tpu.types.validator_set import ValidatorSet
+
+_SH = b"lsh:"
+_VS = b"lvs:"
+
+
+def _k(prefix: bytes, height: int) -> bytes:
+    return prefix + height.to_bytes(8, "big")
+
+
+class TrustedStore:
+    def __init__(self, db: DB):
+        self._db = db
+
+    def save(self, sh: SignedHeader, vals: ValidatorSet) -> None:
+        batch = self._db.new_batch()
+        batch.set(_k(_SH, sh.height), sh.encode())
+        batch.set(_k(_VS, sh.height), vals.encode())
+        batch.write_sync()
+
+    def signed_header(self, height: int) -> Optional[SignedHeader]:
+        raw = self._db.get(_k(_SH, height))
+        return SignedHeader.decode(raw) if raw is not None else None
+
+    def validator_set(self, height: int) -> Optional[ValidatorSet]:
+        raw = self._db.get(_k(_VS, height))
+        return ValidatorSet.decode(raw) if raw is not None else None
+
+    def heights(self) -> List[int]:
+        return sorted(
+            int.from_bytes(k[len(_SH) :], "big")
+            for k, _ in self._db.prefix_iterator(_SH)
+        )
+
+    def latest_height(self) -> int:
+        hs = self.heights()
+        return hs[-1] if hs else 0
+
+    def first_height(self) -> int:
+        hs = self.heights()
+        return hs[0] if hs else 0
+
+    def latest(self) -> Optional[Tuple[SignedHeader, ValidatorSet]]:
+        h = self.latest_height()
+        if h == 0:
+            return None
+        return self.signed_header(h), self.validator_set(h)
+
+    def prune(self, keep: int) -> int:
+        """Keep the newest `keep` heights (reference db store Prune)."""
+        hs = self.heights()
+        drop = hs[:-keep] if keep > 0 else hs
+        for h in drop:
+            self._db.delete(_k(_SH, h))
+            self._db.delete(_k(_VS, h))
+        return len(drop)

@@ -1,0 +1,86 @@
+"""Light-client data types.
+
+Reference: types/block.go SignedHeader :569 region (header + commit),
+lite2/client.go TrustOptions :53.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from fractions import Fraction
+from typing import Optional
+
+from tendermint_tpu.codec.binary import Reader, Writer
+from tendermint_tpu.types.block import BlockID, Commit, Header
+
+
+@dataclass
+class SignedHeader:
+    header: Header
+    commit: Commit
+
+    def validate_basic(self, chain_id: str) -> Optional[str]:
+        """Reference SignedHeader.ValidateBasic types/block.go."""
+        if self.header is None:
+            return "missing header"
+        if self.commit is None:
+            return "missing commit"
+        if self.header.chain_id != chain_id:
+            return f"header belongs to another chain {self.header.chain_id!r}"
+        if self.commit.height != self.header.height:
+            return (
+                f"header and commit height mismatch: {self.header.height} vs {self.commit.height}"
+            )
+        hhash = self.header.hash()
+        if self.commit.block_id.hash != hhash:
+            return (
+                f"commit signs block {self.commit.block_id.hash.hex()[:12]}, "
+                f"header is block {hhash.hex()[:12]}"
+            )
+        return None
+
+    @property
+    def height(self) -> int:
+        return self.header.height
+
+    @property
+    def time_ns(self) -> int:
+        return self.header.time_ns
+
+    def hash(self) -> bytes:
+        return self.header.hash()
+
+    def block_id(self) -> BlockID:
+        return self.commit.block_id
+
+    def encode(self) -> bytes:
+        w = Writer()
+        w.write_bytes(self.header.encode())
+        w.write_bytes(self.commit.encode())
+        return w.bytes()
+
+    @classmethod
+    def decode(cls, data: bytes) -> "SignedHeader":
+        r = Reader(data)
+        return cls(Header.decode(r.read_bytes()), Commit.decode(r.read_bytes()))
+
+
+DEFAULT_TRUST_LEVEL = Fraction(1, 3)
+
+
+@dataclass
+class TrustOptions:
+    """Reference lite2/client.go:53: what the user trusts out-of-band."""
+
+    period_ns: int  # trusting period
+    height: int
+    hash: bytes
+
+    def validate(self) -> Optional[str]:
+        if self.period_ns <= 0:
+            return "trusting period must be > 0"
+        if self.height <= 0:
+            return "trusted height must be > 0"
+        if len(self.hash) != 32:
+            return "trusted hash must be 32 bytes"
+        return None

@@ -1,0 +1,184 @@
+"""Light-client header verification.
+
+Reference: lite2/verifier.go — VerifyAdjacent :96 (hash-chain +
+untrusted VerifyCommit), VerifyNonAdjacent :32 (trusted
+VerifyCommitTrusting at 1/3 :60 + untrusted VerifyCommit :76), Verify
+dispatch :140, VerifyBackwards :228; common checks
+(verifyNewHeaderAndVals :167): basic validation, height/time
+monotonicity, clock drift, trusting period.
+
+Each commit check is ONE batched device verification (★ the BASELINE
+config-3 hot path: headers × heights).
+"""
+
+from __future__ import annotations
+
+import time
+from fractions import Fraction
+from typing import Optional
+
+from tendermint_tpu.light.types import DEFAULT_TRUST_LEVEL, SignedHeader
+from tendermint_tpu.types.validator_set import ValidatorSet
+
+DEFAULT_CLOCK_DRIFT_NS = 10 * 10**9  # 10s (reference defaultClockDrift)
+
+
+class VerificationError(Exception):
+    pass
+
+
+class ErrOldHeaderExpired(VerificationError):
+    pass
+
+
+class ErrNewValSetCantBeTrusted(VerificationError):
+    """Non-adjacent trust check failed — bisection should pivot."""
+
+
+class ErrInvalidHeader(VerificationError):
+    pass
+
+
+def _now_ns(now_ns: Optional[int]) -> int:
+    return time.time_ns() if now_ns is None else now_ns
+
+
+def header_expired(h: SignedHeader, trusting_period_ns: int, now_ns: int) -> bool:
+    """Reference HeaderExpired lite2/verifier.go:186."""
+    return h.time_ns + trusting_period_ns <= now_ns
+
+
+def _verify_new_header_and_vals(
+    chain_id: str,
+    untrusted: SignedHeader,
+    untrusted_vals: ValidatorSet,
+    trusted: SignedHeader,
+    now_ns: int,
+    clock_drift_ns: int,
+) -> None:
+    """Reference verifyNewHeaderAndVals :167."""
+    err = untrusted.validate_basic(chain_id)
+    if err:
+        raise ErrInvalidHeader(err)
+    if untrusted.height <= trusted.height:
+        raise ErrInvalidHeader(
+            f"expected new header height {untrusted.height} > trusted {trusted.height}"
+        )
+    if untrusted.time_ns <= trusted.time_ns:
+        raise ErrInvalidHeader(
+            "expected new header time after old header time"
+        )
+    if untrusted.time_ns >= now_ns + clock_drift_ns:
+        raise ErrInvalidHeader("new header time is from the future")
+    if untrusted.header.validators_hash != untrusted_vals.hash():
+        raise ErrInvalidHeader(
+            "expected new header validators to match those supplied"
+        )
+
+
+def verify_adjacent(
+    chain_id: str,
+    trusted: SignedHeader,
+    untrusted: SignedHeader,
+    untrusted_vals: ValidatorSet,
+    trusting_period_ns: int,
+    now_ns: Optional[int] = None,
+    clock_drift_ns: int = DEFAULT_CLOCK_DRIFT_NS,
+    provider=None,
+) -> None:
+    """Reference VerifyAdjacent :96 — untrusted.height == trusted.height+1."""
+    if untrusted.height != trusted.height + 1:
+        raise ValueError("headers must be adjacent in height")
+    now = _now_ns(now_ns)
+    if header_expired(trusted, trusting_period_ns, now):
+        raise ErrOldHeaderExpired(f"old header expired at {trusted.time_ns + trusting_period_ns}")
+    _verify_new_header_and_vals(chain_id, untrusted, untrusted_vals, trusted, now, clock_drift_ns)
+
+    # the hash-chain link: H+1 validators were committed to by H
+    if untrusted.header.validators_hash != trusted.header.next_validators_hash:
+        raise ErrInvalidHeader(
+            f"expected old header next validators ({trusted.header.next_validators_hash.hex()[:12]}) "
+            f"to match those from new header ({untrusted.header.validators_hash.hex()[:12]})"
+        )
+    # ★ one batched device call
+    untrusted_vals.verify_commit(
+        chain_id, untrusted.block_id(), untrusted.height, untrusted.commit,
+        provider=provider,
+    )
+
+
+def verify_non_adjacent(
+    chain_id: str,
+    trusted: SignedHeader,
+    trusted_vals: ValidatorSet,
+    untrusted: SignedHeader,
+    untrusted_vals: ValidatorSet,
+    trusting_period_ns: int,
+    trust_level: Fraction = DEFAULT_TRUST_LEVEL,
+    now_ns: Optional[int] = None,
+    clock_drift_ns: int = DEFAULT_CLOCK_DRIFT_NS,
+    provider=None,
+) -> None:
+    """Reference VerifyNonAdjacent :32."""
+    if untrusted.height == trusted.height + 1:
+        raise ValueError("headers must be non-adjacent in height")
+    now = _now_ns(now_ns)
+    if header_expired(trusted, trusting_period_ns, now):
+        raise ErrOldHeaderExpired(f"old header expired at {trusted.time_ns + trusting_period_ns}")
+    _verify_new_header_and_vals(chain_id, untrusted, untrusted_vals, trusted, now, clock_drift_ns)
+
+    # 1/3+ of what we trusted still signs the new header
+    try:
+        trusted_vals.verify_commit_trusting(
+            chain_id, untrusted.block_id(), untrusted.height, untrusted.commit,
+            trust_level, provider=provider,
+        )
+    except Exception as e:
+        raise ErrNewValSetCantBeTrusted(str(e))
+    # and the new set has a proper +2/3 commit
+    untrusted_vals.verify_commit(
+        chain_id, untrusted.block_id(), untrusted.height, untrusted.commit,
+        provider=provider,
+    )
+
+
+def verify(
+    chain_id: str,
+    trusted: SignedHeader,
+    trusted_vals: ValidatorSet,
+    untrusted: SignedHeader,
+    untrusted_vals: ValidatorSet,
+    trusting_period_ns: int,
+    trust_level: Fraction = DEFAULT_TRUST_LEVEL,
+    now_ns: Optional[int] = None,
+    clock_drift_ns: int = DEFAULT_CLOCK_DRIFT_NS,
+    provider=None,
+) -> None:
+    """Reference Verify :140: dispatch on adjacency."""
+    if untrusted.height != trusted.height + 1:
+        verify_non_adjacent(
+            chain_id, trusted, trusted_vals, untrusted, untrusted_vals,
+            trusting_period_ns, trust_level, now_ns, clock_drift_ns, provider,
+        )
+    else:
+        verify_adjacent(
+            chain_id, trusted, untrusted, untrusted_vals, trusting_period_ns,
+            now_ns, clock_drift_ns, provider,
+        )
+
+
+def verify_backwards(chain_id: str, untrusted: SignedHeader, trusted: SignedHeader) -> None:
+    """Reference VerifyBackwards :228: hash-chain only, no signatures —
+    untrusted is EARLIER than trusted and must be its ancestor."""
+    err = untrusted.validate_basic(chain_id)
+    if err:
+        raise ErrInvalidHeader(err)
+    if untrusted.height != trusted.height - 1:
+        raise ValueError("headers must be adjacent (backwards)")
+    if untrusted.time_ns >= trusted.time_ns:
+        raise ErrInvalidHeader("expected older header time to be before newer")
+    if trusted.header.last_block_id.hash != untrusted.hash():
+        raise ErrInvalidHeader(
+            f"trusted header's LastBlockID {trusted.header.last_block_id.hash.hex()[:12]} "
+            f"does not match older header's hash {untrusted.hash().hex()[:12]}"
+        )

@@ -1,0 +1,191 @@
+"""Light client: verifier rules, bisection, witnesses, backwards.
+
+Mirrors reference lite2/verifier_test.go (table-driven adjacent /
+non-adjacent cases) and lite2/client_test.go (bisection, trust options,
+witness conflict).
+"""
+
+import asyncio
+from fractions import Fraction
+
+import pytest
+
+from tendermint_tpu.db.memdb import MemDB
+from tendermint_tpu.light import (
+    LightClient,
+    SignedHeader,
+    TrustOptions,
+    verify_adjacent,
+    verify_backwards,
+    verify_non_adjacent,
+)
+from tendermint_tpu.light.client import ErrConflictingHeaders
+from tendermint_tpu.light.provider import MockProvider
+from tendermint_tpu.light.store import TrustedStore
+from tendermint_tpu.light.verifier import (
+    ErrInvalidHeader,
+    ErrNewValSetCantBeTrusted,
+    ErrOldHeaderExpired,
+)
+from tests.light_helpers import CHAIN_ID, T0, gen_chain, keys, valset
+
+PERIOD = 3 * 3600 * 10**9  # 3h
+NOW = T0 + 600 * 10**9  # 10min after genesis
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+# -- verifier --------------------------------------------------------------
+
+
+def test_verify_adjacent_ok_and_hash_chain():
+    headers, vals = gen_chain(3)
+    verify_adjacent(
+        CHAIN_ID, headers[1], headers[2], vals[2], PERIOD, now_ns=NOW
+    )
+    # tampered: wrong untrusted valset
+    other = valset(keys(4, tag="other"))
+    with pytest.raises(ErrInvalidHeader):
+        verify_adjacent(CHAIN_ID, headers[1], headers[2], other, PERIOD, now_ns=NOW)
+
+
+def test_verify_adjacent_rejects_expired_trusted():
+    headers, vals = gen_chain(2)
+    with pytest.raises(ErrOldHeaderExpired):
+        verify_adjacent(
+            CHAIN_ID, headers[1], headers[2], vals[2], PERIOD,
+            now_ns=T0 + PERIOD + 2 * 10**9,
+        )
+
+
+def test_verify_adjacent_rejects_valset_break():
+    """Validator change NOT announced in next_validators_hash fails."""
+    headers, vals = gen_chain(3, key_changes={3: keys(4, tag="new")})
+    # headers[2].next_validators_hash points at the new set; lie about it
+    bad_vals = valset(keys(4, tag="liar"))
+    with pytest.raises(ErrInvalidHeader):
+        verify_adjacent(CHAIN_ID, headers[2], headers[3], bad_vals, PERIOD, now_ns=NOW)
+    # the honest new set passes
+    verify_adjacent(
+        CHAIN_ID, headers[2], headers[3], vals[3], PERIOD, now_ns=NOW
+    )
+
+
+def test_verify_non_adjacent_with_overlap():
+    headers, vals = gen_chain(10)
+    verify_non_adjacent(
+        CHAIN_ID, headers[1], vals[1], headers[9], vals[9], PERIOD, now_ns=NOW
+    )
+
+
+def test_verify_non_adjacent_full_valset_swap_refused():
+    """Total validator replacement between trusted and new → can't trust."""
+    headers, vals = gen_chain(10, key_changes={5: keys(4, tag="swapped")})
+    with pytest.raises(ErrNewValSetCantBeTrusted):
+        verify_non_adjacent(
+            CHAIN_ID, headers[1], vals[1], headers[9], vals[9], PERIOD, now_ns=NOW
+        )
+
+
+def test_verify_backwards():
+    headers, _ = gen_chain(3)
+    verify_backwards(CHAIN_ID, headers[2], headers[3])
+    with pytest.raises(ErrInvalidHeader):
+        bad = gen_chain(3, base_keys=keys(4, tag="fork"))[0]
+        verify_backwards(CHAIN_ID, bad[2], headers[3])
+
+
+# -- client ----------------------------------------------------------------
+
+
+def make_client(headers, vals, witnesses=None, trust_height=1, period=PERIOD):
+    primary = MockProvider(CHAIN_ID, headers, vals)
+    opts = TrustOptions(
+        period_ns=period, height=trust_height, hash=headers[trust_height].hash()
+    )
+    return LightClient(
+        CHAIN_ID, opts, primary, witnesses or [], TrustedStore(MemDB())
+    )
+
+
+def test_client_sequential_and_bisection():
+    async def go():
+        headers, vals = gen_chain(20)
+        c = make_client(headers, vals)
+        sh = await c.verify_header_at_height(20, now_ns=NOW)
+        assert sh.height == 20 and sh.hash() == headers[20].hash()
+        assert c.trusted_height() == 20
+
+    run(go())
+
+
+def test_client_bisection_through_valset_changes():
+    """Gradual validator changes force bisection pivots."""
+
+    async def go():
+        k = keys(8)
+        changes = {
+            5: k[2:6] + keys(2, tag="x"),   # partial overlap
+            10: k[4:8] + keys(2, tag="y"),
+            15: keys(4, tag="z") + k[6:8],
+        }
+        headers, vals = gen_chain(20, key_changes=changes, base_keys=k[:4])
+        c = make_client(headers, vals)
+        sh = await c.verify_header_at_height(20, now_ns=NOW)
+        assert sh.hash() == headers[20].hash()
+
+    run(go())
+
+
+def test_client_witness_agreement_and_conflict():
+    async def go():
+        headers, vals = gen_chain(8)
+        good_witness = MockProvider(CHAIN_ID, headers, vals)
+        c = make_client(headers, vals, witnesses=[good_witness])
+        await c.verify_header_at_height(8, now_ns=NOW)
+
+        # forked witness with different headers at same heights
+        fork_headers, fork_vals = gen_chain(8, base_keys=keys(4, tag="forked"))
+        bad_witness = MockProvider(CHAIN_ID, fork_headers, fork_vals)
+        c2 = make_client(headers, vals, witnesses=[bad_witness])
+        with pytest.raises(ErrConflictingHeaders):
+            await c2.verify_header_at_height(8, now_ns=NOW)
+
+    run(go())
+
+
+def test_client_backwards_verification():
+    async def go():
+        headers, vals = gen_chain(10)
+        c = make_client(headers, vals, trust_height=8)
+        await c.initialize(NOW)
+        sh = await c.verify_header_at_height(3, now_ns=NOW)
+        assert sh.hash() == headers[3].hash()
+
+    run(go())
+
+
+def test_client_rejects_wrong_trusted_hash():
+    async def go():
+        headers, vals = gen_chain(3)
+        primary = MockProvider(CHAIN_ID, headers, vals)
+        opts = TrustOptions(period_ns=PERIOD, height=1, hash=b"\x13" * 32)
+        c = LightClient(CHAIN_ID, opts, primary, [], TrustedStore(MemDB()))
+        with pytest.raises(Exception):
+            await c.initialize(NOW)
+
+    run(go())
+
+
+def test_client_prune():
+    async def go():
+        headers, vals = gen_chain(12)
+        c = make_client(headers, vals)
+        await c.verify_header_at_height(12, now_ns=NOW)
+        c.prune(keep=2)
+        assert len(c.store.heights()) <= 2
+        assert c.store.latest_height() == 12
+
+    run(go())
