@@ -164,23 +164,26 @@ class Node(Service):
         from tendermint_tpu.evidence.reactor import EVIDENCE_CHANNEL
         from tendermint_tpu.mempool.reactor import MEMPOOL_CHANNEL
 
+        from tendermint_tpu.p2p.pex.reactor import PEX_CHANNEL
+
         la = self.transport.listen_addr
+        channels = [
+            BLOCKCHAIN_CHANNEL,
+            STATE_CHANNEL,
+            DATA_CHANNEL,
+            VOTE_CHANNEL,
+            VOTE_SET_BITS_CHANNEL,
+            MEMPOOL_CHANNEL,
+            EVIDENCE_CHANNEL,
+        ]
+        if self.config.p2p.pex:
+            channels.insert(0, PEX_CHANNEL)
         return NodeInfo(
             node_id=self.node_key.id,
             listen_addr=f"{la.host}:{la.port}" if la else "",
             network=self.genesis_doc.chain_id,
             version=TM_CORE_SEMVER,
-            channels=bytes(
-                [
-                    BLOCKCHAIN_CHANNEL,
-                    STATE_CHANNEL,
-                    DATA_CHANNEL,
-                    VOTE_CHANNEL,
-                    VOTE_SET_BITS_CHANNEL,
-                    MEMPOOL_CHANNEL,
-                    EVIDENCE_CHANNEL,
-                ]
-            ),
+            channels=bytes(channels),
             moniker=self.config.base.moniker,
             tx_index="on" if self.config.tx_index.indexer != "null" else "off",
             rpc_address=self.config.rpc.laddr,
@@ -236,6 +239,24 @@ class Node(Service):
         self.switch.add_reactor("consensus", self.consensus_reactor)
         self.switch.add_reactor("mempool", self.mempool_reactor)
         self.switch.add_reactor("evidence", self.evidence_reactor)
+        if self.config.p2p.pex:
+            from tendermint_tpu.p2p.pex import AddrBook, PEXReactor
+
+            self.addr_book = AddrBook(
+                self.config.p2p.addr_book_path(), strict=self.config.p2p.addr_book_strict
+            )
+            seeds = [
+                NetAddress.parse(a.strip())
+                for a in self.config.p2p.seeds.split(",")
+                if a.strip()
+            ]
+            self.pex_reactor = PEXReactor(
+                self.addr_book, seeds=seeds, seed_mode=self.config.p2p.seed_mode
+            )
+            self.switch.add_reactor("pex", self.pex_reactor)
+        else:
+            self.addr_book = None
+            self.pex_reactor = None
 
         # RPC first, then p2p (reference :760 comment: "we may expose the
         # RPC without starting the switch")
@@ -244,6 +265,8 @@ class Node(Service):
 
         addr = NetAddress.parse(self.config.p2p.laddr)
         await self.transport.listen(addr.host, addr.port)
+        if self.addr_book is not None:
+            self.addr_book.add_our_address(self.transport.listen_addr)
         await self.switch.start()
 
         persistent = [

@@ -72,6 +72,8 @@ class NetAddress:
             ip = ipaddress.ip_address(self.host)
         except ValueError:
             return False
+        if ip.is_unspecified or ip.is_multicast:
+            return False
         return ip.is_loopback or ip.is_private
 
     def same_id(self, other: "NetAddress") -> bool:

@@ -1,0 +1,2 @@
+from tendermint_tpu.p2p.pex.addrbook import AddrBook
+from tendermint_tpu.p2p.pex.reactor import PEXReactor

@@ -1,0 +1,176 @@
+"""Address book: persisted peer addresses in new/old buckets.
+
+Reference: p2p/pex/addrbook.go (886 lines) — bucketed storage (new =
+heard about, old = connected successfully at least once), deterministic
+bucket assignment by address+source groups, attempt counting with
+backoff, good/bad marking, JSON file persistence (p2p/pex/file.go).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import random
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from tendermint_tpu.p2p.netaddress import NetAddress
+from tendermint_tpu.utils.log import get_logger
+
+NEW_BUCKET_COUNT = 256
+OLD_BUCKET_COUNT = 64
+MAX_ATTEMPTS = 10  # give up dialing after this many failures
+
+
+@dataclass
+class _KnownAddress:
+    """Reference knownAddress addrbook.go:680 region."""
+
+    addr: NetAddress
+    src: Optional[NetAddress] = None
+    attempts: int = 0
+    last_attempt: float = 0.0
+    last_success: float = 0.0
+    bucket_type: str = "new"  # new | old
+
+    def is_old(self) -> bool:
+        return self.bucket_type == "old"
+
+    def to_json(self) -> dict:
+        return {
+            "addr": str(self.addr),
+            "src": str(self.src) if self.src else "",
+            "attempts": self.attempts,
+            "last_attempt": self.last_attempt,
+            "last_success": self.last_success,
+            "bucket_type": self.bucket_type,
+        }
+
+    @classmethod
+    def from_json(cls, d: dict) -> "_KnownAddress":
+        return cls(
+            addr=NetAddress.parse(d["addr"]),
+            src=NetAddress.parse(d["src"]) if d.get("src") else None,
+            attempts=d.get("attempts", 0),
+            last_attempt=d.get("last_attempt", 0.0),
+            last_success=d.get("last_success", 0.0),
+            bucket_type=d.get("bucket_type", "new"),
+        )
+
+
+class AddrBook:
+    def __init__(self, file_path: str = "", strict: bool = True, logger=None):
+        self._file_path = file_path
+        self._strict = strict
+        self.logger = logger or get_logger("pex.addrbook")
+        self._addrs: Dict[str, _KnownAddress] = {}  # by node id
+        self._our_ids: set = set()
+        self._rng = random.Random(0xADD2)
+        if file_path and os.path.exists(file_path):
+            self.load()
+
+    # -- our own addresses -------------------------------------------------
+
+    def add_our_address(self, addr: NetAddress) -> None:
+        self._our_ids.add(addr.id)
+
+    def our_address(self, addr: NetAddress) -> bool:
+        return addr.id in self._our_ids
+
+    # -- CRUD --------------------------------------------------------------
+
+    def add_address(self, addr: NetAddress, src: Optional[NetAddress] = None) -> bool:
+        """Reference AddAddress :167: returns True if newly added."""
+        if not addr.id or addr.id in self._our_ids:
+            return False
+        if self._strict and not addr.routable() and not addr.local():
+            return False
+        ka = self._addrs.get(addr.id)
+        if ka is not None:
+            # keep old-bucket state; refresh the address
+            ka.addr = addr
+            return False
+        self._addrs[addr.id] = _KnownAddress(addr=addr, src=src)
+        return True
+
+    def remove_address(self, addr: NetAddress) -> None:
+        self._addrs.pop(addr.id, None)
+
+    def has_address(self, addr: NetAddress) -> bool:
+        return addr.id in self._addrs
+
+    def size(self) -> int:
+        return len(self._addrs)
+
+    def is_empty(self) -> bool:
+        return not self._addrs
+
+    # -- dial feedback -----------------------------------------------------
+
+    def mark_attempt(self, addr: NetAddress) -> None:
+        ka = self._addrs.get(addr.id)
+        if ka is not None:
+            ka.attempts += 1
+            ka.last_attempt = time.time()
+
+    def mark_good(self, node_id: str) -> None:
+        """Successful connection → old bucket (reference MarkGood :263)."""
+        ka = self._addrs.get(node_id)
+        if ka is not None:
+            ka.attempts = 0
+            ka.last_success = time.time()
+            ka.bucket_type = "old"
+
+    def mark_bad(self, addr: NetAddress) -> None:
+        self.remove_address(addr)
+
+    # -- selection ---------------------------------------------------------
+
+    def pick_address(self, new_bias_pct: int = 30) -> Optional[NetAddress]:
+        """Random address biased between new/old buckets (reference
+        PickAddress :216)."""
+        if not self._addrs:
+            return None
+        news = [ka for ka in self._addrs.values() if not ka.is_old()]
+        olds = [ka for ka in self._addrs.values() if ka.is_old()]
+        pool = news if (self._rng.random() * 100 < new_bias_pct and news) else (olds or news)
+        candidates = [ka for ka in pool if ka.attempts < MAX_ATTEMPTS]
+        if not candidates:
+            return None
+        return self._rng.choice(candidates).addr
+
+    def get_selection(self, max_count: int = 30) -> List[NetAddress]:
+        """Random subset for PEX responses (reference GetSelection :291)."""
+        addrs = [ka.addr for ka in self._addrs.values()]
+        self._rng.shuffle(addrs)
+        return addrs[:max_count]
+
+    def addresses(self) -> List[NetAddress]:
+        return [ka.addr for ka in self._addrs.values()]
+
+    # -- persistence (reference p2p/pex/file.go) ---------------------------
+
+    def save(self) -> None:
+        if not self._file_path:
+            return
+        doc = {
+            "key": "addrbook",
+            "addrs": [ka.to_json() for ka in self._addrs.values()],
+        }
+        tmp = self._file_path + ".tmp"
+        os.makedirs(os.path.dirname(self._file_path) or ".", exist_ok=True)
+        with open(tmp, "w") as fp:
+            json.dump(doc, fp, indent=2)
+        os.replace(tmp, self._file_path)
+
+    def load(self) -> None:
+        try:
+            with open(self._file_path) as fp:
+                doc = json.load(fp)
+            for d in doc.get("addrs", []):
+                ka = _KnownAddress.from_json(d)
+                self._addrs[ka.addr.id] = ka
+        except Exception as e:
+            self.logger.error("failed to load addrbook", err=str(e))

@@ -1,0 +1,194 @@
+"""PEX reactor: peer-address exchange + outbound peer maintenance.
+
+Reference: p2p/pex/pex_reactor.go — channel 0x00 (PexChannel :36),
+Receive (request→GetSelection response, response→addrbook add),
+ensurePeersRoutine :330 (keep outbound count up by dialing from the
+book), request throttling per peer, seed mode (:470 crawler — here seeds
+simply serve addresses and disconnect surplus peers).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+from typing import Dict, List, Optional
+
+from tendermint_tpu.codec.binary import Reader, Writer
+from tendermint_tpu.p2p.conn.connection import ChannelDescriptor
+from tendermint_tpu.p2p.netaddress import NetAddress
+from tendermint_tpu.p2p.peer import Peer
+from tendermint_tpu.p2p.pex.addrbook import AddrBook
+from tendermint_tpu.p2p.switch import Reactor
+from tendermint_tpu.utils.log import get_logger
+
+PEX_CHANNEL = 0x00
+
+_T_REQUEST = 0x01
+_T_RESPONSE = 0x02
+
+ENSURE_PEERS_PERIOD_S = 30.0
+REQUEST_INTERVAL_S = 60.0  # min seconds between requests from one peer
+MAX_MSG_ADDRS = 100
+
+
+def encode_request() -> bytes:
+    return bytes([_T_REQUEST])
+
+
+def encode_response(addrs: List[NetAddress]) -> bytes:
+    w = Writer()
+    w.write_u8(_T_RESPONSE)
+    w.write_uvarint(len(addrs))
+    for a in addrs:
+        w.write_str(str(a))
+    return w.bytes()
+
+
+def decode_msg(data: bytes):
+    r = Reader(data)
+    tag = r.read_u8()
+    if tag == _T_REQUEST:
+        return ("request", None)
+    if tag == _T_RESPONSE:
+        n = r.read_uvarint()
+        if n > MAX_MSG_ADDRS:
+            raise ValueError(f"too many addrs in pex response: {n}")
+        return ("response", [NetAddress.parse(r.read_str()) for _ in range(n)])
+    raise ValueError(f"unknown pex message tag {tag:#x}")
+
+
+class PEXReactor(Reactor):
+    def __init__(
+        self,
+        book: AddrBook,
+        seeds: Optional[List[NetAddress]] = None,
+        seed_mode: bool = False,
+        ensure_period_s: float = ENSURE_PEERS_PERIOD_S,
+        logger=None,
+    ):
+        super().__init__("pex")
+        self.book = book
+        self.seeds = seeds or []
+        self.seed_mode = seed_mode
+        self.logger = logger or get_logger("pex")
+        self._ensure_period_s = ensure_period_s
+        self._last_request: Dict[str, float] = {}
+        self._requested: set = set()
+        self._task = None
+
+    def get_channels(self):
+        return [ChannelDescriptor(id=PEX_CHANNEL, priority=1, send_queue_capacity=10)]
+
+    async def start(self) -> None:
+        self._task = asyncio.create_task(self._ensure_peers_routine())
+
+    async def stop(self) -> None:
+        if self._task is not None:
+            self._task.cancel()
+        self.book.save()
+
+    # -- peer lifecycle ----------------------------------------------------
+
+    async def add_peer(self, peer: Peer) -> None:
+        """Record the peer's self-reported address; outbound peers get an
+        immediate address request (reference AddPeer :183)."""
+        la = peer.listen_addr()
+        if la is not None:
+            self.book.add_address(la, src=la)
+            self.book.mark_good(peer.id)
+        if peer.outbound and not self.seed_mode:
+            self._request_addrs(peer)
+
+    async def remove_peer(self, peer: Peer, reason: str) -> None:
+        self._last_request.pop(peer.id, None)
+        self._requested.discard(peer.id)
+
+    # -- receive -----------------------------------------------------------
+
+    async def receive(self, ch_id: int, peer: Peer, msg_bytes: bytes) -> None:
+        kind, addrs = decode_msg(msg_bytes)
+        if kind == "request":
+            now = time.monotonic()
+            last = self._last_request.get(peer.id, 0.0)
+            if now - last < REQUEST_INTERVAL_S and last > 0:
+                self.logger.debug("pex request too soon", peer=peer.id[:12])
+                if self.switch is not None:
+                    await self.switch.stop_peer_for_error(peer, "pex request flood")
+                return
+            self._last_request[peer.id] = now
+            peer.try_send(PEX_CHANNEL, encode_response(self.book.get_selection()))
+            if self.seed_mode and peer.outbound is False:
+                # seeds serve addresses then hang up (reference :500 region)
+                await asyncio.sleep(0.1)
+                if self.switch is not None:
+                    await self.switch.stop_peer_gracefully(peer)
+        else:  # response
+            if peer.id not in self._requested:
+                if self.switch is not None:
+                    await self.switch.stop_peer_for_error(
+                        peer, "unsolicited pex response"
+                    )
+                return
+            self._requested.discard(peer.id)
+            src = peer.socket_addr()
+            for addr in addrs:
+                self.book.add_address(addr, src=src)
+
+    def _request_addrs(self, peer: Peer) -> None:
+        if peer.id in self._requested:
+            return
+        self._requested.add(peer.id)
+        peer.try_send(PEX_CHANNEL, encode_request())
+
+    # -- outbound maintenance ----------------------------------------------
+
+    async def _ensure_peers_routine(self) -> None:
+        """Reference ensurePeersRoutine :330."""
+        try:
+            while True:
+                await self._ensure_peers()
+                await asyncio.sleep(self._ensure_period_s)
+        except asyncio.CancelledError:
+            raise
+        except Exception as e:
+            self.logger.error("ensure peers routine died", err=repr(e))
+
+    async def _ensure_peers(self) -> None:
+        if self.switch is None:
+            return
+        out, _ = self.switch.num_peers()
+        need = self.switch._max_outbound - out - len(self.switch._dialing)
+        if need <= 0:
+            return
+        tried = set()
+        for _ in range(need * 3):
+            addr = self.book.pick_address()
+            if addr is None or addr.id in tried:
+                break
+            tried.add(addr.id)
+            if addr.id in self.switch.peers or self.book.our_address(addr):
+                continue
+            self.book.mark_attempt(addr)
+            try:
+                peer = await self.switch.dial_peer(addr)
+                if peer is not None:
+                    self.book.mark_good(peer.id)
+                    need -= 1
+                    if need <= 0:
+                        return
+            except Exception as e:
+                self.logger.debug("pex dial failed", addr=str(addr), err=str(e))
+        # ask a connected peer for more addresses
+        peers = list(self.switch.peers.values())
+        if peers and self.book.size() < 10:
+            import random
+
+            self._request_addrs(random.choice(peers))
+        # fall back to seeds when the book is empty
+        if self.book.is_empty() and self.seeds:
+            for seed in self.seeds:
+                try:
+                    if await self.switch.dial_peer(seed) is not None:
+                        return
+                except Exception:
+                    continue

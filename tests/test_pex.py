@@ -1,0 +1,158 @@
+"""PEX reactor + address book.
+
+Mirrors reference p2p/pex/addrbook_test.go and pex_reactor_test.go
+(TestPEXReactorRequestsAddrs, discovery via a common peer).
+"""
+
+import asyncio
+
+import pytest
+
+from tendermint_tpu.p2p.netaddress import NetAddress
+from tendermint_tpu.p2p.pex import AddrBook, PEXReactor
+from tendermint_tpu.p2p.test_util import (
+    connect_switches,
+    make_connected_switches,
+    make_switch,
+    stop_switches,
+)
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+def na(i: int, port=26656) -> NetAddress:
+    return NetAddress(f"{i:02x}" * 20, f"10.0.0.{i}", port)
+
+
+# -- address book ----------------------------------------------------------
+
+
+def test_addrbook_add_pick_good_bad(tmp_path):
+    book = AddrBook(str(tmp_path / "addrbook.json"), strict=False)
+    assert book.is_empty() and book.pick_address() is None
+    assert book.add_address(na(1))
+    assert not book.add_address(na(1))  # dup
+    assert book.add_address(na(2))
+    assert book.size() == 2
+    picked = book.pick_address()
+    assert picked is not None
+    book.mark_good(na(1).id)
+    assert book._addrs[na(1).id].is_old()
+    book.mark_bad(na(2))
+    assert book.size() == 1
+
+
+def test_addrbook_attempt_backoff():
+    book = AddrBook(strict=False)
+    book.add_address(na(3))
+    for _ in range(15):
+        book.mark_attempt(na(3))
+    assert book.pick_address() is None  # too many attempts
+
+
+def test_addrbook_our_address_excluded():
+    book = AddrBook(strict=False)
+    book.add_our_address(na(9))
+    assert not book.add_address(na(9))
+
+
+def test_addrbook_persistence(tmp_path):
+    path = str(tmp_path / "addrbook.json")
+    book = AddrBook(path, strict=False)
+    book.add_address(na(1))
+    book.add_address(na(2))
+    book.mark_good(na(1).id)
+    book.save()
+    book2 = AddrBook(path, strict=False)
+    assert book2.size() == 2
+    assert book2._addrs[na(1).id].is_old()
+
+
+def test_addrbook_strict_rejects_private():
+    book = AddrBook(strict=True)
+    assert book.add_address(NetAddress("aa" * 20, "8.8.8.8", 26656))
+    # private ranges are allowed only via local() (loopback/rfc1918 — for
+    # testnets); unspecified/multicast rejected
+    assert not book.add_address(NetAddress("bb" * 20, "0.0.0.0", 26656))
+
+
+def test_get_selection_bounded():
+    book = AddrBook(strict=False)
+    for i in range(1, 60):
+        book.add_address(na(i))
+    sel = book.get_selection(max_count=30)
+    assert len(sel) == 30
+    assert len({a.id for a in sel}) == 30
+
+
+# -- reactor ---------------------------------------------------------------
+
+
+def test_pex_discovery_via_common_peer():
+    """C knows only B; B knows A; C discovers A through PEX."""
+
+    async def go():
+        books = {}
+        reactors = {}
+
+        def init(i, sw):
+            books[i] = AddrBook(strict=False)
+            reactors[i] = PEXReactor(books[i], ensure_period_s=0.2)
+            sw.add_reactor("pex", reactors[i])
+
+        # A and B connected
+        switches = await make_connected_switches(2, init=init)
+        a, b = switches
+        try:
+            # C dials B only
+            def init_c(sw):
+                books[2] = AddrBook(strict=False)
+                reactors[2] = PEXReactor(books[2], ensure_period_s=0.2)
+                sw.add_reactor("pex", reactors[2])
+
+            c = await make_switch(2, init=init_c)
+            await c.start()
+            switches.append(c)
+            await c.dial_peer(b.transport.listen_addr)
+
+            # C learns A's address from B and dials it
+            for _ in range(600):
+                if a.transport.listen_addr.id in c.peers:
+                    break
+                await asyncio.sleep(0.01)
+            assert a.transport.listen_addr.id in c.peers, "C never discovered A"
+            assert books[2].has_address(a.transport.listen_addr)
+        finally:
+            await stop_switches(switches)
+
+    run(go())
+
+
+def test_pex_request_flood_disconnects():
+    async def go():
+        books = {}
+
+        def init(i, sw):
+            books[i] = AddrBook(strict=False)
+            sw.add_reactor("pex", PEXReactor(books[i], ensure_period_s=30))
+
+        switches = await make_connected_switches(2, init=init)
+        try:
+            from tendermint_tpu.p2p.pex.reactor import PEX_CHANNEL, encode_request
+
+            peer = next(iter(switches[0].peers.values()))
+            # two rapid requests: second violates the min interval
+            peer.try_send(PEX_CHANNEL, encode_request())
+            await asyncio.sleep(0.1)
+            peer.try_send(PEX_CHANNEL, encode_request())
+            for _ in range(300):
+                if not switches[1].peers:
+                    break
+                await asyncio.sleep(0.01)
+            assert not switches[1].peers  # peer 0 was dropped by peer 1
+        finally:
+            await stop_switches(switches)
+
+    run(go())
