@@ -120,6 +120,7 @@ class ConsensusState(Service):
         priv_validator=None,
         event_bus=None,
         wal: Optional[WAL] = None,
+        metrics=None,
         logger=None,
     ):
         super().__init__("consensus", logger=None)
@@ -135,6 +136,7 @@ class ConsensusState(Service):
         )
         self.event_bus = event_bus
         self.evsw = EventSwitch()
+        self.metrics = metrics
 
         self.rs = RoundState()
         self.state: SMState = SMState()  # set by update_to_state
@@ -283,6 +285,10 @@ class ConsensusState(Service):
         rs.commit_time_ns = 0
 
         self.state = state
+        if self.metrics is not None:
+            self.metrics.height.set(height)
+            self.metrics.validators.set(validators.size())
+            self.metrics.validators_power.set(validators.total_voting_power())
         self._new_step()
 
     def _reconstruct_last_commit_if_needed(self, state: SMState) -> None:
@@ -772,6 +778,15 @@ class ConsensusState(Service):
             except Exception as e:
                 self.logger.error("failed to prune blocks", err=str(e))
 
+        if self.metrics is not None:
+            self.metrics.num_txs.set(len(block.data.txs))
+            self.metrics.total_txs.inc(len(block.data.txs))
+            self.metrics.committed_height.set(height)
+            self.metrics.rounds.set(rs.commit_round)
+            if self.state.last_block_time_ns:
+                self.metrics.block_interval_seconds.observe(
+                    max(block.header.time_ns - self.state.last_block_time_ns, 0) / 1e9
+                )
         self.evsw.fire_event(EVENT_COMMITTED, block)
         self.update_to_state(new_state)
         self._done_first_block.set()

@@ -100,9 +100,9 @@ class EvidencePool:
             raise ErrInvalidEvidence(
                 f"evidence from height {ev.height()} is too old"
             )
-        if ev.height() > height:
-            raise ErrInvalidEvidence("evidence from the future")
-
+        # In-flight-height evidence (h+1, even h+2) is fine: the reference
+        # bounds only by whether a validator set exists at that height
+        # (state/validation.go:161 loads and errors if absent).
         vals = self._state_store.load_validators(ev.height())
         if vals is None:
             raise ErrInvalidEvidence(f"no validator set at height {ev.height()}")

@@ -153,6 +153,34 @@ class Node(Service):
 
         self.rpc_server = None  # attached by configure_rpc when rpc is enabled
 
+        # metrics (reference MetricsProvider node/node.go:126-140)
+        from tendermint_tpu.utils.metrics import (
+            ConsensusMetrics,
+            MempoolMetrics,
+            MetricsServer,
+            P2PMetrics,
+            Registry,
+            StateMetrics,
+        )
+
+        self.metrics_registry = Registry()
+        ns = config.instrumentation.namespace
+        self.consensus_metrics = ConsensusMetrics(self.metrics_registry, ns)
+        self.p2p_metrics = P2PMetrics(self.metrics_registry, ns)
+        self.mempool_metrics = MempoolMetrics(self.metrics_registry, ns)
+        self.state_metrics = StateMetrics(self.metrics_registry, ns)
+        self._block_exec_metrics_attach()
+        self.metrics_server = None
+        if config.instrumentation.prometheus:
+            raw = config.instrumentation.prometheus_listen_addr
+            if raw.startswith(":"):
+                raw = "0.0.0.0" + raw
+            addr = NetAddress.parse(raw)
+            self.metrics_server = MetricsServer(self.metrics_registry, addr.host, addr.port)
+
+    def _block_exec_metrics_attach(self) -> None:
+        self.block_exec._metrics = self.state_metrics
+
     def _make_node_info(self) -> NodeInfo:
         from tendermint_tpu.blockchain.reactor import BLOCKCHAIN_CHANNEL
         from tendermint_tpu.consensus.reactor import (
@@ -221,7 +249,9 @@ class Node(Service):
             priv_validator=self.priv_validator,
             event_bus=self.event_bus,
             wal=BaseWAL(self.config.consensus.wal_file()),
+            metrics=self.consensus_metrics,
         )
+        self.consensus_metrics.fast_syncing.set(1 if fast_sync else 0)
         if not self.config.consensus.create_empty_blocks:
             self.mempool.enable_txs_available()
             self.spawn(self._txs_available_pump())
@@ -262,6 +292,9 @@ class Node(Service):
         # RPC without starting the switch")
         if self.rpc_server is not None:
             await self.rpc_server.start()
+        if self.metrics_server is not None:
+            await self.metrics_server.start()
+        self.spawn(self._metrics_pump())
 
         addr = NetAddress.parse(self.config.p2p.laddr)
         await self.transport.listen(addr.host, addr.port)
@@ -288,6 +321,17 @@ class Node(Service):
             ev.clear()
             if self.consensus_state is not None:
                 self.consensus_state.handle_txs_available()
+
+    async def _metrics_pump(self) -> None:
+        """Periodic gauges that aren't event-driven (peers, mempool)."""
+        import asyncio
+
+        while True:
+            self.p2p_metrics.peers.set(len(self.switch.peers))
+            self.mempool_metrics.size.set(self.mempool.size())
+            if self.bc_reactor is not None:
+                self.consensus_metrics.fast_syncing.set(1 if self.bc_reactor.fast_sync else 0)
+            await asyncio.sleep(2.0)
 
     def _only_validator_is_us(self, state: State) -> bool:
         if self.priv_validator is None:

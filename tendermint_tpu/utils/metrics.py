@@ -1,0 +1,195 @@
+"""Metrics: Prometheus-text-format counters/gauges/histograms.
+
+Reference: go-kit metrics with the Prometheus provider — per-module
+Metrics structs with PrometheusMetrics()/NopMetrics() constructors
+(consensus/metrics.go, p2p/metrics.go, mempool/metrics.go,
+state/metrics.go), served at instrumentation.prometheus_listen_addr
+(node/node.go:781-784; metric table docs/tendermint-core/metrics.md).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+from typing import Dict, List, Optional, Tuple
+
+
+class Metric:
+    def __init__(self, name: str, help_: str, namespace: str, subsystem: str):
+        self.name = f"{namespace}_{subsystem}_{name}" if subsystem else f"{namespace}_{name}"
+        self.help = help_
+
+    def expose(self) -> List[str]:
+        raise NotImplementedError
+
+
+class Gauge(Metric):
+    kind = "gauge"
+
+    def __init__(self, name, help_="", namespace="tendermint", subsystem=""):
+        super().__init__(name, help_, namespace, subsystem)
+        self.value = 0.0
+
+    def set(self, v: float) -> None:
+        self.value = float(v)
+
+    def add(self, v: float) -> None:
+        self.value += v
+
+    def expose(self) -> List[str]:
+        return [
+            f"# HELP {self.name} {self.help}",
+            f"# TYPE {self.name} gauge",
+            f"{self.name} {self.value}",
+        ]
+
+
+class Counter(Metric):
+    kind = "counter"
+
+    def __init__(self, name, help_="", namespace="tendermint", subsystem=""):
+        super().__init__(name, help_, namespace, subsystem)
+        self.value = 0.0
+
+    def inc(self, v: float = 1.0) -> None:
+        self.value += v
+
+    def expose(self) -> List[str]:
+        return [
+            f"# HELP {self.name} {self.help}",
+            f"# TYPE {self.name} counter",
+            f"{self.name} {self.value}",
+        ]
+
+
+class Histogram(Metric):
+    kind = "histogram"
+    DEFAULT_BUCKETS = (0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1, 2.5, 5, 10)
+
+    def __init__(self, name, help_="", namespace="tendermint", subsystem="", buckets=None):
+        super().__init__(name, help_, namespace, subsystem)
+        self.buckets = tuple(buckets or self.DEFAULT_BUCKETS)
+        self.counts = [0] * (len(self.buckets) + 1)
+        self.sum = 0.0
+        self.count = 0
+
+    def observe(self, v: float) -> None:
+        self.sum += v
+        self.count += 1
+        for i, b in enumerate(self.buckets):
+            if v <= b:
+                self.counts[i] += 1
+                return
+        self.counts[-1] += 1
+
+    def expose(self) -> List[str]:
+        out = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} histogram"]
+        acc = 0
+        for b, c in zip(self.buckets, self.counts):
+            acc += c
+            out.append(f'{self.name}_bucket{{le="{b}"}} {acc}')
+        out.append(f'{self.name}_bucket{{le="+Inf"}} {self.count}')
+        out.append(f"{self.name}_sum {self.sum}")
+        out.append(f"{self.name}_count {self.count}")
+        return out
+
+
+class Registry:
+    def __init__(self):
+        self._metrics: List[Metric] = []
+
+    def register(self, m: Metric) -> Metric:
+        self._metrics.append(m)
+        return m
+
+    def expose_text(self) -> str:
+        lines: List[str] = []
+        for m in self._metrics:
+            lines.extend(m.expose())
+        return "\n".join(lines) + "\n"
+
+
+# -- per-module metric structs (reference per-package metrics.go) ----------
+
+
+class ConsensusMetrics:
+    """Reference consensus/metrics.go (213 lines)."""
+
+    def __init__(self, registry: Optional[Registry] = None, namespace="tendermint"):
+        r = registry or Registry()
+        sub = "consensus"
+        reg = r.register
+        self.height = reg(Gauge("height", "Height of the chain.", namespace, sub))
+        self.rounds = reg(Gauge("rounds", "Number of rounds.", namespace, sub))
+        self.validators = reg(Gauge("validators", "Number of validators.", namespace, sub))
+        self.validators_power = reg(Gauge("validators_power", "Total voting power.", namespace, sub))
+        self.missing_validators = reg(Gauge("missing_validators", "Validators missing from the last commit.", namespace, sub))
+        self.byzantine_validators = reg(Gauge("byzantine_validators", "Validators that equivocated.", namespace, sub))
+        self.block_interval_seconds = reg(Histogram("block_interval_seconds", "Time between blocks.", namespace, sub))
+        self.num_txs = reg(Gauge("num_txs", "Txs in the latest block.", namespace, sub))
+        self.block_size_bytes = reg(Gauge("block_size_bytes", "Size of the latest block.", namespace, sub))
+        self.total_txs = reg(Counter("total_txs", "Total transactions committed.", namespace, sub))
+        self.committed_height = reg(Gauge("latest_block_height", "Latest committed height.", namespace, sub))
+        self.fast_syncing = reg(Gauge("fast_syncing", "Whether fast-sync is active.", namespace, sub))
+
+
+class P2PMetrics:
+    def __init__(self, registry: Optional[Registry] = None, namespace="tendermint"):
+        r = registry or Registry()
+        sub = "p2p"
+        self.peers = r.register(Gauge("peers", "Number of connected peers.", namespace, sub))
+        self.peer_receive_bytes_total = r.register(Counter("peer_receive_bytes_total", "Bytes received.", namespace, sub))
+        self.peer_send_bytes_total = r.register(Counter("peer_send_bytes_total", "Bytes sent.", namespace, sub))
+
+
+class MempoolMetrics:
+    def __init__(self, registry: Optional[Registry] = None, namespace="tendermint"):
+        r = registry or Registry()
+        sub = "mempool"
+        self.size = r.register(Gauge("size", "Number of uncommitted txs.", namespace, sub))
+        self.tx_size_bytes = r.register(Histogram("tx_size_bytes", "Tx sizes.", namespace, sub, buckets=(32, 128, 512, 2048, 8192, 32768)))
+        self.failed_txs = r.register(Counter("failed_txs", "Rejected txs.", namespace, sub))
+        self.recheck_times = r.register(Counter("recheck_times", "Tx rechecks.", namespace, sub))
+
+
+class StateMetrics:
+    def __init__(self, registry: Optional[Registry] = None, namespace="tendermint"):
+        r = registry or Registry()
+        self.block_processing_time = r.register(
+            Histogram("block_processing_time", "Seconds to process a block.", namespace, "state",
+                      buckets=[i / 1000 for i in (1, 5, 10, 25, 50, 100, 250, 500, 1000)])
+        )
+
+
+class MetricsServer:
+    """Serves the registry at /metrics (reference node/node.go:781)."""
+
+    def __init__(self, registry: Registry, host: str = "127.0.0.1", port: int = 26660):
+        self.registry = registry
+        self._host, self._port = host, port
+        self._server = None
+        self.bound_port: Optional[int] = None
+
+    async def start(self) -> None:
+        self._server = await asyncio.start_server(self._handle, self._host, self._port)
+        self.bound_port = self._server.sockets[0].getsockname()[1]
+
+    async def stop(self) -> None:
+        if self._server is not None:
+            self._server.close()
+            await self._server.wait_closed()
+
+    async def _handle(self, reader, writer) -> None:
+        try:
+            await reader.readline()
+            while (await reader.readline()) not in (b"\r\n", b"\n", b""):
+                pass
+            body = self.registry.expose_text().encode()
+            writer.write(
+                b"HTTP/1.1 200 OK\r\nContent-Type: text/plain; version=0.0.4\r\n"
+                + f"Content-Length: {len(body)}\r\nConnection: close\r\n\r\n".encode()
+                + body
+            )
+            await writer.drain()
+        finally:
+            writer.close()

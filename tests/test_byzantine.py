@@ -1,0 +1,170 @@
+"""Byzantine validator test: one equivocating proposer, honest majority
+still commits.
+
+Mirrors reference consensus/byzantine_test.go:27 — 4 validators, the
+byzantine one overrides decide_proposal to send DIFFERENT proposals to
+different peers (justifying the decide_proposal/do_prevote seams at
+consensus/state.go:124-126); the 3 honest nodes (3/4 power > 2/3) must
+keep committing, and double-sign evidence may surface.
+"""
+
+import asyncio
+
+import pytest
+
+from tendermint_tpu.codec.signbytes import PREVOTE_TYPE
+from tendermint_tpu.consensus import messages as m
+from tendermint_tpu.consensus.reactor import (
+    DATA_CHANNEL,
+    VOTE_CHANNEL,
+    ConsensusReactor,
+)
+from tendermint_tpu.p2p.test_util import make_connected_switches, stop_switches
+from tendermint_tpu.types.block import BlockID
+from tendermint_tpu.types.proposal import Proposal
+from tendermint_tpu.types.tx import Tx, Txs
+from tendermint_tpu.types.vote import Vote
+from tests.cs_harness import make_genesis, make_node
+
+CHAIN = "cs-harness-chain"
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+def make_byzantine(node, switch_ref):
+    """Install an equivocating decide_proposal on `node` (reference
+    byzantineDecideProposalFunc byzantine_test.go:106)."""
+    cs = node.cs
+
+    async def byz_decide_proposal(height: int, round_: int) -> None:
+        # two different blocks: one empty, one with a tx
+        block_a, parts_a = cs._create_proposal_block()
+        state = cs.state
+        block_b = state.make_block(
+            height,
+            Txs([Tx(b"byzantine-split")]),
+            cs.rs.last_commit.make_commit()
+            if cs.rs.last_commit is not None and cs.rs.last_commit.has_two_thirds_majority()
+            else __import__(
+                "tendermint_tpu.types.block", fromlist=["Commit"]
+            ).Commit(height=0, round=0, block_id=BlockID(), signatures=[]),
+            [],
+            cs._priv_validator_addr,
+        )
+        parts_b = block_b.make_part_set()
+
+        sw = switch_ref[0]
+        peers = list(sw.peers.values())
+        half = len(peers) // 2
+        sides = [(peers[:half], block_a, parts_a), (peers[half:], block_b, parts_b)]
+        for peer_group, block, parts in sides:
+            block_id = BlockID(hash=block.hash(), parts=parts.header())
+            proposal = Proposal(
+                height=height, round=round_, pol_round=cs.rs.valid_round,
+                block_id=block_id, timestamp_ns=cs._vote_time(),
+            )
+            cs._priv_validator.sign_proposal(state.chain_id, proposal)
+            idx, _ = cs.rs.validators.get_by_address(cs._priv_validator_addr)
+            prevote = Vote(
+                vote_type=PREVOTE_TYPE, height=height, round=round_,
+                block_id=block_id, timestamp_ns=cs._vote_time(),
+                validator_address=cs._priv_validator_addr, validator_index=idx,
+            )
+            cs._priv_validator.sign_vote(state.chain_id, prevote)
+            for peer in peer_group:
+                peer.try_send(DATA_CHANNEL, m.encode_msg(m.ProposalMessage(proposal)))
+                for i in range(parts.total):
+                    peer.try_send(
+                        DATA_CHANNEL,
+                        m.encode_msg(m.BlockPartMessage(height, round_, parts.get_part(i))),
+                    )
+                peer.try_send(VOTE_CHANNEL, m.encode_msg(m.VoteMessage(prevote)))
+
+    cs.decide_proposal = byz_decide_proposal
+
+
+def test_byzantine_proposer_honest_majority_commits():
+    async def go():
+        genesis, privs = make_genesis(4)
+        nodes = [await make_node(genesis, pv) for pv in privs]
+        reactors = [ConsensusReactor(n.cs) for n in nodes]
+        switch_refs = [[None] for _ in nodes]
+
+        def init(i, sw):
+            sw.add_reactor("consensus", reactors[i])
+            switch_refs[i][0] = sw
+
+        switches = await make_connected_switches(4, init=init, network=CHAIN)
+        try:
+            # node 0 turns byzantine
+            make_byzantine(nodes[0], switch_refs[0])
+            # honest nodes (1,2,3) keep making progress
+            await asyncio.gather(
+                *(n.cs.wait_for_height(4, timeout_s=90) for n in nodes[1:])
+            )
+            hashes = {n.block_store.load_block(3).hash() for n in nodes[1:]}
+            assert len(hashes) == 1, "honest nodes diverged"
+        finally:
+            await stop_switches(switches)
+
+    run(go())
+
+
+def test_byzantine_double_prevote_creates_evidence():
+    """A validator that signs two different prevotes for the same H/R is
+    caught: honest nodes turn the conflict into DuplicateVoteEvidence."""
+
+    async def go():
+        genesis, privs = make_genesis(4)
+        nodes = [await make_node(genesis, pv) for pv in privs]
+        # honest nodes need an evidence pool to record the conflict
+        from tendermint_tpu.db.memdb import MemDB
+        from tendermint_tpu.evidence import EvidencePool
+
+        for n in nodes:
+            n.cs._evpool = EvidencePool(MemDB(), n.state_store, n.block_store)
+        reactors = [ConsensusReactor(n.cs) for n in nodes]
+
+        def init(i, sw):
+            sw.add_reactor("consensus", reactors[i])
+
+        switches = await make_connected_switches(4, init=init, network=CHAIN)
+        try:
+            await asyncio.gather(*(n.cs.wait_for_height(1, timeout_s=60) for n in nodes))
+            # hand-craft conflicting votes from validator 0 at a future round
+            byz = nodes[0].cs
+            height = max(n.cs.rs.height for n in nodes)
+            idx, _ = byz.rs.validators.get_by_address(byz._priv_validator_addr)
+
+            def vote_for(tag):
+                from tendermint_tpu.types.block import PartSetHeader
+
+                v = Vote(
+                    vote_type=PREVOTE_TYPE, height=height + 1, round=0,
+                    block_id=BlockID(bytes([tag]) * 32, PartSetHeader(1, bytes([tag]) * 32)),
+                    timestamp_ns=1000,
+                    validator_address=byz._priv_validator_addr, validator_index=idx,
+                )
+                privs_by_addr = {p.address(): p for p in privs}
+                privs_by_addr[byz._priv_validator_addr].sign_vote(CHAIN, v)
+                return v
+
+            va, vb = vote_for(0x33), vote_for(0x44)
+            target = nodes[1].cs
+            # wait until node 1 reaches that height, then feed both votes
+            await target.wait_for_height(height, timeout_s=60)
+            await target.add_vote_from_peer(va, "byz-peer")
+            await target.add_vote_from_peer(vb, "byz-peer")
+            for _ in range(500):
+                if nodes[1].cs._evpool.pending_evidence():
+                    break
+                await asyncio.sleep(0.01)
+            evs = nodes[1].cs._evpool.pending_evidence()
+            assert evs, "conflicting votes produced no evidence"
+            assert evs[0].address() == byz._priv_validator_addr
+        finally:
+            await stop_switches(switches)
+
+    run(go())
