@@ -533,7 +533,11 @@ class ConsensusState(Service):
             block_id=block_id, timestamp_ns=now_ns(),
         )
         try:
-            self._priv_validator.sign_proposal(self.state.chain_id, proposal)
+            import inspect
+
+            res = self._priv_validator.sign_proposal(self.state.chain_id, proposal)
+            if inspect.isawaitable(res):
+                await res
         except Exception as e:
             if not self.replay_mode:
                 self.logger.error("propose: error signing proposal", err=str(e))
@@ -993,7 +997,7 @@ class ConsensusState(Service):
             self._priv_validator_addr
         ):
             return None
-        vote = self._sign_vote(vote_type, block_hash, parts_header)
+        vote = await self._sign_vote(vote_type, block_hash, parts_header)
         if vote is not None:
             self.send_internal(VoteMessage(vote))
             self.logger.info("signed and pushed vote", vote=repr(vote))
@@ -1002,8 +1006,11 @@ class ConsensusState(Service):
             self.logger.error("failed signing vote", type=vote_type)
         return None
 
-    def _sign_vote(self, vote_type: int, block_hash: bytes, parts_header) -> Optional[Vote]:
-        """Reference signVote :1922."""
+    async def _sign_vote(self, vote_type: int, block_hash: bytes, parts_header) -> Optional[Vote]:
+        """Reference signVote :1922. Works with sync (FilePV/MockPV) and
+        async (remote SignerClient) priv validators."""
+        import inspect
+
         from tendermint_tpu.types.block import PartSetHeader
 
         rs = self.rs
@@ -1022,7 +1029,9 @@ class ConsensusState(Service):
             validator_index=idx,
         )
         try:
-            self._priv_validator.sign_vote(self.state.chain_id, vote)
+            res = self._priv_validator.sign_vote(self.state.chain_id, vote)
+            if inspect.isawaitable(res):
+                await res
         except Exception as e:
             # Includes ErrDoubleSign: refusing to sign is loss of OUR vote,
             # not a consensus failure (reference signVote returns err).

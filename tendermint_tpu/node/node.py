@@ -222,6 +222,14 @@ class Node(Service):
     async def on_start(self) -> None:
         """Reference OnStart node/node.go:760 (plus the NewNode steps that
         must run inside the event loop: app conns, handshake)."""
+        from tendermint_tpu.privval.signer import SignerClient
+
+        if isinstance(self.priv_validator, SignerClient):
+            # remote signer: listen and wait for it to dial in
+            # (reference createAndStartPrivValidatorSocketClient node/node.go:500)
+            await self.priv_validator.start()
+            await self.priv_validator.wait_for_signer()
+
         await self.proxy_app.start()
         await self.event_bus.start()
         await self.indexer_service.start()
@@ -359,8 +367,13 @@ def default_new_node(config: Config, app=None, logger=None) -> Node:
     """Reference DefaultNewNode node/node.go:90: load node key, privval,
     genesis from the config-rooted files."""
     node_key = load_or_gen_node_key(config.base.node_key_file())
-    pv = load_or_gen_file_pv(
-        config.base.priv_validator_key_file(), config.base.priv_validator_state_file()
-    )
+    if config.base.priv_validator_laddr:
+        from tendermint_tpu.privval.signer import SignerClient
+
+        pv = SignerClient(config.base.priv_validator_laddr)
+    else:
+        pv = load_or_gen_file_pv(
+            config.base.priv_validator_key_file(), config.base.priv_validator_state_file()
+        )
     genesis = GenesisDoc.from_file(config.base.genesis_file())
     return Node(config, genesis, pv, node_key, app=app, logger=logger)

@@ -1,0 +1,131 @@
+"""Remote signer: SignerClient (node side) ↔ SignerServer (key side).
+
+Mirrors reference privval/signer_client_test.go + the tm-signer-harness
+conformance checks (tools/tm-signer-harness): pubkey, vote/proposal
+signing, double-sign refusal propagation, ping; plus a full consensus
+node running against a remote signer.
+"""
+
+import asyncio
+
+import pytest
+
+from tendermint_tpu.codec.signbytes import PREVOTE_TYPE
+from tendermint_tpu.privval import load_or_gen_file_pv
+from tendermint_tpu.privval.signer import RemoteSignerError, SignerClient, SignerServer
+from tendermint_tpu.types.block import BlockID, PartSetHeader
+from tendermint_tpu.types.proposal import Proposal
+from tendermint_tpu.types.vote import Vote
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+async def make_pair(tmp_path):
+    pv = load_or_gen_file_pv(
+        str(tmp_path / "pv_key.json"), str(tmp_path / "pv_state.json")
+    )
+    client = SignerClient("tcp://127.0.0.1:0")
+    await client.start()
+    server = SignerServer(f"tcp://127.0.0.1:{client.bound_port}", pv)
+    await server.start()
+    await client.wait_for_signer(timeout_s=5)
+    return client, server, pv
+
+
+def bid(tag=7):
+    return BlockID(bytes([tag]) * 32, PartSetHeader(1, bytes([tag + 1]) * 32))
+
+
+def make_vote(pv, height=1, block_id=None):
+    return Vote(
+        vote_type=PREVOTE_TYPE,
+        height=height,
+        round=0,
+        block_id=block_id or bid(),
+        timestamp_ns=1000,
+        validator_address=pv.address(),
+        validator_index=0,
+    )
+
+
+def test_pubkey_and_ping(tmp_path):
+    async def go():
+        client, server, pv = await make_pair(tmp_path)
+        try:
+            assert client.get_pub_key().bytes() == pv.get_pub_key().bytes()
+            assert await client.ping()
+        finally:
+            await server.stop()
+            await client.stop()
+
+    run(go())
+
+
+def test_remote_vote_and_proposal_signing(tmp_path):
+    async def go():
+        client, server, pv = await make_pair(tmp_path)
+        try:
+            v = make_vote(pv)
+            await client.sign_vote("sign-chain", v)
+            assert pv.get_pub_key().verify(v.sign_bytes("sign-chain"), v.signature)
+
+            p = Proposal(height=2, round=0, pol_round=-1, block_id=bid(), timestamp_ns=5)
+            await client.sign_proposal("sign-chain", p)
+            assert pv.get_pub_key().verify(p.sign_bytes("sign-chain"), p.signature)
+        finally:
+            await server.stop()
+            await client.stop()
+
+    run(go())
+
+
+def test_double_sign_refusal_propagates(tmp_path):
+    async def go():
+        client, server, pv = await make_pair(tmp_path)
+        try:
+            await client.sign_vote("sign-chain", make_vote(pv, block_id=bid(1)))
+            with pytest.raises(RemoteSignerError, match="DoubleSign|regression|conflicting"):
+                await client.sign_vote("sign-chain", make_vote(pv, block_id=bid(9)))
+        finally:
+            await server.stop()
+            await client.stop()
+
+    run(go())
+
+
+def test_consensus_with_remote_signer(tmp_path):
+    """A single-validator chain where the node signs via the remote
+    signer end-to-end."""
+
+    async def go():
+        from tests.cs_harness import make_node
+        from tendermint_tpu.types.genesis import GenesisDoc, GenesisValidator
+
+        pv = load_or_gen_file_pv(
+            str(tmp_path / "k.json"), str(tmp_path / "s.json")
+        )
+        client = SignerClient("tcp://127.0.0.1:0")
+        await client.start()
+        server = SignerServer(f"tcp://127.0.0.1:{client.bound_port}", pv)
+        await server.start()
+        await client.wait_for_signer(timeout_s=5)
+
+        genesis = GenesisDoc(
+            chain_id="cs-harness-chain",
+            genesis_time_ns=1_700_000_000_000_000_000,
+            validators=[GenesisValidator(pub_key=pv.get_pub_key(), power=10)],
+        )
+        node = await make_node(genesis, client)
+        await node.cs.start()
+        try:
+            await node.cs.wait_for_height(3, timeout_s=30)
+            commit = node.block_store.load_seen_commit(2)
+            assert not commit.signatures[0].absent_()
+        finally:
+            await node.cs.stop()
+            await server.stop()
+            await client.stop()
+
+    run(go())
