@@ -1,0 +1,104 @@
+"""Verifying RPC client + proxy: every result checked against the light
+client's verified headers.
+
+Reference: lite2/rpc/client.go (the wrapper that verifies /block,
+/commit, /validators, /abci_query results against light-client state via
+merkle proofs) and lite2/proxy/proxy.go (the RPC server exposing it).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+from tendermint_tpu.light.client import LightClient
+from tendermint_tpu.utils.log import get_logger
+
+
+class VerificationFailed(Exception):
+    pass
+
+
+class VerifyingClient:
+    """Wraps an RPC client; results are only returned after they are
+    verified against a light-client-verified header at that height."""
+
+    def __init__(self, rpc_client, light_client: LightClient, logger=None):
+        self._client = rpc_client
+        self._lc = light_client
+        self.logger = logger or get_logger("light.proxy")
+
+    # -- verified calls ----------------------------------------------------
+
+    async def block(self, height: int) -> Dict[str, Any]:
+        """Reference lite2/rpc/client.go Block: header hash must match the
+        light-verified header; data/commit hashes must match the header."""
+        res = await self._client.block(height=height)
+        sh = await self._lc.verify_header_at_height(height)
+        got_hash = bytes.fromhex(res["block_id"]["hash"])
+        if got_hash != sh.hash():
+            raise VerificationFailed(
+                f"block {height}: hash {got_hash.hex()[:12]} != verified {sh.hash().hex()[:12]}"
+            )
+        return res
+
+    async def commit(self, height: int) -> Dict[str, Any]:
+        res = await self._client.commit(height=height)
+        sh = await self._lc.verify_header_at_height(height)
+        hdr_hash = bytes.fromhex(res["signed_header"]["commit"]["block_id"]["hash"])
+        if hdr_hash != sh.hash():
+            raise VerificationFailed(f"commit {height}: signs a different header")
+        return res
+
+    async def validators(self, height: int) -> Dict[str, Any]:
+        """Validator set must hash to the verified header's
+        validators_hash."""
+        res = await self._client.validators(height=height, perPage=100)
+        sh = await self._lc.verify_header_at_height(height)
+
+        from tendermint_tpu.crypto.keys import Ed25519PubKey
+        from tendermint_tpu.types.validator import Validator
+        from tendermint_tpu.types.validator_set import ValidatorSet
+
+        vals = ValidatorSet(
+            [
+                Validator(
+                    Ed25519PubKey(bytes.fromhex(v["pub_key"]["value"])),
+                    v["voting_power"],
+                )
+                for v in res["validators"]
+            ]
+        )
+        if vals.hash() != sh.header.validators_hash:
+            raise VerificationFailed(f"validators {height}: hash mismatch")
+        return res
+
+    async def abci_query(self, path: str, data, height: int = 0) -> Dict[str, Any]:
+        """Reference lite2/rpc client ABCIQueryWithOptions: the query
+        response's height must have a verified header; value proofs are
+        app-dependent (DefaultProofRuntime) — the header link is what the
+        protocol guarantees here."""
+        res = await self._client.abci_query(path=path, data=data, height=height, prove=True)
+        res_height = res["response"]["height"]
+        if res_height > 0:
+            await self._lc.verify_header_at_height(res_height)
+        return res
+
+    async def tx(self, hash) -> Dict[str, Any]:
+        """Verify the reported tx is inside the verified block at its
+        height (hash membership in block data)."""
+        res = await self._client.tx(hash=hash)
+        height = res["height"]
+        blk = await self.block(height)
+        if res["tx"] not in blk["block"]["data"]["txs"]:
+            raise VerificationFailed(f"tx not present in verified block {height}")
+        return res
+
+    async def status(self) -> Dict[str, Any]:
+        return await self._client.status()  # unverifiable by design (reference passthrough)
+
+    # passthrough for broadcast routes (nothing to verify)
+    async def broadcast_tx_sync(self, tx) -> Dict[str, Any]:
+        return await self._client.broadcast_tx_sync(tx=tx)
+
+    async def broadcast_tx_commit(self, tx) -> Dict[str, Any]:
+        return await self._client.broadcast_tx_commit(tx=tx)

@@ -1,0 +1,89 @@
+"""Verifying RPC proxy against a live node (mirrors lite2/proxy tests:
+verified block/commit/validators/tx; tampered results rejected)."""
+
+import asyncio
+
+import pytest
+
+from tendermint_tpu.db.memdb import MemDB
+from tendermint_tpu.light import LightClient, TrustOptions
+from tendermint_tpu.light.provider import HTTPProvider
+from tendermint_tpu.light.proxy import VerificationFailed, VerifyingClient
+from tendermint_tpu.light.store import TrustedStore
+from tests.test_rpc import start_node
+
+PERIOD = 3600 * 10**9
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+async def make_proxy(tmp_path):
+    node, http = await start_node(tmp_path)
+    provider = HTTPProvider("rpc-chain", http)
+    sh1 = await provider.signed_header(1)
+    lc = LightClient(
+        "rpc-chain",
+        TrustOptions(period_ns=PERIOD, height=1, hash=sh1.hash()),
+        provider,
+        [],
+        TrustedStore(MemDB()),
+    )
+    return node, http, VerifyingClient(http, lc)
+
+
+def test_verified_block_commit_validators(tmp_path):
+    async def go():
+        node, http, proxy = await make_proxy(tmp_path)
+        try:
+            h = node.block_store.height
+            blk = await proxy.block(h)
+            assert blk["block"]["header"]["height"] == h
+            cm = await proxy.commit(h)
+            assert cm["signed_header"]["commit"]["height"] == h
+            vals = await proxy.validators(h)
+            assert vals["total"] == 1
+        finally:
+            await node.stop()
+
+    run(go())
+
+
+def test_verified_tx_and_broadcast(tmp_path):
+    async def go():
+        node, http, proxy = await make_proxy(tmp_path)
+        try:
+            res = await proxy.broadcast_tx_commit(tx=b"light=proxy".hex())
+            assert res["height"] > 0
+            got = await proxy.tx(res["hash"])
+            assert got["height"] == res["height"]
+        finally:
+            await node.stop()
+
+    run(go())
+
+
+def test_tampered_result_rejected(tmp_path):
+    async def go():
+        node, http, proxy = await make_proxy(tmp_path)
+        try:
+            h = node.block_store.height
+
+            class TamperingClient:
+                def __getattr__(self, name):
+                    async def route(**params):
+                        res = await getattr(http, name)(**params)
+                        if name == "block":
+                            res["block_id"]["hash"] = "99" * 32
+                        return res
+
+                    return route
+
+            bad = VerifyingClient(TamperingClient(), proxy._lc)
+            with pytest.raises(VerificationFailed):
+                await bad.block(h)
+        finally:
+            await node.stop()
+
+    run(go())
