@@ -1,0 +1,116 @@
+"""Threshold multisig public key.
+
+Reference: crypto/multisig/ — PubKeyMultisigThreshold
+(threshold_pubkey.go:96 lines): K-of-N over an ordered pubkey list;
+signature = compact bitarray of participants + concatenated sub-sigs in
+pubkey order; VerifyBytes checks >= K valid sub-sigs in order.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from typing import List, Optional, Sequence
+
+from tendermint_tpu.codec.binary import Reader, Writer
+from tendermint_tpu.crypto.keys import PubKey, decode_pubkey, encode_pubkey, register_pubkey_type
+from tendermint_tpu.utils.bits import BitArray
+
+
+class MultisigThresholdPubKey(PubKey):
+    type_name = "multisig-threshold"
+
+    def __init__(self, threshold: int, pub_keys: Sequence[PubKey]):
+        if threshold <= 0:
+            raise ValueError("threshold must be positive")
+        if len(pub_keys) < threshold:
+            raise ValueError("threshold cannot exceed number of keys")
+        self.threshold = threshold
+        self.pub_keys = list(pub_keys)
+
+    def address(self) -> bytes:
+        return hashlib.sha256(self.bytes()).digest()[:20]
+
+    def bytes(self) -> bytes:
+        w = Writer()
+        w.write_uvarint(self.threshold)
+        w.write_uvarint(len(self.pub_keys))
+        for pk in self.pub_keys:
+            w.write_bytes(encode_pubkey(pk))
+        return w.bytes()
+
+    @classmethod
+    def from_bytes(cls, data: bytes) -> "MultisigThresholdPubKey":
+        r = Reader(data)
+        threshold = r.read_uvarint()
+        n = r.read_uvarint()
+        keys = [decode_pubkey(r.read_bytes()) for _ in range(n)]
+        return cls(threshold, keys)
+
+    def verify(self, msg: bytes, sig: bytes) -> bool:
+        """Reference VerifyBytes threshold_pubkey.go:34: decode the
+        participant bitarray + sub-sigs; all present sigs must verify and
+        count >= threshold."""
+        try:
+            r = Reader(sig)
+            n_bits = r.read_uvarint()
+            if n_bits != len(self.pub_keys):
+                return False
+            bits = BitArray.from_bytes(r.read_bytes(), n_bits)
+            if bits.num_true_bits() < self.threshold:
+                return False
+            for i in range(n_bits):
+                if bits.get_index(i):
+                    sub = r.read_bytes()
+                    if not self.pub_keys[i].verify(msg, sub):
+                        return False
+            r.expect_done()
+            return True
+        except Exception:
+            return False
+
+    def __eq__(self, other) -> bool:
+        return (
+            isinstance(other, MultisigThresholdPubKey) and self.bytes() == other.bytes()
+        )
+
+    def __repr__(self) -> str:
+        return f"MultisigThresholdPubKey{{{self.threshold}/{len(self.pub_keys)}}}"
+
+
+class MultisigBuilder:
+    """Accumulates sub-signatures (reference multisignature.go
+    AddSignatureFromPubKey)."""
+
+    def __init__(self, pub_key: MultisigThresholdPubKey):
+        self.pub_key = pub_key
+        self._sigs: List[Optional[bytes]] = [None] * len(pub_key.pub_keys)
+
+    def add_signature(self, signer_pub: PubKey, sig: bytes) -> None:
+        for i, pk in enumerate(self.pub_key.pub_keys):
+            if pk.bytes() == signer_pub.bytes():
+                self._sigs[i] = sig
+                return
+        raise ValueError("signer is not part of the multisig key")
+
+    def count(self) -> int:
+        return sum(1 for s in self._sigs if s is not None)
+
+    def signature(self) -> bytes:
+        w = Writer()
+        n = len(self.pub_key.pub_keys)
+        w.write_uvarint(n)
+        bits = BitArray(n)
+        for i, s in enumerate(self._sigs):
+            bits.set_index(i, s is not None)
+        w.write_bytes(bits.to_bytes())
+        for s in self._sigs:
+            if s is not None:
+                w.write_bytes(s)
+        return w.bytes()
+
+
+def _decode_multisig(data: bytes) -> MultisigThresholdPubKey:
+    return MultisigThresholdPubKey.from_bytes(data)
+
+
+register_pubkey_type("multisig-threshold", _decode_multisig)

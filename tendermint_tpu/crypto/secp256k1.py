@@ -1,0 +1,118 @@
+"""secp256k1 ECDSA keys.
+
+Reference: crypto/secp256k1/ — pure-Go btcd path by default
+(secp256k1_nocgo.go) with an optional vendored-C build; addresses are
+RIPEMD160(SHA256(compressed pubkey)) (secp256k1.go:23 region,
+Bitcoin-style). Backed here by OpenSSL via `cryptography` (native C —
+the same "optional native" posture as the reference's libsecp256k1).
+
+Signatures are 64-byte r||s with low-s normalization (the reference
+enforces canonical low-s in secp256k1_nocgo.go Sign/VerifyBytes).
+"""
+
+from __future__ import annotations
+
+import hashlib
+
+from cryptography.exceptions import InvalidSignature
+from cryptography.hazmat.primitives import hashes, serialization
+from cryptography.hazmat.primitives.asymmetric import ec
+from cryptography.hazmat.primitives.asymmetric.utils import (
+    decode_dss_signature,
+    encode_dss_signature,
+)
+
+from tendermint_tpu.crypto.keys import PrivKey, PubKey, register_pubkey_type
+
+# curve order (for low-s normalization)
+_N = 0xFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFEBAAEDCE6AF48A03BBFD25E8CD0364141
+
+PUBKEY_SIZE = 33  # compressed
+SIG_SIZE = 64
+
+
+def _address(compressed_pub: bytes) -> bytes:
+    """RIPEMD160(SHA256(pub)) — reference secp256k1.go Address()."""
+    sha = hashlib.sha256(compressed_pub).digest()
+    rip = hashlib.new("ripemd160")
+    rip.update(sha)
+    return rip.digest()
+
+
+class Secp256k1PubKey(PubKey):
+    type_name = "secp256k1"
+
+    def __init__(self, raw: bytes):
+        if len(raw) != PUBKEY_SIZE:
+            raise ValueError(f"secp256k1 pubkey must be {PUBKEY_SIZE} bytes")
+        self._raw = raw
+        self._key = ec.EllipticCurvePublicKey.from_encoded_point(ec.SECP256K1(), raw)
+
+    def address(self) -> bytes:
+        return _address(self._raw)
+
+    def bytes(self) -> bytes:
+        return self._raw
+
+    def verify(self, msg: bytes, sig: bytes) -> bool:
+        if len(sig) != SIG_SIZE:
+            return False
+        r = int.from_bytes(sig[:32], "big")
+        s = int.from_bytes(sig[32:], "big")
+        if s > _N // 2:
+            return False  # reject non-canonical high-s (reference parity)
+        try:
+            self._key.verify(
+                encode_dss_signature(r, s), msg, ec.ECDSA(hashes.SHA256())
+            )
+            return True
+        except InvalidSignature:
+            return False
+
+    def __repr__(self) -> str:
+        return f"Secp256k1PubKey{{{self._raw.hex()[:16]}}}"
+
+
+class Secp256k1PrivKey(PrivKey):
+    def __init__(self, raw: bytes):
+        if len(raw) != 32:
+            raise ValueError("secp256k1 privkey must be 32 bytes")
+        self._raw = raw
+        self._key = ec.derive_private_key(
+            int.from_bytes(raw, "big"), ec.SECP256K1()
+        )
+
+    @classmethod
+    def generate(cls) -> "Secp256k1PrivKey":
+        key = ec.generate_private_key(ec.SECP256K1())
+        raw = key.private_numbers().private_value.to_bytes(32, "big")
+        return cls(raw)
+
+    @classmethod
+    def from_secret(cls, secret: bytes) -> "Secp256k1PrivKey":
+        """Deterministic key from a secret (reference GenPrivKeySecp256k1:
+        sha256 the secret, clamp into the field)."""
+        d = int.from_bytes(hashlib.sha256(secret).digest(), "big") % (_N - 1) + 1
+        return cls(d.to_bytes(32, "big"))
+
+    def bytes(self) -> bytes:
+        return self._raw
+
+    def sign(self, msg: bytes) -> bytes:
+        der = self._key.sign(msg, ec.ECDSA(hashes.SHA256()))
+        r, s = decode_dss_signature(der)
+        if s > _N // 2:
+            s = _N - s  # low-s normalization
+        return r.to_bytes(32, "big") + s.to_bytes(32, "big")
+
+    def pub_key(self) -> Secp256k1PubKey:
+        raw = self._key.public_key().public_bytes(
+            serialization.Encoding.X962, serialization.PublicFormat.CompressedPoint
+        )
+        return Secp256k1PubKey(raw)
+
+    def __eq__(self, other) -> bool:
+        return isinstance(other, Secp256k1PrivKey) and self._raw == other._raw
+
+
+register_pubkey_type("secp256k1", Secp256k1PubKey)

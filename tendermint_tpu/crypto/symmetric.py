@@ -1,0 +1,111 @@
+"""Symmetric AEAD helpers + ASCII armor for key files.
+
+Reference: crypto/xchacha20poly1305/ (AEAD), crypto/xsalsa20symmetric/
+(EncryptSymmetric/DecryptSymmetric with a bcrypt-derived key — used by
+`tendermint gen_validator` key armoring), crypto/armor/ (OpenPGP-style
+ASCII armor blocks).
+
+ChaCha20-Poly1305 with a random 12-byte nonce replaces xsalsa20 (same
+role: password-protected secrets at rest); the KDF is scrypt (stdlib)
+instead of bcrypt.
+"""
+
+from __future__ import annotations
+
+import base64
+import hashlib
+import os
+import textwrap
+from typing import Tuple
+
+from cryptography.exceptions import InvalidTag
+from cryptography.hazmat.primitives.ciphers.aead import ChaCha20Poly1305
+
+NONCE_SIZE = 12
+SALT_SIZE = 16
+
+
+class DecryptError(Exception):
+    pass
+
+
+def derive_key(passphrase: str, salt: bytes) -> bytes:
+    """scrypt KDF (reference uses bcrypt at cost 12 — same role)."""
+    return hashlib.scrypt(
+        passphrase.encode(), salt=salt, n=1 << 14, r=8, p=1, dklen=32
+    )
+
+
+def encrypt_symmetric(plaintext: bytes, passphrase: str) -> bytes:
+    """salt || nonce || ciphertext (reference EncryptSymmetric)."""
+    salt = os.urandom(SALT_SIZE)
+    key = derive_key(passphrase, salt)
+    nonce = os.urandom(NONCE_SIZE)
+    ct = ChaCha20Poly1305(key).encrypt(nonce, plaintext, None)
+    return salt + nonce + ct
+
+
+def decrypt_symmetric(data: bytes, passphrase: str) -> bytes:
+    if len(data) < SALT_SIZE + NONCE_SIZE + 16:
+        raise DecryptError("ciphertext too short")
+    salt, nonce, ct = (
+        data[:SALT_SIZE],
+        data[SALT_SIZE : SALT_SIZE + NONCE_SIZE],
+        data[SALT_SIZE + NONCE_SIZE :],
+    )
+    key = derive_key(passphrase, salt)
+    try:
+        return ChaCha20Poly1305(key).decrypt(nonce, ct, None)
+    except InvalidTag:
+        raise DecryptError("invalid passphrase or corrupted data")
+
+
+# -- ASCII armor (reference crypto/armor/armor.go) -------------------------
+
+_HEAD = "-----BEGIN {}-----"
+_TAIL = "-----END {}-----"
+
+
+def armor(block_type: str, data: bytes, headers: dict = None) -> str:
+    lines = [_HEAD.format(block_type)]
+    for k, v in (headers or {}).items():
+        lines.append(f"{k}: {v}")
+    lines.append("")
+    lines.extend(textwrap.wrap(base64.b64encode(data).decode(), 64))
+    lines.append(_TAIL.format(block_type))
+    return "\n".join(lines) + "\n"
+
+
+def unarmor(text: str) -> Tuple[str, dict, bytes]:
+    lines = [l.rstrip("\r") for l in text.strip().splitlines()]
+    if not lines or not lines[0].startswith("-----BEGIN "):
+        raise ValueError("missing armor header")
+    block_type = lines[0][len("-----BEGIN ") : -len("-----")]
+    if lines[-1] != _TAIL.format(block_type):
+        raise ValueError("missing/mismatched armor footer")
+    headers = {}
+    i = 1
+    while i < len(lines) - 1 and lines[i]:
+        if ":" in lines[i]:
+            k, v = lines[i].split(":", 1)
+            headers[k.strip()] = v.strip()
+        i += 1
+    body = "".join(lines[i + 1 : -1])
+    return block_type, headers, base64.b64decode(body)
+
+
+# -- armored key files (reference EncryptArmorPrivKey) ---------------------
+
+_KEY_BLOCK = "TENDERMINT PRIVATE KEY"
+
+
+def encrypt_armor_priv_key(priv_key_bytes: bytes, passphrase: str, key_type: str = "ed25519") -> str:
+    enc = encrypt_symmetric(priv_key_bytes, passphrase)
+    return armor(_KEY_BLOCK, enc, {"kdf": "scrypt", "type": key_type})
+
+
+def unarmor_decrypt_priv_key(text: str, passphrase: str) -> Tuple[bytes, str]:
+    block_type, headers, data = unarmor(text)
+    if block_type != _KEY_BLOCK:
+        raise ValueError(f"unexpected armor type {block_type!r}")
+    return decrypt_symmetric(data, passphrase), headers.get("type", "ed25519")

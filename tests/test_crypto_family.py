@@ -1,0 +1,130 @@
+"""secp256k1, multisig, symmetric/armor.
+
+Mirrors reference crypto/secp256k1/secp256k1_test.go,
+crypto/multisig/threshold_pubkey_test.go, crypto/xsalsa20symmetric tests
+and crypto/armor/armor_test.go.
+"""
+
+import pytest
+
+from tendermint_tpu.crypto.keys import Ed25519PrivKey, decode_pubkey, encode_pubkey
+from tendermint_tpu.crypto.multisig import MultisigBuilder, MultisigThresholdPubKey
+from tendermint_tpu.crypto.secp256k1 import Secp256k1PrivKey, Secp256k1PubKey
+from tendermint_tpu.crypto.symmetric import (
+    DecryptError,
+    armor,
+    decrypt_symmetric,
+    encrypt_armor_priv_key,
+    encrypt_symmetric,
+    unarmor,
+    unarmor_decrypt_priv_key,
+)
+
+
+# -- secp256k1 -------------------------------------------------------------
+
+
+def test_secp256k1_sign_verify():
+    k = Secp256k1PrivKey.generate()
+    sig = k.sign(b"payload")
+    assert len(sig) == 64
+    assert k.pub_key().verify(b"payload", sig)
+    assert not k.pub_key().verify(b"other", sig)
+    # tampered signature
+    bad = bytes([sig[0] ^ 1]) + sig[1:]
+    assert not k.pub_key().verify(b"payload", bad)
+
+
+def test_secp256k1_deterministic_from_secret():
+    a = Secp256k1PrivKey.from_secret(b"seed")
+    b = Secp256k1PrivKey.from_secret(b"seed")
+    assert a.bytes() == b.bytes()
+    assert a.pub_key().bytes() == b.pub_key().bytes()
+    assert len(a.pub_key().address()) == 20  # bitcoin-style RIPEMD160
+
+
+def test_secp256k1_low_s_enforced():
+    k = Secp256k1PrivKey.generate()
+    sig = k.sign(b"msg")
+    r, s = sig[:32], int.from_bytes(sig[32:], "big")
+    # forge the high-s twin — must be rejected
+    N = 0xFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFEBAAEDCE6AF48A03BBFD25E8CD0364141
+    high = r + (N - s).to_bytes(32, "big")
+    assert not k.pub_key().verify(b"msg", high)
+
+
+def test_secp256k1_registered_codec():
+    k = Secp256k1PrivKey.from_secret(b"roundtrip")
+    pk2 = decode_pubkey(encode_pubkey(k.pub_key()))
+    assert pk2.bytes() == k.pub_key().bytes()
+    assert pk2.verify(b"m", k.sign(b"m"))
+
+
+# -- multisig --------------------------------------------------------------
+
+
+def make_multisig(k=2, n=3):
+    privs = [Ed25519PrivKey.from_secret(f"ms{i}".encode()) for i in range(n)]
+    pk = MultisigThresholdPubKey(k, [p.pub_key() for p in privs])
+    return privs, pk
+
+
+def test_multisig_threshold_verify():
+    privs, pk = make_multisig(2, 3)
+    msg = b"multisig-payload"
+    b = MultisigBuilder(pk)
+    b.add_signature(privs[0].pub_key(), privs[0].sign(msg))
+    assert not pk.verify(msg, b.signature())  # 1 < threshold
+    b.add_signature(privs[2].pub_key(), privs[2].sign(msg))
+    assert pk.verify(msg, b.signature())  # 2-of-3 ok
+
+
+def test_multisig_wrong_sig_rejected():
+    privs, pk = make_multisig(2, 3)
+    msg = b"m"
+    b = MultisigBuilder(pk)
+    b.add_signature(privs[0].pub_key(), privs[0].sign(msg))
+    b.add_signature(privs[1].pub_key(), privs[1].sign(b"DIFFERENT"))
+    assert not pk.verify(msg, b.signature())
+
+
+def test_multisig_stranger_rejected():
+    privs, pk = make_multisig()
+    b = MultisigBuilder(pk)
+    stranger = Ed25519PrivKey.generate()
+    with pytest.raises(ValueError):
+        b.add_signature(stranger.pub_key(), stranger.sign(b"x"))
+
+
+def test_multisig_codec_roundtrip():
+    _, pk = make_multisig(2, 3)
+    pk2 = decode_pubkey(encode_pubkey(pk))
+    assert pk2 == pk and len(pk.address()) == 20
+
+
+# -- symmetric + armor -----------------------------------------------------
+
+
+def test_symmetric_roundtrip_and_wrong_password():
+    ct = encrypt_symmetric(b"secret-data", "hunter2")
+    assert decrypt_symmetric(ct, "hunter2") == b"secret-data"
+    with pytest.raises(DecryptError):
+        decrypt_symmetric(ct, "wrong")
+
+
+def test_armor_roundtrip():
+    text = armor("TEST BLOCK", b"\x00\x01binary\xff" * 20, {"version": "1"})
+    block_type, headers, data = unarmor(text)
+    assert block_type == "TEST BLOCK"
+    assert headers["version"] == "1"
+    assert data == b"\x00\x01binary\xff" * 20
+
+
+def test_armored_key_file():
+    priv = Ed25519PrivKey.generate()
+    text = encrypt_armor_priv_key(priv.bytes(), "pass123")
+    assert "TENDERMINT PRIVATE KEY" in text
+    raw, key_type = unarmor_decrypt_priv_key(text, "pass123")
+    assert raw == priv.bytes() and key_type == "ed25519"
+    with pytest.raises(DecryptError):
+        unarmor_decrypt_priv_key(text, "nope")
