@@ -1,0 +1,61 @@
+#!/usr/bin/env python
+"""Rebuild a consensus WAL from wal2json output (reference
+scripts/json2wal) — the manual corruption-repair path.
+
+Usage: python scripts/json2wal.py <json-file> <wal-file>
+"""
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from tendermint_tpu.consensus import messages as m
+from tendermint_tpu.consensus.wal import _frame
+from tendermint_tpu.types.block import BlockID, PartSetHeader
+from tendermint_tpu.types.part_set import Part
+from tendermint_tpu.types.proposal import Proposal
+from tendermint_tpu.types.vote import Vote
+
+
+def from_jsonable(doc):
+    t = doc["type"]
+    if t == "EndHeight":
+        return m.EndHeightMessage(doc["height"])
+    if t == "Timeout":
+        return m.TimeoutInfo(doc["duration_ms"], doc["height"], doc["round"], doc["step"])
+    if t == "Msg":
+        inner_doc = doc["msg"]
+        mt = doc["msg_type"]
+        if mt == "VoteMessage":
+            v = Vote(
+                vote_type=inner_doc["vote_type"], height=inner_doc["height"],
+                round=inner_doc["round"],
+                block_id=BlockID(bytes.fromhex(inner_doc["block_hash"]), PartSetHeader()),
+                timestamp_ns=0,
+                validator_address=b"\x00" * 20,
+                validator_index=inner_doc["validator_index"],
+                signature=bytes.fromhex(inner_doc["signature"]),
+            )
+            return m.MsgInfo(m.VoteMessage(v), doc["peer_id"])
+        if "raw" in inner_doc:
+            return m.MsgInfo(m.decode_msg(bytes.fromhex(inner_doc["raw"])), doc["peer_id"])
+    raise ValueError(f"cannot reconstruct message type {t!r} (use raw hex form)")
+
+
+def main():
+    if len(sys.argv) != 3:
+        print(__doc__, file=sys.stderr)
+        sys.exit(2)
+    with open(sys.argv[1]) as fin, open(sys.argv[2], "wb") as fout:
+        for line in fin:
+            line = line.strip()
+            if not line:
+                continue
+            msg = from_jsonable(json.loads(line))
+            fout.write(_frame(m.encode_msg(msg)))
+
+
+if __name__ == "__main__":
+    main()

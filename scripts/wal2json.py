@@ -1,0 +1,68 @@
+#!/usr/bin/env python
+"""Dump a consensus WAL as JSON lines (reference scripts/wal2json).
+
+Usage: python scripts/wal2json.py <wal-file>
+"""
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from tendermint_tpu.consensus import messages as m
+from tendermint_tpu.consensus.wal import BaseWAL
+
+
+def to_jsonable(msg):
+    if isinstance(msg, m.EndHeightMessage):
+        return {"type": "EndHeight", "height": msg.height}
+    if isinstance(msg, m.TimeoutInfo):
+        return {
+            "type": "Timeout",
+            "duration_ms": msg.duration_ms,
+            "height": msg.height,
+            "round": msg.round,
+            "step": msg.step,
+        }
+    if isinstance(msg, m.MsgInfo):
+        inner = msg.msg
+        return {
+            "type": "Msg",
+            "peer_id": msg.peer_id,
+            "msg_type": type(inner).__name__,
+            "msg": _inner(inner),
+        }
+    return {"type": type(msg).__name__}
+
+
+def _inner(inner):
+    if isinstance(inner, m.VoteMessage):
+        v = inner.vote
+        return {
+            "height": v.height, "round": v.round, "vote_type": v.vote_type,
+            "validator_index": v.validator_index,
+            "block_hash": v.block_id.hash.hex(),
+            "signature": v.signature.hex(),
+        }
+    if isinstance(inner, m.ProposalMessage):
+        p = inner.proposal
+        return {"height": p.height, "round": p.round, "pol_round": p.pol_round,
+                "block_hash": p.block_id.hash.hex()}
+    if isinstance(inner, m.BlockPartMessage):
+        return {"height": inner.height, "round": inner.round, "part_index": inner.part.index,
+                "part_bytes": inner.part.bytes_.hex()}
+    return {"raw": m.encode_msg(inner).hex()}
+
+
+def main():
+    if len(sys.argv) != 2:
+        print(__doc__, file=sys.stderr)
+        sys.exit(2)
+    wal = BaseWAL(sys.argv[1])
+    for msg in wal.iter_messages(strict=False):
+        print(json.dumps(to_jsonable(msg)))
+
+
+if __name__ == "__main__":
+    main()

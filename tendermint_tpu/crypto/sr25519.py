@@ -1,0 +1,52 @@
+"""sr25519 (Schnorr over Ristretto) key type — gated.
+
+Reference: crypto/sr25519/ backed by go-schnorrkel. No schnorrkel
+implementation ships in this environment (and none is baked into the
+image), so the key type registers but raises a clear error on use —
+the same posture as the reference's non-default libsecp256k1 build tag
+(present in the tree, off by default).
+"""
+
+from __future__ import annotations
+
+from tendermint_tpu.crypto.keys import PrivKey, PubKey, register_pubkey_type
+
+_ERR = (
+    "sr25519 requires a schnorrkel implementation, which is not available "
+    "in this build; use ed25519 (default) or secp256k1"
+)
+
+
+class Sr25519Unavailable(NotImplementedError):
+    pass
+
+
+class Sr25519PubKey(PubKey):
+    type_name = "sr25519"
+
+    def __init__(self, raw: bytes):
+        self._raw = raw
+
+    def address(self) -> bytes:
+        raise Sr25519Unavailable(_ERR)
+
+    def bytes(self) -> bytes:
+        return self._raw
+
+    def verify(self, msg: bytes, sig: bytes) -> bool:
+        raise Sr25519Unavailable(_ERR)
+
+
+class Sr25519PrivKey(PrivKey):
+    @classmethod
+    def generate(cls):
+        raise Sr25519Unavailable(_ERR)
+
+    def sign(self, msg: bytes) -> bytes:
+        raise Sr25519Unavailable(_ERR)
+
+    def pub_key(self):
+        raise Sr25519Unavailable(_ERR)
+
+
+register_pubkey_type("sr25519", Sr25519PubKey)

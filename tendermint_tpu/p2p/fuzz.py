@@ -1,0 +1,84 @@
+"""FuzzedConnection: chaos wrapper for p2p connections.
+
+Reference: p2p/fuzz.go:14 — wraps a net.Conn and probabilistically
+drops reads/writes, delays, or kills the connection; configured by
+FuzzConnConfig (config/config.go:626) and enabled with p2p.test_fuzz.
+Used by resilience tests to shake out error handling.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import random
+from typing import Optional
+
+
+class FuzzedConnection:
+    """Wraps a SecretConnection/StreamAdapter-shaped object."""
+
+    def __init__(
+        self,
+        conn,
+        mode: str = "drop",  # drop | delay
+        max_delay_s: float = 3.0,
+        prob_drop_rw: float = 0.2,
+        prob_drop_conn: float = 0.0,
+        prob_sleep: float = 0.0,
+        seed: Optional[int] = None,
+    ):
+        self._conn = conn
+        self.mode = mode
+        self.max_delay_s = max_delay_s
+        self.prob_drop_rw = prob_drop_rw
+        self.prob_drop_conn = prob_drop_conn
+        self.prob_sleep = prob_sleep
+        self._rng = random.Random(seed)
+        self._dead = False
+
+    @classmethod
+    def from_config(cls, conn, cfg, seed: Optional[int] = None) -> "FuzzedConnection":
+        """cfg is config.FuzzConnConfig."""
+        return cls(
+            conn,
+            mode=cfg.mode,
+            max_delay_s=cfg.max_delay_ms / 1000.0,
+            prob_drop_rw=cfg.prob_drop_rw,
+            prob_drop_conn=cfg.prob_drop_conn,
+            prob_sleep=cfg.prob_sleep,
+            seed=seed,
+        )
+
+    async def _fuzz(self) -> bool:
+        """Returns True if the op should be swallowed (reference fuzz())."""
+        if self._dead:
+            raise ConnectionResetError("fuzzed connection killed")
+        if self.mode == "drop":
+            r = self._rng.random()
+            if r < self.prob_drop_conn:
+                self._dead = True
+                self._conn.close()
+                raise ConnectionResetError("fuzzed connection killed")
+            if r < self.prob_drop_conn + self.prob_drop_rw:
+                return True
+            if r < self.prob_drop_conn + self.prob_drop_rw + self.prob_sleep:
+                await asyncio.sleep(self._rng.random() * self.max_delay_s)
+        elif self.mode == "delay":
+            await asyncio.sleep(self._rng.random() * self.max_delay_s)
+        return False
+
+    async def write(self, data: bytes) -> int:
+        if await self._fuzz():
+            return len(data)  # silently dropped
+        return await self._conn.write(data)
+
+    async def read_exactly(self, n: int) -> bytes:
+        # reads can't be silently dropped without desyncing framing;
+        # the reference drops them too (data loss IS the chaos) — here we
+        # delay-only on reads in drop mode to keep frame alignment, and
+        # rely on write-drops for loss.
+        if self.mode == "delay":
+            await asyncio.sleep(self._rng.random() * self.max_delay_s)
+        return await self._conn.read_exactly(n)
+
+    def close(self) -> None:
+        self._conn.close()

@@ -1,0 +1,81 @@
+"""Bech32 encoding (BIP-173).
+
+Reference: libs/bech32/bech32.go — ConvertAndEncode/DecodeAndConvert,
+used by SDK-style address rendering.
+"""
+
+from __future__ import annotations
+
+from typing import List, Tuple
+
+_CHARSET = "qpzry9x8gf2tvdw0s3jn54khce6mua7l"
+_GEN = (0x3B6A57B2, 0x26508E6D, 0x1EA119FA, 0x3D4233DD, 0x2A1462B3)
+
+
+def _polymod(values: List[int]) -> int:
+    chk = 1
+    for v in values:
+        top = chk >> 25
+        chk = (chk & 0x1FFFFFF) << 5 ^ v
+        for i in range(5):
+            chk ^= _GEN[i] if ((top >> i) & 1) else 0
+    return chk
+
+
+def _hrp_expand(hrp: str) -> List[int]:
+    return [ord(c) >> 5 for c in hrp] + [0] + [ord(c) & 31 for c in hrp]
+
+
+def _create_checksum(hrp: str, data: List[int]) -> List[int]:
+    values = _hrp_expand(hrp) + data
+    polymod = _polymod(values + [0, 0, 0, 0, 0, 0]) ^ 1
+    return [(polymod >> 5 * (5 - i)) & 31 for i in range(6)]
+
+
+def _verify_checksum(hrp: str, data: List[int]) -> bool:
+    return _polymod(_hrp_expand(hrp) + data) == 1
+
+
+def convert_bits(data: bytes, from_bits: int, to_bits: int, pad: bool = True) -> List[int]:
+    acc = 0
+    bits = 0
+    ret = []
+    maxv = (1 << to_bits) - 1
+    for b in data:
+        acc = (acc << from_bits) | b
+        bits += from_bits
+        while bits >= to_bits:
+            bits -= to_bits
+            ret.append((acc >> bits) & maxv)
+    if pad and bits:
+        ret.append((acc << (to_bits - bits)) & maxv)
+    elif not pad and (bits >= from_bits or ((acc << (to_bits - bits)) & maxv)):
+        raise ValueError("invalid padding in bech32 data")
+    return ret
+
+
+def encode(hrp: str, data: bytes) -> str:
+    """ConvertAndEncode: 8-bit bytes → bech32 string."""
+    d5 = convert_bits(data, 8, 5)
+    combined = d5 + _create_checksum(hrp, d5)
+    return hrp + "1" + "".join(_CHARSET[d] for d in combined)
+
+
+def decode(bech: str) -> Tuple[str, bytes]:
+    """DecodeAndConvert: bech32 string → (hrp, 8-bit bytes)."""
+    if bech.lower() != bech and bech.upper() != bech:
+        raise ValueError("mixed-case bech32 string")
+    bech = bech.lower()
+    pos = bech.rfind("1")
+    if pos < 1 or pos + 7 > len(bech) or len(bech) > 90:
+        raise ValueError("invalid bech32 framing")
+    hrp, data_s = bech[:pos], bech[pos + 1 :]
+    data = []
+    for c in data_s:
+        idx = _CHARSET.find(c)
+        if idx == -1:
+            raise ValueError(f"invalid bech32 character {c!r}")
+        data.append(idx)
+    if not _verify_checksum(hrp, data):
+        raise ValueError("invalid bech32 checksum")
+    return hrp, bytes(convert_bits(data[:-6], 5, 8, pad=False))
