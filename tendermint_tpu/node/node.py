@@ -103,8 +103,18 @@ class Node(Service):
             self.state_store.save(state)
 
         # -- app -----------------------------------------------------------
-        self.app = app if app is not None else default_app(config)
-        self.proxy_app = LocalClient(self.app)
+        if app is not None or config.base.abci == "local":
+            self.app = app if app is not None else default_app(config)
+            self.proxy_app = LocalClient(self.app)
+        elif config.base.abci == "socket":
+            # remote app over the ABCI socket protocol (reference
+            # proxy.DefaultClientCreator remote path, proxy/client.go:75)
+            from tendermint_tpu.abci.client.socket import SocketClient
+
+            self.app = None
+            self.proxy_app = SocketClient(config.base.proxy_app)
+        else:
+            raise ValueError(f"unknown abci transport {config.base.abci!r}")
 
         # -- event bus + indexer --------------------------------------------
         self.event_bus = EventBus()

@@ -1,0 +1,40 @@
+"""Node with an out-of-process-style ABCI app over the socket transport
+(reference test/app/test.sh: kvstore over socket against a running node)."""
+
+import asyncio
+import os
+
+from tendermint_tpu.abci.examples.kvstore import KVStoreApplication
+from tendermint_tpu.abci.server.socket import SocketServer
+from tendermint_tpu.cli import main as cli_main
+from tendermint_tpu.config import load_config
+from tendermint_tpu.node import default_new_node
+
+
+def test_node_runs_against_socket_app(tmp_path):
+    async def go():
+        app = KVStoreApplication()
+        server = SocketServer("tcp://127.0.0.1:0", app)
+        await server.start()
+
+        home = str(tmp_path / "sock")
+        cli_main(["--home", home, "init", "--chain-id", "sock-chain"])
+        cfg = load_config(os.path.join(home, "config/config.toml")).set_root(home)
+        cfg.base.db_backend = "memdb"
+        cfg.base.abci = "socket"
+        cfg.base.proxy_app = server.listen_addr
+        cfg.p2p.laddr = "tcp://127.0.0.1:0"
+        cfg.consensus.timeout_commit_ms = 50
+        cfg.consensus.skip_timeout_commit = True
+        node = default_new_node(cfg)
+        await node.start()
+        try:
+            await node.mempool.check_tx(b"sock=app")
+            await node.consensus_state.wait_for_height(3, timeout_s=30)
+            assert app._db.get(b"kv:sock") == b"app"
+            assert app._height >= 3
+        finally:
+            await node.stop()
+            await server.stop()
+
+    asyncio.run(go())
