@@ -226,6 +226,95 @@ def cmd_testnet(args) -> None:
     print(f"Successfully initialized {n} node directories in {out}")
 
 
+def cmd_light(args) -> None:
+    """Reference cmd/tendermint/commands/lite.go: verifying RPC proxy."""
+
+    async def run() -> None:
+        from tendermint_tpu.db.memdb import MemDB
+        from tendermint_tpu.light import LightClient, TrustOptions
+        from tendermint_tpu.light.provider import HTTPProvider
+        from tendermint_tpu.light.proxy import VerifyingClient
+        from tendermint_tpu.light.proxy_server import make_light_proxy_server
+        from tendermint_tpu.light.store import TrustedStore
+        from tendermint_tpu.rpc.client import HTTPClient
+
+        http = HTTPClient(args.primary)
+        primary = HTTPProvider(args.chain_id, http)
+        trusted_hash = bytes.fromhex(args.trusted_hash) if args.trusted_hash else None
+        if trusted_hash is None:
+            sh = await primary.signed_header(args.trusted_height)
+            trusted_hash = sh.hash()
+            print(f"WARNING: trusting fetched hash {trusted_hash.hex()} at height {args.trusted_height}")
+        witnesses = [
+            HTTPProvider(args.chain_id, HTTPClient(w)) for w in args.witness
+        ]
+        lc = LightClient(
+            args.chain_id,
+            TrustOptions(
+                period_ns=args.trust_period_hours * 3600 * 10**9,
+                height=args.trusted_height,
+                hash=trusted_hash,
+            ),
+            primary,
+            witnesses,
+            TrustedStore(MemDB()),
+        )
+        await lc.initialize()
+        server = make_light_proxy_server(VerifyingClient(http, lc), args.laddr)
+        await server.start()
+        print(f"light proxy listening at {server.listen_addr} (chain {args.chain_id})")
+        stop = asyncio.Event()
+        loop = asyncio.get_running_loop()
+        for sig in (signal.SIGINT, signal.SIGTERM):
+            loop.add_signal_handler(sig, stop.set)
+        await stop.wait()
+        await server.stop()
+
+    asyncio.run(run())
+
+
+def cmd_replay(args) -> None:
+    """Reference commands/replay.go: replay the WAL through a fresh
+    consensus state over the stored chain."""
+
+    async def run() -> None:
+        from tendermint_tpu.node import default_new_node
+
+        cfg = load_or_default_config(args.home)
+        node = default_new_node(cfg)
+        await node.start()  # handshake + WAL catchup IS the replay
+        cs = node.consensus_state
+        print(
+            f"replayed to height {cs.state.last_block_height}, "
+            f"round state {cs.rs.height_round_step()}"
+        )
+        await node.stop()
+
+    asyncio.run(run())
+
+
+def cmd_debug(args) -> None:
+    """Reference cmd/tendermint/commands/debug/dump.go: collect
+    status/net_info/consensus dumps over RPC into a directory."""
+
+    async def run() -> None:
+        from tendermint_tpu.rpc.client import HTTPClient
+
+        os.makedirs(args.out, exist_ok=True)
+        c = HTTPClient(args.rpc_laddr.replace("tcp://", ""))
+        for route in ("status", "net_info", "dump_consensus_state", "consensus_state",
+                      "num_unconfirmed_txs"):
+            try:
+                res = await c.call(route)
+                with open(os.path.join(args.out, f"{route}.json"), "w") as fp:
+                    json.dump(res, fp, indent=2)
+                print(f"wrote {route}.json")
+            except Exception as e:
+                print(f"failed {route}: {e}")
+
+    asyncio.run(run())
+
+
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(
         prog="tendermint-tpu", description="TPU-native BFT state-machine replication"
@@ -254,6 +343,24 @@ def build_parser() -> argparse.ArgumentParser:
     ):
         sp = sub.add_parser(name)
         sp.set_defaults(func=fn)
+
+    sp = sub.add_parser("light", help="run a light-client verifying RPC proxy")
+    sp.add_argument("--primary", required=True, help="primary node RPC addr (host:port)")
+    sp.add_argument("--witness", action="append", default=[], help="witness RPC addr (repeatable)")
+    sp.add_argument("--chain-id", required=True)
+    sp.add_argument("--trusted-height", type=int, default=1)
+    sp.add_argument("--trusted-hash", default="", help="hex hash at trusted height (default: fetch)")
+    sp.add_argument("--laddr", default="tcp://127.0.0.1:8888")
+    sp.add_argument("--trust-period-hours", type=int, default=168)
+    sp.set_defaults(func=cmd_light)
+
+    sp = sub.add_parser("replay", help="replay the consensus WAL through a fresh state machine")
+    sp.set_defaults(func=cmd_replay)
+
+    sp = sub.add_parser("debug", help="dump node state via RPC for debugging")
+    sp.add_argument("--rpc-laddr", default="tcp://127.0.0.1:26657")
+    sp.add_argument("--out", default="./debug_dump")
+    sp.set_defaults(func=cmd_debug)
 
     sp = sub.add_parser("testnet", help="generate testnet config dirs")
     sp.add_argument("--v", type=int, default=4, help="number of validators")

@@ -1,0 +1,82 @@
+"""Light-client proxy server: `tendermint light`-style verifying RPC.
+
+Reference: lite2/proxy/proxy.go + routes.go — an RPC server whose
+handlers go through the verifying client; cmd/tendermint/commands/lite.go
+wires it to `tendermint lite`.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+from tendermint_tpu.light.proxy import VerifyingClient
+from tendermint_tpu.rpc.core import RPCError
+
+
+class LightProxyCore:
+    """Route table backed by a VerifyingClient (subset of rpc.core)."""
+
+    def __init__(self, verifying_client: VerifyingClient):
+        self._vc = verifying_client
+        self._routes = {
+            "health": self.health,
+            "status": self.status,
+            "block": self.block,
+            "commit": self.commit,
+            "validators": self.validators,
+            "abci_query": self.abci_query,
+            "tx": self.tx,
+            "broadcast_tx_sync": self.broadcast_tx_sync,
+            "broadcast_tx_commit": self.broadcast_tx_commit,
+            "trusted_height": self.trusted_height,
+        }
+
+    def routes(self):
+        return list(self._routes)
+
+    async def call(self, name: str, params: Dict[str, Any]):
+        handler = self._routes.get(name)
+        if handler is None:
+            raise RPCError(f"unknown method {name!r} (light proxy)", code=-32601)
+        try:
+            return await handler(**params)
+        except RPCError:
+            raise
+        except Exception as e:
+            raise RPCError(f"verification failed: {e}")
+
+    async def health(self):
+        return {}
+
+    async def status(self):
+        return await self._vc.status()
+
+    async def block(self, height=None):
+        return await self._vc.block(int(height))
+
+    async def commit(self, height=None):
+        return await self._vc.commit(int(height))
+
+    async def validators(self, height=None):
+        return await self._vc.validators(int(height))
+
+    async def abci_query(self, path="", data=None, height=0):
+        return await self._vc.abci_query(path, data, int(height or 0))
+
+    async def tx(self, hash=None):
+        return await self._vc.tx(hash)
+
+    async def broadcast_tx_sync(self, tx=None):
+        return await self._vc.broadcast_tx_sync(tx)
+
+    async def broadcast_tx_commit(self, tx=None):
+        return await self._vc.broadcast_tx_commit(tx)
+
+    async def trusted_height(self):
+        return {"height": self._vc._lc.trusted_height()}
+
+
+def make_light_proxy_server(verifying_client: VerifyingClient, laddr: str):
+    from tendermint_tpu.rpc.server import RPCServer
+
+    return RPCServer(None, laddr=laddr, core=LightProxyCore(verifying_client))

@@ -33,11 +33,11 @@ def _rpc_response(id_, result=None, error=None) -> bytes:
 
 
 class RPCServer:
-    def __init__(self, node, laddr: Optional[str] = None, logger=None):
+    def __init__(self, node, laddr: Optional[str] = None, core=None, logger=None):
         self.node = node
-        self.core = RPCCore(node)
+        self.core = core if core is not None else RPCCore(node)
         self.logger = logger or get_logger("rpc")
-        self._laddr = laddr or node.config.rpc.laddr
+        self._laddr = laddr or (node.config.rpc.laddr if node is not None else "tcp://127.0.0.1:0")
         self._server: Optional[asyncio.base_events.Server] = None
         self.listen_addr: Optional[NetAddress] = None
         self._ws_counter = 0
@@ -192,15 +192,19 @@ class RPCServer:
         finally:
             for t in pump_tasks:
                 t.cancel()
-            try:
-                await self.node.event_bus.unsubscribe_all(client_id)
-            except Exception:
-                pass
+            if self.node is not None:
+                try:
+                    await self.node.event_bus.unsubscribe_all(client_id)
+                except Exception:
+                    pass
 
     async def _ws_subscribe(self, client_id, doc, push):
         from tendermint_tpu.utils.pubsub import Query
 
         id_ = doc.get("id")
+        if self.node is None:
+            await push(_rpc_response(id_, error={"code": -32601, "message": "subscriptions unavailable"}))
+            return None
         query_s = (doc.get("params") or {}).get("query", "")
         try:
             query = Query(query_s)

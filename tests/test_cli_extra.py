@@ -1,0 +1,99 @@
+"""CLI: replay, debug dump, light proxy subprocess smoke tests."""
+
+import asyncio
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import pytest
+
+from tendermint_tpu.cli import main as cli_main
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_replay_command(tmp_path, capsys):
+    home = str(tmp_path / "r0")
+    # run a short chain with file-backed stores via persist_node
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    subprocess.run(
+        [sys.executable, os.path.join(REPO, "tests", "persist_node.py"), home, "3"],
+        check=True, env=env, capture_output=True,
+    )
+    # replay needs full node layout; persist_node uses its own layout, so
+    # instead exercise `replay` on a CLI-initialized home with some blocks
+    home2 = str(tmp_path / "r1")
+    cli_main(["--home", home2, "init", "--chain-id", "replay-chain"])
+
+    async def make_blocks():
+        from tendermint_tpu.config import load_config
+        from tendermint_tpu.node import default_new_node
+
+        cfg = load_config(os.path.join(home2, "config/config.toml")).set_root(home2)
+        cfg.p2p.laddr = "tcp://127.0.0.1:0"
+        cfg.consensus.timeout_commit_ms = 50
+        cfg.consensus.skip_timeout_commit = True
+        node = default_new_node(cfg)
+        await node.start()
+        await node.consensus_state.wait_for_height(3, timeout_s=30)
+        await node.stop()
+
+    asyncio.run(make_blocks())
+    capsys.readouterr()
+    # now replay (opens stores + WAL, prints resulting height)
+    out = subprocess.run(
+        [sys.executable, "-m", "tendermint_tpu", "--home", home2, "replay"],
+        env=env, capture_output=True, text=True, timeout=60, cwd=REPO,
+    )
+    assert out.returncode == 0, out.stderr[-2000:]
+    assert "replayed to height" in out.stdout
+
+
+def test_debug_dump_command(tmp_path):
+    """Spin a node process, run `debug` against its RPC."""
+    import socket as socklib
+
+    home = str(tmp_path / "d0")
+    cli_main(["--home", home, "init", "--chain-id", "debug-chain"])
+    s = socklib.socket()
+    s.bind(("127.0.0.1", 0))
+    rpc_port = s.getsockname()[1]
+    s.close()
+
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "tendermint_tpu", "--home", home, "node",
+         "--rpc.laddr", f"tcp://127.0.0.1:{rpc_port}",
+         "--p2p.laddr", "tcp://127.0.0.1:0"],
+        env=env, cwd=REPO,
+        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+    )
+    try:
+        deadline = time.time() + 60
+        ok = False
+        while time.time() < deadline:
+            out_dir = str(tmp_path / "dump")
+            r = subprocess.run(
+                [sys.executable, "-m", "tendermint_tpu", "debug",
+                 "--rpc-laddr", f"tcp://127.0.0.1:{rpc_port}", "--out", out_dir],
+                env=env, capture_output=True, text=True, timeout=30, cwd=REPO,
+            )
+            if r.returncode == 0 and os.path.exists(os.path.join(out_dir, "status.json")):
+                with open(os.path.join(out_dir, "status.json")) as fp:
+                    st = json.load(fp)
+                if st["node_info"]["network"] == "debug-chain":
+                    ok = True
+                    break
+            time.sleep(1)
+        assert ok, "debug dump never succeeded"
+    finally:
+        proc.send_signal(signal.SIGTERM)
+        try:
+            proc.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            proc.kill()
