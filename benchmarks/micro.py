@@ -1,0 +1,219 @@
+#!/usr/bin/env python
+"""Microbenchmarks mirroring the reference's in-tree benches (SURVEY §4.5).
+
+Each bench prints one JSON line {"metric", "value", "unit"}. Run all:
+    python benchmarks/micro.py            # everything except device benches
+    python benchmarks/micro.py light mempool secretconn txindex e2e valset
+
+Reference bench inventory: crypto/ed25519/bench_test.go (→ bench.py at
+the repo root, the driver-run headline), lite2/client_benchmark_test.go,
+mempool/bench_test.go, p2p/conn/secret_connection_test.go:389,
+types/validator_set_test.go:1416, state/txindex/kv/kv_test.go:360,
+plus an e2e single-node commit-latency probe (test/p2p analog).
+"""
+
+import asyncio
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+
+def emit(metric, value, unit):
+    print(json.dumps({"metric": metric, "value": round(value, 4), "unit": unit}))
+
+
+def bench_light():
+    """lite2/client_benchmark_test.go: bisection over a mock chain."""
+    sys.path.insert(0, os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "tests"))
+    from light_helpers import CHAIN_ID, T0, gen_chain
+
+    from tendermint_tpu.db.memdb import MemDB
+    from tendermint_tpu.light import LightClient, TrustOptions
+    from tendermint_tpu.light.provider import MockProvider
+    from tendermint_tpu.light.store import TrustedStore
+
+    n = 200  # headers (chain generation is the expensive part host-side)
+    headers, vals = gen_chain(n)
+    now = T0 + 600 * 10**9
+
+    async def verify_all(mode_seq: bool):
+        lc = LightClient(
+            CHAIN_ID,
+            TrustOptions(period_ns=10**18, height=1, hash=headers[1].hash()),
+            MockProvider(CHAIN_ID, headers, vals),
+            [],
+            TrustedStore(MemDB()),
+        )
+        t0 = time.perf_counter()
+        if mode_seq:
+            for h in range(2, n + 1):
+                await lc.verify_header_at_height(h, now_ns=now)
+        else:
+            await lc.verify_header_at_height(n, now_ns=now)
+        return time.perf_counter() - t0
+
+    seq = asyncio.run(verify_all(True))
+    bis = asyncio.run(verify_all(False))
+    emit("light_sequential_200_headers", seq * 1e3, "ms")
+    emit("light_bisection_to_200", bis * 1e3, "ms")
+
+
+def bench_mempool():
+    """mempool/bench_test.go: CheckTx + Reap."""
+    from tendermint_tpu.abci.client.local import LocalClient
+    from tendermint_tpu.abci.examples.kvstore import KVStoreApplication
+    from tendermint_tpu.config import MempoolConfig
+    from tendermint_tpu.mempool import Mempool
+
+    async def go():
+        client = LocalClient(KVStoreApplication())
+        await client.start()
+        pool = Mempool(MempoolConfig(size=200_000), client)
+        n = 10_000
+        t0 = time.perf_counter()
+        for i in range(n):
+            await pool.check_tx(i.to_bytes(8, "big"))
+        check = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        txs = pool.reap_max_bytes_max_gas(-1, -1)
+        reap = time.perf_counter() - t0
+        assert len(txs) == n
+        emit("mempool_checktx", n / check, "txs/s")
+        emit("mempool_reap_10k", reap * 1e3, "ms")
+
+    asyncio.run(go())
+
+
+def bench_secretconn():
+    """p2p/conn/secret_connection_test.go:389: throughput."""
+    from tendermint_tpu.crypto.keys import Ed25519PrivKey
+    from tendermint_tpu.p2p.conn.secret_connection import SecretConnection
+
+    async def go():
+        ready = asyncio.Queue()
+
+        async def on_conn(r, w):
+            await ready.put((r, w))
+
+        server = await asyncio.start_server(on_conn, "127.0.0.1", 0)
+        host, port = server.sockets[0].getsockname()[:2]
+        cr, cw = await asyncio.open_connection(host, port)
+        sr, sw = await ready.get()
+        sc1, sc2 = await asyncio.gather(
+            SecretConnection.make(cr, cw, Ed25519PrivKey.generate()),
+            SecretConnection.make(sr, sw, Ed25519PrivKey.generate()),
+        )
+        total = 64 * 1024 * 1024  # 64MB
+        chunk = b"\xaa" * (1 << 20)
+
+        async def writer():
+            sent = 0
+            while sent < total:
+                await sc1.write(chunk)
+                sent += len(chunk)
+
+        async def reader():
+            got = 0
+            while got < total:
+                got += len(await sc2.read(1 << 16))
+
+        t0 = time.perf_counter()
+        await asyncio.gather(writer(), reader())
+        dt = time.perf_counter() - t0
+        emit("secretconn_throughput", total / dt / 1e6, "MB/s")
+        sc1.close()
+        sc2.close()
+        server.close()
+
+    asyncio.run(go())
+
+
+def bench_valset():
+    """types/validator_set_test.go:1416 BenchmarkUpdates."""
+    from tendermint_tpu.crypto.keys import Ed25519PrivKey
+    from tendermint_tpu.types.validator import Validator
+    from tendermint_tpu.types.validator_set import ValidatorSet
+
+    n = 1000
+    vals = [
+        Validator(Ed25519PrivKey.from_secret(f"b{i}".encode()).pub_key(), 10)
+        for i in range(n)
+    ]
+    vs = ValidatorSet(vals[: n // 2])
+    t0 = time.perf_counter()
+    vs.update_with_change_set(vals[n // 2 :])
+    dt = time.perf_counter() - t0
+    emit("valset_update_500_into_500", dt * 1e3, "ms")
+    t0 = time.perf_counter()
+    for _ in range(100):
+        vs.increment_proposer_priority(1)
+    emit("valset_increment_priority_1k_x100", (time.perf_counter() - t0) * 1e3, "ms")
+
+
+def bench_txindex():
+    """state/txindex/kv/kv_test.go:360: insert throughput."""
+    from tendermint_tpu.abci import types as abci
+    from tendermint_tpu.db.memdb import MemDB
+    from tendermint_tpu.state.txindex import KVTxIndexer, TxResult
+
+    idx = KVTxIndexer(MemDB())
+    n = 10_000
+    results = [
+        TxResult(
+            height=i // 100 + 1, index=i % 100, tx=i.to_bytes(8, "big"),
+            result=abci.ResponseDeliverTx(
+                events=[abci.Event("e", [abci.KVPair(b"k", str(i % 50).encode())])]
+            ),
+        )
+        for i in range(n)
+    ]
+    t0 = time.perf_counter()
+    for r in results:
+        idx.index(r)
+    dt = time.perf_counter() - t0
+    emit("txindex_insert", n / dt, "txs/s")
+
+
+def bench_e2e():
+    """Single-node commit cadence (localnet rig analog)."""
+    sys.path.insert(0, os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "tests"))
+    from cs_harness import start_network, stop_network
+
+    from tendermint_tpu.config import test_config
+
+    async def go():
+        cfg = test_config().consensus
+        cfg.timeout_commit_ms = 0
+        cfg.skip_timeout_commit = True
+        nodes = await start_network(4, config=cfg)
+        try:
+            await nodes[0].cs.wait_for_height(2, timeout_s=30)
+            t0 = time.perf_counter()
+            target = nodes[0].cs.state.last_block_height + 20
+            await asyncio.gather(*(n.cs.wait_for_height(target, 60) for n in nodes))
+            dt = time.perf_counter() - t0
+            emit("e2e_4node_commit_latency", dt / 20 * 1e3, "ms/block")
+        finally:
+            await stop_network(nodes)
+
+    asyncio.run(go())
+
+
+BENCHES = {
+    "light": bench_light,
+    "mempool": bench_mempool,
+    "secretconn": bench_secretconn,
+    "valset": bench_valset,
+    "txindex": bench_txindex,
+    "e2e": bench_e2e,
+}
+
+
+if __name__ == "__main__":
+    names = sys.argv[1:] or list(BENCHES)
+    for name in names:
+        BENCHES[name]()
