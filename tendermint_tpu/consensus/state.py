@@ -368,12 +368,47 @@ class ConsensusState(Service):
                     self.wal.write(item)
                     await self._handle_timeout(item)
                 elif isinstance(item, MsgInfo):
-                    if item.peer_id:
-                        self.wal.write(item)
+                    leftover = None
+                    if isinstance(item.msg, VoteMessage):
+                        # TPU-first micro-batching: drain all immediately
+                        # queued votes and verify them in ONE device call
+                        # (the accumulate-then-flush redesign, SURVEY §7.1;
+                        # the reference verifies per-vote inline at
+                        # types/vote_set.go:201 — BASELINE config-5 path).
+                        batch = [item]
+                        while not self._queue.empty() and len(batch) < 4096:
+                            nxt = self._queue.get_nowait()
+                            if isinstance(nxt, MsgInfo) and isinstance(nxt.msg, VoteMessage):
+                                batch.append(nxt)
+                            else:
+                                leftover = nxt
+                                break
+                        for mi in batch:
+                            if mi.peer_id:
+                                self.wal.write(mi)
+                            else:
+                                self.wal.write_sync(mi)
+                        if len(batch) == 1:
+                            await self._handle_msg(batch[0])
+                        else:
+                            await self._handle_vote_batch(batch)
                     else:
-                        # internal: fsync before processing (reference :650)
-                        self.wal.write_sync(item)
-                    await self._handle_msg(item)
+                        if item.peer_id:
+                            self.wal.write(item)
+                        else:
+                            # internal: fsync before processing (reference :650)
+                            self.wal.write_sync(item)
+                        await self._handle_msg(item)
+                    if leftover is not None:
+                        if isinstance(leftover, TimeoutInfo):
+                            self.wal.write(leftover)
+                            await self._handle_timeout(leftover)
+                        elif isinstance(leftover, MsgInfo):
+                            if leftover.peer_id:
+                                self.wal.write(leftover)
+                            else:
+                                self.wal.write_sync(leftover)
+                            await self._handle_msg(leftover)
                 else:
                     self.logger.error("unknown queue item", item=repr(item))
             except asyncio.CancelledError:
@@ -396,6 +431,76 @@ class ConsensusState(Service):
             await self._try_add_vote(msg.vote, peer_id)
         else:
             self.logger.error("unknown msg type", type=type(msg).__name__)
+
+    async def _handle_vote_batch(self, batch) -> None:
+        """Bulk vote ingest: verify all current-height votes in one
+        device batch, then run the round-transition checks once per
+        (round, type) group — the accepted votes and resulting
+        transitions are identical to one-at-a-time processing because
+        the transition functions read only VoteSet aggregates."""
+        rs = self.rs
+        current: list = []
+        other: list = []
+        for mi in batch:
+            vote = mi.msg.vote
+            if vote.height == rs.height and rs.votes is not None:
+                current.append(mi)
+            else:
+                other.append(mi)  # lastCommit votes / wrong height
+
+        groups = {}
+        for mi in current:
+            groups.setdefault((mi.msg.vote.round, mi.msg.vote.vote_type), []).append(mi)
+
+        for (round_, vtype), mis in groups.items():
+            votes = [mi.msg.vote for mi in mis]
+            # route through per-peer add for catchup-quota enforcement
+            # only when the round set doesn't exist yet
+            if rs.votes._get_vote_set(round_, vtype) is None:
+                other.extend(mis)
+                continue
+            added, err = rs.votes.add_votes_batched(votes)
+            if err is not None and isinstance(err, ErrVoteConflictingVotes):
+                await self._handle_vote_conflict(err, votes[0])
+            any_added = False
+            for mi, ok in zip(mis, added):
+                if not ok:
+                    continue
+                any_added = True
+                vote = mi.msg.vote
+                if self.event_bus is not None and not self.replay_mode:
+                    self._publish_soon(self.event_bus.publish_event_vote(vote))
+                self.evsw.fire_event(EVENT_VOTE, vote)
+            if any_added:
+                probe = votes[0]
+                if vtype == PREVOTE_TYPE:
+                    await self._on_prevote_added(probe)
+                else:
+                    await self._on_precommit_added(probe)
+
+        for mi in other:
+            await self._try_add_vote(mi.msg.vote, mi.peer_id)
+
+    async def _handle_vote_conflict(self, e, vote) -> None:
+        """Shared conflict→evidence path (reference tryAddVote :1706)."""
+        if self._priv_validator_addr == vote.validator_address:
+            self.logger.error(
+                "found conflicting vote from ourselves", vote=repr(vote)
+            )
+            return
+        if self._evpool is not None:
+            from tendermint_tpu.types.evidence import DuplicateVoteEvidence
+
+            _, val = self.rs.validators.get_by_address(e.vote_a.validator_address)
+            if val is None:
+                return
+            ev = DuplicateVoteEvidence(
+                pub_key=val.pub_key, vote_a=e.vote_a, vote_b=e.vote_b
+            )
+            try:
+                self._evpool.add_evidence(ev)
+            except Exception as ee:
+                self.logger.error("failed to add evidence", err=str(ee))
 
     async def _handle_timeout(self, ti: TimeoutInfo) -> None:
         """Reference handleTimeout :745."""
