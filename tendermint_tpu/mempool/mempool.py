@@ -116,6 +116,23 @@ class Mempool:
         # (reference notifyTxsAvailable :455)
         self._txs_available: Optional[asyncio.Event] = None
         self._notified_txs_available = False
+        # optional WAL of accepted txs (reference InitWAL
+        # clist_mempool.go:137 — forensic log, not replayed)
+        self._wal = None
+        if getattr(config, "wal_dir", ""):
+            self.init_wal()
+
+    def init_wal(self) -> None:
+        import os
+
+        d = self.config.wal_dir
+        os.makedirs(d, exist_ok=True)
+        self._wal = open(os.path.join(d, "wal"), "ab")
+
+    def close_wal(self) -> None:
+        if self._wal is not None:
+            self._wal.close()
+            self._wal = None
 
     # -- info --------------------------------------------------------------
 
@@ -191,6 +208,11 @@ class Mempool:
                 entry.senders.add(sender)
             self._txs[tx_key(tx)] = entry
             self._txs_bytes += len(tx)
+            if self._wal is not None:
+                import base64
+
+                self._wal.write(base64.b64encode(tx) + b"\n")
+                self._wal.flush()
             self.logger.debug(
                 "added good transaction", tx=tx_key(tx).hex()[:12], pool=len(self._txs)
             )

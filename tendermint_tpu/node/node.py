@@ -310,6 +310,13 @@ class Node(Service):
         # RPC without starting the switch")
         if self.rpc_server is not None:
             await self.rpc_server.start()
+        if self.config.rpc.grpc_laddr:
+            from tendermint_tpu.rpc.grpc_api import GRPCBroadcastServer
+
+            self.grpc_server = GRPCBroadcastServer(self, self.config.rpc.grpc_laddr)
+            await self.grpc_server.start()
+        else:
+            self.grpc_server = None
         if self.metrics_server is not None:
             await self.metrics_server.start()
         self.spawn(self._metrics_pump())
@@ -361,6 +368,8 @@ class Node(Service):
 
     async def on_stop(self) -> None:
         await self.switch.stop()
+        if getattr(self, "grpc_server", None) is not None:
+            await self.grpc_server.stop()
         if self.rpc_server is not None:
             await self.rpc_server.stop()
         await self.indexer_service.stop()

@@ -1,0 +1,70 @@
+"""Peer-behaviour reporting.
+
+Reference: behaviour/ — Reporter interface (reporter.go:12),
+SwitchReporter (:17, good behaviour → MarkGood via PEX book; bad →
+StopPeerForError), MockReporter (:50 region) used by blockchain/v2 and
+its tests.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, List
+
+
+# behaviour kinds (reference behaviour/peer_behaviour.go)
+BAD_MESSAGE = "bad_message"
+MESSAGE_OUT_OF_ORDER = "message_out_of_order"
+CONSENSUS_VOTE = "consensus_vote"
+BLOCK_PART = "block_part"
+
+_GOOD = {CONSENSUS_VOTE, BLOCK_PART}
+
+
+@dataclass(frozen=True)
+class PeerBehaviour:
+    peer_id: str
+    kind: str
+    reason: str = ""
+
+    def is_good(self) -> bool:
+        return self.kind in _GOOD
+
+
+class Reporter:
+    async def report(self, behaviour: PeerBehaviour) -> None:
+        raise NotImplementedError
+
+
+class SwitchReporter(Reporter):
+    """Routes reports to the switch: bad behaviour stops the peer; good
+    behaviour marks it in the address book (reference SwitchReporter)."""
+
+    def __init__(self, switch):
+        self._switch = switch
+
+    async def report(self, behaviour: PeerBehaviour) -> None:
+        peer = self._switch.peers.get(behaviour.peer_id)
+        if peer is None:
+            return
+        if behaviour.is_good():
+            pex = self._switch.reactors.get("pex")
+            if pex is not None and hasattr(pex, "book"):
+                pex.book.mark_good(behaviour.peer_id)
+        else:
+            await self._switch.stop_peer_for_error(
+                peer, f"{behaviour.kind}: {behaviour.reason}"
+            )
+
+
+class MockReporter(Reporter):
+    """Records reports for assertions (reference MockReporter)."""
+
+    def __init__(self):
+        self.reports: Dict[str, List[PeerBehaviour]] = {}
+
+    async def report(self, behaviour: PeerBehaviour) -> None:
+        self.reports.setdefault(behaviour.peer_id, []).append(behaviour)
+
+    def get(self, peer_id: str) -> List[PeerBehaviour]:
+        return self.reports.get(peer_id, [])

@@ -1,0 +1,65 @@
+"""Peer behaviour reporting (mirrors behaviour/reporter_test.go) and
+mempool WAL."""
+
+import asyncio
+import base64
+import os
+
+from tendermint_tpu.p2p.behaviour import (
+    BAD_MESSAGE,
+    CONSENSUS_VOTE,
+    MockReporter,
+    PeerBehaviour,
+    SwitchReporter,
+)
+from tendermint_tpu.p2p.test_util import make_connected_switches, stop_switches
+
+
+def test_mock_reporter_records():
+    async def go():
+        r = MockReporter()
+        await r.report(PeerBehaviour("p1", CONSENSUS_VOTE))
+        await r.report(PeerBehaviour("p1", BAD_MESSAGE, "garbage"))
+        assert len(r.get("p1")) == 2
+        assert r.get("p1")[0].is_good()
+        assert not r.get("p1")[1].is_good()
+        assert r.get("p2") == []
+
+    asyncio.run(go())
+
+
+def test_switch_reporter_stops_bad_peer():
+    async def go():
+        switches = await make_connected_switches(2)
+        try:
+            reporter = SwitchReporter(switches[0])
+            peer_id = next(iter(switches[0].peers))
+            await reporter.report(PeerBehaviour(peer_id, CONSENSUS_VOTE))
+            assert peer_id in switches[0].peers  # good: kept
+            await reporter.report(PeerBehaviour(peer_id, BAD_MESSAGE, "bad bytes"))
+            assert peer_id not in switches[0].peers  # bad: dropped
+        finally:
+            await stop_switches(switches)
+
+    asyncio.run(go())
+
+
+def test_mempool_wal_logs_txs(tmp_path):
+    async def go():
+        from tendermint_tpu.abci.client.local import LocalClient
+        from tendermint_tpu.abci.examples.kvstore import KVStoreApplication
+        from tendermint_tpu.config import MempoolConfig
+        from tendermint_tpu.mempool import Mempool
+
+        client = LocalClient(KVStoreApplication())
+        await client.start()
+        cfg = MempoolConfig(wal_dir=str(tmp_path / "mwal"))
+        pool = Mempool(cfg, client)
+        await pool.check_tx(b"walled=1")
+        await pool.check_tx(b"walled=2")
+        pool.close_wal()
+        with open(os.path.join(cfg.wal_dir, "wal"), "rb") as fp:
+            lines = [base64.b64decode(l) for l in fp.read().splitlines()]
+        assert lines == [b"walled=1", b"walled=2"]
+
+    asyncio.run(go())

@@ -1,0 +1,27 @@
+"""gRPC broadcast API (mirrors rpc/grpc/grpc_test.go TestBroadcastTx)."""
+
+import asyncio
+
+from tendermint_tpu.rpc.grpc_api import GRPCBroadcastClient, GRPCBroadcastServer
+from tests.test_rpc import start_node
+
+
+def test_grpc_ping_and_broadcast(tmp_path):
+    async def go():
+        node, _ = await start_node(tmp_path)
+        server = GRPCBroadcastServer(node)
+        await server.start()
+        client = GRPCBroadcastClient(f"127.0.0.1:{server.bound_port}")
+        await client.connect()
+        try:
+            assert await client.ping()
+            res = await client.broadcast_tx(b"grpc=yes")
+            assert res["check_tx"]["code"] == 0
+            assert res["deliver_tx"]["code"] == 0
+            assert node.app._db.get(b"kv:grpc") == b"yes"
+        finally:
+            await client.close()
+            await server.stop()
+            await node.stop()
+
+    asyncio.run(go())
