@@ -1,0 +1,305 @@
+"""Consensus locking/POL rules, driven deterministically.
+
+Mirrors reference consensus/state_test.go — TestStateLockNoPOL /
+TestStateLockPOLUnlock flavors: one real consensus state for validator
+0, with validators 1-3 simulated by injecting signed votes (the
+validatorStub pattern, common_test.go:68). Timeouts are set huge so
+every transition is vote-driven.
+"""
+
+import asyncio
+
+import pytest
+
+from tendermint_tpu.codec.signbytes import PRECOMMIT_TYPE, PREVOTE_TYPE
+from tendermint_tpu.config import test_config
+from tendermint_tpu.consensus.round_state import (
+    STEP_PRECOMMIT,
+    STEP_PREVOTE,
+    STEP_PROPOSE,
+)
+from tendermint_tpu.consensus.messages import BlockPartMessage, ProposalMessage
+from tendermint_tpu.types.block import BlockID
+from tendermint_tpu.types.proposal import Proposal
+from tendermint_tpu.types.vote import Vote
+from tests.cs_harness import CHAIN_ID, make_genesis, make_node
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+def slow_config():
+    cfg = test_config().consensus
+    # nothing fires on its own: transitions are purely vote-driven
+    for name in ("timeout_propose_ms", "timeout_prevote_ms", "timeout_precommit_ms"):
+        setattr(cfg, name, 600_000)
+    # commit timeout gates ROUND 0 START (start_time = commit_time +
+    # timeout_commit) — keep it tiny so the height begins immediately
+    cfg.timeout_commit_ms = 10
+    cfg.skip_timeout_commit = False
+    return cfg
+
+
+async def setup():
+    genesis, privs = make_genesis(4)
+    node = await make_node(genesis, privs[0], config=slow_config())
+    cs = node.cs
+    await cs.start()
+    # wait for round 0 propose step
+    for _ in range(500):
+        if cs.rs.step >= STEP_PROPOSE:
+            break
+        await asyncio.sleep(0.01)
+    return node, cs, privs
+
+
+def stub_vote(cs, priv, vtype, block_id, round_=None, ts=1000):
+    idx, _ = cs.rs.validators.get_by_address(priv.address())
+    v = Vote(
+        vote_type=vtype,
+        height=cs.rs.height,
+        round=cs.rs.round if round_ is None else round_,
+        block_id=block_id,
+        timestamp_ns=ts,
+        validator_address=priv.address(),
+        validator_index=idx,
+    )
+    priv.sign_vote(CHAIN_ID, v)
+    return v
+
+
+async def inject_proposal(cs, proposer_priv, block, round_, pol_round=-1):
+    parts = block.make_part_set()
+    block_id = BlockID(block.hash(), parts.header())
+    prop = Proposal(
+        height=cs.rs.height, round=round_, pol_round=pol_round,
+        block_id=block_id, timestamp_ns=2000,
+    )
+    proposer_priv.sign_proposal(CHAIN_ID, prop)
+    await cs.add_peer_message(ProposalMessage(prop), "stub")
+    for i in range(parts.total):
+        await cs.add_peer_message(
+            BlockPartMessage(cs.rs.height, round_, parts.get_part(i)), "stub"
+        )
+    return block_id
+
+
+async def wait_step(cs, step, timeout_s=5.0):
+    for _ in range(int(timeout_s / 0.01)):
+        if cs.rs.step == step:
+            return
+        await asyncio.sleep(0.01)
+    raise TimeoutError(f"never reached step {step}, at {cs.rs.height_round_step()}")
+
+
+async def arrange_round0_proposal(cs, privs):
+    """Get a complete round-0 proposal into cs: if OUR validator is the
+    proposer it proposed already (use its block); otherwise inject one
+    signed by the actual proposer."""
+    proposer = cs.rs.validators.get_proposer()
+    if proposer.address == privs[0].address():
+        for _ in range(500):
+            if cs.rs.proposal_block is not None:
+                break
+            await asyncio.sleep(0.01)
+        return BlockID(
+            cs.rs.proposal_block.hash(), cs.rs.proposal_block_parts.header()
+        )
+    p_priv = next(p for p in privs if p.address() == proposer.address)
+    from tendermint_tpu.types.block import Commit
+    from tendermint_tpu.types.tx import Txs
+
+    block = cs.state.make_block(
+        cs.rs.height, Txs(),
+        Commit(height=0, round=0, block_id=BlockID(), signatures=[]),
+        [], proposer.address, time_ns=123_456,
+    )
+    return await inject_proposal(cs, p_priv, block, 0)
+
+
+def make_alt_block(cs, node):
+    """A block different from the proposer's (different time)."""
+    from tendermint_tpu.types.block import Commit
+    from tendermint_tpu.types.tx import Tx, Txs
+
+    commit = Commit(height=0, round=0, block_id=BlockID(), signatures=[])
+    return cs.state.make_block(
+        cs.rs.height, Txs([Tx(b"alt")]), commit, [],
+        cs.rs.validators.validators[0].address, time_ns=999_999,
+    )
+
+
+def test_lock_then_keep_prevoting_locked_block():
+    """Reference TestStateLockNoPOL round-2 behavior: once locked, the
+    validator prevotes its locked block in later rounds, even with a
+    different proposal on the table."""
+
+    async def go():
+        node, cs, privs = await setup()
+        try:
+            bid = await arrange_round0_proposal(cs, privs)
+            await wait_step(cs, STEP_PREVOTE)
+
+            # +2/3 prevotes for the block (including ours) → our node
+            # precommits and LOCKS
+            others = [p for p in privs if p.address() != privs[0].address()][:2]
+            for p in others:
+                await cs.add_vote_from_peer(stub_vote(cs, p, PREVOTE_TYPE, bid), "stub")
+            await wait_step(cs, STEP_PRECOMMIT)
+            assert cs.rs.locked_block is not None
+            assert cs.rs.locked_block.hash() == bid.hash
+            assert cs.rs.locked_round == 0
+
+            # nil precommits from others → no commit; round moves to 1
+            nil = BlockID()
+            for p in others:
+                await cs.add_vote_from_peer(
+                    stub_vote(cs, p, PRECOMMIT_TYPE, nil), "stub"
+                )
+            # +2/3 precommits present (ours for block, 2 nil) → precommit
+            # wait → we must inject the third nil to get 2/3 any... force
+            # the round change with the remaining validator
+            last = [p for p in privs if p.address() != privs[0].address()][2]
+            await cs.add_vote_from_peer(
+                stub_vote(cs, last, PRECOMMIT_TYPE, nil), "stub"
+            )
+            # precommit-wait timeout is huge; drive round change by
+            # next-round prevotes with 2/3-ANY but NO polka (2 nil + 1
+            # for an unknown block — a nil polka would rightly unlock)
+            from tendermint_tpu.types.block import PartSetHeader
+
+            stray = BlockID(b"\x5a" * 32, PartSetHeader(1, b"\x5b" * 32))
+            for p, target in zip(others + [last], (nil, nil, stray)):
+                await cs.add_vote_from_peer(
+                    stub_vote(cs, p, PREVOTE_TYPE, target, round_=1), "stub"
+                )
+            for _ in range(500):
+                if cs.rs.round == 1:
+                    break
+                await asyncio.sleep(0.01)
+            assert cs.rs.round == 1
+            # round-1 proposer proposes a DIFFERENT block; with huge
+            # timeouts our node only prevotes once this proposal completes
+            proposer1 = cs.rs.validators.get_proposer()
+            if proposer1.address != privs[0].address():
+                p1 = next(p for p in privs if p.address() == proposer1.address)
+                alt = make_alt_block(cs, node)
+                await inject_proposal(cs, p1, alt, 1)
+            # still locked — and our round-1 prevote must be for the
+            # LOCKED block (reference: enterPrevote with lockedBlock),
+            # NOT the new proposal
+            pv = cs.rs.votes.prevotes(1)
+            our_vote = None
+            for _ in range(500):
+                our_vote = pv.get_by_address(privs[0].address())
+                if our_vote is not None:
+                    break
+                await asyncio.sleep(0.01)
+            assert our_vote is not None, "node did not prevote in round 1"
+            assert our_vote.block_id.hash == bid.hash
+            assert cs.rs.locked_round == 0
+        finally:
+            await node.cs.stop()
+
+    run(go())
+
+
+def test_unlock_on_later_round_nil_polka():
+    """Reference TestStateLockPOLUnlock: a +2/3 NIL polka in a later
+    round unlocks the validator (it precommits nil)."""
+
+    async def go():
+        node, cs, privs = await setup()
+        try:
+            bid = await arrange_round0_proposal(cs, privs)
+            await wait_step(cs, STEP_PREVOTE)
+            others = [p for p in privs if p.address() != privs[0].address()]
+            for p in others[:2]:
+                await cs.add_vote_from_peer(stub_vote(cs, p, PREVOTE_TYPE, bid), "stub")
+            await wait_step(cs, STEP_PRECOMMIT)
+            assert cs.rs.locked_round == 0
+
+            # round 1 via +2/3-any nil prevotes (a nil polka)
+            nil = BlockID()
+            for p in others:
+                await cs.add_vote_from_peer(
+                    stub_vote(cs, p, PREVOTE_TYPE, nil, round_=1), "stub"
+                )
+            for _ in range(500):
+                if cs.rs.round == 1:
+                    break
+                await asyncio.sleep(0.01)
+            assert cs.rs.round == 1
+            # a round-1 proposal lets our node prevote; its own vote event
+            # then sees the nil polka → enterPrecommit → UNLOCK
+            proposer1 = cs.rs.validators.get_proposer()
+            if proposer1.address != privs[0].address():
+                p1 = next(p for p in privs if p.address() == proposer1.address)
+                alt = make_alt_block(cs, node)
+                await inject_proposal(cs, p1, alt, 1)
+            for _ in range(500):
+                if cs.rs.locked_block is None:
+                    break
+                await asyncio.sleep(0.01)
+            assert cs.rs.locked_block is None
+            assert cs.rs.locked_round == -1
+            # and our round-1 precommit is nil
+            pc = cs.rs.votes.precommits(1)
+            our_pc = pc.get_by_address(privs[0].address())
+            assert our_pc is not None and our_pc.is_nil()
+        finally:
+            await node.cs.stop()
+
+    run(go())
+
+
+def test_invalid_proposal_signature_rejected():
+    """A proposal not signed by the round's proposer is refused
+    (reference defaultSetProposal signature check :1614)."""
+
+    async def go():
+        # unequal powers so the OTHER validator is proposer, guaranteed
+        genesis, privs = make_genesis(2, powers=None)
+        # find which priv is NOT the round-0 proposer
+        node = await make_node(genesis, privs[0], config=slow_config())
+        cs = node.cs
+        await cs.start()
+        for _ in range(500):
+            if cs.rs.step >= STEP_PROPOSE:
+                break
+            await asyncio.sleep(0.01)
+        proposer = cs.rs.validators.get_proposer()
+        non_proposer = next(p for p in privs if p.address() != proposer.address)
+        try:
+            # force the signature-check path deterministically: clear any
+            # self-proposal, then inject one signed by the wrong key
+            cs.rs.proposal = None
+            cs.rs.proposal_block = None
+            cs.rs.proposal_block_parts = None
+            from tendermint_tpu.types.block import Commit
+            from tendermint_tpu.types.tx import Txs
+
+            block = cs.state.make_block(
+                cs.rs.height, Txs(),
+                Commit(height=0, round=0, block_id=BlockID(), signatures=[]),
+                [], proposer.address, time_ns=42,
+            )
+            parts = block.make_part_set()
+            prop = Proposal(
+                height=cs.rs.height, round=cs.rs.round, pol_round=-1,
+                block_id=BlockID(block.hash(), parts.header()), timestamp_ns=1,
+            )
+            non_proposer.sign_proposal(CHAIN_ID, prop)  # WRONG signer
+            with pytest.raises(Exception):
+                await cs._default_set_proposal(prop)
+            assert cs.rs.proposal is None
+            # the SAME proposal signed by the real proposer is accepted
+            p_priv = next(p for p in privs if p.address() == proposer.address)
+            p_priv.sign_proposal(CHAIN_ID, prop)
+            await cs._default_set_proposal(prop)
+            assert cs.rs.proposal is not None
+        finally:
+            await node.cs.stop()
+
+    run(go())
