@@ -319,6 +319,16 @@ class Node(Service):
             self.grpc_server = None
         if self.metrics_server is not None:
             await self.metrics_server.start()
+        self.prof_server = None
+        if self.config.base.prof_laddr:
+            from tendermint_tpu.utils.prof import ProfServer
+
+            raw = self.config.base.prof_laddr.replace("tcp://", "")
+            if raw.startswith(":"):
+                raw = "127.0.0.1" + raw
+            host, port = raw.rsplit(":", 1)
+            self.prof_server = ProfServer(host, int(port))
+            await self.prof_server.start()
         self.spawn(self._metrics_pump())
 
         addr = NetAddress.parse(self.config.p2p.laddr)
@@ -368,6 +378,8 @@ class Node(Service):
 
     async def on_stop(self) -> None:
         await self.switch.stop()
+        if getattr(self, "prof_server", None) is not None:
+            await self.prof_server.stop()
         if getattr(self, "grpc_server", None) is not None:
             await self.grpc_server.stop()
         if self.rpc_server is not None:

@@ -1,0 +1,92 @@
+"""Profiling/debug HTTP endpoint — the pprof equivalent.
+
+Reference: node/node.go:719-723 serves net/http/pprof when
+`prof_laddr` is set; `tendermint debug kill` collects goroutine dumps.
+Python equivalents here: /stacks (all thread stacks via faulthandler-
+style traceback dump), /tasks (asyncio task dump — the goroutine-dump
+analog), /gc (object counts), /health.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import gc
+import io
+import sys
+import traceback
+from typing import Optional
+
+
+def dump_thread_stacks() -> str:
+    out = io.StringIO()
+    frames = sys._current_frames()
+    for tid, frame in frames.items():
+        out.write(f"\n--- thread {tid} ---\n")
+        traceback.print_stack(frame, file=out)
+    return out.getvalue()
+
+
+def dump_asyncio_tasks() -> str:
+    out = io.StringIO()
+    try:
+        tasks = asyncio.all_tasks()
+    except RuntimeError:
+        return "no running event loop\n"
+    out.write(f"{len(tasks)} tasks\n")
+    for t in sorted(tasks, key=lambda t: t.get_name()):
+        out.write(f"\n--- task {t.get_name()} done={t.done()} ---\n")
+        stack = t.get_stack(limit=8)
+        for frame in stack:
+            out.write(
+                f"  {frame.f_code.co_filename}:{frame.f_lineno} {frame.f_code.co_name}\n"
+            )
+    return out.getvalue()
+
+
+def dump_gc_stats() -> str:
+    counts = {}
+    for obj in gc.get_objects():
+        name = type(obj).__name__
+        counts[name] = counts.get(name, 0) + 1
+    top = sorted(counts.items(), key=lambda kv: -kv[1])[:40]
+    return "\n".join(f"{n:10d} {name}" for name, n in top) + "\n"
+
+
+class ProfServer:
+    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        self._host, self._port = host, port
+        self._server = None
+        self.bound_port: Optional[int] = None
+
+    async def start(self) -> None:
+        self._server = await asyncio.start_server(self._handle, self._host, self._port)
+        self.bound_port = self._server.sockets[0].getsockname()[1]
+
+    async def stop(self) -> None:
+        if self._server is not None:
+            self._server.close()
+            await self._server.wait_closed()
+
+    async def _handle(self, reader, writer) -> None:
+        try:
+            line = await reader.readline()
+            while (await reader.readline()) not in (b"\r\n", b"\n", b""):
+                pass
+            path = line.split()[1].decode() if len(line.split()) > 1 else "/"
+            if path.startswith("/stacks"):
+                body = dump_thread_stacks()
+            elif path.startswith("/tasks"):
+                body = dump_asyncio_tasks()
+            elif path.startswith("/gc"):
+                body = dump_gc_stats()
+            else:
+                body = "routes: /stacks /tasks /gc\n"
+            data = body.encode()
+            writer.write(
+                b"HTTP/1.1 200 OK\r\nContent-Type: text/plain\r\n"
+                + f"Content-Length: {len(data)}\r\nConnection: close\r\n\r\n".encode()
+                + data
+            )
+            await writer.drain()
+        finally:
+            writer.close()

@@ -1,0 +1,41 @@
+"""Profiling endpoint (pprof-equivalent, node/node.go:719)."""
+
+import asyncio
+import os
+
+from tendermint_tpu.cli import main as cli_main
+from tendermint_tpu.config import load_config
+from tendermint_tpu.node import default_new_node
+
+
+def test_prof_server_routes(tmp_path):
+    async def go():
+        home = str(tmp_path / "p0")
+        cli_main(["--home", home, "init", "--chain-id", "prof-chain"])
+        cfg = load_config(os.path.join(home, "config/config.toml")).set_root(home)
+        cfg.base.db_backend = "memdb"
+        cfg.base.prof_laddr = "127.0.0.1:0"
+        cfg.p2p.laddr = "tcp://127.0.0.1:0"
+        cfg.consensus.timeout_commit_ms = 50
+        cfg.consensus.skip_timeout_commit = True
+        node = default_new_node(cfg)
+        await node.start()
+        try:
+            port = node.prof_server.bound_port
+
+            async def get(path):
+                r, w = await asyncio.open_connection("127.0.0.1", port)
+                w.write(f"GET {path} HTTP/1.1\r\nHost: x\r\n\r\n".encode())
+                await w.drain()
+                raw = await r.read()
+                w.close()
+                return raw.split(b"\r\n\r\n", 1)[1].decode()
+
+            tasks = await get("/tasks")
+            assert "consensus" in tasks or "tasks" in tasks
+            stacks = await get("/stacks")
+            assert "thread" in stacks
+        finally:
+            await node.stop()
+
+    asyncio.run(go())
