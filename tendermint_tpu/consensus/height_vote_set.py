@@ -20,6 +20,10 @@ class ErrGotVoteFromUnwantedRound(Exception):
     """Peer sent a vote for an unwanted round (reference
     GotVoteFromUnwantedRoundError :222)."""
 
+    def __init__(self, msg: str = "", vote: Optional[Vote] = None):
+        super().__init__(msg)
+        self.vote = vote
+
 
 class _RoundVoteSet:
     __slots__ = ("prevotes", "precommits")
@@ -80,36 +84,38 @@ class HeightVoteSet:
         """Add a vote; creates catchup-round sets for peers (max 2 rounds
         per peer, reference :121-132). Raises on invalid votes, returns
         False for unwanted rounds from over-quota peers."""
-        added, err = self.add_votes_batched([vote], peer_id=peer_id)
-        if err is not None:
-            raise err
+        added, errors = self.add_votes_batched([vote], peer_id=peer_id)
+        if errors:
+            raise errors[0]
         return added[0]
 
     def add_votes_batched(
         self, votes: List[Vote], peer_id: str = ""
-    ) -> Tuple[List[bool], Optional[Exception]]:
+    ) -> Tuple[List[bool], List[Exception]]:
         """Batched ingest: group by (round,type) VoteSet, each group drains
-        through one device call (VoteSet.add_votes_batched)."""
+        through one device call (VoteSet.add_votes_batched). ALL hard
+        errors are returned (not just the first) so every conflict in a
+        batch yields evidence."""
         added = [False] * len(votes)
-        first_err: Optional[Exception] = None
+        errors: List[Exception] = []
         groups: Dict[Tuple[int, int], List[Tuple[int, Vote]]] = {}
         for k, vote in enumerate(votes):
             vs = self._vote_set_for(vote, peer_id)
             if vs is None:
-                if first_err is None:
-                    first_err = ErrGotVoteFromUnwantedRound(
-                        f"round {vote.round} from peer {peer_id!r}"
+                errors.append(
+                    ErrGotVoteFromUnwantedRound(
+                        f"round {vote.round} from peer {peer_id!r}", vote=vote
                     )
+                )
                 continue
             groups.setdefault((vote.round, vote.vote_type), []).append((k, vote))
         for (round_, vtype), items in groups.items():
             vs = self._get_vote_set(round_, vtype)
-            flags, err = vs.add_votes_batched([v for _, v in items])
-            if err is not None and first_err is None:
-                first_err = err
+            flags, errs = vs.add_votes_batched([v for _, v in items])
+            errors.extend(errs)
             for (k, _), f in zip(items, flags):
                 added[k] = f
-        return added, first_err
+        return added, errors
 
     def _vote_set_for(self, vote: Vote, peer_id: str) -> Optional[VoteSet]:
         if not (PREVOTE_TYPE == vote.vote_type or PRECOMMIT_TYPE == vote.vote_type):

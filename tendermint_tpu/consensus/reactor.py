@@ -53,6 +53,24 @@ class ConsensusReactor(Reactor):
         self._peer_tasks: Dict[str, list] = {}
         self._gossip_sleep_s = cs.config.peer_gossip_sleep_duration_ms / 1000.0
         self._maj23_sleep_s = cs.config.peer_query_maj23_sleep_duration_ms / 1000.0
+        cs.on_peer_error = self._on_cs_peer_error
+        self._punish_tasks: set = set()
+
+    def _on_cs_peer_error(self, peer_id: str, err: Exception) -> None:
+        """Queued peer messages that fail consensus validation punish the
+        sender (reference Switch.StopPeerForError from reactor paths)."""
+        sw = self.switch
+        if sw is None:
+            return
+        peer = sw.peers.get(peer_id)
+        if peer is None:
+            return
+        # keep a strong reference so the loop can't GC the pending task
+        t = asyncio.get_running_loop().create_task(
+            sw.stop_peer_for_error(peer, f"consensus: {err!r}")
+        )
+        self._punish_tasks.add(t)
+        t.add_done_callback(self._punish_tasks.discard)
 
     def get_channels(self):
         """Reference channel descriptors consensus/reactor.go:131-160."""

@@ -50,13 +50,26 @@ from tendermint_tpu.consensus.round_state import (
     step_name,
 )
 from tendermint_tpu.consensus.wal import WAL, BaseWAL, NilWAL
+from tendermint_tpu.consensus.height_vote_set import ErrGotVoteFromUnwantedRound
 from tendermint_tpu.privval.file import ErrDoubleSign
 from tendermint_tpu.state.state import State as SMState
 from tendermint_tpu.types.block import Block, BlockID, Commit
-from tendermint_tpu.types.part_set import PartSet
+from tendermint_tpu.types.part_set import (
+    ErrPartSetInvalidProof,
+    ErrPartSetUnexpectedIndex,
+    PartSet,
+)
 from tendermint_tpu.types.proposal import Proposal
 from tendermint_tpu.types.vote import Vote
-from tendermint_tpu.types.vote_set import ErrVoteConflictingVotes, VoteSet
+from tendermint_tpu.types.vote_set import (
+    ErrVoteConflictingVotes,
+    ErrVoteInvalidSignature,
+    ErrVoteInvalidValidatorAddress,
+    ErrVoteInvalidValidatorIndex,
+    ErrVoteNonDeterministicSignature,
+    ErrVoteUnexpectedStep,
+    VoteSet,
+)
 from tendermint_tpu.utils import fail
 from tendermint_tpu.utils.events import EventSwitch
 from tendermint_tpu.utils.log import get_logger
@@ -76,6 +89,38 @@ def now_ns() -> int:
 
 class ConsensusError(Exception):
     pass
+
+
+class ErrInvalidProposalSignature(Exception):
+    """Reference ErrInvalidProposalSignature (consensus/state.go:92)."""
+
+
+class ErrInvalidProposalPOLRound(Exception):
+    """Reference ErrInvalidProposalPOLRound (consensus/state.go:93)."""
+
+
+class ErrBlockPartDecode(Exception):
+    """Peer-supplied block parts assembled into undecodable bytes."""
+
+
+# Errors that peer-supplied data can legitimately trigger. These are
+# logged (and the peer punished) but MUST NOT halt consensus — the
+# reference's handleMsg/tryAddVote log-and-continue on them
+# (consensus/state.go:690-744), reserving the halt for internal
+# invariant violations.
+PEER_MSG_ERRORS = (
+    ErrInvalidProposalSignature,
+    ErrInvalidProposalPOLRound,
+    ErrBlockPartDecode,
+    ErrPartSetInvalidProof,
+    ErrPartSetUnexpectedIndex,
+    ErrVoteInvalidSignature,
+    ErrVoteInvalidValidatorAddress,
+    ErrVoteInvalidValidatorIndex,
+    ErrVoteNonDeterministicSignature,
+    ErrVoteUnexpectedStep,
+    ErrGotVoteFromUnwantedRound,
+)
 
 
 class TimeoutTicker:
@@ -155,6 +200,9 @@ class ConsensusState(Service):
         self.decide_proposal = self._default_decide_proposal
         self.do_prevote = self._default_do_prevote
         self.set_proposal = self._default_set_proposal
+        # reactor-installed callback: (peer_id, err) -> None, used to
+        # punish peers whose queued messages fail validation
+        self.on_peer_error = None
 
         self.update_to_state(state)
         self._reconstruct_last_commit_if_needed(state)
@@ -210,9 +258,19 @@ class ConsensusState(Service):
             remaining_ms = max((self.rs.start_time_ns - now_ns()) // 1_000_000 + 1, 0)
             self._schedule_timeout(remaining_ms, self.rs.height, 0, STEP_NEW_ROUND)
         elif self.rs.step == STEP_NEW_ROUND:
-            asyncio.get_running_loop().create_task(
-                self._enter_propose(self.rs.height, 0)
-            )
+            # Enqueue a zero-duration timeout so the enter_propose
+            # transition runs on the receive routine (WAL-ordered,
+            # serialized) — the reference runs handleTxsAvailable inside
+            # receiveRoutine; a detached task could interleave with it
+            # at await points.
+            ti = TimeoutInfo(0, self.rs.height, 0, STEP_NEW_ROUND)
+            try:
+                self._queue.put_nowait(ti)
+            except asyncio.QueueFull:
+                # queue saturated (vote storm): deliver asynchronously so
+                # the notification is never lost and the caller's loop
+                # never sees the exception
+                self.spawn(self._queue.put(ti))
 
     async def wait_for_height(self, height: int, timeout_s: float = 30.0) -> None:
         """Test/tooling helper: block until a height is committed."""
@@ -327,9 +385,9 @@ class ConsensusState(Service):
                     signature=cs_sig.signature,
                 )
             )
-        added, err = vs.add_votes_batched(votes)
-        if err is not None or not vs.has_two_thirds_majority():
-            raise ConsensusError(f"failed to reconstruct LastCommit: {err}")
+        added, errs = vs.add_votes_batched(votes)
+        if errs or not vs.has_two_thirds_majority():
+            raise ConsensusError(f"failed to reconstruct LastCommit: {errs}")
         self.rs.last_commit = vs
 
     def _new_step(self) -> None:
@@ -421,16 +479,35 @@ class ConsensusState(Service):
 
     async def _handle_msg(self, mi: MsgInfo) -> None:
         msg, peer_id = mi.msg, mi.peer_id
-        if isinstance(msg, ProposalMessage):
-            await self.set_proposal(msg.proposal)
-        elif isinstance(msg, BlockPartMessage):
-            added = await self._add_proposal_block_part(msg, peer_id)
-            if added:
-                self.evsw.fire_event(EVENT_HAS_VOTE, None)  # wake gossip (block part)
-        elif isinstance(msg, VoteMessage):
-            await self._try_add_vote(msg.vote, peer_id)
-        else:
-            self.logger.error("unknown msg type", type=type(msg).__name__)
+        try:
+            if isinstance(msg, ProposalMessage):
+                await self.set_proposal(msg.proposal)
+            elif isinstance(msg, BlockPartMessage):
+                added = await self._add_proposal_block_part(msg, peer_id)
+                if added:
+                    self.evsw.fire_event(EVENT_HAS_VOTE, None)  # wake gossip (block part)
+            elif isinstance(msg, VoteMessage):
+                await self._try_add_vote(msg.vote, peer_id)
+            else:
+                self.logger.error("unknown msg type", type=type(msg).__name__)
+        except PEER_MSG_ERRORS as e:
+            if not peer_id:
+                # Our own message failing validation is an internal
+                # invariant violation — halt (reference panics on
+                # conflicting own-votes, state.go:1726).
+                raise
+            self.logger.error(
+                "failed to process peer message",
+                peer=peer_id, msg_type=type(msg).__name__, err=repr(e),
+            )
+            self._punish_peer(peer_id, e)
+
+    def _punish_peer(self, peer_id: str, err: Exception) -> None:
+        if peer_id and self.on_peer_error is not None:
+            try:
+                self.on_peer_error(peer_id, err)
+            except Exception as e:
+                self.logger.error("on_peer_error callback failed", err=repr(e))
 
     async def _handle_vote_batch(self, batch) -> None:
         """Bulk vote ingest: verify all current-height votes in one
@@ -459,9 +536,24 @@ class ConsensusState(Service):
             if rs.votes._get_vote_set(round_, vtype) is None:
                 other.extend(mis)
                 continue
-            added, err = rs.votes.add_votes_batched(votes)
-            if err is not None and isinstance(err, ErrVoteConflictingVotes):
-                await self._handle_vote_conflict(err, votes[0])
+            added, errs = rs.votes.add_votes_batched(votes)
+            for err in errs:
+                if isinstance(err, ErrVoteConflictingVotes):
+                    await self._handle_vote_conflict(err)
+                elif isinstance(err, PEER_MSG_ERRORS):
+                    # attribute the bad vote back to its sender if we can
+                    bad = getattr(err, "vote", None)
+                    peer = next(
+                        (mi.peer_id for mi in mis if bad is not None and mi.msg.vote is bad),
+                        "",
+                    )
+                    self.logger.error(
+                        "bad vote in batch", peer=peer or "?", err=repr(err)
+                    )
+                    if peer:
+                        self._punish_peer(peer, err)
+                else:
+                    self.logger.error("vote batch error", err=repr(err))
             any_added = False
             for mi, ok in zip(mis, added):
                 if not ok:
@@ -479,19 +571,27 @@ class ConsensusState(Service):
                     await self._on_precommit_added(probe)
 
         for mi in other:
-            await self._try_add_vote(mi.msg.vote, mi.peer_id)
+            # route through _handle_msg so the PEER_MSG_ERRORS guard
+            # applies to the serial fallback too (lastCommit votes,
+            # unknown-round votes from over-quota peers, ...)
+            await self._handle_msg(mi)
 
-    async def _handle_vote_conflict(self, e, vote) -> None:
-        """Shared conflict→evidence path (reference tryAddVote :1706)."""
-        if self._priv_validator_addr == vote.validator_address:
+    async def _handle_vote_conflict(self, e: ErrVoteConflictingVotes) -> None:
+        """Shared conflict→evidence path (reference tryAddVote :1706).
+        The offending validator is identified from the conflicting votes
+        themselves (vote_a/vote_b are from the same validator by
+        construction), never from an unrelated probe vote."""
+        offender = e.vote_a.validator_address
+        if self._priv_validator_addr == offender:
             self.logger.error(
-                "found conflicting vote from ourselves", vote=repr(vote)
+                "found conflicting vote from ourselves; did you restart without the privval state file?",
+                vote=repr(e.vote_b),
             )
             return
         if self._evpool is not None:
             from tendermint_tpu.types.evidence import DuplicateVoteEvidence
 
-            _, val = self.rs.validators.get_by_address(e.vote_a.validator_address)
+            _, val = self.rs.validators.get_by_address(offender)
             if val is None:
                 return
             ev = DuplicateVoteEvidence(
@@ -499,6 +599,9 @@ class ConsensusState(Service):
             )
             try:
                 self._evpool.add_evidence(ev)
+                self.logger.info(
+                    "found and sent conflicting vote to evidence pool", ev=repr(ev)
+                )
             except Exception as ee:
                 self.logger.error("failed to add evidence", err=str(ee))
 
@@ -913,12 +1016,14 @@ class ConsensusState(Service):
         if proposal.pol_round < -1 or (
             proposal.pol_round >= 0 and proposal.pol_round >= proposal.round
         ):
-            raise ConsensusError("invalid POLRound in proposal")
+            raise ErrInvalidProposalPOLRound(
+                f"POLRound {proposal.pol_round} round {proposal.round}"
+            )
         proposer = rs.validators.get_proposer()
         if not proposer.pub_key.verify(
             proposal.sign_bytes(self.state.chain_id), proposal.signature
         ):
-            raise ConsensusError("invalid proposal signature")
+            raise ErrInvalidProposalSignature(repr(proposal))
         rs.proposal = proposal
         if rs.proposal_block_parts is None:
             rs.proposal_block_parts = PartSet.new_from_header(proposal.block_id.parts)
@@ -933,7 +1038,10 @@ class ConsensusState(Service):
             return False  # no proposal yet; reference ignores too
         added = rs.proposal_block_parts.add_part(msg.part)
         if added and rs.proposal_block_parts.is_complete():
-            rs.proposal_block = Block.decode(rs.proposal_block_parts.assemble())
+            try:
+                rs.proposal_block = Block.decode(rs.proposal_block_parts.assemble())
+            except Exception as e:
+                raise ErrBlockPartDecode(repr(e)) from e
             self.logger.info(
                 "received complete proposal block",
                 height=rs.proposal_block.header.height,
@@ -968,24 +1076,7 @@ class ConsensusState(Service):
         try:
             return await self._add_vote(vote, peer_id)
         except ErrVoteConflictingVotes as e:
-            if self._priv_validator_addr == vote.validator_address:
-                self.logger.error(
-                    "found conflicting vote from ourselves; did you restart without the privval state file?",
-                    vote=repr(vote),
-                )
-                return False
-            if self._evpool is not None:
-                from tendermint_tpu.types.evidence import DuplicateVoteEvidence
-
-                _, val = self.rs.validators.get_by_address(vote.validator_address)
-                ev = DuplicateVoteEvidence(
-                    pub_key=val.pub_key, vote_a=e.vote_a, vote_b=e.vote_b
-                )
-                try:
-                    self._evpool.add_evidence(ev)
-                    self.logger.info("found and sent conflicting vote to evidence pool", ev=repr(ev))
-                except Exception as ee:
-                    self.logger.error("failed to add evidence", err=str(ee))
+            await self._handle_vote_conflict(e)
             return False
 
     async def _add_vote(self, vote: Vote, peer_id: str) -> bool:

@@ -24,23 +24,32 @@ from tendermint_tpu.utils.bits import BitArray
 MAX_VOTES_COUNT = 10000
 
 
-class ErrVoteUnexpectedStep(Exception):
+class VoteError(Exception):
+    """Base for per-vote ingest errors; carries the offending vote so a
+    batched ingest can attribute each failure back to its sender."""
+
+    def __init__(self, msg: str = "", vote: Optional["Vote"] = None):
+        super().__init__(msg)
+        self.vote = vote
+
+
+class ErrVoteUnexpectedStep(VoteError):
     pass
 
 
-class ErrVoteInvalidValidatorIndex(Exception):
+class ErrVoteInvalidValidatorIndex(VoteError):
     pass
 
 
-class ErrVoteInvalidValidatorAddress(Exception):
+class ErrVoteInvalidValidatorAddress(VoteError):
     pass
 
 
-class ErrVoteInvalidSignature(Exception):
+class ErrVoteInvalidSignature(VoteError):
     pass
 
 
-class ErrVoteNonDeterministicSignature(Exception):
+class ErrVoteNonDeterministicSignature(VoteError):
     pass
 
 
@@ -163,35 +172,37 @@ class VoteSet:
         votes (reference AddVote :142). Verification goes through the
         provider as a batch of one so the device path is exercised
         uniformly; use add_votes_batched for bulk ingest."""
-        added, err = self._add_votes([vote])  # type: ignore[list-item]
-        if err is not None:
-            raise err
+        added, errors = self._add_votes([vote])  # type: ignore[list-item]
+        if errors:
+            raise errors[0]
         return added[0]
 
-    def add_votes_batched(self, votes: Sequence[Vote]) -> Tuple[List[bool], Optional[Exception]]:
+    def add_votes_batched(self, votes: Sequence[Vote]) -> Tuple[List[bool], List[Exception]]:
         """Batched ingest: validate/dedup on host, verify ALL signatures
         in one device call, then apply in order. Returns per-vote added
-        flags and the first hard error (conflicting votes etc.)."""
+        flags and ALL hard errors — every ErrVoteConflictingVotes in the
+        batch is reported independently so equivocation can't hide behind
+        an earlier unrelated error."""
         return self._add_votes(list(votes))
 
-    def _add_votes(self, votes: List[Vote]) -> Tuple[List[bool], Optional[Exception]]:
+    def _add_votes(self, votes: List[Vote]) -> Tuple[List[bool], List[Exception]]:
         added = [False] * len(votes)
         # Phase 1: host-side validation; collect rows needing verification.
         rows: List[int] = []  # index into `votes`
         pks: List[bytes] = []
         msgs: List[bytes] = []
         sigs: List[bytes] = []
-        first_err: Optional[Exception] = None
+        errors: List[Exception] = []
 
         prepared: List[Optional[Tuple[Vote, int]]] = [None] * len(votes)
         for k, vote in enumerate(votes):
             if vote is None:
-                first_err = first_err or ValueError("nil vote")
+                errors.append(ValueError("nil vote"))
                 continue
             err = self._check_vote(vote)
             if err is not None:
-                if not isinstance(err, _BenignDuplicate) and first_err is None:
-                    first_err = err
+                if not isinstance(err, _BenignDuplicate):
+                    errors.append(err)
                 continue
             _, val = self.val_set.get_by_index(vote.validator_index)
             prepared[k] = (vote, val.voting_power)
@@ -212,23 +223,22 @@ class VoteSet:
         for r, k in enumerate(rows):
             vote, power = prepared[k]  # type: ignore[misc]
             if not ok[r]:
-                if first_err is None:
-                    first_err = ErrVoteInvalidSignature(repr(vote))
+                errors.append(ErrVoteInvalidSignature(repr(vote), vote=vote))
                 continue
             conflict = self._add_verified_vote(vote, power)
             if conflict is not None:
-                if not isinstance(conflict, _BenignDuplicate) and first_err is None:
-                    first_err = conflict
+                if not isinstance(conflict, _BenignDuplicate):
+                    errors.append(conflict)
                 continue
             added[k] = True
-        return added, first_err
+        return added, errors
 
     def _check_vote(self, vote: Vote) -> Optional[Exception]:
         """Host-side pre-checks (index, address, H/R/type, duplicates)."""
         if vote.validator_index < 0:
-            return ErrVoteInvalidValidatorIndex("index < 0")
+            return ErrVoteInvalidValidatorIndex("index < 0", vote=vote)
         if not vote.signature:
-            return ValueError("vote has no signature")
+            return ErrVoteInvalidSignature("vote has no signature", vote=vote)
         if (
             vote.height != self.height
             or vote.round != self.round
@@ -236,13 +246,14 @@ class VoteSet:
         ):
             return ErrVoteUnexpectedStep(
                 f"expected {self.height}/{self.round}/{self.signed_msg_type}, "
-                f"got {vote.height}/{vote.round}/{vote.vote_type}"
+                f"got {vote.height}/{vote.round}/{vote.vote_type}",
+                vote=vote,
             )
         addr, val = self.val_set.get_by_index(vote.validator_index)
         if val is None:
-            return ErrVoteInvalidValidatorIndex(str(vote.validator_index))
+            return ErrVoteInvalidValidatorIndex(str(vote.validator_index), vote=vote)
         if addr != vote.validator_address:
-            return ErrVoteInvalidValidatorAddress(vote.validator_address.hex())
+            return ErrVoteInvalidValidatorAddress(vote.validator_address.hex(), vote=vote)
         # Already have an identical vote? Check both the canonical slot and
         # the per-block tracking (a conflicting vote routed through the
         # SetPeerMaj23 path lives only in votes_by_block -- reference

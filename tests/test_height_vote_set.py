@@ -93,8 +93,8 @@ def test_batched_ingest_groups_rounds_and_types():
         + [vote(privs[i], i, 1) for i in range(3)]
         + [vote(privs[i], i, 0, vtype=PRECOMMIT_TYPE) for i in range(3)]
     )
-    added, err = hvs.add_votes_batched(votes)
-    assert err is None and all(added)
+    added, errs = hvs.add_votes_batched(votes)
+    assert not errs and all(added)
     assert hvs.prevotes(0).has_two_thirds_majority()
     assert hvs.prevotes(1).has_two_thirds_majority()
     assert hvs.precommits(0).has_two_thirds_majority()

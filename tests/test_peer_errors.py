@@ -1,0 +1,125 @@
+"""Peer-supplied garbage must never halt consensus.
+
+Reference posture: handleMsg/tryAddVote log per-message errors and
+continue (consensus/state.go:690-744); the halt is reserved for internal
+invariant violations. One malicious peer sending byte-flipped
+votes/proposals must not kill the node (round-1 advisor finding, high).
+"""
+
+import asyncio
+
+from tendermint_tpu.codec.signbytes import PREVOTE_TYPE
+from tendermint_tpu.consensus.messages import ProposalMessage, VoteMessage
+from tendermint_tpu.types.block import BlockID
+from tendermint_tpu.types.proposal import Proposal
+from tendermint_tpu.types.vote import Vote
+from tendermint_tpu.types.vote_set import ErrVoteConflictingVotes
+
+from tests.cs_harness import make_genesis, make_node, start_network, stop_network
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+async def _stalled_node():
+    """Node 0 of a 4-validator set, started alone: no quorum, so the
+    chain stalls at height 1 and rs.height is stable for injection."""
+    genesis, privs = make_genesis(4)
+    node = await make_node(genesis, privs[0])
+    await node.cs.start()
+    return node, privs
+
+
+def test_bad_vote_signature_from_peer_is_nonfatal():
+    async def go():
+        node, privs = await _stalled_node()
+        try:
+            cs = node.cs
+            punished = []
+            cs.on_peer_error = lambda pid, err: punished.append((pid, err))
+
+            # a vote with valid index/address but garbage signature
+            idx, val = cs.rs.validators.get_by_address(privs[1].address())
+            bad = Vote(
+                vote_type=PREVOTE_TYPE,
+                height=cs.rs.height,
+                round=cs.rs.round,
+                block_id=BlockID(),
+                timestamp_ns=1,
+                validator_address=privs[1].address(),
+                validator_index=idx if isinstance(idx, int) else idx,
+                signature=bytes(64),
+            )
+            await cs.add_peer_message(VoteMessage(bad), "evil-peer")
+            await asyncio.sleep(0.2)
+
+            # receive routine is alive: a valid internal input still works
+            assert cs.is_running
+            assert punished and punished[0][0] == "evil-peer"
+            # the bad vote was not tallied
+            pv = cs.rs.votes.prevotes(cs.rs.round)
+            assert pv is None or pv.sum == 0 or not pv.bit_array().get_index(idx)
+        finally:
+            await node.cs.stop()
+
+    run(go())
+
+
+def test_bad_proposal_signature_from_peer_is_nonfatal():
+    async def go():
+        node, privs = await _stalled_node()
+        try:
+            cs = node.cs
+            punished = []
+            cs.on_peer_error = lambda pid, err: punished.append((pid, err))
+            # wait until the round has entered propose so set_proposal runs
+            for _ in range(200):
+                if cs.rs.step >= 1:
+                    break
+                await asyncio.sleep(0.05)
+
+            prop = Proposal(
+                height=cs.rs.height,
+                round=cs.rs.round,
+                pol_round=-1,
+                block_id=BlockID(hash=b"\x01" * 32),
+                timestamp_ns=1,
+                signature=bytes(64),
+            )
+            await cs.add_peer_message(ProposalMessage(prop), "evil-peer")
+            await asyncio.sleep(0.2)
+            assert cs.is_running
+            # proposal may be ignored (wrong round) or rejected (bad sig);
+            # if it reached signature verification the peer was punished
+            if cs.rs.round == prop.round and cs.rs.proposal is None:
+                assert punished
+        finally:
+            await node.cs.stop()
+
+    run(go())
+
+
+def test_multiple_conflicts_in_one_batch_all_reported():
+    """Every equivocation in a batch yields its own conflict error
+    (round-1 advisor finding: conflicts after an earlier error were
+    masked)."""
+    from tests.test_vote_set import BID, setup_voteset, signed_vote
+
+    voteset, _, privs = setup_voteset(7)
+    other = BlockID(hash=b"\x07" * 32)
+
+    first = [signed_vote(privs[i], i, BID) for i in range(4)]
+    added, errs = voteset.add_votes_batched(first)
+    assert all(added) and not errs
+
+    # batch: one invalid signature + two equivocations
+    batch = [signed_vote(privs[4], 4, BID)]
+    batch[0].signature = bytes(64)
+    batch.append(signed_vote(privs[0], 0, other, ts=2))
+    batch.append(signed_vote(privs[1], 1, other, ts=2))
+    added, errs = voteset.add_votes_batched(batch)
+    conflicts = [e for e in errs if isinstance(e, ErrVoteConflictingVotes)]
+    assert len(conflicts) == 2
+    offenders = {c.vote_a.validator_address for c in conflicts}
+    assert offenders == {privs[0].pub_key().address(), privs[1].pub_key().address()}

@@ -108,8 +108,8 @@ def test_batched_ingest_matches_serial():
     for v in votes:
         voteset_a.add_vote(v)
     # batched
-    added, err = voteset_b.add_votes_batched(votes)
-    assert all(added) and err is None
+    added, errs = voteset_b.add_votes_batched(votes)
+    assert all(added) and not errs
     assert voteset_a.sum == voteset_b.sum
     assert voteset_a.maj23 == voteset_b.maj23
     assert voteset_a.bit_array() == voteset_b.bit_array()
@@ -119,9 +119,9 @@ def test_batched_ingest_flags_bad_rows():
     voteset, _, privs = setup_voteset(5)
     votes = [signed_vote(privs[i], i, BID) for i in range(5)]
     votes[2].signature = bytes(64)
-    added, err = voteset.add_votes_batched(votes)
+    added, errs = voteset.add_votes_batched(votes)
     assert added == [True, True, False, True, True]
-    assert err is not None
+    assert errs
     assert voteset.sum == 4
 
 
