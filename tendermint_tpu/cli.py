@@ -230,8 +230,16 @@ def cmd_light(args) -> None:
     """Reference cmd/tendermint/commands/lite.go: verifying RPC proxy."""
 
     async def run() -> None:
+        from tendermint_tpu.crypto.batch import make_provider, set_default_provider
         from tendermint_tpu.db.memdb import MemDB
         from tendermint_tpu.light import LightClient, TrustOptions
+
+        # the light client's entire job is commit verification — select
+        # the batched device provider (non-blocking compile discipline)
+        provider = make_provider(args.crypto_provider, block_on_compile=False)
+        set_default_provider(provider)
+        if hasattr(provider, "warmup"):
+            provider.warmup(background=True)
         from tendermint_tpu.light.provider import HTTPProvider
         from tendermint_tpu.light.proxy import VerifyingClient
         from tendermint_tpu.light.proxy_server import make_light_proxy_server
@@ -352,6 +360,10 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--trusted-hash", default="", help="hex hash at trusted height (default: fetch)")
     sp.add_argument("--laddr", default="tcp://127.0.0.1:8888")
     sp.add_argument("--trust-period-hours", type=int, default=168)
+    sp.add_argument(
+        "--crypto-provider", default="tpu", choices=("tpu", "cpu"),
+        help="batch verifier backend for header verification",
+    )
     sp.set_defaults(func=cmd_light)
 
     sp = sub.add_parser("replay", help="replay the consensus WAL through a fresh state machine")

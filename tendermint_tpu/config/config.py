@@ -355,6 +355,9 @@ def test_config() -> Config:
     cfg.base.proxy_app = "kvstore"
     cfg.base.fast_sync = False
     cfg.base.db_backend = "memdb"
+    # cpu: in-process test nets must not pay XLA compiles; the TPU
+    # provider path has its own dedicated integration test
+    cfg.base.crypto_provider = "cpu"
     cfg.rpc.laddr = "tcp://127.0.0.1:0"
     cfg.p2p.laddr = "tcp://127.0.0.1:0"
     cfg.p2p.allow_duplicate_ip = True
@@ -442,6 +445,11 @@ def load_config(path: str) -> Config:
     for attr, header in _SECTIONS:
         if header in raw:
             _apply(getattr(cfg, attr), raw[header])
+    # Ops override: force the crypto provider without editing config.toml
+    # (used by CI/test rigs to pin "cpu"; mirrors 12-factor env config).
+    env_provider = os.environ.get("TM_CRYPTO_PROVIDER")
+    if env_provider:
+        cfg.base.crypto_provider = env_provider
     return cfg
 
 

@@ -104,10 +104,9 @@ class ErrBlockPartDecode(Exception):
 
 
 # Errors that peer-supplied data can legitimately trigger. These are
-# logged (and the peer punished) but MUST NOT halt consensus — the
-# reference's handleMsg/tryAddVote log-and-continue on them
-# (consensus/state.go:690-744), reserving the halt for internal
-# invariant violations.
+# logged but MUST NOT halt consensus — the reference's
+# handleMsg/tryAddVote log-and-continue on them (consensus/state.go:
+# 690-744), reserving the halt for internal invariant violations.
 PEER_MSG_ERRORS = (
     ErrInvalidProposalSignature,
     ErrInvalidProposalPOLRound,
@@ -120,6 +119,16 @@ PEER_MSG_ERRORS = (
     ErrVoteNonDeterministicSignature,
     ErrVoteUnexpectedStep,
     ErrGotVoteFromUnwantedRound,
+)
+
+# The subset that is unambiguous forgery (cannot arise from benign
+# gossip races like a vote for the height we just left) — only these
+# disconnect the sender. Out-of-sync errors are logged at debug.
+PEER_PUNISH_ERRORS = (
+    ErrInvalidProposalSignature,
+    ErrBlockPartDecode,
+    ErrPartSetInvalidProof,
+    ErrVoteInvalidSignature,
 )
 
 
@@ -496,11 +505,17 @@ class ConsensusState(Service):
                 # invariant violation — halt (reference panics on
                 # conflicting own-votes, state.go:1726).
                 raise
-            self.logger.error(
-                "failed to process peer message",
-                peer=peer_id, msg_type=type(msg).__name__, err=repr(e),
-            )
-            self._punish_peer(peer_id, e)
+            if isinstance(e, PEER_PUNISH_ERRORS):
+                self.logger.error(
+                    "failed to process peer message; punishing peer",
+                    peer=peer_id, msg_type=type(msg).__name__, err=repr(e),
+                )
+                self._punish_peer(peer_id, e)
+            else:
+                self.logger.debug(
+                    "ignoring out-of-sync peer message",
+                    peer=peer_id, msg_type=type(msg).__name__, err=repr(e),
+                )
 
     def _punish_peer(self, peer_id: str, err: Exception) -> None:
         if peer_id and self.on_peer_error is not None:
@@ -529,7 +544,14 @@ class ConsensusState(Service):
         for mi in current:
             groups.setdefault((mi.msg.vote.round, mi.msg.vote.vote_type), []).append(mi)
 
+        batch_height = rs.height
         for (round_, vtype), mis in groups.items():
+            # A commit inside an earlier group advances rs.height; votes
+            # grouped against the old height are now stale — route them
+            # through the per-vote path, which drops them benignly.
+            if rs.height != batch_height:
+                other.extend(mis)
+                continue
             votes = [mi.msg.vote for mi in mis]
             # route through per-peer add for catchup-quota enforcement
             # only when the round set doesn't exist yet
@@ -540,20 +562,25 @@ class ConsensusState(Service):
             for err in errs:
                 if isinstance(err, ErrVoteConflictingVotes):
                     await self._handle_vote_conflict(err)
-                elif isinstance(err, PEER_MSG_ERRORS):
-                    # attribute the bad vote back to its sender if we can
-                    bad = getattr(err, "vote", None)
-                    peer = next(
-                        (mi.peer_id for mi in mis if bad is not None and mi.msg.vote is bad),
-                        "",
-                    )
+                    continue
+                # attribute the bad vote back to its sender if we can
+                bad = getattr(err, "vote", None)
+                src = next(
+                    (mi for mi in mis if bad is not None and mi.msg.vote is bad), None
+                )
+                if src is not None and not src.peer_id:
+                    # our OWN vote failing validation is an internal
+                    # invariant violation — same halt as _handle_msg
+                    raise err
+                if isinstance(err, PEER_PUNISH_ERRORS):
+                    peer = src.peer_id if src is not None else ""
                     self.logger.error(
                         "bad vote in batch", peer=peer or "?", err=repr(err)
                     )
                     if peer:
                         self._punish_peer(peer, err)
                 else:
-                    self.logger.error("vote batch error", err=repr(err))
+                    self.logger.debug("out-of-sync vote in batch", err=repr(err))
             any_added = False
             for mi, ok in zip(mis, added):
                 if not ok:

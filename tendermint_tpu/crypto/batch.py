@@ -88,19 +88,40 @@ class CPUBatchVerifier(BatchVerifier):
 
 
 class TPUBatchVerifier(BatchVerifier):
-    """Batched JAX ed25519 + fused tally on the accelerator."""
+    """Batched JAX ed25519 + fused tally on the accelerator.
+
+    ``block_on_compile=False`` (the live-node setting) keeps consensus
+    latency-safe: a cold batch bucket is verified on host while a
+    background thread compiles the device program; warm buckets run on
+    device. ``min_device_batch`` routes tiny batches (below the device
+    dispatch break-even) to the host verifier."""
 
     name = "tpu"
 
-    def __init__(self, mesh=None):
+    def __init__(self, mesh=None, block_on_compile: bool = True, min_device_batch: int = 2):
         from tendermint_tpu.models import verifier as _verifier_model
 
-        self._model = _verifier_model.VerifierModel(mesh=mesh)
+        self._model = _verifier_model.VerifierModel(
+            mesh=mesh, block_on_compile=block_on_compile
+        )
+        self._cpu = CPUBatchVerifier()
+        self.min_device_batch = min_device_batch
+
+    @property
+    def model(self):
+        return self._model
+
+    def warmup(self, sizes=(16, 1024), msg_len: int = 160, background: bool = False):
+        return self._model.warmup(sizes=sizes, msg_len=msg_len, background=background)
 
     def verify_batch(self, pubkeys, msgs, sigs, msg_lens=None) -> np.ndarray:
+        if len(pubkeys) < self.min_device_batch:
+            return self._cpu.verify_batch(pubkeys, msgs, sigs, msg_lens=msg_lens)
         return self._model.verify(pubkeys, msgs, sigs, msg_lens=msg_lens)
 
     def verify_commit_batch(self, pubkeys, msgs, sigs, powers, counted):
+        if len(pubkeys) < self.min_device_batch:
+            return self._cpu.verify_commit_batch(pubkeys, msgs, sigs, powers, counted)
         return self._model.verify_commit(pubkeys, msgs, sigs, powers, counted)
 
 
@@ -122,11 +143,11 @@ def set_default_provider(v: BatchVerifier) -> None:
         _default = v
 
 
-def make_provider(name: str, mesh=None) -> BatchVerifier:
+def make_provider(name: str, mesh=None, block_on_compile: bool = True) -> BatchVerifier:
     if name == "cpu":
         return CPUBatchVerifier()
     if name == "tpu":
-        return TPUBatchVerifier(mesh=mesh)
+        return TPUBatchVerifier(mesh=mesh, block_on_compile=block_on_compile)
     raise ValueError(f"unknown crypto provider {name!r}")
 
 

@@ -6,13 +6,27 @@ re-used; batch sizes are padded up to bucket boundaries so a live
 validator set of any size hits a warm executable. Padding rows carry an
 always-invalid signature and zero voting power, so they can't affect
 results.
+
+Two compile disciplines:
+
+- ``block_on_compile=True`` (bench/tests): the first call per bucket
+  pays the compile inline.
+- ``block_on_compile=False`` (live node): a cold bucket falls back to
+  the host verifier for THIS call while a background thread compiles
+  the device program; subsequent calls hit the warm executable.
+  Consensus never stalls on XLA.
+
+Multi-chip: the mesh path uses ``shard_map`` so the per-device program
+is exactly the single-device program (compile cost does not scale with
+mesh size, unlike whole-graph GSPMD partitioning); the fused tally is a
+``psum`` over the batch axis riding ICI.
 """
 
 from __future__ import annotations
 
-import functools
 import os
 import threading
+import time
 from typing import Dict, Optional, Tuple
 
 import numpy as np
@@ -25,11 +39,24 @@ os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "1")
 import jax  # noqa: E402
 import jax.numpy as jnp  # noqa: E402
 
-from tendermint_tpu.ops import ed25519 as ops_ed  # noqa: E402
-from tendermint_tpu.parallel import batch_sharding, pad_to_multiple, replicated_sharding  # noqa: E402
+# The env vars above only apply if jax was first imported after they
+# were set; this environment's sitecustomize imports jax at interpreter
+# start, so set the config explicitly too (idempotent).
+if jax.config.jax_compilation_cache_dir is None:
+    jax.config.update(
+        "jax_compilation_cache_dir", os.environ["JAX_COMPILATION_CACHE_DIR"]
+    )
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
 
-# Batch-size buckets (padded row counts) to bound recompilation.
-_BUCKETS = [16, 64, 256, 1024, 4096, 16384]
+from tendermint_tpu.ops import ed25519 as ops_ed  # noqa: E402
+from tendermint_tpu.parallel import pad_to_multiple  # noqa: E402
+from tendermint_tpu.parallel.mesh import BATCH_AXIS  # noqa: E402
+from tendermint_tpu.utils.log import get_logger  # noqa: E402
+
+# Batch-size buckets (padded row counts) to bound recompilation. 10240
+# sits just above MaxVotesCount (types/vote_set.py) so a full 10k-
+# validator commit pads by 2.4%, not 64%.
+_BUCKETS = [16, 64, 256, 1024, 4096, 10240, 16384]
 
 
 def _bucket(n: int, multiple: int) -> int:
@@ -39,51 +66,136 @@ def _bucket(n: int, multiple: int) -> int:
     return pad_to_multiple(n, max(multiple, 16384))
 
 
+class _Entry:
+    __slots__ = ("fn", "ready", "compiling", "compile_s")
+
+    def __init__(self, fn):
+        self.fn = fn
+        self.ready = False
+        self.compiling = False
+        self.compile_s: Optional[float] = None
+
+
 class VerifierModel:
-    def __init__(self, mesh=None):
+    def __init__(self, mesh=None, block_on_compile: bool = True, logger=None):
         self.mesh = mesh
+        self.block_on_compile = block_on_compile
+        self.logger = logger or get_logger("verifier")
         self._lock = threading.Lock()
-        self._verify_fns: Dict[Tuple[int, int], object] = {}
-        self._tally_fns: Dict[Tuple[int, int], object] = {}
+        self._entries: Dict[Tuple[str, int, int], _Entry] = {}
 
     # -- compiled function cache ------------------------------------------
 
-    def _get_verify(self, n_pad: int, msg_len: int):
-        key = (n_pad, msg_len)
-        with self._lock:
-            fn = self._verify_fns.get(key)
-            if fn is None:
-                fn = self._compile_verify(msg_len)
-                self._verify_fns[key] = fn
-            return fn
+    def _shard_specs(self):
+        from jax.sharding import PartitionSpec as P
 
-    def _compile_verify(self, msg_len: int):
-        if self.mesh is not None:
-            shard = batch_sharding(self.mesh)
-            return jax.jit(
+        return P(BATCH_AXIS), P()
+
+    def _build(self, kind: str):
+        """Build the (lazily compiled) jitted callable for `kind`."""
+        if self.mesh is None:
+            if kind == "verify":
+                return jax.jit(ops_ed.verify_core)
+            return jax.jit(ops_ed.verify_and_tally)
+
+        # Mesh path: shard_map keeps the per-device program identical to
+        # the single-device one — compile time is O(1) in mesh size and
+        # XLA inserts exactly one psum (over ICI) for the tally.
+        batch, rep = self._shard_specs()
+        if kind == "verify":
+            mapped = jax.shard_map(
                 ops_ed.verify_core,
-                in_shardings=(shard, shard, shard),
-                out_shardings=shard,
+                mesh=self.mesh,
+                in_specs=(batch, batch, batch),
+                out_specs=batch,
+                check_vma=False,
             )
-        return jax.jit(ops_ed.verify_core)
+            return jax.jit(mapped)
 
-    def _get_tally(self, n_pad: int, msg_len: int):
-        key = (n_pad, msg_len)
+        def tally_core(pk, mg, sg, chunks, counted):
+            ok = ops_ed.verify_core(pk, mg, sg)
+            mask = (ok & counted).astype(jnp.int32)
+            local = jnp.sum(chunks * mask[:, None], axis=0)
+            total = jax.lax.psum(local, BATCH_AXIS)
+            return ok, total
+
+        mapped = jax.shard_map(
+            tally_core,
+            mesh=self.mesh,
+            in_specs=(batch, batch, batch, batch, batch),
+            out_specs=(batch, rep),
+            check_vma=False,
+        )
+        return jax.jit(mapped)
+
+    def _entry(self, kind: str, n_pad: int, msg_len: int) -> _Entry:
+        key = (kind, n_pad, msg_len)
         with self._lock:
-            fn = self._tally_fns.get(key)
-            if fn is None:
-                if self.mesh is not None:
-                    shard = batch_sharding(self.mesh)
-                    rep = replicated_sharding(self.mesh)
-                    fn = jax.jit(
-                        ops_ed.verify_and_tally,
-                        in_shardings=(shard, shard, shard, shard, shard),
-                        out_shardings=(shard, rep),
-                    )
-                else:
-                    fn = jax.jit(ops_ed.verify_and_tally)
-                self._tally_fns[key] = fn
-            return fn
+            e = self._entries.get(key)
+            if e is None:
+                e = _Entry(self._build(kind))
+                self._entries[key] = e
+            return e
+
+    def _zero_args(self, kind: str, n_pad: int, msg_len: int):
+        pk = jnp.zeros((n_pad, 32), dtype=jnp.uint8)
+        mg = jnp.zeros((n_pad, msg_len), dtype=jnp.uint8)
+        sg = jnp.zeros((n_pad, 64), dtype=jnp.uint8)
+        if kind == "verify":
+            return (pk, mg, sg)
+        return (
+            pk, mg, sg,
+            jnp.zeros((n_pad, ops_ed.POWER_CHUNKS), dtype=jnp.int32),
+            jnp.zeros((n_pad,), dtype=bool),
+        )
+
+    def _warm_entry(self, e: _Entry, kind: str, n_pad: int, msg_len: int) -> None:
+        """Force compilation by running on zeros; records compile time."""
+        t0 = time.perf_counter()
+        out = e.fn(*self._zero_args(kind, n_pad, msg_len))
+        jax.block_until_ready(out)
+        e.compile_s = time.perf_counter() - t0
+        e.ready = True
+        self.logger.info(
+            "verifier bucket compiled",
+            kind=kind, rows=n_pad, msg_len=msg_len,
+            seconds=round(e.compile_s, 2),
+        )
+
+    def _claim_compile(self, e: _Entry) -> bool:
+        """Atomically claim the right to compile an entry (warmup and
+        live calls race for the same buckets)."""
+        with self._lock:
+            if e.compiling or e.ready:
+                return False
+            e.compiling = True
+            return True
+
+    def _compile_async(self, e: _Entry, kind: str, n_pad: int, msg_len: int) -> None:
+        if not self._claim_compile(e):
+            return
+
+        def work():
+            try:
+                self._warm_entry(e, kind, n_pad, msg_len)
+            except Exception as ex:  # pragma: no cover - defensive
+                self.logger.error("background compile failed", err=repr(ex))
+            finally:
+                e.compiling = False
+
+        threading.Thread(target=work, daemon=True, name=f"compile-{kind}-{n_pad}").start()
+
+    def _get_fn(self, kind: str, n_pad: int, msg_len: int):
+        """Returns the compiled callable, or None when non-blocking and
+        the bucket is still cold (background compile kicked off)."""
+        e = self._entry(kind, n_pad, msg_len)
+        if e.ready:
+            return e.fn
+        if self.block_on_compile:
+            e.ready = True  # first call compiles inline
+            return e.fn
+        self._compile_async(e, kind, n_pad, msg_len)
+        return None
 
     # -- padding ----------------------------------------------------------
 
@@ -111,13 +223,13 @@ class VerifierModel:
         if n == 0:
             return np.zeros(0, dtype=bool)
         if msg_lens is not None and len(set(int(x) for x in msg_lens)) > 1:
-            from tendermint_tpu.crypto.batch import CPUBatchVerifier
-
-            return CPUBatchVerifier().verify_batch(pubkeys, msgs, sigs, msg_lens)
+            return self._cpu().verify_batch(pubkeys, msgs, sigs, msg_lens)
         msg_len = int(msgs.shape[1]) if msg_lens is None else int(msg_lens[0])
         msgs = np.asarray(msgs)[:, :msg_len]
         n_pad = _bucket(n, self._pad_multiple())
-        fn = self._get_verify(n_pad, msg_len)
+        fn = self._get_fn("verify", n_pad, msg_len)
+        if fn is None:  # cold bucket, non-blocking: host fallback
+            return self._cpu().verify_batch(pubkeys, msgs, sigs)
         ok = fn(
             jnp.asarray(self._pad(np.asarray(pubkeys, dtype=np.uint8), n_pad)),
             jnp.asarray(self._pad(np.asarray(msgs, dtype=np.uint8), n_pad)),
@@ -142,7 +254,9 @@ class VerifierModel:
             return np.concatenate([ok1, ok2]), t1 + t2
         msg_len = int(msgs.shape[1])
         n_pad = _bucket(n, self._pad_multiple())
-        fn = self._get_tally(n_pad, msg_len)
+        fn = self._get_fn("tally", n_pad, msg_len)
+        if fn is None:  # cold bucket, non-blocking: host fallback
+            return self._cpu().verify_commit_batch(pubkeys, msgs, sigs, powers, counted)
         chunks = ops_ed.split_powers(powers)
         ok, sums = fn(
             jnp.asarray(self._pad(np.asarray(pubkeys, dtype=np.uint8), n_pad)),
@@ -153,13 +267,48 @@ class VerifierModel:
         )
         return np.asarray(ok)[:n], ops_ed.combine_power_chunks(np.asarray(sums))
 
-    def warmup(self, sizes=(1024,), msg_len: int = 160) -> None:
-        """Pre-compile buckets so the first live commit pays no compile."""
-        for n in sizes:
-            pk = np.zeros((n, 32), dtype=np.uint8)
-            mg = np.zeros((n, msg_len), dtype=np.uint8)
-            sg = np.zeros((n, 64), dtype=np.uint8)
-            self.verify(pk, mg, sg)
-            self.verify_commit(
-                pk, mg, sg, np.ones(n, dtype=np.int64), np.ones(n, dtype=bool)
-            )
+    @staticmethod
+    def _cpu():
+        from tendermint_tpu.crypto.batch import CPUBatchVerifier
+
+        return CPUBatchVerifier()
+
+    # -- warmup ------------------------------------------------------------
+
+    def warmup(self, sizes=(16, 1024), msg_len: int = 160, background: bool = False):
+        """Pre-compile buckets so live commits pay no compile.
+
+        ``background=True`` returns immediately; a daemon thread warms
+        each bucket in turn (node-start path). Returns the thread (or
+        None when synchronous).
+        """
+        pads = sorted({_bucket(s, self._pad_multiple()) for s in sizes})
+
+        def work():
+            for n_pad in pads:
+                for kind in ("verify", "tally"):
+                    e = self._entry(kind, n_pad, msg_len)
+                    if not self._claim_compile(e):
+                        continue  # a live call is already compiling it
+                    try:
+                        self._warm_entry(e, kind, n_pad, msg_len)
+                    except Exception as ex:
+                        self.logger.error(
+                            "warmup compile failed", kind=kind, rows=n_pad,
+                            err=repr(ex),
+                        )
+                        return
+                    finally:
+                        e.compiling = False
+
+        if background:
+            t = threading.Thread(target=work, daemon=True, name="verifier-warmup")
+            t.start()
+            return t
+        work()
+        return None
+
+    def compile_stats(self) -> Dict[Tuple[str, int, int], Optional[float]]:
+        """(kind, rows, msg_len) -> compile seconds (None = inline/unknown)."""
+        with self._lock:
+            return {k: e.compile_s for k, e in self._entries.items() if e.ready}

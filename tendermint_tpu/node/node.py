@@ -94,6 +94,22 @@ class Node(Service):
         self.node_key = node_key
         self.logger = logger or get_logger("node")
 
+        # -- crypto provider (the BASELINE.json plugin seam) ----------------
+        # Every VerifyCommit / VoteSet ingest / light-client call in this
+        # process drains through this provider (reference behavior is the
+        # serial loop at types/validator_set.go:641; provider "tpu" is the
+        # batched device redesign). block_on_compile=False: a live node
+        # must never stall consensus on an XLA compile — cold buckets are
+        # verified on host while the device program compiles in the
+        # background (models/verifier.py).
+        from tendermint_tpu.crypto.batch import make_provider, set_default_provider
+
+        self.crypto_provider = make_provider(
+            config.base.crypto_provider, block_on_compile=False
+        )
+        set_default_provider(self.crypto_provider)
+        self.logger.info("crypto provider", name=self.crypto_provider.name)
+
         # -- storage -------------------------------------------------------
         self.block_store = BlockStore(make_db("blockstore", config))
         self.state_store = StateStore(make_db("state", config))
@@ -233,6 +249,13 @@ class Node(Service):
         """Reference OnStart node/node.go:760 (plus the NewNode steps that
         must run inside the event loop: app conns, handshake)."""
         from tendermint_tpu.privval.signer import SignerClient
+
+        # Warm the device verifier in the background so the first live
+        # commits hit compiled executables (VerifierModel.warmup logs
+        # per-bucket compile seconds; the persistent cache makes this
+        # near-instant after the first boot on a machine).
+        if hasattr(self.crypto_provider, "warmup"):
+            self.crypto_provider.warmup(background=True)
 
         if isinstance(self.priv_validator, SignerClient):
             # remote signer: listen and wait for it to dial in

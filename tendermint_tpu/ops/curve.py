@@ -156,7 +156,9 @@ def _host_base_table() -> np.ndarray:
     return np.asarray(rows, dtype=np.int32)
 
 
-_BASE_TABLE = jnp.asarray(_host_base_table())  # (16, 4, 20)
+# numpy on purpose: a module-level device array would initialize the
+# backend at import (see field.const); becomes an XLA constant at trace.
+_BASE_TABLE = _host_base_table()  # (16, 4, 20) np.int32
 
 
 def nibble_digits(scalar_bytes: jnp.ndarray) -> jnp.ndarray:
@@ -176,7 +178,7 @@ def _lookup(table: jnp.ndarray, digit: jnp.ndarray) -> Point:
 
 def _lookup_const(digit: jnp.ndarray) -> Point:
     """Select row `digit` from the shared base-point table."""
-    sel = _BASE_TABLE[digit]  # (N, 4, 20) via gather
+    sel = jnp.asarray(_BASE_TABLE)[digit]  # (N, 4, 20) via gather
     return Point(sel[:, 0], sel[:, 1], sel[:, 2], sel[:, 3])
 
 

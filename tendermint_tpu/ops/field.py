@@ -68,8 +68,12 @@ def from_limbs(limbs) -> int:
     return val % P
 
 
-def const(x: int) -> jnp.ndarray:
-    return jnp.asarray(to_limbs(x))
+def const(x: int) -> np.ndarray:
+    """Module-level field constants stay numpy: converting to a device
+    array at import time would initialize the JAX backend on import
+    (hanging a node whose TPU tunnel is down); jnp ops convert numpy
+    operands at trace time for free."""
+    return to_limbs(x)
 
 
 # -- vectorized weak carries ------------------------------------------------

@@ -48,8 +48,10 @@ _H0 = [
     0x510E527FADE682D1, 0x9B05688C2B3E6C1F, 0x1F83D9ABFB41BD6B, 0x5BE0CD19137E2179,
 ]
 
-_K_HI = jnp.asarray([k >> 32 for k in _K], dtype=U32)
-_K_LO = jnp.asarray([k & 0xFFFFFFFF for k in _K], dtype=U32)
+# numpy on purpose: module-level device arrays would initialize the JAX
+# backend at import time (see field.const).
+_K_HI = np.asarray([k >> 32 for k in _K], dtype=np.uint32)
+_K_LO = np.asarray([k & 0xFFFFFFFF for k in _K], dtype=np.uint32)
 
 
 # 64-bit word = (hi, lo) uint32 pair ---------------------------------------

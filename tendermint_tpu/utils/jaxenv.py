@@ -1,0 +1,74 @@
+"""JAX platform plumbing for this environment.
+
+The deployment image ships a sitecustomize hook (``.axon_site``) that
+imports jax at interpreter start and registers an ``axon`` PJRT factory
+whose initialization DIALS THE TPU TUNNEL. When the tunnel is down,
+backend init hangs forever instead of failing — so anything that must
+run without the accelerator (tests, CPU fallbacks, virtual-mesh dryruns)
+needs to (a) strip the hook and (b) force the CPU platform BEFORE the
+first backend initializes. This module is the single home for that
+workaround (bench.py, __graft_entry__.py and tests/conftest.py all use
+it).
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+from typing import Optional, Tuple
+
+
+def force_cpu_platform(n_devices: Optional[int] = None) -> bool:
+    """Force the (virtual, if n_devices is set) CPU platform.
+
+    Safe to call before or after the jax import, as long as no backend
+    has initialized yet. Returns False when it is too late (a backend
+    already initialized, so the platform/device-count flags cannot
+    apply).
+    """
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    if n_devices is not None:
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + f" --xla_force_host_platform_device_count={n_devices}"
+            ).strip()
+    sys.path[:] = [p for p in sys.path if ".axon_site" not in p]
+    os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+
+    import jax
+
+    try:
+        from jax._src import xla_bridge as _xb
+
+        if getattr(_xb, "_backends", None):
+            return False
+        _xb._backend_factories.pop("axon", None)
+    except Exception:
+        pass
+    jax.config.update("jax_platforms", "cpu")
+    return True
+
+
+def probe_accelerator(timeout_s: float = 120) -> Tuple[int, str]:
+    """(device_count, platform) of the default backend, probed IN A
+    SUBPROCESS so a dead tunnel (which hangs instead of failing) can be
+    timed out. Returns (0, "") on failure/timeout."""
+    code = "import jax; d = jax.devices(); print(len(d), d[0].platform)"
+    try:
+        res = subprocess.run(
+            [sys.executable, "-c", code],
+            timeout=timeout_s,
+            capture_output=True,
+            text=True,
+        )
+    except subprocess.TimeoutExpired:
+        return 0, ""
+    if res.returncode != 0:
+        return 0, ""
+    try:
+        count, platform = res.stdout.split()
+        return int(count), platform
+    except ValueError:
+        return 0, ""

@@ -9,41 +9,27 @@ Must run before jax is imported anywhere.
 import os
 import sys
 
-# FORCE cpu (not setdefault: the outer env pins JAX_PLATFORMS=axon) and
-# drop the axon PJRT plugin from the import path — its import dials the
-# TPU tunnel and hangs the whole test run when the tunnel is unhealthy.
-# bench.py / the driver keep the plugin for real-TPU runs.
-os.environ["JAX_PLATFORMS"] = "cpu"
-sys.path[:] = [p for p in sys.path if ".axon_site" not in p]
+# FORCE cpu on a virtual 8-device mesh (not setdefault: the outer env
+# pins JAX_PLATFORMS=axon, and the axon sitecustomize hook's PJRT
+# factory DIALS THE TPU TUNNEL at backend init — a dead tunnel would
+# hang the whole test run). The workaround lives in one place:
+# tendermint_tpu.utils.jaxenv (shared with bench.py / __graft_entry__).
+from tendermint_tpu.utils.jaxenv import force_cpu_platform  # noqa: E402
+
+assert force_cpu_platform(8), "a JAX backend initialized before conftest"
+# subprocess tests: make child interpreters skip axon registration too
+# (the sitecustomize hook is gated on this env var)
 os.environ["PYTHONPATH"] = ":".join(
     p for p in os.environ.get("PYTHONPATH", "").split(":") if p and ".axon_site" not in p
 )
-# subprocess tests: make sure child interpreters skip axon registration
-# entirely (the sitecustomize hook is gated on this env var)
-os.environ.pop("PALLAS_AXON_POOL_IPS", None)
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (
-        flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
-
-# The axon sitecustomize hook (already executed at interpreter start)
-# force-updates jax_platforms to "axon,cpu" and registers a PJRT factory
-# whose initialization DIALS THE TPU TUNNEL — a dead tunnel would hang
-# the whole test run. Undo both for this process: tests run on the
-# virtual 8-device CPU mesh by design.
-import jax  # noqa: E402
-
-jax.config.update("jax_platforms", "cpu")
-try:
-    from jax._src import xla_bridge as _xb  # noqa: E402
-
-    _xb._backend_factories.pop("axon", None)
-except Exception:
-    pass
 
 os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/jax_cache")
 os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "1")
+# TOML-loaded node configs default to the TPU provider; the suite pins
+# cpu so node tests don't spawn background XLA compiles. The TPU
+# provider path has dedicated tests (test_tpu_provider.py,
+# test_ops_ed25519.py).
+os.environ["TM_CRYPTO_PROVIDER"] = "cpu"
 
 import pytest  # noqa: E402
 

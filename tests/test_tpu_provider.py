@@ -1,0 +1,176 @@
+"""The TPU crypto provider is wired from config into the live node.
+
+Round-1 verdict finding 1: ``crypto_provider`` was dead config — no node
+ever constructed TPUBatchVerifier. These tests prove the seam end to
+end: config selects the provider, node assembly installs it as the
+process default, and a running consensus height drains its signature
+checks through it (reference behavior being replaced: the serial loop
+at types/validator_set.go:641 / types/vote_set.go:201).
+"""
+
+import asyncio
+import os
+
+import numpy as np
+import pytest
+
+from tendermint_tpu.cli import main as cli_main
+from tendermint_tpu.config import load_config
+from tendermint_tpu.config import test_config as make_test_config
+from tendermint_tpu.crypto.batch import (
+    CPUBatchVerifier,
+    TPUBatchVerifier,
+    get_default_provider,
+    make_provider,
+    set_default_provider,
+)
+from tendermint_tpu.node import default_new_node
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+def test_make_provider_from_config_names():
+    assert isinstance(make_provider("cpu"), CPUBatchVerifier)
+    assert isinstance(make_provider("tpu"), TPUBatchVerifier)
+    with pytest.raises(ValueError):
+        make_provider("gpu")
+
+
+def test_env_override_pins_provider(tmp_path):
+    home = str(tmp_path / "n0")
+    cli_main(["--home", home, "init", "--chain-id", "prov-chain"])
+    path = os.path.join(home, "config/config.toml")
+    # the rendered TOML carries the provider key (default tpu)
+    assert "crypto_provider" in open(path).read()
+    old = os.environ.get("TM_CRYPTO_PROVIDER")
+    try:
+        os.environ["TM_CRYPTO_PROVIDER"] = "cpu"
+        assert load_config(path).base.crypto_provider == "cpu"
+        os.environ.pop("TM_CRYPTO_PROVIDER")
+        assert load_config(path).base.crypto_provider == "tpu"
+    finally:
+        if old is not None:
+            os.environ["TM_CRYPTO_PROVIDER"] = old
+
+
+def test_node_installs_tpu_provider_and_commits(tmp_path):
+    """A node configured with crypto_provider=tpu installs the batched
+    device verifier as the process default and commits heights whose
+    vote ingest drains through it."""
+    prev = get_default_provider()
+    try:
+        cfg = make_test_config().set_root(str(tmp_path))
+        cfg.base.crypto_provider = "tpu"
+        cfg.consensus.timeout_commit_ms = 50
+        cfg.consensus.skip_timeout_commit = True
+
+        async def go():
+            from tendermint_tpu.config.config import ensure_root
+
+            ensure_root(cfg.root_dir)
+            from tendermint_tpu.types.genesis import GenesisDoc, GenesisValidator
+            from tendermint_tpu.privval import load_or_gen_file_pv
+
+            pv = load_or_gen_file_pv(
+                cfg.base.priv_validator_key_file(), cfg.base.priv_validator_state_file()
+            )
+            doc = GenesisDoc(
+                chain_id="tpu-prov-chain",
+                genesis_time_ns=1_700_000_000_000_000_000,
+                validators=[
+                    GenesisValidator(
+                        address=pv.get_pub_key().address(),
+                        pub_key=pv.get_pub_key(),
+                        power=10,
+                        name="v0",
+                    )
+                ],
+            )
+            doc.save_as(cfg.base.genesis_file())
+
+            node = default_new_node(cfg)
+            assert isinstance(node.crypto_provider, TPUBatchVerifier)
+            assert get_default_provider() is node.crypto_provider
+            # no real background compiles in CI (daemon XLA threads abort
+            # at interpreter exit); the warmup path is covered by
+            # dryrun_multichip
+            node.crypto_provider.warmup = lambda **kw: None
+
+            # spy: count batches flowing through the provider seam
+            calls = {"n": 0}
+            orig = node.crypto_provider.verify_batch
+
+            def spy(*a, **kw):
+                calls["n"] += 1
+                return orig(*a, **kw)
+
+            node.crypto_provider.verify_batch = spy
+
+            await node.start()
+            try:
+                await node.consensus_state.wait_for_height(2, timeout_s=30)
+            finally:
+                await node.stop()
+            assert calls["n"] > 0, "consensus ran but no batch hit the provider"
+
+        run(go())
+    finally:
+        set_default_provider(prev)
+
+
+def test_tpu_provider_nonblocking_falls_back_then_warms():
+    """block_on_compile=False: a cold bucket is served by the host
+    verifier (correct results immediately) while the device program
+    compiles in the background."""
+    from tendermint_tpu.ops import ref_ed25519 as ref
+
+    n = 4
+    pks = np.zeros((n, 32), np.uint8)
+    msgs = np.zeros((n, 40), np.uint8)
+    sigs = np.zeros((n, 64), np.uint8)
+    for i in range(n):
+        seed = bytes([i + 9] * 32)
+        msg = bytes([i]) * 40
+        pks[i] = np.frombuffer(ref.pubkey_from_seed(seed), np.uint8)
+        msgs[i] = np.frombuffer(msg, np.uint8)
+        sigs[i] = np.frombuffer(ref.sign(seed, msg), np.uint8)
+    sigs[2, 0] ^= 1  # one bad row
+
+    v = TPUBatchVerifier(block_on_compile=False)
+    # stub the background compile: a daemon XLA-compile thread would be
+    # killed mid-flight at interpreter exit and abort the process; the
+    # compile itself is covered by dryrun_multichip / test_ops_ed25519
+    kicked = []
+    v._model._compile_async = lambda *a: kicked.append(a)
+    ok = v.verify_batch(pks, msgs, sigs)
+    assert list(ok) == [True, True, False, True]
+    ok2, tally = v.verify_commit_batch(
+        pks, msgs, sigs, np.full(n, 5, np.int64), np.ones(n, bool)
+    )
+    assert list(ok2) == [True, True, False, True] and tally == 15
+    assert kicked, "cold bucket should have scheduled a background compile"
+
+
+def test_tpu_provider_small_batch_routes_to_host():
+    """Batches below min_device_batch never touch the device (dispatch
+    overhead discipline, SURVEY.md section 7.3.6)."""
+    v = TPUBatchVerifier(block_on_compile=False, min_device_batch=4)
+    called = {"n": 0}
+    orig = v._model.verify
+
+    def spy(*a, **kw):
+        called["n"] += 1
+        return orig(*a, **kw)
+
+    v._model.verify = spy
+    from tendermint_tpu.ops import ref_ed25519 as ref
+
+    seed, msg = bytes([3] * 32), b"tiny-batch"
+    pk = np.frombuffer(ref.pubkey_from_seed(seed), np.uint8).reshape(1, 32).repeat(2, 0)
+    mg = np.frombuffer(msg, np.uint8).reshape(1, -1).repeat(2, 0)
+    sg = np.frombuffer(ref.sign(seed, msg), np.uint8).reshape(1, 64).repeat(2, 0).copy()
+    sg[1, 0] ^= 1
+    ok = v.verify_batch(pk, mg, sg)
+    assert list(ok) == [True, False] and called["n"] == 0
