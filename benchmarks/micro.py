@@ -62,6 +62,52 @@ def bench_light():
     emit("light_bisection_to_200", bis * 1e3, "ms")
 
 
+def bench_headers_heights():
+    """BASELINE eval 3: many validators × many heights — per-header device
+    calls vs ONE cross-height batched call (verifier.verify_chain).
+
+    Scaled-down by default (chain generation is host-bound); pass env
+    EVAL3_FULL=1 for the full 1k-validator × 500-height config."""
+    sys.path.insert(0, os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "tests"))
+    import light_helpers as lh
+
+    from tendermint_tpu.light import verifier
+
+    full = os.environ.get("EVAL3_FULL") == "1"
+    n_vals = 1000 if full else 64
+    n_heights = 500 if full else 100
+    ks = lh.keys(n_vals)
+    headers, vals = lh.gen_chain(n_heights, base_keys=ks)
+    now = headers[n_heights].time_ns + 1
+    period = 10**18
+    chain = [(headers[h], vals[h]) for h in range(2, n_heights + 1)]
+
+    # the batching win is a DEVICE property (per-call dispatch + bucket
+    # padding); measure with the jax provider, not the serial-host one
+    from tendermint_tpu.crypto.batch import make_provider
+
+    prov = make_provider("tpu")
+    # warm both bucket shapes out of the timed region
+    prov.warmup(sizes=(n_vals, len(chain) * n_vals), msg_len=160)
+    verifier.verify_chain(lh.CHAIN_ID, headers[1], vals[1], chain[:4], period, now_ns=now, provider=prov)
+
+    t0 = time.perf_counter()
+    cur_sh, cur_vals = headers[1], vals[1]
+    for sh, vs in chain:
+        verifier.verify_adjacent(lh.CHAIN_ID, cur_sh, sh, vs, period, now_ns=now, provider=prov)
+        cur_sh, cur_vals = sh, vs
+    per_header = time.perf_counter() - t0
+
+    t0 = time.perf_counter()
+    verifier.verify_chain(lh.CHAIN_ID, headers[1], vals[1], chain, period, now_ns=now, provider=prov)
+    batched = time.perf_counter() - t0
+
+    tag = f"{n_vals}v_x_{n_heights}h"
+    emit(f"headers_per_height_calls_{tag}", per_header * 1e3, "ms")
+    emit(f"headers_one_batched_call_{tag}", batched * 1e3, "ms")
+    emit(f"headers_batch_speedup_{tag}", per_header / batched, "x")
+
+
 def bench_mempool():
     """mempool/bench_test.go: CheckTx + Reap."""
     from tendermint_tpu.abci.client.local import LocalClient
@@ -205,6 +251,7 @@ def bench_e2e():
 
 BENCHES = {
     "light": bench_light,
+    "headers": bench_headers_heights,
     "mempool": bench_mempool,
     "secretconn": bench_secretconn,
     "valset": bench_valset,

@@ -16,7 +16,10 @@ from typing import List, Optional
 from tendermint_tpu.db.base import DB
 from tendermint_tpu.types.evidence import (
     MAX_EVIDENCE_BYTES,
+    CompositeEvidence,
     Evidence,
+    LunaticValidatorEvidence,
+    PhantomValidatorEvidence,
     decode_evidence,
     encode_evidence,
 )
@@ -48,6 +51,13 @@ class EvidencePool:
         self._new_evidence = asyncio.Event() if _has_loop() else None
         self._seq = 0
         self._seqs: dict = {}  # hash -> insertion seq (gossip cursor)
+        # addr -> last height the validator was in the set, for phantom-
+        # validator detection (reference valToLastHeightMap pool.go:45,
+        # seeded like buildValToLastHeightMap :369)
+        self.val_to_last_height: dict = {}
+        if self.state is not None and self.state.last_block_height > 0:
+            for v in self.state.validators.validators:
+                self.val_to_last_height[v.address] = self.state.last_block_height
 
     # -- queries -----------------------------------------------------------
 
@@ -74,16 +84,61 @@ class EvidencePool:
 
     def add_evidence(self, ev: Evidence) -> None:
         """Verify + store as pending (reference AddEvidence :120).
+        Composite evidence (ConflictingHeaders) is verified as a whole and
+        split into per-validator pieces (:132-144).
         Raises ErrEvidenceAlreadySeen / ErrInvalidEvidence."""
-        if self.is_committed(ev) or self.is_pending(ev):
-            raise ErrEvidenceAlreadySeen(repr(ev))
-        self.verify_evidence(ev)
-        self._seq += 1
-        self._seqs[ev.hash()] = self._seq
-        self._db.set(_key(_PENDING, ev), encode_evidence(ev))
-        self.logger.info("verified new evidence of byzantine behaviour", ev=repr(ev))
-        if self._new_evidence is not None:
+        ev_list = [ev]
+        if isinstance(ev, CompositeEvidence):
+            self.logger.info("breaking up composite evidence", ev=repr(ev))
+            header = self._committed_header(ev.height())
+            vals = self._state_store.load_validators(ev.height())
+            if vals is None:
+                raise ErrInvalidEvidence(f"no validator set at height {ev.height()}")
+            try:
+                ev.verify_composite(header, vals)
+            except Exception as e:
+                raise ErrInvalidEvidence(str(e))
+            ev_list = ev.split(header, vals, self.val_to_last_height)
+            if not ev_list:
+                raise ErrInvalidEvidence("composite evidence split to nothing")
+
+        added = False
+        first_err: Optional[Exception] = None
+        for piece in ev_list:
+            if self.is_committed(piece) or self.is_pending(piece):
+                if len(ev_list) == 1:
+                    raise ErrEvidenceAlreadySeen(repr(piece))
+                continue
+            try:
+                self.verify_evidence(piece)
+            except Exception as e:
+                # one bad split piece must not drop its valid siblings
+                if len(ev_list) == 1:
+                    raise
+                first_err = first_err or e
+                self.logger.info("rejected split evidence piece", ev=repr(piece), err=str(e))
+                continue
+            self._seq += 1
+            self._seqs[piece.hash()] = self._seq
+            self._db.set(_key(_PENDING, piece), encode_evidence(piece))
+            added = True
+            self.logger.info(
+                "verified new evidence of byzantine behaviour", ev=repr(piece)
+            )
+        if added and self._new_evidence is not None:
             self._new_evidence.set()
+        if not added and first_err is not None:
+            raise ErrInvalidEvidence(str(first_err))
+
+    def _committed_header(self, height: int):
+        if self._block_store is None:
+            raise ErrInvalidEvidence(
+                f"no block store; can't fetch committed header at {height}"
+            )
+        meta = self._block_store.load_block_meta(height)
+        if meta is None:
+            raise ErrInvalidEvidence(f"don't have block meta at height {height}")
+        return meta.header
 
     def verify_evidence(self, ev: Evidence) -> None:
         """Reference sm.VerifyEvidence state/validation.go:161."""
@@ -100,17 +155,53 @@ class EvidencePool:
             raise ErrInvalidEvidence(
                 f"evidence from height {ev.height()} is too old"
             )
+        # Lunatic: the claimed-bad header field must differ from what we
+        # actually committed (reference state/validation.go:180 region)
+        if isinstance(ev, LunaticValidatorEvidence):
+            header = self._committed_header(ev.height())
+            try:
+                ev.verify_header(header)
+            except Exception as e:
+                raise ErrInvalidEvidence(str(e))
+
         # In-flight-height evidence (h+1, even h+2) is fine: the reference
         # bounds only by whether a validator set exists at that height
         # (state/validation.go:161 loads and errors if absent).
         vals = self._state_store.load_validators(ev.height())
         if vals is None:
             raise ErrInvalidEvidence(f"no validator set at height {ev.height()}")
-        _, val = vals.get_by_address(ev.address())
-        if val is None:
-            raise ErrInvalidEvidence(
-                f"address {ev.address().hex()[:12]} was not a validator at height {ev.height()}"
+
+        if isinstance(ev, PhantomValidatorEvidence):
+            # must NOT be a validator at the evidence height, but must
+            # have been one at last_height_validator_was_in_set within the
+            # unbonding window (reference state/validation.go:196-219)
+            addr = ev.address()
+            _, val = vals.get_by_address(addr)
+            if val is not None:
+                raise ErrInvalidEvidence(
+                    f"address {addr.hex()[:12]} was a validator at height {ev.height()}"
+                )
+            if age_blocks > 0 and ev.last_height_validator_was_in_set <= age_blocks:
+                raise ErrInvalidEvidence(
+                    f"last time validator was in the set at height "
+                    f"{ev.last_height_validator_was_in_set}, min: {age_blocks + 1}"
+                )
+            prev_vals = self._state_store.load_validators(
+                ev.last_height_validator_was_in_set
             )
+            if prev_vals is None:
+                raise ErrInvalidEvidence(
+                    f"no validator set at height {ev.last_height_validator_was_in_set}"
+                )
+            _, val = prev_vals.get_by_address(addr)
+            if val is None:
+                raise ErrInvalidEvidence(f"phantom validator {addr.hex()[:12]} not found")
+        else:
+            _, val = vals.get_by_address(ev.address())
+            if val is None:
+                raise ErrInvalidEvidence(
+                    f"address {ev.address().hex()[:12]} was not a validator at height {ev.height()}"
+                )
         err = ev.validate_basic()
         if err:
             raise ErrInvalidEvidence(err)
@@ -123,11 +214,21 @@ class EvidencePool:
 
     def update(self, block, state) -> None:
         """After a block commits: mark its evidence committed, drop
-        expired pending (reference Update :95)."""
+        expired pending (reference Update :95), refresh the
+        val→last-height map (updateValToLastHeight :348)."""
         self.state = state
         for ev in block.evidence.evidence:
             self.mark_evidence_as_committed(ev)
         self._remove_expired()
+        for v in state.validators.validators:
+            self.val_to_last_height[v.address] = block.header.height
+        remove_height = (
+            block.header.height - state.consensus_params.evidence.max_age_num_blocks
+        )
+        if remove_height >= 1:
+            for addr, h in list(self.val_to_last_height.items()):
+                if h <= remove_height:
+                    del self.val_to_last_height[addr]
 
     def mark_evidence_as_committed(self, ev: Evidence) -> None:
         self._db.set(_key(_COMMITTED, ev), b"\x01")

@@ -48,6 +48,8 @@ class LightClient:
         store: TrustedStore,
         trust_level: Fraction = DEFAULT_TRUST_LEVEL,
         max_retry_attempts: int = 5,
+        mode: str = "bisection",
+        sequence_window: int = 512,
         logger=None,
     ):
         err = trust_options.validate()
@@ -60,6 +62,10 @@ class LightClient:
         self.primary = primary
         self.witnesses = list(witnesses)
         self.store = store
+        if mode not in ("bisection", "sequence"):
+            raise ValueError(f"unknown verification mode {mode!r}")
+        self.mode = mode
+        self.sequence_window = sequence_window
         self.logger = logger or get_logger("light")
         self._initialized = False
 
@@ -123,7 +129,10 @@ class LightClient:
             raise LightClientError("no trusted state; call initialize")
         trusted_sh, trusted_vals = latest
         new_vals = await self.primary.validator_set(new_header.height)
-        await self._bisection(trusted_sh, trusted_vals, new_header, new_vals, now)
+        if self.mode == "sequence":
+            await self._sequence(trusted_sh, trusted_vals, new_header, new_vals, now)
+        else:
+            await self._bisection(trusted_sh, trusted_vals, new_header, new_vals, now)
         await self._compare_with_witnesses(new_header)
 
     async def _bisection(
@@ -171,6 +180,49 @@ class LightClient:
                         "bisection pivot", frm=cur_sh.height, to=try_h, pivot=pivot
                     )
                     try_h = pivot
+
+    async def _sequence(
+        self,
+        trusted_sh: SignedHeader,
+        trusted_vals: ValidatorSet,
+        new_header: SignedHeader,
+        new_vals: ValidatorSet,
+        now: int,
+    ) -> None:
+        """Sequential verification, batched across heights.
+
+        Reference sequence (lite2/client.go:620) verifies each adjacent
+        header with its own VerifyAdjacent → VerifyCommit call. Here each
+        window of up to ``sequence_window`` headers is fetched and then
+        verified with ONE device call (verifier.verify_chain) — the
+        BASELINE config-3 "1k validators × 500 heights" shape.
+        """
+        import asyncio
+
+        async def fetch(h):
+            if h == target:
+                return new_header, new_vals
+            sh = await self.primary.signed_header(h)
+            vals = await self.primary.validator_set(h)
+            return sh, vals
+
+        cur_sh, cur_vals = trusted_sh, trusted_vals
+        target = new_header.height
+        while cur_sh.height < target:
+            window_end = min(cur_sh.height + self.sequence_window, target)
+            # fetches are independent — overlap the window's round trips
+            chain = list(
+                await asyncio.gather(
+                    *(fetch(h) for h in range(cur_sh.height + 1, window_end + 1))
+                )
+            )
+            verifier.verify_chain(
+                self.chain_id, cur_sh, cur_vals, chain,
+                self.trusting_period_ns, self.trust_level, now_ns=now,
+            )
+            for sh, vals in chain:
+                self.store.save(sh, vals)
+            cur_sh, cur_vals = chain[-1]
 
     async def _backwards(self, height: int, now: int) -> SignedHeader:
         """Reference backwards :883: walk the hash chain down from the

@@ -18,7 +18,11 @@ from fractions import Fraction
 from typing import Optional
 
 from tendermint_tpu.light.types import DEFAULT_TRUST_LEVEL, SignedHeader
-from tendermint_tpu.types.validator_set import ValidatorSet
+from tendermint_tpu.types.validator_set import (
+    CommitVerifySpec,
+    ValidatorSet,
+    verify_commits_batched,
+)
 
 DEFAULT_CLOCK_DRIFT_NS = 10 * 10**9  # 10s (reference defaultClockDrift)
 
@@ -127,19 +131,27 @@ def verify_non_adjacent(
         raise ErrOldHeaderExpired(f"old header expired at {trusted.time_ns + trusting_period_ns}")
     _verify_new_header_and_vals(chain_id, untrusted, untrusted_vals, trusted, now, clock_drift_ns)
 
-    # 1/3+ of what we trusted still signs the new header
-    try:
-        trusted_vals.verify_commit_trusting(
-            chain_id, untrusted.block_id(), untrusted.height, untrusted.commit,
-            trust_level, provider=provider,
-        )
-    except Exception as e:
-        raise ErrNewValSetCantBeTrusted(str(e))
-    # and the new set has a proper +2/3 commit
-    untrusted_vals.verify_commit(
-        chain_id, untrusted.block_id(), untrusted.height, untrusted.commit,
+    # Both checks (1/3+ of the trusted set still signs; the new set has a
+    # proper +2/3 commit) share ONE device batch. The reference runs them
+    # serially (VerifyCommitTrusting :60 then VerifyCommit :76); the
+    # trusting error still surfaces first, so observable behavior matches.
+    bid = untrusted.block_id()
+    res = verify_commits_batched(
+        [
+            CommitVerifySpec(
+                trusted_vals, chain_id, bid, untrusted.height, untrusted.commit,
+                mode="trusting", trust_level=trust_level,
+            ),
+            CommitVerifySpec(
+                untrusted_vals, chain_id, bid, untrusted.height, untrusted.commit,
+            ),
+        ],
         provider=provider,
     )
+    if res[0] is not None:
+        raise ErrNewValSetCantBeTrusted(str(res[0]))
+    if res[1] is not None:
+        raise res[1]
 
 
 def verify(
@@ -165,6 +177,66 @@ def verify(
             chain_id, trusted, untrusted, untrusted_vals, trusting_period_ns,
             now_ns, clock_drift_ns, provider,
         )
+
+
+def verify_chain(
+    chain_id: str,
+    trusted: SignedHeader,
+    trusted_vals: ValidatorSet,
+    chain,  # List[Tuple[SignedHeader, ValidatorSet]], ascending heights
+    trusting_period_ns: int,
+    trust_level: Fraction = DEFAULT_TRUST_LEVEL,
+    now_ns: Optional[int] = None,
+    clock_drift_ns: int = DEFAULT_CLOCK_DRIFT_NS,
+    provider=None,
+) -> None:
+    """Verify a whole chain of headers with ONE batched device call.
+
+    The reference verifies one header per step (sequence lite2/client.go:620,
+    bisection :687 — one VerifyCommit[Trusting] call each). Here every
+    link's signature checks (adjacent → 1 commit; non-adjacent → trusting +
+    full, 2 commits) pack into a single rectangular batch — the SURVEY §5.7
+    "headers × heights" axis (BASELINE config 3). Host-side hash-chain and
+    header checks run sequentially first; the per-link accept/reject replay
+    preserves the step-by-step semantics, so the first failing link raises
+    exactly what the per-step path would have raised.
+    """
+    now = _now_ns(now_ns)
+    specs: list = []
+    spec_links: list = []  # (link_idx, kind) parallel to specs
+    cur_sh, cur_vals = trusted, trusted_vals
+    for li, (sh, vals) in enumerate(chain):
+        if header_expired(cur_sh, trusting_period_ns, now):
+            raise ErrOldHeaderExpired(
+                f"old header expired at {cur_sh.time_ns + trusting_period_ns}"
+            )
+        _verify_new_header_and_vals(chain_id, sh, vals, cur_sh, now, clock_drift_ns)
+        bid = sh.block_id()
+        if sh.height == cur_sh.height + 1:
+            if sh.header.validators_hash != cur_sh.header.next_validators_hash:
+                raise ErrInvalidHeader(
+                    f"link {li}: expected old header next validators to match new"
+                )
+            specs.append(CommitVerifySpec(vals, chain_id, bid, sh.height, sh.commit))
+            spec_links.append((li, "full"))
+        else:
+            specs.append(
+                CommitVerifySpec(
+                    cur_vals, chain_id, bid, sh.height, sh.commit,
+                    mode="trusting", trust_level=trust_level,
+                )
+            )
+            spec_links.append((li, "trusting"))
+            specs.append(CommitVerifySpec(vals, chain_id, bid, sh.height, sh.commit))
+            spec_links.append((li, "full"))
+        cur_sh, cur_vals = sh, vals
+
+    results = verify_commits_batched(specs, provider=provider)  # ★ one device call
+    for (li, kind), err in zip(spec_links, results):
+        if err is not None:
+            if kind == "trusting":
+                raise ErrNewValSetCantBeTrusted(f"link {li}: {err}")
+            raise err
 
 
 def verify_backwards(chain_id: str, untrusted: SignedHeader, trusted: SignedHeader) -> None:

@@ -194,6 +194,23 @@ class Commit:
             chain_id=chain_id,
         )
 
+    def get_vote(self, val_idx: int) -> "Vote":
+        """Reconstruct the precommit Vote behind signature `val_idx`
+        (reference Commit.GetVote types/block.go:619)."""
+        from tendermint_tpu.types.vote import Vote
+
+        cs = self.signatures[val_idx]
+        return Vote(
+            vote_type=PRECOMMIT_TYPE,
+            height=self.height,
+            round=self.round,
+            block_id=cs.block_id(self.block_id),
+            timestamp_ns=cs.timestamp_ns,
+            validator_address=cs.validator_address,
+            validator_index=val_idx,
+            signature=cs.signature,
+        )
+
     def size(self) -> int:
         return len(self.signatures)
 

@@ -343,10 +343,7 @@ class ValidatorSet:
         early-return acceptance is then replayed over the result vectors,
         so the accepted language is identical.
         """
-        if len(self.validators) != len(commit.signatures):
-            raise ErrInvalidCommit(
-                f"wrong set size: {len(self.validators)} vs {len(commit.signatures)}"
-            )
+        self._check_commit_size(commit)
         self._verify_commit_basic(commit, height, block_id)
 
         idxs, _vals_idx, pk, mg, sg, powers, counted = self._commit_batch_arrays(
@@ -354,7 +351,28 @@ class ValidatorSet:
         )
         v = provider or get_default_provider()
         ok, _talled = v.verify_commit_batch(pk, mg, sg, powers, counted)
+        self._replay_commit_full(commit, ok, idxs, powers, counted)
 
+    def _check_commit_size(self, commit) -> None:
+        if len(self.validators) != len(commit.signatures):
+            raise ErrInvalidCommit(
+                f"wrong set size: {len(self.validators)} vs {len(commit.signatures)}"
+            )
+
+    @staticmethod
+    def _validate_trust_level(trust_level) -> None:
+        """Trust level must be in [1/3, 1] (reference ValidateTrustLevel)."""
+        if (
+            trust_level is None
+            or trust_level.denominator == 0
+            or trust_level.numerator * 3 < trust_level.denominator
+            or trust_level.numerator > trust_level.denominator
+        ):
+            raise ValueError(f"trust level must be within [1/3, 1], got {trust_level}")
+
+    def _replay_commit_full(self, commit, ok, idxs, powers, counted) -> None:
+        """Sequential-early-return acceptance over batched results
+        (reference loop types/validator_set.go:641-668)."""
         voting_power_needed = self.total_voting_power() * 2 // 3
         talled = 0
         for r, i in enumerate(idxs):
@@ -389,12 +407,7 @@ class ValidatorSet:
         after the batched device verification, so a duplicate appearing
         AFTER quorum does not reject -- matching the reference's
         early-return loop exactly."""
-        if (
-            trust_level.denominator == 0
-            or trust_level.numerator * 3 < trust_level.denominator
-            or trust_level.numerator > trust_level.denominator
-        ):
-            raise ValueError(f"trust level must be within [1/3, 1], got {trust_level}")
+        self._validate_trust_level(trust_level)
         self._verify_commit_basic(commit, height, block_id)
 
         idxs, vals_idx, pk, mg, sg, powers_arr, counted_arr = self._commit_batch_arrays(
@@ -402,7 +415,13 @@ class ValidatorSet:
         )
         v = provider or get_default_provider()
         ok, _ = v.verify_commit_batch(pk, mg, sg, powers_arr, counted_arr)
+        self._replay_commit_trusting(ok, idxs, vals_idx, powers_arr, counted_arr, trust_level)
 
+    def _replay_commit_trusting(
+        self, ok, idxs, vals_idx, powers_arr, counted_arr, trust_level: Fraction
+    ) -> None:
+        """Sequential replay for the trusting variant (reference loop
+        types/validator_set.go:754 region), incl. duplicate-signer check."""
         total = self.total_voting_power()
         needed = total * trust_level.numerator // trust_level.denominator
         talled = 0
@@ -479,3 +498,94 @@ def _safe_sub(a: int, b: int) -> int:
 def _compute_max_min_priority_diff(vals: List[Validator]) -> int:
     ps = [v.proposer_priority for v in vals]
     return max(ps) - min(ps)
+
+
+# -- cross-height batched commit verification --------------------------------
+
+
+class CommitVerifySpec:
+    """One commit check inside a multi-commit device batch.
+
+    ``mode`` is "full" (ValidatorSet.verify_commit semantics,
+    types/validator_set.go:629) or "trusting" (VerifyCommitTrusting :754,
+    requires ``trust_level``). The batched driver runs every spec's
+    signatures through ONE device call and then replays each spec's
+    sequential acceptance on its slice, so per-spec accept/reject is
+    identical to calling the method directly.
+    """
+
+    __slots__ = ("valset", "chain_id", "block_id", "height", "commit", "mode", "trust_level")
+
+    def __init__(self, valset, chain_id, block_id, height, commit,
+                 mode="full", trust_level=None):
+        self.valset = valset
+        self.chain_id = chain_id
+        self.block_id = block_id
+        self.height = height
+        self.commit = commit
+        self.mode = mode
+        self.trust_level = trust_level
+
+
+def verify_commits_batched(
+    specs: Sequence[CommitVerifySpec],
+    provider: Optional[BatchVerifier] = None,
+) -> List[Optional[Exception]]:
+    """Verify many commits (typically many HEIGHTS) in one device call.
+
+    This is the SURVEY §5.7 chain-length axis: the reference verifies one
+    header's commit at a time (lite2/client.go:687 per bisection step,
+    blockchain/v2/processor_context.go:42 per fast-sync block); here the
+    light client's whole pivot/sequence chain and the fast-sync processor's
+    fetched window pack into a single rectangular batch.
+
+    Returns one entry per spec: None on acceptance, else the exception the
+    direct method call would have raised. Host-side pre-checks (structure,
+    height/BlockID match, set-size) run per spec before packing; a spec
+    failing pre-checks contributes no device rows.
+    """
+    results: List[Optional[Exception]] = [None] * len(specs)
+    segments = []  # (spec_idx, idxs, vals_idx, powers, counted)
+    pk_parts, mg_parts, sg_parts = [], [], []
+    for si, s in enumerate(specs):
+        try:
+            if s.mode == "trusting":
+                ValidatorSet._validate_trust_level(s.trust_level)
+            else:
+                s.valset._check_commit_size(s.commit)
+            s.valset._verify_commit_basic(s.commit, s.height, s.block_id)
+            idxs, vals_idx, pk, mg, sg, powers, counted = s.valset._commit_batch_arrays(
+                s.chain_id, s.commit, by_address=(s.mode == "trusting")
+            )
+        except Exception as e:
+            results[si] = e
+            continue
+        segments.append((si, idxs, vals_idx, powers, counted, len(idxs)))
+        pk_parts.append(pk)
+        mg_parts.append(mg)
+        sg_parts.append(sg)
+
+    if not segments:
+        return results
+
+    pk = np.concatenate(pk_parts, axis=0)
+    mg = np.concatenate(mg_parts, axis=0)
+    sg = np.concatenate(sg_parts, axis=0)
+    v = provider or get_default_provider()
+    ok = np.asarray(v.verify_batch(pk, mg, sg))  # ★ ONE device call, all heights
+
+    off = 0
+    for si, idxs, vals_idx, powers, counted, n in segments:
+        s = specs[si]
+        ok_slice = ok[off : off + n]
+        off += n
+        try:
+            if s.mode == "trusting":
+                s.valset._replay_commit_trusting(
+                    ok_slice, idxs, vals_idx, powers, counted, s.trust_level
+                )
+            else:
+                s.valset._replay_commit_full(s.commit, ok_slice, idxs, powers, counted)
+        except Exception as e:
+            results[si] = e
+    return results

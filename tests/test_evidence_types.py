@@ -1,0 +1,389 @@
+"""The light-client attack evidence family (reference types/evidence.go:
+ConflictingHeaders :309, Phantom :565, Lunatic :668, PotentialAmnesia :805)
+plus pool-side composite split/verification (evidence/pool.go:132-144,
+state/validation.go:180-219)."""
+
+import asyncio
+
+import pytest
+
+from tendermint_tpu.codec.signbytes import PRECOMMIT_TYPE
+from tendermint_tpu.db.memdb import MemDB
+from tendermint_tpu.evidence import EvidencePool
+from tendermint_tpu.evidence.pool import ErrInvalidEvidence
+from tendermint_tpu.light.types import SignedHeader
+from tendermint_tpu.types.block import BlockID, Header, PartSetHeader
+from tendermint_tpu.types.evidence import (
+    ConflictingHeadersEvidence,
+    DuplicateVoteEvidence,
+    LunaticValidatorEvidence,
+    PhantomValidatorEvidence,
+    PotentialAmnesiaEvidence,
+    decode_evidence,
+    encode_evidence,
+    make_potential_amnesia_evidence,
+)
+from tendermint_tpu.types.vote import Vote
+from tendermint_tpu.types.vote_set import VoteSet
+from tests.cs_harness import CHAIN_ID, make_genesis, make_node
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+async def chain_fixture(n_vals=1, heights=2):
+    genesis, privs = make_genesis(n_vals)
+    node = await make_node(genesis, privs[0])
+    await node.cs.start()
+    await node.cs.wait_for_height(heights, timeout_s=30)
+    await node.cs.stop()
+    pool = EvidencePool(MemDB(), node.state_store, node.block_store)
+    return pool, node, privs
+
+
+def committed_signed_header(node, height) -> SignedHeader:
+    meta = node.block_store.load_block_meta(height)
+    commit = node.block_store.load_seen_commit(height)
+    return SignedHeader(header=meta.header, commit=commit)
+
+
+def alt_signed_header(node, privs, height, round_=0, **field_overrides) -> SignedHeader:
+    """A forked header at `height`, fully signed by the real validators."""
+    meta = node.block_store.load_block_meta(height)
+    h = meta.header
+    alt = Header(
+        chain_id=h.chain_id,
+        height=h.height,
+        time_ns=h.time_ns + 1,  # any difference forks the hash
+        last_block_id=h.last_block_id,
+        last_commit_hash=h.last_commit_hash,
+        data_hash=h.data_hash,
+        validators_hash=h.validators_hash,
+        next_validators_hash=h.next_validators_hash,
+        consensus_hash=h.consensus_hash,
+        app_hash=h.app_hash,
+        last_results_hash=h.last_results_hash,
+        evidence_hash=h.evidence_hash,
+        proposer_address=h.proposer_address,
+    )
+    for k, v in field_overrides.items():
+        setattr(alt, k, v)
+    vals = node.state_store.load_validators(height)
+    bid = BlockID(alt.hash(), PartSetHeader(1, b"\xcd" * 32))
+    vs = VoteSet(CHAIN_ID, height, round_, PRECOMMIT_TYPE, vals)
+    by_addr = {pv.address(): pv for pv in privs}
+    for i, val in enumerate(vals.validators):
+        v = Vote(
+            vote_type=PRECOMMIT_TYPE,
+            height=height,
+            round=round_,
+            block_id=bid,
+            timestamp_ns=alt.time_ns + i,
+            validator_address=val.address,
+            validator_index=i,
+        )
+        by_addr[val.address].sign_vote(CHAIN_ID, v)
+        assert vs.add_vote(v)
+    return SignedHeader(header=alt, commit=vs.make_commit())
+
+
+# -- codec round trips -------------------------------------------------------
+
+
+def test_all_evidence_types_roundtrip_codec():
+    async def go():
+        pool, node, privs = await chain_fixture()
+        committed = committed_signed_header(node, 1)
+        alt = alt_signed_header(node, privs, 1)
+
+        che = ConflictingHeadersEvidence(h1=committed, h2=alt)
+        vote = alt.commit.get_vote(0)
+        phantom = PhantomValidatorEvidence(
+            header=alt.header, vote=vote, last_height_validator_was_in_set=1
+        )
+        lunatic = LunaticValidatorEvidence(
+            header=alt.header, vote=vote, invalid_header_field="app_hash"
+        )
+        amnesia = make_potential_amnesia_evidence(
+            committed.commit.get_vote(0), alt.commit.get_vote(0)
+        )
+        for ev in (che, phantom, lunatic, amnesia):
+            back = decode_evidence(encode_evidence(ev))
+            assert type(back) is type(ev)
+            assert back.hash() == ev.hash()
+            assert back.equal(ev)
+
+    run(go())
+
+
+# -- composite verify + split ------------------------------------------------
+
+
+def test_verify_composite_accepts_real_fork():
+    async def go():
+        pool, node, privs = await chain_fixture()
+        committed = committed_signed_header(node, 1)
+        alt = alt_signed_header(node, privs, 1)
+        vals = node.state_store.load_validators(1)
+        che = ConflictingHeadersEvidence(h1=committed, h2=alt)
+        che.verify_composite(committed.header, vals)  # must not raise
+        # orientation doesn't matter
+        ConflictingHeadersEvidence(h1=alt, h2=committed).verify_composite(
+            committed.header, vals
+        )
+
+    run(go())
+
+
+def test_verify_composite_rejects_unrelated_headers():
+    async def go():
+        pool, node, privs = await chain_fixture(heights=3)
+        alt1 = alt_signed_header(node, privs, 1)
+        alt2 = alt_signed_header(node, privs, 1, time_ns=12345)
+        committed = committed_signed_header(node, 1)
+        vals = node.state_store.load_validators(1)
+        che = ConflictingHeadersEvidence(h1=alt1, h2=alt2)
+        with pytest.raises(ValueError, match="committed"):
+            che.verify_composite(committed.header, vals)
+
+    run(go())
+
+
+def test_split_same_round_yields_duplicate_vote():
+    async def go():
+        pool, node, privs = await chain_fixture()
+        committed = committed_signed_header(node, 1)
+        alt = alt_signed_header(node, privs, 1, round_=committed.commit.round)
+        vals = node.state_store.load_validators(1)
+        che = ConflictingHeadersEvidence(h1=committed, h2=alt)
+        pieces = che.split(committed.header, vals, pool.val_to_last_height)
+        assert len(pieces) == 1
+        assert isinstance(pieces[0], DuplicateVoteEvidence)
+        # the piece itself verifies
+        _, val = vals.get_by_address(pieces[0].address())
+        pieces[0].verify(CHAIN_ID, val.pub_key)
+
+    run(go())
+
+
+def test_split_different_round_yields_potential_amnesia():
+    async def go():
+        pool, node, privs = await chain_fixture()
+        committed = committed_signed_header(node, 1)
+        alt = alt_signed_header(node, privs, 1, round_=committed.commit.round + 1)
+        vals = node.state_store.load_validators(1)
+        che = ConflictingHeadersEvidence(h1=committed, h2=alt)
+        pieces = che.split(committed.header, vals, pool.val_to_last_height)
+        assert len(pieces) == 1
+        assert isinstance(pieces[0], PotentialAmnesiaEvidence)
+
+    run(go())
+
+
+def test_split_bad_app_hash_yields_lunatic():
+    async def go():
+        pool, node, privs = await chain_fixture()
+        committed = committed_signed_header(node, 1)
+        alt = alt_signed_header(node, privs, 1, app_hash=b"\x66" * 8)
+        vals = node.state_store.load_validators(1)
+        che = ConflictingHeadersEvidence(h1=committed, h2=alt)
+        pieces = che.split(committed.header, vals, pool.val_to_last_height)
+        assert pieces and all(isinstance(p, LunaticValidatorEvidence) for p in pieces)
+        assert pieces[0].invalid_header_field == "app_hash"
+        pieces[0].verify_header(committed.header)  # field genuinely differs
+
+    run(go())
+
+
+def test_split_phantom_signer():
+    async def go():
+        from tendermint_tpu.crypto.keys import Ed25519PrivKey
+        from tendermint_tpu.types.block import CommitSig, Commit
+        from tendermint_tpu.types.block import BLOCK_ID_FLAG_COMMIT
+
+        pool, node, privs = await chain_fixture()
+        committed = committed_signed_header(node, 1)
+        alt = alt_signed_header(node, privs, 1)
+        vals = node.state_store.load_validators(1)
+
+        # splice a phantom signer's vote into the alt commit
+        phantom_priv = Ed25519PrivKey.from_secret(b"phantom")
+        bid = alt.commit.block_id
+        pv = Vote(
+            vote_type=PRECOMMIT_TYPE,
+            height=1,
+            round=alt.commit.round,
+            block_id=bid,
+            timestamp_ns=alt.header.time_ns,
+            validator_address=phantom_priv.pub_key().address(),
+            validator_index=len(alt.commit.signatures),
+        )
+        pv.signature = phantom_priv.sign(pv.sign_bytes(CHAIN_ID))
+        sigs = list(alt.commit.signatures) + [
+            CommitSig(
+                block_id_flag=BLOCK_ID_FLAG_COMMIT,
+                validator_address=pv.validator_address,
+                timestamp_ns=pv.timestamp_ns,
+                signature=pv.signature,
+            )
+        ]
+        alt2 = SignedHeader(
+            header=alt.header,
+            commit=Commit(height=1, round=alt.commit.round, block_id=bid, signatures=sigs),
+        )
+
+        che = ConflictingHeadersEvidence(h1=committed, h2=alt2)
+        # the phantom was "last seen" at height 1 per our records
+        val_to_last = dict(pool.val_to_last_height)
+        val_to_last[pv.validator_address] = 1
+        pieces = che.split(committed.header, vals, val_to_last)
+        phantoms = [p for p in pieces if isinstance(p, PhantomValidatorEvidence)]
+        assert len(phantoms) == 1
+        assert phantoms[0].address() == pv.validator_address
+        phantoms[0].verify(CHAIN_ID, phantom_priv.pub_key())
+
+    run(go())
+
+
+# -- pool integration --------------------------------------------------------
+
+
+def test_pool_splits_composite_and_stores_pieces():
+    async def go():
+        pool, node, privs = await chain_fixture()
+        committed = committed_signed_header(node, 1)
+        alt = alt_signed_header(node, privs, 1, round_=committed.commit.round)
+        che = ConflictingHeadersEvidence(h1=committed, h2=alt)
+        pool.add_evidence(che)
+        pending = pool.pending_evidence()
+        assert len(pending) == 1
+        assert isinstance(pending[0], DuplicateVoteEvidence)
+
+    run(go())
+
+
+def test_pool_rejects_lunatic_whose_field_matches():
+    async def go():
+        pool, node, privs = await chain_fixture()
+        committed = committed_signed_header(node, 1)
+        alt = alt_signed_header(node, privs, 1)  # app_hash NOT changed
+        ev = LunaticValidatorEvidence(
+            header=alt.header,
+            vote=alt.commit.get_vote(0),
+            invalid_header_field="app_hash",
+        )
+        with pytest.raises(ErrInvalidEvidence, match="matches"):
+            pool.add_evidence(ev)
+
+    run(go())
+
+
+def test_pool_accepts_real_lunatic():
+    async def go():
+        pool, node, privs = await chain_fixture()
+        alt = alt_signed_header(node, privs, 1, app_hash=b"\x55" * 8)
+        ev = LunaticValidatorEvidence(
+            header=alt.header,
+            vote=alt.commit.get_vote(0),
+            invalid_header_field="app_hash",
+        )
+        pool.add_evidence(ev)
+        assert pool.is_pending(ev)
+
+    run(go())
+
+
+def test_pool_rejects_phantom_who_is_a_validator():
+    async def go():
+        pool, node, privs = await chain_fixture()
+        alt = alt_signed_header(node, privs, 1)
+        # claims phantom, but the signer IS in the set at height 1
+        ev = PhantomValidatorEvidence(
+            header=alt.header,
+            vote=alt.commit.get_vote(0),
+            last_height_validator_was_in_set=1,
+        )
+        with pytest.raises(ErrInvalidEvidence, match="was a validator"):
+            pool.add_evidence(ev)
+
+    run(go())
+
+
+def test_pool_accepts_amnesia_evidence():
+    async def go():
+        pool, node, privs = await chain_fixture()
+        committed = committed_signed_header(node, 1)
+        alt = alt_signed_header(node, privs, 1, round_=committed.commit.round + 1)
+        ev = make_potential_amnesia_evidence(
+            committed.commit.get_vote(0), alt.commit.get_vote(0)
+        )
+        assert ev.validate_basic() is None
+        pool.add_evidence(ev)
+        assert pool.is_pending(ev)
+
+    run(go())
+
+
+def test_amnesia_validate_basic_rules():
+    async def go():
+        pool, node, privs = await chain_fixture()
+        committed = committed_signed_header(node, 1)
+        same_round = alt_signed_header(node, privs, 1, round_=committed.commit.round)
+        ev = make_potential_amnesia_evidence(
+            committed.commit.get_vote(0), same_round.commit.get_vote(0)
+        )
+        assert "different rounds" in (ev.validate_basic() or "")
+        # wrong order rejected
+        other = alt_signed_header(node, privs, 1, round_=committed.commit.round + 2)
+        good = make_potential_amnesia_evidence(
+            committed.commit.get_vote(0), other.commit.get_vote(0)
+        )
+        swapped = PotentialAmnesiaEvidence(vote_a=good.vote_b, vote_b=good.vote_a)
+        assert "invalid order" in (swapped.validate_basic() or "")
+
+    run(go())
+
+
+def test_split_resists_reordered_alt_signatures():
+    """The reference's two-pointer merge assumes address-sorted commits;
+    an attacker-reordered alt commit must not let equivocators escape."""
+
+    from tendermint_tpu.types.block import Commit
+    from tests import light_helpers as lh
+
+    headers, valsets = lh.gen_chain(2)
+    headers2, _ = lh.gen_chain(2)  # same keys, fresh objects
+    committed = headers[1]
+    # fork: same height/valset, different time -> different hash
+    alt_hdr = headers2[1].header
+    alt_hdr.time_ns += 7
+    alt_hdr._hash = None if hasattr(alt_hdr, "_hash") else None
+    alt = lh._sign_commit(lh.keys(4), valsets[1], alt_hdr)
+    rev = Commit(
+        height=alt.height, round=alt.round, block_id=alt.block_id,
+        signatures=list(reversed(alt.signatures)),
+    )
+    alt_sh = SignedHeader(header=alt_hdr, commit=rev)
+    che = ConflictingHeadersEvidence(h1=committed, h2=alt_sh)
+    pieces = che.split(committed.header, valsets[1], {})
+    dupes = [p for p in pieces if isinstance(p, DuplicateVoteEvidence)]
+    assert len(dupes) == 4  # every equivocator still caught
+
+
+def test_split_amnesia_pieces_are_valid_either_orientation():
+    """Split must emit PotentialAmnesia pieces that pass their own
+    validate_basic regardless of h1/h2 orientation (BlockID ordering)."""
+
+    async def go():
+        pool, node, privs = await chain_fixture()
+        committed = committed_signed_header(node, 1)
+        alt = alt_signed_header(node, privs, 1, round_=committed.commit.round + 1)
+        vals = node.state_store.load_validators(1)
+        for h1, h2 in ((committed, alt), (alt, committed)):
+            che = ConflictingHeadersEvidence(h1=h1, h2=h2)
+            pieces = che.split(committed.header, vals, pool.val_to_last_height)
+            assert len(pieces) == 1
+            assert pieces[0].validate_basic() is None
+
+    run(go())
