@@ -184,21 +184,25 @@ class BlockchainReactor(Reactor):
         # it would only waste device work under valset churn.
         assumed_vals = self.state.validators
         assumed_hash = assumed_vals.hash()
-        window: list = []
+        # capture each block AND its commit now — remove_peer may pop
+        # entries from self._blocks while apply_block awaits below
+        window: list = []  # (block, commit-for-block)
         i = h
-        while len(window) < PROCESS_WINDOW and self._blocks.get(i) is not None \
-                and self._blocks.get(i + 1) is not None:
-            if window and self._blocks[i].header.validators_hash != assumed_hash:
+        while len(window) < PROCESS_WINDOW:
+            blk, nxt = self._blocks.get(i), self._blocks.get(i + 1)
+            if blk is None or nxt is None:
                 break
-            window.append(self._blocks[i])
+            if window and blk.header.validators_hash != assumed_hash:
+                break
+            window.append((blk, nxt.last_commit))
             i += 1
 
-        parts = [b.make_part_set() for b in window]
-        bids = [BlockID(hash=b.hash(), parts=p.header()) for b, p in zip(window, parts)]
+        parts = [b.make_part_set() for b, _ in window]
+        bids = [BlockID(hash=b.hash(), parts=p.header()) for (b, _), p in zip(window, parts)]
         specs = [
             CommitVerifySpec(
                 assumed_vals, self.state.chain_id, bids[j],
-                window[j].header.height, self._blocks[window[j].header.height + 1].last_commit,
+                window[j][0].header.height, window[j][1],
             )
             for j in range(len(window))
         ]
@@ -207,7 +211,7 @@ class BlockchainReactor(Reactor):
         results = verify_commits_batched(specs)
 
         progressed = False
-        for j, first in enumerate(window):
+        for j, (first, commit) in enumerate(window):
             hh = first.header.height
             err = results[j]
             if err is not None:
@@ -224,10 +228,10 @@ class BlockchainReactor(Reactor):
                             peer, f"bad block {hh}: {err}"
                         )
                 return progressed
-            self._store.save_block(first, parts[j], self._blocks[hh + 1].last_commit)
+            self._store.save_block(first, parts[j], commit)
             self.state, _ = await self._block_exec.apply_block(self.state, bids[j], first)
             self.scheduler.block_processed(hh)
-            del self._blocks[hh]
+            self._blocks.pop(hh, None)
             progressed = True
             if self.state.validators.hash() != assumed_hash:
                 # validator set changed at hh: the batch verified the rest

@@ -81,15 +81,83 @@ class WAL:
 
 
 class BaseWAL(WAL):
-    """File-backed WAL. The reference rotates via autofile.Group with
-    checkpoints; a single append-only file keeps identical crash
-    semantics (fsync ordering) — group rotation only bounds disk, which
-    `prune_to_height` covers by rewriting the tail."""
+    """File-backed WAL with head rotation (autofile.Group analog,
+    libs/autofile/group.go:54): the head file `wal` rotates to
+    `wal.000`, `wal.001`, ... when it exceeds ``head_size_limit``
+    (reference defaultHeadSizeLimit 10MB); oldest rotated files are
+    deleted when the group exceeds ``total_size_limit`` (reference
+    defaultTotalSizeLimit 1GB, checkTotalSizeLimit). Rotation happens
+    between records only, after flush+fsync, so crash semantics are
+    identical to the single-file WAL: only the head can have a torn
+    tail, repaired on start."""
 
-    def __init__(self, path: str, logger=None):
+    HEAD_SIZE_LIMIT = 10 * 1024 * 1024
+    TOTAL_SIZE_LIMIT = 1024 * 1024 * 1024
+
+    def __init__(
+        self,
+        path: str,
+        logger=None,
+        head_size_limit: int = HEAD_SIZE_LIMIT,
+        total_size_limit: int = TOTAL_SIZE_LIMIT,
+    ):
         self.path = path
         self.logger = logger or get_logger("wal")
+        self.head_size_limit = head_size_limit
+        self.total_size_limit = total_size_limit
         self._fp = None
+
+    # -- file group --------------------------------------------------------
+
+    def _rotated_paths(self) -> list:
+        """Rotated files, oldest first."""
+        d = os.path.dirname(self.path) or "."
+        base = os.path.basename(self.path)
+        out = []
+        if os.path.isdir(d):
+            for name in os.listdir(d):
+                if name.startswith(base + "."):
+                    suffix = name[len(base) + 1 :]
+                    if suffix.isdigit():
+                        out.append((int(suffix), os.path.join(d, name)))
+        return [p for _, p in sorted(out)]
+
+    def _all_paths(self) -> list:
+        """Every group file in record order (rotated oldest-first, then
+        the head)."""
+        paths = self._rotated_paths()
+        if os.path.exists(self.path):
+            paths.append(self.path)
+        return paths
+
+    def _maybe_rotate(self) -> None:
+        if self._fp is None or self._fp.tell() < self.head_size_limit:
+            return
+        self.flush_and_sync()
+        self._fp.close()
+        rotated = self._rotated_paths()
+        next_idx = 0
+        if rotated:
+            next_idx = int(rotated[-1].rsplit(".", 1)[1]) + 1
+        os.replace(self.path, f"{self.path}.{next_idx:03d}")
+        self._fp = open(self.path, "ab")
+        self.logger.info("rotated WAL head", index=next_idx)
+        self._enforce_total_size()
+
+    def _enforce_total_size(self) -> None:
+        """Delete oldest rotated files while the group exceeds the total
+        limit (reference checkTotalSizeLimit group.go:238 region)."""
+        while True:
+            rotated = self._rotated_paths()
+            total = sum(os.path.getsize(p) for p in self._all_paths())
+            if total <= self.total_size_limit or not rotated:
+                return
+            oldest = rotated[0]
+            self.logger.error(
+                "WAL group exceeds total size limit; deleting oldest",
+                path=oldest,
+            )
+            os.remove(oldest)
 
     def start(self) -> None:
         os.makedirs(os.path.dirname(self.path) or ".", exist_ok=True)
@@ -98,8 +166,8 @@ class BaseWAL(WAL):
         if os.path.exists(self.path):
             self._truncate_corrupt_tail()
         self._fp = open(self.path, "ab")
-        # a fresh WAL begins with ENDHEIGHT 0 (reference wal.go:108)
-        if self._fp.tell() == 0:
+        # a fresh GROUP begins with ENDHEIGHT 0 (reference wal.go:108)
+        if self._fp.tell() == 0 and not self._rotated_paths():
             self.write_sync(EndHeightMessage(0))
 
     def stop(self) -> None:
@@ -139,6 +207,7 @@ class BaseWAL(WAL):
         :201) — used for internal messages and ENDHEIGHT."""
         self.write(msg)
         self.flush_and_sync()
+        self._maybe_rotate()
 
     def flush_and_sync(self) -> None:
         if self._fp is None:
@@ -149,22 +218,22 @@ class BaseWAL(WAL):
     # -- reading -----------------------------------------------------------
 
     def iter_messages(self, strict: bool = True) -> Iterator[object]:
-        """Decode all messages. strict=False stops at the first corrupt
+        """Decode all messages across the whole group (rotated files in
+        order, then the head). strict=False stops at the first corrupt
         record instead of raising (crash-recovery read)."""
-        if not os.path.exists(self.path):
-            return
-        with open(self.path, "rb") as fp:
-            it = _iter_records(fp)
-            while True:
-                try:
-                    _, data = next(it)
-                except StopIteration:
-                    return
-                except DataCorruptionError:
-                    if strict:
-                        raise
-                    return
-                yield decode_msg(data)
+        for path in self._all_paths():
+            with open(path, "rb") as fp:
+                it = _iter_records(fp)
+                while True:
+                    try:
+                        _, data = next(it)
+                    except StopIteration:
+                        break
+                    except DataCorruptionError:
+                        if strict:
+                            raise
+                        return
+                    yield decode_msg(data)
 
     def search_for_end_height(self, height: int) -> Tuple[Optional[list], bool]:
         """Return (messages_after_ENDHEIGHT(height), found). The reference
@@ -180,9 +249,40 @@ class BaseWAL(WAL):
             return None, False
         return msgs_after, True
 
+    def _file_has_end_height(self, path: str, height: int) -> bool:
+        with open(path, "rb") as fp:
+            it = _iter_records(fp)
+            while True:
+                try:
+                    _, data = next(it)
+                except (StopIteration, DataCorruptionError):
+                    return False
+                msg = decode_msg(data)
+                if isinstance(msg, EndHeightMessage) and msg.height == height:
+                    return True
+
     def prune_to_height(self, height: int) -> None:
-        """Drop records before ENDHEIGHT(height) — the disk-bounding
-        equivalent of autofile group rotation+checkpoint."""
+        """Drop records before ENDHEIGHT(height) — the group checkpoint.
+
+        Rotated files wholly before the sentinel's file are deleted; if
+        the sentinel lives in the head, the head is rewritten from the
+        sentinel onward. Records before the sentinel inside a rotated
+        file are kept (slack bounded by head_size_limit) — same bounded-
+        slack behavior as the reference's file-granular group pruning."""
+        sentinel_path = None
+        for path in self._all_paths():
+            if self._file_has_end_height(path, height):
+                sentinel_path = path
+                break
+        if sentinel_path is None:
+            return
+        for path in self._all_paths():
+            if path == sentinel_path:
+                break
+            os.remove(path)
+        if sentinel_path != self.path:
+            return
+        # sentinel in the head: rewrite it from the sentinel onward
         msgs, found = self.search_for_end_height(height)
         if not found:
             return

@@ -90,6 +90,13 @@ class EvidencePool:
         ev_list = [ev]
         if isinstance(ev, CompositeEvidence):
             self.logger.info("breaking up composite evidence", ev=repr(ev))
+            # validate_basic FIRST: SignedHeader.validate_basic enforces
+            # commit.block_id.hash == header.hash(), without which a real
+            # commit could be paired with a fabricated header to frame
+            # honest validators with lunatic evidence.
+            basic_err = ev.validate_basic()
+            if basic_err:
+                raise ErrInvalidEvidence(basic_err)
             header = self._committed_header(ev.height())
             vals = self._state_store.load_validators(ev.height())
             if vals is None:
@@ -181,10 +188,17 @@ class EvidencePool:
                 raise ErrInvalidEvidence(
                     f"address {addr.hex()[:12]} was a validator at height {ev.height()}"
                 )
-            if age_blocks > 0 and ev.last_height_validator_was_in_set <= age_blocks:
+            # The membership must be within the unbonding window. The
+            # reference literally compares against the evidence AGE
+            # (state/validation.go:206 `LastHeightValidatorWasInSet <=
+            # ageNumBlocks`), which rejects valid recent evidence on young
+            # chains; we compare against the max-age cutoff — the bound
+            # its comment describes and update() prunes by.
+            cutoff = height - ev_params.max_age_num_blocks
+            if ev.last_height_validator_was_in_set <= cutoff:
                 raise ErrInvalidEvidence(
                     f"last time validator was in the set at height "
-                    f"{ev.last_height_validator_was_in_set}, min: {age_blocks + 1}"
+                    f"{ev.last_height_validator_was_in_set}, min: {cutoff + 1}"
                 )
             prev_vals = self._state_store.load_validators(
                 ev.last_height_validator_was_in_set

@@ -172,6 +172,11 @@ class ConflictingHeadersEvidence(CompositeEvidence):
             raise ValueError("alt header is from a different chain")
         if committed_header.height != alt.header.height:
             raise ValueError("alt header is from a different height")
+        # the alt commit must actually sign the alt header — otherwise a
+        # REAL commit paired with a fabricated header would pass the
+        # trusting check below and frame honest validators via split()
+        if alt.commit.block_id.hash != alt.header.hash():
+            raise ValueError("alt commit does not sign the alt header")
         # DoS bound on signature count (reference :545)
         if len(alt.commit.signatures) > val_set.size() * 2:
             raise ValueError(

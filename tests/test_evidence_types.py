@@ -387,3 +387,85 @@ def test_split_amnesia_pieces_are_valid_either_orientation():
             assert pieces[0].validate_basic() is None
 
     run(go())
+
+
+def test_pool_rejects_framing_attack_real_commit_fake_header():
+    """A REAL committed commit paired with a fabricated header (bad
+    app_hash) must not pass composite verification — otherwise honest
+    validators get framed with lunatic evidence."""
+
+    async def go():
+        from tendermint_tpu.types.block import Header
+
+        pool, node, privs = await chain_fixture()
+        committed = committed_signed_header(node, 1)
+        h = committed.header
+        fake = Header(
+            chain_id=h.chain_id, height=h.height, time_ns=h.time_ns,
+            last_block_id=h.last_block_id, last_commit_hash=h.last_commit_hash,
+            data_hash=h.data_hash, validators_hash=h.validators_hash,
+            next_validators_hash=h.next_validators_hash,
+            consensus_hash=h.consensus_hash, app_hash=b"\x99" * 8,
+            last_results_hash=h.last_results_hash, evidence_hash=h.evidence_hash,
+            proposer_address=h.proposer_address,
+        )
+        # fake header + the REAL commit (which signs the real header)
+        fake_sh = SignedHeader(header=fake, commit=committed.commit)
+        che = ConflictingHeadersEvidence(h1=committed, h2=fake_sh)
+        with pytest.raises(ErrInvalidEvidence):
+            pool.add_evidence(che)
+        assert pool.pending_evidence() == []
+
+    run(go())
+
+
+def test_pool_accepts_valid_phantom_on_young_chain():
+    """A phantom whose membership is recent relative to the unbonding
+    window must be accepted even when the chain is young (the reference's
+    literal age-based check would wrongly reject this)."""
+
+    async def go():
+        from tendermint_tpu.crypto.keys import Ed25519PrivKey
+
+        pool, node, privs = await chain_fixture(heights=4)
+        alt = alt_signed_header(node, privs, 3)
+
+        phantom_priv = Ed25519PrivKey.from_secret(b"phantom2")
+        pv = Vote(
+            vote_type=PRECOMMIT_TYPE,
+            height=3,
+            round=alt.commit.round,
+            block_id=alt.commit.block_id,
+            timestamp_ns=alt.header.time_ns,
+            validator_address=phantom_priv.pub_key().address(),
+            validator_index=0,
+        )
+        pv.signature = phantom_priv.sign(pv.sign_bytes(CHAIN_ID))
+        ev = PhantomValidatorEvidence(
+            header=alt.header, vote=pv, last_height_validator_was_in_set=1
+        )
+
+        # state store wrapper: at height 1 the phantom WAS a validator
+        from tendermint_tpu.types.validator import Validator
+
+        real_store = pool._state_store
+
+        class Store:
+            def load_validators(self, h):
+                vals = real_store.load_validators(h)
+                if h == 1 and vals is not None:
+                    from tendermint_tpu.types.validator_set import ValidatorSet
+                    return ValidatorSet(
+                        [v.copy() for v in vals.validators]
+                        + [Validator(phantom_priv.pub_key(), 5)]
+                    )
+                return vals
+
+            def __getattr__(self, name):
+                return getattr(real_store, name)
+
+        pool._state_store = Store()
+        pool.add_evidence(ev)
+        assert pool.is_pending(ev)
+
+    run(go())
