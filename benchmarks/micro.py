@@ -108,6 +108,53 @@ def bench_headers_heights():
     emit(f"headers_batch_speedup_{tag}", per_header / batched, "x")
 
 
+def bench_vote_ingest():
+    """BASELINE eval 5: large-validator-set vote ingest through the
+    batched VoteSet path (types/vote_set.go:142 AddVote serial loop in
+    the reference). Scaled down by default; EVAL5_FULL=1 for 50k."""
+    from tendermint_tpu.codec.signbytes import PRECOMMIT_TYPE
+    from tendermint_tpu.crypto.batch import make_provider
+    from tendermint_tpu.crypto.keys import Ed25519PrivKey
+    from tendermint_tpu.types.block import BlockID, PartSetHeader
+    from tendermint_tpu.types.validator import Validator
+    from tendermint_tpu.types.validator_set import ValidatorSet
+    from tendermint_tpu.types.vote import Vote
+    from tendermint_tpu.types.vote_set import VoteSet
+
+    full = os.environ.get("EVAL5_FULL") == "1"
+    n = 50_000 if full else 5_000
+    micro_batch = 2_048  # gossip-arrival drain size
+
+    privs = [Ed25519PrivKey.from_secret(b"ing%d" % i) for i in range(n)]
+    vals = ValidatorSet([Validator(p.pub_key(), 10) for p in privs])
+    by_addr = {p.pub_key().address(): p for p in privs}
+    bid = BlockID(b"\x11" * 32, PartSetHeader(1, b"\x22" * 32))
+    votes = []
+    for i, val in enumerate(vals.validators):
+        v = Vote(
+            vote_type=PRECOMMIT_TYPE, height=1, round=0, block_id=bid,
+            timestamp_ns=1000 + i, validator_address=val.address,
+            validator_index=i,
+        )
+        v.signature = by_addr[val.address].sign(v.sign_bytes("ingest-chain"))
+        votes.append(v)
+
+    prov = make_provider("tpu")
+    tail = n % micro_batch or micro_batch
+    prov.warmup(sizes=(micro_batch, tail), msg_len=160)
+    vs = VoteSet("ingest-chain", 1, 0, PRECOMMIT_TYPE, vals, provider=prov)
+    t0 = time.perf_counter()
+    total_added = 0
+    for off in range(0, n, micro_batch):
+        added, errs = vs.add_votes_batched(votes[off : off + micro_batch])
+        total_added += sum(added)
+        assert not errs, errs[:1]
+    dt = time.perf_counter() - t0
+    assert total_added == n
+    emit(f"vote_ingest_{n}_validators", n / dt, "votes/s")
+    emit(f"vote_ingest_{n}_total", dt * 1e3, "ms")
+
+
 def bench_mempool():
     """mempool/bench_test.go: CheckTx + Reap."""
     from tendermint_tpu.abci.client.local import LocalClient
@@ -252,6 +299,7 @@ def bench_e2e():
 BENCHES = {
     "light": bench_light,
     "headers": bench_headers_heights,
+    "ingest": bench_vote_ingest,
     "mempool": bench_mempool,
     "secretconn": bench_secretconn,
     "valset": bench_valset,

@@ -1,0 +1,229 @@
+"""abci-cli: drive an ABCI app over socket or gRPC from the command line.
+
+Reference: abci/cmd/abci-cli/abci-cli.go — commands echo, info,
+set_option, deliver_tx, check_tx, commit, query, console (interactive),
+batch (stdin), kvstore / counter (serve the example apps), and `test`
+(the conformance suite, abci/tests/test_app/main.go + test_cli golden
+flavor) against a running app.
+
+    python -m tendermint_tpu.abci.cli --address tcp://127.0.0.1:26658 echo hi
+    python -m tendermint_tpu.abci.cli counter --serial          # serve
+    python -m tendermint_tpu.abci.cli --abci grpc test          # conformance
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import shlex
+import sys
+
+from tendermint_tpu.abci import types as t
+
+DEFAULT_ADDR = "tcp://127.0.0.1:26658"
+
+
+def _make_client(addr: str, transport: str):
+    if transport == "grpc":
+        from tendermint_tpu.abci.client.grpc import GRPCClient
+
+        return GRPCClient(addr)
+    from tendermint_tpu.abci.client.socket import SocketClient
+
+    return SocketClient(addr)
+
+
+def _print_response(res) -> None:
+    name = type(res).__name__[len("Response"):]
+    fields = []
+    for f in getattr(res, "__dataclass_fields__", {}):
+        v = getattr(res, f)
+        if v in (None, "", b"", 0, []):
+            continue
+        if isinstance(v, bytes):
+            v = "0x" + v.hex()
+        fields.append(f"{f}: {v}")
+    code = getattr(res, "code", 0)
+    print(f"-> {name} code: {code}" + ("".join("\n-> " + f for f in fields)))
+
+
+async def _run_one(client, cmd: str, args: list) -> int:
+    """Execute one console/CLI command; returns exit code."""
+    if cmd == "echo":
+        res = await client.echo_sync(" ".join(args))
+    elif cmd == "info":
+        res = await client.info_sync(t.RequestInfo())
+    elif cmd == "set_option":
+        if len(args) != 2:
+            print("usage: set_option <key> <value>", file=sys.stderr)
+            return 1
+        res = await client.set_option_sync(t.RequestSetOption(args[0], args[1]))
+    elif cmd in ("deliver_tx", "check_tx", "query"):
+        if not args:
+            print(f"usage: {cmd} <data>", file=sys.stderr)
+            return 1
+        data = args[0]
+        raw = bytes.fromhex(data[2:]) if data.startswith("0x") else data.encode()
+        if cmd == "deliver_tx":
+            res = await client.deliver_tx_sync(t.RequestDeliverTx(raw))
+        elif cmd == "check_tx":
+            res = await client.check_tx_sync(t.RequestCheckTx(raw))
+        else:
+            res = await client.query_sync(t.RequestQuery(data=raw, path=args[1] if len(args) > 1 else ""))
+    elif cmd == "commit":
+        res = await client.commit_sync()
+    else:
+        print(f"unknown command {cmd!r}", file=sys.stderr)
+        return 1
+    _print_response(res)
+    return 0
+
+
+async def _console(client, lines=None) -> int:
+    """Interactive console / batch mode (reference cmdConsole/cmdBatch)."""
+    rc = 0
+    if lines is None:
+        print("> ", end="", flush=True)
+        lines = sys.stdin
+    for line in lines:
+        line = line.strip()
+        if not line:
+            continue
+        if line in ("exit", "quit"):
+            break
+        try:
+            parts = shlex.split(line)
+            rc = await _run_one(client, parts[0], parts[1:])
+        except Exception as e:
+            # one malformed line must not kill the session (the reference
+            # console prints the error and re-prompts)
+            print(f"error: {e}", file=sys.stderr)
+            rc = 1
+        if lines is sys.stdin:
+            print("> ", end="", flush=True)
+    return rc
+
+
+# -- conformance test suite --------------------------------------------------
+
+
+class ConformanceError(Exception):
+    pass
+
+
+async def run_conformance(client, log=print) -> None:
+    """The abci/tests/test_app flow against a COUNTER app in serial mode:
+    echo round-trip, info, serial CheckTx/DeliverTx accept/reject matrix,
+    commit-hash progression."""
+
+    async def expect(what, got, want):
+        if got != want:
+            raise ConformanceError(f"{what}: got {got!r}, want {want!r}")
+        log(f"ok {what}")
+
+    res = await client.echo_sync("conformance")
+    await expect("echo round-trip", res.message, "conformance")
+
+    await client.info_sync(t.RequestInfo())
+    log("ok info")
+
+    await client.set_option_sync(t.RequestSetOption("serial", "on"))
+    log("ok set_option serial=on")
+
+    # bad tx (too long) rejected by CheckTx
+    res = await client.check_tx_sync(t.RequestCheckTx(b"\x00" * 9))
+    if res.code == 0:
+        raise ConformanceError("oversize tx accepted by CheckTx")
+    log("ok check_tx rejects oversize")
+
+    # serial delivery: 0,1,2 accepted; gap rejected
+    for i in range(3):
+        res = await client.deliver_tx_sync(
+            t.RequestDeliverTx(i.to_bytes(8, "big"))
+        )
+        await expect(f"deliver_tx {i} code", res.code, 0)
+    res = await client.deliver_tx_sync(t.RequestDeliverTx((7).to_bytes(8, "big")))
+    if res.code == 0:
+        raise ConformanceError("out-of-order tx accepted by DeliverTx")
+    log("ok deliver_tx rejects gap")
+
+    # commit hash encodes the tx count big-endian
+    res = await client.commit_sync()
+    await expect("commit hash", res.data, (3).to_bytes(8, "big"))
+
+    # query paths
+    res = await client.query_sync(t.RequestQuery(data=b"", path="tx"))
+    await expect("query tx count", res.value, b"3")
+    log("CONFORMANCE PASSED")
+
+
+# -- servers -----------------------------------------------------------------
+
+
+async def _serve(app, addr: str, transport: str) -> None:
+    if transport == "grpc":
+        from tendermint_tpu.abci.server.grpc import GRPCServer
+
+        srv = GRPCServer(addr, app)
+    else:
+        from tendermint_tpu.abci.server.socket import SocketServer
+
+        srv = SocketServer(addr, app)
+    await srv.start()
+    print(f"serving {type(app).__name__} at {srv.listen_addr} ({transport})")
+    try:
+        while True:
+            await asyncio.sleep(3600)
+    finally:
+        await srv.stop()
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="abci-cli")
+    ap.add_argument("--address", default=DEFAULT_ADDR)
+    ap.add_argument("--abci", default="socket", choices=("socket", "grpc"))
+    sub = ap.add_subparsers(dest="cmd", required=True)
+    for c in ("echo", "info", "set_option", "deliver_tx", "check_tx", "commit",
+              "query", "console", "batch", "test"):
+        sc = sub.add_parser(c)
+        sc.add_argument("args", nargs="*")
+    for c in ("kvstore", "counter"):
+        sc = sub.add_parser(c)
+        sc.add_argument("--serial", action="store_true")
+    ns = ap.parse_args(argv)
+
+    async def go() -> int:
+        if ns.cmd in ("kvstore", "counter"):
+            if ns.cmd == "kvstore":
+                from tendermint_tpu.abci.examples import KVStoreApplication
+
+                app = KVStoreApplication()
+            else:
+                from tendermint_tpu.abci.examples import CounterApplication
+
+                app = CounterApplication(serial=getattr(ns, "serial", False))
+            await _serve(app, ns.address, ns.abci)
+            return 0
+        client = _make_client(ns.address, ns.abci)
+        await client.start()
+        try:
+            if ns.cmd == "console":
+                return await _console(client)
+            if ns.cmd == "batch":
+                return await _console(client, lines=list(sys.stdin))
+            if ns.cmd == "test":
+                try:
+                    await run_conformance(client)
+                    return 0
+                except ConformanceError as e:
+                    print(f"CONFORMANCE FAILED: {e}", file=sys.stderr)
+                    return 1
+            return await _run_one(client, ns.cmd, ns.args)
+        finally:
+            await client.stop()
+
+    return asyncio.run(go())
+
+
+if __name__ == "__main__":
+    sys.exit(main())

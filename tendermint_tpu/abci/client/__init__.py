@@ -1,5 +1,6 @@
 from tendermint_tpu.abci.client.base import ABCIClient, ReqRes
 from tendermint_tpu.abci.client.local import LocalClient
 from tendermint_tpu.abci.client.socket import SocketClient
+from tendermint_tpu.abci.client.grpc import GRPCClient
 
-__all__ = ["ABCIClient", "ReqRes", "LocalClient", "SocketClient"]
+__all__ = ["ABCIClient", "ReqRes", "LocalClient", "SocketClient", "GRPCClient"]

@@ -1,0 +1,116 @@
+"""gRPC ABCI client (reference abci/client/grpc_client.go).
+
+One unary RPC per request type on the ``ABCIApplication`` service,
+message bodies framed with this tree's deterministic ABCI codec (clean
+wire break, no protoc stubs — same approach as rpc/grpc_api.py).
+
+Ordering: a single sender task drains a FIFO queue, so responses are
+delivered in submission order exactly like the socket client — the
+reference gRPC client likewise serializes (grpc_client.go's mutex) and
+documents that socket is the faster transport.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Optional
+
+import grpc
+
+from tendermint_tpu.abci import codec
+from tendermint_tpu.abci import types as t
+from tendermint_tpu.abci.client.base import ABCIClient, ABCIClientError, ReqRes
+from tendermint_tpu.abci.client.socket import _matches
+
+SERVICE = "tendermint_tpu.abci.ABCIApplication"
+
+# request class name -> RPC method name
+def _method_for(req) -> str:
+    return type(req).__name__[len("Request"):]
+
+
+def encode_body(msg) -> bytes:
+    """tag||payload without the socket transport's uvarint length prefix
+    (gRPC does its own framing)."""
+    framed = codec.encode_msg(msg)
+    i = 0
+    while framed[i] & 0x80:
+        i += 1
+    return framed[i + 1 :]
+
+
+class GRPCClient(ABCIClient):
+    def __init__(self, addr: str):
+        super().__init__()
+        self._addr = addr.replace("tcp://", "")
+        self._channel: Optional[grpc.aio.Channel] = None
+        self._queue: asyncio.Queue = None
+        self._err: Optional[Exception] = None
+
+    async def on_start(self) -> None:
+        self._channel = grpc.aio.insecure_channel(self._addr)
+        # build the per-method multicallables once — this client is the
+        # per-tx throughput path (CheckTx/DeliverTx)
+        self._calls = {
+            m: self._channel.unary_unary(
+                f"/{SERVICE}/{m}",
+                request_serializer=bytes,
+                response_deserializer=bytes,
+            )
+            for m in ("Echo", "Info", "SetOption", "Query", "CheckTx",
+                      "InitChain", "BeginBlock", "DeliverTx", "EndBlock", "Commit")
+        }
+        self._queue = asyncio.Queue()
+        self.spawn(self._sender_routine(), name="abci-grpc-sender")
+
+    async def on_stop(self) -> None:
+        if self._channel is not None:
+            await self._channel.close()
+        if self._queue is not None:
+            while not self._queue.empty():
+                _, rr = self._queue.get_nowait()
+                if not rr.future.done():
+                    rr.future.set_exception(ABCIClientError("client stopped"))
+
+    def send_async(self, req) -> ReqRes:
+        if self._err is not None:
+            raise self._err
+        if self._queue is None:
+            raise ABCIClientError("client not started")
+        rr = ReqRes(req)
+        self._queue.put_nowait((req, rr))
+        return rr
+
+    async def _call(self, req):
+        if isinstance(req, t.RequestFlush):
+            return t.ResponseFlush()
+        return codec.decode_msg(await self._calls[_method_for(req)](encode_body(req)))
+
+    async def _sender_routine(self) -> None:
+        while True:
+            req, rr = await self._queue.get()
+            try:
+                res = await self._call(req)
+                # same pairing rule as the socket client: a mismatched
+                # response type is a broken transport (poison), but a
+                # ResponseException is a PER-REQUEST error surfaced via
+                # ReqRes.wait — it must not brick the client.
+                if not _matches(req, res):
+                    raise ABCIClientError(
+                        f"unexpected response type {type(res).__name__} "
+                        f"for request {type(req).__name__}"
+                    )
+            except asyncio.CancelledError:
+                if not rr.future.done():
+                    rr.future.set_exception(ABCIClientError("client stopped"))
+                raise
+            except Exception as e:
+                # transport-level failure: fatal, like the socket client's
+                # connection loss (the reference kills the node on a dead
+                # app conn)
+                self._err = e if isinstance(e, ABCIClientError) else ABCIClientError(str(e))
+                if not rr.future.done():
+                    rr.future.set_exception(self._err)
+                continue
+            self._notify(req, res)
+            rr.set_response(res)

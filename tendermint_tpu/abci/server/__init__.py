@@ -1,3 +1,4 @@
 from tendermint_tpu.abci.server.socket import SocketServer
+from tendermint_tpu.abci.server.grpc import GRPCServer
 
-__all__ = ["SocketServer"]
+__all__ = ["SocketServer", "GRPCServer"]

@@ -1,0 +1,80 @@
+"""gRPC ABCI server (reference abci/server/grpc_server.go).
+
+Serves an application over the ``ABCIApplication`` service — one unary
+method per request type, bodies in the deterministic ABCI codec. A
+shared app lock serializes requests across connections, matching the
+socket server (and the reference's global app mutex).
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Optional
+
+import grpc
+
+from tendermint_tpu.abci import codec
+from tendermint_tpu.abci import types as t
+from tendermint_tpu.abci.application import Application, handle_request
+from tendermint_tpu.abci.client.grpc import SERVICE, encode_body
+from tendermint_tpu.utils.log import get_logger
+from tendermint_tpu.utils.service import Service
+
+_METHODS = (
+    "Echo", "Flush", "Info", "SetOption", "Query", "CheckTx",
+    "InitChain", "BeginBlock", "DeliverTx", "EndBlock", "Commit",
+)
+
+
+class GRPCServer(Service):
+    def __init__(self, addr: str, app: Application, logger=None):
+        super().__init__()
+        self._addr = addr.replace("tcp://", "")
+        self._app = app
+        self._app_lock = asyncio.Lock()
+        self.logger = logger or get_logger("abci.grpc")
+        self._server: Optional[grpc.aio.Server] = None
+        self.bound_port: Optional[int] = None
+
+    @property
+    def listen_addr(self) -> str:
+        host = self._addr.rsplit(":", 1)[0]
+        return f"tcp://{host}:{self.bound_port}"
+
+    async def on_start(self) -> None:
+        self._server = grpc.aio.server()
+        handlers = {
+            m: grpc.unary_unary_rpc_method_handler(
+                self._handler,
+                request_deserializer=bytes,
+                response_serializer=bytes,
+            )
+            for m in _METHODS
+        }
+        self._server.add_generic_rpc_handlers(
+            (grpc.method_handlers_generic_handler(SERVICE, handlers),)
+        )
+        self.bound_port = self._server.add_insecure_port(self._addr)
+        if self.bound_port == 0:
+            raise RuntimeError(f"failed to bind gRPC ABCI server to {self._addr}")
+        await self._server.start()
+        self.logger.info("gRPC ABCI server listening", port=self.bound_port)
+
+    async def on_stop(self) -> None:
+        if self._server is not None:
+            await self._server.stop(1.0)
+
+    async def _handler(self, request: bytes, context) -> bytes:
+        try:
+            req = codec.decode_msg(request)
+        except Exception as e:
+            return encode_body(t.ResponseException(f"decode error: {e}"))
+        async with self._app_lock:
+            try:
+                res = handle_request(self._app, req)
+                if asyncio.iscoroutine(res):
+                    res = await res
+            except Exception as e:
+                self.logger.error("app raised", err=repr(e))
+                res = t.ResponseException(str(e))
+        return encode_body(res)

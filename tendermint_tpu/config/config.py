@@ -45,8 +45,8 @@ class BaseConfig:
     priv_validator_state_name: str = os.path.join(DEFAULT_DATA_DIR, DEFAULT_PRIVVAL_STATE_FILE)
     priv_validator_laddr: str = ""  # remote signer listen addr
     node_key_name: str = os.path.join(DEFAULT_CONFIG_DIR, DEFAULT_NODE_KEY_FILE)
-    abci: str = "local"  # local | socket
-    proxy_app: str = "kvstore"  # app id for local, or tcp://... for socket
+    abci: str = "local"  # local | socket | grpc
+    proxy_app: str = "kvstore"  # app id for local, or tcp://... for socket/grpc
     prof_laddr: str = ""
     filter_peers: bool = False
     # TPU crypto provider selection (the plugin seam BASELINE.json names)
@@ -70,7 +70,7 @@ class BaseConfig:
     def validate_basic(self) -> Optional[str]:
         if self.db_backend not in ("sqlite", "memdb"):
             return f"unknown db_backend {self.db_backend!r}"
-        if self.abci not in ("local", "socket"):
+        if self.abci not in ("local", "socket", "grpc"):
             return f"unknown abci transport {self.abci!r}"
         return None
 

@@ -138,22 +138,32 @@ class VerifierModel:
             return e
 
     def _zero_args(self, kind: str, n_pad: int, msg_len: int):
-        pk = jnp.zeros((n_pad, 32), dtype=jnp.uint8)
-        mg = jnp.zeros((n_pad, msg_len), dtype=jnp.uint8)
-        sg = jnp.zeros((n_pad, 64), dtype=jnp.uint8)
+        # Build from HOST arrays exactly like the live call sites do:
+        # jit specializes on input layout provenance, so warming with
+        # device-native jnp.zeros compiles an executable the live
+        # host-transferred inputs then miss (observed: a second ~11s
+        # compile on the first real call after warmup).
+        pk = jnp.asarray(np.zeros((n_pad, 32), dtype=np.uint8))
+        mg = jnp.asarray(np.zeros((n_pad, msg_len), dtype=np.uint8))
+        sg = jnp.asarray(np.zeros((n_pad, 64), dtype=np.uint8))
         if kind == "verify":
             return (pk, mg, sg)
         return (
             pk, mg, sg,
-            jnp.zeros((n_pad, ops_ed.POWER_CHUNKS), dtype=jnp.int32),
-            jnp.zeros((n_pad,), dtype=bool),
+            jnp.asarray(np.zeros((n_pad, ops_ed.POWER_CHUNKS), dtype=np.int32)),
+            jnp.asarray(np.zeros((n_pad,), dtype=bool)),
         )
 
     def _warm_entry(self, e: _Entry, kind: str, n_pad: int, msg_len: int) -> None:
-        """Force compilation by running on zeros; records compile time."""
+        """Force compilation AND a first full execution by running on
+        zeros. The device-to-host read is load-bearing: on the tunneled
+        TPU backend block_until_ready returns before the first real
+        execution completes, leaving ~6s of program-load latency to be
+        paid by the first live call's d2h read — np.asarray forces it
+        here instead."""
         t0 = time.perf_counter()
         out = e.fn(*self._zero_args(kind, n_pad, msg_len))
-        jax.block_until_ready(out)
+        jax.tree_util.tree_map(np.asarray, out)
         e.compile_s = time.perf_counter() - t0
         e.ready = True
         self.logger.info(

@@ -129,6 +129,12 @@ class Node(Service):
 
             self.app = None
             self.proxy_app = SocketClient(config.base.proxy_app)
+        elif config.base.abci == "grpc":
+            # remote app over gRPC (reference abci/client/grpc_client.go)
+            from tendermint_tpu.abci.client.grpc import GRPCClient
+
+            self.app = None
+            self.proxy_app = GRPCClient(config.base.proxy_app)
         else:
             raise ValueError(f"unknown abci transport {config.base.abci!r}")
 

@@ -1,0 +1,181 @@
+"""gRPC ABCI transport + abci-cli conformance suite.
+
+Reference: abci/client/grpc_client.go, abci/server/grpc_server.go,
+abci/tests/test_app (conformance), abci/cmd/abci-cli.
+"""
+
+import asyncio
+
+import pytest
+
+from tendermint_tpu.abci import types as t
+from tendermint_tpu.abci.cli import run_conformance
+from tendermint_tpu.abci.client.grpc import GRPCClient
+from tendermint_tpu.abci.examples import CounterApplication, KVStoreApplication
+from tendermint_tpu.abci.server.grpc import GRPCServer
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+async def _grpc_pair(app):
+    srv = GRPCServer("127.0.0.1:0", app)
+    await srv.start()
+    cli = GRPCClient(f"127.0.0.1:{srv.bound_port}")
+    await cli.start()
+    return srv, cli
+
+
+def test_grpc_roundtrip_all_methods():
+    async def go():
+        srv, cli = await _grpc_pair(KVStoreApplication())
+        try:
+            assert (await cli.echo_sync("hello")).message == "hello"
+            info = await cli.info_sync(t.RequestInfo())
+            assert info.last_block_height == 0
+            res = await cli.deliver_tx_sync(t.RequestDeliverTx(b"k=v"))
+            assert res.code == 0
+            commit = await cli.commit_sync()
+            assert commit.data  # app hash present
+            q = await cli.query_sync(t.RequestQuery(data=b"k", path="/store"))
+            assert q.value == b"v"
+            chk = await cli.check_tx_sync(t.RequestCheckTx(b"a=b"))
+            assert chk.code == 0
+            await cli.flush()
+        finally:
+            await cli.stop()
+            await srv.stop()
+
+    run(go())
+
+
+def test_grpc_pipelined_async_ordering():
+    """send_async preserves FIFO response order like the socket client."""
+
+    async def go():
+        srv, cli = await _grpc_pair(CounterApplication(serial=True))
+        try:
+            rrs = [
+                cli.send_async(t.RequestDeliverTx(i.to_bytes(8, "big")))
+                for i in range(20)
+            ]
+            results = [await rr.wait() for rr in rrs]
+            assert all(r.code == 0 for r in results)
+            commit = await cli.commit_sync()
+            assert commit.data == (20).to_bytes(8, "big")
+        finally:
+            await cli.stop()
+            await srv.stop()
+
+    run(go())
+
+
+def test_grpc_app_exception_surfaces_as_error():
+    class BoomApp(KVStoreApplication):
+        def deliver_tx(self, req):
+            raise RuntimeError("boom")
+
+    async def go():
+        srv, cli = await _grpc_pair(BoomApp())
+        try:
+            with pytest.raises(Exception, match="boom"):
+                await cli.deliver_tx_sync(t.RequestDeliverTx(b"x"))
+        finally:
+            await cli.stop()
+            await srv.stop()
+
+    run(go())
+
+
+def test_conformance_suite_over_grpc():
+    async def go():
+        srv, cli = await _grpc_pair(CounterApplication())
+        try:
+            await run_conformance(cli, log=lambda *a: None)
+        finally:
+            await cli.stop()
+            await srv.stop()
+
+    run(go())
+
+
+def test_conformance_suite_over_socket():
+    from tendermint_tpu.abci.client.socket import SocketClient
+    from tendermint_tpu.abci.server.socket import SocketServer
+
+    async def go():
+        srv = SocketServer("tcp://127.0.0.1:0", CounterApplication())
+        await srv.start()
+        cli = SocketClient(srv.listen_addr)
+        await cli.start()
+        try:
+            await run_conformance(cli, log=lambda *a: None)
+        finally:
+            await cli.stop()
+            await srv.stop()
+
+    run(go())
+
+
+def test_node_runs_against_grpc_app(tmp_path):
+    """A full node commits blocks with its app behind the gRPC transport
+    (reference: tendermint node --abci grpc)."""
+
+    async def go():
+        import os
+
+        from tendermint_tpu.cli import main as cli_main
+        from tendermint_tpu.config import load_config
+        from tendermint_tpu.node import default_new_node
+
+        app = KVStoreApplication()
+        srv = GRPCServer("127.0.0.1:0", app)
+        await srv.start()
+
+        home = str(tmp_path / "grpcnode")
+        cli_main(["--home", home, "init", "--chain-id", "grpc-chain"])
+        cfg = load_config(os.path.join(home, "config/config.toml")).set_root(home)
+        cfg.base.db_backend = "memdb"
+        cfg.base.abci = "grpc"
+        cfg.base.proxy_app = srv.listen_addr
+        cfg.p2p.laddr = "tcp://127.0.0.1:0"
+        cfg.consensus.timeout_commit_ms = 50
+        cfg.consensus.skip_timeout_commit = True
+        node = default_new_node(cfg)
+        await node.start()
+        try:
+            await node.mempool.check_tx(b"grpc=app")
+            await node.consensus_state.wait_for_height(3, timeout_s=30)
+            assert app._db.get(b"kv:grpc") == b"app"
+        finally:
+            await node.stop()
+            await srv.stop()
+
+    run(go())
+
+
+def test_grpc_response_exception_does_not_poison_client():
+    """A per-request app error surfaces on that request only; the client
+    keeps serving later requests (socket-transport parity)."""
+
+    class FlakyApp(CounterApplication):
+        def deliver_tx(self, req):
+            if req.tx == b"boom":
+                raise RuntimeError("boom")
+            return super().deliver_tx(req)
+
+    async def go():
+        srv, cli = await _grpc_pair(FlakyApp())
+        try:
+            with pytest.raises(Exception, match="boom"):
+                await cli.deliver_tx_sync(t.RequestDeliverTx(b"boom"))
+            # client still alive
+            res = await cli.deliver_tx_sync(t.RequestDeliverTx(b"\x00"))
+            assert res.code == 0
+            assert (await cli.echo_sync("alive")).message == "alive"
+        finally:
+            await cli.stop()
+            await srv.stop()
+
+    run(go())
