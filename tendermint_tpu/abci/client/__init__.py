@@ -1,6 +1,15 @@
 from tendermint_tpu.abci.client.base import ABCIClient, ReqRes
 from tendermint_tpu.abci.client.local import LocalClient
 from tendermint_tpu.abci.client.socket import SocketClient
-from tendermint_tpu.abci.client.grpc import GRPCClient
 
 __all__ = ["ABCIClient", "ReqRes", "LocalClient", "SocketClient", "GRPCClient"]
+
+
+def __getattr__(name):
+    # lazy: grpcio must not become an import-time dependency of nodes
+    # running the local/socket transports
+    if name == "GRPCClient":
+        from tendermint_tpu.abci.client.grpc import GRPCClient
+
+        return GRPCClient
+    raise AttributeError(name)

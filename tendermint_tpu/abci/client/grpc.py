@@ -107,10 +107,16 @@ class GRPCClient(ABCIClient):
             except Exception as e:
                 # transport-level failure: fatal, like the socket client's
                 # connection loss (the reference kills the node on a dead
-                # app conn)
+                # app conn) — fail THIS request, everything queued, and
+                # stop draining so nothing executes after the client is
+                # declared dead
                 self._err = e if isinstance(e, ABCIClientError) else ABCIClientError(str(e))
                 if not rr.future.done():
                     rr.future.set_exception(self._err)
-                continue
+                while not self._queue.empty():
+                    _, pending = self._queue.get_nowait()
+                    if not pending.future.done():
+                        pending.future.set_exception(self._err)
+                return
             self._notify(req, res)
             rr.set_response(res)

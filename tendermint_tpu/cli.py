@@ -83,7 +83,7 @@ def cmd_init(args) -> None:
 def cmd_node(args) -> None:
     """Reference commands/run_node.go."""
     from tendermint_tpu.node import default_new_node
-    from tendermint_tpu.rpc_attach import attach_rpc
+    from tendermint_tpu.rpc.server import RPCServer
 
     cfg = load_or_default_config(args.home)
     if args.proxy_app:
@@ -97,7 +97,7 @@ def cmd_node(args) -> None:
 
     async def run() -> None:
         node = default_new_node(cfg)
-        attach_rpc(node)
+        node.rpc_server = RPCServer(node)
         await node.start()
         stop = asyncio.Event()
         loop = asyncio.get_running_loop()
@@ -301,24 +301,119 @@ def cmd_replay(args) -> None:
     asyncio.run(run())
 
 
+async def _collect_debug_dump(rpc_laddr: str, out: str, home: str) -> None:
+    """Shared collection for `debug dump` / `debug kill` (reference
+    cmd/tendermint/commands/debug/util.go dumpStatus/dumpNetInfo/
+    dumpConsensusState + WAL copy)."""
+    from tendermint_tpu.rpc.client import HTTPClient
+
+    os.makedirs(out, exist_ok=True)
+    c = HTTPClient(rpc_laddr.replace("tcp://", ""))
+    for route in ("status", "net_info", "dump_consensus_state", "consensus_state",
+                  "num_unconfirmed_txs"):
+        try:
+            res = await c.call(route)
+            with open(os.path.join(out, f"{route}.json"), "w") as fp:
+                json.dump(res, fp, indent=2)
+            print(f"wrote {route}.json")
+        except Exception as e:
+            print(f"failed {route}: {e}")
+    # copy the consensus WAL group (debug/kill.go copyWAL)
+    wal_dir = os.path.join(home, "data", "cs.wal")
+    if os.path.isdir(wal_dir):
+        import shutil
+
+        dst = os.path.join(out, "cs.wal")
+        shutil.copytree(wal_dir, dst, dirs_exist_ok=True)
+        print(f"copied WAL -> {dst}")
+
+
 def cmd_debug(args) -> None:
-    """Reference cmd/tendermint/commands/debug/dump.go: collect
-    status/net_info/consensus dumps over RPC into a directory."""
+    """Reference cmd/tendermint/commands/debug/: `dump` collects
+    status/net_info/consensus dumps over RPC; `kill` additionally
+    SIGKILLs a running node after the evidence is safely on disk
+    (debug/kill.go:36)."""
 
     async def run() -> None:
-        from tendermint_tpu.rpc.client import HTTPClient
+        if args.mode == "kill" and args.pid <= 0:
+            # os.kill(0, ...) would signal OUR whole process group
+            print("debug kill requires a positive node pid", file=sys.stderr)
+            raise SystemExit(2)
+        await _collect_debug_dump(args.rpc_laddr, args.out, args.home)
+        if args.mode == "kill":
+            import signal as _signal
 
-        os.makedirs(args.out, exist_ok=True)
-        c = HTTPClient(args.rpc_laddr.replace("tcp://", ""))
-        for route in ("status", "net_info", "dump_consensus_state", "consensus_state",
-                      "num_unconfirmed_txs"):
-            try:
-                res = await c.call(route)
-                with open(os.path.join(args.out, f"{route}.json"), "w") as fp:
-                    json.dump(res, fp, indent=2)
-                print(f"wrote {route}.json")
-            except Exception as e:
-                print(f"failed {route}: {e}")
+            print(f"killing node process {args.pid}")
+            os.kill(args.pid, _signal.SIGKILL)
+
+    asyncio.run(run())
+
+
+def cmd_replay_console(args) -> None:
+    """Reference consensus/replay_file.go:34 RunReplayFile with console=
+    true: step through the WAL interactively — `next [N]` feeds the next
+    N messages into a fresh state machine, `rs` prints the round state,
+    `quit` exits."""
+
+    async def run() -> None:
+        from tendermint_tpu.consensus.replay import WALReplayConsole
+
+        cfg = load_or_default_config(args.home)
+        console = WALReplayConsole(cfg)
+        await console.open()
+        try:
+            print(f"{console.remaining()} WAL messages loaded; "
+                  "commands: next [N] | rs | quit")
+            src = open(args.script) if args.script else sys.stdin
+            while True:
+                if src is sys.stdin:
+                    print("> ", end="", flush=True)
+                line = src.readline()
+                if not line:
+                    break
+                parts = line.strip().split()
+                if not parts:
+                    continue
+                if parts[0] in ("quit", "exit", "q"):
+                    break
+                try:
+                    if parts[0] == "next":
+                        n = int(parts[1]) if len(parts) > 1 else 1
+                        fed = await console.step(n)
+                        print(f"fed {fed} message(s); rs={console.round_state()}")
+                    elif parts[0] == "rs":
+                        print(console.round_state())
+                    else:
+                        print(f"unknown command {parts[0]!r}")
+                except Exception as e:
+                    print(f"error: {e}")
+        finally:
+            await console.close()
+
+    asyncio.run(run())
+
+
+def cmd_signer_harness(args) -> None:
+    """Reference tools/tm-signer-harness: acceptance-test a remote
+    signer. The harness listens; point the signer under test at the
+    printed address."""
+
+    async def run() -> None:
+        from tendermint_tpu.privval.harness import HarnessFailure, run_harness
+
+        expected = None
+        if args.key_file:
+            from tendermint_tpu.privval.file import FilePVKey
+
+            expected = FilePVKey.load(args.key_file).pub_key
+        try:
+            await run_harness(
+                args.laddr, args.chain_id, expected_pub_key=expected,
+                accept_timeout_s=args.accept_timeout,
+            )
+        except HarnessFailure as e:
+            print(f"SIGNER HARNESS FAILED: {e}", file=sys.stderr)
+            raise SystemExit(1)
 
     asyncio.run(run())
 
@@ -369,10 +464,28 @@ def build_parser() -> argparse.ArgumentParser:
     sp = sub.add_parser("replay", help="replay the consensus WAL through a fresh state machine")
     sp.set_defaults(func=cmd_replay)
 
-    sp = sub.add_parser("debug", help="dump node state via RPC for debugging")
+    sp = sub.add_parser(
+        "replay_console",
+        help="step through the consensus WAL interactively (next/rs/quit)",
+    )
+    sp.add_argument("--script", default="", help="read console commands from a file")
+    sp.set_defaults(func=cmd_replay_console)
+
+    sp = sub.add_parser("debug", help="dump node state via RPC (and optionally kill it)")
+    sp.add_argument("mode", nargs="?", default="dump", choices=("dump", "kill"))
+    sp.add_argument("pid", nargs="?", type=int, default=0, help="node pid (kill mode)")
     sp.add_argument("--rpc-laddr", default="tcp://127.0.0.1:26657")
     sp.add_argument("--out", default="./debug_dump")
     sp.set_defaults(func=cmd_debug)
+
+    sp = sub.add_parser(
+        "signer_harness", help="acceptance-test a remote signer (tm-signer-harness)"
+    )
+    sp.add_argument("--laddr", default="tcp://127.0.0.1:0")
+    sp.add_argument("--chain-id", default="test-chain")
+    sp.add_argument("--key-file", default="", help="expected privval key file (optional)")
+    sp.add_argument("--accept-timeout", type=float, default=30.0)
+    sp.set_defaults(func=cmd_signer_harness)
 
     sp = sub.add_parser("testnet", help="generate testnet config dirs")
     sp.add_argument("--v", type=int, default=4, help="number of validators")

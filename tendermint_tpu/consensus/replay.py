@@ -274,3 +274,94 @@ async def _read_replay_message(cs, msg) -> None:
         await cs._handle_msg(msg)
     else:
         raise HandshakeError(f"unknown WAL message {type(msg).__name__}")
+
+
+class WALReplayConsole:
+    """Interactive WAL stepper (reference consensus/replay_file.go:34
+    RunReplayFile with console=true; commands at the :79 region).
+
+    Builds a fresh consensus state over the node's stores (handshake
+    included, like cmd_replay), loads the WAL tail for the in-flight
+    height, and feeds it one message at a time via the same
+    _read_replay_message path the automatic catchup uses.
+    """
+
+    def __init__(self, config, logger=None):
+        self.config = config
+        self.logger = logger or get_logger("replay_console")
+        self.cs = None
+        self._msgs: list = []
+        self._pos = 0
+        self._stops: list = []
+
+    async def open(self) -> None:
+        from tendermint_tpu.consensus.state import ConsensusState
+        from tendermint_tpu.consensus.wal import BaseWAL, NilWAL
+        from tendermint_tpu.node.node import default_app, make_db
+        from tendermint_tpu.abci.client.local import LocalClient
+        from tendermint_tpu.state import BlockExecutor, StateStore, state_from_genesis_doc
+        from tendermint_tpu.store.block_store import BlockStore
+        from tendermint_tpu.types.genesis import GenesisDoc
+
+        cfg = self.config
+        genesis = GenesisDoc.from_file(cfg.base.genesis_file())
+        block_store = BlockStore(make_db("blockstore", cfg))
+        state_store = StateStore(make_db("state", cfg))
+        state = state_store.load()
+        if state is None:
+            state = state_from_genesis_doc(genesis)
+            state_store.save(state)
+
+        proxy_app = LocalClient(default_app(cfg))
+        await proxy_app.start()
+        self._stops.append(proxy_app.stop)
+
+        handshaker = Handshaker(
+            state_store, state, block_store, genesis, logger=self.logger
+        )
+        await handshaker.handshake(proxy_app)
+        state = state_store.load()
+
+        block_exec = BlockExecutor(state_store, proxy_app)
+        self.cs = ConsensusState(
+            config=cfg.consensus,
+            state=state,
+            block_exec=block_exec,
+            block_store=block_store,
+            mempool=None,
+            evidence_pool=None,
+            priv_validator=None,
+            event_bus=None,
+            wal=NilWAL(),  # stepping must not append to the real WAL
+        )
+        self.cs.replay_mode = True  # ctor ran update_to_state already
+
+        wal = BaseWAL(cfg.consensus.wal_file())
+        height = state.last_block_height + 1
+        msgs, found = wal.search_for_end_height(height - 1)
+        if not found:
+            msgs = []
+        self._msgs = msgs
+        self._pos = 0
+
+    def remaining(self) -> int:
+        return len(self._msgs) - self._pos
+
+    def round_state(self) -> str:
+        return self.cs.rs.height_round_step() if self.cs else "<closed>"
+
+    async def step(self, n: int = 1) -> int:
+        """Feed the next n WAL messages; returns how many were fed."""
+        fed = 0
+        while fed < n and self._pos < len(self._msgs):
+            await _read_replay_message(self.cs, self._msgs[self._pos])
+            self._pos += 1
+            fed += 1
+        return fed
+
+    async def close(self) -> None:
+        for stop in self._stops:
+            try:
+                await stop()
+            except Exception:
+                pass

@@ -1,0 +1,259 @@
+"""Peer trust metric: PID-style score over good/bad event history.
+
+Reference: p2p/trust/metric.go (:86 NewMetric, :209 NextTimeInterval,
+faded-memory history :395 region), config.go (DefaultConfig weights
+0.4/0.6, 14-day window, 1-minute intervals), store.go (MetricStore with
+DB persistence, pause-on-disconnect).
+
+The score is  P·w_p + I·w_i + D·γ  where P is the current interval's
+good/(good+bad), I a faded-memory weighted history average, and D the
+derivative (γ=0 when improving, 1 when deteriorating — deterioration
+bites immediately). History is compressed with "faded memories": the
+i-th interval back lives at history slot floor(log2(i)), and each
+rollover merges adjacent slots 2:1, so a 20,160-interval window needs
+~15 slots.
+
+Time is advanced by `next_time_interval()` — an asyncio task drives it
+live (`start()`), tests drive it manually.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import math
+import time
+from typing import Dict, List, Optional
+
+from tendermint_tpu.utils.log import get_logger
+
+# reference metric.go:16-24
+DERIVATIVE_GAMMA1 = 0.0  # weight when current behavior >= previous
+DERIVATIVE_GAMMA2 = 1.0  # weight when current behavior < previous
+HISTORY_DATA_WEIGHT = 0.8
+
+DEFAULT_PROPORTIONAL_WEIGHT = 0.4
+DEFAULT_INTEGRAL_WEIGHT = 0.6
+DEFAULT_TRACKING_WINDOW_S = 14 * 24 * 3600.0
+DEFAULT_INTERVAL_S = 60.0
+
+
+def _interval_to_history_offset(interval: int) -> int:
+    """floor(log2(i)) — reference intervalToHistoryOffset."""
+    return int(math.floor(math.log2(interval)))
+
+
+class TrustMetric:
+    def __init__(
+        self,
+        proportional_weight: float = DEFAULT_PROPORTIONAL_WEIGHT,
+        integral_weight: float = DEFAULT_INTEGRAL_WEIGHT,
+        tracking_window_s: float = DEFAULT_TRACKING_WINDOW_S,
+        interval_s: float = DEFAULT_INTERVAL_S,
+    ):
+        self.proportional_weight = proportional_weight
+        self.integral_weight = integral_weight
+        self.interval_s = interval_s
+        self.max_intervals = int(tracking_window_s / interval_s)
+        self.history_max_size = _interval_to_history_offset(self.max_intervals) + 1
+        self.num_intervals = 0
+        self.history: List[float] = []
+        self.history_weights: List[float] = []
+        self.history_weight_sum = 0.0
+        self.history_value = 1.0
+        self.good = 0.0
+        self.bad = 0.0
+        self.paused = False
+        self._task: Optional[asyncio.Task] = None
+
+    # -- events ------------------------------------------------------------
+
+    def bad_events(self, num: int = 1) -> None:
+        self._unpause()
+        self.bad += num
+
+    def good_events(self, num: int = 1) -> None:
+        self._unpause()
+        self.good += num
+
+    def pause(self) -> None:
+        """Stop accruing intervals until the next event (reference Pause
+        :167 — used on peer disconnect so absence isn't punished)."""
+        self.paused = True
+
+    def _unpause(self) -> None:
+        if self.paused:
+            self.good = 0.0
+            self.bad = 0.0
+            self.paused = False
+
+    # -- scoring -----------------------------------------------------------
+
+    def trust_value(self) -> float:
+        return self._calc_trust_value()
+
+    def trust_score(self) -> int:
+        """0..100 (reference TrustScore :202)."""
+        return int(math.floor(self.trust_value() * 100))
+
+    def _proportional_value(self) -> float:
+        total = self.good + self.bad
+        return self.good / total if total > 0 else 1.0
+
+    def _weighted_derivative(self) -> float:
+        d = self._proportional_value() - self.history_value
+        return (DERIVATIVE_GAMMA2 if d < 0 else DERIVATIVE_GAMMA1) * d
+
+    def _calc_trust_value(self) -> float:
+        tv = (
+            self.proportional_weight * self._proportional_value()
+            + self.integral_weight * self.history_value
+            + self._weighted_derivative()
+        )
+        return max(tv, 0.0)
+
+    # -- interval rollover -------------------------------------------------
+
+    def next_time_interval(self) -> None:
+        """Reference NextTimeInterval :209."""
+        if self.paused:
+            return
+        if self.num_intervals < self.max_intervals:
+            self.num_intervals += 1
+            if self.num_intervals < self.max_intervals:
+                wk = HISTORY_DATA_WEIGHT ** self.num_intervals
+                self.history_weights.append(wk)
+                self.history_weight_sum += wk
+
+        new_hist = self._calc_trust_value()
+        self.history.append(new_hist)
+        if len(self.history) > self.history_max_size:
+            self.history = self.history[len(self.history) - self.history_max_size :]
+        self._update_faded_memory()
+        self.history_value = self._calc_history_value()
+        self.good = 0.0
+        self.bad = 0.0
+
+    def _faded_memory_value(self, interval: int) -> float:
+        first = len(self.history) - 1
+        if interval == 0:
+            return self.history[first]
+        return self.history[first - _interval_to_history_offset(interval)]
+
+    def _calc_history_value(self) -> float:
+        hv = 0.0
+        for i in range(self.num_intervals):
+            w = self.history_weights[i] if i < len(self.history_weights) else (
+                HISTORY_DATA_WEIGHT ** (i + 1)
+            )
+            hv += self._faded_memory_value(i) * w
+        return hv / self.history_weight_sum if self.history_weight_sum else 1.0
+
+    def _update_faded_memory(self) -> None:
+        """Merge older history 2:1 so log2-many slots span the window
+        (reference updateFadedMemory :395)."""
+        n = len(self.history)
+        if n < 2:
+            return
+        end = n - 1
+        for count in range(1, n):
+            i = end - count
+            x = 2.0 ** count
+            self.history[i] = (self.history[i] * (x - 1) + self.history[i + 1]) / x
+
+    # -- persistence -------------------------------------------------------
+
+    def to_json(self) -> dict:
+        return {"num_intervals": self.num_intervals, "history": list(self.history)}
+
+    def init_from_json(self, data: dict) -> None:
+        """Reference Init :138. num_intervals is clamped to what the
+        history slots can actually answer, so a short/garbled persisted
+        record can't drive _faded_memory_value out of range."""
+        hist = [float(x) for x in data.get("history", [])]
+        if len(hist) > self.history_max_size:
+            hist = hist[len(hist) - self.history_max_size :]
+        self.history = hist
+        n = min(int(data.get("num_intervals", 0)), self.max_intervals)
+        if hist:
+            # largest interval representable with len(hist) slots
+            max_answerable = 2 ** len(hist) - 1
+            n = min(n, max_answerable)
+        else:
+            n = 0
+        self.num_intervals = n
+        self.history_weights = [
+            HISTORY_DATA_WEIGHT ** i for i in range(1, self.num_intervals + 1)
+        ]
+        self.history_weight_sum = sum(self.history_weights)
+        if self.history:
+            self.history_value = self._calc_history_value()
+
+    # -- live ticking ------------------------------------------------------
+
+    def start(self) -> None:
+        if self._task is None:
+            self._task = asyncio.get_running_loop().create_task(self._tick_routine())
+
+    def stop(self) -> None:
+        if self._task is not None:
+            self._task.cancel()
+            self._task = None
+
+    async def _tick_routine(self) -> None:
+        while True:
+            await asyncio.sleep(self.interval_s)
+            self.next_time_interval()
+
+
+class TrustMetricStore:
+    """Peer-keyed metric store with DB persistence (reference
+    p2p/trust/store.go)."""
+
+    _KEY = b"trust:metrics"
+
+    def __init__(self, db, interval_s: float = DEFAULT_INTERVAL_S, logger=None):
+        self._db = db
+        self._interval_s = interval_s
+        self.logger = logger or get_logger("p2p.trust")
+        self.peer_metrics: Dict[str, TrustMetric] = {}
+        self._load()
+
+    def size(self) -> int:
+        return len(self.peer_metrics)
+
+    def get_peer_trust_metric(self, key: str) -> TrustMetric:
+        tm = self.peer_metrics.get(key)
+        if tm is None:
+            tm = TrustMetric(interval_s=self._interval_s)
+            self.peer_metrics[key] = tm
+        return tm
+
+    def peer_disconnected(self, key: str) -> None:
+        tm = self.peer_metrics.get(key)
+        if tm is not None:
+            tm.pause()
+
+    def save(self) -> None:
+        data = {k: tm.to_json() for k, tm in self.peer_metrics.items()}
+        self._db.set(self._KEY, json.dumps(data).encode())
+
+    def _load(self) -> None:
+        raw = self._db.get(self._KEY)
+        if not raw:
+            return
+        try:
+            data = json.loads(raw.decode())
+        except Exception as e:
+            self.logger.error("corrupt trust store; starting fresh", err=str(e))
+            return
+        for key, hist in data.items():
+            tm = TrustMetric(interval_s=self._interval_s)
+            try:
+                tm.init_from_json(hist)
+            except Exception as e:
+                self.logger.error(
+                    "corrupt trust record; starting peer fresh", peer=key, err=str(e)
+                )
+                tm = TrustMetric(interval_s=self._interval_s)
+            self.peer_metrics[key] = tm

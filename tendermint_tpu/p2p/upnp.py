@@ -1,0 +1,215 @@
+"""UPnP IGD port mapping (NAT traversal).
+
+Reference: p2p/upnp/upnp.go (Discover :40 region, SSDP M-SEARCH over
+239.255.255.250:1900, device-description fetch, WANIPConnection SOAP
+AddPortMapping/DeletePortMapping/GetExternalIPAddress) and probe.go
+(the makeUPNPListener/ExternalIP flow run by `tendermint probe_upnp`).
+
+Protocol plumbing (request formatting, SSDP/XML/SOAP parsing) is pure
+and unit-tested offline; only `discover()` touches the network, with a
+hard timeout — a sandboxed node simply gets ErrUPnPUnavailable.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import re
+import socket
+import xml.etree.ElementTree as ET
+from dataclasses import dataclass
+from typing import Optional, Tuple
+from urllib.parse import urljoin, urlparse
+
+from tendermint_tpu.utils.log import get_logger
+
+SSDP_ADDR = "239.255.255.250"
+SSDP_PORT = 1900
+
+_WAN_SERVICES = (
+    "urn:schemas-upnp-org:service:WANIPConnection:1",
+    "urn:schemas-upnp-org:service:WANPPPConnection:1",
+)
+
+
+class ErrUPnPUnavailable(Exception):
+    pass
+
+
+def make_search_request(timeout_s: int = 3) -> bytes:
+    """The SSDP M-SEARCH datagram (reference upnp.go Discover)."""
+    return (
+        "M-SEARCH * HTTP/1.1\r\n"
+        f"HOST: {SSDP_ADDR}:{SSDP_PORT}\r\n"
+        'MAN: "ssdp:discover"\r\n'
+        f"MX: {timeout_s}\r\n"
+        "ST: urn:schemas-upnp-org:device:InternetGatewayDevice:1\r\n"
+        "\r\n"
+    ).encode()
+
+
+def parse_search_response(data: bytes) -> Optional[str]:
+    """Extract the LOCATION header from an SSDP response."""
+    try:
+        text = data.decode("utf-8", "replace")
+    except Exception:
+        return None
+    if "200 OK" not in text.split("\r\n", 1)[0]:
+        return None
+    m = re.search(r"^location:\s*(\S+)\s*$", text, re.IGNORECASE | re.MULTILINE)
+    return m.group(1) if m else None
+
+
+def parse_device_description(xml_text: str, base_url: str) -> Optional[str]:
+    """Find the WANIP/WANPPPConnection control URL in a device
+    description document; returns an absolute URL."""
+    try:
+        root = ET.fromstring(xml_text)
+    except ET.ParseError:
+        return None
+    ns = ""
+    if root.tag.startswith("{"):
+        ns = root.tag[: root.tag.index("}") + 1]
+    for svc in root.iter(f"{ns}service"):
+        stype = svc.findtext(f"{ns}serviceType", "")
+        if stype in _WAN_SERVICES:
+            control = svc.findtext(f"{ns}controlURL", "")
+            if control:
+                return urljoin(base_url, control)
+    return None
+
+
+def make_soap_request(action: str, service: str, args: str) -> Tuple[bytes, str]:
+    """(body, SOAPAction header value)."""
+    body = (
+        '<?xml version="1.0"?>\n'
+        '<s:Envelope xmlns:s="http://schemas.xmlsoap.org/soap/envelope/" '
+        's:encodingStyle="http://schemas.xmlsoap.org/soap/encoding/">'
+        f"<s:Body><u:{action} xmlns:u=\"{service}\">{args}</u:{action}></s:Body>"
+        "</s:Envelope>"
+    ).encode()
+    return body, f'"{service}#{action}"'
+
+
+def port_mapping_args(
+    external_port: int, internal_port: int, internal_ip: str,
+    protocol: str = "TCP", description: str = "tendermint-tpu",
+    lease_s: int = 0,
+) -> str:
+    return (
+        "<NewRemoteHost></NewRemoteHost>"
+        f"<NewExternalPort>{external_port}</NewExternalPort>"
+        f"<NewProtocol>{protocol}</NewProtocol>"
+        f"<NewInternalPort>{internal_port}</NewInternalPort>"
+        f"<NewInternalClient>{internal_ip}</NewInternalClient>"
+        "<NewEnabled>1</NewEnabled>"
+        f"<NewPortMappingDescription>{description}</NewPortMappingDescription>"
+        f"<NewLeaseDuration>{lease_s}</NewLeaseDuration>"
+    )
+
+
+def parse_external_ip_response(xml_text: str) -> Optional[str]:
+    m = re.search(
+        r"<NewExternalIPAddress>\s*([0-9.]+)\s*</NewExternalIPAddress>", xml_text
+    )
+    return m.group(1) if m else None
+
+
+@dataclass
+class NAT:
+    """A discovered gateway (reference upnpNAT struct)."""
+
+    control_url: str
+    internal_ip: str
+    service: str = _WAN_SERVICES[0]
+    logger: object = None
+
+    def __post_init__(self):
+        self.logger = self.logger or get_logger("p2p.upnp")
+
+    async def _soap(self, action: str, args: str) -> str:
+        body, soap_action = make_soap_request(action, self.service, args)
+        u = urlparse(self.control_url)
+        reader, writer = await asyncio.open_connection(u.hostname, u.port or 80)
+        try:
+            req = (
+                f"POST {u.path or '/'} HTTP/1.1\r\n"
+                f"Host: {u.hostname}:{u.port or 80}\r\n"
+                'Content-Type: text/xml; charset="utf-8"\r\n'
+                f"SOAPAction: {soap_action}\r\n"
+                f"Content-Length: {len(body)}\r\n"
+                "Connection: close\r\n\r\n"
+            ).encode() + body
+            writer.write(req)
+            await writer.drain()
+            res = await asyncio.wait_for(reader.read(), 10)
+            return res.decode("utf-8", "replace")
+        finally:
+            writer.close()
+
+    async def external_ip(self) -> str:
+        res = await self._soap("GetExternalIPAddress", "")
+        ip = parse_external_ip_response(res)
+        if ip is None:
+            raise ErrUPnPUnavailable("gateway returned no external IP")
+        return ip
+
+    async def add_port_mapping(
+        self, external_port: int, internal_port: int,
+        protocol: str = "TCP", description: str = "tendermint-tpu",
+        lease_s: int = 0,
+    ) -> None:
+        args = port_mapping_args(
+            external_port, internal_port, self.internal_ip, protocol,
+            description, lease_s,
+        )
+        res = await self._soap("AddPortMapping", args)
+        if "AddPortMappingResponse" not in res:
+            raise ErrUPnPUnavailable(f"AddPortMapping failed: {res[:200]}")
+
+    async def delete_port_mapping(self, external_port: int, protocol: str = "TCP") -> None:
+        args = (
+            "<NewRemoteHost></NewRemoteHost>"
+            f"<NewExternalPort>{external_port}</NewExternalPort>"
+            f"<NewProtocol>{protocol}</NewProtocol>"
+        )
+        await self._soap("DeletePortMapping", args)
+
+
+async def discover(timeout_s: float = 3.0) -> NAT:
+    """SSDP multicast search for an InternetGatewayDevice (reference
+    Discover). Raises ErrUPnPUnavailable when no gateway answers."""
+    import urllib.request
+
+    loop = asyncio.get_running_loop()
+    sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    sock.setblocking(False)
+    sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    try:
+        sock.bind(("", 0))
+        await loop.sock_sendto(sock, make_search_request(), (SSDP_ADDR, SSDP_PORT))
+        try:
+            data = await asyncio.wait_for(loop.sock_recv(sock, 4096), timeout_s)
+        except (asyncio.TimeoutError, OSError):
+            raise ErrUPnPUnavailable("no UPnP gateway answered the SSDP search")
+        location = parse_search_response(data)
+        if location is None:
+            raise ErrUPnPUnavailable("malformed SSDP response")
+        internal_ip = sock.getsockname()[0]
+        if internal_ip in ("0.0.0.0", ""):
+            # learn our outbound interface address toward the gateway
+            u = urlparse(location)
+            probe = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+            try:
+                probe.connect((u.hostname, u.port or 80))
+                internal_ip = probe.getsockname()[0]
+            finally:
+                probe.close()
+        desc = await loop.run_in_executor(
+            None, lambda: urllib.request.urlopen(location, timeout=timeout_s).read()
+        )
+        control = parse_device_description(desc.decode("utf-8", "replace"), location)
+        if control is None:
+            raise ErrUPnPUnavailable("gateway offers no WAN connection service")
+        return NAT(control_url=control, internal_ip=internal_ip)
+    finally:
+        sock.close()

@@ -1,0 +1,126 @@
+"""Remote-signer conformance harness.
+
+Reference: tools/tm-signer-harness/ (main.go + internal/test_harness.go)
+— the harness plays the VALIDATOR side: it listens, waits for the remote
+signer under test to dial in, then runs the acceptance cases
+TestPublicKey / TestSignProposal / TestSignVote, including the
+double-sign-refusal probes the real node depends on.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, List, Optional, Tuple
+
+from tendermint_tpu.codec.signbytes import PRECOMMIT_TYPE, PREVOTE_TYPE
+from tendermint_tpu.privval.signer import SignerClient
+from tendermint_tpu.types.block import BlockID, PartSetHeader
+from tendermint_tpu.types.proposal import Proposal
+from tendermint_tpu.types.vote import Vote
+
+
+class HarnessFailure(Exception):
+    def __init__(self, case: str, msg: str):
+        super().__init__(f"{case}: {msg}")
+        self.case = case
+
+
+def _bid(tag: int) -> BlockID:
+    return BlockID(bytes([tag]) * 32, PartSetHeader(1, bytes([tag + 1]) * 32))
+
+
+async def run_harness(
+    laddr: str,
+    chain_id: str,
+    expected_pub_key=None,
+    accept_timeout_s: float = 30.0,
+    log: Callable = print,
+    height: int = 100,
+) -> List[str]:
+    """Run the acceptance suite against a remote signer that dials
+    `laddr`. Returns the list of passed case names; raises
+    HarnessFailure on the first failing case.
+
+    Heights start high (default 100) so a production signer's last-sign
+    state never blocks the probes.
+    """
+    passed: List[str] = []
+    client = SignerClient(laddr)
+    await client.start()
+    log(f"harness listening at {laddr.replace(':0', f':{client.bound_port}')}; "
+        "waiting for the signer to dial in")
+    try:
+        await client.wait_for_signer(timeout_s=accept_timeout_s)
+
+        # -- TestPublicKey (test_harness.go TestPublicKey) -----------------
+        pk = client.get_pub_key()
+        if expected_pub_key is not None and pk.bytes() != expected_pub_key.bytes():
+            raise HarnessFailure(
+                "TestPublicKey",
+                f"signer returned {pk.bytes().hex()[:16]}, expected "
+                f"{expected_pub_key.bytes().hex()[:16]}",
+            )
+        addr = pk.address()
+        log(f"ok TestPublicKey ({pk.bytes().hex()[:16]}…)")
+        passed.append("TestPublicKey")
+
+        # -- TestSignProposal ----------------------------------------------
+        prop = Proposal(
+            height=height, round=0, pol_round=-1, block_id=_bid(0x10),
+            timestamp_ns=1_700_000_000_000_000_000,
+        )
+        await client.sign_proposal(chain_id, prop)
+        if not pk.verify(prop.sign_bytes(chain_id), prop.signature):
+            raise HarnessFailure("TestSignProposal", "invalid proposal signature")
+        log("ok TestSignProposal")
+        passed.append("TestSignProposal")
+
+        # double-sign probe: a CONFLICTING proposal at the same HRS must
+        # be refused (or answered with the original signature)
+        conflicting = Proposal(
+            height=height, round=0, pol_round=-1, block_id=_bid(0x20),
+            timestamp_ns=1_700_000_000_000_000_001,
+        )
+        refused = False
+        try:
+            await client.sign_proposal(chain_id, conflicting)
+        except Exception:
+            refused = True
+        if not refused and conflicting.signature != prop.signature:
+            raise HarnessFailure(
+                "TestSignProposal", "signer double-signed a conflicting proposal"
+            )
+        log("ok TestSignProposal double-sign refusal")
+        passed.append("TestSignProposalDoubleSign")
+
+        # -- TestSignVote (prevote + precommit) ----------------------------
+        for vtype, name in ((PREVOTE_TYPE, "prevote"), (PRECOMMIT_TYPE, "precommit")):
+            v = Vote(
+                vote_type=vtype, height=height + 1, round=0, block_id=_bid(0x30),
+                timestamp_ns=1_700_000_000_000_000_000,
+                validator_address=addr, validator_index=0,
+            )
+            await client.sign_vote(chain_id, v)
+            if not pk.verify(v.sign_bytes(chain_id), v.signature):
+                raise HarnessFailure("TestSignVote", f"invalid {name} signature")
+
+            conflict = Vote(
+                vote_type=vtype, height=height + 1, round=0, block_id=_bid(0x40),
+                timestamp_ns=1_700_000_000_000_000_001,
+                validator_address=addr, validator_index=0,
+            )
+            refused = False
+            try:
+                await client.sign_vote(chain_id, conflict)
+            except Exception:
+                refused = True
+            if not refused and conflict.signature != v.signature:
+                raise HarnessFailure(
+                    "TestSignVote", f"signer double-signed a conflicting {name}"
+                )
+            log(f"ok TestSignVote {name} (+ double-sign refusal)")
+            passed.append(f"TestSignVote_{name}")
+
+        log("SIGNER HARNESS PASSED")
+        return passed
+    finally:
+        await client.stop()

@@ -105,6 +105,8 @@ class RPCCore:
             "tx_search": self.tx_search,
             "broadcast_evidence": self.broadcast_evidence,
             "unsafe_flush_mempool": self.unsafe_flush_mempool,
+            "unsafe_dial_seeds": self.unsafe_dial_seeds,
+            "unsafe_dial_peers": self.unsafe_dial_peers,
         }
 
     def routes(self) -> List[str]:
@@ -442,6 +444,39 @@ class RPCCore:
                     }
         finally:
             await self.node.event_bus.unsubscribe_all(subscriber)
+
+    async def unsafe_dial_seeds(self, seeds=None) -> Dict[str, Any]:
+        """Dial the given seed addresses (reference rpc/core/net.go:61
+        UnsafeDialSeeds). `seeds` is a list of id@host:port strings."""
+        if not seeds:
+            raise RPCError("no seeds provided")
+        return await self._unsafe_dial(seeds, persistent=False, what="seeds")
+
+    async def unsafe_dial_peers(self, peers=None, persistent=False) -> Dict[str, Any]:
+        """Dial the given peer addresses (reference rpc/core/net.go:85
+        UnsafeDialPeers)."""
+        if not peers:
+            raise RPCError("no peers provided")
+        if isinstance(persistent, str):
+            persistent = persistent.lower() in ("1", "true", "yes")
+        return await self._unsafe_dial(peers, persistent=persistent, what="peers")
+
+    async def _unsafe_dial(self, addrs, persistent: bool, what: str) -> Dict[str, Any]:
+        from tendermint_tpu.p2p.netaddress import NetAddress
+
+        sw = getattr(self.node, "switch", None)
+        if sw is None:
+            raise RPCError("p2p switch is not running")
+        if isinstance(addrs, str):
+            addrs = [a for a in addrs.split(",") if a]
+        parsed = []
+        for a in addrs:
+            try:
+                parsed.append(NetAddress.parse(a))
+            except Exception as e:
+                raise RPCError(f"invalid address {a!r}: {e}")
+        sw.dial_peers_async(parsed, persistent=persistent)
+        return {"log": f"dialing {what}: {addrs}"}
 
     async def unsafe_flush_mempool(self) -> Dict[str, Any]:
         await self.node.mempool.flush()

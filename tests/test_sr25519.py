@@ -1,0 +1,142 @@
+"""sr25519 (schnorrkel) — the full from-scratch stack, pinned against
+external conformance vectors.
+
+Reference: crypto/sr25519/ (go-schnorrkel wrapper). Vectors: RFC 9496
+appendix A.1 (ristretto255 generator multiples + invalid encodings),
+the merlin crate's "simple transcript" conformance test.
+"""
+
+import pytest
+
+from tendermint_tpu.crypto.keys import decode_pubkey, encode_pubkey
+from tendermint_tpu.crypto.sr25519 import (
+    _BASEPOINT,
+    Sr25519PrivKey,
+    Sr25519PubKey,
+    Transcript,
+    ristretto_decode,
+    ristretto_encode,
+    sr25519_verify,
+)
+from tendermint_tpu.ops.ref_ed25519 import IDENT, pt_mul
+
+# RFC 9496 §A.1: encodings of B*0 .. B*5
+RFC9496_MULTIPLES = [
+    "0000000000000000000000000000000000000000000000000000000000000000",
+    "e2f2ae0a6abc4e71a884a961c500515f58e30b6aa582dd8db6a65945e08d2d76",
+    "6a493210f7499cd17fecb510ae0cea23a110e8d5b901f8acadd3095c73a3b919",
+    "94741f5d5d52755ece4f23f044ee27d5d1ea1e2bd196b462166b16152a9d0259",
+    "da80862773358b466ffadfe0b3293ab3d9fd53c5ea6c955358f568322daf6a57",
+    "e882b131016b52c1d3337080187cf768423efccbb517bb495ab812c4160ff44e",
+]
+
+# RFC 9496 §A.3: invalid encodings (non-canonical / non-square / etc.)
+RFC9496_INVALID = [
+    "00ffffffffffffffffffffffffffffffffffffffffffffffffffffffffffffff",
+    "ffffffffffffffffffffffffffffffffffffffffffffffffffffffffffffff7f",
+    "f3ffffffffffffffffffffffffffffffffffffffffffffffffffffffffffff7f",
+    "edffffffffffffffffffffffffffffffffffffffffffffffffffffffffffff7f",
+    "0100000000000000000000000000000000000000000000000000000000000000",
+]
+
+
+def test_ristretto_generator_multiples_match_rfc9496():
+    for k, want in enumerate(RFC9496_MULTIPLES):
+        pt = IDENT if k == 0 else pt_mul(k, _BASEPOINT)
+        assert ristretto_encode(pt).hex() == want
+        # decode round-trips to the same canonical encoding
+        back = ristretto_decode(bytes.fromhex(want))
+        assert back is not None
+        assert ristretto_encode(back).hex() == want
+
+
+def test_ristretto_rejects_invalid_encodings():
+    for bad in RFC9496_INVALID:
+        assert ristretto_decode(bytes.fromhex(bad)) is None
+
+
+def test_merlin_conformance_simple_transcript():
+    """The merlin crate's test_transcript_challenge vector."""
+    t = Transcript(b"test protocol")
+    t.append_message(b"some label", b"some data")
+    assert (
+        t.challenge_bytes(b"challenge", 32).hex()
+        == "d5a21972d0d5fe320c0d263fac7fffb8145aa640af6e9bca177c03c7efcf0615"
+    )
+
+
+def test_sign_verify_roundtrip_and_rejections():
+    pv = Sr25519PrivKey.from_seed(b"\x07" * 32)
+    pk = pv.pub_key()
+    msg = b"tendermint over ristretto"
+    sig = pv.sign(msg)
+    assert len(sig) == 64 and (sig[63] & 0x80)
+    assert pk.verify(msg, sig)
+    # wrong message / wrong key / tampered sig all rejected
+    assert not pk.verify(b"something else", sig)
+    other = Sr25519PrivKey.from_seed(b"\x08" * 32).pub_key()
+    assert not other.verify(msg, sig)
+    bad = bytearray(sig)
+    bad[5] ^= 1
+    assert not pk.verify(msg, bytes(bad))
+    # marker bit required (schnorrkel v1 rejects legacy signatures)
+    unmarked = bytearray(sig)
+    unmarked[63] &= 0x7F
+    assert not pk.verify(msg, bytes(unmarked))
+
+
+def test_signatures_are_context_bound():
+    from tendermint_tpu.crypto.sr25519 import sr25519_sign
+
+    pv = Sr25519PrivKey.from_seed(b"\x09" * 32)
+    pk = pv.pub_key()
+    sig = pv.sign(b"msg")  # context "substrate"
+    assert sr25519_verify(pk.bytes(), b"msg", sig, context=b"substrate")
+    assert not sr25519_verify(pk.bytes(), b"msg", sig, context=b"other-ctx")
+
+
+def test_nondeterministic_signatures_both_verify():
+    """schnorrkel signing is randomized (witness includes rng); two
+    signatures of the same message differ yet both verify."""
+    pv = Sr25519PrivKey.from_seed(b"\x0a" * 32)
+    pk = pv.pub_key()
+    s1, s2 = pv.sign(b"m"), pv.sign(b"m")
+    assert s1 != s2
+    assert pk.verify(b"m", s1) and pk.verify(b"m", s2)
+
+
+def test_pubkey_codec_and_address():
+    pv = Sr25519PrivKey.from_seed(b"\x0b" * 32)
+    pk = pv.pub_key()
+    assert len(pk.address()) == 20
+    back = decode_pubkey(encode_pubkey(pk))
+    assert isinstance(back, Sr25519PubKey)
+    assert back.bytes() == pk.bytes()
+    sig = pv.sign(b"codec")
+    assert back.verify(b"codec", sig)
+
+
+def test_keccak_matches_hashlib_sha3():
+    """Cross-check the permutation against CPython's SHA3-256 on a few
+    inputs (sponge with rate 136, pad 0x06)."""
+    import hashlib
+
+    from tendermint_tpu.crypto.sr25519 import keccak_f1600
+
+    def sha3_256(data: bytes) -> bytes:
+        rate = 136
+        state = bytearray(200)
+        # absorb with multi-rate padding 0x06...0x80
+        padded = bytearray(data)
+        pad_len = rate - (len(data) % rate)
+        padded += b"\x06" + b"\x00" * (pad_len - 2) + b"\x80" if pad_len >= 2 else b""
+        if pad_len == 1:
+            padded = bytearray(data) + b"\x86"
+        for off in range(0, len(padded), rate):
+            for i in range(rate):
+                state[i] ^= padded[off + i]
+            keccak_f1600(state)
+        return bytes(state[:32])
+
+    for msg in (b"", b"abc", b"x" * 135, b"y" * 136, b"z" * 300):
+        assert sha3_256(msg) == hashlib.sha3_256(msg).digest(), msg[:8]

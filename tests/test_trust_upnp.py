@@ -1,0 +1,207 @@
+"""Trust metric (p2p/trust/metric.go) and UPnP plumbing (p2p/upnp/)."""
+
+import asyncio
+
+import pytest
+
+from tendermint_tpu.db.memdb import MemDB
+from tendermint_tpu.p2p.trust import (
+    TrustMetric,
+    TrustMetricStore,
+    _interval_to_history_offset,
+)
+from tendermint_tpu.p2p import upnp
+
+
+# -- trust metric (mirrors p2p/trust/metric_test.go) -------------------------
+
+
+def test_new_metric_starts_at_full_trust():
+    tm = TrustMetric()
+    assert tm.trust_score() == 100
+
+
+def test_good_events_keep_score_high():
+    tm = TrustMetric()
+    for _ in range(10):
+        tm.good_events(1)
+        tm.next_time_interval()
+    assert tm.trust_score() == 100
+
+
+def test_bad_events_drop_score_sharply_then_recover():
+    """Reference TestTrustMetricScores: bad events reduce the score; the
+    derivative term makes deterioration bite immediately; sustained good
+    behavior recovers it gradually."""
+    tm = TrustMetric()
+    tm.good_events(1)
+    tm.next_time_interval()
+    assert tm.trust_score() == 100
+
+    tm.bad_events(10)
+    after_bad = tm.trust_score()
+    assert after_bad < 50  # derivative gamma2 punishes the drop hard
+    tm.next_time_interval()
+
+    scores = []
+    for _ in range(30):
+        tm.good_events(5)
+        tm.next_time_interval()
+        scores.append(tm.trust_score())
+    assert scores[-1] > 90
+    assert scores == sorted(scores)  # monotone recovery
+
+
+def test_pause_freezes_history():
+    tm = TrustMetric()
+    tm.good_events(1)
+    tm.next_time_interval()
+    tm.pause()
+    before = tm.trust_score()
+    for _ in range(10):
+        tm.next_time_interval()  # no-ops while paused
+    assert tm.trust_score() == before
+    # first event after pause unpauses with a clean interval
+    tm.bad_events(1)
+    assert not tm.paused
+
+
+def test_faded_memory_compresses_history():
+    tm = TrustMetric(tracking_window_s=60 * 16, interval_s=60)  # 16 intervals
+    assert tm.history_max_size == _interval_to_history_offset(16) + 1  # 5
+    for i in range(50):
+        (tm.good_events if i % 2 else tm.bad_events)(1)
+        tm.next_time_interval()
+    assert len(tm.history) <= tm.history_max_size
+    assert 0 <= tm.trust_value() <= 1
+
+
+def test_history_json_roundtrip():
+    tm = TrustMetric()
+    for i in range(8):
+        tm.good_events(3)
+        tm.bad_events(1)
+        tm.next_time_interval()
+    data = tm.to_json()
+    tm2 = TrustMetric()
+    tm2.init_from_json(data)
+    assert abs(tm2.history_value - tm.history_value) < 1e-9
+    assert tm2.trust_score() == tm.trust_score()
+
+
+def test_metric_store_persistence_and_pause():
+    db = MemDB()
+    store = TrustMetricStore(db)
+    tm = store.get_peer_trust_metric("peer-1")
+    tm.bad_events(5)
+    tm.next_time_interval()
+    score = tm.trust_score()
+    store.peer_disconnected("peer-1")
+    assert tm.paused
+    store.save()
+
+    store2 = TrustMetricStore(db)
+    assert store2.size() == 1
+    tm2 = store2.get_peer_trust_metric("peer-1")
+    assert tm2.trust_score() == score
+    # unknown peers get a fresh full-trust metric
+    assert store2.get_peer_trust_metric("peer-2").trust_score() == 100
+
+
+# -- upnp plumbing (offline: request formats + parsers) ----------------------
+
+
+def test_ssdp_search_request_format():
+    req = upnp.make_search_request().decode()
+    assert req.startswith("M-SEARCH * HTTP/1.1\r\n")
+    assert "ST: urn:schemas-upnp-org:device:InternetGatewayDevice:1" in req
+    assert '"ssdp:discover"' in req
+
+
+def test_ssdp_response_parsing():
+    ok = (
+        b"HTTP/1.1 200 OK\r\n"
+        b"CACHE-CONTROL: max-age=120\r\n"
+        b"LOCATION: http://192.168.1.1:5431/igd.xml\r\n"
+        b"ST: urn:schemas-upnp-org:device:InternetGatewayDevice:1\r\n\r\n"
+    )
+    assert upnp.parse_search_response(ok) == "http://192.168.1.1:5431/igd.xml"
+    assert upnp.parse_search_response(b"HTTP/1.1 404 Not Found\r\n\r\n") is None
+    assert upnp.parse_search_response(b"garbage") is None
+
+
+_IGD_XML = """<?xml version="1.0"?>
+<root xmlns="urn:schemas-upnp-org:device-1-0">
+ <device>
+  <deviceType>urn:schemas-upnp-org:device:InternetGatewayDevice:1</deviceType>
+  <deviceList><device>
+   <deviceList><device>
+    <serviceList>
+     <service>
+      <serviceType>urn:schemas-upnp-org:service:WANIPConnection:1</serviceType>
+      <controlURL>/ctl/IPConn</controlURL>
+     </service>
+    </serviceList>
+   </device></deviceList>
+  </device></deviceList>
+ </device>
+</root>"""
+
+
+def test_device_description_parsing():
+    url = upnp.parse_device_description(_IGD_XML, "http://192.168.1.1:5431/igd.xml")
+    assert url == "http://192.168.1.1:5431/ctl/IPConn"
+    assert upnp.parse_device_description("<not-xml", "http://x/") is None
+    assert upnp.parse_device_description("<root/>", "http://x/") is None
+
+
+def test_soap_request_and_portmapping_args():
+    args = upnp.port_mapping_args(26656, 26656, "192.168.1.7")
+    body, action = upnp.make_soap_request(
+        "AddPortMapping", "urn:schemas-upnp-org:service:WANIPConnection:1", args
+    )
+    assert action == '"urn:schemas-upnp-org:service:WANIPConnection:1#AddPortMapping"'
+    text = body.decode()
+    assert "<NewExternalPort>26656</NewExternalPort>" in text
+    assert "<NewInternalClient>192.168.1.7</NewInternalClient>" in text
+    assert text.startswith('<?xml version="1.0"?>')
+
+
+def test_external_ip_response_parsing():
+    res = (
+        "<s:Envelope><s:Body><u:GetExternalIPAddressResponse>"
+        "<NewExternalIPAddress>203.0.113.7</NewExternalIPAddress>"
+        "</u:GetExternalIPAddressResponse></s:Body></s:Envelope>"
+    )
+    assert upnp.parse_external_ip_response(res) == "203.0.113.7"
+    assert upnp.parse_external_ip_response("<nope/>") is None
+
+
+def test_discover_times_out_cleanly_without_gateway():
+    async def go():
+        with pytest.raises(upnp.ErrUPnPUnavailable):
+            await upnp.discover(timeout_s=0.3)
+
+    asyncio.run(go())
+
+
+def test_metric_store_survives_corrupt_records():
+    """A garbled persisted record (e.g. version skew) must not crash
+    store construction or index out of range."""
+    import json as _json
+
+    db = MemDB()
+    db.set(
+        TrustMetricStore._KEY,
+        _json.dumps({
+            "short": {"num_intervals": 100, "history": [1.0]},
+            "garbage": {"num_intervals": "x", "history": "nope"},
+            "fine": {"num_intervals": 2, "history": [0.5, 0.9]},
+        }).encode(),
+    )
+    store = TrustMetricStore(db)
+    assert store.size() == 3
+    for key in ("short", "garbage", "fine"):
+        tm = store.get_peer_trust_metric(key)
+        assert 0 <= tm.trust_value() <= 1.0
+        tm.next_time_interval()  # still functional
