@@ -1,0 +1,401 @@
+// Native ABCI kvstore app server (C++).
+//
+// The reference treats the application boundary as cross-language: any
+// process speaking the ABCI socket protocol can back a node
+// (abci/server/socket_server.go; example apps in abci/example/). This is
+// that boundary exercised from native code against tendermint_tpu's
+// deterministic wire format (tendermint_tpu/abci/codec.py):
+//
+//     frame   = uvarint(total_len) || tag(u8) || payload
+//     bytes   = uvarint(len) || raw
+//     string  = bytes(utf-8)
+//     u32/u64/i64 = fixed-width big-endian
+//
+// App semantics mirror tendermint_tpu.abci.examples.KVStoreApplication
+// (reference abci/example/kvstore/kvstore.go:63): tx "key=value",
+// app hash = big-endian tx count, /store queries.
+//
+// Build:  g++ -O2 -std=c++17 -o abci_kvstore native/abci_kvstore.cpp
+// Run:    ./abci_kvstore <port>
+// Node:   [base] abci = "socket", proxy_app = "tcp://127.0.0.1:<port>"
+
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <map>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <vector>
+
+namespace {
+
+// ---------------------------------------------------------------- wire ----
+
+struct Writer {
+  std::string buf;
+  void u8(uint8_t v) { buf.push_back(static_cast<char>(v)); }
+  void uvarint(uint64_t n) {
+    while (true) {
+      uint8_t b = n & 0x7F;
+      n >>= 7;
+      if (n) {
+        u8(b | 0x80);
+      } else {
+        u8(b);
+        return;
+      }
+    }
+  }
+  void u32(uint32_t v) {
+    for (int i = 3; i >= 0; --i) u8((v >> (8 * i)) & 0xFF);
+  }
+  void u64(uint64_t v) {
+    for (int i = 7; i >= 0; --i) u8((v >> (8 * i)) & 0xFF);
+  }
+  void i64(int64_t v) { u64(static_cast<uint64_t>(v)); }
+  void bytes(const std::string& b) {
+    uvarint(b.size());
+    buf += b;
+  }
+  void str(const std::string& s) { bytes(s); }
+};
+
+struct Reader {
+  const uint8_t* p;
+  size_t n, pos = 0;
+  Reader(const uint8_t* data, size_t len) : p(data), n(len) {}
+  bool fail = false;
+  uint8_t u8() {
+    if (pos >= n) {
+      fail = true;
+      return 0;
+    }
+    return p[pos++];
+  }
+  uint64_t uvarint() {
+    uint64_t v = 0;
+    int shift = 0;
+    while (shift <= 63) {
+      uint8_t b = u8();
+      if (fail) return 0;
+      v |= static_cast<uint64_t>(b & 0x7F) << shift;
+      if (!(b & 0x80)) return v;
+      shift += 7;
+    }
+    fail = true;
+    return 0;
+  }
+  uint64_t u64() {
+    uint64_t v = 0;
+    for (int i = 0; i < 8; ++i) v = (v << 8) | u8();
+    return v;
+  }
+  uint32_t u32() {
+    uint32_t v = 0;
+    for (int i = 0; i < 4; ++i) v = (v << 8) | u8();
+    return v;
+  }
+  std::string bytes() {
+    uint64_t len = uvarint();
+    if (fail || pos + len > n) {
+      fail = true;
+      return "";
+    }
+    std::string out(reinterpret_cast<const char*>(p + pos), len);
+    pos += len;
+    return out;
+  }
+};
+
+// message tags (tendermint_tpu/abci/codec.py)
+enum Tag : uint8_t {
+  REQ_ECHO = 0x01,
+  REQ_FLUSH = 0x02,
+  REQ_INFO = 0x03,
+  REQ_SET_OPTION = 0x04,
+  REQ_INIT_CHAIN = 0x05,
+  REQ_QUERY = 0x06,
+  REQ_BEGIN_BLOCK = 0x07,
+  REQ_CHECK_TX = 0x08,
+  REQ_DELIVER_TX = 0x09,
+  REQ_END_BLOCK = 0x0A,
+  REQ_COMMIT = 0x0B,
+  RES_EXCEPTION = 0x41,
+  RES_ECHO = 0x42,
+  RES_FLUSH = 0x43,
+  RES_INFO = 0x44,
+  RES_SET_OPTION = 0x45,
+  RES_INIT_CHAIN = 0x46,
+  RES_QUERY = 0x47,
+  RES_BEGIN_BLOCK = 0x48,
+  RES_CHECK_TX = 0x49,
+  RES_DELIVER_TX = 0x4A,
+  RES_END_BLOCK = 0x4B,
+  RES_COMMIT = 0x4C,
+};
+
+void write_events_none(Writer& w) { w.uvarint(0); }
+
+// one "app" event matching the Python kvstore's DeliverTx events
+void write_deliver_events(Writer& w, const std::string& key) {
+  Writer ev;  // Event = str(type) || uvarint(n_attrs) || bytes(attr)*
+  ev.str("app");
+  ev.uvarint(2);
+  Writer a1;  // KVPair = bytes(key) || bytes(value)
+  a1.bytes("creator");
+  a1.bytes("Cosmoshi Netowoko");
+  ev.bytes(a1.buf);
+  Writer a2;
+  a2.bytes("key");
+  a2.bytes(key);
+  ev.bytes(a2.buf);
+  w.uvarint(1);  // one event
+  w.bytes(ev.buf);
+}
+
+// _TxResult wire shape (abci/types.py:364): u32 code || bytes data ||
+// str log || str info || i64 gas_wanted || i64 gas_used || events || str
+// codespace
+void write_tx_result(Writer& w, uint32_t code, const std::string& data,
+                     const std::string& log, int64_t gas_wanted,
+                     const std::string& event_key, bool with_event) {
+  w.u32(code);
+  w.bytes(data);
+  w.str(log);
+  w.str("");
+  w.i64(gas_wanted);
+  w.i64(0);
+  if (with_event) {
+    write_deliver_events(w, event_key);
+  } else {
+    write_events_none(w);
+  }
+  w.str("");
+}
+
+// ----------------------------------------------------------------- app ----
+
+class KVStore {
+ public:
+  std::mutex mu;  // one app, many conns: global app mutex like the reference
+  std::map<std::string, std::string> kv;
+  uint64_t size = 0, height = 0;
+  std::string app_hash;
+
+  std::string commit() {
+    char h[8];
+    for (int i = 0; i < 8; ++i) h[i] = (size >> (8 * (7 - i))) & 0xFF;
+    app_hash.assign(h, 8);
+    height += 1;
+    return app_hash;
+  }
+};
+
+KVStore g_app;
+
+std::string handle(uint8_t tag, Reader& r) {
+  Writer w;
+  std::lock_guard<std::mutex> lock(g_app.mu);
+  switch (tag) {
+    case REQ_ECHO: {
+      std::string msg = r.bytes();
+      w.u8(RES_ECHO);
+      w.str(msg);
+      break;
+    }
+    case REQ_FLUSH:
+      w.u8(RES_FLUSH);
+      break;
+    case REQ_INFO: {
+      w.u8(RES_INFO);
+      w.str("{\"size\":" + std::to_string(g_app.size) + "}");
+      w.str("kvstore-native-0.1.0");
+      w.u64(1);
+      w.u64(g_app.height);
+      w.bytes(g_app.app_hash);
+      break;
+    }
+    case REQ_SET_OPTION: {
+      r.bytes();
+      r.bytes();
+      w.u8(RES_SET_OPTION);
+      w.u32(0);
+      w.str("");
+      w.str("");
+      break;
+    }
+    case REQ_INIT_CHAIN:
+      // consume nothing we need; reply with no updates
+      w.u8(RES_INIT_CHAIN);
+      w.u8(0);  // bool false: no consensus params
+      w.uvarint(0);
+      break;
+    case REQ_QUERY: {
+      std::string data = r.bytes();
+      std::string path = r.bytes();
+      w.u8(RES_QUERY);
+      auto it = g_app.kv.find(data);
+      bool found = it != g_app.kv.end();
+      w.u32(0);
+      w.str(found ? "exists" : "does not exist");
+      w.str("");
+      w.i64(0);
+      w.bytes(data);
+      w.bytes(found ? it->second : "");
+      w.bytes("");
+      w.u64(g_app.height);
+      w.str("");
+      break;
+    }
+    case REQ_BEGIN_BLOCK:
+      w.u8(RES_BEGIN_BLOCK);
+      write_events_none(w);
+      break;
+    case REQ_CHECK_TX: {
+      r.bytes();
+      w.u8(RES_CHECK_TX);
+      write_tx_result(w, 0, "", "", /*gas_wanted=*/1, "", false);
+      break;
+    }
+    case REQ_DELIVER_TX: {
+      std::string tx = r.bytes();
+      auto eq = tx.find('=');
+      std::string key = eq == std::string::npos ? tx : tx.substr(0, eq);
+      std::string val = eq == std::string::npos ? tx : tx.substr(eq + 1);
+      g_app.kv[key] = val;
+      g_app.size += 1;
+      w.u8(RES_DELIVER_TX);
+      write_tx_result(w, 0, "", "", 0, key, true);
+      break;
+    }
+    case REQ_END_BLOCK:
+      // ResponseEndBlock: uvarint(0 updates) || bool false || events(0)
+      w.u8(RES_END_BLOCK);
+      w.uvarint(0);
+      w.u8(0);
+      write_events_none(w);
+      break;
+    case REQ_COMMIT: {
+      std::string hash = g_app.commit();
+      w.u8(RES_COMMIT);
+      w.bytes(hash);
+      w.u64(0);
+      break;
+    }
+    default: {
+      w.u8(RES_EXCEPTION);
+      w.str("unknown request tag");
+      break;
+    }
+  }
+  if (r.fail) {
+    Writer e;
+    e.u8(RES_EXCEPTION);
+    e.str("malformed request payload");
+    return e.buf;
+  }
+  return w.buf;
+}
+
+// ------------------------------------------------------------- transport --
+
+bool read_exact(int fd, uint8_t* buf, size_t n) {
+  size_t got = 0;
+  while (got < n) {
+    ssize_t r = ::read(fd, buf + got, n - got);
+    if (r <= 0) return false;
+    got += r;
+  }
+  return true;
+}
+
+bool write_all(int fd, const std::string& data) {
+  size_t sent = 0;
+  while (sent < data.size()) {
+    ssize_t w = ::write(fd, data.data() + sent, data.size() - sent);
+    if (w <= 0) return false;
+    sent += w;
+  }
+  return true;
+}
+
+void serve_conn(int fd) {
+  int one = 1;
+  setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+  std::vector<uint8_t> frame;
+  while (true) {
+    // uvarint frame length
+    uint64_t len = 0;
+    int shift = 0;
+    while (true) {
+      uint8_t b;
+      if (!read_exact(fd, &b, 1)) {
+        ::close(fd);
+        return;
+      }
+      len |= static_cast<uint64_t>(b & 0x7F) << shift;
+      if (!(b & 0x80)) break;
+      shift += 7;
+      if (shift > 63) {
+        ::close(fd);
+        return;
+      }
+    }
+    if (len == 0 || len > (64u << 20)) {
+      ::close(fd);
+      return;
+    }
+    frame.resize(len);
+    if (!read_exact(fd, frame.data(), len)) {
+      ::close(fd);
+      return;
+    }
+    Reader r(frame.data() + 1, len - 1);
+    std::string res = handle(frame[0], r);
+    Writer out;
+    out.uvarint(res.size());
+    out.buf += res;
+    if (!write_all(fd, out.buf)) {
+      ::close(fd);
+      return;
+    }
+  }
+}
+
+}  // namespace
+
+int main(int argc, char** argv) {
+  int port = argc > 1 ? std::atoi(argv[1]) : 26658;
+  int srv = socket(AF_INET, SOCK_STREAM, 0);
+  int one = 1;
+  setsockopt(srv, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_addr.s_addr = htonl(INADDR_LOOPBACK);
+  addr.sin_port = htons(port);
+  if (bind(srv, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) != 0) {
+    perror("bind");
+    return 1;
+  }
+  if (listen(srv, 8) != 0) {
+    perror("listen");
+    return 1;
+  }
+  // report the bound port (port 0 = ephemeral) for test harnesses
+  socklen_t alen = sizeof(addr);
+  getsockname(srv, reinterpret_cast<sockaddr*>(&addr), &alen);
+  printf("abci_kvstore listening on 127.0.0.1:%d\n", ntohs(addr.sin_port));
+  fflush(stdout);
+  while (true) {
+    int fd = accept(srv, nullptr, nullptr);
+    if (fd < 0) continue;
+    std::thread(serve_conn, fd).detach();
+  }
+}

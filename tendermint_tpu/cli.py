@@ -206,15 +206,27 @@ def cmd_testnet(args) -> None:
     )
     genesis.validate_and_complete()
 
-    peers = ",".join(
-        f"{node_keys[i].id}@127.0.0.1:{starting_port + 2 * i}" for i in range(n)
-    )
+    if args.hostname_prefix:
+        # docker-style: each node at <prefix><octet+i>:26656 (reference
+        # testnet.go --hostname-prefix/--populate-persistent-peers)
+        peers = ",".join(
+            f"{node_keys[i].id}@{args.hostname_prefix}{args.starting_ip_octet + i}:26656"
+            for i in range(n)
+        )
+    else:
+        peers = ",".join(
+            f"{node_keys[i].id}@127.0.0.1:{starting_port + 2 * i}" for i in range(n)
+        )
     for i in range(n):
         home = os.path.join(out, f"node{i}")
         cfg = default_config().set_root(home)
         cfg.base.moniker = f"node{i}"
-        cfg.p2p.laddr = f"tcp://127.0.0.1:{starting_port + 2 * i}"
-        cfg.rpc.laddr = f"tcp://127.0.0.1:{starting_port + 2 * i + 1}"
+        if args.hostname_prefix:
+            cfg.p2p.laddr = "tcp://0.0.0.0:26656"
+            cfg.rpc.laddr = "tcp://0.0.0.0:26657"
+        else:
+            cfg.p2p.laddr = f"tcp://127.0.0.1:{starting_port + 2 * i}"
+            cfg.rpc.laddr = f"tcp://127.0.0.1:{starting_port + 2 * i + 1}"
         cfg.p2p.persistent_peers = ",".join(
             p for j, p in enumerate(peers.split(",")) if j != i
         )
@@ -365,32 +377,42 @@ def cmd_replay_console(args) -> None:
             print(f"{console.remaining()} WAL messages loaded; "
                   "commands: next [N] | rs | quit")
             src = open(args.script) if args.script else sys.stdin
-            while True:
-                if src is sys.stdin:
-                    print("> ", end="", flush=True)
-                line = src.readline()
-                if not line:
-                    break
-                parts = line.strip().split()
-                if not parts:
-                    continue
-                if parts[0] in ("quit", "exit", "q"):
-                    break
-                try:
-                    if parts[0] == "next":
-                        n = int(parts[1]) if len(parts) > 1 else 1
-                        fed = await console.step(n)
-                        print(f"fed {fed} message(s); rs={console.round_state()}")
-                    elif parts[0] == "rs":
-                        print(console.round_state())
-                    else:
-                        print(f"unknown command {parts[0]!r}")
-                except Exception as e:
-                    print(f"error: {e}")
+            try:
+                await _console_loop(console, src)
+            finally:
+                if src is not sys.stdin:
+                    src.close()
         finally:
             await console.close()
 
     asyncio.run(run())
+
+
+async def _console_loop(console, src) -> None:
+    import sys as _sys
+
+    while True:
+        if src is _sys.stdin:
+            print("> ", end="", flush=True)
+        line = src.readline()
+        if not line:
+            break
+        parts = line.strip().split()
+        if not parts:
+            continue
+        if parts[0] in ("quit", "exit", "q"):
+            break
+        try:
+            if parts[0] == "next":
+                n = int(parts[1]) if len(parts) > 1 else 1
+                fed = await console.step(n)
+                print(f"fed {fed} message(s); rs={console.round_state()}")
+            elif parts[0] == "rs":
+                print(console.round_state())
+            else:
+                print(f"unknown command {parts[0]!r}")
+        except Exception as e:
+            print(f"error: {e}")
 
 
 def cmd_signer_harness(args) -> None:
@@ -492,6 +514,12 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--o", default="./mytestnet", help="output directory")
     sp.add_argument("--starting-port", type=int, default=26656)
     sp.add_argument("--chain-id", default="")
+    sp.add_argument(
+        "--hostname-prefix", default="",
+        help="docker mode: peer IPs become <prefix><octet+i>:26656 "
+             "(e.g. 192.167.10.)",
+    )
+    sp.add_argument("--starting-ip-octet", type=int, default=2)
     sp.set_defaults(func=cmd_testnet)
 
     return p

@@ -25,7 +25,7 @@ key type is not the consensus hot path the TPU batch verifier owns.
 from __future__ import annotations
 
 import os
-from typing import List, Tuple
+from typing import List, Optional, Tuple
 
 from tendermint_tpu.crypto.hash import address_hash
 from tendermint_tpu.crypto.keys import PrivKey, PubKey, register_pubkey_type
@@ -404,11 +404,14 @@ class Sr25519PubKey(PubKey):
 
 
 class Sr25519PrivKey(PrivKey):
-    """Expanded schnorrkel secret key: (scalar, nonce seed)."""
+    """Schnorrkel secret key: 32-byte mini-secret, expanded on use."""
 
-    def __init__(self, scalar: int, nonce_seed: bytes):
+    type_name = "sr25519"
+
+    def __init__(self, scalar: int, nonce_seed: bytes, seed: Optional[bytes] = None):
         self._scalar = scalar % L
         self._nonce = nonce_seed
+        self._seed = seed
 
     @classmethod
     def generate(cls) -> "Sr25519PrivKey":
@@ -416,13 +419,29 @@ class Sr25519PrivKey(PrivKey):
 
     @classmethod
     def from_seed(cls, seed: bytes) -> "Sr25519PrivKey":
-        """MiniSecretKey -> SecretKey expansion via a merlin transcript
-        over the 32-byte mini secret (schnorrkel expand_uniform mode)."""
-        t = Transcript(b"ExpandSecretKeys")
-        t.append_message(b"mini", seed)
-        scalar = int.from_bytes(t.challenge_bytes(b"sk", 64), "little") % L
-        nonce = t.challenge_bytes(b"no", 32)
-        return cls(scalar, nonce)
+        """MiniSecretKey -> SecretKey via ExpandEd25519 — what
+        go-schnorrkel (and substrate) use by default: scalar =
+        clamp(SHA512(mini)[:32]) >> 3 (the cofactor division), nonce =
+        SHA512(mini)[32:]. Seeds imported from a reference validator
+        therefore derive the SAME public key here."""
+        import hashlib
+
+        if len(seed) != 32:
+            raise ValueError("sr25519 mini-secret must be 32 bytes")
+        h = hashlib.sha512(seed).digest()
+        key = bytearray(h[:32])
+        key[0] &= 248
+        key[31] &= 63
+        key[31] |= 64
+        scalar = int.from_bytes(bytes(key), "little") >> 3
+        return cls(scalar, h[32:64], seed=bytes(seed))
+
+    def bytes(self) -> bytes:
+        """The 32-byte mini-secret (reference PrivKeySr25519 stores the
+        seed form)."""
+        if self._seed is None:
+            raise ValueError("key was built from a raw scalar; no seed to serialize")
+        return self._seed
 
     def sign(self, msg: bytes) -> bytes:
         return sr25519_sign(
@@ -431,6 +450,11 @@ class Sr25519PrivKey(PrivKey):
 
     def pub_key(self) -> Sr25519PubKey:
         return Sr25519PubKey(ristretto_encode(pt_mul(self._scalar, _BASEPOINT)))
+
+    def __eq__(self, other) -> bool:
+        return (
+            isinstance(other, Sr25519PrivKey) and self._scalar == other._scalar
+        )
 
 
 register_pubkey_type("sr25519", Sr25519PubKey)

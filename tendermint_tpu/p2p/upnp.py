@@ -187,13 +187,19 @@ async def discover(timeout_s: float = 3.0) -> NAT:
     try:
         sock.bind(("", 0))
         await loop.sock_sendto(sock, make_search_request(), (SSDP_ADDR, SSDP_PORT))
-        try:
-            data = await asyncio.wait_for(loop.sock_recv(sock, 4096), timeout_s)
-        except (asyncio.TimeoutError, OSError):
-            raise ErrUPnPUnavailable("no UPnP gateway answered the SSDP search")
-        location = parse_search_response(data)
-        if location is None:
-            raise ErrUPnPUnavailable("malformed SSDP response")
+        # keep listening until the deadline: other SSDP devices (or a
+        # garbled datagram) may answer before the actual gateway does
+        deadline = loop.time() + timeout_s
+        location = None
+        while location is None:
+            remaining = deadline - loop.time()
+            if remaining <= 0:
+                raise ErrUPnPUnavailable("no UPnP gateway answered the SSDP search")
+            try:
+                data = await asyncio.wait_for(loop.sock_recv(sock, 4096), remaining)
+            except (asyncio.TimeoutError, OSError):
+                raise ErrUPnPUnavailable("no UPnP gateway answered the SSDP search")
+            location = parse_search_response(data)
         internal_ip = sock.getsockname()[0]
         if internal_ip in ("0.0.0.0", ""):
             # learn our outbound interface address toward the gateway

@@ -445,9 +445,19 @@ class RPCCore:
         finally:
             await self.node.event_bus.unsubscribe_all(subscriber)
 
+    def _require_unsafe(self) -> None:
+        """Unsafe routes are opt-in via [rpc] unsafe (reference gates
+        them behind --rpc.unsafe, rpc/core/routes.go:49 AddUnsafeRoutes);
+        otherwise any RPC-reachable process could persistently dial
+        attacker peers (eclipse vector)."""
+        cfg = getattr(self.node, "config", None)
+        if cfg is None or not getattr(cfg.rpc, "unsafe", False):
+            raise RPCError("unsafe routes are disabled; set [rpc] unsafe=true")
+
     async def unsafe_dial_seeds(self, seeds=None) -> Dict[str, Any]:
         """Dial the given seed addresses (reference rpc/core/net.go:61
         UnsafeDialSeeds). `seeds` is a list of id@host:port strings."""
+        self._require_unsafe()
         if not seeds:
             raise RPCError("no seeds provided")
         return await self._unsafe_dial(seeds, persistent=False, what="seeds")
@@ -455,6 +465,7 @@ class RPCCore:
     async def unsafe_dial_peers(self, peers=None, persistent=False) -> Dict[str, Any]:
         """Dial the given peer addresses (reference rpc/core/net.go:85
         UnsafeDialPeers)."""
+        self._require_unsafe()
         if not peers:
             raise RPCError("no peers provided")
         if isinstance(persistent, str):
@@ -479,6 +490,7 @@ class RPCCore:
         return {"log": f"dialing {what}: {addrs}"}
 
     async def unsafe_flush_mempool(self) -> Dict[str, Any]:
+        self._require_unsafe()
         await self.node.mempool.flush()
         return {}
 

@@ -1,0 +1,88 @@
+"""Native (C++) ABCI app against the Python node — the cross-language
+application boundary the reference treats as first-class
+(abci/server/socket_server.go + multi-language example apps).
+
+Builds native/abci_kvstore.cpp with g++ and runs a full consensus node
+against it over the socket transport.
+"""
+
+import asyncio
+import os
+import re
+import shutil
+import subprocess
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SRC = os.path.join(REPO, "native", "abci_kvstore.cpp")
+
+pytestmark = pytest.mark.skipif(
+    shutil.which("g++") is None, reason="no C++ toolchain"
+)
+
+
+@pytest.fixture(scope="module")
+def native_app(tmp_path_factory):
+    binary = str(tmp_path_factory.mktemp("native") / "abci_kvstore")
+    subprocess.run(
+        ["g++", "-O2", "-std=c++17", "-pthread", "-o", binary, SRC], check=True
+    )
+    proc = subprocess.Popen(
+        [binary, "0"], stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True
+    )
+    line = proc.stdout.readline()
+    m = re.search(r"127\.0\.0\.1:(\d+)", line)
+    assert m, f"no port line: {line!r}"
+    yield int(m.group(1))
+    proc.kill()
+    proc.wait(timeout=10)
+
+
+def test_native_app_passes_protocol_roundtrip(native_app):
+    from tendermint_tpu.abci import types as t
+    from tendermint_tpu.abci.client.socket import SocketClient
+
+    async def go():
+        cli = SocketClient(f"tcp://127.0.0.1:{native_app}")
+        await cli.start()
+        try:
+            assert (await cli.echo_sync("native")).message == "native"
+            res = await cli.deliver_tx_sync(t.RequestDeliverTx(b"lang=c++"))
+            assert res.code == 0 and res.events[0].type == "app"
+            commit = await cli.commit_sync()
+            assert len(commit.data) == 8
+            q = await cli.query_sync(t.RequestQuery(data=b"lang", path="/store"))
+            assert q.value == b"c++"
+            info = await cli.info_sync(t.RequestInfo())
+            assert info.last_block_height >= 1
+        finally:
+            await cli.stop()
+
+    asyncio.run(go())
+
+
+def test_node_commits_blocks_against_native_app(native_app, tmp_path):
+    from tendermint_tpu.cli import main as cli_main
+    from tendermint_tpu.config import load_config
+    from tendermint_tpu.node import default_new_node
+
+    async def go():
+        home = str(tmp_path / "cppnode")
+        cli_main(["--home", home, "init", "--chain-id", "cpp-chain"])
+        cfg = load_config(os.path.join(home, "config/config.toml")).set_root(home)
+        cfg.base.db_backend = "memdb"
+        cfg.base.abci = "socket"
+        cfg.base.proxy_app = f"tcp://127.0.0.1:{native_app}"
+        cfg.p2p.laddr = "tcp://127.0.0.1:0"
+        cfg.consensus.timeout_commit_ms = 30
+        cfg.consensus.skip_timeout_commit = True
+        node = default_new_node(cfg)
+        await node.start()
+        try:
+            await node.mempool.check_tx(b"cpp=node")
+            await node.consensus_state.wait_for_height(3, timeout_s=30)
+        finally:
+            await node.stop()
+
+    asyncio.run(go())

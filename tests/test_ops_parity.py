@@ -105,12 +105,22 @@ def test_unsafe_dial_routes_registered_and_validated():
             def dial_peers_async(self, addrs, persistent=False):
                 self.dialed.append((addrs, persistent))
 
+        from tendermint_tpu.config import test_config
+
         class FakeNode:
             switch = FakeSwitch()
+            config = test_config()
 
+        FakeNode.config.rpc.unsafe = True
         core = RPCCore(FakeNode())
         assert "unsafe_dial_seeds" in core.routes()
         assert "unsafe_dial_peers" in core.routes()
+
+        # gated behind [rpc] unsafe (reference --rpc.unsafe)
+        FakeNode.config.rpc.unsafe = False
+        with pytest.raises(RPCError, match="disabled"):
+            await core.unsafe_dial_peers(peers=["x"])
+        FakeNode.config.rpc.unsafe = True
 
         with pytest.raises(RPCError):
             await core.unsafe_dial_seeds(seeds=[])
