@@ -104,7 +104,9 @@ struct Reader {
   }
   std::string bytes() {
     uint64_t len = uvarint();
-    if (fail || pos + len > n) {
+    // len > n - pos, NOT pos + len > n: the latter wraps for huge
+    // uvarints and would pass the bounds check
+    if (fail || len > n - pos) {
       fail = true;
       return "";
     }
@@ -266,6 +268,7 @@ std::string handle(uint8_t tag, Reader& r) {
     }
     case REQ_DELIVER_TX: {
       std::string tx = r.bytes();
+      if (r.fail) break;  // malformed payload must NOT mutate app state
       auto eq = tx.find('=');
       std::string key = eq == std::string::npos ? tx : tx.substr(0, eq);
       std::string val = eq == std::string::npos ? tx : tx.substr(eq + 1);

@@ -20,16 +20,38 @@ from typing import Any, Dict, List, NamedTuple, Optional, Tuple
 # ---------------------------------------------------------------------------
 
 _TOKEN_RE = re.compile(
-    r"\s*(?:(?P<op><=|>=|!=|=|<|>)|(?P<kw>AND|CONTAINS|EXISTS)\b"
-    r"|(?P<str>'(?:[^'\\]|\\.)*')|(?P<num>-?\d+(?:\.\d+)?)"
+    r"\s*(?:(?P<op><=|>=|!=|=|<|>)|(?P<kw>AND|CONTAINS|EXISTS|DATE|TIME)\b"
+    r"|(?P<str>'(?:[^'\\]|\\.)*')"
+    r"|(?P<time>\d{4}-\d{2}-\d{2}T\d{2}:\d{2}:\d{2}(?:\.\d+)?Z)"
+    r"|(?P<date>\d{4}-\d{2}-\d{2})"
+    r"|(?P<num>-?\d+(?:\.\d+)?)"
     r"|(?P<ident>[A-Za-z_][\w.]*))"
 )
+
+DATE_LAYOUT = "%Y-%m-%d"
+TIME_LAYOUT = "%Y-%m-%dT%H:%M:%SZ"
+
+
+def _parse_datetime(raw: str) -> Optional[float]:
+    """RFC3339 time or date -> unix seconds (reference DATE/TIME
+    operands, query.peg 'date'/'time' rules)."""
+    import datetime as _dt
+
+    raw = raw.strip()
+    for fmt in ("%Y-%m-%dT%H:%M:%S.%fZ", TIME_LAYOUT, DATE_LAYOUT):
+        try:
+            return _dt.datetime.strptime(raw, fmt).replace(
+                tzinfo=_dt.timezone.utc
+            ).timestamp()
+        except ValueError:
+            continue
+    return None
 
 
 class Condition(NamedTuple):
     key: str
     op: str  # '=', '!=', '<', '<=', '>', '>=', 'CONTAINS', 'EXISTS'
-    value: Any  # str or float; None for EXISTS
+    value: Any  # str, float, or ("dt", unix_seconds); None for EXISTS
 
 
 class QueryError(ValueError):
@@ -46,7 +68,7 @@ def _tokenize(s: str) -> List[Tuple[str, str]]:
         if not m or m.start() != pos:
             raise QueryError(f"bad query near {s[pos:pos+16]!r}")
         pos = m.end()
-        for kind in ("op", "kw", "str", "num", "ident"):
+        for kind in ("op", "kw", "str", "time", "date", "num", "ident"):
             v = m.group(kind)
             if v is not None:
                 tokens.append((kind, v))
@@ -100,7 +122,20 @@ class Query:
                 if i >= len(toks):
                     raise QueryError(f"truncated query after {op!r}")
                 kind2, v2 = toks[i]
-                if kind2 == "str":
+                if kind2 == "kw" and v2 in ("DATE", "TIME"):
+                    # reference: `tx.date > DATE 2017-01-01`,
+                    # `tx.time >= TIME 2013-05-03T14:45:00Z`
+                    i += 1
+                    if i >= len(toks):
+                        raise QueryError(f"truncated query after {v2}")
+                    kind3, v3 = toks[i]
+                    if kind3 not in ("date", "time"):
+                        raise QueryError(f"{v2} needs a {v2.lower()} literal")
+                    ts = _parse_datetime(v3)
+                    if ts is None:
+                        raise QueryError(f"bad {v2.lower()} literal {v3!r}")
+                    conds.append(Condition(key, op, ("dt", ts)))
+                elif kind2 == "str":
                     conds.append(Condition(key, op, _unquote(v2)))
                 elif kind2 == "num":
                     conds.append(Condition(key, op, float(v2)))
@@ -118,11 +153,16 @@ class Query:
 
     def matches(self, tags: Dict[str, List[str]]) -> bool:
         for cond in self.conditions:
+            if cond.op == "EXISTS":
+                # reference semantics: any key with this PREFIX counts
+                # ("slash EXISTS" — and even "sl EXISTS" — matches
+                # slash.reason; libs/pubsub/query query.go matchesAny)
+                if not any(k.startswith(cond.key) for k in tags):
+                    return False
+                continue
             values = tags.get(cond.key)
             if values is None:
                 return False
-            if cond.op == "EXISTS":
-                continue
             if not any(_match_one(v, cond) for v in values):
                 return False
         return True
@@ -141,15 +181,25 @@ def _unquote(s: str) -> str:
     return s[1:-1].replace("\\'", "'")
 
 
+_LEADING_NUM_RE = re.compile(r"-?\d+(?:\.\d+)?")
+
+
 def _match_one(value: str, cond: Condition) -> bool:
     op, want = cond.op, cond.value
     if op == "CONTAINS":
         return str(want) in value
-    if isinstance(want, float):
-        try:
-            have = float(value)
-        except ValueError:
+    if isinstance(want, tuple) and want[0] == "dt":
+        have = _parse_datetime(value)
+        if have is None:
             return False
+        want = want[1]
+    elif isinstance(want, float):
+        # reference: a numeric condition matches suffixed values like
+        # "8.045stake" by parsing the leading number (query.go number rule)
+        m = _LEADING_NUM_RE.match(value)
+        if not m:
+            return False
+        have = float(m.group(0))
     else:
         have = value
     if op == "=":
