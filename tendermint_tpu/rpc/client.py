@@ -139,6 +139,14 @@ class WSClient:
     async def subscribe(self, query: str) -> None:
         await self.call("subscribe", query=query)
 
+    async def unsubscribe(self, query: str) -> None:
+        """Reference rpc/core/events.go Unsubscribe :48."""
+        await self.call("unsubscribe", query=query)
+
+    async def unsubscribe_all(self) -> None:
+        """Reference rpc/core/events.go UnsubscribeAll :78."""
+        await self.call("unsubscribe_all")
+
     async def next_event(self, timeout_s: float = 10.0) -> Dict[str, Any]:
         doc = await asyncio.wait_for(self.events.get(), timeout_s)
         return doc.get("result", {})

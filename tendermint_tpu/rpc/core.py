@@ -107,6 +107,9 @@ class RPCCore:
             "unsafe_flush_mempool": self.unsafe_flush_mempool,
             "unsafe_dial_seeds": self.unsafe_dial_seeds,
             "unsafe_dial_peers": self.unsafe_dial_peers,
+            "unsafe_start_cpu_profiler": self.unsafe_start_cpu_profiler,
+            "unsafe_stop_cpu_profiler": self.unsafe_stop_cpu_profiler,
+            "unsafe_write_heap_profile": self.unsafe_write_heap_profile,
         }
 
     def routes(self) -> List[str]:
@@ -493,6 +496,51 @@ class RPCCore:
         self._require_unsafe()
         await self.node.mempool.flush()
         return {}
+
+    # -- unsafe profiling (reference rpc/core/dev.go UnsafeStartCPUProfiler
+    # :12, UnsafeStopCPUProfiler :26, UnsafeWriteHeapProfile :37; Python
+    # analogs: cProfile + tracemalloc) --------------------------------------
+
+    _cpu_profiler = None
+
+    async def unsafe_start_cpu_profiler(self, filename="cpu.prof") -> Dict[str, Any]:
+        self._require_unsafe()
+        import cProfile
+
+        if RPCCore._cpu_profiler is not None:
+            raise RPCError("CPU profiler already running")
+        prof = cProfile.Profile()
+        prof.enable()
+        RPCCore._cpu_profiler = (prof, filename)
+        return {"log": f"profiling CPU to {filename}"}
+
+    async def unsafe_stop_cpu_profiler(self) -> Dict[str, Any]:
+        self._require_unsafe()
+        if RPCCore._cpu_profiler is None:
+            raise RPCError("CPU profiler is not running")
+        prof, filename = RPCCore._cpu_profiler
+        RPCCore._cpu_profiler = None
+        prof.disable()
+        prof.dump_stats(filename)
+        return {"log": f"wrote {filename}"}
+
+    async def unsafe_write_heap_profile(self, filename="heap.prof") -> Dict[str, Any]:
+        self._require_unsafe()
+        import tracemalloc
+
+        if not tracemalloc.is_tracing():
+            # tracemalloc only sees allocations made AFTER tracing starts;
+            # a snapshot taken now would be empty, not the live heap
+            tracemalloc.start()
+            return {
+                "log": "heap tracing just started; allocations will be "
+                       "recorded from now — call again later for a profile"
+            }
+        snap = tracemalloc.take_snapshot()
+        with open(filename, "w") as fp:
+            for stat in snap.statistics("lineno")[:200]:
+                fp.write(f"{stat}\n")
+        return {"log": f"wrote {filename}"}
 
     # -- abci routes -------------------------------------------------------
 

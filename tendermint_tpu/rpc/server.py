@@ -185,6 +185,38 @@ class RPCServer:
                     if task is not None:
                         pump_tasks.append(task)
                     continue
+                if name in ("unsubscribe", "unsubscribe_all"):
+                    # reference rpc/core/events.go Unsubscribe :48 /
+                    # UnsubscribeAll :78
+                    try:
+                        if name == "unsubscribe":
+                            from tendermint_tpu.utils.pubsub import Query
+
+                            q = (doc.get("params") or {}).get("query", "")
+                            await self.node.event_bus.unsubscribe(
+                                client_id, Query(q)
+                            )
+                        else:
+                            await self.node.event_bus.unsubscribe_all(client_id)
+                        await push(_rpc_response(doc.get("id"), result={}))
+                    except (KeyError, ValueError) as e:
+                        # caller error (bad query / unknown subscription):
+                        # -32602 like the subscribe path, not internal
+                        msg = e.args[0] if e.args else str(e)
+                        await push(
+                            _rpc_response(
+                                doc.get("id"),
+                                error={"code": -32602, "message": str(msg)},
+                            )
+                        )
+                    except Exception as e:
+                        await push(
+                            _rpc_response(
+                                doc.get("id"),
+                                error={"code": -32603, "message": str(e)},
+                            )
+                        )
+                    continue
                 resp = await self._call_one(doc)
                 await push(resp)
         except (asyncio.IncompleteReadError, ConnectionError):

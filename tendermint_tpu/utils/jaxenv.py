@@ -54,8 +54,19 @@ def force_cpu_platform(n_devices: Optional[int] = None) -> bool:
 def probe_accelerator(timeout_s: float = 120) -> Tuple[int, str]:
     """(device_count, platform) of the default backend, probed IN A
     SUBPROCESS so a dead tunnel (which hangs instead of failing) can be
-    timed out. Returns (0, "") on failure/timeout."""
-    code = "import jax; d = jax.devices(); print(len(d), d[0].platform)"
+    timed out. Returns (0, "") on failure/timeout.
+
+    The probe EXECUTES a computation and reads the result back: a wedged
+    tunnel can initialize fine (jax.devices() lists the chip) and then
+    hang on the first execution or device-to-host read — init alone is
+    not evidence the backend works."""
+    code = (
+        "import jax, jax.numpy as jnp, numpy as np; "
+        "d = jax.devices(); "
+        "x = jnp.asarray(np.ones((8, 8), np.float32)); "
+        "assert float(np.asarray(x @ x)[0][0]) == 8.0; "
+        "print(len(d), d[0].platform)"
+    )
     try:
         res = subprocess.run(
             [sys.executable, "-c", code],

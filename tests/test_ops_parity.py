@@ -264,3 +264,47 @@ def test_signer_harness_rejects_wrong_key(tmp_path):
             SignerClient.start = orig_start
 
     run(go())
+
+
+def test_unsafe_profiler_routes():
+    async def go():
+        import os
+        import tempfile
+
+        from tendermint_tpu.config import test_config
+        from tendermint_tpu.rpc.core import RPCCore, RPCError
+
+        class FakeNode:
+            config = test_config()
+
+        FakeNode.config.rpc.unsafe = True
+        core = RPCCore(FakeNode())
+        for r in ("unsafe_start_cpu_profiler", "unsafe_stop_cpu_profiler",
+                  "unsafe_write_heap_profile"):
+            assert r in core.routes()
+
+        with tempfile.TemporaryDirectory() as d:
+            cpu_f = os.path.join(d, "cpu.prof")
+            await core.unsafe_start_cpu_profiler(filename=cpu_f)
+            with pytest.raises(RPCError, match="already running"):
+                await core.unsafe_start_cpu_profiler()
+            sum(range(1000))
+            await core.unsafe_stop_cpu_profiler()
+            assert os.path.getsize(cpu_f) > 0
+            with pytest.raises(RPCError, match="not running"):
+                await core.unsafe_stop_cpu_profiler()
+
+            heap_f = os.path.join(d, "heap.prof")
+            first = await core.unsafe_write_heap_profile(filename=heap_f)
+            if "just started" in first["log"]:
+                # first call only arms tracing; second call dumps
+                blob = [bytearray(1024) for _ in range(10)]
+                second = await core.unsafe_write_heap_profile(filename=heap_f)
+                assert "wrote" in second["log"]
+            assert os.path.getsize(heap_f) > 0
+
+        FakeNode.config.rpc.unsafe = False
+        with pytest.raises(RPCError, match="disabled"):
+            await core.unsafe_write_heap_profile()
+
+    run(go())

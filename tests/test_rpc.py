@@ -180,3 +180,34 @@ def test_websocket_subscription(tmp_path):
             await node.stop()
 
     run(go())
+
+
+def test_websocket_unsubscribe(tmp_path):
+    async def go():
+        node, c = await start_node(tmp_path)
+        try:
+            ws = WSClient(f"{c.host}:{c.port}")
+            await ws.connect()
+            await ws.subscribe("tm.event = 'NewBlock'")
+            await ws.next_event(timeout_s=10)  # events flowing
+            await ws.unsubscribe("tm.event = 'NewBlock'")
+            # drain anything in flight, then confirm silence
+            import asyncio as _a
+
+            await _a.sleep(0.3)
+            while not ws.events.empty():
+                ws.events.get_nowait()
+            with pytest.raises(TimeoutError):
+                await ws.next_event(timeout_s=0.6)
+            # resubscribe works after unsubscribe
+            await ws.subscribe("tm.event = 'NewBlock'")
+            await ws.next_event(timeout_s=10)
+            await ws.unsubscribe_all()
+            # ...and after unsubscribe_all
+            await ws.subscribe("tm.event = 'NewBlock'")
+            await ws.next_event(timeout_s=10)
+            await ws.close()
+        finally:
+            await node.stop()
+
+    run(go())
