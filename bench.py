@@ -192,30 +192,43 @@ def _supervise() -> int:
     import subprocess
 
     state = f"/tmp/tm_bench_state_{os.getpid()}.json"
+    # seed the state file BEFORE spawning: its absence is the child's
+    # "I emitted successfully" signal, so it must exist from the start
+    # (a child that crashes at import never reaches _save_partial)
+    with open(state, "w") as fp:
+        json.dump({**_partial, "platform": "unknown"}, fp)
     env = dict(os.environ, TM_BENCH_INNER="1", TM_BENCH_STATE=state)
     child = subprocess.Popen([sys.executable, os.path.abspath(__file__)], env=env)
     try:
         rc = child.wait(timeout=DEADLINE_S)
         if rc == 0:
+            try:
+                os.unlink(state)  # hygiene; normally already gone
+            except OSError:
+                pass
             return 0
         log(f"bench child exited rc={rc}")
     except subprocess.TimeoutExpired:
         log(f"bench deadline ({DEADLINE_S}s) hit; killing child")
         child.kill()
         child.wait()
-    # child died or timed out without emitting: emit partial state
+    # A missing state file means the child already emitted its real line
+    # (it unlinks via _deadline_done just before emit) and then died in
+    # teardown — emitting again would print a second, worse line.
+    if not os.path.exists(state):
+        log("child emitted before dying; not double-emitting")
+        return 0
     st = {}
-    if os.path.exists(state):
+    try:
+        with open(state) as fp:
+            st = json.load(fp)
+    except Exception:
+        pass
+    finally:
         try:
-            with open(state) as fp:
-                st = json.load(fp)
-        except Exception:
+            os.unlink(state)
+        except OSError:
             pass
-        finally:
-            try:
-                os.unlink(state)
-            except OSError:
-                pass
     emit(
         st.get("value_ms"), st.get("vs_baseline"),
         platform=st.get("platform", "unknown"), deadline_hit=True,

@@ -19,6 +19,7 @@ import sys
 import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+_USER_SET_PLATFORM = "JAX_PLATFORMS" in os.environ
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
 
 
@@ -106,6 +107,40 @@ def bench_headers_heights():
     emit(f"headers_per_height_calls_{tag}", per_header * 1e3, "ms")
     emit(f"headers_one_batched_call_{tag}", batched * 1e3, "ms")
     emit(f"headers_batch_speedup_{tag}", per_header / batched, "x")
+
+
+def bench_sig_scaling():
+    """BASELINE eval 2: raw batched signature verification at 1k / 10k /
+    (optionally) 100k signatures. 100k streams through the 10240 bucket
+    (SIGS_100K=1 to enable; the smaller sizes run by default)."""
+    import numpy as np
+
+    from tendermint_tpu.crypto.batch import make_provider
+
+    sizes = [1024, 10240] + ([102400] if os.environ.get("SIGS_100K") == "1" else [])
+
+    # deterministic valid triples via the repo bench helper (repo root is
+    # already on sys.path)
+    import bench as bench_root
+
+    prov = make_provider("tpu")
+    prov.warmup(sizes=(1024,), msg_len=160)
+    for n in sizes:
+        if n > 1024:
+            prov.warmup(sizes=(min(n, 10240),), msg_len=160)
+        pks, msgs, sigs = bench_root.make_batch(min(n, 10240))
+        reps = max(1, n // 10240)
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            ok = prov.verify_batch(pks, msgs, sigs)
+        dt = time.perf_counter() - t0
+        assert ok.all()
+        emit(f"sig_verify_{n}", n / dt, "sigs/s")
+        if dt > 60:
+            # slow backend (forced-CPU fallback): larger sizes would run
+            # for many minutes without adding information
+            print(f"skipping larger sizes (last took {dt:.0f}s)", file=sys.stderr)
+            break
 
 
 def bench_vote_ingest():
@@ -300,6 +335,7 @@ BENCHES = {
     "light": bench_light,
     "headers": bench_headers_heights,
     "ingest": bench_vote_ingest,
+    "sigs": bench_sig_scaling,
     "mempool": bench_mempool,
     "secretconn": bench_secretconn,
     "valset": bench_valset,
@@ -308,7 +344,22 @@ BENCHES = {
 }
 
 
+_DEVICE_BENCHES = {"headers", "ingest", "sigs"}
+
 if __name__ == "__main__":
     names = sys.argv[1:] or list(BENCHES)
+    if _DEVICE_BENCHES & set(names):
+        # same discipline as bench.py: a wedged TPU tunnel hangs on first
+        # use; probe with a timeout and use the accelerator only when the
+        # probe's round trip succeeds. Only undo OUR setdefault — an
+        # explicitly user-set JAX_PLATFORMS wins.
+        if not _USER_SET_PLATFORM:
+            os.environ.pop("JAX_PLATFORMS", None)
+        from tendermint_tpu.utils.jaxenv import force_cpu_platform, probe_accelerator
+
+        count, platform = probe_accelerator(timeout_s=90)
+        if count == 0 or platform == "cpu":
+            print("accelerator unavailable; forcing CPU", file=sys.stderr)
+            force_cpu_platform()
     for name in names:
         BENCHES[name]()

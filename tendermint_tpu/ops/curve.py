@@ -78,6 +78,39 @@ def negate(p: Point) -> Point:
     return Point(F.neg(p.x), p.y, p.z, F.neg(p.t))
 
 
+class CachedPoint(NamedTuple):
+    """Precomputed addition operand (ref10 ge_cached): Y+X, Y-X, 2Z,
+    2d*T. Converting table entries once saves 2 field muls + 3 add/subs
+    on EVERY scan-step addition; negation is a component swap + one neg."""
+
+    ypx: jnp.ndarray
+    ymx: jnp.ndarray
+    z2: jnp.ndarray
+    t2d: jnp.ndarray
+
+
+def to_cached(p: Point) -> CachedPoint:
+    return CachedPoint(
+        F.add(p.y, p.x),
+        F.sub(p.y, p.x),
+        F.add(p.z, p.z),
+        F.mul(p.t, _D2_C),
+    )
+
+
+def add_cached(p: Point, q: CachedPoint) -> Point:
+    """p + q with q in cached form: 7M (ref10 ge_add)."""
+    a = F.mul(F.sub(p.y, p.x), q.ymx)
+    b = F.mul(F.add(p.y, p.x), q.ypx)
+    c = F.mul(p.t, q.t2d)
+    d = F.mul(p.z, q.z2)
+    e = F.sub(b, a)
+    f = F.sub(d, c)
+    g = F.add(d, c)
+    h = F.add(b, a)
+    return Point(F.mul(e, f), F.mul(g, h), F.mul(f, g), F.mul(e, h))
+
+
 def select(cond: jnp.ndarray, p: Point, q: Point) -> Point:
     """Per-row point select (cond (...,) bool)."""
     return Point(
@@ -145,15 +178,16 @@ _TBL = 8  # signed-window table holds [1..8]Q
 
 
 def _host_base_table() -> np.ndarray:
-    """(8, 4, 20) int32: extended coords of [1..8]B, precomputed on host
-    with the pure-Python reference."""
+    """(8, 4, 20) int32: CACHED coords (Y+X, Y-X, 2Z, 2dT) of [1..8]B,
+    precomputed on host with the pure-Python reference."""
     B = ref.pt_from_affine(*ref.BASE)
     rows = []
     acc = B
     for d in range(_TBL):
         x, y = ref.pt_to_affine(acc)
-        ext = (x, y, 1, (x * y) % ref.P)
-        rows.append([np.asarray(F.to_limbs(c)) for c in ext])
+        t = (x * y) % ref.P
+        cached = ((y + x) % ref.P, (y - x) % ref.P, 2, (2 * ref.D * t) % ref.P)
+        rows.append([np.asarray(F.to_limbs(c)) for c in cached])
         acc = ref.pt_add(acc, B)
     return np.asarray(rows, dtype=np.int32)
 
@@ -189,12 +223,14 @@ def _signed_digits(d: jnp.ndarray) -> jnp.ndarray:
     return jnp.stack(out, axis=-1)
 
 
-def _select_signed(table_flat: jnp.ndarray, digit: jnp.ndarray) -> Point:
-    """One-hot signed-window select from (N, 8, 80) or (8, 80) tables.
+def _select_signed(table_flat: jnp.ndarray, digit: jnp.ndarray) -> CachedPoint:
+    """One-hot signed-window select from CACHED (N, 8, 80) or (8, 80)
+    tables.
 
-    Row |digit|-1 is selected (digit 0 -> identity), then x,t are negated
-    where digit < 0. The one-hot mask-and-sum stays entirely in VPU
-    vector lanes — no gather."""
+    Row |digit|-1 is selected; digit 0 yields the cached identity
+    (1, 1, 2, 0); negation in cached form is ypx<->ymx plus one t2d
+    negation. The one-hot mask-and-sum stays entirely in VPU vector
+    lanes — no gather."""
     mag = jnp.abs(digit)  # (N,)
     onehot = (
         mag[:, None] == jnp.arange(1, _TBL + 1, dtype=jnp.int32)[None, :]
@@ -204,18 +240,18 @@ def _select_signed(table_flat: jnp.ndarray, digit: jnp.ndarray) -> Point:
     else:  # per-row table (N, 8, 80)
         sel = jnp.sum(onehot[:, :, None] * table_flat, axis=1)
     sel = sel.reshape(-1, 4, F.LIMBS)
-    x, y, z, t = sel[:, 0], sel[:, 1], sel[:, 2], sel[:, 3]
+    ypx, ymx, z2, t2d = sel[:, 0], sel[:, 1], sel[:, 2], sel[:, 3]
     zero = digit == 0
-    # identity for digit 0: (0, 1, 1, 0)
-    one = F.broadcast_const(1, x.shape[:-1]).astype(jnp.int32)
-    x = F.select(zero, jnp.zeros_like(x), x)
-    y = F.select(zero, one, y)
-    z = F.select(zero, one, z)
-    t = F.select(zero, jnp.zeros_like(t), t)
-    negate_ = (digit < 0) & ~zero
-    x = F.select(negate_, F.neg(x), x)
-    t = F.select(negate_, F.neg(t), t)
-    return Point(x, y, z, t)
+    one = F.broadcast_const(1, ypx.shape[:-1]).astype(jnp.int32)
+    two = F.broadcast_const(2, ypx.shape[:-1]).astype(jnp.int32)
+    ypx = F.select(zero, one, ypx)
+    ymx = F.select(zero, one, ymx)
+    z2 = F.select(zero, two, z2)
+    t2d = F.select(zero, jnp.zeros_like(t2d), t2d)
+    neg_ = (digit < 0) & ~zero
+    ypx, ymx = F.select(neg_, ymx, ypx), F.select(neg_, ypx, ymx)
+    t2d = F.select(neg_, F.neg(t2d), t2d)
+    return CachedPoint(ypx, ymx, z2, t2d)
 
 
 def double_scalar_mul_base(
@@ -228,9 +264,10 @@ def double_scalar_mul_base(
     """
     n = s_digits.shape[0]
 
-    # Build per-row table of [1..8]Q with a scan (keeps the graph small).
+    # Build per-row table of [1..8]Q (cached form) with a scan.
     def table_body(acc: Point, _):
-        row = jnp.stack([acc.x, acc.y, acc.z, acc.t], axis=1)
+        c = to_cached(acc)
+        row = jnp.stack([c.ypx, c.ymx, c.z2, c.t2d], axis=1)
         nxt = add(acc, q)
         return nxt, row
 
@@ -245,8 +282,8 @@ def double_scalar_mul_base(
     def body(acc: Point, digits):
         sd, kd = digits
         acc = double(double(double(double(acc))))
-        acc = add(acc, _select_signed(jnp.asarray(base_table), sd))
-        acc = add(acc, _select_signed(q_table, kd))
+        acc = add_cached(acc, _select_signed(jnp.asarray(base_table), sd))
+        acc = add_cached(acc, _select_signed(q_table, kd))
         return acc, None
 
     # scan from most-significant window down

@@ -524,23 +524,31 @@ class RPCCore:
         prof.dump_stats(filename)
         return {"log": f"wrote {filename}"}
 
-    async def unsafe_write_heap_profile(self, filename="heap.prof") -> Dict[str, Any]:
+    async def unsafe_write_heap_profile(self, filename="heap.prof", stop=False) -> Dict[str, Any]:
+        """First call arms tracemalloc; later calls dump a profile.
+        Pass stop=true with (or after) a dump to disable tracing again —
+        tracemalloc adds per-allocation overhead for as long as it runs."""
         self._require_unsafe()
         import tracemalloc
 
+        if isinstance(stop, str):
+            stop = stop.lower() in ("1", "true", "yes")
         if not tracemalloc.is_tracing():
             # tracemalloc only sees allocations made AFTER tracing starts;
             # a snapshot taken now would be empty, not the live heap
             tracemalloc.start()
             return {
                 "log": "heap tracing just started; allocations will be "
-                       "recorded from now — call again later for a profile"
+                       "recorded from now — call again later for a profile "
+                       "(pass stop=true then to disable tracing)"
             }
         snap = tracemalloc.take_snapshot()
+        if stop:
+            tracemalloc.stop()
         with open(filename, "w") as fp:
             for stat in snap.statistics("lineno")[:200]:
                 fp.write(f"{stat}\n")
-        return {"log": f"wrote {filename}"}
+        return {"log": f"wrote {filename}" + ("; tracing stopped" if stop else "")}
 
     # -- abci routes -------------------------------------------------------
 

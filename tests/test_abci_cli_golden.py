@@ -1,0 +1,46 @@
+"""abci-cli golden-file test (reference abci/tests/test_cli/: the CLI is
+run against the example apps and output compared byte-for-byte with
+checked-in .out files)."""
+
+import asyncio
+import io
+import os
+import sys
+
+GOLDEN = os.path.join(os.path.dirname(__file__), "golden", "abci_cli_counter.txt")
+
+COMMANDS = """\
+echo hello
+info
+set_option serial on
+check_tx 0x00
+deliver_tx 0x00
+deliver_tx 0x0000000000000001
+deliver_tx 0x0000000000000005
+commit
+query x tx
+"""
+
+
+def test_abci_cli_batch_matches_golden(capsys, monkeypatch):
+    from tendermint_tpu.abci.cli import _console
+    from tendermint_tpu.abci.examples import CounterApplication
+    from tendermint_tpu.abci.server.socket import SocketServer
+    from tendermint_tpu.abci.client.socket import SocketClient
+
+    async def go():
+        srv = SocketServer("tcp://127.0.0.1:0", CounterApplication(serial=True))
+        await srv.start()
+        cli = SocketClient(srv.listen_addr)
+        await cli.start()
+        try:
+            await _console(cli, lines=COMMANDS.splitlines())
+        finally:
+            await cli.stop()
+            await srv.stop()
+
+    asyncio.run(go())
+    out = capsys.readouterr().out
+    with open(GOLDEN) as fp:
+        golden = fp.read()
+    assert out == golden, f"golden mismatch:\n--- got ---\n{out}\n--- want ---\n{golden}"
