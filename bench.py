@@ -164,7 +164,6 @@ def run_bench(platform: str):
     ok_bad, _ = model.verify_commit(pks, msgs, sigs_bad, powers, counted)
     assert not ok_bad[7] and ok_bad.sum() == n - 1
 
-    _deadline_done()
     emit(
         round(p50 * 1e3, 3),
         round(baseline_10k / p50, 2),
@@ -172,6 +171,7 @@ def run_bench(platform: str):
         cold_compile_s=round(cold_s, 1),
         host_baseline_ms=round(baseline_10k * 1e3, 1),
     )
+    _deadline_done()  # AFTER emit: state-file absence must imply the line was printed
 
 
 _STATE_PATH = os.environ.get("TM_BENCH_STATE", "")
@@ -213,7 +213,7 @@ def _supervise() -> int:
         child.kill()
         child.wait()
     # A missing state file means the child already emitted its real line
-    # (it unlinks via _deadline_done just before emit) and then died in
+    # (_deadline_done unlinks it right AFTER the emit) and then died in
     # teardown — emitting again would print a second, worse line.
     if not os.path.exists(state):
         log("child emitted before dying; not double-emitting")
@@ -265,8 +265,8 @@ def main():
         import traceback
 
         traceback.print_exc(file=sys.stderr)
-        _deadline_done()
         emit(None, None, platform=platform, error=repr(e)[:400])
+        _deadline_done()
         sys.exit(0)
 
 

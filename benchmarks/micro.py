@@ -358,7 +358,7 @@ if __name__ == "__main__":
         from tendermint_tpu.utils.jaxenv import force_cpu_platform, probe_accelerator
 
         count, platform = probe_accelerator(timeout_s=90)
-        if count == 0 or platform == "cpu":
+        if (count == 0 or platform == "cpu") and not _USER_SET_PLATFORM:
             print("accelerator unavailable; forcing CPU", file=sys.stderr)
             force_cpu_platform()
     for name in names:

@@ -90,6 +90,10 @@ def canonical_sign_bytes(
     return out
 
 
+# BlockID fields (hash 25:57, parts.total 57:61, parts.hash 61:93) — the
+# span a nil vote zeroes; sign_bytes_matrix vectorizes against these.
+BLOCK_ID_OFFSET = 25
+BLOCK_ID_END = 93
 TIMESTAMP_OFFSET = 93
 
 

@@ -77,6 +77,13 @@ def event_data_json(data) -> Dict[str, Any]:
     return {"type": type(data).__name__}
 
 
+def _as_bool(v) -> bool:
+    """RPC params arrive as strings over the URI transport."""
+    if isinstance(v, str):
+        return v.lower() in ("1", "true", "yes")
+    return bool(v)
+
+
 class RPCCore:
     def __init__(self, node):
         self.node = node
@@ -471,8 +478,7 @@ class RPCCore:
         self._require_unsafe()
         if not peers:
             raise RPCError("no peers provided")
-        if isinstance(persistent, str):
-            persistent = persistent.lower() in ("1", "true", "yes")
+        persistent = _as_bool(persistent)
         return await self._unsafe_dial(peers, persistent=persistent, what="peers")
 
     async def _unsafe_dial(self, addrs, persistent: bool, what: str) -> Dict[str, Any]:
@@ -531,9 +537,10 @@ class RPCCore:
         self._require_unsafe()
         import tracemalloc
 
-        if isinstance(stop, str):
-            stop = stop.lower() in ("1", "true", "yes")
+        stop = _as_bool(stop)
         if not tracemalloc.is_tracing():
+            if stop:
+                return {"log": "heap tracing is not running"}
             # tracemalloc only sees allocations made AFTER tracing starts;
             # a snapshot taken now would be empty, not the live heap
             tracemalloc.start()

@@ -194,6 +194,50 @@ class Commit:
             chain_id=chain_id,
         )
 
+    def sign_bytes_matrix(self, chain_id: str) -> "np.ndarray":
+        """Vectorized canonical sign-bytes for ALL signatures at once:
+        (N, 160) uint8 (absent rows are zeros — callers filter by index).
+
+        Within one commit the rows differ only in timestamp and the
+        nil-vs-commit BlockID flag (the property the fixed-width layout
+        exists for), so the matrix is one numpy template broadcast plus
+        two per-row columns writes — ~50x cheaper than N Python
+        struct.pack calls on a 10k-validator commit."""
+        import numpy as np
+
+        n = len(self.signatures)
+        template = signbytes.canonical_sign_bytes(
+            msg_type=PRECOMMIT_TYPE,
+            height=self.height,
+            round_=self.round,
+            block_hash=self.block_id.hash,
+            parts_total=self.block_id.parts.total,
+            parts_hash=self.block_id.parts.hash,
+            timestamp_ns=0,
+            chain_id=chain_id,
+        )
+        mat = np.broadcast_to(
+            np.frombuffer(template, dtype=np.uint8), (n, signbytes.SIGN_BYTES_LEN)
+        ).copy()
+        ts = np.asarray(
+            [cs.timestamp_ns for cs in self.signatures], dtype=np.int64
+        )
+        # big-endian i64 at the timestamp offset
+        mat[:, signbytes.TIMESTAMP_OFFSET : signbytes.TIMESTAMP_OFFSET + 8] = (
+            ts.astype(">i8").view(np.uint8).reshape(n, 8)
+        )
+        # nil / absent rows: zero the BlockID fields
+        flags = np.asarray(
+            [cs.block_id_flag for cs in self.signatures], dtype=np.uint8
+        )
+        not_commit = flags != BLOCK_ID_FLAG_COMMIT
+        if not_commit.any():
+            mat[not_commit, signbytes.BLOCK_ID_OFFSET : signbytes.BLOCK_ID_END] = 0
+        absent = flags == BLOCK_ID_FLAG_ABSENT
+        if absent.any():
+            mat[absent] = 0
+        return mat
+
     def get_vote(self, val_idx: int) -> "Vote":
         """Reconstruct the precommit Vote behind signature `val_idx`
         (reference Commit.GetVote types/block.go:619)."""

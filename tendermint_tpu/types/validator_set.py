@@ -99,6 +99,7 @@ class ValidatorSet:
             if total > MAX_TOTAL_VOTING_POWER:
                 raise ErrTotalVotingPowerOverflow(total)
         self._total_voting_power = total
+        self._dev_arrays = None  # membership/power changed: drop the cache
 
     def copy(self) -> "ValidatorSet":
         new = ValidatorSet.__new__(ValidatorSet)
@@ -254,6 +255,23 @@ class ValidatorSet:
 
     # -- commit verification (THE hot path) --------------------------------
 
+    def _device_arrays(self) -> Tuple[np.ndarray, np.ndarray]:
+        """Cached (N,32) pubkeys + (N,) powers for this set, built once —
+        commit verification reuses them every height until the set
+        changes (any mutation path ends in _update_total_voting_power,
+        which drops the cache)."""
+        cached = getattr(self, "_dev_arrays", None)
+        if cached is not None:
+            return cached
+        n = len(self.validators)
+        pk = np.zeros((n, 32), dtype=np.uint8)
+        for i, v in enumerate(self.validators):
+            raw = v.pub_key.bytes()
+            pk[i, : min(len(raw), 32)] = np.frombuffer(raw[:32], dtype=np.uint8)
+        powers = np.asarray([v.voting_power for v in self.validators], dtype=np.int64)
+        self._dev_arrays = (pk, powers)
+        return self._dev_arrays
+
     def _commit_batch_arrays(self, chain_id: str, commit, by_address: bool) -> Tuple:
         """Pack a commit's present signatures into device-ready arrays.
 
@@ -261,6 +279,11 @@ class ValidatorSet:
         (verify_commit: commit produced by THIS set); `by_address=True`
         looks each signer up by address, skipping unknowns
         (verify_commit_trusting: commit from another set).
+
+        Vectorized: sign-bytes come from Commit.sign_bytes_matrix (one
+        numpy template + per-row columns), pubkeys/powers from the per-set
+        cache, signatures from one concatenated frombuffer — the 10k-row
+        hot path does no per-row Python struct packing.
 
         Returns (idxs, vals_idx, pubkeys(N,32), msgs(N,160), sigs(N,64),
         powers(N,), counted(N,)) where idxs maps rows back to signature
@@ -270,10 +293,7 @@ class ValidatorSet:
         """
         idxs: List[int] = []
         vals_idx: List[int] = []
-        pks: List[bytes] = []
-        msgs: List[bytes] = []
-        sigs: List[bytes] = []
-        powers: List[int] = []
+        sig_parts: List[bytes] = []
         counted: List[bool] = []
         for i, cs in enumerate(commit.signatures):
             if cs.absent_():
@@ -288,29 +308,28 @@ class ValidatorSet:
                     continue
             else:
                 vi = i
-                val = self.validators[i]
             idxs.append(i)
             vals_idx.append(vi)
-            pks.append(val.pub_key.bytes())
-            msgs.append(commit.vote_sign_bytes(chain_id, i))
-            sigs.append(cs.signature)
-            powers.append(val.voting_power)
+            sig_parts.append(cs.signature.ljust(64, b"\x00"))
             counted.append(cs.for_block())
         n = len(idxs)
-        pk = np.zeros((n, 32), dtype=np.uint8)
-        mg = np.zeros((n, 160), dtype=np.uint8)
-        sg = np.zeros((n, 64), dtype=np.uint8)
-        for r in range(n):
-            pk[r] = np.frombuffer(pks[r], dtype=np.uint8)
-            mg[r] = np.frombuffer(msgs[r], dtype=np.uint8)
-            sg[r, : len(sigs[r])] = np.frombuffer(sigs[r], dtype=np.uint8)
+        all_pk, all_powers = self._device_arrays()
+        vals_idx_arr = np.asarray(vals_idx, dtype=np.int64)
+        pk = all_pk[vals_idx_arr] if n else np.zeros((0, 32), dtype=np.uint8)
+        powers = all_powers[vals_idx_arr] if n else np.zeros(0, dtype=np.int64)
+        mg = commit.sign_bytes_matrix(chain_id)[np.asarray(idxs, dtype=np.int64)] \
+            if n else np.zeros((0, 160), dtype=np.uint8)
+        sg = (
+            np.frombuffer(b"".join(sig_parts), dtype=np.uint8).reshape(n, 64)
+            if n else np.zeros((0, 64), dtype=np.uint8)
+        )
         return (
             idxs,
             vals_idx,
             pk,
             mg,
             sg,
-            np.asarray(powers, dtype=np.int64),
+            powers,
             np.asarray(counted, dtype=bool),
         )
 

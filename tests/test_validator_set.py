@@ -261,3 +261,66 @@ class TestEncoding:
         vs2 = ValidatorSet.decode(data)
         assert vs == vs2
         assert vs2.hash() == vs.hash()
+
+
+def test_sign_bytes_matrix_equals_scalar_path():
+    """Commit.sign_bytes_matrix must be byte-identical to per-index
+    vote_sign_bytes for every flag combination (commit/nil/absent)."""
+    import numpy as np
+
+    from tests.light_helpers import CHAIN_ID, gen_chain
+
+    headers, valsets = lh_chain = gen_chain(2)
+    commit = headers[1].commit
+    # mutate flags: make row 1 nil, row 2 absent (4 validators)
+    from tendermint_tpu.types.block import (
+        BLOCK_ID_FLAG_ABSENT,
+        BLOCK_ID_FLAG_NIL,
+    )
+
+    commit.signatures[1].block_id_flag = BLOCK_ID_FLAG_NIL
+    commit.signatures[2].block_id_flag = BLOCK_ID_FLAG_ABSENT
+    commit.signatures[2].validator_address = b""
+    commit.signatures[2].signature = b""
+
+    mat = commit.sign_bytes_matrix(CHAIN_ID)
+    for i, cs in enumerate(commit.signatures):
+        if cs.absent_():
+            assert not mat[i].any()
+            continue
+        want = commit.vote_sign_bytes(CHAIN_ID, i)
+        got = bytes(bytearray(mat[i]))
+        assert got == want, f"row {i} flag {cs.block_id_flag}"
+
+
+def test_commit_batch_arrays_vectorized_equivalence():
+    """The vectorized _commit_batch_arrays must produce exactly what the
+    direct per-row construction would."""
+    import numpy as np
+
+    from tests.light_helpers import CHAIN_ID, gen_chain
+
+    headers, valsets = gen_chain(3)
+    commit = headers[2].commit
+    vals = valsets[2]
+    idxs, vals_idx, pk, mg, sg, powers, counted = vals._commit_batch_arrays(
+        CHAIN_ID, commit, by_address=False
+    )
+    assert idxs == list(range(4))
+    for r, i in enumerate(idxs):
+        cs = commit.signatures[i]
+        assert bytes(bytearray(mg[r])) == commit.vote_sign_bytes(CHAIN_ID, i)
+        assert bytes(bytearray(sg[r])) == cs.signature.ljust(64, b"\x00")
+        assert bytes(bytearray(pk[r])) == vals.validators[i].pub_key.bytes()
+        assert powers[r] == vals.validators[i].voting_power
+    # cache invalidation: power change must drop _dev_arrays
+    vals._device_arrays()
+    assert vals._dev_arrays is not None
+    from tendermint_tpu.types.validator import Validator
+
+    changed = vals.validators[0].copy()
+    changed.voting_power = 99
+    vals.update_with_change_set([changed])
+    assert vals._dev_arrays is None
+    pk2, powers2 = vals._device_arrays()
+    assert 99 in powers2
