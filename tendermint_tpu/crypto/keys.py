@@ -66,6 +66,14 @@ class PrivKey:
         raise NotImplementedError
 
 
+def is_batch_ed25519(pub_key) -> bool:
+    """True when `pub_key` can ride the batched device verifier: a
+    32-byte ed25519 key. Non-ed25519 validator keys (secp256k1, ...)
+    verify serially via their own type — keep this predicate the single
+    source of truth for both VoteSet ingest and commit verification."""
+    return isinstance(pub_key, Ed25519PubKey) and len(pub_key.bytes()) == 32
+
+
 class Ed25519PubKey(PubKey):
     type_name = ED25519_TYPE
     __slots__ = ("_raw", "_pk")

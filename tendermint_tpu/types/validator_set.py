@@ -107,6 +107,12 @@ class ValidatorSet:
         new.proposer = self.proposer.copy() if self.proposer else None
         new._total_voting_power = self._total_voting_power
         new._addr_index = dict(self._addr_index)
+        # the pubkey/power arrays are immutable once built (fancy indexing
+        # copies them at use sites) and every membership/power mutation
+        # drops them via _update_total_voting_power — safe to share, and
+        # propagating keeps the hot-path cache alive across the per-height
+        # copies in state/execution.py
+        new._dev_arrays = getattr(self, "_dev_arrays", None)
         return new
 
     def hash(self) -> bytes:
@@ -255,21 +261,31 @@ class ValidatorSet:
 
     # -- commit verification (THE hot path) --------------------------------
 
-    def _device_arrays(self) -> Tuple[np.ndarray, np.ndarray]:
-        """Cached (N,32) pubkeys + (N,) powers for this set, built once —
-        commit verification reuses them every height until the set
-        changes (any mutation path ends in _update_total_voting_power,
-        which drops the cache)."""
+    def _device_arrays(self) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+        """Cached (N,32) pubkeys + (N,) powers + (N,) ed25519-mask for
+        this set, built once — commit verification reuses them every
+        height until the set changes (any mutation path ends in
+        _update_total_voting_power, which drops the cache).
+
+        Rows whose key is not a 32-byte ed25519 key (e.g. secp256k1,
+        crypto/secp256k1.py) are masked out: the batch kernel is
+        ed25519-only, so those rows verify serially via their own key
+        type instead of being silently truncated into garbage."""
         cached = getattr(self, "_dev_arrays", None)
         if cached is not None:
             return cached
+        from tendermint_tpu.crypto.keys import is_batch_ed25519
+
         n = len(self.validators)
         pk = np.zeros((n, 32), dtype=np.uint8)
+        ed = np.zeros(n, dtype=bool)
         for i, v in enumerate(self.validators):
             raw = v.pub_key.bytes()
-            pk[i, : min(len(raw), 32)] = np.frombuffer(raw[:32], dtype=np.uint8)
+            if is_batch_ed25519(v.pub_key):
+                pk[i] = np.frombuffer(raw, dtype=np.uint8)
+                ed[i] = True
         powers = np.asarray([v.voting_power for v in self.validators], dtype=np.int64)
-        self._dev_arrays = (pk, powers)
+        self._dev_arrays = (pk, powers, ed)
         return self._dev_arrays
 
     def _commit_batch_arrays(self, chain_id: str, commit, by_address: bool) -> Tuple:
@@ -313,10 +329,11 @@ class ValidatorSet:
             sig_parts.append(cs.signature.ljust(64, b"\x00"))
             counted.append(cs.for_block())
         n = len(idxs)
-        all_pk, all_powers = self._device_arrays()
+        all_pk, all_powers, all_ed = self._device_arrays()
         vals_idx_arr = np.asarray(vals_idx, dtype=np.int64)
         pk = all_pk[vals_idx_arr] if n else np.zeros((0, 32), dtype=np.uint8)
         powers = all_powers[vals_idx_arr] if n else np.zeros(0, dtype=np.int64)
+        ed = all_ed[vals_idx_arr] if n else np.zeros(0, dtype=bool)
         mg = commit.sign_bytes_matrix(chain_id)[np.asarray(idxs, dtype=np.int64)] \
             if n else np.zeros((0, 160), dtype=np.uint8)
         sg = (
@@ -331,7 +348,32 @@ class ValidatorSet:
             sg,
             powers,
             np.asarray(counted, dtype=bool),
+            ed,
         )
+
+    def _verify_rows(
+        self, commit, idxs, vals_idx, pk, mg, sg, powers, counted, ed, provider
+    ) -> np.ndarray:
+        """Per-row signature validity: ed25519 rows go to the batch
+        provider in one call; rows with other key types (secp256k1, ...)
+        verify serially through their own PubKey.verify — the
+        reference accepts any registered key type for validators
+        (types/validator_set.go:641 calls the interface method)."""
+        if ed.all():
+            ok, _ = provider.verify_commit_batch(pk, mg, sg, powers, counted)
+            return np.asarray(ok)
+        ok = np.zeros(len(idxs), dtype=bool)
+        sub = np.nonzero(ed)[0]
+        if sub.size:
+            sub_ok, _ = provider.verify_commit_batch(
+                pk[sub], mg[sub], sg[sub], powers[sub], counted[sub]
+            )
+            ok[sub] = np.asarray(sub_ok)
+        for r in np.nonzero(~ed)[0]:
+            v = self.validators[vals_idx[r]]
+            sig = commit.signatures[idxs[r]].signature
+            ok[r] = bool(v.pub_key.verify(mg[r].tobytes(), sig))
+        return ok
 
     def _verify_commit_basic(self, commit, height: int, block_id) -> None:
         """Shared pre-checks (reference verifyCommitBasic,
@@ -365,11 +407,11 @@ class ValidatorSet:
         self._check_commit_size(commit)
         self._verify_commit_basic(commit, height, block_id)
 
-        idxs, _vals_idx, pk, mg, sg, powers, counted = self._commit_batch_arrays(
+        idxs, vals_idx, pk, mg, sg, powers, counted, ed = self._commit_batch_arrays(
             chain_id, commit, by_address=False
         )
         v = provider or get_default_provider()
-        ok, _talled = v.verify_commit_batch(pk, mg, sg, powers, counted)
+        ok = self._verify_rows(commit, idxs, vals_idx, pk, mg, sg, powers, counted, ed, v)
         self._replay_commit_full(commit, ok, idxs, powers, counted)
 
     def _check_commit_size(self, commit) -> None:
@@ -429,11 +471,13 @@ class ValidatorSet:
         self._validate_trust_level(trust_level)
         self._verify_commit_basic(commit, height, block_id)
 
-        idxs, vals_idx, pk, mg, sg, powers_arr, counted_arr = self._commit_batch_arrays(
+        idxs, vals_idx, pk, mg, sg, powers_arr, counted_arr, ed = self._commit_batch_arrays(
             chain_id, commit, by_address=True
         )
         v = provider or get_default_provider()
-        ok, _ = v.verify_commit_batch(pk, mg, sg, powers_arr, counted_arr)
+        ok = self._verify_rows(
+            commit, idxs, vals_idx, pk, mg, sg, powers_arr, counted_arr, ed, v
+        )
         self._replay_commit_trusting(ok, idxs, vals_idx, powers_arr, counted_arr, trust_level)
 
     def _replay_commit_trusting(
@@ -573,13 +617,15 @@ def verify_commits_batched(
             else:
                 s.valset._check_commit_size(s.commit)
             s.valset._verify_commit_basic(s.commit, s.height, s.block_id)
-            idxs, vals_idx, pk, mg, sg, powers, counted = s.valset._commit_batch_arrays(
-                s.chain_id, s.commit, by_address=(s.mode == "trusting")
+            idxs, vals_idx, pk, mg, sg, powers, counted, ed = (
+                s.valset._commit_batch_arrays(
+                    s.chain_id, s.commit, by_address=(s.mode == "trusting")
+                )
             )
         except Exception as e:
             results[si] = e
             continue
-        segments.append((si, idxs, vals_idx, powers, counted, len(idxs)))
+        segments.append((si, idxs, vals_idx, powers, counted, len(idxs), ed))
         pk_parts.append(pk)
         mg_parts.append(mg)
         sg_parts.append(sg)
@@ -590,11 +636,29 @@ def verify_commits_batched(
     pk = np.concatenate(pk_parts, axis=0)
     mg = np.concatenate(mg_parts, axis=0)
     sg = np.concatenate(sg_parts, axis=0)
+    ed_all = np.concatenate([seg[6] for seg in segments])
     v = provider or get_default_provider()
-    ok = np.asarray(v.verify_batch(pk, mg, sg))  # ★ ONE device call, all heights
+    if ed_all.all():
+        ok = np.asarray(v.verify_batch(pk, mg, sg))  # ★ ONE device call, all heights
+    else:
+        # non-ed25519 validator keys verify serially via their own type
+        ok = np.zeros(len(ed_all), dtype=bool)
+        sub = np.nonzero(ed_all)[0]
+        if sub.size:
+            ok[sub] = np.asarray(v.verify_batch(pk[sub], mg[sub], sg[sub]))
+        off0 = 0
+        for si, idxs, vals_idx, powers, counted, n, ed in segments:
+            s = specs[si]
+            for r in np.nonzero(~ed)[0]:
+                val = s.valset.validators[vals_idx[r]]
+                sig = s.commit.signatures[idxs[r]].signature
+                ok[off0 + r] = bool(
+                    val.pub_key.verify(mg[off0 + r].tobytes(), sig)
+                )
+            off0 += n
 
     off = 0
-    for si, idxs, vals_idx, powers, counted, n in segments:
+    for si, idxs, vals_idx, powers, counted, n, _ed in segments:
         s = specs[si]
         ok_slice = ok[off : off + n]
         off += n

@@ -16,6 +16,7 @@ from __future__ import annotations
 from typing import Dict, List, Optional, Sequence, Tuple
 
 from tendermint_tpu.crypto.batch import BatchVerifier, get_default_provider, pack_triples
+from tendermint_tpu.crypto.keys import is_batch_ed25519
 from tendermint_tpu.types.block import BlockID
 from tendermint_tpu.types.validator_set import ValidatorSet
 from tendermint_tpu.types.vote import Vote, is_vote_type_valid
@@ -195,6 +196,7 @@ class VoteSet:
         errors: List[Exception] = []
 
         prepared: List[Optional[Tuple[Vote, int]]] = [None] * len(votes)
+        direct_ok: List[Optional[bool]] = [None] * len(votes)
         for k, vote in enumerate(votes):
             if vote is None:
                 errors.append(ValueError("nil vote"))
@@ -206,8 +208,19 @@ class VoteSet:
                 continue
             _, val = self.val_set.get_by_index(vote.validator_index)
             prepared[k] = (vote, val.voting_power)
+            raw = val.pub_key.bytes()
+            if not is_batch_ed25519(val.pub_key):
+                # non-ed25519 validator key (e.g. secp256k1): the batch
+                # kernel is ed25519-only — verify through the key's own
+                # type (reference Vote.Verify calls the interface method)
+                direct_ok[k] = bool(
+                    val.pub_key.verify(
+                        vote.sign_bytes(self.chain_id), vote.signature
+                    )
+                )
+                continue
             rows.append(k)
-            pks.append(val.pub_key.bytes())
+            pks.append(raw)
             msgs.append(vote.sign_bytes(self.chain_id))
             sigs.append(vote.signature)
 
@@ -218,11 +231,17 @@ class VoteSet:
             ok = provider.verify_batch(pk, mg, sg, msg_lens=lens)
         else:
             ok = []
+        ok_by_vote: Dict[int, bool] = {k: bool(o) for k, o in zip(rows, ok)}
+        for k, o in enumerate(direct_ok):
+            if o is not None:
+                ok_by_vote[k] = o
 
         # Phase 3: apply verified votes in order (serial, deterministic).
-        for r, k in enumerate(rows):
-            vote, power = prepared[k]  # type: ignore[misc]
-            if not ok[r]:
+        for k, prep in enumerate(prepared):
+            if prep is None:
+                continue
+            vote, power = prep
+            if not ok_by_vote.get(k, False):
                 errors.append(ErrVoteInvalidSignature(repr(vote), vote=vote))
                 continue
             conflict = self._add_verified_vote(vote, power)

@@ -373,3 +373,244 @@ def test_commit_round0_start_waits_for_timeout_commit():
             await cs.stop()
 
     run(go())
+
+
+# -- invalid proposals -------------------------------------------------------
+
+
+def test_prevote_nil_on_invalid_proposal_block():
+    """A syntactically complete proposal whose block fails state
+    validation (wrong AppHash) draws a NIL prevote, not a block prevote
+    (reference TestStateBadProposal, defaultDoPrevote validate path)."""
+
+    async def go():
+        # run the real node as a NON-proposer so the injected proposal is
+        # the only one on the table (a proposer node prevotes its own
+        # honest block before the bad one arrives)
+        from tendermint_tpu.state.state import state_from_genesis_doc
+
+        genesis, privs = make_genesis(4)
+        proposer_addr = state_from_genesis_doc(genesis).validators.get_proposer().address
+        ours = next(p for p in privs if p.address() != proposer_addr)
+        node = await make_node(genesis, ours, config=slow_config())
+        cs = node.cs
+        await cs.start()
+        await wait_for(lambda: cs.rs.step >= STEP_PROPOSE, what="propose step")
+        try:
+            proposer = cs.rs.validators.get_proposer()
+            p_priv = next(p for p in privs if p.address() == proposer.address)
+            from tendermint_tpu.types.block import Commit
+            from tendermint_tpu.types.tx import Txs
+
+            block = cs.state.make_block(
+                cs.rs.height, Txs(),
+                Commit(height=0, round=0, block_id=BlockID(), signatures=[]),
+                [], proposer.address, time_ns=777,
+            )
+            block.header.app_hash = b"\xaa" * 32  # breaks validate_block
+            bad_bid = await inject_proposal(cs, p_priv, block, cs.rs.round)
+            await wait_for(
+                lambda: cs.rs.votes.prevotes(cs.rs.round) is not None
+                and cs.rs.votes.prevotes(cs.rs.round).get_by_address(
+                    ours.address()
+                )
+                is not None,
+                what="our prevote",
+            )
+            our = cs.rs.votes.prevotes(cs.rs.round).get_by_address(ours.address())
+            assert our.is_nil(), f"expected nil prevote, got {our.block_id}"
+            assert cs.rs.locked_block is None
+        finally:
+            await cs.stop()
+
+    run(go())
+
+
+def test_proposal_pol_round_validation():
+    """POLRound must be -1 or in [0, round) — a proposal claiming a POL
+    from its own round or later is rejected (reference
+    defaultSetProposal :1614 bounds check)."""
+
+    async def go():
+        node, cs, privs = await setup()
+        try:
+            proposer = cs.rs.validators.get_proposer()
+            p_priv = next(p for p in privs if p.address() == proposer.address)
+            from tendermint_tpu.types.block import Commit
+            from tendermint_tpu.types.proposal import Proposal
+            from tendermint_tpu.types.tx import Txs
+
+            cs.rs.proposal = None
+            cs.rs.proposal_block = None
+            cs.rs.proposal_block_parts = None
+            block = cs.state.make_block(
+                cs.rs.height, Txs(),
+                Commit(height=0, round=0, block_id=BlockID(), signatures=[]),
+                [], proposer.address, time_ns=31,
+            )
+            parts = block.make_part_set()
+            prop = Proposal(
+                height=cs.rs.height, round=cs.rs.round,
+                pol_round=cs.rs.round,  # INVALID: pol_round == round
+                block_id=BlockID(block.hash(), parts.header()), timestamp_ns=1,
+            )
+            p_priv.sign_proposal(CHAIN_ID, prop)
+            with pytest.raises(Exception):
+                await cs._default_set_proposal(prop)
+            assert cs.rs.proposal is None
+        finally:
+            await cs.stop()
+
+    run(go())
+
+
+# -- relock (LockPOLRelock) --------------------------------------------------
+
+
+def test_relock_on_new_round_polka():
+    """Locked on B0 in round 0; round 1 produces a polka for a DIFFERENT
+    block B1 with its proposal on the table -> the validator precommits
+    B1 and relocks (reference TestStateLockPOLRelock)."""
+
+    async def go():
+        # a short PRECOMMIT timeout drives the round 0 -> 1 advance (the
+        # reference test's mechanism), so the stubs' only round-1 votes
+        # are the ALT polka itself (no conflicting-vote rejections)
+        cfg = slow_config()
+        cfg.timeout_precommit_ms = 150
+        genesis, privs = make_genesis(4)
+        node = await make_node(genesis, privs[0], config=cfg)
+        cs = node.cs
+        await cs.start()
+        await wait_for(lambda: cs.rs.step >= STEP_PROPOSE, what="propose step")
+        try:
+            bid0 = await arrange_round0_proposal(cs, privs)
+            await wait_for(lambda: cs.rs.step >= STEP_PREVOTE, what="prevote")
+            others = [p for p in privs if p.address() != privs[0].address()]
+            for p in others[:2]:
+                await cs.add_vote_from_peer(
+                    stub_vote(cs, p, PREVOTE_TYPE, bid0), "stub"
+                )
+            await wait_step(cs, STEP_PRECOMMIT)
+            assert cs.rs.locked_round == 0
+            assert cs.rs.locked_block.hash() == bid0.hash
+
+            # 3 nil precommits + ours for B0 = +2/3 any -> precommit wait
+            # -> 150ms timeout -> round 1 (still locked on B0)
+            nil = BlockID()
+            for p in others:
+                await cs.add_vote_from_peer(
+                    stub_vote(cs, p, PRECOMMIT_TYPE, nil), "stub"
+                )
+            await wait_for(lambda: cs.rs.round == 1, what="round 1")
+            assert cs.rs.locked_round == 0
+
+            # a VALID alternative block (validated at relock time —
+            # initial-height blocks must carry the genesis time)
+            from tendermint_tpu.types.block import Commit
+            from tendermint_tpu.types.tx import Tx, Txs
+
+            alt = cs.state.make_block(
+                cs.rs.height, Txs([Tx(b"alt")]),
+                Commit(height=0, round=0, block_id=BlockID(), signatures=[]),
+                [], cs.rs.validators.get_proposer().address,
+                time_ns=genesis.genesis_time_ns,
+            )
+            proposer1 = cs.rs.validators.get_proposer()
+            if proposer1.address != privs[0].address():
+                p1 = next(p for p in privs if p.address() == proposer1.address)
+                alt_bid = await inject_proposal(cs, p1, alt, 1)
+            else:
+                # our node proposed its locked block B0; replace the
+                # proposal with ALT signed by ourselves (we ARE the
+                # round-1 proposer, so the signature check passes)
+                cs.rs.proposal = None
+                cs.rs.proposal_block = None
+                cs.rs.proposal_block_parts = None
+                alt_bid = await inject_proposal(cs, privs[0], alt, 1)
+            await wait_for(
+                lambda: cs.rs.proposal_block is not None
+                and cs.rs.proposal_block.hash() == alt_bid.hash,
+                what="round-1 proposal block",
+            )
+
+            # full polka for ALT in round 1 (3 stub validators = +2/3)
+            for p in others:
+                await cs.add_vote_from_peer(
+                    stub_vote(cs, p, PREVOTE_TYPE, alt_bid, round_=1), "stub"
+                )
+            await wait_for(
+                lambda: cs.rs.locked_round == 1
+                and cs.rs.locked_block is not None
+                and cs.rs.locked_block.hash() == alt_bid.hash,
+                what="relock on ALT",
+            )
+            our_pc = cs.rs.votes.precommits(1).get_by_address(privs[0].address())
+            assert our_pc is not None and our_pc.block_id.hash == alt_bid.hash
+        finally:
+            await cs.stop()
+
+    run(go())
+
+
+# -- proposer rotation across rounds ----------------------------------------
+
+
+def test_proposer_rotates_across_rounds_within_height():
+    """With 4 equal-power validators the proposer must differ between
+    round 0 and round 1 of the same height (reference
+    TestStateProposerSelection2: round-robin by round increments)."""
+
+    async def go():
+        node, cs, privs = await setup()
+        try:
+            proposer_r0 = cs.rs.validators.get_proposer().address
+            nil = BlockID()
+            from tendermint_tpu.types.block import PartSetHeader
+
+            stray = BlockID(b"\x31" * 32, PartSetHeader(1, b"\x32" * 32))
+            others = [p for p in privs if p.address() != privs[0].address()]
+            for p, target in zip(others, (nil, nil, stray)):
+                await cs.add_vote_from_peer(
+                    stub_vote(cs, p, PREVOTE_TYPE, target, round_=1), "stub"
+                )
+            await wait_for(lambda: cs.rs.round == 1, what="round 1")
+            proposer_r1 = cs.rs.validators.get_proposer().address
+            assert proposer_r1 != proposer_r0
+        finally:
+            await cs.stop()
+
+    run(go())
+
+
+# -- commit needs the full +2/3 ---------------------------------------------
+
+
+def test_commit_waits_for_full_two_thirds_precommits():
+    """2 of 4 precommits for the block do NOT commit (2/4 < 2/3); the
+    third tips it over (reference TestStateHalt1 flavor)."""
+
+    async def go():
+        node, cs, privs = await setup()
+        try:
+            h0 = cs.rs.height
+            bid = await arrange_round0_proposal(cs, privs)
+            await wait_for(lambda: cs.rs.step >= STEP_PREVOTE, what="prevote")
+            await inject_votes(cs, privs, PREVOTE_TYPE, bid)
+            await wait_step(cs, STEP_PRECOMMIT)
+            others = [p for p in privs if p.address() != privs[0].address()]
+            # our precommit + 1 stub = 2 of 4 -> NOT enough
+            await cs.add_vote_from_peer(
+                stub_vote(cs, others[0], PRECOMMIT_TYPE, bid), "stub"
+            )
+            await asyncio.sleep(0.3)
+            assert cs.rs.height == h0, "committed without +2/3 precommits"
+            # third precommit tips it over
+            await cs.add_vote_from_peer(
+                stub_vote(cs, others[1], PRECOMMIT_TYPE, bid), "stub"
+            )
+            await wait_for(lambda: cs.rs.height == h0 + 1, what="commit")
+        finally:
+            await cs.stop()
+
+    run(go())

@@ -303,9 +303,10 @@ def test_commit_batch_arrays_vectorized_equivalence():
     headers, valsets = gen_chain(3)
     commit = headers[2].commit
     vals = valsets[2]
-    idxs, vals_idx, pk, mg, sg, powers, counted = vals._commit_batch_arrays(
+    idxs, vals_idx, pk, mg, sg, powers, counted, ed = vals._commit_batch_arrays(
         CHAIN_ID, commit, by_address=False
     )
+    assert ed.all()  # all-ed25519 set
     assert idxs == list(range(4))
     for r, i in enumerate(idxs):
         cs = commit.signatures[i]
@@ -322,5 +323,61 @@ def test_commit_batch_arrays_vectorized_equivalence():
     changed.voting_power = 99
     vals.update_with_change_set([changed])
     assert vals._dev_arrays is None
-    pk2, powers2 = vals._device_arrays()
+    pk2, powers2, ed2 = vals._device_arrays()
     assert 99 in powers2
+
+
+def test_mixed_key_type_commit_verification():
+    """A validator set containing a secp256k1 key verifies commits
+    correctly: ed25519 rows go through the batch provider, the secp row
+    through its own key type (reference accepts any registered key type,
+    types/validator_set.go:641). Regression: non-32-byte pubkeys must
+    never be silently truncated into the ed25519 batch."""
+    import pytest
+
+    from tendermint_tpu.codec.signbytes import PRECOMMIT_TYPE
+    from tendermint_tpu.crypto.keys import Ed25519PrivKey
+    from tendermint_tpu.crypto.secp256k1 import Secp256k1PrivKey
+    from tendermint_tpu.types.block import BlockID, PartSetHeader
+    from tendermint_tpu.types.validator import Validator
+    from tendermint_tpu.types.validator_set import (
+        ErrInvalidCommitSignature,
+        ValidatorSet,
+    )
+    from tendermint_tpu.types.vote import Vote
+    from tendermint_tpu.types.vote_set import VoteSet
+
+    chain_id = "mixed-key-chain"
+    eds = [Ed25519PrivKey.from_secret(f"mixed-{i}".encode()) for i in range(3)]
+    secp = Secp256k1PrivKey.from_secret(b"mixed-secp")
+    privs = eds + [secp]
+    vals = ValidatorSet([Validator(p.pub_key(), 10) for p in privs])
+    by_addr = {p.pub_key().address(): p for p in privs}
+
+    block_id = BlockID(b"\x42" * 32, PartSetHeader(1, b"\x43" * 32))
+    vs = VoteSet(chain_id, 5, 0, PRECOMMIT_TYPE, vals)
+    for idx, val in enumerate(vals.validators):
+        priv = by_addr[val.address]
+        v = Vote(
+            vote_type=PRECOMMIT_TYPE, height=5, round=0, block_id=block_id,
+            timestamp_ns=1234, validator_address=val.address,
+            validator_index=idx,
+        )
+        v.signature = priv.sign(v.sign_bytes(chain_id))
+        assert vs.add_vote(v), f"vote {idx} ({type(priv).__name__}) rejected"
+    commit = vs.make_commit()
+
+    # full verification accepts the mixed commit
+    vals.verify_commit(chain_id, block_id, 5, commit)
+
+    # tampering the secp row's signature is DETECTED (not masked by
+    # truncation into an always-failing ed25519 row after quorum)
+    secp_idx = next(
+        i for i, val in enumerate(vals.validators)
+        if len(val.pub_key.bytes()) != 32
+    )
+    sig = bytearray(commit.signatures[secp_idx].signature)
+    sig[-1] ^= 1
+    commit.signatures[secp_idx].signature = bytes(sig)
+    with pytest.raises(ErrInvalidCommitSignature):
+        vals.verify_commit(chain_id, block_id, 5, commit)
