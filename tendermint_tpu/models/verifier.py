@@ -91,42 +91,100 @@ class VerifierModel:
 
         return P(BATCH_AXIS), P()
 
-    def _build(self, kind: str):
-        """Build the (lazily compiled) jitted callable for `kind`."""
-        if self.mesh is None:
-            if kind == "verify":
-                return jax.jit(ops_ed.verify_core)
-            return jax.jit(ops_ed.verify_and_tally)
+    def _stages(self):
+        """Shared stage-1/2 jit wrappers, built once per model.
 
-        # Mesh path: shard_map keeps the per-device program identical to
-        # the single-device one — compile time is O(1) in mesh size and
-        # XLA inserts exactly one psum (over ICI) for the tally.
-        batch, rep = self._shard_specs()
-        if kind == "verify":
-            mapped = jax.shard_map(
-                ops_ed.verify_core,
+        prepare and scan depend only on input shapes, not on `kind` or
+        msg_len-vs-tally flavor, so one jit wrapper serves every bucket
+        (jit re-specializes per shape internally) — the dominant scan
+        stage is traced/compiled once per n_pad, not once per
+        (kind, msg_len) combination."""
+        cached = getattr(self, "_stage_fns", None)
+        if cached is not None:
+            return cached
+        if self.mesh is None:
+            s1 = jax.jit(ops_ed.verify_stage_prepare)
+            s2 = jax.jit(ops_ed.verify_stage_scan)
+        else:
+            batch, _ = self._shard_specs()
+            s1 = self._smap(ops_ed.verify_stage_prepare, 3, (batch,) * 8)
+            s2 = self._smap(ops_ed.verify_stage_scan, 6, (batch,) * 4)
+        self._stage_fns = (s1, s2)
+        return self._stage_fns
+
+    def _smap(self, f, n_in, out_specs):
+        batch, _ = self._shard_specs()
+        return jax.jit(
+            jax.shard_map(
+                f,
                 mesh=self.mesh,
-                in_specs=(batch, batch, batch),
-                out_specs=batch,
+                in_specs=(batch,) * n_in,
+                out_specs=out_specs,
                 check_vma=False,
             )
-            return jax.jit(mapped)
-
-        def tally_core(pk, mg, sg, chunks, counted):
-            ok = ops_ed.verify_core(pk, mg, sg)
-            mask = (ok & counted).astype(jnp.int32)
-            local = jnp.sum(chunks * mask[:, None], axis=0)
-            total = jax.lax.psum(local, BATCH_AXIS)
-            return ok, total
-
-        mapped = jax.shard_map(
-            tally_core,
-            mesh=self.mesh,
-            in_specs=(batch, batch, batch, batch, batch),
-            out_specs=(batch, rep),
-            check_vma=False,
         )
-        return jax.jit(mapped)
+
+    def _build(self, kind: str):
+        """Build the (lazily compiled) callable for `kind`.
+
+        The verify program is jitted as THREE chained stages (prepare /
+        scan / finish) rather than one graph: XLA compile time is
+        superlinear in program size — the fused graph compiles in ~220s
+        on a v5e, the stages in ~33s total. Intermediates stay
+        device-resident between stages, so warm latency is unchanged
+        (two extra ~0.1ms dispatches).
+
+        Mesh path: shard_map keeps the per-device program identical to
+        the single-device one — compile time is O(1) in mesh size and
+        XLA inserts exactly one psum (over ICI) for the tally. Stages
+        are shard_mapped independently; every intermediate is sharded
+        over the batch axis so no collective moves between stages."""
+        s1, s2 = self._stages()
+        if self.mesh is None:
+            if kind == "verify":
+                s3 = jax.jit(ops_ed.verify_stage_finish)
+
+                def fn(pk, mg, sg):
+                    pre = s1(pk, mg, sg)
+                    coords = s2(*pre[:6])
+                    return s3(*coords, sg, pre[6], pre[7])
+
+                return fn
+
+            s3t = jax.jit(ops_ed.verify_stage_finish_tally)
+
+            def fn(pk, mg, sg, chunks, counted):
+                pre = s1(pk, mg, sg)
+                coords = s2(*pre[:6])
+                return s3t(*coords, sg, pre[6], pre[7], chunks, counted)
+
+            return fn
+
+        batch, rep = self._shard_specs()
+        if kind == "verify":
+            s3 = self._smap(ops_ed.verify_stage_finish, 7, batch)
+
+            def fn(pk, mg, sg):
+                pre = s1(pk, mg, sg)
+                coords = s2(*pre[:6])
+                return s3(*coords, sg, pre[6], pre[7])
+
+            return fn
+
+        def finish_tally_psum(px, py, pz, pt, sg, a_ok, s_ok, chunks, counted):
+            ok, local = ops_ed.verify_stage_finish_tally(
+                px, py, pz, pt, sg, a_ok, s_ok, chunks, counted
+            )
+            return ok, jax.lax.psum(local, BATCH_AXIS)
+
+        s3t = self._smap(finish_tally_psum, 9, (batch, rep))
+
+        def fn(pk, mg, sg, chunks, counted):
+            pre = s1(pk, mg, sg)
+            coords = s2(*pre[:6])
+            return s3t(*coords, sg, pre[6], pre[7], chunks, counted)
+
+        return fn
 
     def _entry(self, kind: str, n_pad: int, msg_len: int) -> _Entry:
         key = (kind, n_pad, msg_len)

@@ -206,7 +206,7 @@ def nibble_digits(scalar_bytes: jnp.ndarray) -> jnp.ndarray:
     return jnp.stack([lo, hi], axis=-1).reshape(*scalar_bytes.shape[:-1], 64)
 
 
-def _signed_digits(d: jnp.ndarray) -> jnp.ndarray:
+def signed_digits(d: jnp.ndarray) -> jnp.ndarray:
     """Recode base-16 digits (N, 64) to signed digits in [-8, 8).
 
     d_i >= 8 becomes d_i - 16 with a +1 carry into d_{i+1}. Scalars here
@@ -257,12 +257,21 @@ def _select_signed(table_flat: jnp.ndarray, digit: jnp.ndarray) -> CachedPoint:
 def double_scalar_mul_base(
     s_digits: jnp.ndarray, k_digits: jnp.ndarray, q: Point
 ) -> Point:
-    """[s]B + [k]Q for a batch: s_digits/k_digits (N, 64) nibbles, q a
-    batched point (N-leading axes). Straus with shared doublings:
-    256 doublings + 128 one-hot table additions + 7 table-build
-    additions ([1..8]Q).
+    """[s]B + [k]Q from raw (N, 64) nibble digits (recodes on device)."""
+    return double_scalar_mul_signed(
+        signed_digits(s_digits), signed_digits(k_digits), q
+    )
+
+
+def double_scalar_mul_signed(
+    sd_signed: jnp.ndarray, kd_signed: jnp.ndarray, q: Point
+) -> Point:
+    """[s]B + [k]Q for a batch: sd/kd (N, 64) SIGNED window digits
+    (see signed_digits), q a batched point (N-leading axes). Straus with
+    shared doublings: 256 doublings + 128 one-hot table additions + 7
+    table-build additions ([1..8]Q).
     """
-    n = s_digits.shape[0]
+    n = sd_signed.shape[0]
 
     # Build per-row table of [1..8]Q (cached form) with a scan.
     def table_body(acc: Point, _):
@@ -275,9 +284,6 @@ def double_scalar_mul_base(
     q_table = jnp.swapaxes(rows, 0, 1).reshape(n, _TBL, 4 * F.LIMBS)
 
     base_table = np.asarray(_BASE_TABLE, dtype=np.int32).reshape(_TBL, 4 * F.LIMBS)
-
-    sd_signed = _signed_digits(s_digits)
-    kd_signed = _signed_digits(k_digits)
 
     def body(acc: Point, digits):
         sd, kd = digits

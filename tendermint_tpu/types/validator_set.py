@@ -365,15 +365,24 @@ class ValidatorSet:
         ok = np.zeros(len(idxs), dtype=bool)
         sub = np.nonzero(ed)[0]
         if sub.size:
-            sub_ok, _ = provider.verify_commit_batch(
-                pk[sub], mg[sub], sg[sub], powers[sub], counted[sub]
-            )
-            ok[sub] = np.asarray(sub_ok)
+            # verify_batch, not verify_commit_batch: the tally would be
+            # discarded (the host replay recomputes it), and this kernel
+            # is the one vote ingest already keeps warm.
+            ok[sub] = np.asarray(provider.verify_batch(pk[sub], mg[sub], sg[sub]))
+        self._serial_fill_non_ed(ok, commit, idxs, vals_idx, mg, ed)
+        return ok
+
+    def _serial_fill_non_ed(self, ok, commit, idxs, vals_idx, mg, ed, mg_off=0) -> None:
+        """Fill ok[] for the non-ed25519 rows via each key's own verify.
+        A key type whose verify() raises on malformed input counts as an
+        invalid signature for that row (never aborts the batch)."""
         for r in np.nonzero(~ed)[0]:
             v = self.validators[vals_idx[r]]
             sig = commit.signatures[idxs[r]].signature
-            ok[r] = bool(v.pub_key.verify(mg[r].tobytes(), sig))
-        return ok
+            try:
+                ok[mg_off + r] = bool(v.pub_key.verify(mg[mg_off + r].tobytes(), sig))
+            except Exception:
+                ok[mg_off + r] = False
 
     def _verify_commit_basic(self, commit, height: int, block_id) -> None:
         """Shared pre-checks (reference verifyCommitBasic,
@@ -648,13 +657,9 @@ def verify_commits_batched(
             ok[sub] = np.asarray(v.verify_batch(pk[sub], mg[sub], sg[sub]))
         off0 = 0
         for si, idxs, vals_idx, powers, counted, n, ed in segments:
-            s = specs[si]
-            for r in np.nonzero(~ed)[0]:
-                val = s.valset.validators[vals_idx[r]]
-                sig = s.commit.signatures[idxs[r]].signature
-                ok[off0 + r] = bool(
-                    val.pub_key.verify(mg[off0 + r].tobytes(), sig)
-                )
+            specs[si].valset._serial_fill_non_ed(
+                ok, specs[si].commit, idxs, vals_idx, mg, ed, mg_off=off0
+            )
             off0 += n
 
     off = 0
