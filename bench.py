@@ -164,12 +164,56 @@ def run_bench(platform: str):
     ok_bad, _ = model.verify_commit(pks, msgs, sigs_bad, powers, counted)
     assert not ok_bad[7] and ok_bad.sum() == n - 1
 
+    # -- pipelined device rate: launch K calls, sync once -----------------
+    # The tunneled dev backend adds ~100ms of per-call transfer/sync
+    # latency that a directly-attached chip does not have; amortizing K
+    # in-flight calls over one sync isolates true device throughput.
+    pipelined_ms = None
+    try:
+        import jax as _jax
+        import jax.numpy as jnp
+
+        from tendermint_tpu.ops import ed25519 as ops_ed
+
+        fn = model._get_fn("tally", 10240, MSG_LEN)
+        if fn is not None and n <= 10240:
+            pad = lambda a: model._pad(np.asarray(a), 10240)
+            dev = [
+                _jax.device_put(jnp.asarray(x))
+                for x in (
+                    pad(pks.astype(np.uint8)), pad(msgs.astype(np.uint8)),
+                    pad(sigs.astype(np.uint8)),
+                    pad(ops_ed.split_powers(powers)),
+                    pad(counted.astype(bool)),
+                )
+            ]
+            np.asarray(fn(*dev)[0])  # warm + real sync
+            K = 8
+            t0 = time.perf_counter()
+            outs = [fn(*dev) for _ in range(K)]
+            for o in outs:
+                np.asarray(o[0])
+            pipelined_ms = (time.perf_counter() - t0) / K
+            log(
+                f"pipelined device rate: {pipelined_ms*1e3:.1f} ms/commit "
+                f"({n/pipelined_ms:,.0f} sigs/s sustained)"
+            )
+    except Exception as ex:  # diagnostic only; never forfeit the main line
+        log(f"pipelined measurement failed: {ex!r}")
+
+    extra = {}
+    if pipelined_ms is not None:
+        extra = {
+            "device_pipelined_ms": round(pipelined_ms * 1e3, 2),
+            "sigs_per_sec_sustained": round(n / pipelined_ms),
+        }
     emit(
         round(p50 * 1e3, 3),
         round(baseline_10k / p50, 2),
         platform=platform,
         cold_compile_s=round(cold_s, 1),
         host_baseline_ms=round(baseline_10k * 1e3, 1),
+        **extra,
     )
     _deadline_done()  # AFTER emit: state-file absence must imply the line was printed
 

@@ -55,7 +55,10 @@ def verify_core(
 
 def verify_stage_prepare(pubkeys, msgs, sigs):
     """Stage 1: challenge hash, pubkey decompression, signed-digit
-    recode. Returns (s_digits, k_digits, -A coords x4, a_ok, s_ok)."""
+    recode. Returns (sd, kd, -A coords x4, a_ok, s_ok) where sd/kd are
+    SIGNED window digits in [-8, 8) (signed_digits applied) — exactly
+    what verify_stage_scan / double_scalar_mul_signed consume; raw
+    nibble digits would silently compute wrong points."""
     s_bytes = sigs[:, 32:].astype(jnp.int32)
 
     s_ok = sc.is_canonical(s_bytes)

@@ -359,15 +359,14 @@ class ValidatorSet:
         verify serially through their own PubKey.verify — the
         reference accepts any registered key type for validators
         (types/validator_set.go:641 calls the interface method)."""
+        # verify_batch, not verify_commit_batch: the tally would be
+        # discarded (the host replay recomputes it), and this kernel is
+        # the one vote ingest already keeps warm.
         if ed.all():
-            ok, _ = provider.verify_commit_batch(pk, mg, sg, powers, counted)
-            return np.asarray(ok)
+            return np.asarray(provider.verify_batch(pk, mg, sg))
         ok = np.zeros(len(idxs), dtype=bool)
         sub = np.nonzero(ed)[0]
         if sub.size:
-            # verify_batch, not verify_commit_batch: the tally would be
-            # discarded (the host replay recomputes it), and this kernel
-            # is the one vote ingest already keeps warm.
             ok[sub] = np.asarray(provider.verify_batch(pk[sub], mg[sub], sg[sub]))
         self._serial_fill_non_ed(ok, commit, idxs, vals_idx, mg, ed)
         return ok
