@@ -97,6 +97,22 @@ def make_batch(n, msg_len=MSG_LEN, seed=1234):
     return pks, msgs, sigs
 
 
+def stream_windows(fn, dev_args, n_calls: int) -> float:
+    """Launch n_calls invocations of the warm jitted `fn` on
+    device-resident args and sync once; returns elapsed seconds. Used by
+    the pipelined-rate section below and benchmarks/micro.py — isolates
+    device throughput from the dev tunnel's per-call sync latency."""
+    import numpy as np
+
+    out = fn(*dev_args)
+    np.asarray(out[0] if isinstance(out, tuple) else out)  # warm + real sync
+    t0 = time.perf_counter()
+    outs = [fn(*dev_args) for _ in range(n_calls)]
+    for o in outs:
+        np.asarray(o[0] if isinstance(o, tuple) else o)
+    return time.perf_counter() - t0
+
+
 def run_bench(platform: str):
     import numpy as np
     import jax
@@ -187,13 +203,8 @@ def run_bench(platform: str):
                     pad(counted.astype(bool)),
                 )
             ]
-            np.asarray(fn(*dev)[0])  # warm + real sync
             K = 8
-            t0 = time.perf_counter()
-            outs = [fn(*dev) for _ in range(K)]
-            for o in outs:
-                np.asarray(o[0])
-            pipelined_ms = (time.perf_counter() - t0) / K
+            pipelined_ms = stream_windows(fn, dev, K) / K
             log(
                 f"pipelined device rate: {pipelined_ms*1e3:.1f} ms/commit "
                 f"({n/pipelined_ms:,.0f} sigs/s sustained)"

@@ -351,9 +351,7 @@ class ValidatorSet:
             ed,
         )
 
-    def _verify_rows(
-        self, commit, idxs, vals_idx, pk, mg, sg, powers, counted, ed, provider
-    ) -> np.ndarray:
+    def _verify_rows(self, commit, idxs, vals_idx, pk, mg, sg, ed, provider) -> np.ndarray:
         """Per-row signature validity: ed25519 rows go to the batch
         provider in one call; rows with other key types (secp256k1, ...)
         verify serially through their own PubKey.verify — the
@@ -419,7 +417,7 @@ class ValidatorSet:
             chain_id, commit, by_address=False
         )
         v = provider or get_default_provider()
-        ok = self._verify_rows(commit, idxs, vals_idx, pk, mg, sg, powers, counted, ed, v)
+        ok = self._verify_rows(commit, idxs, vals_idx, pk, mg, sg, ed, v)
         self._replay_commit_full(commit, ok, idxs, powers, counted)
 
     def _check_commit_size(self, commit) -> None:
@@ -483,9 +481,7 @@ class ValidatorSet:
             chain_id, commit, by_address=True
         )
         v = provider or get_default_provider()
-        ok = self._verify_rows(
-            commit, idxs, vals_idx, pk, mg, sg, powers_arr, counted_arr, ed, v
-        )
+        ok = self._verify_rows(commit, idxs, vals_idx, pk, mg, sg, ed, v)
         self._replay_commit_trusting(ok, idxs, vals_idx, powers_arr, counted_arr, trust_level)
 
     def _replay_commit_trusting(

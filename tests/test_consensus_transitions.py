@@ -614,3 +614,127 @@ def test_commit_waits_for_full_two_thirds_precommits():
             await cs.stop()
 
     run(go())
+
+
+# -- create_empty_blocks=false ----------------------------------------------
+
+
+def test_create_empty_blocks_false_waits_for_txs():
+    """With create_empty_blocks=false the node commits the initial proof
+    block, then STALLS in NewRound until the mempool signals txs
+    available (reference enterPropose waitForTxs + handleTxsAvailable
+    :731)."""
+
+    async def go():
+        cfg = _make_test_config().consensus
+        cfg.create_empty_blocks = False
+        cfg.timeout_commit_ms = 10
+        genesis, privs = make_genesis(1)
+        node = await make_node(genesis, privs[0], config=cfg)
+        cs = node.cs
+        node.mempool.enable_txs_available()
+
+        async def notify():
+            while True:
+                await node.mempool.txs_available().wait()
+                node.mempool.txs_available().clear()
+                cs.handle_txs_available()
+
+        notifier = asyncio.create_task(notify())
+        await cs.start()
+        try:
+            # proof blocks commit until the app hash stabilizes (height 1
+            # always; height 2 because the kvstore app hash changes from
+            # the genesis value), then the node STALLS with no proposal
+            await wait_for(lambda: cs.rs.height >= 2, what="proof block commit")
+            stall_h = None
+            for _ in range(40):
+                h = cs.rs.height
+                await asyncio.sleep(0.1)
+                if cs.rs.height == h and cs.rs.proposal is None:
+                    stall_h = h
+                    break
+            assert stall_h is not None, "node never stalled waiting for txs"
+            await asyncio.sleep(0.3)
+            assert cs.rs.height == stall_h, "committed an empty non-proof block"
+            # a tx arrives -> proposal + commit
+            resp = await node.mempool.check_tx(b"k=v")
+            assert resp.code == 0
+            await wait_for(lambda: cs.rs.height > stall_h, what="tx block commit")
+            blk = node.block_store.load_block(stall_h)
+            assert blk is not None and len(blk.data.txs) == 1
+        finally:
+            notifier.cancel()
+            await cs.stop()
+
+    run(go())
+
+
+# -- stale proposals ---------------------------------------------------------
+
+
+def test_wrong_height_or_round_proposal_ignored():
+    """Proposals for another height or a past round are silently ignored
+    (reference defaultSetProposal :1599 early return)."""
+
+    async def go():
+        node, cs, privs = await setup()
+        try:
+            proposer = cs.rs.validators.get_proposer()
+            p_priv = next(p for p in privs if p.address() == proposer.address)
+            from tendermint_tpu.types.block import Commit
+            from tendermint_tpu.types.proposal import Proposal
+            from tendermint_tpu.types.tx import Txs
+
+            cs.rs.proposal = None
+            cs.rs.proposal_block = None
+            cs.rs.proposal_block_parts = None
+            block = cs.state.make_block(
+                cs.rs.height, Txs(),
+                Commit(height=0, round=0, block_id=BlockID(), signatures=[]),
+                [], proposer.address, time_ns=11,
+            )
+            parts = block.make_part_set()
+            for height, round_ in ((cs.rs.height + 5, cs.rs.round), (cs.rs.height, cs.rs.round + 3)):
+                prop = Proposal(
+                    height=height, round=round_, pol_round=-1,
+                    block_id=BlockID(block.hash(), parts.header()), timestamp_ns=1,
+                )
+                p_priv.sign_proposal(CHAIN_ID, prop)
+                await cs._default_set_proposal(prop)
+                assert cs.rs.proposal is None, (height, round_)
+        finally:
+            await cs.stop()
+
+    run(go())
+
+
+# -- LastCommit propagation --------------------------------------------------
+
+
+def test_last_commit_carried_into_next_height():
+    """After committing height H, the node's RoundState carries the H
+    precommits as LastCommit (gossiped to laggards and embedded in the
+    H+1 proposal; reference updateToState :523)."""
+
+    async def go():
+        node, cs, privs = await setup()
+        try:
+            h0 = cs.rs.height
+            bid = await arrange_round0_proposal(cs, privs)
+            await wait_for(lambda: cs.rs.step >= STEP_PREVOTE, what="prevote")
+            await inject_votes(cs, privs, PREVOTE_TYPE, bid)
+            await inject_votes(cs, privs, PRECOMMIT_TYPE, bid)
+            await wait_for(lambda: cs.rs.height == h0 + 1, what="next height")
+            lc = cs.rs.last_commit
+            assert lc is not None
+            assert lc.height == h0
+            maj_bid, ok = lc.two_thirds_majority()
+            assert ok and maj_bid.hash == bid.hash
+            # and the stored block commit round-trips
+            commit = node.block_store.load_seen_commit(h0)
+            assert commit is not None and commit.height == h0
+        finally:
+            await cs.stop()
+
+    run(go())
