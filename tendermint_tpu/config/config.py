@@ -51,6 +51,12 @@ class BaseConfig:
     filter_peers: bool = False
     # TPU crypto provider selection (the plugin seam BASELINE.json names)
     crypto_provider: str = "tpu"  # tpu | cpu
+    # Shard the verify batch over a device mesh when this many JAX
+    # devices are available (0/1 = single device). The sharded program
+    # is shard_map'd per stage with the quorum tally psum'd over ICI
+    # (models/verifier.py); on hosts with fewer devices the node falls
+    # back to single-device and logs it.
+    crypto_mesh_devices: int = 0
 
     def genesis_file(self) -> str:
         return _rootify(self.genesis_file_name, self.root_dir)
@@ -208,12 +214,22 @@ class MempoolConfig:
 
 @dataclass
 class FastSyncConfig:
-    """Reference FastSyncConfig config/config.go:708."""
+    """Reference FastSyncConfig config/config.go:708.
+
+    The reference ships three engine generations (blockchain/v0 pool,
+    v1 and v2 event-driven FSMs) selected here. This framework has ONE
+    engine implementing the union of their semantics — v0's per-height
+    requesters with timeout/redo and deliverer punishment
+    (blockchain/v0/pool.go:108,373) inside v2's pure-FSM scheduler +
+    processor structure (blockchain/v2/scheduler.go), plus cross-height
+    batched commit verification — so all three version strings are
+    accepted and select it (configs written for the reference migrate
+    unchanged)."""
 
     version: str = "v2"
 
     def validate_basic(self) -> Optional[str]:
-        if self.version not in ("v2",):
+        if self.version not in ("v0", "v1", "v2"):
             return f"unknown fastsync version {self.version!r}"
         return None
 

@@ -66,6 +66,47 @@ def _bucket(n: int, multiple: int) -> int:
     return pad_to_multiple(n, max(multiple, 16384))
 
 
+# In-flight background compile threads. They are daemon threads (a
+# stuck XLA compile must never block a node that is being killed), but
+# the interpreter tearing one down MID-COMPILE aborts the process from
+# XLA's C++ ("FATAL: exception not rethrown", exit 134) — so an atexit
+# hook joins them first. Escape hatch: TM_NO_COMPILE_JOIN=1 skips the
+# join (fast exit, possible abort message).
+_compile_threads: list = []
+_compile_threads_lock = threading.Lock()
+
+
+def _track_compile_thread(t: threading.Thread) -> None:
+    with _compile_threads_lock:
+        # prune only threads that RAN and finished: a tracked-but-not-
+        # yet-started thread also reports is_alive() == False and must
+        # not be dropped from the join list
+        _compile_threads[:] = [
+            x for x in _compile_threads if x.ident is None or x.is_alive()
+        ]
+        _compile_threads.append(t)
+
+
+# Bounded: the join exists to avoid the mid-compile abort, but a wedged
+# backend (dead TPU tunnel) must not hang shutdown forever.
+_JOIN_TIMEOUT_S = float(os.environ.get("TM_COMPILE_JOIN_TIMEOUT_S", "300"))
+
+
+def _join_compile_threads() -> None:  # pragma: no cover - exit path
+    if os.environ.get("TM_NO_COMPILE_JOIN") == "1":
+        return
+    deadline = time.monotonic() + _JOIN_TIMEOUT_S
+    with _compile_threads_lock:
+        pending = list(_compile_threads)
+    for t in pending:
+        t.join(timeout=max(0.0, deadline - time.monotonic()))
+
+
+import atexit  # noqa: E402
+
+atexit.register(_join_compile_threads)
+
+
 class _Entry:
     __slots__ = ("fn", "ready", "compiling", "compile_s")
 
@@ -251,7 +292,9 @@ class VerifierModel:
             finally:
                 e.compiling = False
 
-        threading.Thread(target=work, daemon=True, name=f"compile-{kind}-{n_pad}").start()
+        t = threading.Thread(target=work, daemon=True, name=f"compile-{kind}-{n_pad}")
+        _track_compile_thread(t)
+        t.start()
 
     def _get_fn(self, kind: str, n_pad: int, msg_len: int):
         """Returns the compiled callable, or None when non-blocking and
@@ -371,6 +414,7 @@ class VerifierModel:
 
         if background:
             t = threading.Thread(target=work, daemon=True, name="verifier-warmup")
+            _track_compile_thread(t)
             t.start()
             return t
         work()

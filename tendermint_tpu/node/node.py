@@ -104,11 +104,21 @@ class Node(Service):
         # background (models/verifier.py).
         from tendermint_tpu.crypto.batch import make_provider, set_default_provider
 
+        mesh = None
+        if (
+            config.base.crypto_provider == "tpu"
+            and config.base.crypto_mesh_devices > 1
+        ):
+            mesh = self._build_crypto_mesh(config.base.crypto_mesh_devices)
         self.crypto_provider = make_provider(
-            config.base.crypto_provider, block_on_compile=False
+            config.base.crypto_provider, mesh=mesh, block_on_compile=False
         )
         set_default_provider(self.crypto_provider)
-        self.logger.info("crypto provider", name=self.crypto_provider.name)
+        self.logger.info(
+            "crypto provider",
+            name=self.crypto_provider.name,
+            mesh_devices=0 if mesh is None else mesh.devices.size,
+        )
 
         # -- storage -------------------------------------------------------
         self.block_store = BlockStore(make_db("blockstore", config))
@@ -209,6 +219,28 @@ class Node(Service):
                 raw = "0.0.0.0" + raw
             addr = NetAddress.parse(raw)
             self.metrics_server = MetricsServer(self.metrics_registry, addr.host, addr.port)
+
+    def _build_crypto_mesh(self, want: int):
+        """Mesh over the first `want` local JAX devices, or None (logged)
+        when the host has fewer. The batch axis is the only sharded axis;
+        the quorum tally is psum'd over ICI (SURVEY §5.8)."""
+        try:
+            import jax
+
+            from tendermint_tpu.parallel import make_mesh
+
+            devs = jax.devices()
+            if len(devs) < want:
+                self.logger.error(
+                    "crypto_mesh_devices exceeds available devices; "
+                    "falling back to single-device",
+                    want=want, have=len(devs),
+                )
+                return None
+            return make_mesh(devs[:want])
+        except Exception as e:  # backend init failure: single-device path
+            self.logger.error("crypto mesh unavailable", err=repr(e))
+            return None
 
     def _block_exec_metrics_attach(self) -> None:
         self.block_exec._metrics = self.state_metrics
