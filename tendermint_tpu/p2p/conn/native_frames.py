@@ -1,0 +1,96 @@
+"""ctypes binding for the native SecretConnection frame codec
+(native/secretconn_frames.cpp): bulk ChaCha20-Poly1305 seal/open of
+1024-byte frames, one C call per message instead of one Python AEAD
+call per frame.
+
+The library is optional: `load()` returns None when it hasn't been
+built (`make -C native`), and SecretConnection falls back to the pure
+`cryptography` path. Byte-for-byte wire compatibility with that path is
+pinned by differential tests (tests/test_native_frames.py) plus the RFC
+8439 vectors.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import threading
+from typing import Optional, Tuple
+
+# Canonical frame-layout constants (secret_connection.py re-exports
+# them; this module has no imports from the package so there is exactly
+# one definition site).
+TOTAL_FRAME_SIZE = 1024
+DATA_LEN_SIZE = 4
+DATA_MAX_SIZE = TOTAL_FRAME_SIZE - DATA_LEN_SIZE  # 1020
+TAG_SIZE = 16
+SEALED_FRAME_SIZE = TOTAL_FRAME_SIZE + TAG_SIZE  # 1040
+
+_REPO = os.path.dirname(
+    os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+)
+_LIB_PATHS = [
+    os.environ.get("TM_SECRETCONN_LIB", ""),
+    os.path.join(_REPO, "native", "build", "libsecretconn.so"),
+]
+
+_lib = None
+_lib_tried = False
+_lock = threading.Lock()
+
+
+def load() -> Optional[ctypes.CDLL]:
+    """The shared library, or None when unavailable (cached)."""
+    global _lib, _lib_tried
+    with _lock:
+        if _lib_tried:
+            return _lib
+        _lib_tried = True
+        for path in _LIB_PATHS:
+            if not path or not os.path.exists(path):
+                continue
+            try:
+                lib = ctypes.CDLL(path)
+                lib.sc_seal_frames.restype = ctypes.c_long
+                lib.sc_seal_frames.argtypes = [
+                    ctypes.c_char_p, ctypes.c_char_p,
+                    ctypes.c_char_p, ctypes.c_long, ctypes.c_char_p,
+                ]
+                lib.sc_open_frames.restype = ctypes.c_long
+                lib.sc_open_frames.argtypes = [
+                    ctypes.c_char_p, ctypes.c_char_p,
+                    ctypes.c_char_p, ctypes.c_long, ctypes.c_char_p,
+                ]
+                _lib = lib
+                break
+            except OSError:
+                continue
+        return _lib
+
+
+def n_frames_for(data_len: int) -> int:
+    return max(1, (data_len + DATA_MAX_SIZE - 1) // DATA_MAX_SIZE)
+
+
+def seal_frames(lib, key: bytes, nonce: int, data: bytes) -> Tuple[bytes, int]:
+    """Seal `data` into frames; returns (sealed bytes, next nonce)."""
+    frames = n_frames_for(len(data))
+    out = ctypes.create_string_buffer(frames * SEALED_FRAME_SIZE)
+    nbuf = ctypes.create_string_buffer(nonce.to_bytes(12, "little"), 12)
+    wrote = lib.sc_seal_frames(key, nbuf, data, len(data), out)
+    assert wrote == frames, (wrote, frames)
+    return out.raw, int.from_bytes(nbuf.raw[:12], "little")
+
+
+def open_frames(lib, key: bytes, nonce: int, sealed: bytes) -> Tuple[Optional[bytes], int]:
+    """Open concatenated sealed frames; returns (data, next nonce) or
+    (None, nonce) on authentication failure."""
+    frames, rem = divmod(len(sealed), SEALED_FRAME_SIZE)
+    if rem:
+        raise ValueError(f"sealed length {len(sealed)} not a frame multiple")
+    out = ctypes.create_string_buffer(frames * DATA_MAX_SIZE)
+    nbuf = ctypes.create_string_buffer(nonce.to_bytes(12, "little"), 12)
+    got = lib.sc_open_frames(key, nbuf, sealed, frames, out)
+    if got < 0:
+        return None, nonce
+    return out.raw[:got], int.from_bytes(nbuf.raw[:12], "little")

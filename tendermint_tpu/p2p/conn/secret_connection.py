@@ -31,12 +31,14 @@ from cryptography.hazmat.primitives import hashes, serialization
 
 from tendermint_tpu.codec.binary import Reader, Writer
 from tendermint_tpu.crypto.keys import Ed25519PubKey, PrivKey, PubKey
-
-TOTAL_FRAME_SIZE = 1024
-DATA_LEN_SIZE = 4
-DATA_MAX_SIZE = TOTAL_FRAME_SIZE - DATA_LEN_SIZE  # 1020
-TAG_SIZE = 16
-SEALED_FRAME_SIZE = TOTAL_FRAME_SIZE + TAG_SIZE
+from tendermint_tpu.p2p.conn import native_frames
+from tendermint_tpu.p2p.conn.native_frames import (  # canonical definitions
+    DATA_LEN_SIZE,
+    DATA_MAX_SIZE,
+    SEALED_FRAME_SIZE,
+    TAG_SIZE,
+    TOTAL_FRAME_SIZE,
+)
 
 _TRANSCRIPT_LABEL = b"TENDERMINT_TPU_SECRET_CONNECTION_TRANSCRIPT_HASH"
 _HKDF_INFO = b"TENDERMINT_TPU_SECRET_CONNECTION_KEY_AND_CHALLENGE_GEN"
@@ -103,6 +105,11 @@ class SecretConnection:
         self._writer = writer
         self._send_aead: Optional[ChaCha20Poly1305] = None
         self._recv_aead: Optional[ChaCha20Poly1305] = None
+        self._send_key = b""
+        self._recv_key = b""
+        # bulk native codec (native/secretconn_frames.cpp); None -> the
+        # pure `cryptography` per-frame path below
+        self._native = native_frames.load()
         self._send_nonce = _Nonce()
         self._recv_nonce = _Nonce()
         self._recv_buf = b""
@@ -138,6 +145,7 @@ class SecretConnection:
         )
         sc._send_aead = ChaCha20Poly1305(send_key)
         sc._recv_aead = ChaCha20Poly1305(recv_key)
+        sc._send_key, sc._recv_key = send_key, recv_key
         challenge = transcript_challenge(loc_eph, rem_eph)
 
         # 3. authenticate over the encrypted channel
@@ -157,14 +165,26 @@ class SecretConnection:
     # -- framed I/O --------------------------------------------------------
 
     async def write(self, data: bytes) -> int:
-        """Encrypt `data` into sealed frames (reference Write :219)."""
+        """Encrypt `data` into sealed frames (reference Write :219).
+
+        With the native codec the whole message seals in ONE C call;
+        otherwise one `cryptography` AEAD call per 1KB frame."""
         total = len(data)
-        while data:
-            chunk, data = data[:DATA_MAX_SIZE], data[DATA_MAX_SIZE:]
-            frame = struct.pack(">I", len(chunk)) + chunk
-            frame += b"\x00" * (TOTAL_FRAME_SIZE - len(frame))
-            sealed = self._send_aead.encrypt(self._send_nonce.use(), frame, None)
+        if not data:
+            return 0
+        if self._native is not None:
+            sealed, nxt = native_frames.seal_frames(
+                self._native, self._send_key, self._send_nonce.n, data
+            )
+            self._send_nonce.n = nxt
             self._writer.write(sealed)
+        else:
+            while data:
+                chunk, data = data[:DATA_MAX_SIZE], data[DATA_MAX_SIZE:]
+                frame = struct.pack(">I", len(chunk)) + chunk
+                frame += b"\x00" * (TOTAL_FRAME_SIZE - len(frame))
+                sealed = self._send_aead.encrypt(self._send_nonce.use(), frame, None)
+                self._writer.write(sealed)
         await self._writer.drain()
         return total
 
@@ -184,6 +204,34 @@ class SecretConnection:
         parts = []
         got = 0
         while got < n:
+            need = n - got
+            if (
+                self._native is not None
+                and not self._recv_buf
+                and need > DATA_MAX_SIZE
+            ):
+                # `need` outstanding bytes occupy AT LEAST
+                # ceil(need/1020) frames (each carries <= 1020), so that
+                # many sealed frames are guaranteed to arrive — read and
+                # open them in ONE C call; surplus trailing bytes (from
+                # frames shared with the next message) stay buffered.
+                k = native_frames.n_frames_for(need)
+                sealed = await self._reader.readexactly(k * SEALED_FRAME_SIZE)
+                data, nxt = native_frames.open_frames(
+                    self._native, self._recv_key, self._recv_nonce.n, sealed
+                )
+                if data is None:
+                    raise AuthFailure("frame authentication failed")
+                self._recv_nonce.n = nxt
+                if not data:
+                    # all-zero-length frames: same no-progress error the
+                    # pure path raises (a conforming peer never sends them)
+                    raise asyncio.IncompleteReadError(b"".join(parts), n)
+                take = data[:need]
+                self._recv_buf = data[need:]
+                parts.append(take)
+                got += len(take)
+                continue
             p = await self.read(n - got)
             if not p:
                 raise asyncio.IncompleteReadError(b"".join(parts), n)

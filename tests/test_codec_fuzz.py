@@ -1,0 +1,147 @@
+"""Decode-robustness property tests (SURVEY §5.2: the reference fuzzes
+WAL decode with go-fuzz, consensus/wal_fuzz.go; p2p frames via
+FuzzedConnection). Here every wire decoder is fed adversarial bytes:
+
+1. random garbage,
+2. truncations of VALID encodings (every prefix length),
+3. single-bit flips of valid encodings,
+4. oversized length prefixes.
+
+The property: decoders either return a value or raise a CONTROLLED
+error (DecodeError/ValueError family) — never IndexError / KeyError /
+MemoryError / OverflowError, and never an allocation driven by an
+unvalidated length prefix.
+"""
+
+import random
+
+import pytest
+
+from tendermint_tpu.codec.binary import DecodeError, Writer
+from tendermint_tpu.codec.signbytes import PRECOMMIT_TYPE
+from tendermint_tpu.consensus import messages as cmsg
+from tendermint_tpu.crypto.keys import Ed25519PrivKey
+from tendermint_tpu.types.block import (
+    BLOCK_ID_FLAG_COMMIT,
+    Block,
+    BlockID,
+    Commit,
+    CommitSig,
+    Header,
+    PartSetHeader,
+)
+from tendermint_tpu.types.vote import Vote
+
+# Controlled-failure set: what a decoder may legitimately raise on
+# malformed input. Anything else (IndexError, KeyError, struct.error,
+# MemoryError...) is a robustness bug.
+ALLOWED = (DecodeError, ValueError)
+
+
+def _valid_vote_bytes() -> bytes:
+    priv = Ed25519PrivKey.from_secret(b"fuzz-vote")
+    v = Vote(
+        vote_type=PRECOMMIT_TYPE, height=7, round=2,
+        block_id=BlockID(b"\x01" * 32, PartSetHeader(3, b"\x02" * 32)),
+        timestamp_ns=123456789,
+        validator_address=priv.pub_key().address(), validator_index=4,
+    )
+    v.signature = b"\x05" * 64
+    return v.encode()
+
+
+def _valid_commit_bytes() -> bytes:
+    sig = CommitSig(
+        block_id_flag=BLOCK_ID_FLAG_COMMIT,
+        validator_address=b"\x0a" * 20,
+        timestamp_ns=55,
+        signature=b"\x0b" * 64,
+    )
+    c = Commit(
+        height=9, round=1,
+        block_id=BlockID(b"\x03" * 32, PartSetHeader(1, b"\x04" * 32)),
+        signatures=[sig] * 4,
+    )
+    return c.encode()
+
+
+def _valid_block_bytes() -> bytes:
+    from tendermint_tpu.types.block import Data, EvidenceData
+    from tendermint_tpu.types.tx import Tx, Txs
+
+    h = Header(
+        chain_id="fuzz-chain", height=2, time_ns=1,
+        last_block_id=BlockID(b"\x06" * 32, PartSetHeader(1, b"\x07" * 32)),
+        validators_hash=b"\x08" * 32, next_validators_hash=b"\x08" * 32,
+        consensus_hash=b"\x09" * 32, app_hash=b"",
+        last_results_hash=b"", proposer_address=b"\x0c" * 20,
+    )
+    blk = Block(
+        header=h, data=Data(Txs([Tx(b"hello")])), evidence=EvidenceData([]),
+        last_commit=Commit(
+            height=1, round=0,
+            block_id=BlockID(b"\x03" * 32, PartSetHeader(1, b"\x04" * 32)),
+            signatures=[CommitSig(
+                block_id_flag=BLOCK_ID_FLAG_COMMIT,
+                validator_address=b"\x0a" * 20, timestamp_ns=1,
+                signature=b"\x0b" * 64,
+            )],
+        ),
+    )
+    return blk.encode()
+
+
+DECODERS = [
+    ("vote", Vote.decode, _valid_vote_bytes),
+    ("commit", Commit.decode, _valid_commit_bytes),
+    ("block", Block.decode, _valid_block_bytes),
+    ("consensus_msg", cmsg.decode_msg, None),
+]
+
+
+def _probe(decode, data: bytes) -> None:
+    try:
+        decode(data)
+    except ALLOWED:
+        pass
+    # any OTHER exception propagates and fails the test
+
+
+@pytest.mark.parametrize("name,decode,mk_valid", DECODERS)
+def test_decoder_survives_random_garbage(name, decode, mk_valid):
+    rng = random.Random(1234)
+    for _ in range(300):
+        n = rng.randrange(0, 400)
+        _probe(decode, rng.randbytes(n))
+
+
+@pytest.mark.parametrize(
+    "name,decode,mk_valid", [d for d in DECODERS if d[2] is not None]
+)
+def test_decoder_survives_truncation(name, decode, mk_valid):
+    data = mk_valid()
+    decode(data)  # the valid encoding itself must decode
+    for cut in range(len(data)):
+        _probe(decode, data[:cut])
+
+
+@pytest.mark.parametrize(
+    "name,decode,mk_valid", [d for d in DECODERS if d[2] is not None]
+)
+def test_decoder_survives_bitflips(name, decode, mk_valid):
+    rng = random.Random(99)
+    data = bytearray(mk_valid())
+    positions = rng.sample(range(len(data) * 8), min(400, len(data) * 8))
+    for bitpos in positions:
+        flipped = bytearray(data)
+        flipped[bitpos // 8] ^= 1 << (bitpos % 8)
+        _probe(decode, bytes(flipped))
+
+
+def test_length_prefix_cannot_drive_allocation():
+    """A huge claimed length must fail fast (EOF), not allocate."""
+    w = Writer()
+    w.write_uvarint(1 << 40)  # claims a 1TB byte string follows
+    data = w.bytes() + b"\x00" * 16
+    for _, decode, _mk in DECODERS:
+        _probe(decode, data)
