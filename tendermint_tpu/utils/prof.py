@@ -43,6 +43,50 @@ def dump_asyncio_tasks() -> str:
     return out.getvalue()
 
 
+_jax_trace_dir: Optional[str] = None
+
+
+def jax_trace(action: str, trace_dir: str = "") -> str:
+    """Start/stop a JAX profiler trace (xprof/tensorboard format) —
+    the device-side analog of the reference's pprof CPU profiles
+    (SURVEY §5.1: 'JAX profiler + xprof traces around kernel
+    dispatch'). Lazy import: a node without device work never touches
+    jax here."""
+    global _jax_trace_dir
+    try:
+        import jax
+    except Exception as e:  # pragma: no cover - jax is baked in
+        return f"jax unavailable: {e!r}\n"
+    if action == "start":
+        if _jax_trace_dir is not None:
+            return f"trace already running -> {_jax_trace_dir}\n"
+        if not trace_dir:
+            import tempfile
+
+            # never a fixed path in world-writable /tmp (symlink games,
+            # cross-process clobbering)
+            trace_dir = tempfile.mkdtemp(prefix="tm_jax_trace_")
+        try:
+            jax.profiler.start_trace(trace_dir)
+        except Exception as e:
+            return f"start_trace failed: {e!r}\n"
+        _jax_trace_dir = trace_dir
+        return f"tracing -> {trace_dir}\n"
+    if action == "stop":
+        if _jax_trace_dir is None:
+            return "no trace running\n"
+        out, _jax_trace_dir = _jax_trace_dir, None
+        try:
+            # clear the marker FIRST: if stop raises (e.g. someone used
+            # jax.profiler directly), start stays retryable instead of
+            # the endpoint wedging until restart
+            jax.profiler.stop_trace()
+        except Exception as e:
+            return f"stop_trace failed: {e!r}\n"
+        return f"trace written -> {out}\n"
+    return "actions: start stop\n"
+
+
 def dump_gc_stats() -> str:
     counts = {}
     for obj in gc.get_objects():
@@ -79,8 +123,22 @@ class ProfServer:
                 body = dump_asyncio_tasks()
             elif path.startswith("/gc"):
                 body = dump_gc_stats()
+            elif path.startswith("/jax_trace"):
+                # /jax_trace?action=start&dir=/tmp/trace | ?action=stop
+                from urllib.parse import parse_qs, urlsplit
+
+                q = parse_qs(urlsplit(path).query)
+                # in an executor: stop_trace serializes the whole trace
+                # to disk and must not freeze the event loop that also
+                # runs consensus on the node being profiled
+                body = await asyncio.get_running_loop().run_in_executor(
+                    None,
+                    jax_trace,
+                    q.get("action", [""])[0],
+                    q.get("dir", [""])[0],
+                )
             else:
-                body = "routes: /stacks /tasks /gc\n"
+                body = "routes: /stacks /tasks /gc /jax_trace\n"
             data = body.encode()
             writer.write(
                 b"HTTP/1.1 200 OK\r\nContent-Type: text/plain\r\n"

@@ -39,3 +39,47 @@ def test_prof_server_routes(tmp_path):
             await node.stop()
 
     asyncio.run(go())
+
+
+def test_jax_trace_route():
+    """/jax_trace start/stop writes an xprof trace directory (the
+    device-side pprof analog, SURVEY §5.1)."""
+    import shutil
+    import tempfile
+    import urllib.request
+
+    from tendermint_tpu.utils.prof import ProfServer
+
+    async def go():
+        srv = ProfServer()
+        await srv.start()
+        try:
+            d = tempfile.mkdtemp(prefix="jaxtrace")
+            base = f"http://127.0.0.1:{srv.bound_port}/jax_trace"
+
+            async def fetch(url):
+                loop = asyncio.get_running_loop()
+                return await loop.run_in_executor(
+                    None, lambda: urllib.request.urlopen(url, timeout=10).read().decode()
+                )
+
+            try:
+                out = await fetch(f"{base}?action=start&dir={d}")
+                assert "tracing" in out, out
+                # some device work while tracing
+                import jax.numpy as jnp
+
+                (jnp.ones((8, 8)) @ jnp.ones((8, 8))).block_until_ready()
+            finally:
+                # always stop: a leaked process-wide trace breaks every
+                # later start_trace in this pytest process
+                out = await fetch(f"{base}?action=stop")
+            assert "trace written" in out, out
+            assert os.path.isdir(d) and os.listdir(d), "no trace output"
+            out = await fetch(f"{base}?action=stop")
+            assert "no trace running" in out
+            shutil.rmtree(d, ignore_errors=True)
+        finally:
+            await srv.stop()
+
+    asyncio.run(go())
