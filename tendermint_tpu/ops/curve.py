@@ -123,7 +123,13 @@ def select(cond: jnp.ndarray, p: Point, q: Point) -> Point:
 
 def encode(p: Point) -> jnp.ndarray:
     """Compressed encoding: (..., 32) int32 bytes -- y with sign(x) in
-    bit 255. One field inversion per row."""
+    bit 255. One field inversion per row.
+
+    Negative result (round 2): Montgomery-batching the inversions via
+    F.invert_batched cuts device work ~12ms @10k rows but blows the
+    finish-stage XLA compile from ~6s to >530s (associative_scan's
+    odd/even slicing tree lowers terribly at (N, 20) int32), so the
+    per-row chain stays."""
     zi = F.invert(p.z)
     x = F.mul(p.x, zi)
     y = F.mul(p.y, zi)

@@ -241,6 +241,35 @@ def invert(z: jnp.ndarray) -> jnp.ndarray:
     return mul(t1, t0)  # 2^255-21 = p-2
 
 
+def invert_batched(z: jnp.ndarray) -> jnp.ndarray:
+    """Montgomery batch inversion over the LEADING axis: (N, 20) -> (N, 20).
+
+    Work drops from ~254 muls/row (the addition chain) to ~6 muls/row:
+    two log-depth prefix/suffix product sweeps (lax.associative_scan)
+    plus ONE width-1 addition-chain inversion of the total product —
+    1/z_i = prefix_{i-1} * suffix_{i+1} * (prod z)^-1.
+
+    NOT used by the jitted verify pipeline: at (10k, 20) int32 the
+    associative_scan lowering blows the stage compile from ~6s to
+    >530s (measured round 2) — the runtime win is ~12ms, so the hot
+    path keeps the per-row chain. Available for host-side/eager uses.
+
+    Rows with z == 0 return 0 (ref10 invert(0) == 0): zeros are replaced
+    by 1 for the sweeps so one bad row (e.g. a non-point from a failed
+    decompression) cannot zero the whole batch's product."""
+    zero = is_zero(z)
+    one = jnp.zeros_like(z).at[..., 0].set(1)
+    z_safe = jnp.where(zero[..., None], one, z)
+    prefix = jax.lax.associative_scan(mul, z_safe, axis=0)
+    suffix = jax.lax.associative_scan(mul, z_safe, axis=0, reverse=True)
+    total_inv = invert(prefix[-1:])  # width-1 chain
+    # prod_{j != i} z_j = prefix[i-1] * suffix[i+1] (identity at the ends)
+    pre = jnp.concatenate([one[:1], prefix[:-1]], axis=0)
+    suf = jnp.concatenate([suffix[1:], one[:1]], axis=0)
+    inv = mul(mul(pre, suf), total_inv)
+    return jnp.where(zero[..., None], jnp.zeros_like(z), inv)
+
+
 # -- canonical form / encoding ---------------------------------------------
 
 

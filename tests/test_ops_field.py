@@ -7,6 +7,7 @@ arbitrary-precision Python arithmetic.
 import random
 
 import numpy as np
+import jax
 import jax.numpy as jnp
 import pytest
 
@@ -105,3 +106,27 @@ def test_sha512_matches_hashlib():
         out = np.asarray(sha512(jnp.asarray(msgs))).astype(np.uint8)
         for i in range(4):
             assert bytes(out[i]) == hashlib.sha512(bytes(msgs[i])).digest()
+
+
+def test_invert_batched_matches_chain():
+    """Montgomery batch inversion == per-row addition chain, including
+    zero rows (ref10 invert(0) == 0) which must not poison the batch."""
+    rng = np.random.RandomState(7)
+    vals = [int.from_bytes(rng.bytes(32), "little") % F.P for _ in range(33)]
+    vals[5] = 0
+    vals[32] = 0
+    z = np.stack([F.to_limbs(v) for v in vals])
+    got = np.asarray(jax.jit(F.invert_batched)(jnp.asarray(z)))
+    want = np.asarray(jax.jit(F.invert)(jnp.asarray(z)))
+    for i in range(len(vals)):
+        assert F.from_limbs(got[i]) == F.from_limbs(want[i]), i
+    # and they really are inverses
+    for i, v in enumerate(vals):
+        if v:
+            assert (F.from_limbs(got[i]) * v) % F.P == 1, i
+
+
+def test_invert_batched_single_row():
+    z = np.stack([F.to_limbs(12345)])
+    got = np.asarray(jax.jit(F.invert_batched)(jnp.asarray(z)))
+    assert (F.from_limbs(got[0]) * 12345) % F.P == 1
