@@ -110,3 +110,106 @@ def test_reactor_peer_catchup_via_gossip():
             await stop_switches(switches)
 
     run(go())
+
+
+class _StubPeer:
+    """Minimal Peer for direct reactor.receive tests: kv store + a
+    recording try_send."""
+
+    def __init__(self, peer_id="stub-peer-id", sent=None):
+        self.id = peer_id
+        self._kv = {}
+        self.sent = sent if sent is not None else []
+
+    def set(self, k, v):
+        self._kv[k] = v
+
+    def get(self, k):
+        return self._kv.get(k)
+
+    def try_send(self, ch, data):
+        self.sent.append((ch, data))
+        return True
+
+
+def test_vote_set_maj23_query_gets_bits_response():
+    """A peer claiming +2/3 for a BlockID gets our vote bits back on the
+    bits channel, and the claim is recorded against that peer
+    (reference Receive StateChannel VoteSetMaj23 :232-260)."""
+
+    async def go():
+        from tendermint_tpu.codec.signbytes import PREVOTE_TYPE
+        from tendermint_tpu.consensus import messages as m
+        from tendermint_tpu.consensus.reactor import (
+            PEER_STATE_KEY,
+            STATE_CHANNEL,
+            VOTE_SET_BITS_CHANNEL,
+            PeerState,
+        )
+        from tendermint_tpu.types.block import BlockID, PartSetHeader
+
+        genesis, privs = make_genesis(4)
+        node = await make_node(genesis, privs[0])
+        reactor = ConsensusReactor(node.cs)
+        await node.cs.start()
+        peer = _StubPeer()
+        try:
+            for _ in range(500):
+                if node.cs.rs.votes is not None:
+                    break
+                await asyncio.sleep(0.01)
+            assert node.cs.rs.votes is not None, "cs never initialized votes"
+            peer.set(PEER_STATE_KEY, PeerState(peer.id))
+            bid = BlockID(b"\x77" * 32, PartSetHeader(1, b"\x78" * 32))
+            msg = m.VoteSetMaj23Message(
+                height=node.cs.rs.height, round=node.cs.rs.round,
+                vote_type=PREVOTE_TYPE, block_id=bid,
+            )
+            await reactor.receive(STATE_CHANNEL, peer, m.encode_msg(msg))
+            bits = [
+                m.decode_msg(d) for ch, d in peer.sent if ch == VOTE_SET_BITS_CHANNEL
+            ]
+            assert bits, "no VoteSetBits response"
+            reply = bits[0]
+            assert isinstance(reply, m.VoteSetBitsMessage)
+            assert reply.height == node.cs.rs.height
+            assert reply.block_id.hash == bid.hash
+            # the maj23 claim itself was recorded against THIS peer
+            vs = node.cs.rs.votes.prevotes(node.cs.rs.round)
+            assert vs.peer_maj23s.get(peer.id) == bid
+        finally:
+            await node.cs.stop()
+
+    run(go())
+
+
+def test_reactor_garbage_message_punishes_peer_e2e():
+    """Undecodable bytes on a consensus channel make the RECEIVING
+    switch drop the sender (Switch._on_peer_receive catch ->
+    stop_peer_for_error) while its own consensus stays alive."""
+
+    async def go():
+        from tendermint_tpu.consensus.reactor import STATE_CHANNEL
+
+        nodes, reactors, switches = await build_net(2)
+        try:
+            # wait for the mesh
+            for _ in range(500):
+                if switches[0].peers and switches[1].peers:
+                    break
+                await asyncio.sleep(0.01)
+            assert switches[0].peers and switches[1].peers
+            # node 0 sends garbage to node 1 on the state channel
+            peer_of_1 = next(iter(switches[0].peers.values()))
+            assert peer_of_1.try_send(STATE_CHANNEL, b"\xde\xad\xbe\xef" * 5)
+            # node 1 must drop the peer (decode error -> punish)
+            for _ in range(500):
+                if not switches[1].peers:
+                    break
+                await asyncio.sleep(0.01)
+            assert not switches[1].peers, "garbage sender was not dropped"
+            assert nodes[1].cs.is_running
+        finally:
+            await stop_switches(switches)
+
+    run(go())

@@ -60,7 +60,7 @@ def test_jax_trace_route():
             async def fetch(url):
                 loop = asyncio.get_running_loop()
                 return await loop.run_in_executor(
-                    None, lambda: urllib.request.urlopen(url, timeout=10).read().decode()
+                    None, lambda: urllib.request.urlopen(url, timeout=60).read().decode()
                 )
 
             try:
