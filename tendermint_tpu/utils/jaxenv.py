@@ -83,3 +83,34 @@ def probe_accelerator(timeout_s: float = 120) -> Tuple[int, str]:
         return int(count), platform
     except ValueError:
         return 0, ""
+
+
+def ensure_local_platform(
+    timeout_s: float = 60, min_devices: Optional[int] = None
+) -> Tuple[int, str]:
+    """Probe the accelerator (subprocess, timeout) and fall back to the
+    (virtual, if min_devices is set) CPU platform when it is absent,
+    insufficient, or wedged. THE decision helper for every entry point
+    (driver entry(), dryrun, bench) so fallback guards cannot drift.
+
+    Returns the probe's (count, platform). Raises RuntimeError when the
+    fallback is needed but can no longer take effect (a backend already
+    initialized in this process) — proceeding would hang on the dead
+    backend with no diagnostic."""
+    count, platform = probe_accelerator(timeout_s=timeout_s)
+    usable = count > 0 and platform != "cpu"
+    if usable and (min_devices is None or count >= min_devices):
+        return count, platform
+    if not force_cpu_platform(min_devices):
+        raise RuntimeError(
+            "accelerator unavailable and the CPU fallback cannot apply: "
+            "a backend already initialized in this process; set "
+            "JAX_PLATFORMS=cpu"
+            + (
+                f" XLA_FLAGS=--xla_force_host_platform_device_count={min_devices}"
+                if min_devices
+                else ""
+            )
+            + " before python starts"
+        )
+    return count, platform
