@@ -296,3 +296,60 @@ def test_node_key_roundtrip(tmp_path):
     nk.save_as(p)
     nk2 = NodeKey.load(p)
     assert nk2.id == nk.id == node_id_from_pubkey(nk.pub_key())
+
+def test_mconnection_malformed_packets_error_not_hang():
+    """Hostile bytes on the wire (unknown packet type, unknown channel,
+    oversized payload claim, capacity overflow) surface as on_error —
+    never a hang, crash, or silent acceptance (reference recvRoutine
+    :553 error paths)."""
+
+    async def go():
+        import struct
+
+        from tendermint_tpu.p2p.conn.connection import _PKT_MSG
+
+        def msg_pkt(ch, eof, payload, claim_len=None):
+            length = len(payload) if claim_len is None else claim_len
+            return struct.pack(">BBBH", _PKT_MSG, ch, eof, length) + payload
+
+        small_cap = ChannelDescriptor(
+            id=0x20, priority=5, recv_message_capacity=2048
+        )
+        cases = [
+            ("unknown packet type", [small_cap], struct.pack(">B", 0x7F)),
+            ("unknown channel", [small_cap], msg_pkt(0x99, 1, b"abc")),
+            ("oversized payload claim", [small_cap], msg_pkt(0x20, 0, b"", claim_len=60000)),
+            (
+                "capacity overflow",
+                [small_cap],
+                # 3KB of non-eof fragments > the 2KB capacity
+                b"".join(msg_pkt(0x20, 0, b"\x00" * 1024) for _ in range(3)),
+            ),
+        ]
+        for name, descs, hostile in cases:
+            (cr, cw), (sr, sw), server = await tcp_pair()
+            errs = []
+            got = asyncio.Queue()
+
+            async def on_recv(ch, msg):
+                await got.put((ch, msg))
+
+            async def on_err(e, _errs=errs):
+                _errs.append(e)
+
+            m2 = MConnection(StreamAdapter(sr, sw), descs, on_recv, on_err)
+            m2.start()
+            cw.write(hostile)
+            await cw.drain()
+            for _ in range(200):
+                if errs:
+                    break
+                await asyncio.sleep(0.01)
+            assert errs, f"{name}: no error surfaced"
+            assert got.empty(), f"{name}: hostile bytes delivered a message"
+            await m2.stop()
+            cw.close()
+            server.close()
+            await server.wait_closed()
+
+    run(go())
