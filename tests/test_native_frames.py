@@ -34,6 +34,12 @@ def lib():
         ["make", "-C", os.path.join(REPO, "native"), "build/libsecretconn.so"],
         check=True, capture_output=True,
     )
+    # load() caches a None result; on a fresh checkout an earlier test
+    # may have probed before the .so existed — reset so the fresh build
+    # is picked up
+    with native_frames._lock:
+        native_frames._lib_tried = False
+        native_frames._lib = None
     lib = native_frames.load()
     assert lib is not None
     return lib
