@@ -144,3 +144,73 @@ def test_three_process_localnet(tmp_path):
                 p.wait(timeout=10)
             except subprocess.TimeoutExpired:
                 p.kill()
+
+
+def test_kill_all_and_restart(tmp_path):
+    """Reference test/p2p/kill_all: SIGKILL EVERY node mid-chain
+    (unclean crash), restart them all from their WALs/stores, and the
+    network must resume committing past the pre-kill height."""
+    out = str(tmp_path / "net")
+    base_port = free_port_range(8)
+    subprocess.run(
+        [sys.executable, "-m", "tendermint_tpu", "testnet", "--v", "4",
+         "--o", out, "--chain-id", "killall-chain", "--starting-port", str(base_port)],
+        check=True, capture_output=True, cwd=REPO,
+    )
+    rpc_ports = [base_port + 2 * i + 1 for i in range(4)]
+
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("FAIL_TEST_INDEX", None)
+    procs = []
+
+    def start(i):
+        home = os.path.join(out, f"node{i}")
+        p = subprocess.Popen(
+            [sys.executable, "-m", "tendermint_tpu", "--home", home, "node"],
+            env=env, cwd=REPO,
+            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+        )
+        procs.append(p)
+        return p
+
+    try:
+        for i in range(4):
+            start(i)
+        wait_for(
+            lambda: all(
+                rpc(p, "status")["sync_info"]["latest_block_height"] >= 3
+                for p in rpc_ports
+            ),
+            90, "nodes never reached height 3",
+        )
+        pre_kill = max(
+            rpc(p, "status")["sync_info"]["latest_block_height"] for p in rpc_ports
+        )
+
+        # unclean crash of the WHOLE network
+        for p in procs:
+            p.kill()
+        for p in procs:
+            p.wait(timeout=15)
+        procs.clear()
+
+        for i in range(4):
+            start(i)
+        wait_for(
+            lambda: all(
+                rpc(p, "status", timeout=5)["sync_info"]["latest_block_height"]
+                >= pre_kill + 2
+                for p in rpc_ports
+            ),
+            120, "network never resumed past the pre-kill height",
+        )
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.send_signal(signal.SIGTERM)
+        for p in procs:
+            try:
+                p.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                p.kill()
