@@ -1,10 +1,19 @@
 """Fast sync: block catchup from peers.
 
-Reference: blockchain/v2/ (ADR-043 "riri-org" design) — the pure-function
-scheduler + processor state machines demuxed by the reactor
-(blockchain/v2/scheduler.go, processor.go, reactor.go:301). One engine
-here (the reference ships v0/v1/v2; v2 is the architecture to keep:
-deterministic, unit-testable without any network).
+Two engines, matching the reference's generations and sharing one wire
+protocol (channel 0x40, blockchain/messages.py):
+
+- v0 (`pool.py` + `reactor_v0.py`): the requester/pool model
+  (blockchain/v0/pool.go) — per-height requesters with peer
+  assignment, timeout redo, deliverer punishment, per-pair verify.
+- v2 (`scheduler.py` + `reactor.py`, default; also serves "v1"): the
+  pure-FSM scheduler + processor (ADR-043 "riri-org",
+  blockchain/v2/scheduler.go, processor.go) with cross-height BATCHED
+  commit verification — the TPU-first redesign.
+
+Selected via config `fast_sync.version`.
 """
 
+from tendermint_tpu.blockchain.pool import BlockPool
 from tendermint_tpu.blockchain.reactor import BlockchainReactor
+from tendermint_tpu.blockchain.reactor_v0 import BlockchainReactorV0

@@ -1,0 +1,210 @@
+"""v0-style fast-sync BlockPool: per-height requesters with peer
+assignment, timeout redo, and ordered two-block delivery.
+
+Reference: blockchain/v0/pool.go — BlockPool :108 (requesters map,
+PeekTwoBlocks/PopRequest/RedoRequest), makeNextRequester :373, per-peer
+pending caps, peer timeout/ban. The reference runs one goroutine per
+requester; here the pool is a PURE state machine driven by the
+reactor's ticker (make_next_requesters / expire take an explicit
+`now`), which keeps it unit-testable exactly like the v2 scheduler
+(blockchain/scheduler.py) — the two engines share the wire protocol
+(blockchain/messages.py) and differ in this engine layer only.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+MAX_PENDING_PER_PEER = 20  # reference maxPendingRequestsPerPeer
+DEFAULT_PENDING_LIMIT = 40  # in-flight heights (requesters)
+DEFAULT_REQUEST_TIMEOUT_S = 8.0
+
+
+@dataclass
+class _PoolPeer:
+    peer_id: str
+    base: int = 0
+    height: int = 0
+    n_pending: int = 0
+
+
+@dataclass
+class _Requester:
+    height: int
+    peer_id: Optional[str] = None
+    block: Optional[object] = None
+    requested_at: float = 0.0
+
+
+class BlockPool:
+    def __init__(
+        self,
+        start_height: int,
+        pending_limit: int = DEFAULT_PENDING_LIMIT,
+        request_timeout_s: float = DEFAULT_REQUEST_TIMEOUT_S,
+    ):
+        self.height = start_height  # next height to apply
+        self.pending_limit = pending_limit
+        self.request_timeout_s = request_timeout_s
+        self.peers: Dict[str, _PoolPeer] = {}
+        self.requesters: Dict[int, _Requester] = {}
+        self._caught_up_since: Optional[float] = None
+        # set on the first clocked call so tests can drive an explicit
+        # timeline; anchors the startup grace below
+        self._created_at: Optional[float] = None
+
+    # -- peers -------------------------------------------------------------
+
+    def add_peer(self, peer_id: str) -> None:
+        self.peers.setdefault(peer_id, _PoolPeer(peer_id))
+
+    def set_peer_range(self, peer_id: str, base: int, height: int) -> None:
+        p = self.peers.setdefault(peer_id, _PoolPeer(peer_id))
+        p.base, p.height = base, height
+
+    def remove_peer(self, peer_id: str) -> List[int]:
+        """Unassign the peer's in-flight requests; returns the heights
+        that need a new peer (their requesters stay, unassigned)."""
+        self.peers.pop(peer_id, None)
+        redo = []
+        for r in self.requesters.values():
+            if r.peer_id == peer_id and r.block is None:
+                r.peer_id = None
+                redo.append(r.height)
+        return redo
+
+    def max_peer_height(self) -> int:
+        return max((p.height for p in self.peers.values()), default=0)
+
+    # -- request scheduling ------------------------------------------------
+
+    def _pick_peer(self, height: int) -> Optional[_PoolPeer]:
+        best = None
+        for p in self.peers.values():
+            if p.base <= height <= p.height and p.n_pending < MAX_PENDING_PER_PEER:
+                if best is None or p.n_pending < best.n_pending:
+                    best = p
+        return best
+
+    def make_next_requesters(self, now: Optional[float] = None) -> List[Tuple[int, str]]:
+        """Create/assign requesters up to the pending window; returns
+        (height, peer_id) pairs to actually send BlockRequests for
+        (reference makeNextRequester :373)."""
+        now = time.monotonic() if now is None else now
+        if self._created_at is None:
+            self._created_at = now
+        out: List[Tuple[int, str]] = []
+        top = self.max_peer_height()
+        h = self.height
+        while len(self.requesters) < self.pending_limit and h <= top:
+            if h not in self.requesters:
+                self.requesters[h] = _Requester(h)
+            h += 1
+        for r in sorted(self.requesters.values(), key=lambda r: r.height):
+            if r.peer_id is None and r.block is None:
+                p = self._pick_peer(r.height)
+                if p is None:
+                    continue
+                r.peer_id = p.peer_id
+                r.requested_at = now
+                p.n_pending += 1
+                out.append((r.height, p.peer_id))
+        return out
+
+    def expire(self, now: Optional[float] = None) -> List[Tuple[int, str]]:
+        """Timed-out assignments: unassign and report (height, peer) so
+        the reactor can ban the slow peer (reference requester redo on
+        timeout)."""
+        now = time.monotonic() if now is None else now
+        out = []
+        for r in self.requesters.values():
+            if (
+                r.peer_id is not None
+                and r.block is None
+                and now - r.requested_at > self.request_timeout_s
+            ):
+                out.append((r.height, r.peer_id))
+                self._unassign(r)
+        return out
+
+    def _unassign(self, r: _Requester) -> None:
+        if r.peer_id is not None:
+            p = self.peers.get(r.peer_id)
+            if p is not None and p.n_pending > 0:
+                p.n_pending -= 1
+        r.peer_id = None
+        r.block = None
+        r.requested_at = 0.0
+
+    # -- block flow --------------------------------------------------------
+
+    def add_block(self, peer_id: str, block) -> bool:
+        """Accept a block only from the peer it was requested from
+        (reference AddBlock: unsolicited blocks are an error)."""
+        h = block.header.height
+        r = self.requesters.get(h)
+        if r is None or r.peer_id != peer_id or r.block is not None:
+            return False
+        r.block = block
+        p = self.peers.get(peer_id)
+        if p is not None and p.n_pending > 0:
+            p.n_pending -= 1
+        return True
+
+    def peek_two_blocks(self):
+        """(first, second) at (height, height+1), or (None, None)
+        (reference PeekTwoBlocks — verification needs the SECOND block's
+        LastCommit)."""
+        first = self.requesters.get(self.height)
+        second = self.requesters.get(self.height + 1)
+        return (
+            first.block if first else None,
+            second.block if second else None,
+        )
+
+    def pop_request(self) -> None:
+        """First block applied: advance (reference PopRequest)."""
+        self.requesters.pop(self.height, None)
+        self.height += 1
+
+    def redo_request(self, height: int) -> List[str]:
+        """First block at `height` failed verification: both deliverers
+        (height and height+1) are suspect — unassign their requesters
+        and return the peer ids to ban (reference RedoRequest)."""
+        bad = []
+        for h in (height, height + 1):
+            r = self.requesters.get(h)
+            if r is None:
+                continue
+            deliverer = r.peer_id
+            if deliverer:
+                bad.append(deliverer)
+            self._unassign(r)
+        return bad
+
+    # -- caught up? --------------------------------------------------------
+
+    STARTUP_GRACE_S = 5.0  # reference IsCaughtUp receivedBlockOrTimedOut
+
+    def is_caught_up(self, now: Optional[float] = None) -> bool:
+        """At/above every peer's REPORTED height (so a peer whose
+        StatusResponse hasn't arrived can't make a far-behind node
+        declare victory), after a startup grace, sustained for a second
+        (reference IsCaughtUp, blockchain/v0/pool.go)."""
+        now = time.monotonic() if now is None else now
+        if self._created_at is None:
+            self._created_at = now
+        top = self.max_peer_height()
+        if (
+            now - self._created_at < self.STARTUP_GRACE_S
+            or not self.peers
+            or top == 0
+            or self.height < top
+        ):
+            self._caught_up_since = None
+            return False
+        if self._caught_up_since is None:
+            self._caught_up_since = now
+        return now - self._caught_up_since >= 1.0

@@ -1,0 +1,217 @@
+"""v0-style fast-sync reactor: BlockPool + poolRoutine.
+
+Reference: blockchain/v0/reactor.go — Receive :180 region, poolRoutine
+:285 (request ticker, status updates, trySync), per-pair verification
+at :318 (first block's commit checked with the SECOND block's
+LastCommit), SwitchToConsensus.
+
+Shares the wire protocol (channel 0x40, blockchain/messages.py) with
+the v2-style engine (blockchain/reactor.py) — a v0 node syncs from a
+v2 node and vice versa. Engine differences, faithful to the reference
+generations:
+
+- v0 (this file): requester/pool model, one verify+apply per block
+  pair per loop turn.
+- v2 (reactor.py): pure-FSM scheduler + processor with cross-height
+  BATCHED commit verification (the TPU-first redesign).
+
+Commit verification still drains through the configured BatchVerifier
+(one batched device call per commit), so v0 keeps the device path for
+the signature work itself.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Optional
+
+from tendermint_tpu.blockchain import messages as m
+from tendermint_tpu.blockchain.pool import BlockPool
+from tendermint_tpu.blockchain.reactor import (
+    BLOCKCHAIN_CHANNEL,
+    STATUS_UPDATE_INTERVAL_S,
+    TRY_SYNC_INTERVAL_S,
+)
+from tendermint_tpu.p2p.conn.connection import ChannelDescriptor
+from tendermint_tpu.p2p.peer import Peer
+from tendermint_tpu.p2p.switch import Reactor
+from tendermint_tpu.types.block import BlockID
+from tendermint_tpu.utils.log import get_logger
+
+
+class BlockchainReactorV0(Reactor):
+    def __init__(
+        self,
+        state,
+        block_exec,
+        block_store,
+        fast_sync: bool,
+        consensus_reactor=None,
+        logger=None,
+    ):
+        super().__init__("blockchain")
+        self.logger = logger or get_logger("blockchain.v0")
+        self.state = state
+        self._block_exec = block_exec
+        self._store = block_store
+        self.fast_sync = fast_sync
+        self._consensus_reactor = consensus_reactor
+        self.pool = BlockPool(start_height=state.last_block_height + 1)
+        self._switched = False
+
+    def get_channels(self):
+        return [
+            ChannelDescriptor(
+                id=BLOCKCHAIN_CHANNEL, priority=10, send_queue_capacity=1000
+            )
+        ]
+
+    # -- lifecycle ---------------------------------------------------------
+
+    async def start(self) -> None:
+        self._tasks = []
+        if self.fast_sync:
+            self._tasks = [
+                asyncio.create_task(self._request_routine()),
+                asyncio.create_task(self._pool_routine()),
+            ]
+
+    async def stop(self) -> None:
+        for t in getattr(self, "_tasks", []):
+            t.cancel()
+        await asyncio.gather(*getattr(self, "_tasks", []), return_exceptions=True)
+
+    # -- peers -------------------------------------------------------------
+
+    async def add_peer(self, peer: Peer) -> None:
+        peer.try_send(
+            BLOCKCHAIN_CHANNEL,
+            m.encode_msg(m.StatusResponse(self._store.height, self._store.base)),
+        )
+        self.pool.add_peer(peer.id)
+
+    async def remove_peer(self, peer: Peer, reason: str) -> None:
+        self.pool.remove_peer(peer.id)
+
+    # -- receive -----------------------------------------------------------
+
+    async def receive(self, ch_id: int, peer: Peer, msg_bytes: bytes) -> None:
+        msg = m.decode_msg(msg_bytes)
+        if isinstance(msg, m.StatusRequest):
+            peer.try_send(
+                BLOCKCHAIN_CHANNEL,
+                m.encode_msg(m.StatusResponse(self._store.height, self._store.base)),
+            )
+        elif isinstance(msg, m.StatusResponse):
+            self.pool.set_peer_range(peer.id, msg.base, msg.height)
+        elif isinstance(msg, m.BlockRequest):
+            block = self._store.load_block(msg.height)
+            if block is not None:
+                peer.try_send(BLOCKCHAIN_CHANNEL, m.encode_msg(m.BlockResponse(block)))
+            else:
+                peer.try_send(
+                    BLOCKCHAIN_CHANNEL, m.encode_msg(m.NoBlockResponse(msg.height))
+                )
+        elif isinstance(msg, m.BlockResponse):
+            if self.fast_sync and not self.pool.add_block(peer.id, msg.block):
+                self.logger.debug(
+                    "unsolicited block", height=msg.block.header.height,
+                    peer=peer.id[:12],
+                )
+        elif isinstance(msg, m.NoBlockResponse):
+            self.logger.debug("peer has no block", height=msg.height, peer=peer.id[:12])
+        else:
+            raise ValueError(f"unknown blockchain message {type(msg).__name__}")
+
+    # -- routines ----------------------------------------------------------
+
+    async def _request_routine(self) -> None:
+        """Status ticker + requester assignment + timeout bans
+        (reference poolRoutine's ticker halves)."""
+        ticks = 0
+        while self.fast_sync:  # exits after switch-to-consensus: a
+            # finished syncer must not keep requesting blocks and then
+            # ban every peer when the (now-ignored) responses time out
+            try:
+                if self.switch is not None:
+                    if ticks % int(STATUS_UPDATE_INTERVAL_S / 0.25) == 0:
+                        self.switch.broadcast(
+                            BLOCKCHAIN_CHANNEL, m.encode_msg(m.StatusRequest())
+                        )
+                    for height, peer_id in self.pool.make_next_requesters():
+                        p = self.switch.peers.get(peer_id)
+                        if p is not None:
+                            p.try_send(
+                                BLOCKCHAIN_CHANNEL,
+                                m.encode_msg(m.BlockRequest(height)),
+                            )
+                    for height, peer_id in self.pool.expire():
+                        p = self.switch.peers.get(peer_id)
+                        if p is not None:
+                            await self.switch.stop_peer_for_error(
+                                p, f"block request timeout at {height}"
+                            )
+            except asyncio.CancelledError:
+                raise
+            except Exception as e:  # transient: log and keep the loop
+                self.logger.error("v0 request routine error", err=repr(e))
+            ticks += 1
+            await asyncio.sleep(0.25)
+
+    async def _pool_routine(self) -> None:
+        """trySync: verify+apply one pair per turn (reference :285)."""
+        while True:
+            try:
+                progressed = await self._try_sync_one()
+                if not progressed:
+                    if self.pool.is_caught_up():
+                        await self._switch_to_consensus()
+                        return
+                    await asyncio.sleep(TRY_SYNC_INTERVAL_S * 10)
+            except asyncio.CancelledError:
+                raise
+            except Exception as e:  # transient (ABCI hiccup, disk):
+                # retry — a dead routine would leave the node stuck in
+                # fast_sync with consensus waiting forever
+                self.logger.error("v0 pool routine error", err=repr(e))
+                await asyncio.sleep(0.5)
+
+    async def _try_sync_one(self) -> bool:
+        first, second = self.pool.peek_two_blocks()
+        if first is None or second is None:
+            return False
+        parts = first.make_part_set()
+        bid = BlockID(hash=first.hash(), parts=parts.header())
+        try:
+            # ONE commit verified per step — the v0 shape; the signature
+            # batch inside still runs on the device provider
+            self.state.validators.verify_commit(
+                self.state.chain_id, bid, first.header.height, second.last_commit
+            )
+        except Exception as e:
+            self.logger.error(
+                "invalid block; redo", height=first.header.height, err=str(e)
+            )
+            for pid in self.pool.redo_request(first.header.height):
+                peer = self.switch.peers.get(pid) if self.switch else None
+                if peer is not None:
+                    await self.switch.stop_peer_for_error(
+                        peer, f"bad block {first.header.height}: {e}"
+                    )
+            return False
+        self._store.save_block(first, parts, second.last_commit)
+        self.state, _ = await self._block_exec.apply_block(self.state, bid, first)
+        self.pool.pop_request()
+        return True
+
+    async def _switch_to_consensus(self) -> None:
+        if self._switched:
+            return
+        self._switched = True
+        self.fast_sync = False
+        self.logger.info(
+            "fast sync complete (v0); switching to consensus",
+            height=self.state.last_block_height,
+        )
+        if self._consensus_reactor is not None:
+            await self._consensus_reactor.switch_to_consensus(self.state)

@@ -216,15 +216,16 @@ class MempoolConfig:
 class FastSyncConfig:
     """Reference FastSyncConfig config/config.go:708.
 
-    The reference ships three engine generations (blockchain/v0 pool,
-    v1 and v2 event-driven FSMs) selected here. This framework has ONE
-    engine implementing the union of their semantics — v0's per-height
-    requesters with timeout/redo and deliverer punishment
-    (blockchain/v0/pool.go:108,373) inside v2's pure-FSM scheduler +
-    processor structure (blockchain/v2/scheduler.go), plus cross-height
-    batched commit verification — so all three version strings are
-    accepted and select it (configs written for the reference migrate
-    unchanged)."""
+    Engine selection, matching the reference's generations (one wire
+    protocol, blockchain/messages.py):
+
+    - "v0": the requester/pool engine (blockchain/pool.py +
+      reactor_v0.py) — per-height requesters, timeout redo, deliverer
+      punishment, per-pair verification (blockchain/v0/pool.go).
+    - "v2" (default) and "v1" (same FSM generation): the pure-FSM
+      scheduler + processor (blockchain/scheduler.py + reactor.py)
+      with cross-height BATCHED commit verification — the TPU-first
+      redesign (blockchain/v2/scheduler.go)."""
 
     version: str = "v2"
 

@@ -91,9 +91,14 @@ def _track_compile_thread(t: threading.Thread) -> None:
         _compile_threads.append(t)
 
 
-# Bounded: the join exists to avoid the mid-compile abort, but a wedged
-# backend (dead TPU tunnel) must not hang shutdown forever.
-_JOIN_TIMEOUT_S = float(os.environ.get("TM_COMPILE_JOIN_TIMEOUT_S", "300"))
+# Bounded: the join exists to avoid the mid-compile abort, but neither a
+# wedged backend nor a slow compile may stall shutdown unboundedly. 60s
+# covers a cold STAGED TPU compile (~37s) and every warm-persistent-
+# cache case; only a first-boot compile on a machine with an empty
+# cache can outlive it, where the worst case is an abort message (and
+# exit 134) during interpreter teardown instead of a multi-minute hang
+# on a SIGTERM'd node.
+_JOIN_TIMEOUT_S = float(os.environ.get("TM_COMPILE_JOIN_TIMEOUT_S", "60"))
 
 
 def _join_compile_threads() -> None:  # pragma: no cover - exit path

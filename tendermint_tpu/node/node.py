@@ -337,7 +337,16 @@ class Node(Service):
         self.consensus_reactor = ConsensusReactor(
             self.consensus_state, wait_sync=fast_sync
         )
-        self.bc_reactor = BlockchainReactor(
+        # engine selection (reference fast_sync.version, config.go:714):
+        # v0 = requester/pool engine; v1/v2 = FSM engine with batched
+        # cross-height verification (v1's FSM generation maps onto v2)
+        if self.config.fastsync.version == "v0":
+            from tendermint_tpu.blockchain.reactor_v0 import BlockchainReactorV0
+
+            bc_cls = BlockchainReactorV0
+        else:
+            bc_cls = BlockchainReactor
+        self.bc_reactor = bc_cls(
             state,
             self.block_exec,
             self.block_store,

@@ -1,0 +1,206 @@
+"""v0-style fast-sync engine: BlockPool unit tests (pure FSM, explicit
+time) + end-to-end catchup with BlockchainReactorV0 (mirrors
+test_fast_sync's v2 integration case).
+
+Reference: blockchain/v0/pool.go (requesters, PeekTwoBlocks/PopRequest/
+RedoRequest, timeout redo), v0/reactor.go (poolRoutine trySync).
+"""
+
+import asyncio
+
+from tendermint_tpu.blockchain.pool import MAX_PENDING_PER_PEER, BlockPool
+from tendermint_tpu.blockchain.reactor_v0 import BlockchainReactorV0
+from tendermint_tpu.consensus.reactor import ConsensusReactor
+from tendermint_tpu.p2p.test_util import (
+    connect_switches,
+    make_switch,
+    stop_switches,
+)
+from tests.cs_harness import make_genesis, make_node
+
+CHAIN = "cs-harness-chain"
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+class _Blk:
+    """Stand-in with just the header.height the pool reads."""
+
+    def __init__(self, h):
+        self.header = type("H", (), {"height": h})()
+
+
+# -- pool FSM ---------------------------------------------------------------
+
+
+def test_pool_assigns_within_ranges_and_pending_caps():
+    pool = BlockPool(start_height=1)
+    pool.set_peer_range("a", 1, 50)
+    pool.set_peer_range("b", 10, 100)
+    reqs = pool.make_next_requesters(now=0.0)
+    assert reqs, "no requests made"
+    for h, pid in reqs:
+        if h < 10:
+            assert pid == "a", (h, pid)
+    by_peer = {}
+    for _, pid in reqs:
+        by_peer[pid] = by_peer.get(pid, 0) + 1
+    assert all(n <= MAX_PENDING_PER_PEER for n in by_peer.values())
+
+
+def test_pool_ordered_delivery_and_pop():
+    pool = BlockPool(start_height=5)
+    pool.set_peer_range("p", 1, 10)
+    dict(pool.make_next_requesters(now=0.0))
+    # out-of-order arrival: 6 before 5
+    assert pool.add_block("p", _Blk(6))
+    first, second = pool.peek_two_blocks()
+    assert first is None  # 5 not here yet
+    assert second is not None and second.header.height == 6
+    assert pool.add_block("p", _Blk(5))
+    first, second = pool.peek_two_blocks()
+    assert first.header.height == 5 and second.header.height == 6
+    pool.pop_request()
+    assert pool.height == 6
+
+
+def test_pool_rejects_unsolicited_and_wrong_peer():
+    pool = BlockPool(start_height=1)
+    pool.set_peer_range("good", 1, 10)
+    pool.set_peer_range("evil", 1, 10)
+    assignments = dict(pool.make_next_requesters(now=0.0))
+    h = 1
+    owner = assignments[h]
+    other = "evil" if owner == "good" else "good"
+    assert not pool.add_block(other, _Blk(h)), "wrong-peer block accepted"
+    assert not pool.add_block("stranger", _Blk(999)), "unknown height accepted"
+    assert pool.add_block(owner, _Blk(h))
+    assert not pool.add_block(owner, _Blk(h)), "duplicate accepted"
+
+
+def test_pool_timeout_unassigns_and_reports_peer():
+    pool = BlockPool(start_height=1, request_timeout_s=5.0)
+    pool.set_peer_range("slow", 1, 10)
+    pool.make_next_requesters(now=0.0)
+    assert pool.expire(now=4.0) == []
+    expired = pool.expire(now=6.0)
+    assert expired and all(pid == "slow" for _, pid in expired)
+    # the reactor bans the reported peer (stop_peer_for_error ->
+    # remove_peer); after that the heights reassign to a healthy one
+    pool.remove_peer("slow")
+    pool.set_peer_range("fast", 1, 10)
+    reassigned = dict(pool.make_next_requesters(now=6.0))
+    assert reassigned and all(pid == "fast" for pid in reassigned.values())
+
+
+def test_pool_redo_unassigns_both_deliverers():
+    pool = BlockPool(start_height=1)
+    pool.set_peer_range("p", 1, 10)
+    pool.make_next_requesters(now=0.0)
+    assert pool.add_block("p", _Blk(1))
+    assert pool.add_block("p", _Blk(2))
+    bad = pool.redo_request(1)
+    assert bad == ["p", "p"]
+    first, second = pool.peek_two_blocks()
+    assert first is None and second is None  # both dropped for refetch
+
+
+def test_pool_remove_peer_requeues():
+    pool = BlockPool(start_height=1)
+    pool.set_peer_range("p", 1, 6)
+    assigned = dict(pool.make_next_requesters(now=0.0))
+    redo = pool.remove_peer("p")
+    assert sorted(redo) == sorted(assigned.keys())
+    assert pool.max_peer_height() == 0
+    assert not pool.is_caught_up(now=10.0)  # no peers != caught up
+
+
+def test_pool_caught_up_needs_sustained_top_and_grace():
+    pool = BlockPool(start_height=11)
+    pool.set_peer_range("p", 1, 10)  # we are past this peer
+    assert not pool.is_caught_up(now=0.0)  # starts the clocks
+    assert not pool.is_caught_up(now=1.5)  # startup grace (5s) not over
+    assert not pool.is_caught_up(now=5.5)  # grace over; 1s sustain starts
+    assert pool.is_caught_up(now=6.6)
+    # a peer whose StatusResponse never arrived (height 0) blocks it
+    pool2 = BlockPool(start_height=1)
+    pool2.add_peer("silent")
+    assert not pool2.is_caught_up(now=0.0)
+    assert not pool2.is_caught_up(now=10.0), "height-0 peer must not count"
+
+
+# -- end to end -------------------------------------------------------------
+
+
+def test_v0_fast_sync_catchup_then_consensus():
+    """A fresh validator joins late with the v0 engine, pool-syncs the
+    chain, switches to consensus and participates (v0 analog of
+    test_fast_sync.test_fast_sync_catchup_then_consensus)."""
+
+    async def go():
+        from tendermint_tpu.config import test_config
+        from tendermint_tpu.state.execution import BlockExecutor
+
+        cfg = test_config().consensus
+        cfg.timeout_commit_ms = 400
+        cfg.skip_timeout_commit = False
+
+        genesis, privs = make_genesis(4)
+        nodes = [await make_node(genesis, pv, config=cfg) for pv in privs]
+
+        cs_reactors = [ConsensusReactor(n.cs) for n in nodes[:3]]
+        bc_reactors = [
+            BlockchainReactorV0(n.cs.state, None, n.block_store, fast_sync=False)
+            for n in nodes[:3]
+        ]
+
+        def init3(i, sw):
+            sw.add_reactor("consensus", cs_reactors[i])
+            sw.add_reactor("blockchain", bc_reactors[i])
+
+        switches = []
+        for i in range(3):
+            switches.append(
+                await make_switch(i, network=CHAIN, init=lambda s, _i=i: init3(_i, s))
+            )
+        for sw in switches:
+            await sw.start()
+        await connect_switches(switches)
+        try:
+            await asyncio.gather(*(n.cs.wait_for_height(4, 60) for n in nodes[:3]))
+
+            late = nodes[3]
+            cs_r = ConsensusReactor(late.cs, wait_sync=True)
+            bc_r = BlockchainReactorV0(
+                late.cs.state,
+                BlockExecutor(
+                    late.state_store, late.cs._block_exec._app, mempool=late.mempool
+                ),
+                late.block_store,
+                fast_sync=True,
+                consensus_reactor=cs_r,
+            )
+
+            def init_late(sw):
+                sw.add_reactor("consensus", cs_r)
+                sw.add_reactor("blockchain", bc_r)
+
+            sw4 = await make_switch(3, network=CHAIN, init=init_late)
+            await sw4.start()
+            switches.append(sw4)
+            for sw in switches[:3]:
+                await sw4.dial_peer(sw.transport.listen_addr)
+
+            for _ in range(1500):
+                if not bc_r.fast_sync:
+                    break
+                await asyncio.sleep(0.02)
+            assert not bc_r.fast_sync, "v0 engine never switched to consensus"
+            h = late.cs.state.last_block_height
+            await late.cs.wait_for_height(h + 2, timeout_s=60)
+        finally:
+            await stop_switches(switches)
+
+    run(go())
