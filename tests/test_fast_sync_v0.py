@@ -204,3 +204,77 @@ def test_v0_fast_sync_catchup_then_consensus():
             await stop_switches(switches)
 
     run(go())
+
+
+def test_cross_engine_sync_v2_from_v0_servers():
+    """Engine interop: a v2-engine late joiner syncs from v0-engine
+    peers (one wire protocol, two engines)."""
+
+    async def go():
+        from tendermint_tpu.blockchain.reactor import BlockchainReactor
+        from tendermint_tpu.config import test_config
+        from tendermint_tpu.state.execution import BlockExecutor
+
+        cfg = test_config().consensus
+        cfg.timeout_commit_ms = 400
+        cfg.skip_timeout_commit = False
+
+        genesis, privs = make_genesis(4)
+        nodes = [await make_node(genesis, pv, config=cfg) for pv in privs]
+
+        cs_reactors = [ConsensusReactor(n.cs) for n in nodes[:3]]
+        # the RUNNING nodes serve blocks through the v0 reactor
+        bc_reactors = [
+            BlockchainReactorV0(n.cs.state, None, n.block_store, fast_sync=False)
+            for n in nodes[:3]
+        ]
+
+        def init3(i, sw):
+            sw.add_reactor("consensus", cs_reactors[i])
+            sw.add_reactor("blockchain", bc_reactors[i])
+
+        switches = []
+        for i in range(3):
+            switches.append(
+                await make_switch(i, network=CHAIN, init=lambda s, _i=i: init3(_i, s))
+            )
+        for sw in switches:
+            await sw.start()
+        await connect_switches(switches)
+        try:
+            await asyncio.gather(*(n.cs.wait_for_height(4, 60) for n in nodes[:3]))
+
+            # the late joiner syncs with the v2 (FSM, batched) engine
+            late = nodes[3]
+            cs_r = ConsensusReactor(late.cs, wait_sync=True)
+            bc_r = BlockchainReactor(
+                late.cs.state,
+                BlockExecutor(
+                    late.state_store, late.cs._block_exec._app, mempool=late.mempool
+                ),
+                late.block_store,
+                fast_sync=True,
+                consensus_reactor=cs_r,
+            )
+
+            def init_late(sw):
+                sw.add_reactor("consensus", cs_r)
+                sw.add_reactor("blockchain", bc_r)
+
+            sw4 = await make_switch(3, network=CHAIN, init=init_late)
+            await sw4.start()
+            switches.append(sw4)
+            for sw in switches[:3]:
+                await sw4.dial_peer(sw.transport.listen_addr)
+
+            for _ in range(1500):
+                if not bc_r.fast_sync:
+                    break
+                await asyncio.sleep(0.02)
+            assert not bc_r.fast_sync, "v2 syncer never finished against v0 servers"
+            h = late.cs.state.last_block_height
+            await late.cs.wait_for_height(h + 2, timeout_s=60)
+        finally:
+            await stop_switches(switches)
+
+    run(go())
