@@ -113,6 +113,70 @@ def stream_windows(fn, dev_args, n_calls: int) -> float:
     return time.perf_counter() - t0
 
 
+_LAST_TPU_PATH = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "benchmarks", "last_tpu_result.json"
+)
+
+
+def _record_tpu_result(line: dict) -> None:
+    """Persist the latest real-accelerator measurement so a later run
+    whose tunnel is down can still REPORT it (clearly labeled) instead
+    of losing the round's device numbers to infrastructure flakiness.
+    Atomic write: a kill mid-dump must not destroy the previous good
+    record (same pattern as privval/file.py _atomic_write)."""
+    try:
+        import datetime
+        import subprocess
+        import tempfile
+
+        line = dict(line)
+        line["measured_at"] = datetime.datetime.now(datetime.timezone.utc).strftime(
+            "%Y-%m-%dT%H:%MZ"
+        )
+        try:
+            line["git_rev"] = (
+                subprocess.run(
+                    ["git", "rev-parse", "--short", "HEAD"],
+                    capture_output=True, text=True, timeout=10,
+                    cwd=os.path.dirname(os.path.abspath(__file__)),
+                ).stdout.strip()
+                or None
+            )
+        except Exception:
+            line["git_rev"] = None
+        fd, tmp = tempfile.mkstemp(
+            dir=os.path.dirname(_LAST_TPU_PATH), prefix=".last_tpu_"
+        )
+        with os.fdopen(fd, "w") as fp:
+            json.dump(line, fp)
+        os.replace(tmp, _LAST_TPU_PATH)
+    except Exception as e:  # never fail the bench over bookkeeping
+        log(f"could not record tpu result: {e!r}")
+
+
+_LAST_TPU_MAX_AGE_DAYS = 14
+
+
+def _last_tpu_result():
+    """The recorded measurement, or None when unreadable or too old to
+    be meaningful (it carries measured_at + git_rev so a consumer can
+    see exactly which code produced it)."""
+    try:
+        import datetime
+
+        with open(_LAST_TPU_PATH) as fp:
+            line = json.load(fp)
+        ts = datetime.datetime.strptime(
+            line.get("measured_at", ""), "%Y-%m-%dT%H:%MZ"
+        ).replace(tzinfo=datetime.timezone.utc)
+        age = datetime.datetime.now(datetime.timezone.utc) - ts
+        if age.days > _LAST_TPU_MAX_AGE_DAYS:
+            return None
+        return line
+    except Exception:
+        return None
+
+
 def run_bench(platform: str, accelerator: bool = True):
     import numpy as np
     import jax
@@ -153,11 +217,16 @@ def run_bench(platform: str, accelerator: bool = True):
         assert ok.all() and talled == n * 10
         p50 = sorted(times)[len(times) // 2]
         log(f"host-fallback VerifyCommit@10k p50: {p50*1e3:.1f} ms")
+        extra = {}
+        last = _last_tpu_result()
+        if last is not None:
+            extra["last_measured_tpu"] = last
         emit(
             round(p50 * 1e3, 3),
             round(baseline_10k / p50, 2),
             platform=platform,
             note="accelerator unavailable; measured the node's host fallback path",
+            **extra,
         )
         _deadline_done()
         return
@@ -240,14 +309,21 @@ def run_bench(platform: str, accelerator: bool = True):
             "device_pipelined_ms": round(pipelined_ms * 1e3, 2),
             "sigs_per_sec_sustained": round(n / pipelined_ms),
         }
-    emit(
-        round(p50 * 1e3, 3),
-        round(baseline_10k / p50, 2),
-        platform=platform,
-        cold_compile_s=round(cold_s, 1),
-        host_baseline_ms=round(baseline_10k * 1e3, 1),
+    line = {
+        "metric": "verify_commit_p50_latency_10k_validators",
+        "value": round(p50 * 1e3, 3),
+        "unit": "ms",
+        "vs_baseline": round(baseline_10k / p50, 2),
+        "platform": platform,
+        "cold_compile_s": round(cold_s, 1),
+        "host_baseline_ms": round(baseline_10k * 1e3, 1),
         **extra,
-    )
+    }
+    if platform != "cpu":
+        _record_tpu_result(line)
+    # ONE construction of the output line: print it directly (emit()
+    # would rebuild the same dict field-by-field)
+    print(json.dumps(line), flush=True)
     _deadline_done()  # AFTER emit: state-file absence must imply the line was printed
 
 
