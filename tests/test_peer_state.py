@@ -1,0 +1,134 @@
+"""PeerState gossip bookkeeping (reference consensus/reactor.go
+PeerState :840-1330): vote bit-arrays per (height, round, type),
+pick-send-vote de-duplication, round-step transitions carrying
+precommits into last_commit, and vote-set-bits merging."""
+
+from tendermint_tpu.codec.signbytes import PRECOMMIT_TYPE, PREVOTE_TYPE
+from tendermint_tpu.consensus.messages import (
+    HasVoteMessage,
+    NewRoundStepMessage,
+    VoteSetBitsMessage,
+)
+from tendermint_tpu.consensus.peer_state import PeerState
+from tendermint_tpu.crypto.keys import Ed25519PrivKey
+from tendermint_tpu.types.block import BlockID, PartSetHeader
+from tendermint_tpu.types.validator import Validator
+from tendermint_tpu.types.validator_set import ValidatorSet
+from tendermint_tpu.types.vote import Vote
+from tendermint_tpu.types.vote_set import VoteSet
+from tendermint_tpu.utils.bits import BitArray
+
+CHAIN = "peer-state-chain"
+N = 4
+
+
+def _valset():
+    privs = [Ed25519PrivKey.from_secret(b"ps%d" % i) for i in range(N)]
+    vals = ValidatorSet([Validator(p.pub_key(), 10) for p in privs])
+    by_addr = {p.pub_key().address(): p for p in privs}
+    return vals, [by_addr[v.address] for v in vals.validators]
+
+
+def _vote_set(vals, privs, height=3, round_=0, n_votes=N):
+    bid = BlockID(b"\x21" * 32, PartSetHeader(1, b"\x22" * 32))
+    vs = VoteSet(CHAIN, height, round_, PREVOTE_TYPE, vals)
+    for idx in range(n_votes):
+        v = Vote(
+            vote_type=PREVOTE_TYPE, height=height, round=round_, block_id=bid,
+            timestamp_ns=1, validator_address=vals.validators[idx].address,
+            validator_index=idx,
+        )
+        v.signature = privs[idx].sign(v.sign_bytes(CHAIN))
+        assert vs.add_vote(v)
+    return vs
+
+
+def _peer_at(height=3, round_=0):
+    ps = PeerState("peer-x")
+    ps.apply_new_round_step(
+        NewRoundStepMessage(
+            height=height, round=round_, step=3,
+            seconds_since_start_time=0, last_commit_round=-1,
+        )
+    )
+    ps.ensure_vote_bit_arrays(height, N)
+    return ps
+
+
+def test_pick_send_vote_covers_all_then_exhausts():
+    vals, privs = _valset()
+    votes = _vote_set(vals, privs)
+    ps = _peer_at()
+    seen = set()
+    for _ in range(N):
+        v = ps.pick_send_vote(votes)
+        assert v is not None
+        seen.add(v.validator_index)
+    assert seen == set(range(N)), "each vote picked exactly once"
+    assert ps.pick_send_vote(votes) is None, "peer already has everything"
+
+
+def test_has_vote_message_prevents_resend():
+    vals, privs = _valset()
+    votes = _vote_set(vals, privs)
+    ps = _peer_at()
+    # the peer announces it already has votes 0..2
+    for i in range(3):
+        ps.apply_has_vote(
+            HasVoteMessage(height=3, round=0, vote_type=PREVOTE_TYPE, index=i)
+        )
+    v = ps.pick_send_vote(votes)
+    assert v is not None and v.validator_index == 3
+    assert ps.pick_send_vote(votes) is None
+
+
+def test_has_vote_for_other_height_ignored():
+    ps = _peer_at(height=3)
+    ps.apply_has_vote(
+        HasVoteMessage(height=9, round=0, vote_type=PREVOTE_TYPE, index=0)
+    )
+    assert ps.rs.prevotes is not None and not ps.rs.prevotes.get_index(0)
+
+
+def test_round_step_carries_precommits_into_last_commit():
+    """Peer moves to height+1: its precommit bits become last_commit
+    bits when the commit round matches (ApplyNewRoundStepMessage)."""
+    ps = _peer_at(height=3, round_=1)
+    ps.rs.precommits = BitArray(N)
+    ps.rs.precommits.set_index(2, True)
+    ps.apply_new_round_step(
+        NewRoundStepMessage(
+            height=4, round=0, step=1,
+            seconds_since_start_time=0, last_commit_round=1,
+        )
+    )
+    assert ps.rs.height == 4
+    assert ps.rs.last_commit_round == 1
+    assert ps.rs.last_commit is not None and ps.rs.last_commit.get_index(2)
+    # fresh round state otherwise
+    assert ps.rs.prevotes is None and ps.rs.precommits is None
+
+
+def test_vote_set_bits_merge_semantics():
+    """ApplyVoteSetBitsMessage (reference :1300): the peer's claim is
+    AUTHORITATIVE for the our_votes subset (a claimed-missing our-vote
+    is dropped), while bits outside our_votes survive the merge."""
+    ps = _peer_at()
+    ps.set_has_vote(3, 0, PREVOTE_TYPE, 2)  # has a vote OUTSIDE our set
+    ps.set_has_vote(3, 0, PREVOTE_TYPE, 1)  # has one of OUR votes...
+    claimed = BitArray(N)
+    claimed.set_index(0, True)  # ...but the claim only covers vote 0
+    our = BitArray(N)
+    our.set_index(0, True)
+    our.set_index(1, True)
+    ps.apply_vote_set_bits(
+        VoteSetBitsMessage(
+            height=3, round=0, vote_type=PREVOTE_TYPE,
+            block_id=BlockID(b"\x21" * 32, PartSetHeader(1, b"\x22" * 32)),
+            votes=claimed,
+        ),
+        our_votes=our,
+    )
+    assert ps.rs.prevotes.get_index(0), "claimed bit set"
+    assert not ps.rs.prevotes.get_index(1), "claim is authoritative for our votes"
+    assert ps.rs.prevotes.get_index(2), "non-our-votes knowledge survives"
