@@ -291,9 +291,12 @@ class Node(Service):
         # Warm the device verifier in the background so the first live
         # commits hit compiled executables (VerifierModel.warmup logs
         # per-bucket compile seconds; the persistent cache makes this
-        # near-instant after the first boot on a machine).
+        # near-instant after the first boot on a machine). Includes the
+        # bucket for THIS chain's validator-set size — a 10k-validator
+        # chain must not cold-start its bucket on the first live commit.
         if hasattr(self.crypto_provider, "warmup"):
-            self.crypto_provider.warmup(background=True)
+            n_vals = self._state_at_boot.validators.size()
+            self.crypto_provider.warmup(sizes=(16, 1024, n_vals), background=True)
 
         if isinstance(self.priv_validator, SignerClient):
             # remote signer: listen and wait for it to dial in
