@@ -211,3 +211,61 @@ def test_websocket_unsubscribe(tmp_path):
             await node.stop()
 
     run(go())
+
+
+def test_rpc_server_survives_hostile_requests(tmp_path):
+    """Malformed JSON, unknown methods, wrong params, raw garbage bytes:
+    every one gets a JSON-RPC error (or a clean close) and the server
+    keeps serving valid requests afterwards."""
+
+    async def go():
+        import urllib.request
+
+        node, client = await start_node(tmp_path)
+        addr = node.rpc_server.listen_addr
+        url = f"http://{addr.host}:{addr.port}/"
+
+        def post(body: bytes):
+            req = urllib.request.Request(
+                url, data=body, headers={"Content-Type": "application/json"}
+            )
+            loop = asyncio.get_running_loop()
+
+            def _do():
+                try:
+                    with urllib.request.urlopen(req, timeout=10) as r:
+                        return r.status, r.read()
+                except urllib.error.HTTPError as e:
+                    return e.code, e.read()
+                except Exception as e:
+                    return None, repr(e).encode()
+
+            return loop.run_in_executor(None, _do)
+
+        try:
+            # 1. unparseable JSON
+            status, body = await post(b"{not json!!")
+            assert body and b"error" in body, (status, body[:120])
+            # 2. unknown method
+            status, body = await post(
+                json.dumps({"jsonrpc": "2.0", "id": 1, "method": "no_such"}).encode()
+            )
+            assert b"error" in body
+            # 3. wrong param types
+            status, body = await post(
+                json.dumps(
+                    {"jsonrpc": "2.0", "id": 2, "method": "block",
+                     "params": {"height": {"nested": "junk"}}}
+                ).encode()
+            )
+            assert b"error" in body
+            # 4. raw binary garbage
+            status, body = await post(b"\x00\xff\xfe\x01" * 64)
+            assert body is not None
+            # server still healthy for a real request
+            st = await client.call("status")
+            assert st["sync_info"]["latest_block_height"] >= 1
+        finally:
+            await node.stop()
+
+    run(go())
