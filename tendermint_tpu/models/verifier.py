@@ -326,6 +326,22 @@ class VerifierModel:
             return int(np.prod(list(self.mesh.shape.values())))
         return 1
 
+    def _window_size(self, cap: int) -> int:
+        """Largest streaming window <= cap that the mesh divides (the
+        shard_map batch axis must split evenly across devices)."""
+        mult = self._pad_multiple()
+        return max((cap // mult) * mult, mult)
+
+    def _full_window_outputs(self, fn, arrays, n: int, window: int):
+        """Dispatch `fn` over every FULL window of `arrays` (all windows
+        stay in flight; no padding — each slice is exactly `window`
+        rows). Returns (outputs, tail_start)."""
+        outs = []
+        full_end = (n // window) * window
+        for off in range(0, full_end, window):
+            outs.append(fn(*(jnp.asarray(a[off : off + window]) for a in arrays)))
+        return outs, full_end
+
     def _pad(self, arr: np.ndarray, n_pad: int) -> np.ndarray:
         n = arr.shape[0]
         if n == n_pad:
@@ -369,45 +385,62 @@ class VerifierModel:
         return np.asarray(ok)[:n]
 
     def _verify_windowed(self, pubkeys, msgs, sigs, msg_len: int) -> np.ndarray:
-        """Stream >MAX_DEVICE_ROWS batches as in-flight windows of the
-        largest bucket; sync once at the end."""
+        """Stream >MAX_DEVICE_ROWS batches as in-flight full windows; the
+        sub-window tail reuses the direct bucketed path (a tail of 1 row
+        must not pay a full-window execution)."""
         n = int(pubkeys.shape[0])
-        fn = self._get_fn("verify", MAX_DEVICE_ROWS, msg_len)
+        window = self._window_size(MAX_DEVICE_ROWS)
+        fn = self._get_fn("verify", window, msg_len)
         if fn is None:  # cold bucket, non-blocking: host fallback
             return self._cpu().verify_batch(pubkeys, msgs, sigs)
         pk = np.asarray(pubkeys, dtype=np.uint8)
         mg = np.asarray(msgs, dtype=np.uint8)
         sg = np.asarray(sigs, dtype=np.uint8)
-        outs = []
-        for off in range(0, n, MAX_DEVICE_ROWS):
-            end = min(off + MAX_DEVICE_ROWS, n)
-            outs.append(
-                fn(
-                    jnp.asarray(self._pad(pk[off:end], MAX_DEVICE_ROWS)),
-                    jnp.asarray(self._pad(mg[off:end], MAX_DEVICE_ROWS)),
-                    jnp.asarray(self._pad(sg[off:end], MAX_DEVICE_ROWS)),
-                )
-            )
-        return np.concatenate(
-            [np.asarray(o) for o in outs]
-        )[:n]
+        outs, tail_start = self._full_window_outputs(fn, (pk, mg, sg), n, window)
+        parts = [np.asarray(o) for o in outs]
+        if tail_start < n:
+            parts.append(self.verify(pk[tail_start:], mg[tail_start:], sg[tail_start:]))
+        return np.concatenate(parts)
 
     def verify_commit(self, pubkeys, msgs, sigs, powers, counted) -> Tuple[np.ndarray, int]:
-        """Fused verify + tally; returns (ok (N,) bool, tallied power)."""
+        """Fused verify + tally; returns (ok (N,) bool, tallied power).
+
+        Batches beyond MAX_TALLY_ROWS (int32 tally-chunk headroom, which
+        coincides with the MAX_DEVICE_ROWS dispatch window) stream as
+        in-flight full-bucket windows with one final sync and a host-side
+        tally merge — same rationale as verify(), and no recursive
+        halving into oddly-padded sub-buckets."""
         n = int(pubkeys.shape[0])
         if n == 0:
             return np.zeros(0, dtype=bool), 0
-        if n > ops_ed.MAX_TALLY_ROWS:
-            # Tally chunk sums would overflow int32; split the batch.
-            mid = n // 2
-            ok1, t1 = self.verify_commit(
-                pubkeys[:mid], msgs[:mid], sigs[:mid], powers[:mid], counted[:mid]
-            )
-            ok2, t2 = self.verify_commit(
-                pubkeys[mid:], msgs[mid:], sigs[mid:], powers[mid:], counted[mid:]
-            )
-            return np.concatenate([ok1, ok2]), t1 + t2
         msg_len = int(msgs.shape[1])
+        window = self._window_size(min(ops_ed.MAX_TALLY_ROWS, MAX_DEVICE_ROWS))
+        if n > window:
+            fn = self._get_fn("tally", window, msg_len)
+            if fn is None:  # cold bucket, non-blocking: host fallback
+                return self._cpu().verify_commit_batch(
+                    pubkeys, msgs, sigs, powers, counted
+                )
+            pk = np.asarray(pubkeys, dtype=np.uint8)
+            mg = np.asarray(msgs, dtype=np.uint8)
+            sg = np.asarray(sigs, dtype=np.uint8)
+            ch = ops_ed.split_powers(powers)
+            ct = np.asarray(counted, dtype=bool)
+            outs, tail_start = self._full_window_outputs(
+                fn, (pk, mg, sg, ch, ct), n, window
+            )
+            ok_parts = [np.asarray(o) for o, _ in outs]
+            tallies = [
+                ops_ed.combine_power_chunks(np.asarray(sums)) for _, sums in outs
+            ]
+            if tail_start < n:
+                ok_t, t_t = self.verify_commit(
+                    pk[tail_start:], mg[tail_start:], sg[tail_start:],
+                    np.asarray(powers)[tail_start:], ct[tail_start:],
+                )
+                ok_parts.append(ok_t)
+                tallies.append(t_t)
+            return np.concatenate(ok_parts), sum(tallies)
         n_pad = _bucket(n, self._pad_multiple())
         fn = self._get_fn("tally", n_pad, msg_len)
         if fn is None:  # cold bucket, non-blocking: host fallback

@@ -174,3 +174,47 @@ def test_tpu_provider_small_batch_routes_to_host():
     sg[1, 0] ^= 1
     ok = v.verify_batch(pk, mg, sg)
     assert list(ok) == [True, False] and called["n"] == 0
+
+
+def test_verify_commit_windows_large_batches(monkeypatch):
+    """Batches beyond the tally window stream as full-bucket windows
+    with a host-side tally merge; results are identical to the direct
+    path (window shrunk via monkeypatch so the test stays fast)."""
+    import numpy as np
+
+    import tendermint_tpu.models.verifier as mv
+    from tendermint_tpu.models.verifier import VerifierModel
+    from tendermint_tpu.ops import ref_ed25519 as ref
+
+    n = 40  # spans 3 windows of 16
+    monkeypatch.setattr(mv.ops_ed, "MAX_TALLY_ROWS", 16)
+    monkeypatch.setattr(mv, "MAX_DEVICE_ROWS", 16)
+
+    seeds = [bytes([i + 1]) * 32 for i in range(4)]
+    mats = []
+    for i, seed in enumerate(seeds):
+        msg = bytes([i]) * 160
+        mats.append(
+            (
+                np.frombuffer(ref.pubkey_from_seed(seed), dtype=np.uint8),
+                np.frombuffer(msg, dtype=np.uint8),
+                np.frombuffer(ref.sign(seed, msg), dtype=np.uint8),
+            )
+        )
+    pks = np.zeros((n, 32), np.uint8)
+    msgs = np.zeros((n, 160), np.uint8)
+    sigs = np.zeros((n, 64), np.uint8)
+    for r in range(n):
+        pks[r], msgs[r], sigs[r] = mats[r % 4]
+    powers = np.arange(1, n + 1, dtype=np.int64)
+    counted = np.ones(n, dtype=bool)
+    counted[5] = False  # nil vote: verified but not tallied
+    sigs = sigs.copy()
+    sigs[17, 0] ^= 1  # invalid row in the middle window
+
+    model = VerifierModel()
+    ok, tally = model.verify_commit(pks, msgs, sigs, powers, counted)
+    assert ok.shape == (n,)
+    assert not ok[17] and ok[np.arange(n) != 17].all()
+    expected = int(powers[(np.arange(n) != 17) & counted].sum())
+    assert tally == expected
