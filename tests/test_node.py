@@ -193,3 +193,32 @@ def test_config_roundtrips_mesh_and_fastsync_version(tmp_path):
     assert back.fastsync.version == "v0"
     cfg.fastsync.version = "v9"
     assert cfg.fastsync.validate_basic() is not None
+
+
+def test_node_selects_fast_sync_engine_from_config(tmp_path):
+    """fast_sync.version=v0 wires the requester/pool engine; the default
+    (v2) wires the batched FSM engine."""
+    from tendermint_tpu.blockchain.reactor import BlockchainReactor
+    from tendermint_tpu.blockchain.reactor_v0 import BlockchainReactorV0
+
+    async def go(version, expected_cls):
+        # fresh home per engine: a reused home's privval last-sign state
+        # (correctly) refuses to re-sign height 1 of a fresh memdb chain
+        home = init_home(tmp_path, name=f"engine-{version}")
+        cfg = load_config(os.path.join(home, "config/config.toml")).set_root(home)
+        cfg.base.db_backend = "memdb"
+        cfg.p2p.laddr = "tcp://127.0.0.1:0"
+        cfg.fastsync.version = version
+        cfg.consensus.timeout_commit_ms = 50
+        cfg.consensus.skip_timeout_commit = True
+        node = default_new_node(cfg)
+        await node.start()
+        try:
+            assert type(node.bc_reactor) is expected_cls, version
+            await node.consensus_state.wait_for_height(2, timeout_s=30)
+        finally:
+            await node.stop()
+
+    run(go("v0", BlockchainReactorV0))
+    run(go("v2", BlockchainReactor))
+    run(go("v1", BlockchainReactor))
