@@ -130,7 +130,10 @@ class BlockPool:
         return out
 
     def _unassign(self, r: _Requester) -> None:
-        if r.peer_id is not None:
+        # n_pending was already decremented in add_block once the block
+        # arrived; only an in-flight request (block is None) still counts
+        # against the peer's pending budget.
+        if r.peer_id is not None and r.block is None:
             p = self.peers.get(r.peer_id)
             if p is not None and p.n_pending > 0:
                 p.n_pending -= 1
@@ -189,19 +192,25 @@ class BlockPool:
     STARTUP_GRACE_S = 5.0  # reference IsCaughtUp receivedBlockOrTimedOut
 
     def is_caught_up(self, now: Optional[float] = None) -> bool:
-        """At/above every peer's REPORTED height (so a peer whose
-        StatusResponse hasn't arrived can't make a far-behind node
-        declare victory), after a startup grace, sustained for a second
-        (reference IsCaughtUp, blockchain/v0/pool.go)."""
+        """At/above every peer's REPORTED height, after a startup grace,
+        sustained for a second (reference IsCaughtUp,
+        blockchain/v0/pool.go). Only a reported height > 0 blocks
+        victory: if every peer still reports 0 after the grace, the
+        whole network is at genesis and our chain is trivially the
+        longest, so we are caught up."""
         now = time.monotonic() if now is None else now
         if self._created_at is None:
             self._created_at = now
         top = self.max_peer_height()
+        # top == 0 with peers present means the whole network is at
+        # genesis: our chain is (trivially) the longest, so after the
+        # grace we are caught up (reference IsCaughtUp's
+        # ourChainIsLongestAmongPeers with maxPeerHeight == 0).
+        our_chain_is_longest = top == 0 or self.height >= top
         if (
             now - self._created_at < self.STARTUP_GRACE_S
             or not self.peers
-            or top == 0
-            or self.height < top
+            or not our_chain_is_longest
         ):
             self._caught_up_since = None
             return False

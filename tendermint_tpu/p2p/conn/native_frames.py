@@ -78,8 +78,14 @@ def seal_frames(lib, key: bytes, nonce: int, data: bytes) -> Tuple[bytes, int]:
     out = ctypes.create_string_buffer(frames * SEALED_FRAME_SIZE)
     nbuf = ctypes.create_string_buffer(nonce.to_bytes(12, "little"), 12)
     wrote = lib.sc_seal_frames(key, nbuf, data, len(data), out)
-    assert wrote == frames, (wrote, frames)
-    return out.raw, int.from_bytes(nbuf.raw[:12], "little")
+    if wrote != frames:
+        raise RuntimeError(f"native seal wrote {wrote} frames, expected {frames}")
+    nxt = int.from_bytes(nbuf.raw[:12], "little")
+    if nxt < nonce:
+        # the C counter wraps silently at 2^96; reusing a nonce under the
+        # same key breaks AEAD — fail hard like the pure path's _Nonce.use()
+        raise OverflowError("secret connection nonce wrapped (2^96 frames)")
+    return out.raw, nxt
 
 
 def open_frames(lib, key: bytes, nonce: int, sealed: bytes) -> Tuple[Optional[bytes], int]:
@@ -93,4 +99,7 @@ def open_frames(lib, key: bytes, nonce: int, sealed: bytes) -> Tuple[Optional[by
     got = lib.sc_open_frames(key, nbuf, sealed, frames, out)
     if got < 0:
         return None, nonce
-    return out.raw[:got], int.from_bytes(nbuf.raw[:12], "little")
+    nxt = int.from_bytes(nbuf.raw[:12], "little")
+    if nxt < nonce:
+        raise OverflowError("secret connection nonce wrapped (2^96 frames)")
+    return out.raw[:got], nxt

@@ -213,11 +213,14 @@ class VoteSet:
                 # non-ed25519 validator key (e.g. secp256k1): the batch
                 # kernel is ed25519-only — verify through the key's own
                 # type (reference Vote.Verify calls the interface method)
-                direct_ok[k] = bool(
-                    val.pub_key.verify(
-                        vote.sign_bytes(self.chain_id), vote.signature
-                    )
-                )
+                sb = vote.sign_bytes(self.chain_id)
+                try:
+                    direct_ok[k] = bool(val.pub_key.verify(sb, vote.signature))
+                except Exception:
+                    # a key type whose verify() raises on malformed input
+                    # counts as an invalid signature, not a batch abort
+                    # (same contract as _serial_fill_non_ed)
+                    direct_ok[k] = False
                 continue
             rows.append(k)
             pks.append(raw)
