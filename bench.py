@@ -31,7 +31,7 @@ os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", CACHE_DIR)
 os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "1")
 
 PROBE_TIMEOUT_S = 120  # first TPU init can be slow; a dead tunnel hangs forever
-BENCH_N = 10000
+BENCH_N = int(os.environ.get("TM_BENCH_N", "10000"))  # override for smoke tests
 MSG_LEN = 160
 # Hard deadline: emit SOMETHING before an external timeout can kill the
 # process with no output (the forced-CPU fallback's cold compile alone
@@ -271,6 +271,72 @@ def run_bench(platform: str, accelerator: bool = True):
     ok_bad, _ = model.verify_commit(pks, msgs, sigs_bad, powers, counted)
     assert not ok_bad[7] and ok_bad.sum() == n - 1
 
+    # -- per-valset cached-table path (round 3) ---------------------------
+    # The live verify_commit hot path: tables of each -A precomputed once
+    # per valset (pubkeys are stable across heights), leaving sha512 +
+    # a 32-doubling scan + blocked-inversion encode per commit.
+    tabled = {}
+    tabled_p50 = None
+    try:
+        key = b"bench-valset"
+        idx = np.arange(n, dtype=np.int32)
+        t0 = time.perf_counter()
+        ok_t = model.verify_rows_cached(key, pks, idx, msgs, sigs)
+        tabled_cold_s = time.perf_counter() - t0
+        if ok_t is not None:
+            assert ok_t.all(), int(ok_t.sum())
+            e = model._valset_tables.get(key)
+            tabled["tables_build_s"] = round(e.build_s, 2) if e and e.build_s else None
+            tabled["tabled_cold_s"] = round(tabled_cold_s, 1)
+            t_times = []
+            for _ in range(5):
+                t0 = time.perf_counter()
+                ok_t = model.verify_rows_cached(key, pks, idx, msgs, sigs)
+                t_times.append(time.perf_counter() - t0)
+            tabled_p50 = sorted(t_times)[len(t_times) // 2]
+            tabled["tabled_p50_ms"] = round(tabled_p50 * 1e3, 2)
+            log(
+                f"tabled VerifyCommit@10k p50: {tabled_p50*1e3:.2f} ms "
+                f"({n/tabled_p50:,.0f} sigs/s; build {tabled['tables_build_s']}s)"
+            )
+            # negative control through the cached path
+            ok_tb = model.verify_rows_cached(key, pks, idx, msgs, sigs_bad)
+            assert ok_tb is not None and not ok_tb[7] and ok_tb.sum() == n - 1
+            # pipelined: K chained stage dispatches, one sync
+            import jax as _jax
+            import jax.numpy as jnp
+
+            s1, s2, s3, _b = model._table_stage_fns()
+            n_pad = 10240
+            pk_d = _jax.device_put(jnp.asarray(model._pad(pks, n_pad)))
+            mg_d = _jax.device_put(jnp.asarray(model._pad(msgs, n_pad)))
+            sg_d = _jax.device_put(jnp.asarray(model._pad(sigs, n_pad)))
+            idx_d = _jax.device_put(jnp.asarray(model._pad(idx, n_pad)))
+
+            def chain():
+                sd, kd, s_ok = s1(pk_d, mg_d, sg_d)
+                px, py, pz, pt, a_ok = s2(sd, kd, e.tables, e.a_ok, idx_d)
+                return s3(px, py, pz, pt, sg_d, a_ok, s_ok)
+
+            np.asarray(chain())  # warm the 10240 bucket
+            K = 8
+            t0 = time.perf_counter()
+            outs = [chain() for _ in range(K)]
+            for o in outs:
+                np.asarray(o)
+            tp = (time.perf_counter() - t0) / K
+            tabled["tabled_pipelined_ms"] = round(tp * 1e3, 2)
+            tabled["tabled_sigs_per_sec_sustained"] = round(n / tp)
+            log(
+                f"tabled pipelined: {tp*1e3:.1f} ms/commit "
+                f"({n/tp:,.0f} sigs/s sustained)"
+            )
+    except Exception as ex:  # diagnostic only; never forfeit the main line
+        import traceback
+
+        traceback.print_exc(file=sys.stderr)
+        log(f"tabled measurement failed: {ex!r}")
+
     # -- pipelined device rate: launch K calls, sync once -----------------
     # The tunneled dev backend adds ~100ms of per-call transfer/sync
     # latency that a directly-attached chip does not have; amortizing K
@@ -303,21 +369,54 @@ def run_bench(platform: str, accelerator: bool = True):
     except Exception as ex:  # diagnostic only; never forfeit the main line
         log(f"pipelined measurement failed: {ex!r}")
 
+    # -- AOT cold start: fresh process, warm AOT cache --------------------
+    # VERDICT round 2 #2: a restarting validator must reach its first
+    # device-verified commit in seconds, not a ~20s recompile window.
+    aot_extra = {}
+    try:
+        if platform != "cpu":
+            import subprocess
+
+            env = dict(os.environ, TM_BENCH_COLDSTART="1", TM_BENCH_INNER="")
+            r = subprocess.run(
+                [sys.executable, os.path.abspath(__file__)],
+                env=env, capture_output=True, text=True, timeout=180,
+            )
+            cs = json.loads(r.stdout.strip().splitlines()[-1])
+            aot_extra = {
+                "coldstart_backend_init_s": cs.get("backend_init_s"),
+                "coldstart_first_verify_s": cs.get("first_verify_s"),
+            }
+            log(f"fresh-process cold start: {cs}")
+    except Exception as ex:
+        log(f"cold-start probe failed: {ex!r}")
+
     extra = {}
     if pipelined_ms is not None:
         extra = {
             "device_pipelined_ms": round(pipelined_ms * 1e3, 2),
             "sigs_per_sec_sustained": round(n / pipelined_ms),
         }
+    # headline = the best path a live node would take (the cached-table
+    # path IS the verify_commit hot path when tables are warm)
+    best_p50 = p50 if tabled_p50 is None else min(p50, tabled_p50)
+    if tabled.get("tabled_sigs_per_sec_sustained") and (
+        not extra.get("sigs_per_sec_sustained")
+        or tabled["tabled_sigs_per_sec_sustained"] > extra["sigs_per_sec_sustained"]
+    ):
+        extra["sigs_per_sec_sustained"] = tabled["tabled_sigs_per_sec_sustained"]
     line = {
         "metric": "verify_commit_p50_latency_10k_validators",
-        "value": round(p50 * 1e3, 3),
+        "value": round(best_p50 * 1e3, 3),
         "unit": "ms",
-        "vs_baseline": round(baseline_10k / p50, 2),
+        "vs_baseline": round(baseline_10k / best_p50, 2),
         "platform": platform,
         "cold_compile_s": round(cold_s, 1),
         "host_baseline_ms": round(baseline_10k * 1e3, 1),
+        "generic_p50_ms": round(p50 * 1e3, 3),
         **extra,
+        **tabled,
+        **aot_extra,
     }
     if platform != "cpu":
         _record_tpu_result(line)
@@ -400,7 +499,37 @@ def _deadline_done() -> None:
             pass
 
 
+def _coldstart() -> None:
+    """Fresh-process measurement: backend init + AOT-loaded first verify.
+    Prints one JSON line; run by the parent bench with a warm AOT cache."""
+    n = BENCH_N
+    pks, msgs, sigs = make_batch(n)  # host prep excluded from the timing
+
+    t0 = time.perf_counter()
+    import jax
+
+    jax.devices()
+    init_s = time.perf_counter() - t0
+
+    from tendermint_tpu.models.verifier import VerifierModel
+
+    t0 = time.perf_counter()
+    model = VerifierModel()
+    ok = model.verify(pks, msgs, sigs)
+    first_s = time.perf_counter() - t0
+    assert ok.all()
+    print(
+        json.dumps(
+            {"backend_init_s": round(init_s, 2), "first_verify_s": round(first_s, 2)}
+        ),
+        flush=True,
+    )
+
+
 def main():
+    if os.environ.get("TM_BENCH_COLDSTART") == "1":
+        _coldstart()
+        return
     if os.environ.get("TM_BENCH_INNER") != "1":
         sys.exit(_supervise())
     accelerator = probe()

@@ -28,6 +28,9 @@ class _PoolPeer:
     base: int = 0
     height: int = 0
     n_pending: int = 0
+    # True once a StatusResponse arrived: a merely-connected peer whose
+    # report is still in flight must not look like "at genesis"
+    reported: bool = False
 
 
 @dataclass
@@ -63,6 +66,7 @@ class BlockPool:
     def set_peer_range(self, peer_id: str, base: int, height: int) -> None:
         p = self.peers.setdefault(peer_id, _PoolPeer(peer_id))
         p.base, p.height = base, height
+        p.reported = True
 
     def remove_peer(self, peer_id: str) -> List[int]:
         """Unassign the peer's in-flight requests; returns the heights
@@ -194,22 +198,22 @@ class BlockPool:
     def is_caught_up(self, now: Optional[float] = None) -> bool:
         """At/above every peer's REPORTED height, after a startup grace,
         sustained for a second (reference IsCaughtUp,
-        blockchain/v0/pool.go). Only a reported height > 0 blocks
-        victory: if every peer still reports 0 after the grace, the
-        whole network is at genesis and our chain is trivially the
-        longest, so we are caught up."""
+        blockchain/v0/pool.go). Only peers whose StatusResponse has
+        actually arrived count: a connected-but-silent peer can neither
+        block victory nor (crucially) fake a genesis network — a
+        far-behind node whose peers' reports are delayed must keep
+        waiting. If every REPORTING peer says 0, the whole network is
+        at genesis and our chain is trivially the longest (reference
+        ourChainIsLongestAmongPeers with maxPeerHeight == 0)."""
         now = time.monotonic() if now is None else now
         if self._created_at is None:
             self._created_at = now
-        top = self.max_peer_height()
-        # top == 0 with peers present means the whole network is at
-        # genesis: our chain is (trivially) the longest, so after the
-        # grace we are caught up (reference IsCaughtUp's
-        # ourChainIsLongestAmongPeers with maxPeerHeight == 0).
+        reported = [p for p in self.peers.values() if p.reported]
+        top = max((p.height for p in reported), default=0)
         our_chain_is_longest = top == 0 or self.height >= top
         if (
             now - self._created_at < self.STARTUP_GRACE_S
-            or not self.peers
+            or not reported
             or not our_chain_is_longest
         ):
             self._caught_up_since = None

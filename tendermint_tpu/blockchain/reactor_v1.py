@@ -1,0 +1,248 @@
+"""v1-style fast-sync reactor: the asyncio shell around FsmV1.
+
+Reference: blockchain/v1/reactor.go — Receive :222 routes wire messages
+into FSM events, poolRoutine :336 (request ticker + status ticker +
+state-timer plumbing), processBlocksRoutine :284 (verify+apply pair,
+report processedBlockEv back into the FSM), switchToConsensus :474.
+
+Shares channel 0x40 and blockchain/messages.py with the v0/v2 engines;
+selection happens in node/node.py via config fast_sync.version. The
+FSM itself (blockchain/v1.py) is pure and table-tested; this shell
+owns asyncio timers, the switch, and block execution. Commit
+verification drains through ValidatorSet.verify_commit, i.e. the
+batched device provider (per-valset cached tables when warm).
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Optional
+
+from tendermint_tpu.blockchain import messages as m
+from tendermint_tpu.blockchain.reactor import (
+    BLOCKCHAIN_CHANNEL,
+    STATUS_UPDATE_INTERVAL_S,
+    TRY_SYNC_INTERVAL_S,
+)
+from tendermint_tpu.blockchain.v1 import (
+    ErrMissingBlock,
+    FsmV1,
+    ToReactor,
+)
+from tendermint_tpu.p2p.conn.connection import ChannelDescriptor
+from tendermint_tpu.p2p.peer import Peer
+from tendermint_tpu.p2p.switch import Reactor
+from tendermint_tpu.types.block import BlockID
+from tendermint_tpu.utils.log import get_logger
+
+TRY_SEND_INTERVAL_S = 0.25
+
+
+class BlockchainReactorV1(Reactor, ToReactor):
+    def __init__(
+        self,
+        state,
+        block_exec,
+        block_store,
+        fast_sync: bool,
+        consensus_reactor=None,
+        logger=None,
+    ):
+        Reactor.__init__(self, "blockchain")
+        self.logger = logger or get_logger("blockchain.v1")
+        self.state = state
+        self._block_exec = block_exec
+        self._store = block_store
+        self.fast_sync = fast_sync
+        self._consensus_reactor = consensus_reactor
+        self.fsm = FsmV1(state.last_block_height + 1, self)
+        self._switched = False
+        self._timer_task: Optional[asyncio.Task] = None
+        self._timer_gen = 0
+
+    def get_channels(self):
+        return [
+            ChannelDescriptor(
+                id=BLOCKCHAIN_CHANNEL, priority=10, send_queue_capacity=1000
+            )
+        ]
+
+    # -- lifecycle ---------------------------------------------------------
+
+    async def start(self) -> None:
+        self._tasks = []
+        if self.fast_sync:
+            self.fsm.handle_start()
+            self._tasks = [
+                asyncio.create_task(self._pool_routine()),
+                asyncio.create_task(self._process_routine()),
+            ]
+
+    async def stop(self) -> None:
+        if self._timer_task is not None:
+            self._timer_task.cancel()
+        for t in getattr(self, "_tasks", []):
+            t.cancel()
+        await asyncio.gather(*getattr(self, "_tasks", []), return_exceptions=True)
+
+    # -- ToReactor (FSM -> world) ------------------------------------------
+
+    def send_status_request(self) -> None:
+        if self.switch is not None:
+            self.switch.broadcast(BLOCKCHAIN_CHANNEL, m.encode_msg(m.StatusRequest()))
+
+    def send_block_request(self, peer_id: str, height: int) -> bool:
+        p = self.switch.peers.get(peer_id) if self.switch is not None else None
+        if p is None:
+            return False
+        return p.try_send(BLOCKCHAIN_CHANNEL, m.encode_msg(m.BlockRequest(height)))
+
+    def send_peer_error(self, err: Exception, peer_id: str) -> None:
+        p = self.switch.peers.get(peer_id) if self.switch is not None else None
+        if p is not None:
+            asyncio.ensure_future(
+                self.switch.stop_peer_for_error(p, f"fast sync: {err}")
+            )
+
+    def reset_state_timer(self, state_name: str, timeout_s: float) -> None:
+        """One active FSM state timer; superseded timers die via the
+        generation counter (reference resetStateTimer :504)."""
+        self._timer_gen += 1
+        gen = self._timer_gen
+        if self._timer_task is not None:
+            self._timer_task.cancel()
+
+        async def fire():
+            await asyncio.sleep(timeout_s)
+            if gen != self._timer_gen:
+                return
+            err = self.fsm.handle_state_timeout(state_name)
+            if err is not None:
+                self.logger.debug("fsm state timeout", state=state_name, err=str(err))
+
+        self._timer_task = asyncio.create_task(fire())
+
+    def switch_to_consensus(self) -> None:
+        if self._switched:
+            return
+        self._switched = True
+        self.fast_sync = False
+        self.logger.info(
+            "fast sync complete (v1); switching to consensus",
+            height=self.state.last_block_height,
+        )
+        if self._consensus_reactor is not None:
+            asyncio.ensure_future(
+                self._consensus_reactor.switch_to_consensus(self.state)
+            )
+
+    # -- peers -------------------------------------------------------------
+
+    async def add_peer(self, peer: Peer) -> None:
+        peer.try_send(
+            BLOCKCHAIN_CHANNEL,
+            m.encode_msg(m.StatusResponse(self._store.height, self._store.base)),
+        )
+
+    async def remove_peer(self, peer: Peer, reason: str) -> None:
+        self.fsm.handle_peer_remove(peer.id)
+
+    # -- receive -----------------------------------------------------------
+
+    async def receive(self, ch_id: int, peer: Peer, msg_bytes: bytes) -> None:
+        msg = m.decode_msg(msg_bytes)
+        if isinstance(msg, m.StatusRequest):
+            peer.try_send(
+                BLOCKCHAIN_CHANNEL,
+                m.encode_msg(m.StatusResponse(self._store.height, self._store.base)),
+            )
+        elif isinstance(msg, m.StatusResponse):
+            if self.fast_sync:
+                self.fsm.handle_status_response(peer.id, msg.base, msg.height)
+        elif isinstance(msg, m.BlockRequest):
+            block = self._store.load_block(msg.height)
+            if block is not None:
+                peer.try_send(BLOCKCHAIN_CHANNEL, m.encode_msg(m.BlockResponse(block)))
+            else:
+                peer.try_send(
+                    BLOCKCHAIN_CHANNEL, m.encode_msg(m.NoBlockResponse(msg.height))
+                )
+        elif isinstance(msg, m.BlockResponse):
+            if self.fast_sync:
+                err = self.fsm.handle_block_response(
+                    peer.id, msg.block, recv_size=len(msg_bytes)
+                )
+                if err is not None:
+                    self.logger.debug(
+                        "rejected block response",
+                        height=msg.block.header.height, err=str(err),
+                    )
+        elif isinstance(msg, m.NoBlockResponse):
+            self.logger.debug("peer has no block", height=msg.height, peer=peer.id[:12])
+        else:
+            raise ValueError(f"unknown blockchain message {type(msg).__name__}")
+
+    # -- routines ----------------------------------------------------------
+
+    async def _pool_routine(self) -> None:
+        """Status + request tickers and per-peer response timeouts
+        (reference poolRoutine :336)."""
+        ticks = 0
+        import time as _time
+
+        while self.fast_sync:
+            try:
+                if ticks % int(STATUS_UPDATE_INTERVAL_S / TRY_SEND_INTERVAL_S) == 0:
+                    self.send_status_request()
+                if self.fsm.needs_blocks():
+                    self.fsm.handle_make_requests()
+                now = _time.monotonic()
+                for pid in self.fsm.pool.overdue_peers(now):
+                    self.logger.info("peer block-response timeout", peer=pid[:12])
+                    self.fsm.handle_peer_remove(pid)
+                    self.send_peer_error(
+                        ErrMissingBlock("block response timeout"), pid
+                    )
+            except asyncio.CancelledError:
+                raise
+            except Exception as e:
+                self.logger.error("v1 pool routine error", err=repr(e))
+            ticks += 1
+            await asyncio.sleep(TRY_SEND_INTERVAL_S)
+
+    async def _process_routine(self) -> None:
+        """Verify+apply the pair at (H, H+1); feed the result back in as
+        processedBlockEv (reference processBlocksRoutine :284)."""
+        while self.fast_sync:
+            try:
+                progressed = await self._process_block()
+                if not progressed:
+                    await asyncio.sleep(TRY_SYNC_INTERVAL_S * 10)
+            except asyncio.CancelledError:
+                raise
+            except Exception as e:
+                self.logger.error("v1 process routine error", err=repr(e))
+                await asyncio.sleep(0.5)
+
+    async def _process_block(self) -> bool:
+        try:
+            first, _fp, second, _sp = self.fsm.pool.first_two_blocks_and_peers()
+        except ErrMissingBlock:
+            return False
+        parts = first.make_part_set()
+        bid = BlockID(hash=first.hash(), parts=parts.header())
+        try:
+            self.state.validators.verify_commit(
+                self.state.chain_id, bid, first.header.height, second.last_commit
+            )
+        except Exception as e:
+            self.logger.error(
+                "invalid block; invalidating pair", height=first.header.height,
+                err=str(e),
+            )
+            self.fsm.handle_processed_block(e)
+            return False
+        self._store.save_block(first, parts, second.last_commit)
+        self.state, _ = await self._block_exec.apply_block(self.state, bid, first)
+        self.fsm.handle_processed_block(None)
+        return True

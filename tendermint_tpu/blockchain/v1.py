@@ -1,0 +1,499 @@
+"""v1-style fast-sync engine: event-driven FSM + per-peer block pool.
+
+Reference: blockchain/v1/reactor_fsm.go (BcReactorFSM :39, states
+unknown/waitForPeer/waitForBlock/finished :138, event handlers
+:180-370), blockchain/v1/pool.go (BlockPool :12 — blocks live INSIDE
+each peer, plannedRequests reschedule set, MakeNextRequests :169),
+blockchain/v1/peer.go (BpPeer :26 — per-peer response timer + receive
+-rate monitor).
+
+The v1 generation differs from v0 (requesters pulled by a ticker) and
+v2 (scheduler/processor FSM): ALL control flow is explicit events into
+one state machine, which makes every corner (peer lies, timeouts,
+processing failures) a pure table-testable transition. Like the repo's
+other engine layers this is a PURE state machine — explicit `now`
+everywhere, timers surfaced through the ToReactor callback interface —
+driven by reactor_v1.py's asyncio shell; all three engines share one
+wire protocol (blockchain/messages.py).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, Dict, List, Optional, Tuple
+
+MAX_REQUESTS_PER_PEER = 20  # reference v1/reactor.go:39
+MAX_NUM_REQUESTS = 64  # reference v1/reactor.go:41
+WAIT_FOR_PEER_TIMEOUT_S = 3.0  # reference reactor_fsm.go:148
+WAIT_FOR_BLOCK_TIMEOUT_S = 10.0  # reference reactor_fsm.go:149
+PEER_RESPONSE_TIMEOUT_S = 15.0  # reference peer.go BpPeerDefaultParams
+MIN_RECV_RATE_BPS = 7680  # reference peer.go: minimum bytes/s from a peer
+
+
+class V1Error(Exception):
+    pass
+
+
+class ErrPeerTooShort(V1Error):
+    pass
+
+
+class ErrPeerLowersItsHeight(V1Error):
+    pass
+
+
+class ErrBadDataFromPeer(V1Error):
+    pass
+
+
+class ErrDuplicateBlock(V1Error):
+    pass
+
+
+class ErrMissingBlock(V1Error):
+    pass
+
+
+class ErrSlowPeer(V1Error):
+    pass
+
+
+class ErrNoTallerPeer(V1Error):
+    """No peer has a taller chain: fast sync is done (not a failure)."""
+
+
+class ErrNoPeerResponseForCurrentHeights(V1Error):
+    pass
+
+
+class ErrInvalidEvent(V1Error):
+    pass
+
+
+class BpPeer:
+    """Fast-sync view of one peer: its reported range, the blocks it
+    was asked for (None = in flight), a response deadline and a receive
+    -rate estimate (reference peer.go BpPeer; the flowrate EMA becomes
+    a windowed average — same slow-peer cut, explicit time)."""
+
+    def __init__(self, peer_id: str, base: int, height: int):
+        self.peer_id = peer_id
+        self.base = base
+        self.height = height
+        self.blocks: Dict[int, Optional[object]] = {}  # height -> block | None
+        self.n_pending = 0
+        self.response_deadline: Optional[float] = None
+        self._monitor_start: Optional[float] = None
+        self._bytes_received = 0
+
+    def block_at_height(self, height: int):
+        b = self.blocks.get(height)
+        if b is None:
+            raise ErrMissingBlock(f"no block at {height} from {self.peer_id}")
+        return b
+
+    def request_sent(self, height: int, now: float) -> None:
+        self.blocks[height] = None
+        if self.n_pending == 0:
+            self._monitor_start = now
+            self._bytes_received = 0
+            self.response_deadline = now + PEER_RESPONSE_TIMEOUT_S
+        self.n_pending += 1
+
+    def add_block(self, block, recv_size: int, now: float) -> None:
+        h = block.header.height
+        if h not in self.blocks:
+            raise ErrMissingBlock(f"unsolicited block {h} from {self.peer_id}")
+        if self.blocks[h] is not None:
+            raise ErrDuplicateBlock(f"duplicate block {h} from {self.peer_id}")
+        self.blocks[h] = block
+        self.n_pending -= 1
+        if self.n_pending == 0:
+            self.response_deadline = None
+            self._monitor_start = None
+        else:
+            self._bytes_received += max(recv_size, 0)
+            self.response_deadline = now + PEER_RESPONSE_TIMEOUT_S
+
+    def remove_block(self, height: int) -> None:
+        self.blocks.pop(height, None)
+
+    def check_rate(self, now: float) -> Optional[V1Error]:
+        """Slow-peer cut (reference CheckRate): with requests pending,
+        the average receive rate since the monitor started must stay
+        above MIN_RECV_RATE_BPS (after a 2s grace so a just-started
+        monitor can't divide by ~zero)."""
+        if self.n_pending == 0 or self._monitor_start is None:
+            return None
+        elapsed = now - self._monitor_start
+        if elapsed < 2.0:
+            return None
+        rate = self._bytes_received / elapsed
+        if rate < MIN_RECV_RATE_BPS:
+            return ErrSlowPeer(
+                f"{self.peer_id}: {rate:.0f} B/s < {MIN_RECV_RATE_BPS}"
+            )
+        return None
+
+    def response_overdue(self, now: float) -> bool:
+        return self.response_deadline is not None and now > self.response_deadline
+
+
+class BlockPoolV1:
+    """Reference v1/pool.go: blocks live inside the delivering peer;
+    the pool maps height -> expected deliverer and keeps the
+    plannedRequests reschedule set."""
+
+    def __init__(self, height: int):
+        self.height = height  # next block to execute
+        self.max_peer_height = 0
+        self.peers: Dict[str, BpPeer] = {}
+        self.blocks: Dict[int, str] = {}  # height -> peer_id expected/delivered
+        self.planned_requests: set = set()
+        self.next_request_height = height
+        # peers removed this step that the reactor must report/disconnect
+        self.errored_peers: List[Tuple[str, V1Error]] = []
+
+    # -- peers -------------------------------------------------------------
+
+    def update_peer(self, peer_id: str, base: int, height: int) -> Optional[V1Error]:
+        peer = self.peers.get(peer_id)
+        if peer is None:
+            if height < self.height:
+                return ErrPeerTooShort(f"{peer_id} at {height} < {self.height}")
+            self.peers[peer_id] = BpPeer(peer_id, base, height)
+        else:
+            if height < peer.height:
+                err = ErrPeerLowersItsHeight(f"{peer_id}: {peer.height} -> {height}")
+                self.remove_peer(peer_id, err)
+                return err
+            peer.base, peer.height = base, height
+        self._update_max_peer_height()
+        return None
+
+    def _update_max_peer_height(self) -> None:
+        self.max_peer_height = max((p.height for p in self.peers.values()), default=0)
+
+    def remove_peer(self, peer_id: str, err: Optional[V1Error]) -> None:
+        peer = self.peers.get(peer_id)
+        if peer is None:
+            return
+        for h in list(peer.blocks):
+            # reschedule everything assigned to (or delivered by) the peer
+            self.planned_requests.add(h)
+            self.blocks.pop(h, None)
+            peer.remove_block(h)
+        old_max = self.max_peer_height
+        del self.peers[peer_id]
+        if err is not None:
+            self.errored_peers.append((peer_id, err))
+        self._update_max_peer_height()
+        if old_max > self.max_peer_height:
+            self.planned_requests = {
+                h for h in self.planned_requests if h <= self.max_peer_height
+            }
+            if self.next_request_height > self.max_peer_height:
+                self.next_request_height = self.max_peer_height + 1
+
+    def remove_short_peers(self) -> None:
+        for p in list(self.peers.values()):
+            if p.height < self.height:
+                self.remove_peer(p.peer_id, None)
+
+    def remove_bad_peers(self, now: float) -> None:
+        self.remove_short_peers()
+        for p in list(self.peers.values()):
+            err = p.check_rate(now)
+            if err is not None:
+                self.remove_peer(p.peer_id, err)
+
+    def num_peers(self) -> int:
+        return len(self.peers)
+
+    def reached_max_height(self) -> bool:
+        return self.height >= self.max_peer_height
+
+    def needs_blocks(self) -> bool:
+        return len(self.blocks) < MAX_NUM_REQUESTS
+
+    # -- requests ----------------------------------------------------------
+
+    def make_next_requests(
+        self, max_num_requests: int, now: float
+    ) -> List[Tuple[int, str]]:
+        """Plan + assign requests; returns (height, peer_id) pairs for
+        the reactor to send (reference MakeNextRequests — the send
+        itself goes through the ToReactor seam)."""
+        self.remove_bad_peers(now)
+        num_needed = max_num_requests - len(self.blocks)
+        while len(self.planned_requests) < num_needed:
+            if self.next_request_height > self.max_peer_height:
+                break
+            self.planned_requests.add(self.next_request_height)
+            self.next_request_height += 1
+        out: List[Tuple[int, str]] = []
+        for h in sorted(self.planned_requests):
+            assigned = self._assign(h, now)
+            if assigned is None:
+                break  # no peer for h => none for h+1 either
+            out.append((h, assigned))
+        for h, _ in out:
+            self.planned_requests.discard(h)
+        return out
+
+    def _assign(self, height: int, now: float) -> Optional[str]:
+        for p in self.peers.values():
+            if p.n_pending >= MAX_REQUESTS_PER_PEER:
+                continue
+            if p.base > height or p.height < height:
+                continue
+            self.blocks[height] = p.peer_id
+            p.request_sent(height, now)
+            return p.peer_id
+        return None
+
+    # -- blocks ------------------------------------------------------------
+
+    def add_block(self, peer_id: str, block, recv_size: int, now: float) -> Optional[V1Error]:
+        peer = self.peers.get(peer_id)
+        if peer is None:
+            return ErrBadDataFromPeer(f"block from unknown peer {peer_id}")
+        want = self.blocks.get(block.header.height)
+        if want is not None and want != peer_id:
+            return ErrBadDataFromPeer(
+                f"block {block.header.height} from {peer_id}, expected {want}"
+            )
+        try:
+            peer.add_block(block, recv_size, now)
+        except V1Error as e:
+            return e
+        return None
+
+    def _block_and_peer(self, height: int):
+        peer = self.peers.get(self.blocks.get(height, ""))
+        if peer is None:
+            raise ErrMissingBlock(f"no delivery peer for {height}")
+        return peer.block_at_height(height), peer
+
+    def first_two_blocks_and_peers(self):
+        """(first, first_peer, second, second_peer) at heights H, H+1;
+        raises ErrMissingBlock when either is absent."""
+        first, fp = self._block_and_peer(self.height)
+        second, sp = self._block_and_peer(self.height + 1)
+        return first, fp, second, sp
+
+    def invalidate_first_two_blocks(self, err: V1Error) -> None:
+        for h in (self.height, self.height + 1):
+            try:
+                _, peer = self._block_and_peer(h)
+            except ErrMissingBlock:
+                continue
+            self.remove_peer(peer.peer_id, err)
+
+    def processed_current_height_block(self) -> None:
+        pid = self.blocks.pop(self.height, None)
+        if pid in self.peers:
+            self.peers[pid].remove_block(self.height)
+        self.height += 1
+        self.remove_short_peers()
+
+    def remove_peer_at_current_heights(self, err: V1Error) -> None:
+        """FSM stalled: drop the peer owing the block at H (or H+1)."""
+        for h in (self.height, self.height + 1):
+            pid = self.blocks.get(h)
+            peer = self.peers.get(pid) if pid is not None else None
+            if peer is not None and peer.blocks.get(h) is None:
+                self.remove_peer(peer.peer_id, err)
+                return
+
+    def overdue_peers(self, now: float) -> List[str]:
+        return [p.peer_id for p in self.peers.values() if p.response_overdue(now)]
+
+    def drain_errored_peers(self) -> List[Tuple[str, V1Error]]:
+        out, self.errored_peers = self.errored_peers, []
+        return out
+
+    def cleanup(self) -> None:
+        self.peers.clear()
+        self.blocks.clear()
+        self.planned_requests.clear()
+
+
+# -- the FSM -----------------------------------------------------------------
+
+S_UNKNOWN = "unknown"
+S_WAIT_FOR_PEER = "waitForPeer"
+S_WAIT_FOR_BLOCK = "waitForBlock"
+S_FINISHED = "finished"
+
+STATE_TIMEOUTS_S = {
+    S_WAIT_FOR_PEER: WAIT_FOR_PEER_TIMEOUT_S,
+    S_WAIT_FOR_BLOCK: WAIT_FOR_BLOCK_TIMEOUT_S,
+}
+
+
+class ToReactor:
+    """Callback seam the FSM drives (reference bcReactor interface,
+    reactor_fsm.go:379); implemented by reactor_v1.py and by tests."""
+
+    def send_status_request(self) -> None: ...
+
+    def send_block_request(self, peer_id: str, height: int) -> bool:
+        """False when the peer is gone from the switch."""
+        return True
+
+    def send_peer_error(self, err: Exception, peer_id: str) -> None: ...
+
+    def reset_state_timer(self, state_name: str, timeout_s: float) -> None: ...
+
+    def switch_to_consensus(self) -> None: ...
+
+
+class FsmV1:
+    """The v1 event-driven FSM (reference BcReactorFSM). Every input is
+    one `handle_*` call; transitions and side effects happen through
+    the pool and the ToReactor seam. `now` is explicit for tests."""
+
+    def __init__(self, start_height: int, to_bcr: ToReactor):
+        self.pool = BlockPoolV1(start_height)
+        self.to_bcr = to_bcr
+        self.state = S_UNKNOWN
+
+    # -- driving -----------------------------------------------------------
+
+    def _transition(self, next_state: str) -> None:
+        if next_state == self.state:
+            return
+        self.state = next_state
+        timeout = STATE_TIMEOUTS_S.get(next_state)
+        if timeout is not None:
+            self.to_bcr.reset_state_timer(next_state, timeout)
+        if next_state == S_FINISHED:
+            self.to_bcr.switch_to_consensus()
+            self.pool.cleanup()
+
+    def _report_errored_peers(self) -> None:
+        for pid, err in self.pool.drain_errored_peers():
+            self.to_bcr.send_peer_error(err, pid)
+
+    def is_caught_up(self) -> bool:
+        return self.state == S_FINISHED
+
+    def needs_blocks(self) -> bool:
+        return self.state == S_WAIT_FOR_BLOCK and self.pool.needs_blocks()
+
+    # -- events ------------------------------------------------------------
+
+    def handle_start(self) -> Optional[Exception]:
+        if self.state != S_UNKNOWN:
+            return ErrInvalidEvent(f"start in {self.state}")
+        self.to_bcr.send_status_request()
+        self._transition(S_WAIT_FOR_PEER)
+        return None
+
+    def handle_status_response(
+        self, peer_id: str, base: int, height: int, now: Optional[float] = None
+    ) -> Optional[Exception]:
+        now = time.monotonic() if now is None else now
+        if self.state == S_WAIT_FOR_PEER:
+            err = self.pool.update_peer(peer_id, base, height)
+            self._report_errored_peers()
+            if self.pool.num_peers() > 0:
+                self._transition(S_WAIT_FOR_BLOCK)
+            return err
+        if self.state == S_WAIT_FOR_BLOCK:
+            err = self.pool.update_peer(peer_id, base, height)
+            self._report_errored_peers()
+            if self.pool.num_peers() == 0:
+                self._transition(S_WAIT_FOR_PEER)
+            elif self.pool.reached_max_height():
+                self._transition(S_FINISHED)
+            return err
+        return ErrInvalidEvent(f"statusResponse in {self.state}")
+
+    def handle_block_response(
+        self, peer_id: str, block, recv_size: int, now: Optional[float] = None
+    ) -> Optional[Exception]:
+        now = time.monotonic() if now is None else now
+        if self.state != S_WAIT_FOR_BLOCK:
+            return ErrInvalidEvent(f"blockResponse in {self.state}")
+        err = self.pool.add_block(peer_id, block, recv_size, now)
+        if err is not None:
+            # unsolicited / wrong peer / duplicate: drop & report the
+            # peer (remove_peer queues it; _report_errored_peers sends
+            # exactly once)
+            self.pool.remove_peer(peer_id, err)
+        self._report_errored_peers()
+        if self.pool.num_peers() == 0:
+            self._transition(S_WAIT_FOR_PEER)
+        return err
+
+    def handle_processed_block(
+        self, err: Optional[Exception], now: Optional[float] = None
+    ) -> Optional[Exception]:
+        if self.state != S_WAIT_FOR_BLOCK:
+            return ErrInvalidEvent(f"processedBlock in {self.state}")
+        if err is not None:
+            # both deliverers of the failed pair are suspect
+            self.pool.invalidate_first_two_blocks(
+                err if isinstance(err, V1Error) else ErrBadDataFromPeer(str(err))
+            )
+            self._report_errored_peers()
+        else:
+            self.pool.processed_current_height_block()
+            self.to_bcr.reset_state_timer(
+                S_WAIT_FOR_BLOCK, WAIT_FOR_BLOCK_TIMEOUT_S
+            )
+        if self.pool.reached_max_height():
+            self._transition(S_FINISHED)
+        return err
+
+    def handle_make_requests(
+        self, max_num_requests: int = MAX_NUM_REQUESTS, now: Optional[float] = None
+    ) -> None:
+        now = time.monotonic() if now is None else now
+        if self.state != S_WAIT_FOR_BLOCK:
+            return
+        for height, peer_id in self.pool.make_next_requests(max_num_requests, now):
+            if not self.to_bcr.send_block_request(peer_id, height):
+                # switch no longer has the peer: unwind the assignment
+                self.pool.remove_peer(peer_id, None)
+        self._report_errored_peers()
+
+    def handle_peer_remove(
+        self, peer_id: str, err: Optional[Exception] = None
+    ) -> None:
+        self.pool.remove_peer(
+            peer_id,
+            err if isinstance(err, V1Error) or err is None else ErrBadDataFromPeer(str(err)),
+        )
+        self.pool.drain_errored_peers()  # switch already knows
+        if self.state == S_WAIT_FOR_BLOCK:
+            if self.pool.num_peers() == 0:
+                self._transition(S_WAIT_FOR_PEER)
+            elif self.pool.reached_max_height():
+                self._transition(S_FINISHED)
+
+    def handle_state_timeout(self, state_name: str) -> Optional[Exception]:
+        if state_name != self.state:
+            return ErrInvalidEvent(f"timeout for {state_name} while in {self.state}")
+        if self.state == S_WAIT_FOR_PEER:
+            # nobody taller responded: our chain is the longest
+            self._transition(S_FINISHED)
+            return ErrNoTallerPeer("no taller peer")
+        if self.state == S_WAIT_FOR_BLOCK:
+            err = ErrNoPeerResponseForCurrentHeights("stalled at current heights")
+            self.pool.remove_peer_at_current_heights(err)
+            self._report_errored_peers()
+            self.to_bcr.reset_state_timer(S_WAIT_FOR_BLOCK, WAIT_FOR_BLOCK_TIMEOUT_S)
+            if self.pool.num_peers() == 0:
+                self._transition(S_WAIT_FOR_PEER)
+                return err
+            if self.pool.reached_max_height():
+                self._transition(S_FINISHED)
+                return None
+            return err
+        return None
+
+    def handle_stop(self) -> None:
+        self._transition(S_FINISHED)

@@ -64,6 +64,25 @@ class BatchVerifier:
         talled = int(np.sum(np.where(ok & counted.astype(bool), powers, 0)))
         return ok, talled
 
+    def verify_rows_cached(
+        self,
+        valset_key: bytes,
+        all_pubkeys: np.ndarray,
+        row_idx: np.ndarray,
+        msgs: np.ndarray,
+        sigs: np.ndarray,
+    ) -> Optional[np.ndarray]:
+        """Verify rows whose pubkeys are ``all_pubkeys[row_idx]`` using
+        per-valset precomputed tables keyed by ``valset_key``.
+
+        Validator sets are stable across heights; providers that
+        precompute per-key tables (the TPU path) hoist decompression and
+        most of the scalar-mult doublings out of the per-commit program.
+        Returns None when no cached path is available — callers MUST
+        fall back to verify_batch (this default does exactly that
+        signal)."""
+        return None
+
 
 class CPUBatchVerifier(BatchVerifier):
     """Serial host verification -- reference-parity behavior."""
@@ -123,6 +142,13 @@ class TPUBatchVerifier(BatchVerifier):
         if len(pubkeys) < self.min_device_batch:
             return self._cpu.verify_commit_batch(pubkeys, msgs, sigs, powers, counted)
         return self._model.verify_commit(pubkeys, msgs, sigs, powers, counted)
+
+    def verify_rows_cached(self, valset_key, all_pubkeys, row_idx, msgs, sigs):
+        if len(row_idx) < self.min_device_batch:
+            return None
+        return self._model.verify_rows_cached(
+            valset_key, all_pubkeys, row_idx, msgs, sigs
+        )
 
 
 _lock = threading.Lock()

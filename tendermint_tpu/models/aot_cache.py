@@ -1,0 +1,223 @@
+"""Serialized-executable (AOT) cache for the verify pipeline.
+
+The XLA persistent compilation cache (JAX_COMPILATION_CACHE_DIR) keeps a
+restarting node from re-OPTIMIZING programs, but every process start
+still pays trace + lower + cache lookup + program load — measured
+15-23s for the staged verify pipeline on a v5e even with a warm
+persistent cache (BENCHMARKS.md round 2). The reference's serial
+verifier has zero warmup (crypto/ed25519/ed25519.go:151), so a
+restarting validator must not fall that far behind.
+
+This cache serializes the jax.stages.Compiled executable itself
+(jax.experimental.serialize_executable): deserialize_and_load skips
+trace, lowering AND compilation, handing back a loaded executable in
+~100ms per stage. Keyed by a fingerprint of jaxlib version + backend
+platform + device kind + the source of the ops/ modules, plus the
+stage name and argument shapes — any mismatch or load failure falls
+back to a normal jit compile; the cache is an optimization, never a
+correctness dependency.
+
+Disable with TM_AOT_CACHE=0; relocate with TM_AOT_CACHE_DIR.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import threading
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+
+from tendermint_tpu.utils.log import get_logger
+
+_log = get_logger("aot-cache")
+
+_FINGERPRINT: Optional[str] = None
+_fp_lock = threading.Lock()
+
+
+def enabled() -> bool:
+    return os.environ.get("TM_AOT_CACHE", "1") != "0"
+
+
+def cache_dir() -> str:
+    d = os.environ.get("TM_AOT_CACHE_DIR")
+    if not d:
+        d = os.path.join(
+            os.environ.get("XDG_CACHE_HOME", os.path.expanduser("~/.cache")),
+            "tendermint_tpu",
+            "aot",
+        )
+    return d
+
+
+def _code_digest() -> str:
+    """Digest of the kernel source files: a changed kernel must never
+    load a stale executable."""
+    import tendermint_tpu.models.verifier as _v
+    import tendermint_tpu.ops as _ops
+
+    h = hashlib.sha256()
+    roots = [os.path.dirname(_ops.__file__), _v.__file__]
+    files = []
+    for r in roots:
+        if os.path.isdir(r):
+            files.extend(
+                os.path.join(r, f) for f in sorted(os.listdir(r)) if f.endswith(".py")
+            )
+        else:
+            files.append(r)
+    for f in files:
+        with open(f, "rb") as fh:
+            h.update(fh.read())
+    return h.hexdigest()[:16]
+
+
+def fingerprint() -> str:
+    """Backend + code identity baked into every cache filename."""
+    global _FINGERPRINT
+    with _fp_lock:
+        if _FINGERPRINT is None:
+            dev = jax.devices()[0]
+            raw = "|".join(
+                [
+                    jax.__version__,
+                    getattr(dev, "platform", "?"),
+                    getattr(dev, "device_kind", "?"),
+                    _code_digest(),
+                ]
+            )
+            _FINGERPRINT = hashlib.sha256(raw.encode()).hexdigest()[:20]
+        return _FINGERPRINT
+
+
+def _arg_sig(args: Tuple[Any, ...]) -> str:
+    parts = []
+    for a in args:
+        shape = getattr(a, "shape", None)
+        dtype = getattr(a, "dtype", None)
+        parts.append(f"{tuple(shape) if shape is not None else '?'}:{dtype}")
+    return hashlib.sha256(";".join(parts).encode()).hexdigest()[:16]
+
+
+def _path(stage: str, args: Tuple[Any, ...]) -> str:
+    return os.path.join(cache_dir(), f"{fingerprint()}-{stage}-{_arg_sig(args)}.jaxexe")
+
+
+def load(stage: str, args: Tuple[Any, ...]):
+    """A loaded Compiled for (stage, arg shapes), or None."""
+    if not enabled():
+        return None
+    try:
+        p = _path(stage, args)
+        if not os.path.exists(p):
+            return None
+        from jax.experimental.serialize_executable import deserialize_and_load
+        import pickle
+
+        with open(p, "rb") as fh:
+            payload, in_tree, out_tree, device_ids = pickle.load(fh)
+        # restore the original device assignment: deserialize_and_load
+        # defaults to ALL local devices, which breaks a single-device
+        # executable on a multi-device host (and vice versa)
+        by_id = {d.id: d for d in jax.devices()}
+        devices = [by_id[i] for i in device_ids]
+        return deserialize_and_load(payload, in_tree, out_tree, execution_devices=devices)
+    except Exception as ex:  # stale/incompatible blob: recompile
+        _log.info("aot load failed (recompiling)", stage=stage, err=repr(ex))
+        return None
+
+
+def save(stage: str, args: Tuple[Any, ...], compiled) -> None:
+    """Best-effort: serialize `compiled` for the next process."""
+    if not enabled():
+        return
+    try:
+        from jax.experimental.serialize_executable import serialize
+        import pickle
+
+        payload, in_tree, out_tree = serialize(compiled)
+        device_ids = [
+            d.id for d in compiled._executable.xla_executable.local_devices()
+        ]
+        os.makedirs(cache_dir(), exist_ok=True)
+        p = _path(stage, args)
+        tmp = p + f".tmp.{os.getpid()}"
+        with open(tmp, "wb") as fh:
+            pickle.dump((payload, in_tree, out_tree, device_ids), fh)
+        os.replace(tmp, p)
+    except Exception as ex:  # backend without executable serialization
+        _log.info("aot save failed", stage=stage, err=repr(ex))
+
+
+class AotJit:
+    """jit wrapper that persists compiled executables across processes.
+
+    Call like the underlying function; per distinct argument shapes it
+    (1) tries the on-disk executable, (2) falls back to lower+compile
+    and saves the result. In-process, the loaded/compiled executable is
+    memoized exactly like jit's own cache.
+
+    A deserialized executable is VALIDATED on its first use (synchronous
+    block inside a try): some backends' AOT loaders accept a blob and
+    then fail at dispatch (observed on XLA:CPU for large programs with
+    subcomputations — "Function ... not found"). A dispatch failure
+    drops the stale file, recompiles, and re-runs — the cache can slow
+    a start down, never break it.
+    """
+
+    def __init__(self, fn, stage: str, jit_fn=None):
+        self._jit = jit_fn if jit_fn is not None else jax.jit(fn)
+        self.stage = stage
+        self._compiled: Dict[str, Any] = {}  # sig -> [callable, needs_validation]
+        self._lock = threading.Lock()
+        self.last_source: Optional[str] = None  # "aot" | "compile" (tests/metrics)
+
+    def _get(self, sig: str, args):
+        rec = self._compiled.get(sig)
+        if rec is None:
+            with self._lock:
+                rec = self._compiled.get(sig)
+                if rec is None:
+                    c = load(self.stage, args)
+                    if c is not None:
+                        self.last_source = "aot"
+                        rec = [c, True]
+                    else:
+                        c = self._jit.lower(*args).compile()
+                        self.last_source = "compile"
+                        save(self.stage, args, c)
+                        rec = [c, False]
+                    self._compiled[sig] = rec
+        return rec
+
+    def _recompile(self, sig: str, args):
+        try:
+            os.remove(_path(self.stage, args))
+        except OSError:
+            pass
+        c = self._jit.lower(*args).compile()
+        self.last_source = "compile"
+        save(self.stage, args, c)
+        with self._lock:
+            self._compiled[sig] = [c, False]
+        return c
+
+    def __call__(self, *args):
+        sig = _arg_sig(args)
+        rec = self._get(sig, args)
+        c, needs_validation = rec
+        if not needs_validation:
+            return c(*args)
+        try:
+            out = c(*args)
+            jax.tree_util.tree_map(lambda x: x.block_until_ready(), out)
+        except Exception as ex:
+            _log.info(
+                "aot executable failed validation (recompiling)",
+                stage=self.stage, err=repr(ex),
+            )
+            return self._recompile(sig, args)(*args)
+        rec[1] = False
+        return out

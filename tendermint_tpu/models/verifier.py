@@ -128,6 +128,25 @@ class _Entry:
         self.compile_s: Optional[float] = None
 
 
+# Per-valset cached tables kept device-resident (LRU): ~12KB/validator
+# (SPLITS*8 affine-cached points), so a 10k set is ~123MB of HBM per
+# entry. Two entries cover the live pattern (current set + next set
+# around a validator-set change).
+MAX_CACHED_VALSETS = 2
+
+
+class _TablesEntry:
+    __slots__ = ("tables", "a_ok", "v", "ready", "building", "build_s")
+
+    def __init__(self, v: int):
+        self.tables = None
+        self.a_ok = None
+        self.v = v
+        self.ready = False
+        self.building = False
+        self.build_s: Optional[float] = None
+
+
 class VerifierModel:
     def __init__(self, mesh=None, block_on_compile: bool = True, logger=None):
         self.mesh = mesh
@@ -135,6 +154,7 @@ class VerifierModel:
         self.logger = logger or get_logger("verifier")
         self._lock = threading.Lock()
         self._entries: Dict[Tuple[str, int, int], _Entry] = {}
+        self._valset_tables: Dict[bytes, _TablesEntry] = {}  # insertion-ordered LRU
 
     # -- compiled function cache ------------------------------------------
 
@@ -154,13 +174,22 @@ class VerifierModel:
         cached = getattr(self, "_stage_fns", None)
         if cached is not None:
             return cached
+        from tendermint_tpu.models.aot_cache import AotJit
+
         if self.mesh is None:
-            s1 = jax.jit(ops_ed.verify_stage_prepare)
-            s2 = jax.jit(ops_ed.verify_stage_scan)
+            s1 = AotJit(ops_ed.verify_stage_prepare, "prepare")
+            s2 = AotJit(ops_ed.verify_stage_scan, "scan")
         else:
             batch, _ = self._shard_specs()
-            s1 = self._smap(ops_ed.verify_stage_prepare, 3, (batch,) * 8)
-            s2 = self._smap(ops_ed.verify_stage_scan, 6, (batch,) * 4)
+            tag = f"mesh{tuple(self.mesh.shape.values())}"
+            s1 = AotJit(
+                None, f"prepare-{tag}",
+                jit_fn=self._smap(ops_ed.verify_stage_prepare, 3, (batch,) * 8),
+            )
+            s2 = AotJit(
+                None, f"scan-{tag}",
+                jit_fn=self._smap(ops_ed.verify_stage_scan, 6, (batch,) * 4),
+            )
         self._stage_fns = (s1, s2)
         return self._stage_fns
 
@@ -191,10 +220,12 @@ class VerifierModel:
         XLA inserts exactly one psum (over ICI) for the tally. Stages
         are shard_mapped independently; every intermediate is sharded
         over the batch axis so no collective moves between stages."""
+        from tendermint_tpu.models.aot_cache import AotJit
+
         s1, s2 = self._stages()
         if self.mesh is None:
             if kind == "verify":
-                s3 = jax.jit(ops_ed.verify_stage_finish)
+                s3 = AotJit(ops_ed.verify_stage_finish, "finish")
 
                 def fn(pk, mg, sg):
                     pre = s1(pk, mg, sg)
@@ -203,7 +234,7 @@ class VerifierModel:
 
                 return fn
 
-            s3t = jax.jit(ops_ed.verify_stage_finish_tally)
+            s3t = AotJit(ops_ed.verify_stage_finish_tally, "finish-tally")
 
             def fn(pk, mg, sg, chunks, counted):
                 pre = s1(pk, mg, sg)
@@ -213,8 +244,12 @@ class VerifierModel:
             return fn
 
         batch, rep = self._shard_specs()
+        tag = f"mesh{tuple(self.mesh.shape.values())}"
         if kind == "verify":
-            s3 = self._smap(ops_ed.verify_stage_finish, 7, batch)
+            s3 = AotJit(
+                None, f"finish-{tag}",
+                jit_fn=self._smap(ops_ed.verify_stage_finish, 7, batch),
+            )
 
             def fn(pk, mg, sg):
                 pre = s1(pk, mg, sg)
@@ -229,7 +264,10 @@ class VerifierModel:
             )
             return ok, jax.lax.psum(local, BATCH_AXIS)
 
-        s3t = self._smap(finish_tally_psum, 9, (batch, rep))
+        s3t = AotJit(
+            None, f"finish-tally-{tag}",
+            jit_fn=self._smap(finish_tally_psum, 9, (batch, rep)),
+        )
 
         def fn(pk, mg, sg, chunks, counted):
             pre = s1(pk, mg, sg)
@@ -460,6 +498,182 @@ class VerifierModel:
         from tendermint_tpu.crypto.batch import CPUBatchVerifier
 
         return CPUBatchVerifier()
+
+    # -- per-valset cached tables ------------------------------------------
+    #
+    # Validator pubkeys are stable across heights (the reference
+    # re-verifies the same keys every block, types/validator_set.go:641).
+    # build_valset_tables hoists everything key-dependent out of the
+    # per-commit program: decompression, the per-row table build and 224
+    # of 256 shared doublings. verify_rows_cached is the resulting fast
+    # path: challenge hash + 32-doubling split scan + blocked-inversion
+    # encode, with each row's table gathered by validator index on
+    # device.
+
+    def _table_stage_fns(self):
+        cached = getattr(self, "_table_stages", None)
+        if cached is not None:
+            return cached
+        from tendermint_tpu.models.aot_cache import AotJit
+
+        self._table_stages = (
+            AotJit(ops_ed.verify_stage_prepare_tabled, "t-prepare"),
+            AotJit(ops_ed.verify_stage_scan_tabled, "t-scan"),
+            AotJit(ops_ed.verify_stage_finish_blocked, "t-finish"),
+            AotJit(ops_ed.build_valset_tables, "t-build"),
+        )
+        return self._table_stages
+
+    def _build_tables(self, e: _TablesEntry, key: bytes, pubkeys: np.ndarray) -> None:
+        _, _, _, build = self._table_stage_fns()
+        t0 = time.perf_counter()
+        v = pubkeys.shape[0]
+        v_pad = _bucket(v, 1)
+        tables, a_ok = build(jnp.asarray(self._pad(np.asarray(pubkeys, dtype=np.uint8), v_pad)))
+        tables.block_until_ready()
+        e.tables, e.a_ok = tables, a_ok
+        e.build_s = time.perf_counter() - t0
+        e.ready = True
+        self.logger.info(
+            "valset tables built",
+            validators=v, key=key[:8].hex(), seconds=round(e.build_s, 2),
+        )
+
+    def _tables_entry(self, key: bytes, pubkeys: np.ndarray) -> Optional[_TablesEntry]:
+        """The ready tables entry for `key`, or None when still cold
+        (async build kicked off in non-blocking mode)."""
+        with self._lock:
+            e = self._valset_tables.get(key)
+            if e is not None:
+                # true LRU: refresh recency on every hit, else two cold
+                # lookups (e.g. historical sets for evidence) would
+                # evict the hot current set
+                self._valset_tables.pop(key)
+                self._valset_tables[key] = e
+            else:
+                e = _TablesEntry(int(pubkeys.shape[0]))
+                self._valset_tables[key] = e
+                while len(self._valset_tables) > MAX_CACHED_VALSETS:
+                    old = next(iter(self._valset_tables))
+                    if old == key:
+                        break
+                    del self._valset_tables[old]
+        if e.ready:
+            return e
+        if self.block_on_compile:
+            with self._lock:
+                if e.building:
+                    return None  # another thread mid-build
+                e.building = True
+            try:
+                if not e.ready:
+                    self._build_tables(e, key, pubkeys)
+            finally:
+                e.building = False
+            return e
+        with self._lock:
+            if e.building or e.ready:
+                return e if e.ready else None
+            e.building = True
+        pk_copy = np.array(pubkeys, dtype=np.uint8, copy=True)
+
+        def work():
+            try:
+                self._build_tables(e, key, pk_copy)
+            except Exception as ex:  # pragma: no cover - defensive
+                self.logger.error("valset table build failed", err=repr(ex))
+            finally:
+                e.building = False
+
+        t = threading.Thread(target=work, daemon=True, name="valset-tables")
+        _track_compile_thread(t)
+        t.start()
+        return None
+
+    def verify_rows_cached(
+        self, valset_key: bytes, all_pubkeys, row_idx, msgs, sigs
+    ) -> Optional[np.ndarray]:
+        """Verify rows whose pubkeys are all_pubkeys[row_idx] against the
+        per-valset cached tables. Returns (N,) bool, or None when the
+        cached path is unavailable (mesh configured, tables cold in
+        non-blocking mode, or batch too large) — callers fall back to
+        verify().
+
+        row_idx MUST index into all_pubkeys; rows are independent, so
+        duplicate indices are fine (the trusting path may produce them).
+        """
+        if self.mesh is not None:
+            return None  # sharded table gather not supported yet: generic path
+        n = int(len(row_idx))
+        if n == 0:
+            return np.zeros(0, dtype=bool)
+        if n > MAX_DEVICE_ROWS:
+            return None
+        e = self._tables_entry(valset_key, np.asarray(all_pubkeys, dtype=np.uint8))
+        if e is None:
+            return None
+        msg_len = int(msgs.shape[1])
+        n_pad = _bucket(n, 1)
+        # the table's padded row count is part of the compiled shape: a
+        # valset that grows past its pad bucket must re-warm, not run a
+        # synchronous compile on the live path
+        v_pad = int(e.tables.shape[0])
+        key = ("tabled", n_pad, msg_len, v_pad)
+        with self._lock:
+            ent = self._entries.get(key)
+            if ent is None:
+                ent = _Entry(None)  # stage fns are shared; entry tracks warmth
+                self._entries[key] = ent
+        if not ent.ready and not self.block_on_compile:
+            self._compile_tabled_async(ent, e, n_pad, msg_len)
+            return None
+        s1, s2, s3, _ = self._table_stage_fns()
+        pk_rows = np.asarray(all_pubkeys, dtype=np.uint8)[np.asarray(row_idx)]
+        idx = self._pad(np.asarray(row_idx, dtype=np.int32), n_pad)
+        pk = jnp.asarray(self._pad(pk_rows, n_pad))
+        mg = jnp.asarray(self._pad(np.asarray(msgs, dtype=np.uint8), n_pad))
+        sg = jnp.asarray(self._pad(np.asarray(sigs, dtype=np.uint8), n_pad))
+        t0 = time.perf_counter()
+        sd, kd, s_ok = s1(pk, mg, sg)
+        px, py, pz, pt, a_ok = s2(sd, kd, e.tables, e.a_ok, jnp.asarray(idx))
+        ok = s3(px, py, pz, pt, sg, a_ok, s_ok)
+        out = np.asarray(ok)[:n]
+        if not ent.ready:
+            ent.compile_s = time.perf_counter() - t0
+            ent.ready = True
+        return out
+
+    def _compile_tabled_async(
+        self, ent: _Entry, e: _TablesEntry, n_pad: int, msg_len: int
+    ) -> None:
+        if not self._claim_compile(ent):
+            return
+
+        def work():
+            try:
+                t0 = time.perf_counter()
+                s1, s2, s3, _ = self._table_stage_fns()
+                pk = jnp.asarray(np.zeros((n_pad, 32), dtype=np.uint8))
+                mg = jnp.asarray(np.zeros((n_pad, msg_len), dtype=np.uint8))
+                sg = jnp.asarray(np.zeros((n_pad, 64), dtype=np.uint8))
+                idx = jnp.asarray(np.zeros(n_pad, dtype=np.int32))
+                sd, kd, s_ok = s1(pk, mg, sg)
+                px, py, pz, pt, a_ok = s2(sd, kd, e.tables, e.a_ok, idx)
+                np.asarray(s3(px, py, pz, pt, sg, a_ok, s_ok))
+                ent.compile_s = time.perf_counter() - t0
+                ent.ready = True
+                self.logger.info(
+                    "tabled bucket compiled", rows=n_pad, msg_len=msg_len,
+                    seconds=round(ent.compile_s, 2),
+                )
+            except Exception as ex:  # pragma: no cover - defensive
+                self.logger.error("tabled compile failed", err=repr(ex))
+            finally:
+                ent.compiling = False
+
+        t = threading.Thread(target=work, daemon=True, name=f"compile-tabled-{n_pad}")
+        _track_compile_thread(t)
+        t.start()
 
     # -- warmup ------------------------------------------------------------
 

@@ -341,12 +341,18 @@ class Node(Service):
             self.consensus_state, wait_sync=fast_sync
         )
         # engine selection (reference fast_sync.version, config.go:714):
-        # v0 = requester/pool engine; v1/v2 = FSM engine with batched
-        # cross-height verification (v1's FSM generation maps onto v2)
+        # v0 = requester/pool engine; v1 = event-driven FSM engine
+        # (blockchain/v1.py, reference blockchain/v1/reactor_fsm.go);
+        # v2 = scheduler/processor engine with batched cross-height
+        # verification (the TPU-first generation, default)
         if self.config.fastsync.version == "v0":
             from tendermint_tpu.blockchain.reactor_v0 import BlockchainReactorV0
 
             bc_cls = BlockchainReactorV0
+        elif self.config.fastsync.version == "v1":
+            from tendermint_tpu.blockchain.reactor_v1 import BlockchainReactorV1
+
+            bc_cls = BlockchainReactorV1
         else:
             bc_cls = BlockchainReactor
         self.bc_reactor = bc_cls(

