@@ -88,6 +88,14 @@ class BlockchainReactor(Reactor):
         for h in self.scheduler.remove_peer(peer.id):
             self._blocks.pop(h, None)
 
+    def _drop_unscheduled_blocks(self) -> None:
+        """Drop held blocks whose scheduler record vanished (their
+        deliverer was removed): an invalidated delivery must never be
+        processed, only re-requested."""
+        for h in list(self._blocks):
+            if h not in self.scheduler.received:
+                self._blocks.pop(h, None)
+
     # -- receive -----------------------------------------------------------
 
     async def receive(self, ch_id: int, peer: Peer, msg_bytes: bytes) -> None:
@@ -98,7 +106,13 @@ class BlockchainReactor(Reactor):
                 m.encode_msg(m.StatusResponse(self._store.height, self._store.base)),
             )
         elif isinstance(msg, m.StatusResponse):
-            self.scheduler.set_peer_range(peer.id, msg.base, msg.height)
+            err = self.scheduler.set_peer_range(peer.id, msg.base, msg.height)
+            if err is not None and self.fast_sync:
+                # descending height / base>height: peer is lying
+                # (reference setPeerRange removes + errors the peer)
+                self._drop_unscheduled_blocks()
+                if self.switch is not None:
+                    await self.switch.stop_peer_for_error(peer, err)
         elif isinstance(msg, m.BlockRequest):
             block = self._store.load_block(msg.height)
             if block is not None:
@@ -111,12 +125,23 @@ class BlockchainReactor(Reactor):
             if not self.fast_sync:
                 return
             h = msg.block.header.height
-            if self.scheduler.block_received(peer.id, h):
+            if self.scheduler.block_received(peer.id, h, size=len(msg_bytes)):
                 self._blocks[h] = msg.block
             else:
                 self.logger.debug("unsolicited block", height=h, peer=peer.id[:12])
         elif isinstance(msg, m.NoBlockResponse):
-            self.logger.debug("peer has no block", height=msg.height, peer=peer.id[:12])
+            if self.fast_sync and self.scheduler.no_block_response(peer.id, msg.height):
+                # the peer advertised a range it cannot serve (reference
+                # handleNoBlockResponse): drop its blocks + disconnect
+                self._drop_unscheduled_blocks()
+                if self.switch is not None:
+                    await self.switch.stop_peer_for_error(
+                        peer, f"claims no block for {msg.height}"
+                    )
+            else:
+                self.logger.debug(
+                    "peer has no block", height=msg.height, peer=peer.id[:12]
+                )
         else:
             raise ValueError(f"unknown blockchain message {type(msg).__name__}")
 
@@ -132,6 +157,19 @@ class BlockchainReactor(Reactor):
                         self.switch.broadcast(
                             BLOCKCHAIN_CHANNEL, m.encode_msg(m.StatusRequest())
                         )
+                    if self.fast_sync and ticks % 4 == 0:  # ~1s cadence
+                        # reference rTryPrunePeer: stale/slow peers out
+                        pruned = self.scheduler.prunable_peers()
+                        for pid in pruned:
+                            self.scheduler.remove_peer(pid)
+                        if pruned:
+                            self._drop_unscheduled_blocks()
+                        for pid in pruned:
+                            peer = self.switch.peers.get(pid)
+                            if peer is not None:
+                                await self.switch.stop_peer_for_error(
+                                    peer, "fast sync: stale or slow peer"
+                                )
                     for height, peer_id in self.scheduler.next_requests():
                         peer = self.switch.peers.get(peer_id)
                         if peer is not None:
@@ -219,9 +257,12 @@ class BlockchainReactor(Reactor):
                     "invalid block; punishing peers", height=hh, err=str(err)
                 )
                 bad = self.scheduler.processing_failed(hh)
+                self._blocks.pop(hh, None)
+                self._blocks.pop(hh + 1, None)
+                # removing the deliverers invalidated EVERY block they
+                # sent, not just the failing pair
+                self._drop_unscheduled_blocks()
                 for pid in bad:
-                    self._blocks.pop(hh, None)
-                    self._blocks.pop(hh + 1, None)
                     peer = self.switch.peers.get(pid) if self.switch else None
                     if peer is not None:
                         await self.switch.stop_peer_for_error(

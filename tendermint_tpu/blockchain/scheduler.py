@@ -6,7 +6,22 @@ requests within a lookahead window, tracks pending/received per height,
 reassigns on peer loss/timeout, and reports when we're caught up. All
 methods are synchronous and side-effect free outside `self` — the payoff
 is table-driven unit tests with no network (scheduler_test.go:2223
-lines in the reference).
+lines in the reference; tests/test_scheduler_table.py mirrors that
+style here).
+
+Reference-parity corner semantics (each pinned by a table test):
+- a peer REPORTING A LOWER HEIGHT than before is removed and its work
+  rescheduled (scheduler.go setPeerRange :285 — "cannot move peer
+  height lower");
+- base > height is rejected without mutating the peer;
+- NoBlockResponse for an advertised height removes the peer
+  (handleNoBlockResponse :537);
+- removing a peer invalidates its RECEIVED-but-unprocessed blocks too,
+  not just its in-flight requests (removePeer :222 resets both to
+  blockStateNew — a bad peer's delivered blocks cannot be trusted);
+- peers go stale: no touch (status/block) within peer_timeout_s makes
+  them prunable (prunablePeers :335), as does a last-response rate
+  below min_recv_rate while requests are pending.
 """
 
 from __future__ import annotations
@@ -26,6 +41,8 @@ class _Peer:
     base: int = 0
     height: int = 0  # latest height the peer claims
     pending: Set[int] = field(default_factory=set)
+    last_touch: float = 0.0
+    last_rate: float = 0.0  # bytes/s of the last block response
 
 
 class Scheduler:
@@ -35,42 +52,95 @@ class Scheduler:
         max_pending_per_peer: int = 10,
         lookahead: int = 200,
         request_timeout_s: float = 15.0,
+        peer_timeout_s: float = 15.0,
+        min_recv_rate: float = 0.0,  # bytes/s; 0 disables the rate prune
     ):
         # next height not yet processed (blocks below are applied)
         self.height = initial_height
         self.max_pending_per_peer = max_pending_per_peer
         self.lookahead = lookahead
         self.request_timeout_s = request_timeout_s
+        self.peer_timeout_s = peer_timeout_s
+        self.min_recv_rate = min_recv_rate
         self.peers: Dict[str, _Peer] = {}
         self.pending: Dict[int, Tuple[str, float]] = {}  # height → (peer, t)
         self.received: Dict[int, str] = {}  # height → peer holding the block
 
     # -- peer events -------------------------------------------------------
 
-    def add_peer(self, peer_id: str) -> None:
+    def add_peer(self, peer_id: str, now: Optional[float] = None) -> None:
         if peer_id not in self.peers:
-            self.peers[peer_id] = _Peer(peer_id)
+            self.peers[peer_id] = _Peer(
+                peer_id, last_touch=time.monotonic() if now is None else now
+            )
 
-    def set_peer_range(self, peer_id: str, base: int, height: int) -> None:
-        """StatusResponse from a peer (reference setPeerRange)."""
+    def set_peer_range(
+        self, peer_id: str, base: int, height: int, now: Optional[float] = None
+    ) -> Optional[str]:
+        """StatusResponse from a peer (reference setPeerRange :285).
+        Returns an error string when the report is malicious/invalid —
+        a DESCENDING height removes the peer (its work is rescheduled
+        internally; the caller should disconnect it)."""
+        now = time.monotonic() if now is None else now
         p = self.peers.get(peer_id)
         if p is None or p.state != PEER_STATE_READY:
-            self.add_peer(peer_id)
+            self.add_peer(peer_id, now=now)
             p = self.peers[peer_id]
+        if base > height:
+            return f"peer {peer_id} reports base {base} > height {height}"
         if height < p.height:
-            return  # peers never shrink; ignore stale
+            self.remove_peer(peer_id)
+            return f"peer {peer_id} height descending: {p.height} -> {height}"
         p.base, p.height = base, height
+        p.last_touch = now
+        return None
 
     def remove_peer(self, peer_id: str) -> List[int]:
-        """Peer gone: return heights that must be re-requested."""
+        """Peer gone: return heights that must be re-requested — BOTH
+        its in-flight requests and its received-but-unprocessed blocks
+        (reference removePeer :222: a removed peer's deliveries reset to
+        blockStateNew; they cannot be trusted)."""
         p = self.peers.pop(peer_id, None)
         if p is None:
             return []
         lost = [h for h, (pid, _) in self.pending.items() if pid == peer_id]
         for h in lost:
             del self.pending[h]
-        # received blocks from this peer are kept (already validated shape)
-        return sorted(lost)
+        delivered = [h for h, pid in self.received.items() if pid == peer_id]
+        for h in delivered:
+            del self.received[h]
+        return sorted(lost + delivered)
+
+    def no_block_response(self, peer_id: str, height: int) -> bool:
+        """Peer claims not to have a block it advertised (reference
+        handleNoBlockResponse :537): remove it. Returns True when the
+        peer existed (caller should report/disconnect)."""
+        if peer_id not in self.peers:
+            return False
+        self.remove_peer(peer_id)
+        return True
+
+    def touch_peer(self, peer_id: str, now: Optional[float] = None) -> None:
+        p = self.peers.get(peer_id)
+        if p is not None:
+            p.last_touch = time.monotonic() if now is None else now
+
+    def prunable_peers(self, now: Optional[float] = None) -> List[str]:
+        """Peers to drop: silent past peer_timeout_s, or responding
+        slower than min_recv_rate with requests pending (reference
+        prunablePeers :335). Pure query — callers remove/report."""
+        now = time.monotonic() if now is None else now
+        out = []
+        for p in self.peers.values():
+            if now - p.last_touch > self.peer_timeout_s:
+                out.append(p.peer_id)
+            elif (
+                self.min_recv_rate > 0
+                and p.pending
+                and 0 < p.last_rate < self.min_recv_rate
+            ):
+                out.append(p.peer_id)
+        return sorted(out)
 
     # -- request scheduling ------------------------------------------------
 
@@ -126,16 +196,23 @@ class Scheduler:
 
     # -- block events ------------------------------------------------------
 
-    def block_received(self, peer_id: str, height: int) -> bool:
+    def block_received(
+        self, peer_id: str, height: int, size: int = 0, now: Optional[float] = None
+    ) -> bool:
         """Returns False if this block wasn't requested from this peer
-        (unsolicited — reference errors the peer)."""
+        (unsolicited — reference errors the peer). `size` feeds the
+        peer's response-rate estimate (reference markReceived :354)."""
+        now = time.monotonic() if now is None else now
         ent = self.pending.get(height)
         if ent is None or ent[0] != peer_id:
             return False
-        del self.pending[height]
+        pid, t_req = self.pending.pop(height)
         p = self.peers.get(peer_id)
         if p is not None:
             p.pending.discard(height)
+            p.last_touch = now
+            if size > 0 and now > t_req:
+                p.last_rate = size / (now - t_req)
         self.received[height] = peer_id
         return True
 
@@ -146,14 +223,15 @@ class Scheduler:
 
     def processing_failed(self, height: int) -> List[str]:
         """Verification failed at `height`: the peers that delivered
-        heights height and height+1 are suspect (reference: both peers
-        are errored, blocks redownloaded)."""
+        heights height and height+1 are suspect (reference
+        handleBlockProcessError :575 — both peers removed, their
+        deliveries rescheduled)."""
         bad = []
         for h in (height, height + 1):
-            pid = self.received.pop(h, None)
+            pid = self.received.get(h)
             if pid is not None:
                 bad.append(pid)
-            pend = self.pending.pop(h, None)
+            pend = self.pending.get(h)
             if pend is not None:
                 bad.append(pend[0])
         for pid in set(bad):

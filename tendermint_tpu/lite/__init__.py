@@ -1,0 +1,32 @@
+"""Deprecated lite (v1) client — parity with the reference's `lite/`.
+
+Reference: lite/dynamic_verifier.go:24 (DynamicVerifier),
+lite/base_verifier.go:19 (BaseVerifier), lite/commit.go:16 (FullCommit),
+lite/dbprovider.go:20 (DBProvider), lite/multiprovider.go:13, wired to
+the `lite` command (cmd/tendermint/commands/lite.go). Deprecated
+upstream in v0.33 in favor of lite2 — which here is `light/` (the
+bisection client with batched sequence verification). This package
+exists for component parity and for applications still pinned to the
+v1 FullCommit data model; new code should use `tendermint_tpu.light`.
+
+The one TPU-relevant difference from a transliteration: commit
+signature checks drain through ValidatorSet.verify_commit /
+verify_commit_trusting, i.e. the batched device verifier with
+per-valset cached tables — the v1 client gets the same kernel as
+everything else.
+"""
+
+from tendermint_tpu.lite.types import FullCommit  # noqa: F401
+from tendermint_tpu.lite.provider import (  # noqa: F401
+    DBProvider,
+    ErrCommitNotFound,
+    ErrUnknownValidators,
+    MultiProvider,
+    PersistentProvider,
+    Provider,
+)
+from tendermint_tpu.lite.verifier import (  # noqa: F401
+    BaseVerifier,
+    DynamicVerifier,
+    ErrUnexpectedValidators,
+)

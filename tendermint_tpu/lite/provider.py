@@ -1,0 +1,166 @@
+"""lite-v1 providers: where FullCommits come from and where trusted
+ones are kept.
+
+Reference: lite/provider.go:10 (Provider / PersistentProvider),
+lite/dbprovider.go:20 (DBProvider over a KV store),
+lite/multiprovider.go:13 (first-match composition).
+"""
+
+from __future__ import annotations
+
+import struct
+from typing import Dict, Optional, Tuple
+
+from tendermint_tpu.lite.types import FullCommit
+from tendermint_tpu.light.types import SignedHeader
+from tendermint_tpu.types.validator_set import ValidatorSet
+
+
+class LiteError(Exception):
+    pass
+
+
+class ErrCommitNotFound(LiteError):
+    """Reference lerr.ErrCommitNotFound."""
+
+
+class ErrUnknownValidators(LiteError):
+    """Reference lerr.ErrUnknownValidators."""
+
+
+class Provider:
+    """Read side (reference lite/provider.go:10)."""
+
+    def latest_full_commit(
+        self, chain_id: str, min_height: int, max_height: int
+    ) -> FullCommit:
+        """Latest FullCommit with min_height <= h <= max_height
+        (max_height 0 = unbounded). Raises ErrCommitNotFound."""
+        raise NotImplementedError
+
+    def validator_set(self, chain_id: str, height: int) -> ValidatorSet:
+        """Raises ErrUnknownValidators when absent."""
+        raise NotImplementedError
+
+
+class PersistentProvider(Provider):
+    """Write side (reference lite/provider.go:27)."""
+
+    def save_full_commit(self, fc: FullCommit) -> None:
+        raise NotImplementedError
+
+
+def _sh_key(chain_id: str, height: int) -> bytes:
+    return b"lite/" + chain_id.encode() + b"/" + struct.pack(">q", height) + b"/sh"
+
+
+def _vs_key(chain_id: str, height: int) -> bytes:
+    return b"lite/" + chain_id.encode() + b"/" + struct.pack(">q", height) + b"/vs"
+
+
+class DBProvider(PersistentProvider):
+    """KV-backed persistent provider (reference lite/dbprovider.go:20):
+    a FullCommit is stored as the signed header at h plus the valsets at
+    h and h+1 — LatestFullCommit re-assembles it (fillFullCommit)."""
+
+    def __init__(self, db):
+        self._db = db
+        # height index kept in memory for descending scans (reference
+        # uses a reverse iterator), REHYDRATED from the stored keys so a
+        # restart over the same DB keeps every trusted commit visible
+        self._heights: Dict[str, set] = {}
+        self._vals_cache: Dict[Tuple[str, int], ValidatorSet] = {}
+        for key, _ in db.prefix_iterator(b"lite/"):
+            if not key.endswith(b"/sh"):
+                continue
+            body = key[len(b"lite/") : -len(b"/sh")]
+            chain_raw, _, h_raw = body.rpartition(b"/")
+            if len(h_raw) != 8:
+                continue
+            self._heights.setdefault(chain_raw.decode(), set()).add(
+                struct.unpack(">q", h_raw)[0]
+            )
+
+    def save_full_commit(self, fc: FullCommit) -> None:
+        chain_id = fc.chain_id()
+        h = fc.height()
+        self._db.set(_sh_key(chain_id, h), fc.signed_header.encode())
+        self._db.set(_vs_key(chain_id, h), fc.validators.encode())
+        self._db.set(_vs_key(chain_id, h + 1), fc.next_validators.encode())
+        self._heights.setdefault(chain_id, set()).add(h)
+
+    def latest_full_commit(
+        self, chain_id: str, min_height: int, max_height: int
+    ) -> FullCommit:
+        if max_height == 0:
+            max_height = 1 << 62
+        heights = sorted(
+            (
+                h
+                for h in self._heights.get(chain_id, ())
+                if min_height <= h <= max_height
+            ),
+            reverse=True,
+        )
+        for h in heights:
+            raw = self._db.get(_sh_key(chain_id, h))
+            if raw is None:
+                continue
+            sh = SignedHeader.decode(raw)
+            return FullCommit(
+                signed_header=sh,
+                validators=self.validator_set(chain_id, h),
+                next_validators=self.validator_set(chain_id, h + 1),
+            )
+        raise ErrCommitNotFound(f"no commit in [{min_height}, {max_height}]")
+
+    def validator_set(self, chain_id: str, height: int) -> ValidatorSet:
+        key = (chain_id, height)
+        vs = self._vals_cache.get(key)
+        if vs is None:
+            raw = self._db.get(_vs_key(chain_id, height))
+            if raw is None:
+                raise ErrUnknownValidators(f"{chain_id}@{height}")
+            vs = ValidatorSet.decode(raw)
+            self._vals_cache[key] = vs
+        return vs
+
+
+class MultiProvider(PersistentProvider):
+    """First-match composition (reference lite/multiprovider.go:13):
+    saves go to the FIRST provider; reads fall through in order."""
+
+    def __init__(self, *providers: PersistentProvider):
+        if not providers:
+            raise ValueError("need at least one provider")
+        self._providers = list(providers)
+
+    def save_full_commit(self, fc: FullCommit) -> None:
+        self._providers[0].save_full_commit(fc)
+
+    def latest_full_commit(
+        self, chain_id: str, min_height: int, max_height: int
+    ) -> FullCommit:
+        best: Optional[FullCommit] = None
+        for p in self._providers:
+            try:
+                fc = p.latest_full_commit(chain_id, min_height, max_height)
+            except ErrCommitNotFound:
+                continue
+            if best is None or fc.height() > best.height():
+                best = fc
+            # reference returns the first provider's hit only when it
+            # reaches maxHeight; otherwise keeps looking for better
+            if best.height() == max_height:
+                break
+        if best is None:
+            raise ErrCommitNotFound(f"no commit in [{min_height}, {max_height}]")
+        return best
+
+    def validator_set(self, chain_id: str, height: int) -> ValidatorSet:
+        for p in self._providers:
+            try:
+                return p.validator_set(chain_id, height)
+            except ErrUnknownValidators:
+                continue
+        raise ErrUnknownValidators(f"{chain_id}@{height}")

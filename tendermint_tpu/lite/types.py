@@ -1,0 +1,61 @@
+"""FullCommit: the deprecated lite-v1 trust unit.
+
+Reference: lite/commit.go:16 — a SignedHeader plus the validator set
+that signed it AND the next validator set, so a verifier can follow
+valset changes height to height.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional
+
+from tendermint_tpu.light.types import SignedHeader
+from tendermint_tpu.types.validator_set import ValidatorSet
+
+
+@dataclass
+class FullCommit:
+    signed_header: SignedHeader
+    validators: ValidatorSet
+    next_validators: ValidatorSet
+
+    def height(self) -> int:
+        return self.signed_header.header.height
+
+    def chain_id(self) -> str:
+        return self.signed_header.header.chain_id
+
+    def validate_full(self, chain_id: str) -> Optional[str]:
+        """Consistency + signature validation (reference
+        FullCommit.ValidateFull lite/commit.go:36): valsets must exist
+        and match the header's hashes, the header must be basically
+        valid, and Validators must have actually signed the commit
+        (>2/3 — the batched verify_commit path)."""
+        if self.validators is None or self.validators.size() == 0:
+            return "need FullCommit.validators"
+        if self.signed_header.header.validators_hash != self.validators.hash():
+            return (
+                f"header has vhash {self.signed_header.header.validators_hash.hex()} "
+                f"but valset hash is {self.validators.hash().hex()}"
+            )
+        if self.next_validators is None or self.next_validators.size() == 0:
+            return "need FullCommit.next_validators"
+        if (
+            self.signed_header.header.next_validators_hash
+            != self.next_validators.hash()
+        ):
+            return (
+                "header has next vhash "
+                f"{self.signed_header.header.next_validators_hash.hex()} but next "
+                f"valset hash is {self.next_validators.hash().hex()}"
+            )
+        err = self.signed_header.validate_basic(chain_id)
+        if err is not None:
+            return err
+        hdr, cmt = self.signed_header.header, self.signed_header.commit
+        try:
+            self.validators.verify_commit(chain_id, cmt.block_id, hdr.height, cmt)
+        except Exception as e:
+            return str(e)
+        return None

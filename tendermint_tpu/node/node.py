@@ -185,11 +185,20 @@ class Node(Service):
         self.evidence_reactor = EvidenceReactor(self.evidence_pool)
 
         # -- p2p -----------------------------------------------------------
+        # connection filters run BEFORE the secret handshake (reference
+        # node.go:416-483 MultiplexTransportConnFilters; the duplicate-
+        # IP filter is registered iff allow_duplicate_ip is false, :425)
+        conn_filters = []
+        if not config.p2p.allow_duplicate_ip:
+            from tendermint_tpu.p2p.transport import conn_duplicate_ip_filter
+
+            conn_filters.append(conn_duplicate_ip_filter)
         self.transport = Transport(
             node_key,
             self._make_node_info,
             handshake_timeout_s=config.p2p.handshake_timeout_ms / 1000.0,
             dial_timeout_s=config.p2p.dial_timeout_ms / 1000.0,
+            conn_filters=conn_filters,
         )
         self.switch = Switch(self.transport, config=config.p2p)
 

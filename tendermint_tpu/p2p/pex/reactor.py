@@ -27,7 +27,13 @@ _T_REQUEST = 0x01
 _T_RESPONSE = 0x02
 
 ENSURE_PEERS_PERIOD_S = 30.0
-REQUEST_INTERVAL_S = 60.0  # min seconds between requests from one peer
+# Min seconds between requests from one peer. MUST be less than the
+# ensure-peers period, "otherwise we'll request peers too quickly from
+# others and they'll think we're bad!" (reference pex_reactor.go:105
+# minReceiveRequestInterval = ensurePeersPeriod / 3) — a longer window
+# here made every node kick its well-behaved peers on their second
+# scheduled request and the pex mesh never filled.
+REQUEST_INTERVAL_S = ENSURE_PEERS_PERIOD_S / 3
 MAX_MSG_ADDRS = 100
 
 
@@ -109,13 +115,22 @@ class PEXReactor(Reactor):
         kind, addrs = decode_msg(msg_bytes)
         if kind == "request":
             now = time.monotonic()
-            last = self._last_request.get(peer.id, 0.0)
-            if now - last < REQUEST_INTERVAL_S and last > 0:
+            # The first TWO requests get a free pass (reference
+            # receiveRequest pex_reactor.go:300: nil -> empty-time ->
+            # tracked): a peer's immediate add_peer-time request is not
+            # aligned to its 30s ensure schedule, so throttling from the
+            # very first request would kick honest peers at bootstrap.
+            if peer.id not in self._last_request:
+                self._last_request[peer.id] = 0.0
+            elif self._last_request[peer.id] == 0.0:
+                self._last_request[peer.id] = now
+            elif now - self._last_request[peer.id] < REQUEST_INTERVAL_S:
                 self.logger.debug("pex request too soon", peer=peer.id[:12])
                 if self.switch is not None:
                     await self.switch.stop_peer_for_error(peer, "pex request flood")
                 return
-            self._last_request[peer.id] = now
+            else:
+                self._last_request[peer.id] = now
             peer.try_send(PEX_CHANNEL, encode_response(self.book.get_selection()))
             if self.seed_mode and peer.outbound is False:
                 # seeds serve addresses then hang up (reference :500 region)

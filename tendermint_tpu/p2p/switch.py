@@ -110,16 +110,31 @@ class Switch(Service):
             _, inbound = self.num_peers()
             if inbound >= self._max_inbound:
                 self.logger.info("rejecting inbound: full", id=up.node_id[:12])
-                up.conn.close()
+                self._discard_conn(up)
                 continue
             try:
                 await self._add_peer(up)
+            except ValueError:
+                pass  # duplicate peer: _add_peer already discarded it
             except Exception as e:
                 self.logger.error("failed to add inbound peer", err=str(e))
-                up.conn.close()
+                adopted = self.peers.get(up.node_id)
+                if adopted is not None:  # failed after adoption: full stop
+                    await self.stop_peer_for_error(adopted, f"init failed: {e}")
+                else:
+                    self._discard_conn(up)
+
+    def _discard_conn(self, up: UpgradedConn) -> None:
+        """Close a never-adopted connection, releasing its IP slot."""
+        if up.ip_registered:
+            self.transport.unregister_conn_ip(up.remote_addr[0])
+            up.ip_registered = False
+        up.conn.close()
 
     async def _add_peer(self, up: UpgradedConn) -> Peer:
         if up.node_id in self.peers:
+            if up.ip_registered:
+                self.transport.unregister_conn_ip(up.remote_addr[0])
             up.conn.close()
             raise ValueError(f"duplicate peer {up.node_id[:12]}")
         cfg = self.config
@@ -136,6 +151,11 @@ class Switch(Service):
             await reactor.init_peer(peer)
         peer.start()
         self.peers[peer.id] = peer
+        # live-IP registry feeds the transport's duplicate-IP ConnFilter;
+        # inbound conns were registered at filter time by the transport
+        if not up.ip_registered:
+            self.transport.register_conn_ip(up.remote_addr[0])
+            up.ip_registered = True
         for reactor in self.reactors.values():
             await reactor.add_peer(peer)
         self.logger.info("added peer", peer=repr(peer), total=len(self.peers))
@@ -171,7 +191,8 @@ class Switch(Service):
         await self._stop_and_remove_peer(peer, "graceful stop")
 
     async def _stop_and_remove_peer(self, peer: Peer, reason: str) -> None:
-        self.peers.pop(peer.id, None)
+        if self.peers.pop(peer.id, None) is not None:
+            self.transport.unregister_conn_ip(peer.socket_addr().host)
         await peer.stop()
         for reactor in self.reactors.values():
             await reactor.remove_peer(peer, reason)

@@ -9,7 +9,7 @@ from __future__ import annotations
 
 import asyncio
 from dataclasses import dataclass
-from typing import Callable, Optional, Tuple
+from typing import Awaitable, Callable, List, Optional, Tuple
 
 from tendermint_tpu.p2p.conn.secret_connection import SecretConnection
 from tendermint_tpu.p2p.key import NodeKey, node_id_from_pubkey
@@ -26,6 +26,29 @@ class ErrRejected(TransportError):
     """Peer rejected during handshake (id mismatch, incompatible, filtered)."""
 
 
+class ErrFiltered(ErrRejected):
+    """Connection rejected by a ConnFilter (reference ErrFiltered)."""
+
+
+class ErrFilterTimeout(ErrRejected):
+    """A ConnFilter exceeded filter_timeout_s (reference ErrFilterTimeout)."""
+
+
+# ConnFilter: async (transport, remote (host, port)) -> None, raising
+# ErrRejected/ErrFiltered to refuse the connection BEFORE the secret
+# handshake (reference p2p/transport.go ConnFilterFunc, wired at
+# node/node.go:416-483 via MultiplexTransportConnFilters).
+ConnFilter = Callable[["Transport", Tuple[str, int]], Awaitable[None]]
+
+
+async def conn_duplicate_ip_filter(transport: "Transport", remote: Tuple[str, int]) -> None:
+    """Reject a second connection from an IP we already have a live conn
+    from (reference ConnDuplicateIPFilter). Registered only when
+    config p2p.allow_duplicate_ip is false, like node.go:425."""
+    if remote[0] in transport.connected_ips():
+        raise ErrFiltered(f"duplicate ip {remote[0]}")
+
+
 @dataclass
 class UpgradedConn:
     """An authenticated, identity-checked connection ready for MConnection."""
@@ -34,6 +57,9 @@ class UpgradedConn:
     node_info: NodeInfo
     remote_addr: Tuple[str, int]
     outbound: bool
+    # True when the transport already registered this conn's IP at
+    # filter time (inbound path) — the switch must not double-count
+    ip_registered: bool = False
 
     @property
     def node_id(self) -> str:
@@ -49,16 +75,48 @@ class Transport:
         node_info_provider: Callable[[], NodeInfo],
         handshake_timeout_s: float = 20.0,
         dial_timeout_s: float = 3.0,
+        conn_filters: Optional[List[ConnFilter]] = None,
+        filter_timeout_s: float = 5.0,
         logger=None,
     ):
         self._node_key = node_key
         self._node_info_provider = node_info_provider
         self._handshake_timeout_s = handshake_timeout_s
         self._dial_timeout_s = dial_timeout_s
+        self.conn_filters: List[ConnFilter] = list(conn_filters or [])
+        self.filter_timeout_s = filter_timeout_s
         self.logger = logger or get_logger("p2p.transport")
         self._server: Optional[asyncio.base_events.Server] = None
         self._accept_queue: asyncio.Queue = asyncio.Queue(maxsize=64)
         self.listen_addr: Optional[NetAddress] = None
+        # live connection IPs for the duplicate-IP filter; the switch
+        # (which owns peer lifecycle) registers/unregisters here
+        self._conn_ips: dict = {}  # host -> refcount
+
+    # -- connection-IP registry (duplicate-IP filter support) --------------
+
+    def register_conn_ip(self, host: str) -> None:
+        self._conn_ips[host] = self._conn_ips.get(host, 0) + 1
+
+    def unregister_conn_ip(self, host: str) -> None:
+        n = self._conn_ips.get(host, 0) - 1
+        if n <= 0:
+            self._conn_ips.pop(host, None)
+        else:
+            self._conn_ips[host] = n
+
+    def connected_ips(self):
+        return set(self._conn_ips)
+
+    async def _apply_filters(self, remote: Tuple[str, int]) -> None:
+        """Run every ConnFilter with the shared timeout (reference
+        filterConn p2p/transport.go — filters run before the secret
+        handshake; a slow filter is an ErrFilterTimeout)."""
+        for f in self.conn_filters:
+            try:
+                await asyncio.wait_for(f(self, remote), self.filter_timeout_s)
+            except asyncio.TimeoutError:
+                raise ErrFilterTimeout(f"filter {getattr(f, '__name__', f)!r} timed out")
 
     # -- listening ---------------------------------------------------------
 
@@ -75,6 +133,17 @@ class Transport:
     ) -> None:
         peer_host, peer_port = writer.get_extra_info("peername")[:2]
         try:
+            await self._apply_filters((peer_host, peer_port))
+        except ErrRejected as e:
+            self.logger.debug("inbound filtered", err=str(e), host=peer_host)
+            writer.close()
+            return
+        # Register the IP BEFORE the handshake (reference filterConn's
+        # t.conns.Set): N simultaneous connections from one IP must not
+        # all slip past the duplicate-IP filter while none is registered
+        # yet. Ownership passes to the switch with ip_registered=True.
+        self.register_conn_ip(peer_host)
+        try:
             up = await asyncio.wait_for(
                 self._upgrade(reader, writer, expected_id="", outbound=False,
                               remote_addr=(peer_host, peer_port)),
@@ -82,12 +151,15 @@ class Transport:
             )
         except Exception as e:
             self.logger.debug("inbound upgrade failed", err=str(e), host=peer_host)
+            self.unregister_conn_ip(peer_host)
             writer.close()
             return
+        up.ip_registered = True
         try:
             self._accept_queue.put_nowait(up)
         except asyncio.QueueFull:
             self.logger.error("accept queue full; dropping inbound peer")
+            self.unregister_conn_ip(peer_host)
             up.conn.close()
 
     async def accept(self) -> UpgradedConn:
@@ -97,6 +169,7 @@ class Transport:
     # -- dialing -----------------------------------------------------------
 
     async def dial(self, addr: NetAddress) -> UpgradedConn:
+        await self._apply_filters((addr.host, addr.port))
         try:
             reader, writer = await asyncio.wait_for(
                 asyncio.open_connection(addr.host, addr.port), self._dial_timeout_s
@@ -152,5 +225,22 @@ class Transport:
     async def close(self) -> None:
         if self._server is not None:
             self._server.close()
-            await self._server.wait_closed()
+            # Drain queued-but-unaccepted upgraded conns: since Python
+            # 3.12 Server.wait_closed() waits for every live connection
+            # handler, and an unclaimed socket in the accept queue would
+            # park shutdown forever.
+            while not self._accept_queue.empty():
+                try:
+                    up = self._accept_queue.get_nowait()
+                except asyncio.QueueEmpty:  # pragma: no cover - race
+                    break
+                if up.ip_registered:
+                    self.unregister_conn_ip(up.remote_addr[0])
+                up.conn.close()
+            try:
+                await asyncio.wait_for(self._server.wait_closed(), 2.0)
+            except asyncio.TimeoutError:
+                # lingering accepted conns are owned (and closed) by the
+                # switch's peer lifecycle, not the listener
+                pass
             self._server = None

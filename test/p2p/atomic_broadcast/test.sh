@@ -1,0 +1,5 @@
+#!/usr/bin/env bash
+# Reference test/p2p/atomic_broadcast/test.sh analog; see ../driver.py.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+exec python3 driver.py atomic_broadcast

@@ -1,0 +1,6 @@
+#!/usr/bin/env bash
+# Start the local testnet and keep it running (reference
+# test/p2p/local_testnet_start.sh). Backend: TM_P2P_BACKEND=procs|docker.
+set -euo pipefail
+cd "$(dirname "$0")"
+exec python3 driver.py --keep --out "${TM_P2P_NET_DIR:-/tmp/p2p-localnet}" basic

@@ -1,0 +1,8 @@
+#!/usr/bin/env bash
+# Stop the local testnet (reference test/p2p/local_testnet_stop.sh).
+set -euo pipefail
+if [ "${TM_P2P_BACKEND:-procs}" = "docker" ]; then
+  docker compose -f "$(dirname "$0")/../../networks/local/docker-compose.yml" down -v
+else
+  pkill -f "tendermint_tpu --home ${TM_P2P_NET_DIR:-/tmp/p2p-localnet}" || true
+fi

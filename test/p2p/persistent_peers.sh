@@ -1,0 +1,6 @@
+#!/usr/bin/env bash
+# Print the persistent_peers line for a generated testnet dir
+# (reference test/p2p/persistent_peers.sh).
+set -euo pipefail
+NET_DIR="${1:-/tmp/p2p-localnet}"
+grep -h '^persistent_peers' "$NET_DIR"/node0/config/config.toml

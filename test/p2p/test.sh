@@ -1,0 +1,5 @@
+#!/usr/bin/env bash
+# Run every p2p scenario (reference test/p2p/test.sh).
+set -euo pipefail
+cd "$(dirname "$0")"
+exec python3 driver.py all

@@ -1,0 +1,193 @@
+"""Deprecated lite-v1 client (tendermint_tpu/lite/).
+
+Reference: lite/base_verifier_test.go, lite/dynamic_verifier_test.go —
+fixed-valset verification, auto-update across validator-set changes,
+and divide-and-conquer bisection when a single 2/3 jump is impossible.
+"""
+
+import pytest
+
+from tendermint_tpu.db import MemDB
+from tendermint_tpu.lite import (
+    BaseVerifier,
+    DBProvider,
+    DynamicVerifier,
+    ErrCommitNotFound,
+    ErrUnexpectedValidators,
+    FullCommit,
+    MultiProvider,
+)
+from tendermint_tpu.lite.verifier import LiteVerifyError
+from tests.light_helpers import CHAIN_ID, gen_chain, keys, valset
+
+
+def build_source(n_heights, key_changes=None):
+    """In-memory source provider holding FullCommits for 1..n-1."""
+    headers, valsets = gen_chain(n_heights, key_changes=key_changes)
+    db = DBProvider(MemDB())
+    for h in range(1, n_heights):
+        db.save_full_commit(
+            FullCommit(
+                signed_header=headers[h],
+                validators=valsets[h],
+                next_validators=valsets[h + 1],
+            )
+        )
+    return db, headers, valsets
+
+
+def seeded_trusted(source, h=1):
+    t = DBProvider(MemDB())
+    t.save_full_commit(source.latest_full_commit(CHAIN_ID, h, h))
+    return t
+
+
+# -- BaseVerifier -----------------------------------------------------------
+
+
+def test_base_verifier_accepts_matching_header():
+    source, headers, valsets = build_source(4)
+    bv = BaseVerifier(CHAIN_ID, 2, valsets[2])
+    bv.verify(headers[2])
+
+
+def test_base_verifier_rejects_wrong_chain_older_height_wrong_valset():
+    source, headers, valsets = build_source(4)
+    bv = BaseVerifier(CHAIN_ID, 2, valsets[2])
+    with pytest.raises(LiteVerifyError):
+        bv.verify(headers[1])  # older than bv.height
+    other = valset(keys(3, tag="other"))
+    bv2 = BaseVerifier(CHAIN_ID, 1, other)
+    with pytest.raises(ErrUnexpectedValidators):
+        bv2.verify(headers[1])
+
+
+def test_base_verifier_rejects_corrupted_commit():
+    source, headers, valsets = build_source(4)
+    sh = headers[2]
+    cs = sh.commit.signatures[0]
+    cs.signature = cs.signature[:10] + bytes([cs.signature[10] ^ 1]) + cs.signature[11:]
+    bv = BaseVerifier(CHAIN_ID, 2, valsets[2])
+    with pytest.raises(Exception):
+        bv.verify(sh)
+
+
+# -- FullCommit --------------------------------------------------------------
+
+
+def test_full_commit_validate_full_checks_hashes_and_sigs():
+    source, headers, valsets = build_source(4)
+    fc = source.latest_full_commit(CHAIN_ID, 2, 2)
+    assert fc.validate_full(CHAIN_ID) is None
+    wrong = FullCommit(fc.signed_header, valsets[2], valsets[2])
+    # next_validators hash mismatches the header unless the set is static,
+    # so corrupt the VALIDATORS field instead for a deterministic failure
+    bad = FullCommit(fc.signed_header, valset(keys(2, tag="x")), fc.next_validators)
+    assert bad.validate_full(CHAIN_ID) is not None
+
+
+# -- providers ----------------------------------------------------------------
+
+
+def test_db_provider_range_and_missing():
+    source, headers, valsets = build_source(6)
+    fc = source.latest_full_commit(CHAIN_ID, 1, 3)
+    assert fc.height() == 3
+    fc = source.latest_full_commit(CHAIN_ID, 1, 0)  # 0 = unbounded
+    assert fc.height() == 5
+    with pytest.raises(ErrCommitNotFound):
+        source.latest_full_commit(CHAIN_ID, 50, 60)
+
+
+def test_multi_provider_fallthrough():
+    source, headers, valsets = build_source(5)
+    empty = DBProvider(MemDB())
+    multi = MultiProvider(empty, source)
+    assert multi.latest_full_commit(CHAIN_ID, 1, 0).height() == 4
+    # saves land in the FIRST provider
+    multi.save_full_commit(source.latest_full_commit(CHAIN_ID, 2, 2))
+    assert empty.latest_full_commit(CHAIN_ID, 1, 0).height() == 2
+
+
+# -- DynamicVerifier ----------------------------------------------------------
+
+
+def test_dynamic_sequential_verification():
+    source, headers, valsets = build_source(6)
+    trusted = seeded_trusted(source)
+    dv = DynamicVerifier(CHAIN_ID, trusted, source)
+    for h in range(2, 5):
+        dv.verify(headers[h])
+    assert dv.last_trusted_height() >= 4
+
+
+def test_dynamic_follows_valset_change():
+    new_keys = keys(4, tag="gen2")
+    source, headers, valsets = build_source(8, key_changes={4: new_keys})
+    trusted = seeded_trusted(source)
+    dv = DynamicVerifier(CHAIN_ID, trusted, source)
+    for h in range(2, 7):
+        dv.verify(headers[h])
+    assert valsets[5].hash() == valset(new_keys).hash()
+
+
+def test_dynamic_jump_with_bisection():
+    """A TOTAL valset change mid-chain makes the direct 2/3 jump
+    impossible; updateToHeight must bisect through the change."""
+    gen2 = keys(4, tag="bisect-gen2")
+    source, headers, valsets = build_source(30, key_changes={15: gen2})
+    trusted = seeded_trusted(source)
+    dv = DynamicVerifier(CHAIN_ID, trusted, source)
+    dv.verify(headers[25])  # jump straight from 1 to 25
+    assert dv.last_trusted_height() >= 24
+
+
+def test_dynamic_rejects_header_not_matching_updated_valset():
+    source, headers, valsets = build_source(8)
+    other_chain_headers, _ = gen_chain(8, base_keys=keys(4, tag="imposter"))
+    trusted = seeded_trusted(source)
+    dv = DynamicVerifier(CHAIN_ID, trusted, source)
+    with pytest.raises(Exception):
+        dv.verify(other_chain_headers[3])
+
+
+def test_db_provider_rehydrates_after_restart():
+    """The height index must be rebuilt from the stored keys: a restart
+    over the same DB keeps every trusted commit visible."""
+    db = MemDB()
+    p1 = DBProvider(db)
+    source, headers, valsets = build_source(5)
+    for h in (1, 2, 3):
+        p1.save_full_commit(source.latest_full_commit(CHAIN_ID, h, h))
+    p2 = DBProvider(db)  # fresh provider, same DB = process restart
+    assert p2.latest_full_commit(CHAIN_ID, 1, 0).height() == 3
+    assert p2.latest_full_commit(CHAIN_ID, 1, 2).height() == 2
+
+
+def test_dynamic_malicious_source_raises_not_hangs():
+    """A source serving a forged chain (internally consistent but signed
+    by the wrong validators) must make updateToHeight RAISE — bisection
+    without progress must never loop forever."""
+    source, headers, valsets = build_source(20)
+    forged_source, forged_headers, _ = build_source(
+        20, key_changes=None
+    )
+    # forge: replace the source with a chain signed by imposter keys
+    forged = DBProvider(MemDB())
+    f_headers, f_valsets = gen_chain(20, base_keys=keys(4, tag="forger"))
+    for h in range(1, 20):
+        forged.save_full_commit(
+            FullCommit(f_headers[h], f_valsets[h], f_valsets[h + 1])
+        )
+    trusted = seeded_trusted(source)  # trust the REAL chain's height 1
+    dv = DynamicVerifier(CHAIN_ID, trusted, forged)
+    with pytest.raises(Exception):
+        dv._update_to_height(15)
+
+
+def test_dynamic_cached_height_short_circuits():
+    source, headers, valsets = build_source(5)
+    trusted = seeded_trusted(source)
+    dv = DynamicVerifier(CHAIN_ID, trusted, source)
+    dv.verify(headers[2])
+    dv.verify(headers[2])  # second call hits the trusted cache

@@ -1,0 +1,422 @@
+"""Table-driven scheduler tests (v2 engine), reference-style.
+
+Mirrors blockchain/v2/scheduler_test.go (2,223 lines of pure-FSM table
+rows) against blockchain/scheduler.py: every adversarial corner — peer
+lies about its range, duplicate/unsolicited/late blocks, timeout vs
+receive races, peer removal mid-request, stale/slow pruning — as an
+explicit-time scenario with no network.
+"""
+
+import pytest
+
+from tendermint_tpu.blockchain.scheduler import Scheduler
+
+
+def sched(h=1, **kw):
+    kw.setdefault("max_pending_per_peer", 4)
+    kw.setdefault("lookahead", 50)
+    kw.setdefault("request_timeout_s", 10.0)
+    kw.setdefault("peer_timeout_s", 15.0)
+    return Scheduler(initial_height=h, **kw)
+
+
+def ready(s, *peers, now=0.0):
+    for pid, base, height in peers:
+        s.add_peer(pid, now=now)
+        assert s.set_peer_range(pid, base, height, now=now) is None
+
+
+# -- peer admission / status rows -------------------------------------------
+
+
+def row_add_peer_idempotent():
+    s = sched()
+    s.add_peer("a", now=0.0)
+    s.add_peer("a", now=5.0)
+    assert len(s.peers) == 1 and s.peers["a"].last_touch == 0.0
+
+
+def row_status_sets_range_and_touch():
+    s = sched()
+    ready(s, ("a", 2, 9))
+    p = s.peers["a"]
+    assert (p.base, p.height) == (2, 9) and s.max_peer_height() == 9
+
+
+def row_status_from_unknown_peer_adds_it():
+    s = sched()
+    assert s.set_peer_range("new", 0, 7, now=0.0) is None
+    assert "new" in s.peers and s.max_peer_height() == 7
+
+
+def row_peer_raises_height_ok():
+    s = sched()
+    ready(s, ("a", 0, 5))
+    assert s.set_peer_range("a", 0, 9, now=1.0) is None
+    assert s.peers["a"].height == 9
+
+
+def row_peer_lowers_height_removed_and_errored():
+    s = sched()
+    ready(s, ("a", 0, 9))
+    reqs = dict(s.next_requests(now=0.1))
+    err = s.set_peer_range("a", 0, 5, now=1.0)
+    assert err is not None and "descending" in err
+    assert "a" not in s.peers
+    assert not s.pending, "in-flight work not rescheduled"
+    assert reqs  # it had work assigned before lying
+
+
+def row_peer_base_above_height_rejected_without_mutation():
+    s = sched()
+    ready(s, ("a", 0, 9))
+    err = s.set_peer_range("a", 12, 10, now=1.0)
+    assert err is not None and "base" in err
+    assert "a" in s.peers and s.peers["a"].height == 9  # untouched
+
+
+def row_max_height_drops_when_tallest_leaves():
+    s = sched()
+    ready(s, ("tall", 0, 100), ("short", 0, 6))
+    s.remove_peer("tall")
+    assert s.max_peer_height() == 6
+
+
+# -- request assignment rows -------------------------------------------------
+
+
+def row_requests_within_base_and_height():
+    s = sched()
+    ready(s, ("a", 3, 6), ("b", 1, 10))
+    for h, pid in s.next_requests(now=0.1):
+        base, height = {"a": (3, 6), "b": (1, 10)}[pid]
+        assert base <= h <= height
+
+
+def row_requests_respect_pending_cap():
+    s = sched()
+    ready(s, ("a", 1, 40))
+    reqs = s.next_requests(now=0.1)
+    assert len(reqs) == 4  # max_pending_per_peer
+    assert len(s.peers["a"].pending) == 4
+
+
+def row_requests_prefer_least_loaded_peer():
+    s = sched()
+    ready(s, ("a", 1, 40), ("b", 1, 40))
+    reqs = s.next_requests(now=0.1)
+    by = {}
+    for h, pid in reqs:
+        by[pid] = by.get(pid, 0) + 1
+    assert by.get("a", 0) == 4 and by.get("b", 0) == 4
+
+
+def row_requests_bounded_by_lookahead():
+    s = sched(lookahead=3)
+    ready(s, *[(f"p{i}", 1, 1000) for i in range(8)])
+    reqs = s.next_requests(now=0.1)
+    assert max(h for h, _ in reqs) <= s.height + 3
+
+
+def row_no_requests_without_peers():
+    s = sched()
+    assert s.next_requests(now=0.1) == []
+
+
+def row_no_duplicate_requests_for_pending_height():
+    s = sched()
+    ready(s, ("a", 1, 8))
+    first = s.next_requests(now=0.1)
+    again = s.next_requests(now=0.2)
+    assert not set(h for h, _ in first) & set(h for h, _ in again)
+
+
+def row_gap_heights_reassigned_after_peer_loss():
+    # cap 8 so the surviving peer has headroom to absorb the orphans
+    s = sched(max_pending_per_peer=8)
+    ready(s, ("a", 1, 8), ("b", 1, 8))
+    reqs = dict(s.next_requests(now=0.1))
+    lost = s.remove_peer("a")
+    assert sorted(lost) == sorted(h for h, p in reqs.items() if p == "a")
+    re = dict(s.next_requests(now=0.2))
+    assert set(lost) <= set(re)
+    assert all(p == "b" for p in re.values())
+
+
+# -- block receive rows -------------------------------------------------------
+
+
+def row_receive_requested_block_ok():
+    s = sched()
+    ready(s, ("a", 1, 8))
+    h, pid = s.next_requests(now=0.1)[0]
+    assert s.block_received(pid, h, size=500, now=0.5)
+    assert s.received[h] == pid and h not in s.pending
+
+
+def row_receive_unrequested_height_rejected():
+    s = sched()
+    ready(s, ("a", 1, 8))
+    s.next_requests(now=0.1)
+    assert not s.block_received("a", 999)
+
+
+def row_receive_from_wrong_peer_rejected():
+    s = sched()
+    ready(s, ("a", 1, 8), ("b", 1, 8))
+    reqs = dict(s.next_requests(now=0.1))
+    h = next(iter(reqs))
+    owner = reqs[h]
+    other = "b" if owner == "a" else "a"
+    assert not s.block_received(other, h)
+    assert h in s.pending  # still expected from the owner
+
+
+def row_receive_duplicate_rejected():
+    s = sched()
+    ready(s, ("a", 1, 8))
+    h, pid = s.next_requests(now=0.1)[0]
+    assert s.block_received(pid, h)
+    assert not s.block_received(pid, h), "duplicate accepted"
+
+
+def row_receive_from_unknown_peer_rejected():
+    s = sched()
+    ready(s, ("a", 1, 8))
+    h, _ = s.next_requests(now=0.1)[0]
+    assert not s.block_received("stranger", h)
+
+
+def row_receive_updates_rate():
+    s = sched()
+    ready(s, ("a", 1, 8))
+    h, pid = s.next_requests(now=0.0)[0]
+    s.block_received(pid, h, size=10_000, now=2.0)
+    assert s.peers["a"].last_rate == pytest.approx(5_000.0)
+
+
+# -- timeout vs receive races -------------------------------------------------
+
+
+def row_timeout_expires_stale_request():
+    s = sched(request_timeout_s=5.0)
+    ready(s, ("a", 1, 8))
+    h, _ = s.next_requests(now=0.0)[0]
+    s.next_requests(now=6.0)  # triggers expiry sweep
+    # the height is reassigned (possibly to the same peer) with a fresh clock
+    assert h in s.pending and s.pending[h][1] == 6.0
+
+
+def row_block_arriving_after_timeout_rejected():
+    s = sched(request_timeout_s=5.0)
+    ready(s, ("a", 1, 2), ("b", 1, 2))
+    reqs = dict(s.next_requests(now=0.0))
+    h = 1
+    first_owner = reqs[h]
+    # expire, reassign to the other peer
+    s.peers[first_owner].pending.clear()
+    s.pending.pop(h)
+    s.pending[h] = ("b" if first_owner == "a" else "a", 6.0)
+    late_ok = s.block_received(first_owner, h, now=7.0)
+    assert not late_ok, "late block from timed-out assignment accepted"
+
+
+def row_block_arriving_just_before_timeout_accepted():
+    s = sched(request_timeout_s=5.0)
+    ready(s, ("a", 1, 8))
+    h, pid = s.next_requests(now=0.0)[0]
+    assert s.block_received(pid, h, now=4.9)
+    s.next_requests(now=5.1)  # sweep AFTER receive: nothing to expire
+    assert h in s.received
+
+
+def row_timeout_does_not_touch_received_blocks():
+    s = sched(request_timeout_s=5.0)
+    ready(s, ("a", 1, 8))
+    reqs = s.next_requests(now=0.0)
+    h0, p0 = reqs[0]
+    s.block_received(p0, h0, now=1.0)
+    s.next_requests(now=20.0)
+    assert h0 in s.received
+
+
+# -- processing rows ----------------------------------------------------------
+
+
+def row_processed_advances_height():
+    s = sched()
+    ready(s, ("a", 1, 3))
+    for h, pid in s.next_requests(now=0.1):
+        s.block_received(pid, h)
+    s.block_processed(1)
+    assert s.height == 2 and 1 not in s.received
+
+
+def row_processing_failure_removes_both_deliverers():
+    s = sched()
+    ready(s, ("a", 1, 1), ("b", 2, 2), ("c", 1, 2))
+    reqs = dict(s.next_requests(now=0.1))
+    d1, d2 = reqs[1], reqs[2]
+    s.block_received(d1, 1)
+    s.block_received(d2, 2)
+    bad = s.processing_failed(1)
+    assert set(bad) == {d1, d2}
+    assert d1 not in s.peers and d2 not in s.peers
+    assert 1 not in s.received and 2 not in s.received
+
+
+def row_processing_failure_same_peer_reported_once():
+    s = sched()
+    ready(s, ("a", 1, 9))
+    for h, pid in s.next_requests(now=0.1):
+        s.block_received(pid, h)
+    bad = s.processing_failed(1)
+    assert bad == ["a"]
+
+
+def row_processing_failure_invalidate_includes_pending_second():
+    s = sched()
+    ready(s, ("a", 1, 1), ("b", 2, 2))
+    reqs = dict(s.next_requests(now=0.1))
+    s.block_received(reqs[1], 1)  # second still pending with b
+    bad = s.processing_failed(1)
+    assert set(bad) == {reqs[1], reqs[2]}
+    assert 2 not in s.pending
+
+
+def row_remove_peer_invalidates_its_received_blocks():
+    s = sched()
+    ready(s, ("a", 1, 8))
+    for h, pid in s.next_requests(now=0.1):
+        s.block_received(pid, h)
+    lost = s.remove_peer("a")
+    assert s.received == {}, "removed peer's deliveries kept"
+    assert lost  # every delivery rescheduled
+
+
+# -- no-block / pruning rows --------------------------------------------------
+
+
+def row_no_block_response_removes_advertiser():
+    s = sched()
+    ready(s, ("a", 1, 8))
+    s.next_requests(now=0.1)
+    assert s.no_block_response("a", 3)
+    assert "a" not in s.peers and not s.pending
+
+
+def row_no_block_response_from_unknown_ignored():
+    s = sched()
+    assert not s.no_block_response("ghost", 3)
+
+
+def row_silent_peer_becomes_prunable():
+    s = sched(peer_timeout_s=15.0)
+    ready(s, ("a", 1, 8), now=0.0)
+    assert s.prunable_peers(now=10.0) == []
+    assert s.prunable_peers(now=16.0) == ["a"]
+
+
+def row_touch_defers_pruning():
+    s = sched(peer_timeout_s=15.0)
+    ready(s, ("a", 1, 8), now=0.0)
+    s.touch_peer("a", now=14.0)
+    assert s.prunable_peers(now=20.0) == []
+    assert s.prunable_peers(now=29.5) == ["a"]
+
+
+def row_slow_peer_prunable_only_with_pending():
+    s = sched(min_recv_rate=1000.0)
+    ready(s, ("a", 1, 8), now=0.0)
+    h, pid = s.next_requests(now=0.0)[0]
+    s.block_received(pid, h, size=10, now=1.0)  # 10 B/s << 1000
+    assert s.prunable_peers(now=1.0) == ["a"]  # more requests pending
+    # drain every pending request: no longer prunable for slowness
+    for hh in list(s.pending):
+        s.block_received(s.pending[hh][0], hh, size=10_000_000, now=2.0)
+    assert s.prunable_peers(now=2.0) == []
+
+
+def row_fast_peer_not_prunable():
+    s = sched(min_recv_rate=1000.0)
+    ready(s, ("a", 1, 8), now=0.0)
+    h, pid = s.next_requests(now=0.0)[0]
+    s.block_received(pid, h, size=1_000_000, now=1.0)
+    assert s.prunable_peers(now=1.0) == []
+
+
+# -- caught-up rows -----------------------------------------------------------
+
+
+def row_caught_up_needs_a_peer():
+    s = sched(h=5)
+    assert not s.is_caught_up()
+
+
+def row_caught_up_at_max_peer_height():
+    s = sched(h=5)
+    ready(s, ("a", 1, 5))
+    assert s.is_caught_up()
+    s.set_peer_range("a", 1, 9, now=1.0)
+    assert not s.is_caught_up()
+
+
+def row_mid_sync_height_prune_keeps_consistency():
+    # peers at mixed heights; tallest leaves mid-sync; remaining state
+    # must stay requestable and consistent
+    s = sched()
+    ready(s, ("tall", 1, 100), ("mid", 1, 10))
+    reqs = dict(s.next_requests(now=0.1))
+    tall_heights = [h for h, p in reqs.items() if p == "tall"]
+    s.remove_peer("tall")
+    assert all(h not in s.pending for h in tall_heights)
+    re = dict(s.next_requests(now=0.2))
+    assert all(h <= 10 for h in re)
+    assert all(p == "mid" for p in re.values())
+
+
+ROWS = [
+    row_add_peer_idempotent,
+    row_status_sets_range_and_touch,
+    row_status_from_unknown_peer_adds_it,
+    row_peer_raises_height_ok,
+    row_peer_lowers_height_removed_and_errored,
+    row_peer_base_above_height_rejected_without_mutation,
+    row_max_height_drops_when_tallest_leaves,
+    row_requests_within_base_and_height,
+    row_requests_respect_pending_cap,
+    row_requests_prefer_least_loaded_peer,
+    row_requests_bounded_by_lookahead,
+    row_no_requests_without_peers,
+    row_no_duplicate_requests_for_pending_height,
+    row_gap_heights_reassigned_after_peer_loss,
+    row_receive_requested_block_ok,
+    row_receive_unrequested_height_rejected,
+    row_receive_from_wrong_peer_rejected,
+    row_receive_duplicate_rejected,
+    row_receive_from_unknown_peer_rejected,
+    row_receive_updates_rate,
+    row_timeout_expires_stale_request,
+    row_block_arriving_after_timeout_rejected,
+    row_block_arriving_just_before_timeout_accepted,
+    row_timeout_does_not_touch_received_blocks,
+    row_processed_advances_height,
+    row_processing_failure_removes_both_deliverers,
+    row_processing_failure_same_peer_reported_once,
+    row_processing_failure_invalidate_includes_pending_second,
+    row_remove_peer_invalidates_its_received_blocks,
+    row_no_block_response_removes_advertiser,
+    row_no_block_response_from_unknown_ignored,
+    row_silent_peer_becomes_prunable,
+    row_touch_defers_pruning,
+    row_slow_peer_prunable_only_with_pending,
+    row_fast_peer_not_prunable,
+    row_caught_up_needs_a_peer,
+    row_caught_up_at_max_peer_height,
+    row_mid_sync_height_prune_keeps_consistency,
+]
+
+
+@pytest.mark.parametrize("row", ROWS, ids=lambda r: r.__name__[4:])
+def test_scheduler_table(row):
+    row()

@@ -1,0 +1,124 @@
+"""Transport connection filters (reference p2p/transport.go
+ConnFilterFunc + ConnDuplicateIPFilter, wired at node/node.go:416-483).
+
+Filters run BEFORE the secret handshake; a rejecting filter closes the
+raw socket, a slow filter is an ErrFilterTimeout.
+"""
+
+import asyncio
+
+import pytest
+
+from tendermint_tpu.p2p.transport import (
+    ErrFiltered,
+    ErrFilterTimeout,
+    Transport,
+    conn_duplicate_ip_filter,
+)
+from tendermint_tpu.p2p.key import NodeKey
+from tendermint_tpu.p2p.node_info import NodeInfo
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+def _mk_transport(i=0, **kw):
+    nk = NodeKey.generate()
+
+    def info():
+        return NodeInfo(
+            node_id=nk.id, listen_addr="tcp://127.0.0.1:0",
+            network="filter-test", version="0.33.4", channels=b"\x40",
+            moniker=f"t{i}",
+        )
+
+    return Transport(nk, info, **kw)
+
+
+def test_rejecting_filter_blocks_dial_and_inbound():
+    async def go():
+        async def deny_all(t, remote):
+            raise ErrFiltered("nope")
+
+        lst = _mk_transport(0, conn_filters=[deny_all])
+        dialer = _mk_transport(1, conn_filters=[deny_all])
+        addr = await lst.listen()
+        try:
+            # outbound: the dialer's own filter refuses before connecting
+            with pytest.raises(ErrFiltered):
+                await dialer.dial(addr)
+        finally:
+            await lst.close()
+
+    run(go())
+
+
+def test_inbound_filtered_connection_is_closed():
+    async def go():
+        async def deny_all(t, remote):
+            raise ErrFiltered("inbound refused")
+
+        lst = _mk_transport(0, conn_filters=[deny_all])
+        dialer = _mk_transport(1)
+        addr = await lst.listen()
+        try:
+            # the listener drops the raw socket before any handshake, so
+            # the dialer's upgrade fails
+            with pytest.raises(Exception):
+                await asyncio.wait_for(dialer.dial(addr), 8)
+            assert lst._accept_queue.empty()
+        finally:
+            await lst.close()
+
+    run(go())
+
+
+def test_slow_filter_times_out():
+    async def go():
+        async def sleepy(t, remote):
+            await asyncio.sleep(60)
+
+        tr = _mk_transport(0, conn_filters=[sleepy], filter_timeout_s=0.2)
+        with pytest.raises(ErrFilterTimeout):
+            await tr._apply_filters(("10.0.0.1", 1))
+
+    run(go())
+
+
+def test_duplicate_ip_filter_uses_live_registry():
+    async def go():
+        tr = _mk_transport(0, conn_filters=[conn_duplicate_ip_filter])
+        await tr._apply_filters(("10.1.2.3", 5))  # unknown ip: fine
+        tr.register_conn_ip("10.1.2.3")
+        with pytest.raises(ErrFiltered):
+            await tr._apply_filters(("10.1.2.3", 6))
+        # refcounted: second registration, one unregister -> still live
+        tr.register_conn_ip("10.1.2.3")
+        tr.unregister_conn_ip("10.1.2.3")
+        with pytest.raises(ErrFiltered):
+            await tr._apply_filters(("10.1.2.3", 7))
+        tr.unregister_conn_ip("10.1.2.3")
+        await tr._apply_filters(("10.1.2.3", 8))  # gone: accepted again
+
+    run(go())
+
+
+def test_end_to_end_duplicate_ip_rejected():
+    """Two dials from the same IP: the second inbound is filtered when
+    the listener runs the duplicate-IP filter and the first connection
+    is registered (as the switch does on peer add)."""
+
+    async def go():
+        lst = _mk_transport(0, conn_filters=[conn_duplicate_ip_filter])
+        d1, d2 = _mk_transport(1), _mk_transport(2)
+        addr = await lst.listen()
+        try:
+            up1 = await d1.dial(addr)
+            lst.register_conn_ip(up1.remote_addr[0])  # switch add_peer analog
+            with pytest.raises(Exception):
+                await asyncio.wait_for(d2.dial(addr), 8)
+        finally:
+            await lst.close()
+
+    run(go())
