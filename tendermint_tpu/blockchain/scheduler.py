@@ -30,14 +30,9 @@ import time
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Set, Tuple
 
-PEER_STATE_READY = "ready"
-PEER_STATE_REMOVED = "removed"
-
-
 @dataclass
 class _Peer:
     peer_id: str
-    state: str = PEER_STATE_READY
     base: int = 0
     height: int = 0  # latest height the peer claims
     pending: Set[int] = field(default_factory=set)
@@ -83,7 +78,7 @@ class Scheduler:
         internally; the caller should disconnect it)."""
         now = time.monotonic() if now is None else now
         p = self.peers.get(peer_id)
-        if p is None or p.state != PEER_STATE_READY:
+        if p is None:
             self.add_peer(peer_id, now=now)
             p = self.peers[peer_id]
         if base > height:
@@ -174,8 +169,7 @@ class Scheduler:
         candidates = [
             p
             for p in self.peers.values()
-            if p.state == PEER_STATE_READY
-            and p.base <= height <= p.height
+            if p.base <= height <= p.height
             and len(p.pending) < self.max_pending_per_peer
         ]
         if not candidates:

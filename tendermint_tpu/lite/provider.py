@@ -74,9 +74,12 @@ class DBProvider(PersistentProvider):
             if not key.endswith(b"/sh"):
                 continue
             body = key[len(b"lite/") : -len(b"/sh")]
-            chain_raw, _, h_raw = body.rpartition(b"/")
-            if len(h_raw) != 8:
+            # FIXED-WIDTH slicing, never a '/' split: the packed height
+            # itself may contain 0x2f (e.g. height 47) and a split would
+            # silently drop it from the rehydrated index
+            if len(body) < 9 or body[-9:-8] != b"/":
                 continue
+            chain_raw, h_raw = body[:-9], body[-8:]
             self._heights.setdefault(chain_raw.decode(), set()).add(
                 struct.unpack(">q", h_raw)[0]
             )

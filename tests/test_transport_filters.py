@@ -87,19 +87,43 @@ def test_slow_filter_times_out():
 
 
 def test_duplicate_ip_filter_uses_live_registry():
+    """Contract: the connection under test registers BEFORE filters run
+    (register-then-filter closes the concurrent-stampede window), so
+    'duplicate' means a refcount above one."""
+
     async def go():
         tr = _mk_transport(0, conn_filters=[conn_duplicate_ip_filter])
-        await tr._apply_filters(("10.1.2.3", 5))  # unknown ip: fine
-        tr.register_conn_ip("10.1.2.3")
+        tr.register_conn_ip("10.1.2.3")  # the conn under test itself
+        await tr._apply_filters(("10.1.2.3", 5))  # count 1: sole conn, fine
+        tr.register_conn_ip("10.1.2.3")  # a second conn appears
         with pytest.raises(ErrFiltered):
             await tr._apply_filters(("10.1.2.3", 6))
-        # refcounted: second registration, one unregister -> still live
-        tr.register_conn_ip("10.1.2.3")
         tr.unregister_conn_ip("10.1.2.3")
-        with pytest.raises(ErrFiltered):
-            await tr._apply_filters(("10.1.2.3", 7))
+        await tr._apply_filters(("10.1.2.3", 7))  # back to one: fine
         tr.unregister_conn_ip("10.1.2.3")
-        await tr._apply_filters(("10.1.2.3", 8))  # gone: accepted again
+
+    run(go())
+
+
+def test_simultaneous_inbound_from_one_ip_only_one_survives():
+    """The stampede the register-then-filter ordering exists for: N
+    concurrent dials from one IP must not all pass the filter."""
+
+    async def go():
+        lst = _mk_transport(0, conn_filters=[conn_duplicate_ip_filter])
+        dialers = [_mk_transport(i + 1) for i in range(4)]
+        addr = await lst.listen()
+        try:
+            results = await asyncio.gather(
+                *(asyncio.wait_for(d.dial(addr), 10) for d in dialers),
+                return_exceptions=True,
+            )
+            ok = [r for r in results if not isinstance(r, Exception)]
+            assert len(ok) <= 1, f"{len(ok)} conns from one IP passed the filter"
+            # the accept queue holds at most the surviving connection
+            assert lst._accept_queue.qsize() <= 1
+        finally:
+            await lst.close()
 
     run(go())
 
