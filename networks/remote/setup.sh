@@ -1,0 +1,20 @@
+#!/usr/bin/env bash
+# Generate an N-node testnet and distribute one config dir per host
+# (reference networks/remote/ansible's config distribution, shell-thin).
+set -euo pipefail
+REPO="$(cd "$(dirname "$0")/../.." && pwd)"
+HOSTS=("$@")
+N=${#HOSTS[@]}
+[ "$N" -ge 1 ] || { echo "usage: $0 host1 [host2 ...]"; exit 1; }
+OUT=$(mktemp -d)
+python3 -m tendermint_tpu testnet --v "$N" --o "$OUT" \
+  --hostname-prefix "" --starting-ip-octet 0 2>/dev/null || \
+python3 -m tendermint_tpu testnet --v "$N" --o "$OUT"
+for i in "${!HOSTS[@]}"; do
+  h="${HOSTS[$i]}"
+  echo "-> $h (node$i)"
+  rsync -az --delete "$REPO/tendermint_tpu" "$REPO/__init__.py" "$h:~/tendermint-tpu/" 2>/dev/null || \
+    scp -r "$REPO/tendermint_tpu" "$h:~/tendermint-tpu/"
+  scp -r "$OUT/node$i" "$h:~/tmhome" >/dev/null
+done
+echo "testnet distributed from $OUT"

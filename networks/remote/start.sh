@@ -1,0 +1,6 @@
+#!/usr/bin/env bash
+set -euo pipefail
+for h in "$@"; do
+  echo "-> starting $h"
+  ssh "$h" 'cd ~/tendermint-tpu && nohup python3 -m tendermint_tpu --home ~/tmhome node > ~/tm.log 2>&1 & echo $! > ~/tm.pid'
+done

@@ -1,0 +1,9 @@
+#!/usr/bin/env bash
+set -euo pipefail
+for h in "$@"; do
+  printf "%s: " "$h"
+  ssh "$h" 'curl -s -m 3 -X POST -H "Content-Type: application/json" \
+    -d "{\"jsonrpc\":\"2.0\",\"id\":1,\"method\":\"status\",\"params\":{}}" \
+    http://127.0.0.1:26657/ | python3 -c "import json,sys; d=json.load(sys.stdin); print(d[\"result\"][\"sync_info\"][\"latest_block_height\"])"' \
+    || echo unreachable
+done

@@ -1,0 +1,6 @@
+#!/usr/bin/env bash
+set -euo pipefail
+for h in "$@"; do
+  echo "-> stopping $h"
+  ssh "$h" 'test -f ~/tm.pid && kill "$(cat ~/tm.pid)" && rm ~/tm.pid || true'
+done

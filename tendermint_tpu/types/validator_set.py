@@ -679,7 +679,27 @@ def verify_commits_batched(
     ed_all = np.concatenate([seg[6] for seg in segments])
     v = provider or get_default_provider()
     if ed_all.all():
-        ok = np.asarray(v.verify_batch(pk, mg, sg))  # ★ ONE device call, all heights
+        # When every spec checks against the SAME validator set (the
+        # fast-sync window / light-client sequential shape: the set is
+        # stable across heights), the whole cross-height batch rides
+        # the per-valset cached tables — per-window decompression and
+        # table builds are hoisted out entirely (eval 3).
+        ok = None
+        f = getattr(v, "verify_rows_cached", None)
+        if f is not None:
+            key0, all_pk0, ed0 = specs[segments[0][0]].valset.batch_cache()
+            if ed0.all() and all(
+                specs[si].valset.batch_cache()[0] == key0
+                for si, *_ in segments[1:]
+            ):
+                all_idx = np.concatenate(
+                    [np.asarray(seg[2], dtype=np.int32) for seg in segments]
+                )
+                ok = f(key0, all_pk0, all_idx, mg, sg)
+        if ok is None:
+            ok = np.asarray(v.verify_batch(pk, mg, sg))  # ★ ONE device call, all heights
+        else:
+            ok = np.asarray(ok)
     else:
         # non-ed25519 validator keys verify serially via their own type
         ok = np.zeros(len(ed_all), dtype=bool)

@@ -36,6 +36,10 @@ def _run(cache_dir: str) -> dict:
         JAX_PLATFORMS="cpu",
         JAX_COMPILATION_CACHE_DIR=cache_dir,
         JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS="0.1",
+        # isolate the layer under test: with the AOT executable cache
+        # active (models/aot_cache.py) a warm machine LOADS executables
+        # and the XLA persistent cache never gets written at all
+        TM_AOT_CACHE="0",
         PYTHONPATH=":".join(
             p
             for p in [REPO] + os.environ.get("PYTHONPATH", "").split(":")

@@ -176,6 +176,72 @@ def test_verifier_model_nonblocking_cold_returns_none():
     assert out is not None and out.all()
 
 
+def test_cross_height_batch_rides_cached_tables():
+    """verify_commits_batched over heights sharing one valset (the
+    fast-sync / light-client sequential shape) must route through the
+    per-valset cached tables and accept/reject exactly like the CPU
+    provider per height."""
+    from tendermint_tpu.crypto.batch import CPUBatchVerifier, TPUBatchVerifier
+    from tendermint_tpu.types.validator_set import (
+        CommitVerifySpec,
+        verify_commits_batched,
+    )
+    from tests.light_helpers import CHAIN_ID, gen_chain, keys, valset
+
+    headers, valsets = gen_chain(10)
+    # corrupt height 4's commit
+    cs = headers[4].commit.signatures[1]
+    cs.signature = cs.signature[:12] + bytes([cs.signature[12] ^ 2]) + cs.signature[13:]
+
+    def specs():
+        return [
+            CommitVerifySpec(
+                valsets[h], CHAIN_ID, headers[h].commit.block_id,
+                h, headers[h].commit,
+            )
+            for h in range(1, 10)
+        ]
+
+    tpu = TPUBatchVerifier(block_on_compile=True, min_device_batch=2)
+    res_tpu = verify_commits_batched(specs(), provider=tpu)
+    res_cpu = verify_commits_batched(specs(), provider=CPUBatchVerifier())
+    assert len(tpu.model._valset_tables) == 1, "cached tables not used"
+    for h, (a, b) in enumerate(zip(res_tpu, res_cpu), start=1):
+        assert (a is None) == (b is None), (h, a, b)
+    assert res_tpu[3] is not None  # height 4 rejected
+    assert sum(1 for r in res_tpu if r is None) == 8
+
+
+def test_windowed_cached_path_boundary_controls(monkeypatch):
+    """The >MAX_DEVICE_ROWS streaming path: shrink the window so CI
+    drives full windows + tail with invalid rows planted on both sides
+    of every boundary (in-repo reproduction of the 17k-row drive)."""
+    from tendermint_tpu.models import verifier as vmod
+
+    monkeypatch.setattr(vmod, "MAX_DEVICE_ROWS", 16)
+    pks, msgs, sigs = _sign_rows(16, seed=23)
+    pk16, mg16, sg16 = _arrs(pks, msgs, sigs)
+    n = 42  # 2 full windows of 16 + tail of 10
+    rng = np.random.default_rng(5)
+    idx = rng.integers(0, 16, size=n).astype(np.int32)
+    mg = mg16[idx].copy()
+    sg = sg16[idx].copy()
+    bad = [0, 15, 16, 31, 32, 41]  # straddle every window boundary
+    for b in bad:
+        sg[b, 7] ^= 0x08
+    m = vmod.VerifierModel(block_on_compile=True)
+    ok = m.verify_rows_cached(b"win-test", pk16, idx, mg, sg)
+    assert ok is not None and ok.shape == (n,)
+    want = np.ones(n, dtype=bool)
+    want[bad] = False
+    np.testing.assert_array_equal(ok, want)
+
+    # non-blocking with a cold tail bucket: nothing dispatches, the
+    # caller falls back (no wasted window work)
+    m2 = vmod.VerifierModel(block_on_compile=False)
+    assert m2.verify_rows_cached(b"win-test-2", pk16, idx, mg, sg) is None
+
+
 def test_validator_set_verify_commit_uses_cached_tables():
     """End-to-end: ValidatorSet.verify_commit through a TPU provider must
     accept/reject identically to the CPU provider, and hit the cached
