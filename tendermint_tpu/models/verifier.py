@@ -193,13 +193,13 @@ class VerifierModel:
         self._stage_fns = (s1, s2)
         return self._stage_fns
 
-    def _smap(self, f, n_in, out_specs):
+    def _smap(self, f, n_in, out_specs, in_specs=None):
         batch, _ = self._shard_specs()
         return jax.jit(
             jax.shard_map(
                 f,
                 mesh=self.mesh,
-                in_specs=(batch,) * n_in,
+                in_specs=(batch,) * n_in if in_specs is None else in_specs,
                 out_specs=out_specs,
                 check_vma=False,
             )
@@ -516,11 +516,41 @@ class VerifierModel:
             return cached
         from tendermint_tpu.models.aot_cache import AotJit
 
+        if self.mesh is None:
+            self._table_stages = (
+                AotJit(ops_ed.verify_stage_prepare_tabled, "t-prepare"),
+                AotJit(ops_ed.verify_stage_scan_tabled, "t-scan"),
+                AotJit(ops_ed.verify_stage_finish_blocked, "t-finish"),
+                AotJit(ops_ed.build_valset_tables, "t-build"),
+            )
+            return self._table_stages
+        # Mesh path: rows shard over the batch axis, the valset tables
+        # REPLICATE (each device gathers its shard's rows from a full
+        # local copy — ~12KB/validator/device; no cross-device gather).
+        # The per-device program is identical to the single-device one,
+        # so compile cost is O(1) in mesh size, like the generic stages.
+        batch, rep = self._shard_specs()
+        tag = f"mesh{tuple(self.mesh.shape.values())}"
         self._table_stages = (
-            AotJit(ops_ed.verify_stage_prepare_tabled, "t-prepare"),
-            AotJit(ops_ed.verify_stage_scan_tabled, "t-scan"),
-            AotJit(ops_ed.verify_stage_finish_blocked, "t-finish"),
-            AotJit(ops_ed.build_valset_tables, "t-build"),
+            AotJit(
+                None, f"t-prepare-{tag}",
+                jit_fn=self._smap(ops_ed.verify_stage_prepare_tabled, 3, (batch,) * 3),
+            ),
+            AotJit(
+                None, f"t-scan-{tag}",
+                jit_fn=self._smap(
+                    ops_ed.verify_stage_scan_tabled, 5, (batch,) * 5,
+                    in_specs=(batch, batch, rep, rep, batch),
+                ),
+            ),
+            AotJit(
+                None, f"t-finish-{tag}",
+                jit_fn=self._smap(ops_ed.verify_stage_finish_blocked, 7, batch),
+            ),
+            # tables build once per valset: replicated output (every
+            # device computes the full table; a sharded build would save
+            # build time but force a cross-device gather per verify)
+            AotJit(None, f"t-build-{tag}", jit_fn=jax.jit(ops_ed.build_valset_tables)),
         )
         return self._table_stages
 
@@ -530,6 +560,16 @@ class VerifierModel:
         v = pubkeys.shape[0]
         v_pad = _bucket(v, 1)
         tables, a_ok = build(jnp.asarray(self._pad(np.asarray(pubkeys, dtype=np.uint8), v_pad)))
+        if self.mesh is not None:
+            # replicate ONCE at build: the shard_map scan consumes the
+            # tables with a replicated spec, and leaving them committed
+            # to one device would re-broadcast ~12KB/validator to every
+            # device on every verify dispatch
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            rep = NamedSharding(self.mesh, PartitionSpec())
+            tables = jax.device_put(tables, rep)
+            a_ok = jax.device_put(a_ok, rep)
         tables.block_until_ready()
         e.tables, e.a_ok = tables, a_ok
         e.build_s = time.perf_counter() - t0
@@ -594,16 +634,14 @@ class VerifierModel:
         self, valset_key: bytes, all_pubkeys, row_idx, msgs, sigs
     ) -> Optional[np.ndarray]:
         """Verify rows whose pubkeys are all_pubkeys[row_idx] against the
-        per-valset cached tables. Returns (N,) bool, or None when the
-        cached path is unavailable (mesh configured, tables cold in
-        non-blocking mode, or batch too large) — callers fall back to
-        verify().
+        per-valset cached tables (single device, or a mesh: rows shard
+        over the batch axis, tables replicate). Returns (N,) bool, or
+        None when the cached path is unavailable (tables or a bucket
+        cold in non-blocking mode) — callers fall back to verify().
 
         row_idx MUST index into all_pubkeys; rows are independent, so
         duplicate indices are fine (the trusting path may produce them).
         """
-        if self.mesh is not None:
-            return None  # sharded table gather not supported yet: generic path
         n = int(len(row_idx))
         if n == 0:
             return np.zeros(0, dtype=bool)
@@ -619,7 +657,7 @@ class VerifierModel:
                 valset_key, e, all_pubkeys, row_idx, msgs, sigs
             )
         msg_len = int(msgs.shape[1])
-        n_pad = _bucket(n, 1)
+        n_pad = _bucket(n, self._pad_multiple())
         # the table's padded row count is part of the compiled shape: a
         # valset that grows past its pad bucket must re-warm, not run a
         # synchronous compile on the live path
@@ -662,13 +700,13 @@ class VerifierModel:
         self, valset_key: bytes, e: _TablesEntry, all_pubkeys, row_idx, msgs, sigs
     ) -> Optional[np.ndarray]:
         n = int(len(row_idx))
-        window = _bucket(MAX_DEVICE_ROWS, 1)
+        window = self._window_size(MAX_DEVICE_ROWS)
         msg_len = int(msgs.shape[1])
         full_end = (n // window) * window
+        tail_pad = _bucket(n - full_end, self._pad_multiple()) if full_end < n else 0
         win_ent = self._tabled_bucket_entry(e, window, msg_len)
         tail_ent = (
-            self._tabled_bucket_entry(e, _bucket(n - full_end, 1), msg_len)
-            if full_end < n else None
+            self._tabled_bucket_entry(e, tail_pad, msg_len) if tail_pad else None
         )
         if not self.block_on_compile:
             # BOTH buckets must be warm before dispatching anything:
@@ -677,7 +715,7 @@ class VerifierModel:
             # whole batch on the fallback path
             cold = [
                 (ent, pad)
-                for ent, pad in ((win_ent, window), (tail_ent, _bucket(n - full_end, 1)))
+                for ent, pad in ((win_ent, window), (tail_ent, tail_pad))
                 if ent is not None and not ent.ready
             ]
             if cold:

@@ -303,9 +303,10 @@ def _tree_select(table: jnp.ndarray, mag: jnp.ndarray) -> jnp.ndarray:
     tables — about half the VPU work of the one-hot masked sum it
     replaced (~420 vs ~960 ops/row at 60-limb entries). mag 0 selects
     entry 0; callers mask the digit-0 identity afterward."""
+    assert _TBL & (_TBL - 1) == 0, "tree select needs a power-of-two table"
     m = jnp.maximum(mag - 1, 0)  # (N,) in [0, _TBL-1]
     t = table
-    for bit in range(3):  # halve: 8 -> 4 -> 2 -> 1 entries
+    for bit in range(_TBL.bit_length() - 1):  # halve until 1 entry
         b = ((m >> bit) & 1).astype(bool)[:, None, None]
         t = jnp.where(b, t[:, 1::2], t[:, 0::2])
     return t[:, 0]

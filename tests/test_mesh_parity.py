@@ -92,6 +92,23 @@ def test_mesh_parity_uneven_batch(models):
     assert not ok_m[0] and not ok_m[n - 1] and ok_m[1 : n - 1].all()
 
 
+def test_mesh_parity_tabled_path(models):
+    """The per-valset cached-table path on a mesh (rows sharded, tables
+    replicated) must match the single-device tabled path bit-for-bit."""
+    mesh_m, single_m = models
+    n = 128
+    pk, mg, sg = _signed_batch(n, seed=14)
+    all_pk = pk[:16].copy()  # 16 distinct keys repeated: valset matrix
+    idx = (np.arange(n) % 16).astype(np.int32)
+    sg[9] = 0
+    sg[77, 3] ^= 1
+    ok_m = mesh_m.verify_rows_cached(b"mesh-valset", all_pk, idx, mg, sg)
+    ok_s = single_m.verify_rows_cached(b"mesh-valset", all_pk, idx, mg, sg)
+    assert ok_m is not None and ok_s is not None
+    np.testing.assert_array_equal(ok_m, ok_s)
+    assert not ok_m[9] and not ok_m[77] and ok_m.sum() == n - 2
+
+
 def test_mesh_parity_verify_only_path(models):
     mesh_m, single_m = models
     n = 64
