@@ -150,6 +150,10 @@ class TPUBatchVerifier(BatchVerifier):
             valset_key, all_pubkeys, row_idx, msgs, sigs
         )
 
+    def register_valset(self, valset_key, all_pubkeys) -> None:
+        """Pre-build the per-valset cached tables (node-start warmup)."""
+        self._model.register_valset(valset_key, all_pubkeys)
+
 
 _lock = threading.Lock()
 _default: Optional[BatchVerifier] = None

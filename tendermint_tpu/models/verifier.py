@@ -747,6 +747,46 @@ class VerifierModel:
             parts.append(tail)
         return np.concatenate(parts) if parts else np.zeros(0, dtype=bool)
 
+    def register_valset(self, valset_key: bytes, all_pubkeys, msg_len: int = 160) -> None:
+        """Pre-build the cached tables for a valset and warm its tabled
+        buckets (node-start path: a restarting validator's FIRST commit
+        should already ride the tabled pipeline, not wait for a lazy
+        build on the live path). Non-blocking when the model is; safe
+        to call for an already-registered set."""
+        pk = np.asarray(all_pubkeys, dtype=np.uint8)
+        if self.block_on_compile:
+            e = self._tables_entry(valset_key, pk)
+        else:
+            self._tables_entry(valset_key, pk)  # kicks the async build
+            with self._lock:
+                e = self._valset_tables.get(valset_key)
+        if e is None:
+            return
+        n_pad = _bucket(int(pk.shape[0]), self._pad_multiple())
+
+        def warm_bucket():
+            ent = self._tabled_bucket_entry(e, n_pad, msg_len)
+            if not ent.ready:
+                self._compile_tabled_async(ent, e, n_pad, msg_len)
+
+        if e.ready:
+            warm_bucket()
+            return
+
+        def warm_when_built():
+            deadline = time.monotonic() + 600
+            while time.monotonic() < deadline:
+                if e.ready:
+                    warm_bucket()
+                    return
+                if not e.building:
+                    return  # build failed (logged by _build_tables): stop polling
+                time.sleep(0.25)
+
+        t = threading.Thread(target=warm_when_built, daemon=True, name="tabled-warmup")
+        _track_compile_thread(t)
+        t.start()
+
     def _compile_tabled_async(
         self, ent: _Entry, e: _TablesEntry, n_pad: int, msg_len: int
     ) -> None:

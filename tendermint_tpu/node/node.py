@@ -306,6 +306,12 @@ class Node(Service):
         if hasattr(self.crypto_provider, "warmup"):
             n_vals = self._state_at_boot.validators.size()
             self.crypto_provider.warmup(sizes=(16, 1024, n_vals), background=True)
+        if hasattr(self.crypto_provider, "register_valset"):
+            # pre-build THIS chain's per-valset cached tables so the
+            # first live commit rides the tabled pipeline immediately
+            key, all_pk, ed = self._state_at_boot.validators.batch_cache()
+            if bool(ed.all()) and len(all_pk):
+                self.crypto_provider.register_valset(key, all_pk)
 
         if isinstance(self.priv_validator, SignerClient):
             # remote signer: listen and wait for it to dial in

@@ -176,6 +176,49 @@ def test_verifier_model_nonblocking_cold_returns_none():
     assert out is not None and out.all()
 
 
+def test_register_valset_prewarms_tabled_path():
+    """Node-start warmup: register_valset builds tables + warms the
+    valset-size bucket so the FIRST live verify uses the cached path
+    (blocking mode: immediately; non-blocking: after the background
+    build completes)."""
+    import time as _time
+
+    from tendermint_tpu.models.verifier import VerifierModel
+
+    # msg_len 160 = the commit sign-bytes width register_valset warms
+    pks, msgs, sigs = _sign_rows(12, msg_len=160, seed=19)
+    pk, mg, sg = _arrs(pks, msgs, sigs)
+    idx = np.arange(12, dtype=np.int32)
+
+    m = VerifierModel(block_on_compile=True)
+    m.register_valset(b"boot-valset", pk)
+    assert len(m._valset_tables) == 1
+    ok = m.verify_rows_cached(b"boot-valset", pk, idx, mg, sg)
+    assert ok is not None and ok.all()
+    assert len(m._valset_tables) == 1  # no rebuild
+
+    # Non-blocking: the warmup ALONE (no live traffic) must build the
+    # tables and warm the valset-size bucket — polled WITHOUT calling
+    # verify_rows_cached, which would otherwise kick the lazy build
+    # itself and mask a broken warmup.
+    m2 = VerifierModel(block_on_compile=False)
+    m2.register_valset(b"boot-valset-2", pk)
+    deadline = _time.monotonic() + 120
+    warmed = False
+    while _time.monotonic() < deadline:
+        e = m2._valset_tables.get(b"boot-valset-2")
+        if e is not None and e.ready:
+            ent = m2._entries.get(("tabled", 16, 160, int(e.tables.shape[0])))
+            if ent is not None and ent.ready:
+                warmed = True
+                break
+        _time.sleep(0.25)
+    assert warmed, "warmup alone never built tables + warmed the bucket"
+    # and the first live call is served immediately (no None fallback)
+    ok2 = m2.verify_rows_cached(b"boot-valset-2", pk, idx, mg, sg)
+    assert ok2 is not None and ok2.all()
+
+
 def test_cross_height_batch_rides_cached_tables():
     """verify_commits_batched over heights sharing one valset (the
     fast-sync / light-client sequential shape) must route through the
