@@ -117,8 +117,21 @@ def _compress(state, wh, wl):
     def round_body(carry, xs):
         words_h, words_l, st = carry
         kh, kl, idx = xs
-        wh_t = words_h[0]
-        wl_t = words_l[0]
+        # RING BUFFER schedule: the sliding 16-word window stays in
+        # place and round idx reads/writes slot idx % 16 with
+        # scalar-indexed dynamic slices. The previous formulation
+        # shifted the window with a (16, N) concatenate every round —
+        # ~32 N-wide copies per round, an order of magnitude more
+        # memory traffic than the round's ~30 ALU ops.
+        i0 = idx % 16
+
+        def at(ws, j):
+            return jax.lax.dynamic_index_in_dim(
+                ws, (idx + j) % 16, axis=0, keepdims=False
+            )
+
+        wh_t = at(words_h, 0)
+        wl_t = at(words_l, 0)
         va, vb, vc, vd, ve, vf, vg, vh = st
         s1 = _big_sigma1(*ve)
         ch = (
@@ -135,14 +148,15 @@ def _compress(state, wh, wl):
         new_e = _add2(*vd, t1h, t1l)
         new_a = _add2(t1h, t1l, t2h, t2l)
         st = (new_a, va, vb, vc, new_e, ve, vf, vg)
-        # extend schedule: w16 = ssigma1(w14) + w9 + ssigma0(w1) + w0
-        s0w = _small_sigma0(words_h[1], words_l[1])
-        s1w = _small_sigma1(words_h[14], words_l[14])
-        t = _add2(s1w[0], s1w[1], words_h[9], words_l[9])
+        # extend schedule: w16 = ssigma1(w14) + w9 + ssigma0(w1) + w0,
+        # written into the slot just consumed
+        s0w = _small_sigma0(at(words_h, 1), at(words_l, 1))
+        s1w = _small_sigma1(at(words_h, 14), at(words_l, 14))
+        t = _add2(s1w[0], s1w[1], at(words_h, 9), at(words_l, 9))
         t = _add2(*t, *s0w)
         w16h, w16l = _add2(*t, wh_t, wl_t)
-        words_h = jnp.concatenate([words_h[1:], w16h[None]], axis=0)
-        words_l = jnp.concatenate([words_l[1:], w16l[None]], axis=0)
+        words_h = jax.lax.dynamic_update_index_in_dim(words_h, w16h, i0, axis=0)
+        words_l = jax.lax.dynamic_update_index_in_dim(words_l, w16l, i0, axis=0)
         return (words_h, words_l, st), None
 
     st0 = tuple(a)
