@@ -136,7 +136,7 @@ MAX_CACHED_VALSETS = 2
 
 
 class _TablesEntry:
-    __slots__ = ("tables", "a_ok", "v", "ready", "building", "build_s")
+    __slots__ = ("tables", "a_ok", "v", "ready", "building", "failed", "build_s")
 
     def __init__(self, v: int):
         self.tables = None
@@ -144,6 +144,10 @@ class _TablesEntry:
         self.v = v
         self.ready = False
         self.building = False
+        # latched on a build failure (e.g. device OOM): the cached path
+        # stays disabled for this valset instead of retrying a
+        # deterministic failure on every verify
+        self.failed = False
         self.build_s: Optional[float] = None
 
 
@@ -600,6 +604,8 @@ class VerifierModel:
                     del self._valset_tables[old]
         if e.ready:
             return e
+        if e.failed:
+            return None  # build already failed for this valset: generic path
         if self.block_on_compile:
             with self._lock:
                 if e.building:
@@ -608,6 +614,12 @@ class VerifierModel:
             try:
                 if not e.ready:
                     self._build_tables(e, key, pubkeys)
+            except Exception as ex:
+                # the contract is None-means-fallback, never an exception
+                # escaping into commit verification
+                e.failed = True
+                self.logger.error("valset table build failed", err=repr(ex))
+                return None
             finally:
                 e.building = False
             return e
@@ -621,6 +633,7 @@ class VerifierModel:
             try:
                 self._build_tables(e, key, pk_copy)
             except Exception as ex:  # pragma: no cover - defensive
+                e.failed = True  # latch: don't retry a doomed build per verify
                 self.logger.error("valset table build failed", err=repr(ex))
             finally:
                 e.building = False
@@ -658,16 +671,10 @@ class VerifierModel:
             )
         msg_len = int(msgs.shape[1])
         n_pad = _bucket(n, self._pad_multiple())
-        # the table's padded row count is part of the compiled shape: a
-        # valset that grows past its pad bucket must re-warm, not run a
-        # synchronous compile on the live path
-        v_pad = int(e.tables.shape[0])
-        key = ("tabled", n_pad, msg_len, v_pad)
-        with self._lock:
-            ent = self._entries.get(key)
-            if ent is None:
-                ent = _Entry(None)  # stage fns are shared; entry tracks warmth
-                self._entries[key] = ent
+        # the bucket key includes the table's padded row count (see
+        # _tabled_bucket_entry): a valset that grows past its pad bucket
+        # must re-warm, not run a synchronous compile on the live path
+        ent = self._tabled_bucket_entry(e, n_pad, msg_len)
         if not ent.ready and not self.block_on_compile:
             self._compile_tabled_async(ent, e, n_pad, msg_len)
             return None

@@ -149,8 +149,15 @@ class Transport:
         self.register_conn_ip(peer_host)
         try:
             await self._apply_filters((peer_host, peer_port))
-        except ErrRejected as e:
-            self.logger.debug("inbound filtered", err=str(e), host=peer_host)
+        except Exception as e:
+            # ANY filter failure (not just a clean rejection) must
+            # release the IP slot and the socket, or a buggy
+            # user-supplied ConnFilter permanently blocks the host
+            # (reference filterConn removes the conn on any error)
+            if isinstance(e, ErrRejected):
+                self.logger.debug("inbound filtered", err=str(e), host=peer_host)
+            else:
+                self.logger.error("conn filter error", err=repr(e), host=peer_host)
             self.unregister_conn_ip(peer_host)
             writer.close()
             return
@@ -180,16 +187,18 @@ class Transport:
     # -- dialing -----------------------------------------------------------
 
     async def dial(self, addr: NetAddress) -> UpgradedConn:
-        # same register-then-filter discipline as the inbound path
+        # same register-then-filter discipline as the inbound path; ANY
+        # filter failure must release the IP slot, not just ErrRejected
         self.register_conn_ip(addr.host)
         try:
             await self._apply_filters((addr.host, addr.port))
+        except Exception:
+            self.unregister_conn_ip(addr.host)
+            raise
+        try:
             reader, writer = await asyncio.wait_for(
                 asyncio.open_connection(addr.host, addr.port), self._dial_timeout_s
             )
-        except ErrRejected:
-            self.unregister_conn_ip(addr.host)
-            raise
         except (OSError, asyncio.TimeoutError) as e:
             self.unregister_conn_ip(addr.host)
             raise TransportError(f"dial {addr}: {e}")

@@ -176,6 +176,29 @@ def test_verifier_model_nonblocking_cold_returns_none():
     assert out is not None and out.all()
 
 
+def test_failed_table_build_latches_to_generic_fallback(monkeypatch):
+    """A table build that raises (e.g. device OOM) must surface as the
+    None-fallback contract — never an exception into commit
+    verification — and must NOT be retried on every verify."""
+    from tendermint_tpu.models.verifier import VerifierModel
+
+    pks, msgs, sigs = _sign_rows(8, seed=29)
+    pk, mg, sg = _arrs(pks, msgs, sigs)
+    idx = np.arange(8, dtype=np.int32)
+
+    m = VerifierModel(block_on_compile=True)
+    calls = []
+
+    def boom(e, key, pubkeys):
+        calls.append(1)
+        raise RuntimeError("RESOURCE_EXHAUSTED (simulated)")
+
+    monkeypatch.setattr(m, "_build_tables", boom)
+    assert m.verify_rows_cached(b"doomed", pk, idx, mg, sg) is None
+    assert m.verify_rows_cached(b"doomed", pk, idx, mg, sg) is None
+    assert len(calls) == 1, "doomed build retried"
+
+
 def test_register_valset_prewarms_tabled_path():
     """Node-start warmup: register_valset builds tables + warms the
     valset-size bucket so the FIRST live verify uses the cached path
@@ -283,6 +306,40 @@ def test_windowed_cached_path_boundary_controls(monkeypatch):
     # caller falls back (no wasted window work)
     m2 = vmod.VerifierModel(block_on_compile=False)
     assert m2.verify_rows_cached(b"win-test-2", pk16, idx, mg, sg) is None
+
+
+def test_cross_height_batch_mixed_valsets_fall_back_correctly():
+    """Specs spanning DIFFERENT validator sets cannot share one table
+    cache — the batch must take the generic route and still
+    accept/reject per spec exactly like the CPU provider."""
+    from tendermint_tpu.crypto.batch import CPUBatchVerifier, TPUBatchVerifier
+    from tendermint_tpu.types.validator_set import (
+        CommitVerifySpec,
+        verify_commits_batched,
+    )
+    from tests.light_helpers import CHAIN_ID, gen_chain, keys
+
+    gen2 = keys(4, tag="mixed-gen2")
+    headers, valsets = gen_chain(8, key_changes={5: gen2})
+    cs = headers[6].commit.signatures[2]
+    cs.signature = cs.signature[:5] + bytes([cs.signature[5] ^ 1]) + cs.signature[6:]
+
+    def specs():
+        return [
+            CommitVerifySpec(
+                valsets[h], CHAIN_ID, headers[h].commit.block_id,
+                h, headers[h].commit,
+            )
+            for h in range(1, 8)
+        ]
+
+    tpu = TPUBatchVerifier(block_on_compile=True, min_device_batch=2)
+    res_tpu = verify_commits_batched(specs(), provider=tpu)
+    res_cpu = verify_commits_batched(specs(), provider=CPUBatchVerifier())
+    for h, (a, b) in enumerate(zip(res_tpu, res_cpu), start=1):
+        assert (a is None) == (b is None), (h, a, b)
+    assert res_tpu[5] is not None  # corrupted height 6 rejected
+    assert sum(1 for r in res_tpu if r is None) == 6
 
 
 def test_validator_set_verify_commit_uses_cached_tables():

@@ -128,6 +128,36 @@ def test_simultaneous_inbound_from_one_ip_only_one_survives():
     run(go())
 
 
+def test_crashing_filter_releases_ip_slot():
+    """A filter raising a NON-ErrRejected exception must still release
+    the pre-registered IP refcount on both paths, or the host is
+    permanently blocked when duplicate-IP filtering is active."""
+
+    async def go():
+        async def crashy(t, remote):
+            raise ValueError("buggy user filter")
+
+        tr = _mk_transport(0, conn_filters=[crashy])
+        with pytest.raises(ValueError):
+            await tr.dial(
+                type("A", (), {"host": "10.9.9.9", "port": 1, "id": "x" * 40})()
+            )
+        assert tr.conn_ip_count("10.9.9.9") == 0, "dial leaked the IP slot"
+
+        lst = _mk_transport(1, conn_filters=[crashy])
+        d = _mk_transport(2)
+        addr = await lst.listen()
+        try:
+            with pytest.raises(Exception):
+                await asyncio.wait_for(d.dial(addr), 8)
+            await asyncio.sleep(0.2)
+            assert lst.conn_ip_count("127.0.0.1") == 0, "inbound leaked the IP slot"
+        finally:
+            await lst.close()
+
+    run(go())
+
+
 def test_end_to_end_duplicate_ip_rejected():
     """Two dials from the same IP: the second inbound is filtered when
     the listener runs the duplicate-IP filter and the first connection
