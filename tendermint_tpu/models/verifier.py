@@ -7,6 +7,18 @@ validator set of any size hits a warm executable. Padding rows carry an
 always-invalid signature and zero voting power, so they can't affect
 results.
 
+Two verify pipelines share the buckets:
+
+- the GENERIC staged pipeline (prepare/scan/finish) for arbitrary
+  (pubkey, msg, sig) batches;
+- the per-valset CACHED-TABLE pipeline (``verify_rows_cached``):
+  validator pubkeys are stable across heights, so affine-cached split
+  tables of each key (built once per valset digest, LRU of
+  MAX_CACHED_VALSETS, device-resident) remove decompression, the
+  per-row table build, and 7/8 of the scan doublings from the
+  per-commit program. Streams past MAX_DEVICE_ROWS as in-flight
+  windows; ``register_valset`` pre-builds at node start.
+
 Two compile disciplines:
 
 - ``block_on_compile=True`` (bench/tests): the first call per bucket
@@ -14,12 +26,14 @@ Two compile disciplines:
 - ``block_on_compile=False`` (live node): a cold bucket falls back to
   the host verifier for THIS call while a background thread compiles
   the device program; subsequent calls hit the warm executable.
-  Consensus never stalls on XLA.
+  Consensus never stalls on XLA. Compiled executables persist across
+  processes via the AOT cache (models/aot_cache.py).
 
 Multi-chip: the mesh path uses ``shard_map`` so the per-device program
 is exactly the single-device program (compile cost does not scale with
 mesh size, unlike whole-graph GSPMD partitioning); the fused tally is a
-``psum`` over the batch axis riding ICI.
+``psum`` over the batch axis riding ICI; cached tables replicate across
+the mesh while rows shard.
 """
 
 from __future__ import annotations
