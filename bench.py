@@ -157,6 +157,13 @@ def _record_tpu_result(line: dict) -> None:
 _LAST_TPU_MAX_AGE_DAYS = 14
 
 
+def _last_tpu_extra() -> dict:
+    """{"last_measured_tpu": <record>} when a usable record exists, else
+    {} — merged into any emit that could not measure the device itself."""
+    last = _last_tpu_result()
+    return {} if last is None else {"last_measured_tpu": last}
+
+
 def _last_tpu_result():
     """The recorded measurement, or None when unreadable or too old to
     be meaningful (it carries measured_at + git_rev so a consumer can
@@ -217,16 +224,12 @@ def run_bench(platform: str, accelerator: bool = True):
         assert ok.all() and talled == n * 10
         p50 = sorted(times)[len(times) // 2]
         log(f"host-fallback VerifyCommit@10k p50: {p50*1e3:.1f} ms")
-        extra = {}
-        last = _last_tpu_result()
-        if last is not None:
-            extra["last_measured_tpu"] = last
         emit(
             round(p50 * 1e3, 3),
             round(baseline_10k / p50, 2),
             platform=platform,
             note="accelerator unavailable; measured the node's host fallback path",
-            **extra,
+            **_last_tpu_extra(),
         )
         _deadline_done()
         return
@@ -481,10 +484,14 @@ def _supervise() -> int:
             os.unlink(state)
         except OSError:
             pass
+    # a wedged tunnel can hang the child mid-compile AFTER the probe
+    # succeeded; the partial line must still carry the last real device
+    # measurement (same contract as the host-fallback path)
     emit(
         st.get("value_ms"), st.get("vs_baseline"),
         platform=st.get("platform", "unknown"), deadline_hit=True,
         note=st.get("note", "bench child produced no output"),
+        **_last_tpu_extra(),
     )
     return 0
 

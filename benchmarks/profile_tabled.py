@@ -1,0 +1,110 @@
+#!/usr/bin/env python
+"""Profile the tabled verify pipeline stage-by-stage on the live backend.
+
+Prints per-stage wall times (pipelined over K dispatches, one sync) so
+the optimization target is measured, not estimated:
+
+    python benchmarks/profile_tabled.py            # 10240 rows
+    TM_PROF_N=4096 python benchmarks/profile_tabled.py
+    TM_PROF_TRACE=/tmp/xprof python benchmarks/profile_tabled.py
+
+With TM_PROF_TRACE set, the warm stage loop also runs under
+jax.profiler.trace for xprof/tensorboard analysis (the trace dir is
+printed). Stage split (models/verifier.py cached-table path):
+
+    s1  sha512 challenge + canonical-s + signed recode
+    s2  table gather + 32-doubling/128-madd split scan   <- dominant
+    s3  blocked-inversion encode + R compare
+
+Reference loop being replaced: types/validator_set.go:641-668.
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+
+def main():
+    n = int(os.environ.get("TM_PROF_N", "10240"))
+    k = int(os.environ.get("TM_PROF_K", "8"))
+
+    import bench as bench_mod
+
+    pks, msgs, sigs = bench_mod.make_batch(n)
+
+    import jax
+    import jax.numpy as jnp
+
+    print(f"devices: {jax.devices()}", file=sys.stderr)
+
+    from tendermint_tpu.models.verifier import VerifierModel
+
+    model = VerifierModel()
+    idx = np.arange(n, dtype=np.int32)
+    key = b"profile-valset"
+
+    t0 = time.perf_counter()
+    ok = model.verify_rows_cached(key, pks, idx, msgs, sigs)
+    assert ok is not None and ok.all(), "tabled path must verify the batch"
+    print(f"cold (tables+compile+run): {time.perf_counter()-t0:.1f}s", file=sys.stderr)
+
+    e = model._valset_tables[key]
+    s1, s2, s3, _ = model._table_stage_fns()
+    pk_d = jax.device_put(jnp.asarray(pks))
+    mg_d = jax.device_put(jnp.asarray(msgs))
+    sg_d = jax.device_put(jnp.asarray(sigs))
+    idx_d = jax.device_put(jnp.asarray(idx))
+
+    # warm every stage on device-resident args
+    sd, kd, s_ok = s1(pk_d, mg_d, sg_d)
+    px, py, pz, pt, a_ok = s2(sd, kd, e.tables, e.a_ok, idx_d)
+    out = s3(px, py, pz, pt, sg_d, a_ok, s_ok)
+    np.asarray(out)
+
+    def timed(label, fn, sync):
+        t0 = time.perf_counter()
+        outs = [fn() for _ in range(k)]
+        for o in outs:
+            sync(o)
+        dt = (time.perf_counter() - t0) / k
+        print(f"{label:28s} {dt*1e3:8.2f} ms/dispatch")
+        return dt
+
+    sync_first = lambda o: np.asarray(o[0] if isinstance(o, tuple) else o)
+    t1 = timed("s1 prepare (sha512+recode)", lambda: s1(pk_d, mg_d, sg_d), sync_first)
+    t2 = timed(
+        "s2 scan (gather+split scan)",
+        lambda: s2(sd, kd, e.tables, e.a_ok, idx_d),
+        sync_first,
+    )
+    t3 = timed(
+        "s3 finish (blocked inv)",
+        lambda: s3(px, py, pz, pt, sg_d, a_ok, s_ok),
+        sync_first,
+    )
+
+    def chain():
+        a, b, c = s1(pk_d, mg_d, sg_d)
+        x, y, z, t, w = s2(a, b, e.tables, e.a_ok, idx_d)
+        return s3(x, y, z, t, sg_d, w, c)
+
+    tc = timed("chained s1->s2->s3", chain, np.asarray)
+    print(
+        f"sum of stages {sum((t1,t2,t3))*1e3:.2f} ms; chained {tc*1e3:.2f} ms; "
+        f"{n/tc:,.0f} sigs/s sustained"
+    )
+
+    trace_dir = os.environ.get("TM_PROF_TRACE")
+    if trace_dir:
+        with jax.profiler.trace(trace_dir):
+            for _ in range(3):
+                np.asarray(chain())
+        print(f"xprof trace written to {trace_dir}")
+
+
+if __name__ == "__main__":
+    main()

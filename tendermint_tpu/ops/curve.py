@@ -60,8 +60,12 @@ def add(p: Point, q: Point) -> Point:
     return Point(F.mul(e, f), F.mul(g, h), F.mul(f, g), F.mul(e, h))
 
 
-def double(p: Point) -> Point:
-    """dbl-2008-hwcd with a = -1: 4M + 4S."""
+def double(p: Point, want_t: bool = True) -> Point:
+    """dbl-2008-hwcd with a = -1: 4M + 4S (3M + 4S with want_t=False).
+
+    Doubling never READS p.t, so in a run of doublings only the last
+    one (whose output feeds an addition) needs its T computed —
+    want_t=False skips the E*H mul and returns t=0."""
     a = F.square(p.x)
     b = F.square(p.y)
     c = F.square(p.z)
@@ -71,7 +75,8 @@ def double(p: Point) -> Point:
     g = F.add(d, b)
     f = F.sub(g, c)
     h = F.sub(d, b)
-    return Point(F.mul(e, f), F.mul(g, h), F.mul(f, g), F.mul(e, h))
+    t = F.mul(e, h) if want_t else jnp.zeros_like(e)
+    return Point(F.mul(e, f), F.mul(g, h), F.mul(f, g), t)
 
 
 def negate(p: Point) -> Point:
@@ -98,8 +103,10 @@ def to_cached(p: Point) -> CachedPoint:
     )
 
 
-def add_cached(p: Point, q: CachedPoint) -> Point:
-    """p + q with q in cached form: 7M (ref10 ge_add)."""
+def add_cached(p: Point, q: CachedPoint, want_t: bool = True) -> Point:
+    """p + q with q in cached form: 7M (ref10 ge_add; 6M with
+    want_t=False — for an output consumed only by a doubling or by
+    encode, neither of which reads T)."""
     a = F.mul(F.sub(p.y, p.x), q.ymx)
     b = F.mul(F.add(p.y, p.x), q.ypx)
     c = F.mul(p.t, q.t2d)
@@ -108,7 +115,8 @@ def add_cached(p: Point, q: CachedPoint) -> Point:
     f = F.sub(d, c)
     g = F.add(d, c)
     h = F.add(b, a)
-    return Point(F.mul(e, f), F.mul(g, h), F.mul(f, g), F.mul(e, h))
+    t = F.mul(e, h) if want_t else jnp.zeros_like(e)
+    return Point(F.mul(e, f), F.mul(g, h), F.mul(f, g), t)
 
 
 def select(cond: jnp.ndarray, p: Point, q: Point) -> Point:
@@ -206,8 +214,9 @@ class AffineCached(NamedTuple):
     t2d: jnp.ndarray
 
 
-def madd(p: Point, q: AffineCached) -> Point:
-    """p + q with q affine-cached: 7M (ref10 ge_madd)."""
+def madd(p: Point, q: AffineCached, want_t: bool = True) -> Point:
+    """p + q with q affine-cached: 7M (ref10 ge_madd; 6M with
+    want_t=False, see add_cached)."""
     a = F.mul(F.sub(p.y, p.x), q.ymx)
     b = F.mul(F.add(p.y, p.x), q.ypx)
     c = F.mul(p.t, q.t2d)
@@ -216,7 +225,8 @@ def madd(p: Point, q: AffineCached) -> Point:
     f = F.sub(d, c)
     g = F.add(d, c)
     h = F.add(b, a)
-    return Point(F.mul(e, f), F.mul(g, h), F.mul(f, g), F.mul(e, h))
+    t = F.mul(e, h) if want_t else jnp.zeros_like(e)
+    return Point(F.mul(e, f), F.mul(g, h), F.mul(f, g), t)
 
 
 def _host_base_table() -> np.ndarray:
@@ -295,6 +305,14 @@ def signed_digits(d: jnp.ndarray) -> jnp.ndarray:
         out.append(v - 16 * high)
         carry = high
     return jnp.stack(out, axis=-1)
+
+
+def _window_doublings(acc: Point) -> Point:
+    """The shared 4-doubling run between scan windows. Doubling never
+    reads T, so only the LAST doubling (whose output feeds an addition)
+    computes its T — the first three skip the E*H mul (see double)."""
+    acc = double(double(double(acc, want_t=False), want_t=False), want_t=False)
+    return double(acc)
 
 
 def _tree_select(table: jnp.ndarray, mag: jnp.ndarray) -> jnp.ndarray:
@@ -455,10 +473,16 @@ def double_scalar_mul_tabled(
 
     def body(acc: Point, xs):
         sdi, kdi = xs  # (N, m), (N, m)
-        acc = double(double(double(double(acc))))
+        # the last madd's T feeds the next iteration's first doubling
+        # (or encode), which never reads it — a free skipped mul
+        acc = _window_doublings(acc)
         for m in range(SPLITS):
             acc = madd(acc, _select_affine(jnp.asarray(base[m]), sdi[:, m]))
-            acc = madd(acc, _select_affine(key_tables[:, m], kdi[:, m]))
+            acc = madd(
+                acc,
+                _select_affine(key_tables[:, m], kdi[:, m]),
+                want_t=(m < SPLITS - 1),
+            )
         return acc, None
 
     acc, _ = jax.lax.scan(body, identity((n,)), (sdw, kdw))
@@ -498,9 +522,10 @@ def double_scalar_mul_signed(
 
     def body(acc: Point, digits):
         sd, kd = digits
-        acc = double(double(double(double(acc))))
+        # the window's last addition skips T like the tabled scan's
+        acc = _window_doublings(acc)
         acc = add_cached(acc, _select_signed(jnp.asarray(base_table), sd))
-        acc = add_cached(acc, _select_signed(q_table, kd))
+        acc = add_cached(acc, _select_signed(q_table, kd), want_t=False)
         return acc, None
 
     # scan from most-significant window down
