@@ -29,6 +29,11 @@ import time
 CACHE_DIR = os.environ.get("JAX_COMPILATION_CACHE_DIR", "/tmp/jax_cache")
 os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", CACHE_DIR)
 os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "1")
+# Bench-scoped table cache: the synthetic b"bench-valset" tables
+# (~120MB at 10k) must not land in the production dir, where
+# _prune_tables could evict a REAL valset's persisted tables and cost
+# the node its <5s restart path. The coldstart child inherits this.
+os.environ.setdefault("TM_TABLES_CACHE_DIR", "/tmp/tm_bench_tables")
 
 PROBE_TIMEOUT_S = 120  # first TPU init can be slow; a dead tunnel hangs forever
 BENCH_N = int(os.environ.get("TM_BENCH_N", "10000"))  # override for smoke tests
@@ -99,17 +104,23 @@ def make_batch(n, msg_len=MSG_LEN, seed=1234):
 
 def stream_windows(fn, dev_args, n_calls: int) -> float:
     """Launch n_calls invocations of the warm jitted `fn` on
-    device-resident args and sync once; returns elapsed seconds. Used by
-    the pipelined-rate section below and benchmarks/micro.py — isolates
-    device throughput from the dev tunnel's per-call sync latency."""
+    device-resident args, sync on the LAST output only; returns elapsed
+    seconds. A single TPU core executes its stream in order, so the
+    last output being ready implies every prior dispatch completed —
+    while per-output np.asarray syncs would each pay the dev tunnel's
+    ~5ms round trip (measured round 3: per-output syncs inflated a
+    35ms/commit chain to 79ms/commit), which a directly-attached chip
+    does not have. Used by the pipelined-rate sections below and
+    benchmarks/micro.py."""
     import numpy as np
 
     out = fn(*dev_args)
     np.asarray(out[0] if isinstance(out, tuple) else out)  # warm + real sync
     t0 = time.perf_counter()
-    outs = [fn(*dev_args) for _ in range(n_calls)]
-    for o in outs:
-        np.asarray(o[0] if isinstance(o, tuple) else o)
+    out = None
+    for _ in range(n_calls):
+        out = fn(*dev_args)
+    np.asarray(out[0] if isinstance(out, tuple) else out)
     return time.perf_counter() - t0
 
 
@@ -290,6 +301,9 @@ def run_bench(platform: str, accelerator: bool = True):
             assert ok_t.all(), int(ok_t.sum())
             e = model._valset_tables.get(key)
             tabled["tables_build_s"] = round(e.build_s, 2) if e and e.build_s else None
+            # "disk" means a persisted table was reused: build_s is then
+            # load time, NOT comparable to a prior round's device build
+            tabled["tables_source"] = e.source if e else None
             tabled["tabled_cold_s"] = round(tabled_cold_s, 1)
             t_times = []
             for _ in range(5):
@@ -321,13 +335,10 @@ def run_bench(platform: str, accelerator: bool = True):
                 px, py, pz, pt, a_ok = s2(sd, kd, e.tables, e.a_ok, idx_d)
                 return s3(px, py, pz, pt, sg_d, a_ok, s_ok)
 
-            np.asarray(chain())  # warm the 10240 bucket
-            K = 8
-            t0 = time.perf_counter()
-            outs = [chain() for _ in range(K)]
-            for o in outs:
-                np.asarray(o)
-            tp = (time.perf_counter() - t0) / K
+            # deep queue, one final sync — stream_windows owns the sync
+            # discipline (chain takes no args, so dev_args is empty)
+            K = 16
+            tp = stream_windows(chain, (), K) / K
             tabled["tabled_pipelined_ms"] = round(tp * 1e3, 2)
             tabled["tabled_sigs_per_sec_sustained"] = round(n / tp)
             log(
@@ -363,7 +374,7 @@ def run_bench(platform: str, accelerator: bool = True):
                     pad(counted.astype(bool)),
                 )
             ]
-            K = 8
+            K = 16
             pipelined_ms = stream_windows(fn, dev, K) / K
             log(
                 f"pipelined device rate: {pipelined_ms*1e3:.1f} ms/commit "
@@ -389,6 +400,8 @@ def run_bench(platform: str, accelerator: bool = True):
             aot_extra = {
                 "coldstart_backend_init_s": cs.get("backend_init_s"),
                 "coldstart_first_verify_s": cs.get("first_verify_s"),
+                "coldstart_tabled_first_s": cs.get("tabled_first_s"),
+                "coldstart_tables_source": cs.get("tables_source"),
             }
             log(f"fresh-process cold start: {cs}")
     except Exception as ex:
@@ -507,10 +520,18 @@ def _deadline_done() -> None:
 
 
 def _coldstart() -> None:
-    """Fresh-process measurement: backend init + AOT-loaded first verify.
-    Prints one JSON line; run by the parent bench with a warm AOT cache."""
+    """Fresh-process measurement of the RESTARTING-VALIDATOR paths
+    (round-2 verdict #2: first device-verified commit <5s, not a ~20s
+    recompile window): backend init, then verify_commit with AOT-loaded
+    stage executables, then the tabled path with the parent's persisted
+    valset tables (pure data from disk — no build program). Prints one
+    JSON line; run by the parent bench with warm AOT + table caches."""
+    import numpy as np
+
     n = BENCH_N
     pks, msgs, sigs = make_batch(n)  # host prep excluded from the timing
+    powers = np.full(n, 10, dtype=np.int64)
+    counted = np.ones(n, dtype=bool)
 
     t0 = time.perf_counter()
     import jax
@@ -522,15 +543,26 @@ def _coldstart() -> None:
 
     t0 = time.perf_counter()
     model = VerifierModel()
-    ok = model.verify(pks, msgs, sigs)
+    ok, tally = model.verify_commit(pks, msgs, sigs, powers, counted)
     first_s = time.perf_counter() - t0
-    assert ok.all()
-    print(
-        json.dumps(
-            {"backend_init_s": round(init_s, 2), "first_verify_s": round(first_s, 2)}
-        ),
-        flush=True,
-    )
+    assert ok.all() and tally == n * 10
+
+    # tabled restart: same valset key the parent measured under, so the
+    # persisted tables are the ones a restarting node would find
+    t0 = time.perf_counter()
+    idx = np.arange(n, dtype=np.int32)
+    ok_t = model.verify_rows_cached(b"bench-valset", pks, idx, msgs, sigs)
+    tabled_s = time.perf_counter() - t0
+    e = model._valset_tables.get(b"bench-valset")
+    out = {
+        "backend_init_s": round(init_s, 2),
+        "first_verify_s": round(first_s, 2),
+    }
+    if ok_t is not None:
+        assert ok_t.all()
+        out["tabled_first_s"] = round(tabled_s, 2)
+        out["tables_source"] = e.source if e else None
+    print(json.dumps(out), flush=True)
 
 
 def main():

@@ -151,6 +151,109 @@ def save(stage: str, args: Tuple[Any, ...], compiled) -> None:
         _log.info("aot save failed", stage=stage, err=repr(ex))
 
 
+# -- built valset tables (pure data) -----------------------------------
+#
+# The split tables a valset build produces are deterministic int32
+# arrays (~12KB/validator). Persisting THEM — not just the build
+# executable — lets a restarting node device_put ~120MB of data instead
+# of loading a ~200MB t-build executable AND re-running the build
+# (measured 15.9s load + ~14-30s run at 10k validators on a v5e).
+# Keyed by the code digest only: tables are device-independent data,
+# so a CPU-built table is valid on TPU and vice versa.
+
+_TABLES_KEEP = int(os.environ.get("TM_TABLES_CACHE_KEEP", "4"))
+
+
+def tables_dir() -> str:
+    d = os.environ.get("TM_TABLES_CACHE_DIR")
+    if not d:
+        d = os.path.join(
+            os.environ.get("XDG_CACHE_HOME", os.path.expanduser("~/.cache")),
+            "tendermint_tpu",
+            "tables",
+        )
+    return d
+
+
+_CODE_DIGEST: Optional[str] = None
+
+
+def _code_digest_cached() -> str:
+    global _CODE_DIGEST
+    if _CODE_DIGEST is None:
+        _CODE_DIGEST = _code_digest()
+    return _CODE_DIGEST
+
+
+def _tables_path(valset_key: bytes, v: int) -> str:
+    return os.path.join(
+        tables_dir(), f"{_code_digest_cached()}-{valset_key.hex()[:32]}-{v}.npz"
+    )
+
+
+def load_tables(valset_key: bytes, v: int):
+    """(tables, a_ok) numpy arrays for this valset, or None."""
+    if not enabled():
+        return None
+    try:
+        import numpy as np
+
+        p = _tables_path(valset_key, v)
+        if not os.path.exists(p):
+            return None
+        with np.load(p) as z:
+            tables, a_ok = z["tables"], z["a_ok"]
+        if tables.shape[0] < v:  # truncated/foreign blob
+            return None
+        try:
+            os.utime(p)  # LRU recency for _prune_tables
+        except OSError:
+            pass  # read-only cache dir (e.g. baked into an image): the
+            # load itself succeeded and that's what matters
+        return tables, a_ok
+    except Exception as ex:
+        _log.info("tables load failed (rebuilding)", err=repr(ex))
+        return None
+
+
+def save_tables(valset_key: bytes, tables, a_ok) -> None:
+    """Best-effort atomic persist of built tables (uncompressed: field
+    elements don't compress and savez_compressed is ~10x slower)."""
+    if not enabled():
+        return
+    try:
+        import numpy as np
+
+        os.makedirs(tables_dir(), exist_ok=True)
+        p = _tables_path(valset_key, int(a_ok.shape[0]))
+        tmp = p + f".tmp.{os.getpid()}"
+        with open(tmp, "wb") as fh:
+            np.savez(fh, tables=np.asarray(tables), a_ok=np.asarray(a_ok))
+        os.replace(tmp, p)
+        _prune_tables()
+    except Exception as ex:
+        _log.info("tables save failed", err=repr(ex))
+
+
+def _prune_tables() -> None:
+    """Bound the on-disk table cache to the newest _TABLES_KEEP files
+    (a 10k-valset file is ~120MB; an unbounded dir would eat the disk
+    across valset changes)."""
+    try:
+        d = tables_dir()
+        files = [
+            os.path.join(d, f) for f in os.listdir(d) if f.endswith(".npz")
+        ]
+        files.sort(key=os.path.getmtime, reverse=True)
+        for p in files[_TABLES_KEEP:]:
+            try:
+                os.remove(p)
+            except OSError:
+                pass
+    except Exception:
+        pass
+
+
 class AotJit:
     """jit wrapper that persists compiled executables across processes.
 

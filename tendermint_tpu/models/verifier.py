@@ -150,7 +150,9 @@ MAX_CACHED_VALSETS = 2
 
 
 class _TablesEntry:
-    __slots__ = ("tables", "a_ok", "v", "ready", "building", "failed", "build_s")
+    __slots__ = (
+        "tables", "a_ok", "v", "ready", "building", "failed", "build_s", "source"
+    )
 
     def __init__(self, v: int):
         self.tables = None
@@ -163,6 +165,7 @@ class _TablesEntry:
         # deterministic failure on every verify
         self.failed = False
         self.build_s: Optional[float] = None
+        self.source: Optional[str] = None  # "build" | "disk"
 
 
 class VerifierModel:
@@ -573,11 +576,22 @@ class VerifierModel:
         return self._table_stages
 
     def _build_tables(self, e: _TablesEntry, key: bytes, pubkeys: np.ndarray) -> None:
-        _, _, _, build = self._table_stage_fns()
+        from tendermint_tpu.models import aot_cache
+
         t0 = time.perf_counter()
         v = pubkeys.shape[0]
         v_pad = _bucket(v, 1)
-        tables, a_ok = build(jnp.asarray(self._pad(np.asarray(pubkeys, dtype=np.uint8), v_pad)))
+        loaded = aot_cache.load_tables(key, v_pad)
+        if loaded is not None:
+            # restart path: pure data from disk, no build program at all
+            tables, a_ok = jnp.asarray(loaded[0]), jnp.asarray(loaded[1])
+            e.source = "disk"
+        else:
+            _, _, _, build = self._table_stage_fns()
+            tables, a_ok = build(
+                jnp.asarray(self._pad(np.asarray(pubkeys, dtype=np.uint8), v_pad))
+            )
+            e.source = "build"
         if self.mesh is not None:
             # replicate ONCE at build: the shard_map scan consumes the
             # tables with a replicated spec, and leaving them committed
@@ -593,9 +607,12 @@ class VerifierModel:
         e.build_s = time.perf_counter() - t0
         e.ready = True
         self.logger.info(
-            "valset tables built",
-            validators=v, key=key[:8].hex(), seconds=round(e.build_s, 2),
+            "valset tables ready",
+            validators=v, key=key[:8].hex(), source=e.source,
+            seconds=round(e.build_s, 2),
         )
+        if e.source == "build":
+            aot_cache.save_tables(key, np.asarray(tables), np.asarray(a_ok))
 
     def _tables_entry(self, key: bytes, pubkeys: np.ndarray) -> Optional[_TablesEntry]:
         """The ready tables entry for `key`, or None when still cold

@@ -25,6 +25,18 @@ os.environ["PYTHONPATH"] = ":".join(
 
 os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/jax_cache")
 os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "1")
+# Isolate the on-disk valset-table cache per test run: suites reuse
+# fixed valset keys (b"valset-key-1", ...), so a shared dir would leak
+# one run's built tables into the next and flip build-path assertions
+# (e.g. the failed-build latch test would load from disk instead).
+import atexit  # noqa: E402
+import shutil  # noqa: E402
+import tempfile  # noqa: E402
+
+if "TM_TABLES_CACHE_DIR" not in os.environ:
+    _tables_tmp = tempfile.mkdtemp(prefix="tm_tables_test_")
+    os.environ["TM_TABLES_CACHE_DIR"] = _tables_tmp
+    atexit.register(shutil.rmtree, _tables_tmp, True)
 # TOML-loaded node configs default to the TPU provider; the suite pins
 # cpu so node tests don't spawn background XLA compiles. The TPU
 # provider path has dedicated tests (test_tpu_provider.py,
