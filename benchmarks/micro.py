@@ -88,9 +88,29 @@ def bench_headers_heights():
     from tendermint_tpu.crypto.batch import make_provider
 
     prov = make_provider("tpu")
-    # warm both bucket shapes out of the timed region
-    prov.warmup(sizes=(n_vals, len(chain) * n_vals), msg_len=160)
-    verifier.verify_chain(lh.CHAIN_ID, headers[1], vals[1], chain[:4], period, now_ns=now, provider=prov)
+    # Warm EVERY bucket both timed paths touch out of the timed region
+    # (compiles measured in-region turned the round-3 first run into a
+    # 146s "batched" figure that was ~90% XLA compile):
+    #  - generic buckets (host-fallback seams)
+    #  - the tabled per-height bucket (n_vals rows) + the valset tables
+    #  - the tabled 16384-row streaming window
+    #  - the tabled 10240 tail bucket (499k % 16384 = 7480 -> 10240)
+    prov.warmup(sizes=(n_vals,), msg_len=160)
+    verifier.verify_adjacent(
+        lh.CHAIN_ID, headers[1], chain[0][0], chain[0][1], period,
+        now_ns=now, provider=prov,
+    )
+    if full:
+        for warm_heights in (10, 17):  # 10240 bucket; 16384 window + tail
+            verifier.verify_chain(
+                lh.CHAIN_ID, headers[1], vals[1], chain[:warm_heights],
+                period, now_ns=now, provider=prov,
+            )
+    else:
+        verifier.verify_chain(
+            lh.CHAIN_ID, headers[1], vals[1], chain[:4], period,
+            now_ns=now, provider=prov,
+        )
 
     t0 = time.perf_counter()
     cur_sh, cur_vals = headers[1], vals[1]
@@ -196,6 +216,21 @@ def bench_vote_ingest():
     prov = make_provider("tpu")
     tail = n % micro_batch or micro_batch
     prov.warmup(sizes=(micro_batch, tail), msg_len=160)
+    # Warm the tabled path out of the timed region, like a live node
+    # does at start (register_valset): the 50k table build is the
+    # dominant one-time cost and must not masquerade as ingest time.
+    # Bucket warmup rows are garbage (all-invalid) — shapes are what
+    # compiles, validity is irrelevant.
+    import numpy as np
+
+    key, pk, _ed = vals.batch_cache()
+    prov.register_valset(key, pk)
+    ml = len(votes[0].sign_bytes("ingest-chain"))
+    for rows in sorted({micro_batch, tail}):
+        prov.verify_rows_cached(
+            key, pk, np.zeros(rows, np.int32),
+            np.zeros((rows, ml), np.uint8), np.zeros((rows, 64), np.uint8),
+        )
     vs = VoteSet("ingest-chain", 1, 0, PRECOMMIT_TYPE, vals, provider=prov)
     t0 = time.perf_counter()
     total_added = 0

@@ -151,12 +151,14 @@ MAX_CACHED_VALSETS = 2
 
 class _TablesEntry:
     __slots__ = (
-        "tables", "a_ok", "v", "ready", "building", "failed", "build_s", "source"
+        "tables", "a_ok", "pk_dev", "v", "ready", "building", "failed",
+        "build_s", "source",
     )
 
     def __init__(self, v: int):
         self.tables = None
         self.a_ok = None
+        self.pk_dev = None  # (V_pad, 32) u8 device copy for stage-1 gather
         self.v = v
         self.ready = False
         self.building = False
@@ -539,7 +541,7 @@ class VerifierModel:
 
         if self.mesh is None:
             self._table_stages = (
-                AotJit(ops_ed.verify_stage_prepare_tabled, "t-prepare"),
+                AotJit(ops_ed.verify_stage_prepare_tabled_gathered, "t-prepare-g"),
                 AotJit(ops_ed.verify_stage_scan_tabled, "t-scan"),
                 AotJit(ops_ed.verify_stage_finish_blocked, "t-finish"),
                 AotJit(ops_ed.build_valset_tables, "t-build"),
@@ -554,8 +556,12 @@ class VerifierModel:
         tag = f"mesh{tuple(self.mesh.shape.values())}"
         self._table_stages = (
             AotJit(
-                None, f"t-prepare-{tag}",
-                jit_fn=self._smap(ops_ed.verify_stage_prepare_tabled, 3, (batch,) * 3),
+                None, f"t-prepare-g-{tag}",
+                # pubkey matrix replicates (like the tables); rows shard
+                jit_fn=self._smap(
+                    ops_ed.verify_stage_prepare_tabled_gathered, 4, (batch,) * 3,
+                    in_specs=(rep, batch, batch, batch),
+                ),
             ),
             AotJit(
                 None, f"t-scan-{tag}",

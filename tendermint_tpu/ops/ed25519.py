@@ -135,6 +135,16 @@ def verify_stage_prepare_tabled(pubkeys, msgs, sigs):
     return sd, kd, s_ok
 
 
+def verify_stage_prepare_tabled_gathered(pk_all, idx, msgs, sigs):
+    """Tabled stage 1 with DEVICE-side pubkey gather: pk_all is the
+    valset's device-resident (V, 32) pubkey matrix (cached alongside
+    the split tables), idx the per-row validator index. The old stage
+    shipped a host-gathered (N, 32) copy per call — 32 of the 260 H2D
+    bytes/row, plus the host fancy-index itself, for data the device
+    already holds."""
+    return verify_stage_prepare_tabled(jnp.take(pk_all, idx, axis=0), msgs, sigs)
+
+
 def verify_stage_scan_tabled(sd, kd, tables, a_ok, idx):
     """Tabled stage 2: gather each row's key table by validator index
     (device gather along the leading axis — large contiguous rows, DMA
