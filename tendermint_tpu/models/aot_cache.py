@@ -74,17 +74,42 @@ def _code_digest() -> str:
     return h.hexdigest()[:16]
 
 
+def _host_machine_sig() -> str:
+    """Host ISA identity: arch + the CPU feature flags XLA:CPU compiles
+    against. A serialized CPU executable built on a host with (say)
+    avx512 loads fine on a host without it and then SIGILLs at dispatch
+    — XLA only warns ("Machine type used for XLA:CPU compilation
+    doesn't match the machine type for execution"). Baking the flags
+    into the fingerprint makes such a blob a cache MISS instead."""
+    import platform as _platform
+
+    parts = [_platform.machine()]
+    try:
+        with open("/proc/cpuinfo") as fh:
+            for line in fh:
+                if line.startswith(("flags", "Features")):
+                    parts.append(" ".join(sorted(line.split(":", 1)[1].split())))
+                    break
+    except OSError:
+        parts.append(_platform.processor() or "?")
+    return hashlib.sha256("|".join(parts).encode()).hexdigest()[:12]
+
+
 def fingerprint() -> str:
-    """Backend + code identity baked into every cache filename."""
+    """Backend + host + code identity baked into every cache filename."""
     global _FINGERPRINT
     with _fp_lock:
         if _FINGERPRINT is None:
             dev = jax.devices()[0]
+            platform = getattr(dev, "platform", "?")
             raw = "|".join(
                 [
                     jax.__version__,
-                    getattr(dev, "platform", "?"),
+                    platform,
                     getattr(dev, "device_kind", "?"),
+                    # only XLA:CPU lowers to host ISA; a TPU executable is
+                    # host-agnostic and must stay shareable across hosts
+                    _host_machine_sig() if platform == "cpu" else "",
                     _code_digest(),
                 ]
             )
@@ -185,14 +210,21 @@ def _code_digest_cached() -> str:
     return _CODE_DIGEST
 
 
-def _tables_path(valset_key: bytes, v: int) -> str:
+def _tables_path(valset_key: bytes, v: int, dir_path: Optional[str] = None) -> str:
     return os.path.join(
-        tables_dir(), f"{_code_digest_cached()}-{valset_key.hex()[:32]}-{v}.npz"
+        dir_path or tables_dir(),
+        f"{_code_digest_cached()}-{valset_key.hex()[:32]}-{v}.npz",
     )
 
 
-def load_tables(valset_key: bytes, v: int):
-    """(tables, a_ok) numpy arrays for this valset, or None."""
+def load_tables(valset_key: bytes, v: int, pk_digest: bytes):
+    """(tables, a_ok) numpy arrays for this valset, or None.
+
+    pk_digest = sha256 of the (padded) pubkey matrix the caller is about
+    to verify against. The stored digest must match: a stale blob under
+    a reused key, a truncated-hex collision, or a tampered cache file
+    would otherwise silently substitute wrong precomputed tables into
+    signature verification — a consensus-safety issue, not a perf one."""
     if not enabled():
         return None
     try:
@@ -203,6 +235,11 @@ def load_tables(valset_key: bytes, v: int):
             return None
         with np.load(p) as z:
             tables, a_ok = z["tables"], z["a_ok"]
+            stored = z["pk_sha"].tobytes() if "pk_sha" in z else b""
+        if stored != pk_digest:
+            _log.info("tables pubkey digest mismatch (rebuilding)",
+                      path=os.path.basename(p))
+            return None
         if tables.shape[0] < v:  # truncated/foreign blob
             return None
         try:
@@ -216,19 +253,29 @@ def load_tables(valset_key: bytes, v: int):
         return None
 
 
-def save_tables(valset_key: bytes, tables, a_ok) -> None:
+def save_tables(
+    valset_key: bytes, tables, a_ok, pk_digest: bytes,
+    dir_path: Optional[str] = None,
+) -> None:
     """Best-effort atomic persist of built tables (uncompressed: field
-    elements don't compress and savez_compressed is ~10x slower)."""
+    elements don't compress and savez_compressed is ~10x slower). The
+    pubkey digest is stored alongside so load_tables can refuse a blob
+    that doesn't belong to the pubkeys being verified. dir_path lets an
+    async builder pin the directory it resolved at BUILD time (the env
+    var may point elsewhere by the time a background thread saves)."""
     if not enabled():
         return
     try:
         import numpy as np
 
-        os.makedirs(tables_dir(), exist_ok=True)
-        p = _tables_path(valset_key, int(a_ok.shape[0]))
+        os.makedirs(dir_path or tables_dir(), exist_ok=True)
+        p = _tables_path(valset_key, int(a_ok.shape[0]), dir_path)
         tmp = p + f".tmp.{os.getpid()}"
         with open(tmp, "wb") as fh:
-            np.savez(fh, tables=np.asarray(tables), a_ok=np.asarray(a_ok))
+            np.savez(
+                fh, tables=np.asarray(tables), a_ok=np.asarray(a_ok),
+                pk_sha=np.frombuffer(pk_digest, dtype=np.uint8),
+            )
         os.replace(tmp, p)
         _prune_tables()
     except Exception as ex:

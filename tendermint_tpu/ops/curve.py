@@ -198,10 +198,14 @@ _TBL = 8  # signed-window table holds [1..8]Q
 # Split-table (per-valset cached) scan: the 64 signed 4-bit windows are
 # grouped into SPLITS chunks of SPLIT_W windows; a table of multiples of
 # [16^(SPLIT_W*m)]Q per chunk turns 256 shared doublings into
-# 4*SPLIT_W = 32 — the doubling half of the Straus scan all but
+# 4*SPLIT_W — the doubling half of the Straus scan all but
 # disappears when Q (a validator pubkey) is stable across heights.
-SPLITS = 8
-SPLIT_W = 8  # 64 // SPLITS
+# 16 splits (16 shared doublings, ~24KB of table per validator) measured
+# faster than 8 (32 doublings, ~12KB) on v5e: the doubling runs are pure
+# serial VPU latency while the extra table HBM is cheap next to the
+# per-madd arithmetic.
+SPLITS = 16
+SPLIT_W = 4  # 64 // SPLITS
 
 
 class AffineCached(NamedTuple):
@@ -279,6 +283,105 @@ def base_table_all_windows() -> np.ndarray:
     if _BASE_TABLE_ALL is None:
         _BASE_TABLE_ALL = _host_base_table_all_windows()
     return _BASE_TABLE_ALL
+
+
+# -- 8-bit signed base comb (tabled scan's [s]B side) -----------------------
+#
+# The fixed-base half of the verification equation needs no doublings at
+# all: [s]B = sum_p [sd_p * 256^p]B over 32 SIGNED byte digits, each
+# selected from a CONSTANT 128-entry table. Constant tables turn the
+# select into a one-hot matmul the MXU executes for ~free (the per-row
+# key tables can't ride the MXU — each row contracts against different
+# data — which is why the key side keeps the 8-entry binary select
+# tree). bf16 exactness: one-hot entries are 0/1 and table operands are
+# 7-bit limb halves, both exact in bf16's 8-bit mantissa; each output
+# element is ONE table value + zeros, exact in the f32 accumulator.
+
+
+def signed_digits_base256(scalar_bytes: jnp.ndarray) -> jnp.ndarray:
+    """(..., 32) u8/int32 little-endian scalar -> (..., 32) SIGNED
+    base-256 digits in [-128, 128). d_i >= 128 becomes d_i - 256 with a
+    +1 carry up; scalars are < 2^253 so digit 31 absorbs the carry."""
+    d = scalar_bytes.astype(jnp.int32)
+    carry = jnp.zeros(d.shape[:-1], dtype=jnp.int32)
+    out = []
+    for i in range(32):
+        v = d[..., i] + carry
+        high = (v >= 128).astype(jnp.int32)
+        out.append(v - 256 * high)
+        carry = high
+    return jnp.stack(out, axis=-1)
+
+
+_COMB256 = 128  # entries per digit position: [1..128] * 256^p * B
+
+
+def _host_base_comb256() -> np.ndarray:
+    """(32, 128, 3, 20) int32: AFFINE-cached (Y+X, Y-X, 2dXY) of
+    [i * 256^p]B for p in 0..31, i in 1..128."""
+    out = np.empty((32, _COMB256, 3, F.LIMBS), dtype=np.int32)
+    win = ref.pt_from_affine(*ref.BASE)
+    for p in range(32):
+        acc = win
+        for i in range(_COMB256):
+            x, y = ref.pt_to_affine(acc)
+            out[p, i, 0] = F.to_limbs((y + x) % ref.P)
+            out[p, i, 1] = F.to_limbs((y - x) % ref.P)
+            out[p, i, 2] = F.to_limbs(2 * ref.D * x * y % ref.P)
+            if i < _COMB256 - 1:
+                acc = ref.pt_add(acc, win)
+        for _ in range(8):  # win = [256]win
+            win = ref.pt_double(win)
+    return out
+
+
+_BASE_COMB256: np.ndarray | None = None  # lazy: 4096 host point ops (~10s)
+
+
+def base_comb256() -> np.ndarray:
+    global _BASE_COMB256
+    if _BASE_COMB256 is None:
+        _BASE_COMB256 = _host_base_comb256()
+    return _BASE_COMB256
+
+
+def _comb256_halves() -> Tuple[np.ndarray, np.ndarray]:
+    """The comb table as 7-bit limb halves, (32, 128, 60) each —
+    bf16-exact operands for the one-hot matmul."""
+    t = base_comb256().reshape(32, _COMB256, 3 * F.LIMBS)
+    return (t >> 7).astype(np.float32), (t & 127).astype(np.float32)
+
+
+def _select_comb256(digits: jnp.ndarray) -> AffineCached:
+    """All 32 base-comb selections at once: digits (N, 32) signed in
+    [-128, 128) -> AffineCached of (N, 32, 20) (one selected entry per
+    digit position). One batched bf16 one-hot matmul per 7-bit half —
+    (N, 32, 128) x (32, 128, 60) rides the MXU."""
+    mag = jnp.abs(digits)  # (N, 32), values 0..128
+    onehot = (
+        mag[..., None] == jnp.arange(1, _COMB256 + 1, dtype=jnp.int32)
+    ).astype(jnp.bfloat16)  # (N, 32, 128)
+    hi_t, lo_t = _comb256_halves()
+    hi = jnp.einsum(
+        "npk,pkc->npc", onehot, jnp.asarray(hi_t, dtype=jnp.bfloat16),
+        preferred_element_type=jnp.float32,
+    )
+    lo = jnp.einsum(
+        "npk,pkc->npc", onehot, jnp.asarray(lo_t, dtype=jnp.bfloat16),
+        preferred_element_type=jnp.float32,
+    )
+    sel = (hi.astype(jnp.int32) << 7) | lo.astype(jnp.int32)  # (N, 32, 60)
+    sel = sel.reshape(*sel.shape[:-1], 3, F.LIMBS)
+    ypx, ymx, t2d = sel[..., 0, :], sel[..., 1, :], sel[..., 2, :]
+    zero = digits == 0
+    one = F.broadcast_const(1, ypx.shape[:-1]).astype(jnp.int32)
+    ypx = F.select(zero, one, ypx)
+    ymx = F.select(zero, one, ymx)
+    t2d = F.select(zero, jnp.zeros_like(t2d), t2d)
+    neg_ = (digits < 0) & ~zero
+    ypx, ymx = F.select(neg_, ymx, ypx), F.select(neg_, ypx, ymx)
+    t2d = F.select(neg_, F.neg(t2d), t2d)
+    return AffineCached(ypx, ymx, t2d)
 
 
 def nibble_digits(scalar_bytes: jnp.ndarray) -> jnp.ndarray:
@@ -406,33 +509,31 @@ def build_split_tables(q: Point) -> jnp.ndarray:
     per-key precomputation those verifies share is hoisted out of the
     per-commit path entirely.
 
-    Cost: 32*(SPLITS-1) doublings + 8*SPLITS adds + one blocked batch
-    inversion over V*64 entries — amortized over every subsequent
-    commit/vote batch for the set.
+    Cost: 4*SPLIT_W*SPLITS doublings + 8*SPLITS adds + one blocked
+    batch inversion over V*SPLITS*8 entries — amortized over every
+    subsequent commit/vote batch for the set.
     """
     v = q.x.shape[0]
-    ents_x, ents_y, ents_z, ents_t = [], [], [], []
-    qm = q
-    for m in range(SPLITS):
-        def ent_body(acc: Point, _, _qm=qm):
-            return add(acc, _qm), acc  # outputs [1..8]qm (pre-add carry)
+
+    # scan over chunks so the build PROGRAM is O(1) in SPLITS (the
+    # unrolled form doubled compile time when SPLITS went 8 -> 16)
+    def chunk_body(qm: Point, _):
+        def ent_body(acc: Point, __):
+            return add(acc, qm), acc  # outputs [1..8]qm (pre-add carry)
 
         _, ents = jax.lax.scan(ent_body, qm, None, length=_TBL)
-        # ents: Point of (8, V, 20)
-        ents_x.append(ents.x)
-        ents_y.append(ents.y)
-        ents_z.append(ents.z)
-        ents_t.append(ents.t)
-        if m < SPLITS - 1:
-            qm = jax.lax.fori_loop(
-                0, 4 * SPLIT_W, lambda _, p: double(p), qm
-            )  # [16^SPLIT_W]qm
-    # (SPLITS, 8, V, 20) -> (V, SPLITS*8, 20)
-    def _stack(parts):
-        a = jnp.stack(parts)  # (SPLITS, 8, V, 20)
+        qm2 = jax.lax.fori_loop(
+            0, 4 * SPLIT_W, lambda _, p: double(p), qm
+        )  # [16^SPLIT_W]qm
+        return qm2, ents
+
+    _, ents = jax.lax.scan(chunk_body, q, None, length=SPLITS)
+
+    # Point of (SPLITS, 8, V, 20) -> (V*SPLITS*8, 20)
+    def _stack(a):
         return jnp.transpose(a, (2, 0, 1, 3)).reshape(v * SPLITS * _TBL, F.LIMBS)
 
-    X, Y, Z = _stack(ents_x), _stack(ents_y), _stack(ents_z)
+    X, Y, Z = _stack(ents.x), _stack(ents.y), _stack(ents.z)
     zi = F.invert_blocked(Z)
     x = F.mul(X, zi)
     y = F.mul(Y, zi)
@@ -444,48 +545,46 @@ def build_split_tables(q: Point) -> jnp.ndarray:
 
 
 def double_scalar_mul_tabled(
-    sd_signed: jnp.ndarray, kd_signed: jnp.ndarray, key_tables: jnp.ndarray
+    sd8: jnp.ndarray, kd_signed: jnp.ndarray, key_tables: jnp.ndarray
 ) -> Point:
-    """[s]B + [k]Q with per-key precomputed split tables: sd/kd (N, 64)
-    SIGNED window digits, key_tables (N, SPLITS, 8, 3*LIMBS) from
+    """[s]B + [k]Q with per-key precomputed split tables: sd8 (N, 32)
+    SIGNED base-256 digits of s (signed_digits_base256), kd (N, 64)
+    signed nibble digits of k, key_tables (N, SPLITS, 8, 3*LIMBS) from
     build_split_tables (gathered per row).
 
-    SPLIT_W scan iterations x (4 doublings + 2*SPLITS mixed adds):
-    32 doublings total vs 256 for the untabled scan, no per-row table
-    build, and no pubkey decompression in the per-commit path.
+    The key side runs SPLIT_W scan iterations x (4 doublings + SPLITS
+    mixed adds) — 32 doublings total vs 256 for the untabled scan, no
+    per-row table build, no decompression. The base side rides a
+    doubling-free 8-bit comb: 32 mixed adds of MXU-selected constant
+    entries (_select_comb256) appended after the scan — half the base
+    adds the 4-bit in-scan windows needed, with the select arithmetic
+    moved off the VPU entirely.
     """
-    n = sd_signed.shape[0]
+    n = kd_signed.shape[0]
     # digit j = SPLIT_W*m + w -> (w, N, m), MSB window first
-    def _rearrange(d):
-        return jnp.flip(
-            jnp.transpose(d.reshape(n, SPLITS, SPLIT_W), (2, 0, 1)), axis=0
-        )
-
-    sdw, kdw = _rearrange(sd_signed), _rearrange(kd_signed)
-    # Chunk m always adds multiples of [16^(SPLIT_W*m)]B — the 16^w
-    # factor comes from the shared doublings — so only the comb's
-    # split-point windows are used, the same table at every scan step.
-    base = (
-        base_table_all_windows()[::SPLIT_W]
-        .reshape(SPLITS, _TBL, 3 * F.LIMBS)
-        .copy()
+    kdw = jnp.flip(
+        jnp.transpose(kd_signed.reshape(n, SPLITS, SPLIT_W), (2, 0, 1)), axis=0
     )
 
-    def body(acc: Point, xs):
-        sdi, kdi = xs  # (N, m), (N, m)
-        # the last madd's T feeds the next iteration's first doubling
-        # (or encode), which never reads it — a free skipped mul
+    def body(acc: Point, kdi):
         acc = _window_doublings(acc)
         for m in range(SPLITS):
-            acc = madd(acc, _select_affine(jnp.asarray(base[m]), sdi[:, m]))
-            acc = madd(
-                acc,
-                _select_affine(key_tables[:, m], kdi[:, m]),
-                want_t=(m < SPLITS - 1),
-            )
+            # want_t throughout: the scan's LAST madd feeds the base
+            # comb's first madd, which reads T (uniform trace beats
+            # saving one mul on 7 of 8 iterations)
+            acc = madd(acc, _select_affine(key_tables[:, m], kdi[:, m]))
         return acc, None
 
-    acc, _ = jax.lax.scan(body, identity((n,)), (sdw, kdw))
+    acc, _ = jax.lax.scan(body, identity((n,)), kdw)
+    combs = _select_comb256(sd8)  # (N, 32, 20) per coordinate
+    for p in range(32):
+        acc = madd(
+            acc,
+            AffineCached(
+                combs.ypx[:, p], combs.ymx[:, p], combs.t2d[:, p]
+            ),
+            want_t=(p < 31),  # the last madd feeds encode: T unread
+        )
     return acc
 
 

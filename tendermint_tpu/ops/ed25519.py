@@ -122,7 +122,9 @@ def build_valset_tables(pubkeys: jnp.ndarray):
 def verify_stage_prepare_tabled(pubkeys, msgs, sigs):
     """Tabled stage 1: challenge hash + canonical-s + signed recode.
     No decompression — the tables already encode -A. pubkeys are still
-    hashed (k = SHA512(R || A || M))."""
+    hashed (k = SHA512(R || A || M)). s recodes to SIGNED BASE-256
+    digits (the base side rides the doubling-free 8-bit MXU comb);
+    k keeps signed nibbles for the per-key split tables."""
     s_bytes = sigs[:, 32:].astype(jnp.int32)
     s_ok = sc.is_canonical(s_bytes)
     preimage = jnp.concatenate(
@@ -130,9 +132,9 @@ def verify_stage_prepare_tabled(pubkeys, msgs, sigs):
         axis=1,
     )
     k_bytes = sc.reduce512(sha512(preimage))
-    sd = curve.signed_digits(curve.nibble_digits(s_bytes))
+    sd8 = curve.signed_digits_base256(s_bytes)
     kd = curve.signed_digits(curve.nibble_digits(k_bytes))
-    return sd, kd, s_ok
+    return sd8, kd, s_ok
 
 
 def verify_stage_prepare_tabled_gathered(pk_all, idx, msgs, sigs):
@@ -152,6 +154,16 @@ def verify_stage_scan_tabled(sd, kd, tables, a_ok, idx):
     row_tables = jnp.take(tables, idx, axis=0)
     p = curve.double_scalar_mul_tabled(sd, kd, row_tables)
     return p.x, p.y, p.z, p.t, jnp.take(a_ok, idx, axis=0)
+
+
+def verify_stage_scan_tabled_dense(sd, kd, tables, a_ok):
+    """Tabled stage 2, DENSE case: row i IS validator i (a full commit
+    in validator order — the hot shape), so the per-row table gather
+    disappears entirely. TPU gathers serialize on the scatter/gather
+    unit; skipping one over the ~12KB/row tables was worth ~10ms of the
+    35ms stage-2 time at 10k rows (see BENCHMARKS.md round 4)."""
+    p = curve.double_scalar_mul_tabled(sd, kd, tables)
+    return p.x, p.y, p.z, p.t, a_ok
 
 
 def verify_stage_finish_blocked(px, py, pz, pt, sigs, a_ok, s_ok):

@@ -2,10 +2,10 @@
 
 TPU has no native 64-bit integers; every 64-bit word is a pair of uint32
 arrays and the compression function is expressed in paired ops (add with
-carry, rotate across the pair). The round loop is a lax.scan over the 80
-round constants; message blocks are processed in a static Python loop
-(callers hash fixed-length inputs -- the ed25519 preimage for the
-consensus hot path is 224 bytes = exactly 2 blocks after padding).
+carry, rotate across the pair). Rounds and message blocks are statically
+unrolled Python loops (callers hash fixed-length inputs -- the ed25519
+preimage for the consensus hot path is 224 bytes = exactly 2 blocks
+after padding); see _compress for why not lax.scan.
 
 Used for the ed25519 challenge hash k = SHA512(R || A || M).
 """
@@ -108,70 +108,70 @@ def _small_sigma1(h, l):
     return _xor3(_ror(h, l, 19), _ror(h, l, 61), _shr(h, l, 6))
 
 
-def _compress(state, wh, wl):
-    """One block: state (8, 2, N) uint32; wh/wl (N, 16)."""
-    a = [(state[i][0], state[i][1]) for i in range(8)]
-
-    # Message schedule + rounds as one scan of 80 steps over a sliding
-    # 16-word window carried in the loop state.
-    def round_body(carry, xs):
-        words_h, words_l, st = carry
-        kh, kl, idx = xs
-        # RING BUFFER schedule: the sliding 16-word window stays in
-        # place and round idx reads/writes slot idx % 16 with
-        # scalar-indexed dynamic slices. The previous formulation
-        # shifted the window with a (16, N) concatenate every round —
-        # ~32 N-wide copies per round, an order of magnitude more
-        # memory traffic than the round's ~30 ALU ops.
-        i0 = idx % 16
-
-        def at(ws, j):
-            return jax.lax.dynamic_index_in_dim(
-                ws, (idx + j) % 16, axis=0, keepdims=False
-            )
-
-        wh_t = at(words_h, 0)
-        wl_t = at(words_l, 0)
-        va, vb, vc, vd, ve, vf, vg, vh = st
-        s1 = _big_sigma1(*ve)
-        ch = (
-            (ve[0] & vf[0]) ^ (~ve[0] & vg[0]),
-            (ve[1] & vf[1]) ^ (~ve[1] & vg[1]),
-        )
-        t1h, t1l = _add3(*_add3(*vh, *s1, *ch), kh, kl, wh_t, wl_t)
-        s0 = _big_sigma0(*va)
-        maj = (
-            (va[0] & vb[0]) ^ (va[0] & vc[0]) ^ (vb[0] & vc[0]),
-            (va[1] & vb[1]) ^ (va[1] & vc[1]) ^ (vb[1] & vc[1]),
-        )
-        t2h, t2l = _add2(*s0, *maj)
-        new_e = _add2(*vd, t1h, t1l)
-        new_a = _add2(t1h, t1l, t2h, t2l)
-        st = (new_a, va, vb, vc, new_e, ve, vf, vg)
-        # extend schedule: w16 = ssigma1(w14) + w9 + ssigma0(w1) + w0,
-        # written into the slot just consumed
-        s0w = _small_sigma0(at(words_h, 1), at(words_l, 1))
-        s1w = _small_sigma1(at(words_h, 14), at(words_l, 14))
-        t = _add2(s1w[0], s1w[1], at(words_h, 9), at(words_l, 9))
-        t = _add2(*t, *s0w)
-        w16h, w16l = _add2(*t, wh_t, wl_t)
-        words_h = jax.lax.dynamic_update_index_in_dim(words_h, w16h, i0, axis=0)
-        words_l = jax.lax.dynamic_update_index_in_dim(words_l, w16l, i0, axis=0)
-        return (words_h, words_l, st), None
-
-    st0 = tuple(a)
-    words_h = jnp.swapaxes(wh, 0, 1)  # (16, N)
-    words_l = jnp.swapaxes(wl, 0, 1)
-    (_, _, st), _ = jax.lax.scan(
-        round_body,
-        (words_h, words_l, st0),
-        (_K_HI, _K_LO, jnp.arange(80)),
+def _round(st, wt, kh, kl):
+    """One SHA-512 round: st is the (a..h) tuple of (hi, lo) pairs."""
+    va, vb, vc, vd, ve, vf, vg, vh = st
+    s1 = _big_sigma1(*ve)
+    ch = (
+        (ve[0] & vf[0]) ^ (~ve[0] & vg[0]),
+        (ve[1] & vf[1]) ^ (~ve[1] & vg[1]),
     )
-    out = []
-    for i in range(8):
-        h, lo = _add2(state[i][0], state[i][1], st[i][0], st[i][1])
-        out.append((h, lo))
-    return out
+    t1h, t1l = _add3(*_add3(*vh, *s1, *ch), kh, kl, *wt)
+    s0 = _big_sigma0(*va)
+    maj = (
+        (va[0] & vb[0]) ^ (va[0] & vc[0]) ^ (vb[0] & vc[0]),
+        (va[1] & vb[1]) ^ (va[1] & vc[1]) ^ (vb[1] & vc[1]),
+    )
+    t2h, t2l = _add2(*s0, *maj)
+    return (
+        _add2(t1h, t1l, t2h, t2l), va, vb, vc,
+        _add2(*vd, t1h, t1l), ve, vf, vg,
+    )
+
+
+def _compress(state, wh, wl):
+    """One block: state (8, 2, N) uint32; wh/wl (N, 16).
+
+    Rounds run in 16-round CHUNKS: the first 16 statically, then a
+    lax.scan of 4 steps whose body unrolls 16 rounds. Sixteen rounds
+    advance the message-schedule ring buffer by exactly one full
+    revolution, so every w-slot index inside the chunk body is STATIC —
+    no scalar-indexed dynamic slices/updates. The earlier one-round
+    lax.scan needed dynamic ring indexing, which forced XLA into
+    per-round buffer shuffling on the (16, N) window (measured 13 ms
+    for the 10240-row two-block ed25519 challenge hash; this form cuts
+    stage 1 to ~3 ms — BENCHMARKS.md round 4). A FULL 80-round unroll
+    is not an option either: XLA:CPU compile time explodes (>9 min for
+    one block) while this chunked form compiles in seconds on both
+    backends."""
+    w = [(wh[:, i], wl[:, i]) for i in range(16)]
+    st = tuple((state[i][0], state[i][1]) for i in range(8))
+    for t in range(16):  # chunk 0: schedule read straight from the block
+        st = _round(st, w[t], jnp.uint32(_K[t] >> 32), jnp.uint32(_K[t] & 0xFFFFFFFF))
+
+    def chunk_body(carry, ks):
+        w, st = list(carry[0]), carry[1]
+        kh, kl = ks  # (16,) each
+        for j in range(16):
+            # w[t] = ssigma1(w[t-2]) + w[t-7] + ssigma0(w[t-15]) + w[t-16]
+            s0w = _small_sigma0(*w[(j + 1) % 16])
+            s1w = _small_sigma1(*w[(j + 14) % 16])
+            x = _add2(s1w[0], s1w[1], *w[(j + 9) % 16])
+            x = _add2(*x, *s0w)
+            wt = _add2(*x, *w[j])
+            w[j] = wt
+            st2 = _round(st, wt, kh[j], kl[j])
+            st = st2
+        return (tuple(w), st), None
+
+    ks = (
+        jnp.asarray(_K_HI[16:].reshape(4, 16)),
+        jnp.asarray(_K_LO[16:].reshape(4, 16)),
+    )
+    (_, st), _ = jax.lax.scan(chunk_body, (tuple(w), st), ks)
+    return [
+        _add2(state[i][0], state[i][1], st[i][0], st[i][1]) for i in range(8)
+    ]
 
 
 def sha512(msgs: jnp.ndarray) -> jnp.ndarray:

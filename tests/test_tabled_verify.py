@@ -439,6 +439,31 @@ def test_tables_disk_corruption_falls_back_to_build(tmp_path, monkeypatch):
     assert ok is not None and ok.all()
 
 
+def test_tables_disk_pubkey_mismatch_rebuilds(tmp_path, monkeypatch):
+    """A persisted blob under a reused valset key must NOT be trusted
+    when the pubkeys differ: the stored sha256(pubkeys) gates the load
+    (a wrong table silently flips signature-verification results)."""
+    from tendermint_tpu.models.verifier import VerifierModel
+
+    monkeypatch.setenv("TM_TABLES_CACHE_DIR", str(tmp_path))
+    key = b"reused-valset-key"
+    pks1, msgs1, sigs1 = _sign_rows(8, seed=41)
+    pk1, mg1, sg1 = _arrs(pks1, msgs1, sigs1)
+    idx = np.arange(8, dtype=np.int32)
+
+    m1 = VerifierModel(block_on_compile=True)
+    assert m1.verify_rows_cached(key, pk1, idx, mg1, sg1).all()
+    assert m1._valset_tables[key].source == "build"
+
+    # same key, DIFFERENT pubkeys: the persisted blob must be rejected
+    pks2, msgs2, sigs2 = _sign_rows(8, seed=43)
+    pk2, mg2, sg2 = _arrs(pks2, msgs2, sigs2)
+    m2 = VerifierModel(block_on_compile=True)
+    ok = m2.verify_rows_cached(key, pk2, idx, mg2, sg2)
+    assert m2._valset_tables[key].source == "build"  # rebuilt, not loaded
+    assert ok is not None and ok.all()
+
+
 def test_tables_disk_cache_bounded(tmp_path, monkeypatch):
     from tendermint_tpu.models import aot_cache
 
@@ -448,6 +473,6 @@ def test_tables_disk_cache_bounded(tmp_path, monkeypatch):
     t = np.zeros((4, 2, 8, 60), dtype=np.int32)
     a = np.ones(4, dtype=bool)
     for i in range(4):
-        aot_cache.save_tables(bytes([i]) * 8, t, a)
+        aot_cache.save_tables(bytes([i]) * 8, t, a, b"\x00" * 32)
     left = [f for f in os.listdir(tmp_path) if f.endswith(".npz")]
     assert len(left) == 2
