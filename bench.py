@@ -195,6 +195,53 @@ def _last_tpu_result():
         return None
 
 
+# -- regression guard ------------------------------------------------------
+#
+# Round-3 lesson: the flagship tabled path was broken by a last-minute
+# refactor and the bench silently degraded to the generic path — the
+# builder's own rig must catch that. When a previous real-accelerator
+# record exists, a sub-path that previously measured and now errors, or
+# that regresses beyond tolerance, hard-fails the bench (exit code 3)
+# with the failures listed in the emitted line.
+
+_GUARD_TOL = float(os.environ.get("TM_BENCH_GUARD_TOL", "0.20"))
+_GUARD_KEYS = [
+    ("value", "lower"),
+    ("generic_p50_ms", "lower"),
+    ("tabled_p50_ms", "lower"),
+    ("tabled_pipelined_ms", "lower"),
+    ("device_pipelined_ms", "lower"),
+    ("tabled_sigs_per_sec_sustained", "higher"),
+    ("sigs_per_sec_sustained", "higher"),
+    ("coldstart_first_verify_s", None),   # presence-only: timing varies
+    ("coldstart_tabled_first_s", None),
+]
+
+
+def _regression_guard(line: dict, platform: str) -> list:
+    """Failure strings comparing `line` to the last recorded accelerator
+    result; empty when clean (or no comparable record)."""
+    if os.environ.get("TM_BENCH_NO_GUARD") == "1" or platform == "cpu":
+        return []
+    last = _last_tpu_result()
+    if not last or last.get("platform") == "cpu":
+        return []
+    if int(last.get("bench_n", 10000)) != BENCH_N:
+        return []  # different batch size: numbers aren't comparable
+    fails = []
+    for key, direction in _GUARD_KEYS:
+        prev, cur = last.get(key), line.get(key)
+        if not isinstance(prev, (int, float)):
+            continue
+        if not isinstance(cur, (int, float)):
+            fails.append(f"{key}: previously {prev}, now missing/errored")
+        elif direction == "lower" and cur > prev * (1 + _GUARD_TOL):
+            fails.append(f"{key}: {prev} -> {cur} (regressed >{_GUARD_TOL:.0%})")
+        elif direction == "higher" and cur < prev * (1 - _GUARD_TOL):
+            fails.append(f"{key}: {prev} -> {cur} (regressed >{_GUARD_TOL:.0%})")
+    return fails
+
+
 def run_bench(platform: str, accelerator: bool = True):
     import numpy as np
     import jax
@@ -323,16 +370,20 @@ def run_bench(platform: str, accelerator: bool = True):
             import jax as _jax
             import jax.numpy as jnp
 
-            s1, s2, s3, _b = model._table_stage_fns()
-            n_pad = 10240
-            pk_d = _jax.device_put(jnp.asarray(model._pad(pks, n_pad)))
+            _, _, s3, _b = model._table_stage_fns()
+            s1d, s2d = model._dense_stage_fns()
+            # the table's own padded row count, NOT a hardcoded 10240:
+            # TM_BENCH_N smoke runs build smaller tables
+            n_pad = int(e.tables.shape[0])
             mg_d = _jax.device_put(jnp.asarray(model._pad(msgs, n_pad)))
             sg_d = _jax.device_put(jnp.asarray(model._pad(sigs, n_pad)))
-            idx_d = _jax.device_put(jnp.asarray(model._pad(idx, n_pad)))
+            pk_d = e.pk_dev[:n_pad]
+            tb_d, ao_d = e.tables[:n_pad], e.a_ok[:n_pad]
 
             def chain():
-                sd, kd, s_ok = s1(pk_d, mg_d, sg_d)
-                px, py, pz, pt, a_ok = s2(sd, kd, e.tables, e.a_ok, idx_d)
+                # dense full-commit shape: no index gathers anywhere
+                sd, kd, s_ok = s1d(pk_d, mg_d, sg_d)
+                px, py, pz, pt, a_ok = s2d(sd, kd, tb_d, ao_d)
                 return s3(px, py, pz, pt, sg_d, a_ok, s_ok)
 
             # deep queue, one final sync — stream_windows owns the sync
@@ -345,11 +396,12 @@ def run_bench(platform: str, accelerator: bool = True):
                 f"tabled pipelined: {tp*1e3:.1f} ms/commit "
                 f"({n/tp:,.0f} sigs/s sustained)"
             )
-    except Exception as ex:  # diagnostic only; never forfeit the main line
+    except Exception as ex:  # keep the main line; the guard below flags it
         import traceback
 
         traceback.print_exc(file=sys.stderr)
         log(f"tabled measurement failed: {ex!r}")
+        tabled["tabled_error"] = repr(ex)[:200]
 
     # -- pipelined device rate: launch K calls, sync once -----------------
     # The tunneled dev backend adds ~100ms of per-call transfer/sync
@@ -396,16 +448,30 @@ def run_bench(platform: str, accelerator: bool = True):
                 [sys.executable, os.path.abspath(__file__)],
                 env=env, capture_output=True, text=True, timeout=180,
             )
-            cs = json.loads(r.stdout.strip().splitlines()[-1])
-            aot_extra = {
-                "coldstart_backend_init_s": cs.get("backend_init_s"),
-                "coldstart_first_verify_s": cs.get("first_verify_s"),
-                "coldstart_tabled_first_s": cs.get("tabled_first_s"),
-                "coldstart_tables_source": cs.get("tables_source"),
-            }
-            log(f"fresh-process cold start: {cs}")
+            out_lines = r.stdout.strip().splitlines()
+            if r.returncode != 0 or not out_lines:
+                # a dead child must fail LOUDLY: its stderr carries the
+                # actual traceback (round-3 lesson: an IndexError here
+                # swallowed the TypeError that broke the tabled path)
+                for ln in r.stderr.strip().splitlines()[-20:]:
+                    log(f"  coldstart| {ln}")
+                aot_extra = {
+                    "coldstart_error": f"child rc={r.returncode}, "
+                    f"stdout lines={len(out_lines)} (stderr above)"
+                }
+                log(f"cold-start probe FAILED: child rc={r.returncode}")
+            else:
+                cs = json.loads(out_lines[-1])
+                aot_extra = {
+                    "coldstart_backend_init_s": cs.get("backend_init_s"),
+                    "coldstart_first_verify_s": cs.get("first_verify_s"),
+                    "coldstart_tabled_first_s": cs.get("tabled_first_s"),
+                    "coldstart_tables_source": cs.get("tables_source"),
+                }
+                log(f"fresh-process cold start: {cs}")
     except Exception as ex:
         log(f"cold-start probe failed: {ex!r}")
+        aot_extra = {"coldstart_error": repr(ex)[:200]}
 
     extra = {}
     if pipelined_ms is not None:
@@ -427,6 +493,7 @@ def run_bench(platform: str, accelerator: bool = True):
         "unit": "ms",
         "vs_baseline": round(baseline_10k / best_p50, 2),
         "platform": platform,
+        "bench_n": n,
         "cold_compile_s": round(cold_s, 1),
         "host_baseline_ms": round(baseline_10k * 1e3, 1),
         "generic_p50_ms": round(p50 * 1e3, 3),
@@ -434,6 +501,17 @@ def run_bench(platform: str, accelerator: bool = True):
         **tabled,
         **aot_extra,
     }
+    regressions = _regression_guard(line, platform)
+    if regressions:
+        # keep the PREVIOUS record as the baseline (recording the bad
+        # run would mask the regression on the next comparison), emit
+        # the line with the failures spelled out, and exit nonzero
+        line["regressions"] = regressions
+        for r in regressions:
+            log(f"REGRESSION: {r}")
+        print(json.dumps(line), flush=True)
+        _deadline_done()
+        sys.exit(3)
     if platform != "cpu":
         _record_tpu_result(line)
     # ONE construction of the output line: print it directly (emit()
@@ -467,6 +545,7 @@ def _supervise() -> int:
         json.dump({**_partial, "platform": "unknown"}, fp)
     env = dict(os.environ, TM_BENCH_INNER="1", TM_BENCH_STATE=state)
     child = subprocess.Popen([sys.executable, os.path.abspath(__file__)], env=env)
+    rc = None
     try:
         rc = child.wait(timeout=DEADLINE_S)
         if rc == 0:
@@ -482,10 +561,12 @@ def _supervise() -> int:
         child.wait()
     # A missing state file means the child already emitted its real line
     # (_deadline_done unlinks it right AFTER the emit) and then died in
-    # teardown — emitting again would print a second, worse line.
+    # teardown — emitting again would print a second, worse line. rc==3
+    # is the regression-guard verdict: propagate it (any other nonzero
+    # rc after a successful emit is XLA teardown noise, not a failure).
     if not os.path.exists(state):
         log("child emitted before dying; not double-emitting")
-        return 0
+        return 3 if rc == 3 else 0
     st = {}
     try:
         with open(state) as fp:
@@ -589,6 +670,10 @@ def main():
         traceback.print_exc(file=sys.stderr)
         emit(None, None, platform=platform, error=repr(e)[:400])
         _deadline_done()
+        # a total crash where a previous accelerator record exists is a
+        # regression by definition: fail loudly like the guard would
+        if platform != "cpu" and _last_tpu_result() is not None:
+            sys.exit(3)
         sys.exit(0)
 
 

@@ -21,6 +21,10 @@ import time
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 _USER_SET_PLATFORM = "JAX_PLATFORMS" in os.environ
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Bench-scoped table cache (mirrors bench.py): synthetic valset tables
+# must not land in the production dir where _prune_tables could evict a
+# real node's persisted tables and cost it the <5s restart path.
+os.environ.setdefault("TM_TABLES_CACHE_DIR", "/tmp/tm_bench_tables")
 
 
 def emit(metric, value, unit):
@@ -244,6 +248,72 @@ def bench_vote_ingest():
     emit(f"vote_ingest_{n}_total", dt * 1e3, "ms")
 
 
+def bench_fastsync():
+    """BASELINE eval 4: fast-sync replay verify — 4k-validator commits
+    across many heights through verify_commits_batched (the v2
+    processor's verify site, blockchain/v2/processor_context.go:42,
+    which the reference drives ONE serial VerifyCommit per block).
+
+    Host chain synthesis at full scale (10k blocks × 4k sigs = 40M
+    signatures) is host-bound, not a device property, so ONE 4k-sig
+    commit is signed and replayed across K heights; the verify work per
+    block is identical. Reports blocks/s and the projected 10k-block
+    replay time at that rate (labeled projected_*). EVAL4_HEIGHTS
+    overrides K (default 64; 256 with EVAL4_FULL=1)."""
+    from tendermint_tpu.codec.signbytes import PRECOMMIT_TYPE
+    from tendermint_tpu.crypto.batch import make_provider
+    from tendermint_tpu.crypto.keys import Ed25519PrivKey
+    from tendermint_tpu.types.block import BlockID, PartSetHeader
+    from tendermint_tpu.types.validator import Validator
+    from tendermint_tpu.types.validator_set import (
+        CommitVerifySpec,
+        ValidatorSet,
+        verify_commits_batched,
+    )
+    from tendermint_tpu.types.vote import Vote
+    from tendermint_tpu.types.vote_set import VoteSet
+
+    chain_id = "fastsync-bench"
+    n_vals = 4000
+    k = int(
+        os.environ.get(
+            "EVAL4_HEIGHTS", "256" if os.environ.get("EVAL4_FULL") == "1" else "64"
+        )
+    )
+    privs = [Ed25519PrivKey.from_secret(b"fs%d" % i) for i in range(n_vals)]
+    vals = ValidatorSet([Validator(p.pub_key(), 10) for p in privs])
+    by_addr = {p.pub_key().address(): p for p in privs}
+    bid = BlockID(b"\x31" * 32, PartSetHeader(1, b"\x32" * 32))
+    vs = VoteSet(chain_id, 1, 0, PRECOMMIT_TYPE, vals)
+    for i, val in enumerate(vals.validators):
+        v = Vote(
+            vote_type=PRECOMMIT_TYPE, height=1, round=0, block_id=bid,
+            timestamp_ns=1000 + i, validator_address=val.address,
+            validator_index=i,
+        )
+        v.signature = by_addr[val.address].sign(v.sign_bytes(chain_id))
+        vs.add_vote(v)
+    commit = vs.make_commit()
+
+    prov = make_provider("tpu")
+    specs = [
+        CommitVerifySpec(vals, chain_id, bid, 1, commit) for _ in range(k)
+    ]
+    # warm the streaming buckets out of the timed region (2 specs cover
+    # the window + tail shapes the full run touches)
+    errs = verify_commits_batched(specs[:2], provider=prov)
+    assert all(e is None for e in errs), errs[:1]
+
+    t0 = time.perf_counter()
+    errs = verify_commits_batched(specs, provider=prov)
+    dt = time.perf_counter() - t0
+    assert all(e is None for e in errs), errs[:1]
+
+    emit(f"fastsync_replay_verify_{n_vals}v_{k}blocks", dt * 1e3, "ms")
+    emit(f"fastsync_replay_blocks_per_s_{n_vals}v", k / dt, "blocks/s")
+    emit(f"fastsync_projected_10k_blocks_{n_vals}v", 10_000 / (k / dt), "s")
+
+
 def bench_mempool():
     """mempool/bench_test.go: CheckTx + Reap."""
     from tendermint_tpu.abci.client.local import LocalClient
@@ -390,6 +460,7 @@ BENCHES = {
     "headers": bench_headers_heights,
     "ingest": bench_vote_ingest,
     "sigs": bench_sig_scaling,
+    "fastsync": bench_fastsync,
     "mempool": bench_mempool,
     "secretconn": bench_secretconn,
     "valset": bench_valset,
@@ -398,7 +469,7 @@ BENCHES = {
 }
 
 
-_DEVICE_BENCHES = {"headers", "ingest", "sigs"}
+_DEVICE_BENCHES = {"headers", "ingest", "sigs", "fastsync"}
 
 if __name__ == "__main__":
     names = sys.argv[1:] or list(BENCHES)

@@ -1,0 +1,91 @@
+"""The bench regression guard (bench.py): a sub-path that previously
+measured on the accelerator and now errors — or regresses beyond
+tolerance — must hard-fail the bench instead of silently degrading
+(round-3 lesson: the tabled path broke and the bench fell back to the
+generic path without complaint).
+
+Reference for what the numbers mean: types/validator_set.go:641-668
+(the serial loop the tabled path replaces).
+"""
+
+import json
+
+import pytest
+
+
+@pytest.fixture()
+def bench(monkeypatch, tmp_path):
+    import bench as bench_mod
+
+    # point the guard at a synthetic "last recorded" file
+    rec = tmp_path / "last_tpu_result.json"
+    monkeypatch.setattr(bench_mod, "_LAST_TPU_PATH", str(rec))
+    monkeypatch.delenv("TM_BENCH_NO_GUARD", raising=False)
+    return bench_mod
+
+
+def _write_record(bench_mod, **fields):
+    import datetime
+
+    line = {
+        "platform": "tpu",
+        "bench_n": 10000,
+        "measured_at": datetime.datetime.now(datetime.timezone.utc).strftime(
+            "%Y-%m-%dT%H:%MZ"
+        ),
+        **fields,
+    }
+    with open(bench_mod._LAST_TPU_PATH, "w") as fp:
+        json.dump(line, fp)
+
+
+def test_guard_clean_when_no_record(bench):
+    assert bench._regression_guard({"value": 100.0}, "tpu") == []
+
+
+def test_guard_skips_cpu_platform(bench):
+    _write_record(bench, tabled_p50_ms=200.0)
+    assert bench._regression_guard({}, "cpu") == []
+
+
+def test_guard_flags_missing_subpath(bench):
+    # the round-3 failure mode: tabled previously measured, now errored
+    _write_record(bench, tabled_p50_ms=203.3, tabled_sigs_per_sec_sustained=278617)
+    line = {"value": 232.9, "generic_p50_ms": 232.9, "tabled_error": "TypeError(...)"}
+    fails = bench._regression_guard(line, "tpu")
+    assert any("tabled_p50_ms" in f and "missing" in f for f in fails)
+    assert any("tabled_sigs_per_sec_sustained" in f for f in fails)
+
+
+def test_guard_flags_latency_regression(bench):
+    _write_record(bench, tabled_p50_ms=100.0)
+    fails = bench._regression_guard({"tabled_p50_ms": 130.0}, "tpu")
+    assert len(fails) == 1 and "regressed" in fails[0]
+    # within tolerance: clean
+    assert bench._regression_guard({"tabled_p50_ms": 115.0}, "tpu") == []
+
+
+def test_guard_flags_throughput_regression(bench):
+    _write_record(bench, tabled_sigs_per_sec_sustained=278617)
+    fails = bench._regression_guard({"tabled_sigs_per_sec_sustained": 135818}, "tpu")
+    assert len(fails) == 1
+    assert bench._regression_guard({"tabled_sigs_per_sec_sustained": 280000}, "tpu") == []
+
+
+def test_guard_skips_mismatched_batch_size(bench):
+    _write_record(bench, tabled_p50_ms=100.0, bench_n=64)
+    assert bench._regression_guard({"tabled_p50_ms": 900.0}, "tpu") == []
+
+
+def test_guard_coldstart_presence_only(bench):
+    # coldstart timings vary run to run: only their DISAPPEARANCE fails
+    _write_record(bench, coldstart_first_verify_s=2.0)
+    assert bench._regression_guard({"coldstart_first_verify_s": 9.0}, "tpu") == []
+    fails = bench._regression_guard({"coldstart_error": "child rc=1"}, "tpu")
+    assert any("coldstart_first_verify_s" in f for f in fails)
+
+
+def test_guard_env_kill_switch(bench, monkeypatch):
+    _write_record(bench, tabled_p50_ms=100.0)
+    monkeypatch.setenv("TM_BENCH_NO_GUARD", "1")
+    assert bench._regression_guard({}, "tpu") == []
