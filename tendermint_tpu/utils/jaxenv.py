@@ -51,6 +51,81 @@ def force_cpu_platform(n_devices: Optional[int] = None) -> bool:
     return True
 
 
+def host_scope_cpu_caches() -> None:
+    """Scope the XLA:CPU persistent-cache dir to this host's ISA.
+
+    XLA:CPU lowers to the host instruction set; a serialized executable
+    compiled on another machine can SIGILL here, and the loader only
+    warns (cpu_aot_loader.cc). Keying the cache dir by the host machine
+    signature makes a foreign blob a cache MISS instead. (The in-repo
+    AOT cache does the same via its fingerprint — models/aot_cache.py.)
+    """
+    import jax
+
+    from tendermint_tpu.models.aot_cache import _host_machine_sig
+
+    base = os.environ.get("JAX_COMPILATION_CACHE_DIR", "/tmp/jax_cache")
+    scoped = os.path.join(base, f"cpu-{_host_machine_sig()}")
+    jax.config.update("jax_compilation_cache_dir", scoped)
+
+
+def filter_cpu_aot_noise():
+    """Filter the KNOWN-FALSE-POSITIVE cpu_aot_loader warnings from the
+    C++ stderr stream (fd 2), passing everything else through.
+
+    XLA bakes its own codegen tuning flags (+prefer-no-scatter,
+    +prefer-no-gather) into the serialized executable's feature string
+    and then compares that string against the host's CPU feature list
+    at load — flags that are not CPU features and never appear in the
+    host list, so EVERY load of a CPU executable warns "Machine type
+    ... doesn't match ... could lead to SIGILL", including a blob
+    compiled seconds earlier on this very machine (verified by
+    save/load probe in one process pair on one host). With the cache
+    dirs host-scoped (host_scope_cpu_caches + the AOT fingerprint), a
+    genuinely foreign executable can no longer load, which makes the
+    remaining warnings pure noise — drop exactly those lines.
+
+    Returns a restore() callable. Escape hatch: TM_RAW_CPP_STDERR=1
+    makes this a no-op."""
+    if os.environ.get("TM_RAW_CPP_STDERR") == "1":
+        return lambda: None
+    import threading
+
+    pattern = b"cpu_aot_loader"
+    r, w = os.pipe()
+    orig = os.dup(2)
+    os.dup2(w, 2)
+    os.close(w)
+    out_fd = os.dup(orig)
+
+    def pump():
+        buf = b""
+        with os.fdopen(r, "rb", 0) as rf:
+            while True:
+                chunk = rf.read(4096)
+                if not chunk:
+                    break
+                buf += chunk
+                while b"\n" in buf:
+                    line, buf = buf.split(b"\n", 1)
+                    if pattern not in line:
+                        os.write(out_fd, line + b"\n")
+            if buf and pattern not in buf:
+                os.write(out_fd, buf)
+        os.close(out_fd)
+
+    t = threading.Thread(target=pump, daemon=True, name="stderr-filter")
+    t.start()
+
+    def restore():
+        sys.stderr.flush()
+        os.dup2(orig, 2)  # drops the last ref to the pipe's write end
+        os.close(orig)
+        t.join(timeout=5)
+
+    return restore
+
+
 def probe_accelerator(timeout_s: float = 120) -> Tuple[int, str]:
     """(device_count, platform) of the default backend, probed IN A
     SUBPROCESS so a dead tunnel (which hangs instead of failing) can be
