@@ -1,9 +1,19 @@
-"""Address book: persisted peer addresses in new/old buckets.
+"""Address book: persisted peer addresses in hashed new/old buckets.
 
-Reference: p2p/pex/addrbook.go (886 lines) — bucketed storage (new =
-heard about, old = connected successfully at least once), deterministic
-bucket assignment by address+source groups, attempt counting with
-backoff, good/bad marking, JSON file persistence (p2p/pex/file.go).
+Reference: p2p/pex/addrbook.go (886 lines) + p2p/pex/params.go:16-31 —
+bucketed storage (new = heard about, old = connected successfully at
+least once), deterministic bucket assignment derived from address and
+source /16 groups, attempt counting, good/bad marking, JSON file
+persistence (p2p/pex/file.go).
+
+The bucket structure IS the eclipse-attack resistance (reference
+addrbook.go:94-136): a new-bucket index is a keyed hash of the SOURCE
+group plus a per-(address-group, source-group) subindex modulo
+NEW_BUCKETS_PER_GROUP — so all addresses funneled through one /16
+source land in at most 32 of the 256 new buckets, each bounded at
+NEW_BUCKET_SIZE entries, and pick_address draws a BUCKET first: a peer
+flooding the book can neither grow it without bound nor dominate dial
+selection.
 """
 
 from __future__ import annotations
@@ -19,9 +29,41 @@ from typing import Dict, List, Optional
 from tendermint_tpu.p2p.netaddress import NetAddress
 from tendermint_tpu.utils.log import get_logger
 
+# Reference p2p/pex/params.go:16-31.
 NEW_BUCKET_COUNT = 256
 OLD_BUCKET_COUNT = 64
+NEW_BUCKET_SIZE = 64
+OLD_BUCKET_SIZE = 64
+NEW_BUCKETS_PER_GROUP = 32
+OLD_BUCKETS_PER_GROUP = 4
+MAX_NEW_BUCKETS_PER_ADDRESS = 4
 MAX_ATTEMPTS = 10  # give up dialing after this many failures
+
+
+def group_key(addr: NetAddress) -> str:
+    """Source-group of an address (reference p2p/netaddress-based
+    groupKey): "local" for loopback/private, "unroutable" buckets the
+    rest of the junk together, /16 prefix for routable IPv4, /32 (4
+    nibbles) for IPv6, the hostname itself for names."""
+    if addr.local():
+        return "local"
+    if not addr.routable():
+        return "unroutable"
+    import ipaddress
+
+    try:
+        ip = ipaddress.ip_address(addr.host)
+    except ValueError:
+        return addr.host  # hostname: its own group
+    if ip.version == 4:
+        parts = addr.host.split(".")
+        return f"{parts[0]}.{parts[1]}"
+    return ip.exploded[:9]  # first two hextets
+
+
+def _sha256d_u64(data: bytes) -> int:
+    h = hashlib.sha256(hashlib.sha256(data).digest()).digest()
+    return int.from_bytes(h[:8], "big")
 
 
 @dataclass
@@ -34,6 +76,7 @@ class _KnownAddress:
     last_attempt: float = 0.0
     last_success: float = 0.0
     bucket_type: str = "new"  # new | old
+    buckets: List[int] = field(default_factory=list)
 
     def is_old(self) -> bool:
         return self.bucket_type == "old"
@@ -46,6 +89,7 @@ class _KnownAddress:
             "last_attempt": self.last_attempt,
             "last_success": self.last_success,
             "bucket_type": self.bucket_type,
+            "buckets": list(self.buckets),
         }
 
     @classmethod
@@ -57,19 +101,45 @@ class _KnownAddress:
             last_attempt=d.get("last_attempt", 0.0),
             last_success=d.get("last_success", 0.0),
             bucket_type=d.get("bucket_type", "new"),
+            buckets=[int(b) for b in d.get("buckets", [])],
         )
 
 
 class AddrBook:
-    def __init__(self, file_path: str = "", strict: bool = True, logger=None):
+    def __init__(
+        self, file_path: str = "", strict: bool = True, logger=None,
+        key: Optional[str] = None,
+    ):
         self._file_path = file_path
         self._strict = strict
         self.logger = logger or get_logger("pex.addrbook")
         self._addrs: Dict[str, _KnownAddress] = {}  # by node id
+        self._new: List[Dict[str, _KnownAddress]] = [
+            {} for _ in range(NEW_BUCKET_COUNT)
+        ]
+        self._old: List[Dict[str, _KnownAddress]] = [
+            {} for _ in range(OLD_BUCKET_COUNT)
+        ]
         self._our_ids: set = set()
         self._rng = random.Random(0xADD2)
+        # per-book secret salting the bucket hashes (reference a.key,
+        # addrbook.go:112): without it an attacker who knows the code
+        # could grind addresses into one target bucket
+        self._key = key if key is not None else "%024x" % random.getrandbits(96)
         if file_path and os.path.exists(file_path):
             self.load()
+
+    # -- bucket math (reference calcNewBucket/calcOldBucket) ---------------
+
+    def _calc_new_bucket(self, addr: NetAddress, src: NetAddress) -> int:
+        ga, gs = group_key(addr), group_key(src)
+        sub = _sha256d_u64(f"{self._key}{ga}{gs}".encode()) % NEW_BUCKETS_PER_GROUP
+        return _sha256d_u64(f"{self._key}{gs}{sub}".encode()) % NEW_BUCKET_COUNT
+
+    def _calc_old_bucket(self, addr: NetAddress) -> int:
+        sub = _sha256d_u64(f"{self._key}{addr}".encode()) % OLD_BUCKETS_PER_GROUP
+        ga = group_key(addr)
+        return _sha256d_u64(f"{self._key}{ga}{sub}".encode()) % OLD_BUCKET_COUNT
 
     # -- our own addresses -------------------------------------------------
 
@@ -87,16 +157,67 @@ class AddrBook:
             return False
         if self._strict and not addr.routable() and not addr.local():
             return False
+        if src is None:
+            src = addr  # self-reported
         ka = self._addrs.get(addr.id)
         if ka is not None:
-            # keep old-bucket state; refresh the address
-            ka.addr = addr
+            if ka.is_old():
+                return False  # already vetted; new sightings don't demote
+            ka.addr = addr  # refresh
+            if len(ka.buckets) >= MAX_NEW_BUCKETS_PER_ADDRESS:
+                return False
+            # the more buckets it's already in, the less often it gains
+            # another (reference :187 region: 1/2^n chance)
+            if self._rng.randrange(1 << len(ka.buckets)) != 0:
+                return False
+        bucket = self._calc_new_bucket(addr, src)
+        if ka is not None and bucket in ka.buckets:
             return False
-        self._addrs[addr.id] = _KnownAddress(addr=addr, src=src)
-        return True
+        if ka is None:
+            ka = _KnownAddress(addr=addr, src=src)
+            self._addrs[addr.id] = ka
+            added = True
+        else:
+            added = False
+        self._add_to_new_bucket(ka, bucket)
+        return added
+
+    def _add_to_new_bucket(self, ka: _KnownAddress, bucket: int) -> None:
+        b = self._new[bucket]
+        if ka.addr.id in b:
+            return
+        if len(b) >= NEW_BUCKET_SIZE:
+            self._expire_new(bucket)
+        b[ka.addr.id] = ka
+        ka.buckets.append(bucket)
+
+    def _expire_new(self, bucket: int) -> None:
+        """Make room: drop the stalest entry of a full new bucket
+        (reference expireNew :224 — bad first, else oldest)."""
+        b = self._new[bucket]
+        victim = None
+        for ka in b.values():  # anything that looks bad goes first
+            if ka.attempts >= MAX_ATTEMPTS:
+                victim = ka
+                break
+        if victim is None:
+            victim = min(b.values(), key=lambda k: (k.last_attempt, k.last_success))
+        self._remove_from_new_bucket(victim, bucket)
+        if not victim.buckets and not victim.is_old():
+            self._addrs.pop(victim.addr.id, None)
+
+    def _remove_from_new_bucket(self, ka: _KnownAddress, bucket: int) -> None:
+        self._new[bucket].pop(ka.addr.id, None)
+        if bucket in ka.buckets:
+            ka.buckets.remove(bucket)
 
     def remove_address(self, addr: NetAddress) -> None:
-        self._addrs.pop(addr.id, None)
+        ka = self._addrs.pop(addr.id, None)
+        if ka is None:
+            return
+        for b in list(ka.buckets):
+            (self._old if ka.is_old() else self._new)[b].pop(addr.id, None)
+        ka.buckets.clear()
 
     def has_address(self, addr: NetAddress) -> bool:
         return addr.id in self._addrs
@@ -116,12 +237,33 @@ class AddrBook:
             ka.last_attempt = time.time()
 
     def mark_good(self, node_id: str) -> None:
-        """Successful connection → old bucket (reference MarkGood :263)."""
+        """Successful connection → old bucket (reference MarkGood :263 →
+        moveToOld :599)."""
         ka = self._addrs.get(node_id)
-        if ka is not None:
-            ka.attempts = 0
-            ka.last_success = time.time()
-            ka.bucket_type = "old"
+        if ka is None:
+            return
+        ka.attempts = 0
+        ka.last_success = time.time()
+        if ka.is_old():
+            return
+        # leave every new bucket, enter exactly one old bucket
+        for b in list(ka.buckets):
+            self._remove_from_new_bucket(ka, b)
+        ka.bucket_type = "old"
+        bucket = self._calc_old_bucket(ka.addr)
+        ob = self._old[bucket]
+        if len(ob) >= OLD_BUCKET_SIZE:
+            # displace the stalest old entry back into a new bucket
+            # (reference moveToOld's freed slot dance)
+            victim = min(ob.values(), key=lambda k: k.last_success)
+            ob.pop(victim.addr.id, None)
+            victim.buckets.clear()
+            victim.bucket_type = "new"
+            self._add_to_new_bucket(
+                victim, self._calc_new_bucket(victim.addr, victim.src or victim.addr)
+            )
+        ob[ka.addr.id] = ka
+        ka.buckets = [bucket]
 
     def mark_bad(self, addr: NetAddress) -> None:
         self.remove_address(addr)
@@ -129,17 +271,39 @@ class AddrBook:
     # -- selection ---------------------------------------------------------
 
     def pick_address(self, new_bias_pct: int = 30) -> Optional[NetAddress]:
-        """Random address biased between new/old buckets (reference
-        PickAddress :216)."""
+        """Random address, BUCKET FIRST (reference PickAddress :216):
+        choose new-vs-old by bias, then a uniform non-empty bucket of
+        that type, then a uniform address within it — a source group
+        confined to NEW_BUCKETS_PER_GROUP buckets gets at most its
+        bucket share of picks, however many addresses it pushed."""
         if not self._addrs:
             return None
-        news = [ka for ka in self._addrs.values() if not ka.is_old()]
-        olds = [ka for ka in self._addrs.values() if ka.is_old()]
-        pool = news if (self._rng.random() * 100 < new_bias_pct and news) else (olds or news)
-        candidates = [ka for ka in pool if ka.attempts < MAX_ATTEMPTS]
-        if not candidates:
-            return None
-        return self._rng.choice(candidates).addr
+        pick_new = self._rng.random() * 100 < new_bias_pct
+        for bucket_set in self._ordered_sets(pick_new):
+            occupied = [b for b in bucket_set if b]
+            if not occupied:
+                continue
+            for _ in range(8):  # retry budget over attempt-capped rows
+                b = self._rng.choice(occupied)
+                ka = self._rng.choice(list(b.values()))
+                if ka.attempts < MAX_ATTEMPTS:
+                    return ka.addr
+            # unlucky draws must not report an empty book: fall back to
+            # an exhaustive scan so a pick happens whenever any
+            # eligible address exists (bucket-first bias is a
+            # statistical property, not a correctness one)
+            eligible = [
+                ka
+                for b in occupied
+                for ka in b.values()
+                if ka.attempts < MAX_ATTEMPTS
+            ]
+            if eligible:
+                return self._rng.choice(eligible).addr
+        return None
+
+    def _ordered_sets(self, pick_new: bool):
+        return (self._new, self._old) if pick_new else (self._old, self._new)
 
     def get_selection(self, max_count: int = 30) -> List[NetAddress]:
         """Random subset for PEX responses (reference GetSelection :291)."""
@@ -156,7 +320,7 @@ class AddrBook:
         if not self._file_path:
             return
         doc = {
-            "key": "addrbook",
+            "key": self._key,
             "addrs": [ka.to_json() for ka in self._addrs.values()],
         }
         tmp = self._file_path + ".tmp"
@@ -169,8 +333,34 @@ class AddrBook:
         try:
             with open(self._file_path) as fp:
                 doc = json.load(fp)
+            k = doc.get("key", "")
+            # adopt only real random keys (24 hex chars); the legacy
+            # format stored the literal "addrbook" here — adopting a
+            # publicly-known key would let an attacker grind addresses
+            # into chosen buckets, defeating the keyed hash entirely
+            if len(k) == 24 and all(c in "0123456789abcdef" for c in k):
+                self._key = k  # bucket placement stays stable
             for d in doc.get("addrs", []):
                 ka = _KnownAddress.from_json(d)
+                recorded, ka.buckets = ka.buckets, []
                 self._addrs[ka.addr.id] = ka
+                if ka.is_old():
+                    b = recorded[0] if recorded else self._calc_old_bucket(ka.addr)
+                    if not 0 <= b < OLD_BUCKET_COUNT or len(self._old[b]) >= OLD_BUCKET_SIZE:
+                        b = self._calc_old_bucket(ka.addr)
+                    if len(self._old[b]) < OLD_BUCKET_SIZE:
+                        self._old[b][ka.addr.id] = ka
+                        ka.buckets = [b]
+                    else:  # overflowing legacy/corrupt file: demote
+                        ka.bucket_type = "new"
+                        self._add_to_new_bucket(
+                            ka, self._calc_new_bucket(ka.addr, ka.src or ka.addr)
+                        )
+                else:
+                    good = [b for b in recorded if 0 <= b < NEW_BUCKET_COUNT]
+                    if not good:
+                        good = [self._calc_new_bucket(ka.addr, ka.src or ka.addr)]
+                    for b in good[:MAX_NEW_BUCKETS_PER_ADDRESS]:
+                        self._add_to_new_bucket(ka, b)
         except Exception as e:
             self.logger.error("failed to load addrbook", err=str(e))

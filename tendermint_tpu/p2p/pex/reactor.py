@@ -3,8 +3,9 @@
 Reference: p2p/pex/pex_reactor.go — channel 0x00 (PexChannel :36),
 Receive (request→GetSelection response, response→addrbook add),
 ensurePeersRoutine :330 (keep outbound count up by dialing from the
-book), request throttling per peer, seed mode (:470 crawler — here seeds
-simply serve addresses and disconnect surplus peers).
+book), request throttling per peer, seed mode: serve-then-hangup plus
+the crawl loop (crawlPeersRoutine :470) that keeps a seed's book fresh
+by periodically dialing known addresses and asking them for more.
 """
 
 from __future__ import annotations
@@ -86,7 +87,8 @@ class PEXReactor(Reactor):
         return [ChannelDescriptor(id=PEX_CHANNEL, priority=1, send_queue_capacity=10)]
 
     async def start(self) -> None:
-        self._task = asyncio.create_task(self._ensure_peers_routine())
+        routine = self._crawl_routine if self.seed_mode else self._ensure_peers_routine
+        self._task = asyncio.create_task(routine())
 
     async def stop(self) -> None:
         if self._task is not None:
@@ -148,6 +150,11 @@ class PEXReactor(Reactor):
             src = peer.socket_addr()
             for addr in addrs:
                 self.book.add_address(addr, src=src)
+            if self.seed_mode and self.switch is not None:
+                # crawl complete for this peer: harvest then hang up
+                # (reference crawlPeers — a seed holds no long-lived
+                # outbound slots)
+                await self.switch.stop_peer_gracefully(peer)
 
     def _request_addrs(self, peer: Peer) -> None:
         if peer.id in self._requested:
@@ -207,3 +214,56 @@ class PEXReactor(Reactor):
                         return
                 except Exception:
                     continue
+
+    # -- seed crawl (reference crawlPeersRoutine pex_reactor.go:470) -------
+
+    MAX_CRAWLS_PER_ROUND = 8
+
+    async def _crawl_routine(self) -> None:
+        """Seeds don't maintain outbound slots; they CRAWL — dial known
+        addresses, ask each for its peers, hang up — so the book they
+        serve stays fresh instead of decaying into dead entries."""
+        try:
+            while True:
+                await self._crawl_peers()
+                await asyncio.sleep(self._ensure_period_s)
+        except asyncio.CancelledError:
+            raise
+        except Exception as e:
+            self.logger.error("crawl routine died", err=repr(e))
+
+    async def _crawl_peers(self) -> None:
+        if self.switch is None:
+            return
+        if self.book.is_empty() and self.seeds:
+            for seed in self.seeds:  # bootstrap the book off other seeds
+                try:
+                    p = await self.switch.dial_peer(seed)
+                    if p is not None:
+                        self._request_addrs(p)
+                        return
+                except Exception:
+                    continue
+            return
+        crawled = 0
+        tried = set()
+        while crawled < self.MAX_CRAWLS_PER_ROUND:
+            addr = self.book.pick_address(new_bias_pct=70)  # freshness bias
+            if addr is None or addr.id in tried:
+                break
+            tried.add(addr.id)
+            if addr.id in self.switch.peers or self.book.our_address(addr):
+                continue
+            self.book.mark_attempt(addr)
+            crawled += 1
+            try:
+                peer = await self.switch.dial_peer(addr)
+            except Exception as e:
+                self.logger.debug("crawl dial failed", addr=str(addr), err=str(e))
+                continue
+            if peer is None:
+                continue
+            self.book.mark_good(peer.id)
+            # the response handler hangs up after harvesting (seed_mode
+            # branch in receive())
+            self._request_addrs(peer)

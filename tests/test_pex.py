@@ -87,6 +87,95 @@ def test_get_selection_bounded():
     assert len({a.id for a in sel}) == 30
 
 
+# -- bucket structure (eclipse resistance) ---------------------------------
+#
+# Reference p2p/pex/addrbook.go:94-136 + params.go:16-31: a new-bucket
+# index is keyed by the SOURCE /16 group, so one source group is
+# confined to NEW_BUCKETS_PER_GROUP of the NEW_BUCKET_COUNT buckets.
+
+
+def _flood_addr(i: int) -> NetAddress:
+    # unique routable addresses spread across many /16s
+    return NetAddress(
+        f"{i:040x}", f"45.{1 + i % 200}.{(i // 200) % 250 + 1}.{i % 250 + 1}", 26656
+    )
+
+
+def test_one_source_group_confined_to_bucket_share():
+    from tendermint_tpu.p2p.pex.addrbook import (
+        NEW_BUCKET_SIZE,
+        NEW_BUCKETS_PER_GROUP,
+    )
+
+    book = AddrBook(strict=True, key="00" * 12)
+    src = NetAddress("cc" * 20, "45.1.9.9", 26656)  # ONE /16 source group
+    for i in range(5000):
+        book.add_address(_flood_addr(i), src=src)
+    occupied = [b for b in book._new if b]
+    assert len(occupied) <= NEW_BUCKETS_PER_GROUP, (
+        f"one source group spread into {len(occupied)} buckets"
+    )
+    # each bucket bounded -> the whole flood is bounded
+    assert all(len(b) <= NEW_BUCKET_SIZE for b in occupied)
+    assert book.size() <= NEW_BUCKETS_PER_GROUP * NEW_BUCKET_SIZE
+
+
+def test_many_source_groups_spread_wider_than_one():
+    book = AddrBook(strict=True, key="00" * 12)
+    for i in range(2000):
+        src = NetAddress("dd" * 20, f"{20 + i % 50}.{i % 200}.1.1", 26656)
+        book.add_address(_flood_addr(i), src=src)
+    occupied = sum(1 for b in book._new if b)
+    assert occupied > 32  # many groups use many buckets
+
+
+def test_flooder_cannot_dominate_pick_address():
+    """2000 addresses pushed through one source group vs ONE honest
+    address from another: bucket-first picking gives the honest address
+    ~1/33 of picks, not ~1/2001 (the flat-dict failure mode)."""
+    book = AddrBook(strict=True, key="00" * 12)
+    flood_src = NetAddress("cc" * 20, "45.1.9.9", 26656)
+    for i in range(2000):
+        book.add_address(_flood_addr(i), src=flood_src)
+    honest = NetAddress("ee" * 20, "99.88.77.66", 26656)
+    book.add_address(honest, src=NetAddress("ff" * 20, "99.88.1.1", 26656))
+    hits = sum(
+        1 for _ in range(2000) if book.pick_address(new_bias_pct=100) == honest
+    )
+    assert hits > 20, f"honest address picked only {hits}/2000 times"
+
+
+def test_mark_good_moves_to_old_bucket_and_back_pressure():
+    from tendermint_tpu.p2p.pex.addrbook import OLD_BUCKET_COUNT
+
+    book = AddrBook(strict=False, key="00" * 12)
+    for i in range(40):
+        a = na(i + 1)
+        book.add_address(a)
+        book.mark_good(a.id)
+    olds = sum(len(b) for b in book._old)
+    assert olds == 40
+    assert sum(len(b) for b in book._new) == 0
+    assert all(len(b) <= OLD_BUCKET_COUNT for b in book._old)
+
+
+def test_bucketed_persistence_roundtrip(tmp_path):
+    path = str(tmp_path / "addrbook.json")
+    book = AddrBook(path, strict=False, key="00" * 12)
+    for i in range(1, 30):
+        book.add_address(na(i))
+    book.mark_good(na(1).id)
+    book.save()
+    book2 = AddrBook(path, strict=False)
+    assert book2.size() == book.size()
+    assert book2._key == book._key  # bucket placement stays stable
+    assert book2._addrs[na(1).id].is_old()
+    # every loaded entry is actually IN the bucket its record names
+    for ka in book2._addrs.values():
+        sets = book2._old if ka.is_old() else book2._new
+        assert ka.buckets and all(ka.addr.id in sets[b] for b in ka.buckets)
+
+
 # -- reactor ---------------------------------------------------------------
 
 
@@ -124,6 +213,53 @@ def test_pex_discovery_via_common_peer():
                 await asyncio.sleep(0.01)
             assert a.transport.listen_addr.id in c.peers, "C never discovered A"
             assert books[2].has_address(a.transport.listen_addr)
+        finally:
+            await stop_switches(switches)
+
+    run(go())
+
+
+def test_seed_crawler_refreshes_book_and_hangs_up():
+    """Reference crawlPeersRoutine (pex_reactor.go:470): a seed dials
+    known addresses, harvests their peers into its book, and does NOT
+    hold the connections open."""
+
+    async def go():
+        books = {}
+
+        def init(i, sw):
+            books[i] = AddrBook(strict=False)
+            sw.add_reactor("pex", PEXReactor(books[i], ensure_period_s=30))
+
+        switches = await make_connected_switches(2, init=init)
+        a, b = switches
+        try:
+
+            def init_seed(sw):
+                books["seed"] = AddrBook(strict=False)
+                sw.add_reactor(
+                    "pex",
+                    PEXReactor(books["seed"], seed_mode=True, ensure_period_s=0.2),
+                )
+
+            s = await make_switch(2, init=init_seed)
+            # the seed knows only B; the crawl must discover A through it
+            books["seed"].add_address(b.transport.listen_addr)
+            await s.start()
+            switches.append(s)
+
+            for _ in range(600):
+                if books["seed"].has_address(a.transport.listen_addr):
+                    break
+                await asyncio.sleep(0.01)
+            assert books["seed"].has_address(a.transport.listen_addr)
+            # crawl connections are transient: the seed hangs up after
+            # harvesting
+            for _ in range(300):
+                if not s.peers:
+                    break
+                await asyncio.sleep(0.01)
+            assert not s.peers
         finally:
             await stop_switches(switches)
 
