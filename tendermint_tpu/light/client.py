@@ -3,8 +3,9 @@
 Reference: lite2/client.go — Client :120, initialization from
 TrustOptions :275 region, VerifyHeaderAtHeight :480, verifyHeader :550,
 sequence :620, bisection :687 (pivot at 9/16, client.go:30-31),
-backwards :883, compareNewHeaderWithWitnesses :931, RemovePrimary/
-witness replacement :1034, AutoUpdate/prune.
+backwards :883, compareNewHeaderWithWitnesses :931, primary failover
+(replacePrimaryProvider :1034, invoked from :662, :744, :911),
+AutoUpdate/prune.
 """
 
 from __future__ import annotations
@@ -62,12 +63,57 @@ class LightClient:
         self.primary = primary
         self.witnesses = list(witnesses)
         self.store = store
+        self.max_retry_attempts = max_retry_attempts
         if mode not in ("bisection", "sequence"):
             raise ValueError(f"unknown verification mode {mode!r}")
         self.mode = mode
         self.sequence_window = sequence_window
         self.logger = logger or get_logger("light")
         self._initialized = False
+
+    # -- primary failover --------------------------------------------------
+
+    async def _from_primary(self, method: str, *args):
+        """Fetch from the primary with retry; on persistent failure
+        promote a witness to primary and keep going (reference
+        replacePrimaryProvider lite2/client.go:1034 — a dead or
+        unreachable primary is an availability event, not a hard
+        failure, as long as any witness remains).
+
+        Deterministic provider answers (height not found / not yet
+        produced — ProviderError) PROPAGATE instead of triggering
+        failover: every healthy witness would answer the same way, so
+        replacing would burn the whole witness list over a caller
+        asking for a height past the tip."""
+        from tendermint_tpu.light.provider import ProviderError
+
+        while True:
+            last_err: Optional[Exception] = None
+            for _ in range(max(1, self.max_retry_attempts)):
+                try:
+                    return await getattr(self.primary, method)(*args)
+                except ProviderError:
+                    raise
+                except Exception as e:
+                    last_err = e
+            self._replace_primary(last_err)
+
+    def _replace_primary(self, err: Optional[Exception]) -> None:
+        """Promote the first witness to primary, dropping the failed
+        primary entirely (reference replacePrimaryProvider :1034)."""
+        if not self.witnesses:
+            raise LightClientError(
+                f"primary unavailable and no witnesses left to promote: {err!r}"
+            )
+        old = self.primary
+        self.primary = self.witnesses.pop(0)
+        self.logger.info(
+            "primary replaced with witness",
+            old=getattr(old, "name", repr(old))[:40],
+            new=getattr(self.primary, "name", repr(self.primary))[:40],
+            err=repr(err)[:120],
+            witnesses_left=len(self.witnesses),
+        )
 
     # -- initialization ----------------------------------------------------
 
@@ -78,13 +124,13 @@ class LightClient:
             return
         h = self.store.signed_header(self.trust_options.height)
         if h is None:
-            sh = await self.primary.signed_header(self.trust_options.height)
+            sh = await self._from_primary("signed_header", self.trust_options.height)
             if sh.hash() != self.trust_options.hash:
                 raise LightClientError(
                     f"expected header hash {self.trust_options.hash.hex()[:12]}, "
                     f"got {sh.hash().hex()[:12]}"
                 )
-            vals = await self.primary.validator_set(sh.height)
+            vals = await self._from_primary("validator_set", sh.height)
             if sh.header.validators_hash != vals.hash():
                 raise LightClientError("validators mismatch at trusted height")
             # ★ one batched device call
@@ -106,7 +152,7 @@ class LightClient:
             if existing is not None:
                 return existing
             return await self._backwards(height, now)
-        sh = await self.primary.signed_header(height)
+        sh = await self._from_primary("signed_header", height)
         if sh.height <= latest_trusted_h:
             got = self.store.signed_header(sh.height)
             return got if got is not None else sh
@@ -128,7 +174,7 @@ class LightClient:
         if latest is None:
             raise LightClientError("no trusted state; call initialize")
         trusted_sh, trusted_vals = latest
-        new_vals = await self.primary.validator_set(new_header.height)
+        new_vals = await self._from_primary("validator_set", new_header.height)
         if self.mode == "sequence":
             await self._sequence(trusted_sh, trusted_vals, new_header, new_vals, now)
         else:
@@ -157,8 +203,8 @@ class LightClient:
             while True:
                 sh, vals = headers_cache.get(try_h, (None, None))
                 if sh is None:
-                    sh = await self.primary.signed_header(try_h)
-                    vals = await self.primary.validator_set(try_h)
+                    sh = await self._from_primary("signed_header", try_h)
+                    vals = await self._from_primary("validator_set", try_h)
                     headers_cache[try_h] = (sh, vals)
                 try:
                     verifier.verify(
@@ -202,8 +248,8 @@ class LightClient:
         async def fetch(h):
             if h == target:
                 return new_header, new_vals
-            sh = await self.primary.signed_header(h)
-            vals = await self.primary.validator_set(h)
+            sh = await self._from_primary("signed_header", h)
+            vals = await self._from_primary("validator_set", h)
             return sh, vals
 
         cur_sh, cur_vals = trusted_sh, trusted_vals
@@ -232,10 +278,10 @@ class LightClient:
         if cur is None or height >= first_h:
             raise LightClientError(f"cannot get header at height {height}")
         while cur.height > height + 1:
-            prev = await self.primary.signed_header(cur.height - 1)
+            prev = await self._from_primary("signed_header", cur.height - 1)
             verifier.verify_backwards(self.chain_id, prev, cur)
             cur = prev
-        target = await self.primary.signed_header(height)
+        target = await self._from_primary("signed_header", height)
         verifier.verify_backwards(self.chain_id, target, cur)
         return target
 

@@ -179,6 +179,70 @@ def test_client_rejects_wrong_trusted_hash():
     run(go())
 
 
+class _DyingProvider(MockProvider):
+    """Serves normally for `live_calls` fetches, then fails every call
+    (a primary dying mid-bisection)."""
+
+    def __init__(self, chain_id, headers, vals, live_calls: int):
+        super().__init__(chain_id, headers, vals)
+        self._live = live_calls
+
+    def _tick(self):
+        if self._live <= 0:
+            raise ConnectionError("primary is dead")
+        self._live -= 1
+
+    async def signed_header(self, height: int):
+        self._tick()
+        return await super().signed_header(height)
+
+    async def validator_set(self, height: int):
+        self._tick()
+        return await super().validator_set(height)
+
+
+def test_client_primary_failover_mid_bisection():
+    """Reference replacePrimaryProvider (lite2/client.go:1034, call
+    sites :662,:744,:911): when the primary dies mid-verification a
+    witness is promoted and the client completes."""
+
+    async def go():
+        k = keys(8)
+        changes = {5: k[2:6] + keys(2, tag="x"), 10: k[4:8] + keys(2, tag="y")}
+        headers, vals = gen_chain(15, key_changes=changes, base_keys=k[:4])
+        # primary serves init + the first couple of fetches, then dies
+        primary = _DyingProvider(CHAIN_ID, headers, vals, live_calls=5)
+        witness = MockProvider(CHAIN_ID, headers, vals)
+        opts = TrustOptions(period_ns=PERIOD, height=1, hash=headers[1].hash())
+        c = LightClient(
+            CHAIN_ID, opts, primary, [witness], TrustedStore(MemDB()),
+            max_retry_attempts=2,
+        )
+        sh = await c.verify_header_at_height(15, now_ns=NOW)
+        assert sh.hash() == headers[15].hash()
+        assert c.primary is witness  # promoted
+        assert c.witnesses == []  # and removed from the witness list
+
+    run(go())
+
+
+def test_client_primary_dead_no_witnesses_hard_fails():
+    async def go():
+        headers, vals = gen_chain(5)
+        primary = _DyingProvider(CHAIN_ID, headers, vals, live_calls=0)
+        opts = TrustOptions(period_ns=PERIOD, height=1, hash=headers[1].hash())
+        from tendermint_tpu.light.client import LightClientError
+
+        c = LightClient(
+            CHAIN_ID, opts, primary, [], TrustedStore(MemDB()),
+            max_retry_attempts=2,
+        )
+        with pytest.raises(LightClientError, match="no witnesses"):
+            await c.verify_header_at_height(5, now_ns=NOW)
+
+    run(go())
+
+
 def test_client_prune():
     async def go():
         headers, vals = gen_chain(12)
