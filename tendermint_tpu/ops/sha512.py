@@ -138,12 +138,12 @@ def _compress(state, wh, wl):
     revolution, so every w-slot index inside the chunk body is STATIC —
     no scalar-indexed dynamic slices/updates. The earlier one-round
     lax.scan needed dynamic ring indexing, which forced XLA into
-    per-round buffer shuffling on the (16, N) window (measured 13 ms
-    for the 10240-row two-block ed25519 challenge hash; this form cuts
-    stage 1 to ~3 ms — BENCHMARKS.md round 4). A FULL 80-round unroll
-    is not an option either: XLA:CPU compile time explodes (>9 min for
-    one block) while this chunked form compiles in seconds on both
-    backends."""
+    per-round buffer shuffling on the (16, N) window — switching to
+    chunks cut the tabled verify's measured stage-1 time from 13.0 to
+    7.6 ms at 10240 rows on a v5e (BENCHMARKS.md round 4). A FULL
+    80-round unroll is not an option either: XLA:CPU compile time
+    explodes (>9 min for one block) while this chunked form compiles
+    in seconds on both backends."""
     w = [(wh[:, i], wl[:, i]) for i in range(16)]
     st = tuple((state[i][0], state[i][1]) for i in range(8))
     for t in range(16):  # chunk 0: schedule read straight from the block
