@@ -142,11 +142,26 @@ class _Entry:
         self.compile_s: Optional[float] = None
 
 
-# Per-valset cached tables kept device-resident (LRU): ~12KB/validator
-# (SPLITS*8 affine-cached points), so a 10k set is ~123MB of HBM per
+# Per-valset cached tables kept device-resident (LRU): ~30KB/validator
+# (SPLITS*8 affine-cached points), so a 10k set is ~315MB of HBM per
 # entry. Two entries cover the live pattern (current set + next set
 # around a validator-set change).
 MAX_CACHED_VALSETS = 2
+
+# Largest validator slice per table-build dispatch: the build's affine
+# conversion holds (rows*SPLITS*8, 20, 20) int32 intermediates, so one
+# 65536-row dispatch wants ~30GB of HBM (half the reason: the padded
+# outer product) — 8192-row chunks keep the build under ~2GB in flight.
+_TABLE_BUILD_CHUNK = 8192
+
+# Largest valset the cached-table path engages for. The reference caps
+# commits at 10k votes (types/vote_set.go:18 MaxVotesCount); beyond
+# ~16k validators the tables stop paying for themselves — ~30KB/row of
+# HBM (2GB at 50k) plus huge-shape stage compiles, and the 50k-ingest
+# eval measured the whole process slowing ~50x while a 65536-row table
+# was resident and its buckets were compiling. Oversized sets ride the
+# generic pipeline, which handles 50k ingest at ~20k votes/s.
+MAX_TABLED_VALSET = int(os.environ.get("TM_MAX_TABLED_VALSET", "16384"))
 
 
 class _TablesEntry:
@@ -549,7 +564,7 @@ class VerifierModel:
             return self._table_stages
         # Mesh path: rows shard over the batch axis, the valset tables
         # REPLICATE (each device gathers its shard's rows from a full
-        # local copy — ~12KB/validator/device; no cross-device gather).
+        # local copy — ~30KB/validator/device; no cross-device gather).
         # The per-device program is identical to the single-device one,
         # so compile cost is O(1) in mesh size, like the generic stages.
         batch, rep = self._shard_specs()
@@ -585,7 +600,7 @@ class VerifierModel:
         """Single-device DENSE tabled stages for the full-commit shape
         (row i == validator i): stage 1 consumes the device-resident
         pubkey matrix directly and stage 2 skips the per-row table
-        gather — TPU gathers serialize, and the ~12KB/row table gather
+        gather — TPU gathers serialize, and the ~30KB/row table gather
         was ~30% of stage-2 time at 10k rows."""
         cached = getattr(self, "_dense_stages", None)
         if cached is not None:
@@ -618,7 +633,20 @@ class VerifierModel:
             e.source = "disk"
         else:
             _, _, _, build = self._table_stage_fns()
-            tables, a_ok = build(jnp.asarray(pk_pad))
+            if v_pad > _TABLE_BUILD_CHUNK:
+                # the build program's post-scan affine conversion holds
+                # (rows*SPLITS*8, 20, 20) intermediates — one shot at
+                # 65536 rows wants ~30GB of HBM (observed OOM at 50k
+                # validators). Chunk the BUILD only; the result is one
+                # contiguous device table either way.
+                parts = [
+                    build(jnp.asarray(pk_pad[off : off + _TABLE_BUILD_CHUNK]))
+                    for off in range(0, v_pad, _TABLE_BUILD_CHUNK)
+                ]
+                tables = jnp.concatenate([t for t, _ in parts])
+                a_ok = jnp.concatenate([a for _, a in parts])
+            else:
+                tables, a_ok = build(jnp.asarray(pk_pad))
             e.source = "build"
         # device-resident pubkey matrix for the gathered stage-1: rows
         # gather by validator index ON DEVICE, so per-commit H2D carries
@@ -628,7 +656,7 @@ class VerifierModel:
         if self.mesh is not None:
             # replicate ONCE at build: the shard_map scan consumes the
             # tables with a replicated spec, and leaving them committed
-            # to one device would re-broadcast ~12KB/validator to every
+            # to one device would re-broadcast ~30KB/validator to every
             # device on every verify dispatch
             from jax.sharding import NamedSharding, PartitionSpec
 
@@ -653,7 +681,10 @@ class VerifierModel:
 
     def _tables_entry(self, key: bytes, pubkeys: np.ndarray) -> Optional[_TablesEntry]:
         """The ready tables entry for `key`, or None when still cold
-        (async build kicked off in non-blocking mode)."""
+        (async build kicked off in non-blocking mode) or the set is too
+        large for the tabled path (see MAX_TABLED_VALSET)."""
+        if int(pubkeys.shape[0]) > MAX_TABLED_VALSET:
+            return None
         with self._lock:
             e = self._valset_tables.get(key)
             if e is not None:
@@ -712,7 +743,8 @@ class VerifierModel:
         return None
 
     def verify_rows_cached(
-        self, valset_key: bytes, all_pubkeys, row_idx, msgs, sigs
+        self, valset_key: bytes, all_pubkeys, row_idx, msgs, sigs,
+        _window_tail: bool = False,
     ) -> Optional[np.ndarray]:
         """Verify rows whose pubkeys are all_pubkeys[row_idx] against the
         per-valset cached tables (single device, or a mesh: rows shard
@@ -722,6 +754,9 @@ class VerifierModel:
 
         row_idx MUST index into all_pubkeys; rows are independent, so
         duplicate indices are fine (the trusting path may produce them).
+        _window_tail is internal: the windowed path's tail slice must
+        not hit the small-batch gather policy (the windows already ran;
+        nullifying the tail would discard all their device work).
         """
         n = int(len(row_idx))
         if n == 0:
@@ -739,6 +774,15 @@ class VerifierModel:
             )
         msg_len = int(msgs.shape[1])
         n_pad = _bucket(n, self._pad_multiple())
+        idx_np = np.asarray(row_idx, dtype=np.int32)
+        dense = self._dense_applies(e, idx_np, n, n_pad)
+        if not dense and not _window_tail and int(e.tables.shape[0]) > 4 * n_pad:
+            # small gathered batch against a huge table: the per-row
+            # ~30KB table gather goes pathological when the table
+            # dwarfs the batch (measured: 50k-validator ingest in
+            # 2048-vote drains fell from 19.9k votes/s generic to 436
+            # through this path) — the generic pipeline wins there
+            return None
         # the bucket key includes the table's padded row count (see
         # _tabled_bucket_entry): a valset that grows past its pad bucket
         # must re-warm, not run a synchronous compile on the live path
@@ -747,24 +791,35 @@ class VerifierModel:
             self._compile_tabled_async(ent, e, n_pad, msg_len)
             return None
         _, _, s3, _ = self._table_stage_fns()
-        idx_np = np.asarray(row_idx, dtype=np.int32)
         mg = jnp.asarray(self._pad(np.asarray(msgs, dtype=np.uint8), n_pad))
         sg = jnp.asarray(self._pad(np.asarray(sigs, dtype=np.uint8), n_pad))
         t0 = time.perf_counter()
-        if self._dense_applies(e, idx_np, n, n_pad):
-            # full-commit shape (row i == validator i): no gathers at all
-            s1d, s2d = self._dense_stage_fns()
-            sd, kd, s_ok = s1d(e.pk_dev[:n_pad], mg, sg)
-            px, py, pz, pt, a_ok = s2d(
-                sd, kd, e.tables[:n_pad], e.a_ok[:n_pad]
+        try:
+            if dense:
+                # full-commit shape (row i == validator i): no gathers
+                s1d, s2d = self._dense_stage_fns()
+                sd, kd, s_ok = s1d(e.pk_dev[:n_pad], mg, sg)
+                px, py, pz, pt, a_ok = s2d(
+                    sd, kd, e.tables[:n_pad], e.a_ok[:n_pad]
+                )
+            else:
+                s1, s2, _, _ = self._table_stage_fns()
+                idx = jnp.asarray(self._pad(idx_np, n_pad))
+                sd, kd, s_ok = s1(e.pk_dev, idx, mg, sg)
+                px, py, pz, pt, a_ok = s2(sd, kd, e.tables, e.a_ok, idx)
+            ok = s3(px, py, pz, pt, sg, a_ok, s_ok)
+            out = np.asarray(ok)[:n]
+        except Exception as ex:
+            # None-means-fallback, never an exception into commit
+            # verification: a transient device/remote-compile failure
+            # (observed: the TPU tunnel dropping a compile response
+            # mid-read) must degrade to the generic path, not crash the
+            # node. NOT latched as e.failed — the tables themselves are
+            # fine and the next call may succeed.
+            self.logger.error(
+                "tabled verify failed (falling back)", rows=n, err=repr(ex)[:200]
             )
-        else:
-            s1, s2, _, _ = self._table_stage_fns()
-            idx = jnp.asarray(self._pad(idx_np, n_pad))
-            sd, kd, s_ok = s1(e.pk_dev, idx, mg, sg)
-            px, py, pz, pt, a_ok = s2(sd, kd, e.tables, e.a_ok, idx)
-        ok = s3(px, py, pz, pt, sg, a_ok, s_ok)
-        out = np.asarray(ok)[:n]
+            return None
         if not ent.ready:
             ent.compile_s = time.perf_counter() - t0
             ent.ready = True
@@ -834,11 +889,15 @@ class VerifierModel:
         win_ent.ready = True  # compile timing lives in the AOT layer
         parts = [np.asarray(o) for o in outs]
         if full_end < n:
-            # true reuse of the bucketed path for the tail slice
+            # true reuse of the bucketed path for the tail slice;
+            # _window_tail bypasses the small-batch gather policy (the
+            # windows already ran — nullifying the tail would discard
+            # all their device work)
             tail = self.verify_rows_cached(
-                valset_key, all_pubkeys, idx[full_end:], mg[full_end:], sg[full_end:]
+                valset_key, all_pubkeys, idx[full_end:], mg[full_end:],
+                sg[full_end:], _window_tail=True,
             )
-            if tail is None:  # pragma: no cover - racing table eviction
+            if tail is None:  # racing eviction or compile failure
                 return None
             parts.append(tail)
         return np.concatenate(parts) if parts else np.zeros(0, dtype=bool)

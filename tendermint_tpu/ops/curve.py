@@ -200,8 +200,8 @@ _TBL = 8  # signed-window table holds [1..8]Q
 # [16^(SPLIT_W*m)]Q per chunk turns 256 shared doublings into
 # 4*SPLIT_W — the doubling half of the Straus scan all but
 # disappears when Q (a validator pubkey) is stable across heights.
-# 16 splits (16 shared doublings, ~24KB of table per validator) measured
-# faster than 8 (32 doublings, ~12KB) on v5e: the doubling runs are pure
+# 16 splits (16 shared doublings, ~30KB of table per validator) measured
+# faster than 8 (32 doublings, ~15KB) on v5e: the doubling runs are pure
 # serial VPU latency while the extra table HBM is cheap next to the
 # per-madd arithmetic.
 SPLITS = 16

@@ -160,8 +160,9 @@ def verify_stage_scan_tabled_dense(sd, kd, tables, a_ok):
     """Tabled stage 2, DENSE case: row i IS validator i (a full commit
     in validator order — the hot shape), so the per-row table gather
     disappears entirely. TPU gathers serialize on the scatter/gather
-    unit; skipping one over the ~12KB/row tables was worth ~10ms of the
-    35ms stage-2 time at 10k rows (see BENCHMARKS.md round 4)."""
+    unit; skipping it was worth ~10ms of the 35ms stage-2 time at 10k
+    rows when measured (12KB/row tables at SPLITS=8 then; ~30KB now —
+    see BENCHMARKS.md round 4)."""
     p = curve.double_scalar_mul_tabled(sd, kd, tables)
     return p.x, p.y, p.z, p.t, a_ok
 

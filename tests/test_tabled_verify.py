@@ -464,6 +464,38 @@ def test_tables_disk_pubkey_mismatch_rebuilds(tmp_path, monkeypatch):
     assert ok is not None and ok.all()
 
 
+def test_oversized_valset_skips_tabled_path(monkeypatch):
+    """Sets beyond MAX_TABLED_VALSET must ride the generic pipeline:
+    the 50k-ingest eval measured the huge-table path ~50x slower end
+    to end (HBM-resident 2GB tables + huge-shape compiles)."""
+    from tendermint_tpu.models import verifier as vmod
+
+    monkeypatch.setattr(vmod, "MAX_TABLED_VALSET", 8)
+    pks, msgs, sigs = _sign_rows(12, seed=51)
+    pk, mg, sg = _arrs(pks, msgs, sigs)
+    m = vmod.VerifierModel(block_on_compile=True)
+    out = m.verify_rows_cached(b"big-valset", pk, np.arange(12, dtype=np.int32), mg, sg)
+    assert out is None  # caller falls back to the generic path
+    assert b"big-valset" not in m._valset_tables  # nothing was built
+
+
+def test_small_gathered_batch_against_huge_table_falls_back(monkeypatch):
+    """A gathered batch the table dwarfs (>4x padded rows) returns None
+    rather than running the pathological per-row table gather."""
+    from tendermint_tpu.models.verifier import VerifierModel
+
+    pks, msgs, sigs = _sign_rows(80, seed=53)
+    pk, mg, sg = _arrs(pks, msgs, sigs)
+    m = VerifierModel(block_on_compile=True)
+    # full-set call (dense) builds the 80-row (pad 256) tables
+    ok = m.verify_rows_cached(b"gather-valset", pk, np.arange(80, dtype=np.int32), mg, sg)
+    assert ok is not None and ok.all()
+    # 3-row gathered subset: 256 > 4*16 -> generic fallback
+    sub = np.array([5, 2, 9], dtype=np.int32)
+    out = m.verify_rows_cached(b"gather-valset", pk, sub, mg[:3], sg[:3])
+    assert out is None
+
+
 def test_tables_disk_cache_bounded(tmp_path, monkeypatch):
     from tendermint_tpu.models import aot_cache
 
