@@ -150,9 +150,16 @@ MAX_CACHED_VALSETS = 2
 
 # Largest validator slice per table-build dispatch: the build's affine
 # conversion holds (rows*SPLITS*8, 20, 20) int32 intermediates, so one
-# 65536-row dispatch wants ~30GB of HBM (half the reason: the padded
-# outer product) — 8192-row chunks keep the build under ~2GB in flight.
-_TABLE_BUILD_CHUNK = 8192
+# 65536-row dispatch wants ~30GB of HBM (observed OOM at 50k
+# validators) while 16384 rows stay ~3.4GB in flight — chosen so every
+# build at the DEFAULT MAX_TABLED_VALSET (16384) remains one-shot and
+# chunking only engages for env-raised caps.
+_TABLE_BUILD_CHUNK = 16384
+
+# The small-gathered-batch policy below only applies to tables beyond
+# this row count: the ~50x pathology was measured against a 65536-row
+# (~2GB) table; small and mid tables gather fine (round-3 ingest data).
+_GATHER_POLICY_MIN_TABLE = 16384
 
 # Largest valset the cached-table path engages for. The reference caps
 # commits at 10k votes (types/vote_set.go:18 MaxVotesCount); beyond
@@ -776,7 +783,12 @@ class VerifierModel:
         n_pad = _bucket(n, self._pad_multiple())
         idx_np = np.asarray(row_idx, dtype=np.int32)
         dense = self._dense_applies(e, idx_np, n, n_pad)
-        if not dense and not _window_tail and int(e.tables.shape[0]) > 4 * n_pad:
+        if (
+            not dense
+            and not _window_tail
+            and int(e.tables.shape[0]) > _GATHER_POLICY_MIN_TABLE
+            and int(e.tables.shape[0]) > 4 * n_pad
+        ):
             # small gathered batch against a huge table: the per-row
             # ~30KB table gather goes pathological when the table
             # dwarfs the batch (measured: 50k-validator ingest in
@@ -878,16 +890,26 @@ class VerifierModel:
         mg = np.asarray(msgs, dtype=np.uint8)
         sg = np.asarray(sigs, dtype=np.uint8)
         idx = np.asarray(row_idx, dtype=np.int32)
-        outs = []
-        for off in range(0, full_end, window):
-            sl = slice(off, off + window)
-            idx_d = jnp.asarray(idx[sl])
-            sg_d = jnp.asarray(sg[sl])
-            sd, kd, s_ok = s1(e.pk_dev, idx_d, jnp.asarray(mg[sl]), sg_d)
-            px, py, pz, pt, a_ok = s2(sd, kd, e.tables, e.a_ok, idx_d)
-            outs.append(s3(px, py, pz, pt, sg_d, a_ok, s_ok))
-        win_ent.ready = True  # compile timing lives in the AOT layer
-        parts = [np.asarray(o) for o in outs]
+        try:
+            outs = []
+            for off in range(0, full_end, window):
+                sl = slice(off, off + window)
+                idx_d = jnp.asarray(idx[sl])
+                sg_d = jnp.asarray(sg[sl])
+                sd, kd, s_ok = s1(e.pk_dev, idx_d, jnp.asarray(mg[sl]), sg_d)
+                px, py, pz, pt, a_ok = s2(sd, kd, e.tables, e.a_ok, idx_d)
+                outs.append(s3(px, py, pz, pt, sg_d, a_ok, s_ok))
+            win_ent.ready = True  # compile timing lives in the AOT layer
+            parts = [np.asarray(o) for o in outs]
+        except Exception as ex:
+            # same None-means-fallback contract as the bucketed branch:
+            # a transient device/compile failure mid-window degrades the
+            # whole batch to the generic path, never crashes replay
+            self.logger.error(
+                "tabled windowed verify failed (falling back)",
+                rows=n, err=repr(ex)[:200],
+            )
+            return None
         if full_end < n:
             # true reuse of the bucketed path for the tail slice;
             # _window_tail bypasses the small-batch gather policy (the

@@ -480,19 +480,25 @@ def test_oversized_valset_skips_tabled_path(monkeypatch):
 
 
 def test_small_gathered_batch_against_huge_table_falls_back(monkeypatch):
-    """A gathered batch the table dwarfs (>4x padded rows) returns None
-    rather than running the pathological per-row table gather."""
-    from tendermint_tpu.models.verifier import VerifierModel
+    """A gathered batch the table dwarfs (>4x padded rows, table above
+    the policy floor) returns None rather than running the pathological
+    per-row table gather. Below the floor the tabled path still serves
+    small drains (the pathology was only measured on ~2GB tables)."""
+    from tendermint_tpu.models import verifier as vmod
 
     pks, msgs, sigs = _sign_rows(80, seed=53)
     pk, mg, sg = _arrs(pks, msgs, sigs)
-    m = VerifierModel(block_on_compile=True)
+    m = vmod.VerifierModel(block_on_compile=True)
     # full-set call (dense) builds the 80-row (pad 256) tables
     ok = m.verify_rows_cached(b"gather-valset", pk, np.arange(80, dtype=np.int32), mg, sg)
     assert ok is not None and ok.all()
-    # 3-row gathered subset: 256 > 4*16 -> generic fallback
     sub = np.array([5, 2, 9], dtype=np.int32)
-    out = m.verify_rows_cached(b"gather-valset", pk, sub, mg[:3], sg[:3])
+    # below the policy floor: the gathered path still engages
+    out = m.verify_rows_cached(b"gather-valset", pk, sub, mg[sub], sg[sub])
+    assert out is not None and out.all()
+    # floor lowered: 256 > 4*16 and 256 > floor -> generic fallback
+    monkeypatch.setattr(vmod, "_GATHER_POLICY_MIN_TABLE", 64)
+    out = m.verify_rows_cached(b"gather-valset", pk, sub, mg[sub], sg[sub])
     assert out is None
 
 
