@@ -335,7 +335,7 @@ def run_bench(platform: str, accelerator: bool = True):
     # -- per-valset cached-table path (round 3) ---------------------------
     # The live verify_commit hot path: tables of each -A precomputed once
     # per valset (pubkeys are stable across heights), leaving sha512 +
-    # a 32-doubling scan + blocked-inversion encode per commit.
+    # a 16-doubling (4*SPLIT_W) scan + blocked-inversion encode per commit.
     tabled = {}
     tabled_p50 = None
     try:

@@ -13,7 +13,7 @@ jax.profiler.trace for xprof/tensorboard analysis (the trace dir is
 printed). Stage split (models/verifier.py cached-table path):
 
     s1  sha512 challenge + canonical-s + signed recode
-    s2  table gather + 32-doubling/128-madd split scan   <- dominant
+    s2  table gather + 16-doubling/96-madd split scan    <- dominant
     s3  blocked-inversion encode + R compare
 
 Reference loop being replaced: types/validator_set.go:641-668.

@@ -549,11 +549,11 @@ class VerifierModel:
     # Validator pubkeys are stable across heights (the reference
     # re-verifies the same keys every block, types/validator_set.go:641).
     # build_valset_tables hoists everything key-dependent out of the
-    # per-commit program: decompression, the per-row table build and 224
-    # of 256 shared doublings. verify_rows_cached is the resulting fast
-    # path: challenge hash + 32-doubling split scan + blocked-inversion
-    # encode, with each row's table gathered by validator index on
-    # device.
+    # per-commit program: decompression, the per-row table build and 240
+    # of 256 shared doublings (256 - 4*SPLIT_W). verify_rows_cached is
+    # the resulting fast path: challenge hash + 16-doubling (4*SPLIT_W)
+    # split scan + blocked-inversion encode, with each row's table
+    # gathered by validator index on device.
 
     def _table_stage_fns(self):
         cached = getattr(self, "_table_stages", None)

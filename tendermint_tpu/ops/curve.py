@@ -553,7 +553,8 @@ def double_scalar_mul_tabled(
     build_split_tables (gathered per row).
 
     The key side runs SPLIT_W scan iterations x (4 doublings + SPLITS
-    mixed adds) — 32 doublings total vs 256 for the untabled scan, no
+    mixed adds) — 4*SPLIT_W (=16) doublings total vs 256 for the
+    untabled scan, no
     per-row table build, no decompression. The base side rides a
     doubling-free 8-bit comb: 32 mixed adds of MXU-selected constant
     entries (_select_comb256) appended after the scan — half the base

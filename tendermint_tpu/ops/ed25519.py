@@ -104,9 +104,10 @@ def verify_stage_finish_tally(px, py, pz, pt, sigs, a_ok, s_ok, power_chunks, co
 # the same keys every block (types/validator_set.go:641). Precomputing
 # split tables of each -A once per valset (curve.build_split_tables)
 # removes from the per-commit path: pubkey decompression (~16ms @10k),
-# the per-row [1..8]Q table build, and 224 of the 256 shared doublings.
-# The per-commit program is then: sha512 challenge + digit recode + a
-# 32-doubling/128-mixed-add scan + blocked-inversion encode.
+# the per-row [1..8]Q table build, and 240 of the 256 shared doublings
+# (256 - 4*SPLIT_W). The per-commit program is then: sha512 challenge +
+# digit recode + a 16-doubling/96-mixed-add scan (64 key-side + 32
+# base-comb adds) + blocked-inversion encode.
 
 
 def build_valset_tables(pubkeys: jnp.ndarray):
@@ -150,7 +151,7 @@ def verify_stage_prepare_tabled_gathered(pk_all, idx, msgs, sigs):
 def verify_stage_scan_tabled(sd, kd, tables, a_ok, idx):
     """Tabled stage 2: gather each row's key table by validator index
     (device gather along the leading axis — large contiguous rows, DMA
-    friendly) and run the 32-doubling split scan."""
+    friendly) and run the 4*SPLIT_W-doubling split scan."""
     row_tables = jnp.take(tables, idx, axis=0)
     p = curve.double_scalar_mul_tabled(sd, kd, row_tables)
     return p.x, p.y, p.z, p.t, jnp.take(a_ok, idx, axis=0)

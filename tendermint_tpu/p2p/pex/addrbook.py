@@ -22,6 +22,7 @@ import hashlib
 import json
 import os
 import random
+import secrets
 import time
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional
@@ -121,11 +122,16 @@ class AddrBook:
             {} for _ in range(OLD_BUCKET_COUNT)
         ]
         self._our_ids: set = set()
-        self._rng = random.Random(0xADD2)
+        # unpredictable stream: the 1/2^n bucket-admission draw and the
+        # bucket-first pick must not be grindable by a peer — Mersenne
+        # Twister state is recoverable from observed outputs, so use the
+        # OS CSPRNG for the draws themselves, not just the seed
+        self._rng = random.SystemRandom()
         # per-book secret salting the bucket hashes (reference a.key,
-        # addrbook.go:112): without it an attacker who knows the code
-        # could grind addresses into one target bucket
-        self._key = key if key is not None else "%024x" % random.getrandbits(96)
+        # addrbook.go:112, crypto.CRandHex(24)): without it an attacker
+        # who knows the code could grind addresses into one target
+        # bucket — so it must come from the OS CSPRNG
+        self._key = key if key is not None else secrets.token_hex(12)
         if file_path and os.path.exists(file_path):
             self.load()
 

@@ -91,7 +91,22 @@ def filter_cpu_aot_noise():
         return lambda: None
     import threading
 
-    pattern = b"cpu_aot_loader"
+    # A line is dropped only when its TRIGGERING feature (the loader
+    # names it: "Target machine feature <X> is not supported") is one
+    # of the codegen tuning flags XLA bakes into every feature string.
+    # Merely CONTAINING the flag names is not enough — every modern
+    # blob's compile-feature dump lists them, including a genuinely
+    # foreign-ISA blob's — so a real mismatch (triggered by e.g.
+    # +avx512fp16 on an un-scoped shared cache dir) passes through.
+    tag = b"cpu_aot_loader"
+    fp_triggers = (
+        b"machine feature +prefer-no-scatter is not",
+        b"machine feature +prefer-no-gather is not",
+    )
+
+    def is_noise(line: bytes) -> bool:
+        return tag in line and any(f in line for f in fp_triggers)
+
     r, w = os.pipe()
     orig = os.dup(2)
     os.dup2(w, 2)
@@ -108,9 +123,9 @@ def filter_cpu_aot_noise():
                 buf += chunk
                 while b"\n" in buf:
                     line, buf = buf.split(b"\n", 1)
-                    if pattern not in line:
+                    if not is_noise(line):
                         os.write(out_fd, line + b"\n")
-            if buf and pattern not in buf:
+            if buf and not is_noise(buf):
                 os.write(out_fd, buf)
         os.close(out_fd)
 

@@ -14,9 +14,17 @@ import sys
 # factory DIALS THE TPU TUNNEL at backend init — a dead tunnel would
 # hang the whole test run). The workaround lives in one place:
 # tendermint_tpu.utils.jaxenv (shared with bench.py / __graft_entry__).
-from tendermint_tpu.utils.jaxenv import force_cpu_platform  # noqa: E402
+from tendermint_tpu.utils.jaxenv import (  # noqa: E402
+    filter_cpu_aot_noise,
+    force_cpu_platform,
+)
 
 assert force_cpu_platform(8), "a JAX backend initialized before conftest"
+# The AOT loader warns (one ~3KB feature-dump line, twice) on EVERY
+# persistent-cache executable load — known false positives (see
+# filter_cpu_aot_noise) that bury real stderr from failing tests.
+# TM_RAW_CPP_STDERR=1 bypasses.
+filter_cpu_aot_noise()
 # subprocess tests: make child interpreters skip axon registration too
 # (the sitecustomize hook is gated on this env var)
 os.environ["PYTHONPATH"] = ":".join(
