@@ -1,0 +1,182 @@
+#!/usr/bin/env python
+"""Consensus-with-TPU e2e at scale: a live 4-node net whose vote path
+carries a LARGE simulated validator set through the tabled device
+verifier — eval 1's actual deployment shape, not a microbench.
+
+4 real validators hold quorum (the net keeps committing on its own);
+N_SIM simulated validators' prevotes+precommits are signed and injected
+through the normal peer-vote path every (height, round), so every
+block's ingest drains N_SIM-vote batches through
+consensus/state._handle_vote_batch -> vote_set.add_votes_batched ->
+the templated cached-table pipeline. Reported:
+
+    e2e_scale_blocks_per_s_<n>    blocks/s over the measured window
+    e2e_scale_ms_per_block_<n>    inverse, for eyeballing
+    e2e_scale_vote_batch_p50_ms   p50 add_votes_batched latency
+    e2e_scale_votes_ingested      total simulated votes accepted
+
+    python benchmarks/e2e_scale.py              # 1,000 simulated
+    EVAL1_FULL=1 python benchmarks/e2e_scale.py # 4,000 simulated
+
+Reference path being replaced: consensus/reactor.go:606
+(gossipVotesRoutine) -> vote_set.go:201 per-vote serial verify.
+"""
+
+import asyncio
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+_USER_SET_PLATFORM = "JAX_PLATFORMS" in os.environ
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault("TM_TABLES_CACHE_DIR", "/tmp/tm_bench_tables")
+# the consensus nodes must pick the TPU provider, not the conftest CPU pin
+os.environ.pop("TM_CRYPTO_PROVIDER", None)
+
+N_REAL = 4
+N_SIM = 4000 if os.environ.get("EVAL1_FULL") == "1" else 1000
+HEIGHTS = int(os.environ.get("E2E_HEIGHTS", "8"))
+
+
+def emit(metric, value, unit):
+    print(json.dumps({"metric": metric, "value": round(value, 4), "unit": unit}))
+
+
+def main():
+    sys.path.insert(
+        0,
+        os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "tests"),
+    )
+    from tendermint_tpu.codec.signbytes import PRECOMMIT_TYPE, PREVOTE_TYPE
+    from tendermint_tpu.config import default_config
+    from tendermint_tpu.consensus.reactor import ConsensusReactor
+    from tendermint_tpu.consensus.round_state import STEP_PRECOMMIT, STEP_PREVOTE
+    from tendermint_tpu.crypto.batch import make_provider, set_default_provider
+    from tendermint_tpu.p2p.test_util import connect_switches, make_switch, stop_switches
+    from tendermint_tpu.state.state import state_from_genesis_doc
+    from tendermint_tpu.types.block import BlockID
+    from tendermint_tpu.types.vote import Vote
+    from tendermint_tpu.types import vote_set as vote_set_mod
+    from tests.cs_harness import CHAIN_ID, make_genesis, make_node
+
+    prov = make_provider("tpu")  # block_on_compile: warm out of band below
+    set_default_provider(prov)
+
+    # per-batch ingest latency, observed at the real call site
+    batch_ms = []
+    orig_add = vote_set_mod.VoteSet.add_votes_batched
+
+    def timed_add(self, votes):
+        t0 = time.perf_counter()
+        out = orig_add(self, votes)
+        if len(votes) >= N_SIM // 2:  # only the swarm drains, not 4-vote rounds
+            batch_ms.append((time.perf_counter() - t0) * 1e3)
+        return out
+
+    vote_set_mod.VoteSet.add_votes_batched = timed_add
+
+    async def go():
+        powers = [N_SIM * 10] * N_REAL + [1] * N_SIM
+        genesis, privs = make_genesis(N_REAL + N_SIM, powers=powers)
+        st = state_from_genesis_doc(genesis)
+        real, sims = [], []
+        for vi, val in enumerate(st.validators.validators):
+            (real if val.voting_power > 1 else sims).append((vi, privs[vi]))
+        assert len(real) == N_REAL
+
+        # warm the device path out of the timed region, like a node
+        # start does: tables + the swarm-drain bucket
+        key, all_pk, _ = st.validators.batch_cache()
+        prov.register_valset(key, all_pk)
+
+        cfg = default_config().consensus
+        cfg.create_empty_blocks = True
+
+        nodes = [await make_node(genesis, pv, config=cfg) for _, pv in real]
+        reactors = [ConsensusReactor(n.cs) for n in nodes]
+        switches = []
+        for i in range(N_REAL):
+            def init(sw, _i=i):
+                sw.add_reactor("consensus", reactors[_i])
+            switches.append(await make_switch(i, network=CHAIN_ID, init=init))
+        for sw in switches:
+            await sw.start()
+        await connect_switches(switches)
+
+        stop_evt = asyncio.Event()
+        injected = [0]
+
+        async def inject(node):
+            done = set()
+            while not stop_evt.is_set():
+                rs = node.cs.rs
+                blk, parts = rs.proposal_block, rs.proposal_block_parts
+                if blk is None or parts is None or rs.votes is None:
+                    await asyncio.sleep(0.01)
+                    continue
+                bid = BlockID(hash=blk.hash(), parts=parts.header())
+                for vtype, min_step in (
+                    (PREVOTE_TYPE, STEP_PREVOTE),
+                    (PRECOMMIT_TYPE, STEP_PRECOMMIT),
+                ):
+                    k = (rs.height, rs.round, vtype)
+                    if k in done or rs.step < min_step:
+                        continue
+                    done.add(k)
+                    for vi, pv in sims:
+                        v = Vote(
+                            vote_type=vtype, height=rs.height, round=rs.round,
+                            block_id=bid, timestamp_ns=blk.header.time_ns + 1,
+                            validator_address=pv.address(), validator_index=vi,
+                        )
+                        v.signature = pv.priv_key.sign(v.sign_bytes(CHAIN_ID))
+                        await node.cs.add_vote_from_peer(v, "sim-swarm")
+                    injected[0] += len(sims)
+                await asyncio.sleep(0.005)
+
+        injectors = [asyncio.create_task(inject(n)) for n in nodes[:1]]
+        try:
+            await asyncio.gather(
+                *(n.cs.wait_for_height(2, timeout_s=120) for n in nodes)
+            )
+            start_h = nodes[0].cs.state.last_block_height
+            t0 = time.perf_counter()
+            target = start_h + HEIGHTS
+            await asyncio.gather(
+                *(n.cs.wait_for_height(target, timeout_s=120 * HEIGHTS) for n in nodes)
+            )
+            dt = time.perf_counter() - t0
+        finally:
+            stop_evt.set()
+            for t in injectors:
+                t.cancel()
+            await asyncio.gather(*injectors, return_exceptions=True)
+            await stop_switches(switches)
+
+        emit(f"e2e_scale_blocks_per_s_{N_SIM}sim", HEIGHTS / dt, "blocks/s")
+        emit(f"e2e_scale_ms_per_block_{N_SIM}sim", dt / HEIGHTS * 1e3, "ms")
+        if batch_ms:
+            batch_ms.sort()
+            emit(
+                "e2e_scale_vote_batch_p50_ms",
+                batch_ms[len(batch_ms) // 2],
+                "ms",
+            )
+            emit("e2e_scale_vote_batches", float(len(batch_ms)), "count")
+        emit("e2e_scale_votes_ingested", float(injected[0]), "votes")
+
+    asyncio.run(go())
+
+
+if __name__ == "__main__":
+    if not _USER_SET_PLATFORM:
+        os.environ.pop("JAX_PLATFORMS", None)
+    from tendermint_tpu.utils.jaxenv import force_cpu_platform, probe_accelerator
+
+    count, platform = probe_accelerator(timeout_s=90)
+    if (count == 0 or platform == "cpu") and not _USER_SET_PLATFORM:
+        print("accelerator unavailable; forcing CPU", file=sys.stderr)
+        force_cpu_platform()
+    main()

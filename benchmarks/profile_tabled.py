@@ -53,7 +53,7 @@ def main():
     print(f"cold (tables+compile+run): {time.perf_counter()-t0:.1f}s", file=sys.stderr)
 
     e = model._valset_tables[key]
-    s1, s2, s3, _ = model._table_stage_fns()
+    s1, s2, s3 = model._table_stage_fns()[:3]
     mg_d = jax.device_put(jnp.asarray(msgs))
     sg_d = jax.device_put(jnp.asarray(sigs))
     idx_d = jax.device_put(jnp.asarray(idx))

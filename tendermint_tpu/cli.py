@@ -206,11 +206,22 @@ def cmd_testnet(args) -> None:
     )
     genesis.validate_and_complete()
 
+    if args.hostname_suffix and not args.hostname_prefix:
+        print(
+            "testnet: --hostname-suffix requires --hostname-prefix "
+            "(IP-based peer lists have no hostname to suffix)",
+            file=sys.stderr,
+        )
+        raise SystemExit(1)
     if args.hostname_prefix:
-        # docker-style: each node at <prefix><octet+i>:26656 (reference
-        # testnet.go --hostname-prefix/--populate-persistent-peers)
+        # docker-style: each node at <prefix><octet+i><suffix>:26656
+        # (reference testnet.go --hostname-prefix/--hostname-suffix/
+        # --populate-persistent-peers). A suffix like ".myapp" makes the
+        # names Kubernetes headless-service FQDNs
+        # (tools/mintnet-kubernetes): tm-tpu-0.myapp, tm-tpu-1.myapp, ...
         peers = ",".join(
-            f"{node_keys[i].id}@{args.hostname_prefix}{args.starting_ip_octet + i}:26656"
+            f"{node_keys[i].id}@{args.hostname_prefix}{args.starting_ip_octet + i}"
+            f"{args.hostname_suffix}:26656"
             for i in range(n)
         )
     else:
@@ -514,6 +525,12 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--o", default="./mytestnet", help="output directory")
     sp.add_argument("--starting-port", type=int, default=26656)
     sp.add_argument("--chain-id", default="")
+    sp.add_argument(
+        "--hostname-suffix", default="",
+        help="appended after each node's ordinal (e.g. '.myapp' for "
+        "Kubernetes headless-service names, reference testnet.go "
+        "--hostname-suffix)",
+    )
     sp.add_argument(
         "--hostname-prefix", default="",
         help="docker mode: peer IPs become <prefix><octet+i>:26656 "

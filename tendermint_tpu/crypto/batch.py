@@ -83,6 +83,23 @@ class BatchVerifier:
         signal)."""
         return None
 
+    def verify_rows_cached_templated(
+        self,
+        valset_key: bytes,
+        all_pubkeys: np.ndarray,
+        row_idx: np.ndarray,
+        templates: np.ndarray,
+        tmpl_idx: np.ndarray,
+        ts8: np.ndarray,
+        sigs: np.ndarray,
+    ) -> Optional[np.ndarray]:
+        """verify_rows_cached with TEMPLATED messages: row r's sign
+        bytes are templates[tmpl_idx[r]] (T, 160) with ts8[r] (8 bytes)
+        spliced at the timestamp offset (codec/signbytes.py layout).
+        Device providers materialize rows on device, cutting per-row
+        H2D from ~228 B to ~80 B. Same None-means-fallback contract."""
+        return None
+
 
 class CPUBatchVerifier(BatchVerifier):
     """Serial host verification -- reference-parity behavior."""
@@ -148,6 +165,15 @@ class TPUBatchVerifier(BatchVerifier):
             return None
         return self._model.verify_rows_cached(
             valset_key, all_pubkeys, row_idx, msgs, sigs
+        )
+
+    def verify_rows_cached_templated(
+        self, valset_key, all_pubkeys, row_idx, templates, tmpl_idx, ts8, sigs
+    ):
+        if len(row_idx) < self.min_device_batch:
+            return None
+        return self._model.verify_rows_cached_templated(
+            valset_key, all_pubkeys, row_idx, templates, tmpl_idx, ts8, sigs
         )
 
     def register_valset(self, valset_key, all_pubkeys) -> None:

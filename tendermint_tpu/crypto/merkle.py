@@ -208,6 +208,19 @@ class ValueOp(ProofOperator):
         self.key = key
         self.proof = proof
 
+    def to_proof_op(self) -> ProofOp:
+        """Wire form consumed by default_proof_runtime's decoder."""
+        from tendermint_tpu.codec.binary import Writer
+
+        w = Writer()
+        w.write_uvarint(self.proof.total)
+        w.write_uvarint(self.proof.index)
+        w.write_bytes(self.proof.leaf_hash)
+        w.write_uvarint(len(self.proof.aunts))
+        for a in self.proof.aunts:
+            w.write_bytes(a)
+        return ProofOp(self.TYPE, self.key, w.bytes())
+
     def get_key(self) -> bytes:
         return self.key
 
@@ -222,6 +235,32 @@ class ValueOp(ProofOperator):
         if leaf_hash(leaf) != self.proof.leaf_hash:
             raise ValueError("leaf mismatch")
         return [self.proof.compute_root()]
+
+
+def encode_proof_ops(ops: List[ProofOp]) -> bytes:
+    """Deterministic wire form for a multi-store proof-op chain — what
+    an ABCI app puts in ResponseQuery.proof_bytes and the lite proxy
+    (lite/proxy.py) decodes back (reference: merkle.Proof in
+    ResponseQuery, abci/types/types.proto)."""
+    from tendermint_tpu.codec.binary import Writer
+
+    w = Writer()
+    w.write_uvarint(len(ops))
+    for op in ops:
+        w.write_str(op.type)
+        w.write_bytes(op.key)
+        w.write_bytes(op.data)
+    return w.bytes()
+
+
+def decode_proof_ops(data: bytes) -> List[ProofOp]:
+    from tendermint_tpu.codec.binary import Reader
+
+    r = Reader(data)
+    return [
+        ProofOp(r.read_str(), r.read_bytes(), r.read_bytes())
+        for _ in range(r.read_uvarint())
+    ]
 
 
 def default_proof_runtime() -> ProofRuntime:

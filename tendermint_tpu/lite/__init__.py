@@ -30,3 +30,12 @@ from tendermint_tpu.lite.verifier import (  # noqa: F401
     DynamicVerifier,
     ErrUnexpectedValidators,
 )
+from tendermint_tpu.lite.proxy import (  # noqa: F401
+    ErrEmptyTree,
+    LiteProxyError,
+    get_certified_commit,
+    get_with_proof,
+    get_with_proof_options,
+    new_verifier,
+    parse_query_store_path,
+)

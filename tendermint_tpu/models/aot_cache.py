@@ -54,11 +54,15 @@ def cache_dir() -> str:
 
 def _code_digest() -> str:
     """Digest of the kernel source files: a changed kernel must never
-    load a stale executable."""
+    load a stale executable. Env-tunable kernel parameters (TM_SPLITS
+    changes every table shape and scan program) fold in too — a table
+    or executable built at one value must miss at another."""
     import tendermint_tpu.models.verifier as _v
     import tendermint_tpu.ops as _ops
+    from tendermint_tpu.ops import curve as _curve
 
     h = hashlib.sha256()
+    h.update(f"splits={_curve.SPLITS}".encode())
     roots = [os.path.dirname(_ops.__file__), _v.__file__]
     files = []
     for r in roots:
@@ -118,8 +122,15 @@ def fingerprint() -> str:
 
 
 def _arg_sig(args: Tuple[Any, ...]) -> str:
+    # tree_leaves: container args (e.g. the sharded scan's tuple of
+    # table shards) contribute each leaf's shape — a bare getattr would
+    # map every tuple to '?' and collide executables across different
+    # shard counts. Flat array args flatten to themselves, so existing
+    # cache keys are unchanged.
+    import jax
+
     parts = []
-    for a in args:
+    for a in jax.tree_util.tree_leaves(args):
         shape = getattr(a, "shape", None)
         dtype = getattr(a, "dtype", None)
         parts.append(f"{tuple(shape) if shape is not None else '?'}:{dtype}")
@@ -301,6 +312,16 @@ def _prune_tables() -> None:
         pass
 
 
+# ONE compile/deserialize at a time, process-wide. Background warm
+# threads (verifier._compile_tabled_async, register_valset) compile
+# concurrently with live-path compiles; with the persistent caches in
+# play that interleaving segfaulted inside jax's compilation-cache
+# read (zstd deserialize) twice in full-suite runs — same stack both
+# times, never reproducible single-threaded. Serializing costs nothing
+# real: XLA compiles saturate the host cores anyway.
+_COMPILE_SERIAL = threading.Lock()
+
+
 class AotJit:
     """jit wrapper that persists compiled executables across processes.
 
@@ -315,14 +336,41 @@ class AotJit:
     subcomputations — "Function ... not found"). A dispatch failure
     drops the stale file, recompiles, and re-runs — the cache can slow
     a start down, never break it.
+
+    ``fragile=True`` marks a stage whose executable does not SURVIVE
+    XLA:CPU (de)serialization: full-suite runs segfaulted inside the
+    compilation-cache read for the templated-prepare program (three
+    runs, same stack, never reproducible in a fresh process). On the
+    CPU backend such stages skip persistence entirely — ours AND
+    jax's own cache (toggled off around the compile; we hold
+    _COMPILE_SERIAL, so no other model compile sees the toggle).
+    Non-CPU backends serialize through a different path and keep full
+    caching (the cold-start budget needs it).
     """
 
-    def __init__(self, fn, stage: str, jit_fn=None):
+    def __init__(self, fn, stage: str, jit_fn=None, fragile: bool = False):
         self._jit = jit_fn if jit_fn is not None else jax.jit(fn)
         self.stage = stage
+        self.fragile = fragile
         self._compiled: Dict[str, Any] = {}  # sig -> [callable, needs_validation]
         self._lock = threading.Lock()
         self.last_source: Optional[str] = None  # "aot" | "compile" (tests/metrics)
+
+    def _no_persist(self) -> bool:
+        return self.fragile and jax.default_backend() == "cpu"
+
+    def _compile_uncached(self, args):
+        # jax_enable_compilation_cache gates BOTH the cache read and
+        # the post-compile serialize-and-write inside
+        # compile_or_get_cached (clearing the dir does not: an
+        # already-initialized cache keeps its handle — observed as a
+        # segfault in _cache_write with the dir set to None)
+        prev = jax.config.jax_enable_compilation_cache
+        jax.config.update("jax_enable_compilation_cache", False)
+        try:
+            return self._jit.lower(*args).compile()
+        finally:
+            jax.config.update("jax_enable_compilation_cache", prev)
 
     def _get(self, sig: str, args):
         rec = self._compiled.get(sig)
@@ -330,15 +378,21 @@ class AotJit:
             with self._lock:
                 rec = self._compiled.get(sig)
                 if rec is None:
-                    c = load(self.stage, args)
-                    if c is not None:
-                        self.last_source = "aot"
-                        rec = [c, True]
-                    else:
-                        c = self._jit.lower(*args).compile()
-                        self.last_source = "compile"
-                        save(self.stage, args, c)
-                        rec = [c, False]
+                    with _COMPILE_SERIAL:
+                        if self._no_persist():
+                            c = self._compile_uncached(args)
+                            self.last_source = "compile"
+                            rec = [c, False]
+                        else:
+                            c = load(self.stage, args)
+                            if c is not None:
+                                self.last_source = "aot"
+                                rec = [c, True]
+                            else:
+                                c = self._jit.lower(*args).compile()
+                                self.last_source = "compile"
+                                save(self.stage, args, c)
+                                rec = [c, False]
                     self._compiled[sig] = rec
         return rec
 
@@ -347,9 +401,14 @@ class AotJit:
             os.remove(_path(self.stage, args))
         except OSError:
             pass
-        c = self._jit.lower(*args).compile()
-        self.last_source = "compile"
-        save(self.stage, args, c)
+        with _COMPILE_SERIAL:
+            if self._no_persist():
+                c = self._compile_uncached(args)
+                self.last_source = "compile"
+            else:
+                c = self._jit.lower(*args).compile()
+                self.last_source = "compile"
+                save(self.stage, args, c)
         with self._lock:
             self._compiled[sig] = [c, False]
         return c

@@ -76,6 +76,12 @@ _BUCKETS = [16, 64, 256, 1024, 4096, 10240, 16384]
 # this size (one final sync). See VerifierModel.verify.
 MAX_DEVICE_ROWS = 16384
 
+# Template-count buckets for the templated message source: a live
+# commit is one (commit, nil) template pair; a cross-height batch has
+# one pair per height. Padding T to a bucket keeps the stage-1 program
+# count bounded instead of compiling per distinct height count.
+_TPL_BUCKETS = [2, 8, 32, 128, 512, 1024]
+
 
 def _bucket(n: int, multiple: int) -> int:
     for b in _BUCKETS:
@@ -161,24 +167,31 @@ _TABLE_BUILD_CHUNK = 16384
 # (~2GB) table; small and mid tables gather fine (round-3 ingest data).
 _GATHER_POLICY_MIN_TABLE = 16384
 
-# Largest valset the cached-table path engages for. The reference caps
+# Largest valset served by ONE device table. The reference caps
 # commits at 10k votes (types/vote_set.go:18 MaxVotesCount); beyond
-# ~16k validators the tables stop paying for themselves — ~30KB/row of
-# HBM (2GB at 50k) plus huge-shape stage compiles, and the 50k-ingest
+# ~16k rows a single table's gathers go pathological (the 50k-ingest
 # eval measured the whole process slowing ~50x while a 65536-row table
-# was resident and its buckets were compiling. Oversized sets ride the
-# generic pipeline, which handles 50k ingest at ~20k votes/s.
+# was resident — round-4 ledger). Larger sets up to MAX_SHARDED_VALSET
+# ride SHARDED tables: equal <=16384-row shards with per-shard bounded
+# gathers in one program (ops_ed.verify_stage_scan_tabled_sharded).
 MAX_TABLED_VALSET = int(os.environ.get("TM_MAX_TABLED_VALSET", "16384"))
+
+# Largest valset for the sharded-table path (single device; HBM is the
+# bound: ~30KB/validator => ~2GB at 65536). Beyond it — or on a mesh,
+# where the tables would replicate per device — the generic pipeline
+# takes over.
+MAX_SHARDED_VALSET = int(os.environ.get("TM_MAX_SHARDED_VALSET", str(1 << 16)))
 
 
 class _TablesEntry:
     __slots__ = (
-        "tables", "a_ok", "pk_dev", "v", "ready", "building", "failed",
-        "build_s", "source",
+        "tables", "shards", "a_ok", "pk_dev", "v", "ready", "building",
+        "failed", "build_s", "source",
     )
 
     def __init__(self, v: int):
         self.tables = None
+        self.shards = None  # tuple of per-shard tables for V > MAX_TABLED_VALSET
         self.a_ok = None
         self.pk_dev = None  # (V_pad, 32) u8 device copy for stage-1 gather
         self.v = v
@@ -603,6 +616,36 @@ class VerifierModel:
         )
         return self._table_stages
 
+    def _materialize_fn(self):
+        """The tiny templated-message materializer (one program per
+        (t_pad, n_pad) shape): its u8 output feeds the SAME compiled
+        prepare executables the materialized path uses — see
+        ops_ed.materialize_sign_bytes for why this is a separate
+        program. fragile: skip executable persistence on XLA:CPU (the
+        crash class that motivated the split; the program is trivial
+        to recompile)."""
+        cached = getattr(self, "_materialize", None)
+        if cached is not None:
+            return cached
+        from tendermint_tpu.models.aot_cache import AotJit
+
+        if self.mesh is None:
+            self._materialize = AotJit(
+                ops_ed.materialize_sign_bytes, "t-materialize", fragile=True
+            )
+        else:
+            batch, rep = self._shard_specs()
+            tag = f"mesh{tuple(self.mesh.shape.values())}"
+            self._materialize = AotJit(
+                None, f"t-materialize-{tag}", fragile=True,
+                # templates replicate (KB-scale); per-row columns shard
+                jit_fn=self._smap(
+                    ops_ed.materialize_sign_bytes, 3, batch,
+                    in_specs=(rep, batch, batch),
+                ),
+            )
+        return self._materialize
+
     def _dense_stage_fns(self):
         """Single-device DENSE tabled stages for the full-commit shape
         (row i == validator i): stage 1 consumes the device-resident
@@ -633,24 +676,49 @@ class VerifierModel:
         # resolve the cache dir NOW: on the async-build path the env
         # var may point somewhere else by the time the thread saves
         tables_dir = aot_cache.tables_dir()
+        # Sets past the single-table bound keep their tables as
+        # equal-size <=MAX_TABLED_VALSET-row shards: the sharded scan
+        # gathers each shard bounded instead of one pathological
+        # huge-table gather. The shard size also respects the BUILD
+        # chunk (HBM bound of the build program's intermediates).
+        sharded = v_pad > MAX_TABLED_VALSET
+        shard_rows = (
+            min(MAX_TABLED_VALSET, _TABLE_BUILD_CHUNK) if sharded else v_pad
+        )
         loaded = aot_cache.load_tables(key, v_pad, pk_digest)
+        shards = None
         if loaded is not None:
             # restart path: pure data from disk, no build program at all
-            tables, a_ok = jnp.asarray(loaded[0]), jnp.asarray(loaded[1])
+            if sharded:
+                shards = tuple(
+                    jnp.asarray(loaded[0][off : off + shard_rows])
+                    for off in range(0, v_pad, shard_rows)
+                )
+                tables = None
+            else:
+                tables = jnp.asarray(loaded[0])
+            a_ok = jnp.asarray(loaded[1])
             e.source = "disk"
         else:
-            _, _, _, build = self._table_stage_fns()
-            if v_pad > _TABLE_BUILD_CHUNK:
+            build = self._table_stage_fns()[3]
+            # one build call per shard when sharded (shard_rows already
+            # respects the build chunk), else the plain HBM chunking
+            chunk = shard_rows if sharded else _TABLE_BUILD_CHUNK
+            if v_pad > chunk:
                 # the build program's post-scan affine conversion holds
                 # (rows*SPLITS*8, 20, 20) intermediates — one shot at
                 # 65536 rows wants ~30GB of HBM (observed OOM at 50k
-                # validators). Chunk the BUILD only; the result is one
-                # contiguous device table either way.
+                # validators). Chunk the BUILD; past the single-table
+                # bound the chunks STAY separate as the scan's shards.
                 parts = [
-                    build(jnp.asarray(pk_pad[off : off + _TABLE_BUILD_CHUNK]))
-                    for off in range(0, v_pad, _TABLE_BUILD_CHUNK)
+                    build(jnp.asarray(pk_pad[off : off + chunk]))
+                    for off in range(0, v_pad, chunk)
                 ]
-                tables = jnp.concatenate([t for t, _ in parts])
+                if sharded:
+                    shards = tuple(t for t, _ in parts)
+                    tables = None
+                else:
+                    tables = jnp.concatenate([t for t, _ in parts])
                 a_ok = jnp.concatenate([a for _, a in parts])
             else:
                 tables, a_ok = build(jnp.asarray(pk_pad))
@@ -664,33 +732,48 @@ class VerifierModel:
             # replicate ONCE at build: the shard_map scan consumes the
             # tables with a replicated spec, and leaving them committed
             # to one device would re-broadcast ~30KB/validator to every
-            # device on every verify dispatch
+            # device on every verify dispatch (sharded entries never
+            # reach the mesh path — _tables_entry gates them)
             from jax.sharding import NamedSharding, PartitionSpec
 
             rep = NamedSharding(self.mesh, PartitionSpec())
             tables = jax.device_put(tables, rep)
             a_ok = jax.device_put(a_ok, rep)
             pk_dev = jax.device_put(pk_dev, rep)
-        tables.block_until_ready()
+        if sharded:
+            shards[-1].block_until_ready()
+            e.shards = shards
+        else:
+            tables.block_until_ready()
         e.tables, e.a_ok, e.pk_dev = tables, a_ok, pk_dev
         e.build_s = time.perf_counter() - t0
         e.ready = True
         self.logger.info(
             "valset tables ready",
             validators=v, key=key[:8].hex(), source=e.source,
+            shards=len(shards) if sharded else 1,
             seconds=round(e.build_s, 2),
         )
         if e.source == "build":
+            flat = (
+                np.concatenate([np.asarray(s) for s in shards])
+                if sharded
+                else np.asarray(tables)
+            )
             aot_cache.save_tables(
-                key, np.asarray(tables), np.asarray(a_ok), pk_digest,
+                key, flat, np.asarray(a_ok), pk_digest,
                 dir_path=tables_dir,
             )
 
     def _tables_entry(self, key: bytes, pubkeys: np.ndarray) -> Optional[_TablesEntry]:
         """The ready tables entry for `key`, or None when still cold
         (async build kicked off in non-blocking mode) or the set is too
-        large for the tabled path (see MAX_TABLED_VALSET)."""
-        if int(pubkeys.shape[0]) > MAX_TABLED_VALSET:
+        large for the tabled path: past MAX_TABLED_VALSET the tables go
+        SHARDED (single device only — replicating multi-GB tables per
+        mesh device is not worth it), past MAX_SHARDED_VALSET the
+        generic pipeline takes over."""
+        v = int(pubkeys.shape[0])
+        if v > MAX_TABLED_VALSET and (self.mesh is not None or v > MAX_SHARDED_VALSET):
             return None
         with self._lock:
             e = self._valset_tables.get(key)
@@ -765,6 +848,109 @@ class VerifierModel:
         not hit the small-batch gather policy (the windows already ran;
         nullifying the tail would discard all their device work).
         """
+        src = ("mat", np.asarray(msgs, dtype=np.uint8))
+        return self._rows_cached_core(
+            valset_key, all_pubkeys, row_idx, src, sigs, _window_tail
+        )
+
+    def verify_rows_cached_templated(
+        self, valset_key: bytes, all_pubkeys, row_idx,
+        templates, tmpl_idx, ts8, sigs,
+        _window_tail: bool = False,
+    ) -> Optional[np.ndarray]:
+        """verify_rows_cached with TEMPLATED messages: row r's sign
+        bytes are templates[tmpl_idx[r]] with ts8[r] (8 bytes,
+        big-endian i64) spliced at the timestamp offset — materialized
+        ON DEVICE (ops_ed.materialize_sign_bytes). Per-row H2D drops
+        from ~228 B to ~80 B, which through the ~14 MB/s tunnel is the
+        difference between the device computing and the device waiting
+        (eval 3 measured 18% of peak, all H2D).
+
+        templates (T, 160) u8 — T is padded up to a small bucket so
+        cross-height batches (one template pair per height) don't
+        compile per T. Same None-means-fallback contract."""
+        src = (
+            "tpl",
+            np.asarray(templates, dtype=np.uint8),
+            np.asarray(tmpl_idx, dtype=np.int32),
+            np.asarray(ts8, dtype=np.uint8),
+        )
+        return self._rows_cached_core(
+            valset_key, all_pubkeys, row_idx, src, sigs, _window_tail
+        )
+
+    # -- shared cached-path machinery (mat | tpl message sources) ---------
+
+    @staticmethod
+    def _table_rows(e: _TablesEntry) -> int:
+        if e.shards is not None:
+            return sum(int(s.shape[0]) for s in e.shards)
+        return int(e.tables.shape[0])
+
+    def _scan_rows(self, e: _TablesEntry, sd, kd, idx_dev):
+        """Dispatch the right stage-2 flavor: single table (gathered)
+        or sharded per-shard bounded gathers."""
+        if e.shards is not None:
+            from tendermint_tpu.models.aot_cache import AotJit
+
+            fn = getattr(self, "_sharded_scan", None)
+            if fn is None:
+                fn = self._sharded_scan = AotJit(
+                    ops_ed.verify_stage_scan_tabled_sharded, "t-scan-sh"
+                )
+            return fn(sd, kd, e.a_ok, idx_dev, e.shards)
+        s2 = self._table_stage_fns()[1]
+        return s2(sd, kd, e.tables, e.a_ok, idx_dev)
+
+    @staticmethod
+    def _src_msg_len(src) -> int:
+        return int(src[1].shape[1])
+
+    @staticmethod
+    def _src_tpl_pad(src) -> int:
+        """Padded template count (0 for the mat source): bounds
+        recompiles across cross-height batches of varying heights."""
+        if src[0] == "mat":
+            return 0
+        t = int(src[1].shape[0])
+        for b in _TPL_BUCKETS:
+            if t <= b:
+                return b
+        return pad_to_multiple(t, _TPL_BUCKETS[-1])
+
+    @staticmethod
+    def _src_slice(src, sl: slice):
+        """Row-slice a message source (templates are shared, per-row
+        columns slice)."""
+        if src[0] == "mat":
+            return ("mat", src[1][sl])
+        return ("tpl", src[1], src[2][sl], src[3][sl])
+
+    def _src_stage1(self, e: _TablesEntry, src, dense: bool, n_pad: int, idx_dev, sg_dev):
+        """Dispatch stage 1 for (source, dense) and return
+        (sd, kd, s_ok). Inputs are padded to n_pad here. Both sources
+        converge on the SAME prepare executables: the templated source
+        materializes its (n_pad, W) u8 messages on device first (one
+        tiny extra dispatch; the H2D saving is the point)."""
+        if src[0] == "mat":
+            mg = jnp.asarray(self._pad(src[1], n_pad))
+        else:
+            _, templates, tmpl_idx, ts8 = src
+            mg = self._materialize_fn()(
+                jnp.asarray(self._pad(templates, self._src_tpl_pad(src))),
+                jnp.asarray(self._pad(tmpl_idx, n_pad)),
+                jnp.asarray(self._pad(ts8, n_pad)),
+            )
+        if dense:
+            s1d = self._dense_stage_fns()[0]
+            return s1d(e.pk_dev[:n_pad], mg, sg_dev)
+        s1 = self._table_stage_fns()[0]
+        return s1(e.pk_dev, idx_dev, mg, sg_dev)
+
+    def _rows_cached_core(
+        self, valset_key: bytes, all_pubkeys, row_idx, src, sigs,
+        _window_tail: bool = False,
+    ) -> Optional[np.ndarray]:
         n = int(len(row_idx))
         if n == 0:
             return np.zeros(0, dtype=bool)
@@ -777,48 +963,48 @@ class VerifierModel:
             # decompress and table build the generic path pays are
             # already hoisted into the cached tables
             return self._rows_cached_windowed(
-                valset_key, e, all_pubkeys, row_idx, msgs, sigs
+                valset_key, e, all_pubkeys, row_idx, src, sigs
             )
-        msg_len = int(msgs.shape[1])
         n_pad = _bucket(n, self._pad_multiple())
         idx_np = np.asarray(row_idx, dtype=np.int32)
         dense = self._dense_applies(e, idx_np, n, n_pad)
         if (
             not dense
             and not _window_tail
-            and int(e.tables.shape[0]) > _GATHER_POLICY_MIN_TABLE
-            and int(e.tables.shape[0]) > 4 * n_pad
+            and e.shards is None
+            and self._table_rows(e) > _GATHER_POLICY_MIN_TABLE
+            and self._table_rows(e) > 4 * n_pad
         ):
-            # small gathered batch against a huge table: the per-row
-            # ~30KB table gather goes pathological when the table
-            # dwarfs the batch (measured: 50k-validator ingest in
+            # small gathered batch against a huge SINGLE table: the
+            # per-row ~30KB table gather goes pathological when the
+            # table dwarfs the batch (measured: 50k-validator ingest in
             # 2048-vote drains fell from 19.9k votes/s generic to 436
-            # through this path) — the generic pipeline wins there
+            # through this path) — the generic pipeline wins there.
+            # Sharded entries are exempt: their gathers are bounded per
+            # shard, which is the whole point of sharding.
             return None
         # the bucket key includes the table's padded row count (see
         # _tabled_bucket_entry): a valset that grows past its pad bucket
         # must re-warm, not run a synchronous compile on the live path
-        ent = self._tabled_bucket_entry(e, n_pad, msg_len)
+        ent = self._tabled_bucket_entry(e, n_pad, src)
         if not ent.ready and not self.block_on_compile:
-            self._compile_tabled_async(ent, e, n_pad, msg_len)
+            self._compile_tabled_async(ent, e, n_pad, src)
             return None
-        _, _, s3, _ = self._table_stage_fns()
-        mg = jnp.asarray(self._pad(np.asarray(msgs, dtype=np.uint8), n_pad))
+        s3 = self._table_stage_fns()[2]
         sg = jnp.asarray(self._pad(np.asarray(sigs, dtype=np.uint8), n_pad))
         t0 = time.perf_counter()
         try:
             if dense:
                 # full-commit shape (row i == validator i): no gathers
-                s1d, s2d = self._dense_stage_fns()
-                sd, kd, s_ok = s1d(e.pk_dev[:n_pad], mg, sg)
+                sd, kd, s_ok = self._src_stage1(e, src, True, n_pad, None, sg)
+                s2d = self._dense_stage_fns()[1]
                 px, py, pz, pt, a_ok = s2d(
                     sd, kd, e.tables[:n_pad], e.a_ok[:n_pad]
                 )
             else:
-                s1, s2, _, _ = self._table_stage_fns()
                 idx = jnp.asarray(self._pad(idx_np, n_pad))
-                sd, kd, s_ok = s1(e.pk_dev, idx, mg, sg)
-                px, py, pz, pt, a_ok = s2(sd, kd, e.tables, e.a_ok, idx)
+                sd, kd, s_ok = self._src_stage1(e, src, False, n_pad, idx, sg)
+                px, py, pz, pt, a_ok = self._scan_rows(e, sd, kd, idx)
             ok = s3(px, py, pz, pt, sg, a_ok, s_ok)
             out = np.asarray(ok)[:n]
         except Exception as ex:
@@ -846,13 +1032,19 @@ class VerifierModel:
         The host arange compare is ~µs at 10k rows."""
         return (
             self.mesh is None
+            and e.shards is None
             and n_pad <= int(e.tables.shape[0])
             and idx_np.shape[0] == n
             and bool((idx_np == np.arange(n, dtype=np.int32)).all())
         )
 
-    def _tabled_bucket_entry(self, e: _TablesEntry, n_pad: int, msg_len: int) -> _Entry:
-        key = ("tabled", n_pad, msg_len, int(e.tables.shape[0]))
+    def _tabled_bucket_entry(self, e: _TablesEntry, n_pad: int, src) -> _Entry:
+        kind = "tabled" if src[0] == "mat" else "tabled-tpl"
+        n_shards = len(e.shards) if e.shards is not None else 1
+        key = (
+            kind, n_pad, self._src_msg_len(src), self._src_tpl_pad(src),
+            self._table_rows(e), n_shards,
+        )
         with self._lock:
             ent = self._entries.get(key)
             if ent is None:
@@ -861,16 +1053,15 @@ class VerifierModel:
             return ent
 
     def _rows_cached_windowed(
-        self, valset_key: bytes, e: _TablesEntry, all_pubkeys, row_idx, msgs, sigs
+        self, valset_key: bytes, e: _TablesEntry, all_pubkeys, row_idx, src, sigs
     ) -> Optional[np.ndarray]:
         n = int(len(row_idx))
         window = self._window_size(MAX_DEVICE_ROWS)
-        msg_len = int(msgs.shape[1])
         full_end = (n // window) * window
         tail_pad = _bucket(n - full_end, self._pad_multiple()) if full_end < n else 0
-        win_ent = self._tabled_bucket_entry(e, window, msg_len)
+        win_ent = self._tabled_bucket_entry(e, window, src)
         tail_ent = (
-            self._tabled_bucket_entry(e, tail_pad, msg_len) if tail_pad else None
+            self._tabled_bucket_entry(e, tail_pad, src) if tail_pad else None
         )
         if not self.block_on_compile:
             # BOTH buckets must be warm before dispatching anything:
@@ -884,10 +1075,9 @@ class VerifierModel:
             ]
             if cold:
                 for ent, pad in cold:
-                    self._compile_tabled_async(ent, e, pad, msg_len)
+                    self._compile_tabled_async(ent, e, pad, src)
                 return None
-        s1, s2, s3, _ = self._table_stage_fns()
-        mg = np.asarray(msgs, dtype=np.uint8)
+        s3 = self._table_stage_fns()[2]
         sg = np.asarray(sigs, dtype=np.uint8)
         idx = np.asarray(row_idx, dtype=np.int32)
         try:
@@ -896,8 +1086,10 @@ class VerifierModel:
                 sl = slice(off, off + window)
                 idx_d = jnp.asarray(idx[sl])
                 sg_d = jnp.asarray(sg[sl])
-                sd, kd, s_ok = s1(e.pk_dev, idx_d, jnp.asarray(mg[sl]), sg_d)
-                px, py, pz, pt, a_ok = s2(sd, kd, e.tables, e.a_ok, idx_d)
+                sd, kd, s_ok = self._src_stage1(
+                    e, self._src_slice(src, sl), False, window, idx_d, sg_d
+                )
+                px, py, pz, pt, a_ok = self._scan_rows(e, sd, kd, idx_d)
                 outs.append(s3(px, py, pz, pt, sg_d, a_ok, s_ok))
             win_ent.ready = True  # compile timing lives in the AOT layer
             parts = [np.asarray(o) for o in outs]
@@ -915,8 +1107,9 @@ class VerifierModel:
             # _window_tail bypasses the small-batch gather policy (the
             # windows already ran — nullifying the tail would discard
             # all their device work)
-            tail = self.verify_rows_cached(
-                valset_key, all_pubkeys, idx[full_end:], mg[full_end:],
+            tail = self._rows_cached_core(
+                valset_key, all_pubkeys, idx[full_end:],
+                self._src_slice(src, slice(full_end, n)),
                 sg[full_end:], _window_tail=True,
             )
             if tail is None:  # racing eviction or compile failure
@@ -926,10 +1119,12 @@ class VerifierModel:
 
     def register_valset(self, valset_key: bytes, all_pubkeys, msg_len: int = 160) -> None:
         """Pre-build the cached tables for a valset and warm its tabled
-        buckets (node-start path: a restarting validator's FIRST commit
-        should already ride the tabled pipeline, not wait for a lazy
-        build on the live path). Non-blocking when the model is; safe
-        to call for an already-registered set."""
+        buckets — BOTH message flavors: the live commit path sends
+        templated messages, while vote ingest and fallbacks still send
+        materialized ones (node-start path: a restarting validator's
+        FIRST commit should already ride the tabled pipeline, not wait
+        for a lazy build on the live path). Non-blocking when the model
+        is; safe to call for an already-registered set."""
         pk = np.asarray(all_pubkeys, dtype=np.uint8)
         if self.block_on_compile:
             e = self._tables_entry(valset_key, pk)
@@ -939,12 +1134,25 @@ class VerifierModel:
                 e = self._valset_tables.get(valset_key)
         if e is None:
             return
-        n_pad = _bucket(int(pk.shape[0]), self._pad_multiple())
+        n = int(pk.shape[0])
+        # oversized sets dispatch as <=MAX_DEVICE_ROWS windows; warming
+        # a bigger bucket would compile a shape no call ever uses
+        n_pad = _bucket(min(n, MAX_DEVICE_ROWS), self._pad_multiple())
+        warm_srcs = (
+            ("mat", np.zeros((n, msg_len), dtype=np.uint8)),
+            (
+                "tpl",
+                np.zeros((2, msg_len), dtype=np.uint8),
+                np.zeros(n, dtype=np.int32),
+                np.zeros((n, 8), dtype=np.uint8),
+            ),
+        )
 
         def warm_bucket():
-            ent = self._tabled_bucket_entry(e, n_pad, msg_len)
-            if not ent.ready:
-                self._compile_tabled_async(ent, e, n_pad, msg_len)
+            for src in warm_srcs:
+                ent = self._tabled_bucket_entry(e, n_pad, src)
+                if not ent.ready:
+                    self._compile_tabled_async(ent, e, n_pad, src)
 
         if e.ready:
             warm_bucket()
@@ -964,27 +1172,44 @@ class VerifierModel:
         _track_compile_thread(t)
         t.start()
 
+    def _src_zero(self, src, n_pad: int):
+        """Zero-filled source with src's static shape signature, padded
+        to n_pad rows — compiles the same executables the live call
+        will hit."""
+        if src[0] == "mat":
+            return ("mat", np.zeros((n_pad, self._src_msg_len(src)), dtype=np.uint8))
+        return (
+            "tpl",
+            np.zeros((self._src_tpl_pad(src), self._src_msg_len(src)), dtype=np.uint8),
+            np.zeros(n_pad, dtype=np.int32),
+            np.zeros((n_pad, 8), dtype=np.uint8),
+        )
+
     def _compile_tabled_async(
-        self, ent: _Entry, e: _TablesEntry, n_pad: int, msg_len: int
+        self, ent: _Entry, e: _TablesEntry, n_pad: int, src
     ) -> None:
         if not self._claim_compile(ent):
             return
+        zsrc = self._src_zero(src, n_pad)
 
         def work():
             try:
                 t0 = time.perf_counter()
-                s1, s2, s3, _ = self._table_stage_fns()
-                mg = jnp.asarray(np.zeros((n_pad, msg_len), dtype=np.uint8))
+                s3 = self._table_stage_fns()[2]
                 sg = jnp.asarray(np.zeros((n_pad, 64), dtype=np.uint8))
                 idx = jnp.asarray(np.zeros(n_pad, dtype=np.int32))
-                sd, kd, s_ok = s1(e.pk_dev, idx, mg, sg)
-                px, py, pz, pt, a_ok = s2(sd, kd, e.tables, e.a_ok, idx)
+                sd, kd, s_ok = self._src_stage1(e, zsrc, False, n_pad, idx, sg)
+                px, py, pz, pt, a_ok = self._scan_rows(e, sd, kd, idx)
                 np.asarray(s3(px, py, pz, pt, sg, a_ok, s_ok))
-                if self.mesh is None and n_pad <= int(e.tables.shape[0]):
+                if (
+                    self.mesh is None
+                    and e.shards is None
+                    and n_pad <= int(e.tables.shape[0])
+                ):
                     # the dense (full-commit) variant must be warm too:
                     # the live path picks it per-call by index shape
-                    s1d, s2d = self._dense_stage_fns()
-                    sd, kd, s_ok = s1d(e.pk_dev[:n_pad], mg, sg)
+                    sd, kd, s_ok = self._src_stage1(e, zsrc, True, n_pad, None, sg)
+                    s2d = self._dense_stage_fns()[1]
                     px, py, pz, pt, a_ok = s2d(
                         sd, kd, e.tables[:n_pad], e.a_ok[:n_pad]
                     )
@@ -992,7 +1217,8 @@ class VerifierModel:
                 ent.compile_s = time.perf_counter() - t0
                 ent.ready = True
                 self.logger.info(
-                    "tabled bucket compiled", rows=n_pad, msg_len=msg_len,
+                    "tabled bucket compiled", rows=n_pad, kind=src[0],
+                    msg_len=self._src_msg_len(src),
                     seconds=round(ent.compile_s, 2),
                 )
             except Exception as ex:  # pragma: no cover - defensive

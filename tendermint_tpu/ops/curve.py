@@ -13,6 +13,7 @@ verify loop (crypto/ed25519/ed25519.go:151).
 
 from __future__ import annotations
 
+import os
 from typing import NamedTuple, Tuple
 
 import jax
@@ -203,9 +204,12 @@ _TBL = 8  # signed-window table holds [1..8]Q
 # 16 splits (16 shared doublings, ~30KB of table per validator) measured
 # faster than 8 (32 doublings, ~15KB) on v5e: the doubling runs are pure
 # serial VPU latency while the extra table HBM is cheap next to the
-# per-madd arithmetic.
-SPLITS = 16
-SPLIT_W = 4  # 64 // SPLITS
+# per-madd arithmetic. TM_SPLITS overrides for experiments (32 = 8
+# doublings, ~60KB/validator); persisted tables and AOT executables are
+# shape-keyed, so mixed values can coexist in the caches.
+SPLITS = int(os.environ.get("TM_SPLITS", "16"))
+assert 64 % SPLITS == 0, "TM_SPLITS must divide 64"
+SPLIT_W = 64 // SPLITS
 
 
 class AffineCached(NamedTuple):

@@ -148,11 +148,74 @@ def verify_stage_prepare_tabled_gathered(pk_all, idx, msgs, sigs):
     return verify_stage_prepare_tabled(jnp.take(pk_all, idx, axis=0), msgs, sigs)
 
 
+# -- templated sign-bytes -----------------------------------------------------
+#
+# Within one commit the 160-byte canonical sign-bytes differ per row
+# ONLY in the 8-byte timestamp and the nil-vs-commit BlockID variant
+# (codec/signbytes.py layout; reference Commit.VoteSignBytes
+# types/block.go:637 — CommitSig carries just Timestamp + BlockIDFlag).
+# A nil row is simply a SECOND template with the BlockID span zeroed,
+# so a whole commit is (templates (T,160), tmpl_idx (N,), ts8 (N,8)):
+# ~13 H2D bytes/row instead of 160. Rows materialize ON DEVICE before
+# SHA-512. Through the ~14 MB/s tunnel the message upload dominated
+# every multi-height eval (BENCHMARKS.md eval 3: the device sat idle
+# while ~80 MB of messages crawled up); this drops total per-row H2D
+# from ~228 B (msgs+sigs+idx) to ~80 B.
+
+SIGN_BYTES_TS_OFFSET = 93  # codec/signbytes.py TIMESTAMP_OFFSET
+
+
+def materialize_sign_bytes(templates, tmpl_idx, ts8):
+    """templates (T, W) u8, tmpl_idx (N,) i32, ts8 (N, 8) u8 big-endian
+    i64 timestamps -> (N, W) uint8 messages.
+
+    Runs as its OWN tiny program whose device-resident output feeds the
+    STANDARD prepare stages — the templated path reuses the exact
+    compiled prepare executables the materialized path warms, and the
+    big sha512 prepare program never needs a templated variant (a fused
+    form segfaulted XLA:CPU executable (de)serialization three times in
+    full-suite runs; see models/aot_cache.AotJit's fragile note).
+
+    T is static and tiny (2 per commit; one pair per height in a
+    cross-height batch), so the per-row template gather reads ~160 B
+    rows from a KB-scale table — nothing like the pathological
+    30 KB-row valset-table gathers (models/verifier.py policy)."""
+    if templates.shape[0] == 1:
+        rows = jnp.broadcast_to(
+            templates, (tmpl_idx.shape[0],) + templates.shape[1:]
+        )
+    else:
+        rows = jnp.take(templates, tmpl_idx, axis=0)
+    o = SIGN_BYTES_TS_OFFSET
+    return jnp.concatenate([rows[:, :o], ts8, rows[:, o + 8 :]], axis=1)
+
+
 def verify_stage_scan_tabled(sd, kd, tables, a_ok, idx):
     """Tabled stage 2: gather each row's key table by validator index
     (device gather along the leading axis — large contiguous rows, DMA
     friendly) and run the 4*SPLIT_W-doubling split scan."""
     row_tables = jnp.take(tables, idx, axis=0)
+    p = curve.double_scalar_mul_tabled(sd, kd, row_tables)
+    return p.x, p.y, p.z, p.t, jnp.take(a_ok, idx, axis=0)
+
+
+def verify_stage_scan_tabled_sharded(sd, kd, a_ok, idx, tables):
+    """Tabled stage 2 for LARGE valsets: `tables` is a tuple of
+    equal-size shards along the validator axis (each <= the 16384-row
+    bound that gathers fine — models/verifier.MAX_TABLED_VALSET). Each
+    shard is gathered with a clipped local index and the true shard's
+    rows selected by mask: S bounded gathers replace one huge-table
+    gather, which measured ~50x pathological at 65536 rows (round-4
+    ledger). One dispatch either way — the extra gathers cost HBM
+    reads, not round trips."""
+    shard = tables[0].shape[0]
+    row_tables = None
+    for s, t in enumerate(tables):
+        local = jnp.clip(idx - s * shard, 0, t.shape[0] - 1)
+        g = jnp.take(t, local, axis=0)
+        sel = (idx >= s * shard) & (idx < s * shard + t.shape[0])
+        g = jnp.where(sel[:, None, None, None], g, 0)
+        row_tables = g if row_tables is None else row_tables + g
     p = curve.double_scalar_mul_tabled(sd, kd, row_tables)
     return p.x, p.y, p.z, p.t, jnp.take(a_ok, idx, axis=0)
 

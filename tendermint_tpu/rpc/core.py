@@ -576,6 +576,11 @@ class RPCCore:
                 "index": res.index,
                 "key": hx(res.key),
                 "value": hx(res.value),
+                # encode_proof_ops wire form (crypto/merkle.py) — the
+                # lite verifying proxy (lite/proxy.py) consumes it;
+                # reference serves ResponseQuery.Proof here
+                # (rpc/core/abci.go:17)
+                "proof": hx(res.proof_bytes),
                 "height": res.height,
             }
         }

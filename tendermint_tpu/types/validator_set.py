@@ -319,10 +319,14 @@ class ValidatorSet:
         hot path does no per-row Python struct packing.
 
         Returns (idxs, vals_idx, pubkeys(N,32), msgs(N,160), sigs(N,64),
-        powers(N,), counted(N,)) where idxs maps rows back to signature
-        indices and vals_idx to validator indices (for duplicate-signer
-        detection during the sequential replay -- NOT here, so that a
-        duplicate after quorum doesn't reject like the reference doesn't).
+        powers(N,), counted(N,), ed(N,), tpl) where idxs maps rows back
+        to signature indices and vals_idx to validator indices (for
+        duplicate-signer detection during the sequential replay -- NOT
+        here, so that a duplicate after quorum doesn't reject like the
+        reference doesn't). tpl is the commit's templated sign-bytes
+        (templates(2,160), tmpl_idx(N,), ts8(N,8)) row-gathered like
+        msgs — device providers materialize rows on device so per-row
+        H2D carries 12 message bytes instead of 160.
         """
         idxs: List[int] = []
         vals_idx: List[int] = []
@@ -351,8 +355,27 @@ class ValidatorSet:
         pk = all_pk[vals_idx_arr] if n else np.zeros((0, 32), dtype=np.uint8)
         powers = all_powers[vals_idx_arr] if n else np.zeros(0, dtype=np.int64)
         ed = all_ed[vals_idx_arr] if n else np.zeros(0, dtype=bool)
-        mg = commit.sign_bytes_matrix(chain_id)[np.asarray(idxs, dtype=np.int64)] \
-            if n else np.zeros((0, 160), dtype=np.uint8)
+        idxs_arr = np.asarray(idxs, dtype=np.int64)
+        # ONE sign_bytes_parts call feeds both forms: the templated
+        # parts (what device providers consume) and the host-side
+        # materialization mg (fallback paths + non-ed rows). Absent
+        # rows were filtered above, so the absent-row zeroing that
+        # sign_bytes_matrix does is not needed here.
+        templates, tmpl_idx_all, ts8_all = commit.sign_bytes_parts(chain_id)
+        if n:
+            from tendermint_tpu.codec.signbytes import TIMESTAMP_OFFSET
+
+            tpl = (templates, tmpl_idx_all[idxs_arr], ts8_all[idxs_arr])
+            # fancy indexing already allocates a fresh array
+            mg = templates[tpl[1]]
+            mg[:, TIMESTAMP_OFFSET : TIMESTAMP_OFFSET + 8] = tpl[2]
+        else:
+            tpl = (
+                templates,
+                np.zeros(0, dtype=np.int32),
+                np.zeros((0, 8), dtype=np.uint8),
+            )
+            mg = np.zeros((0, 160), dtype=np.uint8)
         sg = (
             np.frombuffer(b"".join(sig_parts), dtype=np.uint8).reshape(n, 64)
             if n else np.zeros((0, 64), dtype=np.uint8)
@@ -366,9 +389,12 @@ class ValidatorSet:
             powers,
             np.asarray(counted, dtype=bool),
             ed,
+            tpl,
         )
 
-    def _verify_rows(self, commit, idxs, vals_idx, pk, mg, sg, ed, provider) -> np.ndarray:
+    def _verify_rows(
+        self, commit, idxs, vals_idx, pk, mg, sg, ed, provider, tpl=None
+    ) -> np.ndarray:
         """Per-row signature validity: ed25519 rows go to the batch
         provider in one call; rows with other key types (secp256k1, ...)
         verify serially through their own PubKey.verify — the
@@ -378,7 +404,7 @@ class ValidatorSet:
         # discarded (the host replay recomputes it), and this kernel is
         # the one vote ingest already keeps warm.
         if ed.all():
-            cached = self._rows_cached(provider, vals_idx, mg, sg)
+            cached = self._rows_cached(provider, vals_idx, mg, sg, tpl)
             if cached is not None:
                 return cached
             return np.asarray(provider.verify_batch(pk, mg, sg))
@@ -386,7 +412,10 @@ class ValidatorSet:
         sub = np.nonzero(ed)[0]
         if sub.size:
             sub_idx = np.asarray(vals_idx, dtype=np.int64)[sub]
-            cached = self._rows_cached(provider, sub_idx, mg[sub], sg[sub])
+            sub_tpl = (
+                (tpl[0], tpl[1][sub], tpl[2][sub]) if tpl is not None else None
+            )
+            cached = self._rows_cached(provider, sub_idx, mg[sub], sg[sub], sub_tpl)
             ok[sub] = (
                 cached
                 if cached is not None
@@ -395,14 +424,23 @@ class ValidatorSet:
         self._serial_fill_non_ed(ok, commit, idxs, vals_idx, mg, ed)
         return ok
 
-    def _rows_cached(self, provider, vals_idx, mg, sg) -> Optional[np.ndarray]:
+    def _rows_cached(self, provider, vals_idx, mg, sg, tpl=None) -> Optional[np.ndarray]:
         """Try the provider's per-valset cached-table path (None = use
-        the generic batch kernel). Rows must all be ed25519."""
+        the generic batch kernel). Rows must all be ed25519. The
+        templated form goes first — it uploads ~12 message bytes/row
+        instead of 160 (the dominant transport cost per commit)."""
+        key, all_pk, _ = self.batch_cache()
+        idx32 = np.asarray(vals_idx, dtype=np.int32)
+        if tpl is not None:
+            f_t = getattr(provider, "verify_rows_cached_templated", None)
+            if f_t is not None:
+                out = f_t(key, all_pk, idx32, tpl[0], tpl[1], tpl[2], sg)
+                if out is not None:
+                    return np.asarray(out)
         f = getattr(provider, "verify_rows_cached", None)
         if f is None:
             return None
-        key, all_pk, _ = self.batch_cache()
-        out = f(key, all_pk, np.asarray(vals_idx, dtype=np.int32), mg, sg)
+        out = f(key, all_pk, idx32, mg, sg)
         return None if out is None else np.asarray(out)
 
     def _serial_fill_non_ed(self, ok, commit, idxs, vals_idx, mg, ed, mg_off=0) -> None:
@@ -449,11 +487,11 @@ class ValidatorSet:
         self._check_commit_size(commit)
         self._verify_commit_basic(commit, height, block_id)
 
-        idxs, vals_idx, pk, mg, sg, powers, counted, ed = self._commit_batch_arrays(
-            chain_id, commit, by_address=False
+        idxs, vals_idx, pk, mg, sg, powers, counted, ed, tpl = (
+            self._commit_batch_arrays(chain_id, commit, by_address=False)
         )
         v = provider or get_default_provider()
-        ok = self._verify_rows(commit, idxs, vals_idx, pk, mg, sg, ed, v)
+        ok = self._verify_rows(commit, idxs, vals_idx, pk, mg, sg, ed, v, tpl)
         self._replay_commit_full(commit, ok, idxs, powers, counted)
 
     def _check_commit_size(self, commit) -> None:
@@ -513,11 +551,11 @@ class ValidatorSet:
         self._validate_trust_level(trust_level)
         self._verify_commit_basic(commit, height, block_id)
 
-        idxs, vals_idx, pk, mg, sg, powers_arr, counted_arr, ed = self._commit_batch_arrays(
-            chain_id, commit, by_address=True
+        idxs, vals_idx, pk, mg, sg, powers_arr, counted_arr, ed, tpl = (
+            self._commit_batch_arrays(chain_id, commit, by_address=True)
         )
         v = provider or get_default_provider()
-        ok = self._verify_rows(commit, idxs, vals_idx, pk, mg, sg, ed, v)
+        ok = self._verify_rows(commit, idxs, vals_idx, pk, mg, sg, ed, v, tpl)
         self._replay_commit_trusting(ok, idxs, vals_idx, powers_arr, counted_arr, trust_level)
 
     def _replay_commit_trusting(
@@ -650,6 +688,7 @@ def verify_commits_batched(
     results: List[Optional[Exception]] = [None] * len(specs)
     segments = []  # (spec_idx, idxs, vals_idx, powers, counted)
     pk_parts, mg_parts, sg_parts = [], [], []
+    tpl_templates, tpl_idx_parts, ts8_parts = [], [], []
     for si, s in enumerate(specs):
         try:
             if s.mode == "trusting":
@@ -657,7 +696,7 @@ def verify_commits_batched(
             else:
                 s.valset._check_commit_size(s.commit)
             s.valset._verify_commit_basic(s.commit, s.height, s.block_id)
-            idxs, vals_idx, pk, mg, sg, powers, counted, ed = (
+            idxs, vals_idx, pk, mg, sg, powers, counted, ed, tpl = (
                 s.valset._commit_batch_arrays(
                     s.chain_id, s.commit, by_address=(s.mode == "trusting")
                 )
@@ -669,6 +708,11 @@ def verify_commits_batched(
         pk_parts.append(pk)
         mg_parts.append(mg)
         sg_parts.append(sg)
+        # each spec contributes its own template pair; row indices
+        # offset into the stacked (2S, 160) template matrix
+        tpl_templates.append(tpl[0])
+        tpl_idx_parts.append(tpl[1] + 2 * (len(tpl_templates) - 1))
+        ts8_parts.append(tpl[2])
 
     if not segments:
         return results
@@ -683,19 +727,34 @@ def verify_commits_batched(
         # fast-sync window / light-client sequential shape: the set is
         # stable across heights), the whole cross-height batch rides
         # the per-valset cached tables — per-window decompression and
-        # table builds are hoisted out entirely (eval 3).
+        # table builds are hoisted out entirely (eval 3). The templated
+        # form uploads one template pair per HEIGHT plus 12 B/row of
+        # deltas instead of 160 B/row of materialized messages — the
+        # message upload was the measured bottleneck of the whole
+        # multi-height eval (the device sat idle behind H2D).
         ok = None
-        f = getattr(v, "verify_rows_cached", None)
-        if f is not None:
-            key0, all_pk0, ed0 = specs[segments[0][0]].valset.batch_cache()
-            if ed0.all() and all(
-                specs[si].valset.batch_cache()[0] == key0
-                for si, *_ in segments[1:]
-            ):
-                all_idx = np.concatenate(
-                    [np.asarray(seg[2], dtype=np.int32) for seg in segments]
+        key0, all_pk0, ed0 = specs[segments[0][0]].valset.batch_cache()
+        same_set = ed0.all() and all(
+            specs[si].valset.batch_cache()[0] == key0
+            for si, *_ in segments[1:]
+        )
+        if same_set:
+            all_idx = np.concatenate(
+                [np.asarray(seg[2], dtype=np.int32) for seg in segments]
+            )
+            f_t = getattr(v, "verify_rows_cached_templated", None)
+            if f_t is not None:
+                ok = f_t(
+                    key0, all_pk0, all_idx,
+                    np.concatenate(tpl_templates, axis=0),
+                    np.concatenate(tpl_idx_parts),
+                    np.concatenate(ts8_parts, axis=0),
+                    sg,
                 )
-                ok = f(key0, all_pk0, all_idx, mg, sg)
+            if ok is None:
+                f = getattr(v, "verify_rows_cached", None)
+                if f is not None:
+                    ok = f(key0, all_pk0, all_idx, mg, sg)
         if ok is None:
             ok = np.asarray(v.verify_batch(pk, mg, sg))  # ★ ONE device call, all heights
         else:

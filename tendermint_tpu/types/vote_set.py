@@ -17,7 +17,8 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
-from tendermint_tpu.crypto.batch import BatchVerifier, get_default_provider, pack_triples
+from tendermint_tpu.codec import signbytes
+from tendermint_tpu.crypto.batch import BatchVerifier, get_default_provider
 from tendermint_tpu.crypto.keys import is_batch_ed25519
 from tendermint_tpu.types.block import BlockID
 from tendermint_tpu.types.validator_set import ValidatorSet
@@ -194,12 +195,15 @@ class VoteSet:
         rows: List[int] = []  # index into `votes`
         vis: List[int] = []  # validator index per row
         pks: List[bytes] = []
-        msgs: List[bytes] = []
         sigs: List[bytes] = []
         errors: List[Exception] = []
 
         prepared: List[Optional[Tuple[Vote, int]]] = [None] * len(votes)
         direct_ok: List[Optional[bool]] = [None] * len(votes)
+        tpl_map: Dict[tuple, int] = {}  # (hash, parts_total, parts_hash)
+        tpl_list: List[bytes] = []
+        tmpl_idx_rows: List[int] = []
+        ts_rows: List[int] = []
         for k, vote in enumerate(votes):
             if vote is None:
                 errors.append(ValueError("nil vote"))
@@ -228,8 +232,27 @@ class VoteSet:
             rows.append(k)
             vis.append(vote.validator_index)
             pks.append(raw)
-            msgs.append(vote.sign_bytes(self.chain_id))
             sigs.append(vote.signature)
+            # templated form: within a vote set (one height/round/type)
+            # rows differ only in timestamp and BlockID, so ONE
+            # canonical_sign_bytes per distinct BlockID + 8 raw ts
+            # bytes per row replaces the per-vote 160 B struct.pack —
+            # host work drops with H2D (the device materializes rows,
+            # ops/ed25519.materialize_sign_bytes); full messages are
+            # built lazily only if the templated path declines
+            bid = vote.block_id
+            tb = (bid.hash, bid.parts.total, bid.parts.hash)
+            ti = tpl_map.get(tb)
+            if ti is None:
+                ti = tpl_map[tb] = len(tpl_map)
+                tpl_list.append(
+                    signbytes.canonical_sign_bytes(
+                        self.signed_msg_type, self.height, self.round,
+                        tb[0], tb[1], tb[2], 0, self.chain_id,
+                    )
+                )
+            tmpl_idx_rows.append(ti)
+            ts_rows.append(vote.timestamp_ns)
 
         # Phase 2: one batched signature verification. When the provider
         # keeps per-valset precomputed tables (verify_rows_cached), rows
@@ -237,14 +260,48 @@ class VoteSet:
         # ValidatorSet._verify_rows' cached path.
         if rows:
             provider = self.provider or get_default_provider()
-            pk, mg, sg, lens = pack_triples(pks, msgs, sigs)
+            n_rows = len(rows)
+            sg = np.frombuffer(
+                b"".join(s[:64].ljust(64, b"\x00") for s in sigs), dtype=np.uint8
+            ).reshape(n_rows, 64)
+            templates = np.frombuffer(
+                b"".join(tpl_list), dtype=np.uint8
+            ).reshape(len(tpl_list), signbytes.SIGN_BYTES_LEN)
+            tmpl_idx = np.asarray(tmpl_idx_rows, dtype=np.int32)
+            ts8 = (
+                np.asarray(ts_rows, dtype=np.int64)
+                .astype(">i8")
+                .view(np.uint8)
+                .reshape(n_rows, 8)
+            )
             ok = None
-            f = getattr(provider, "verify_rows_cached", None)
-            if f is not None and lens is None:
+            vis32 = np.asarray(vis, dtype=np.int32)
+            # templated first (see phase-1 comment); capped so a
+            # byzantine flood of distinct BlockIDs cannot grow an
+            # unbounded template upload
+            f_t = getattr(provider, "verify_rows_cached_templated", None)
+            if f_t is not None and len(tpl_list) <= 128:
                 key, all_pk, _ = self.val_set.batch_cache()
-                ok = f(key, all_pk, np.asarray(vis, dtype=np.int32), mg, sg)
+                ok = f_t(key, all_pk, vis32, templates, tmpl_idx, ts8, sg)
             if ok is None:
-                ok = provider.verify_batch(pk, mg, sg, msg_lens=lens)
+                # host-side materialization (vectorized) for the
+                # fallback paths — only paid when templated declined
+                # (fancy indexing already allocates a fresh array)
+                mg = templates[tmpl_idx]
+                mg[
+                    :,
+                    signbytes.TIMESTAMP_OFFSET : signbytes.TIMESTAMP_OFFSET + 8,
+                ] = ts8
+                f = getattr(provider, "verify_rows_cached", None)
+                if f is not None:
+                    key, all_pk, _ = self.val_set.batch_cache()
+                    ok = f(key, all_pk, vis32, mg, sg)
+                if ok is None:
+                    pk = np.frombuffer(
+                        b"".join(p[:32].ljust(32, b"\x00") for p in pks),
+                        dtype=np.uint8,
+                    ).reshape(n_rows, 32)
+                    ok = provider.verify_batch(pk, mg, sg)
         else:
             ok = []
         ok_by_vote: Dict[int, bool] = {k: bool(o) for k, o in zip(rows, ok)}
@@ -274,6 +331,13 @@ class VoteSet:
             return ErrVoteInvalidValidatorIndex("index < 0", vote=vote)
         if not vote.signature:
             return ErrVoteInvalidSignature("vote has no signature", vote=vote)
+        if len(vote.signature) > 64:
+            # reference MaxSignatureSize (Vote.ValidateBasic): an
+            # oversized signature must never be TRUNCATED into a valid
+            # 64-byte prefix by the batch packing below
+            return ErrVoteInvalidSignature(
+                f"signature too big ({len(vote.signature)})", vote=vote
+            )
         if (
             vote.height != self.height
             or vote.round != self.round

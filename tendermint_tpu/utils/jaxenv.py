@@ -69,6 +69,29 @@ def host_scope_cpu_caches() -> None:
     jax.config.update("jax_compilation_cache_dir", scoped)
 
 
+_AOT_NOISE_TAG = b"cpu_aot_loader"
+# A line is noise only when its TRIGGERING feature (the loader names
+# it: "Target machine feature <X> is not supported") is one of the
+# codegen tuning flags XLA bakes into every feature string. Merely
+# CONTAINING the flag names is not enough — every modern blob's
+# compile-feature dump lists them, including a genuinely foreign-ISA
+# blob's — so a real mismatch (triggered by e.g. +avx512fp16 on an
+# un-scoped shared cache dir) passes through.
+_AOT_NOISE_TRIGGERS = (
+    b"machine feature +prefer-no-scatter is not",
+    b"machine feature +prefer-no-gather is not",
+)
+
+
+def is_cpu_aot_noise(line) -> bool:
+    """True when `line` (str or bytes) is a KNOWN-false-positive
+    cpu_aot_loader warning (see _AOT_NOISE_TRIGGERS). Shared by the fd
+    filter below and tests/conftest's captured-output scrub."""
+    if isinstance(line, str):
+        line = line.encode("utf-8", "replace")
+    return _AOT_NOISE_TAG in line and any(t in line for t in _AOT_NOISE_TRIGGERS)
+
+
 def filter_cpu_aot_noise():
     """Filter the KNOWN-FALSE-POSITIVE cpu_aot_loader warnings from the
     C++ stderr stream (fd 2), passing everything else through.
@@ -91,22 +114,7 @@ def filter_cpu_aot_noise():
         return lambda: None
     import threading
 
-    # A line is dropped only when its TRIGGERING feature (the loader
-    # names it: "Target machine feature <X> is not supported") is one
-    # of the codegen tuning flags XLA bakes into every feature string.
-    # Merely CONTAINING the flag names is not enough — every modern
-    # blob's compile-feature dump lists them, including a genuinely
-    # foreign-ISA blob's — so a real mismatch (triggered by e.g.
-    # +avx512fp16 on an un-scoped shared cache dir) passes through.
-    tag = b"cpu_aot_loader"
-    fp_triggers = (
-        b"machine feature +prefer-no-scatter is not",
-        b"machine feature +prefer-no-gather is not",
-    )
-
-    def is_noise(line: bytes) -> bool:
-        return tag in line and any(f in line for f in fp_triggers)
-
+    is_noise = is_cpu_aot_noise
     r, w = os.pipe()
     orig = os.dup(2)
     os.dup2(w, 2)

@@ -17,13 +17,24 @@ import sys
 from tendermint_tpu.utils.jaxenv import (  # noqa: E402
     filter_cpu_aot_noise,
     force_cpu_platform,
+    is_cpu_aot_noise,
 )
 
 assert force_cpu_platform(8), "a JAX backend initialized before conftest"
 # The AOT loader warns (one ~3KB feature-dump line, twice) on EVERY
 # persistent-cache executable load — known false positives (see
 # filter_cpu_aot_noise) that bury real stderr from failing tests.
-# TM_RAW_CPP_STDERR=1 bypasses.
+# Three layers, because pytest's fd-level capture dup2's over fd 2
+# between tests and bypasses any one filter (TM_RAW_CPP_STDERR=1
+# bypasses all three):
+#  1. the fd filter below — covers collection time and capture-off
+#     (-s) runs;
+#  2. a report hook scrubbing noise lines from captured-stderr
+#     sections — covers what a FAILING test prints;
+#  3. an interpreter-exit fd filter (registered at unconfigure, after
+#     capture is done with fd 2) — covers the teardown burst of AOT
+#     loads from compile-thread joins that used to flood the last
+#     screen of every suite run.
 filter_cpu_aot_noise()
 # subprocess tests: make child interpreters skip axon registration too
 # (the sitecustomize hook is gated on this env var)
@@ -52,6 +63,40 @@ if "TM_TABLES_CACHE_DIR" not in os.environ:
 os.environ["TM_CRYPTO_PROVIDER"] = "cpu"
 
 import pytest  # noqa: E402
+
+
+def _scrub_aot_noise(text: str) -> str:
+    lines = [ln for ln in text.splitlines() if not is_cpu_aot_noise(ln)]
+    return "\n".join(lines)
+
+
+@pytest.hookimpl(wrapper=True)
+def pytest_runtest_makereport(item, call):
+    rep = yield
+    if os.environ.get("TM_RAW_CPP_STDERR") != "1":
+        rep.sections = [
+            (title, _scrub_aot_noise(content) if "stderr" in title else content)
+            for title, content in rep.sections
+        ]
+    return rep
+
+
+def pytest_unconfigure(config):
+    # LIFO atexit: registering the install here (after every
+    # module-level import already registered its own hooks, e.g. the
+    # verifier's compile-thread join at import time) makes it run
+    # FIRST at interpreter exit — so the join-triggered AOT loads warn
+    # into the filter, not the terminal. Capture has restored the real
+    # fd 2 by the time atexit runs, so the filter wraps the real
+    # stderr. Deliberately NOT restored: a restore hook registered now
+    # would run BEFORE those earlier-registered join hooks (LIFO) and
+    # unwrap fd 2 just ahead of the burst it exists to filter. The
+    # filter's pump thread forwards non-noise lines until interpreter
+    # finalization; only C++ static-destructor output after that point
+    # can be dropped.
+    import atexit
+
+    atexit.register(filter_cpu_aot_noise)
 
 
 @pytest.fixture(scope="session")

@@ -57,10 +57,13 @@ def gen_chain(
     n_heights: int,
     key_changes: Optional[Dict[int, List[Ed25519PrivKey]]] = None,
     base_keys: Optional[List[Ed25519PrivKey]] = None,
+    app_hashes: Optional[Dict[int, bytes]] = None,
 ) -> Tuple[Dict[int, SignedHeader], Dict[int, ValidatorSet]]:
     """Heights 1..n. key_changes[h] = the key list that takes effect AT
-    height h (so next_validators_hash of h-1 points at it)."""
+    height h (so next_validators_hash of h-1 points at it).
+    app_hashes[h] sets header h's app_hash (lite-proxy proof tests)."""
     key_changes = key_changes or {}
+    app_hashes = app_hashes or {}
     cur_keys = base_keys or keys(4)
     headers: Dict[int, SignedHeader] = {}
     valsets: Dict[int, ValidatorSet] = {}
@@ -80,7 +83,7 @@ def gen_chain(
             validators_hash=vals.hash(),
             next_validators_hash=next_vals.hash(),
             consensus_hash=b"\x01" * 32,
-            app_hash=b"",
+            app_hash=app_hashes.get(h, b""),
             proposer_address=vals.validators[0].address,
         )
         commit = _sign_commit(cur_keys, vals, header)

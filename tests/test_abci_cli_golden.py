@@ -44,3 +44,47 @@ def test_abci_cli_batch_matches_golden(capsys, monkeypatch):
     with open(GOLDEN) as fp:
         golden = fp.read()
     assert out == golden, f"golden mismatch:\n--- got ---\n{out}\n--- want ---\n{golden}"
+
+
+KVSTORE_GOLDEN = os.path.join(
+    os.path.dirname(__file__), "golden", "abci_cli_kvstore.txt"
+)
+
+# mirrors the reference's first golden example (abci/tests/test_cli/
+# ex1.abci: echo/info/commit/deliver/query against the kvstore app)
+KVSTORE_COMMANDS = """\
+echo hello
+info
+commit
+deliver_tx "abc"
+info
+commit
+query "abc"
+deliver_tx "def=xyz"
+commit
+query "def"
+"""
+
+
+def test_abci_cli_kvstore_matches_golden(capsys):
+    from tendermint_tpu.abci.cli import _console
+    from tendermint_tpu.abci.examples import KVStoreApplication
+    from tendermint_tpu.abci.server.socket import SocketServer
+    from tendermint_tpu.abci.client.socket import SocketClient
+
+    async def go():
+        srv = SocketServer("tcp://127.0.0.1:0", KVStoreApplication())
+        await srv.start()
+        cli = SocketClient(srv.listen_addr)
+        await cli.start()
+        try:
+            await _console(cli, lines=KVSTORE_COMMANDS.splitlines())
+        finally:
+            await cli.stop()
+            await srv.stop()
+
+    asyncio.run(go())
+    out = capsys.readouterr().out
+    with open(KVSTORE_GOLDEN) as fp:
+        golden = fp.read()
+    assert out == golden, f"golden mismatch:\n--- got ---\n{out}\n--- want ---\n{golden}"

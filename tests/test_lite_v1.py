@@ -193,3 +193,134 @@ def test_dynamic_cached_height_short_circuits():
     dv = DynamicVerifier(CHAIN_ID, trusted, source)
     dv.verify(headers[2])
     dv.verify(headers[2])  # second call hits the trusted cache
+
+
+# -- verifying proxy (reference lite/proxy/query.go) ------------------------
+
+
+def _kv_proof_setup():
+    """A tiny proven MULTISTORE (the reference's two-level shape,
+    lite/proxy/query.go:82 keypath [storeName, key]): store "main"
+    holds the kv pairs (root R1); the app root commits (storeName, R1)
+    — so a query proof is [ValueOp(key) in main, ValueOp("main") in
+    the multistore]. State at height 3, app_hash in header 4.
+    Returns (client, source, verifier, key, value, root)."""
+    import asyncio  # noqa: F401  (async client driven via asyncio.run)
+
+    from tendermint_tpu.crypto.merkle import (
+        ValueOp,
+        encode_proof_ops,
+        proofs_from_byte_slices,
+    )
+    from tendermint_tpu.codec.binary import Writer
+    import hashlib
+
+    def kv_leaf(k, v):
+        return Writer().write_bytes(k).write_bytes(
+            hashlib.sha256(v).digest()
+        ).bytes()
+
+    kv = [(b"alpha", b"1"), (b"beta", b"2"), (b"gamma", b"3")]
+    r1, proofs = proofs_from_byte_slices([kv_leaf(k, v) for k, v in kv])
+    # multistore level: one store, leaf commits ("main", hash(R1))
+    root, store_proofs = proofs_from_byte_slices([kv_leaf(b"main", r1)])
+    store_op = ValueOp(b"main", store_proofs[0]).to_proof_op()
+
+    # chain with the app hash planted at height 4 (state @3)
+    headers, valsets = gen_chain(6, app_hashes={4: root})
+    source_db = DBProvider(MemDB())
+    for h in range(1, 6):
+        source_db.save_full_commit(
+            FullCommit(headers[h], valsets[h], valsets[h + 1])
+        )
+    trusted = seeded_trusted(source_db)
+    dv = DynamicVerifier(CHAIN_ID, trusted, source_db)
+
+    from tendermint_tpu.light.provider import MockProvider
+
+    light_source = MockProvider(CHAIN_ID, headers, valsets)
+
+    class Client:
+        def __init__(self):
+            self.tamper_value = False
+            self.tamper_proof = False
+
+        async def abci_query(self, path="", data=b"", height=0, prove=False):
+            i = [k for k, _ in kv].index(data)
+            value = kv[i][1]
+            op = ValueOp(data, proofs[i]).to_proof_op()
+            proof = encode_proof_ops([op, store_op])
+            if self.tamper_value:
+                value = b"evil"
+            if self.tamper_proof:
+                proof = proof[:-1] + bytes([proof[-1] ^ 1])
+            return {
+                "response": {
+                    "code": 0,
+                    "key": data.hex(),
+                    "value": value.hex(),
+                    "proof": proof.hex(),
+                    "height": 3,
+                }
+            }
+
+    return Client(), light_source, dv, kv[1][0], kv[1][1], root
+
+
+def test_lite_proxy_get_with_proof_accepts():
+    import asyncio
+
+    from tendermint_tpu.lite import get_with_proof
+
+    client, source, dv, key, value, _ = _kv_proof_setup()
+    val, height = asyncio.run(
+        get_with_proof(key, 0, client, source, dv, store_name="main")
+    )
+    assert val == value and height == 3
+    # certified: header 4 is now trusted
+    assert dv.last_trusted_height() >= 4
+
+
+def test_lite_proxy_rejects_tampered_value_and_proof():
+    import asyncio
+
+    import pytest as _pytest
+
+    from tendermint_tpu.lite import LiteProxyError, get_with_proof
+
+    client, source, dv, key, _, _ = _kv_proof_setup()
+    client.tamper_value = True
+    with _pytest.raises(LiteProxyError):
+        asyncio.run(get_with_proof(key, 0, client, source, dv))
+    client.tamper_value = False
+    client.tamper_proof = True
+    with _pytest.raises(Exception):  # decode or proof mismatch
+        asyncio.run(get_with_proof(key, 0, client, source, dv))
+
+
+def test_lite_proxy_parse_store_path():
+    import pytest as _pytest
+
+    from tendermint_tpu.lite import LiteProxyError, parse_query_store_path
+
+    assert parse_query_store_path("/store/main/key") == "main"
+    for bad in ("store/main/key", "/stores/main/key", "/store/main/sub"):
+        with _pytest.raises(LiteProxyError):
+            parse_query_store_path(bad)
+
+
+def test_proof_ops_roundtrip():
+    from tendermint_tpu.crypto.merkle import (
+        ProofOp,
+        decode_proof_ops,
+        encode_proof_ops,
+    )
+
+    ops = [
+        ProofOp("simple:v", b"k1", b"\x01\x02"),
+        ProofOp("iavl:x", b"", b""),
+    ]
+    back = decode_proof_ops(encode_proof_ops(ops))
+    assert [(o.type, o.key, o.data) for o in back] == [
+        (o.type, o.key, o.data) for o in ops
+    ]

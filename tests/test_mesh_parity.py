@@ -109,6 +109,36 @@ def test_mesh_parity_tabled_path(models):
     assert not ok_m[9] and not ok_m[77] and ok_m.sum() == n - 2
 
 
+def test_mesh_parity_tabled_templated_path(models):
+    """The TEMPLATED tabled path (templates replicate, per-row columns
+    shard, rows materialize on device) must match the materialized
+    mesh run and the single-device templated run bit-for-bit."""
+    mesh_m, single_m = models
+    n = 128
+    pk, mg, sg = _signed_batch(n, seed=14)
+    all_pk = pk[:16].copy()
+    idx = (np.arange(n) % 16).astype(np.int32)
+    sg[9] = 0
+    sg[77, 3] ^= 1
+    # each row as its own template with the ts span spliced out:
+    # materialization must reproduce mg exactly
+    templates = mg.copy()
+    templates[:, 93:101] = 0
+    ts8 = mg[:, 93:101].copy()
+    tmpl_idx = np.arange(n, dtype=np.int32)
+    ok_mat = mesh_m.verify_rows_cached(b"mesh-valset-t", all_pk, idx, mg, sg)
+    ok_m = mesh_m.verify_rows_cached_templated(
+        b"mesh-valset-t", all_pk, idx, templates, tmpl_idx, ts8, sg
+    )
+    ok_s = single_m.verify_rows_cached_templated(
+        b"mesh-valset-t", all_pk, idx, templates, tmpl_idx, ts8, sg
+    )
+    assert ok_mat is not None and ok_m is not None and ok_s is not None
+    np.testing.assert_array_equal(ok_m, ok_mat)
+    np.testing.assert_array_equal(ok_m, ok_s)
+    assert not ok_m[9] and not ok_m[77] and ok_m.sum() == n - 2
+
+
 def test_mesh_parity_verify_only_path(models):
     mesh_m, single_m = models
     n = 64

@@ -233,8 +233,10 @@ def test_register_valset_prewarms_tabled_path():
     while _time.monotonic() < deadline:
         e = m2._valset_tables.get(b"boot-valset-2")
         if e is not None and e.ready:
-            ent = m2._entries.get(("tabled", 16, 160, int(e.tables.shape[0])))
-            if ent is not None and ent.ready:
+            rows = int(e.tables.shape[0])
+            ent = m2._entries.get(("tabled", 16, 160, 0, rows, 1))
+            ent_t = m2._entries.get(("tabled-tpl", 16, 160, 2, rows, 1))
+            if ent is not None and ent.ready and ent_t is not None and ent_t.ready:
                 warmed = True
                 break
         _time.sleep(0.25)
@@ -242,6 +244,156 @@ def test_register_valset_prewarms_tabled_path():
     # and the first live call is served immediately (no None fallback)
     ok2 = m2.verify_rows_cached(b"boot-valset-2", pk, idx, mg, sg)
     assert ok2 is not None and ok2.all()
+
+
+def _templated_rows(n, n_templates=3, seed=11):
+    """Signed rows whose messages are template[tmpl_idx] with an 8-byte
+    splice at the sign-bytes timestamp offset (93:101) — the exact
+    shape materialize_sign_bytes reconstructs on device."""
+    rng = np.random.default_rng(seed)
+    templates = rng.integers(0, 256, size=(n_templates, 160)).astype(np.uint8)
+    tmpl_idx = rng.integers(0, n_templates, size=n).astype(np.int32)
+    ts8 = rng.integers(0, 256, size=(n, 8)).astype(np.uint8)
+    msgs = templates[tmpl_idx].copy()
+    msgs[:, 93:101] = ts8
+    seeds = [rng.bytes(32) for _ in range(n)]
+    pks = np.frombuffer(
+        b"".join(ref.pubkey_from_seed(s) for s in seeds), dtype=np.uint8
+    ).reshape(n, 32)
+    sigs = np.frombuffer(
+        b"".join(ref.sign(s, m.tobytes()) for s, m in zip(seeds, msgs)),
+        dtype=np.uint8,
+    ).reshape(n, 64)
+    return pks, templates, tmpl_idx, ts8, msgs, sigs
+
+
+def test_templated_rows_cached_matches_materialized():
+    """verify_rows_cached_templated must accept/reject bit-identically
+    to verify_rows_cached on the materialized messages — dense shape,
+    gathered subset (with duplicates), and corrupted rows."""
+    from tendermint_tpu.models.verifier import VerifierModel
+
+    n = 24
+    pks, templates, tmpl_idx, ts8, msgs, sigs = _templated_rows(n)
+    sigs = sigs.copy()
+    sigs[5, 3] ^= 1
+    ts8_bad = ts8.copy()
+    ts8_bad[9] ^= 0xFF  # wrong timestamp => wrong sign bytes => reject
+
+    m = VerifierModel(block_on_compile=True)
+    key = b"tpl-parity"
+    idx = np.arange(n, dtype=np.int32)
+    ok_mat = m.verify_rows_cached(key, pks, idx, msgs, sigs)
+    ok_tpl = m.verify_rows_cached_templated(
+        key, pks, idx, templates, tmpl_idx, ts8, sigs
+    )
+    assert ok_mat is not None and ok_tpl is not None
+    np.testing.assert_array_equal(ok_mat, ok_tpl)
+    assert not ok_tpl[5] and ok_tpl.sum() == n - 1
+
+    ok_bad_ts = m.verify_rows_cached_templated(
+        key, pks, idx, templates, tmpl_idx, ts8_bad, sigs
+    )
+    assert not ok_bad_ts[9] and ok_bad_ts.sum() == n - 2
+
+    # gathered shape with duplicate validator indices
+    sub = np.array([3, 3, 11, 0, 17, 23], dtype=np.int32)
+    ok_sub = m.verify_rows_cached_templated(
+        key, pks, sub, templates, tmpl_idx[sub], ts8[sub], sigs[sub]
+    )
+    assert ok_sub is not None
+    np.testing.assert_array_equal(ok_sub, np.ones(len(sub), dtype=bool))
+
+
+def test_templated_windowed_boundary_controls(monkeypatch):
+    """The templated source through the >MAX_DEVICE_ROWS streaming path:
+    invalid rows planted across every window boundary, same controls as
+    the materialized windowed test."""
+    from tendermint_tpu.models import verifier as vmod
+
+    monkeypatch.setattr(vmod, "MAX_DEVICE_ROWS", 16)
+    pks, templates, tmpl_idx, ts8, msgs, sigs = _templated_rows(16, seed=29)
+    n = 42  # 2 full windows of 16 + tail of 10
+    rng = np.random.default_rng(5)
+    idx = rng.integers(0, 16, size=n).astype(np.int32)
+    ti = tmpl_idx[idx].copy()
+    t8 = ts8[idx].copy()
+    sg = sigs[idx].copy()
+    bad = [0, 15, 16, 31, 32, 41]
+    for b in bad:
+        sg[b, 7] ^= 0x08
+    m = vmod.VerifierModel(block_on_compile=True)
+    ok = m.verify_rows_cached_templated(b"tpl-win", pks, idx, templates, ti, t8, sg)
+    assert ok is not None and ok.shape == (n,)
+    want = np.ones(n, dtype=bool)
+    want[bad] = False
+    np.testing.assert_array_equal(ok, want)
+
+    # non-blocking with cold buckets: nothing dispatches, caller falls back
+    m2 = vmod.VerifierModel(block_on_compile=False)
+    assert (
+        m2.verify_rows_cached_templated(b"tpl-win-2", pks, idx, templates, ti, t8, sg)
+        is None
+    )
+
+
+def test_sharded_tables_large_valset(monkeypatch, tmp_path):
+    """Valsets past MAX_TABLED_VALSET ride SHARDED tables (equal-size
+    shards, per-shard bounded gathers in one program) instead of
+    falling to the generic pipeline. Shrunk constants drive the real
+    code path on CPU: 20 validators, 8-row shards. Verdicts must match
+    the materialized/templated single-table semantics bit for bit, and
+    the shards must round-trip the disk cache (re-split on load)."""
+    from tendermint_tpu.models import aot_cache, verifier as vmod
+
+    monkeypatch.setenv("TM_TABLES_CACHE_DIR", str(tmp_path))
+    monkeypatch.setattr(vmod, "MAX_TABLED_VALSET", 8)
+    monkeypatch.setattr(vmod, "_TABLE_BUILD_CHUNK", 8)
+    monkeypatch.setattr(vmod, "MAX_SHARDED_VALSET", 64)
+
+    v = 20
+    pks, msgs, sigs = _sign_rows(v, msg_len=160, seed=31)
+    pk, mg16, sg16 = _arrs(pks, msgs, sigs)
+    rng = np.random.default_rng(9)
+    n = 33  # rows spanning all shards, with duplicates
+    idx = rng.integers(0, v, size=n).astype(np.int32)
+    mg = mg16[idx].copy()
+    sg = sg16[idx].copy()
+    bad = [0, 7, 8, 20, 32]
+    for b in bad:
+        sg[b, 5] ^= 0x10
+    m = vmod.VerifierModel(block_on_compile=True)
+    ok = m.verify_rows_cached(b"sharded-valset", pk, idx, mg, sg)
+    assert ok is not None, "sharded path unavailable"
+    e = m._valset_tables[b"sharded-valset"]
+    assert e.shards is not None and len(e.shards) == 8  # v_pad 64 / 8
+    want = np.ones(n, dtype=bool)
+    want[bad] = False
+    np.testing.assert_array_equal(ok, want)
+
+    # templated source over the same sharded entry
+    templates = mg.copy()
+    templates[:, 93:101] = 0
+    ts8 = mg[:, 93:101].copy()
+    ok_t = m.verify_rows_cached_templated(
+        b"sharded-valset", pk, idx, templates,
+        np.arange(n, dtype=np.int32), ts8, sg,
+    )
+    assert ok_t is not None
+    np.testing.assert_array_equal(ok_t, want)
+
+    # disk round-trip: a fresh model loads and RE-SPLITS the shards
+    m2 = vmod.VerifierModel(block_on_compile=True)
+    ok2 = m2.verify_rows_cached(b"sharded-valset", pk, idx, mg, sg)
+    assert ok2 is not None
+    e2 = m2._valset_tables[b"sharded-valset"]
+    assert e2.source == "disk" and e2.shards is not None and len(e2.shards) == 8
+    np.testing.assert_array_equal(ok2, want)
+
+    # past MAX_SHARDED_VALSET: tabled path declines (generic fallback)
+    monkeypatch.setattr(vmod, "MAX_SHARDED_VALSET", 16)
+    m3 = vmod.VerifierModel(block_on_compile=True)
+    assert m3.verify_rows_cached(b"sharded-valset-2", pk, idx, mg, sg) is None
 
 
 def test_cross_height_batch_rides_cached_tables():
@@ -465,12 +617,15 @@ def test_tables_disk_pubkey_mismatch_rebuilds(tmp_path, monkeypatch):
 
 
 def test_oversized_valset_skips_tabled_path(monkeypatch):
-    """Sets beyond MAX_TABLED_VALSET must ride the generic pipeline:
+    """Sets beyond MAX_SHARDED_VALSET must ride the generic pipeline:
     the 50k-ingest eval measured the huge-table path ~50x slower end
-    to end (HBM-resident 2GB tables + huge-shape compiles)."""
+    to end (HBM-resident 2GB tables + huge-shape compiles). Sets
+    between the two caps go SHARDED (test_sharded_tables_large_valset)
+    — only past the sharded cap does the tabled path decline."""
     from tendermint_tpu.models import verifier as vmod
 
     monkeypatch.setattr(vmod, "MAX_TABLED_VALSET", 8)
+    monkeypatch.setattr(vmod, "MAX_SHARDED_VALSET", 8)
     pks, msgs, sigs = _sign_rows(12, seed=51)
     pk, mg, sg = _arrs(pks, msgs, sigs)
     m = vmod.VerifierModel(block_on_compile=True)

@@ -303,17 +303,22 @@ def test_commit_batch_arrays_vectorized_equivalence():
     headers, valsets = gen_chain(3)
     commit = headers[2].commit
     vals = valsets[2]
-    idxs, vals_idx, pk, mg, sg, powers, counted, ed = vals._commit_batch_arrays(
+    idxs, vals_idx, pk, mg, sg, powers, counted, ed, tpl = vals._commit_batch_arrays(
         CHAIN_ID, commit, by_address=False
     )
     assert ed.all()  # all-ed25519 set
     assert idxs == list(range(4))
+    templates, tmpl_idx, ts8 = tpl
     for r, i in enumerate(idxs):
         cs = commit.signatures[i]
         assert bytes(bytearray(mg[r])) == commit.vote_sign_bytes(CHAIN_ID, i)
         assert bytes(bytearray(sg[r])) == cs.signature.ljust(64, b"\x00")
         assert bytes(bytearray(pk[r])) == vals.validators[i].pub_key.bytes()
         assert powers[r] == vals.validators[i].voting_power
+        # templated parts materialize to the same row (host-side splice)
+        row = templates[tmpl_idx[r]].copy()
+        row[93:101] = ts8[r]
+        assert bytes(bytearray(row)) == commit.vote_sign_bytes(CHAIN_ID, i)
     # cache invalidation: power change must drop _dev_arrays
     vals._device_arrays()
     assert vals._dev_arrays is not None

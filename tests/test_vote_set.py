@@ -158,3 +158,17 @@ def test_set_peer_maj23_conflict():
     other = BlockID(hash=b"\x55" * 32, parts=PartSetHeader(1, b"\x56" * 32))
     with pytest.raises(ValueError):
         voteset.set_peer_maj23("peer1", other)
+
+
+def test_oversized_signature_rejected_not_truncated():
+    """A >64-byte signature whose 64-byte prefix is the VALID signature
+    must be rejected (reference MaxSignatureSize via Vote.ValidateBasic),
+    never truncated into acceptance by the batch packing."""
+    from tendermint_tpu.types.vote_set import ErrVoteInvalidSignature
+
+    voteset, vs, privs = setup_voteset(4)
+    v = signed_vote(privs[0], 0, BID)
+    v.signature = v.signature + b"\x00"
+    added, errs = voteset.add_votes_batched([v])
+    assert not added[0]
+    assert errs and isinstance(errs[0], ErrVoteInvalidSignature)

@@ -1,0 +1,32 @@
+#!/bin/sh
+# Generate a 4-validator testnet and load it into Kubernetes as the
+# tm-tpu-seeds Secret the StatefulSet's init container consumes.
+#
+#   ./generate.sh [n_validators] [namespace]
+#
+# Requires kubectl context pointing at the target cluster; run from a
+# checkout (or image) where `python -m tendermint_tpu.cli` imports.
+set -eu
+
+N="${1:-4}"
+NS="${2:-default}"
+OUT="$(mktemp -d)"
+trap 'rm -rf "$OUT"' EXIT
+
+# Stable k8s DNS: pod tm-tpu-<i> resolves as tm-tpu-<i>.kvstore (the
+# headless Service in app.yaml is named "kvstore").
+python -m tendermint_tpu.cli testnet \
+  --v "$N" --o "$OUT/net" \
+  --hostname-prefix tm-tpu- --hostname-suffix .kvstore --starting-ip-octet 0
+
+ARGS=""
+for i in $(seq 0 $((N - 1))); do
+  tar -C "$OUT/net/node$i" -czf "$OUT/home-$i.tgz" .
+  ARGS="$ARGS --from-file=home-$i.tgz=$OUT/home-$i.tgz"
+done
+
+# shellcheck disable=SC2086
+kubectl -n "$NS" create secret generic tm-tpu-seeds $ARGS \
+  --dry-run=client -o yaml | kubectl -n "$NS" apply -f -
+
+echo "tm-tpu-seeds Secret ready ($N nodes). Now: kubectl -n $NS apply -f app.yaml"
