@@ -258,6 +258,36 @@ def _regression_guard(line: dict, platform: str) -> list:
     return fails
 
 
+def _carry_coldstart(aot_extra: dict, platform: str) -> dict:
+    """When the cold-start probe failed (tunnel flakiness), carry the
+    previous record's coldstart keys AT MOST ONCE so the regression
+    guard keeps covering the restart path without going permanently
+    blind — a second consecutive carry leaves the keys out and the
+    guard fails the run (round-4 verdict: one clean same-run record).
+    A successful probe resets the counter (no coldstart_carried key)."""
+    if "coldstart_first_verify_s" in aot_extra or platform == "cpu":
+        return aot_extra
+    last = _last_tpu_result() or {}
+    carried = int(last.get("coldstart_carried", 0))
+    if "coldstart_first_verify_s" in last and carried < 1:
+        aot_extra = dict(aot_extra)
+        aot_extra.update(
+            {
+                k: last[k]
+                for k in (
+                    "coldstart_backend_init_s",
+                    "coldstart_first_verify_s",
+                    "coldstart_tabled_first_s",
+                    "coldstart_tables_source",
+                )
+                if k in last
+            },
+            coldstart_carried=carried + 1,
+        )
+        log("coldstart keys carried from previous record (1st carry)")
+    return aot_extra
+
+
 def run_bench(platform: str, accelerator: bool = True):
     import numpy as np
     import jax
@@ -424,7 +454,7 @@ def run_bench(platform: str, accelerator: bool = True):
                 )
                 # negative control: corrupt one timestamp byte
                 ts8_bad = ts8.copy()
-                ts8_bad[7] ^= 0xFF
+                ts8_bad[7, 3] ^= 0xFF
                 ok_tpl_b = model.verify_rows_cached_templated(
                     key, pks, idx, tpl, t_idx, ts8_bad, sg_t
                 )
@@ -542,6 +572,7 @@ def run_bench(platform: str, accelerator: bool = True):
     except Exception as ex:
         log(f"cold-start probe failed: {ex!r}")
         aot_extra = {"coldstart_error": repr(ex)[:200]}
+    aot_extra = _carry_coldstart(aot_extra, platform)
 
     extra = {}
     if pipelined_ms is not None:

@@ -13,7 +13,8 @@ the templated cached-table pipeline. Reported:
     e2e_scale_blocks_per_s_<n>    blocks/s over the measured window
     e2e_scale_ms_per_block_<n>    inverse, for eyeballing
     e2e_scale_vote_batch_p50_ms   p50 add_votes_batched latency
-    e2e_scale_votes_ingested      total simulated votes accepted
+    e2e_scale_votes_injected      votes submitted by the swarm
+    e2e_scale_votes_accepted      votes actually added (all sets)
 
     python benchmarks/e2e_scale.py              # 1,000 simulated
     EVAL1_FULL=1 python benchmarks/e2e_scale.py # 4,000 simulated
@@ -36,7 +37,11 @@ os.environ.setdefault("TM_TABLES_CACHE_DIR", "/tmp/tm_bench_tables")
 os.environ.pop("TM_CRYPTO_PROVIDER", None)
 
 N_REAL = 4
-N_SIM = 4000 if os.environ.get("EVAL1_FULL") == "1" else 1000
+N_SIM = int(
+    os.environ.get(
+        "E2E_SIM", "4000" if os.environ.get("EVAL1_FULL") == "1" else "1000"
+    )
+)
 HEIGHTS = int(os.environ.get("E2E_HEIGHTS", "8"))
 
 
@@ -61,16 +66,22 @@ def main():
     from tendermint_tpu.types import vote_set as vote_set_mod
     from tests.cs_harness import CHAIN_ID, make_genesis, make_node
 
-    prov = make_provider("tpu")  # block_on_compile: warm out of band below
+    # node mode: a cold bucket falls back to the host verifier while a
+    # background thread compiles — consensus must never stall on XLA
+    # (an inline-compile provider stalled rounds past their timeouts)
+    prov = make_provider("tpu", block_on_compile=False)
     set_default_provider(prov)
 
-    # per-batch ingest latency, observed at the real call site
+    # per-batch ingest latency + true acceptance count, observed at the
+    # real call site
     batch_ms = []
+    accepted = [0]
     orig_add = vote_set_mod.VoteSet.add_votes_batched
 
     def timed_add(self, votes):
         t0 = time.perf_counter()
         out = orig_add(self, votes)
+        accepted[0] += sum(out[0])
         if len(votes) >= N_SIM // 2:  # only the swarm drains, not 4-vote rounds
             batch_ms.append((time.perf_counter() - t0) * 1e3)
         return out
@@ -87,10 +98,28 @@ def main():
         assert len(real) == N_REAL
 
         # warm the device path out of the timed region, like a node
-        # start does: tables + the swarm-drain bucket
+        # start does: tables + the swarm-drain bucket. Wait for the
+        # warm so the MEASURED window rides the device path, not the
+        # host fallback (start isn't gated on it in a real node).
         key, all_pk, _ = st.validators.batch_cache()
         prov.register_valset(key, all_pk)
+        warm_deadline = time.monotonic() + float(
+            os.environ.get("E2E_WARM_TIMEOUT_S", "600")
+        )
+        while time.monotonic() < warm_deadline:
+            if any(
+                k[0] == "tabled-tpl" and e.ready
+                for k, e in prov.model._entries.items()
+            ):
+                break
+            await asyncio.sleep(1)
+        else:
+            print("warm timeout: measuring host-fallback path", file=sys.stderr)
 
+        # DEFAULT timeouts: this is eval 1's deployment shape, so
+        # blocks/s includes the real round timers and p2p gossip
+        # cadence — the verifier-facing number is the vote-batch p50
+        # (the swarm drain through the templated tabled pipeline)
         cfg = default_config().consensus
         cfg.create_empty_blocks = True
 
@@ -138,8 +167,10 @@ def main():
 
         injectors = [asyncio.create_task(inject(n)) for n in nodes[:1]]
         try:
+            # generous first-height allowance: residual background
+            # compiles contend with the round timers on small hosts
             await asyncio.gather(
-                *(n.cs.wait_for_height(2, timeout_s=120) for n in nodes)
+                *(n.cs.wait_for_height(2, timeout_s=600) for n in nodes)
             )
             start_h = nodes[0].cs.state.last_block_height
             t0 = time.perf_counter()
@@ -165,7 +196,8 @@ def main():
                 "ms",
             )
             emit("e2e_scale_vote_batches", float(len(batch_ms)), "count")
-        emit("e2e_scale_votes_ingested", float(injected[0]), "votes")
+        emit("e2e_scale_votes_injected", float(injected[0]), "votes")
+        emit("e2e_scale_votes_accepted", float(accepted[0]), "votes")
 
     asyncio.run(go())
 

@@ -97,6 +97,16 @@ BLOCK_ID_END = 93
 TIMESTAMP_OFFSET = 93
 
 
+def splice_timestamps(rows, ts8):
+    """Host-side materialization of templated sign bytes: write each
+    row's 8 big-endian timestamp bytes at TIMESTAMP_OFFSET, in place.
+    THE one place (with the device twin ops/ed25519.
+    materialize_sign_bytes, which imports TIMESTAMP_OFFSET from here)
+    that encodes the splice — callers must not re-derive the offset."""
+    rows[:, TIMESTAMP_OFFSET : TIMESTAMP_OFFSET + 8] = ts8
+    return rows
+
+
 def extract_timestamp_ns(sign_bytes: bytes) -> int:
     """Read the i64 timestamp back out of canonical sign-bytes — used by
     the privval only-differs-by-timestamp double-sign rule

@@ -99,6 +99,12 @@ def _bucket(n: int, multiple: int) -> int:
 _compile_threads: list = []
 _compile_threads_lock = threading.Lock()
 
+# One background WARM body at a time (see _compile_tabled_async): the
+# compile steps inside are already serialized by the AOT layer's
+# _COMPILE_SERIAL, but the interleaved eager device ops between them
+# were implicated in flaky cross-thread trace corruption.
+_WARM_SERIAL = threading.Lock()
+
 
 def _track_compile_thread(t: threading.Thread) -> None:
     with _compile_threads_lock:
@@ -629,21 +635,25 @@ class VerifierModel:
             return cached
         from tendermint_tpu.models.aot_cache import AotJit
 
-        if self.mesh is None:
-            self._materialize = AotJit(
-                ops_ed.materialize_sign_bytes, "t-materialize", fragile=True
-            )
-        else:
-            batch, rep = self._shard_specs()
-            tag = f"mesh{tuple(self.mesh.shape.values())}"
-            self._materialize = AotJit(
-                None, f"t-materialize-{tag}", fragile=True,
-                # templates replicate (KB-scale); per-row columns shard
-                jit_fn=self._smap(
-                    ops_ed.materialize_sign_bytes, 3, batch,
-                    in_specs=(rep, batch, batch),
-                ),
-            )
+        with self._lock:  # one AotJit per model (warm threads race here)
+            cached = getattr(self, "_materialize", None)
+            if cached is not None:
+                return cached
+            if self.mesh is None:
+                self._materialize = AotJit(
+                    ops_ed.materialize_sign_bytes, "t-materialize", fragile=True
+                )
+            else:
+                batch, rep = self._shard_specs()
+                tag = f"mesh{tuple(self.mesh.shape.values())}"
+                self._materialize = AotJit(
+                    None, f"t-materialize-{tag}", fragile=True,
+                    # templates replicate (KB-scale); per-row columns shard
+                    jit_fn=self._smap(
+                        ops_ed.materialize_sign_bytes, 3, batch,
+                        in_specs=(rep, batch, batch),
+                    ),
+                )
         return self._materialize
 
     def _dense_stage_fns(self):
@@ -891,13 +901,16 @@ class VerifierModel:
         """Dispatch the right stage-2 flavor: single table (gathered)
         or sharded per-shard bounded gathers."""
         if e.shards is not None:
-            from tendermint_tpu.models.aot_cache import AotJit
-
             fn = getattr(self, "_sharded_scan", None)
             if fn is None:
-                fn = self._sharded_scan = AotJit(
-                    ops_ed.verify_stage_scan_tabled_sharded, "t-scan-sh"
-                )
+                from tendermint_tpu.models.aot_cache import AotJit
+
+                with self._lock:  # one AotJit per model, like the stage tuples
+                    fn = getattr(self, "_sharded_scan", None)
+                    if fn is None:
+                        fn = self._sharded_scan = AotJit(
+                            ops_ed.verify_stage_scan_tabled_sharded, "t-scan-sh"
+                        )
             return fn(sd, kd, e.a_ok, idx_dev, e.shards)
         s2 = self._table_stage_fns()[1]
         return s2(sd, kd, e.tables, e.a_ok, idx_dev)
@@ -1192,35 +1205,52 @@ class VerifierModel:
             return
         zsrc = self._src_zero(src, n_pad)
 
-        def work():
-            try:
-                t0 = time.perf_counter()
-                s3 = self._table_stage_fns()[2]
-                sg = jnp.asarray(np.zeros((n_pad, 64), dtype=np.uint8))
-                idx = jnp.asarray(np.zeros(n_pad, dtype=np.int32))
-                sd, kd, s_ok = self._src_stage1(e, zsrc, False, n_pad, idx, sg)
-                px, py, pz, pt, a_ok = self._scan_rows(e, sd, kd, idx)
-                np.asarray(s3(px, py, pz, pt, sg, a_ok, s_ok))
-                if (
-                    self.mesh is None
-                    and e.shards is None
-                    and n_pad <= int(e.tables.shape[0])
-                ):
-                    # the dense (full-commit) variant must be warm too:
-                    # the live path picks it per-call by index shape
-                    sd, kd, s_ok = self._src_stage1(e, zsrc, True, n_pad, None, sg)
-                    s2d = self._dense_stage_fns()[1]
-                    px, py, pz, pt, a_ok = s2d(
-                        sd, kd, e.tables[:n_pad], e.a_ok[:n_pad]
-                    )
-                    np.asarray(s3(px, py, pz, pt, sg, a_ok, s_ok))
-                ent.compile_s = time.perf_counter() - t0
-                ent.ready = True
-                self.logger.info(
-                    "tabled bucket compiled", rows=n_pad, kind=src[0],
-                    msg_len=self._src_msg_len(src),
-                    seconds=round(ent.compile_s, 2),
+        def one_pass():
+            t0 = time.perf_counter()
+            s3 = self._table_stage_fns()[2]
+            sg = jnp.asarray(np.zeros((n_pad, 64), dtype=np.uint8))
+            idx = jnp.asarray(np.zeros(n_pad, dtype=np.int32))
+            sd, kd, s_ok = self._src_stage1(e, zsrc, False, n_pad, idx, sg)
+            px, py, pz, pt, a_ok = self._scan_rows(e, sd, kd, idx)
+            np.asarray(s3(px, py, pz, pt, sg, a_ok, s_ok))
+            if (
+                self.mesh is None
+                and e.shards is None
+                and n_pad <= int(e.tables.shape[0])
+            ):
+                # the dense (full-commit) variant must be warm too:
+                # the live path picks it per-call by index shape
+                sd, kd, s_ok = self._src_stage1(e, zsrc, True, n_pad, None, sg)
+                s2d = self._dense_stage_fns()[1]
+                px, py, pz, pt, a_ok = s2d(
+                    sd, kd, e.tables[:n_pad], e.a_ok[:n_pad]
                 )
+                np.asarray(s3(px, py, pz, pt, sg, a_ok, s_ok))
+            ent.compile_s = time.perf_counter() - t0
+            ent.ready = True
+            self.logger.info(
+                "tabled bucket compiled", rows=n_pad, kind=src[0],
+                msg_len=self._src_msg_len(src),
+                seconds=round(ent.compile_s, 2),
+            )
+
+        def work():
+            # _WARM_SERIAL: one warm body at a time. Two warm threads
+            # tracing simultaneously while the live thread dispatches
+            # produced flaky trace-corruption errors (KeyError(Var...),
+            # phantom shape mismatches) on CPU builds; those same
+            # errors then vanished single-threaded — so serialize, and
+            # retry once since a poisoned first trace can succeed clean
+            # on the second pass.
+            try:
+                with _WARM_SERIAL:
+                    try:
+                        one_pass()
+                    except Exception as ex:
+                        self.logger.info(
+                            "tabled warm retrying", err=repr(ex)[:120]
+                        )
+                        one_pass()
             except Exception as ex:  # pragma: no cover - defensive
                 self.logger.error("tabled compile failed", err=repr(ex))
             finally:

@@ -162,7 +162,9 @@ def verify_stage_prepare_tabled_gathered(pk_all, idx, msgs, sigs):
 # while ~80 MB of messages crawled up); this drops total per-row H2D
 # from ~228 B (msgs+sigs+idx) to ~80 B.
 
-SIGN_BYTES_TS_OFFSET = 93  # codec/signbytes.py TIMESTAMP_OFFSET
+from tendermint_tpu.codec.signbytes import (  # noqa: E402
+    TIMESTAMP_OFFSET as SIGN_BYTES_TS_OFFSET,
+)
 
 
 def materialize_sign_bytes(templates, tmpl_idx, ts8):

@@ -363,12 +363,11 @@ class ValidatorSet:
         # sign_bytes_matrix does is not needed here.
         templates, tmpl_idx_all, ts8_all = commit.sign_bytes_parts(chain_id)
         if n:
-            from tendermint_tpu.codec.signbytes import TIMESTAMP_OFFSET
+            from tendermint_tpu.codec.signbytes import splice_timestamps
 
             tpl = (templates, tmpl_idx_all[idxs_arr], ts8_all[idxs_arr])
             # fancy indexing already allocates a fresh array
-            mg = templates[tpl[1]]
-            mg[:, TIMESTAMP_OFFSET : TIMESTAMP_OFFSET + 8] = tpl[2]
+            mg = splice_timestamps(templates[tpl[1]], tpl[2])
         else:
             tpl = (
                 templates,

@@ -287,11 +287,7 @@ class VoteSet:
                 # host-side materialization (vectorized) for the
                 # fallback paths — only paid when templated declined
                 # (fancy indexing already allocates a fresh array)
-                mg = templates[tmpl_idx]
-                mg[
-                    :,
-                    signbytes.TIMESTAMP_OFFSET : signbytes.TIMESTAMP_OFFSET + 8,
-                ] = ts8
+                mg = signbytes.splice_timestamps(templates[tmpl_idx], ts8)
                 f = getattr(provider, "verify_rows_cached", None)
                 if f is not None:
                     key, all_pk, _ = self.val_set.batch_cache()

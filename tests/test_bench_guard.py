@@ -89,3 +89,31 @@ def test_guard_env_kill_switch(bench, monkeypatch):
     _write_record(bench, tabled_p50_ms=100.0)
     monkeypatch.setenv("TM_BENCH_NO_GUARD", "1")
     assert bench._regression_guard({}, "tpu") == []
+
+
+def test_coldstart_carry_at_most_once(bench):
+    """A failed cold-start probe carries the previous record's keys
+    exactly once; a record that already carried leaves them out (the
+    presence guard then fails the run), and a successful probe resets."""
+    _write_record(
+        bench, value=30.0, coldstart_first_verify_s=9.1, coldstart_carried=0
+    )
+    out = bench._carry_coldstart({}, "tpu")
+    assert out["coldstart_first_verify_s"] == 9.1
+    assert out["coldstart_carried"] == 1
+
+    # record that already carried once: no second carry
+    _write_record(
+        bench, value=30.0, coldstart_first_verify_s=9.1, coldstart_carried=1
+    )
+    out2 = bench._carry_coldstart({}, "tpu")
+    assert "coldstart_first_verify_s" not in out2
+    # and the presence-only guard flags the resulting line
+    fails = bench._regression_guard({"value": 30.0, "bench_n": 10000}, "tpu")
+    assert any("coldstart_first_verify_s" in f for f in fails)
+
+    # successful probe passes through untouched (no carried counter)
+    fresh = {"coldstart_first_verify_s": 8.0}
+    assert bench._carry_coldstart(dict(fresh), "tpu") == fresh
+    # cpu fallback never carries
+    assert bench._carry_coldstart({}, "cpu") == {}
