@@ -343,6 +343,18 @@ def main(argv=None):
         net = make_net(out, pex_topology=pex_topology)
         try:
             net.start_all()
+            if not pex_topology:
+                # every node's RPC answering before any scenario runs:
+                # scenarios call net.height() unguarded, and a subset
+                # run that skips `basic` (which used to absorb startup)
+                # hit ConnectionRefused on a fresh net. The pex net is
+                # exempt: its nodes must DISCOVER the quorum first, the
+                # scenario budgets its own 180s for that, and its
+                # wait_for loops already swallow connection errors.
+                wait_for(
+                    lambda: all(net.height(i) >= 1 for i in range(net.n)),
+                    120, "net never came up",
+                )
             for nm in group:
                 log(f"--- scenario {nm} ---")
                 SCENARIOS[nm][0](net)
