@@ -160,6 +160,13 @@ class BlockPool:
             p.n_pending -= 1
         return True
 
+    def peek_block(self, height: int):
+        """Delivered block at `height`, or None — the lookahead probe
+        for the pipelined verify window (blockchain/verify_window.py);
+        peek_two_blocks stays the apply-path API."""
+        r = self.requesters.get(height)
+        return r.block if r is not None else None
+
     def peek_two_blocks(self):
         """(first, second) at (height, height+1), or (None, None)
         (reference PeekTwoBlocks — verification needs the SECOND block's

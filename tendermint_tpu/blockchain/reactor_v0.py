@@ -15,9 +15,11 @@ generations:
 - v2 (reactor.py): pure-FSM scheduler + processor with cross-height
   BATCHED commit verification (the TPU-first redesign).
 
-Commit verification still drains through the configured BatchVerifier
-(one batched device call per commit), so v0 keeps the device path for
-the signature work itself.
+Commit verification drains through the configured BatchVerifier and,
+when the provider is the pipelined dispatcher (crypto/pipeline.py),
+through a K-deep CommitVerifyWindow: heights H..H+K-1 verify in flight
+while H applies, instead of alternating verify/apply serially
+(blockchain/verify_window.py owns the staleness guards).
 """
 
 from __future__ import annotations
@@ -27,6 +29,7 @@ from typing import Optional
 
 from tendermint_tpu.blockchain import messages as m
 from tendermint_tpu.blockchain.pool import BlockPool
+from tendermint_tpu.blockchain.verify_window import CommitVerifyWindow
 from tendermint_tpu.blockchain.reactor import (
     BLOCKCHAIN_CHANNEL,
     STATUS_UPDATE_INTERVAL_S,
@@ -35,7 +38,6 @@ from tendermint_tpu.blockchain.reactor import (
 from tendermint_tpu.p2p.conn.connection import ChannelDescriptor
 from tendermint_tpu.p2p.peer import Peer
 from tendermint_tpu.p2p.switch import Reactor
-from tendermint_tpu.types.block import BlockID
 from tendermint_tpu.utils.log import get_logger
 
 
@@ -48,6 +50,8 @@ class BlockchainReactorV0(Reactor):
         fast_sync: bool,
         consensus_reactor=None,
         logger=None,
+        verify_depth: Optional[int] = None,
+        provider=None,
     ):
         super().__init__("blockchain")
         self.logger = logger or get_logger("blockchain.v0")
@@ -58,6 +62,7 @@ class BlockchainReactorV0(Reactor):
         self._consensus_reactor = consensus_reactor
         self.pool = BlockPool(start_height=state.last_block_height + 1)
         self._switched = False
+        self._verify_window = CommitVerifyWindow(depth=verify_depth, provider=provider)
 
     def get_channels(self):
         return [
@@ -177,26 +182,30 @@ class BlockchainReactorV0(Reactor):
                 await asyncio.sleep(0.5)
 
     async def _try_sync_one(self) -> bool:
+        # keep K commits in flight through the pipelined dispatcher
+        # (inert when the provider has no submit_commit — then the
+        # serial verify below is the only path, the original v0 shape)
+        self._verify_window.lookahead(
+            self.pool.peek_block,
+            self.pool.height,
+            self.state.chain_id,
+            self.state.validators,
+        )
         first, second = self.pool.peek_two_blocks()
         if first is None or second is None:
             return False
-        parts = first.make_part_set()
-        bid = BlockID(hash=first.hash(), parts=parts.header())
-        try:
-            # ONE commit verified per step — the v0 shape; the signature
-            # batch inside still runs on the device provider
-            self.state.validators.verify_commit(
-                self.state.chain_id, bid, first.header.height, second.last_commit
-            )
-        except Exception as e:
-            self.logger.error(
-                "invalid block; redo", height=first.header.height, err=str(e)
-            )
-            for pid in self.pool.redo_request(first.header.height):
+        height = first.header.height
+        parts, bid, err = await self._verify_window.verify_pair(
+            first, second, self.state.chain_id, self.state.validators
+        )
+        if err is not None:
+            self.logger.error("invalid block; redo", height=height, err=str(err))
+            self._verify_window.clear()  # refetched blocks invalidate lookahead
+            for pid in self.pool.redo_request(height):
                 peer = self.switch.peers.get(pid) if self.switch else None
                 if peer is not None:
                     await self.switch.stop_peer_for_error(
-                        peer, f"bad block {first.header.height}: {e}"
+                        peer, f"bad block {height}: {err}"
                     )
             return False
         self._store.save_block(first, parts, second.last_commit)

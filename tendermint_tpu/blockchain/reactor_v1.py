@@ -10,7 +10,10 @@ selection happens in node/node.py via config fast_sync.version. The
 FSM itself (blockchain/v1.py) is pure and table-tested; this shell
 owns asyncio timers, the switch, and block execution. Commit
 verification drains through ValidatorSet.verify_commit, i.e. the
-batched device provider (per-valset cached tables when warm).
+batched device provider (per-valset cached tables when warm), and —
+when the provider is the pipelined dispatcher (crypto/pipeline.py) —
+through a K-deep CommitVerifyWindow that verifies heights H..H+K-1 in
+flight while H applies (blockchain/verify_window.py).
 """
 
 from __future__ import annotations
@@ -29,10 +32,10 @@ from tendermint_tpu.blockchain.v1 import (
     FsmV1,
     ToReactor,
 )
+from tendermint_tpu.blockchain.verify_window import CommitVerifyWindow
 from tendermint_tpu.p2p.conn.connection import ChannelDescriptor
 from tendermint_tpu.p2p.peer import Peer
 from tendermint_tpu.p2p.switch import Reactor
-from tendermint_tpu.types.block import BlockID
 from tendermint_tpu.utils.log import get_logger
 
 TRY_SEND_INTERVAL_S = 0.25
@@ -47,6 +50,8 @@ class BlockchainReactorV1(Reactor, ToReactor):
         fast_sync: bool,
         consensus_reactor=None,
         logger=None,
+        verify_depth: Optional[int] = None,
+        provider=None,
     ):
         Reactor.__init__(self, "blockchain")
         self.logger = logger or get_logger("blockchain.v1")
@@ -57,6 +62,7 @@ class BlockchainReactorV1(Reactor, ToReactor):
         self._consensus_reactor = consensus_reactor
         self.fsm = FsmV1(state.last_block_height + 1, self)
         self._switched = False
+        self._verify_window = CommitVerifyWindow(depth=verify_depth, provider=provider)
         self._timer_task: Optional[asyncio.Task] = None
         self._timer_gen = 0
 
@@ -225,22 +231,29 @@ class BlockchainReactorV1(Reactor, ToReactor):
                 await asyncio.sleep(0.5)
 
     async def _process_block(self) -> bool:
+        # K-deep lookahead through the pipelined dispatcher (inert when
+        # the provider has no submit_commit — then the serial verify
+        # below is the only path, the original v1 shape)
+        self._verify_window.lookahead(
+            self.fsm.pool.block_at,
+            self.fsm.pool.height,
+            self.state.chain_id,
+            self.state.validators,
+        )
         try:
             first, _fp, second, _sp = self.fsm.pool.first_two_blocks_and_peers()
         except ErrMissingBlock:
             return False
-        parts = first.make_part_set()
-        bid = BlockID(hash=first.hash(), parts=parts.header())
-        try:
-            self.state.validators.verify_commit(
-                self.state.chain_id, bid, first.header.height, second.last_commit
-            )
-        except Exception as e:
+        height = first.header.height
+        parts, bid, err = await self._verify_window.verify_pair(
+            first, second, self.state.chain_id, self.state.validators
+        )
+        if err is not None:
             self.logger.error(
-                "invalid block; invalidating pair", height=first.header.height,
-                err=str(e),
+                "invalid block; invalidating pair", height=height, err=str(err)
             )
-            self.fsm.handle_processed_block(e)
+            self._verify_window.clear()  # pool refetches; lookahead is stale
+            self.fsm.handle_processed_block(err)
             return False
         self._store.save_block(first, parts, second.last_commit)
         self.state, _ = await self._block_exec.apply_block(self.state, bid, first)

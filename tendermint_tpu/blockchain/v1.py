@@ -269,6 +269,15 @@ class BlockPoolV1:
             return e
         return None
 
+    def block_at(self, height: int):
+        """Delivered block at `height`, or None (no exception — the
+        pipelined verify window probes far heights opportunistically,
+        blockchain/verify_window.py)."""
+        peer = self.peers.get(self.blocks.get(height, ""))
+        if peer is None:
+            return None
+        return peer.blocks.get(height)
+
     def _block_and_peer(self, height: int):
         peer = self.peers.get(self.blocks.get(height, ""))
         if peer is None:

@@ -1,0 +1,156 @@
+"""CommitVerifyWindow: K-deep in-flight commit verification for the
+fast-sync engines.
+
+The v0 and v1 reactors verify ONE block pair per loop turn and block on
+the device call before applying (reactor_v0._try_sync_one,
+reactor_v1._process_block) — verify and apply alternate serially, so
+the device idles during ABCI execution and the executor idles during
+verification. This window keeps up to ``depth`` commits in flight
+through the pipelined dispatcher (crypto/pipeline.PipelinedVerifier
+.submit_commit): heights H..H+K-1 verify — grouped into ONE
+cross-height device call when they land in the same bundle — while the
+reactor applies H.
+
+Correctness guards, because lookahead verifies against the validator
+set as of SUBMIT time:
+
+- an entry is only consumed when its block objects are STILL the pool's
+  blocks for that height (``is`` identity — a redo/refetch replaces the
+  objects) and the submit-time validator set equals the set the serial
+  path would use now (content equality; a valset-changing block between
+  submit and use invalidates the entry);
+- on any verification failure the whole window is dropped (the pool
+  refetches, and refetched blocks fail the identity check anyway);
+- when the provider has no ``submit_commit`` (plain CPU/TPU provider,
+  pipeline disabled), the window is inert and the reactors fall back to
+  the exact serial verify they always did.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Callable, Dict, Optional, Tuple
+
+from tendermint_tpu.crypto.batch import get_default_provider
+from tendermint_tpu.types.block import BlockID
+
+DEFAULT_VERIFY_DEPTH = 8
+
+
+class CommitVerifyWindow:
+    def __init__(self, depth: Optional[int] = None, provider=None):
+        self._depth = depth
+        self._provider = provider
+        self._inflight: Dict[int, dict] = {}
+
+    def provider(self):
+        return self._provider if self._provider is not None else get_default_provider()
+
+    def depth(self) -> int:
+        if self._depth:
+            return int(self._depth)
+        return int(getattr(self.provider(), "depth", 0) or DEFAULT_VERIFY_DEPTH)
+
+    def inflight(self) -> int:
+        return len(self._inflight)
+
+    def lookahead(
+        self,
+        peek: Callable[[int], Optional[object]],
+        base_height: int,
+        chain_id: str,
+        validators,
+    ) -> None:
+        """Submit verification for every complete (h, h+1) pair in
+        [base_height, base_height+depth) that isn't already in flight.
+        ``peek(h)`` returns the pool's delivered block at h or None.
+        Host prep (part sets, block hashes) happens here, overlapping
+        the device work already in flight."""
+        submit = getattr(self.provider(), "submit_commit", None)
+        if submit is None:
+            return
+        from tendermint_tpu.types.validator_set import CommitVerifySpec
+
+        # prune applied heights (and entries whose blocks were replaced)
+        for h in [h for h in self._inflight if h < base_height]:
+            del self._inflight[h]
+        for h in range(base_height, base_height + self.depth()):
+            first, second = peek(h), peek(h + 1)
+            if first is None or second is None:
+                continue
+            ent = self._inflight.get(h)
+            if ent is not None:
+                if (
+                    ent["first"] is first
+                    and ent["second"] is second
+                    and (ent["valset"] is validators or ent["valset"] == validators)
+                ):
+                    # refresh to the current object: apply_block installs
+                    # a fresh (equal) validators copy every height, and
+                    # without this the `is` fast path here and in take()
+                    # never hits again — at 10k validators that's an
+                    # O(n) content comparison per entry per loop turn
+                    ent["valset"] = validators
+                    continue
+                # pool refetched the blocks, or a valset-changing block
+                # applied since submit: resubmit against current state
+                # (take() would reject the entry anyway — without this,
+                # a chain with per-block power changes would pay a
+                # discarded device verify plus a serial re-verify at
+                # every height)
+                del self._inflight[h]
+            parts = first.make_part_set()
+            bid = BlockID(hash=first.hash(), parts=parts.header())
+            spec = CommitVerifySpec(
+                validators, chain_id, bid, first.header.height, second.last_commit
+            )
+            self._inflight[h] = {
+                "first": first,
+                "second": second,
+                "parts": parts,
+                "bid": bid,
+                "valset": validators,
+                "future": submit(spec),
+            }
+
+    def take(self, height: int, first, second, validators) -> Optional[dict]:
+        """The in-flight entry for ``height`` iff it is still valid for
+        (first, second, validators); None means verify serially."""
+        ent = self._inflight.pop(height, None)
+        if (
+            ent is not None
+            and ent["first"] is first
+            and ent["second"] is second
+            and (ent["valset"] is validators or ent["valset"] == validators)
+        ):
+            return ent
+        return None
+
+    async def verify_pair(
+        self, first, second, chain_id: str, validators
+    ) -> Tuple[object, BlockID, Optional[Exception]]:
+        """Verify (first, second.last_commit) and return
+        (parts, block_id, err) — err is None on acceptance. Consumes the
+        in-flight entry when one is still valid for exactly these
+        blocks and this validator set; otherwise verifies serially, the
+        original reactor behavior. Shared by both fast-sync engines so
+        the await/fallback logic cannot diverge between them."""
+        height = first.header.height
+        ent = self.take(height, first, second, validators)
+        if ent is not None:
+            try:
+                err = await asyncio.wrap_future(ent["future"])
+            except Exception as e:
+                err = e
+            return ent["parts"], ent["bid"], err
+        parts = first.make_part_set()
+        bid = BlockID(hash=first.hash(), parts=parts.header())
+        try:
+            validators.verify_commit(chain_id, bid, height, second.last_commit)
+            err = None
+        except Exception as e:
+            err = e
+        return parts, bid, err
+
+    def clear(self) -> None:
+        self._inflight.clear()

@@ -51,6 +51,16 @@ class BaseConfig:
     filter_peers: bool = False
     # TPU crypto provider selection (the plugin seam BASELINE.json names)
     crypto_provider: str = "tpu"  # tpu | cpu
+    # crypto.pipeline: wrap the provider in the pipelined dispatcher
+    # (crypto/pipeline.py) — future-based micro-batching with a gossip
+    # dedupe cache. depth = how many fast-sync commits the reactors
+    # keep in flight (the K-deep verify window,
+    # blockchain/verify_window.py); flush_ms = how long the dispatcher
+    # lingers to coalesce concurrent requests into one device call
+    # (0 = only the natural back-pressure coalescing).
+    crypto_pipeline: bool = True
+    crypto_pipeline_depth: int = 8
+    crypto_pipeline_flush_ms: int = 0
     # Shard the verify batch over a device mesh when this many JAX
     # devices are available (0/1 = single device). The sharded program
     # is shard_map'd per stage with the quorum tally psum'd over ICI
@@ -78,6 +88,10 @@ class BaseConfig:
             return f"unknown db_backend {self.db_backend!r}"
         if self.abci not in ("local", "socket", "grpc"):
             return f"unknown abci transport {self.abci!r}"
+        if self.crypto_pipeline_depth < 1:
+            return "crypto_pipeline_depth must be >= 1"
+        if self.crypto_pipeline_flush_ms < 0:
+            return "crypto_pipeline_flush_ms can't be negative"
         return None
 
 
@@ -453,10 +467,14 @@ def write_config_file(path: str, cfg: Config) -> None:
 
 
 def load_config(path: str) -> Config:
-    import tomllib
+    try:
+        import tomllib
 
-    with open(path, "rb") as fp:
-        raw = tomllib.load(fp)
+        with open(path, "rb") as fp:
+            raw = tomllib.load(fp)
+    except ImportError:  # Python < 3.11: parse the subset we render
+        with open(path, "r") as fp:
+            raw = _parse_toml_subset(fp.read())
     cfg = Config()
     _apply(cfg.base, {k: v for k, v in raw.items() if not isinstance(v, dict)})
     for attr, header in _SECTIONS:
@@ -468,6 +486,57 @@ def load_config(path: str) -> Config:
     if env_provider:
         cfg.base.crypto_provider = env_provider
     return cfg
+
+
+def _parse_toml_subset(text: str) -> dict:
+    """Minimal TOML reader for the exact subset write_config_file emits
+    (flat [section]s; str/bool/int/float and flat string lists). Used
+    only when stdlib tomllib (3.11+) is unavailable."""
+    import ast
+
+    root: dict = {}
+    cur = root
+    for line in text.splitlines():
+        line = line.strip()
+        if not line or line.startswith("#"):
+            continue
+        if line.startswith("[") and line.endswith("]"):
+            cur = root.setdefault(line[1:-1].strip(), {})
+            continue
+        key, _, val = line.partition("=")
+        key, val = key.strip(), val.strip()
+        if not _:
+            continue
+        if val.startswith("'"):
+            # TOML literal string: NO escape processing (ast would
+            # reinterpret backslashes)
+            end = val.find("'", 1)
+            if end < 0:
+                raise ValueError(f"unterminated string for {key!r}")
+            cur[key] = val[1:end]
+            continue
+        if val.startswith('"'):
+            # scan to the closing unescaped quote so a trailing
+            # comment is not swallowed into the value
+            i = 1
+            while i < len(val):
+                if val[i] == "\\":
+                    i += 2
+                    continue
+                if val[i] == '"':
+                    break
+                i += 1
+            cur[key] = ast.literal_eval(val[: i + 1])
+            continue
+        # non-string value: an inline comment is not part of it
+        val = val.split("#", 1)[0].strip()
+        if val in ("true", "false"):
+            cur[key] = val == "true"
+        else:
+            # lists/numbers as rendered by _toml_value are valid
+            # Python literals
+            cur[key] = ast.literal_eval(val)
+    return root
 
 
 def _apply(obj, d: dict) -> None:

@@ -40,11 +40,18 @@ class HeightVoteSet:
         height: int,
         val_set: ValidatorSet,
         provider=None,
+        dedupe_cache=None,
     ):
         self.chain_id = chain_id
         self.height = height
         self.val_set = val_set
         self.provider = provider
+        # One gossip dedupe cache (crypto/pipeline.SigCache) shared by
+        # every round's VoteSets: a vote redelivered across rounds or
+        # catch-up (the same triple lands in the same round's set) pays
+        # one hash instead of a device round trip. None = the
+        # process-wide default cache.
+        self.dedupe_cache = dedupe_cache
         self.round = 0
         self._round_vote_sets: Dict[int, _RoundVoteSet] = {}
         self._peer_catchup_rounds: Dict[str, List[int]] = {}
@@ -70,11 +77,11 @@ class HeightVoteSet:
         self._round_vote_sets[round_] = _RoundVoteSet(
             prevotes=VoteSet(
                 self.chain_id, self.height, round_, PREVOTE_TYPE, self.val_set,
-                provider=self.provider,
+                provider=self.provider, dedupe_cache=self.dedupe_cache,
             ),
             precommits=VoteSet(
                 self.chain_id, self.height, round_, PRECOMMIT_TYPE, self.val_set,
-                provider=self.provider,
+                provider=self.provider, dedupe_cache=self.dedupe_cache,
             ),
         )
 

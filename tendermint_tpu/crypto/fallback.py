@@ -1,0 +1,523 @@
+"""Pure-Python stand-ins for the `cryptography` package.
+
+This container policy is "stub or gate missing deps": the `cryptography`
+wheel (OpenSSL bindings) is not always present in the image, and without
+it every module that host-signs or host-verifies fails at import —
+which at the seed took out most of the test suite and the bench's CPU
+fallback path. This module implements the exact API subset those
+modules use, so they gate their imports:
+
+    try:
+        from cryptography... import X
+    except ImportError:
+        from tendermint_tpu.crypto.fallback import X
+
+Implementations:
+
+- ed25519: delegates to ops/ref_ed25519.py — the repo's own reference
+  implementation, differentially tested against the device kernels and
+  pinned to RFC 8032 vector 1 (tests/test_ops_ed25519.py).
+- ChaCha20-Poly1305 AEAD: RFC 8439 (KATs in tests/test_crypto_fallback).
+- X25519: RFC 7748 montgomery ladder.
+- HKDF-SHA256: RFC 5869 over stdlib hmac.
+- secp256k1 ECDSA: jacobian-coordinate curve ops with RFC 6979
+  deterministic nonces (OpenSSL uses random nonces — signatures differ
+  but verify identically; determinism is strictly stronger).
+
+Pure Python is ~100x slower than OpenSSL (ed25519 verify ~6 ms vs
+~60 us). That is fine for tests and for correctness-fallback operation;
+a production deployment ships the real wheel (the batched device path
+never touches this code — it has its own kernels).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import hmac as _hmac
+import os
+import struct
+from typing import Optional, Tuple
+
+
+class InvalidSignature(Exception):
+    pass
+
+
+class InvalidTag(Exception):
+    pass
+
+
+# -- namespace shims (the consumers only use these as enum-ish tags) --------
+
+
+class _Raw:
+    pass
+
+
+class serialization:  # noqa: N801 - mirrors the cryptography module name
+    class Encoding:
+        Raw = "raw"
+        X962 = "x962"
+
+    class PublicFormat:
+        Raw = "raw"
+        CompressedPoint = "compressed"
+
+
+class hashes:  # noqa: N801
+    class SHA256:
+        digest_size = 32
+
+
+# -- ed25519 (delegates to the repo's reference implementation) -------------
+
+
+class Ed25519PublicKey:
+    def __init__(self, raw: bytes):
+        if len(raw) != 32:
+            raise ValueError("ed25519 public key must be 32 bytes")
+        self._raw = bytes(raw)
+
+    @classmethod
+    def from_public_bytes(cls, data: bytes) -> "Ed25519PublicKey":
+        return cls(data)
+
+    def public_bytes(self, encoding=None, format=None) -> bytes:
+        return self._raw
+
+    def verify(self, signature: bytes, data: bytes) -> None:
+        from tendermint_tpu.ops import ref_ed25519 as ref
+
+        if not ref.verify(self._raw, data, signature):
+            raise InvalidSignature("ed25519 verification failed")
+
+
+class Ed25519PrivateKey:
+    def __init__(self, seed: bytes):
+        if len(seed) != 32:
+            raise ValueError("ed25519 private key must be a 32-byte seed")
+        self._seed = bytes(seed)
+
+    @classmethod
+    def from_private_bytes(cls, data: bytes) -> "Ed25519PrivateKey":
+        return cls(data)
+
+    @classmethod
+    def generate(cls) -> "Ed25519PrivateKey":
+        return cls(os.urandom(32))
+
+    def private_bytes(self, encoding=None, format=None, encryption_algorithm=None) -> bytes:
+        return self._seed
+
+    def public_key(self) -> Ed25519PublicKey:
+        from tendermint_tpu.ops import ref_ed25519 as ref
+
+        return Ed25519PublicKey(ref.pubkey_from_seed(self._seed))
+
+    def sign(self, data: bytes) -> bytes:
+        from tendermint_tpu.ops import ref_ed25519 as ref
+
+        return ref.sign(self._seed, data)
+
+
+# -- ChaCha20-Poly1305 AEAD (RFC 8439) --------------------------------------
+
+_MASK32 = 0xFFFFFFFF
+
+
+def _quarter(w, a, b, c, d):
+    w[a] = (w[a] + w[b]) & _MASK32
+    w[d] ^= w[a]
+    w[d] = ((w[d] << 16) | (w[d] >> 16)) & _MASK32
+    w[c] = (w[c] + w[d]) & _MASK32
+    w[b] ^= w[c]
+    w[b] = ((w[b] << 12) | (w[b] >> 20)) & _MASK32
+    w[a] = (w[a] + w[b]) & _MASK32
+    w[d] ^= w[a]
+    w[d] = ((w[d] << 8) | (w[d] >> 24)) & _MASK32
+    w[c] = (w[c] + w[d]) & _MASK32
+    w[b] ^= w[c]
+    w[b] = ((w[b] << 7) | (w[b] >> 25)) & _MASK32
+
+
+def _chacha20_block(key_words, counter: int, nonce_words) -> bytes:
+    state = [
+        0x61707865, 0x3320646E, 0x79622D32, 0x6B206574,
+        *key_words, counter & _MASK32, *nonce_words,
+    ]
+    w = list(state)
+    for _ in range(10):
+        _quarter(w, 0, 4, 8, 12)
+        _quarter(w, 1, 5, 9, 13)
+        _quarter(w, 2, 6, 10, 14)
+        _quarter(w, 3, 7, 11, 15)
+        _quarter(w, 0, 5, 10, 15)
+        _quarter(w, 1, 6, 11, 12)
+        _quarter(w, 2, 7, 8, 13)
+        _quarter(w, 3, 4, 9, 14)
+    return struct.pack(
+        "<16I", *(((w[i] + state[i]) & _MASK32) for i in range(16))
+    )
+
+
+def _chacha20_xor(key_words, counter: int, nonce_words, data: bytes) -> bytes:
+    out = bytearray(len(data))
+    for i in range(0, len(data), 64):
+        block = _chacha20_block(key_words, counter + i // 64, nonce_words)
+        chunk = data[i : i + 64]
+        out[i : i + len(chunk)] = bytes(
+            x ^ y for x, y in zip(chunk, block)
+        )
+    return bytes(out)
+
+
+_P1305 = (1 << 130) - 5
+
+
+def _poly1305(otk: bytes, msg: bytes) -> bytes:
+    r = int.from_bytes(otk[:16], "little") & 0x0FFFFFFC0FFFFFFC0FFFFFFC0FFFFFFF
+    s = int.from_bytes(otk[16:32], "little")
+    acc = 0
+    for i in range(0, len(msg), 16):
+        n = int.from_bytes(msg[i : i + 16] + b"\x01", "little")
+        acc = (acc + n) * r % _P1305
+    return ((acc + s) & ((1 << 128) - 1)).to_bytes(16, "little")
+
+
+def _pad16(b: bytes) -> bytes:
+    return b"\x00" * (-len(b) % 16)
+
+
+class ChaCha20Poly1305:
+    def __init__(self, key: bytes):
+        if len(key) != 32:
+            raise ValueError("ChaCha20Poly1305 key must be 32 bytes")
+        self._key_words = struct.unpack("<8I", key)
+
+    def _mac(self, nonce_words, ciphertext: bytes, aad: bytes) -> bytes:
+        otk = _chacha20_block(self._key_words, 0, nonce_words)[:32]
+        mac_data = (
+            aad + _pad16(aad) + ciphertext + _pad16(ciphertext)
+            + struct.pack("<QQ", len(aad), len(ciphertext))
+        )
+        return _poly1305(otk, mac_data)
+
+    def encrypt(self, nonce: bytes, data: bytes, associated_data: Optional[bytes]) -> bytes:
+        if len(nonce) != 12:
+            raise ValueError("nonce must be 12 bytes")
+        aad = associated_data or b""
+        nw = struct.unpack("<3I", nonce)
+        ct = _chacha20_xor(self._key_words, 1, nw, data)
+        return ct + self._mac(nw, ct, aad)
+
+    def decrypt(self, nonce: bytes, data: bytes, associated_data: Optional[bytes]) -> bytes:
+        if len(nonce) != 12:
+            raise ValueError("nonce must be 12 bytes")
+        if len(data) < 16:
+            raise InvalidTag("ciphertext too short")
+        aad = associated_data or b""
+        nw = struct.unpack("<3I", nonce)
+        ct, tag = data[:-16], data[-16:]
+        if not _hmac.compare_digest(self._mac(nw, ct, aad), tag):
+            raise InvalidTag("poly1305 tag mismatch")
+        return _chacha20_xor(self._key_words, 1, nw, ct)
+
+
+# -- X25519 (RFC 7748) ------------------------------------------------------
+
+_P25519 = 2**255 - 19
+_A24 = 121665
+
+
+def _x25519_scalarmult(k_bytes: bytes, u_bytes: bytes) -> bytes:
+    k = bytearray(k_bytes)
+    k[0] &= 248
+    k[31] &= 127
+    k[31] |= 64
+    k_int = int.from_bytes(bytes(k), "little")
+    u = int.from_bytes(u_bytes, "little") & ((1 << 255) - 1)
+    x1, x2, z2, x3, z3 = u, 1, 0, u, 1
+    swap = 0
+    for t in range(254, -1, -1):
+        k_t = (k_int >> t) & 1
+        swap ^= k_t
+        if swap:
+            x2, x3 = x3, x2
+            z2, z3 = z3, z2
+        swap = k_t
+        a = (x2 + z2) % _P25519
+        aa = a * a % _P25519
+        b = (x2 - z2) % _P25519
+        bb = b * b % _P25519
+        e = (aa - bb) % _P25519
+        c = (x3 + z3) % _P25519
+        d = (x3 - z3) % _P25519
+        da = d * a % _P25519
+        cb = c * b % _P25519
+        x3 = (da + cb) % _P25519
+        x3 = x3 * x3 % _P25519
+        z3 = (da - cb) % _P25519
+        z3 = x1 * (z3 * z3 % _P25519) % _P25519
+        x2 = aa * bb % _P25519
+        z2 = e * (aa + _A24 * e) % _P25519
+    if swap:
+        x2, x3 = x3, x2
+        z2, z3 = z3, z2
+    out = x2 * pow(z2, _P25519 - 2, _P25519) % _P25519
+    return out.to_bytes(32, "little")
+
+
+class X25519PublicKey:
+    def __init__(self, raw: bytes):
+        if len(raw) != 32:
+            raise ValueError("x25519 public key must be 32 bytes")
+        self._raw = bytes(raw)
+
+    @classmethod
+    def from_public_bytes(cls, data: bytes) -> "X25519PublicKey":
+        return cls(data)
+
+    def public_bytes(self, encoding=None, format=None) -> bytes:
+        return self._raw
+
+
+class X25519PrivateKey:
+    def __init__(self, raw: bytes):
+        if len(raw) != 32:
+            raise ValueError("x25519 private key must be 32 bytes")
+        self._raw = bytes(raw)
+
+    @classmethod
+    def generate(cls) -> "X25519PrivateKey":
+        return cls(os.urandom(32))
+
+    @classmethod
+    def from_private_bytes(cls, data: bytes) -> "X25519PrivateKey":
+        return cls(data)
+
+    def public_key(self) -> X25519PublicKey:
+        return X25519PublicKey(
+            _x25519_scalarmult(self._raw, (9).to_bytes(32, "little"))
+        )
+
+    def exchange(self, peer_public_key: X25519PublicKey) -> bytes:
+        shared = _x25519_scalarmult(self._raw, peer_public_key.public_bytes())
+        if shared == b"\x00" * 32:
+            # all-zero shared secret (low-order point): the real library
+            # raises too; SecretConnection treats it as a handshake error
+            raise ValueError("x25519 shared secret is all zeros")
+        return shared
+
+
+# -- HKDF-SHA256 (RFC 5869) -------------------------------------------------
+
+
+class HKDF:
+    def __init__(self, algorithm=None, length: int = 32, salt: Optional[bytes] = None,
+                 info: Optional[bytes] = None, backend=None):
+        self._length = int(length)
+        self._salt = salt
+        self._info = info or b""
+
+    def derive(self, key_material: bytes) -> bytes:
+        salt = self._salt if self._salt else b"\x00" * 32
+        prk = _hmac.new(salt, key_material, hashlib.sha256).digest()
+        okm, t, i = b"", b"", 1
+        while len(okm) < self._length:
+            t = _hmac.new(prk, t + self._info + bytes([i]), hashlib.sha256).digest()
+            okm += t
+            i += 1
+        return okm[: self._length]
+
+
+# -- secp256k1 ECDSA (RFC 6979 nonces, jacobian coordinates) ----------------
+
+_SECP_P = 0xFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFEFFFFFC2F
+_SECP_N = 0xFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFEBAAEDCE6AF48A03BBFD25E8CD0364141
+_SECP_GX = 0x79BE667EF9DCBBAC55A06295CE870B07029BFCDB2DCE28D959F2815B16F81798
+_SECP_GY = 0x483ADA7726A3C4655DA4FBFC0E1108A8FD17B448A68554199C47D08FFB10D4B8
+
+
+def _secp_jac_double(p):
+    x, y, z = p
+    if y == 0:
+        return (0, 0, 0)
+    s = 4 * x * y * y % _SECP_P
+    m = 3 * x * x % _SECP_P  # a == 0 for secp256k1
+    x3 = (m * m - 2 * s) % _SECP_P
+    y3 = (m * (s - x3) - 8 * pow(y, 4, _SECP_P)) % _SECP_P
+    z3 = 2 * y * z % _SECP_P
+    return (x3, y3, z3)
+
+
+def _secp_jac_add(p, q):
+    if p[2] == 0:
+        return q
+    if q[2] == 0:
+        return p
+    x1, y1, z1 = p
+    x2, y2, z2 = q
+    z1z1 = z1 * z1 % _SECP_P
+    z2z2 = z2 * z2 % _SECP_P
+    u1 = x1 * z2z2 % _SECP_P
+    u2 = x2 * z1z1 % _SECP_P
+    s1 = y1 * z2 * z2z2 % _SECP_P
+    s2 = y2 * z1 * z1z1 % _SECP_P
+    if u1 == u2:
+        if s1 != s2:
+            return (0, 0, 0)
+        return _secp_jac_double(p)
+    h = (u2 - u1) % _SECP_P
+    r = (s2 - s1) % _SECP_P
+    h2 = h * h % _SECP_P
+    h3 = h * h2 % _SECP_P
+    u1h2 = u1 * h2 % _SECP_P
+    x3 = (r * r - h3 - 2 * u1h2) % _SECP_P
+    y3 = (r * (u1h2 - x3) - s1 * h3) % _SECP_P
+    z3 = h * z1 * z2 % _SECP_P
+    return (x3, y3, z3)
+
+
+def _secp_mul(k: int, point_affine) -> Tuple[int, int]:
+    acc = (0, 0, 0)
+    add = (point_affine[0], point_affine[1], 1)
+    while k:
+        if k & 1:
+            acc = _secp_jac_add(acc, add)
+        add = _secp_jac_double(add)
+        k >>= 1
+    if acc[2] == 0:
+        raise ValueError("point at infinity")
+    zinv = pow(acc[2], _SECP_P - 2, _SECP_P)
+    z2 = zinv * zinv % _SECP_P
+    return (acc[0] * z2 % _SECP_P, acc[1] * z2 * zinv % _SECP_P)
+
+
+def _secp_decompress(data: bytes) -> Tuple[int, int]:
+    if len(data) != 33 or data[0] not in (2, 3):
+        raise ValueError("invalid compressed secp256k1 point")
+    x = int.from_bytes(data[1:], "big")
+    if x >= _SECP_P:
+        raise ValueError("x out of range")
+    y2 = (pow(x, 3, _SECP_P) + 7) % _SECP_P
+    y = pow(y2, (_SECP_P + 1) // 4, _SECP_P)
+    if y * y % _SECP_P != y2:
+        raise ValueError("point not on curve")
+    if (y & 1) != (data[0] & 1):
+        y = _SECP_P - y
+    return (x, y)
+
+
+def _rfc6979_k(d: int, h1: bytes) -> int:
+    """Deterministic nonce (RFC 6979, HMAC-SHA256)."""
+    x = d.to_bytes(32, "big")
+    v = b"\x01" * 32
+    k = b"\x00" * 32
+    k = _hmac.new(k, v + b"\x00" + x + h1, hashlib.sha256).digest()
+    v = _hmac.new(k, v, hashlib.sha256).digest()
+    k = _hmac.new(k, v + b"\x01" + x + h1, hashlib.sha256).digest()
+    v = _hmac.new(k, v, hashlib.sha256).digest()
+    while True:
+        v = _hmac.new(k, v, hashlib.sha256).digest()
+        cand = int.from_bytes(v, "big")
+        if 1 <= cand < _SECP_N:
+            return cand
+        k = _hmac.new(k, v + b"\x00", hashlib.sha256).digest()
+        v = _hmac.new(k, v, hashlib.sha256).digest()
+
+
+def encode_dss_signature(r: int, s: int):
+    return (int(r), int(s))
+
+
+def decode_dss_signature(sig) -> Tuple[int, int]:
+    r, s = sig
+    return int(r), int(s)
+
+
+class ec:  # noqa: N801 - mirrors cryptography.hazmat.primitives.asymmetric.ec
+    class SECP256K1:
+        pass
+
+    class ECDSA:
+        def __init__(self, algorithm):
+            self.algorithm = algorithm
+
+    class EllipticCurvePublicKey:
+        def __init__(self, point: Tuple[int, int]):
+            self._point = point
+
+        @classmethod
+        def from_encoded_point(cls, curve, data: bytes) -> "ec.EllipticCurvePublicKey":
+            return cls(_secp_decompress(data))
+
+        def public_bytes(self, encoding=None, format=None) -> bytes:
+            x, y = self._point
+            return bytes([2 | (y & 1)]) + x.to_bytes(32, "big")
+
+        def verify(self, signature, data: bytes, sig_algo) -> None:
+            r, s = decode_dss_signature(signature)
+            if not (1 <= r < _SECP_N and 1 <= s < _SECP_N):
+                raise InvalidSignature("r/s out of range")
+            e = int.from_bytes(hashlib.sha256(data).digest(), "big") % _SECP_N
+            w = pow(s, _SECP_N - 2, _SECP_N)
+            u1 = e * w % _SECP_N
+            u2 = r * w % _SECP_N
+            acc = (0, 0, 0)
+            if u1:
+                g = _secp_mul(u1, (_SECP_GX, _SECP_GY))
+                acc = _secp_jac_add(acc, (g[0], g[1], 1))
+            if u2:
+                q = _secp_mul(u2, self._point)
+                acc = _secp_jac_add(acc, (q[0], q[1], 1))
+            if acc[2] == 0:
+                raise InvalidSignature("infinity")
+            zinv = pow(acc[2], _SECP_P - 2, _SECP_P)
+            x = acc[0] * zinv * zinv % _SECP_P
+            if x % _SECP_N != r:
+                raise InvalidSignature("secp256k1 verification failed")
+
+    class _PrivateKey:
+        def __init__(self, d: int):
+            if not (1 <= d < _SECP_N):
+                raise ValueError("private value out of range")
+            self._d = d
+            self._pub = _secp_mul(d, (_SECP_GX, _SECP_GY))
+
+        def private_numbers(self):
+            class _Nums:
+                pass
+
+            n = _Nums()
+            n.private_value = self._d
+            return n
+
+        def public_key(self) -> "ec.EllipticCurvePublicKey":
+            return ec.EllipticCurvePublicKey(self._pub)
+
+        def sign(self, data: bytes, sig_algo):
+            e_bytes = hashlib.sha256(data).digest()
+            e = int.from_bytes(e_bytes, "big") % _SECP_N
+            while True:
+                k = _rfc6979_k(self._d, e_bytes)
+                x, _y = _secp_mul(k, (_SECP_GX, _SECP_GY))
+                r = x % _SECP_N
+                if r == 0:
+                    e_bytes = hashlib.sha256(e_bytes).digest()
+                    continue
+                s = pow(k, _SECP_N - 2, _SECP_N) * (e + r * self._d) % _SECP_N
+                if s == 0:
+                    e_bytes = hashlib.sha256(e_bytes).digest()
+                    continue
+                return encode_dss_signature(r, s)
+
+    @staticmethod
+    def derive_private_key(private_value: int, curve) -> "ec._PrivateKey":
+        return ec._PrivateKey(private_value)
+
+    @staticmethod
+    def generate_private_key(curve) -> "ec._PrivateKey":
+        while True:
+            d = int.from_bytes(os.urandom(32), "big")
+            if 1 <= d < _SECP_N:
+                return ec._PrivateKey(d)

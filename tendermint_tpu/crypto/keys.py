@@ -14,12 +14,20 @@ import hmac
 import os
 from typing import Optional
 
-from cryptography.hazmat.primitives.asymmetric.ed25519 import (
-    Ed25519PrivateKey,
-    Ed25519PublicKey,
-)
-from cryptography.hazmat.primitives import serialization
-from cryptography.exceptions import InvalidSignature
+try:
+    from cryptography.hazmat.primitives.asymmetric.ed25519 import (
+        Ed25519PrivateKey,
+        Ed25519PublicKey,
+    )
+    from cryptography.hazmat.primitives import serialization
+    from cryptography.exceptions import InvalidSignature
+except ImportError:  # no OpenSSL wheel in this image: pure-Python fallback
+    from tendermint_tpu.crypto.fallback import (  # type: ignore[assignment]
+        Ed25519PrivateKey,
+        Ed25519PublicKey,
+        InvalidSignature,
+        serialization,
+    )
 
 from tendermint_tpu.crypto.hash import address_hash
 

@@ -1,0 +1,746 @@
+"""Pipelined verification dispatch: async micro-batching over a BatchVerifier.
+
+The device path (crypto/batch.py -> models/verifier.py) is fast per
+CALL, but every call site blocks on its own device round trip: the
+fast-sync reactors alternate verify/apply serially, and vote ingest
+pays a dispatch per drain even when several drains race. Prior bench
+rounds measured the gap directly — the overlapped device rate runs ~5x
+faster than back-to-back synchronous calls (BENCH_r05.json:
+tabled_pipelined_ms 26.29 vs tabled_p50_ms 123.97) because a
+synchronous caller leaves the device idle during host prep and result
+readback.
+
+``PipelinedVerifier`` closes that gap without touching the kernels:
+
+- callers SUBMIT work and get a Future; a dispatch thread micro-batches
+  whatever is queued into one device-sized bucket (same-shape requests
+  concatenate into a single provider call, commit specs group into one
+  cross-height ``verify_commits_batched`` call);
+- the pipeline is DOUBLE-BUFFERED: the dispatch thread does host prep
+  (row packing, dedupe hashing, template stacking) for bundle N+1 while
+  a second thread executes bundle N on the device — the bounded
+  handoff queue (depth 1) is the second buffer;
+- a bounded LRU ``SigCache`` keyed by digest(pubkey, sign bytes, sig)
+  makes gossip redelivery free: rows whose exact triple already
+  verified successfully resolve without a device round trip, both
+  across submissions and WITHIN one bundle (two peers delivering the
+  same commit concurrently verify its rows once). Only successful
+  verifies are ever cached, so a failed signature can never poison the
+  cache, and the signature bytes are part of the key, so a hit can
+  never mask a row that differs only in its sig.
+
+The wrapper is itself a BatchVerifier, so it drops into
+``set_default_provider`` and every existing call site
+(ValidatorSet.verify_commit, VoteSet ingest, the light client) routes
+through the shared dispatch queue unchanged — a single gossiped vote
+and a 10k-row bulk ingest land in the same jit bucket. Counters
+(queue depth, batch occupancy, cache hits) are exposed via ``stats()``
+and surfaced as ``tendermint_crypto_*`` metrics (docs/metrics.md);
+``stop()`` drains the queue and joins the threads so node shutdown is
+clean.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import queue
+import threading
+from collections import OrderedDict, deque
+from concurrent.futures import Future
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from tendermint_tpu.crypto.batch import BatchVerifier, CPUBatchVerifier
+
+# Largest single dispatch the grouper will build; matches the verifier
+# model's streaming window (models/verifier.py MAX_DEVICE_ROWS) so one
+# bundle never forces the windowed path.
+MAX_BUNDLE_ROWS = 16384
+
+# Template stacking cap per bundle (mirrors vote_set's byzantine-flood
+# cap): beyond this, templated groups stop coalescing rather than grow
+# an unbounded template upload.
+MAX_BUNDLE_TEMPLATES = 512
+
+
+class SigCache:
+    """Bounded LRU of digests of (pubkey, sign bytes, signature) triples
+    that verified SUCCESSFULLY — the gossip dedupe cache.
+
+    Thread-safe. ``capacity=0`` disables caching entirely (every lookup
+    misses, nothing is stored). Only genuinely-verified triples may be
+    inserted (callers enforce it; the pipeline only inserts rows whose
+    device verdict was True), which is what makes a hit equivalent to
+    re-verifying: same bytes, same deterministic answer.
+    """
+
+    def __init__(self, capacity: int = 1 << 16):
+        self.capacity = int(capacity)
+        self._od: "OrderedDict[bytes, None]" = OrderedDict()
+        self._lock = threading.Lock()
+        self.hits = 0
+        self.misses = 0
+        self.insertions = 0
+        self.evictions = 0
+
+    @staticmethod
+    def key(pubkey: bytes, sign_bytes: bytes, sig: bytes) -> bytes:
+        """Digest of one (pubkey, sign bytes, sig) triple. All three
+        components are hashed with length framing so no two distinct
+        triples can collide by concatenation."""
+        h = hashlib.sha256()
+        h.update(len(pubkey).to_bytes(2, "big"))
+        h.update(pubkey)
+        h.update(len(sign_bytes).to_bytes(4, "big"))
+        h.update(sign_bytes)
+        h.update(len(sig).to_bytes(2, "big"))
+        h.update(sig)
+        return h.digest()
+
+    @staticmethod
+    def key_templated(pubkey: bytes, template: bytes, ts8: bytes, sig: bytes) -> bytes:
+        """Key for the templated sign-bytes form (codec/signbytes.py):
+        (template, ts8) uniquely determines the materialized sign bytes
+        — the timestamp splice is deterministic — so hashing the parts
+        avoids materializing 160 bytes per row on the hot ingest path.
+        NOTE: this is a distinct keyspace from ``key`` (same triple,
+        different digest); each call site must use one form
+        consistently, which they do (vote ingest is always templated)."""
+        h = hashlib.sha256()
+        h.update(len(pubkey).to_bytes(2, "big"))
+        h.update(pubkey)
+        h.update(b"tpl")
+        h.update(len(template).to_bytes(4, "big"))
+        h.update(template)
+        h.update(ts8)
+        h.update(len(sig).to_bytes(2, "big"))
+        h.update(sig)
+        return h.digest()
+
+    def seen(self, key: bytes) -> bool:
+        with self._lock:
+            if key in self._od:
+                self._od.move_to_end(key)
+                self.hits += 1
+                return True
+            self.misses += 1
+            return False
+
+    def add(self, key: bytes) -> None:
+        if self.capacity <= 0:
+            return
+        with self._lock:
+            if key in self._od:
+                self._od.move_to_end(key)
+                return
+            self._od[key] = None
+            self.insertions += 1
+            while len(self._od) > self.capacity:
+                self._od.popitem(last=False)
+                self.evictions += 1
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._od)
+
+    def stats(self) -> Dict[str, int]:
+        with self._lock:
+            return {
+                "size": len(self._od),
+                "capacity": self.capacity,
+                "hits": self.hits,
+                "misses": self.misses,
+                "insertions": self.insertions,
+                "evictions": self.evictions,
+            }
+
+
+_default_cache: Optional[SigCache] = None
+_default_cache_lock = threading.Lock()
+
+
+def default_sig_cache() -> SigCache:
+    """Process-wide dedupe cache: gossip redelivers the same vote into
+    different VoteSets (rounds, catch-up replays), so the cache must
+    outlive any one set."""
+    global _default_cache
+    with _default_cache_lock:
+        if _default_cache is None:
+            _default_cache = SigCache()
+        return _default_cache
+
+
+def set_default_sig_cache(c: Optional[SigCache]) -> None:
+    global _default_cache
+    with _default_cache_lock:
+        _default_cache = c
+
+
+class _Item:
+    """One submitted request awaiting dispatch."""
+
+    __slots__ = ("kind", "fut", "n", "data")
+
+    def __init__(self, kind: str, fut: Future, n: int, data: tuple):
+        self.kind = kind  # "batch" | "rows" | "tpl" | "commit"
+        self.fut = fut
+        self.n = n  # row count (1 for commit specs)
+        self.data = data
+
+
+class _Bundle:
+    """Prepped work handed from the dispatch thread to the exec thread."""
+
+    __slots__ = ("kind", "items", "prep")
+
+    def __init__(self, kind: str, items: List[_Item], prep: dict):
+        self.kind = kind
+        self.items = items
+        self.prep = prep
+
+
+_SENTINEL = object()
+
+
+class PipelinedVerifier(BatchVerifier):
+    """Future-based micro-batching front end over ``inner``.
+
+    ``depth`` is advisory for callers that pipeline multi-step work
+    (the fast-sync reactors keep ``depth`` commits in flight);
+    ``flush_deadline_s`` is how long the dispatcher lingers after the
+    first queued item to let concurrent submitters coalesce (0 = only
+    the natural coalescing that back-pressure provides: while the
+    device executes bundle N, everything submitted meanwhile groups
+    into bundle N+1).
+    """
+
+    name = "pipelined"
+
+    def __init__(
+        self,
+        inner: Optional[BatchVerifier] = None,
+        *,
+        depth: int = 8,
+        flush_deadline_s: float = 0.0,
+        max_bundle_rows: int = MAX_BUNDLE_ROWS,
+        cache: Optional[SigCache] = None,
+    ):
+        self.inner = inner if inner is not None else CPUBatchVerifier()
+        self.name = f"pipelined({self.inner.name})"
+        self.depth = int(depth)
+        self.flush_deadline_s = float(flush_deadline_s)
+        self.max_bundle_rows = int(max_bundle_rows)
+        self.cache = cache if cache is not None else default_sig_cache()
+
+        self._q: "deque[_Item]" = deque()
+        self._cv = threading.Condition()
+        self._stopped = False
+        # depth-1 handoff: the second buffer of the double-buffer — the
+        # dispatcher preps bundle N+1 while the exec thread runs N, and
+        # blocks here (letting the queue accumulate) when both are full
+        self._hand: "queue.Queue" = queue.Queue(maxsize=1)
+
+        # counters (under _cv to share the lock with the queue)
+        self.submitted_calls = 0
+        self.submitted_rows = 0
+        self.dispatched_bundles = 0
+        self.dispatched_rows = 0
+        self.device_rows = 0  # rows that actually reached inner
+        self.coalesced_bundles = 0  # bundles that merged >1 request
+        self.bundle_dup_rows = 0  # in-bundle duplicate rows collapsed
+        self.max_queue_depth = 0
+        self._occupancy_sum = 0  # requests per bundle, summed
+
+        self._dispatch_t = threading.Thread(
+            target=self._dispatch_loop, daemon=True, name="verify-dispatch"
+        )
+        self._exec_t = threading.Thread(
+            target=self._exec_loop, daemon=True, name="verify-exec"
+        )
+        self._dispatch_t.start()
+        self._exec_t.start()
+
+    # -- submit API --------------------------------------------------------
+
+    def submit_batch(
+        self, pubkeys, msgs, sigs, msg_lens=None, dedupe: bool = False
+    ) -> "Future[np.ndarray]":
+        """Verify (N,32)/(N,L)/(N,64) rows; resolves to (N,) bool.
+        ``dedupe=True`` routes rows through the SigCache (gossip
+        redelivery shape: commits/votes that may arrive repeatedly)."""
+        fut: Future = Future()
+        n = int(len(pubkeys))
+        if n == 0:
+            fut.set_result(np.zeros(0, dtype=bool))
+            return fut
+        pk = np.asarray(pubkeys, dtype=np.uint8)
+        mg = np.asarray(msgs, dtype=np.uint8)
+        sg = np.asarray(sigs, dtype=np.uint8)
+        lens = None if msg_lens is None else np.asarray(msg_lens, dtype=np.int32)
+        self._enqueue(_Item("batch", fut, n, (pk, mg, sg, lens, bool(dedupe))))
+        return fut
+
+    def submit_rows(
+        self, valset_key: bytes, all_pubkeys, row_idx, msgs, sigs
+    ) -> "Future[np.ndarray]":
+        """Per-valset cached-table rows (crypto/batch.verify_rows_cached
+        shape). Unlike the raw provider method this ALWAYS resolves to a
+        result array: when the cached path declines (None), the exec
+        thread falls back to the generic batch kernel itself, so callers
+        need no fallback of their own."""
+        fut: Future = Future()
+        n = int(len(row_idx))
+        if n == 0:
+            fut.set_result(np.zeros(0, dtype=bool))
+            return fut
+        self._enqueue(
+            _Item(
+                "rows",
+                fut,
+                n,
+                (
+                    bytes(valset_key),
+                    all_pubkeys,
+                    np.asarray(row_idx, dtype=np.int32),
+                    np.asarray(msgs, dtype=np.uint8),
+                    np.asarray(sigs, dtype=np.uint8),
+                ),
+            )
+        )
+        return fut
+
+    def submit_rows_templated(
+        self, valset_key: bytes, all_pubkeys, row_idx, templates, tmpl_idx, ts8, sigs
+    ) -> "Future[np.ndarray]":
+        """Templated-message rows (one template per BlockID + 8 ts bytes
+        per row — codec/signbytes.py layout). Same always-resolves
+        contract as submit_rows."""
+        fut: Future = Future()
+        n = int(len(row_idx))
+        if n == 0:
+            fut.set_result(np.zeros(0, dtype=bool))
+            return fut
+        self._enqueue(
+            _Item(
+                "tpl",
+                fut,
+                n,
+                (
+                    bytes(valset_key),
+                    all_pubkeys,
+                    np.asarray(row_idx, dtype=np.int32),
+                    np.asarray(templates, dtype=np.uint8),
+                    np.asarray(tmpl_idx, dtype=np.int32),
+                    np.asarray(ts8, dtype=np.uint8),
+                    np.asarray(sigs, dtype=np.uint8),
+                ),
+            )
+        )
+        return fut
+
+    def submit_commit(self, spec) -> "Future[Optional[Exception]]":
+        """One CommitVerifySpec (types/validator_set.py); resolves to
+        None on acceptance or the exception verify_commit would have
+        raised. Concurrent specs — the fast-sync window, the light
+        client's bisection chain — group into ONE cross-height
+        verify_commits_batched device call."""
+        fut: Future = Future()
+        self._enqueue(_Item("commit", fut, 1, (spec,)))
+        return fut
+
+    def _enqueue(self, item: _Item) -> None:
+        with self._cv:
+            if not self._stopped:
+                self._q.append(item)
+                self.submitted_calls += 1
+                self.submitted_rows += item.n
+                self.max_queue_depth = max(self.max_queue_depth, len(self._q))
+                self._cv.notify_all()
+                return
+        # stopped: run inline so teardown races degrade gracefully
+        # instead of hanging a caller on a future nobody will resolve
+        self._run_bundle(self._prep([item]))
+
+    # -- BatchVerifier interface (sync callers share the queue) ------------
+
+    def verify_batch(self, pubkeys, msgs, sigs, msg_lens=None) -> np.ndarray:
+        return self.submit_batch(pubkeys, msgs, sigs, msg_lens=msg_lens).result()
+
+    def verify_rows_cached(self, valset_key, all_pubkeys, row_idx, msgs, sigs):
+        return self.submit_rows(valset_key, all_pubkeys, row_idx, msgs, sigs).result()
+
+    def verify_rows_cached_templated(
+        self, valset_key, all_pubkeys, row_idx, templates, tmpl_idx, ts8, sigs
+    ):
+        return self.submit_rows_templated(
+            valset_key, all_pubkeys, row_idx, templates, tmpl_idx, ts8, sigs
+        ).result()
+
+    # verify_commit_batch: inherited — composes over verify_batch (the
+    # host tally is microseconds; routing the rows through the shared
+    # queue matters more than the fused device tally here)
+
+    # -- inner passthroughs -------------------------------------------------
+
+    def warmup(self, *a, **kw):
+        f = getattr(self.inner, "warmup", None)
+        return f(*a, **kw) if f is not None else None
+
+    def register_valset(self, *a, **kw):
+        f = getattr(self.inner, "register_valset", None)
+        return f(*a, **kw) if f is not None else None
+
+    @property
+    def model(self):
+        return getattr(self.inner, "model", None)
+
+    # -- stats / lifecycle --------------------------------------------------
+
+    def queue_depth(self) -> int:
+        with self._cv:
+            return len(self._q)
+
+    def stats(self) -> Dict[str, float]:
+        with self._cv:
+            bundles = self.dispatched_bundles
+            s = {
+                "queue_depth": len(self._q),
+                "max_queue_depth": self.max_queue_depth,
+                "submitted_calls": self.submitted_calls,
+                "submitted_rows": self.submitted_rows,
+                "dispatched_bundles": bundles,
+                "dispatched_rows": self.dispatched_rows,
+                "device_rows": self.device_rows,
+                "coalesced_bundles": self.coalesced_bundles,
+                "bundle_dup_rows": self.bundle_dup_rows,
+                "batch_occupancy_avg": (
+                    self._occupancy_sum / bundles if bundles else 0.0
+                ),
+            }
+        for k, v in self.cache.stats().items():
+            s[f"cache_{k}"] = v
+        return s
+
+    def stop(self, drain: bool = True, timeout: float = 30.0) -> None:
+        """Drain and join. With ``drain`` (the node-stop path) every
+        already-submitted future completes before the threads exit;
+        without, pending futures are cancelled."""
+        with self._cv:
+            if self._stopped:
+                return
+            self._stopped = True
+            if not drain:
+                while self._q:
+                    self._q.popleft().fut.cancel()
+            self._cv.notify_all()
+        self._dispatch_t.join(timeout=timeout)
+        self._exec_t.join(timeout=timeout)
+
+    # context-manager sugar for tests/benches
+    def __enter__(self) -> "PipelinedVerifier":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    # -- dispatch thread: group + host prep ---------------------------------
+
+    def _dispatch_loop(self) -> None:
+        while True:
+            with self._cv:
+                while not self._q and not self._stopped:
+                    self._cv.wait()
+                if not self._q and self._stopped:
+                    break
+                if (
+                    self.flush_deadline_s > 0
+                    and not self._stopped
+                    and self._hand.full()
+                ):
+                    # optional lingering, ONLY while the exec thread is
+                    # busy and the handoff slot is taken — dispatching
+                    # couldn't proceed anyway, so the wait costs nothing.
+                    # When the pipeline is idle the group is cut
+                    # immediately: a lone synchronous caller (a blocked
+                    # event loop cannot produce concurrent submitters)
+                    # must never pay the flush window as pure latency.
+                    import time as _time
+
+                    deadline = _time.monotonic() + self.flush_deadline_s
+                    while (
+                        not self._stopped
+                        and self._hand.full()
+                        and sum(i.n for i in self._q) < self.max_bundle_rows
+                        and _time.monotonic() < deadline
+                    ):
+                        self._cv.wait(timeout=deadline - _time.monotonic())
+                group = self._take_group_locked()
+            try:
+                bundle = self._prep(group)
+            except Exception as e:
+                # same invariant as _resolve: a prep failure must fail
+                # THIS group's futures, never the dispatch thread — a
+                # dead dispatcher would wedge every later verification
+                for it in group:
+                    self._resolve(it.fut, exc=e)
+                continue
+            self._hand.put(bundle)  # blocks while exec runs the prior bundle
+        self._hand.put(_SENTINEL)
+
+    def _take_group_locked(self) -> List[_Item]:
+        """Pop the maximal leading run of the queue that can share one
+        device call: same kind and compatible shapes, bounded by
+        max_bundle_rows (always at least one item)."""
+        head = self._q.popleft()
+        group = [head]
+        rows = head.n
+        templates = head.data[3].shape[0] if head.kind == "tpl" else 0
+        while self._q:
+            nxt = self._q[0]
+            if nxt.kind != head.kind or rows + nxt.n > self.max_bundle_rows:
+                break
+            if not self._compatible(head, nxt):
+                break
+            if head.kind == "tpl":
+                t = nxt.data[3].shape[0]
+                if templates + t > MAX_BUNDLE_TEMPLATES:
+                    break
+                templates += t
+            group.append(self._q.popleft())
+            rows += nxt.n
+        return group
+
+    @staticmethod
+    def _compatible(a: _Item, b: _Item) -> bool:
+        if a.kind == "batch":
+            # same row width; ragged (msg_lens) items merge by carrying
+            # explicit lengths for every row
+            return a.data[1].shape[1] == b.data[1].shape[1]
+        if a.kind == "rows":
+            return a.data[0] == b.data[0] and a.data[3].shape[1] == b.data[3].shape[1]
+        if a.kind == "tpl":
+            return a.data[0] == b.data[0] and a.data[3].shape[1] == b.data[3].shape[1]
+        return True  # commit specs always group
+
+    def _prep(self, group: List[_Item]) -> _Bundle:
+        kind = group[0].kind
+        prep: dict = {}
+        if kind == "batch":
+            pk = np.concatenate([i.data[0] for i in group], axis=0)
+            mg = np.concatenate([i.data[1] for i in group], axis=0)
+            sg = np.concatenate([i.data[2] for i in group], axis=0)
+            if any(i.data[3] is not None for i in group):
+                width = mg.shape[1]
+                lens = np.concatenate(
+                    [
+                        i.data[3]
+                        if i.data[3] is not None
+                        else np.full(i.n, width, dtype=np.int32)
+                        for i in group
+                    ]
+                )
+            else:
+                lens = None
+            prep.update(pk=pk, mg=mg, sg=sg, lens=lens)
+            if any(i.data[4] for i in group):
+                self._prep_dedupe(group, prep)
+        elif kind == "rows":
+            prep.update(
+                vkey=group[0].data[0],
+                all_pk=group[0].data[1],
+                idx=np.concatenate([i.data[2] for i in group]),
+                mg=np.concatenate([i.data[3] for i in group], axis=0),
+                sg=np.concatenate([i.data[4] for i in group], axis=0),
+            )
+        elif kind == "tpl":
+            # stack each request's templates; per-row template indices
+            # offset into the stacked matrix (the verify_commits_batched
+            # pattern, types/validator_set.py)
+            tpls, idx_parts, off = [], [], 0
+            for i in group:
+                tpls.append(i.data[3])
+                idx_parts.append(i.data[4] + off)
+                off += i.data[3].shape[0]
+            prep.update(
+                vkey=group[0].data[0],
+                all_pk=group[0].data[1],
+                idx=np.concatenate([i.data[2] for i in group]),
+                templates=np.concatenate(tpls, axis=0),
+                tmpl_idx=np.concatenate(idx_parts),
+                ts8=np.concatenate([i.data[5] for i in group], axis=0),
+                sg=np.concatenate([i.data[6] for i in group], axis=0),
+            )
+        elif kind == "commit":
+            prep.update(specs=[i.data[0] for i in group])
+        return _Bundle(kind, group, prep)
+
+    def _prep_dedupe(self, group: List[_Item], prep: dict) -> None:
+        """Host-side dedupe for a 'batch' bundle: rows whose triple is
+        already in the SigCache resolve from it; duplicate rows WITHIN
+        the bundle collapse to one device row (concurrent gossip
+        deliveries of the same commit). Builds:
+
+        - prep["unique"]: indices (into the concatenated rows) that go
+          to the device;
+        - prep["remap"]: per-row index into the unique set (-1 = cache
+          hit, resolved True);
+        - prep["keys"]: per-unique-row cache key, inserted on success.
+
+        This hashing is exactly the host prep the double-buffer exists
+        to overlap with device execution of the previous bundle."""
+        pk, mg, sg, lens = prep["pk"], prep["mg"], prep["sg"], prep["lens"]
+        n = pk.shape[0]
+        remap = np.empty(n, dtype=np.int64)
+        unique: List[int] = []
+        keys: List[bytes] = []
+        in_bundle: Dict[bytes, int] = {}
+        # rows of non-dedupe items still dispatch, but skip the cache
+        dedupe_row = np.zeros(n, dtype=bool)
+        off = 0
+        for it in group:
+            if it.data[4]:
+                dedupe_row[off : off + it.n] = True
+            off += it.n
+        for r in range(n):
+            if not dedupe_row[r]:
+                remap[r] = len(unique)
+                unique.append(r)
+                keys.append(b"")
+                continue
+            m = mg[r] if lens is None else mg[r, : int(lens[r])]
+            k = SigCache.key(pk[r].tobytes(), m.tobytes(), sg[r].tobytes())
+            prior = in_bundle.get(k)
+            if prior is not None:
+                remap[r] = prior
+                continue
+            if self.cache.seen(k):
+                remap[r] = -1
+                continue
+            in_bundle[k] = len(unique)
+            remap[r] = len(unique)
+            unique.append(r)
+            keys.append(k)
+        prep["remap"] = remap
+        prep["unique"] = np.asarray(unique, dtype=np.int64)
+        prep["keys"] = keys
+        dups = n - len(unique) - int((remap < 0).sum())
+        if dups:
+            with self._cv:
+                self.bundle_dup_rows += dups
+
+    # -- exec thread: device call + result fan-out ---------------------------
+
+    def _exec_loop(self) -> None:
+        while True:
+            bundle = self._hand.get()
+            if bundle is _SENTINEL:
+                break
+            self._run_bundle(bundle)
+
+    @staticmethod
+    def _resolve(fut: Future, value=None, exc: Optional[Exception] = None) -> None:
+        """Complete a future, tolerating a caller-side cancellation that
+        lands between the done() check and the set — e.g. an asyncio
+        task awaiting wrap_future() being cancelled at reactor shutdown.
+        An InvalidStateError here must never kill the exec thread (that
+        would wedge the handoff queue and deadlock every verify)."""
+        try:
+            if fut.done():
+                return
+            if exc is not None:
+                fut.set_exception(exc)
+            else:
+                fut.set_result(value)
+        except Exception:
+            pass  # cancelled concurrently: nobody is waiting
+
+    def _run_bundle(self, bundle: _Bundle) -> None:
+        try:
+            ok = self._execute(bundle)
+        except Exception as e:
+            for it in bundle.items:
+                self._resolve(it.fut, exc=e)
+            return
+        with self._cv:
+            self.dispatched_bundles += 1
+            self.dispatched_rows += sum(i.n for i in bundle.items)
+            self._occupancy_sum += len(bundle.items)
+            if len(bundle.items) > 1:
+                self.coalesced_bundles += 1
+        if bundle.kind == "commit":
+            for it, res in zip(bundle.items, ok):
+                self._resolve(it.fut, res)
+            return
+        off = 0
+        for it in bundle.items:
+            self._resolve(it.fut, np.asarray(ok[off : off + it.n]))
+            off += it.n
+
+    def _execute(self, bundle: _Bundle):
+        p = bundle.prep
+        if bundle.kind == "commit":
+            from tendermint_tpu.types.validator_set import verify_commits_batched
+
+            return verify_commits_batched(p["specs"], provider=self.inner)
+        if bundle.kind == "batch":
+            if "remap" not in p:
+                with self._cv:
+                    self.device_rows += p["pk"].shape[0]
+                return self.inner.verify_batch(
+                    p["pk"], p["mg"], p["sg"], msg_lens=p["lens"]
+                )
+            unique, remap, keys = p["unique"], p["remap"], p["keys"]
+            if unique.size:
+                with self._cv:
+                    self.device_rows += int(unique.size)
+                ok_u = np.asarray(
+                    self.inner.verify_batch(
+                        p["pk"][unique],
+                        p["mg"][unique],
+                        p["sg"][unique],
+                        msg_lens=None if p["lens"] is None else p["lens"][unique],
+                    )
+                )
+                for j in np.nonzero(ok_u)[0]:
+                    if keys[j]:
+                        self.cache.add(keys[j])
+            else:
+                ok_u = np.zeros(0, dtype=bool)
+            out = np.empty(remap.shape[0], dtype=bool)
+            hit = remap < 0
+            out[hit] = True  # cache hits: this exact triple verified before
+            out[~hit] = ok_u[remap[~hit]]
+            return out
+        if bundle.kind == "rows":
+            with self._cv:
+                self.device_rows += int(p["idx"].shape[0])
+            out = None
+            f = getattr(self.inner, "verify_rows_cached", None)
+            if f is not None:
+                out = f(p["vkey"], p["all_pk"], p["idx"], p["mg"], p["sg"])
+            if out is None:
+                pk = np.asarray(p["all_pk"], dtype=np.uint8)[p["idx"]]
+                out = self.inner.verify_batch(pk, p["mg"], p["sg"])
+            return np.asarray(out)
+        # "tpl"
+        with self._cv:
+            self.device_rows += int(p["idx"].shape[0])
+        out = None
+        f_t = getattr(self.inner, "verify_rows_cached_templated", None)
+        if f_t is not None:
+            out = f_t(
+                p["vkey"], p["all_pk"], p["idx"],
+                p["templates"], p["tmpl_idx"], p["ts8"], p["sg"],
+            )
+        if out is None:
+            from tendermint_tpu.codec.signbytes import splice_timestamps
+
+            mg = splice_timestamps(p["templates"][p["tmpl_idx"]], p["ts8"])
+            f = getattr(self.inner, "verify_rows_cached", None)
+            if f is not None:
+                out = f(p["vkey"], p["all_pk"], p["idx"], mg, p["sg"])
+            if out is None:
+                pk = np.asarray(p["all_pk"], dtype=np.uint8)[p["idx"]]
+                out = self.inner.verify_batch(pk, mg, p["sg"])
+        return np.asarray(out)

@@ -14,13 +14,23 @@ from __future__ import annotations
 
 import hashlib
 
-from cryptography.exceptions import InvalidSignature
-from cryptography.hazmat.primitives import hashes, serialization
-from cryptography.hazmat.primitives.asymmetric import ec
-from cryptography.hazmat.primitives.asymmetric.utils import (
-    decode_dss_signature,
-    encode_dss_signature,
-)
+try:
+    from cryptography.exceptions import InvalidSignature
+    from cryptography.hazmat.primitives import hashes, serialization
+    from cryptography.hazmat.primitives.asymmetric import ec
+    from cryptography.hazmat.primitives.asymmetric.utils import (
+        decode_dss_signature,
+        encode_dss_signature,
+    )
+except ImportError:  # no OpenSSL wheel in this image: pure-Python fallback
+    from tendermint_tpu.crypto.fallback import (  # type: ignore[assignment]
+        InvalidSignature,
+        decode_dss_signature,
+        ec,
+        encode_dss_signature,
+        hashes,
+        serialization,
+    )
 
 from tendermint_tpu.crypto.keys import PrivKey, PubKey, register_pubkey_type
 

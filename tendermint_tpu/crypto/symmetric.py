@@ -18,8 +18,14 @@ import os
 import textwrap
 from typing import Tuple
 
-from cryptography.exceptions import InvalidTag
-from cryptography.hazmat.primitives.ciphers.aead import ChaCha20Poly1305
+try:
+    from cryptography.exceptions import InvalidTag
+    from cryptography.hazmat.primitives.ciphers.aead import ChaCha20Poly1305
+except ImportError:  # no OpenSSL wheel in this image: pure-Python fallback
+    from tendermint_tpu.crypto.fallback import (  # type: ignore[assignment]
+        ChaCha20Poly1305,
+        InvalidTag,
+    )
 
 NONCE_SIZE = 12
 SALT_SIZE = 16

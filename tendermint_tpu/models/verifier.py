@@ -259,15 +259,20 @@ class VerifierModel:
 
     def _smap(self, f, n_in, out_specs, in_specs=None):
         batch, _ = self._shard_specs()
-        return jax.jit(
-            jax.shard_map(
-                f,
-                mesh=self.mesh,
-                in_specs=(batch,) * n_in if in_specs is None else in_specs,
-                out_specs=out_specs,
+        in_specs = (batch,) * n_in if in_specs is None else in_specs
+        if hasattr(jax, "shard_map"):
+            smapped = jax.shard_map(
+                f, mesh=self.mesh, in_specs=in_specs, out_specs=out_specs,
                 check_vma=False,
             )
-        )
+        else:  # pre-0.5 jax: the experimental module, check_rep spelling
+            from jax.experimental.shard_map import shard_map as _shard_map
+
+            smapped = _shard_map(
+                f, mesh=self.mesh, in_specs=in_specs, out_specs=out_specs,
+                check_rep=False,
+            )
+        return jax.jit(smapped)
 
     def _build(self, kind: str):
         """Build the (lazily compiled) callable for `kind`.

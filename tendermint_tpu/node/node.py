@@ -113,6 +113,18 @@ class Node(Service):
         self.crypto_provider = make_provider(
             config.base.crypto_provider, mesh=mesh, block_on_compile=False
         )
+        if config.base.crypto_pipeline:
+            # pipelined dispatch layer (crypto/pipeline.py): future-based
+            # micro-batching + the gossip dedupe cache. The wrapper IS a
+            # BatchVerifier, so every verify site below routes through
+            # its shared queue; on_stop drains it.
+            from tendermint_tpu.crypto.pipeline import PipelinedVerifier
+
+            self.crypto_provider = PipelinedVerifier(
+                self.crypto_provider,
+                depth=config.base.crypto_pipeline_depth,
+                flush_deadline_s=config.base.crypto_pipeline_flush_ms / 1000.0,
+            )
         set_default_provider(self.crypto_provider)
         self.logger.info(
             "crypto provider",
@@ -214,12 +226,15 @@ class Node(Service):
             StateMetrics,
         )
 
+        from tendermint_tpu.utils.metrics import CryptoMetrics
+
         self.metrics_registry = Registry()
         ns = config.instrumentation.namespace
         self.consensus_metrics = ConsensusMetrics(self.metrics_registry, ns)
         self.p2p_metrics = P2PMetrics(self.metrics_registry, ns)
         self.mempool_metrics = MempoolMetrics(self.metrics_registry, ns)
         self.state_metrics = StateMetrics(self.metrics_registry, ns)
+        self.crypto_metrics = CryptoMetrics(self.metrics_registry, ns)
         self._block_exec_metrics_attach()
         self.metrics_server = None
         if config.instrumentation.prometheus:
@@ -370,12 +385,21 @@ class Node(Service):
             bc_cls = BlockchainReactorV1
         else:
             bc_cls = BlockchainReactor
+        bc_kwargs = {}
+        if bc_cls is not BlockchainReactor:
+            # v0/v1 engines take the pipelined verify window's depth
+            # (the v2 engine batches cross-height on its own)
+            bc_kwargs = dict(
+                verify_depth=self.config.base.crypto_pipeline_depth,
+                provider=self.crypto_provider,
+            )
         self.bc_reactor = bc_cls(
             state,
             self.block_exec,
             self.block_store,
             fast_sync=fast_sync,
             consensus_reactor=self.consensus_reactor,
+            **bc_kwargs,
         )
         self.switch.add_reactor("blockchain", self.bc_reactor)
         self.switch.add_reactor("consensus", self.consensus_reactor)
@@ -460,6 +484,9 @@ class Node(Service):
             self.mempool_metrics.size.set(self.mempool.size())
             if self.bc_reactor is not None:
                 self.consensus_metrics.fast_syncing.set(1 if self.bc_reactor.fast_sync else 0)
+            stats = getattr(self.crypto_provider, "stats", None)
+            if stats is not None:
+                self.crypto_metrics.update(stats())
             await asyncio.sleep(2.0)
 
     def _only_validator_is_us(self, state: State) -> bool:
@@ -472,6 +499,11 @@ class Node(Service):
 
     async def on_stop(self) -> None:
         await self.switch.stop()
+        # drain the pipelined verify dispatcher: every already-submitted
+        # future completes before its threads exit (crypto/pipeline.py)
+        stop_pipeline = getattr(self.crypto_provider, "stop", None)
+        if stop_pipeline is not None:
+            stop_pipeline(drain=True)
         if getattr(self, "prof_server", None) is not None:
             await self.prof_server.stop()
         if getattr(self, "grpc_server", None) is not None:

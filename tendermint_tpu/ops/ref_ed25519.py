@@ -129,13 +129,45 @@ def sc_reduce(data: bytes) -> int:
     return int.from_bytes(data, "little") % L
 
 
+# Precomputed [2^i]B for the fixed base point, built lazily once (~256
+# doublings): base-point scalar mults drop from double-AND-add to
+# add-only over set bits (~2x on sign, ~1.4x on verify). Pure lookup
+# reuse — the group math is unchanged, and the RFC 8032 KAT plus the
+# differential tests against the device kernels pin the results.
+_BASE_POWS: Optional[list] = None
+
+
+def _base_pows() -> list:
+    global _BASE_POWS
+    if _BASE_POWS is None:
+        pows = [pt_from_affine(*BASE)]
+        for _ in range(255):
+            pows.append(pt_double(pows[-1]))
+        _BASE_POWS = pows
+    return _BASE_POWS
+
+
+def pt_mul_base(k: int):
+    """[k]B via the fixed-base table (identical result to
+    pt_mul(k, pt_from_affine(*BASE)))."""
+    pows = _base_pows()
+    q = IDENT
+    i = 0
+    while k:
+        if k & 1:
+            q = pt_add(q, pows[i])
+        k >>= 1
+        i += 1
+    return q
+
+
 # -- signing / verification -------------------------------------------------
 
 
 def pubkey_from_seed(seed: bytes) -> bytes:
     h = hashlib.sha512(seed).digest()
     a = _clamp(h[:32])
-    return pt_encode(pt_mul(a, pt_from_affine(*BASE)))
+    return pt_encode(pt_mul_base(a))
 
 
 def _clamp(b: bytes) -> int:
@@ -150,9 +182,9 @@ def sign(seed: bytes, msg: bytes) -> bytes:
     h = hashlib.sha512(seed).digest()
     a = _clamp(h[:32])
     prefix = h[32:]
-    A = pt_encode(pt_mul(a, pt_from_affine(*BASE)))
+    A = pt_encode(pt_mul_base(a))
     r = sc_reduce(hashlib.sha512(prefix + msg).digest())
-    R = pt_encode(pt_mul(r, pt_from_affine(*BASE)))
+    R = pt_encode(pt_mul_base(r))
     k = sc_reduce(hashlib.sha512(R + A + msg).digest())
     s = (r + k * a) % L
     return R + s.to_bytes(32, "little")
@@ -171,5 +203,5 @@ def verify(pubkey: bytes, msg: bytes, sig: bytes) -> bool:
         return False
     k = sc_reduce(hashlib.sha512(R_bytes + pubkey + msg).digest())
     #  P = [s]B + [k](-A)
-    Pnt = pt_add(pt_mul(s, pt_from_affine(*BASE)), pt_mul(k, pt_neg(A)))
+    Pnt = pt_add(pt_mul_base(s), pt_mul(k, pt_neg(A)))
     return pt_encode(Pnt) == R_bytes

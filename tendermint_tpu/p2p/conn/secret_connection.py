@@ -21,13 +21,23 @@ import hashlib
 import struct
 from typing import Optional, Tuple
 
-from cryptography.hazmat.primitives.asymmetric.x25519 import (
-    X25519PrivateKey,
-    X25519PublicKey,
-)
-from cryptography.hazmat.primitives.ciphers.aead import ChaCha20Poly1305
-from cryptography.hazmat.primitives.kdf.hkdf import HKDF
-from cryptography.hazmat.primitives import hashes, serialization
+try:
+    from cryptography.hazmat.primitives.asymmetric.x25519 import (
+        X25519PrivateKey,
+        X25519PublicKey,
+    )
+    from cryptography.hazmat.primitives.ciphers.aead import ChaCha20Poly1305
+    from cryptography.hazmat.primitives.kdf.hkdf import HKDF
+    from cryptography.hazmat.primitives import hashes, serialization
+except ImportError:  # no OpenSSL wheel in this image: pure-Python fallback
+    from tendermint_tpu.crypto.fallback import (  # type: ignore[assignment]
+        HKDF,
+        ChaCha20Poly1305,
+        X25519PrivateKey,
+        X25519PublicKey,
+        hashes,
+        serialization,
+    )
 
 from tendermint_tpu.codec.binary import Reader, Writer
 from tendermint_tpu.crypto.keys import Ed25519PubKey, PrivKey, PubKey

@@ -20,6 +20,7 @@ import numpy as np
 from tendermint_tpu.codec import signbytes
 from tendermint_tpu.crypto.batch import BatchVerifier, get_default_provider
 from tendermint_tpu.crypto.keys import is_batch_ed25519
+from tendermint_tpu.crypto.pipeline import SigCache, default_sig_cache
 from tendermint_tpu.types.block import BlockID
 from tendermint_tpu.types.validator_set import ValidatorSet
 from tendermint_tpu.types.vote import Vote, is_vote_type_valid
@@ -101,6 +102,7 @@ class VoteSet:
         signed_msg_type: int,
         val_set: ValidatorSet,
         provider: Optional[BatchVerifier] = None,
+        dedupe_cache: Optional[SigCache] = None,
     ):
         if height == 0:
             raise ValueError("cannot make VoteSet for height == 0")
@@ -112,6 +114,17 @@ class VoteSet:
         self.signed_msg_type = signed_msg_type
         self.val_set = val_set
         self.provider = provider
+        # Gossip dedupe: votes re-received from multiple peers (or
+        # re-ingested across rounds/catch-up) whose exact
+        # (pubkey, sign bytes, sig) triple already verified skip the
+        # device round trip entirely. Only SUCCESSFUL verifies are ever
+        # cached (see _add_votes), and the sig bytes are part of the
+        # key, so a hit can never accept a different signature.
+        # Process-wide by default — redelivery crosses VoteSet
+        # instances; SigCache(capacity=0) disables.
+        self.dedupe_cache = (
+            dedupe_cache if dedupe_cache is not None else default_sig_cache()
+        )
 
         n = val_set.size()
         self.votes_bit_array = BitArray(n)
@@ -174,8 +187,12 @@ class VoteSet:
     def add_vote(self, vote: Optional[Vote]) -> bool:
         """Add one vote; returns True if it was added. Raises on invalid
         votes (reference AddVote :142). Verification goes through the
-        provider as a batch of one so the device path is exercised
-        uniformly; use add_votes_batched for bulk ingest."""
+        provider via the SAME entry point as bulk ingest — when the
+        provider is the pipelined dispatcher (crypto/pipeline.py) a
+        single gossiped vote coalesces with any concurrent drain and
+        keeps the shared jit bucket warm between bulk ingests; it also
+        shares the dedupe cache, so a redelivered single vote costs one
+        hash, not a device round trip."""
         added, errors = self._add_votes([vote])  # type: ignore[list-item]
         if errors:
             raise errors[0]
@@ -196,6 +213,7 @@ class VoteSet:
         vis: List[int] = []  # validator index per row
         pks: List[bytes] = []
         sigs: List[bytes] = []
+        row_keys: List[bytes] = []  # dedupe-cache key per row
         errors: List[Exception] = []
 
         prepared: List[Optional[Tuple[Vote, int]]] = [None] * len(votes)
@@ -229,10 +247,6 @@ class VoteSet:
                     # (same contract as _serial_fill_non_ed)
                     direct_ok[k] = False
                 continue
-            rows.append(k)
-            vis.append(vote.validator_index)
-            pks.append(raw)
-            sigs.append(vote.signature)
             # templated form: within a vote set (one height/round/type)
             # rows differ only in timestamp and BlockID, so ONE
             # canonical_sign_bytes per distinct BlockID + 8 raw ts
@@ -243,14 +257,39 @@ class VoteSet:
             bid = vote.block_id
             tb = (bid.hash, bid.parts.total, bid.parts.hash)
             ti = tpl_map.get(tb)
+            tpl_bytes = (
+                tpl_list[ti]
+                if ti is not None
+                else signbytes.canonical_sign_bytes(
+                    self.signed_msg_type, self.height, self.round,
+                    tb[0], tb[1], tb[2], 0, self.chain_id,
+                )
+            )
+            # gossip dedupe pre-filter: an exact triple that verified
+            # before (this set, another round's set, another peer's
+            # redelivery) is valid by construction — skip its row.
+            # Probed BEFORE registering the template so fully-cached
+            # BlockIDs neither count against the 128-template cap nor
+            # upload unused templates.
+            ck = b""
+            if self.dedupe_cache.capacity > 0:
+                ck = SigCache.key_templated(
+                    raw,
+                    tpl_bytes,
+                    vote.timestamp_ns.to_bytes(8, "big", signed=True),
+                    vote.signature,
+                )
+                if self.dedupe_cache.seen(ck):
+                    direct_ok[k] = True
+                    continue
             if ti is None:
                 ti = tpl_map[tb] = len(tpl_map)
-                tpl_list.append(
-                    signbytes.canonical_sign_bytes(
-                        self.signed_msg_type, self.height, self.round,
-                        tb[0], tb[1], tb[2], 0, self.chain_id,
-                    )
-                )
+                tpl_list.append(tpl_bytes)
+            rows.append(k)
+            vis.append(vote.validator_index)
+            pks.append(raw)
+            sigs.append(vote.signature)
+            row_keys.append(ck)
             tmpl_idx_rows.append(ti)
             ts_rows.append(vote.timestamp_ns)
 
@@ -301,6 +340,11 @@ class VoteSet:
         else:
             ok = []
         ok_by_vote: Dict[int, bool] = {k: bool(o) for k, o in zip(rows, ok)}
+        # only SUCCESSFUL verifies enter the dedupe cache — a failed
+        # signature must never be able to poison a later lookup
+        for r, k in enumerate(rows):
+            if row_keys[r] and ok_by_vote.get(k, False):
+                self.dedupe_cache.add(row_keys[r])
         for k, o in enumerate(direct_ok):
             if o is not None:
                 ok_by_vote[k] = o

@@ -152,6 +152,40 @@ class MempoolMetrics:
         self.recheck_times = r.register(Counter("recheck_times", "Tx rechecks.", namespace, sub))
 
 
+class CryptoMetrics:
+    """Pipelined verification dispatch + gossip dedupe cache
+    (crypto/pipeline.py). Values mirror PipelinedVerifier.stats() —
+    monotonic counts are exported as gauges SET from the pipeline's own
+    counters each pump (utils can't observe the increments themselves).
+    See docs/verification-pipeline.md."""
+
+    def __init__(self, registry: Optional[Registry] = None, namespace="tendermint"):
+        r = registry or Registry()
+        sub = "crypto"
+        reg = r.register
+        self.pipeline_queue_depth = reg(Gauge("pipeline_queue_depth", "Verify requests waiting for dispatch.", namespace, sub))
+        self.pipeline_submitted = reg(Gauge("pipeline_submitted_total", "Verify requests submitted.", namespace, sub))
+        self.pipeline_bundles = reg(Gauge("pipeline_bundles_total", "Device bundles dispatched.", namespace, sub))
+        self.pipeline_rows = reg(Gauge("pipeline_rows_total", "Signature rows submitted.", namespace, sub))
+        self.pipeline_device_rows = reg(Gauge("pipeline_device_rows_total", "Signature rows that reached the device (post-dedupe).", namespace, sub))
+        self.pipeline_batch_occupancy = reg(Gauge("pipeline_batch_occupancy_avg", "Mean requests coalesced per bundle.", namespace, sub))
+        self.dedupe_cache_hits = reg(Gauge("dedupe_cache_hits_total", "Dedupe-cache hits (device round trips saved).", namespace, sub))
+        self.dedupe_cache_misses = reg(Gauge("dedupe_cache_misses_total", "Dedupe-cache misses.", namespace, sub))
+        self.dedupe_cache_size = reg(Gauge("dedupe_cache_size", "Verified triples currently cached.", namespace, sub))
+
+    def update(self, stats: dict) -> None:
+        """Copy a PipelinedVerifier.stats() snapshot into the gauges."""
+        self.pipeline_queue_depth.set(stats.get("queue_depth", 0))
+        self.pipeline_submitted.set(stats.get("submitted_calls", 0))
+        self.pipeline_bundles.set(stats.get("dispatched_bundles", 0))
+        self.pipeline_rows.set(stats.get("submitted_rows", 0))
+        self.pipeline_device_rows.set(stats.get("device_rows", 0))
+        self.pipeline_batch_occupancy.set(stats.get("batch_occupancy_avg", 0))
+        self.dedupe_cache_hits.set(stats.get("cache_hits", 0))
+        self.dedupe_cache_misses.set(stats.get("cache_misses", 0))
+        self.dedupe_cache_size.set(stats.get("cache_size", 0))
+
+
 class StateMetrics:
     def __init__(self, registry: Optional[Registry] = None, namespace="tendermint"):
         r = registry or Registry()

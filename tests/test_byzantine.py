@@ -85,6 +85,7 @@ def make_byzantine(node, switch_ref):
     cs.decide_proposal = byz_decide_proposal
 
 
+@pytest.mark.slow
 def test_byzantine_proposer_honest_majority_commits():
     async def go():
         genesis, privs = make_genesis(4)
@@ -112,6 +113,7 @@ def test_byzantine_proposer_honest_majority_commits():
     run(go())
 
 
+@pytest.mark.slow
 def test_byzantine_double_prevote_creates_evidence():
     """A validator that signs two different prevotes for the same H/R is
     caught: honest nodes turn the conflict into DuplicateVoteEvidence."""

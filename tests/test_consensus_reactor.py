@@ -37,6 +37,7 @@ async def wait_heights(nodes, height, timeout_s=60):
     await asyncio.gather(*(n.cs.wait_for_height(height, timeout_s) for n in nodes))
 
 
+@pytest.mark.slow
 def test_reactor_basic_4_nodes():
     async def go():
         nodes, reactors, switches = await build_net(4)
@@ -53,6 +54,7 @@ def test_reactor_basic_4_nodes():
     run(go())
 
 
+@pytest.mark.slow
 def test_reactor_with_txs():
     async def go():
         nodes, reactors, switches = await build_net(4)
@@ -73,6 +75,7 @@ def test_reactor_with_txs():
     run(go())
 
 
+@pytest.mark.slow
 def test_reactor_peer_catchup_via_gossip():
     """A node connected LATE catches up from peers' stored blocks
     (gossip_data_catchup + CommitVotes path)."""
@@ -183,6 +186,7 @@ def test_vote_set_maj23_query_gets_bits_response():
     run(go())
 
 
+@pytest.mark.slow
 def test_reactor_garbage_message_punishes_peer_e2e():
     """Undecodable bytes on a consensus channel make the RECEIVING
     switch drop the sender (Switch._on_peer_receive catch ->

@@ -113,6 +113,7 @@ def test_scheduler_respects_peer_base():
 # -- end to end ------------------------------------------------------------
 
 
+@pytest.mark.slow
 def test_fast_sync_catchup_then_consensus():
     """A fresh validator joins late, fast-syncs the chain from peers,
     switches to consensus and participates."""

@@ -7,6 +7,7 @@ RedoRequest, timeout redo), v0/reactor.go (poolRoutine trySync).
 """
 
 import asyncio
+import pytest
 
 from tendermint_tpu.blockchain.pool import MAX_PENDING_PER_PEER, BlockPool
 from tendermint_tpu.blockchain.reactor_v0 import BlockchainReactorV0
@@ -149,6 +150,7 @@ def test_pool_caught_up_needs_sustained_top_and_grace():
 # -- end to end -------------------------------------------------------------
 
 
+@pytest.mark.slow
 def test_v0_fast_sync_catchup_then_consensus():
     """A fresh validator joins late with the v0 engine, pool-syncs the
     chain, switches to consensus and participates (v0 analog of
@@ -221,6 +223,7 @@ def test_v0_fast_sync_catchup_then_consensus():
     run(go())
 
 
+@pytest.mark.slow
 def test_cross_engine_sync_v2_from_v0_servers():
     """Engine interop: a v2-engine late joiner syncs from v0-engine
     peers (one wire protocol, two engines)."""

@@ -444,6 +444,7 @@ def test_fsm_table(row):
 # -- end to end -------------------------------------------------------------
 
 
+@pytest.mark.slow
 def test_v1_fast_sync_catchup_then_consensus():
     """A fresh validator joins late with the v1 engine, FSM-syncs the
     chain, switches to consensus and participates (v1 analog of the
@@ -525,6 +526,7 @@ def test_v1_fast_sync_catchup_then_consensus():
     asyncio.run(go())
 
 
+@pytest.mark.slow
 def test_cross_engine_sync_v1_from_v0_servers():
     """Engine interop: a v1-engine late joiner syncs from v0-engine
     peers (one wire protocol, three engines)."""

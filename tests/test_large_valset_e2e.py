@@ -68,6 +68,7 @@ async def _inject_sim_votes(node, sim_idx_privs, stop_evt, injected):
         await asyncio.sleep(0.005)
 
 
+@pytest.mark.slow
 def test_large_valset_rounds_within_default_timeouts():
     async def go():
         # 4 real validators carry quorum (power 200 each = 800 of 1000);

@@ -147,6 +147,7 @@ def test_three_process_localnet(tmp_path):
                 p.kill()
 
 
+@pytest.mark.slow
 def test_kill_all_and_restart(tmp_path):
     """Reference test/p2p/kill_all: SIGKILL EVERY node mid-chain
     (unclean crash), restart them all from their WALs/stores, and the

@@ -1,6 +1,8 @@
 """Mempool tx gossip over p2p (mirrors mempool/reactor_test.go
 TestReactorBroadcastTxMessage)."""
 
+import pytest
+
 import asyncio
 
 from tendermint_tpu.consensus.reactor import ConsensusReactor
@@ -42,6 +44,7 @@ def test_txs_gossip_between_mempools():
     run(go())
 
 
+@pytest.mark.slow
 def test_tx_committed_via_gossip_in_full_net():
     """tx submitted on a non-proposer reaches a block quickly because the
     mempool gossips it to whoever proposes next."""

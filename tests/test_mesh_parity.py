@@ -19,8 +19,13 @@ N_DEV = 8
 
 
 def _signed_batch(n, msg_len=96, seed=11):
-    from cryptography.hazmat.primitives import serialization
-    from cryptography.hazmat.primitives.asymmetric.ed25519 import Ed25519PrivateKey
+    try:
+        from cryptography.hazmat.primitives import serialization
+        from cryptography.hazmat.primitives.asymmetric.ed25519 import (
+            Ed25519PrivateKey,
+        )
+    except ImportError:  # no OpenSSL wheel: pure-Python fallback
+        from tendermint_tpu.crypto.fallback import Ed25519PrivateKey, serialization
 
     rng = np.random.RandomState(seed)
     keys = [

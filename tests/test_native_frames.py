@@ -11,7 +11,10 @@ import subprocess
 
 import pytest
 
-from cryptography.hazmat.primitives.ciphers.aead import ChaCha20Poly1305
+try:
+    from cryptography.hazmat.primitives.ciphers.aead import ChaCha20Poly1305
+except ImportError:  # no OpenSSL wheel: pure-Python fallback
+    from tendermint_tpu.crypto.fallback import ChaCha20Poly1305
 
 from tendermint_tpu.p2p.conn import native_frames
 from tendermint_tpu.p2p.conn.secret_connection import (

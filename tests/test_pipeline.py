@@ -1,0 +1,484 @@
+"""Pipelined verification dispatch (crypto/pipeline.py).
+
+Covers the ISSUE-1 acceptance properties:
+
+- PipelinedVerifier results are BIT-IDENTICAL to the serial CPU
+  provider on random vectors, including zero-padded msg_lens rows and
+  mixed valid/invalid batches (property test over seeds);
+- dedupe-cache poisoning: a FAILED verify is never cached, and a cache
+  hit can never mask a signature that differs only in the sig bytes;
+- concurrent submissions coalesce into shared bundles and still return
+  per-request-correct slices;
+- commit specs verify through submit_commit identically to the direct
+  ValidatorSet.verify_commit call;
+- the fast-sync CommitVerifyWindow only serves entries that are still
+  valid for (blocks, valset) and the reactors' serial fallback engages
+  otherwise;
+- clean drain on stop: every submitted future completes.
+"""
+
+import threading
+
+import numpy as np
+import pytest
+
+from tendermint_tpu.codec.signbytes import PRECOMMIT_TYPE, PREVOTE_TYPE
+from tendermint_tpu.crypto.batch import CPUBatchVerifier, pack_triples
+from tendermint_tpu.crypto.keys import Ed25519PrivKey
+from tendermint_tpu.crypto.pipeline import PipelinedVerifier, SigCache
+from tendermint_tpu.types.block import BlockID, PartSetHeader
+from tendermint_tpu.types.validator import Validator
+from tendermint_tpu.types.validator_set import CommitVerifySpec, ValidatorSet
+from tendermint_tpu.types.vote import Vote
+from tendermint_tpu.types.vote_set import ErrVoteInvalidSignature, VoteSet
+
+CHAIN = "pipeline-chain"
+
+_KEYS = [Ed25519PrivKey.from_secret(f"pipe{i}".encode()) for i in range(6)]
+
+
+def _random_batch(seed: int, n: int, ragged: bool):
+    """Mixed valid/invalid rows; ragged messages exercise the
+    zero-padded msg_lens path in pack_triples."""
+    rng = np.random.RandomState(seed)
+    pks, msgs, sigs = [], [], []
+    for i in range(n):
+        k = _KEYS[i % len(_KEYS)]
+        mlen = int(rng.randint(40, 120)) if ragged else 80
+        m = bytes(rng.bytes(mlen))
+        s = bytearray(k.sign(m))
+        kind = i % 4
+        if kind == 1:
+            s[3] ^= 0x40  # corrupt sig
+        elif kind == 2:
+            m = bytes([m[0] ^ 1]) + m[1:]  # sig no longer matches msg
+        pks.append(k.pub_key().bytes())
+        msgs.append(m)
+        sigs.append(bytes(s))
+    return pack_triples(pks, msgs, sigs)
+
+
+@pytest.mark.parametrize("seed,ragged", [(1, False), (2, True), (3, True)])
+def test_pipelined_bit_identical_to_serial(seed, ragged):
+    pk, mg, sg, lens = _random_batch(seed, 21, ragged)
+    ref = CPUBatchVerifier().verify_batch(pk, mg, sg, msg_lens=lens)
+    assert ref.any() and not ref.all(), "want a mixed batch"
+    with PipelinedVerifier(CPUBatchVerifier(), cache=SigCache()) as pv:
+        got = pv.verify_batch(pk, mg, sg, msg_lens=lens)
+        assert (got == ref).all()
+        # dedupe path must be bit-identical too (valid rows cached,
+        # invalid rows re-verified)
+        got1 = pv.submit_batch(pk, mg, sg, msg_lens=lens, dedupe=True).result()
+        got2 = pv.submit_batch(pk, mg, sg, msg_lens=lens, dedupe=True).result()
+        assert (got1 == ref).all() and (got2 == ref).all()
+
+
+def test_concurrent_submits_coalesce_and_split_correctly():
+    batches = [_random_batch(10 + i, 9 + i, i % 2 == 1) for i in range(6)]
+    refs = [
+        CPUBatchVerifier().verify_batch(pk, mg, sg, msg_lens=lens)
+        for pk, mg, sg, lens in batches
+    ]
+    with PipelinedVerifier(CPUBatchVerifier(), cache=SigCache()) as pv:
+        results = [None] * len(batches)
+
+        def submit(i):
+            pk, mg, sg, lens = batches[i]
+            results[i] = pv.submit_batch(pk, mg, sg, msg_lens=lens).result()
+
+        threads = [
+            threading.Thread(target=submit, args=(i,)) for i in range(len(batches))
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        for got, ref in zip(results, refs):
+            assert (got == ref).all()
+        st = pv.stats()
+        assert st["submitted_calls"] == len(batches)
+        assert st["dispatched_bundles"] <= st["submitted_calls"]
+
+
+def test_failed_verify_is_never_cached():
+    k = _KEYS[0]
+    msg = b"m" * 64
+    good = k.sign(msg)
+    bad = bytearray(good)
+    bad[7] ^= 0x20
+    pk, mg, sg, lens = pack_triples(
+        [k.pub_key().bytes()], [msg], [bytes(bad)]
+    )
+    cache = SigCache()
+    with PipelinedVerifier(CPUBatchVerifier(), cache=cache) as pv:
+        assert not pv.submit_batch(pk, mg, sg, dedupe=True).result()[0]
+        assert cache.stats()["insertions"] == 0, "failed verify was cached"
+        # the same bad row again: must come back False (not a fake hit)
+        assert not pv.submit_batch(pk, mg, sg, dedupe=True).result()[0]
+        assert cache.stats()["hits"] == 0
+
+
+def test_cache_hit_cannot_mask_a_different_sig():
+    k = _KEYS[1]
+    msg = b"n" * 64
+    good = k.sign(msg)
+    pk, mg, sg, _ = pack_triples([k.pub_key().bytes()], [msg], [good])
+    cache = SigCache()
+    with PipelinedVerifier(CPUBatchVerifier(), cache=cache) as pv:
+        assert pv.submit_batch(pk, mg, sg, dedupe=True).result()[0]
+        assert cache.stats()["insertions"] == 1
+        # same (pubkey, msg) but different sig bytes: MUST miss and fail
+        bad = bytearray(good)
+        bad[63] ^= 0x01
+        pk2, mg2, sg2, _ = pack_triples([k.pub_key().bytes()], [msg], [bytes(bad)])
+        assert not pv.submit_batch(pk2, mg2, sg2, dedupe=True).result()[0]
+
+
+def test_stop_drains_pending_futures():
+    pv = PipelinedVerifier(CPUBatchVerifier(), cache=SigCache())
+    pk, mg, sg, lens = _random_batch(42, 12, False)
+    futs = [pv.submit_batch(pk, mg, sg) for _ in range(8)]
+    pv.stop(drain=True)
+    ref = CPUBatchVerifier().verify_batch(pk, mg, sg)
+    for f in futs:
+        assert (f.result(timeout=5) == ref).all()
+    # submission after stop degrades to inline execution, not a hang
+    assert (pv.submit_batch(pk, mg, sg).result(timeout=5) == ref).all()
+
+
+# -- vote ingest dedupe ------------------------------------------------------
+
+
+def _voteset(cache, n=4, vote_type=PREVOTE_TYPE):
+    privs = [Ed25519PrivKey.from_secret(f"pvs{i}".encode()) for i in range(n)]
+    vs = ValidatorSet([Validator(p.pub_key(), 1) for p in privs])
+    by_addr = {p.pub_key().address(): p for p in privs}
+    ordered = [by_addr[v.address] for v in vs.validators]
+    return (
+        VoteSet(CHAIN, 1, 0, vote_type, vs, dedupe_cache=cache),
+        vs,
+        ordered,
+    )
+
+
+def _signed_vote(priv, idx, bid, ts=9000):
+    v = Vote(
+        vote_type=PREVOTE_TYPE,
+        height=1,
+        round=0,
+        block_id=bid,
+        timestamp_ns=ts + idx,
+        validator_address=priv.pub_key().address(),
+        validator_index=idx,
+    )
+    v.signature = priv.sign(v.sign_bytes(CHAIN))
+    return v
+
+
+BID = BlockID(hash=b"\x55" * 32, parts=PartSetHeader(total=1, hash=b"\x56" * 32))
+
+
+def test_voteset_redelivery_hits_cache_across_sets():
+    cache = SigCache()
+    voteset, vs, privs = _voteset(cache)
+    assert voteset.add_vote(_signed_vote(privs[0], 0, BID))
+    assert voteset.add_vote(_signed_vote(privs[1], 1, BID))
+    assert cache.stats()["insertions"] == 2
+    # gossip redelivery into a FRESH set (same height/round): cache hits,
+    # identical acceptance
+    vs2 = VoteSet(CHAIN, 1, 0, PREVOTE_TYPE, voteset.val_set, dedupe_cache=cache)
+    added, errs = vs2.add_votes_batched(
+        [_signed_vote(privs[0], 0, BID), _signed_vote(privs[1], 1, BID)]
+    )
+    assert added == [True, True] and not errs
+    assert cache.stats()["hits"] == 2
+
+
+def test_voteset_poisoned_sig_not_masked_by_cache():
+    cache = SigCache()
+    voteset, vs, privs = _voteset(cache)
+    good = _signed_vote(privs[0], 0, BID)
+    assert voteset.add_vote(good)
+    # same vote, sig bytes flipped: the cached success for the good sig
+    # must NOT accept this one
+    vs2 = VoteSet(CHAIN, 1, 0, PREVOTE_TYPE, voteset.val_set, dedupe_cache=cache)
+    bad = _signed_vote(privs[0], 0, BID)
+    sig = bytearray(bad.signature)
+    sig[10] ^= 0x04
+    bad.signature = bytes(sig)
+    added, errs = vs2.add_votes_batched([bad])
+    assert added == [False]
+    assert len(errs) == 1 and isinstance(errs[0], ErrVoteInvalidSignature)
+    # and the failure was not inserted
+    vs3 = VoteSet(CHAIN, 1, 0, PREVOTE_TYPE, voteset.val_set, dedupe_cache=cache)
+    added, errs = vs3.add_votes_batched([bad])
+    assert added == [False] and len(errs) == 1
+
+
+def test_voteset_results_identical_with_and_without_cache():
+    bid_nil = BlockID()
+    for trial in range(3):
+        votesets = []
+        for cache in (SigCache(capacity=0), SigCache()):
+            voteset, vs, privs = _voteset(cache)
+            batch = []
+            for i, p in enumerate(privs):
+                v = _signed_vote(p, i, BID if i % 2 else bid_nil, ts=9000 + trial)
+                if i == 3:
+                    v.signature = bytes(64)  # invalid
+                batch.append(v)
+            # ingest twice: second pass exercises hits (or re-verifies)
+            out1 = voteset.add_votes_batched(batch)
+            out2 = voteset.add_votes_batched(batch)
+            votesets.append((out1[0], [type(e) for e in out1[1]],
+                             out2[0], [type(e) for e in out2[1]],
+                             voteset.sum, voteset.maj23))
+        assert votesets[0] == votesets[1]
+
+
+# -- commit specs + the fast-sync verify window ------------------------------
+
+
+def _commit_fixture(n=4, bad_idx=None):
+    privs = [Ed25519PrivKey.from_secret(f"cw{i}".encode()) for i in range(n)]
+    vs = ValidatorSet([Validator(p.pub_key(), 1) for p in privs])
+    by_addr = {p.pub_key().address(): p for p in privs}
+    bid = BlockID(hash=b"\x42" * 32, parts=PartSetHeader(total=1, hash=b"\x43" * 32))
+    from tendermint_tpu.types.block import BLOCK_ID_FLAG_COMMIT, Commit, CommitSig
+
+    sigs = []
+    for i, val in enumerate(vs.validators):
+        v = Vote(
+            vote_type=PRECOMMIT_TYPE,
+            height=5,
+            round=0,
+            block_id=bid,
+            timestamp_ns=1000 + i,
+            validator_address=val.address,
+            validator_index=i,
+        )
+        sig = by_addr[val.address].sign(v.sign_bytes(CHAIN))
+        if bad_idx is not None and i in bad_idx:
+            sig = bytes(64)
+        sigs.append(
+            CommitSig(
+                block_id_flag=BLOCK_ID_FLAG_COMMIT,
+                validator_address=val.address,
+                timestamp_ns=1000 + i,
+                signature=sig,
+            )
+        )
+    return vs, Commit(height=5, round=0, block_id=bid, signatures=sigs), bid
+
+
+def test_submit_commit_matches_direct_verify():
+    vs_good, commit_good, bid = _commit_fixture()
+    vs_bad, commit_bad, bid_b = _commit_fixture(bad_idx={0})
+    with PipelinedVerifier(CPUBatchVerifier(), cache=SigCache()) as pv:
+        f_good = pv.submit_commit(
+            CommitVerifySpec(vs_good, CHAIN, bid, 5, commit_good)
+        )
+        f_bad = pv.submit_commit(
+            CommitVerifySpec(vs_bad, CHAIN, bid_b, 5, commit_bad)
+        )
+        assert f_good.result() is None
+        err = f_bad.result()
+    try:
+        vs_bad.verify_commit(CHAIN, bid_b, 5, commit_bad, provider=CPUBatchVerifier())
+        direct = None
+    except Exception as e:
+        direct = e
+    assert direct is not None
+    assert type(err) is type(direct) and str(err) == str(direct)
+
+
+class _FakeBlock:
+    """Duck-typed block for the window: header.height, hash(),
+    make_part_set(), last_commit."""
+
+    def __init__(self, height, commit=None):
+        self.header = type("H", (), {"height": height})()
+        self.last_commit = commit
+
+    def hash(self):
+        return bytes([self.header.height]) * 32
+
+    def make_part_set(self):
+        h = self.header.height
+
+        class _PS:
+            def header(self_inner):
+                return PartSetHeader(total=1, hash=bytes([h]) * 32)
+
+        return _PS()
+
+
+def test_verify_window_identity_and_valset_guards():
+    from tendermint_tpu.blockchain.verify_window import CommitVerifyWindow
+
+    vs, commit, _bid = _commit_fixture()
+    with PipelinedVerifier(CPUBatchVerifier(), cache=SigCache()) as pv:
+        win = CommitVerifyWindow(depth=4, provider=pv)
+        blocks = {h: _FakeBlock(h, commit) for h in range(1, 7)}
+        win.lookahead(blocks.get, 1, CHAIN, vs)
+        assert win.inflight() == 4  # heights 1..4 (5 needs block 6's pair... 5 has 6)
+        ent = win.take(1, blocks[1], blocks[2], vs)
+        assert ent is not None
+        ent["future"].result()  # completes (accept or reject — commit heights differ)
+        # a refetched block object invalidates its entry
+        win.lookahead(blocks.get, 2, CHAIN, vs)
+        replacement = _FakeBlock(2, commit)
+        assert win.take(2, replacement, blocks[3], vs) is None
+        # a changed validator set invalidates too
+        win.lookahead(blocks.get, 3, CHAIN, vs)
+        privs = [Ed25519PrivKey.from_secret(f"other{i}".encode()) for i in range(4)]
+        other_vs = ValidatorSet([Validator(p.pub_key(), 1) for p in privs])
+        assert win.take(3, blocks[3], blocks[4], other_vs) is None
+        # entries below the new base height are pruned
+        win.lookahead(blocks.get, 5, CHAIN, vs)
+        assert all(h >= 5 for h in win._inflight)
+
+    # provider without submit_commit: the window stays inert
+    win2 = CommitVerifyWindow(depth=4, provider=CPUBatchVerifier())
+    win2.lookahead(blocks.get, 1, CHAIN, vs)
+    assert win2.inflight() == 0
+
+
+# -- v0 reactor loop with the pipelined window -------------------------------
+
+
+def _make_chain(privs, vs, n_heights):
+    """Fake blocks 1..n_heights+1 where block h+1 carries the commit
+    FOR block h, signed over block h's real (hash, parts) BlockID —
+    the exact pair shape _try_sync_one verifies."""
+    by_addr = {p.pub_key().address(): p for p in privs}
+    from tendermint_tpu.types.block import BLOCK_ID_FLAG_COMMIT, Commit, CommitSig
+
+    blocks = {1: _FakeBlock(1)}
+    for h in range(1, n_heights + 1):
+        first = blocks[h]
+        bid = BlockID(hash=first.hash(), parts=first.make_part_set().header())
+        sigs = []
+        for i, val in enumerate(vs.validators):
+            v = Vote(
+                vote_type=PRECOMMIT_TYPE,
+                height=h,
+                round=0,
+                block_id=bid,
+                timestamp_ns=2000 + i,
+                validator_address=val.address,
+                validator_index=i,
+            )
+            sigs.append(
+                CommitSig(
+                    block_id_flag=BLOCK_ID_FLAG_COMMIT,
+                    validator_address=val.address,
+                    timestamp_ns=2000 + i,
+                    signature=by_addr[val.address].sign(v.sign_bytes(CHAIN)),
+                )
+            )
+        blocks[h + 1] = _FakeBlock(
+            h + 1, Commit(height=h, round=0, block_id=bid, signatures=sigs)
+        )
+    return blocks
+
+
+def test_v0_reactor_pipelines_commit_verification():
+    """_try_sync_one keeps K commits in flight and applies the chain in
+    order; results are identical to the serial path and the window's
+    futures actually rode the pipelined provider."""
+    import asyncio
+
+    from tendermint_tpu.blockchain.reactor_v0 import BlockchainReactorV0
+
+    privs = [Ed25519PrivKey.from_secret(f"r0{i}".encode()) for i in range(4)]
+    vs = ValidatorSet([Validator(p.pub_key(), 1) for p in privs])
+    blocks = _make_chain(privs, vs, 6)
+
+    class _State:
+        validators = vs
+        chain_id = CHAIN
+        last_block_height = 0
+
+    applied = []
+
+    class _Exec:
+        async def apply_block(self, state, bid, block):
+            applied.append(block.header.height)
+            return state, None
+
+    class _Store:
+        saved = []
+
+        def save_block(self, first, parts, commit):
+            self.saved.append(first.header.height)
+
+    async def go():
+        with PipelinedVerifier(CPUBatchVerifier(), cache=SigCache()) as pv:
+            r = BlockchainReactorV0(
+                _State(), _Exec(), _Store(), fast_sync=True,
+                verify_depth=4, provider=pv,
+            )
+            r.pool.set_peer_range("p", 1, 7)
+            r.pool.make_next_requesters(now=0.0)
+            for h in range(1, 8):
+                r.pool.requesters[h].peer_id = "p"
+                assert r.pool.add_block("p", blocks[h])
+            while await r._try_sync_one():
+                pass
+            assert applied == [1, 2, 3, 4, 5, 6]
+            stats = pv.stats()
+            assert stats["submitted_calls"] >= 6, "window never submitted"
+
+    asyncio.run(go())
+
+
+def test_v0_reactor_rejects_bad_commit_through_window():
+    """A corrupted commit mid-chain fails through the pipelined window
+    exactly like the serial path: the pair is redone, nothing applied
+    past the bad height, and the lookahead window is dropped."""
+    import asyncio
+
+    from tendermint_tpu.blockchain.reactor_v0 import BlockchainReactorV0
+
+    privs = [Ed25519PrivKey.from_secret(f"r1{i}".encode()) for i in range(4)]
+    vs = ValidatorSet([Validator(p.pub_key(), 1) for p in privs])
+    blocks = _make_chain(privs, vs, 5)
+    # corrupt the commit for height 3 (carried by block 4)
+    blocks[4].last_commit.signatures[0].signature = bytes(64)
+
+    class _State:
+        validators = vs
+        chain_id = CHAIN
+        last_block_height = 0
+
+    applied = []
+
+    class _Exec:
+        async def apply_block(self, state, bid, block):
+            applied.append(block.header.height)
+            return state, None
+
+    class _Store:
+        def save_block(self, first, parts, commit):
+            pass
+
+    async def go():
+        with PipelinedVerifier(CPUBatchVerifier(), cache=SigCache()) as pv:
+            r = BlockchainReactorV0(
+                _State(), _Exec(), _Store(), fast_sync=True,
+                verify_depth=4, provider=pv,
+            )
+            r.pool.set_peer_range("p", 1, 6)
+            r.pool.make_next_requesters(now=0.0)
+            for h in range(1, 7):
+                r.pool.requesters[h].peer_id = "p"
+                assert r.pool.add_block("p", blocks[h])
+            while await r._try_sync_one():
+                pass
+            assert applied == [1, 2], f"applied past the bad commit: {applied}"
+            assert r._verify_window.inflight() == 0, "window not dropped"
+            # blocks 3 and 4 were unassigned for refetch
+            assert r.pool.peek_two_blocks() == (None, None)
+
+    asyncio.run(go())
