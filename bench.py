@@ -240,6 +240,7 @@ _GUARD_KEYS = [
     ("tabled_sigs_per_sec_sustained", "higher"),
     ("sigs_per_sec_sustained", "higher"),
     ("replay_speedup", "higher"),
+    ("merkle_root_speedup", "higher"),
     ("coldstart_first_verify_s", None),   # presence-only: timing varies
     ("coldstart_tabled_first_s", None),
 ]
@@ -345,6 +346,7 @@ def run_bench(platform: str, accelerator: bool = True):
             platform=platform,
             note="accelerator unavailable; measured the node's host fallback path",
             **replay_bench(cpu),
+            **merkle_bench(),
             **_last_tpu_extra(),
         )
         _deadline_done()
@@ -558,6 +560,9 @@ def run_bench(platform: str, accelerator: bool = True):
         log(f"replay provider setup failed: {ex!r}")
         replay_extra = {"replay_error": repr(ex)[:200]}
 
+    # -- merkle engine: device vs host root + part-set split --------------
+    merkle_extra = merkle_bench()
+
     # -- AOT cold start: fresh process, warm AOT cache --------------------
     # VERDICT round 2 #2: a restarting validator must reach its first
     # device-verified commit in seconds, not a ~20s recompile window.
@@ -630,6 +635,7 @@ def run_bench(platform: str, accelerator: bool = True):
         **extra,
         **tabled,
         **replay_extra,
+        **merkle_extra,
         **aot_extra,
     }
     regressions = _regression_guard(line, platform)
@@ -649,6 +655,104 @@ def run_bench(platform: str, accelerator: bool = True):
     # would rebuild the same dict field-by-field)
     print(json.dumps(line), flush=True)
     _deadline_done()  # AFTER emit: state-file absence must imply the line was printed
+
+
+# -- merkle: device-batched SHA-256 engine vs host hashlib -----------------
+#
+# The commit/propose loop's non-signature hot path: tx roots, part-set
+# roots, validator-set hashes (crypto/merkle.py). Measures the device
+# engine (models/hasher.py) against the iterative host path over a
+# MERKLE_N-leaf tree, plus a PartSet.from_data block-split case (root +
+# every part proof in one batched pass). merkle_root_speedup joins the
+# regression guard next to replay_speedup.
+
+MERKLE_N = int(os.environ.get("TM_BENCH_MERKLE_N", "10000"))
+
+
+def merkle_bench() -> dict:
+    """Returns the merkle_* bench keys; never raises (the main line
+    must survive a broken engine — the guard then flags the missing
+    key against the previous record)."""
+    try:
+        import numpy as np
+
+        from tendermint_tpu.crypto import merkle
+
+        rng = np.random.RandomState(99)
+        # 45-byte leaves: validator hash_bytes / commit-sig scale, one
+        # message block per leaf
+        items = [rng.bytes(45) for _ in range(MERKLE_N)]
+
+        merkle.configure_device(False)
+        t0 = time.perf_counter()
+        for _ in range(3):
+            root_host = merkle.hash_from_byte_slices(items)
+        host_s = (time.perf_counter() - t0) / 3
+
+        merkle.configure_device(True, threshold=2, block_on_compile=True)
+        t0 = time.perf_counter()
+        root_dev = merkle.hash_from_byte_slices(items)
+        cold_s = time.perf_counter() - t0
+        assert root_dev == root_host, "device root != host root"
+        times = []
+        for _ in range(5):
+            t0 = time.perf_counter()
+            root_dev = merkle.hash_from_byte_slices(items)
+            times.append(time.perf_counter() - t0)
+        dev_s = sorted(times)[len(times) // 2]
+        assert root_dev == root_host
+        # negative control: one flipped leaf byte must change the root
+        tampered = list(items)
+        tampered[7] = bytes([items[7][0] ^ 1]) + items[7][1:]
+        assert merkle.hash_from_byte_slices(tampered) != root_host
+
+        # PartSet.from_data: block split into small parts so the part
+        # count clears the device threshold (root + every part proof)
+        from tendermint_tpu.types.part_set import PartSet
+
+        data = rng.bytes(512 * 1024)
+        merkle.configure_device(False)
+        t0 = time.perf_counter()
+        ps_host = PartSet.from_data(data, part_size=256)
+        ps_host_s = time.perf_counter() - t0
+        merkle.configure_device(True, threshold=2, block_on_compile=True)
+        ps_dev = PartSet.from_data(data, part_size=256)  # compile pass
+        t0 = time.perf_counter()
+        ps_dev = PartSet.from_data(data, part_size=256)
+        ps_dev_s = time.perf_counter() - t0
+        assert ps_dev.header() == ps_host.header(), "part-set root mismatch"
+        p = ps_dev.get_part(3)
+        assert ps_host.get_part(3).proof.aunts == p.proof.aunts
+
+        out = {
+            "merkle_n_leaves": MERKLE_N,
+            "merkle_host_ms": round(host_s * 1e3, 2),
+            "merkle_device_ms": round(dev_s * 1e3, 2),
+            "merkle_cold_compile_s": round(cold_s, 1),
+            "merkle_root_speedup": round(host_s / dev_s, 2),
+            "merkle_partset_host_ms": round(ps_host_s * 1e3, 2),
+            "merkle_partset_device_ms": round(ps_dev_s * 1e3, 2),
+        }
+        log(
+            f"merkle root@{MERKLE_N}: host {host_s*1e3:.1f} ms, device "
+            f"{dev_s*1e3:.1f} ms ({out['merkle_root_speedup']}x; cold {cold_s:.1f}s); "
+            f"partset 2048x256B: host {ps_host_s*1e3:.1f} ms, device {ps_dev_s*1e3:.1f} ms"
+        )
+        return out
+    except Exception as ex:
+        import traceback
+
+        traceback.print_exc(file=sys.stderr)
+        log(f"merkle measurement failed: {ex!r}")
+        return {"merkle_error": repr(ex)[:200]}
+    finally:
+        # leave the engine off for the rest of the bench process
+        try:
+            from tendermint_tpu.crypto import merkle as _m
+
+            _m.configure_device(False)
+        except Exception:
+            pass
 
 
 # -- fast-sync replay: pipelined dispatch vs synchronous per-commit --------

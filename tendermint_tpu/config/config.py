@@ -67,6 +67,15 @@ class BaseConfig:
     # (models/verifier.py); on hosts with fewer devices the node falls
     # back to single-device and logs it.
     crypto_mesh_devices: int = 0
+    # Device-batched SHA-256 merkle engine (models/hasher.py behind
+    # crypto/merkle.py): tx roots, part-set roots, validator-set /
+    # commit-sig / evidence hashes with at least merkle_device_threshold
+    # leaves hash on the accelerator; smaller trees and every fallback
+    # stay on the iterative host path (bit-identical roots/proofs). The
+    # node enables the engine non-blocking: cold size-buckets hash on
+    # host while their dispatch chain compiles in the background.
+    merkle_device: bool = True
+    merkle_device_threshold: int = 1024
 
     def genesis_file(self) -> str:
         return _rootify(self.genesis_file_name, self.root_dir)
@@ -92,6 +101,8 @@ class BaseConfig:
             return "crypto_pipeline_depth must be >= 1"
         if self.crypto_pipeline_flush_ms < 0:
             return "crypto_pipeline_flush_ms can't be negative"
+        if self.merkle_device_threshold < 2:
+            return "merkle_device_threshold must be >= 2"
         return None
 
 

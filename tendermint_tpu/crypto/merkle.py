@@ -10,8 +10,10 @@ multi-op proofs, used by the verifying light proxy).
 from __future__ import annotations
 
 import hashlib
+import os
+import threading
 from dataclasses import dataclass, field
-from typing import Callable, Dict, List, Sequence
+from typing import Callable, Dict, List, Optional, Sequence
 
 _LEAF_PREFIX = b"\x00"
 _INNER_PREFIX = b"\x01"
@@ -39,16 +41,127 @@ def _split_point(n: int) -> int:
     return k
 
 
+# -- device engine seam -----------------------------------------------------
+#
+# The batched SHA-256 merkle engine (models/hasher.py) serves
+# hash_from_byte_slices / proofs_from_byte_slices for trees with at
+# least _DEVICE_THRESHOLD leaves: tx roots, part-set roots, validator
+# set hashes, commit-sig and evidence hashes all funnel through these
+# two functions, so one seam accelerates every caller. The engine is
+# OFF until configure_device() enables it (node startup wires it from
+# config.base.merkle_device{,_threshold}); the host path below is the
+# always-available fallback and the two are bit-identical — tests
+# assert roots, proofs and aunts match shape-for-shape.
+
+_DEVICE_LOCK = threading.Lock()
+_DEVICE_ENABLED = os.environ.get("TM_MERKLE_DEVICE", "") == "1"
+_DEVICE_THRESHOLD = max(2, int(os.environ.get("TM_MERKLE_DEVICE_THRESHOLD", "1024")))
+_DEVICE_BLOCK_ON_COMPILE = False
+_HASHER = None
+_HOST_STATS = {"host_roots": 0, "host_proof_sets": 0}
+
+
+def configure_device(
+    enabled: bool = True,
+    threshold: Optional[int] = None,
+    block_on_compile: Optional[bool] = None,
+) -> None:
+    """Enable/disable the device merkle engine process-wide. The hasher
+    itself is created lazily on the first qualifying tree, so flipping
+    the flag never imports jax by itself."""
+    global _DEVICE_ENABLED, _DEVICE_THRESHOLD, _DEVICE_BLOCK_ON_COMPILE, _HASHER
+    with _DEVICE_LOCK:
+        _DEVICE_ENABLED = bool(enabled)
+        if threshold is not None:
+            _DEVICE_THRESHOLD = max(2, int(threshold))
+        if block_on_compile is not None and block_on_compile != _DEVICE_BLOCK_ON_COMPILE:
+            _DEVICE_BLOCK_ON_COMPILE = block_on_compile
+            _HASHER = None  # rebuilt with the new compile discipline
+
+
+def _device_hasher():
+    """The lazily constructed MerkleHasher, or None when construction
+    fails (e.g. no usable jax backend) — failure latches the engine off
+    rather than re-raising into consensus hashing."""
+    global _HASHER, _DEVICE_ENABLED
+    h = _HASHER
+    if h is not None:
+        return h
+    with _DEVICE_LOCK:
+        if _HASHER is None:
+            try:
+                from tendermint_tpu.models.hasher import MerkleHasher
+
+                _HASHER = MerkleHasher(block_on_compile=_DEVICE_BLOCK_ON_COMPILE)
+            except Exception:
+                _DEVICE_ENABLED = False
+                return None
+        return _HASHER
+
+
+def device_stats() -> Dict[str, int]:
+    """Engine counters for metrics (tendermint_merkle_* rows in
+    docs/metrics.md); zeros when the engine never engaged."""
+    out = dict(_HOST_STATS)
+    out["device_enabled"] = 1 if _DEVICE_ENABLED else 0
+    h = _HASHER
+    if h is not None:
+        out.update(h.stats)
+    else:
+        out.update(
+            device_roots=0, device_proof_sets=0, device_leaves=0,
+            fallback_cold=0, fallback_shape=0,
+        )
+    return out
+
+
+def hasher_warmup(sizes=(1024, 10240), background: bool = True):
+    """Pre-compile device buckets (node-start path); no-op when the
+    engine is disabled or unavailable."""
+    if not _DEVICE_ENABLED:
+        return None
+    h = _device_hasher()
+    if h is None:
+        return None
+    return h.warmup(sizes=sizes, background=background)
+
+
 def hash_from_byte_slices(items: Sequence[bytes]) -> bytes:
     """Merkle root; empty input hashes to sha256 of empty (reference
-    emptyHash, simple_tree.go)."""
+    emptyHash, simple_tree.go).
+
+    Level-iterative, not recursive: the reference recursion splits at
+    the largest power of two k < n, which is EXACTLY the tree produced
+    by pairing adjacent nodes level-by-level and promoting an odd last
+    node (the left subtree of any node covers a power-of-two aligned
+    prefix, so pair-reduction never mixes across the split; induction
+    on levels). Iteration kills the O(n log n) items[:k]/items[k:] list
+    copying the recursion paid at every level, and the same pairing is
+    what the device engine parallelizes."""
     n = len(items)
     if n == 0:
         return _sha(b"")
     if n == 1:
         return leaf_hash(items[0])
-    k = _split_point(n)
-    return inner_hash(hash_from_byte_slices(items[:k]), hash_from_byte_slices(items[k:]))
+    if _DEVICE_ENABLED and n >= _DEVICE_THRESHOLD:
+        h = _device_hasher()
+        if h is not None:
+            try:
+                root = h.root(items)
+            except Exception:
+                root = None  # degrade to host, never raise into hashing
+            if root is not None:
+                return root
+    _HOST_STATS["host_roots"] += 1
+    level = [leaf_hash(it) for it in items]
+    while len(level) > 1:
+        nxt = [
+            inner_hash(level[i], level[i + 1]) for i in range(0, len(level) - 1, 2)
+        ]
+        if len(level) % 2:
+            nxt.append(level[-1])
+        level = nxt
+    return level[0]
 
 
 @dataclass
@@ -89,8 +202,67 @@ def _compute_from_aunts(index: int, total: int, lh: bytes, aunts: List[bytes]) -
     return inner_hash(aunts[-1], right)
 
 
+def _aunts_from_levels(levels, counts) -> List[List[bytes]]:
+    """Per-leaf aunt paths from materialized tree levels (leaf level
+    first). At level l a node at position p (= leaf_index >> l) pairs
+    with sibling p^1 when that sibling exists (p^1 < count); a promoted
+    node contributes no aunt at that level. Leaf-level-first ordering
+    matches _Node.flatten_aunts / _compute_from_aunts. Row bytes are
+    sliced once per level and shared by reference across paths — the
+    per-leaf loop only appends existing objects."""
+    n = counts[0]
+    if n == 0:
+        return []
+    depth = len(levels) - 1
+    rows: List[List[bytes]] = []
+    for l in range(depth):
+        lv, cnt = levels[l], counts[l]
+        if hasattr(lv, "tobytes"):
+            buf = lv.tobytes()
+            rows.append([buf[i * 32 : (i + 1) * 32] for i in range(cnt)])
+        else:
+            rows.append([bytes(x) for x in lv[:cnt]])
+    counts_l = list(counts)
+    aunts: List[List[bytes]] = []
+    for i in range(n):
+        path = []
+        p = i
+        for l in range(depth):
+            s = p ^ 1
+            if s < counts_l[l]:
+                path.append(rows[l][s])
+            p >>= 1
+        aunts.append(path)
+    return aunts
+
+
 def proofs_from_byte_slices(items: Sequence[bytes]) -> tuple:
-    """(root, [SimpleProof per item]) -- simple_proof.go SimpleProofsFromByteSlices."""
+    """(root, [SimpleProof per item]) -- simple_proof.go SimpleProofsFromByteSlices.
+
+    Rides the device engine above the threshold: leaf digests and every
+    inner level come back from one batched pass and the aunt paths are
+    extracted positionally (no trail-node graph), bit-identical to the
+    host path below it."""
+    n = len(items)
+    if _DEVICE_ENABLED and n >= _DEVICE_THRESHOLD:
+        h = _device_hasher()
+        if h is not None:
+            try:
+                out = h.tree(items)
+            except Exception:
+                out = None  # degrade to host, never raise into hashing
+            if out is not None:
+                levels, counts = out
+                root = bytes(levels[-1][0])
+                aunts = _aunts_from_levels(levels, counts)
+                proofs = [
+                    SimpleProof(
+                        total=n, index=i,
+                        leaf_hash=bytes(levels[0][i]), aunts=aunts[i],
+                    )
+                    for i in range(n)
+                ]
+                return root, proofs
     trails, root_node = _trails_from_byte_slices(list(items))
     root = root_node.hash
     proofs = []
@@ -125,21 +297,32 @@ class _Node:
 
 
 def _trails_from_byte_slices(items: List[bytes]):
+    """Iterative trail construction (host path; the recursion-equivalence
+    argument is on hash_from_byte_slices). A promoted odd node is the
+    SAME _Node carried to the next level — its parent/sibling links are
+    set at whatever level it finally pairs, which is exactly the
+    recursive wiring (the lone right subtree root links directly to the
+    ancestor it joins)."""
     n = len(items)
     if n == 0:
         return [], _Node(_sha(b""))
-    if n == 1:
-        node = _Node(leaf_hash(items[0]))
-        return [node], node
-    k = _split_point(n)
-    lefts, left_root = _trails_from_byte_slices(items[:k])
-    rights, right_root = _trails_from_byte_slices(items[k:])
-    root = _Node(inner_hash(left_root.hash, right_root.hash))
-    left_root.parent = root
-    left_root.right = right_root
-    right_root.parent = root
-    right_root.left = left_root
-    return lefts + rights, root
+    leaves = [_Node(leaf_hash(it)) for it in items]
+    _HOST_STATS["host_proof_sets"] += 1
+    level = leaves
+    while len(level) > 1:
+        nxt = []
+        for i in range(0, len(level) - 1, 2):
+            left, right = level[i], level[i + 1]
+            parent = _Node(inner_hash(left.hash, right.hash))
+            left.parent = parent
+            left.right = right
+            right.parent = parent
+            right.left = left
+            nxt.append(parent)
+        if len(level) % 2:
+            nxt.append(level[-1])
+        level = nxt
+    return leaves, level[0]
 
 
 # ---------------------------------------------------------------------------

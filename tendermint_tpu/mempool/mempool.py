@@ -56,9 +56,11 @@ class TxCache:
     def reset(self) -> None:
         self._map.clear()
 
-    def push(self, tx: bytes) -> bool:
-        """Returns False if already present (and refreshes recency)."""
-        k = tx_key(tx)
+    def push(self, tx: bytes, key: Optional[bytes] = None) -> bool:
+        """Returns False if already present (and refreshes recency).
+        `key` is the precomputed tx_key when the caller already hashed
+        the tx (the CheckTx admission path hashes exactly once)."""
+        k = key if key is not None else tx_key(tx)
         if k in self._map:
             self._map.move_to_end(k)
             return False
@@ -67,8 +69,8 @@ class TxCache:
             self._map.popitem(last=False)
         return True
 
-    def remove(self, tx: bytes) -> None:
-        self._map.pop(tx_key(tx), None)
+    def remove(self, tx: bytes, key: Optional[bytes] = None) -> None:
+        self._map.pop(key if key is not None else tx_key(tx), None)
 
     def __contains__(self, tx: bytes) -> bool:
         return tx_key(tx) in self._map
@@ -177,9 +179,13 @@ class Mempool:
             perr = self._pre_check(tx)
             if perr is not None:
                 raise ErrPreCheck(perr)
-        if not self._cache.push(tx):
+        # hash ONCE per CheckTx and thread the key through: the admission
+        # path previously recomputed tx_key up to four times per tx
+        # (cache push, in-pool lookup, pool insert, log line)
+        key = tx_key(tx)
+        if not self._cache.push(tx, key):
             # record extra sender for an in-pool tx (reference :259-266)
-            entry = self._txs.get(tx_key(tx))
+            entry = self._txs.get(key)
             if entry is not None and sender:
                 entry.senders.add(sender)
             raise ErrTxInCache()
@@ -187,26 +193,27 @@ class Mempool:
         try:
             res = await self._app.check_tx_sync(abci.RequestCheckTx(tx=tx))
         except Exception:
-            self._cache.remove(tx)
+            self._cache.remove(tx, key)
             raise
-        await self._res_cb_first_time(tx, sender, res)
+        await self._res_cb_first_time(tx, key, sender, res)
         return res
 
     async def _res_cb_first_time(
-        self, tx: bytes, sender: str, res: abci.ResponseCheckTx
+        self, tx: bytes, key: bytes, sender: str, res: abci.ResponseCheckTx
     ) -> None:
-        """reference resCbFirstTime :366."""
+        """reference resCbFirstTime :366. `key` is tx_key(tx), computed
+        once by check_tx."""
         post_err = self._post_check(tx, res) if self._post_check else None
         if res.is_ok() and post_err is None:
             err = self.is_full(len(tx))
             if err is not None:
-                self._cache.remove(tx)
+                self._cache.remove(tx, key)
                 raise err
             self._seq += 1
             entry = _MempoolTx(tx, self._height, res.gas_wanted, self._seq)
             if sender:
                 entry.senders.add(sender)
-            self._txs[tx_key(tx)] = entry
+            self._txs[key] = entry
             self._txs_bytes += len(tx)
             if self._wal is not None:
                 import base64
@@ -214,7 +221,7 @@ class Mempool:
                 self._wal.write(base64.b64encode(tx) + b"\n")
                 self._wal.flush()
             self.logger.debug(
-                "added good transaction", tx=tx_key(tx).hex()[:12], pool=len(self._txs)
+                "added good transaction", tx=key.hex()[:12], pool=len(self._txs)
             )
             self._notify_txs_available()
             async with self._new_tx:
@@ -222,10 +229,10 @@ class Mempool:
         else:
             # ignore bad transaction; allow resubmission (reference :399)
             self.logger.debug(
-                "rejected bad transaction", tx=tx_key(tx).hex()[:12], code=res.code,
+                "rejected bad transaction", tx=key.hex()[:12], code=res.code,
                 post_check_err=str(post_err) if post_err else "",
             )
-            self._cache.remove(tx)
+            self._cache.remove(tx, key)
 
     def _notify_txs_available(self) -> None:
         if self._txs_available is not None and not self._notified_txs_available:
@@ -304,13 +311,14 @@ class Mempool:
 
         for tx, res in zip(txs, deliver_tx_responses):
             tx = bytes(tx)
+            key = tx_key(tx)
             if res.is_ok():
                 # committed: keep in cache to reject future resubmission
-                self._cache.push(tx)
+                self._cache.push(tx, key)
             else:
                 # invalid on-chain: allow resubmission later
-                self._cache.remove(tx)
-            entry = self._txs.pop(tx_key(tx), None)
+                self._cache.remove(tx, key)
+            entry = self._txs.pop(key, None)
             if entry is not None:
                 self._txs_bytes -= len(entry.tx)
 
@@ -339,7 +347,7 @@ class Mempool:
                 k = tx_key(entry.tx)
                 if self._txs.pop(k, None) is not None:
                     self._txs_bytes -= len(entry.tx)
-                self._cache.remove(entry.tx)
+                self._cache.remove(entry.tx, k)
 
     async def flush(self) -> None:
         """Drop everything (reference Flush :434; RPC unsafe_flush_mempool)."""

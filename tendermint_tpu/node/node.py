@@ -132,6 +132,27 @@ class Node(Service):
             mesh_devices=0 if mesh is None else mesh.devices.size,
         )
 
+        # -- device merkle engine (crypto/merkle.py seam) --------------------
+        # Tx roots / part-set roots / validator-set hashes with at least
+        # merkle_device_threshold leaves batch onto the accelerator;
+        # non-blocking like the verifier — a cold size-bucket hashes on
+        # host while its dispatch chain compiles in the background.
+        from tendermint_tpu.crypto import merkle as _merkle
+
+        # TM_MERKLE_DEVICE=0/1 is the ops kill switch (mirrors
+        # TM_CRYPTO_PROVIDER): it overrides config without editing toml.
+        _env_merkle = os.environ.get("TM_MERKLE_DEVICE")
+        # effective state is remembered so the boot-time warmup gate
+        # agrees with the kill switch, not just with config.toml
+        self._merkle_enabled = (
+            config.base.merkle_device if _env_merkle is None else _env_merkle == "1"
+        )
+        _merkle.configure_device(
+            enabled=self._merkle_enabled,
+            threshold=config.base.merkle_device_threshold,
+            block_on_compile=False,
+        )
+
         # -- storage -------------------------------------------------------
         self.block_store = BlockStore(make_db("blockstore", config))
         self.state_store = StateStore(make_db("state", config))
@@ -226,7 +247,7 @@ class Node(Service):
             StateMetrics,
         )
 
-        from tendermint_tpu.utils.metrics import CryptoMetrics
+        from tendermint_tpu.utils.metrics import CryptoMetrics, MerkleMetrics
 
         self.metrics_registry = Registry()
         ns = config.instrumentation.namespace
@@ -235,6 +256,7 @@ class Node(Service):
         self.mempool_metrics = MempoolMetrics(self.metrics_registry, ns)
         self.state_metrics = StateMetrics(self.metrics_registry, ns)
         self.crypto_metrics = CryptoMetrics(self.metrics_registry, ns)
+        self.merkle_metrics = MerkleMetrics(self.metrics_registry, ns)
         self._block_exec_metrics_attach()
         self.metrics_server = None
         if config.instrumentation.prometheus:
@@ -327,6 +349,15 @@ class Node(Service):
             key, all_pk, ed = self._state_at_boot.validators.batch_cache()
             if bool(ed.all()) and len(all_pk):
                 self.crypto_provider.register_valset(key, all_pk)
+        # Warm the merkle engine's bucket for THIS chain's validator-set
+        # hash only when the set is big enough to ever ride the device —
+        # small chains (and test rigs) never pay a merkle compile.
+        if self._merkle_enabled:
+            n_vals = self._state_at_boot.validators.size()
+            if n_vals >= self.config.base.merkle_device_threshold:
+                from tendermint_tpu.crypto import merkle as _merkle
+
+                _merkle.hasher_warmup(sizes=(n_vals,), background=True)
 
         if isinstance(self.priv_validator, SignerClient):
             # remote signer: listen and wait for it to dial in
@@ -487,6 +518,9 @@ class Node(Service):
             stats = getattr(self.crypto_provider, "stats", None)
             if stats is not None:
                 self.crypto_metrics.update(stats())
+            from tendermint_tpu.crypto import merkle as _merkle
+
+            self.merkle_metrics.update(_merkle.device_stats())
             await asyncio.sleep(2.0)
 
     def _only_validator_is_us(self, state: State) -> bool:

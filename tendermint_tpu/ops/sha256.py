@@ -1,0 +1,376 @@
+"""Batched SHA-256 kernels for the device merkle engine.
+
+Mirrors the ops/sha512.py message-schedule style (statically unrolled
+rounds over uint32 words; SHA-256 is natively 32-bit so no (hi, lo)
+pairing is needed), but the graph SHAPE is driven by an XLA:CPU fusion
+discipline the merkle workload forced into the open:
+
+- ONE compression per compiled graph. Chaining two 64-round compress
+  instances in a single jit graph pushes XLA past its fusion budget and
+  both compile time (~40s -> minutes) and runtime (2ms -> 120ms+ at 10k
+  rows) collapse. The tree is therefore reduced DISPATCH-BY-DISPATCH
+  from Python (models/hasher.py), each dispatch one compress.
+- ONE logical output per graph, behind an optimization_barrier. XLA
+  re-materializes the whole 1800-op compress DAG once per fusion root:
+  a (N,) single-word output runs ~1.9ms at 10k rows where the same
+  graph serialized to (N, 32) digest bytes (32 roots) runs ~70ms. Hash
+  state therefore travels BETWEEN dispatches as one stacked (8, N)
+  uint32 array — big-endian words, exactly the digest — and bytes are
+  only materialized host-side (state_to_digests).
+- Inner-node messages are built in WORD space (merkle_inner_first):
+  an inner node hashes 0x01 || left || right (65 bytes, 2 blocks), and
+  both children arrive as (8, half) word columns, so w0..w15 of block
+  one are shifts/ors of child words — no byte round-trip. Block two is
+  all padding except its first byte (right child's last byte), so its
+  schedule constant-folds at trace time around that single varying
+  word (merkle_inner_tail).
+
+Used by models/hasher.py for block data hashes, tx roots, part-set
+roots, validator-set hashes and evidence hashes above the
+merkle_device_threshold (crypto/merkle.py).
+"""
+
+from __future__ import annotations
+
+from typing import List, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+U32 = jnp.uint32
+
+_K = [
+    0x428A2F98, 0x71374491, 0xB5C0FBCF, 0xE9B5DBA5,
+    0x3956C25B, 0x59F111F1, 0x923F82A4, 0xAB1C5ED5,
+    0xD807AA98, 0x12835B01, 0x243185BE, 0x550C7DC3,
+    0x72BE5D74, 0x80DEB1FE, 0x9BDC06A7, 0xC19BF174,
+    0xE49B69C1, 0xEFBE4786, 0x0FC19DC6, 0x240CA1CC,
+    0x2DE92C6F, 0x4A7484AA, 0x5CB0A9DC, 0x76F988DA,
+    0x983E5152, 0xA831C66D, 0xB00327C8, 0xBF597FC7,
+    0xC6E00BF3, 0xD5A79147, 0x06CA6351, 0x14292967,
+    0x27B70A85, 0x2E1B2138, 0x4D2C6DFC, 0x53380D13,
+    0x650A7354, 0x766A0ABB, 0x81C2C92E, 0x92722C85,
+    0xA2BFE8A1, 0xA81A664B, 0xC24B8B70, 0xC76C51A3,
+    0xD192E819, 0xD6990624, 0xF40E3585, 0x106AA070,
+    0x19A4C116, 0x1E376C08, 0x2748774C, 0x34B0BCB5,
+    0x391C0CB3, 0x4ED8AA4A, 0x5B9CCA4F, 0x682E6FF3,
+    0x748F82EE, 0x78A5636F, 0x84C87814, 0x8CC70208,
+    0x90BEFFFA, 0xA4506CEB, 0xBEF9A3F7, 0xC67178F2,
+]
+
+_H0 = [
+    0x6A09E667, 0xBB67AE85, 0x3C6EF372, 0xA54FF53A,
+    0x510E527F, 0x9B05688C, 0x1F83D9AB, 0x5BE0CD19,
+]
+
+def _ror(x, n: int):
+    return (x >> n) | (x << (32 - n))
+
+
+def _round(st, wt, kt: int):
+    """One SHA-256 round; ch uses the 3-op form g ^ (e & (f ^ g))."""
+    a, b, c, d, e, f, g, h = st
+    s1 = _ror(e, 6) ^ _ror(e, 11) ^ _ror(e, 25)
+    ch = g ^ (e & (f ^ g))
+    t1 = h + s1 + ch + jnp.uint32(kt) + wt
+    s0 = _ror(a, 2) ^ _ror(a, 13) ^ _ror(a, 22)
+    maj = (a & b) ^ (a & c) ^ (b & c)
+    return (t1 + s0 + maj, a, b, c, d + t1, e, f, g)
+
+
+def _round_const(st, kw: int):
+    """_round with the schedule word pre-folded into the constant."""
+    a, b, c, d, e, f, g, h = st
+    s1 = _ror(e, 6) ^ _ror(e, 11) ^ _ror(e, 25)
+    ch = g ^ (e & (f ^ g))
+    t1 = h + s1 + ch + jnp.uint32(kw)
+    s0 = _ror(a, 2) ^ _ror(a, 13) ^ _ror(a, 22)
+    maj = (a & b) ^ (a & c) ^ (b & c)
+    return (t1 + s0 + maj, a, b, c, d + t1, e, f, g)
+
+
+def _compress(st, w16):
+    """One block: st 8-tuple of (N,) u32; w16 list of 16 (N,) u32 words.
+    Rounds AND message schedule statically unrolled — on XLA:CPU a
+    lax.scan boundary costs ~6x runtime (the scan carry becomes a
+    multi-root fusion, see module docstring)."""
+    wl = list(w16)
+    s_in = st
+    for t in range(64):
+        if t < 16:
+            wt = wl[t]
+        else:
+            j = t % 16
+            x1 = wl[(j + 1) % 16]
+            x14 = wl[(j + 14) % 16]
+            s0 = _ror(x1, 7) ^ _ror(x1, 18) ^ (x1 >> 3)
+            s1 = _ror(x14, 17) ^ _ror(x14, 19) ^ (x14 >> 10)
+            wt = wl[j] + s0 + wl[(j + 9) % 16] + s1
+            wl[j] = wt
+        st = _round(st, wt, _K[t])
+    return tuple(o + n for o, n in zip(s_in, st))
+
+
+def _words_from_bytes(blk):
+    """(N, 64) u8 byte values -> 16 (N,) u32 big-endian words."""
+    b = blk.astype(U32).reshape(blk.shape[0], 16, 4)
+    w = (b[:, :, 0] << 24) | (b[:, :, 1] << 16) | (b[:, :, 2] << 8) | b[:, :, 3]
+    return [w[:, i] for i in range(16)]
+
+
+def _stack_state(st) -> jnp.ndarray:
+    """8-tuple -> (8, N) behind a barrier: without it XLA re-derives the
+    full compress once per output row (the multi-root duplication)."""
+    return jnp.stack(jax.lax.optimization_barrier(tuple(st)), axis=0)
+
+
+# -- leaf hashing -----------------------------------------------------------
+
+
+def leaf_block_state(blk: jnp.ndarray) -> jnp.ndarray:
+    """First (or only) message block of every leaf: (N, 64) u8 pre-padded
+    block bytes -> (8, N) u32 state. Rows are independent leaves; the
+    block must already carry the 0x00 leaf prefix and, for single-block
+    leaves, the 0x80 terminator + bit length (models/hasher.py packs)."""
+    st = tuple(jnp.full((blk.shape[0],), h, dtype=U32) for h in _H0)
+    return _stack_state(_compress(st, _words_from_bytes(blk)))
+
+
+def leaf_block_update(state: jnp.ndarray, blk: jnp.ndarray, active: jnp.ndarray) -> jnp.ndarray:
+    """Fold one more block into multi-block leaves: state (8, N) u32,
+    blk (N, 64) u8, active (N,) bool (False rows — leaves already fully
+    consumed — keep their state)."""
+    st = tuple(state[i] for i in range(8))
+    new = _compress(st, _words_from_bytes(blk))
+    return _stack_state(
+        tuple(jnp.where(active, n, o) for o, n in zip(st, new))
+    )
+
+
+# -- inner levels -----------------------------------------------------------
+#
+# Inner node = sha256(0x01 || left(32) || right(32)): 65 bytes, two
+# blocks. Block one is bytes 0..63 (prefix, left, right[0:31]); block
+# two is right[31] || 0x80 || zeros || len(520 bits) — constant except
+# its first byte.
+
+
+def merkle_inner_first(level: jnp.ndarray) -> jnp.ndarray:
+    """Block one of all sibling pairs of a level: level (8, C) u32 word
+    columns (C even or odd; an odd last column is a promoted node the
+    tail step re-appends) -> (8, C//2) u32 mid-state."""
+    half = level.shape[1] // 2
+    lw = [level[i, 0 : 2 * half : 2] for i in range(8)]   # left child words
+    rw = [level[i, 1 : 2 * half : 2] for i in range(8)]   # right child words
+    w = [jnp.uint32(0x01000000) | (lw[0] >> 8)]
+    for k in range(1, 8):
+        w.append((lw[k - 1] << 24) | (lw[k] >> 8))
+    w.append((lw[7] << 24) | (rw[0] >> 8))
+    for k in range(1, 8):
+        w.append((rw[k - 1] << 24) | (rw[k] >> 8))
+    st = tuple(jnp.full((half,), h, dtype=U32) for h in _H0)
+    return _stack_state(_compress(st, w))
+
+
+def _inner_tail_words(r_last) -> list:
+    """Block-two schedule with w0 = right[31] || 0x80 || 0 || 0 the only
+    varying word: entries stay python ints wherever both operands are
+    constant, so most of the 48-step expansion folds at trace time."""
+    w: List[Union[int, jnp.ndarray]] = [
+        (r_last << 24) | jnp.uint32(0x00800000)
+    ]
+    w += [0] * 14
+    w.append(65 * 8)  # bit length of the 65-byte message
+    for t in range(16, 64):
+
+        def sig0(x):
+            if isinstance(x, int):
+                return (
+                    (((x >> 7) | (x << 25)) ^ ((x >> 18) | (x << 14)) ^ (x >> 3))
+                    & 0xFFFFFFFF
+                )
+            return _ror(x, 7) ^ _ror(x, 18) ^ (x >> 3)
+
+        def sig1(x):
+            if isinstance(x, int):
+                return (
+                    (((x >> 17) | (x << 15)) ^ ((x >> 19) | (x << 13)) ^ (x >> 10))
+                    & 0xFFFFFFFF
+                )
+            return _ror(x, 17) ^ _ror(x, 19) ^ (x >> 10)
+
+        parts = [w[t - 16], sig0(w[t - 15]), w[t - 7], sig1(w[t - 2])]
+        if all(isinstance(p, int) for p in parts):
+            w.append(sum(parts) & 0xFFFFFFFF)
+        else:
+            acc = None
+            const = 0
+            for p in parts:
+                if isinstance(p, int):
+                    const = (const + p) & 0xFFFFFFFF
+                else:
+                    acc = p if acc is None else acc + p
+            w.append(acc + jnp.uint32(const) if const else acc)
+    return w
+
+
+def merkle_inner_tail(mid: jnp.ndarray, level: jnp.ndarray, m) -> jnp.ndarray:
+    """Finish the inner hashes and build the next level.
+
+    mid (8, half) u32 from merkle_inner_first; level (8, C) the current
+    level's word columns; m () int32 — the level's LOGICAL node count
+    (<= C; columns past it are padding junk). Output (8, ceil(C/2)):
+    column i is the pair hash when 2i+1 < m, the PROMOTED left child
+    when 2i == m-1 (odd count, reference getSplitPoint recursion — the
+    lone node rides up unchanged), junk otherwise."""
+    half = level.shape[1] // 2
+    r_last = level[7, 1 : 2 * half : 2] & jnp.uint32(0xFF)
+    st_in = tuple(mid[i] for i in range(8))
+    st = st_in
+    w = _inner_tail_words(r_last)
+    for t in range(64):
+        wt = w[t]
+        if isinstance(wt, int):
+            # fold the constant schedule word into the round constant
+            st = _round_const(st, (_K[t] + wt) & 0xFFFFFFFF)
+        else:
+            st = _round(st, wt, _K[t])
+    pair = tuple(o + n for o, n in zip(st_in, st))
+    idx = jnp.arange(half, dtype=jnp.int32)
+    has_right = (2 * idx + 1) < m
+    out = tuple(
+        jnp.where(has_right, p, level[i, 0 : 2 * half : 2])
+        for i, p in enumerate(pair)
+    )
+    out = _stack_state(out)
+    if level.shape[1] % 2:
+        # odd STATIC width: the last column can only pair with padding,
+        # so it is carried; when the logical count is smaller and odd,
+        # the promoted node lives inside the pairs region and the
+        # has_right select above already carried it.
+        out = jnp.concatenate([out, level[:, -1:]], axis=1)
+    return out
+
+
+# -- host-side helpers ------------------------------------------------------
+
+
+def state_to_digests(state: np.ndarray) -> np.ndarray:
+    """(8, N) u32 state words -> (N, 32) u8 big-endian digests (pure
+    numpy; digests only materialize host-side by design)."""
+    st = np.asarray(state, dtype=np.uint32)
+    return (
+        st.byteswap()
+        .view(np.uint8)
+        .reshape(8, st.shape[1], 4)
+        .transpose(1, 0, 2)
+        .reshape(st.shape[1], 32)
+    )
+
+
+def digests_to_state(digests: np.ndarray) -> np.ndarray:
+    """(N, 32) u8 -> (8, N) u32 big-endian words (inverse of
+    state_to_digests; used to feed host-computed levels back)."""
+    d = np.ascontiguousarray(np.asarray(digests, dtype=np.uint8))
+    return (
+        d.reshape(d.shape[0], 8, 4)
+        .transpose(1, 0, 2)
+        .reshape(8, d.shape[0] * 4)
+        .view(np.uint32)
+        .byteswap()
+        .reshape(8, d.shape[0])
+    )
+
+
+def pack_leaf_blocks(
+    items: Sequence[bytes], n_pad: int, n_blocks: int
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Pack leaves into fully padded SHA-256 message blocks, host-side
+    and vectorized: (n_pad, n_blocks, 64) u8 blocks + (n_pad,) int32
+    per-row block counts. Each row is 0x00-leaf-prefix || leaf || 0x80
+    || zeros || 64-bit big-endian bit length — the kernel never touches
+    padding logic. Pad rows (>= len(items)) get count 0 and all-zero
+    blocks; their junk digests are never selected (merkle_inner_tail
+    masks on the logical count)."""
+    n = len(items)
+    lens = np.fromiter((len(x) for x in items), dtype=np.int64, count=n)
+    row = n_blocks * 64
+    flat = np.zeros(n_pad * row, dtype=np.uint8)
+    counts = np.zeros(n_pad, dtype=np.int32)
+    if not n:
+        return flat.reshape(n_pad, n_blocks, 64), counts
+    if int(lens.min()) == int(lens.max()):
+        # uniform leaves (tx-hash / part-split shape): one reshape-copy
+        # and constant padding — ~4x cheaper than the ragged scatter
+        length = int(lens[0])
+        buf = flat.reshape(n_pad, row)
+        if length:
+            buf[:n, 1 : 1 + length] = np.frombuffer(
+                b"".join(items), dtype=np.uint8
+            ).reshape(n, length)
+        buf[:n, 1 + length] = 0x80
+        nbi = (length + 73) // 64
+        bits = (length + 1) * 8
+        buf[:n, nbi * 64 - 8 : nbi * 64] = np.frombuffer(
+            bits.to_bytes(8, "big"), dtype=np.uint8
+        )
+        counts[:n] = nbi
+        return flat.reshape(n_pad, n_blocks, 64), counts
+    total = int(lens.sum())
+    src = np.frombuffer(b"".join(items), dtype=np.uint8)
+    row_base = np.arange(n, dtype=np.int64) * row + 1  # +1: leaf prefix 0x00
+    if total:
+        offs = np.zeros(n, dtype=np.int64)
+        np.cumsum(lens[:-1], out=offs[1:])
+        dst = np.repeat(row_base - offs, lens) + np.arange(total, dtype=np.int64)
+        flat[dst] = src
+    flat[row_base + lens] = 0x80
+    nb = (lens + 73) // 64  # 1 prefix + 1 terminator + 8 length bytes
+    bits = (lens + 1) * 8
+    tail = np.arange(n, dtype=np.int64) * row + nb * 64
+    for k in range(8):
+        flat[tail - 1 - k] = (bits >> (8 * k)) & 0xFF
+    counts[:n] = nb
+    return flat.reshape(n_pad, n_blocks, 64), counts
+
+
+def leaf_blocks_needed(max_len: int) -> int:
+    """Blocks for the longest leaf (prefix + terminator + length)."""
+    return int((max_len + 73) // 64)
+
+
+# -- generic fixed-length batch (sha512-style API) --------------------------
+
+
+def sha256(msgs: jnp.ndarray) -> jnp.ndarray:
+    """Batched SHA-256 of uniform-length messages: (N, L) u8/int32 byte
+    values -> (N, 32) int32 digest bytes. L is static; padding is
+    computed at trace time (mirror of ops/sha512.sha256's contract).
+    Fine under vmap/jit for L <= 55 (one block); multi-block inputs
+    chain compress instances in one graph, which is correct everywhere
+    but slow on XLA:CPU — the merkle engine uses the staged kernels
+    above instead."""
+    n, length = msgs.shape
+    m = msgs.astype(U32)
+    total = length + 1 + 8
+    blocks = (total + 63) // 64
+    padded = blocks * 64
+    pad = np.zeros(padded - length, dtype=np.uint32)
+    pad[0] = 0x80
+    bitlen = length * 8
+    for i in range(8):
+        pad[-1 - i] = (bitlen >> (8 * i)) & 0xFF
+    m = jnp.concatenate(
+        [m, jnp.broadcast_to(jnp.asarray(pad), (n, pad.shape[0]))], axis=1
+    )
+    st = tuple(jnp.full((n,), h, dtype=U32) for h in _H0)
+    for b in range(blocks):
+        st = _compress(st, _words_from_bytes(m[:, b * 64 : (b + 1) * 64]))
+    st = jax.lax.optimization_barrier(tuple(st))
+    outs = []
+    for word in st:
+        outs.extend(
+            [(word >> 24) & 0xFF, (word >> 16) & 0xFF, (word >> 8) & 0xFF, word & 0xFF]
+        )
+    return jnp.stack(outs, axis=-1).astype(jnp.int32)

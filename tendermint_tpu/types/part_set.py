@@ -79,6 +79,10 @@ class PartSet:
     def from_data(cls, data: bytes, part_size: int = BLOCK_PART_SIZE) -> "PartSet":
         total = max(1, (len(data) + part_size - 1) // part_size)
         chunks = [data[i * part_size : (i + 1) * part_size] for i in range(total)]
+        # one tree pass yields root AND all part proofs/aunts; above the
+        # merkle_device_threshold this is the batched device engine
+        # (crypto/merkle.py), which hashes every part in one dispatch
+        # chain and extracts the aunt paths positionally
         root, proofs = merkle.proofs_from_byte_slices(chunks)
         ps = cls(PartSetHeader(total=total, hash=root))
         for i, chunk in enumerate(chunks):

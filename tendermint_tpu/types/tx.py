@@ -4,7 +4,7 @@ Txs.Proof :61 region).
 
 from __future__ import annotations
 
-from typing import List, Optional
+from typing import List, Optional, Tuple
 
 from tendermint_tpu.crypto import merkle
 from tendermint_tpu.crypto.hash import sha256
@@ -13,20 +13,88 @@ Tx = bytes
 
 
 class Txs(list):
-    """List of raw txs with merkle hashing."""
+    """List of raw txs with merkle hashing.
+
+    The tx set of a proposed block is immutable once built (the
+    reference reaps ONE list per proposal and never mutates it), so the
+    raw-bytes leaves, the merkle root, and the per-tx proofs are all
+    computed once and cached on the instance — proof() previously
+    rebuilt the ENTIRE tree per call, which made serving N tx proofs
+    O(N^2) hashing. Caches invalidate on length change AND on every
+    overridden in-place mutator below, so a same-length mutation
+    (txs[i] = ..., sort, reverse) can never serve a stale root."""
+
+    _leaves_cache: Optional[Tuple[int, List[bytes]]] = None
+    _root_cache: Optional[Tuple[int, bytes]] = None
+    _proofs_cache: Optional[Tuple[int, bytes, list]] = None
+
+    def _invalidate(self) -> None:
+        self._leaves_cache = None
+        self._root_cache = None
+        self._proofs_cache = None
+
+    def __setitem__(self, *a):
+        self._invalidate()
+        return super().__setitem__(*a)
+
+    def __delitem__(self, *a):
+        self._invalidate()
+        return super().__delitem__(*a)
+
+    def sort(self, *a, **kw):
+        self._invalidate()
+        return super().sort(*a, **kw)
+
+    def reverse(self):
+        self._invalidate()
+        return super().reverse()
+
+    def insert(self, *a):
+        self._invalidate()
+        return super().insert(*a)
+
+    def pop(self, *a):
+        self._invalidate()
+        return super().pop(*a)
+
+    def remove(self, *a):
+        self._invalidate()
+        return super().remove(*a)
+
+    def _leaves(self) -> List[bytes]:
+        cached = self._leaves_cache
+        if cached is not None and cached[0] == len(self):
+            return cached[1]
+        leaves = [bytes(tx) for tx in self]
+        self._leaves_cache = (len(self), leaves)
+        return leaves
 
     def hash(self) -> bytes:
-        return merkle.hash_from_byte_slices([bytes(tx) for tx in self])
+        cached = self._root_cache
+        if cached is not None and cached[0] == len(self):
+            return cached[1]
+        proofs = self._proofs_cache
+        if proofs is not None and proofs[0] == len(self):
+            root = proofs[1]
+        else:
+            root = merkle.hash_from_byte_slices(self._leaves())
+        self._root_cache = (len(self), root)
+        return root
 
     def index(self, tx: Tx) -> int:
-        for i, t in enumerate(self):
-            if bytes(t) == bytes(tx):
+        target = bytes(tx)
+        for i, t in enumerate(self._leaves()):
+            if t == target:
                 return i
         return -1
 
     def proof(self, i: int):
-        root, proofs = merkle.proofs_from_byte_slices([bytes(tx) for tx in self])
-        return TxProof(root_hash=root, data=bytes(self[i]), proof=proofs[i])
+        cached = self._proofs_cache
+        if cached is None or cached[0] != len(self):
+            root, proofs = merkle.proofs_from_byte_slices(self._leaves())
+            cached = self._proofs_cache = (len(self), root, proofs)
+            self._root_cache = (len(self), root)
+        return TxProof(root_hash=cached[1], data=bytes(self[i]), proof=cached[2][i])
 
 
 def tx_hash(tx: Tx) -> bytes:

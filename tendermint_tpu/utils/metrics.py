@@ -186,6 +186,38 @@ class CryptoMetrics:
         self.dedupe_cache_size.set(stats.get("cache_size", 0))
 
 
+class MerkleMetrics:
+    """Device merkle engine counters (crypto/merkle.py device_stats():
+    the batched SHA-256 engine behind tx/part-set/validator-set
+    hashing, models/hasher.py). Monotonic counts are exported as gauges
+    SET from the engine's own counters each pump, like CryptoMetrics.
+    See docs/merkle-acceleration.md."""
+
+    def __init__(self, registry: Optional[Registry] = None, namespace="tendermint"):
+        r = registry or Registry()
+        sub = "merkle"
+        reg = r.register
+        self.device_enabled = reg(Gauge("device_enabled", "1 when the device merkle engine is configured on.", namespace, sub))
+        self.device_roots = reg(Gauge("device_roots_total", "Merkle roots computed on the device engine.", namespace, sub))
+        self.device_proof_sets = reg(Gauge("device_proof_sets_total", "Full proof sets (root + aunts) computed on the device engine.", namespace, sub))
+        self.device_leaves = reg(Gauge("device_leaves_total", "Leaves hashed by the device engine.", namespace, sub))
+        self.host_roots = reg(Gauge("host_roots_total", "Merkle roots computed on the host path (below threshold or fallback).", namespace, sub))
+        self.host_proof_sets = reg(Gauge("host_proof_sets_total", "Proof sets computed on the host path.", namespace, sub))
+        self.fallback_cold = reg(Gauge("fallback_cold_total", "Qualifying trees served on host while a device bucket compiled.", namespace, sub))
+        self.fallback_shape = reg(Gauge("fallback_shape_total", "Qualifying trees outside the device size caps (leaf count/bytes).", namespace, sub))
+
+    def update(self, stats: dict) -> None:
+        """Copy a crypto.merkle.device_stats() snapshot into the gauges."""
+        self.device_enabled.set(stats.get("device_enabled", 0))
+        self.device_roots.set(stats.get("device_roots", 0))
+        self.device_proof_sets.set(stats.get("device_proof_sets", 0))
+        self.device_leaves.set(stats.get("device_leaves", 0))
+        self.host_roots.set(stats.get("host_roots", 0))
+        self.host_proof_sets.set(stats.get("host_proof_sets", 0))
+        self.fallback_cold.set(stats.get("fallback_cold", 0))
+        self.fallback_shape.set(stats.get("fallback_shape", 0))
+
+
 class StateMetrics:
     def __init__(self, registry: Optional[Registry] = None, namespace="tendermint"):
         r = registry or Registry()

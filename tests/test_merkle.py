@@ -58,3 +58,33 @@ def test_split_point():
     assert merkle._split_point(5) == 4
     assert merkle._split_point(8) == 4
     assert merkle._split_point(9) == 8
+
+
+def _recursive_root(items):
+    """The original simple_tree.go recursion, kept as the test oracle
+    for the iterative rewrite (pair-adjacent + promote-odd-last must
+    produce the identical split-point tree for every n)."""
+    n = len(items)
+    if n == 0:
+        return hashlib.sha256(b"").digest()
+    if n == 1:
+        return merkle.leaf_hash(items[0])
+    k = merkle._split_point(n)
+    return merkle.inner_hash(_recursive_root(items[:k]), _recursive_root(items[k:]))
+
+
+@pytest.mark.parametrize("n", [2, 3, 5, 6, 7, 11, 12, 13, 31, 32, 33, 100, 255, 513])
+def test_iterative_root_matches_recursive(n):
+    items = [f"leaf-{i}".encode() * (i % 5 + 1) for i in range(n)]
+    assert merkle.hash_from_byte_slices(items) == _recursive_root(items)
+
+
+def test_iterative_trails_match_recursive_shape():
+    """Aunt paths from the iterative trail builder reconstruct the
+    recursive tree: every proof recomputes to the recursive root."""
+    for n in (3, 5, 9, 21, 64, 100):
+        items = [f"x{i}".encode() for i in range(n)]
+        root, proofs = merkle.proofs_from_byte_slices(items)
+        assert root == _recursive_root(items)
+        for i, p in enumerate(proofs):
+            assert p.compute_root() == root, (n, i)
