@@ -347,6 +347,7 @@ def run_bench(platform: str, accelerator: bool = True):
             note="accelerator unavailable; measured the node's host fallback path",
             **replay_bench(cpu),
             **merkle_bench(),
+            **trace_overhead_bench(),
             **_last_tpu_extra(),
         )
         _deadline_done()
@@ -563,6 +564,9 @@ def run_bench(platform: str, accelerator: bool = True):
     # -- merkle engine: device vs host root + part-set split --------------
     merkle_extra = merkle_bench()
 
+    # -- flight recorder: overhead + per-stage breakdown ------------------
+    trace_extra = trace_overhead_bench()
+
     # -- AOT cold start: fresh process, warm AOT cache --------------------
     # VERDICT round 2 #2: a restarting validator must reach its first
     # device-verified commit in seconds, not a ~20s recompile window.
@@ -636,6 +640,7 @@ def run_bench(platform: str, accelerator: bool = True):
         **tabled,
         **replay_extra,
         **merkle_extra,
+        **trace_extra,
         **aot_extra,
     }
     regressions = _regression_guard(line, platform)
@@ -753,6 +758,99 @@ def merkle_bench() -> dict:
             _m.configure_device(False)
         except Exception:
             pass
+
+
+# -- flight recorder: tracing overhead + per-stage latency breakdown -------
+#
+# The observability contract (docs/tracing.md): span tracing must cost
+# <3% on an instrumented hot path when ENABLED, and ~nothing when
+# disabled. Measured on the host merkle root (an instrumented real
+# consensus stage: ~1 span per call through crypto/merkle.py) plus the
+# pipelined verify dispatch (pipeline.prep/execute/resolve spans per
+# bundle). The per-stage aggregate from the enabled run is the
+# latency-attribution breakdown the BENCH json carries.
+
+TRACE_BENCH_LEAVES = int(os.environ.get("TM_BENCH_TRACE_LEAVES", "768"))
+TRACE_BENCH_ITERS = int(os.environ.get("TM_BENCH_TRACE_ITERS", "40"))
+
+
+def trace_overhead_bench() -> dict:
+    """Returns the trace_* bench keys; never raises (the main line must
+    survive a broken tracer)."""
+    from tendermint_tpu.utils import trace as _tr
+
+    prev_tracer = _tr.get_tracer()
+    try:
+        import numpy as np
+
+        from tendermint_tpu.crypto import merkle
+        from tendermint_tpu.crypto.pipeline import PipelinedVerifier, SigCache
+        from tendermint_tpu.crypto.batch import CPUBatchVerifier
+
+        rng = np.random.RandomState(7)
+        items = [rng.bytes(45) for _ in range(TRACE_BENCH_LEAVES)]
+        merkle.configure_device(False)
+
+        def workload():
+            t0 = time.perf_counter()
+            for _ in range(TRACE_BENCH_ITERS):
+                merkle.hash_from_byte_slices(items)
+            return time.perf_counter() - t0
+
+        # explicit tracer object (set_tracer bypasses the TM_TRACE env
+        # override on purpose: the bench must control both arms).
+        # The arms ALTERNATE and each takes its min: on a shared/busy
+        # host, back-to-back blocks differ by far more than the ~5us
+        # span cost, so a sequential A/B measures scheduler noise.
+        tracer = _tr.set_tracer(_tr.Tracer(enabled=True, buffer_events=1 << 16))
+        workload()  # warm
+        on_times, off_times = [], []
+        for _ in range(8):
+            tracer.enabled = True
+            on_times.append(workload())
+            tracer.enabled = False
+            off_times.append(workload())
+        on_s, off_s = min(on_times), min(off_times)
+        tracer.enabled = True
+
+        # drive the instrumented pipeline so the breakdown includes the
+        # bundle lifecycle stages, not just merkle routing
+        pk, mg, sg = make_batch(256, seed=777)
+        with PipelinedVerifier(CPUBatchVerifier(), cache=SigCache()) as pv:
+            futs = [pv.submit_batch(pk, mg, sg, dedupe=True) for _ in range(4)]
+            for f in futs:
+                assert f.result().all()
+
+        # residual scheduler noise can leave on_s marginally below
+        # off_s; clamp at 0 — "no measurable overhead"
+        overhead_pct = max((on_s - off_s) / off_s * 100, 0.0) if off_s > 0 else None
+        breakdown = tracer.timeline()["stages"]
+        out = {
+            "trace_disabled_ms": round(off_s * 1e3, 2),
+            "trace_enabled_ms": round(on_s * 1e3, 2),
+            "trace_overhead_pct": round(overhead_pct, 2)
+            if overhead_pct is not None
+            else None,
+            "trace_overhead_ok": bool(
+                overhead_pct is not None and overhead_pct < 3.0
+            ),
+            "trace_events_recorded": tracer.stats()["events_recorded"],
+            "trace_stage_breakdown": breakdown,
+        }
+        log(
+            f"trace overhead: disabled {off_s*1e3:.1f} ms, enabled "
+            f"{on_s*1e3:.1f} ms ({out['trace_overhead_pct']}% for "
+            f"{out['trace_events_recorded']} events; "
+            f"{len(breakdown)} stages in breakdown)"
+        )
+        if not out["trace_overhead_ok"]:
+            log("WARNING: tracing overhead exceeds the 3% budget")
+        return out
+    except Exception as ex:
+        log(f"trace overhead measurement failed: {ex!r}")
+        return {"trace_error": repr(ex)[:200]}
+    finally:
+        _tr.set_tracer(prev_tracer)
 
 
 # -- fast-sync replay: pipelined dispatch vs synchronous per-commit --------

@@ -33,6 +33,7 @@ from typing import Callable, Dict, Optional, Tuple
 
 from tendermint_tpu.crypto.batch import get_default_provider
 from tendermint_tpu.types.block import BlockID
+from tendermint_tpu.utils import trace
 
 DEFAULT_VERIFY_DEPTH = 8
 
@@ -99,19 +100,22 @@ class CommitVerifyWindow:
                 # discarded device verify plus a serial re-verify at
                 # every height)
                 del self._inflight[h]
-            parts = first.make_part_set()
-            bid = BlockID(hash=first.hash(), parts=parts.header())
-            spec = CommitVerifySpec(
-                validators, chain_id, bid, first.header.height, second.last_commit
-            )
-            self._inflight[h] = {
-                "first": first,
-                "second": second,
-                "parts": parts,
-                "bid": bid,
-                "valset": validators,
-                "future": submit(spec),
-            }
+            with trace.span(
+                "verify_window.submit", height=h, inflight=len(self._inflight)
+            ):
+                parts = first.make_part_set()
+                bid = BlockID(hash=first.hash(), parts=parts.header())
+                spec = CommitVerifySpec(
+                    validators, chain_id, bid, first.header.height, second.last_commit
+                )
+                self._inflight[h] = {
+                    "first": first,
+                    "second": second,
+                    "parts": parts,
+                    "bid": bid,
+                    "valset": validators,
+                    "future": submit(spec),
+                }
 
     def take(self, height: int, first, second, validators) -> Optional[dict]:
         """The in-flight entry for ``height`` iff it is still valid for
@@ -138,18 +142,20 @@ class CommitVerifyWindow:
         height = first.header.height
         ent = self.take(height, first, second, validators)
         if ent is not None:
+            with trace.span("verify_window.await", height=height, pipelined=True):
+                try:
+                    err = await asyncio.wrap_future(ent["future"])
+                except Exception as e:
+                    err = e
+            return ent["parts"], ent["bid"], err
+        with trace.span("verify_window.serial_verify", height=height, pipelined=False):
+            parts = first.make_part_set()
+            bid = BlockID(hash=first.hash(), parts=parts.header())
             try:
-                err = await asyncio.wrap_future(ent["future"])
+                validators.verify_commit(chain_id, bid, height, second.last_commit)
+                err = None
             except Exception as e:
                 err = e
-            return ent["parts"], ent["bid"], err
-        parts = first.make_part_set()
-        bid = BlockID(hash=first.hash(), parts=parts.header())
-        try:
-            validators.verify_commit(chain_id, bid, height, second.last_commit)
-            err = None
-        except Exception as e:
-            err = e
         return parts, bid, err
 
     def clear(self) -> None:

@@ -76,6 +76,16 @@ class BaseConfig:
     # host while their dispatch chain compiles in the background.
     merkle_device: bool = True
     merkle_device_threshold: int = 1024
+    # Flight-recorder span tracing (utils/trace.py): consensus step
+    # transitions, pipeline bundle lifecycle, merkle routing, WAL
+    # fsyncs, mempool CheckTx and RPC requests recorded into a bounded
+    # ring buffer, exported via the dump_trace / trace_timeline RPCs as
+    # Chrome trace-event JSON (perfetto). Near-zero cost when disabled
+    # (the default); TM_TRACE=0/1 is the env kill switch overriding
+    # this without editing toml. trace_buffer_events bounds the ring —
+    # the oldest events are evicted (and counted) once it fills.
+    trace_enabled: bool = False
+    trace_buffer_events: int = 65536
 
     def genesis_file(self) -> str:
         return _rootify(self.genesis_file_name, self.root_dir)
@@ -103,6 +113,8 @@ class BaseConfig:
             return "crypto_pipeline_flush_ms can't be negative"
         if self.merkle_device_threshold < 2:
             return "merkle_device_threshold must be >= 2"
+        if self.trace_buffer_events < 1:
+            return "trace_buffer_events must be >= 1"
         return None
 
 

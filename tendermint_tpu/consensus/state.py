@@ -70,7 +70,7 @@ from tendermint_tpu.types.vote_set import (
     ErrVoteUnexpectedStep,
     VoteSet,
 )
-from tendermint_tpu.utils import fail
+from tendermint_tpu.utils import fail, trace
 from tendermint_tpu.utils.events import EventSwitch
 from tendermint_tpu.utils.log import get_logger
 from tendermint_tpu.utils.service import Service
@@ -130,6 +130,37 @@ PEER_PUNISH_ERRORS = (
     ErrPartSetInvalidProof,
     ErrVoteInvalidSignature,
 )
+
+
+class _StepSpan:
+    """Trace span + ``consensus_step_duration_seconds{step=...}``
+    histogram around one step transition. The histogram is fed even
+    with tracing off (it is the cheap always-on summary; the trace is
+    the deep-dive), so timing runs unconditionally."""
+
+    __slots__ = ("_cs", "_step", "_span", "_t0")
+
+    def __init__(self, cs: "ConsensusState", step: str, height: int, round_: int):
+        self._cs = cs
+        self._step = step
+        self._span = trace.span("consensus." + step, height=height, round=round_) \
+            if trace.enabled() else trace.NOOP_SPAN
+
+    def __enter__(self):
+        self._t0 = time.perf_counter()
+        self._span.__enter__()
+        return self._span
+
+    def __exit__(self, *exc) -> bool:
+        self._span.__exit__(*exc)
+        m = self._cs.metrics
+        if m is not None:
+            hist = getattr(m, "step_duration_seconds", None)
+            if hist is not None:
+                hist.with_labels(step=self._step).observe(
+                    time.perf_counter() - self._t0
+                )
+        return False
 
 
 class TimeoutTicker:
@@ -531,6 +562,11 @@ class ConsensusState(Service):
         transitions are identical to one-at-a-time processing because
         the transition functions read only VoteSet aggregates."""
         rs = self.rs
+        with trace.span("consensus.vote_batch", height=rs.height, votes=len(batch)):
+            await self._do_handle_vote_batch(batch)
+
+    async def _do_handle_vote_batch(self, batch) -> None:
+        rs = self.rs
         current: list = []
         other: list = []
         for mi in batch:
@@ -640,6 +676,11 @@ class ConsensusState(Service):
         ):
             self.logger.debug("ignoring timeout for stale H/R/S", ti=repr(ti))
             return
+        if trace.enabled():
+            trace.instant(
+                "consensus.timeout",
+                height=ti.height, round=ti.round, step=step_name(ti.step),
+            )
         if ti.step == STEP_NEW_HEIGHT:
             await self._enter_new_round(ti.height, 0)
         elif ti.step == STEP_NEW_ROUND:
@@ -664,6 +705,12 @@ class ConsensusState(Service):
     # round entry functions
     # ------------------------------------------------------------------
 
+    def _step_span(self, step: str, height: int, round_: int):
+        """Span + per-step latency histogram around one transition.
+        ``step`` is a precomputed literal so the disabled-tracer path
+        never formats a string."""
+        return _StepSpan(self, step, height, round_)
+
     async def _enter_new_round(self, height: int, round_: int) -> None:
         """Reference enterNewRound :815."""
         rs = self.rs
@@ -673,6 +720,23 @@ class ConsensusState(Service):
             return
         self.logger.info("enterNewRound", height=height, round=round_)
 
+        with self._step_span("new_round", height, round_):
+            self._do_enter_new_round(height, round_)
+
+        wait_for_txs = (
+            not self.config.create_empty_blocks and round_ == 0 and not self._need_proof_block(height)
+        )
+        if wait_for_txs:
+            if self.config.create_empty_blocks_interval_ms > 0:
+                self._schedule_timeout(
+                    self.config.create_empty_blocks_interval_ms, height, round_, STEP_NEW_ROUND
+                )
+            # else: wait for handle_txs_available
+        else:
+            await self._enter_propose(height, round_)
+
+    def _do_enter_new_round(self, height: int, round_: int) -> None:
+        rs = self.rs
         validators = rs.validators
         if rs.round < round_:
             validators = validators.copy()
@@ -691,18 +755,6 @@ class ConsensusState(Service):
         if self.event_bus is not None and not self.replay_mode:
             self._publish_soon(self.event_bus.publish_event_new_round(rs))
         self._new_step()
-
-        wait_for_txs = (
-            not self.config.create_empty_blocks and round_ == 0 and not self._need_proof_block(height)
-        )
-        if wait_for_txs:
-            if self.config.create_empty_blocks_interval_ms > 0:
-                self._schedule_timeout(
-                    self.config.create_empty_blocks_interval_ms, height, round_, STEP_NEW_ROUND
-                )
-            # else: wait for handle_txs_available
-        else:
-            await self._enter_propose(height, round_)
 
     def _need_proof_block(self, height: int) -> bool:
         """App hash changed at the last block → must make a block so the
@@ -729,12 +781,13 @@ class ConsensusState(Service):
             self._new_step()
 
         try:
-            if self._priv_validator is not None and self._is_proposer(self._priv_validator_addr):
-                self.logger.info(
-                    "enterPropose: our turn to propose",
-                    proposer=self._priv_validator_addr.hex()[:12],
-                )
-                await self.decide_proposal(height, round_)
+            with self._step_span("propose", height, round_):
+                if self._priv_validator is not None and self._is_proposer(self._priv_validator_addr):
+                    self.logger.info(
+                        "enterPropose: our turn to propose",
+                        proposer=self._priv_validator_addr.hex()[:12],
+                    )
+                    await self.decide_proposal(height, round_)
         finally:
             done()
             # complete proposal may already be in (from gossip or ourselves)
@@ -804,10 +857,11 @@ class ConsensusState(Service):
         ):
             return
         self.logger.debug("enterPrevote", height=height, round=round_)
-        rs.round = round_
-        rs.step = STEP_PREVOTE
-        self._new_step()
-        await self.do_prevote(height, round_)
+        with self._step_span("prevote", height, round_):
+            rs.round = round_
+            rs.step = STEP_PREVOTE
+            self._new_step()
+            await self.do_prevote(height, round_)
 
     async def _default_do_prevote(self, height: int, round_: int) -> None:
         """Reference defaultDoPrevote :1090."""
@@ -857,6 +911,11 @@ class ConsensusState(Service):
         ):
             return
         self.logger.debug("enterPrecommit", height=height, round=round_)
+        with self._step_span("precommit", height, round_):
+            await self._do_enter_precommit(height, round_)
+
+    async def _do_enter_precommit(self, height: int, round_: int) -> None:
+        rs = self.rs
         rs.round = round_
         rs.step = STEP_PRECOMMIT
         self._new_step()
@@ -942,7 +1001,11 @@ class ConsensusState(Service):
         if rs.height != height or STEP_COMMIT <= rs.step:
             return
         self.logger.info("enterCommit", height=height, commit_round=commit_round)
+        with self._step_span("commit", height, commit_round):
+            await self._do_enter_commit(height, commit_round)
 
+    async def _do_enter_commit(self, height: int, commit_round: int) -> None:
+        rs = self.rs
         block_id, ok = rs.votes.precommits(commit_round).two_thirds_majority()
         if not ok or block_id.is_zero():
             raise ConsensusError("enterCommit expects +2/3 precommits for a block")
@@ -992,23 +1055,27 @@ class ConsensusState(Service):
         if block is None or block.hash() != block_id.hash:
             raise ConsensusError("cannot finalize: no/wrong proposal block")
 
-        self._block_exec.validate_block(self.state, block)
-        fail.fail()  # crash point 1: validated, nothing saved
+        with self._step_span("finalize_commit", height, rs.commit_round) as sp:
+            sp.set(txs=len(block.data.txs))
+            self._block_exec.validate_block(self.state, block)
+            fail.fail()  # crash point 1: validated, nothing saved
 
-        if self._block_store.height < block.header.height:
-            seen_commit = rs.votes.precommits(rs.commit_round).make_commit()
-            self._block_store.save_block(block, block_parts, seen_commit)
-        fail.fail()  # crash point 2: block saved, no ENDHEIGHT
+            if self._block_store.height < block.header.height:
+                seen_commit = rs.votes.precommits(rs.commit_round).make_commit()
+                with trace.span("consensus.save_block", height=height):
+                    self._block_store.save_block(block, block_parts, seen_commit)
+            fail.fail()  # crash point 2: block saved, no ENDHEIGHT
 
-        # ENDHEIGHT marks this height fully input-complete (fsync'd).
-        self.wal.write_sync(EndHeightMessage(height))
-        fail.fail()  # crash point 3: ENDHEIGHT written, not applied
+            # ENDHEIGHT marks this height fully input-complete (fsync'd).
+            self.wal.write_sync(EndHeightMessage(height))
+            fail.fail()  # crash point 3: ENDHEIGHT written, not applied
 
-        state_copy = self.state.copy()
-        new_state, retain_height = await self._block_exec.apply_block(
-            state_copy, block_id, block
-        )
-        fail.fail()  # crash point 4: applied + state saved
+            state_copy = self.state.copy()
+            with trace.span("consensus.apply_block", height=height):
+                new_state, retain_height = await self._block_exec.apply_block(
+                    state_copy, block_id, block
+                )
+            fail.fail()  # crash point 4: applied + state saved
 
         if retain_height > 0:
             try:

@@ -19,6 +19,7 @@ import zlib
 from typing import Iterator, Optional, Tuple
 
 from tendermint_tpu.consensus.messages import EndHeightMessage, decode_msg, encode_msg
+from tendermint_tpu.utils import trace
 from tendermint_tpu.utils.log import get_logger
 
 MAX_MSG_SIZE = 1 << 20  # 1MB, reference wal.go maxMsgSizeBytes
@@ -205,15 +206,17 @@ class BaseWAL(WAL):
     def write_sync(self, msg) -> None:
         """Write + flush + fsync before returning (reference WriteSync
         :201) — used for internal messages and ENDHEIGHT."""
-        self.write(msg)
-        self.flush_and_sync()
-        self._maybe_rotate()
+        with trace.span("wal.write_sync", msg=type(msg).__name__):
+            self.write(msg)
+            self.flush_and_sync()
+            self._maybe_rotate()
 
     def flush_and_sync(self) -> None:
         if self._fp is None:
             return
-        self._fp.flush()
-        os.fsync(self._fp.fileno())
+        with trace.span("wal.fsync"):
+            self._fp.flush()
+            os.fsync(self._fp.fileno())
 
     # -- reading -----------------------------------------------------------
 

@@ -15,6 +15,8 @@ import threading
 from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Optional, Sequence
 
+from tendermint_tpu.utils import trace
+
 _LEAF_PREFIX = b"\x00"
 _INNER_PREFIX = b"\x01"
 
@@ -146,22 +148,25 @@ def hash_from_byte_slices(items: Sequence[bytes]) -> bytes:
     if _DEVICE_ENABLED and n >= _DEVICE_THRESHOLD:
         h = _device_hasher()
         if h is not None:
-            try:
-                root = h.root(items)
-            except Exception:
-                root = None  # degrade to host, never raise into hashing
-            if root is not None:
-                return root
-    _HOST_STATS["host_roots"] += 1
-    level = [leaf_hash(it) for it in items]
-    while len(level) > 1:
-        nxt = [
-            inner_hash(level[i], level[i + 1]) for i in range(0, len(level) - 1, 2)
-        ]
-        if len(level) % 2:
-            nxt.append(level[-1])
-        level = nxt
-    return level[0]
+            with trace.span("merkle.root", leaves=n, path="device") as sp:
+                try:
+                    root = h.root(items)
+                except Exception:
+                    root = None  # degrade to host, never raise into hashing
+                if root is not None:
+                    return root
+                sp.set(path="device_declined")  # falling through to host
+    with trace.span("merkle.root", leaves=n, path="host"):
+        _HOST_STATS["host_roots"] += 1
+        level = [leaf_hash(it) for it in items]
+        while len(level) > 1:
+            nxt = [
+                inner_hash(level[i], level[i + 1]) for i in range(0, len(level) - 1, 2)
+            ]
+            if len(level) % 2:
+                nxt.append(level[-1])
+            level = nxt
+        return level[0]
 
 
 @dataclass
@@ -247,32 +252,35 @@ def proofs_from_byte_slices(items: Sequence[bytes]) -> tuple:
     if _DEVICE_ENABLED and n >= _DEVICE_THRESHOLD:
         h = _device_hasher()
         if h is not None:
-            try:
-                out = h.tree(items)
-            except Exception:
-                out = None  # degrade to host, never raise into hashing
-            if out is not None:
-                levels, counts = out
-                root = bytes(levels[-1][0])
-                aunts = _aunts_from_levels(levels, counts)
-                proofs = [
-                    SimpleProof(
-                        total=n, index=i,
-                        leaf_hash=bytes(levels[0][i]), aunts=aunts[i],
-                    )
-                    for i in range(n)
-                ]
-                return root, proofs
-    trails, root_node = _trails_from_byte_slices(list(items))
-    root = root_node.hash
-    proofs = []
-    for i, trail in enumerate(trails):
-        proofs.append(
-            SimpleProof(
-                total=len(items), index=i, leaf_hash=trail.hash, aunts=trail.flatten_aunts()
+            with trace.span("merkle.proof_set", leaves=n, path="device") as sp:
+                try:
+                    out = h.tree(items)
+                except Exception:
+                    out = None  # degrade to host, never raise into hashing
+                if out is not None:
+                    levels, counts = out
+                    root = bytes(levels[-1][0])
+                    aunts = _aunts_from_levels(levels, counts)
+                    proofs = [
+                        SimpleProof(
+                            total=n, index=i,
+                            leaf_hash=bytes(levels[0][i]), aunts=aunts[i],
+                        )
+                        for i in range(n)
+                    ]
+                    return root, proofs
+                sp.set(path="device_declined")
+    with trace.span("merkle.proof_set", leaves=n, path="host"):
+        trails, root_node = _trails_from_byte_slices(list(items))
+        root = root_node.hash
+        proofs = []
+        for i, trail in enumerate(trails):
+            proofs.append(
+                SimpleProof(
+                    total=len(items), index=i, leaf_hash=trail.hash, aunts=trail.flatten_aunts()
+                )
             )
-        )
-    return root, proofs
+        return root, proofs
 
 
 class _Node:

@@ -49,9 +49,12 @@ from collections import OrderedDict, deque
 from concurrent.futures import Future
 from typing import Dict, List, Optional, Sequence, Tuple
 
+import time
+
 import numpy as np
 
 from tendermint_tpu.crypto.batch import BatchVerifier, CPUBatchVerifier
+from tendermint_tpu.utils import trace
 
 # Largest single dispatch the grouper will build; matches the verifier
 # model's streaming window (models/verifier.py MAX_DEVICE_ROWS) so one
@@ -180,13 +183,14 @@ def set_default_sig_cache(c: Optional[SigCache]) -> None:
 class _Item:
     """One submitted request awaiting dispatch."""
 
-    __slots__ = ("kind", "fut", "n", "data")
+    __slots__ = ("kind", "fut", "n", "data", "t_enq")
 
     def __init__(self, kind: str, fut: Future, n: int, data: tuple):
         self.kind = kind  # "batch" | "rows" | "tpl" | "commit"
         self.fut = fut
         self.n = n  # row count (1 for commit specs)
         self.data = data
+        self.t_enq = time.perf_counter_ns()  # enqueue→dispatch wait (trace)
 
 
 class _Bundle:
@@ -477,7 +481,13 @@ class PipelinedVerifier(BatchVerifier):
                         self._cv.wait(timeout=deadline - _time.monotonic())
                 group = self._take_group_locked()
             try:
-                bundle = self._prep(group)
+                with trace.span(
+                    "pipeline.prep",
+                    kind=group[0].kind,
+                    requests=len(group),
+                    rows=sum(i.n for i in group),
+                ):
+                    bundle = self._prep(group)
             except Exception as e:
                 # same invariant as _resolve: a prep failure must fail
                 # THIS group's futures, never the dispatch thread — a
@@ -656,26 +666,50 @@ class PipelinedVerifier(BatchVerifier):
             pass  # cancelled concurrently: nobody is waiting
 
     def _run_bundle(self, bundle: _Bundle) -> None:
-        try:
-            ok = self._execute(bundle)
-        except Exception as e:
-            for it in bundle.items:
-                self._resolve(it.fut, exc=e)
-            return
+        rows = sum(i.n for i in bundle.items)
+        sp = trace.span(
+            "pipeline.execute",
+            kind=bundle.kind,
+            requests=len(bundle.items),
+            rows=rows,
+        )
+        with sp:
+            if sp is not trace.NOOP_SPAN:
+                # dispatch-occupancy attribution: how long the oldest
+                # request waited from submit to device execution
+                now = time.perf_counter_ns()
+                sp.set(
+                    queue_wait_ms=round(
+                        (now - min(i.t_enq for i in bundle.items)) / 1e6, 3
+                    )
+                )
+                if "remap" in bundle.prep:
+                    remap = bundle.prep["remap"]
+                    sp.set(
+                        cache_hits=int((remap < 0).sum()),
+                        device_rows=int(bundle.prep["unique"].size),
+                    )
+            try:
+                ok = self._execute(bundle)
+            except Exception as e:
+                for it in bundle.items:
+                    self._resolve(it.fut, exc=e)
+                return
         with self._cv:
             self.dispatched_bundles += 1
-            self.dispatched_rows += sum(i.n for i in bundle.items)
+            self.dispatched_rows += rows
             self._occupancy_sum += len(bundle.items)
             if len(bundle.items) > 1:
                 self.coalesced_bundles += 1
-        if bundle.kind == "commit":
-            for it, res in zip(bundle.items, ok):
-                self._resolve(it.fut, res)
-            return
-        off = 0
-        for it in bundle.items:
-            self._resolve(it.fut, np.asarray(ok[off : off + it.n]))
-            off += it.n
+        with trace.span("pipeline.resolve", kind=bundle.kind, requests=len(bundle.items)):
+            if bundle.kind == "commit":
+                for it, res in zip(bundle.items, ok):
+                    self._resolve(it.fut, res)
+                return
+            off = 0
+            for it in bundle.items:
+                self._resolve(it.fut, np.asarray(ok[off : off + it.n]))
+                off += it.n
 
     def _execute(self, bundle: _Bundle):
         p = bundle.prep

@@ -22,6 +22,7 @@ from typing import Callable, Dict, List, Optional
 
 from tendermint_tpu.abci import types as abci
 from tendermint_tpu.types.tx import Tx, Txs
+from tendermint_tpu.utils import trace
 from tendermint_tpu.utils.log import get_logger
 
 
@@ -170,33 +171,35 @@ class Mempool:
         admission failure; returns the app's ResponseCheckTx otherwise
         (rejected txs return with res.code != OK, not raised)."""
         tx = bytes(tx)
-        if len(tx) > self.config.max_tx_bytes:
-            raise ErrTxTooLarge(f"{len(tx)} > {self.config.max_tx_bytes}")
-        err = self.is_full(len(tx))
-        if err is not None:
-            raise err
-        if self._pre_check is not None:
-            perr = self._pre_check(tx)
-            if perr is not None:
-                raise ErrPreCheck(perr)
-        # hash ONCE per CheckTx and thread the key through: the admission
-        # path previously recomputed tx_key up to four times per tx
-        # (cache push, in-pool lookup, pool insert, log line)
-        key = tx_key(tx)
-        if not self._cache.push(tx, key):
-            # record extra sender for an in-pool tx (reference :259-266)
-            entry = self._txs.get(key)
-            if entry is not None and sender:
-                entry.senders.add(sender)
-            raise ErrTxInCache()
+        with trace.span("mempool.check_tx", bytes=len(tx)) as sp:
+            if len(tx) > self.config.max_tx_bytes:
+                raise ErrTxTooLarge(f"{len(tx)} > {self.config.max_tx_bytes}")
+            err = self.is_full(len(tx))
+            if err is not None:
+                raise err
+            if self._pre_check is not None:
+                perr = self._pre_check(tx)
+                if perr is not None:
+                    raise ErrPreCheck(perr)
+            # hash ONCE per CheckTx and thread the key through: the admission
+            # path previously recomputed tx_key up to four times per tx
+            # (cache push, in-pool lookup, pool insert, log line)
+            key = tx_key(tx)
+            if not self._cache.push(tx, key):
+                # record extra sender for an in-pool tx (reference :259-266)
+                entry = self._txs.get(key)
+                if entry is not None and sender:
+                    entry.senders.add(sender)
+                raise ErrTxInCache()
 
-        try:
-            res = await self._app.check_tx_sync(abci.RequestCheckTx(tx=tx))
-        except Exception:
-            self._cache.remove(tx, key)
-            raise
-        await self._res_cb_first_time(tx, key, sender, res)
-        return res
+            try:
+                res = await self._app.check_tx_sync(abci.RequestCheckTx(tx=tx))
+            except Exception:
+                self._cache.remove(tx, key)
+                raise
+            sp.set(code=res.code)
+            await self._res_cb_first_time(tx, key, sender, res)
+            return res
 
     async def _res_cb_first_time(
         self, tx: bytes, key: bytes, sender: str, res: abci.ResponseCheckTx

@@ -150,16 +150,26 @@ def load(stage: str, args: Tuple[Any, ...]):
         if not os.path.exists(p):
             return None
         from jax.experimental.serialize_executable import deserialize_and_load
+        import inspect
         import pickle
 
         with open(p, "rb") as fh:
             payload, in_tree, out_tree, device_ids = pickle.load(fh)
         # restore the original device assignment: deserialize_and_load
         # defaults to ALL local devices, which breaks a single-device
-        # executable on a multi-device host (and vice versa)
-        by_id = {d.id: d for d in jax.devices()}
-        devices = [by_id[i] for i in device_ids]
-        return deserialize_and_load(payload, in_tree, out_tree, execution_devices=devices)
+        # executable on a multi-device host (and vice versa). jax<=0.4.x
+        # has no execution_devices kwarg — there the loader derives the
+        # assignment from the serialized payload itself, so the blob is
+        # loaded as-is (the first-use validation in AotJit.__call__
+        # still catches an executable that can't actually dispatch).
+        params = inspect.signature(deserialize_and_load).parameters
+        if "execution_devices" in params:
+            by_id = {d.id: d for d in jax.devices()}
+            devices = [by_id[i] for i in device_ids]
+            return deserialize_and_load(
+                payload, in_tree, out_tree, execution_devices=devices
+            )
+        return deserialize_and_load(payload, in_tree, out_tree)
     except Exception as ex:  # stale/incompatible blob: recompile
         _log.info("aot load failed (recompiling)", stage=stage, err=repr(ex))
         return None

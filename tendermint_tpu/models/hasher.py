@@ -56,6 +56,7 @@ if jax.config.jax_compilation_cache_dir is None:
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
 
 from tendermint_tpu.ops import sha256 as ops_sha  # noqa: E402
+from tendermint_tpu.utils import trace
 from tendermint_tpu.utils.log import get_logger  # noqa: E402
 
 # Leaf-count buckets (padded row counts). 10240 sits just above the 10k
@@ -288,9 +289,11 @@ class MerkleHasher:
         shape = self._shape(items)
         if shape is None:
             self.stats["fallback_shape"] += 1
+            trace.instant("merkle.device_fallback", reason="shape", leaves=len(items))
             return None
         if not self._ensure_bucket(shape[0]):
             self.stats["fallback_cold"] += 1
+            trace.instant("merkle.device_fallback", reason="cold", leaves=len(items))
             return None
         try:
             dev_levels, counts = self._device_levels(items, *shape)
@@ -316,9 +319,11 @@ class MerkleHasher:
         shape = self._shape(items)
         if shape is None:
             self.stats["fallback_shape"] += 1
+            trace.instant("merkle.device_fallback", reason="shape", leaves=len(items))
             return None
         if not self._ensure_bucket(shape[0]):
             self.stats["fallback_cold"] += 1
+            trace.instant("merkle.device_fallback", reason="cold", leaves=len(items))
             return None
         try:
             dev_levels, counts = self._device_levels(items, *shape)

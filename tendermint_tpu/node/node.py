@@ -94,6 +94,17 @@ class Node(Service):
         self.node_key = node_key
         self.logger = logger or get_logger("node")
 
+        # -- flight recorder (utils/trace.py) --------------------------------
+        # Configured FIRST so provider/engine construction below already
+        # records into the ring. TM_TRACE=0/1 overrides config inside
+        # configure() (the ops kill switch).
+        from tendermint_tpu.utils import trace as _trace
+
+        _trace.configure(
+            enabled=config.base.trace_enabled,
+            buffer_events=config.base.trace_buffer_events,
+        )
+
         # -- crypto provider (the BASELINE.json plugin seam) ----------------
         # Every VerifyCommit / VoteSet ingest / light-client call in this
         # process drains through this provider (reference behavior is the
@@ -247,7 +258,11 @@ class Node(Service):
             StateMetrics,
         )
 
-        from tendermint_tpu.utils.metrics import CryptoMetrics, MerkleMetrics
+        from tendermint_tpu.utils.metrics import (
+            CryptoMetrics,
+            MerkleMetrics,
+            TraceMetrics,
+        )
 
         self.metrics_registry = Registry()
         ns = config.instrumentation.namespace
@@ -257,6 +272,7 @@ class Node(Service):
         self.state_metrics = StateMetrics(self.metrics_registry, ns)
         self.crypto_metrics = CryptoMetrics(self.metrics_registry, ns)
         self.merkle_metrics = MerkleMetrics(self.metrics_registry, ns)
+        self.trace_metrics = TraceMetrics(self.metrics_registry, ns)
         self._block_exec_metrics_attach()
         self.metrics_server = None
         if config.instrumentation.prometheus:
@@ -519,8 +535,10 @@ class Node(Service):
             if stats is not None:
                 self.crypto_metrics.update(stats())
             from tendermint_tpu.crypto import merkle as _merkle
+            from tendermint_tpu.utils import trace as _trace
 
             self.merkle_metrics.update(_merkle.device_stats())
+            self.trace_metrics.update(_trace.get_tracer().stats())
             await asyncio.sleep(2.0)
 
     def _only_validator_is_us(self, state: State) -> bool:

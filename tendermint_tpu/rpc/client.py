@@ -148,7 +148,13 @@ class WSClient:
         await self.call("unsubscribe_all")
 
     async def next_event(self, timeout_s: float = 10.0) -> Dict[str, Any]:
-        doc = await asyncio.wait_for(self.events.get(), timeout_s)
+        try:
+            doc = await asyncio.wait_for(self.events.get(), timeout_s)
+        except asyncio.TimeoutError:
+            # builtin TimeoutError: asyncio.TimeoutError is a DISTINCT
+            # class until Python 3.11, so callers catching the builtin
+            # (the natural spelling) would miss it on 3.10
+            raise TimeoutError(f"no event within {timeout_s}s") from None
         return doc.get("result", {})
 
     async def close(self) -> None:

@@ -117,6 +117,8 @@ class RPCCore:
             "unsafe_start_cpu_profiler": self.unsafe_start_cpu_profiler,
             "unsafe_stop_cpu_profiler": self.unsafe_stop_cpu_profiler,
             "unsafe_write_heap_profile": self.unsafe_write_heap_profile,
+            "dump_trace": self.dump_trace,
+            "trace_timeline": self.trace_timeline,
         }
 
     def routes(self) -> List[str]:
@@ -556,6 +558,41 @@ class RPCCore:
             for stat in snap.statistics("lineno")[:200]:
                 fp.write(f"{stat}\n")
         return {"log": f"wrote {filename}" + ("; tracing stopped" if stop else "")}
+
+    # -- flight recorder (utils/trace.py; read-only unlike the unsafe
+    # profiler routes above, so no [rpc] unsafe gate) ------------------------
+
+    async def dump_trace(self, limit=None) -> Dict[str, Any]:
+        """The flight recorder's ring buffer as a Chrome trace-event
+        document — load the result field into https://ui.perfetto.dev
+        or chrome://tracing. Empty unless tracing is enabled
+        (config ``trace_enabled`` / env ``TM_TRACE=1``). ``limit``
+        keeps only the newest N events. The export walks up to 64k
+        ring entries (~hundreds of ms at capacity), so it runs in an
+        executor — the consensus event loop must never stall on a
+        debugging endpoint."""
+        from tendermint_tpu.utils import trace
+
+        t = trace.get_tracer()
+        lim = _int_arg(limit, "limit", None)
+        return await asyncio.get_running_loop().run_in_executor(
+            None, lambda: t.export_chrome(limit=lim)
+        )
+
+    async def trace_timeline(self, height=None) -> Dict[str, Any]:
+        """Per-height, per-stage latency attribution summarized from
+        the span buffer; pass ``height`` to restrict the per-height
+        breakdown to one height. Runs in an executor like dump_trace
+        (it walks the whole ring)."""
+        from tendermint_tpu.utils import trace
+
+        t = trace.get_tracer()
+        h = _int_arg(height, "height", None)
+        out = await asyncio.get_running_loop().run_in_executor(
+            None, lambda: t.timeline(height=h)
+        )
+        out["tracer"] = t.stats()
+        return out
 
     # -- abci routes -------------------------------------------------------
 

@@ -18,6 +18,7 @@ from urllib.parse import parse_qsl, urlparse
 
 from tendermint_tpu.p2p.netaddress import NetAddress
 from tendermint_tpu.rpc.core import RPCCore, RPCError
+from tendermint_tpu.utils import trace
 from tendermint_tpu.utils.log import get_logger
 
 _WS_GUID = "258EAFA5-E914-47DA-95CA-C5AB0DC85B11"
@@ -133,14 +134,20 @@ class RPCServer:
         id_ = doc.get("id")
         name = doc.get("method", "")
         params = doc.get("params") or {}
-        try:
-            result = await self.core.call(name, params)
-            return _rpc_response(id_, result=result)
-        except RPCError as e:
-            return _rpc_response(id_, error={"code": e.code, "message": str(e), "data": e.data})
-        except Exception as e:
-            self.logger.error("rpc handler error", method=name, err=repr(e))
-            return _rpc_response(id_, error={"code": -32603, "message": f"internal error: {e}"})
+        # method name truncated: it is attacker-controlled and the ring
+        # bounds event COUNT, not bytes — an unbounded string here would
+        # let a client pin megabytes per slot for the buffer's lifetime
+        with trace.span("rpc.request", method=str(name)[:128]) as sp:
+            try:
+                result = await self.core.call(name, params)
+                return _rpc_response(id_, result=result)
+            except RPCError as e:
+                sp.set(error=e.code)
+                return _rpc_response(id_, error={"code": e.code, "message": str(e), "data": e.data})
+            except Exception as e:
+                sp.set(error=-32603)
+                self.logger.error("rpc handler error", method=name, err=repr(e))
+                return _rpc_response(id_, error={"code": -32603, "message": f"internal error: {e}"})
 
     # -- websocket ----------------------------------------------------------
 

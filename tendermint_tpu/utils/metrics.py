@@ -5,21 +5,93 @@ Metrics structs with PrometheusMetrics()/NopMetrics() constructors
 (consensus/metrics.go, p2p/metrics.go, mempool/metrics.go,
 state/metrics.go), served at instrumentation.prometheus_listen_addr
 (node/node.go:781-784; metric table docs/tendermint-core/metrics.md).
+
+All instruments are thread-safe: mutation (``inc``/``set``/``add``/
+``observe``) and exposition hold a per-metric lock — values are written
+from the event loop, the crypto pipeline's dispatch/exec threads, and
+background compile threads concurrently with the scrape handler.
+
+Labels: every instrument supports ``with_labels(k=v, ...)``, returning
+a child instrument exposing ``name{k="v",...}`` series (go-kit
+``With``). Children share the parent's HELP/TYPE header; the unlabeled
+base series is emitted only while no children exist or the base was
+itself written, so a fully-labeled family never exports a stray
+``name 0`` sample. Label values are escaped per the Prometheus text
+format (backslash, double quote, newline).
 """
 
 from __future__ import annotations
 
 import asyncio
+import threading
 import time
+from collections import OrderedDict
 from typing import Dict, List, Optional, Tuple
+
+
+def escape_label_value(v: str) -> str:
+    """Prometheus text-format label escaping (backslash first)."""
+    return str(v).replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+
+
+def _render_labels(labels: Tuple[Tuple[str, str], ...], extra: str = "") -> str:
+    parts = [f'{k}="{escape_label_value(v)}"' for k, v in labels]
+    if extra:
+        parts.append(extra)
+    return "{" + ",".join(parts) + "}" if parts else ""
 
 
 class Metric:
     def __init__(self, name: str, help_: str, namespace: str, subsystem: str):
         self.name = f"{namespace}_{subsystem}_{name}" if subsystem else f"{namespace}_{name}"
         self.help = help_
+        self._lock = threading.Lock()
+        self._labels: Tuple[Tuple[str, str], ...] = ()
+        self._children: "OrderedDict[Tuple[Tuple[str, str], ...], Metric]" = OrderedDict()
+        self._parent: Optional["Metric"] = None
+        self._touched = False
+
+    # -- labels ------------------------------------------------------------
+
+    def with_labels(self, **labels) -> "Metric":
+        """Child instrument for this label set (created once, then
+        returned again — so ``m.with_labels(peer=p).inc()`` is cheap on
+        repeat calls). Chaining composes go-kit-style:
+        ``m.with_labels(a=1).with_labels(b=2)`` is the ``{a,b}`` child
+        of the ROOT instrument (only the root's children are exposed)."""
+        if self._parent is not None:
+            merged = dict(self._labels)
+            merged.update((k, str(v)) for k, v in labels.items())
+            return self._parent.with_labels(**merged)
+        key = tuple(sorted((k, str(v)) for k, v in labels.items()))
+        with self._lock:
+            child = self._children.get(key)
+            if child is None:
+                child = self._make_child()
+                child.name = self.name  # series name comes from the parent
+                child.help = self.help
+                child._labels = key
+                child._parent = self
+                self._children[key] = child
+            return child
+
+    def _make_child(self) -> "Metric":
+        raise NotImplementedError
+
+    # -- exposition --------------------------------------------------------
 
     def expose(self) -> List[str]:
+        out = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} {self.kind}"]
+        with self._lock:
+            children = list(self._children.values())
+            emit_base = self._touched or not children
+        if emit_base:
+            out.extend(self._sample_lines())
+        for c in children:
+            out.extend(c._sample_lines())
+        return out
+
+    def _sample_lines(self) -> List[str]:
         raise NotImplementedError
 
 
@@ -31,17 +103,22 @@ class Gauge(Metric):
         self.value = 0.0
 
     def set(self, v: float) -> None:
-        self.value = float(v)
+        with self._lock:
+            self.value = float(v)
+            self._touched = True
 
     def add(self, v: float) -> None:
-        self.value += v
+        with self._lock:
+            self.value += v
+            self._touched = True
 
-    def expose(self) -> List[str]:
-        return [
-            f"# HELP {self.name} {self.help}",
-            f"# TYPE {self.name} gauge",
-            f"{self.name} {self.value}",
-        ]
+    def _make_child(self) -> "Gauge":
+        return Gauge("child", self.help)
+
+    def _sample_lines(self) -> List[str]:
+        with self._lock:
+            v = self.value
+        return [f"{self.name}{_render_labels(self._labels)} {v}"]
 
 
 class Counter(Metric):
@@ -52,14 +129,19 @@ class Counter(Metric):
         self.value = 0.0
 
     def inc(self, v: float = 1.0) -> None:
-        self.value += v
+        if v < 0:
+            raise ValueError(f"counter {self.name} cannot decrease (inc {v})")
+        with self._lock:
+            self.value += v
+            self._touched = True
 
-    def expose(self) -> List[str]:
-        return [
-            f"# HELP {self.name} {self.help}",
-            f"# TYPE {self.name} counter",
-            f"{self.name} {self.value}",
-        ]
+    def _make_child(self) -> "Counter":
+        return Counter("child", self.help)
+
+    def _sample_lines(self) -> List[str]:
+        with self._lock:
+            v = self.value
+        return [f"{self.name}{_render_labels(self._labels)} {v}"]
 
 
 class Histogram(Metric):
@@ -74,39 +156,77 @@ class Histogram(Metric):
         self.count = 0
 
     def observe(self, v: float) -> None:
-        self.sum += v
-        self.count += 1
-        for i, b in enumerate(self.buckets):
-            if v <= b:
-                self.counts[i] += 1
-                return
-        self.counts[-1] += 1
+        with self._lock:
+            self._touched = True
+            self.sum += v
+            self.count += 1
+            for i, b in enumerate(self.buckets):
+                if v <= b:
+                    self.counts[i] += 1
+                    return
+            self.counts[-1] += 1
 
-    def expose(self) -> List[str]:
-        out = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} histogram"]
+    def _make_child(self) -> "Histogram":
+        return Histogram("child", self.help, buckets=self.buckets)
+
+    def _sample_lines(self) -> List[str]:
+        with self._lock:
+            counts = list(self.counts)
+            total, s = self.count, self.sum
+        out = []
+        lbl = self._labels
         acc = 0
-        for b, c in zip(self.buckets, self.counts):
+        for b, c in zip(self.buckets, counts):
             acc += c
-            out.append(f'{self.name}_bucket{{le="{b}"}} {acc}')
-        out.append(f'{self.name}_bucket{{le="+Inf"}} {self.count}')
-        out.append(f"{self.name}_sum {self.sum}")
-        out.append(f"{self.name}_count {self.count}")
+            le = 'le="%s"' % b
+            out.append(f"{self.name}_bucket{_render_labels(lbl, le)} {acc}")
+        inf = 'le="+Inf"'
+        out.append(f"{self.name}_bucket{_render_labels(lbl, inf)} {total}")
+        out.append(f"{self.name}_sum{_render_labels(lbl)} {s}")
+        out.append(f"{self.name}_count{_render_labels(lbl)} {total}")
         return out
 
 
 class Registry:
     def __init__(self):
         self._metrics: List[Metric] = []
+        self._lock = threading.Lock()
 
     def register(self, m: Metric) -> Metric:
-        self._metrics.append(m)
+        with self._lock:
+            self._metrics.append(m)
         return m
 
     def expose_text(self) -> str:
+        with self._lock:
+            metrics = list(self._metrics)
         lines: List[str] = []
-        for m in self._metrics:
+        for m in metrics:
             lines.extend(m.expose())
         return "\n".join(lines) + "\n"
+
+
+class _SnapshotCounters:
+    """Feed true counters from a monotonic-snapshot source.
+
+    The crypto pipeline and merkle engine keep their own internal
+    counters and hand the node periodic ``stats()`` snapshots; the
+    metric pump can only see absolute values, not increments. This
+    helper turns those snapshots into genuine Prometheus counters by
+    inc()'ing the positive delta vs the previous snapshot. A snapshot
+    that goes BACKWARD (source replaced/restarted, e.g. a new
+    PipelinedVerifier after reconfiguration) is treated as a fresh
+    source: the full new value is added, mirroring how Prometheus
+    ``rate()`` handles counter resets."""
+
+    def __init__(self):
+        self._last: Dict[str, float] = {}
+
+    def feed(self, counter: Counter, key: str, stats: dict) -> None:
+        new = float(stats.get(key, 0) or 0)
+        prev = self._last.get(key, 0.0)
+        counter.inc(new - prev if new >= prev else new)
+        self._last[key] = new
 
 
 # -- per-module metric structs (reference per-package metrics.go) ----------
@@ -131,6 +251,16 @@ class ConsensusMetrics:
         self.total_txs = reg(Counter("total_txs", "Total transactions committed.", namespace, sub))
         self.committed_height = reg(Gauge("latest_block_height", "Latest committed height.", namespace, sub))
         self.fast_syncing = reg(Gauge("fast_syncing", "Whether fast-sync is active.", namespace, sub))
+        # per-step latency attribution (flight recorder summary; the
+        # full span detail rides the dump_trace RPC). Labeled by step.
+        self.step_duration_seconds = reg(
+            Histogram(
+                "step_duration_seconds",
+                "Wall seconds spent in each consensus step transition (label: step).",
+                namespace, sub,
+                buckets=[i / 1000 for i in (1, 5, 10, 25, 50, 100, 250, 500, 1000, 5000)],
+            )
+        )
 
 
 class P2PMetrics:
@@ -154,68 +284,112 @@ class MempoolMetrics:
 
 class CryptoMetrics:
     """Pipelined verification dispatch + gossip dedupe cache
-    (crypto/pipeline.py). Values mirror PipelinedVerifier.stats() —
-    monotonic counts are exported as gauges SET from the pipeline's own
-    counters each pump (utils can't observe the increments themselves).
-    See docs/verification-pipeline.md."""
+    (crypto/pipeline.py). Monotonic totals are TRUE counters fed by
+    snapshot deltas from PipelinedVerifier.stats() on each pump;
+    instantaneous values (queue depth, occupancy, cache size) stay
+    gauges. See docs/verification-pipeline.md."""
+
+    _COUNTERS = (
+        ("pipeline_submitted", "submitted_calls"),
+        ("pipeline_bundles", "dispatched_bundles"),
+        ("pipeline_rows", "submitted_rows"),
+        ("pipeline_device_rows", "device_rows"),
+        ("dedupe_cache_hits", "cache_hits"),
+        ("dedupe_cache_misses", "cache_misses"),
+    )
 
     def __init__(self, registry: Optional[Registry] = None, namespace="tendermint"):
         r = registry or Registry()
         sub = "crypto"
         reg = r.register
         self.pipeline_queue_depth = reg(Gauge("pipeline_queue_depth", "Verify requests waiting for dispatch.", namespace, sub))
-        self.pipeline_submitted = reg(Gauge("pipeline_submitted_total", "Verify requests submitted.", namespace, sub))
-        self.pipeline_bundles = reg(Gauge("pipeline_bundles_total", "Device bundles dispatched.", namespace, sub))
-        self.pipeline_rows = reg(Gauge("pipeline_rows_total", "Signature rows submitted.", namespace, sub))
-        self.pipeline_device_rows = reg(Gauge("pipeline_device_rows_total", "Signature rows that reached the device (post-dedupe).", namespace, sub))
+        self.pipeline_submitted = reg(Counter("pipeline_submitted_total", "Verify requests submitted.", namespace, sub))
+        self.pipeline_bundles = reg(Counter("pipeline_bundles_total", "Device bundles dispatched.", namespace, sub))
+        self.pipeline_rows = reg(Counter("pipeline_rows_total", "Signature rows submitted.", namespace, sub))
+        self.pipeline_device_rows = reg(Counter("pipeline_device_rows_total", "Signature rows that reached the device (post-dedupe).", namespace, sub))
         self.pipeline_batch_occupancy = reg(Gauge("pipeline_batch_occupancy_avg", "Mean requests coalesced per bundle.", namespace, sub))
-        self.dedupe_cache_hits = reg(Gauge("dedupe_cache_hits_total", "Dedupe-cache hits (device round trips saved).", namespace, sub))
-        self.dedupe_cache_misses = reg(Gauge("dedupe_cache_misses_total", "Dedupe-cache misses.", namespace, sub))
+        self.dedupe_cache_hits = reg(Counter("dedupe_cache_hits_total", "Dedupe-cache hits (device round trips saved).", namespace, sub))
+        self.dedupe_cache_misses = reg(Counter("dedupe_cache_misses_total", "Dedupe-cache misses.", namespace, sub))
         self.dedupe_cache_size = reg(Gauge("dedupe_cache_size", "Verified triples currently cached.", namespace, sub))
+        self._deltas = _SnapshotCounters()
 
     def update(self, stats: dict) -> None:
-        """Copy a PipelinedVerifier.stats() snapshot into the gauges."""
+        """Fold a PipelinedVerifier.stats() snapshot into the
+        instruments (delta-feed for counters, set for gauges)."""
         self.pipeline_queue_depth.set(stats.get("queue_depth", 0))
-        self.pipeline_submitted.set(stats.get("submitted_calls", 0))
-        self.pipeline_bundles.set(stats.get("dispatched_bundles", 0))
-        self.pipeline_rows.set(stats.get("submitted_rows", 0))
-        self.pipeline_device_rows.set(stats.get("device_rows", 0))
         self.pipeline_batch_occupancy.set(stats.get("batch_occupancy_avg", 0))
-        self.dedupe_cache_hits.set(stats.get("cache_hits", 0))
-        self.dedupe_cache_misses.set(stats.get("cache_misses", 0))
         self.dedupe_cache_size.set(stats.get("cache_size", 0))
+        for attr, key in self._COUNTERS:
+            self._deltas.feed(getattr(self, attr), key, stats)
 
 
 class MerkleMetrics:
     """Device merkle engine counters (crypto/merkle.py device_stats():
     the batched SHA-256 engine behind tx/part-set/validator-set
-    hashing, models/hasher.py). Monotonic counts are exported as gauges
-    SET from the engine's own counters each pump, like CryptoMetrics.
+    hashing, models/hasher.py). Monotonic totals are TRUE counters fed
+    by snapshot deltas, like CryptoMetrics.
     See docs/merkle-acceleration.md."""
+
+    _COUNTERS = (
+        ("device_roots", "device_roots"),
+        ("device_proof_sets", "device_proof_sets"),
+        ("device_leaves", "device_leaves"),
+        ("host_roots", "host_roots"),
+        ("host_proof_sets", "host_proof_sets"),
+        ("fallback_cold", "fallback_cold"),
+        ("fallback_shape", "fallback_shape"),
+    )
 
     def __init__(self, registry: Optional[Registry] = None, namespace="tendermint"):
         r = registry or Registry()
         sub = "merkle"
         reg = r.register
         self.device_enabled = reg(Gauge("device_enabled", "1 when the device merkle engine is configured on.", namespace, sub))
-        self.device_roots = reg(Gauge("device_roots_total", "Merkle roots computed on the device engine.", namespace, sub))
-        self.device_proof_sets = reg(Gauge("device_proof_sets_total", "Full proof sets (root + aunts) computed on the device engine.", namespace, sub))
-        self.device_leaves = reg(Gauge("device_leaves_total", "Leaves hashed by the device engine.", namespace, sub))
-        self.host_roots = reg(Gauge("host_roots_total", "Merkle roots computed on the host path (below threshold or fallback).", namespace, sub))
-        self.host_proof_sets = reg(Gauge("host_proof_sets_total", "Proof sets computed on the host path.", namespace, sub))
-        self.fallback_cold = reg(Gauge("fallback_cold_total", "Qualifying trees served on host while a device bucket compiled.", namespace, sub))
-        self.fallback_shape = reg(Gauge("fallback_shape_total", "Qualifying trees outside the device size caps (leaf count/bytes).", namespace, sub))
+        self.device_roots = reg(Counter("device_roots_total", "Merkle roots computed on the device engine.", namespace, sub))
+        self.device_proof_sets = reg(Counter("device_proof_sets_total", "Full proof sets (root + aunts) computed on the device engine.", namespace, sub))
+        self.device_leaves = reg(Counter("device_leaves_total", "Leaves hashed by the device engine.", namespace, sub))
+        self.host_roots = reg(Counter("host_roots_total", "Merkle roots computed on the host path (below threshold or fallback).", namespace, sub))
+        self.host_proof_sets = reg(Counter("host_proof_sets_total", "Proof sets computed on the host path.", namespace, sub))
+        self.fallback_cold = reg(Counter("fallback_cold_total", "Qualifying trees served on host while a device bucket compiled.", namespace, sub))
+        self.fallback_shape = reg(Counter("fallback_shape_total", "Qualifying trees outside the device size caps (leaf count/bytes).", namespace, sub))
+        self._deltas = _SnapshotCounters()
 
     def update(self, stats: dict) -> None:
-        """Copy a crypto.merkle.device_stats() snapshot into the gauges."""
+        """Fold a crypto.merkle.device_stats() snapshot into the
+        instruments."""
         self.device_enabled.set(stats.get("device_enabled", 0))
-        self.device_roots.set(stats.get("device_roots", 0))
-        self.device_proof_sets.set(stats.get("device_proof_sets", 0))
-        self.device_leaves.set(stats.get("device_leaves", 0))
-        self.host_roots.set(stats.get("host_roots", 0))
-        self.host_proof_sets.set(stats.get("host_proof_sets", 0))
-        self.fallback_cold.set(stats.get("fallback_cold", 0))
-        self.fallback_shape.set(stats.get("fallback_shape", 0))
+        for attr, key in self._COUNTERS:
+            self._deltas.feed(getattr(self, attr), key, stats)
+
+
+class TraceMetrics:
+    """Flight-recorder health (utils/trace.py Tracer.stats()): is the
+    tracer on, how full is the ring, is it dropping. The span payloads
+    themselves are served by the dump_trace RPC, not scraped."""
+
+    _COUNTERS = (
+        ("events_recorded", "events_recorded"),
+        ("events_dropped", "events_dropped"),
+    )
+
+    def __init__(self, registry: Optional[Registry] = None, namespace="tendermint"):
+        r = registry or Registry()
+        sub = "trace"
+        reg = r.register
+        self.enabled = reg(Gauge("enabled", "1 when span tracing is enabled.", namespace, sub))
+        self.events_recorded = reg(Counter("events_recorded_total", "Trace events recorded into the ring buffer.", namespace, sub))
+        self.events_dropped = reg(Counter("events_dropped_total", "Trace events evicted from the full ring buffer.", namespace, sub))
+        self.buffer_events = reg(Gauge("buffer_events", "Events currently held in the ring buffer.", namespace, sub))
+        self.buffer_capacity = reg(Gauge("buffer_capacity", "Ring buffer capacity (trace_buffer_events).", namespace, sub))
+        self._deltas = _SnapshotCounters()
+
+    def update(self, stats: dict) -> None:
+        """Fold a Tracer.stats() snapshot into the instruments."""
+        self.enabled.set(stats.get("enabled", 0))
+        self.buffer_events.set(stats.get("buffer_events", 0))
+        self.buffer_capacity.set(stats.get("buffer_capacity", 0))
+        for attr, key in self._COUNTERS:
+            self._deltas.feed(getattr(self, attr), key, stats)
 
 
 class StateMetrics:

@@ -1,0 +1,364 @@
+"""Flight-recorder span tracing for the consensus hot path.
+
+The node is a pipeline of overlapping host/device stages (consensus
+step machine, pipelined verify dispatch, device merkle engine, WAL,
+mempool, RPC) with per-module counters but no way to attribute WHERE a
+slow height actually went. This module is the attribution layer: a
+lock-protected, bounded ring buffer ``Tracer`` recording nested spans
+
+    with tracer.span("pipeline.execute", kind="batch", rows=n):
+        ...
+
+and instant events, exportable as Chrome trace-event JSON (load the
+``dump_trace`` RPC output straight into https://ui.perfetto.dev or
+chrome://tracing) and as a per-height timeline summary
+(``trace_timeline`` RPC). See docs/tracing.md for the span taxonomy.
+
+Design constraints, in order:
+
+- **Near-zero cost disabled.** The module-level ``span()``/``instant()``
+  helpers check one flag and return a shared no-op context manager
+  before touching anything else — no timestamp read, no string
+  formatting, no allocation beyond the caller's kwargs dict. Call sites
+  therefore never need their own ``if tracing:`` guard.
+- **Bounded.** The ring holds ``buffer_events`` events; the oldest are
+  evicted (counted in ``dropped``) — a tracer left on for a week is a
+  window over the recent past, never an OOM.
+- **Thread-safe.** Spans originate from the event loop, the pipeline's
+  dispatch/exec threads, and background compile threads; the ring is
+  lock-protected and span nesting is tracked per-thread.
+
+The global tracer is wired from config (``trace_enabled``,
+``trace_buffer_events``) at node construction; ``TM_TRACE=0``/``1`` is
+the ops kill switch overriding config without editing toml.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from collections import deque
+from typing import Any, Dict, List, Optional, Tuple
+
+DEFAULT_BUFFER_EVENTS = 65536
+
+_PID = os.getpid()
+
+
+class _NoopSpan:
+    """Shared do-nothing span: what call sites get while tracing is off
+    (and what makes instrumentation free to leave in the hot path)."""
+
+    __slots__ = ()
+
+    def __enter__(self) -> "_NoopSpan":
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        return False
+
+    def set(self, **args) -> None:
+        pass
+
+
+NOOP_SPAN = _NoopSpan()
+
+# per-thread span stack for nesting attribution
+_tls = threading.local()
+
+
+def _stack() -> list:
+    s = getattr(_tls, "stack", None)
+    if s is None:
+        s = _tls.stack = []
+    return s
+
+
+class _Span:
+    """One live span. Records a Chrome 'X' (complete) event on exit."""
+
+    __slots__ = ("_tracer", "name", "args", "_t0", "_tid")
+
+    def __init__(self, tracer: "Tracer", name: str, args: Dict[str, Any]):
+        self._tracer = tracer
+        self.name = name
+        self.args = args
+
+    def set(self, **args) -> None:
+        """Attach/overwrite args after entry (e.g. a routing outcome
+        known only mid-span)."""
+        self.args.update(args)
+
+    def __enter__(self) -> "_Span":
+        self._tid = threading.get_ident()
+        st = _stack()
+        if st:
+            # parent attribution is best-effort: concurrent asyncio tasks
+            # interleave on one thread, so only the NAME is recorded
+            self.args.setdefault("parent", st[-1].name)
+        st.append(self)
+        self._t0 = time.perf_counter_ns()
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        dur = time.perf_counter_ns() - self._t0
+        st = _stack()
+        if st and st[-1] is self:
+            st.pop()
+        else:  # interleaved async exit order: remove by identity
+            try:
+                st.remove(self)
+            except ValueError:
+                pass
+        self._tracer._record("X", self.name, self._t0, dur, self._tid, self.args)
+        return False
+
+
+class Tracer:
+    """Bounded, lock-protected ring buffer of trace events."""
+
+    def __init__(
+        self, buffer_events: int = DEFAULT_BUFFER_EVENTS, enabled: bool = True
+    ):
+        self.enabled = bool(enabled)
+        self._cap = max(int(buffer_events), 1)
+        self._ring: "deque[tuple]" = deque()
+        self._lock = threading.Lock()
+        self._origin_ns = time.perf_counter_ns()
+        # wall-clock anchor so exported timestamps can be correlated
+        # with log lines (perf_counter has an arbitrary epoch)
+        self._origin_unix_ns = time.time_ns()
+        self.recorded = 0
+        self.dropped = 0
+        self._thread_names: Dict[int, str] = {}
+
+    # -- recording ---------------------------------------------------------
+
+    def span(self, name: str, **args):
+        """Context manager timing a stage. Returns a shared no-op when
+        the tracer is disabled."""
+        if not self.enabled:
+            return NOOP_SPAN
+        return _Span(self, name, args)
+
+    def instant(self, name: str, **args) -> None:
+        """Zero-duration marker event."""
+        if not self.enabled:
+            return
+        self._record(
+            "i", name, time.perf_counter_ns(), 0, threading.get_ident(), args
+        )
+
+    def _record(
+        self, ph: str, name: str, t0_ns: int, dur_ns: int, tid: int, args: dict
+    ) -> None:
+        with self._lock:
+            if tid not in self._thread_names:
+                # current_thread() is the caller's own thread; cheap
+                self._thread_names[tid] = threading.current_thread().name
+            if len(self._ring) >= self._cap:
+                self._ring.popleft()
+                self.dropped += 1
+            self._ring.append((ph, name, t0_ns, dur_ns, tid, args))
+            self.recorded += 1
+
+    # -- management --------------------------------------------------------
+
+    def clear(self) -> None:
+        with self._lock:
+            self._ring.clear()
+
+    def set_capacity(self, buffer_events: int) -> None:
+        with self._lock:
+            self._cap = max(int(buffer_events), 1)
+            while len(self._ring) > self._cap:
+                self._ring.popleft()
+                self.dropped += 1
+
+    def stats(self) -> Dict[str, float]:
+        """Counters for the ``tendermint_trace_*`` metric family."""
+        with self._lock:
+            return {
+                "enabled": 1 if self.enabled else 0,
+                "events_recorded": self.recorded,
+                "events_dropped": self.dropped,
+                "buffer_events": len(self._ring),
+                "buffer_capacity": self._cap,
+            }
+
+    def _snapshot(self) -> List[tuple]:
+        with self._lock:
+            return list(self._ring)
+
+    # -- export ------------------------------------------------------------
+
+    def export_chrome(self, limit: Optional[int] = None) -> Dict[str, Any]:
+        """Chrome trace-event document (perfetto / chrome://tracing).
+        Spans are 'X' complete events; instants are 'i'; thread-name
+        metadata rides 'M' events. Timestamps are microseconds since
+        the tracer's origin. ``limit`` keeps only the newest N events
+        (a full 64k ring renders to ~10MB of JSON)."""
+        events: List[Dict[str, Any]] = []
+        with self._lock:
+            names = dict(self._thread_names)
+            ring = list(self._ring)
+        if limit is not None and limit >= 0:
+            # explicit slice for 0: ring[-0:] is the FULL list
+            ring = ring[-limit:] if limit > 0 else []
+        for tid, tname in sorted(names.items()):
+            events.append(
+                {
+                    "ph": "M", "name": "thread_name", "pid": _PID, "tid": tid,
+                    "args": {"name": tname},
+                }
+            )
+        for ph, name, t0_ns, dur_ns, tid, args in ring:
+            ev: Dict[str, Any] = {
+                "ph": ph,
+                "name": name,
+                "pid": _PID,
+                "tid": tid,
+                "ts": (t0_ns - self._origin_ns) / 1000.0,
+            }
+            if ph == "X":
+                ev["dur"] = dur_ns / 1000.0
+            if ph == "i":
+                ev["s"] = "t"  # thread-scoped instant
+            if args:
+                ev["args"] = args
+            events.append(ev)
+        return {
+            "traceEvents": events,
+            "displayTimeUnit": "ms",
+            "otherData": {
+                "origin_unix_ns": self._origin_unix_ns,
+                "dropped_events": self.dropped,
+            },
+        }
+
+    def timeline(self, height: Optional[int] = None) -> Dict[str, Any]:
+        """Per-height latency attribution: spans carrying a ``height``
+        arg grouped by height then span name, plus a cross-height
+        per-stage aggregate over EVERY span in the buffer. All
+        durations in milliseconds."""
+        per_height: Dict[int, Dict[str, Any]] = {}
+        stages: Dict[str, Dict[str, float]] = {}
+        for ph, name, t0_ns, dur_ns, tid, args in self._snapshot():
+            if ph != "X":
+                continue
+            dur_ms = dur_ns / 1e6
+            agg = stages.setdefault(
+                name, {"count": 0, "total_ms": 0.0, "max_ms": 0.0}
+            )
+            agg["count"] += 1
+            agg["total_ms"] += dur_ms
+            agg["max_ms"] = max(agg["max_ms"], dur_ms)
+            h = args.get("height")
+            if not isinstance(h, int) or (height is not None and h != height):
+                continue
+            hrec = per_height.setdefault(
+                h, {"first_ts_ns": t0_ns, "last_ts_ns": t0_ns + dur_ns, "stages": {}}
+            )
+            hrec["first_ts_ns"] = min(hrec["first_ts_ns"], t0_ns)
+            hrec["last_ts_ns"] = max(hrec["last_ts_ns"], t0_ns + dur_ns)
+            srec = hrec["stages"].setdefault(
+                name, {"count": 0, "total_ms": 0.0, "max_ms": 0.0}
+            )
+            srec["count"] += 1
+            srec["total_ms"] += dur_ms
+            srec["max_ms"] = max(srec["max_ms"], dur_ms)
+        heights = []
+        for h in sorted(per_height):
+            rec = per_height[h]
+            heights.append(
+                {
+                    "height": h,
+                    "wall_ms": round((rec["last_ts_ns"] - rec["first_ts_ns"]) / 1e6, 3),
+                    "stages": {
+                        k: {
+                            "count": v["count"],
+                            "total_ms": round(v["total_ms"], 3),
+                            "max_ms": round(v["max_ms"], 3),
+                        }
+                        for k, v in sorted(rec["stages"].items())
+                    },
+                }
+            )
+        return {
+            "heights": heights,
+            "stages": {
+                k: {
+                    "count": v["count"],
+                    "total_ms": round(v["total_ms"], 3),
+                    "max_ms": round(v["max_ms"], 3),
+                    "avg_ms": round(v["total_ms"] / v["count"], 4) if v["count"] else 0,
+                }
+                for k, v in sorted(stages.items())
+            },
+        }
+
+
+# -- global tracer ----------------------------------------------------------
+#
+# One process-wide tracer (like the crypto provider and merkle engine
+# seams): every subsystem records into the same ring so the exported
+# trace interleaves consensus steps with the device work they caused.
+
+def _env_enabled(default: bool) -> bool:
+    """TM_TRACE=0 force-disables, TM_TRACE=1 force-enables (ops kill
+    switch; mirrors TM_MERKLE_DEVICE / TM_CRYPTO_PROVIDER). Allowlist
+    for ON: an unrecognized spelling (off/disabled/typo) must fail
+    SAFE — disabled — never force-enable hot-path recording."""
+    v = os.environ.get("TM_TRACE")
+    if v is None or v == "":
+        return default
+    return v.strip().lower() in ("1", "true", "yes", "on")
+
+
+_tracer = Tracer(enabled=_env_enabled(False))
+
+
+def get_tracer() -> Tracer:
+    return _tracer
+
+
+def set_tracer(t: Tracer) -> Tracer:
+    """Install a specific tracer (tests/bench); bypasses the TM_TRACE
+    override on purpose."""
+    global _tracer
+    _tracer = t
+    return t
+
+
+def configure(
+    enabled: Optional[bool] = None, buffer_events: Optional[int] = None
+) -> Tracer:
+    """Apply config to the global tracer (node wiring). ``TM_TRACE``
+    overrides ``enabled``."""
+    if buffer_events is not None:
+        _tracer.set_capacity(buffer_events)
+    if enabled is not None:
+        _tracer.enabled = _env_enabled(bool(enabled))
+    return _tracer
+
+
+def enabled() -> bool:
+    return _tracer.enabled
+
+
+def span(name: str, **args):
+    """``with trace.span("stage", height=h):`` — the hot-path entry
+    point. One flag check when disabled."""
+    t = _tracer
+    if not t.enabled:
+        return NOOP_SPAN
+    return _Span(t, name, args)
+
+
+def instant(name: str, **args) -> None:
+    t = _tracer
+    if t.enabled:
+        t._record(
+            "i", name, time.perf_counter_ns(), 0, threading.get_ident(), args
+        )

@@ -99,6 +99,23 @@ def pytest_unconfigure(config):
     atexit.register(filter_cpu_aot_noise)
 
 
+def load_check_metrics_lint():
+    """The scripts/check_metrics.py module (it lives outside the
+    package, so tests load it by path — here once, shared by
+    test_metrics.py and test_check_metrics.py)."""
+    import importlib.util
+
+    path = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "scripts",
+        "check_metrics.py",
+    )
+    spec = importlib.util.spec_from_file_location("check_metrics", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
 @pytest.fixture(scope="session")
 def cpu_mesh():
     import jax

@@ -5,6 +5,10 @@ prometheus endpoint wiring (node/node.go:781)."""
 
 import asyncio
 import os
+import sys
+import threading
+
+import pytest
 
 from tendermint_tpu.cli import main as cli_main
 from tendermint_tpu.config import load_config
@@ -12,10 +16,13 @@ from tendermint_tpu.node import default_new_node
 from tendermint_tpu.utils.metrics import (
     ConsensusMetrics,
     Counter,
+    CryptoMetrics,
     Gauge,
     Histogram,
+    MerkleMetrics,
     MetricsServer,
     Registry,
+    TraceMetrics,
 )
 
 
@@ -36,6 +43,160 @@ def test_exposition_format():
     assert 'tendermint_state_t_bucket{le="1"} 2' in text
     assert 'tendermint_state_t_bucket{le="+Inf"} 3' in text
     assert "tendermint_state_t_count 3" in text
+
+
+def _parse_series(text):
+    """{full_series_line_lhs: float} for every sample line."""
+    out = {}
+    for line in text.splitlines():
+        if not line or line.startswith("#"):
+            continue
+        lhs, _, val = line.rpartition(" ")
+        out[lhs] = float(val)
+    return out
+
+
+def test_help_type_pairing():
+    """Every family exposes exactly one HELP directly paired with its
+    TYPE, before any sample — asserted by the shared exposition lint
+    (scripts/check_metrics.py) so this test and the CI lint can never
+    drift apart."""
+    from conftest import load_check_metrics_lint
+
+    lint = load_check_metrics_lint()
+    r = Registry()
+    ConsensusMetrics(r)
+    CryptoMetrics(r)
+    MerkleMetrics(r)
+    TraceMetrics(r)
+    errors = lint.validate_metrics_text(r.expose_text())
+    assert errors == [], "\n".join(errors)
+
+
+def test_labeled_series_and_escaping():
+    r = Registry()
+    c = r.register(Counter("reqs_total", "Requests.", "tendermint", "rpc"))
+    c.with_labels(method="status").inc(3)
+    c.with_labels(method="status").inc()  # same child returned again
+    c.with_labels(method='q"uo\\te\nnl').inc()
+    text = r.expose_text()
+    series = _parse_series(text)
+    assert series['tendermint_rpc_reqs_total{method="status"}'] == 4.0
+    # backslash, quote, and newline escaped per the text format
+    assert 'method="q\\"uo\\\\te\\nnl"' in text
+    # fully-labeled family: no stray unlabeled base sample line
+    assert not any(
+        line.startswith("tendermint_rpc_reqs_total ")
+        for line in text.splitlines()
+    )
+
+    g = r.register(Gauge("depth", "D.", "tendermint", "rpc"))
+    g.set(2)  # base touched -> still exposed alongside children
+    g.with_labels(queue="a").set(5)
+    series = _parse_series(r.expose_text())
+    assert series["tendermint_rpc_depth"] == 2.0
+    assert series['tendermint_rpc_depth{queue="a"}'] == 5.0
+
+    # chained with_labels composes onto the ROOT (go-kit With idiom):
+    # the {a,b} child is exposed and identical to the direct lookup
+    chained = c.with_labels(method="x").with_labels(code="0")
+    chained.inc(7)
+    assert chained is c.with_labels(code="0", method="x")
+    series = _parse_series(r.expose_text())
+    assert series['tendermint_rpc_reqs_total{code="0",method="x"}'] == 7.0
+
+
+def test_labeled_histogram_buckets_monotonic():
+    r = Registry()
+    h = r.register(Histogram("lat", "L.", "tendermint", "rpc", buckets=(0.1, 1, 5)))
+    for v in (0.05, 0.5, 0.5, 3, 30):
+        h.with_labels(method="block").observe(v)
+    text = r.expose_text()
+    series = _parse_series(text)
+    le = lambda b: series[f'tendermint_rpc_lat_bucket{{method="block",le="{b}"}}']
+    buckets = [le("0.1"), le("1"), le("5"), le("+Inf")]
+    assert buckets == [1, 3, 4, 5]
+    assert all(a <= b for a, b in zip(buckets, buckets[1:]))
+    assert series['tendermint_rpc_lat_count{method="block"}'] == 5
+    assert series['tendermint_rpc_lat_sum{method="block"}'] == pytest.approx(34.05)
+
+
+def test_concurrent_writers_are_exact():
+    """Counter.inc / Histogram.observe / Gauge.add from many threads
+    lose no updates (the guard the issue's race fix adds); exposition
+    runs concurrently without corrupting the totals."""
+    c = Counter("n_total", "N.")
+    h = Histogram("t", "T.", buckets=(0.5,))
+    g = Gauge("g", "G.")
+    n_threads, n_iter = 8, 5000
+    stop = threading.Event()
+
+    def scraper():
+        while not stop.is_set():
+            c.expose()
+            h.expose()
+
+    def writer():
+        for i in range(n_iter):
+            c.inc()
+            g.add(1)
+            h.observe(0.1 if i % 2 else 0.9)
+
+    prev = sys.getswitchinterval()
+    sys.setswitchinterval(1e-5)  # force interleaving at the bytecode level
+    try:
+        scr = threading.Thread(target=scraper)
+        scr.start()
+        threads = [threading.Thread(target=writer) for _ in range(n_threads)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        stop.set()
+        scr.join()
+    finally:
+        sys.setswitchinterval(prev)
+
+    total = n_threads * n_iter
+    assert c.value == total
+    assert g.value == total
+    assert h.count == total
+    assert sum(h.counts) == total
+    assert h.counts[0] == total // 2
+
+
+def test_counter_rejects_decrease():
+    c = Counter("n_total", "N.")
+    with pytest.raises(ValueError):
+        c.inc(-1)
+
+
+def test_snapshot_delta_counters():
+    """CryptoMetrics/MerkleMetrics turn monotonic stats() snapshots
+    into true counters: increments accumulate, a source reset doesn't
+    decrease the series."""
+    r = Registry()
+    cm = CryptoMetrics(r)
+    cm.update({"submitted_calls": 10, "cache_hits": 4, "queue_depth": 3})
+    cm.update({"submitted_calls": 25, "cache_hits": 4, "queue_depth": 0})
+    assert cm.pipeline_submitted.value == 25
+    assert cm.dedupe_cache_hits.value == 4
+    assert cm.pipeline_queue_depth.value == 0  # gauge tracks instantaneous
+    # pipeline replaced (counters restart): no decrease, new counts add
+    cm.update({"submitted_calls": 5, "cache_hits": 1, "queue_depth": 1})
+    assert cm.pipeline_submitted.value == 30
+    assert cm.dedupe_cache_hits.value == 5
+
+    mm = MerkleMetrics(r)
+    mm.update({"device_enabled": 1, "device_roots": 7, "host_roots": 2})
+    mm.update({"device_enabled": 1, "device_roots": 9, "host_roots": 2})
+    assert mm.device_roots.value == 9
+    assert mm.host_roots.value == 2
+    assert mm.device_enabled.value == 1
+    # exposition declares them as counters now
+    text = r.expose_text()
+    assert "# TYPE tendermint_crypto_pipeline_submitted_total counter" in text
+    assert "# TYPE tendermint_merkle_device_roots_total counter" in text
 
 
 def test_node_serves_metrics(tmp_path):

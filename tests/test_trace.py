@@ -1,0 +1,248 @@
+"""Flight-recorder tracing (utils/trace.py): span nesting, ring
+eviction, Chrome trace-event export, the TM_TRACE kill switch, and the
+live-node acceptance path — dump_trace on a running node returns
+consensus step, pipeline bundle, and merkle routing spans for a
+committed height."""
+
+import asyncio
+import json
+import os
+import threading
+import time
+
+import pytest
+
+from tendermint_tpu.utils import trace
+from tendermint_tpu.utils.trace import Tracer
+
+
+@pytest.fixture(autouse=True)
+def _restore_global_tracer():
+    prev = trace.get_tracer()
+    yield
+    trace.set_tracer(prev)
+
+
+def _spans(t):
+    return [e for e in t.export_chrome()["traceEvents"] if e["ph"] == "X"]
+
+
+def test_span_nesting_and_args():
+    t = Tracer(buffer_events=128)
+    with t.span("outer", height=7):
+        time.sleep(0.002)
+        with t.span("inner", height=7, rows=3):
+            time.sleep(0.001)
+    evs = {e["name"]: e for e in _spans(t)}
+    assert set(evs) == {"outer", "inner"}
+    outer, inner = evs["outer"], evs["inner"]
+    # child is recorded with its parent's name and nests in time
+    assert inner["args"]["parent"] == "outer"
+    assert inner["args"]["rows"] == 3
+    assert outer["ts"] <= inner["ts"]
+    assert inner["ts"] + inner["dur"] <= outer["ts"] + outer["dur"] + 1e-3
+    assert outer["dur"] >= inner["dur"]
+
+
+def test_span_set_updates_args():
+    t = Tracer()
+    with t.span("routed", leaves=10, path="device") as sp:
+        sp.set(path="host")
+    (ev,) = _spans(t)
+    assert ev["args"]["path"] == "host"
+
+
+def test_ring_eviction_bounds_and_counters():
+    t = Tracer(buffer_events=8)
+    for i in range(20):
+        t.instant("tick", i=i)
+    st = t.stats()
+    assert st["buffer_events"] == 8
+    assert st["events_recorded"] == 20
+    assert st["events_dropped"] == 12
+    # survivors are the NEWEST events
+    kept = [e["args"]["i"] for e in t.export_chrome()["traceEvents"] if e["ph"] == "i"]
+    assert kept == list(range(12, 20))
+
+
+def test_chrome_export_is_valid_json_with_complete_events():
+    t = Tracer()
+    with t.span("a", height=1):
+        pass
+    t.instant("marker", height=1)
+    doc = json.loads(json.dumps(t.export_chrome()))
+    evs = doc["traceEvents"]
+    assert all(e["ph"] in ("X", "i", "M") for e in evs)
+    xs = [e for e in evs if e["ph"] == "X"]
+    assert xs and all("ts" in e and "dur" in e and "pid" in e and "tid" in e for e in xs)
+    # thread metadata present for the recording thread
+    assert any(e["ph"] == "M" and e["name"] == "thread_name" for e in evs)
+
+
+def test_disabled_tracer_is_noop():
+    t = Tracer(enabled=False)
+    s = t.span("x", height=1)
+    assert s is trace.NOOP_SPAN
+    with s:
+        pass
+    t.instant("y")
+    assert t.stats()["events_recorded"] == 0
+
+
+def test_module_helpers_and_kill_switch(monkeypatch):
+    monkeypatch.delenv("TM_TRACE", raising=False)
+    t = trace.set_tracer(Tracer(enabled=False))
+    assert trace.span("x") is trace.NOOP_SPAN
+    trace.configure(enabled=True)
+    with trace.span("x", height=2):
+        pass
+    assert t.stats()["events_recorded"] == 1
+
+    # TM_TRACE=0 overrides config-on (ops kill switch)
+    monkeypatch.setenv("TM_TRACE", "0")
+    trace.configure(enabled=True)
+    assert not trace.enabled()
+    # TM_TRACE=1 overrides config-off
+    monkeypatch.setenv("TM_TRACE", "1")
+    trace.configure(enabled=False)
+    assert trace.enabled()
+    # unrecognized spellings fail SAFE (disabled), never force-enable
+    for v in ("off", "OFF", "False", "NO", "disabled", "junk"):
+        monkeypatch.setenv("TM_TRACE", v)
+        trace.configure(enabled=True)
+        assert not trace.enabled(), v
+    monkeypatch.setenv("TM_TRACE", "on")
+    trace.configure(enabled=False)
+    assert trace.enabled()
+
+
+def test_export_limit():
+    t = Tracer()
+    for i in range(6):
+        t.instant("e", i=i)
+    data = lambda doc: [e for e in doc["traceEvents"] if e["ph"] != "M"]
+    assert len(data(t.export_chrome())) == 6
+    assert [e["args"]["i"] for e in data(t.export_chrome(limit=2))] == [4, 5]
+    assert data(t.export_chrome(limit=0)) == []  # ring[-0:] trap
+
+
+def test_threaded_recording_is_race_free():
+    t = Tracer(buffer_events=100_000)
+
+    def worker(k):
+        for i in range(500):
+            with t.span("w", k=k):
+                pass
+
+    threads = [threading.Thread(target=worker, args=(k,)) for k in range(8)]
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join()
+    st = t.stats()
+    assert st["events_recorded"] == 4000
+    assert st["events_dropped"] == 0
+    assert len(_spans(t)) == 4000
+
+
+def test_timeline_attribution():
+    t = Tracer()
+    for h in (5, 6):
+        with t.span("consensus.propose", height=h, round=0):
+            time.sleep(0.001)
+        with t.span("consensus.commit", height=h, round=0):
+            pass
+    with t.span("unattributed"):
+        pass
+    tl = t.timeline()
+    assert [rec["height"] for rec in tl["heights"]] == [5, 6]
+    h5 = tl["heights"][0]["stages"]
+    assert h5["consensus.propose"]["count"] == 1
+    assert h5["consensus.propose"]["total_ms"] >= 1.0
+    assert "consensus.commit" in h5
+    # cross-height stage aggregate counts every span, attributed or not
+    assert tl["stages"]["consensus.propose"]["count"] == 2
+    assert tl["stages"]["unattributed"]["count"] == 1
+    # height filter
+    only6 = t.timeline(height=6)
+    assert [rec["height"] for rec in only6["heights"]] == [6]
+
+
+def test_set_capacity_trims():
+    t = Tracer(buffer_events=100)
+    for i in range(50):
+        t.instant("e", i=i)
+    t.set_capacity(10)
+    assert t.stats()["buffer_events"] == 10
+    assert t.stats()["events_dropped"] == 40
+
+
+# -- live node: the acceptance-criteria path --------------------------------
+
+
+def test_dump_trace_on_running_node(tmp_path):
+    """dump_trace on a live local node returns Chrome trace-event JSON
+    containing consensus step, pipeline bundle, and merkle routing
+    spans for at least one committed height; trace_timeline attributes
+    per-stage latency to committed heights."""
+    from tendermint_tpu.cli import main as cli_main
+    from tendermint_tpu.config import load_config
+    from tendermint_tpu.node import default_new_node
+    from tendermint_tpu.rpc.client import HTTPClient
+    from tendermint_tpu.rpc.server import RPCServer
+
+    async def go():
+        home = str(tmp_path / "tracenode")
+        cli_main(["--home", home, "init", "--chain-id", "trace-chain"])
+        cfg = load_config(os.path.join(home, "config/config.toml")).set_root(home)
+        cfg.base.db_backend = "memdb"
+        cfg.base.trace_enabled = True
+        cfg.p2p.laddr = "tcp://127.0.0.1:0"
+        cfg.rpc.laddr = "tcp://127.0.0.1:0"
+        cfg.consensus.timeout_commit_ms = 50
+        cfg.consensus.skip_timeout_commit = True
+        node = default_new_node(cfg)
+        node.rpc_server = RPCServer(node)
+        await node.start()
+        try:
+            await node.consensus_state.wait_for_height(2, timeout_s=30)
+            addr = node.rpc_server.listen_addr
+            c = HTTPClient(f"{addr.host}:{addr.port}")
+            doc = await c.call("dump_trace")
+            # round-trips as JSON and is a Chrome trace-event document
+            doc = json.loads(json.dumps(doc))
+            evs = doc["traceEvents"]
+            assert all(e["ph"] in ("X", "i", "M") for e in evs)
+            names = {e["name"] for e in evs if e["ph"] == "X"}
+            # consensus steps for a committed height
+            committed = {
+                e["args"]["height"]
+                for e in evs
+                if e["ph"] == "X"
+                and e["name"] == "consensus.finalize_commit"
+            }
+            assert committed, f"no finalize_commit spans in {sorted(names)}"
+            assert "consensus.propose" in names
+            assert "consensus.prevote" in names
+            assert "consensus.precommit" in names
+            assert "consensus.commit" in names
+            # pipeline bundle lifecycle (crypto_pipeline is on by default)
+            assert "pipeline.execute" in names, sorted(names)
+            # merkle routing (host path on this small chain)
+            assert "merkle.root" in names or "merkle.proof_set" in names
+            # wal + rpc spans ride along
+            assert "wal.fsync" in names
+            # per-height timeline attributes stages to a committed height
+            tl = await c.call("trace_timeline")
+            heights = {rec["height"]: rec for rec in tl["heights"]}
+            h = min(committed)
+            assert h in heights
+            assert "consensus.finalize_commit" in heights[h]["stages"]
+            assert tl["tracer"]["enabled"] == 1
+            # height filter works over RPC
+            tl1 = await c.call("trace_timeline", height=h)
+            assert [rec["height"] for rec in tl1["heights"]] == [h]
+        finally:
+            await node.stop()
+
+    asyncio.run(go())
