@@ -29,7 +29,10 @@ from typing import Optional
 
 from tendermint_tpu.blockchain import messages as m
 from tendermint_tpu.blockchain.pool import BlockPool
-from tendermint_tpu.blockchain.verify_window import CommitVerifyWindow
+from tendermint_tpu.blockchain.verify_window import (
+    DEFAULT_AWAIT_DEADLINE_S,
+    CommitVerifyWindow,
+)
 from tendermint_tpu.blockchain.reactor import (
     BLOCKCHAIN_CHANNEL,
     STATUS_UPDATE_INTERVAL_S,
@@ -52,6 +55,7 @@ class BlockchainReactorV0(Reactor):
         logger=None,
         verify_depth: Optional[int] = None,
         provider=None,
+        verify_deadline_s: Optional[float] = DEFAULT_AWAIT_DEADLINE_S,
     ):
         super().__init__("blockchain")
         self.logger = logger or get_logger("blockchain.v0")
@@ -62,7 +66,12 @@ class BlockchainReactorV0(Reactor):
         self._consensus_reactor = consensus_reactor
         self.pool = BlockPool(start_height=state.last_block_height + 1)
         self._switched = False
-        self._verify_window = CommitVerifyWindow(depth=verify_depth, provider=provider)
+        # None passes through as "wait forever" — the documented meaning
+        # of watchdog_future_deadline_ms = 0, not a reset to the default
+        self._verify_window = CommitVerifyWindow(
+            depth=verify_depth, provider=provider,
+            await_deadline_s=verify_deadline_s,
+        )
 
     def get_channels(self):
         return [

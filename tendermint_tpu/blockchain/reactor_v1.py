@@ -32,7 +32,10 @@ from tendermint_tpu.blockchain.v1 import (
     FsmV1,
     ToReactor,
 )
-from tendermint_tpu.blockchain.verify_window import CommitVerifyWindow
+from tendermint_tpu.blockchain.verify_window import (
+    DEFAULT_AWAIT_DEADLINE_S,
+    CommitVerifyWindow,
+)
 from tendermint_tpu.p2p.conn.connection import ChannelDescriptor
 from tendermint_tpu.p2p.peer import Peer
 from tendermint_tpu.p2p.switch import Reactor
@@ -52,6 +55,7 @@ class BlockchainReactorV1(Reactor, ToReactor):
         logger=None,
         verify_depth: Optional[int] = None,
         provider=None,
+        verify_deadline_s: Optional[float] = DEFAULT_AWAIT_DEADLINE_S,
     ):
         Reactor.__init__(self, "blockchain")
         self.logger = logger or get_logger("blockchain.v1")
@@ -62,7 +66,12 @@ class BlockchainReactorV1(Reactor, ToReactor):
         self._consensus_reactor = consensus_reactor
         self.fsm = FsmV1(state.last_block_height + 1, self)
         self._switched = False
-        self._verify_window = CommitVerifyWindow(depth=verify_depth, provider=provider)
+        # None passes through as "wait forever" — the documented meaning
+        # of watchdog_future_deadline_ms = 0, not a reset to the default
+        self._verify_window = CommitVerifyWindow(
+            depth=verify_depth, provider=provider,
+            await_deadline_s=verify_deadline_s,
+        )
         self._timer_task: Optional[asyncio.Task] = None
         self._timer_gen = 0
 

@@ -37,12 +37,26 @@ from tendermint_tpu.utils import trace
 
 DEFAULT_VERIFY_DEPTH = 8
 
+# Default ceiling on how long verify_pair waits for an in-flight future
+# before falling back to serial verification. Generous next to a
+# healthy device call (ms) but bounded: a pipeline whose exec thread
+# died mid-bundle must delay fast sync by at most this long per height,
+# never hang it (node wiring overrides from watchdog_future_deadline_ms).
+DEFAULT_AWAIT_DEADLINE_S = 10.0
+
 
 class CommitVerifyWindow:
-    def __init__(self, depth: Optional[int] = None, provider=None):
+    def __init__(
+        self,
+        depth: Optional[int] = None,
+        provider=None,
+        await_deadline_s: Optional[float] = DEFAULT_AWAIT_DEADLINE_S,
+    ):
         self._depth = depth
         self._provider = provider
         self._inflight: Dict[int, dict] = {}
+        self.await_deadline_s = await_deadline_s  # None = wait forever
+        self.deadline_fallbacks = 0
 
     def provider(self):
         return self._provider if self._provider is not None else get_default_provider()
@@ -142,12 +156,43 @@ class CommitVerifyWindow:
         height = first.header.height
         ent = self.take(height, first, second, validators)
         if ent is not None:
-            with trace.span("verify_window.await", height=height, pipelined=True):
+            with trace.span("verify_window.await", height=height, pipelined=True) as sp:
+                stuck = False
                 try:
-                    err = await asyncio.wrap_future(ent["future"])
+                    fut = asyncio.wrap_future(ent["future"])
+                    if self.await_deadline_s is not None:
+                        err = await asyncio.wait_for(fut, self.await_deadline_s)
+                    else:
+                        err = await fut
                 except Exception as e:
-                    err = e
-            return ent["parts"], ent["bid"], err
+                    from tendermint_tpu.crypto.pipeline import _is_liveness_error
+
+                    if not isinstance(
+                        e, (asyncio.TimeoutError, TimeoutError)
+                    ) and not _is_liveness_error(e):
+                        # a real verification verdict — surface it
+                        err = e
+                    else:
+                        # the pipeline failed this REQUEST, not the
+                        # signatures: the future never resolved (dead
+                        # exec thread, wedged device), the watchdog
+                        # deadline fired, or shutdown/restart failed the
+                        # bundle with PipelineShutdownError. Drop the
+                        # whole window — its siblings rode the same
+                        # machinery — and verify serially; returning the
+                        # liveness error as a verdict would make the
+                        # reactors punish an honest peer for a good
+                        # block.
+                        stuck = True
+                        self.deadline_fallbacks += 1
+                        self.clear()
+                        if sp is not trace.NOOP_SPAN:
+                            sp.set(deadline_fallback=True)
+                        trace.instant(
+                            "verify_window.deadline_fallback", height=height
+                        )
+            if not stuck:
+                return ent["parts"], ent["bid"], err
         with trace.span("verify_window.serial_verify", height=height, pipelined=False):
             parts = first.make_part_set()
             bid = BlockID(hash=first.hash(), parts=parts.header())

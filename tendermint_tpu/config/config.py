@@ -86,6 +86,25 @@ class BaseConfig:
     # the oldest events are evicted (and counted) once it fills.
     trace_enabled: bool = False
     trace_buffer_events: int = 65536
+    # Self-healing supervision (utils/watchdog.py): a daemon thread that
+    # restarts dead pipeline workers, flags stalled pumps/height
+    # progress, and enforces resolution deadlines on pipeline /
+    # verify-window futures (a stuck future fails with a timeout and the
+    # caller falls back to serial verify instead of hanging).
+    # TM_WATCHDOG=0/1 overrides watchdog_enabled without editing toml.
+    watchdog_enabled: bool = True
+    watchdog_interval_ms: int = 1000
+    # deadline for pipeline-submitted futures and the fast-sync verify
+    # window await; 0 disables future deadlines
+    watchdog_future_deadline_ms: int = 10_000
+    # consensus height unchanged for this long -> a health stall is
+    # recorded (metric + trace instant; no restart). 0 disables.
+    watchdog_height_stall_ms: int = 120_000
+    # Circuit-breaker defaults for the device engines (verifier tables,
+    # merkle compile, merkle device path): consecutive failures before
+    # tripping open, and how long before a half-open recovery probe.
+    breaker_failure_threshold: int = 3
+    breaker_cooldown_ms: int = 30_000
 
     def genesis_file(self) -> str:
         return _rootify(self.genesis_file_name, self.root_dir)
@@ -115,6 +134,16 @@ class BaseConfig:
             return "merkle_device_threshold must be >= 2"
         if self.trace_buffer_events < 1:
             return "trace_buffer_events must be >= 1"
+        if self.watchdog_interval_ms < 1:
+            return "watchdog_interval_ms must be >= 1"
+        if self.watchdog_future_deadline_ms < 0:
+            return "watchdog_future_deadline_ms can't be negative"
+        if self.watchdog_height_stall_ms < 0:
+            return "watchdog_height_stall_ms can't be negative"
+        if self.breaker_failure_threshold < 1:
+            return "breaker_failure_threshold must be >= 1"
+        if self.breaker_cooldown_ms < 0:
+            return "breaker_cooldown_ms can't be negative"
         return None
 
 

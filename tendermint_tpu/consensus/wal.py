@@ -19,6 +19,7 @@ import zlib
 from typing import Iterator, Optional, Tuple
 
 from tendermint_tpu.consensus.messages import EndHeightMessage, decode_msg, encode_msg
+from tendermint_tpu.utils import faultinject as faults
 from tendermint_tpu.utils import trace
 from tendermint_tpu.utils.log import get_logger
 
@@ -197,8 +198,22 @@ class BaseWAL(WAL):
         if self._fp is None:
             return
         try:
-            self._fp.write(_frame(encode_msg(msg)))
-        except WALWriteError:
+            faults.maybe("wal.write")
+            data = _frame(encode_msg(msg))
+            # torn-write injection ("wal.fsync" armed with `tear`): the
+            # frame is cut mid-record, what was written is made durable
+            # — exactly the on-disk state a crash between write and
+            # fsync completion leaves — and the fault propagates like
+            # the crash would. start() repairs the torn tail.
+            torn = faults.tear("wal.fsync", data)
+            if torn is not None:
+                self._fp.write(torn)
+                self.flush_and_sync()
+                raise faults.InjectedFault(
+                    f"torn WAL write ({len(torn)}/{len(data)} bytes)"
+                )
+            self._fp.write(data)
+        except (WALWriteError, faults.InjectedFault):
             raise
         except Exception as e:
             raise WALWriteError(str(e))
@@ -215,6 +230,7 @@ class BaseWAL(WAL):
         if self._fp is None:
             return
         with trace.span("wal.fsync"):
+            faults.maybe("wal.fsync")
             self._fp.flush()
             os.fsync(self._fp.fileno())
 

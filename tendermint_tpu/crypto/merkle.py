@@ -61,6 +61,23 @@ _DEVICE_THRESHOLD = max(2, int(os.environ.get("TM_MERKLE_DEVICE_THRESHOLD", "102
 _DEVICE_BLOCK_ON_COMPILE = False
 _HASHER = None
 _HOST_STATS = {"host_roots": 0, "host_proof_sets": 0}
+# Runtime-failure circuit breaker for the device path: consecutive
+# device errors trip it open and every qualifying tree goes host
+# WITHOUT even attempting the device; after the cooldown one half-open
+# probe re-enables it (utils/watchdog.py; replaces retry-forever).
+# Created lazily with the hasher so importing merkle registers nothing.
+_DEVICE_BREAKER = None
+
+
+def _device_breaker():
+    global _DEVICE_BREAKER
+    if _DEVICE_BREAKER is None:
+        from tendermint_tpu.utils.watchdog import CircuitBreaker
+
+        with _DEVICE_LOCK:
+            if _DEVICE_BREAKER is None:
+                _DEVICE_BREAKER = CircuitBreaker("merkle.device")
+    return _DEVICE_BREAKER
 
 
 def configure_device(
@@ -145,7 +162,7 @@ def hash_from_byte_slices(items: Sequence[bytes]) -> bytes:
         return _sha(b"")
     if n == 1:
         return leaf_hash(items[0])
-    if _DEVICE_ENABLED and n >= _DEVICE_THRESHOLD:
+    if _DEVICE_ENABLED and n >= _DEVICE_THRESHOLD and _device_breaker().allow():
         h = _device_hasher()
         if h is not None:
             with trace.span("merkle.root", leaves=n, path="device") as sp:
@@ -153,9 +170,19 @@ def hash_from_byte_slices(items: Sequence[bytes]) -> bytes:
                     root = h.root(items)
                 except Exception:
                     root = None  # degrade to host, never raise into hashing
+                    _device_breaker().record_failure()
+                else:
+                    if root is None:
+                        # declined without an error (cold bucket, shape
+                        # over the caps): no verdict — return the probe
+                        # token so the breaker can't latch half-open
+                        _device_breaker().release_probe()
                 if root is not None:
+                    _device_breaker().record_success()
                     return root
                 sp.set(path="device_declined")  # falling through to host
+        else:
+            _device_breaker().release_probe()
     with trace.span("merkle.root", leaves=n, path="host"):
         _HOST_STATS["host_roots"] += 1
         level = [leaf_hash(it) for it in items]
@@ -249,7 +276,7 @@ def proofs_from_byte_slices(items: Sequence[bytes]) -> tuple:
     extracted positionally (no trail-node graph), bit-identical to the
     host path below it."""
     n = len(items)
-    if _DEVICE_ENABLED and n >= _DEVICE_THRESHOLD:
+    if _DEVICE_ENABLED and n >= _DEVICE_THRESHOLD and _device_breaker().allow():
         h = _device_hasher()
         if h is not None:
             with trace.span("merkle.proof_set", leaves=n, path="device") as sp:
@@ -257,7 +284,12 @@ def proofs_from_byte_slices(items: Sequence[bytes]) -> tuple:
                     out = h.tree(items)
                 except Exception:
                     out = None  # degrade to host, never raise into hashing
+                    _device_breaker().record_failure()
+                else:
+                    if out is None:
+                        _device_breaker().release_probe()  # declined, no verdict
                 if out is not None:
+                    _device_breaker().record_success()
                     levels, counts = out
                     root = bytes(levels[-1][0])
                     aunts = _aunts_from_levels(levels, counts)
@@ -270,6 +302,8 @@ def proofs_from_byte_slices(items: Sequence[bytes]) -> tuple:
                     ]
                     return root, proofs
                 sp.set(path="device_declined")
+        else:
+            _device_breaker().release_probe()
     with trace.span("merkle.proof_set", leaves=n, path="host"):
         trails, root_node = _trails_from_byte_slices(list(items))
         root = root_node.hash

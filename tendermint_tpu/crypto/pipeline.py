@@ -54,6 +54,7 @@ import time
 import numpy as np
 
 from tendermint_tpu.crypto.batch import BatchVerifier, CPUBatchVerifier
+from tendermint_tpu.utils import faultinject as faults
 from tendermint_tpu.utils import trace
 
 # Largest single dispatch the grouper will build; matches the verifier
@@ -207,6 +208,21 @@ class _Bundle:
 _SENTINEL = object()
 
 
+class PipelineShutdownError(Exception):
+    """The pipeline stopped (or a worker wedged through shutdown) before
+    this request was executed."""
+
+
+def _is_liveness_error(e: Exception) -> bool:
+    """Errors meaning 'the pipeline failed this request, not the
+    signatures' — the sync interface retries those serially."""
+    from concurrent.futures import CancelledError
+
+    from tendermint_tpu.utils.watchdog import FutureDeadlineError
+
+    return isinstance(e, (FutureDeadlineError, PipelineShutdownError, CancelledError))
+
+
 class PipelinedVerifier(BatchVerifier):
     """Future-based micro-batching front end over ``inner``.
 
@@ -255,15 +271,87 @@ class PipelinedVerifier(BatchVerifier):
         self.bundle_dup_rows = 0  # in-bundle duplicate rows collapsed
         self.max_queue_depth = 0
         self._occupancy_sum = 0  # requests per bundle, summed
+        self.worker_restarts = 0
+        self.fallback_serial = 0  # sync callers that timed out + verified serially
 
-        self._dispatch_t = threading.Thread(
-            target=self._dispatch_loop, daemon=True, name="verify-dispatch"
+        # watchdog integration (attach_watchdog): every submitted future
+        # gets a resolution deadline, so a crashed exec thread can never
+        # strand a caller — the future fails with FutureDeadlineError
+        # and sync paths fall back to a direct inner call.
+        self._watchdog = None
+        self._deadline_s: Optional[float] = None
+
+        # bundle currently executing (or abandoned by a dead exec
+        # thread) — what _fail_leftovers resolves that the queues can't
+        self._inflight_bundle: Optional[_Bundle] = None
+        # set by _fail_leftovers: from then on the dispatch thread must
+        # fail any bundle it holds instead of depositing it (nobody
+        # will drain the handoff slot again)
+        self._leftovers_failed = False
+
+        self._dispatch_t = self._spawn("dispatch")
+        self._exec_t = self._spawn("exec")
+
+    def _spawn(self, which: str) -> threading.Thread:
+        target = self._dispatch_loop if which == "dispatch" else self._exec_loop
+        t = threading.Thread(target=target, daemon=True, name=f"verify-{which}")
+        t.start()
+        return t
+
+    # -- supervision (utils/watchdog.py wiring) ----------------------------
+
+    def attach_watchdog(self, wd, deadline_s: Optional[float] = None) -> None:
+        """Register the dispatch/exec threads for restart-on-death and
+        (optionally) put a resolution deadline on every submitted
+        future. Liveness treats a stopped pipeline as healthy — its
+        threads are SUPPOSED to be gone."""
+        self._watchdog = wd
+        self._deadline_s = deadline_s
+        wd.register_worker(
+            "pipeline.dispatch",
+            lambda: self._stopped or self._dispatch_t.is_alive(),
+            self.restart_workers,
         )
-        self._exec_t = threading.Thread(
-            target=self._exec_loop, daemon=True, name="verify-exec"
+        wd.register_worker(
+            "pipeline.exec",
+            lambda: self._stopped or self._exec_t.is_alive(),
+            self.restart_workers,
         )
-        self._dispatch_t.start()
-        self._exec_t.start()
+
+    def workers_alive(self) -> bool:
+        return self._dispatch_t.is_alive() and self._exec_t.is_alive()
+
+    def restart_workers(self) -> List[str]:
+        """Replace dead dispatch/exec threads (watchdog restart hook;
+        also callable directly). Work still queued is picked up by the
+        replacements; a bundle that died IN the exec thread is lost —
+        its futures resolve via the watchdog deadline. Thread-safe and
+        idempotent: live threads are left alone."""
+        restarted: List[str] = []
+        orphan = None
+        with self._cv:
+            if self._stopped:
+                return restarted
+            if not self._dispatch_t.is_alive():
+                self._dispatch_t = self._spawn("dispatch")
+                restarted.append("dispatch")
+            if not self._exec_t.is_alive():
+                # the bundle the dead thread was holding is unrecoverable
+                # work: fail its futures NOW (liveness error -> sync
+                # callers re-verify serially) instead of leaving them to
+                # the deadline — or to nothing, if none is configured
+                orphan = self._inflight_bundle
+                self._inflight_bundle = None
+                self._exec_t = self._spawn("exec")
+                restarted.append("exec")
+            self.worker_restarts += len(restarted)
+        if orphan is not None:
+            err = PipelineShutdownError("exec worker died holding this bundle")
+            for it in orphan.items:
+                self._resolve(it.fut, exc=err)
+        if restarted:
+            trace.instant("pipeline.workers_restarted", which=",".join(restarted))
+        return restarted
 
     # -- submit API --------------------------------------------------------
 
@@ -361,25 +449,81 @@ class PipelinedVerifier(BatchVerifier):
                 self.submitted_rows += item.n
                 self.max_queue_depth = max(self.max_queue_depth, len(self._q))
                 self._cv.notify_all()
+                if self._watchdog is not None and self._deadline_s is not None:
+                    self._watchdog.watch_future(
+                        item.fut, self._deadline_s, name=f"pipeline.{item.kind}"
+                    )
                 return
         # stopped: run inline so teardown races degrade gracefully
         # instead of hanging a caller on a future nobody will resolve
         self._run_bundle(self._prep([item]))
 
     # -- BatchVerifier interface (sync callers share the queue) ------------
+    #
+    # A sync caller blocking on .result() must never hang on a wedged
+    # pipeline: when a watchdog deadline is configured, a future that
+    # fails with a deadline/shutdown error is re-verified SERIALLY
+    # against the inner provider — the exact call the caller would have
+    # made with the pipeline disabled. Without a watchdog the behavior
+    # is unchanged (wait indefinitely, like any Future).
+
+    def _await_or_serial(self, fut: Future, serial):
+        try:
+            return fut.result()
+        except Exception as e:
+            if not _is_liveness_error(e):
+                raise
+        with self._cv:
+            self.fallback_serial += 1
+        trace.instant("pipeline.fallback_serial")
+        return serial()
 
     def verify_batch(self, pubkeys, msgs, sigs, msg_lens=None) -> np.ndarray:
-        return self.submit_batch(pubkeys, msgs, sigs, msg_lens=msg_lens).result()
+        return self._await_or_serial(
+            self.submit_batch(pubkeys, msgs, sigs, msg_lens=msg_lens),
+            lambda: self.inner.verify_batch(pubkeys, msgs, sigs, msg_lens=msg_lens),
+        )
 
     def verify_rows_cached(self, valset_key, all_pubkeys, row_idx, msgs, sigs):
-        return self.submit_rows(valset_key, all_pubkeys, row_idx, msgs, sigs).result()
+        def serial():
+            out = None
+            f = getattr(self.inner, "verify_rows_cached", None)
+            if f is not None:
+                out = f(valset_key, all_pubkeys, row_idx, msgs, sigs)
+            if out is None:
+                pk = np.asarray(all_pubkeys, dtype=np.uint8)[
+                    np.asarray(row_idx, dtype=np.int32)
+                ]
+                out = self.inner.verify_batch(pk, msgs, sigs)
+            return np.asarray(out)
+
+        return self._await_or_serial(
+            self.submit_rows(valset_key, all_pubkeys, row_idx, msgs, sigs), serial
+        )
 
     def verify_rows_cached_templated(
         self, valset_key, all_pubkeys, row_idx, templates, tmpl_idx, ts8, sigs
     ):
-        return self.submit_rows_templated(
-            valset_key, all_pubkeys, row_idx, templates, tmpl_idx, ts8, sigs
-        ).result()
+        def serial():
+            from tendermint_tpu.codec.signbytes import splice_timestamps
+
+            mg = splice_timestamps(
+                np.asarray(templates, dtype=np.uint8)[
+                    np.asarray(tmpl_idx, dtype=np.int32)
+                ],
+                np.asarray(ts8, dtype=np.uint8),
+            )
+            pk = np.asarray(all_pubkeys, dtype=np.uint8)[
+                np.asarray(row_idx, dtype=np.int32)
+            ]
+            return np.asarray(self.inner.verify_batch(pk, mg, sigs))
+
+        return self._await_or_serial(
+            self.submit_rows_templated(
+                valset_key, all_pubkeys, row_idx, templates, tmpl_idx, ts8, sigs
+            ),
+            serial,
+        )
 
     # verify_commit_batch: inherited — composes over verify_batch (the
     # host tally is microseconds; routing the rows through the shared
@@ -421,6 +565,8 @@ class PipelinedVerifier(BatchVerifier):
                 "batch_occupancy_avg": (
                     self._occupancy_sum / bundles if bundles else 0.0
                 ),
+                "worker_restarts": self.worker_restarts,
+                "fallback_serial": self.fallback_serial,
             }
         for k, v in self.cache.stats().items():
             s[f"cache_{k}"] = v
@@ -429,7 +575,12 @@ class PipelinedVerifier(BatchVerifier):
     def stop(self, drain: bool = True, timeout: float = 30.0) -> None:
         """Drain and join. With ``drain`` (the node-stop path) every
         already-submitted future completes before the threads exit;
-        without, pending futures are cancelled."""
+        without, pending futures are cancelled.
+
+        A wedged/dead worker must not turn stop() into a hang for
+        CALLERS either: if the joins time out (or a worker died before
+        stop), whatever is still queued or handed off is failed with
+        PipelineShutdownError so no ``fut.result()`` blocks forever."""
         with self._cv:
             if self._stopped:
                 return
@@ -440,6 +591,64 @@ class PipelinedVerifier(BatchVerifier):
             self._cv.notify_all()
         self._dispatch_t.join(timeout=timeout)
         self._exec_t.join(timeout=timeout)
+        if self._dispatch_t.is_alive() or self._exec_t.is_alive():
+            trace.instant(
+                "pipeline.stop_wedged",
+                dispatch_alive=self._dispatch_t.is_alive(),
+                exec_alive=self._exec_t.is_alive(),
+            )
+        self._fail_leftovers()
+
+    def _fail_leftovers(self) -> None:
+        """Resolve every future still reachable after shutdown: the
+        submit queue (dispatch never took it) and the handoff slot
+        (exec never ran it). Already-resolved futures are skipped by
+        _resolve's done() check."""
+        err = PipelineShutdownError("verify pipeline stopped before executing request")
+        leftovers: List[_Item] = []
+        # harvest the in-flight bundle unconditionally: a DEAD exec
+        # thread abandoned it, and a wedged-but-alive one (join timed
+        # out mid-_run_bundle, e.g. a hung device dispatch) will never
+        # finish it either — both ways its callers must not hang.
+        # Normal completion cleared the marker; a late resolution from
+        # a wedged thread that eventually wakes is swallowed by
+        # _resolve's done() check.
+        orphan = self._inflight_bundle
+        if orphan is not None:
+            self._inflight_bundle = None
+            leftovers.extend(orphan.items)
+        self._leftovers_failed = True  # before the drain: see below
+        with self._cv:
+            while self._q:
+                leftovers.append(self._q.popleft())
+        # drain the handoff slot — and KEEP draining while the dispatch
+        # thread is alive: a dispatcher blocked in put() succeeds the
+        # instant the first get frees the slot, re-stranding its bundle
+        # where nobody would fail it. Bounded: dispatch also fails its
+        # own bundle once it observes _leftovers_failed (set above), so
+        # one of the two sides always resolves those futures.
+        deadline = time.monotonic() + 2.0
+        while True:
+            try:
+                bundle = self._hand.get_nowait()
+            except queue.Empty:
+                if not self._dispatch_t.is_alive() or time.monotonic() >= deadline:
+                    break
+                time.sleep(0.01)
+                continue
+            if bundle is _SENTINEL:
+                continue
+            leftovers.extend(bundle.items)
+        for it in leftovers:
+            self._resolve(it.fut, exc=err)
+        # dispatch died before delivering its shutdown sentinel: wake a
+        # still-live exec thread so it can exit instead of blocking on
+        # the handoff forever
+        if self._exec_t.is_alive() and not self._dispatch_t.is_alive():
+            try:
+                self._hand.put_nowait(_SENTINEL)
+            except queue.Full:  # pragma: no cover - race
+                pass
 
     # context-manager sugar for tests/benches
     def __enter__(self) -> "PipelinedVerifier":
@@ -452,6 +661,10 @@ class PipelinedVerifier(BatchVerifier):
 
     def _dispatch_loop(self) -> None:
         while True:
+            # chaos site: a raise HERE (before any item is popped) kills
+            # the dispatch thread without losing work — queued items wait
+            # for the watchdog to start a replacement
+            faults.maybe("pipeline.dispatch")
             with self._cv:
                 while not self._q and not self._stopped:
                     self._cv.wait()
@@ -495,8 +708,28 @@ class PipelinedVerifier(BatchVerifier):
                 for it in group:
                     self._resolve(it.fut, exc=e)
                 continue
-            self._hand.put(bundle)  # blocks while exec runs the prior bundle
-        self._hand.put(_SENTINEL)
+            # blocks while exec runs the prior bundle — but never
+            # forever: once stop() has failed the leftovers, a deposit
+            # would strand these futures in the handoff slot, so fail
+            # them here instead
+            while True:
+                try:
+                    self._hand.put(bundle, timeout=0.2)
+                    break
+                except queue.Full:
+                    if self._leftovers_failed:
+                        err = PipelineShutdownError(
+                            "verify pipeline stopped before executing request"
+                        )
+                        for it in bundle.items:
+                            self._resolve(it.fut, exc=err)
+                        break
+        try:
+            # sentinel only matters to a LIVE exec thread (which drains
+            # the slot promptly); don't block on a dead one
+            self._hand.put(_SENTINEL, timeout=1.0)
+        except queue.Full:  # pragma: no cover - exec dead with full slot
+            pass
 
     def _take_group_locked(self) -> List[_Item]:
         """Pop the maximal leading run of the queue that can share one
@@ -646,7 +879,18 @@ class PipelinedVerifier(BatchVerifier):
             bundle = self._hand.get()
             if bundle is _SENTINEL:
                 break
+            # tracked so stop()/restart can reach this bundle's futures
+            # if the thread dies mid-execution; cleared ONLY on normal
+            # completion — an escaping exception (thread death) must
+            # leave the marker for _fail_leftovers/restart_workers
+            self._inflight_bundle = bundle
+            # chaos site: a raise HERE kills the exec thread WITH a
+            # bundle in hand — the harshest pipeline failure. Those
+            # futures resolve via the watchdog deadline, restart, or
+            # stop(); callers then fall back to serial verify.
+            faults.maybe("pipeline.exec")
             self._run_bundle(bundle)
+            self._inflight_bundle = None
 
     @staticmethod
     def _resolve(fut: Future, value=None, exc: Optional[Exception] = None) -> None:

@@ -56,8 +56,10 @@ if jax.config.jax_compilation_cache_dir is None:
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
 
 from tendermint_tpu.ops import sha256 as ops_sha  # noqa: E402
+from tendermint_tpu.utils import faultinject as faults  # noqa: E402
 from tendermint_tpu.utils import trace
 from tendermint_tpu.utils.log import get_logger  # noqa: E402
+from tendermint_tpu.utils.watchdog import CircuitBreaker  # noqa: E402
 
 # Leaf-count buckets (padded row counts). 10240 sits just above the 10k
 # commit-sig / validator-row shape for the same reason as the verifier's
@@ -99,9 +101,11 @@ class _Bucket:
     def __init__(self):
         self.ready = False
         self.compiling = False
-        # latched on a compile/dispatch failure: the bucket stays on the
+        # set on a compile/dispatch failure: the bucket stays on the
         # host path instead of re-running a deterministic failure (same
-        # contract as the verifier's _TablesEntry.failed)
+        # contract as the verifier's _TablesEntry.failed). No longer a
+        # PERMANENT latch: the engine's circuit breaker clears it for a
+        # half-open retry probe after its cooldown.
         self.failed = False
         self.compile_s: Optional[float] = None
 
@@ -135,6 +139,9 @@ class MerkleHasher:
             "fallback_cold": 0,
             "fallback_shape": 0,
         }
+        # compile-failure breaker: replaces the permanent _Bucket.failed
+        # latch with fail-stop + a half-open retry after cooldown
+        self.compile_breaker = CircuitBreaker("merkle.compile", failure_threshold=1)
 
     # -- bucket/compile management ----------------------------------------
 
@@ -163,11 +170,13 @@ class MerkleHasher:
         block columns reuse the update executable) and every level
         width the live calls will dispatch."""
         t0 = time.perf_counter()
+        faults.maybe("merkle.compile")
         leaf = b"\x01" * (2 * 64 - 73)
         self._device_levels([leaf] * n_pad, n_pad, 2)
         e = self._buckets[n_pad]
         e.compile_s = time.perf_counter() - t0
         e.ready = True
+        self.compile_breaker.record_success()
         self.logger.info(
             "merkle bucket compiled", leaves=n_pad,
             seconds=round(e.compile_s, 2),
@@ -177,8 +186,15 @@ class MerkleHasher:
         """True when the bucket is warm (or blocking mode compiles it
         inline); False -> caller must take the host path."""
         e = self._bucket_entry(key)
+        probed = False  # did WE take the half-open probe token?
         if e.failed:
-            return False  # latched: don't retry a doomed compile per tree
+            # fail-stop per tree, breaker-gated: one half-open probe per
+            # cooldown clears the flag and retries the compile below
+            if not self.compile_breaker.allow():
+                return False
+            probed = True
+            with self._lock:
+                e.failed = False
         if e.ready:
             return True
         if self.block_on_compile:
@@ -186,6 +202,11 @@ class MerkleHasher:
             return True
         with self._lock:
             if e.compiling or e.ready:
+                if probed and not e.ready:
+                    # a compile is already in flight; return OUR probe
+                    # token (never someone else's) — the running
+                    # compile records its own verdict on the breaker
+                    self.compile_breaker.release_probe()
                 return e.ready
             e.compiling = True
 
@@ -193,7 +214,8 @@ class MerkleHasher:
             try:
                 self._warm(key)
             except Exception as ex:  # pragma: no cover - defensive
-                e.failed = True  # latch: every retry would fail the same way
+                e.failed = True
+                self.compile_breaker.record_failure()
                 self.logger.error("merkle bucket compile failed", err=repr(ex))
             finally:
                 e.compiling = False
@@ -225,7 +247,8 @@ class MerkleHasher:
                 try:
                     self._warm(key)
                 except Exception as ex:  # pragma: no cover - defensive
-                    e.failed = True  # latch, like every other compile path
+                    e.failed = True  # breaker-gated, like the live path
+                    self.compile_breaker.record_failure()
                     self.logger.error(
                         "merkle warmup failed", bucket=key, err=repr(ex)
                     )
@@ -296,13 +319,17 @@ class MerkleHasher:
             trace.instant("merkle.device_fallback", reason="cold", leaves=len(items))
             return None
         try:
+            faults.maybe("device.hash")
             dev_levels, counts = self._device_levels(items, *shape)
         except Exception:
-            # a failing compile/dispatch would fail identically on every
-            # retry: latch the bucket onto the host path and re-raise for
-            # the caller's fallback handling (crypto/merkle.py catches)
+            # a failing compile/dispatch likely fails identically on the
+            # next retry: park the bucket on the host path (breaker-gated
+            # retry after cooldown) and re-raise for the caller's
+            # fallback handling (crypto/merkle.py catches)
             self._bucket_entry(shape[0]).failed = True
+            self.compile_breaker.record_failure()
             raise
+        self.compile_breaker.record_success()  # closes a half-open probe
         tail = ops_sha.state_to_digests(np.asarray(dev_levels[-1]))
         level = [bytes(tail[i]) for i in range(counts[-1])]
         host = self._host_finish(level)
@@ -326,10 +353,13 @@ class MerkleHasher:
             trace.instant("merkle.device_fallback", reason="cold", leaves=len(items))
             return None
         try:
+            faults.maybe("device.hash")
             dev_levels, counts = self._device_levels(items, *shape)
         except Exception:
             self._bucket_entry(shape[0]).failed = True
+            self.compile_breaker.record_failure()
             raise
+        self.compile_breaker.record_success()  # closes a half-open probe
         levels = [
             ops_sha.state_to_digests(np.asarray(lv))[:c]
             for lv, c in zip(dev_levels, counts)

@@ -65,6 +65,7 @@ if jax.config.jax_compilation_cache_dir is None:
 from tendermint_tpu.ops import ed25519 as ops_ed  # noqa: E402
 from tendermint_tpu.parallel import pad_to_multiple  # noqa: E402
 from tendermint_tpu.parallel.mesh import BATCH_AXIS  # noqa: E402
+from tendermint_tpu.utils import faultinject as faults  # noqa: E402
 from tendermint_tpu.utils.log import get_logger  # noqa: E402
 
 # Batch-size buckets (padded row counts) to bound recompilation. 10240
@@ -213,12 +214,21 @@ class _TablesEntry:
 
 class VerifierModel:
     def __init__(self, mesh=None, block_on_compile: bool = True, logger=None):
+        from tendermint_tpu.utils.watchdog import CircuitBreaker
+
         self.mesh = mesh
         self.block_on_compile = block_on_compile
         self.logger = logger or get_logger("verifier")
         self._lock = threading.Lock()
         self._entries: Dict[Tuple[str, int, int], _Entry] = {}
         self._valset_tables: Dict[bytes, _TablesEntry] = {}  # insertion-ordered LRU
+        # Table-build failure used to latch `e.failed` FOREVER: one
+        # transient device hiccup (OOM during a vote storm, a wedged
+        # runtime) downgraded that valset to the generic path until
+        # restart. The breaker keeps the fast fail-stop behavior — no
+        # retry per verify — but allows a half-open rebuild probe after
+        # the cooldown (docs/robustness.md).
+        self.tables_breaker = CircuitBreaker("verifier.tables", failure_threshold=1)
 
     # -- compiled function cache ------------------------------------------
 
@@ -484,6 +494,7 @@ class VerifierModel:
         fn = self._get_fn("verify", n_pad, msg_len)
         if fn is None:  # cold bucket, non-blocking: host fallback
             return self._cpu().verify_batch(pubkeys, msgs, sigs)
+        faults.maybe("device.verify")
         ok = fn(
             jnp.asarray(self._pad(np.asarray(pubkeys, dtype=np.uint8), n_pad)),
             jnp.asarray(self._pad(np.asarray(msgs, dtype=np.uint8), n_pad)),
@@ -681,6 +692,7 @@ class VerifierModel:
     def _build_tables(self, e: _TablesEntry, key: bytes, pubkeys: np.ndarray) -> None:
         from tendermint_tpu.models import aot_cache
 
+        faults.maybe("device.tables")
         t0 = time.perf_counter()
         v = pubkeys.shape[0]
         v_pad = _bucket(v, 1)
@@ -763,6 +775,7 @@ class VerifierModel:
         e.tables, e.a_ok, e.pk_dev = tables, a_ok, pk_dev
         e.build_s = time.perf_counter() - t0
         e.ready = True
+        self.tables_breaker.record_success()
         self.logger.info(
             "valset tables ready",
             validators=v, key=key[:8].hex(), source=e.source,
@@ -808,20 +821,38 @@ class VerifierModel:
                     del self._valset_tables[old]
         if e.ready:
             return e
+        probed = False  # did WE take the half-open probe token below?
         if e.failed:
-            return None  # build already failed for this valset: generic path
+            # failed build: circuit breaker instead of a permanent
+            # latch — fail-stop until the cooldown, then ONE half-open
+            # probe clears the latch and retries the build; everyone
+            # else keeps the generic path meanwhile
+            if not self.tables_breaker.allow():
+                return None
+            probed = True
+            e.failed = False
         if self.block_on_compile:
             with self._lock:
                 if e.building:
-                    return None  # another thread mid-build
+                    if probed:
+                        # another thread mid-build records its own
+                        # verdict; return OUR token so the breaker
+                        # can't latch half-open (only the holder may
+                        # release — flipping someone else's in-flight
+                        # probe would break the single-probe gate)
+                        self.tables_breaker.release_probe()
+                    return None
                 e.building = True
             try:
                 if not e.ready:
                     self._build_tables(e, key, pubkeys)
+                elif probed:
+                    self.tables_breaker.release_probe()  # raced ready: no build, no verdict
             except Exception as ex:
                 # the contract is None-means-fallback, never an exception
                 # escaping into commit verification
                 e.failed = True
+                self.tables_breaker.record_failure()
                 self.logger.error("valset table build failed", err=repr(ex))
                 return None
             finally:
@@ -829,6 +860,11 @@ class VerifierModel:
             return e
         with self._lock:
             if e.building or e.ready:
+                if probed:
+                    # no build attempt by US: in-flight builds record
+                    # their own verdict, a raced-ready entry records
+                    # nothing — either way return the token we hold
+                    self.tables_breaker.release_probe()
                 return e if e.ready else None
             e.building = True
         pk_copy = np.array(pubkeys, dtype=np.uint8, copy=True)
@@ -837,7 +873,10 @@ class VerifierModel:
             try:
                 self._build_tables(e, key, pk_copy)
             except Exception as ex:  # pragma: no cover - defensive
-                e.failed = True  # latch: don't retry a doomed build per verify
+                # fail-stop (don't retry a doomed build per verify), but
+                # breaker-gated: a half-open probe retries after cooldown
+                e.failed = True
+                self.tables_breaker.record_failure()
                 self.logger.error("valset table build failed", err=repr(ex))
             finally:
                 e.building = False
@@ -983,6 +1022,7 @@ class VerifierModel:
             return self._rows_cached_windowed(
                 valset_key, e, all_pubkeys, row_idx, src, sigs
             )
+        faults.maybe("device.verify")
         n_pad = _bucket(n, self._pad_multiple())
         idx_np = np.asarray(row_idx, dtype=np.int32)
         dense = self._dense_applies(e, idx_np, n, n_pad)

@@ -8,6 +8,7 @@ DefaultNewNode :90, OnStart :760 (RPC before p2p), makeNodeInfo :1090.
 
 from __future__ import annotations
 
+import asyncio
 import os
 from typing import List, Optional
 
@@ -105,6 +106,41 @@ class Node(Service):
             buffer_events=config.base.trace_buffer_events,
         )
 
+        # -- robustness layer (utils/faultinject.py + utils/watchdog.py) -----
+        # Breaker defaults must land BEFORE the engines below construct
+        # their breakers' first transitions; fault injection is armed by
+        # TM_FAULTS (parsed at import) — log it loudly so a chaos rig
+        # left enabled is visible at boot.
+        from tendermint_tpu.utils import faultinject as _faults
+        from tendermint_tpu.utils import watchdog as _watchdog
+
+        _watchdog.set_breaker_defaults(
+            failure_threshold=config.base.breaker_failure_threshold,
+            cooldown_s=config.base.breaker_cooldown_ms / 1000.0,
+        )
+        if _faults.enabled():
+            self.logger.error(
+                "FAULT INJECTION ARMED", sites=_faults.get_registry().armed()
+            )
+        # TM_WATCHDOG=0/1 overrides config (ops kill switch, like TM_TRACE)
+        _env_wd = os.environ.get("TM_WATCHDOG")
+        wd_enabled = (
+            config.base.watchdog_enabled if _env_wd in (None, "") else _env_wd == "1"
+        )
+        self.watchdog: Optional[_watchdog.Watchdog] = (
+            _watchdog.Watchdog(
+                interval_s=config.base.watchdog_interval_ms / 1000.0,
+                logger=self.logger,
+            )
+            if wd_enabled
+            else None
+        )
+        self._future_deadline_s: Optional[float] = (
+            config.base.watchdog_future_deadline_ms / 1000.0
+            if config.base.watchdog_future_deadline_ms > 0
+            else None
+        )
+
         # -- crypto provider (the BASELINE.json plugin seam) ----------------
         # Every VerifyCommit / VoteSet ingest / light-client call in this
         # process drains through this provider (reference behavior is the
@@ -136,6 +172,14 @@ class Node(Service):
                 depth=config.base.crypto_pipeline_depth,
                 flush_deadline_s=config.base.crypto_pipeline_flush_ms / 1000.0,
             )
+            if self.watchdog is not None:
+                # supervise the dispatch/exec threads (restart-on-death)
+                # and bound every submitted future: a dead exec thread
+                # can strand a bundle; the deadline fails those futures
+                # and callers fall back to serial verify
+                self.crypto_provider.attach_watchdog(
+                    self.watchdog, deadline_s=self._future_deadline_s
+                )
         set_default_provider(self.crypto_provider)
         self.logger.info(
             "crypto provider",
@@ -243,6 +287,14 @@ class Node(Service):
             handshake_timeout_s=config.p2p.handshake_timeout_ms / 1000.0,
             dial_timeout_s=config.p2p.dial_timeout_ms / 1000.0,
             conn_filters=conn_filters,
+            # chaos wrapper (reference p2p/fuzz.go wiring): every
+            # upgraded conn rides a FuzzedConnection when test_fuzz is
+            # on, seeded from the chaos rig's one knob (TM_FAULTS_SEED)
+            # so a fuzz-found failure replays deterministically
+            fuzz_config=(
+                config.p2p.test_fuzz_config if config.p2p.test_fuzz else None
+            ),
+            fuzz_seed=_faults.global_seed(),
         )
         self.switch = Switch(self.transport, config=config.p2p)
 
@@ -260,6 +312,7 @@ class Node(Service):
 
         from tendermint_tpu.utils.metrics import (
             CryptoMetrics,
+            HealthMetrics,
             MerkleMetrics,
             TraceMetrics,
         )
@@ -273,6 +326,7 @@ class Node(Service):
         self.crypto_metrics = CryptoMetrics(self.metrics_registry, ns)
         self.merkle_metrics = MerkleMetrics(self.metrics_registry, ns)
         self.trace_metrics = TraceMetrics(self.metrics_registry, ns)
+        self.health_metrics = HealthMetrics(self.metrics_registry, ns)
         self._block_exec_metrics_attach()
         self.metrics_server = None
         if config.instrumentation.prometheus:
@@ -435,10 +489,12 @@ class Node(Service):
         bc_kwargs = {}
         if bc_cls is not BlockchainReactor:
             # v0/v1 engines take the pipelined verify window's depth
-            # (the v2 engine batches cross-height on its own)
+            # (the v2 engine batches cross-height on its own) and the
+            # watchdog deadline on awaited commit-verify futures
             bc_kwargs = dict(
                 verify_depth=self.config.base.crypto_pipeline_depth,
                 provider=self.crypto_provider,
+                verify_deadline_s=self._future_deadline_s,
             )
         self.bc_reactor = bc_cls(
             state,
@@ -496,6 +552,42 @@ class Node(Service):
             await self.prof_server.start()
         self.spawn(self._metrics_pump())
 
+        # -- watchdog: supervise what is now running -------------------------
+        if self.watchdog is not None:
+            cs = self.consensus_state
+            loop = asyncio.get_running_loop()
+
+            def _reopen_wal() -> None:
+                # serialized with the loop's own writers/start —
+                # BaseWAL open + tail-repair from the watchdog THREAD
+                # could race consensus startup's wal.start() (is_running
+                # flips before on_start opens the head) and corrupt the
+                # head; the _fp re-check drops the restart if the loop
+                # won that race
+                def _do():
+                    if cs.is_running and cs.wal is not None and cs.wal._fp is None:
+                        cs.wal.start()
+
+                loop.call_soon_threadsafe(_do)
+
+            # WAL group: a closed/failed head while consensus runs is a
+            # dead worker; restart re-opens (and tail-repairs) the head
+            self.watchdog.register_worker(
+                "consensus.wal",
+                lambda: not cs.is_running or cs.wal is None
+                or getattr(cs.wal, "_fp", object()) is not None,
+                _reopen_wal,
+            )
+            stall_ms = self.config.base.watchdog_height_stall_ms
+            if stall_ms > 0:
+                self.watchdog.register_progress(
+                    "consensus.height", cs.height, stall_after_s=stall_ms / 1000.0
+                )
+            # metrics/trace pump: push-style heartbeat, stalled when
+            # silent for 5 pump intervals
+            self.watchdog.register_heartbeat("node.metrics_pump", stall_after_s=10.0)
+            self.watchdog.start()
+
         addr = NetAddress.parse(self.config.p2p.laddr)
         await self.transport.listen(addr.host, addr.port)
         if self.addr_book is not None:
@@ -539,6 +631,16 @@ class Node(Service):
 
             self.merkle_metrics.update(_merkle.device_stats())
             self.trace_metrics.update(_trace.get_tracer().stats())
+            from tendermint_tpu.utils import faultinject as _faults
+            from tendermint_tpu.utils import watchdog as _watchdog
+
+            self.health_metrics.update(
+                self.watchdog.stats() if self.watchdog is not None else None,
+                _watchdog.breaker_stats(),
+                _faults.stats(),
+            )
+            if self.watchdog is not None:
+                self.watchdog.heartbeat("node.metrics_pump")
             await asyncio.sleep(2.0)
 
     def _only_validator_is_us(self, state: State) -> bool:
@@ -550,6 +652,9 @@ class Node(Service):
         return addr == self.priv_validator.get_pub_key().address()
 
     async def on_stop(self) -> None:
+        # watchdog first: nothing may be "restarted" mid-teardown
+        if self.watchdog is not None:
+            self.watchdog.stop()
         await self.switch.stop()
         # drain the pipelined verify dispatcher: every already-submitted
         # future completes before its threads exit (crypto/pipeline.py)

@@ -24,6 +24,7 @@ import time
 from dataclasses import dataclass
 from typing import Awaitable, Callable, Dict, List, Optional
 
+from tendermint_tpu.utils import faultinject as faults
 from tendermint_tpu.utils.log import get_logger
 
 MAX_PACKET_PAYLOAD = 1024
@@ -198,6 +199,10 @@ class MConnection:
                 pkt = ch.next_packet()
                 if pkt is None:
                     continue
+                # chaos site: a raise here surfaces as a connection
+                # error -> peer drop -> switch reconnect machinery; a
+                # delay suspends only this connection's coroutine
+                await faults.maybe_async("p2p.write")
                 await self._conn.write(pkt)
                 budget -= len(pkt)
                 if budget <= 0:
@@ -216,6 +221,7 @@ class MConnection:
         recv_budget = float(self._recv_rate) * 0.1
         try:
             while True:
+                await faults.maybe_async("p2p.read")
                 (pkt_type,) = struct.unpack(">B", await self._conn.read_exactly(1))
                 if pkt_type == _PKT_PING:
                     self._pong_pending = True

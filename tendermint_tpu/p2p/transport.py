@@ -15,6 +15,7 @@ from tendermint_tpu.p2p.conn.secret_connection import SecretConnection
 from tendermint_tpu.p2p.key import NodeKey, node_id_from_pubkey
 from tendermint_tpu.p2p.netaddress import NetAddress
 from tendermint_tpu.p2p.node_info import NodeInfo
+from tendermint_tpu.utils import faultinject as faults
 from tendermint_tpu.utils.log import get_logger
 
 
@@ -82,6 +83,8 @@ class Transport:
         dial_timeout_s: float = 3.0,
         conn_filters: Optional[List[ConnFilter]] = None,
         filter_timeout_s: float = 5.0,
+        fuzz_config=None,  # config.FuzzConnConfig | None
+        fuzz_seed: Optional[int] = None,
         logger=None,
     ):
         self._node_key = node_key
@@ -90,6 +93,14 @@ class Transport:
         self._dial_timeout_s = dial_timeout_s
         self.conn_filters: List[ConnFilter] = list(conn_filters or [])
         self.filter_timeout_s = filter_timeout_s
+        # chaos wrapper (reference p2p/fuzz.go, enabled by p2p.test_fuzz):
+        # when set, every upgraded connection — inbound and dialed — is
+        # wrapped in a FuzzedConnection AFTER the handshake, so the
+        # MConnection byte stream sees the drops/delays but the identity
+        # exchange stays intact (the reference wraps at MConn creation).
+        self.fuzz_config = fuzz_config
+        self._fuzz_seed = fuzz_seed
+        self._fuzz_count = 0
         self.logger = logger or get_logger("p2p.transport")
         self._server: Optional[asyncio.base_events.Server] = None
         self._accept_queue: asyncio.Queue = asyncio.Queue(maxsize=64)
@@ -148,6 +159,7 @@ class Transport:
         # to the switch with ip_registered=True.
         self.register_conn_ip(peer_host)
         try:
+            await faults.maybe_async("p2p.accept")
             await self._apply_filters((peer_host, peer_port))
         except Exception as e:
             # ANY filter failure (not just a clean rejection) must
@@ -187,6 +199,7 @@ class Transport:
     # -- dialing -----------------------------------------------------------
 
     async def dial(self, addr: NetAddress) -> UpgradedConn:
+        await faults.maybe_async("p2p.dial")
         # same register-then-filter discipline as the inbound path; ANY
         # filter failure must release the IP slot, not just ErrRejected
         self.register_conn_ip(addr.host)
@@ -248,8 +261,25 @@ class Transport:
         if err:
             raise ErrRejected(err)
         return UpgradedConn(
-            conn=sc, node_info=their_info, remote_addr=remote_addr, outbound=outbound
+            conn=self._maybe_fuzz(sc), node_info=their_info,
+            remote_addr=remote_addr, outbound=outbound,
         )
+
+    def _maybe_fuzz(self, conn):
+        """Wrap in FuzzedConnection when p2p.test_fuzz armed this
+        transport. Each conn gets its own deterministic RNG stream:
+        (seed, wrap ordinal) — reproducible chaos without every conn
+        replaying the identical drop pattern."""
+        if self.fuzz_config is None:
+            return conn
+        from tendermint_tpu.p2p.fuzz import FuzzedConnection
+
+        self._fuzz_count += 1
+        seed = None
+        if self._fuzz_seed is not None:
+            seed = self._fuzz_seed + self._fuzz_count
+        self.logger.info("fuzzing connection", mode=self.fuzz_config.mode, seed=seed)
+        return FuzzedConnection.from_config(conn, self.fuzz_config, seed=seed)
 
     async def close(self) -> None:
         if self._server is not None:

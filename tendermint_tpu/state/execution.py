@@ -26,6 +26,7 @@ from tendermint_tpu.types.tx import Txs
 from tendermint_tpu.types.validator import Validator
 from tendermint_tpu.types.validator_set import ValidatorSet
 from tendermint_tpu.utils import fail
+from tendermint_tpu.utils import faultinject as faults
 from tendermint_tpu.utils.log import get_logger
 
 
@@ -85,6 +86,7 @@ class BlockExecutor:
         """Validate, execute and commit `block` against `state`. Returns
         (new_state, retain_height). Raises on invalid blocks or app crash."""
         t0 = time.perf_counter()
+        await faults.maybe_async("exec.apply")
         self.validate_block(state, block)
 
         abci_responses = await exec_block_on_proxy_app(
@@ -136,6 +138,7 @@ class BlockExecutor:
         try:
             if self._mempool is not None:
                 await self._mempool.flush_app_conn()
+            await faults.maybe_async("exec.commit")
             res = await self._app.commit_sync()
             self.logger.info(
                 "committed state",

@@ -1,0 +1,357 @@
+"""Seeded, deterministic fault injection for the accelerated hot path.
+
+``utils/fail.py`` covers the reference's crash matrix (FAIL_TEST_INDEX
+kills the process at numbered commit-path points), but PRs 1-3 moved
+verification, hashing and commit replay onto background threads and
+device engines that a process kill cannot exercise: a dispatch thread
+that dies, a device call that raises, a WAL write torn mid-frame, a
+p2p packet delayed past a peer timeout. This module is the chaos layer
+for THOSE failure modes: named sites in the hot path call
+
+    faults.maybe("pipeline.exec")
+
+and an armed :class:`FaultSpec` for that site deterministically raises,
+sleeps, or (for write sites, via :func:`tear`) truncates the payload.
+
+Design constraints, mirroring ``utils/trace.py``:
+
+- **One flag check when disabled.** The module-level ``maybe()`` reads
+  a single bool before touching anything else; an unfaulted production
+  node pays an attribute load + branch per site.
+- **Deterministic.** Every spec owns a ``random.Random`` seeded from
+  (global seed, site name), and triggers are gated by a per-site call
+  counter — the same program order reproduces the same faults, which
+  is what makes a chaos failure debuggable.
+- **Thread-safe.** Sites fire from the event loop, the pipeline's
+  dispatch/exec threads, and compile threads; spec state is guarded by
+  a lock (the disabled fast path takes no lock).
+
+Configuration: the ``TM_FAULTS`` env var (parsed at import, like
+``FAIL_TEST_INDEX``) or the programmatic :func:`arm` API. Spec
+grammar (see docs/robustness.md):
+
+    TM_FAULTS="site:action[:key=val]*[;site:action...]"
+
+    wal.fsync:tear:p=0.01;pipeline.exec:raise:after=5:times=1;p2p.read:delay:ms=25
+
+Actions: ``raise`` (raise :class:`InjectedFault`), ``delay`` (sleep
+``ms``), ``tear`` (:data:`TEAR_SITES` only — sites whose call point
+consumes :func:`tear`: the caller writes a truncated prefix, then
+raises; arming it elsewhere is rejected rather than silently inert). Keys: ``p`` trigger probability (default 1),
+``after`` skip the first N eligible calls, ``times`` max triggers
+(default unlimited), ``ms`` delay milliseconds (default 10), ``frac``
+torn fraction of the payload kept (default deterministic ~mid-frame).
+``TM_FAULTS_SEED`` seeds the per-site RNGs (default 0).
+
+Every trigger emits a ``fault.injected`` trace instant and bumps the
+per-site counter surfaced as ``tendermint_health_faults_injected_total``
+(docs/metrics.md).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import os
+import random
+import threading
+import time
+import zlib
+from typing import Dict, Optional
+
+from tendermint_tpu.utils import trace
+
+# The registered site taxonomy (docs/robustness.md). arm() accepts
+# unknown names (new sites appear before docs catch up) but flags them
+# in stats so a typo'd chaos spec is visible instead of silently inert.
+KNOWN_SITES = (
+    "wal.write",       # consensus/wal.py write path, before framing
+    "wal.fsync",       # consensus/wal.py flush+fsync; `tear` truncates the frame
+    "pipeline.dispatch",  # crypto/pipeline.py dispatch loop (raise kills the thread)
+    "pipeline.exec",   # crypto/pipeline.py exec loop (raise kills the thread, drops the in-hand bundle)
+    "device.verify",   # models/verifier.py device verify dispatch
+    "device.tables",   # models/verifier.py per-valset table build
+    "device.hash",     # models/hasher.py device tree dispatch
+    "merkle.compile",  # models/hasher.py bucket compile (_warm)
+    "exec.apply",      # state/execution.py apply_block entry
+    "exec.commit",     # state/execution.py app commit
+    "p2p.read",        # p2p/conn/connection.py recv routine
+    "p2p.write",       # p2p/conn/connection.py send routine
+    "p2p.accept",      # p2p/transport.py inbound upgrade path
+    "p2p.dial",        # p2p/transport.py outbound dial path
+)
+
+_ACTIONS = ("raise", "delay", "tear")
+
+# Sites whose call point actually consumes tear() — a ``tear`` spec
+# anywhere else would arm cleanly and then never fire (decide() skips
+# tear specs by design), a silently vacuous chaos config. Extend this
+# WITH the call point when a new write site adopts faults.tear().
+TEAR_SITES = ("wal.fsync",)
+
+
+class InjectedFault(Exception):
+    """An intentionally injected failure (never raised unless armed)."""
+
+
+class FaultSpec:
+    """One armed site. Mutable counters are guarded by the registry lock."""
+
+    __slots__ = (
+        "site", "action", "p", "after", "times", "delay_ms", "frac",
+        "rng", "evals", "triggers",
+    )
+
+    def __init__(
+        self,
+        site: str,
+        action: str = "raise",
+        p: float = 1.0,
+        after: int = 0,
+        times: Optional[int] = None,
+        delay_ms: float = 10.0,
+        frac: Optional[float] = None,
+        seed: Optional[int] = None,
+    ):
+        if action not in _ACTIONS:
+            raise ValueError(f"unknown fault action {action!r} (want one of {_ACTIONS})")
+        if action == "tear" and site not in TEAR_SITES:
+            raise ValueError(
+                f"site {site!r} has no tear() call point (tear works at: "
+                f"{', '.join(TEAR_SITES)})"
+            )
+        self.site = site
+        self.action = action
+        self.p = float(p)
+        self.after = int(after)
+        self.times = None if times is None else int(times)
+        self.delay_ms = float(delay_ms)
+        self.frac = None if frac is None else float(frac)
+        # (global seed, site) -> per-site stream: arming the same spec
+        # under the same seed reproduces the same trigger sequence
+        # regardless of what other sites are armed
+        base = _global_seed() if seed is None else int(seed)
+        self.rng = random.Random(base ^ zlib.crc32(site.encode()))
+        self.evals = 0
+        self.triggers = 0
+
+    def _fire(self) -> bool:
+        """Counter/probability gate. Caller holds the registry lock."""
+        self.evals += 1
+        if self.evals <= self.after:
+            return False
+        if self.times is not None and self.triggers >= self.times:
+            return False
+        if self.p < 1.0 and self.rng.random() >= self.p:
+            return False
+        self.triggers += 1
+        return True
+
+
+def _global_seed() -> int:
+    try:
+        return int(os.environ.get("TM_FAULTS_SEED", "0"))
+    except ValueError:
+        return 0
+
+
+class FaultRegistry:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._specs: Dict[str, FaultSpec] = {}
+        self.enabled = False  # fast-path flag; True iff any spec armed
+
+    # -- arming ------------------------------------------------------------
+
+    def arm(self, site: str, action: str = "raise", **kw) -> FaultSpec:
+        spec = FaultSpec(site, action, **kw)
+        with self._lock:
+            self._specs[site] = spec
+            self.enabled = True
+        return spec
+
+    def disarm(self, site: Optional[str] = None) -> None:
+        """Disarm one site, or everything when site is None."""
+        with self._lock:
+            if site is None:
+                self._specs.clear()
+            else:
+                self._specs.pop(site, None)
+            self.enabled = bool(self._specs)
+
+    def configure(self, spec_str: Optional[str]) -> None:
+        """Parse a TM_FAULTS spec string, replacing all armed sites.
+        None/empty disarms everything. All-or-nothing: every item is
+        parsed into a spec before any arming happens, so a malformed
+        item later in the string can never leave earlier items armed
+        behind a caller that catches the ValueError."""
+        specs = []
+        for item in (spec_str or "").replace(";", ",").split(","):
+            item = item.strip()
+            if not item:
+                continue
+            parts = item.split(":")
+            if len(parts) < 2:
+                raise ValueError(f"bad TM_FAULTS item {item!r} (want site:action[:k=v...])")
+            site, action = parts[0].strip(), parts[1].strip()
+            kw: Dict[str, float] = {}
+            for opt in parts[2:]:
+                k, _, v = opt.partition("=")
+                k = k.strip()
+                if not _ or k not in ("p", "after", "times", "ms", "frac", "seed"):
+                    raise ValueError(f"bad TM_FAULTS option {opt!r} in {item!r}")
+                num = float(v)
+                if k == "ms":
+                    kw["delay_ms"] = num
+                elif k in ("after", "times", "seed"):
+                    kw[k] = int(num)
+                else:
+                    kw[k] = num
+            specs.append(FaultSpec(site, action, **kw))
+        with self._lock:
+            self._specs = {s.site: s for s in specs}
+            self.enabled = bool(self._specs)
+
+    # -- firing ------------------------------------------------------------
+
+    def decide(self, site: str) -> Optional[float]:
+        """Evaluate `site`'s spec: raises :class:`InjectedFault` for an
+        armed ``raise``, returns the delay in SECONDS for an armed
+        ``delay``, None when nothing fires. ``tear`` specs never fire
+        here — they only act through tear(). Split from the sleeping so
+        async sites can await the delay instead of blocking the loop."""
+        with self._lock:
+            spec = self._specs.get(site)
+            if spec is None or spec.action == "tear" or not spec._fire():
+                return None
+            action, delay_ms = spec.action, spec.delay_ms
+        trace.instant("fault.injected", site=site, action=action)
+        if action == "raise":
+            raise InjectedFault(f"injected fault at {site}")
+        return delay_ms / 1000.0
+
+    def maybe(self, site: str) -> None:
+        """Raise or sleep (blocking) when `site` is armed."""
+        d = self.decide(site)
+        if d:
+            time.sleep(d)
+
+    def tear(self, site: str, data: bytes) -> Optional[bytes]:
+        """For write sites: the truncated prefix to write when a ``tear``
+        spec triggers (the caller writes it, syncs, and raises), else
+        None. The cut point is deterministic from the spec RNG and lands
+        strictly inside the payload (1 <= cut < len)."""
+        with self._lock:
+            spec = self._specs.get(site)
+            if (
+                spec is None
+                or spec.action != "tear"
+                or len(data) < 2
+                or not spec._fire()
+            ):
+                return None
+            if spec.frac is not None:
+                cut = max(1, min(len(data) - 1, int(len(data) * spec.frac)))
+            else:
+                cut = spec.rng.randrange(1, len(data))
+        trace.instant("fault.injected", site=site, action="tear", cut=cut)
+        return data[:cut]
+
+    # -- introspection -----------------------------------------------------
+
+    def stats(self) -> Dict[str, object]:
+        """Counters for the ``tendermint_health_*`` metric family."""
+        with self._lock:
+            return {
+                "enabled": 1 if self.enabled else 0,
+                "sites": {
+                    s.site: {
+                        "action": s.action,
+                        "evals": s.evals,
+                        "triggers": s.triggers,
+                        "known": s.site in KNOWN_SITES,
+                    }
+                    for s in self._specs.values()
+                },
+            }
+
+    def armed(self) -> Dict[str, str]:
+        with self._lock:
+            return {s.site: s.action for s in self._specs.values()}
+
+
+# -- global registry --------------------------------------------------------
+#
+# One process-wide registry (like the tracer and the crypto provider):
+# the sites live in library code that has no node handle.
+
+_registry = FaultRegistry()
+
+
+def get_registry() -> FaultRegistry:
+    return _registry
+
+
+def enabled() -> bool:
+    return _registry.enabled
+
+
+def arm(site: str, action: str = "raise", **kw) -> FaultSpec:
+    return _registry.arm(site, action, **kw)
+
+
+def disarm(site: Optional[str] = None) -> None:
+    _registry.disarm(site)
+
+
+def configure(spec_str: Optional[str]) -> None:
+    _registry.configure(spec_str)
+
+
+def stats() -> Dict[str, object]:
+    return _registry.stats()
+
+
+def global_seed() -> int:
+    """The chaos rig's base seed (``TM_FAULTS_SEED``) — shared with the
+    p2p fuzz wrapper so a whole chaos run replays from one knob."""
+    return _global_seed()
+
+
+def maybe(site: str) -> None:
+    """``faults.maybe("pipeline.exec")`` — the hot-path entry point.
+    One flag check when nothing is armed. Blocking-sleep delay: for
+    thread-resident sites (pipeline loops, compiles, WAL — whose real
+    fsync blocks its caller the same way)."""
+    r = _registry
+    if not r.enabled:
+        return
+    r.maybe(site)
+
+
+async def maybe_async(site: str) -> None:
+    """Awaitable variant for event-loop-resident sites (p2p routines,
+    block exec): a ``delay`` fault suspends only THIS coroutine via
+    asyncio.sleep — time.sleep here would freeze every peer connection,
+    consensus timer, and RPC handler on the loop, turning a simulated
+    slow peer into a whole-node stall. Same one-flag check disabled."""
+    r = _registry
+    if not r.enabled:
+        return
+    d = r.decide(site)
+    if d:
+        await asyncio.sleep(d)
+
+
+def tear(site: str, data: bytes) -> Optional[bytes]:
+    """Torn-write check for write sites; None means write normally."""
+    r = _registry
+    if not r.enabled:
+        return None
+    return r.tear(site, data)
+
+
+# TM_FAULTS is parsed at import (the chaos rig sets it before spawning
+# the node process, exactly like FAIL_TEST_INDEX).
+_env_spec = os.environ.get("TM_FAULTS")
+if _env_spec:
+    configure(_env_spec)

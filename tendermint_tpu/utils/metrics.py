@@ -392,6 +392,73 @@ class TraceMetrics:
             self._deltas.feed(getattr(self, attr), key, stats)
 
 
+class HealthMetrics:
+    """Self-healing / chaos layer health (``tendermint_health_*``):
+    watchdog restarts + stalls + future deadlines (utils/watchdog.py
+    Watchdog.stats()), circuit-breaker state/trips/recoveries for every
+    registered breaker (watchdog.breaker_stats()), and injected-fault
+    counters (utils/faultinject.py stats()). Monotonic totals are TRUE
+    counters fed by snapshot deltas, like CryptoMetrics; per-entity
+    series ride labels (worker=, breaker=, site=).
+    See docs/robustness.md."""
+
+    def __init__(self, registry: Optional[Registry] = None, namespace="tendermint"):
+        r = registry or Registry()
+        sub = "health"
+        reg = r.register
+        self.watchdog_enabled = reg(Gauge("watchdog_enabled", "1 when the watchdog supervisor thread is running.", namespace, sub))
+        self.worker_restarts = reg(Counter("worker_restarts_total", "Dead worker loops restarted by the watchdog (label: worker).", namespace, sub))
+        self.worker_stalls = reg(Counter("worker_stalls_total", "Stall episodes recorded on progress probes/heartbeats (label: worker).", namespace, sub))
+        self.future_timeouts = reg(Counter("future_timeouts_total", "Futures force-failed by a watchdog deadline.", namespace, sub))
+        self.breaker_state = reg(Gauge("breaker_state", "Circuit-breaker state: 0 closed, 1 half-open, 2 open (label: breaker).", namespace, sub))
+        self.breaker_trips = reg(Counter("breaker_trips_total", "Circuit-breaker trips to open (label: breaker).", namespace, sub))
+        self.breaker_recoveries = reg(Counter("breaker_recoveries_total", "Half-open probes that closed a breaker (label: breaker).", namespace, sub))
+        self.faults_enabled = reg(Gauge("faults_enabled", "1 when fault injection is armed (TM_FAULTS / programmatic).", namespace, sub))
+        self.faults_injected = reg(Counter("faults_injected_total", "Faults injected at registered sites (label: site).", namespace, sub))
+        self._deltas = _SnapshotCounters()
+
+    def update(
+        self,
+        watchdog_stats: Optional[dict] = None,
+        breaker_stats: Optional[dict] = None,
+        fault_stats: Optional[dict] = None,
+    ) -> None:
+        """Fold the three snapshot sources into the instruments. Any
+        source may be None (e.g. no watchdog configured)."""
+        d = self._deltas
+        if watchdog_stats is not None:
+            self.watchdog_enabled.set(watchdog_stats.get("running", 0))
+            d.feed(self.future_timeouts, "future_timeouts", watchdog_stats)
+            for worker, ws in watchdog_stats.get("workers", {}).items():
+                d.feed(
+                    self.worker_restarts.with_labels(worker=worker),
+                    f"restarts/{worker}", {f"restarts/{worker}": ws.get("restarts", 0)},
+                )
+            for name, ps in watchdog_stats.get("stalls", {}).items():
+                d.feed(
+                    self.worker_stalls.with_labels(worker=name),
+                    f"stalls/{name}", {f"stalls/{name}": ps.get("stalls", 0)},
+                )
+        if breaker_stats is not None:
+            for name, bs in breaker_stats.items():
+                self.breaker_state.with_labels(breaker=name).set(bs.get("state_code", 0))
+                d.feed(
+                    self.breaker_trips.with_labels(breaker=name),
+                    f"trips/{name}", {f"trips/{name}": bs.get("trips", 0)},
+                )
+                d.feed(
+                    self.breaker_recoveries.with_labels(breaker=name),
+                    f"recoveries/{name}", {f"recoveries/{name}": bs.get("recoveries", 0)},
+                )
+        if fault_stats is not None:
+            self.faults_enabled.set(fault_stats.get("enabled", 0))
+            for site, ss in fault_stats.get("sites", {}).items():
+                d.feed(
+                    self.faults_injected.with_labels(site=site),
+                    f"faults/{site}", {f"faults/{site}": ss.get("triggers", 0)},
+                )
+
+
 class StateMetrics:
     def __init__(self, registry: Optional[Registry] = None, namespace="tendermint"):
         r = registry or Registry()

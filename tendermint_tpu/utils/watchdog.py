@@ -1,0 +1,429 @@
+"""Self-healing supervision for the accelerated hot path.
+
+PRs 1-3 put consensus work on background machinery with no supervisor:
+the pipelined verifier's dispatch/exec threads (crypto/pipeline.py),
+the node's metrics/trace pumps, the WAL file group, and the device
+engines whose compile failures latch them off permanently
+(models/verifier.py, models/hasher.py). A dead exec thread strands
+every future behind it; a latched engine never probes the device
+again. This module supplies the two missing pieces:
+
+- :class:`Watchdog` — a daemon-thread supervisor that (a) restarts
+  registered worker loops that die, (b) flags registered progress
+  probes/heartbeats that stall, and (c) enforces deadlines on
+  ``concurrent.futures.Future``s so a stuck pipeline future resolves
+  with :class:`FutureDeadlineError` and the caller falls back to
+  serial verification instead of hanging (blockchain/verify_window.py,
+  crypto/pipeline.py sync paths).
+- :class:`CircuitBreaker` — closed/open/half-open with a cooldown:
+  failures trip it open (callers take the host path), and after
+  ``cooldown_s`` a single half-open probe is allowed through; success
+  closes it (recovery), failure re-opens it. This replaces the
+  permanent ``failed = True`` latches in the device engines.
+
+Every trip, recovery, restart, stall and deadline hit emits a trace
+instant and a counter surfaced as the ``tendermint_health_*`` metric
+family (docs/metrics.md, docs/robustness.md).
+
+Breakers register themselves in a process-wide registry (the engines
+that own them are process-wide singletons with no node handle);
+``breaker_stats()`` is what the node's metrics pump scrapes.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from concurrent.futures import Future
+from typing import Callable, Dict, List, Optional, Tuple
+
+from tendermint_tpu.utils import trace
+from tendermint_tpu.utils.log import get_logger
+
+# -- breaker defaults (node wiring overrides from config) -------------------
+
+_defaults_lock = threading.Lock()
+_DEFAULT_FAILURE_THRESHOLD = 3
+_DEFAULT_COOLDOWN_S = 30.0
+
+
+def set_breaker_defaults(
+    failure_threshold: Optional[int] = None, cooldown_s: Optional[float] = None
+) -> None:
+    """Process-wide defaults for breakers constructed without explicit
+    knobs (config ``breaker_failure_threshold`` / ``breaker_cooldown_ms``).
+    Existing breakers using defaults pick the new values up on their
+    next transition — the engines are built before config is applied."""
+    global _DEFAULT_FAILURE_THRESHOLD, _DEFAULT_COOLDOWN_S
+    with _defaults_lock:
+        if failure_threshold is not None:
+            _DEFAULT_FAILURE_THRESHOLD = max(1, int(failure_threshold))
+        if cooldown_s is not None:
+            _DEFAULT_COOLDOWN_S = max(0.0, float(cooldown_s))
+
+
+class FutureDeadlineError(TimeoutError):
+    """A watchdog deadline fired on a future nobody resolved."""
+
+
+# -- circuit breaker --------------------------------------------------------
+
+CLOSED, OPEN, HALF_OPEN = "closed", "open", "half_open"
+_STATE_CODE = {CLOSED: 0, HALF_OPEN: 1, OPEN: 2}
+
+_breakers_lock = threading.Lock()
+# keyed by name: a rebuilt engine (configure_device flips, test
+# fixtures) REPLACES its breaker rather than leaking a dead instance
+# the metrics pump would iterate forever
+_breakers: Dict[str, "CircuitBreaker"] = {}
+
+
+class CircuitBreaker:
+    """Thread-safe closed/open/half-open breaker.
+
+    ``allow()`` is the gate callers check before the protected path:
+    closed -> True; open -> False until ``cooldown_s`` has elapsed,
+    then exactly ONE caller gets True (the half-open probe) while
+    everyone else keeps getting False until the probe reports back via
+    ``record_success``/``record_failure``.
+    """
+
+    def __init__(
+        self,
+        name: str,
+        failure_threshold: Optional[int] = None,
+        cooldown_s: Optional[float] = None,
+        register: bool = True,
+    ):
+        self.name = name
+        self._failure_threshold = failure_threshold
+        self._cooldown_s = cooldown_s
+        self._lock = threading.Lock()
+        self._state = CLOSED
+        self._failures = 0  # consecutive failures while closed
+        self._opened_at = 0.0
+        self.trips = 0
+        self.recoveries = 0
+        self.probes = 0
+        if register:
+            with _breakers_lock:
+                _breakers[name] = self
+
+    # dynamic lookup: set_breaker_defaults runs AFTER process-wide
+    # engines (and their breakers) are constructed
+    @property
+    def failure_threshold(self) -> int:
+        return (
+            self._failure_threshold
+            if self._failure_threshold is not None
+            else _DEFAULT_FAILURE_THRESHOLD
+        )
+
+    @property
+    def cooldown_s(self) -> float:
+        return self._cooldown_s if self._cooldown_s is not None else _DEFAULT_COOLDOWN_S
+
+    def state(self) -> str:
+        with self._lock:
+            return self._state
+
+    def allow(self) -> bool:
+        with self._lock:
+            if self._state == CLOSED:
+                return True
+            if self._state == OPEN:
+                if time.monotonic() - self._opened_at < self.cooldown_s:
+                    return False
+                self._state = HALF_OPEN
+                self.probes += 1
+            else:  # HALF_OPEN: a probe is already in flight
+                return False
+        trace.instant("breaker.probe", breaker=self.name)
+        return True
+
+    def record_success(self) -> None:
+        with self._lock:
+            self._failures = 0
+            if self._state == CLOSED:
+                return
+            self._state = CLOSED
+            self.recoveries += 1
+        trace.instant("breaker.recovered", breaker=self.name)
+
+    def release_probe(self) -> None:
+        """Return an unused half-open probe token: the caller passed
+        ``allow()`` but never exercised the protected path (work
+        declined, another thread already mid-build), so there is no
+        verdict to record. Back to OPEN with the original trip time —
+        the cooldown has already elapsed, so the next ``allow()`` may
+        probe again immediately. No-op unless half-open; without this,
+        an indeterminate probe would latch the breaker HALF_OPEN
+        forever (every later allow() False — a permanent latch, the
+        exact failure mode breakers exist to remove)."""
+        with self._lock:
+            if self._state == HALF_OPEN:
+                self._state = OPEN
+
+    def record_failure(self) -> None:
+        with self._lock:
+            if self._state == HALF_OPEN:
+                # failed probe: straight back to open, new cooldown
+                self._state = OPEN
+                self._opened_at = time.monotonic()
+                tripped = True
+            else:
+                self._failures += 1
+                tripped = self._state == CLOSED and self._failures >= self.failure_threshold
+                if tripped:
+                    self._state = OPEN
+                    self._opened_at = time.monotonic()
+                    self._failures = 0
+            if tripped:
+                self.trips += 1
+        if tripped:
+            trace.instant("breaker.tripped", breaker=self.name)
+
+    def force_open(self) -> None:
+        """Trip immediately (ops/testing hook)."""
+        with self._lock:
+            if self._state != OPEN:
+                self.trips += 1
+            self._state = OPEN
+            self._opened_at = time.monotonic()
+            self._failures = 0
+
+    def stats(self) -> Dict[str, float]:
+        with self._lock:
+            return {
+                "state": self._state,
+                "state_code": _STATE_CODE[self._state],
+                "trips": self.trips,
+                "recoveries": self.recoveries,
+                "probes": self.probes,
+            }
+
+
+def breakers() -> List[CircuitBreaker]:
+    with _breakers_lock:
+        return list(_breakers.values())
+
+
+def breaker_stats() -> Dict[str, Dict[str, float]]:
+    """name -> stats for every LIVE registered breaker (metrics pump
+    input). Registration is keyed by name, so only the most recently
+    constructed breaker per name exists here."""
+    return {b.name: b.stats() for b in breakers()}
+
+
+def _reset_breakers_for_tests() -> None:
+    with _breakers_lock:
+        _breakers.clear()
+
+
+# -- watchdog ---------------------------------------------------------------
+
+
+class _Worker:
+    __slots__ = ("name", "is_alive", "restart", "restarts")
+
+    def __init__(self, name, is_alive, restart):
+        self.name = name
+        self.is_alive = is_alive
+        self.restart = restart
+        self.restarts = 0
+
+
+class _Probe:
+    __slots__ = ("name", "probe", "stall_after_s", "on_stall", "last_value",
+                 "last_change", "stalls", "stalled")
+
+    def __init__(self, name, probe, stall_after_s, on_stall):
+        self.name = name
+        self.probe = probe
+        self.stall_after_s = float(stall_after_s)
+        self.on_stall = on_stall
+        self.last_value = object()  # sentinel: first tick always "changes"
+        self.last_change = time.monotonic()
+        self.stalls = 0
+        self.stalled = False
+
+
+class Watchdog:
+    """Daemon-thread supervisor. Runs its checks every ``interval_s``;
+    everything registered is checked from that one thread, so restart
+    callbacks must be thread-safe (PipelinedVerifier.restart_workers
+    is; asyncio-side stall handlers should just schedule work)."""
+
+    def __init__(self, interval_s: float = 1.0, logger=None):
+        self.interval_s = max(0.01, float(interval_s))
+        self.logger = logger or get_logger("watchdog")
+        self._lock = threading.Lock()
+        self._workers: List[_Worker] = []
+        self._probes: List[_Probe] = []
+        self._heartbeats: Dict[str, _Probe] = {}
+        # (deadline, future, name); scanned each tick — the node has a
+        # handful of verify futures in flight, not thousands
+        self._futures: List[Tuple[float, Future, str]] = []
+        self.future_timeouts = 0
+        self._thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+
+    # -- registration ------------------------------------------------------
+
+    def register_worker(
+        self, name: str, is_alive: Callable[[], bool], restart: Callable[[], object]
+    ) -> None:
+        """``is_alive`` False on a tick -> ``restart`` is called (and
+        counted). Return value of restart is ignored; exceptions are
+        logged, never propagated into the watchdog loop."""
+        with self._lock:
+            self._workers.append(_Worker(name, is_alive, restart))
+
+    def register_progress(
+        self,
+        name: str,
+        probe: Callable[[], object],
+        stall_after_s: float,
+        on_stall: Optional[Callable[[str, float], None]] = None,
+    ) -> None:
+        """``probe()`` is sampled each tick; an unchanged value for
+        ``stall_after_s`` records a stall (once per stall episode)."""
+        with self._lock:
+            self._probes.append(_Probe(name, probe, stall_after_s, on_stall))
+
+    def register_heartbeat(
+        self,
+        name: str,
+        stall_after_s: float,
+        on_stall: Optional[Callable[[str, float], None]] = None,
+    ) -> None:
+        """Push-style liveness: the worker calls ``heartbeat(name)``;
+        silence for ``stall_after_s`` records a stall."""
+        p = _Probe(name, None, stall_after_s, on_stall)
+        p.last_change = time.monotonic()
+        with self._lock:
+            self._heartbeats[name] = p
+
+    def heartbeat(self, name: str) -> None:
+        p = self._heartbeats.get(name)
+        if p is not None:
+            p.last_change = time.monotonic()
+            p.stalled = False
+
+    def watch_future(self, fut: Future, deadline_s: float, name: str = "") -> Future:
+        """Resolve ``fut`` with FutureDeadlineError if still pending
+        after ``deadline_s`` (tolerating a concurrent resolution race —
+        set_exception on a completed future is swallowed)."""
+        with self._lock:
+            self._futures.append((time.monotonic() + float(deadline_s), fut, name))
+        return fut
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self) -> "Watchdog":
+        if self._thread is not None and self._thread.is_alive():
+            return self
+        self._stop.clear()
+        self._thread = threading.Thread(target=self._run, daemon=True, name="watchdog")
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        t = self._thread
+        if t is not None:
+            t.join(timeout=max(2.0, self.interval_s * 3))
+        self._thread = None
+
+    @property
+    def running(self) -> bool:
+        t = self._thread
+        return t is not None and t.is_alive()
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            try:
+                self.check_once()
+            except Exception as e:  # pragma: no cover - defensive
+                # the supervisor must never die of a bad callback
+                self.logger.error("watchdog tick failed", err=repr(e))
+
+    # -- one tick (public so tests drive it synchronously) -----------------
+
+    def check_once(self) -> None:
+        now = time.monotonic()
+        with self._lock:
+            workers = list(self._workers)
+            probes = list(self._probes)
+            beats = list(self._heartbeats.values())
+            fut_watch = self._futures
+            self._futures = [x for x in fut_watch if not x[1].done() and x[0] > now]
+            expired = [x for x in fut_watch if not x[1].done() and x[0] <= now]
+        for deadline, fut, name in expired:
+            try:
+                fut.set_exception(
+                    FutureDeadlineError(f"watchdog deadline expired on {name or 'future'}")
+                )
+            except Exception:
+                continue  # resolved in the race window: no timeout after all
+            self.future_timeouts += 1
+            trace.instant("watchdog.future_timeout", future=name)
+            self.logger.error("future deadline expired", future=name)
+        for w in workers:
+            try:
+                alive = bool(w.is_alive())
+            except Exception as e:
+                self.logger.error("liveness check failed", worker=w.name, err=repr(e))
+                continue
+            if alive:
+                continue
+            w.restarts += 1
+            trace.instant("watchdog.restart", worker=w.name)
+            self.logger.error("worker dead; restarting", worker=w.name, restarts=w.restarts)
+            try:
+                w.restart()
+            except Exception as e:
+                self.logger.error("worker restart failed", worker=w.name, err=repr(e))
+        for p in probes:
+            try:
+                v = p.probe()
+            except Exception as e:
+                self.logger.error("progress probe failed", probe=p.name, err=repr(e))
+                continue
+            if v != p.last_value:
+                p.last_value = v
+                p.last_change = now
+                p.stalled = False
+            elif not p.stalled and now - p.last_change >= p.stall_after_s:
+                self._record_stall(p, now)
+        for p in beats:
+            if not p.stalled and now - p.last_change >= p.stall_after_s:
+                self._record_stall(p, now)
+
+    def _record_stall(self, p: _Probe, now: float) -> None:
+        p.stalls += 1
+        p.stalled = True  # one record per stall episode
+        stalled_for = now - p.last_change
+        trace.instant("watchdog.stall", probe=p.name, stalled_s=round(stalled_for, 1))
+        self.logger.error("progress stalled", probe=p.name, stalled_s=round(stalled_for, 1))
+        if p.on_stall is not None:
+            try:
+                p.on_stall(p.name, stalled_for)
+            except Exception as e:
+                self.logger.error("on_stall callback failed", probe=p.name, err=repr(e))
+
+    # -- introspection -----------------------------------------------------
+
+    def stats(self) -> Dict[str, object]:
+        """Counters for the ``tendermint_health_*`` metric family."""
+        with self._lock:
+            return {
+                "running": 1 if self.running else 0,
+                "future_timeouts": self.future_timeouts,
+                "futures_watched": len(self._futures),
+                "workers": {w.name: {"restarts": w.restarts} for w in self._workers},
+                "stalls": {
+                    p.name: {"stalls": p.stalls, "stalled": 1 if p.stalled else 0}
+                    for p in self._probes + list(self._heartbeats.values())
+                },
+            }

@@ -8,6 +8,12 @@ handshake + WAL catchup.
 
 Usage: python tests/persist_node.py <root_dir> <target_height> [--txs N]
 Exits 0 when target height is committed and app state matches stores.
+
+target_height 0 is VERIFY-ONLY: reconcile the app with the stores via
+the ABCI handshake (replaying from the block store / WAL state as
+needed) and assert app-hash consistency WITHOUT running consensus —
+deterministic, so two consecutive verify-only runs must print the same
+app hash (the crash matrix asserts exactly that).
 """
 
 import asyncio
@@ -64,6 +70,24 @@ async def main(root: str, target_height: int, n_txs: int) -> int:
     handshaker = Handshaker(state_store, state, block_store, genesis)
     await handshaker.handshake(client)
     state = state_store.load()
+
+    if target_height == 0:
+        # verify-only: handshake already reconciled app vs stores above
+        final_state = state_store.load()
+        info = await client.info_sync(
+            __import__("tendermint_tpu.abci.types", fromlist=["RequestInfo"]).RequestInfo()
+        )
+        assert info.last_block_height == final_state.last_block_height, (
+            info.last_block_height, final_state.last_block_height,
+        )
+        assert info.last_block_app_hash == final_state.app_hash, (
+            info.last_block_app_hash.hex(), final_state.app_hash.hex(),
+        )
+        print(
+            f"VERIFY height={final_state.last_block_height} "
+            f"app_hash={final_state.app_hash.hex()}"
+        )
+        return 0
 
     mempool = Mempool(MempoolConfig(), client)
     block_exec = BlockExecutor(state_store, client, mempool=mempool)

@@ -1,0 +1,179 @@
+"""ISSUE-4 chaos acceptance: a live node with faults armed at EVERY
+registered site (low probability, fixed seed) still commits >= 5
+consecutive heights, with the watchdog supervising the pipeline and a
+file-backed WAL absorbing the write/fsync chaos.
+
+Site/action assignment mirrors what each site can survive (the
+taxonomy table in docs/robustness.md): sites whose failure the node is
+BUILT to absorb (pipeline thread death -> watchdog restart + deadline
+fallback; device errors -> host fallback) get `raise`; sites where a
+raise IS a crash by design (WAL, apply — that's utils/fail.py's crash
+matrix, tests/test_replay.py) get `delay`, which exercises the code
+path without asking consensus to survive its own halt policy.
+"""
+
+import asyncio
+import os
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from tests.cs_harness import make_genesis, start_network, stop_network
+from tendermint_tpu.consensus.wal import BaseWAL
+from tendermint_tpu.crypto.batch import (
+    CPUBatchVerifier,
+    get_default_provider,
+    set_default_provider,
+)
+from tendermint_tpu.crypto.pipeline import PipelinedVerifier, SigCache
+from tendermint_tpu.utils import faultinject as faults
+from tendermint_tpu.utils.faultinject import KNOWN_SITES
+from tendermint_tpu.utils.watchdog import Watchdog
+
+CHAOS_SEED = 1337
+
+# site -> (action, kwargs). Every KNOWN_SITES entry must appear: the
+# acceptance criterion is faults ENABLED at every registered site.
+CHAOS_PLAN = {
+    "wal.write": ("delay", dict(p=0.2, delay_ms=2)),
+    "wal.fsync": ("delay", dict(p=0.2, delay_ms=2)),
+    "pipeline.dispatch": ("raise", dict(after=4, times=1)),
+    "pipeline.exec": ("raise", dict(after=2, times=1)),
+    "device.verify": ("raise", dict(p=0.2)),
+    "device.tables": ("raise", dict(p=0.2)),
+    "device.hash": ("raise", dict(p=0.2)),
+    "merkle.compile": ("raise", dict(p=0.2)),
+    "exec.apply": ("delay", dict(p=0.2, delay_ms=2)),
+    "exec.commit": ("delay", dict(p=0.2, delay_ms=2)),
+    "p2p.read": ("delay", dict(p=0.1, delay_ms=1)),
+    "p2p.write": ("delay", dict(p=0.1, delay_ms=1)),
+    "p2p.accept": ("raise", dict(p=0.1)),
+    "p2p.dial": ("raise", dict(p=0.1)),
+}
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    prev = get_default_provider()
+    faults.disarm()
+    yield
+    faults.disarm()
+    set_default_provider(prev)
+
+
+def test_chaos_plan_covers_every_registered_site():
+    assert set(CHAOS_PLAN) == set(KNOWN_SITES)
+
+
+def test_chaos_node_commits_five_heights(tmp_path):
+    """Faults at every site, fixed seed, supervised pipeline, real WAL:
+    the node must still commit >= 5 consecutive heights, the chaos must
+    actually FIRE (trigger counters), and the forced pipeline.exec
+    death must be healed by the watchdog with the stranded verify
+    resolving by deadline fallback — no caller hangs."""
+
+    async def go():
+        pv = PipelinedVerifier(CPUBatchVerifier(), cache=SigCache())
+        wd = Watchdog(interval_s=0.05)
+        pv.attach_watchdog(wd, deadline_s=1.0)
+        wd.start()
+        set_default_provider(pv)
+
+        for site, (action, kw) in CHAOS_PLAN.items():
+            faults.arm(site, action, seed=CHAOS_SEED, **kw)
+
+        genesis, privs = make_genesis(1)
+        from tests.cs_harness import make_node
+
+        node = await make_node(
+            genesis, privs[0], wal=BaseWAL(str(tmp_path / "cs.wal"))
+        )
+        await node.cs.start()
+        try:
+            await node.cs.wait_for_height(5, timeout_s=90)
+        finally:
+            st = faults.stats()["sites"]  # snapshot BEFORE disarm clears it
+            await node.cs.stop()
+            faults.disarm()
+            wd.stop()
+            pv.stop(timeout=5.0)
+
+        assert node.cs.state.last_block_height >= 5
+        # the chaos was real: the hot sites were evaluated and fired
+        for site in ("wal.write", "wal.fsync", "pipeline.exec"):
+            assert st[site]["evals"] > 0, f"{site} never evaluated"
+        assert st["wal.write"]["triggers"] > 0, "WAL delay chaos never fired"
+        assert st["pipeline.exec"]["triggers"] == 1, "exec death never injected"
+        # ...and the node healed: the killed exec worker was restarted
+        pstats = pv.stats()
+        assert pstats["submitted_calls"] > 0, "consensus never used the pipeline"
+        assert pstats["worker_restarts"] >= 1, "watchdog never restarted the worker"
+        assert wd.stats()["workers"]["pipeline.exec"]["restarts"] >= 1
+        # the stranded caller resolved (fallback or retry), never hung:
+        # reaching height 5 past the injected exec death proves it —
+        # whether via deadline fallback (fallback_serial) or a restart
+        # winning the race is timing-dependent, so neither counter is
+        # asserted here (test_pipeline_exec_death_pending_commit_verify_resolves
+        # pins the fallback path deterministically)
+        # WAL survived the chaos: replayable, ENDHEIGHT for each height
+        wal = BaseWAL(str(tmp_path / "cs.wal"))
+        msgs, found = wal.search_for_end_height(5)
+        assert found, "WAL must hold ENDHEIGHT(5) after the chaos run"
+
+    asyncio.run(go())
+
+
+def test_pipeline_exec_death_pending_commit_verify_resolves(tmp_path):
+    """The acceptance clause in isolation: a pending COMMIT-verify
+    future whose exec thread was killed resolves within its deadline
+    (fallback serial verify succeeds), and the watchdog restart makes
+    the next submit_commit ride the pipeline again."""
+
+    async def go():
+        from tests.test_pipeline import CHAIN, _commit_fixture
+        from tendermint_tpu.types.validator_set import CommitVerifySpec
+
+        pv = PipelinedVerifier(CPUBatchVerifier(), cache=SigCache())
+        wd = Watchdog(interval_s=0.02)
+        pv.attach_watchdog(wd, deadline_s=0.3)
+        wd.start()
+        try:
+            vs, commit, bid = _commit_fixture()
+            spec = CommitVerifySpec(vs, CHAIN, bid, 5, commit)
+
+            faults.arm("pipeline.exec", "raise", times=1)
+            fut = pv.submit_commit(spec)
+            # no caller hangs: the future resolves (exception) within
+            # its deadline despite the dead exec thread
+            err = None
+            try:
+                res = await asyncio.wait_for(asyncio.wrap_future(fut), 3.0)
+            except asyncio.TimeoutError:
+                pytest.fail("commit-verify future hung past its deadline")
+            except Exception as e:
+                err = e
+                res = None
+            faults.disarm()
+            if err is not None:
+                # liveness failure -> the caller's serial fallback path
+                vs.verify_commit(CHAIN, bid, 5, commit, provider=CPUBatchVerifier())
+            else:
+                assert res is None, "commit must verify clean"
+
+            # watchdog heals the pipeline; retry rides the device path
+            deadline = asyncio.get_event_loop().time() + 3.0
+            while asyncio.get_event_loop().time() < deadline:
+                if pv.workers_alive():
+                    break
+                await asyncio.sleep(0.02)
+            assert pv.workers_alive(), "watchdog must restart the exec worker"
+            fut2 = pv.submit_commit(spec)
+            assert await asyncio.wait_for(asyncio.wrap_future(fut2), 10.0) is None
+        finally:
+            faults.disarm()
+            wd.stop()
+            pv.stop(timeout=5.0)
+
+    asyncio.run(go())

@@ -1,0 +1,220 @@
+"""FuzzedConnection chaos wrapper + its transport wiring.
+
+Satellite of ISSUE 4: `FuzzedConnection.from_config` existed but was
+wired into nothing — now the transport wraps every upgraded connection
+(inbound AND dialed) when p2p.test_fuzz is on (reference p2p/fuzz.go,
+config/config.go:626 FuzzConnConfig).
+"""
+
+import asyncio
+
+import pytest
+
+from tendermint_tpu.config.config import FuzzConnConfig
+from tendermint_tpu.config.config import test_config as make_test_config
+from tendermint_tpu.p2p.fuzz import FuzzedConnection
+from tendermint_tpu.p2p.key import NodeKey
+from tendermint_tpu.p2p.node_info import NodeInfo
+from tendermint_tpu.p2p.transport import Transport
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+class MockConn:
+    """SecretConnection I/O surface backed by in-memory buffers."""
+
+    def __init__(self):
+        self.written = []
+        self.read_data = b""
+        self.closed = False
+
+    async def write(self, data: bytes) -> int:
+        self.written.append(bytes(data))
+        return len(data)
+
+    async def read_exactly(self, n: int) -> bytes:
+        out, self.read_data = self.read_data[:n], self.read_data[n:]
+        return out
+
+    def close(self) -> None:
+        self.closed = True
+
+
+def test_drop_mode_drops_deterministically_with_seed():
+    async def go(seed):
+        inner = MockConn()
+        fz = FuzzedConnection(
+            inner, mode="drop", prob_drop_rw=0.5, seed=seed
+        )
+        pattern = []
+        for i in range(40):
+            await fz.write(bytes([i]))
+            pattern.append(len(inner.written))
+        return pattern, inner.written
+
+    p1, w1 = run(go(7))
+    p2, w2 = run(go(7))
+    assert p1 == p2 and w1 == w2, "same seed -> same drop pattern"
+    assert 0 < len(w1) < 40, "prob 0.5 over 40 writes must drop some, not all"
+    p3, w3 = run(go(8))
+    assert w3 != w1, "different seed -> different chaos"
+
+
+def test_dropped_write_reports_full_length():
+    """The caller must not see a short write (the reference swallows
+    silently) — data loss IS the chaos, not an IO error."""
+
+    async def go():
+        inner = MockConn()
+        fz = FuzzedConnection(inner, mode="drop", prob_drop_rw=1.0, seed=1)
+        n = await fz.write(b"hello")
+        assert n == 5
+        assert inner.written == []
+
+    run(go())
+
+
+def test_delay_mode_delays_reads_and_writes():
+    async def go():
+        inner = MockConn()
+        inner.read_data = b"abcdef"
+        fz = FuzzedConnection(inner, mode="delay", max_delay_s=0.05, seed=3)
+        import time
+
+        t0 = time.perf_counter()
+        await fz.write(b"x")
+        assert await fz.read_exactly(3) == b"abc"
+        # delays are random in [0, max]; just require forward progress
+        assert time.perf_counter() - t0 < 5
+        assert inner.written == [b"x"]
+
+    run(go())
+
+
+def test_drop_conn_kills_connection():
+    async def go():
+        inner = MockConn()
+        fz = FuzzedConnection(inner, mode="drop", prob_drop_rw=0.0,
+                              prob_drop_conn=1.0, seed=5)
+        with pytest.raises(ConnectionResetError):
+            await fz.write(b"x")
+        assert inner.closed
+        # dead stays dead
+        with pytest.raises(ConnectionResetError):
+            await fz.write(b"y")
+
+    run(go())
+
+
+def test_from_config_maps_fields():
+    cfg = FuzzConnConfig(mode="delay", max_delay_ms=250, prob_drop_rw=0.1,
+                         prob_drop_conn=0.2, prob_sleep=0.3)
+    fz = FuzzedConnection.from_config(MockConn(), cfg, seed=9)
+    assert fz.mode == "delay"
+    assert fz.max_delay_s == 0.25
+    assert fz.prob_drop_rw == 0.1
+    assert fz.prob_drop_conn == 0.2
+    assert fz.prob_sleep == 0.3
+
+
+# -- transport wiring -------------------------------------------------------
+
+
+def _mk_transport(i=0, **kw):
+    nk = NodeKey.generate()
+
+    def info():
+        return NodeInfo(
+            node_id=nk.id, listen_addr="tcp://127.0.0.1:0",
+            network="fuzz-test", version="0.33.4", channels=b"\x40",
+            moniker=f"f{i}",
+        )
+
+    return Transport(nk, info, **kw)
+
+
+def test_transport_wraps_both_sides_when_fuzz_configured():
+    """End to end over a real socket: with fuzz_config set, the upgraded
+    conn on BOTH the dialing and accepting transports is a
+    FuzzedConnection — wrapped after the handshake, so the identity
+    exchange itself is untouched."""
+
+    async def go():
+        # prob 0: chaos disabled statistically, wrapping still observable
+        cfg = FuzzConnConfig(mode="drop", prob_drop_rw=0.0)
+        lst = _mk_transport(0, fuzz_config=cfg, fuzz_seed=1234)
+        dialer = _mk_transport(1, fuzz_config=cfg, fuzz_seed=1234)
+        addr = await lst.listen()
+        try:
+            up_out = await asyncio.wait_for(dialer.dial(addr), 10)
+            up_in = await asyncio.wait_for(lst.accept(), 10)
+            assert isinstance(up_out.conn, FuzzedConnection)
+            assert isinstance(up_in.conn, FuzzedConnection)
+            # the byte stream still works through the wrapper
+            await up_out.conn.write(b"ping-frame")
+            got = await asyncio.wait_for(up_in.conn.read_exactly(10), 10)
+            assert got == b"ping-frame"
+            up_out.conn.close()
+            up_in.conn.close()
+        finally:
+            await lst.close()
+
+    run(go())
+
+
+def test_transport_unwrapped_without_fuzz_config():
+    async def go():
+        lst = _mk_transport(0)
+        dialer = _mk_transport(1)
+        addr = await lst.listen()
+        try:
+            up_out = await asyncio.wait_for(dialer.dial(addr), 10)
+            up_in = await asyncio.wait_for(lst.accept(), 10)
+            assert not isinstance(up_out.conn, FuzzedConnection)
+            assert not isinstance(up_in.conn, FuzzedConnection)
+            up_out.conn.close()
+            up_in.conn.close()
+        finally:
+            await lst.close()
+
+    run(go())
+
+
+def test_write_drops_through_real_transport():
+    """Chaos actually bites: with prob_drop_rw=1 on the dialer side,
+    frames written by the dialer never arrive at the acceptor."""
+
+    async def go():
+        cfg = FuzzConnConfig(mode="drop", prob_drop_rw=1.0)
+        lst = _mk_transport(0)
+        dialer = _mk_transport(1, fuzz_config=cfg, fuzz_seed=7)
+        addr = await lst.listen()
+        try:
+            up_out = await asyncio.wait_for(dialer.dial(addr), 10)
+            up_in = await asyncio.wait_for(lst.accept(), 10)
+            assert isinstance(up_out.conn, FuzzedConnection)
+            await up_out.conn.write(b"lost")
+            with pytest.raises(asyncio.TimeoutError):
+                await asyncio.wait_for(up_in.conn.read_exactly(4), 0.4)
+            up_out.conn.close()
+            up_in.conn.close()
+        finally:
+            await lst.close()
+
+    run(go())
+
+
+def test_node_config_gates_fuzz():
+    """p2p.test_fuzz=false (default) must leave the transport unfuzzed;
+    true must arm it with p2p.test_fuzz_config (node wiring contract)."""
+    cfg = make_test_config()
+    assert cfg.p2p.test_fuzz is False
+    assert isinstance(cfg.p2p.test_fuzz_config, FuzzConnConfig)
+    # node wiring passes None when off, the config object when on
+    armed = cfg.p2p.test_fuzz_config if cfg.p2p.test_fuzz else None
+    assert armed is None
+    cfg.p2p.test_fuzz = True
+    armed = cfg.p2p.test_fuzz_config if cfg.p2p.test_fuzz else None
+    assert armed is cfg.p2p.test_fuzz_config

@@ -344,6 +344,83 @@ def test_verify_window_identity_and_valset_guards():
     assert win2.inflight() == 0
 
 
+def test_verify_window_deadline_falls_back_to_serial():
+    """ISSUE-4: a future the pipeline never resolves (dead exec thread,
+    wedged device) must not hang fast sync — verify_pair times out,
+    drops the window, and verifies SERIALLY against the validator set.
+    The serial result is authoritative: a good commit still applies."""
+    import asyncio
+    from concurrent.futures import Future
+
+    from tendermint_tpu.blockchain.verify_window import CommitVerifyWindow
+
+    privs = [Ed25519PrivKey.from_secret(f"dw{i}".encode()) for i in range(4)]
+    vs = ValidatorSet([Validator(p.pub_key(), 1) for p in privs])
+    blocks = _make_chain(privs, vs, 3)
+
+    class _StuckProvider:
+        """submit_commit hands out futures nobody will ever resolve."""
+
+        def submit_commit(self, spec):
+            return Future()
+
+    async def go():
+        win = CommitVerifyWindow(
+            depth=2, provider=_StuckProvider(), await_deadline_s=0.1
+        )
+        win.lookahead(blocks.get, 1, CHAIN, vs)
+        assert win.inflight() >= 1
+        import time as _t
+
+        t0 = _t.perf_counter()
+        parts, bid, err = await win.verify_pair(blocks[1], blocks[2], CHAIN, vs)
+        elapsed = _t.perf_counter() - t0
+        assert elapsed < 5.0, "must time out, not hang"
+        assert err is None, f"serial fallback must accept the good commit: {err}"
+        assert win.deadline_fallbacks == 1
+        assert win.inflight() == 0, "a stuck window is dropped wholesale"
+
+        # the watchdog flavor: the future FAILS with a deadline error
+        # instead of staying pending — same serial-fallback outcome
+        from tendermint_tpu.utils.watchdog import FutureDeadlineError
+
+        class _FailingProvider:
+            def submit_commit(self, spec):
+                f = Future()
+                f.set_exception(FutureDeadlineError("watchdog deadline"))
+                return f
+
+        win2 = CommitVerifyWindow(
+            depth=2, provider=_FailingProvider(), await_deadline_s=5.0
+        )
+        win2.lookahead(blocks.get, 1, CHAIN, vs)
+        parts, bid, err = await win2.verify_pair(blocks[1], blocks[2], CHAIN, vs)
+        assert err is None, f"deadline error must route to serial verify: {err}"
+        assert win2.deadline_fallbacks == 1
+
+        # the shutdown/restart flavor: stop() or restart_workers failed
+        # the bundle with PipelineShutdownError — a liveness error, not
+        # a verdict; returning it as err would make the reactor drop an
+        # honest peer for a good block
+        from tendermint_tpu.crypto.pipeline import PipelineShutdownError
+
+        class _ShutdownProvider:
+            def submit_commit(self, spec):
+                f = Future()
+                f.set_exception(PipelineShutdownError("exec worker died"))
+                return f
+
+        win3 = CommitVerifyWindow(
+            depth=2, provider=_ShutdownProvider(), await_deadline_s=5.0
+        )
+        win3.lookahead(blocks.get, 1, CHAIN, vs)
+        parts, bid, err = await win3.verify_pair(blocks[1], blocks[2], CHAIN, vs)
+        assert err is None, f"shutdown error must route to serial verify: {err}"
+        assert win3.deadline_fallbacks == 1
+
+    asyncio.run(go())
+
+
 # -- v0 reactor loop with the pipelined window -------------------------------
 
 

@@ -54,8 +54,13 @@ def test_fresh_app_is_replayed_from_store(tmp_path):
 
 @pytest.mark.parametrize("fail_index", list(range(8)))
 def test_crash_matrix(tmp_path, fail_index):
-    """Crash at each fail-point in the first block's commit path, then
-    restart and require full recovery to a later height."""
+    """Crash at each fail-point in the first block's commit path
+    (covering all fail.fail() sites in consensus/state.py and
+    state/execution.py — 4 + 4 per height), then restart and require
+    full recovery to a later height AND a consistent, stable app hash:
+    WAL replay + handshake must land the app exactly on the state
+    store's app hash, and a second restart must reproduce the same
+    hash bit-for-bit (verify-only mode runs no consensus)."""
     root = str(tmp_path / f"node{fail_index}")
     r1 = run_node(root, 3, fail_index=fail_index)
     assert r1.returncode != 0, f"fail-point {fail_index} did not crash"
@@ -65,6 +70,19 @@ def test_crash_matrix(tmp_path, fail_index):
     assert r2.returncode == 0, (
         f"recovery after fail-point {fail_index} failed:\n{r2.stderr[-3000:]}"
     )
+    # app-hash stability across two more restarts (no consensus: pure
+    # handshake/replay — recovery must be deterministic and idempotent)
+    v1 = run_node(root, 0)
+    assert v1.returncode == 0, f"verify-only failed:\n{v1.stderr[-3000:]}"
+    v2 = run_node(root, 0)
+    assert v2.returncode == 0, f"second verify-only failed:\n{v2.stderr[-3000:]}"
+    h1 = [l for l in v1.stdout.splitlines() if l.startswith("VERIFY")]
+    h2 = [l for l in v2.stdout.splitlines() if l.startswith("VERIFY")]
+    assert h1 and h1 == h2, (
+        f"app hash not stable across restarts after fail-point {fail_index}: "
+        f"{h1} vs {h2}"
+    )
+    assert "app_hash=" in h1[0]
 
 
 def test_wal_catchup_preserves_vote_state(tmp_path):

@@ -255,6 +255,94 @@ def test_total_size_limit_drops_oldest(tmp_path):
     assert heights[0] > 0  # oldest dropped
 
 
+# -- torn writes (the wal.fsync `tear` fault shape, ISSUE 4) -----------------
+#
+# A crash between write and fsync completion leaves a PREFIX of the last
+# frame on disk. Repair must truncate at the first corrupt record — cut
+# mid-header (not even a full crc+len) or mid-payload — and be
+# idempotent across two restarts.
+
+
+def _torn_wal(tmp_path, cut_in_last_frame: int):
+    """Build a WAL with 3 good records, then append record 4 torn at
+    `cut_in_last_frame` bytes into its frame. Returns (path, good_size)."""
+    path = str(tmp_path / "wal")
+    w = BaseWAL(path)
+    w.start()
+    for h in (1, 2, 3):
+        w.write_sync(EndHeightMessage(h))
+    w.stop()
+    good_size = os.path.getsize(path)
+    frame = _frame(encode_msg(make_vote_msg(4)))
+    assert cut_in_last_frame < len(frame)
+    with open(path, "ab") as fp:
+        fp.write(frame[:cut_in_last_frame])
+    return path, good_size
+
+
+@pytest.mark.parametrize(
+    "cut,where", [(3, "mid-header"), (5, "header-done-no-payload"), (40, "mid-payload")]
+)
+def test_torn_write_truncated_at_first_corrupt_record(tmp_path, cut, where):
+    path, good_size = _torn_wal(tmp_path, cut)
+    w = BaseWAL(path)
+    w.start()  # repair
+    assert os.path.getsize(path) == good_size, f"torn {where} not truncated"
+    msgs = list(w.iter_messages())
+    assert msgs[-1] == EndHeightMessage(3), "all good records survive"
+    # and the log is appendable after repair
+    w.write_sync(EndHeightMessage(4))
+    w.stop()
+    _, found = BaseWAL(path).search_for_end_height(4)
+    assert found
+
+
+def test_torn_write_repair_is_idempotent_across_two_restarts(tmp_path):
+    path, good_size = _torn_wal(tmp_path, 40)
+    w1 = BaseWAL(path)
+    w1.start()
+    w1.stop()
+    after_first = os.path.getsize(path)
+    assert after_first == good_size
+    first_bytes = open(path, "rb").read()
+    # second restart: repair must change NOTHING
+    w2 = BaseWAL(path)
+    w2.start()
+    w2.stop()
+    assert os.path.getsize(path) == after_first
+    assert open(path, "rb").read() == first_bytes
+
+
+def test_injected_torn_fault_leaves_exactly_repairable_state(tmp_path):
+    """End to end through the fault registry: the `tear` action at
+    wal.fsync must leave the same torn-tail shape the manual tests
+    above construct, including the fsync'd prefix."""
+    from tendermint_tpu.utils import faultinject as faults
+
+    path = str(tmp_path / "wal")
+    try:
+        w = BaseWAL(path)
+        w.start()
+        w.write_sync(EndHeightMessage(1))
+        good = os.path.getsize(path)
+        faults.arm("wal.fsync", "tear")
+        with pytest.raises(faults.InjectedFault):
+            w.write_sync(make_vote_msg(2))
+        faults.disarm()
+        w.stop()
+        assert good < os.path.getsize(path) < good + len(
+            _frame(encode_msg(make_vote_msg(2)))
+        )
+        # two repair passes, both land on the same good prefix
+        for _ in range(2):
+            w2 = BaseWAL(path)
+            w2.start()
+            w2.stop()
+            assert os.path.getsize(path) == good
+    finally:
+        faults.disarm()
+
+
 # -- fuzz / property: random corruption always recovers ----------------------
 
 
