@@ -241,6 +241,8 @@ _GUARD_KEYS = [
     ("sigs_per_sec_sustained", "higher"),
     ("replay_speedup", "higher"),
     ("merkle_root_speedup", "higher"),
+    ("lightserve_clients_per_sec", "higher"),
+    ("lightserve_speedup", "higher"),
     ("coldstart_first_verify_s", None),   # presence-only: timing varies
     ("coldstart_tabled_first_s", None),
 ]
@@ -346,6 +348,7 @@ def run_bench(platform: str, accelerator: bool = True):
             platform=platform,
             note="accelerator unavailable; measured the node's host fallback path",
             **replay_bench(cpu),
+            **lightserve_bench(cpu),
             **merkle_bench(),
             **degraded_mode_bench(),
             **trace_overhead_bench(),
@@ -562,6 +565,13 @@ def run_bench(platform: str, accelerator: bool = True):
         log(f"replay provider setup failed: {ex!r}")
         replay_extra = {"replay_error": repr(ex)[:200]}
 
+    # -- lightserve: batched client fleet vs per-client serial ------------
+    try:
+        _ls_provider = tpv  # the warmed device provider from the replay section
+    except NameError:
+        _ls_provider = None
+    lightserve_extra = lightserve_bench(_ls_provider)
+
     # -- merkle engine: device vs host root + part-set split --------------
     merkle_extra = merkle_bench()
 
@@ -643,6 +653,7 @@ def run_bench(platform: str, accelerator: bool = True):
         **extra,
         **tabled,
         **replay_extra,
+        **lightserve_extra,
         **merkle_extra,
         **degraded_extra,
         **trace_extra,
@@ -1114,6 +1125,108 @@ def replay_bench(inner) -> dict:
     except Exception as ex:
         log(f"replay measurement failed: {ex!r}")
         return {"replay_error": repr(ex)[:200]}
+
+
+# -- lightserve: batched light-client fleet vs per-client serial -----------
+#
+# The verify-server measurement (lightserve/, docs/light-service.md):
+# N synthetic clients each request a verified header near the tip of a
+# K-height chain. The SERIAL arm runs every client's skip-verification
+# independently (direct light/verifier.py calls — the naive proxy
+# baseline); the BATCHED arm funnels all clients through one
+# LightServeService (shared verified-header store + single-flight
+# bisection + aggregator bundles through the provider). The headline is
+# clients served per second; lightserve_speedup joins the regression
+# guard next to replay_speedup.
+
+LIGHTSERVE_CLIENTS = int(os.environ.get("TM_BENCH_LIGHTSERVE_CLIENTS", "64"))
+LIGHTSERVE_HEIGHTS = int(os.environ.get("TM_BENCH_LIGHTSERVE_HEIGHTS", "16"))
+LIGHTSERVE_VALS = int(os.environ.get("TM_BENCH_LIGHTSERVE_VALS", "8"))
+LIGHTSERVE_TARGETS = int(os.environ.get("TM_BENCH_LIGHTSERVE_TARGETS", "4"))
+
+
+def lightserve_bench(provider=None) -> dict:
+    """Returns the lightserve_* bench keys; never raises (the main line
+    must survive a broken service — the guard then flags the missing
+    keys against the previous record)."""
+    try:
+        from tendermint_tpu.db.memdb import MemDB
+        from tendermint_tpu.light.store import TrustedStore
+        from tendermint_tpu.lightserve import loadgen
+        from tendermint_tpu.lightserve.aggregator import RequestAggregator
+        from tendermint_tpu.lightserve.service import LightServeService
+
+        n_heights = max(2, LIGHTSERVE_HEIGHTS)
+        headers, valsets = loadgen.make_chain(
+            n_heights, base_keys=loadgen.keys(LIGHTSERVE_VALS)
+        )
+        now = loadgen.T0 + 600 * 10**9
+        period = 30 * 24 * 3600 * 10**9
+        # the fleet chases the tip: targets round-robin the newest
+        # LIGHTSERVE_TARGETS heights (the overlap a real swarm has)
+        n_targets = max(1, min(LIGHTSERVE_TARGETS, n_heights - 1))
+        tips = list(range(n_heights - n_targets + 1, n_heights + 1))
+        targets = [tips[i % n_targets] for i in range(LIGHTSERVE_CLIENTS)]
+
+        serial_res, serial_s = loadgen.serial_fleet(
+            headers, valsets, targets, period, now, provider=provider
+        )
+
+        agg = RequestAggregator(provider=provider, flush_s=0.002)
+        svc = LightServeService(
+            loadgen.CHAIN_ID,
+            loadgen.ChainSource(headers, valsets),
+            TrustedStore(MemDB()),
+            aggregator=agg,
+            trusting_period_ns=period,
+        )
+        try:
+            batched_res, batched_s = loadgen.run_fleet(
+                svc, targets, now, threads=16
+            )
+            stats = svc.stats()
+        finally:
+            svc.stop()
+            agg.stop()
+        assert batched_res == serial_res, "batched fleet verdicts != serial"
+
+        out = {
+            "lightserve_clients": LIGHTSERVE_CLIENTS,
+            "lightserve_chain_heights": n_heights,
+            "lightserve_validators": LIGHTSERVE_VALS,
+            "lightserve_serial_ms": round(serial_s * 1e3, 2),
+            "lightserve_batched_ms": round(batched_s * 1e3, 2),
+            "lightserve_clients_per_sec": (
+                round(LIGHTSERVE_CLIENTS / batched_s) if batched_s > 0 else None
+            ),
+            "lightserve_serial_clients_per_sec": (
+                round(LIGHTSERVE_CLIENTS / serial_s) if serial_s > 0 else None
+            ),
+            "lightserve_speedup": (
+                round(serial_s / batched_s, 2) if batched_s > 0 else None
+            ),
+            "lightserve_singleflight_hits": stats["singleflight_hits"],
+            "lightserve_singleflight_runs": stats["singleflight_runs"],
+            "lightserve_store_hits": stats["store_hits"],
+            "lightserve_bundles": stats["bundles"],
+            "lightserve_bundle_occupancy_avg": round(
+                stats["bundle_occupancy_avg"], 2
+            ),
+        }
+        log(
+            f"lightserve fleet @{LIGHTSERVE_CLIENTS} clients: serial "
+            f"{serial_s*1e3:.1f} ms, batched {batched_s*1e3:.1f} ms "
+            f"({out['lightserve_speedup']}x; {out['lightserve_clients_per_sec']}"
+            f" clients/s; {stats['singleflight_hits']} single-flight hits, "
+            f"{stats['store_hits']} store hits, {stats['bundles']} bundles)"
+        )
+        return out
+    except Exception as ex:
+        import traceback
+
+        traceback.print_exc(file=sys.stderr)
+        log(f"lightserve measurement failed: {ex!r}")
+        return {"lightserve_error": repr(ex)[:200]}
 
 
 _STATE_PATH = os.environ.get("TM_BENCH_STATE", "")

@@ -105,6 +105,18 @@ class BaseConfig:
     # tripping open, and how long before a half-open recovery probe.
     breaker_failure_threshold: int = 3
     breaker_cooldown_ms: int = 30_000
+    # Batched light-client verification service (lightserve/): the node
+    # serves verified headers to a fleet of thin clients — concurrent
+    # verify requests coalesce into device-sized commit bundles
+    # (bundle_rows signature rows max; the aggregator lingers flush_ms
+    # so a thundering herd lands in one dispatch) behind a shared
+    # verified-header store with single-flight bisection. laddr = a
+    # dedicated RPC endpoint for the fleet ("" = routes only on the
+    # main RPC). See docs/light-service.md.
+    lightserve_enabled: bool = False
+    lightserve_laddr: str = ""
+    lightserve_bundle_rows: int = 4096
+    lightserve_flush_ms: int = 2
 
     def genesis_file(self) -> str:
         return _rootify(self.genesis_file_name, self.root_dir)
@@ -144,6 +156,10 @@ class BaseConfig:
             return "breaker_failure_threshold must be >= 1"
         if self.breaker_cooldown_ms < 0:
             return "breaker_cooldown_ms can't be negative"
+        if self.lightserve_bundle_rows < 1:
+            return "lightserve_bundle_rows must be >= 1"
+        if self.lightserve_flush_ms < 0:
+            return "lightserve_flush_ms can't be negative"
         return None
 
 

@@ -16,6 +16,7 @@ from typing import List, Optional, Tuple
 
 from tendermint_tpu.light import verifier
 from tendermint_tpu.light.provider import Provider
+from tendermint_tpu.lightserve import core
 from tendermint_tpu.light.store import TrustedStore
 from tendermint_tpu.light.types import DEFAULT_TRUST_LEVEL, SignedHeader, TrustOptions
 from tendermint_tpu.types.validator_set import ValidatorSet
@@ -51,6 +52,7 @@ class LightClient:
         max_retry_attempts: int = 5,
         mode: str = "bisection",
         sequence_window: int = 512,
+        resilient_providers: bool = False,
         logger=None,
     ):
         err = trust_options.validate()
@@ -60,6 +62,15 @@ class LightClient:
         self.trusting_period_ns = trust_options.period_ns
         self.trust_options = trust_options
         self.trust_level = trust_level
+        if resilient_providers:
+            # per-peer retry/backoff + circuit breaker (light/provider.py
+            # ResilientProvider): a transient peer blip no longer burns a
+            # failover attempt, and a dead peer fails fast while its
+            # breaker is open
+            from tendermint_tpu.light.provider import make_resilient
+
+            primary = make_resilient(primary)
+            witnesses = [make_resilient(w) for w in witnesses]
         self.primary = primary
         self.witnesses = list(witnesses)
         self.store = store
@@ -133,8 +144,15 @@ class LightClient:
             vals = await self._from_primary("validator_set", sh.height)
             if sh.header.validators_hash != vals.hash():
                 raise LightClientError("validators mismatch at trusted height")
-            # ★ one batched device call
-            vals.verify_commit(self.chain_id, sh.block_id(), sh.height, sh.commit)
+            # bind the root header to its own commit (validate_basic's
+            # commit.block_id.hash == header.hash() check — the commit
+            # verification alone can't see a header/commit mismatch)
+            try:
+                core.ensure_basic(self.chain_id, sh)
+            except core.ErrBadHeader as e:
+                raise LightClientError(str(e)) from None
+            # ★ one batched device call through the shared core
+            core.verify_one(core.full_spec(vals, self.chain_id, sh))
             self.store.save(sh, vals)
         self._initialized = True
 

@@ -8,6 +8,8 @@ mock provider (mock/mock.go, deterministic fixtures).
 
 from __future__ import annotations
 
+import asyncio
+import itertools
 from typing import Dict, Optional
 
 from tendermint_tpu.light.types import SignedHeader
@@ -16,6 +18,11 @@ from tendermint_tpu.types.validator_set import ValidatorSet
 
 class ProviderError(Exception):
     pass
+
+
+class ErrProviderUnavailable(ProviderError):
+    """The provider's circuit breaker is open — fail fast instead of
+    hammering a known-dead peer (ResilientProvider)."""
 
 
 class ErrSignedHeaderNotFound(ProviderError):
@@ -35,6 +42,108 @@ class Provider:
 
     async def validator_set(self, height: int) -> ValidatorSet:
         raise NotImplementedError
+
+
+def backoff_delays(retries: int, base_s: float, max_s: float):
+    """The shared retry schedule (exponential, capped): delays to sleep
+    BETWEEN attempts — one policy for both the async ResilientProvider
+    and the sync lightserve fetch path, so they cannot drift."""
+    for attempt in range(max(0, retries - 1)):
+        yield min(base_s * (1 << attempt), max_s)
+
+
+class ResilientProvider(Provider):
+    """Retry/backoff + a per-peer circuit breaker around any provider.
+
+    Before this wrapper a single transient peer error failed the whole
+    client request (LightClient would burn a retry attempt or promote a
+    witness over a blip). Semantics:
+
+    - transient errors retry up to ``retries`` times with exponential
+      backoff (``backoff_base_s`` doubling, capped at
+      ``backoff_max_s``);
+    - deterministic answers (``ProviderError`` — height not found /
+      not yet produced) PROPAGATE immediately and count as provider
+      HEALTH: every retry would repeat them;
+    - exhausted retries record a failure on the peer's
+      ``CircuitBreaker`` (utils/watchdog.py, process-wide defaults from
+      config ``breaker_failure_threshold``/``breaker_cooldown_ms``); an
+      OPEN breaker fails fast with :class:`ErrProviderUnavailable`, so
+      a dead peer costs callers microseconds (and LightClient's
+      failover promotes a witness immediately) until the half-open
+      probe heals it.
+    """
+
+    _peer_seq = itertools.count()
+
+    def __init__(
+        self,
+        inner: Provider,
+        name: Optional[str] = None,
+        retries: int = 3,
+        backoff_base_s: float = 0.05,
+        backoff_max_s: float = 1.0,
+        breaker=None,
+    ):
+        from tendermint_tpu.utils.watchdog import CircuitBreaker
+
+        self.inner = inner
+        self.chain_id = inner.chain_id
+        # PER-PEER breaker: the registry is keyed by name, so two peers
+        # of the same provider class must not share one — default names
+        # get a process-wide ordinal discriminator. Ordinal-named
+        # breakers are NOT registered in the process-wide registry:
+        # every wrap would otherwise leak one more permanently-unique
+        # entry into the metrics pump (unbounded registry + label
+        # cardinality). A caller that wants the breaker exported gives
+        # it a STABLE name (or passes its own registered breaker).
+        stable = name or getattr(inner, "name", None)
+        self.name = stable or f"{type(inner).__name__}-{next(self._peer_seq)}"
+        self.retries = max(1, int(retries))
+        self.backoff_base_s = float(backoff_base_s)
+        self.backoff_max_s = float(backoff_max_s)
+        self.breaker = breaker or CircuitBreaker(
+            f"lightprovider.{self.name}", register=stable is not None
+        )
+        self.calls = 0
+        self.retried = 0
+
+    async def _call(self, method: str, height: int):
+        if not self.breaker.allow():
+            raise ErrProviderUnavailable(
+                f"provider {self.name}: breaker open"
+            )
+        last: Optional[Exception] = None
+        delays = backoff_delays(self.retries, self.backoff_base_s, self.backoff_max_s)
+        for attempt in range(self.retries):
+            self.calls += 1
+            try:
+                res = await getattr(self.inner, method)(height)
+            except ProviderError:
+                # deterministic miss: a healthy answer — no retry, no trip
+                self.breaker.record_success()
+                raise
+            except Exception as e:
+                last = e
+                if attempt + 1 < self.retries:
+                    self.retried += 1
+                    await asyncio.sleep(next(delays))
+            else:
+                self.breaker.record_success()
+                return res
+        self.breaker.record_failure()
+        raise last  # type: ignore[misc]
+
+    async def signed_header(self, height: int) -> SignedHeader:
+        return await self._call("signed_header", height)
+
+    async def validator_set(self, height: int) -> ValidatorSet:
+        return await self._call("validator_set", height)
+
+
+def make_resilient(p: Provider, **kw) -> Provider:
+    """Wrap unless already wrapped (idempotent LightClient wiring)."""
+    return p if isinstance(p, ResilientProvider) else ResilientProvider(p, **kw)
 
 
 class MockProvider(Provider):

@@ -3,10 +3,19 @@
 Reference: lite2/store/ — Store interface (store.go:9), db
 implementation (db/db.go: SignedHeader + ValidatorSet per height,
 LightBlock iteration, prune).
+
+The height index is kept IN MEMORY (built once from a prefix scan,
+then maintained by ``save``/``prune``): ``latest_height``/
+``first_height``/``heights`` used to re-scan and re-sort the whole DB
+prefix on every call, which the lightserve hot path hits per client
+request. The store is thread-safe — the verify-server serves a fleet
+of client threads over one shared instance.
 """
 
 from __future__ import annotations
 
+import bisect
+import threading
 from typing import List, Optional, Tuple
 
 from tendermint_tpu.db.base import DB
@@ -24,12 +33,29 @@ def _k(prefix: bytes, height: int) -> bytes:
 class TrustedStore:
     def __init__(self, db: DB):
         self._db = db
+        self._lock = threading.Lock()
+        # sorted in-memory height index; None until first use, then
+        # maintained by save/prune (never re-scanned)
+        self._heights: Optional[List[int]] = None
+
+    def _index_locked(self) -> List[int]:
+        if self._heights is None:
+            self._heights = sorted(
+                int.from_bytes(k[len(_SH) :], "big")
+                for k, _ in self._db.prefix_iterator(_SH)
+            )
+        return self._heights
 
     def save(self, sh: SignedHeader, vals: ValidatorSet) -> None:
         batch = self._db.new_batch()
         batch.set(_k(_SH, sh.height), sh.encode())
         batch.set(_k(_VS, sh.height), vals.encode())
-        batch.write_sync()
+        with self._lock:
+            batch.write_sync()
+            hs = self._index_locked()
+            i = bisect.bisect_left(hs, sh.height)
+            if i == len(hs) or hs[i] != sh.height:
+                hs.insert(i, sh.height)
 
     def signed_header(self, height: int) -> Optional[SignedHeader]:
         raw = self._db.get(_k(_SH, height))
@@ -40,18 +66,18 @@ class TrustedStore:
         return ValidatorSet.decode(raw) if raw is not None else None
 
     def heights(self) -> List[int]:
-        return sorted(
-            int.from_bytes(k[len(_SH) :], "big")
-            for k, _ in self._db.prefix_iterator(_SH)
-        )
+        with self._lock:
+            return list(self._index_locked())
 
     def latest_height(self) -> int:
-        hs = self.heights()
-        return hs[-1] if hs else 0
+        with self._lock:
+            hs = self._index_locked()
+            return hs[-1] if hs else 0
 
     def first_height(self) -> int:
-        hs = self.heights()
-        return hs[0] if hs else 0
+        with self._lock:
+            hs = self._index_locked()
+            return hs[0] if hs else 0
 
     def latest(self) -> Optional[Tuple[SignedHeader, ValidatorSet]]:
         h = self.latest_height()
@@ -61,9 +87,11 @@ class TrustedStore:
 
     def prune(self, keep: int) -> int:
         """Keep the newest `keep` heights (reference db store Prune)."""
-        hs = self.heights()
-        drop = hs[:-keep] if keep > 0 else hs
-        for h in drop:
-            self._db.delete(_k(_SH, h))
-            self._db.delete(_k(_VS, h))
-        return len(drop)
+        with self._lock:
+            hs = self._index_locked()
+            drop = hs[:-keep] if keep > 0 else list(hs)
+            for h in drop:
+                self._db.delete(_k(_SH, h))
+                self._db.delete(_k(_VS, h))
+            self._heights = hs[-keep:] if keep > 0 else []
+            return len(drop)

@@ -7,22 +7,23 @@ dispatch :140, VerifyBackwards :228; common checks
 (verifyNewHeaderAndVals :167): basic validation, height/time
 monotonicity, clock drift, trusting period.
 
-Each commit check is ONE batched device verification (★ the BASELINE
-config-3 hot path: headers × heights).
+Every commit check drains through the shared device-backed core
+(lightserve/core.py — ★ the BASELINE config-3 hot path: headers ×
+heights). The host-side checks + spec construction for one trust link
+live in :func:`link_specs` so the lightserve aggregator can verify the
+SAME link semantics while batching the device work across many
+concurrent clients (docs/light-service.md).
 """
 
 from __future__ import annotations
 
 import time
 from fractions import Fraction
-from typing import Optional
+from typing import List, Optional, Tuple
 
 from tendermint_tpu.light.types import DEFAULT_TRUST_LEVEL, SignedHeader
-from tendermint_tpu.types.validator_set import (
-    CommitVerifySpec,
-    ValidatorSet,
-    verify_commits_batched,
-)
+from tendermint_tpu.lightserve import core
+from tendermint_tpu.types.validator_set import CommitVerifySpec, ValidatorSet
 
 DEFAULT_CLOCK_DRIFT_NS = 10 * 10**9  # 10s (reference defaultClockDrift)
 
@@ -61,9 +62,10 @@ def _verify_new_header_and_vals(
     clock_drift_ns: int,
 ) -> None:
     """Reference verifyNewHeaderAndVals :167."""
-    err = untrusted.validate_basic(chain_id)
-    if err:
-        raise ErrInvalidHeader(err)
+    try:
+        core.ensure_basic(chain_id, untrusted)
+    except core.ErrBadHeader as e:
+        raise ErrInvalidHeader(str(e)) from None
     if untrusted.height <= trusted.height:
         raise ErrInvalidHeader(
             f"expected new header height {untrusted.height} > trusted {trusted.height}"
@@ -74,10 +76,69 @@ def _verify_new_header_and_vals(
         )
     if untrusted.time_ns >= now_ns + clock_drift_ns:
         raise ErrInvalidHeader("new header time is from the future")
-    if untrusted.header.validators_hash != untrusted_vals.hash():
+    try:
+        core.ensure_valset_matches(untrusted, untrusted_vals)
+    except core.ErrValsetMismatch:
         raise ErrInvalidHeader(
             "expected new header validators to match those supplied"
+        ) from None
+
+
+def link_specs(
+    chain_id: str,
+    trusted: SignedHeader,
+    trusted_vals: Optional[ValidatorSet],
+    untrusted: SignedHeader,
+    untrusted_vals: ValidatorSet,
+    trusting_period_ns: int,
+    trust_level: Fraction = DEFAULT_TRUST_LEVEL,
+    now_ns: Optional[int] = None,
+    clock_drift_ns: int = DEFAULT_CLOCK_DRIFT_NS,
+) -> List[Tuple[str, CommitVerifySpec]]:
+    """Host-side checks for ONE trust link trusted→untrusted, returning
+    the commit specs the device must confirm: ``[("full", spec)]`` for
+    an adjacent link (after the hash-chain check), ``[("trusting",
+    spec), ("full", spec)]`` for a skip link. Host failures raise here;
+    a "trusting" spec failing on the device means the link needs a
+    bisection pivot (:class:`ErrNewValSetCantBeTrusted`), which
+    :func:`_raise_link` maps. This is the seam the lightserve
+    aggregator shares with :func:`verify`, so a batched fleet request
+    accepts/rejects bit-identically to a direct serial call."""
+    now = _now_ns(now_ns)
+    if header_expired(trusted, trusting_period_ns, now):
+        raise ErrOldHeaderExpired(
+            f"old header expired at {trusted.time_ns + trusting_period_ns}"
         )
+    _verify_new_header_and_vals(
+        chain_id, untrusted, untrusted_vals, trusted, now, clock_drift_ns
+    )
+    if untrusted.height == trusted.height + 1:
+        # the hash-chain link: H+1 validators were committed to by H
+        if untrusted.header.validators_hash != trusted.header.next_validators_hash:
+            raise ErrInvalidHeader(
+                f"expected old header next validators "
+                f"({trusted.header.next_validators_hash.hex()[:12]}) to match "
+                f"those from new header "
+                f"({untrusted.header.validators_hash.hex()[:12]})"
+            )
+        return [("full", core.full_spec(untrusted_vals, chain_id, untrusted))]
+    # Both checks (1/3+ of the trusted set still signs; the new set has
+    # a proper +2/3 commit) share ONE device batch. The reference runs
+    # them serially (VerifyCommitTrusting :60 then VerifyCommit :76);
+    # the trusting error still surfaces first, so observable behavior
+    # matches.
+    if trusted_vals is None:
+        raise ValueError("non-adjacent link requires the trusted valset")
+    return [
+        ("trusting", core.trusting_spec(trusted_vals, chain_id, untrusted, trust_level)),
+        ("full", core.full_spec(untrusted_vals, chain_id, untrusted)),
+    ]
+
+
+def _raise_link(kind: str, err: Exception, prefix: str = "") -> None:
+    if kind == "trusting":
+        raise ErrNewValSetCantBeTrusted(f"{prefix}{err}")
+    raise err
 
 
 def verify_adjacent(
@@ -93,22 +154,12 @@ def verify_adjacent(
     """Reference VerifyAdjacent :96 — untrusted.height == trusted.height+1."""
     if untrusted.height != trusted.height + 1:
         raise ValueError("headers must be adjacent in height")
-    now = _now_ns(now_ns)
-    if header_expired(trusted, trusting_period_ns, now):
-        raise ErrOldHeaderExpired(f"old header expired at {trusted.time_ns + trusting_period_ns}")
-    _verify_new_header_and_vals(chain_id, untrusted, untrusted_vals, trusted, now, clock_drift_ns)
-
-    # the hash-chain link: H+1 validators were committed to by H
-    if untrusted.header.validators_hash != trusted.header.next_validators_hash:
-        raise ErrInvalidHeader(
-            f"expected old header next validators ({trusted.header.next_validators_hash.hex()[:12]}) "
-            f"to match those from new header ({untrusted.header.validators_hash.hex()[:12]})"
-        )
-    # ★ one batched device call
-    untrusted_vals.verify_commit(
-        chain_id, untrusted.block_id(), untrusted.height, untrusted.commit,
-        provider=provider,
+    specs = link_specs(
+        chain_id, trusted, None, untrusted, untrusted_vals,
+        trusting_period_ns, now_ns=now_ns, clock_drift_ns=clock_drift_ns,
     )
+    # ★ one batched device call
+    core.verify_one(specs[0][1], provider=provider)
 
 
 def verify_non_adjacent(
@@ -126,32 +177,14 @@ def verify_non_adjacent(
     """Reference VerifyNonAdjacent :32."""
     if untrusted.height == trusted.height + 1:
         raise ValueError("headers must be non-adjacent in height")
-    now = _now_ns(now_ns)
-    if header_expired(trusted, trusting_period_ns, now):
-        raise ErrOldHeaderExpired(f"old header expired at {trusted.time_ns + trusting_period_ns}")
-    _verify_new_header_and_vals(chain_id, untrusted, untrusted_vals, trusted, now, clock_drift_ns)
-
-    # Both checks (1/3+ of the trusted set still signs; the new set has a
-    # proper +2/3 commit) share ONE device batch. The reference runs them
-    # serially (VerifyCommitTrusting :60 then VerifyCommit :76); the
-    # trusting error still surfaces first, so observable behavior matches.
-    bid = untrusted.block_id()
-    res = verify_commits_batched(
-        [
-            CommitVerifySpec(
-                trusted_vals, chain_id, bid, untrusted.height, untrusted.commit,
-                mode="trusting", trust_level=trust_level,
-            ),
-            CommitVerifySpec(
-                untrusted_vals, chain_id, bid, untrusted.height, untrusted.commit,
-            ),
-        ],
-        provider=provider,
+    specs = link_specs(
+        chain_id, trusted, trusted_vals, untrusted, untrusted_vals,
+        trusting_period_ns, trust_level, now_ns, clock_drift_ns,
     )
-    if res[0] is not None:
-        raise ErrNewValSetCantBeTrusted(str(res[0]))
-    if res[1] is not None:
-        raise res[1]
+    res = core.verify_specs([s for _, s in specs], provider=provider)
+    for (kind, _), err in zip(specs, res):
+        if err is not None:
+            _raise_link(kind, err)
 
 
 def verify(
@@ -202,49 +235,35 @@ def verify_chain(
     exactly what the per-step path would have raised.
     """
     now = _now_ns(now_ns)
-    specs: list = []
-    spec_links: list = []  # (link_idx, kind) parallel to specs
+    specs: List[CommitVerifySpec] = []
+    spec_links: List[Tuple[int, str]] = []  # (link_idx, kind) parallel to specs
     cur_sh, cur_vals = trusted, trusted_vals
     for li, (sh, vals) in enumerate(chain):
-        if header_expired(cur_sh, trusting_period_ns, now):
-            raise ErrOldHeaderExpired(
-                f"old header expired at {cur_sh.time_ns + trusting_period_ns}"
+        try:
+            link = link_specs(
+                chain_id, cur_sh, cur_vals, sh, vals,
+                trusting_period_ns, trust_level, now, clock_drift_ns,
             )
-        _verify_new_header_and_vals(chain_id, sh, vals, cur_sh, now, clock_drift_ns)
-        bid = sh.block_id()
-        if sh.height == cur_sh.height + 1:
-            if sh.header.validators_hash != cur_sh.header.next_validators_hash:
-                raise ErrInvalidHeader(
-                    f"link {li}: expected old header next validators to match new"
-                )
-            specs.append(CommitVerifySpec(vals, chain_id, bid, sh.height, sh.commit))
-            spec_links.append((li, "full"))
-        else:
-            specs.append(
-                CommitVerifySpec(
-                    cur_vals, chain_id, bid, sh.height, sh.commit,
-                    mode="trusting", trust_level=trust_level,
-                )
-            )
-            spec_links.append((li, "trusting"))
-            specs.append(CommitVerifySpec(vals, chain_id, bid, sh.height, sh.commit))
-            spec_links.append((li, "full"))
+        except ErrInvalidHeader as e:
+            raise ErrInvalidHeader(f"link {li}: {e}") from None
+        for kind, s in link:
+            specs.append(s)
+            spec_links.append((li, kind))
         cur_sh, cur_vals = sh, vals
 
-    results = verify_commits_batched(specs, provider=provider)  # ★ one device call
+    results = core.verify_specs(specs, provider=provider)  # ★ one device call
     for (li, kind), err in zip(spec_links, results):
         if err is not None:
-            if kind == "trusting":
-                raise ErrNewValSetCantBeTrusted(f"link {li}: {err}")
-            raise err
+            _raise_link(kind, err, prefix=f"link {li}: " if kind == "trusting" else "")
 
 
 def verify_backwards(chain_id: str, untrusted: SignedHeader, trusted: SignedHeader) -> None:
     """Reference VerifyBackwards :228: hash-chain only, no signatures —
     untrusted is EARLIER than trusted and must be its ancestor."""
-    err = untrusted.validate_basic(chain_id)
-    if err:
-        raise ErrInvalidHeader(err)
+    try:
+        core.ensure_basic(chain_id, untrusted)
+    except core.ErrBadHeader as e:
+        raise ErrInvalidHeader(str(e)) from None
     if untrusted.height != trusted.height - 1:
         raise ValueError("headers must be adjacent (backwards)")
     if untrusted.time_ns >= trusted.time_ns:

@@ -1,0 +1,29 @@
+"""Batched light-client verification service.
+
+The node becomes a verify-server for a fleet of thin clients
+(PAPERS.md, arxiv 2410.03347 "Practical Light Clients for
+Committee-Based Blockchains"): thousands of concurrent skip-verification
+requests coalesce into device-sized commit bundles dispatched through
+the existing pipelined verifier, and overlapping bisection work is
+computed once behind a shared verified-header store (single-flight).
+
+Layout:
+
+- ``core``        — the ONE device-backed commit-verification core that
+                    both ``light/`` (lite2) and ``lite/`` (v1) consume;
+- ``aggregator``  — coalesces concurrent ``CommitVerifySpec`` requests
+                    into bundles (one device call serves N clients);
+- ``service``     — the verify-server: shared ``TrustedStore``,
+                    single-flight bisection, provider retry/breaker;
+- ``loadgen``     — synthetic chain generator + client-fleet driver
+                    (bench.py ``lightserve_*`` section and the tests);
+- ``server``      — the RPC surface (wired into ``node/`` next to the
+                    existing light proxy server).
+
+NOTE: deliberately import-free — ``light/verifier.py`` imports
+``lightserve.core`` while ``lightserve.service`` imports
+``light/verifier.py``; eager re-exports here would close that loop on
+whichever module loads first.
+
+See docs/light-service.md.
+"""

@@ -1,0 +1,276 @@
+"""Request aggregator: coalesce concurrent commit-verify requests into
+device-sized bundles.
+
+Thousands of light clients asking "is this header committed?" is the
+headers-×-heights shape the device verifier batches best — but each
+client arrives on its own thread/connection with one or two
+``CommitVerifySpec``s. This aggregator is the funnel: submissions queue
+behind a condition variable, a dispatch thread lingers ``flush_s``
+(bounded by ``bundle_rows`` signature rows) to let concurrent
+submitters pile on, then verifies the whole bundle through the shared
+core (lightserve/core.py) — ONE ``verify_commits_batched`` device call
+(or, on a live node, one ``PipelinedVerifier.submit_commit`` group that
+additionally coalesces with the node's own verify traffic and rides the
+SigCache).
+
+Differences from ``PipelinedVerifier``'s own micro-batching: the
+pipeline cuts a bundle the moment the device is free (optimal for the
+node's latency-bound hot path); a verify SERVER wants the opposite
+default — hold the door ``flush_s`` so a thundering herd of clients
+lands in one dispatch. Both compose: aggregator bundles feed the
+pipeline, which may merge them further.
+
+Counters feed the ``tendermint_lightserve_*`` metrics family
+(docs/metrics.md). Chaos site ``lightserve.bundle`` fires per dispatched
+bundle (utils/faultinject.py).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from concurrent.futures import Future
+from typing import Dict, List, Optional, Sequence
+
+from tendermint_tpu.lightserve import core
+from tendermint_tpu.types.validator_set import CommitVerifySpec
+from tendermint_tpu.utils import faultinject as faults
+from tendermint_tpu.utils import trace
+
+
+class AggregatorShutdownError(Exception):
+    """The aggregator stopped before this request was executed."""
+
+
+class _Req:
+    __slots__ = ("spec", "rows", "fut")
+
+    def __init__(self, spec: CommitVerifySpec, rows: int, fut: Future):
+        self.spec = spec
+        self.rows = rows
+        self.fut = fut
+
+
+def _resolve(fut: Future, value=None, exc: Optional[Exception] = None) -> None:
+    """Complete a future, tolerating a concurrent resolution (stop()
+    racing a wedged-but-alive dispatch thread that finally finishes) —
+    an InvalidStateError must never kill the dispatch thread."""
+    try:
+        if fut.done():
+            return
+        if exc is not None:
+            fut.set_exception(exc)
+        else:
+            fut.set_result(value)
+    except Exception:
+        pass  # resolved concurrently: someone answered the caller
+
+
+class RequestAggregator:
+    """Thread-safe bundle funnel over :func:`core.verify_specs`.
+
+    ``submit`` returns a Future resolving to ``Optional[Exception]``
+    (the verdict contract of ``verify_commits_batched``); ``verify`` is
+    the blocking convenience used by the service's bisection loop.
+    """
+
+    def __init__(
+        self,
+        provider=None,
+        bundle_rows: int = 4096,
+        flush_s: float = 0.002,
+    ):
+        self.provider = provider
+        self.bundle_rows = max(1, int(bundle_rows))
+        self.flush_s = max(0.0, float(flush_s))
+
+        self._q: "deque[_Req]" = deque()
+        self._queued_rows = 0  # running total — the linger loop must
+        # not re-sum a 10k-deep queue under the lock on every wakeup
+        self._cv = threading.Condition()
+        self._stopped = False
+        # the bundle the dispatch thread is currently executing —
+        # stop()/restart_worker fail its futures if the thread dies or
+        # wedges mid-bundle (the PipelinedVerifier._inflight_bundle
+        # no-hang discipline); cleared only on normal completion
+        self._inflight: Optional[List[_Req]] = None
+
+        # counters (under _cv), snapshot via stats()
+        self.requests = 0
+        self.request_rows = 0
+        self.bundles = 0
+        self.bundle_rows_total = 0
+        self.max_queue_depth = 0
+        self._occupancy_sum = 0  # requests per bundle, summed
+
+        self._t = self._spawn()
+
+    def _spawn(self) -> threading.Thread:
+        t = threading.Thread(target=self._loop, daemon=True, name="lightserve-agg")
+        t.start()
+        return t
+
+    # -- supervision (utils/watchdog.py) -----------------------------------
+
+    def attach_watchdog(self, wd) -> None:
+        """Restart-on-death for the dispatch thread, mirroring
+        PipelinedVerifier.attach_watchdog (a stopped aggregator counts
+        as healthy — its thread is SUPPOSED to be gone)."""
+        wd.register_worker(
+            "lightserve.dispatch",
+            lambda: self._stopped or self._t.is_alive(),
+            self.restart_worker,
+        )
+
+    def restart_worker(self) -> None:
+        with self._cv:
+            if self._stopped or self._t.is_alive():
+                return
+            # the dead thread's locally-held bundle is unrecoverable:
+            # fail its futures NOW so no client blocks forever
+            orphan = self._inflight
+            self._inflight = None
+            self._t = self._spawn()
+        if orphan:
+            err = AggregatorShutdownError(
+                "lightserve dispatch worker died holding this bundle"
+            )
+            for r in orphan:
+                _resolve(r.fut, exc=err)
+        trace.instant("lightserve.worker_restarted")
+
+    # -- submit API --------------------------------------------------------
+
+    def submit(self, spec: CommitVerifySpec) -> "Future[Optional[Exception]]":
+        fut: Future = Future()
+        rows = len(spec.commit.signatures)
+        with self._cv:
+            if not self._stopped:
+                self._q.append(_Req(spec, rows, fut))
+                self._queued_rows += rows
+                self.requests += 1
+                self.request_rows += rows
+                self.max_queue_depth = max(self.max_queue_depth, len(self._q))
+                self._cv.notify_all()
+                return fut
+        # stopped: run inline so teardown races degrade gracefully
+        try:
+            fut.set_result(core.verify_specs([spec], provider=self.provider)[0])
+        except Exception as e:
+            fut.set_exception(e)
+        return fut
+
+    def verify(
+        self, specs: Sequence[CommitVerifySpec]
+    ) -> List[Optional[Exception]]:
+        """Blocking: submit all specs and wait for their verdicts (the
+        bisection loop's per-link call — concurrent clients' links share
+        bundles)."""
+        futs = [self.submit(s) for s in specs]
+        return [f.result() for f in futs]
+
+    # -- dispatch thread ---------------------------------------------------
+
+    def _take_bundle_locked(self) -> List[_Req]:
+        group: List[_Req] = [self._q.popleft()]
+        rows = group[0].rows
+        while self._q and rows + self._q[0].rows <= self.bundle_rows:
+            r = self._q.popleft()
+            group.append(r)
+            rows += r.rows
+        self._queued_rows -= rows
+        return group
+
+    def _loop(self) -> None:
+        while True:
+            with self._cv:
+                while not self._q and not self._stopped:
+                    self._cv.wait()
+                if not self._q and self._stopped:
+                    return
+                if self.flush_s > 0 and not self._stopped:
+                    # hold the door: let concurrent submitters coalesce
+                    # (bounded by rows so a full bundle cuts immediately)
+                    deadline = time.monotonic() + self.flush_s
+                    while (
+                        not self._stopped
+                        and self._queued_rows < self.bundle_rows
+                        and time.monotonic() < deadline
+                    ):
+                        self._cv.wait(timeout=deadline - time.monotonic())
+                group = self._take_bundle_locked()
+                self._inflight = group
+            self._run_bundle(group)
+            with self._cv:
+                self._inflight = None
+
+    def _run_bundle(self, group: List[_Req]) -> None:
+        rows = sum(r.rows for r in group)
+        with trace.span("lightserve.bundle", requests=len(group), rows=rows):
+            try:
+                # chaos site: a raise HERE fails THIS bundle's futures
+                # (clients see the error), never the dispatch thread
+                faults.maybe("lightserve.bundle")
+                res = core.verify_specs(
+                    [r.spec for r in group], provider=self.provider
+                )
+            except Exception as e:
+                for r in group:
+                    _resolve(r.fut, exc=e)
+                return
+        with self._cv:
+            self.bundles += 1
+            self.bundle_rows_total += rows
+            self._occupancy_sum += len(group)
+        for r, verdict in zip(group, res):
+            _resolve(r.fut, verdict)
+
+    # -- stats / lifecycle -------------------------------------------------
+
+    def stats(self) -> Dict[str, float]:
+        with self._cv:
+            bundles = self.bundles
+            return {
+                "queue_depth": len(self._q),
+                "max_queue_depth": self.max_queue_depth,
+                "requests": self.requests,
+                "request_rows": self.request_rows,
+                "bundles": bundles,
+                "bundle_rows": self.bundle_rows_total,
+                "bundle_occupancy_avg": (
+                    self._occupancy_sum / bundles if bundles else 0.0
+                ),
+            }
+
+    def stop(self, timeout: float = 10.0) -> None:
+        """Stop accepting work, drain what is queued, join the thread.
+        Anything still unresolved after the join — queued requests AND
+        the in-flight bundle of a wedged/dead dispatch thread — fails
+        with AggregatorShutdownError so no caller hangs. A wedged
+        thread that eventually wakes loses the resolution race
+        harmlessly (_resolve swallows the already-done set)."""
+        with self._cv:
+            if self._stopped:
+                return
+            self._stopped = True
+            self._cv.notify_all()
+        self._t.join(timeout=timeout)
+        leftovers: List[_Req] = []
+        with self._cv:
+            orphan = self._inflight
+            self._inflight = None
+            if orphan:
+                leftovers.extend(orphan)
+            while self._q:
+                leftovers.append(self._q.popleft())
+            self._queued_rows = 0
+        err = AggregatorShutdownError("lightserve aggregator stopped")
+        for r in leftovers:
+            _resolve(r.fut, exc=err)
+
+    def __enter__(self) -> "RequestAggregator":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.stop()

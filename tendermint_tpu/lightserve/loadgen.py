@@ -1,0 +1,223 @@
+"""Load generator for the lightserve bench and tests: deterministic
+signed-header chains plus a synthetic client fleet.
+
+The chain generator is the canonical implementation of what
+tests/light_helpers.py used to build privately (that module now
+delegates here): keyed validators produce heights 1..N of
+header+commit pairs with optional validator-set changes per height —
+the reference lite2/helpers_test.go GenMockNode shape.
+
+The fleet driver runs N synthetic clients, each requesting a verified
+header at a target height, either **batched** (threads through one
+shared ``LightServeService`` — single-flight + aggregator bundles) or
+**serial** (each client runs its own skip-verification from the trust
+root with direct ``light/verifier.py`` calls — the per-client baseline
+arm). bench.py's ``lightserve_clients_per_sec`` section compares the
+two.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+from tendermint_tpu.codec.signbytes import PRECOMMIT_TYPE
+from tendermint_tpu.crypto.keys import Ed25519PrivKey
+from tendermint_tpu.light.types import SignedHeader
+from tendermint_tpu.types.block import BlockID, Header, PartSetHeader
+from tendermint_tpu.types.validator import Validator
+from tendermint_tpu.types.validator_set import ValidatorSet
+from tendermint_tpu.types.vote import Vote
+from tendermint_tpu.types.vote_set import VoteSet
+
+CHAIN_ID = "light-test-chain"
+T0 = 1_700_000_000_000_000_000
+BLOCK_NS = 1_000_000_000  # 1s blocks
+
+
+def keys(n: int, tag: str = "lc") -> List[Ed25519PrivKey]:
+    return [Ed25519PrivKey.from_secret(f"{tag}-{i}".encode()) for i in range(n)]
+
+
+def valset(privs: List[Ed25519PrivKey], power: int = 10) -> ValidatorSet:
+    return ValidatorSet([Validator(p.pub_key(), power) for p in privs])
+
+
+def sign_commit(
+    privs: List[Ed25519PrivKey],
+    vals: ValidatorSet,
+    header: Header,
+    chain_id: str = CHAIN_ID,
+):
+    block_id = BlockID(header.hash(), PartSetHeader(1, b"\xab" * 32))
+    vs = VoteSet(chain_id, header.height, 0, PRECOMMIT_TYPE, vals)
+    by_addr = {p.pub_key().address(): p for p in privs}
+    for idx, val in enumerate(vals.validators):
+        priv = by_addr[val.address]
+        v = Vote(
+            vote_type=PRECOMMIT_TYPE,
+            height=header.height,
+            round=0,
+            block_id=block_id,
+            timestamp_ns=header.time_ns,
+            validator_address=val.address,
+            validator_index=idx,
+        )
+        v.signature = priv.sign(v.sign_bytes(chain_id))
+        assert vs.add_vote(v)
+    return vs.make_commit()
+
+
+def make_chain(
+    n_heights: int,
+    key_changes: Optional[Dict[int, List[Ed25519PrivKey]]] = None,
+    base_keys: Optional[List[Ed25519PrivKey]] = None,
+    app_hashes: Optional[Dict[int, bytes]] = None,
+    chain_id: str = CHAIN_ID,
+    t0: int = T0,
+) -> Tuple[Dict[int, SignedHeader], Dict[int, ValidatorSet]]:
+    """Heights 1..n. key_changes[h] = the key list that takes effect AT
+    height h (so next_validators_hash of h-1 points at it).
+    app_hashes[h] sets header h's app_hash (lite-proxy proof tests)."""
+    key_changes = key_changes or {}
+    app_hashes = app_hashes or {}
+    cur_keys = base_keys or keys(4)
+    headers: Dict[int, SignedHeader] = {}
+    valsets: Dict[int, ValidatorSet] = {}
+    last_block_id = BlockID()
+
+    for h in range(1, n_heights + 1):
+        if h in key_changes:
+            cur_keys = key_changes[h]
+        vals = valset(cur_keys)
+        next_keys = key_changes.get(h + 1, cur_keys)
+        next_vals = valset(next_keys)
+        header = Header(
+            chain_id=chain_id,
+            height=h,
+            time_ns=t0 + h * BLOCK_NS,
+            last_block_id=last_block_id,
+            validators_hash=vals.hash(),
+            next_validators_hash=next_vals.hash(),
+            consensus_hash=b"\x01" * 32,
+            app_hash=app_hashes.get(h, b""),
+            proposer_address=vals.validators[0].address,
+        )
+        commit = sign_commit(cur_keys, vals, header, chain_id=chain_id)
+        headers[h] = SignedHeader(header, commit)
+        valsets[h] = vals
+        last_block_id = BlockID(header.hash(), PartSetHeader(1, b"\xab" * 32))
+    return headers, valsets
+
+
+class ChainSource:
+    """Sync lightserve source over generated fixtures. ``fail_every``
+    injects a transient fault on every Nth fetch (resilience tests)."""
+
+    def __init__(self, headers, valsets, fail_every: int = 0):
+        self._headers = headers
+        self._vals = valsets
+        self.fail_every = int(fail_every)
+        self.calls = 0
+        self.name = "chaingen"
+
+    def latest_height(self) -> int:
+        return max(self._headers) if self._headers else 0
+
+    def fetch(self, height: int):
+        self.calls += 1
+        if self.fail_every and self.calls % self.fail_every == 0:
+            raise ConnectionError("injected transient source failure")
+        sh = self._headers.get(height)
+        vals = self._vals.get(height)
+        if sh is None or vals is None:
+            raise KeyError(height)
+        return sh, vals
+
+
+# -- fleet drivers ----------------------------------------------------------
+
+
+def run_fleet(
+    service,
+    targets: List[int],
+    now_ns: int,
+    threads: int = 8,
+) -> Tuple[Dict[int, bytes], float]:
+    """Batched arm: one request per target through the shared service,
+    ``threads`` concurrent client workers. Returns ({target: verified
+    header hash}, elapsed_s); any client error propagates."""
+    results: Dict[int, bytes] = {}
+    errors: List[Exception] = []
+    lock = threading.Lock()
+    it = iter(list(enumerate(targets)))
+
+    def worker():
+        while True:
+            with lock:
+                nxt = next(it, None)
+            if nxt is None:
+                return
+            i, h = nxt
+            try:
+                sh = service.verify_at(h, now_ns=now_ns)
+                with lock:
+                    results[i] = sh.hash()
+            except Exception as e:  # pragma: no cover - surfaced below
+                with lock:
+                    errors.append(e)
+                return
+
+    ts = [threading.Thread(target=worker) for _ in range(max(1, threads))]
+    t0 = time.perf_counter()
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    elapsed = time.perf_counter() - t0
+    if errors:
+        raise errors[0]
+    return results, elapsed
+
+
+def serial_fleet(
+    headers,
+    valsets,
+    targets: List[int],
+    trusting_period_ns: int,
+    now_ns: int,
+    chain_id: str = CHAIN_ID,
+    provider=None,
+) -> Tuple[Dict[int, bytes], float]:
+    """Per-client serial arm: every client independently
+    skip-verifies from the trust root (height 1) to its target with
+    direct ``light/verifier.py`` calls — no shared store, no
+    single-flight, no bundling. The baseline a naive proxy would run."""
+    from tendermint_tpu.light import verifier
+
+    results: Dict[int, bytes] = {}
+    t0 = time.perf_counter()
+    for i, target in enumerate(targets):
+        cur_sh, cur_vals = headers[1], valsets[1]
+        while cur_sh.height < target:
+            try_h = target
+            while True:
+                sh, vals = headers[try_h], valsets[try_h]
+                try:
+                    verifier.verify(
+                        chain_id, cur_sh, cur_vals, sh, vals,
+                        trusting_period_ns, now_ns=now_ns, provider=provider,
+                    )
+                    cur_sh, cur_vals = sh, vals
+                    break
+                except verifier.ErrNewValSetCantBeTrusted:
+                    gap = try_h - cur_sh.height
+                    pivot = cur_sh.height + gap * 9 // 16
+                    if pivot <= cur_sh.height or pivot >= try_h:
+                        pivot = cur_sh.height + 1
+                    if pivot == try_h:
+                        raise
+                    try_h = pivot
+        results[i] = cur_sh.hash()
+    return results, time.perf_counter() - t0

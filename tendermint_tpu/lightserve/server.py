@@ -1,0 +1,82 @@
+"""RPC surface for the lightserve verify-server.
+
+Reference analog: lite2/proxy (the verifying RPC server), but serving
+VERIFICATION as the product — a thin client posts ``lightserve_verify``
+with a height and gets back the verified signed header (or an error),
+with all the batching/single-flight happening behind the route. Runs
+standalone next to the existing light proxy server
+(light/proxy_server.py) via :func:`make_lightserve_server`, and the
+same routes are exposed on the node's main RPC (rpc/core.py) when
+``lightserve_enabled`` is on.
+
+Handlers run the blocking service call in the default executor so a
+bisection in flight never stalls the event loop serving other clients
+— concurrency is exactly what makes the aggregator's bundles fill.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Any, Dict
+
+from tendermint_tpu.rpc.core import RPCError
+from tendermint_tpu.rpc.encoding import commit_json, header_json
+
+
+def verified_header_json(sh) -> Dict[str, Any]:
+    return {
+        "height": sh.height,
+        "hash": sh.hash().hex(),
+        "signed_header": {
+            "header": header_json(sh.header),
+            "commit": commit_json(sh.commit),
+        },
+    }
+
+
+class LightServeCore:
+    """Route table backed by a LightServeService (subset of rpc.core)."""
+
+    def __init__(self, service):
+        self._svc = service
+        self._routes = {
+            "health": self.health,
+            "lightserve_verify": self.lightserve_verify,
+            "lightserve_status": self.lightserve_status,
+            "trusted_height": self.trusted_height,
+        }
+
+    def routes(self):
+        return list(self._routes)
+
+    async def call(self, name: str, params: Dict[str, Any]):
+        handler = self._routes.get(name)
+        if handler is None:
+            raise RPCError(f"unknown method {name!r} (lightserve)", code=-32601)
+        try:
+            return await handler(**params)
+        except RPCError:
+            raise
+        except Exception as e:
+            raise RPCError(f"lightserve: {e}")
+
+    async def health(self):
+        return {}
+
+    async def lightserve_verify(self, height=None):
+        h = int(height or 0)
+        loop = asyncio.get_running_loop()
+        sh = await loop.run_in_executor(None, self._svc.verify_at, h)
+        return verified_header_json(sh)
+
+    async def lightserve_status(self):
+        return self._svc.stats()
+
+    async def trusted_height(self):
+        return {"height": self._svc.trusted_height()}
+
+
+def make_lightserve_server(service, laddr: str):
+    from tendermint_tpu.rpc.server import RPCServer
+
+    return RPCServer(None, laddr=laddr, core=LightServeCore(service))

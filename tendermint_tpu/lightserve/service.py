@@ -1,0 +1,385 @@
+"""The verify-server: shared verified-header store + single-flight
+skip-verification over the request aggregator.
+
+A fleet of thin clients asks for verified headers ("is height H
+final?"). Serving each client independently repeats the exact same
+work: when 1,000 clients bisect toward the same target height, the
+pivot chain — fetch header+valset, host checks, commit verification —
+is identical for every one of them. This service makes that work
+sublinear in clients:
+
+- the **shared store** (light/store.py ``TrustedStore``): any height a
+  client request verified is verified for every later client — a store
+  hit costs a dict lookup, no crypto;
+- **single-flight**: concurrent requests for the SAME target height
+  collapse onto one bisection; the first caller runs it, everyone else
+  blocks on the same future and shares the verdict (hits are counted —
+  the metric that proves the dedupe works);
+- the **aggregator** (lightserve/aggregator.py): pivot-chain commit
+  checks from DIFFERENT targets still coalesce into one device bundle;
+- **provider resilience**: fetches retry with exponential backoff
+  behind a per-source ``CircuitBreaker`` (utils/watchdog.py), so one
+  flaky upstream degrades to fast-fail instead of hanging every
+  client. Chaos site ``lightserve.fetch`` injects here.
+
+Verification semantics are EXACTLY ``light/verifier.py``'s: each trust
+link goes through :func:`light.verifier.link_specs` and the shared core,
+so a batched fleet answer is bit-identical to a serial
+``verifier.verify`` call chain (tests/test_lightserve.py proves it).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from concurrent.futures import Future
+from fractions import Fraction
+from typing import Callable, Dict, List, Optional, Tuple
+
+from tendermint_tpu.light import verifier
+from tendermint_tpu.light.store import TrustedStore
+from tendermint_tpu.light.types import DEFAULT_TRUST_LEVEL, SignedHeader
+from tendermint_tpu.lightserve.aggregator import RequestAggregator
+from tendermint_tpu.types.validator_set import ValidatorSet
+from tendermint_tpu.utils import faultinject as faults
+from tendermint_tpu.utils import trace
+from tendermint_tpu.utils.log import get_logger
+from tendermint_tpu.utils.watchdog import CircuitBreaker
+
+# reference client.go:30-31 — pivot at 9/16 of the gap (valsets change
+# slowly, so skew toward the new header); shared with light/client.py
+_BISECTION_NUM = 9
+_BISECTION_DEN = 16
+
+# the node serving its own verified chain: two weeks, the reference's
+# recommended unbonding-period-scale trusting window
+DEFAULT_TRUSTING_PERIOD_NS = 14 * 24 * 3600 * 10**9
+
+
+class LightServeError(Exception):
+    pass
+
+
+class ErrSourceUnavailable(LightServeError):
+    """The header source failed (or its breaker is open)."""
+
+
+class ErrHeightNotServable(LightServeError):
+    """Requested height is below the service's trust root or not yet
+    produced by the source."""
+
+
+class SingleFlight:
+    """Coalesce concurrent identical work: the first caller for a key
+    runs ``fn``; everyone else arriving while it runs blocks on the
+    same future and shares result or exception. Thread-safe."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._inflight: Dict[object, Future] = {}
+        self.runs = 0
+        self.hits = 0
+
+    def do(self, key, fn: Callable[[], object]):
+        with self._lock:
+            fut = self._inflight.get(key)
+            if fut is not None:
+                self.hits += 1
+                mine = False
+            else:
+                fut = Future()
+                self._inflight[key] = fut
+                self.runs += 1
+                mine = True
+        if not mine:
+            return fut.result()
+        try:
+            res = fn()
+        except Exception as e:
+            fut.set_exception(e)
+            raise
+        else:
+            fut.set_result(res)
+            return res
+        finally:
+            with self._lock:
+                self._inflight.pop(key, None)
+
+    def stats(self) -> Dict[str, int]:
+        with self._lock:
+            return {"runs": self.runs, "hits": self.hits, "inflight": len(self._inflight)}
+
+
+class LightServeService:
+    """Batched light-client verification service (docs/light-service.md).
+
+    ``source`` is a SYNC header source: ``fetch(height) ->
+    (SignedHeader, ValidatorSet)`` raising on absence, plus
+    ``latest_height() -> int``. ``NodeSource`` adapts a live node;
+    ``loadgen.ChainSource`` adapts generated fixtures.
+    """
+
+    def __init__(
+        self,
+        chain_id: str,
+        source,
+        store: TrustedStore,
+        aggregator: Optional[RequestAggregator] = None,
+        trusting_period_ns: int = DEFAULT_TRUSTING_PERIOD_NS,
+        trust_level: Fraction = DEFAULT_TRUST_LEVEL,
+        clock_drift_ns: int = verifier.DEFAULT_CLOCK_DRIFT_NS,
+        trust_height: int = 1,
+        trust_hash: Optional[bytes] = None,
+        fetch_retries: int = 3,
+        fetch_backoff_s: float = 0.05,
+        metrics=None,
+        logger=None,
+    ):
+        self.chain_id = chain_id
+        self.source = source
+        self.store = store
+        self.aggregator = aggregator or RequestAggregator()
+        self.trusting_period_ns = int(trusting_period_ns)
+        self.trust_level = trust_level
+        self.clock_drift_ns = int(clock_drift_ns)
+        self.trust_height = int(trust_height)
+        self.trust_hash = trust_hash
+        self.fetch_retries = max(1, int(fetch_retries))
+        self.fetch_backoff_s = float(fetch_backoff_s)
+        self.metrics = metrics
+        self.logger = logger or get_logger("lightserve")
+
+        self._sf = SingleFlight()
+        self._lock = threading.Lock()  # counters
+        self.requests = 0
+        self.store_hits = 0
+        self.headers_verified = 0
+        self.fetches = 0
+        self.fetch_failures = 0
+        self._depth_sum = 0
+        self._depth_max = 0
+        self._breaker = CircuitBreaker(
+            f"lightserve.fetch.{getattr(source, 'name', type(source).__name__)}"
+        )
+
+    # -- fetching (retry/backoff + breaker) --------------------------------
+
+    def _fetch(self, height: int) -> Tuple[SignedHeader, ValidatorSet]:
+        if not self._breaker.allow():
+            raise ErrSourceUnavailable(
+                f"source breaker {self._breaker.name} is open"
+            )
+        # same retry POLICY as light/provider.ResilientProvider._call
+        # (that one is async over Provider errors, this one sync over
+        # KeyError sources) — the schedule itself is shared so the two
+        # paths cannot drift
+        from tendermint_tpu.light.provider import backoff_delays
+
+        last: Optional[Exception] = None
+        delays = backoff_delays(self.fetch_retries, self.fetch_backoff_s, 2.0)
+        for attempt in range(self.fetch_retries):
+            # counted per ATTEMPT, before any failure path, so
+            # fetch_failures can never exceed fetches on a dashboard
+            with self._lock:
+                self.fetches += 1
+            try:
+                faults.maybe("lightserve.fetch")
+                sh, vals = self.source.fetch(height)
+                self._breaker.record_success()
+                return sh, vals
+            except KeyError as e:
+                # deterministic absence (height pruned / not produced):
+                # the source is HEALTHY — don't trip the breaker or burn
+                # retries on an answer every attempt would repeat
+                self._breaker.record_success()
+                raise ErrHeightNotServable(f"no header at height {height}") from e
+            except Exception as e:
+                last = e
+                with self._lock:
+                    self.fetch_failures += 1
+                if attempt + 1 < self.fetch_retries:
+                    time.sleep(next(delays))
+        self._breaker.record_failure()
+        raise ErrSourceUnavailable(
+            f"source failed after {self.fetch_retries} attempts: {last!r}"
+        )
+
+    # -- initialization ----------------------------------------------------
+
+    def _ensure_initialized(self, now_ns: int) -> None:
+        if self.store.latest_height() > 0:
+            return
+        sh, vals = self._fetch(self.trust_height)
+        if self.trust_hash is not None and sh.hash() != self.trust_hash:
+            raise LightServeError(
+                f"trust root hash mismatch at height {self.trust_height}"
+            )
+        from tendermint_tpu.lightserve import core
+
+        # the root header must bind to its own commit
+        # (commit.block_id.hash == header.hash() lives in
+        # validate_basic) — without this a source could pair a real
+        # commit with a forged header and poison the shared store
+        core.ensure_basic(self.chain_id, sh)
+        core.ensure_valset_matches(sh, vals)
+        err = self.aggregator.verify([core.full_spec(vals, self.chain_id, sh)])[0]
+        if err is not None:
+            raise err
+        self.store.save(sh, vals)
+
+    # -- public API --------------------------------------------------------
+
+    def trusted_height(self) -> int:
+        return self.store.latest_height()
+
+    def verify_at(self, height: int, now_ns: Optional[int] = None) -> SignedHeader:
+        """A verified SignedHeader at ``height`` (0 = source latest).
+        Store hit → free; otherwise one single-flighted bisection from
+        the nearest trusted header below, its commit checks riding the
+        shared aggregator bundles."""
+        now = time.time_ns() if now_ns is None else now_ns
+        with self._lock:
+            self.requests += 1
+        if height == 0:
+            height = self.source.latest_height()
+            if height <= 0:
+                raise ErrHeightNotServable("source has no headers yet")
+        sh = self.store.signed_header(height)
+        if sh is not None:
+            with self._lock:
+                self.store_hits += 1
+            return sh
+        return self._sf.do(height, lambda: self._advance_to(height, now))
+
+    # -- bisection ---------------------------------------------------------
+
+    def _anchor_below(self, height: int) -> Tuple[SignedHeader, ValidatorSet]:
+        hs = self.store.heights()
+        below = [h for h in hs if h <= height]
+        if not below:
+            raise ErrHeightNotServable(
+                f"height {height} is below the trust root {hs[0] if hs else 0}"
+            )
+        h = below[-1]
+        return self.store.signed_header(h), self.store.validator_set(h)
+
+    def _advance_to(self, height: int, now: int) -> SignedHeader:
+        # a racer may have stored it between the miss and our turn
+        sh = self.store.signed_header(height)
+        if sh is not None:
+            return sh
+        self._ensure_initialized(now)
+        with trace.span("lightserve.advance", height=height):
+            cur_sh, cur_vals = self._anchor_below(height)
+            fetched: Dict[int, Tuple[SignedHeader, ValidatorSet]] = {}
+            depth = 0
+            guard = 0
+            while cur_sh.height < height:
+                guard += 1
+                if guard > 128:
+                    raise LightServeError("bisection did not converge")
+                try_h = height
+                while True:
+                    stored = self.store.signed_header(try_h)
+                    if stored is not None:
+                        # another target's pivot chain already verified
+                        # this height — adopt it, no crypto
+                        cur_sh, cur_vals = stored, self.store.validator_set(try_h)
+                        break
+                    if try_h in fetched:
+                        # pivot rounds revisit heights (the target is
+                        # retried after every accepted pivot) — one
+                        # fetch per height per flight
+                        sh, vals = fetched[try_h]
+                    else:
+                        sh, vals = fetched[try_h] = self._fetch(try_h)
+                    specs = verifier.link_specs(
+                        self.chain_id, cur_sh, cur_vals, sh, vals,
+                        self.trusting_period_ns, self.trust_level,
+                        now_ns=now, clock_drift_ns=self.clock_drift_ns,
+                    )
+                    res = self.aggregator.verify([s for _, s in specs])
+                    err_kind = next(
+                        (
+                            (kind, err)
+                            for (kind, _), err in zip(specs, res)
+                            if err is not None
+                        ),
+                        None,
+                    )
+                    if err_kind is None:
+                        self.store.save(sh, vals)
+                        with self._lock:
+                            self.headers_verified += 1
+                        depth += 1
+                        cur_sh, cur_vals = sh, vals
+                        break
+                    kind, err = err_kind
+                    if kind != "trusting":
+                        raise err
+                    # pivot closer to the trusted header (9/16 rule)
+                    gap = try_h - cur_sh.height
+                    pivot = cur_sh.height + gap * _BISECTION_NUM // _BISECTION_DEN
+                    if pivot <= cur_sh.height or pivot >= try_h:
+                        pivot = cur_sh.height + 1
+                    if pivot == try_h:
+                        raise verifier.ErrNewValSetCantBeTrusted(str(err))
+                    try_h = pivot
+        with self._lock:
+            self._depth_sum += depth
+            self._depth_max = max(self._depth_max, depth)
+        if self.metrics is not None:
+            self.metrics.observe_bisection_depth(depth)
+        return cur_sh
+
+    # -- stats / lifecycle -------------------------------------------------
+
+    def stats(self) -> Dict[str, float]:
+        with self._lock:
+            s = {
+                "requests": self.requests,
+                "store_hits": self.store_hits,
+                "headers_verified": self.headers_verified,
+                "fetches": self.fetches,
+                "fetch_failures": self.fetch_failures,
+                "bisection_depth_max": self._depth_max,
+                "trusted_height": self.store.latest_height(),
+                "trusted_heights": len(self.store.heights()),
+                "breaker_state": self._breaker.state(),
+            }
+        sf = self._sf.stats()
+        s["singleflight_runs"] = sf["runs"]
+        s["singleflight_hits"] = sf["hits"]
+        for k, v in self.aggregator.stats().items():
+            s[f"bundle_{k}" if not k.startswith("bundle") else k] = v
+        return s
+
+    def stop(self) -> None:
+        self.aggregator.stop()  # idempotent; drains queued bundles
+
+
+class NodeSource:
+    """Sync header source over a live in-process node (the block/state
+    stores are plain dict/sqlite reads — no event loop needed)."""
+
+    def __init__(self, node):
+        self._node = node
+        self.name = "node"
+
+    def latest_height(self) -> int:
+        return self._node.block_store.height
+
+    def fetch(self, height: int) -> Tuple[SignedHeader, ValidatorSet]:
+        store = self._node.block_store
+        meta = store.load_block_meta(height)
+        commit = (
+            store.load_seen_commit(height)
+            if height == store.height
+            else store.load_block_commit(height)
+        )
+        if meta is None or commit is None:
+            raise KeyError(height)
+        vals = self._node.state_store.load_validators(height)
+        if vals is None:
+            raise KeyError(height)
+        return SignedHeader(meta.header, commit), vals

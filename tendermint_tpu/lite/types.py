@@ -53,9 +53,13 @@ class FullCommit:
         err = self.signed_header.validate_basic(chain_id)
         if err is not None:
             return err
-        hdr, cmt = self.signed_header.header, self.signed_header.commit
+        # batched +2/3 signature check via the shared device-backed core
+        # (lightserve/core.py) — the same dispatch path as light/ and
+        # the lite verifiers
+        from tendermint_tpu.lightserve import core
+
         try:
-            self.validators.verify_commit(chain_id, cmt.block_id, hdr.height, cmt)
+            core.verify_one(core.full_spec(self.validators, chain_id, self.signed_header))
         except Exception as e:
             return str(e)
         return None

@@ -3,10 +3,14 @@
 
 Reference: lite/base_verifier.go:19, lite/dynamic_verifier.go:24
 (Verify :71, verifyAndSave :190, updateToHeight divide-and-conquer
-:210). Commit signature work drains through the batched device
-verifier (ValidatorSet.verify_commit / verify_commit_trusting with
-trust level 2/3 standing in for VerifyFutureCommit — the same >2/3
-old-set rule, types/validator_set.go:744).
+:210). All commit signature work drains through the SAME device-backed
+core as the lite2 stack (lightserve/core.py): this module used to
+re-implement the header/valset consistency checks and call the batched
+verifier methods directly; those duplicated paths are gone — the v1
+stack is now pure v1 SEMANTICS (FullCommit bookkeeping, bisection
+policy) over the shared core. Trust level 2/3 stands in for
+VerifyFutureCommit — the same >2/3 old-set rule,
+types/validator_set.go:744.
 """
 
 from __future__ import annotations
@@ -14,6 +18,7 @@ from __future__ import annotations
 from fractions import Fraction
 from typing import Optional
 
+from tendermint_tpu.lightserve import core
 from tendermint_tpu.lite.provider import (
     ErrCommitNotFound,
     ErrUnknownValidators,
@@ -61,16 +66,14 @@ class BaseVerifier:
             raise LiteVerifyError(
                 f"BaseVerifier height is {self.height}, cannot verify {hdr.height}"
             )
-        if hdr.validators_hash != self.valset.hash():
-            raise ErrUnexpectedValidators(
-                f"header vhash {hdr.validators_hash.hex()} != {self.valset.hash().hex()}"
-            )
-        err = shdr.validate_basic(self.chain_id)
-        if err is not None:
-            raise LiteVerifyError(err)
-        self.valset.verify_commit(
-            self.chain_id, shdr.commit.block_id, hdr.height, shdr.commit
-        )
+        # basic validity + valset-hash match + the batched +2/3 commit
+        # check — ONE shared core call, the v1 taxonomy mapped back on
+        try:
+            core.verify_header(self.chain_id, shdr, self.valset)
+        except core.ErrValsetMismatch as e:
+            raise ErrUnexpectedValidators(str(e)) from None
+        except core.ErrBadHeader as e:
+            raise LiteVerifyError(str(e)) from None
 
 
 class DynamicVerifier:
@@ -140,12 +143,12 @@ class DynamicVerifier:
 
     def _verify_and_save(self, trusted_fc: FullCommit, source_fc: FullCommit) -> None:
         """Reference verifyAndSave :190: >2/3 of the trusted NEXT valset
-        must have signed the source commit (VerifyFutureCommit)."""
+        must have signed the source commit (VerifyFutureCommit) — one
+        batched trusting check through the shared core."""
         assert trusted_fc.height() < source_fc.height()
-        sh = source_fc.signed_header
-        trusted_fc.next_validators.verify_commit_trusting(
-            self.chain_id, sh.commit.block_id, sh.header.height, sh.commit,
-            trust_level=_TRUST_2_3,
+        core.verify_header_trusting(
+            self.chain_id, trusted_fc.next_validators,
+            source_fc.signed_header, _TRUST_2_3,
         )
         self.trusted.save_full_commit(source_fc)
 

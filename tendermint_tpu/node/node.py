@@ -313,6 +313,7 @@ class Node(Service):
         from tendermint_tpu.utils.metrics import (
             CryptoMetrics,
             HealthMetrics,
+            LightServeMetrics,
             MerkleMetrics,
             TraceMetrics,
         )
@@ -327,6 +328,12 @@ class Node(Service):
         self.merkle_metrics = MerkleMetrics(self.metrics_registry, ns)
         self.trace_metrics = TraceMetrics(self.metrics_registry, ns)
         self.health_metrics = HealthMetrics(self.metrics_registry, ns)
+        self.lightserve_metrics = LightServeMetrics(self.metrics_registry, ns)
+        # batched light-client verification service (lightserve/):
+        # constructed in on_start (it reads the block store), None when
+        # lightserve_enabled is off
+        self.lightserve = None
+        self.lightserve_server = None
         self._block_exec_metrics_attach()
         self.metrics_server = None
         if config.instrumentation.prometheus:
@@ -527,6 +534,40 @@ class Node(Service):
             self.addr_book = None
             self.pex_reactor = None
 
+        # -- lightserve: the node as a verify-server for thin clients ------
+        # (lightserve/service.py; docs/light-service.md). Sources headers
+        # straight from the local block/state stores, coalesces the
+        # fleet's commit checks into device bundles THROUGH the node's
+        # own pipelined provider, and shares verified headers across all
+        # clients. Started before RPC so its routes are servable the
+        # moment the port is open.
+        if self.config.base.lightserve_enabled:
+            from tendermint_tpu.lightserve.aggregator import RequestAggregator
+            from tendermint_tpu.lightserve.server import make_lightserve_server
+            from tendermint_tpu.lightserve.service import LightServeService, NodeSource
+            from tendermint_tpu.light.store import TrustedStore
+
+            agg = RequestAggregator(
+                provider=self.crypto_provider,
+                bundle_rows=self.config.base.lightserve_bundle_rows,
+                flush_s=self.config.base.lightserve_flush_ms / 1000.0,
+            )
+            if self.watchdog is not None:
+                agg.attach_watchdog(self.watchdog)
+            self.lightserve = LightServeService(
+                self.genesis_doc.chain_id,
+                NodeSource(self),
+                TrustedStore(make_db("lightserve", self.config)),
+                aggregator=agg,
+                metrics=self.lightserve_metrics,
+                logger=self.logger,
+            )
+            if self.config.base.lightserve_laddr:
+                self.lightserve_server = make_lightserve_server(
+                    self.lightserve, self.config.base.lightserve_laddr
+                )
+                await self.lightserve_server.start()
+
         # RPC first, then p2p (reference :760 comment: "we may expose the
         # RPC without starting the switch")
         if self.rpc_server is not None:
@@ -639,6 +680,8 @@ class Node(Service):
                 _watchdog.breaker_stats(),
                 _faults.stats(),
             )
+            if self.lightserve is not None:
+                self.lightserve_metrics.update(self.lightserve.stats())
             if self.watchdog is not None:
                 self.watchdog.heartbeat("node.metrics_pump")
             await asyncio.sleep(2.0)
@@ -656,6 +699,12 @@ class Node(Service):
         if self.watchdog is not None:
             self.watchdog.stop()
         await self.switch.stop()
+        # lightserve before the pipeline: its aggregator feeds specs into
+        # the pipelined provider, so it must drain first
+        if self.lightserve_server is not None:
+            await self.lightserve_server.stop()
+        if self.lightserve is not None:
+            self.lightserve.stop()
         # drain the pipelined verify dispatcher: every already-submitted
         # future completes before its threads exit (crypto/pipeline.py)
         stop_pipeline = getattr(self.crypto_provider, "stop", None)

@@ -119,6 +119,8 @@ class RPCCore:
             "unsafe_write_heap_profile": self.unsafe_write_heap_profile,
             "dump_trace": self.dump_trace,
             "trace_timeline": self.trace_timeline,
+            "lightserve_verify": self.lightserve_verify,
+            "lightserve_status": self.lightserve_status,
         }
 
     def routes(self) -> List[str]:
@@ -593,6 +595,33 @@ class RPCCore:
         )
         out["tracer"] = t.stats()
         return out
+
+    # -- lightserve routes (the batched light-client verify service,
+    # lightserve/service.py; also servable on its own laddr via
+    # lightserve/server.py) ------------------------------------------------
+
+    def _lightserve(self):
+        svc = getattr(self.node, "lightserve", None)
+        if svc is None:
+            raise RPCError("lightserve is not enabled on this node")
+        return svc
+
+    async def lightserve_verify(self, height=None) -> Dict[str, Any]:
+        """A light-client-VERIFIED signed header at ``height`` (0 =
+        latest). Blocking bisection work runs in an executor so
+        concurrent client requests coalesce in the aggregator instead
+        of serializing on the event loop."""
+        from tendermint_tpu.lightserve.server import verified_header_json
+
+        svc = self._lightserve()
+        h = _int_arg(height, "height", 0) or 0
+        sh = await asyncio.get_running_loop().run_in_executor(
+            None, svc.verify_at, h
+        )
+        return verified_header_json(sh)
+
+    async def lightserve_status(self) -> Dict[str, Any]:
+        return self._lightserve().stats()
 
     # -- abci routes -------------------------------------------------------
 

@@ -78,6 +78,8 @@ KNOWN_SITES = (
     "p2p.write",       # p2p/conn/connection.py send routine
     "p2p.accept",      # p2p/transport.py inbound upgrade path
     "p2p.dial",        # p2p/transport.py outbound dial path
+    "lightserve.fetch",   # lightserve/service.py header-source fetch path
+    "lightserve.bundle",  # lightserve/aggregator.py bundle dispatch (fails the bundle, not the thread)
 )
 
 _ACTIONS = ("raise", "delay", "tear")

@@ -459,6 +459,68 @@ class HealthMetrics:
                 )
 
 
+class LightServeMetrics:
+    """Batched light-client verification service
+    (``tendermint_lightserve_*``, lightserve/service.py +
+    aggregator.py): client request volume, how well the shared store /
+    single-flight / bundle funnel collapse it, and the bisection-depth
+    distribution. Monotonic totals are TRUE counters fed by snapshot
+    deltas from ``LightServeService.stats()`` on each pump, like
+    CryptoMetrics; the bisection-depth histogram is observed directly
+    by the service (a distribution can't be rebuilt from snapshot
+    deltas). See docs/light-service.md."""
+
+    _COUNTERS = (
+        ("requests", "requests"),
+        ("store_hits", "store_hits"),
+        ("singleflight_runs", "singleflight_runs"),
+        ("singleflight_hits", "singleflight_hits"),
+        ("headers_verified", "headers_verified"),
+        ("bundles", "bundles"),
+        ("bundle_rows", "bundle_rows"),
+        ("fetches", "fetches"),
+        ("fetch_failures", "fetch_failures"),
+    )
+
+    def __init__(self, registry: Optional[Registry] = None, namespace="tendermint"):
+        r = registry or Registry()
+        sub = "lightserve"
+        reg = r.register
+        self.requests = reg(Counter("requests_total", "Client verify requests served.", namespace, sub))
+        self.store_hits = reg(Counter("store_hits_total", "Requests answered from the shared verified-header store (no crypto).", namespace, sub))
+        self.singleflight_runs = reg(Counter("singleflight_runs_total", "Bisections actually executed.", namespace, sub))
+        self.singleflight_hits = reg(Counter("singleflight_hits_total", "Requests that shared another caller's in-flight bisection.", namespace, sub))
+        self.headers_verified = reg(Counter("headers_verified_total", "Headers verified and added to the shared store.", namespace, sub))
+        self.bundles = reg(Counter("bundles_total", "Aggregator bundles dispatched to the device.", namespace, sub))
+        self.bundle_rows = reg(Counter("bundle_rows_total", "Signature rows dispatched in aggregator bundles.", namespace, sub))
+        self.fetches = reg(Counter("fetches_total", "Header-source fetches.", namespace, sub))
+        self.fetch_failures = reg(Counter("fetch_failures_total", "Header-source fetch attempts that failed (pre-retry).", namespace, sub))
+        self.bundle_occupancy = reg(Gauge("bundle_occupancy_avg", "Mean verify requests coalesced per bundle.", namespace, sub))
+        self.trusted_height = reg(Gauge("trusted_height", "Latest verified height in the shared store.", namespace, sub))
+        self.trusted_heights = reg(Gauge("trusted_heights", "Heights currently held in the shared store.", namespace, sub))
+        self.bisection_depth = reg(
+            Histogram(
+                "bisection_depth",
+                "Links verified per bisection (skip-verification pivot chain length).",
+                namespace, sub,
+                buckets=(1, 2, 4, 8, 16, 32, 64),
+            )
+        )
+        self._deltas = _SnapshotCounters()
+
+    def observe_bisection_depth(self, depth: int) -> None:
+        self.bisection_depth.observe(depth)
+
+    def update(self, stats: dict) -> None:
+        """Fold a LightServeService.stats() snapshot into the
+        instruments (delta-feed for counters, set for gauges)."""
+        self.bundle_occupancy.set(stats.get("bundle_occupancy_avg", 0))
+        self.trusted_height.set(stats.get("trusted_height", 0))
+        self.trusted_heights.set(stats.get("trusted_heights", 0))
+        for attr, key in self._COUNTERS:
+            self._deltas.feed(getattr(self, attr), key, stats)
+
+
 class StateMetrics:
     def __init__(self, registry: Optional[Registry] = None, namespace="tendermint"):
         r = registry or Registry()

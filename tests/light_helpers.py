@@ -3,91 +3,20 @@
 Reference: lite2/helpers_test.go — genMockNodeWithKeys / GenMockNode:
 keyed validators produce a chain of headers+commits with optional
 validator-set changes per height.
+
+The implementation moved to ``tendermint_tpu/lightserve/loadgen.py``
+(the lightserve bench needs the same generator outside the test tree);
+this module keeps the historical test-facing names as thin aliases.
 """
 
 from __future__ import annotations
 
-from typing import Dict, List, Optional, Tuple
-
-from tendermint_tpu.codec.signbytes import PRECOMMIT_TYPE
-from tendermint_tpu.crypto.keys import Ed25519PrivKey
-from tendermint_tpu.light.types import SignedHeader
-from tendermint_tpu.types.block import BlockID, Header, PartSetHeader
-from tendermint_tpu.types.validator import Validator
-from tendermint_tpu.types.validator_set import ValidatorSet
-from tendermint_tpu.types.vote import Vote
-from tendermint_tpu.types.vote_set import VoteSet
-
-CHAIN_ID = "light-test-chain"
-T0 = 1_700_000_000_000_000_000
-BLOCK_NS = 1_000_000_000  # 1s blocks
-
-
-def keys(n: int, tag: str = "lc") -> List[Ed25519PrivKey]:
-    return [Ed25519PrivKey.from_secret(f"{tag}-{i}".encode()) for i in range(n)]
-
-
-def valset(privs: List[Ed25519PrivKey], power: int = 10) -> ValidatorSet:
-    return ValidatorSet([Validator(p.pub_key(), power) for p in privs])
-
-
-def _sign_commit(
-    privs: List[Ed25519PrivKey], vals: ValidatorSet, header: Header
-) -> "Commit":
-    block_id = BlockID(header.hash(), PartSetHeader(1, b"\xab" * 32))
-    vs = VoteSet(CHAIN_ID, header.height, 0, PRECOMMIT_TYPE, vals)
-    by_addr = {p.pub_key().address(): p for p in privs}
-    for idx, val in enumerate(vals.validators):
-        priv = by_addr[val.address]
-        v = Vote(
-            vote_type=PRECOMMIT_TYPE,
-            height=header.height,
-            round=0,
-            block_id=block_id,
-            timestamp_ns=header.time_ns,
-            validator_address=val.address,
-            validator_index=idx,
-        )
-        v.signature = priv.sign(v.sign_bytes(CHAIN_ID))
-        assert vs.add_vote(v)
-    return vs.make_commit()
-
-
-def gen_chain(
-    n_heights: int,
-    key_changes: Optional[Dict[int, List[Ed25519PrivKey]]] = None,
-    base_keys: Optional[List[Ed25519PrivKey]] = None,
-    app_hashes: Optional[Dict[int, bytes]] = None,
-) -> Tuple[Dict[int, SignedHeader], Dict[int, ValidatorSet]]:
-    """Heights 1..n. key_changes[h] = the key list that takes effect AT
-    height h (so next_validators_hash of h-1 points at it).
-    app_hashes[h] sets header h's app_hash (lite-proxy proof tests)."""
-    key_changes = key_changes or {}
-    app_hashes = app_hashes or {}
-    cur_keys = base_keys or keys(4)
-    headers: Dict[int, SignedHeader] = {}
-    valsets: Dict[int, ValidatorSet] = {}
-    last_block_id = BlockID()
-
-    for h in range(1, n_heights + 1):
-        if h in key_changes:
-            cur_keys = key_changes[h]
-        vals = valset(cur_keys)
-        next_keys = key_changes.get(h + 1, cur_keys)
-        next_vals = valset(next_keys)
-        header = Header(
-            chain_id=CHAIN_ID,
-            height=h,
-            time_ns=T0 + h * BLOCK_NS,
-            last_block_id=last_block_id,
-            validators_hash=vals.hash(),
-            next_validators_hash=next_vals.hash(),
-            consensus_hash=b"\x01" * 32,
-            app_hash=app_hashes.get(h, b""),
-            proposer_address=vals.validators[0].address,
-        )
-        commit = _sign_commit(cur_keys, vals, header)
-        headers[h] = SignedHeader(header, commit)
-        valsets[h] = vals
-        last_block_id = BlockID(header.hash(), PartSetHeader(1, b"\xab" * 32))
-    return headers, valsets
+from tendermint_tpu.lightserve.loadgen import (  # noqa: F401
+    BLOCK_NS,
+    CHAIN_ID,
+    T0,
+    keys,
+    make_chain as gen_chain,
+    sign_commit as _sign_commit,
+    valset,
+)

@@ -85,6 +85,55 @@ def test_guard_coldstart_presence_only(bench):
     assert any("coldstart_first_verify_s" in f for f in fails)
 
 
+def test_guard_flags_lightserve_regression_and_disappearance(bench):
+    """The lightserve fleet keys ride the guard like replay_speedup: a
+    previously-measured clients/sec that regresses or goes missing must
+    hard-fail the bench."""
+    _write_record(bench, lightserve_clients_per_sec=500, lightserve_speedup=8.0)
+    # regressed beyond tolerance
+    fails = bench._regression_guard(
+        {"lightserve_clients_per_sec": 300, "lightserve_speedup": 8.0}, "tpu"
+    )
+    assert len(fails) == 1 and "lightserve_clients_per_sec" in fails[0]
+    # section errored entirely: both keys flagged missing
+    fails = bench._regression_guard({"lightserve_error": "boom"}, "tpu")
+    assert any("lightserve_clients_per_sec" in f and "missing" in f for f in fails)
+    assert any("lightserve_speedup" in f for f in fails)
+    # within tolerance: clean
+    assert (
+        bench._regression_guard(
+            {"lightserve_clients_per_sec": 450, "lightserve_speedup": 7.5}, "tpu"
+        )
+        == []
+    )
+
+
+def test_lightserve_bench_batched_beats_serial_3x(bench, monkeypatch):
+    """The acceptance bar: the batched lightserve arm serves clients at
+    least 3x the per-client serial arm on this box (test-sized fleet —
+    the full-size run rides bench.py)."""
+    monkeypatch.setattr(bench, "LIGHTSERVE_CLIENTS", 24)
+    monkeypatch.setattr(bench, "LIGHTSERVE_HEIGHTS", 8)
+    monkeypatch.setattr(bench, "LIGHTSERVE_VALS", 4)
+    monkeypatch.setattr(bench, "LIGHTSERVE_TARGETS", 2)
+    # best-of-2: a scheduler hiccup on a small shared box can eat one
+    # batched arm (the bench's own min-of-N discipline); typical runs
+    # measure 5-7x here
+    best = None
+    for _ in range(2):
+        out = bench.lightserve_bench()
+        assert "lightserve_error" not in out, out
+        if best is None or out["lightserve_speedup"] > best["lightserve_speedup"]:
+            best = out
+        if best["lightserve_speedup"] >= 3.0:
+            break
+    out = best
+    assert out["lightserve_clients_per_sec"] > 0
+    assert out["lightserve_speedup"] >= 3.0, out
+    # the mechanisms that produce the speedup actually engaged
+    assert out["lightserve_singleflight_hits"] + out["lightserve_store_hits"] > 0
+
+
 def test_guard_env_kill_switch(bench, monkeypatch):
     _write_record(bench, tabled_p50_ms=100.0)
     monkeypatch.setenv("TM_BENCH_NO_GUARD", "1")

@@ -51,6 +51,13 @@ CHAOS_PLAN = {
     "p2p.write": ("delay", dict(p=0.1, delay_ms=1)),
     "p2p.accept": ("raise", dict(p=0.1)),
     "p2p.dial": ("raise", dict(p=0.1)),
+    # lightserve absorbs raises by design: fetch retries/backoff eat
+    # transient source errors, and a bundle raise fails that bundle's
+    # client futures, never the dispatch thread (the chaos node here
+    # runs with lightserve off, so these stay armed-but-idle; their
+    # firing paths are pinned in tests/test_lightserve.py)
+    "lightserve.fetch": ("raise", dict(p=0.2)),
+    "lightserve.bundle": ("raise", dict(p=0.2)),
 }
 
 

@@ -13,6 +13,7 @@ from tendermint_tpu.utils.metrics import (
     CryptoMetrics,
     Gauge,
     Histogram,
+    LightServeMetrics,
     MerkleMetrics,
     MetricsServer,
     Registry,
@@ -39,6 +40,13 @@ def _full_registry() -> Registry:
     tm = TraceMetrics(r)
     tm.update({"enabled": 1, "events_recorded": 100, "events_dropped": 1,
                "buffer_events": 99, "buffer_capacity": 128})
+    ls = LightServeMetrics(r)
+    ls.observe_bisection_depth(3)
+    ls.update({"requests": 40, "store_hits": 20, "singleflight_runs": 4,
+               "singleflight_hits": 16, "headers_verified": 5, "bundles": 2,
+               "bundle_rows": 64, "fetches": 6, "fetch_failures": 1,
+               "bundle_occupancy_avg": 3.5, "trusted_height": 16,
+               "trusted_heights": 5})
     lbl = r.register(Counter("requests_total", "Reqs.", "tendermint", "rpc"))
     lbl.with_labels(method="status").inc(2)
     lbl.with_labels(method='we"ird\\path\n').inc()  # escaping exercised
@@ -63,6 +71,10 @@ def test_scrape_started_metrics_server():
             await srv.stop()
         assert "tendermint_consensus_height" in text
         assert 'step="propose"' in text
+        # the lightserve family is scraped from the live server and
+        # passes the same strict lint
+        assert "tendermint_lightserve_requests_total" in text
+        assert "tendermint_lightserve_bisection_depth_bucket" in text
         errors = lint.validate_metrics_text(text)
         assert errors == [], "\n".join(errors)
 

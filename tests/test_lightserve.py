@@ -1,0 +1,673 @@
+"""The batched light-client verification service (tendermint_tpu/lightserve/).
+
+Covers the whole new subsystem: the shared device-backed core both
+light stacks consume, the request aggregator's coalescing, single-flight
+bisection over the shared store (the ISSUE's concurrent-bisection
+parity requirement), the store's in-memory height index, provider
+resilience (retry/backoff + breaker), the chaos sites, and the RPC
+surface. Long-running fleet scale rides the ``slow`` marker.
+"""
+
+import threading
+import time
+
+import pytest
+
+from tendermint_tpu.db.memdb import MemDB
+from tendermint_tpu.light import verifier
+from tendermint_tpu.light.store import TrustedStore
+from tendermint_tpu.lightserve import core, loadgen
+from tendermint_tpu.lightserve.aggregator import RequestAggregator
+from tendermint_tpu.lightserve.service import (
+    ErrHeightNotServable,
+    ErrSourceUnavailable,
+    LightServeService,
+    SingleFlight,
+)
+from tendermint_tpu.types.validator_set import (
+    ErrInvalidCommitSignature,
+    ErrNotEnoughVotingPower,
+)
+
+CHAIN_ID = loadgen.CHAIN_ID
+PERIOD = 3 * 3600 * 10**9
+NOW = loadgen.T0 + 600 * 10**9
+
+
+def make_service(headers, valsets, flush_s=0.001, trusting_period_ns=PERIOD, **kw):
+    src = loadgen.ChainSource(headers, valsets)
+    agg = RequestAggregator(flush_s=flush_s)
+    svc = LightServeService(
+        CHAIN_ID, src, TrustedStore(MemDB()), aggregator=agg,
+        trusting_period_ns=trusting_period_ns, fetch_backoff_s=0.001, **kw,
+    )
+    return svc, src, agg
+
+
+def tamper(sh):
+    cs = sh.commit.signatures[0]
+    cs.signature = (
+        cs.signature[:10] + bytes([cs.signature[10] ^ 1]) + cs.signature[11:]
+    )
+
+
+# -- shared core ------------------------------------------------------------
+
+
+def test_core_verify_specs_parity_with_direct_calls():
+    """Core verdicts must be the exact exceptions the direct
+    ValidatorSet methods raise — light/ and lite/ both ride this."""
+    headers, valsets = loadgen.make_chain(3)
+    good = core.full_spec(valsets[2], CHAIN_ID, headers[2])
+    bad_sh = loadgen.make_chain(3)[0][2]
+    tamper(bad_sh)
+    bad = core.full_spec(valsets[2], CHAIN_ID, bad_sh)
+    # a trusting check against a disjoint set: no overlap -> no power
+    other_vals = loadgen.valset(loadgen.keys(4, tag="disjoint"))
+    from fractions import Fraction
+
+    weak = core.trusting_spec(other_vals, CHAIN_ID, headers[2], Fraction(1, 3))
+
+    res = core.verify_specs([good, bad, weak])
+    assert res[0] is None
+    assert isinstance(res[1], ErrInvalidCommitSignature)
+    assert isinstance(res[2], ErrNotEnoughVotingPower)
+
+    with pytest.raises(ErrInvalidCommitSignature):
+        core.verify_one(bad)
+    core.verify_header(CHAIN_ID, headers[2], valsets[2])
+    with pytest.raises(core.ErrValsetMismatch):
+        core.verify_header(CHAIN_ID, headers[2], other_vals)
+
+
+def test_core_routes_through_pipelined_provider():
+    """A provider with submit_commit (the node's PipelinedVerifier) gets
+    the specs SUBMITTED — one coalesced device group — with verdict
+    parity."""
+    from tendermint_tpu.crypto.batch import CPUBatchVerifier
+    from tendermint_tpu.crypto.pipeline import PipelinedVerifier, SigCache
+
+    headers, valsets = loadgen.make_chain(4)
+    specs = [core.full_spec(valsets[h], CHAIN_ID, headers[h]) for h in (2, 3, 4)]
+    with PipelinedVerifier(CPUBatchVerifier(), cache=SigCache()) as pv:
+        res = core.verify_specs(specs, provider=pv)
+        assert res == [None, None, None]
+        assert pv.stats()["submitted_calls"] >= 3
+
+
+# -- aggregator -------------------------------------------------------------
+
+
+def test_aggregator_coalesces_concurrent_submits():
+    headers, valsets = loadgen.make_chain(6)
+    with RequestAggregator(flush_s=0.05) as agg:
+        futs = [
+            agg.submit(core.full_spec(valsets[h], CHAIN_ID, headers[h]))
+            for h in range(2, 7)
+        ]
+        assert [f.result() for f in futs] == [None] * 5
+        st = agg.stats()
+        assert st["requests"] == 5
+        # the 50ms linger must have bundled the burst into ONE dispatch
+        assert st["bundles"] == 1
+        assert st["bundle_occupancy_avg"] == 5.0
+
+
+def test_aggregator_verdict_parity_and_row_cap():
+    headers, valsets = loadgen.make_chain(4)
+    bad_sh = loadgen.make_chain(4)[0][3]
+    tamper(bad_sh)
+    # bundle_rows=1: every spec becomes its own bundle (cap respected)
+    with RequestAggregator(flush_s=0.0, bundle_rows=1) as agg:
+        res = agg.verify(
+            [
+                core.full_spec(valsets[2], CHAIN_ID, headers[2]),
+                core.full_spec(valsets[3], CHAIN_ID, bad_sh),
+            ]
+        )
+        assert res[0] is None
+        assert isinstance(res[1], ErrInvalidCommitSignature)
+        assert agg.stats()["bundles"] == 2
+
+
+def test_aggregator_stop_fails_pending_and_inlines_late_submits():
+    headers, valsets = loadgen.make_chain(2)
+    agg = RequestAggregator(flush_s=0.0)
+    agg.stop()
+    # late submit after stop still resolves (inline execution)
+    fut = agg.submit(core.full_spec(valsets[2], CHAIN_ID, headers[2]))
+    assert fut.result() is None
+
+
+def test_aggregator_bundle_fault_site_fails_bundle_not_thread():
+    from tendermint_tpu.utils import faultinject as faults
+    from tendermint_tpu.utils.faultinject import InjectedFault
+
+    headers, valsets = loadgen.make_chain(2)
+    with RequestAggregator(flush_s=0.0) as agg:
+        faults.arm("lightserve.bundle", "raise", times=1)
+        try:
+            fut = agg.submit(core.full_spec(valsets[2], CHAIN_ID, headers[2]))
+            with pytest.raises(InjectedFault):
+                fut.result()
+        finally:
+            faults.disarm()
+        # the dispatch thread survived: the next bundle verifies fine
+        assert agg.verify(
+            [core.full_spec(valsets[2], CHAIN_ID, headers[2])]
+        ) == [None]
+
+
+def test_aggregator_stop_fails_wedged_inflight_bundle():
+    """A dispatch thread wedged inside a device call must not turn
+    stop() into a caller hang: the in-flight bundle's futures fail with
+    AggregatorShutdownError (the PipelinedVerifier no-hang contract)."""
+    from tendermint_tpu.lightserve.aggregator import AggregatorShutdownError
+
+    headers, valsets = loadgen.make_chain(2)
+    gate = threading.Event()
+
+    class WedgedProvider:
+        name = "wedged"
+
+        def verify_batch(self, pk, mg, sg, msg_lens=None):
+            gate.wait(timeout=30)  # wedge until the test releases us
+            raise RuntimeError("woke after stop")
+
+    agg = RequestAggregator(provider=WedgedProvider(), flush_s=0.0)
+    fut = agg.submit(core.full_spec(valsets[2], CHAIN_ID, headers[2]))
+    time.sleep(0.1)  # let the dispatch thread take the bundle and wedge
+    agg.stop(timeout=0.3)
+    with pytest.raises((AggregatorShutdownError, RuntimeError)):
+        fut.result(timeout=5)
+    gate.set()  # release the wedged thread; its late resolve is swallowed
+
+
+def test_service_rejects_forged_trust_root_header():
+    """A source pairing a REAL commit with a forged header (same
+    height/valset hash, different contents) must not seed the store:
+    validate_basic's header↔commit binding runs on the trust root."""
+    import dataclasses
+
+    headers, valsets = loadgen.make_chain(3)
+    real = headers[1]
+    forged_header = dataclasses.replace(real.header, app_hash=b"\xee" * 32)
+    headers = dict(headers)
+    headers[1] = type(real)(forged_header, real.commit)  # commit signs the REAL block
+    svc, _, _ = make_service(headers, valsets)
+    try:
+        with pytest.raises(core.ErrBadHeader):
+            svc.verify_at(1, now_ns=NOW)
+    finally:
+        svc.stop()
+
+
+# -- single-flight ----------------------------------------------------------
+
+
+def test_singleflight_coalesces_threads():
+    sf = SingleFlight()
+    calls = []
+    gate = threading.Event()
+
+    def work():
+        calls.append(1)
+        gate.wait(timeout=5)
+        return "res"
+
+    out = []
+    ts = [
+        threading.Thread(target=lambda: out.append(sf.do("k", work)))
+        for _ in range(8)
+    ]
+    for t in ts:
+        t.start()
+    time.sleep(0.05)  # let everyone pile onto the in-flight future
+    gate.set()
+    for t in ts:
+        t.join()
+    assert out == ["res"] * 8
+    assert len(calls) == 1
+    st = sf.stats()
+    assert st["runs"] == 1 and st["hits"] == 7 and st["inflight"] == 0
+
+
+def test_singleflight_propagates_errors_to_all_waiters():
+    sf = SingleFlight()
+    gate = threading.Event()
+
+    def boom():
+        gate.wait(timeout=5)
+        raise ValueError("nope")
+
+    errs = []
+
+    def waiter():
+        try:
+            sf.do("k", boom)
+        except ValueError as e:
+            errs.append(e)
+
+    ts = [threading.Thread(target=waiter) for _ in range(4)]
+    for t in ts:
+        t.start()
+    time.sleep(0.05)
+    gate.set()
+    for t in ts:
+        t.join()
+    assert len(errs) == 4
+    # the key is released: the next call runs fresh
+    assert sf.do("k", lambda: 42) == 42
+
+
+# -- the ISSUE's concurrent-bisection requirement ---------------------------
+
+
+def test_concurrent_bisection_bit_identical_to_serial_verifier():
+    """N threads requesting overlapping target heights through the
+    aggregator yield bit-identical verdicts to serial light/verifier.py
+    calls, and the single-flight counters prove each target's
+    verification ran exactly once (static valset: one skip link per
+    distinct target, deterministic accounting)."""
+    headers, valsets = loadgen.make_chain(16)
+
+    targets = [16, 14, 16, 10, 14, 16, 10, 16, 14, 16, 10, 14]  # overlapping
+    serial_res, _ = loadgen.serial_fleet(headers, valsets, targets, PERIOD, NOW)
+
+    svc, src, _ = make_service(headers, valsets)
+    try:
+        batched_res, _ = loadgen.run_fleet(svc, targets, NOW, threads=6)
+        st = svc.stats()
+    finally:
+        svc.stop()
+
+    # bit-identical verdicts, client by client
+    assert batched_res == serial_res
+    for i, t in enumerate(targets):
+        assert batched_res[i] == headers[t].hash()
+
+    # single-flight accounting is exact: every request either hit the
+    # store, shared an in-flight bisection, or ran one
+    assert st["requests"] == len(targets)
+    assert (
+        st["store_hits"] + st["singleflight_hits"] + st["singleflight_runs"]
+        == st["requests"]
+    )
+    # exactly one bisection per DISTINCT target ran, each verifying its
+    # one skip link once — 12 requests cost 3 verifications total
+    assert st["singleflight_runs"] == len(set(targets))
+    assert st["headers_verified"] == len(set(targets))
+    assert sorted(svc.store.heights()) == [1, 10, 14, 16]
+
+
+def test_concurrent_same_target_pivot_chain_verified_once():
+    """All clients chasing the same tip through a chain with validator
+    rotations (bisection pivots required): exactly ONE flight runs, and
+    the whole pivot chain is verified once — every stored height maps
+    to one headers_verified increment."""
+    k = loadgen.keys(8)
+    changes = {6: k[2:6] + loadgen.keys(2, tag="x"), 12: k[4:8] + loadgen.keys(2, tag="y")}
+    headers, valsets = loadgen.make_chain(16, key_changes=changes, base_keys=k[:4])
+
+    # serial oracle for the same jump
+    serial_res, _ = loadgen.serial_fleet(headers, valsets, [16], PERIOD, NOW)
+
+    svc, src, _ = make_service(headers, valsets, flush_s=0.005)
+    n = 12
+    try:
+        res, _ = loadgen.run_fleet(svc, [16] * n, NOW, threads=n)
+        st = svc.stats()
+    finally:
+        svc.stop()
+    assert all(h == headers[16].hash() for h in res.values())
+    assert res[0] == serial_res[0]
+    assert st["singleflight_runs"] == 1
+    assert st["singleflight_hits"] + st["store_hits"] == n - 1
+    # the pivot chain (valset rotations force >1 link) was verified ONCE
+    assert st["headers_verified"] == len(svc.store.heights()) - 1  # minus anchor
+    assert st["headers_verified"] >= 2
+    assert st["bisection_depth_max"] >= 2
+    # and every height was fetched at most once (no duplicated provider
+    # work either — the single-flight proof from the source's view)
+    assert src.calls == st["fetches"]
+    assert st["fetches"] <= len(svc.store.heights()) + 2
+
+
+def test_concurrent_invalid_target_same_error_as_serial():
+    headers, valsets = loadgen.make_chain(6)
+    tamper(headers[2])  # adjacent to the trust root: the full check fails
+    # serial arm: the direct verifier call's exception type
+    with pytest.raises(ErrInvalidCommitSignature):
+        verifier.verify(
+            CHAIN_ID, headers[1], valsets[1], headers[2], valsets[2],
+            PERIOD, now_ns=NOW,
+        )
+    svc, _, _ = make_service(headers, valsets)
+    errs = []
+
+    def client():
+        try:
+            svc.verify_at(2, now_ns=NOW)
+        except Exception as e:
+            errs.append(e)
+
+    try:
+        ts = [threading.Thread(target=client) for _ in range(4)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+    finally:
+        svc.stop()
+    assert len(errs) == 4
+    assert all(isinstance(e, ErrInvalidCommitSignature) for e in errs)
+
+
+def test_verify_at_latest_and_below_root():
+    headers, valsets = loadgen.make_chain(8)
+    svc, _, _ = make_service(headers, valsets, trust_height=4)
+    try:
+        sh = svc.verify_at(0, now_ns=NOW)  # 0 = source latest
+        assert sh.height == 8
+        with pytest.raises(ErrHeightNotServable):
+            svc.verify_at(2, now_ns=NOW)  # below the trust root
+        with pytest.raises(ErrHeightNotServable):
+            svc.verify_at(99, now_ns=NOW)  # beyond the source
+    finally:
+        svc.stop()
+
+
+# -- provider resilience (service side) -------------------------------------
+
+
+def test_service_fetch_retries_through_transient_failures():
+    headers, valsets = loadgen.make_chain(8)
+    src = loadgen.ChainSource(headers, valsets, fail_every=2)
+    agg = RequestAggregator(flush_s=0.0)
+    svc = LightServeService(
+        CHAIN_ID, src, TrustedStore(MemDB()), aggregator=agg,
+        trusting_period_ns=PERIOD, fetch_backoff_s=0.001,
+    )
+    try:
+        sh = svc.verify_at(8, now_ns=NOW)
+        assert sh.hash() == headers[8].hash()
+        assert svc.stats()["fetch_failures"] >= 1
+        assert svc.stats()["breaker_state"] == "closed"
+    finally:
+        svc.stop()
+
+
+def test_service_fetch_fault_site_and_breaker_open():
+    from tendermint_tpu.utils import faultinject as faults
+    from tendermint_tpu.utils.watchdog import CircuitBreaker
+
+    headers, valsets = loadgen.make_chain(4)
+    svc, _, _ = make_service(headers, valsets, fetch_retries=2)
+    # fresh breaker with a tight threshold so the test can't interact
+    # with process-wide defaults
+    svc._breaker = CircuitBreaker(
+        "lightserve.fetch.test", failure_threshold=1, cooldown_s=60, register=False
+    )
+    try:
+        faults.arm("lightserve.fetch", "raise")  # every fetch raises
+        try:
+            with pytest.raises(ErrSourceUnavailable):
+                svc.verify_at(4, now_ns=NOW)
+        finally:
+            faults.disarm()
+        # breaker tripped: the next request fails FAST without fetching
+        assert svc._breaker.state() == "open"
+        calls_before = svc.stats()["fetches"]
+        with pytest.raises(ErrSourceUnavailable):
+            svc.verify_at(4, now_ns=NOW)
+        assert svc.stats()["fetches"] == calls_before
+    finally:
+        svc.stop()
+
+
+# -- ResilientProvider (light/provider.py satellite) ------------------------
+
+
+class _FlakyProvider(loadgen.ChainSource):
+    pass
+
+
+def test_resilient_provider_retries_and_breaker():
+    import asyncio
+
+    from tendermint_tpu.light.provider import (
+        ErrProviderUnavailable,
+        ErrSignedHeaderNotFound,
+        MockProvider,
+        ResilientProvider,
+    )
+    from tendermint_tpu.utils.watchdog import CircuitBreaker
+
+    headers, valsets = loadgen.make_chain(4)
+
+    class Flaky(MockProvider):
+        def __init__(self):
+            super().__init__(CHAIN_ID, headers, valsets)
+            self.fail_next = 0
+            self.calls = 0
+
+        async def signed_header(self, height):
+            self.calls += 1
+            if self.fail_next > 0:
+                self.fail_next -= 1
+                raise ConnectionError("blip")
+            return await super().signed_header(height)
+
+    async def go():
+        inner = Flaky()
+        p = ResilientProvider(
+            inner, retries=3, backoff_base_s=0.001,
+            breaker=CircuitBreaker("t.flaky", failure_threshold=1,
+                                   cooldown_s=60, register=False),
+        )
+        # one transient blip: absorbed by the retry, client never sees it
+        inner.fail_next = 1
+        sh = await p.signed_header(2)
+        assert sh.hash() == headers[2].hash()
+        assert p.retried == 1
+
+        # deterministic miss: propagates immediately, no retries burned
+        calls = inner.calls
+        with pytest.raises(ErrSignedHeaderNotFound):
+            await p.signed_header(99)
+        assert inner.calls == calls + 1
+
+        # persistent failure: retries exhausted -> breaker opens ->
+        # fail-fast without touching the peer
+        inner.fail_next = 10**9
+        with pytest.raises(ConnectionError):
+            await p.signed_header(2)
+        assert p.breaker.state() == "open"
+        calls = inner.calls
+        with pytest.raises(ErrProviderUnavailable):
+            await p.signed_header(2)
+        assert inner.calls == calls
+
+    asyncio.run(go())
+
+
+def test_light_client_opt_in_resilient_providers():
+    import asyncio
+
+    from tendermint_tpu.db.memdb import MemDB as _MemDB
+    from tendermint_tpu.light import LightClient, TrustOptions
+    from tendermint_tpu.light.provider import MockProvider, ResilientProvider
+
+    headers, valsets = loadgen.make_chain(6)
+
+    async def go():
+        primary = MockProvider(CHAIN_ID, headers, valsets)
+        c = LightClient(
+            CHAIN_ID,
+            TrustOptions(period_ns=PERIOD, height=1, hash=headers[1].hash()),
+            primary, [MockProvider(CHAIN_ID, headers, valsets)],
+            TrustedStore(_MemDB()),
+            resilient_providers=True,
+        )
+        assert isinstance(c.primary, ResilientProvider)
+        assert all(isinstance(w, ResilientProvider) for w in c.witnesses)
+        sh = await c.verify_header_at_height(6, now_ns=NOW)
+        assert sh.hash() == headers[6].hash()
+
+    asyncio.run(go())
+
+
+# -- store height index (light/store.py satellite) --------------------------
+
+
+def test_store_height_index_maintained_without_rescans():
+    db = MemDB()
+    store = TrustedStore(db)
+    headers, valsets = loadgen.make_chain(6)
+    assert store.latest_height() == 0 and store.first_height() == 0
+    for h in (2, 5, 3):
+        store.save(headers[h], valsets[h])
+    assert store.heights() == [2, 3, 5]
+    assert store.latest_height() == 5 and store.first_height() == 2
+    # duplicate save: index stays unique
+    store.save(headers[3], valsets[3])
+    assert store.heights() == [2, 3, 5]
+    # prune updates the index AND the db
+    assert store.prune(keep=1) == 2
+    assert store.heights() == [5]
+    assert store.signed_header(2) is None
+    # a fresh store over the same db rehydrates from disk
+    store2 = TrustedStore(db)
+    assert store2.heights() == [5]
+    assert store2.latest() is not None
+
+
+def test_store_index_thread_safety():
+    store = TrustedStore(MemDB())
+    headers, valsets = loadgen.make_chain(32)
+
+    def writer(hs):
+        for h in hs:
+            store.save(headers[h], valsets[h])
+
+    ts = [
+        threading.Thread(target=writer, args=(range(i + 1, 33, 4),))
+        for i in range(4)
+    ]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    assert store.heights() == list(range(1, 33))
+    assert store.latest_height() == 32
+
+
+# -- RPC surface ------------------------------------------------------------
+
+
+def test_lightserve_core_routes():
+    import asyncio
+
+    from tendermint_tpu.lightserve.server import LightServeCore
+    from tendermint_tpu.rpc.core import RPCError
+
+    headers, valsets = loadgen.make_chain(5)
+    # the RPC path uses real wall time for expiry — give the fixture
+    # chain (pinned to T0 in 2023) a trusting period that outlives it
+    svc, _, _ = make_service(
+        headers, valsets, trusting_period_ns=100 * 365 * 24 * 3600 * 10**9
+    )
+    core_rpc = LightServeCore(svc)
+
+    async def go():
+        try:
+            out = await core_rpc.call("lightserve_verify", {"height": 5})
+            assert out["height"] == 5
+            assert out["hash"] == headers[5].hash().hex()
+            assert out["signed_header"]["header"]["height"] == 5
+            st = await core_rpc.call("lightserve_status", {})
+            assert st["requests"] == 1 and st["trusted_height"] == 5
+            th = await core_rpc.call("trusted_height", {})
+            assert th["height"] == 5
+            with pytest.raises(RPCError):
+                await core_rpc.call("nope", {})
+        finally:
+            svc.stop()
+
+    asyncio.run(go())
+
+
+@pytest.mark.slow
+def test_lightserve_fleet_scale():
+    """Long-running fleet: 256 clients over a 48-height chain with two
+    valset changes — the bench shape at test scale, registered slow per
+    pytest.ini."""
+    k = loadgen.keys(8)
+    changes = {16: k[2:6] + loadgen.keys(2, tag="a"), 32: k[4:8] + loadgen.keys(2, tag="b")}
+    headers, valsets = loadgen.make_chain(48, key_changes=changes, base_keys=k[:4])
+    svc, _, _ = make_service(headers, valsets, flush_s=0.002)
+    targets = [48 - (i % 6) for i in range(256)]
+    try:
+        res, elapsed = loadgen.run_fleet(svc, targets, NOW, threads=16)
+        st = svc.stats()
+    finally:
+        svc.stop()
+    assert len(res) == 256
+    for i, t in enumerate(targets):
+        assert res[i] == headers[t].hash()
+    # the funnel worked: bisections ran per distinct target at most
+    assert st["singleflight_runs"] <= 6
+    assert st["requests"] == 256
+
+
+@pytest.mark.slow
+def test_lightserve_on_live_node(tmp_path):
+    """End to end: a live node with lightserve_enabled serves verified
+    headers of its own chain over both the main RPC and a dedicated
+    lightserve endpoint."""
+    import asyncio
+
+    from tendermint_tpu.rpc.client import HTTPClient
+    from tests.test_rpc import start_node
+
+    async def go():
+        node, c = await start_node(tmp_path)
+        try:
+            # enable lightserve on the running node exactly as on_start
+            # would (start_node builds the node before we can flip the
+            # config flag)
+            from tendermint_tpu.lightserve.aggregator import RequestAggregator
+            from tendermint_tpu.lightserve.server import make_lightserve_server
+            from tendermint_tpu.lightserve.service import (
+                LightServeService,
+                NodeSource,
+            )
+
+            agg = RequestAggregator(provider=node.crypto_provider, flush_s=0.002)
+            node.lightserve = LightServeService(
+                node.genesis_doc.chain_id, NodeSource(node),
+                TrustedStore(MemDB()), aggregator=agg,
+                metrics=node.lightserve_metrics,
+            )
+            node.lightserve_server = make_lightserve_server(
+                node.lightserve, "tcp://127.0.0.1:0"
+            )
+            await node.lightserve_server.start()
+
+            h = node.block_store.height
+            out = await c.call("lightserve_verify", height=h)
+            assert out["height"] == h
+            meta = node.block_store.load_block_meta(h)
+            assert out["hash"] == meta.header.hash().hex()
+
+            st = await c.call("lightserve_status")
+            assert st["trusted_height"] >= h
+
+            addr = node.lightserve_server.listen_addr
+            ls = HTTPClient(f"{addr.host}:{addr.port}")
+            out2 = await ls.call("lightserve_verify", height=h)
+            assert out2["hash"] == out["hash"]
+        finally:
+            await node.stop()
+
+    asyncio.run(go())
