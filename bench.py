@@ -243,6 +243,8 @@ _GUARD_KEYS = [
     ("merkle_root_speedup", "higher"),
     ("lightserve_clients_per_sec", "higher"),
     ("lightserve_speedup", "higher"),
+    ("ingest_txs_per_sec", "higher"),
+    ("ingest_speedup", "higher"),
     ("coldstart_first_verify_s", None),   # presence-only: timing varies
     ("coldstart_tabled_first_s", None),
 ]
@@ -349,6 +351,7 @@ def run_bench(platform: str, accelerator: bool = True):
             note="accelerator unavailable; measured the node's host fallback path",
             **replay_bench(cpu),
             **lightserve_bench(cpu),
+            **ingest_bench(cpu),
             **merkle_bench(),
             **degraded_mode_bench(),
             **trace_overhead_bench(),
@@ -572,6 +575,9 @@ def run_bench(platform: str, accelerator: bool = True):
         _ls_provider = None
     lightserve_extra = lightserve_bench(_ls_provider)
 
+    # -- ingest: batched mempool admission vs per-tx serial CheckTx -------
+    ingest_extra = ingest_bench(_ls_provider)
+
     # -- merkle engine: device vs host root + part-set split --------------
     merkle_extra = merkle_bench()
 
@@ -654,6 +660,7 @@ def run_bench(platform: str, accelerator: bool = True):
         **tabled,
         **replay_extra,
         **lightserve_extra,
+        **ingest_extra,
         **merkle_extra,
         **degraded_extra,
         **trace_extra,
@@ -1227,6 +1234,209 @@ def lightserve_bench(provider=None) -> dict:
         traceback.print_exc(file=sys.stderr)
         log(f"lightserve measurement failed: {ex!r}")
         return {"lightserve_error": repr(ex)[:200]}
+
+
+# -- ingest: batched mempool admission vs per-tx serial CheckTx ------------
+#
+# The admission-side measurement (ingest/, docs/ingest.md): a fleet of
+# ed25519-signed payment txs enters the mempool. The SERIAL arm is the
+# reference lifecycle — one Mempool.check_tx per tx (per-tx hash + the
+# app's host signature verify), then INGEST_RECHECKS post-commit recheck
+# rounds in which the app re-verifies every pending tx (what stock
+# CheckTx traffic costs while a deep pool rides across heights). The
+# BATCHED arm funnels the same fleet through the IngestBatcher — bundled
+# tx-key hashing, ONE pipeline sig pre-verification per bundle, SigCache-
+# backed app checks — so rechecks resolve from the cache and, on real
+# accelerators, the initial verify runs device-batched. Admission
+# verdicts must be bit-identical across arms (asserted here and in the
+# tests/test_ingest.py property suite). ingest_speedup and the batched
+# admission rate join the regression guard next to replay_speedup; a
+# live-node end-to-end arm (payments app through consensus) reports
+# ingest_e2e_txs_per_sec, unguarded — consensus timing on a small box
+# is noisier than the 20% guard tolerance.
+
+INGEST_TXS = int(os.environ.get("TM_BENCH_INGEST_TXS", "192"))
+INGEST_ACCOUNTS = int(os.environ.get("TM_BENCH_INGEST_ACCOUNTS", "16"))
+INGEST_RECHECKS = int(os.environ.get("TM_BENCH_INGEST_RECHECKS", "6"))
+INGEST_E2E_TXS = int(os.environ.get("TM_BENCH_INGEST_E2E_TXS", "96"))
+
+
+def ingest_bench(provider=None, e2e: bool = True) -> dict:
+    """Returns the ingest_* bench keys; never raises (the main line must
+    survive a broken subsystem — the guard then flags the missing keys
+    against the previous record)."""
+    import asyncio
+
+    try:
+        from tendermint_tpu.abci.client.local import LocalClient
+        from tendermint_tpu.abci.examples.payments import (
+            PaymentsApplication,
+            sig_rows,
+        )
+        from tendermint_tpu.config import MempoolConfig
+        from tendermint_tpu.crypto.batch import CPUBatchVerifier
+        from tendermint_tpu.crypto.pipeline import PipelinedVerifier, SigCache
+        from tendermint_tpu.ingest import IngestBatcher
+        from tendermint_tpu.ingest import loadgen as igen
+        from tendermint_tpu.ingest.hashing import TxKeyHasher
+
+        inner = provider if provider is not None else CPUBatchVerifier()
+        privs, balances = igen.accounts(INGEST_ACCOUNTS)
+        txs = igen.make_transfers(privs, INGEST_TXS, amount=1, fee=2)
+
+        async def make_pool(app):
+            client = LocalClient(app)
+            await client.start()
+            return Mempool(MempoolConfig(), client)
+
+        from tendermint_tpu.mempool import Mempool
+
+        async def arms():
+            # serial arm: cache-less app — every CheckTx (and every
+            # recheck) pays a host signature verify, the reference cost
+            app_s = PaymentsApplication(dict(balances), sig_cache=False)
+            serial_v, serial_s = await igen.serial_admit(
+                await make_pool(app_s), txs, rechecks=INGEST_RECHECKS
+            )
+            # batched arm: fresh SigCache shared by pipeline and app
+            cache = SigCache()
+            app_b = PaymentsApplication(dict(balances), sig_cache=cache)
+            pv = PipelinedVerifier(inner, cache=cache)
+            hasher = TxKeyHasher(block_on_compile=True)
+            batcher = IngestBatcher(
+                await make_pool(app_b),
+                verifier=pv,
+                sig_extractor=sig_rows,
+                hasher=hasher,
+                hash_threshold=64,
+            )
+            # warm the tx-key hash bucket outside the timed window (the
+            # live node compiles it in the background at boot)
+            hasher.keys_or_host(txs[: min(len(txs), 256)], 64)
+            try:
+                batched_v, batched_s = await igen.batched_admit(
+                    batcher, txs, rechecks=INGEST_RECHECKS
+                )
+                stats = batcher.stats()
+            finally:
+                await batcher.stop()
+                pv.stop()
+            return serial_v, serial_s, batched_v, batched_s, stats
+
+        serial_v, serial_s, batched_v, batched_s, stats = asyncio.run(arms())
+        assert serial_v == batched_v, "batched admission verdicts != serial"
+
+        out = {
+            "ingest_txs": INGEST_TXS,
+            "ingest_accounts": INGEST_ACCOUNTS,
+            "ingest_recheck_heights": INGEST_RECHECKS,
+            "ingest_serial_ms": round(serial_s * 1e3, 2),
+            "ingest_batched_ms": round(batched_s * 1e3, 2),
+            "ingest_txs_per_sec": (
+                round(INGEST_TXS * (1 + INGEST_RECHECKS) / batched_s)
+                if batched_s > 0
+                else None
+            ),
+            "ingest_serial_txs_per_sec": (
+                round(INGEST_TXS * (1 + INGEST_RECHECKS) / serial_s)
+                if serial_s > 0
+                else None
+            ),
+            "ingest_speedup": (
+                round(serial_s / batched_s, 2) if batched_s > 0 else None
+            ),
+            "ingest_bundles": stats["bundles"],
+            "ingest_bundle_occupancy_avg": round(stats["bundle_occupancy_avg"], 2),
+            "ingest_sig_rows": stats["sig_rows"],
+            "ingest_hash_device_rows": stats["hash_device_rows"],
+            "ingest_hash_host_rows": stats["hash_host_rows"],
+        }
+        log(
+            f"ingest admission @{INGEST_TXS} txs x{1 + INGEST_RECHECKS} checks: "
+            f"serial {serial_s*1e3:.1f} ms, batched {batched_s*1e3:.1f} ms "
+            f"({out['ingest_speedup']}x; {out['ingest_txs_per_sec']} tx-checks/s; "
+            f"{stats['bundles']} bundles, {stats['hash_device_rows']} device-hashed keys)"
+        )
+        if e2e:
+            out.update(_ingest_e2e(inner))
+        return out
+    except Exception as ex:
+        import traceback
+
+        traceback.print_exc(file=sys.stderr)
+        log(f"ingest measurement failed: {ex!r}")
+        return {"ingest_error": repr(ex)[:200]}
+
+
+def _ingest_e2e(inner) -> dict:
+    """End-to-end tx/s through a LIVE single-validator node running the
+    payments app: txs enter through the IngestBatcher and the number
+    reported is committed-and-applied transfers per second, admission
+    through consensus. Uses the in-process consensus harness
+    (tests/cs_harness.py — the same rig the chaos suite drives)."""
+    import asyncio
+
+    try:
+        sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "tests"))
+        from cs_harness import make_genesis, make_node
+
+        from tendermint_tpu.abci.examples.payments import (
+            PaymentsApplication,
+            sig_rows,
+        )
+        from tendermint_tpu.crypto.batch import CPUBatchVerifier
+        from tendermint_tpu.crypto.pipeline import PipelinedVerifier, SigCache
+        from tendermint_tpu.ingest import IngestBatcher
+        from tendermint_tpu.ingest import loadgen as igen
+
+        async def go():
+            privs, balances = igen.accounts(INGEST_ACCOUNTS)
+            txs = igen.make_transfers(privs, INGEST_E2E_TXS, amount=1, fee=1)
+            cache = SigCache()
+            app = PaymentsApplication(dict(balances), sig_cache=cache)
+            genesis, vals = make_genesis(1)
+            node = await make_node(genesis, vals[0], app=app)
+            pv = PipelinedVerifier(
+                inner if inner is not None else CPUBatchVerifier(), cache=cache
+            )
+            batcher = IngestBatcher(
+                node.mempool, verifier=pv, sig_extractor=sig_rows,
+                hash_threshold=1 << 30,
+            )
+            await node.cs.start()
+            t0 = time.perf_counter()
+            try:
+                await asyncio.gather(
+                    *(batcher.check_tx(tx) for tx in txs), return_exceptions=True
+                )
+                deadline = time.monotonic() + 60
+                while app.tx_applied < len(txs) and time.monotonic() < deadline:
+                    await asyncio.sleep(0.05)
+                elapsed = time.perf_counter() - t0
+            finally:
+                await node.cs.stop()
+                await batcher.stop()
+                pv.stop()
+            return app.tx_applied, elapsed, node.cs.state.last_block_height
+
+        applied, elapsed, height = asyncio.run(go())
+        if applied < INGEST_E2E_TXS:
+            raise RuntimeError(
+                f"only {applied}/{INGEST_E2E_TXS} txs applied in {elapsed:.1f}s"
+            )
+        out = {
+            "ingest_e2e_txs": applied,
+            "ingest_e2e_heights": height,
+            "ingest_e2e_txs_per_sec": round(applied / elapsed, 1),
+        }
+        log(
+            f"ingest e2e: {applied} transfers through {height} live heights "
+            f"in {elapsed:.2f}s ({out['ingest_e2e_txs_per_sec']} tx/s committed)"
+        )
+        return out
+    except Exception as ex:
+        log(f"ingest e2e measurement failed: {ex!r}")
+        return {"ingest_e2e_error": repr(ex)[:200]}
 
 
 _STATE_PATH = os.environ.get("TM_BENCH_STATE", "")

@@ -1,4 +1,12 @@
 from tendermint_tpu.abci.examples.counter import CounterApplication
+from tendermint_tpu.abci.examples.kvproofs import KVProofsApplication
 from tendermint_tpu.abci.examples.kvstore import KVStoreApplication, PersistentKVStoreApplication
+from tendermint_tpu.abci.examples.payments import PaymentsApplication
 
-__all__ = ["CounterApplication", "KVStoreApplication", "PersistentKVStoreApplication"]
+__all__ = [
+    "CounterApplication",
+    "KVProofsApplication",
+    "KVStoreApplication",
+    "PaymentsApplication",
+    "PersistentKVStoreApplication",
+]

@@ -1,0 +1,108 @@
+"""kvproofs example app: a KV store whose queries answer with merkle
+proofs over the committed state — the read-side workload of the ingest
+app zoo (docs/ingest.md).
+
+State commits to a simple merkle tree over the sorted
+``(key, sha256(value))`` leaf encodings (the exact leaf shape
+crypto/merkle.ValueOp verifies), so ``app_hash`` is the tree root and
+``Query(prove=True)`` returns a ``ValueOp`` proof-op chain any client
+can check with ``default_proof_runtime().verify_value`` against a
+header's app_hash — the lite-proxy flow, self-served. Roots and the
+full per-leaf proof set are computed through
+``crypto/merkle.proofs_from_byte_slices``, i.e. the device-batched
+SHA-256 engine above the configured threshold, and are cached per
+commit: N client queries against one height pay ONE tree build.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+from tendermint_tpu.abci import types as t
+from tendermint_tpu.abci.application import Application
+from tendermint_tpu.codec.binary import Writer
+from tendermint_tpu.crypto import merkle
+from tendermint_tpu.crypto.hash import sha256
+
+
+def kv_leaf(key: bytes, value: bytes) -> bytes:
+    """Deterministic (key, value-hash) leaf — must mirror ValueOp.run."""
+    return Writer().write_bytes(key).write_bytes(sha256(value)).bytes()
+
+
+class KVProofsApplication(Application):
+    """Tx format ``key=value`` (key alone stores itself, like kvstore)."""
+
+    def __init__(self):
+        self._store: Dict[bytes, bytes] = {}
+        # queries (and their proofs) serve the COMMITTED snapshot — a
+        # proof must verify against the app_hash a header carries, not
+        # against half-delivered next-block state
+        self._committed: Dict[bytes, bytes] = {}
+        self._height = 0
+        self._app_hash = merkle.hash_from_byte_slices([])
+        # per-commit proof cache: {key: SimpleProof}; invalidated by
+        # commit, rebuilt lazily on the first proven query
+        self._proofs: Optional[Dict[bytes, merkle.SimpleProof]] = None
+
+    def info(self, req: t.RequestInfo) -> t.ResponseInfo:
+        return t.ResponseInfo(
+            data=f"{{\"keys\":{len(self._store)}}}",
+            version="kvproofs-tpu-0.1.0",
+            last_block_height=self._height,
+            last_block_app_hash=self._app_hash,
+        )
+
+    def check_tx(self, req: t.RequestCheckTx) -> t.ResponseCheckTx:
+        if not req.tx:
+            return t.ResponseCheckTx(code=1, log="empty tx")
+        return t.ResponseCheckTx(gas_wanted=1)
+
+    def deliver_tx(self, req: t.RequestDeliverTx) -> t.ResponseDeliverTx:
+        if not req.tx:
+            return t.ResponseDeliverTx(code=1, log="empty tx")
+        if b"=" in req.tx:
+            key, value = req.tx.split(b"=", 1)
+        else:
+            key, value = req.tx, req.tx
+        self._store[key] = value
+        return t.ResponseDeliverTx(code=t.CODE_TYPE_OK)
+
+    def _leaves(self) -> List[bytes]:
+        return [kv_leaf(k, self._committed[k]) for k in sorted(self._committed)]
+
+    def commit(self) -> t.ResponseCommit:
+        # ONE root build per commit (device-batched above the merkle
+        # threshold); proofs rebuild lazily when a proven query arrives
+        self._committed = dict(self._store)
+        self._app_hash = merkle.hash_from_byte_slices(self._leaves())
+        self._proofs = None
+        self._height += 1
+        return t.ResponseCommit(data=self._app_hash)
+
+    def _proof_for(self, key: bytes) -> Optional[merkle.SimpleProof]:
+        if self._proofs is None:
+            keys = sorted(self._committed)
+            _root, proofs = merkle.proofs_from_byte_slices(self._leaves())
+            self._proofs = dict(zip(keys, proofs))
+        return self._proofs.get(key)
+
+    def query(self, req: t.RequestQuery) -> t.ResponseQuery:
+        if req.path not in ("/store", ""):
+            return t.ResponseQuery(code=1, log=f"unknown path {req.path}")
+        value = self._committed.get(req.data)
+        if value is None:
+            return t.ResponseQuery(
+                code=t.CODE_TYPE_OK, key=req.data, log="does not exist",
+                height=self._height,
+            )
+        proof_bytes = b""
+        if req.prove:
+            proof = self._proof_for(req.data)
+            if proof is not None:
+                op = merkle.ValueOp(req.data, proof).to_proof_op()
+                proof_bytes = merkle.encode_proof_ops([op])
+        return t.ResponseQuery(
+            code=t.CODE_TYPE_OK, key=req.data, value=value,
+            proof_bytes=proof_bytes, height=self._height, log="exists",
+        )

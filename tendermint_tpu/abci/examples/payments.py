@@ -1,0 +1,236 @@
+"""payments example app: ed25519-signed token transfers with nonces,
+balances and fees — the admission-heavy workload the ingest subsystem
+exists for (every CheckTx is a real signature check).
+
+Tx wire format (fixed 156 bytes, so tx-key hashing rides the uniform
+fast path of ingest/hashing.py):
+
+    b"PAY1" | sender_pub (32) | nonce u64 | fee u64 | recipient (32)
+            | amount u64 | sig (64)
+
+``sig`` is the sender's ed25519 signature over the first 92 bytes (the
+message). Accounts are raw 32-byte ed25519 pubkeys. ``fee`` is burned
+on delivery and doubles as the mempool QoS priority
+(ResponseCheckTx.priority), so paid traffic outranks spam in the
+priority lane (mempool/mempool.py).
+
+Signature verification goes through an injectable ``verify`` seam that
+by default consults the process SigCache (crypto/pipeline.py): the
+ingest batcher pre-verifies whole bundles on the device and only
+successful triples are ever cached, so a cache hit is equivalent to
+re-verifying — and a miss re-verifies on host. CheckTx verdicts are
+therefore bit-identical whether admission arrived batched or serial.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import struct
+from typing import Dict, NamedTuple, Optional, Tuple
+
+from tendermint_tpu.abci import types as t
+from tendermint_tpu.abci.application import Application
+
+MAGIC = b"PAY1"
+MSG_LEN = 92
+TX_LEN = MSG_LEN + 64
+
+CODE_MALFORMED = 1
+CODE_BAD_SIG = 2
+CODE_STALE_NONCE = 3
+CODE_INSUFFICIENT_FUNDS = 4
+CODE_BAD_NONCE = 5  # deliver-time: not the exact next nonce
+
+
+class Transfer(NamedTuple):
+    sender: bytes  # 32-byte pubkey
+    nonce: int
+    fee: int
+    recipient: bytes  # 32-byte account id
+    amount: int
+    sig: bytes
+
+
+def encode_msg(sender_pub: bytes, nonce: int, recipient: bytes, amount: int, fee: int) -> bytes:
+    return MAGIC + sender_pub + struct.pack(">QQ", nonce, fee) + recipient + struct.pack(">Q", amount)
+
+
+def make_transfer(priv, nonce: int, recipient: bytes, amount: int, fee: int = 0) -> bytes:
+    """Build + sign one transfer tx with an Ed25519PrivKey."""
+    msg = encode_msg(priv.pub_key().bytes(), nonce, recipient, amount, fee)
+    return msg + priv.sign(msg)
+
+
+def parse_tx(tx: bytes) -> Optional[Transfer]:
+    if len(tx) != TX_LEN or tx[:4] != MAGIC:
+        return None
+    nonce, fee = struct.unpack(">QQ", tx[36:52])
+    (amount,) = struct.unpack(">Q", tx[84:92])
+    return Transfer(tx[4:36], nonce, fee, tx[52:84], amount, tx[92:])
+
+
+def priority_hint(tx: bytes) -> Optional[int]:
+    """Crypto-free upper bound on CheckTx priority: the declared fee
+    (a pure parse). Lets a full mempool reject un-outranking floods
+    without paying a signature verify per spam tx
+    (Mempool.priority_hint seam). Malformed txs hint None — the app's
+    parse rejection is already cheap."""
+    tr = parse_tx(tx)
+    return None if tr is None else tr.fee
+
+
+def sig_rows(tx: bytes) -> Optional[Tuple[bytes, bytes, bytes]]:
+    """Stateless admission extractor (IngestBatcher.sig_extractor):
+    (pubkey, msg, sig) for a well-formed transfer, None otherwise —
+    malformed txs carry no signature work for the device."""
+    if len(tx) != TX_LEN or tx[:4] != MAGIC:
+        return None
+    return tx[4:36], bytes(tx[:MSG_LEN]), bytes(tx[MSG_LEN:])
+
+
+class PaymentsApplication(Application):
+    """In-memory transfer ledger. ``sig_cache=None`` uses the process
+    SigCache (crypto.pipeline.default_sig_cache) so batched admission
+    pre-warms apply; pass ``sig_cache=False`` for pure host-serial
+    verification (the naive baseline arm in bench.py)."""
+
+    # seams the node wiring discovers on any app (node/node.py):
+    # stateless (pubkey, msg, sig) extraction for device-batched
+    # admission pre-verification, and the crypto-free priority bound
+    # for the mempool's full-pool fast reject
+    admission_sig_rows = staticmethod(sig_rows)
+    admission_priority_hint = staticmethod(priority_hint)
+
+    def __init__(self, initial_balances: Optional[Dict[bytes, int]] = None, sig_cache=None):
+        self._balances: Dict[bytes, int] = dict(initial_balances or {})
+        self._nonces: Dict[bytes, int] = {}
+        self._height = 0
+        self._app_hash = b""
+        self._fees_burned = 0
+        self.tx_applied = 0
+        if sig_cache is None:
+            from tendermint_tpu.crypto.pipeline import default_sig_cache
+
+            self._cache = default_sig_cache()
+        elif sig_cache is False:
+            self._cache = None
+        else:
+            # NOTE: an explicit-instance check, not truthiness — an
+            # EMPTY SigCache is len()==0 and would read as False
+            self._cache = sig_cache
+
+    # -- signature seam ----------------------------------------------------
+
+    def _verify(self, pub: bytes, msg: bytes, sig: bytes) -> bool:
+        """SigCache-first verify: only successful exact triples are ever
+        cached (pipeline invariant), so a hit IS the verified verdict; a
+        miss verifies on host and back-fills — same answer, once."""
+        if self._cache is not None:
+            from tendermint_tpu.crypto.pipeline import SigCache
+
+            key = SigCache.key(pub, msg, sig)
+            if self._cache.seen(key):
+                return True
+        ok = self._host_verify(pub, msg, sig)
+        if ok and self._cache is not None:
+            self._cache.add(key)
+        return ok
+
+    @staticmethod
+    def _host_verify(pub: bytes, msg: bytes, sig: bytes) -> bool:
+        from tendermint_tpu.crypto.keys import Ed25519PubKey
+
+        try:
+            return Ed25519PubKey(pub).verify(msg, sig)
+        except Exception:
+            return False
+
+    # -- shared tx validation ----------------------------------------------
+
+    def _validate(self, tx: bytes, exact_nonce: bool):
+        tr = parse_tx(tx)
+        if tr is None:
+            return None, t.ResponseCheckTx(code=CODE_MALFORMED, log="malformed payments tx")
+        if not self._verify(tx[4:36], tx[:MSG_LEN], tr.sig):
+            return None, t.ResponseCheckTx(code=CODE_BAD_SIG, log="bad signature")
+        expected = self._nonces.get(tr.sender, 0)
+        if exact_nonce:
+            if tr.nonce != expected:
+                return None, t.ResponseCheckTx(
+                    code=CODE_BAD_NONCE, log=f"nonce {tr.nonce} != expected {expected}"
+                )
+        elif tr.nonce < expected:
+            return None, t.ResponseCheckTx(
+                code=CODE_STALE_NONCE, log=f"nonce {tr.nonce} < committed {expected}"
+            )
+        if self._balances.get(tr.sender, 0) < tr.amount + tr.fee:
+            return None, t.ResponseCheckTx(
+                code=CODE_INSUFFICIENT_FUNDS, log="insufficient funds"
+            )
+        return tr, None
+
+    # -- abci --------------------------------------------------------------
+
+    def init_chain(self, req: t.RequestInitChain) -> t.ResponseInitChain:
+        """Fund accounts from the genesis app_state:
+        ``{"balances": {"<hex 32-byte account>": amount, ...}}`` — how a
+        standalone ``proxy_app = "payments"`` node gets a ledger."""
+        if req.app_state_bytes:
+            import json
+
+            doc = json.loads(req.app_state_bytes.decode() or "{}")
+            for acct_hex, amount in (doc.get("balances") or {}).items():
+                self._balances[bytes.fromhex(acct_hex)] = int(amount)
+        return t.ResponseInitChain()
+
+    def info(self, req: t.RequestInfo) -> t.ResponseInfo:
+        return t.ResponseInfo(
+            data=f"{{\"accounts\":{len(self._balances)},\"applied\":{self.tx_applied}}}",
+            version="payments-tpu-0.1.0",
+            last_block_height=self._height,
+            last_block_app_hash=self._app_hash,
+        )
+
+    def check_tx(self, req: t.RequestCheckTx) -> t.ResponseCheckTx:
+        tr, bad = self._validate(req.tx, exact_nonce=False)
+        if bad is not None:
+            return bad
+        # fee IS the QoS priority (clamped: the wire field is an i64 and
+        # the fee is an attacker-declared u64); sender feeds the
+        # per-sender flood cap
+        return t.ResponseCheckTx(
+            gas_wanted=1, priority=min(tr.fee, (1 << 63) - 1), sender=tr.sender.hex()
+        )
+
+    def deliver_tx(self, req: t.RequestDeliverTx) -> t.ResponseDeliverTx:
+        tr, bad = self._validate(req.tx, exact_nonce=True)
+        if bad is not None:
+            return t.ResponseDeliverTx(code=bad.code, log=bad.log)
+        # .get: a zero-amount zero-fee transfer from an account with no
+        # balance record passes validation (0 <= 0) and must not KeyError
+        self._balances[tr.sender] = self._balances.get(tr.sender, 0) - (tr.amount + tr.fee)
+        self._balances[tr.recipient] = self._balances.get(tr.recipient, 0) + tr.amount
+        self._nonces[tr.sender] = tr.nonce + 1
+        self._fees_burned += tr.fee
+        self.tx_applied += 1
+        return t.ResponseDeliverTx(code=t.CODE_TYPE_OK)
+
+    def commit(self) -> t.ResponseCommit:
+        h = hashlib.sha256()
+        for acct in sorted(set(self._balances) | set(self._nonces)):
+            h.update(acct)
+            h.update(struct.pack(">QQ", self._balances.get(acct, 0), self._nonces.get(acct, 0)))
+        self._height += 1
+        self._app_hash = h.digest()
+        return t.ResponseCommit(data=self._app_hash)
+
+    def query(self, req: t.RequestQuery) -> t.ResponseQuery:
+        if req.path == "/balance":
+            v = self._balances.get(req.data, 0)
+        elif req.path == "/nonce":
+            v = self._nonces.get(req.data, 0)
+        else:
+            return t.ResponseQuery(code=1, log=f"unknown path {req.path}")
+        return t.ResponseQuery(
+            key=req.data, value=struct.pack(">Q", v), height=self._height
+        )

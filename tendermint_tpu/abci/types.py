@@ -369,10 +369,12 @@ class _TxResult:
         w.write_str(self.codespace)
         return w.bytes()
 
-    @classmethod
-    def decode(cls, data: bytes):
-        r = Reader(data)
-        return cls(
+    @staticmethod
+    def _read_base(r: Reader) -> tuple:
+        """The shared field sequence, mirroring encode() — subclasses
+        that append fields (ResponseCheckTx) reuse this so the two
+        decoders can never drift."""
+        return (
             r.read_u32(),
             r.read_bytes(),
             r.read_str(),
@@ -383,10 +385,38 @@ class _TxResult:
             r.read_str(),
         )
 
+    @classmethod
+    def decode(cls, data: bytes):
+        return cls(*cls._read_base(Reader(data)))
+
 
 @dataclass
 class ResponseCheckTx(_TxResult):
-    pass
+    """CheckTx result + the v0.35-style priority-mempool fields
+    (proto ResponseCheckTx.priority/sender): ``priority`` orders the
+    mempool's QoS lane (fee-derived in the payments app), ``sender``
+    feeds the per-sender flood cap (mempool/mempool.py). Appended after
+    the shared _TxResult wire fields; absent on old frames (decode
+    tolerates the short form)."""
+
+    priority: int = 0
+    sender: str = ""
+
+    def encode(self) -> bytes:
+        return (
+            super().encode()
+            + Writer().write_i64(self.priority).write_str(self.sender).bytes()
+        )
+
+    @classmethod
+    def decode(cls, data: bytes):
+        r = Reader(data)
+        base = cls._read_base(r)
+        priority, sender = 0, ""
+        if r.remaining():
+            priority = r.read_i64()
+            sender = r.read_str()
+        return cls(*base, priority, sender)
 
 
 @dataclass

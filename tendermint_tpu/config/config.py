@@ -117,6 +117,17 @@ class BaseConfig:
     lightserve_laddr: str = ""
     lightserve_bundle_rows: int = 4096
     lightserve_flush_ms: int = 2
+    # Batched mempool admission (ingest/): concurrent broadcast_tx_* /
+    # gossip CheckTx calls coalesce into bundles — tx keys hash in one
+    # device SHA-256 call (above ingest_hash_threshold rows), signature
+    # rows pre-verify through the pipelined provider + SigCache, then
+    # admission replays the serial order. The dispatch task lingers
+    # ingest_flush_ms so a herd of concurrent submitters lands in one
+    # bundle (bounded by ingest_bundle_txs). See docs/ingest.md.
+    ingest_enabled: bool = True
+    ingest_bundle_txs: int = 256
+    ingest_flush_ms: int = 2
+    ingest_hash_threshold: int = 64
 
     def genesis_file(self) -> str:
         return _rootify(self.genesis_file_name, self.root_dir)
@@ -160,6 +171,12 @@ class BaseConfig:
             return "lightserve_bundle_rows must be >= 1"
         if self.lightserve_flush_ms < 0:
             return "lightserve_flush_ms can't be negative"
+        if self.ingest_bundle_txs < 1:
+            return "ingest_bundle_txs must be >= 1"
+        if self.ingest_flush_ms < 0:
+            return "ingest_flush_ms can't be negative"
+        if self.ingest_hash_threshold < 1:
+            return "ingest_hash_threshold must be >= 1"
         return None
 
 
@@ -275,6 +292,14 @@ class MempoolConfig:
     max_txs_bytes: int = 1_073_741_824  # 1GB
     cache_size: int = 10_000
     max_tx_bytes: int = 1_048_576  # 1MB
+    # QoS lane (docs/ingest.md): priority-ordered reap + lane-aware
+    # eviction — when the pool is full, a tx whose app-assigned
+    # priority (ResponseCheckTx.priority, e.g. the payments fee)
+    # strictly outranks resident entries evicts them instead of being
+    # rejected, so paid traffic survives spam floods. max_txs_per_sender
+    # bounds pending txs per app-declared sender (0 = uncapped).
+    priority_lanes: bool = True
+    max_txs_per_sender: int = 0
 
     def wal_dir_path(self) -> str:
         return _rootify(self.wal_dir, self.root_dir) if self.wal_dir else ""
@@ -291,6 +316,8 @@ class MempoolConfig:
             return "cache_size can't be negative"
         if self.max_tx_bytes < 0:
             return "max_tx_bytes can't be negative"
+        if self.max_txs_per_sender < 0:
+            return "max_txs_per_sender can't be negative"
         return None
 
 

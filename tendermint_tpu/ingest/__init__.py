@@ -1,0 +1,16 @@
+"""Device-batched ingest: the mempool's admission front door.
+
+CheckTx is the surface "heavy traffic from millions of users" actually
+hits (ROADMAP item 4): every broadcast_tx_* RPC and every reactor-gossip
+delivery lands here. This package coalesces those concurrent per-tx
+calls into device-sized bundles — tx-key SHA-256 through the batched
+ops/sha256.py kernels (ingest/hashing.py) and tx signature rows through
+the shared crypto/pipeline.py PipelinedVerifier + SigCache
+(ingest/batcher.py) — so admission keeps the batched verifier saturated
+instead of paying one host round trip per transaction. See
+docs/ingest.md.
+"""
+
+from tendermint_tpu.ingest.batcher import IngestBatcher, IngestShutdownError
+
+__all__ = ["IngestBatcher", "IngestShutdownError"]

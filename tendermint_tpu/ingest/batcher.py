@@ -1,0 +1,341 @@
+"""Batched mempool admission: coalesce concurrent CheckTx calls into
+device-sized bundles.
+
+Every ``broadcast_tx_*`` RPC handler and every reactor-gossip delivery
+runs as its own asyncio task, but ``Mempool.check_tx`` processes them
+one at a time: one sha256, one app round trip, one signature check per
+transaction. Under payment-style load the per-tx signature check is the
+whole cost, and it is exactly the shape the batched verifier eats best
+(PAPERS.md arxiv 2112.02229: keep the verification engine saturated
+from every protocol surface; 2302.00418: admission-side signature
+volume dominates at committee scale).
+
+``IngestBatcher`` is the funnel, the lightserve RequestAggregator's
+sibling for the event loop: submitters enqueue and get a future, a
+dispatch task lingers ``flush_s`` (bounded by ``bundle_txs``) so a
+thundering herd of concurrent submitters lands in one bundle, then per
+bundle:
+
+- tx keys hash in ONE batched SHA-256 call (ingest/hashing.py device
+  engine above ``hash_threshold`` rows, host hashlib below — identical
+  digests) and thread into ``Mempool.check_tx(key=...)`` so admission
+  never re-hashes;
+- signature rows extracted by the app's stateless ``sig_extractor``
+  (e.g. abci/examples/payments.sig_rows) ride ONE
+  ``PipelinedVerifier.submit_batch(dedupe=True)`` — coalescing with the
+  node's own verify traffic — and verified triples land in the shared
+  SigCache, which the app's CheckTx then consults instead of paying a
+  host-serial verify (a miss re-verifies on host, so verdicts are
+  bit-identical to the unbatched path);
+- admission itself runs in submission order, so cache dedupe, capacity
+  and QoS-lane decisions are exactly the serial sequence.
+
+Liveness rides the pipeline's ``_await_or_serial`` contract: a verify
+bundle that fails with a liveness error (shutdown, deadline, restart)
+is simply skipped — the app's own host verify is the serial fallback,
+never a hang. Chaos site ``ingest.batch`` fires per dispatched bundle
+and fails that bundle's callers, never the dispatch task
+(utils/faultinject.py). Counters feed ``tendermint_ingest_*``
+(docs/metrics.md). See docs/ingest.md.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from collections import deque
+from typing import Callable, Dict, List, Optional, Tuple
+
+from tendermint_tpu.ingest.hashing import TxKeyHasher
+from tendermint_tpu.utils import faultinject as faults
+from tendermint_tpu.utils import trace
+from tendermint_tpu.utils.log import get_logger
+
+SigRow = Tuple[bytes, bytes, bytes]  # (pubkey32, msg, sig64)
+
+
+class IngestShutdownError(Exception):
+    """The batcher stopped before this submission was admitted."""
+
+
+class _Pending:
+    __slots__ = ("tx", "sender", "fut")
+
+    def __init__(self, tx: bytes, sender: str, fut: "asyncio.Future"):
+        self.tx = tx
+        self.sender = sender
+        self.fut = fut
+
+
+def _is_liveness_error(e: Exception) -> bool:
+    from tendermint_tpu.crypto.pipeline import _is_liveness_error as _ple
+
+    return _ple(e)
+
+
+class IngestBatcher:
+    """Admission funnel over a :class:`Mempool`.
+
+    ``check_tx`` is a drop-in for ``Mempool.check_tx`` (same returns,
+    same raised admission errors) — the RPC handlers and the mempool
+    reactor call it instead of the pool. ``verifier`` is the node's
+    crypto provider; signature pre-verification only engages when it
+    exposes ``submit_batch`` (the PipelinedVerifier shape), otherwise
+    bundles still batch hashing and admission bookkeeping and the app
+    verifies serially."""
+
+    def __init__(
+        self,
+        mempool,
+        verifier=None,
+        sig_extractor: Optional[Callable[[bytes], Optional[SigRow]]] = None,
+        bundle_txs: int = 256,
+        flush_s: float = 0.002,
+        hasher: Optional[TxKeyHasher] = None,
+        hash_threshold: int = 64,
+        metrics=None,
+        logger=None,
+    ):
+        self.mempool = mempool
+        self.verifier = verifier
+        self.sig_extractor = sig_extractor
+        self.bundle_txs = max(1, int(bundle_txs))
+        self.flush_s = max(0.0, float(flush_s))
+        self.hasher = hasher if hasher is not None else TxKeyHasher(block_on_compile=False)
+        self.hash_threshold = int(hash_threshold)
+        self.metrics = metrics
+        self.logger = logger or get_logger("ingest")
+
+        self._q: "deque[_Pending]" = deque()
+        self._wake = asyncio.Event()
+        self._task: Optional[asyncio.Task] = None
+        self._stopped = False
+        # the bundle _process is currently admitting — its entries were
+        # already popped from _q, so stop() must fail THESE futures too
+        # if it has to cancel a wedged dispatch task (the
+        # PipelinedVerifier._inflight_bundle no-hang discipline)
+        self._inflight: Optional[List[_Pending]] = None
+
+        # counters, snapshot via stats() (metrics pump + bench)
+        self.submitted = 0
+        self.admitted = 0
+        self.rejected = 0  # app said no (res.code != OK)
+        self.admission_errors = 0  # cache dup / full / pre-check raised
+        self.bundles = 0
+        self.bundle_txs_total = 0
+        self.sig_rows_submitted = 0
+        self.verify_liveness_fallbacks = 0
+        self.max_queue_depth = 0
+        self._occupancy_sum = 0
+
+    # -- submit API --------------------------------------------------------
+
+    async def check_tx(self, tx: bytes, sender: str = ""):
+        """Queue one tx for bundled admission and await its verdict.
+        After stop() (or on a dead dispatch task) the call degrades to
+        the direct serial path so teardown races never lose a tx."""
+        if self._stopped:
+            return await self.mempool.check_tx(tx, sender=sender)
+        self._ensure_task()
+        fut = asyncio.get_running_loop().create_future()
+        self._q.append(_Pending(bytes(tx), sender, fut))
+        self.submitted += 1
+        self.max_queue_depth = max(self.max_queue_depth, len(self._q))
+        self._wake.set()
+        return await fut
+
+    def _ensure_task(self) -> None:
+        if self._task is None or self._task.done():
+            if self._task is not None and self._task.done():
+                # a crashed dispatch task must not silently serialize
+                # every later submission; restart and surface the cause
+                exc = self._task.exception() if not self._task.cancelled() else None
+                if exc is not None:
+                    self.logger.error("ingest dispatch task died", err=repr(exc))
+                # the dead task's locally-held bundle is unrecoverable:
+                # fail its unresolved futures NOW so no caller blocks
+                # forever while the replacement serves new traffic (the
+                # restart_workers discipline from the pipeline)
+                orphan, self._inflight = self._inflight, None
+                if orphan:
+                    err = IngestShutdownError(
+                        "ingest dispatch task died holding this bundle"
+                    )
+                    for p in orphan:
+                        self._resolve(p.fut, exc=err)
+            self._task = asyncio.get_running_loop().create_task(self._loop())
+
+    def start(self) -> None:
+        """Spawn the dispatch task (idempotent; check_tx also lazily
+        starts it — this is for node wiring symmetry)."""
+        self._ensure_task()
+
+    # -- dispatch ----------------------------------------------------------
+
+    async def _loop(self) -> None:
+        loop = asyncio.get_running_loop()
+        while True:
+            while not self._q and not self._stopped:
+                self._wake.clear()
+                await self._wake.wait()
+            if not self._q and self._stopped:
+                return
+            if self.flush_s > 0 and len(self._q) < self.bundle_txs:
+                # hold the door: concurrent submitters (each its own
+                # task on this loop) pile on; a full bundle cuts early
+                deadline = loop.time() + self.flush_s
+                while (
+                    not self._stopped
+                    and len(self._q) < self.bundle_txs
+                    and (remaining := deadline - loop.time()) > 0
+                ):
+                    self._wake.clear()
+                    try:
+                        await asyncio.wait_for(self._wake.wait(), remaining)
+                    except asyncio.TimeoutError:
+                        break
+            bundle: List[_Pending] = []
+            while self._q and len(bundle) < self.bundle_txs:
+                bundle.append(self._q.popleft())
+            if bundle:
+                self._inflight = bundle
+                await self._process(bundle)
+                # cleared ONLY on normal completion: an escaping raise
+                # (task death, stop()-cancellation mid-await) must leave
+                # the marker so stop() fails the unresolved futures
+                self._inflight = None
+
+    async def _process(self, bundle: List[_Pending]) -> None:
+        with trace.span("ingest.bundle", txs=len(bundle)):
+            try:
+                # chaos site: a raise HERE fails THIS bundle's callers
+                # (they see the error), never the dispatch task
+                await faults.maybe_async("ingest.batch")
+                txs = [p.tx for p in bundle]
+                keys = self.hasher.keys_or_host(txs, self.hash_threshold)
+                await self._preverify(txs, keys)
+            except Exception as e:
+                for p in bundle:
+                    self._resolve(p.fut, exc=e)
+                return
+            self.bundles += 1
+            self.bundle_txs_total += len(bundle)
+            self._occupancy_sum += len(bundle)
+            if self.metrics is not None:
+                self.metrics.observe_bundle_txs(len(bundle))
+            # admission in submission order: dedupe/capacity/lane
+            # decisions replay the exact serial sequence
+            for p, key in zip(bundle, keys):
+                if p.fut.done():
+                    continue  # caller gone (cancelled await)
+                try:
+                    res = await self.mempool.check_tx(p.tx, sender=p.sender, key=key)
+                except Exception as e:
+                    self.admission_errors += 1
+                    self._resolve(p.fut, exc=e)
+                    continue
+                if res.is_ok():
+                    self.admitted += 1
+                else:
+                    self.rejected += 1
+                self._resolve(p.fut, res)
+
+    async def _preverify(self, txs: List[bytes], keys: List[bytes]) -> None:
+        """Submit the bundle's signature rows as ONE pipeline batch with
+        dedupe=True: verified triples land in the shared SigCache, so
+        the app's per-tx CheckTx resolves them without a host-serial
+        verify. Rows whose tx the mempool would fast-reject anyway
+        (seen-cache dup, oversize, full pool the priority hint can't
+        outrank) are skipped FIRST — spam against a full pool must not
+        buy signature work here either (the mempool DoS guard extends
+        to the batched path). Liveness errors are swallowed — the
+        app's own verify IS the serial fallback (the _await_or_serial
+        contract)."""
+        if self.sig_extractor is None or self.verifier is None:
+            return
+        submit = getattr(self.verifier, "submit_batch", None)
+        if submit is None:
+            return
+        fast_reject = getattr(self.mempool, "would_fast_reject", None)
+        rows: List[SigRow] = []
+        for tx, key in zip(txs, keys):
+            if fast_reject is not None and fast_reject(tx, key):
+                continue
+            r = self.sig_extractor(tx)
+            if r is not None:
+                rows.append(r)
+        if not rows:
+            return
+        from tendermint_tpu.crypto.batch import pack_triples
+
+        pk, mg, sg, lens = pack_triples(*zip(*rows))
+        self.sig_rows_submitted += len(rows)
+        fut = submit(pk, mg, sg, msg_lens=lens, dedupe=True)
+        try:
+            await asyncio.wrap_future(fut)
+        except Exception as e:
+            if not _is_liveness_error(e):
+                raise
+            self.verify_liveness_fallbacks += 1
+            trace.instant("ingest.verify_fallback_serial")
+
+    @staticmethod
+    def _resolve(fut: "asyncio.Future", value=None, exc: Optional[Exception] = None) -> None:
+        if fut.done():
+            return
+        if exc is not None:
+            fut.set_exception(exc)
+        else:
+            fut.set_result(value)
+
+    # -- stats / lifecycle -------------------------------------------------
+
+    def queue_depth(self) -> int:
+        """Txs awaiting bundle dispatch (the gossip reactor's
+        backpressure probe)."""
+        return len(self._q)
+
+    def stats(self) -> Dict[str, float]:
+        s = {
+            "queue_depth": len(self._q),
+            "max_queue_depth": self.max_queue_depth,
+            "submitted": self.submitted,
+            "admitted": self.admitted,
+            "rejected": self.rejected,
+            "admission_errors": self.admission_errors,
+            "bundles": self.bundles,
+            "bundle_txs": self.bundle_txs_total,
+            "sig_rows": self.sig_rows_submitted,
+            "verify_liveness_fallbacks": self.verify_liveness_fallbacks,
+            "bundle_occupancy_avg": (
+                self._occupancy_sum / self.bundles if self.bundles else 0.0
+            ),
+        }
+        s.update(self.hasher.stats())
+        return s
+
+    async def stop(self) -> None:
+        """Stop accepting bundled work and fail anything still queued
+        with IngestShutdownError (callers, if any remain, retry through
+        the serial path). The dispatch task drains its current bundle
+        and exits."""
+        if self._stopped:
+            return
+        self._stopped = True
+        self._wake.set()
+        if self._task is not None:
+            try:
+                # drain: the dispatch task admits what is already queued
+                # before exiting; a wedged task is cancelled, and its
+                # leftovers fail below
+                await asyncio.wait_for(asyncio.shield(self._task), timeout=5.0)
+            except Exception:
+                self._task.cancel()
+        err = IngestShutdownError("ingest batcher stopped before admitting request")
+        # the in-flight bundle's entries were already popped from _q: if
+        # the task was cancelled mid-_process (e.g. a stalled app conn),
+        # their unresolved futures must fail HERE or the callers hang
+        orphan, self._inflight = self._inflight, None
+        for p in orphan or ():
+            self._resolve(p.fut, exc=err)
+        while self._q:
+            self._resolve(self._q.popleft().fut, exc=err)

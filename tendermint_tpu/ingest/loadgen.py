@@ -1,0 +1,107 @@
+"""Load generator for the ingest bench and tests (the lightserve
+loadgen's sibling): deterministic signed payment fleets plus admission
+drivers for the serial and batched arms.
+
+The fleet is ``n_accounts`` funded ed25519 keypairs producing
+round-robin transfer txs with per-sender nonces — every tx is a real
+signature the admission path must check, which is what makes the
+batched-vs-serial comparison mean something. Verdicts are normalized
+(``ok`` / app code / raised-error class) so the property suite can
+assert bit-identical admission across arms.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from tendermint_tpu.abci.examples import payments
+from tendermint_tpu.crypto.keys import Ed25519PrivKey
+
+
+def accounts(
+    n: int, funds: int = 1_000_000_000, tag: str = "pay"
+) -> Tuple[List[Ed25519PrivKey], Dict[bytes, int]]:
+    """Deterministic funded keypairs: (privs, initial_balances)."""
+    privs = [Ed25519PrivKey.from_secret(f"{tag}-{i}".encode()) for i in range(n)]
+    return privs, {p.pub_key().bytes(): funds for p in privs}
+
+
+def make_transfers(
+    privs: Sequence[Ed25519PrivKey],
+    n_txs: int,
+    amount: int = 1,
+    fee: int = 0,
+    fee_of=None,
+    recipient_of=None,
+) -> List[bytes]:
+    """Round-robin senders, incrementing per-sender nonces. ``fee_of(i)``
+    / ``recipient_of(i)`` override the flat fee / next-account recipient
+    (QoS tests shape fees; defaults model uniform paid traffic)."""
+    nonces = {id(p): 0 for p in privs}
+    out: List[bytes] = []
+    for i in range(n_txs):
+        p = privs[i % len(privs)]
+        to = (
+            recipient_of(i)
+            if recipient_of is not None
+            else privs[(i + 1) % len(privs)].pub_key().bytes()
+        )
+        f = fee_of(i) if fee_of is not None else fee
+        out.append(payments.make_transfer(p, nonces[id(p)], to, amount, fee=f))
+        nonces[id(p)] += 1
+    return out
+
+
+def verdict(res=None, exc: Optional[Exception] = None) -> Tuple:
+    """Normalized admission outcome for cross-arm comparison."""
+    if exc is not None:
+        return ("err", type(exc).__name__)
+    if res.is_ok():
+        return ("ok", res.priority)
+    return ("code", res.code)
+
+
+async def _admit_one(check_tx, tx: bytes, sender: str = "") -> Tuple:
+    try:
+        return verdict(await check_tx(tx, sender))
+    except Exception as e:
+        return verdict(exc=e)
+
+
+async def serial_admit(
+    mempool, txs: Sequence[bytes], rechecks: int = 0
+) -> Tuple[List[Tuple], float]:
+    """The per-tx baseline arm: one serial ``Mempool.check_tx`` per tx
+    (each paying its own hash + host signature verify), then
+    ``rechecks`` post-commit recheck rounds — the reference behavior
+    where the app re-validates every pending tx each height."""
+    t0 = time.perf_counter()
+    out = [await _admit_one(mempool.check_tx, tx) for tx in txs]
+    for h in range(rechecks):
+        await mempool.update(h + 1, _empty_txs(), [])
+    return out, time.perf_counter() - t0
+
+
+async def batched_admit(
+    batcher, txs: Sequence[bytes], rechecks: int = 0
+) -> Tuple[List[Tuple], float]:
+    """The batched arm: all txs submitted concurrently through the
+    ingest funnel (bundled hashing + pipeline sig pre-verification +
+    SigCache-backed app checks), then the same recheck rounds — which
+    resolve from the cache instead of re-verifying."""
+    t0 = time.perf_counter()
+    tasks = [
+        asyncio.ensure_future(_admit_one(batcher.check_tx, tx)) for tx in txs
+    ]
+    out = list(await asyncio.gather(*tasks))
+    for h in range(rechecks):
+        await batcher.mempool.update(h + 1, _empty_txs(), [])
+    return out, time.perf_counter() - t0
+
+
+def _empty_txs():
+    from tendermint_tpu.types.tx import Txs
+
+    return Txs([])

@@ -1,5 +1,7 @@
 from tendermint_tpu.mempool.mempool import (
     ErrMempoolIsFull,
+    ErrPreCheck,
+    ErrSenderFloodLimit,
     ErrTxInCache,
     ErrTxTooLarge,
     Mempool,
@@ -9,6 +11,8 @@ from tendermint_tpu.mempool.mempool import (
 
 __all__ = [
     "ErrMempoolIsFull",
+    "ErrPreCheck",
+    "ErrSenderFloodLimit",
     "ErrTxInCache",
     "ErrTxTooLarge",
     "Mempool",

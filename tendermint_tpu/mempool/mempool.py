@@ -22,6 +22,7 @@ from typing import Callable, Dict, List, Optional
 
 from tendermint_tpu.abci import types as abci
 from tendermint_tpu.types.tx import Tx, Txs
+from tendermint_tpu.utils import faultinject as faults
 from tendermint_tpu.utils import trace
 from tendermint_tpu.utils.log import get_logger
 
@@ -40,6 +41,10 @@ class ErrMempoolIsFull(Exception):
 
 class ErrPreCheck(Exception):
     pass
+
+
+class ErrSenderFloodLimit(Exception):
+    """Sender exceeded max_txs_per_sender pending txs (QoS flood cap)."""
 
 
 def tx_key(tx: bytes) -> bytes:
@@ -73,21 +78,42 @@ class TxCache:
     def remove(self, tx: bytes, key: Optional[bytes] = None) -> None:
         self._map.pop(key if key is not None else tx_key(tx), None)
 
+    def contains_key(self, key: bytes) -> bool:
+        """Membership by precomputed key (no re-hash; recheck path)."""
+        return key in self._map
+
     def __contains__(self, tx: bytes) -> bool:
         return tx_key(tx) in self._map
 
 
 class _MempoolTx:
-    """One pool entry (reference mempoolTx clist_mempool.go:765)."""
+    """One pool entry (reference mempoolTx clist_mempool.go:765).
+    ``key`` is the tx_key digest computed once at admission and threaded
+    through update/recheck/eviction so the pool never re-hashes;
+    ``priority``/``sender`` come from the app's ResponseCheckTx and
+    drive the QoS lane (priority-ordered reap, lane-aware eviction,
+    per-sender flood cap)."""
 
-    __slots__ = ("tx", "height", "gas_wanted", "seq", "senders")
+    __slots__ = ("tx", "height", "gas_wanted", "seq", "senders", "key", "priority", "sender")
 
-    def __init__(self, tx: bytes, height: int, gas_wanted: int, seq: int):
+    def __init__(
+        self,
+        tx: bytes,
+        height: int,
+        gas_wanted: int,
+        seq: int,
+        key: bytes = b"",
+        priority: int = 0,
+        sender: str = "",
+    ):
         self.tx = tx
         self.height = height  # height at which validated
         self.gas_wanted = gas_wanted
         self.seq = seq
         self.senders: set = set()  # peer ids that sent us this tx
+        self.key = key
+        self.priority = priority
+        self.sender = sender  # flood-cap identity (app sender, else peer)
 
 
 class Mempool:
@@ -100,6 +126,7 @@ class Mempool:
         height: int = 0,
         pre_check: Optional[Callable[[bytes], Optional[str]]] = None,
         post_check: Optional[Callable[[bytes, abci.ResponseCheckTx], Optional[str]]] = None,
+        priority_hint: Optional[Callable[[bytes], Optional[int]]] = None,
         logger=None,
     ):
         self.config = config
@@ -110,8 +137,26 @@ class Mempool:
         self._txs_bytes = 0
         self._seq = 0
         self._cache = TxCache(config.cache_size)
+        # QoS lane bookkeeping (docs/ingest.md): pending txs per flood-cap
+        # identity, plus cumulative lane counters for tendermint_ingest_*
+        self._sender_counts: Dict[str, int] = {}
+        self._lane_paid = 0  # resident entries with priority > 0
+        # keys explicitly banned via invalidate_tx and not yet consumed
+        # by a recheck drop. Bans come ONLY from that API (never from
+        # ordinary rejection churn), and the set is pruned to resident
+        # keys each recheck, so it stays operator-action-sized.
+        self._banned: set = set()
+        self.evicted_total = 0
+        self.sender_capped_total = 0
+        self.recheck_cache_drops = 0
         self._pre_check = pre_check
         self._post_check = post_check
+        # crypto-free upper bound on the priority the app could assign
+        # (e.g. the payments fee field, a pure parse): lets a FULL pool
+        # reject un-outranking floods for the cost of a scan instead of
+        # paying the app round trip (and its signature verify) per spam
+        # tx. The app's real verdict still rules when the tx proceeds.
+        self._priority_hint = priority_hint
         # consensus lock: held around Commit + Update (reference Lock/Unlock)
         self._update_lock = asyncio.Lock()
         self._new_tx = asyncio.Condition()
@@ -165,32 +210,55 @@ class Mempool:
 
     # -- admission (reference CheckTx :213) --------------------------------
 
-    async def check_tx(self, tx: bytes, sender: str = "") -> abci.ResponseCheckTx:
+    async def check_tx(
+        self, tx: bytes, sender: str = "", key: Optional[bytes] = None
+    ) -> abci.ResponseCheckTx:
         """Validate tx via the app and add to the pool if accepted.
-        Raises ErrTxInCache/ErrTxTooLarge/ErrMempoolIsFull/ErrPreCheck on
-        admission failure; returns the app's ResponseCheckTx otherwise
-        (rejected txs return with res.code != OK, not raised)."""
+        Raises ErrTxInCache/ErrTxTooLarge/ErrMempoolIsFull/ErrPreCheck/
+        ErrSenderFloodLimit on admission failure; returns the app's
+        ResponseCheckTx otherwise (rejected txs return with
+        res.code != OK, not raised). ``key`` is the precomputed tx_key
+        when the caller already hashed the tx (the batched ingest path
+        hashes whole bundles in one device call, ingest/batcher.py)."""
         tx = bytes(tx)
         with trace.span("mempool.check_tx", bytes=len(tx)) as sp:
+            # chaos site: an injected raise here is a failed admission
+            # the caller sees (RPC error / gossip drop), never a crash
+            await faults.maybe_async("mempool.admit")
             if len(tx) > self.config.max_tx_bytes:
                 raise ErrTxTooLarge(f"{len(tx)} > {self.config.max_tx_bytes}")
-            err = self.is_full(len(tx))
+            # hash ONCE per CheckTx and thread the key through: the admission
+            # path previously recomputed tx_key up to four times per tx
+            # (cache push, in-pool lookup, pool insert, log line).
+            # Duplicate checks run BEFORE the full-pool gate: replaying
+            # an already-seen tx against a full pool must stay O(1), not
+            # pay the gate's hint parse + resident-floor scan per echo.
+            if key is None:
+                key = tx_key(tx)
+            entry = self._txs.get(key)
+            if entry is not None:
+                # resident tx: a redelivery is a cache hit whatever the
+                # cache's current state — re-inserting would double-count
+                # _txs_bytes and the flood-cap tally. An LRU-churned key
+                # is repaired here, but NOT one explicitly banned: a
+                # gossip echo must not revoke an operator's
+                # unsafe_invalidate_tx ban awaiting the next recheck
+                if sender:
+                    entry.senders.add(sender)
+                if key not in self._banned and not self._cache.contains_key(key):
+                    self._cache.push(tx, key)  # churn repair only
+                raise ErrTxInCache()
+            if self._cache.contains_key(key):
+                self._cache.push(tx, key)  # refresh LRU recency
+                raise ErrTxInCache()
+            err = self._full_pool_gate(tx)
             if err is not None:
                 raise err
             if self._pre_check is not None:
                 perr = self._pre_check(tx)
                 if perr is not None:
                     raise ErrPreCheck(perr)
-            # hash ONCE per CheckTx and thread the key through: the admission
-            # path previously recomputed tx_key up to four times per tx
-            # (cache push, in-pool lookup, pool insert, log line)
-            key = tx_key(tx)
-            if not self._cache.push(tx, key):
-                # record extra sender for an in-pool tx (reference :259-266)
-                entry = self._txs.get(key)
-                if entry is not None and sender:
-                    entry.senders.add(sender)
-                raise ErrTxInCache()
+            self._cache.push(tx, key)
 
             try:
                 res = await self._app.check_tx_sync(abci.RequestCheckTx(tx=tx))
@@ -208,16 +276,48 @@ class Mempool:
         once by check_tx."""
         post_err = self._post_check(tx, res) if self._post_check else None
         if res.is_ok() and post_err is None:
+            # clamped non-negative: the lane's floor arithmetic
+            # (_outranks_floor, _lane_paid) assumes priority >= 0
+            priority = max(0, int(getattr(res, "priority", 0) or 0))
+            # flood-cap identity: the app's declared sender (an account)
+            # beats the transport peer id — a spammer can hop peers but
+            # not signatures
+            lane_sender = getattr(res, "sender", "") or sender
+            cap = getattr(self.config, "max_txs_per_sender", 0)
+            if cap > 0 and lane_sender and self._sender_counts.get(lane_sender, 0) >= cap:
+                self._cache.remove(tx, key)
+                self.sender_capped_total += 1
+                raise ErrSenderFloodLimit(
+                    f"sender {lane_sender[:16]} has {cap} txs pending"
+                )
             err = self.is_full(len(tx))
             if err is not None:
-                self._cache.remove(tx, key)
-                raise err
+                # lane-aware eviction: strictly-lower-priority entries
+                # make room for paid traffic; equal-or-higher stays and
+                # the newcomer is rejected (reference v0.35 priority
+                # mempool semantics)
+                if not (
+                    self.config.priority_lanes
+                    and self._make_room(len(tx), priority)
+                ):
+                    self._cache.remove(tx, key)
+                    raise err
             self._seq += 1
-            entry = _MempoolTx(tx, self._height, res.gas_wanted, self._seq)
+            entry = _MempoolTx(
+                tx, self._height, res.gas_wanted, self._seq,
+                key=key, priority=priority, sender=lane_sender,
+            )
             if sender:
                 entry.senders.add(sender)
             self._txs[key] = entry
             self._txs_bytes += len(tx)
+            self._banned.discard(key)  # full re-validation revokes a ban
+            if priority > 0:
+                self._lane_paid += 1
+            if lane_sender:
+                self._sender_counts[lane_sender] = (
+                    self._sender_counts.get(lane_sender, 0) + 1
+                )
             if self._wal is not None:
                 import base64
 
@@ -236,6 +336,106 @@ class Mempool:
                 post_check_err=str(post_err) if post_err else "",
             )
             self._cache.remove(tx, key)
+
+    def _drop_entry(self, entry: _MempoolTx, evict_cache: bool) -> None:
+        """Remove one pool entry (shared by update/recheck/eviction).
+        ``evict_cache`` also forgets the seen-cache entry so the tx may
+        be resubmitted later (eviction and recheck-failure semantics)."""
+        if self._txs.pop(entry.key, None) is None:
+            return
+        self._txs_bytes -= len(entry.tx)
+        if entry.priority > 0:
+            self._lane_paid -= 1
+        if entry.sender:
+            n = self._sender_counts.get(entry.sender, 0) - 1
+            if n > 0:
+                self._sender_counts[entry.sender] = n
+            else:
+                self._sender_counts.pop(entry.sender, None)
+        if evict_cache:
+            self._cache.remove(entry.tx, entry.key)
+
+    def _full_pool_gate(self, tx: bytes) -> Optional[Exception]:
+        """The full-pool admission gate, shared by check_tx and
+        would_fast_reject so the batcher's skip-signature-work decision
+        can never drift from real admission. A full pool fails CLOSED:
+        the reference fast reject (no app round trip) unless the QoS
+        lane is on AND the app wired a crypto-free priority hint whose
+        bound outranks the resident floor — a full pool must never
+        convert spam into per-tx app/signature work. The hint is an
+        upper bound only: a lying high hint just pays the app check and
+        gets rejected there, and lane eviction still only acts on the
+        app's REAL priority."""
+        err = self.is_full(len(tx))
+        if err is None:
+            return None
+        if not self.config.priority_lanes or self._priority_hint is None:
+            return err
+        hint = self._priority_hint(tx)
+        if hint is None or not self._outranks_floor(int(hint)):
+            return err
+        return None
+
+    def would_fast_reject(self, tx: bytes, key: bytes) -> bool:
+        """Cheap (no-app, no-crypto, non-mutating) admission pre-filter
+        for the batched ingest path: True when check_tx would refuse
+        this tx before any app round trip — oversize, a full pool the
+        priority hint can't outrank (_full_pool_gate), or a seen-cache
+        duplicate. The batcher skips signature pre-verification for
+        these rows so a flood can't buy device work the admission gate
+        would discard (ingest/batcher.py _preverify)."""
+        if len(tx) > self.config.max_tx_bytes:
+            return True
+        if self._cache.contains_key(key):  # cheap dup check first
+            return True
+        return self._full_pool_gate(tx) is not None
+
+    def _outranks_floor(self, priority: int) -> bool:
+        """True when a tx of this priority could evict SOMETHING — i.e.
+        some resident entry has strictly lower priority. Priorities are
+        clamped non-negative at admission, so the flood shapes are O(1):
+        a zero-hint tx never outranks, and any positive hint outranks a
+        pool holding at least one free entry (the _lane_paid counter).
+        Only the rare all-paid-pool case scans — and the batcher hits
+        this at most once per tx via would_fast_reject, both call sites
+        sharing _full_pool_gate."""
+        if priority <= 0:
+            return False
+        if len(self._txs) - self._lane_paid > 0:
+            return True
+        return any(e.priority < priority for e in self._txs.values())
+
+    def _make_room(self, need_bytes: int, priority: int) -> bool:
+        """Evict strictly-lower-priority entries (lowest priority first,
+        newest first within a priority) until the pool fits one more
+        entry of ``need_bytes``. Feasibility is decided BEFORE anything
+        is removed: if the strictly-lower victims can't free enough
+        room, the pool stays untouched and admission fails with
+        ErrMempoolIsFull — a newcomer that won't fit must not strip the
+        low-priority lane on its way to rejection (reference v0.35
+        priority-mempool semantics)."""
+        victims = sorted(
+            (e for e in self._txs.values() if e.priority < priority),
+            key=lambda e: (e.priority, -e.seq),
+        )
+        count, total = len(self._txs), self._txs_bytes
+        take = 0
+        for v in victims:
+            if count < self.config.size and total + need_bytes <= self.config.max_txs_bytes:
+                break
+            count -= 1
+            total -= len(v.tx)
+            take += 1
+        if not (count < self.config.size and total + need_bytes <= self.config.max_txs_bytes):
+            return False
+        for v in victims[:take]:
+            self._drop_entry(v, evict_cache=True)
+            self.evicted_total += 1
+            self.logger.debug(
+                "evicted lower-priority tx", tx=v.key.hex()[:12],
+                priority=v.priority, for_priority=priority,
+            )
+        return True
 
     def _notify_txs_available(self) -> None:
         if self._txs_available is not None and not self._notified_txs_available:
@@ -271,13 +471,44 @@ class Mempool:
     async def flush_app_conn(self) -> None:
         await self._app.flush()
 
+    def _reap_order(self) -> List[_MempoolTx]:
+        """Block-building order: priority lane first (descending by
+        EFFECTIVE priority), FIFO within a lane (stable sort over
+        insertion order). A sender's own txs always keep admission
+        (seq) order — nonce-style apps (payments) reject a later tx
+        delivered before its earlier sibling — which is why the rank is
+        the sender's running-minimum fee, not the tx's own: seq order
+        falls out of sort stability, and a later high fee cannot
+        elevate earlier cheap siblings past other senders' paid
+        traffic. Lanes off — or a pool with no paid entry — reaps pure
+        insertion order with no sort (the legacy path, and the
+        all-zero-priority fast path: reap_max_txs(1) must not sort a 5k
+        pool for nothing)."""
+        if not self.config.priority_lanes or self._lane_paid == 0:
+            return list(self._txs.values())
+        # effective priority = the running MINIMUM of the sender's fees
+        # up to this tx: non-increasing along a sender's sequence, so a
+        # stable descending sort preserves per-sender seq order, and a
+        # later high fee can never elevate earlier cheap siblings (one
+        # paid tx must not buy block space for a free flood)
+        eff: Dict[int, int] = {}
+        run_min: Dict[object, int] = {}
+        for e in self._txs.values():  # insertion order == seq order
+            k = e.sender or e.key
+            m = run_min.get(k)
+            m = e.priority if m is None else min(m, e.priority)
+            run_min[k] = m
+            eff[id(e)] = m
+        return sorted(self._txs.values(), key=lambda e: -eff[id(e)])
+
     def reap_max_bytes_max_gas(self, max_bytes: int, max_gas: int) -> Txs:
-        """Collect txs in order up to byte/gas limits (reference
-        ReapMaxBytesMaxGas :471). max_bytes/max_gas < 0 mean no cap."""
+        """Collect txs in priority order up to byte/gas limits
+        (reference ReapMaxBytesMaxGas :471). max_bytes/max_gas < 0 mean
+        no cap."""
         out: List[Tx] = []
         total_bytes = 0
         total_gas = 0
-        for entry in self._txs.values():
+        for entry in self._reap_order():
             sz = len(entry.tx)
             if max_bytes > -1 and total_bytes + sz > max_bytes:
                 break
@@ -290,10 +521,10 @@ class Mempool:
         return Txs(out)
 
     def reap_max_txs(self, n: int) -> Txs:
-        """First n txs (reference ReapMaxTxs :508)."""
+        """First n txs in priority order (reference ReapMaxTxs :508)."""
         if n < 0:
             n = len(self._txs)
-        return Txs([Tx(e.tx) for _, e in zip(range(n), self._txs.values())])
+        return Txs([Tx(e.tx) for _, e in zip(range(n), self._reap_order())])
 
     async def update(
         self,
@@ -312,18 +543,25 @@ class Mempool:
         if post_check is not None:
             self._post_check = post_check
 
-        for tx, res in zip(txs, deliver_tx_responses):
+        # committed-block keys come from the Txs cache (types/tx.py
+        # keys()) — the admission path hashed each pool tx once, and the
+        # post-commit path must not re-serialize/re-hash the whole block
+        keys = (
+            txs.keys()
+            if isinstance(txs, Txs)
+            else [tx_key(bytes(t)) for t in txs]
+        )
+        for tx, key, res in zip(txs, keys, deliver_tx_responses):
             tx = bytes(tx)
-            key = tx_key(tx)
             if res.is_ok():
                 # committed: keep in cache to reject future resubmission
                 self._cache.push(tx, key)
             else:
                 # invalid on-chain: allow resubmission later
                 self._cache.remove(tx, key)
-            entry = self._txs.pop(key, None)
+            entry = self._txs.get(key)
             if entry is not None:
-                self._txs_bytes -= len(entry.tx)
+                self._drop_entry(entry, evict_cache=False)
 
         if self._txs:
             if self.config.recheck:
@@ -334,8 +572,31 @@ class Mempool:
 
     async def _recheck_txs(self) -> None:
         """Re-validate every pool tx at the new app state (reference
-        recheckTxs :591): requests pipelined, responses applied in order."""
-        entries = list(self._txs.values())
+        recheckTxs :591): requests pipelined, responses applied in
+        order. Entries EXPLICITLY invalidated through the seen-cache
+        (TxCache.remove: failed on-chain, operator ban) are dropped
+        WITHOUT an ABCI round-trip — re-validating a tx the cache
+        already disowned is the redundant recheck; gossip redelivery
+        re-admits (and re-validates) it if it comes back. Entries whose
+        key merely fell off the LRU under churn are REPAIRED (key
+        re-pushed) and rechecked normally — cache pressure must never
+        silently discard a valid pending tx. Entry keys were computed
+        once at admission (_MempoolTx.key); nothing on this path
+        re-hashes."""
+        entries = []
+        for entry in list(self._txs.values()):
+            if entry.key in self._banned:
+                self._drop_entry(entry, evict_cache=False)
+                self._banned.discard(entry.key)
+                self.recheck_cache_drops += 1
+                continue
+            if not self._cache.contains_key(entry.key):
+                self._cache.push(entry.tx, entry.key)  # churn repair
+            entries.append(entry)
+        # marks for non-resident keys can never match a recheck: prune
+        # them so the set stays operator-action-sized (a ban on a tx
+        # that never showed up simply means full re-validation later)
+        self._banned.intersection_update(self._txs.keys())
         reqres = [
             self._app.check_tx_async(
                 abci.RequestCheckTx(tx=e.tx, type=abci.CHECK_TX_RECHECK)
@@ -347,16 +608,44 @@ class Mempool:
             res = await rr.wait()
             post_err = self._post_check(entry.tx, res) if self._post_check else None
             if not res.is_ok() or post_err is not None:
-                k = tx_key(entry.tx)
-                if self._txs.pop(k, None) is not None:
-                    self._txs_bytes -= len(entry.tx)
-                self._cache.remove(entry.tx, k)
+                self._drop_entry(entry, evict_cache=True)
+
+    def lane_stats(self) -> Dict[str, int]:
+        """QoS-lane occupancy + cumulative counters for the
+        tendermint_ingest_* metrics family (utils/metrics.py)."""
+        return {
+            "lane_paid": self._lane_paid,
+            "lane_free": len(self._txs) - self._lane_paid,
+            "senders_tracked": len(self._sender_counts),
+            "evicted": self.evicted_total,
+            "sender_capped": self.sender_capped_total,
+            "recheck_cache_drops": self.recheck_cache_drops,
+        }
+
+    def invalidate_tx(self, tx: Optional[bytes] = None, key: Optional[bytes] = None) -> None:
+        """Explicit single-tx ban — the targeted counterpart of
+        flush(): forget the seen-cache entry and mark it invalidated,
+        so the next recheck drops a resident copy WITHOUT an ABCI
+        round-trip and gossip may not readmit it from the cache. For
+        out-of-band knowledge that a tx is bad (seen failing in a
+        peer's block, operator intervention via the
+        unsafe_invalidate_tx RPC). A resident copy is dropped by the
+        recheck pass, so the ban needs ``config.recheck`` (the default)
+        to clear the pool; a NON-resident tx is simply forgotten and
+        will be fully re-validated if resubmitted."""
+        if key is None:
+            key = tx_key(bytes(tx))
+        self._cache.remove(b"", key=key)
+        self._banned.add(key)
 
     async def flush(self) -> None:
         """Drop everything (reference Flush :434; RPC unsafe_flush_mempool)."""
         self._cache.reset()
         self._txs.clear()
         self._txs_bytes = 0
+        self._sender_counts.clear()
+        self._lane_paid = 0
+        self._banned.clear()
 
 
 class NopMempool:
@@ -368,8 +657,11 @@ class NopMempool:
     def txs_bytes(self) -> int:
         return 0
 
-    async def check_tx(self, tx: bytes, sender: str = ""):
+    async def check_tx(self, tx: bytes, sender: str = "", key=None):
         raise ErrMempoolIsFull("nop mempool")
+
+    def lane_stats(self) -> Dict[str, int]:
+        return {}
 
     def reap_max_bytes_max_gas(self, max_bytes: int, max_gas: int) -> Txs:
         return Txs()

@@ -38,12 +38,20 @@ def decode_txs(data: bytes):
 
 
 class MempoolReactor(Reactor):
-    def __init__(self, config, mempool: Mempool, logger=None):
+    def __init__(self, config, mempool: Mempool, ingest=None, logger=None):
         super().__init__("mempool")
         self.config = config
         self.mempool = mempool
+        # batched admission front-end (ingest/batcher.py): when wired,
+        # gossip deliveries coalesce with the RPC herd into device-sized
+        # CheckTx bundles instead of paying one host pass per tx
+        self.ingest = ingest
         self.logger = logger or get_logger("mempool.reactor")
         self._peer_tasks: Dict[str, asyncio.Task] = {}
+        # strong refs for fire-and-forget admissions: the loop keeps
+        # only weak references to tasks, so an unreferenced pending
+        # task can be garbage-collected mid-flight (asyncio docs)
+        self._bg: set = set()
 
     def get_channels(self):
         return [ChannelDescriptor(id=MEMPOOL_CHANNEL, priority=1, send_queue_capacity=100)]
@@ -59,15 +67,45 @@ class MempoolReactor(Reactor):
         if t is not None:
             t.cancel()
 
+    # gossip backpressure high-water: while the batcher's queue holds
+    # fewer than this many txs, deliveries are fire-and-forget so the
+    # peer's receive loop never idles out a flush linger per tx; past
+    # it, the reactor awaits (the pre-batcher backpressure), bounding
+    # memory under a gossip flood
+    INGEST_HIGH_WATER = 2048
+
     async def receive(self, ch_id: int, peer: Peer, msg_bytes: bytes) -> None:
-        """Reference Receive :160."""
-        for tx in decode_txs(msg_bytes):
+        """Reference Receive :160. With the batched front-end wired,
+        deliveries submit CONCURRENTLY so a whole gossip message (and
+        back-to-back single-tx messages from a busy peer) coalesce into
+        shared admission bundles instead of 1-tx bundles that each pay
+        the flush linger serially."""
+        txs = decode_txs(msg_bytes)
+        if self.ingest is not None:
+            futs = []
+            for tx in txs:
+                t = asyncio.ensure_future(self._checktx_quiet(tx, peer.id))
+                self._bg.add(t)
+                t.add_done_callback(self._bg.discard)
+                futs.append(t)
+            if self.ingest.queue_depth() >= self.INGEST_HIGH_WATER:
+                await asyncio.gather(*futs)
+            return
+        for tx in txs:
             try:
                 await self.mempool.check_tx(tx, sender=peer.id)
             except (ErrTxInCache, ErrMempoolIsFull):
                 pass  # benign
             except Exception as e:
                 self.logger.debug("peer tx rejected", err=str(e))
+
+    async def _checktx_quiet(self, tx: bytes, sender: str) -> None:
+        try:
+            await self.ingest.check_tx(tx, sender=sender)
+        except (ErrTxInCache, ErrMempoolIsFull):
+            pass  # benign
+        except Exception as e:
+            self.logger.debug("peer tx rejected", err=str(e))
 
     async def _broadcast_tx_routine(self, peer: Peer) -> None:
         """Reference broadcastTxRoutine :193: walk the pool in order,

@@ -70,6 +70,14 @@ def default_app(config: Config):
         from tendermint_tpu.abci.examples.counter import CounterApplication
 
         return CounterApplication()
+    if spec == "payments":
+        from tendermint_tpu.abci.examples.payments import PaymentsApplication
+
+        return PaymentsApplication()
+    if spec == "kvproofs":
+        from tendermint_tpu.abci.examples.kvproofs import KVProofsApplication
+
+        return KVProofsApplication()
     if spec == "noop":
         from tendermint_tpu.abci.application import Application
 
@@ -254,7 +262,13 @@ class Node(Service):
         self.priv_validator = priv_validator
 
         # -- mempool / evidence / exec (wired in on_start after handshake) --
-        self.mempool = Mempool(config.mempool, self.proxy_app)
+        self.mempool = Mempool(
+            config.mempool,
+            self.proxy_app,
+            # crypto-free priority bound (docs/ingest.md): a full pool
+            # rejects un-outranking floods before the app round trip
+            priority_hint=getattr(self.app, "admission_priority_hint", None),
+        )
         self.evidence_pool = EvidencePool(
             make_db("evidence", config), self.state_store, self.block_store
         )
@@ -266,10 +280,33 @@ class Node(Service):
             event_bus=self.event_bus,
         )
 
+        # -- batched ingest (ingest/batcher.py; docs/ingest.md) -------------
+        # The mempool's admission front door: concurrent broadcast_tx_* /
+        # gossip CheckTx calls coalesce into bundles — tx keys hash in one
+        # device SHA-256 call, signature rows (apps exposing
+        # admission_sig_rows, e.g. payments) pre-verify through the
+        # pipelined provider's SigCache. The dispatch task starts lazily
+        # on the first submission (needs the running loop).
+        self.ingest = None
+        if config.base.ingest_enabled:
+            from tendermint_tpu.ingest import IngestBatcher
+
+            self.ingest = IngestBatcher(
+                self.mempool,
+                verifier=self.crypto_provider,
+                sig_extractor=getattr(self.app, "admission_sig_rows", None),
+                bundle_txs=config.base.ingest_bundle_txs,
+                flush_s=config.base.ingest_flush_ms / 1000.0,
+                hash_threshold=config.base.ingest_hash_threshold,
+                logger=self.logger,
+            )
+
         self.consensus_state: Optional[ConsensusState] = None
         self.consensus_reactor: Optional[ConsensusReactor] = None
         self.bc_reactor: Optional[BlockchainReactor] = None
-        self.mempool_reactor = MempoolReactor(config.mempool, self.mempool)
+        self.mempool_reactor = MempoolReactor(
+            config.mempool, self.mempool, ingest=self.ingest
+        )
         self.evidence_reactor = EvidenceReactor(self.evidence_pool)
 
         # -- p2p -----------------------------------------------------------
@@ -313,6 +350,7 @@ class Node(Service):
         from tendermint_tpu.utils.metrics import (
             CryptoMetrics,
             HealthMetrics,
+            IngestMetrics,
             LightServeMetrics,
             MerkleMetrics,
             TraceMetrics,
@@ -329,6 +367,12 @@ class Node(Service):
         self.trace_metrics = TraceMetrics(self.metrics_registry, ns)
         self.health_metrics = HealthMetrics(self.metrics_registry, ns)
         self.lightserve_metrics = LightServeMetrics(self.metrics_registry, ns)
+        self.ingest_metrics = IngestMetrics(self.metrics_registry, ns)
+        if self.ingest is not None:
+            # direct handle for the bundle-size histogram (distributions
+            # can't be rebuilt from snapshot deltas, the LightServe
+            # bisection-depth pattern)
+            self.ingest.metrics = self.ingest_metrics
         # batched light-client verification service (lightserve/):
         # constructed in on_start (it reads the block store), None when
         # lightserve_enabled is off
@@ -682,6 +726,12 @@ class Node(Service):
             )
             if self.lightserve is not None:
                 self.lightserve_metrics.update(self.lightserve.stats())
+            # lane counters move regardless of the ingest front-end —
+            # the QoS lane lives in the mempool (docs/metrics.md)
+            self.ingest_metrics.update(
+                self.ingest.stats() if self.ingest is not None else {},
+                getattr(self.mempool, "lane_stats", dict)(),
+            )
             if self.watchdog is not None:
                 self.watchdog.heartbeat("node.metrics_pump")
             await asyncio.sleep(2.0)
@@ -705,6 +755,10 @@ class Node(Service):
             await self.lightserve_server.stop()
         if self.lightserve is not None:
             self.lightserve.stop()
+        # ingest before the pipeline: its bundles pre-verify through the
+        # pipelined provider, so the funnel must drain first
+        if self.ingest is not None:
+            await self.ingest.stop()
         # drain the pipelined verify dispatcher: every already-submitted
         # future completes before its threads exit (crypto/pipeline.py)
         stop_pipeline = getattr(self.crypto_provider, "stop", None)

@@ -284,16 +284,20 @@ def digests_to_state(digests: np.ndarray) -> np.ndarray:
 
 
 def pack_leaf_blocks(
-    items: Sequence[bytes], n_pad: int, n_blocks: int
+    items: Sequence[bytes], n_pad: int, n_blocks: int, prefix_len: int = 1
 ) -> Tuple[np.ndarray, np.ndarray]:
     """Pack leaves into fully padded SHA-256 message blocks, host-side
     and vectorized: (n_pad, n_blocks, 64) u8 blocks + (n_pad,) int32
-    per-row block counts. Each row is 0x00-leaf-prefix || leaf || 0x80
-    || zeros || 64-bit big-endian bit length — the kernel never touches
-    padding logic. Pad rows (>= len(items)) get count 0 and all-zero
-    blocks; their junk digests are never selected (merkle_inner_tail
-    masks on the logical count)."""
+    per-row block counts. Each row is ``prefix_len`` ZERO prefix bytes
+    || leaf || 0x80 || zeros || 64-bit big-endian bit length — the
+    kernel never touches padding logic. The default prefix_len=1 is the
+    merkle 0x00 leaf prefix (zero content, so it never needs writing);
+    prefix_len=0 packs plain sha256 messages (the ingest tx-key engine,
+    ingest/hashing.py). Pad rows (>= len(items)) get count 0 and
+    all-zero blocks; their junk digests are never selected
+    (merkle_inner_tail masks on the logical count)."""
     n = len(items)
+    p = int(prefix_len)
     lens = np.fromiter((len(x) for x in items), dtype=np.int64, count=n)
     row = n_blocks * 64
     flat = np.zeros(n_pad * row, dtype=np.uint8)
@@ -306,12 +310,12 @@ def pack_leaf_blocks(
         length = int(lens[0])
         buf = flat.reshape(n_pad, row)
         if length:
-            buf[:n, 1 : 1 + length] = np.frombuffer(
+            buf[:n, p : p + length] = np.frombuffer(
                 b"".join(items), dtype=np.uint8
             ).reshape(n, length)
-        buf[:n, 1 + length] = 0x80
-        nbi = (length + 73) // 64
-        bits = (length + 1) * 8
+        buf[:n, p + length] = 0x80
+        nbi = (length + p + 72) // 64
+        bits = (length + p) * 8
         buf[:n, nbi * 64 - 8 : nbi * 64] = np.frombuffer(
             bits.to_bytes(8, "big"), dtype=np.uint8
         )
@@ -319,15 +323,15 @@ def pack_leaf_blocks(
         return flat.reshape(n_pad, n_blocks, 64), counts
     total = int(lens.sum())
     src = np.frombuffer(b"".join(items), dtype=np.uint8)
-    row_base = np.arange(n, dtype=np.int64) * row + 1  # +1: leaf prefix 0x00
+    row_base = np.arange(n, dtype=np.int64) * row + p
     if total:
         offs = np.zeros(n, dtype=np.int64)
         np.cumsum(lens[:-1], out=offs[1:])
         dst = np.repeat(row_base - offs, lens) + np.arange(total, dtype=np.int64)
         flat[dst] = src
     flat[row_base + lens] = 0x80
-    nb = (lens + 73) // 64  # 1 prefix + 1 terminator + 8 length bytes
-    bits = (lens + 1) * 8
+    nb = (lens + p + 72) // 64  # prefix + 1 terminator + 8 length bytes
+    bits = (lens + p) * 8
     tail = np.arange(n, dtype=np.int64) * row + nb * 64
     for k in range(8):
         flat[tail - 1 - k] = (bits >> (8 * k)) & 0xFF

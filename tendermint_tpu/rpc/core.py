@@ -112,6 +112,7 @@ class RPCCore:
             "tx_search": self.tx_search,
             "broadcast_evidence": self.broadcast_evidence,
             "unsafe_flush_mempool": self.unsafe_flush_mempool,
+            "unsafe_invalidate_tx": self.unsafe_invalidate_tx,
             "unsafe_dial_seeds": self.unsafe_dial_seeds,
             "unsafe_dial_peers": self.unsafe_dial_peers,
             "unsafe_start_cpu_profiler": self.unsafe_start_cpu_profiler,
@@ -385,6 +386,13 @@ class RPCCore:
             "total_bytes": self.node.mempool.txs_bytes(),
         }
 
+    def _checktx_entry(self):
+        """Admission entry: the batched ingest front-end when the node
+        wires one (concurrent broadcasts coalesce into device-sized
+        bundles, ingest/batcher.py), else the mempool directly."""
+        ing = getattr(self.node, "ingest", None)
+        return ing.check_tx if ing is not None else self.node.mempool.check_tx
+
     async def broadcast_tx_async(self, tx=None) -> Dict[str, Any]:
         """Reference mempool.go:23 — returns immediately."""
         raw = _bytes_arg(tx, "tx")
@@ -395,7 +403,7 @@ class RPCCore:
 
     async def _checktx_quiet(self, raw: bytes) -> None:
         try:
-            await self.node.mempool.check_tx(raw)
+            await self._checktx_entry()(raw)
         except Exception:
             pass
 
@@ -406,7 +414,7 @@ class RPCCore:
         from tendermint_tpu.state.txindex import tx_hash
 
         try:
-            res = await self.node.mempool.check_tx(raw)
+            res = await self._checktx_entry()(raw)
         except ErrTxInCache:
             raise RPCError("tx already exists in cache")
         except Exception as e:
@@ -430,7 +438,7 @@ class RPCCore:
             subscriber, query_for_event(EVENT_TX), capacity=100
         )
         try:
-            res = await self.node.mempool.check_tx(raw)
+            res = await self._checktx_entry()(raw)
             if not res.is_ok():
                 return {
                     "check_tx": tx_result_json(res),
@@ -505,6 +513,14 @@ class RPCCore:
     async def unsafe_flush_mempool(self) -> Dict[str, Any]:
         self._require_unsafe()
         await self.node.mempool.flush()
+        return {}
+
+    async def unsafe_invalidate_tx(self, tx=None) -> Dict[str, Any]:
+        """Single-tx ban (mempool.invalidate_tx): the targeted
+        counterpart of unsafe_flush_mempool — the resident copy drops
+        at the next recheck without an ABCI round trip."""
+        self._require_unsafe()
+        self.node.mempool.invalidate_tx(_bytes_arg(tx, "tx"))
         return {}
 
     # -- unsafe profiling (reference rpc/core/dev.go UnsafeStartCPUProfiler

@@ -27,11 +27,13 @@ class Txs(list):
     _leaves_cache: Optional[Tuple[int, List[bytes]]] = None
     _root_cache: Optional[Tuple[int, bytes]] = None
     _proofs_cache: Optional[Tuple[int, bytes, list]] = None
+    _keys_cache: Optional[Tuple[int, List[bytes]]] = None
 
     def _invalidate(self) -> None:
         self._leaves_cache = None
         self._root_cache = None
         self._proofs_cache = None
+        self._keys_cache = None
 
     def __setitem__(self, *a):
         self._invalidate()
@@ -80,6 +82,18 @@ class Txs(list):
             root = merkle.hash_from_byte_slices(self._leaves())
         self._root_cache = (len(self), root)
         return root
+
+    def keys(self) -> List[bytes]:
+        """Per-tx sha256 digests (mempool tx_key / tx-index hash),
+        computed once per block and cached like the leaves: the
+        post-commit mempool update walks every committed tx and must
+        not re-hash what admission already hashed."""
+        cached = self._keys_cache
+        if cached is not None and cached[0] == len(self):
+            return cached[1]
+        keys = [sha256(leaf) for leaf in self._leaves()]
+        self._keys_cache = (len(self), keys)
+        return keys
 
     def index(self, tx: Tx) -> int:
         target = bytes(tx)

@@ -80,6 +80,8 @@ KNOWN_SITES = (
     "p2p.dial",        # p2p/transport.py outbound dial path
     "lightserve.fetch",   # lightserve/service.py header-source fetch path
     "lightserve.bundle",  # lightserve/aggregator.py bundle dispatch (fails the bundle, not the thread)
+    "ingest.batch",       # ingest/batcher.py bundle dispatch (fails the bundle's callers, not the task)
+    "mempool.admit",      # mempool/mempool.py check_tx admission (a raise is a failed admission)
 )
 
 _ACTIONS = ("raise", "delay", "tear")

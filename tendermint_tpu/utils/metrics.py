@@ -521,6 +521,80 @@ class LightServeMetrics:
             self._deltas.feed(getattr(self, attr), key, stats)
 
 
+class IngestMetrics:
+    """Batched mempool admission (``tendermint_ingest_*``,
+    ingest/batcher.py + the mempool QoS lane): tx volume in/out of the
+    admission funnel, how well concurrent CheckTx calls coalesce into
+    device bundles, where tx-key hashing ran, and the lane occupancy /
+    flood-defense counters. Monotonic totals are TRUE counters fed by
+    snapshot deltas from ``IngestBatcher.stats()`` +
+    ``Mempool.lane_stats()`` on each pump, like CryptoMetrics; the
+    bundle-size histogram is observed directly by the batcher. See
+    docs/ingest.md and docs/metrics.md."""
+
+    _BATCHER_COUNTERS = (
+        ("submitted", "submitted"),
+        ("admitted", "admitted"),
+        ("rejected", "rejected"),
+        ("admission_errors", "admission_errors"),
+        ("bundles", "bundles"),
+        ("bundle_txs", "bundle_txs"),
+        ("sig_rows", "sig_rows"),
+        ("hash_device_rows", "hash_device_rows"),
+        ("hash_host_rows", "hash_host_rows"),
+    )
+    _LANE_COUNTERS = (
+        ("lane_evictions", "evicted"),
+        ("sender_capped", "sender_capped"),
+        ("recheck_cache_drops", "recheck_cache_drops"),
+    )
+
+    def __init__(self, registry: Optional[Registry] = None, namespace="tendermint"):
+        r = registry or Registry()
+        sub = "ingest"
+        reg = r.register
+        self.submitted = reg(Counter("submitted_total", "Txs submitted to the admission funnel.", namespace, sub))
+        self.admitted = reg(Counter("admitted_total", "Txs the app accepted into the pool.", namespace, sub))
+        self.rejected = reg(Counter("rejected_total", "Txs the app rejected (CheckTx code != OK).", namespace, sub))
+        self.admission_errors = reg(Counter("admission_errors_total", "Txs refused by admission outside an app acceptance: cache dup / oversize / pre-check (before the app), flood cap / failed lane eviction (after it).", namespace, sub))
+        self.bundles = reg(Counter("bundles_total", "Admission bundles dispatched.", namespace, sub))
+        self.bundle_txs = reg(Counter("bundle_txs_total", "Txs carried in admission bundles.", namespace, sub))
+        self.sig_rows = reg(Counter("sig_rows_total", "Signature rows pre-verified through the pipeline.", namespace, sub))
+        self.hash_device_rows = reg(Counter("hash_device_rows_total", "Tx keys hashed by the device SHA-256 engine.", namespace, sub))
+        self.hash_host_rows = reg(Counter("hash_host_rows_total", "Tx keys hashed on host (below threshold or fallback).", namespace, sub))
+        self.lane_evictions = reg(Counter("lane_evictions_total", "Lower-priority txs evicted for paid traffic.", namespace, sub))
+        self.sender_capped = reg(Counter("sender_capped_total", "Admissions refused by the per-sender flood cap.", namespace, sub))
+        self.recheck_cache_drops = reg(Counter("recheck_cache_drops_total", "Pool txs dropped at recheck without an ABCI round-trip (cache no longer vouches).", namespace, sub))
+        self.queue_depth = reg(Gauge("queue_depth", "Txs waiting for bundle dispatch.", namespace, sub))
+        self.bundle_occupancy = reg(Gauge("bundle_occupancy_avg", "Mean txs coalesced per bundle.", namespace, sub))
+        self.lane_txs = reg(Gauge("lane_txs", "Pool txs per QoS lane (label: lane).", namespace, sub))
+        self.bundle_size = reg(
+            Histogram(
+                "bundle_size_txs",
+                "Txs per dispatched admission bundle.",
+                namespace, sub,
+                buckets=(1, 2, 4, 8, 16, 32, 64, 128, 256, 512),
+            )
+        )
+        self._deltas = _SnapshotCounters()
+
+    def observe_bundle_txs(self, n: int) -> None:
+        self.bundle_size.observe(n)
+
+    def update(self, batcher_stats: dict, lane_stats: Optional[dict] = None) -> None:
+        """Fold an IngestBatcher.stats() snapshot (and optionally the
+        mempool's lane_stats()) into the instruments."""
+        self.queue_depth.set(batcher_stats.get("queue_depth", 0))
+        self.bundle_occupancy.set(batcher_stats.get("bundle_occupancy_avg", 0))
+        for attr, key in self._BATCHER_COUNTERS:
+            self._deltas.feed(getattr(self, attr), key, batcher_stats)
+        if lane_stats is not None:
+            self.lane_txs.with_labels(lane="paid").set(lane_stats.get("lane_paid", 0))
+            self.lane_txs.with_labels(lane="free").set(lane_stats.get("lane_free", 0))
+            for attr, key in self._LANE_COUNTERS:
+                self._deltas.feed(getattr(self, attr), key, lane_stats)
+
+
 class StateMetrics:
     def __init__(self, registry: Optional[Registry] = None, namespace="tendermint"):
         r = registry or Registry()

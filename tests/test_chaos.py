@@ -58,6 +58,12 @@ CHAOS_PLAN = {
     # firing paths are pinned in tests/test_lightserve.py)
     "lightserve.fetch": ("raise", dict(p=0.2)),
     "lightserve.bundle": ("raise", dict(p=0.2)),
+    # ingest absorbs raises by design: a batch fault fails that bundle's
+    # callers (gossip drops / RPC errors, both retryable) and an
+    # admission fault is one failed CheckTx — neither touches consensus.
+    # test_chaos_admission_faults_node_still_commits drives them hot.
+    "ingest.batch": ("raise", dict(p=0.2)),
+    "mempool.admit": ("raise", dict(p=0.2)),
 }
 
 
@@ -128,6 +134,73 @@ def test_chaos_node_commits_five_heights(tmp_path):
         wal = BaseWAL(str(tmp_path / "cs.wal"))
         msgs, found = wal.search_for_end_height(5)
         assert found, "WAL must hold ENDHEIGHT(5) after the chaos run"
+
+    asyncio.run(go())
+
+
+def test_chaos_admission_faults_node_still_commits(tmp_path):
+    """ISSUE-7 satellite: a live node whose ADMISSION path is under
+    injected faults (ingest.batch bundle failures + mempool.admit
+    raises) still commits >= 5 heights — and still lands real payment
+    transfers on chain, because admission failures are retryable by
+    design (gossip redelivers; the driver here plays that role)."""
+
+    async def go():
+        from tendermint_tpu.abci.examples.payments import (
+            PaymentsApplication,
+            sig_rows,
+        )
+        from tendermint_tpu.crypto.pipeline import (
+            PipelinedVerifier as PV,
+            SigCache as SC,
+        )
+        from tendermint_tpu.ingest import IngestBatcher
+        from tendermint_tpu.ingest import loadgen as igen
+        from tests.cs_harness import make_genesis, make_node
+
+        faults.arm("ingest.batch", "raise", p=0.3, seed=CHAOS_SEED)
+        faults.arm("mempool.admit", "raise", p=0.3, seed=CHAOS_SEED)
+
+        privs, balances = igen.accounts(4)
+        txs = igen.make_transfers(privs, 24, amount=1, fee=1)
+        cache = SC()
+        app = PaymentsApplication(dict(balances), sig_cache=cache)
+        genesis, vals = make_genesis(1)
+        node = await make_node(genesis, vals[0], app=app)
+        pv = PV(CPUBatchVerifier(), cache=cache)
+        batcher = IngestBatcher(
+            node.mempool, verifier=pv, sig_extractor=sig_rows,
+            bundle_txs=8, hash_threshold=1 << 30,
+        )
+        await node.cs.start()
+        try:
+            async def submit_with_retry(tx):
+                from tendermint_tpu.mempool.mempool import ErrTxInCache
+
+                for _ in range(20):
+                    try:
+                        await batcher.check_tx(tx)
+                        return True
+                    except ErrTxInCache:
+                        return True  # an earlier attempt landed it
+                    except Exception:
+                        await asyncio.sleep(0.02)  # gossip-redelivery shape
+                return False
+
+            ok = await asyncio.gather(*(submit_with_retry(t) for t in txs))
+            assert all(ok), "admission chaos starved a tx past 20 retries"
+            await node.cs.wait_for_height(5, timeout_s=90)
+        finally:
+            st = faults.stats()["sites"]
+            await node.cs.stop()
+            await batcher.stop()
+            faults.disarm()
+            pv.stop(timeout=5.0)
+
+        assert node.cs.state.last_block_height >= 5
+        # the chaos was real AND transfers still committed through it
+        assert st["ingest.batch"]["triggers"] + st["mempool.admit"]["triggers"] > 0
+        assert app.tx_applied > 0, "no transfer survived the admission chaos"
 
     asyncio.run(go())
 

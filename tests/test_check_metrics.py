@@ -13,6 +13,7 @@ from tendermint_tpu.utils.metrics import (
     CryptoMetrics,
     Gauge,
     Histogram,
+    IngestMetrics,
     LightServeMetrics,
     MerkleMetrics,
     MetricsServer,
@@ -47,6 +48,17 @@ def _full_registry() -> Registry:
                "bundle_rows": 64, "fetches": 6, "fetch_failures": 1,
                "bundle_occupancy_avg": 3.5, "trusted_height": 16,
                "trusted_heights": 5})
+    ing = IngestMetrics(r)
+    ing.observe_bundle_txs(12)
+    ing.observe_bundle_txs(200)
+    ing.update(
+        {"submitted": 50, "admitted": 40, "rejected": 6, "admission_errors": 4,
+         "bundles": 5, "bundle_txs": 50, "sig_rows": 44,
+         "hash_device_rows": 32, "hash_host_rows": 18,
+         "queue_depth": 3, "bundle_occupancy_avg": 10.0},
+        {"lane_paid": 7, "lane_free": 13, "evicted": 2, "sender_capped": 1,
+         "recheck_cache_drops": 3},
+    )
     lbl = r.register(Counter("requests_total", "Reqs.", "tendermint", "rpc"))
     lbl.with_labels(method="status").inc(2)
     lbl.with_labels(method='we"ird\\path\n').inc()  # escaping exercised
@@ -75,6 +87,10 @@ def test_scrape_started_metrics_server():
         # passes the same strict lint
         assert "tendermint_lightserve_requests_total" in text
         assert "tendermint_lightserve_bisection_depth_bucket" in text
+        # ...and the ingest family, counters + lane gauges + histogram
+        assert "tendermint_ingest_admitted_total" in text
+        assert "tendermint_ingest_bundle_size_txs_bucket" in text
+        assert 'tendermint_ingest_lane_txs{lane="paid"}' in text
         errors = lint.validate_metrics_text(text)
         assert errors == [], "\n".join(errors)
 

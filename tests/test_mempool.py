@@ -10,12 +10,14 @@ import asyncio
 import pytest
 
 from tendermint_tpu.abci import types as abci
+from tendermint_tpu.abci.application import Application
 from tendermint_tpu.abci.client.local import LocalClient
 from tendermint_tpu.abci.examples.counter import CounterApplication
 from tendermint_tpu.abci.examples.kvstore import KVStoreApplication
 from tendermint_tpu.config import MempoolConfig
 from tendermint_tpu.mempool import (
     ErrMempoolIsFull,
+    ErrSenderFloodLimit,
     ErrTxInCache,
     ErrTxTooLarge,
     Mempool,
@@ -28,11 +30,11 @@ def run(coro):
     return asyncio.run(coro)
 
 
-async def make_pool(app=None, **cfg_kwargs) -> Mempool:
+async def make_pool(app=None, priority_hint=None, **cfg_kwargs) -> Mempool:
     app = app or KVStoreApplication()
     client = LocalClient(app)
     await client.start()
-    return Mempool(MempoolConfig(**cfg_kwargs), client)
+    return Mempool(MempoolConfig(**cfg_kwargs), client, priority_hint=priority_hint)
 
 
 def tx_n(n: int, width: int = 8) -> bytes:
@@ -173,6 +175,278 @@ def test_wait_for_next_gossip_cursor():
         await pool.check_tx(b"b")
         e2 = await asyncio.wait_for(waiter, 1)
         assert e2.tx == b"b"
+
+    run(go())
+
+
+# -- QoS lane (ingest PR): priority reap, eviction, flood cap --------------
+
+
+class PriorityApp(Application):
+    """check_tx reads ``<priority>:<sender>:<payload>`` from the tx so
+    tests can shape the lane directly."""
+
+    def check_tx(self, req: abci.RequestCheckTx) -> abci.ResponseCheckTx:
+        prio, sender, _ = req.tx.split(b":", 2)
+        return abci.ResponseCheckTx(
+            gas_wanted=1, priority=int(prio), sender=sender.decode()
+        )
+
+
+def ptx(prio: int, sender: str, payload: str) -> bytes:
+    return f"{prio}:{sender}:{payload}".encode()
+
+
+def ptx_hint(tx: bytes) -> int:
+    """The crypto-free priority bound for PriorityApp txs — lane
+    eviction on a full pool only engages when the app wires one
+    (hint-less apps keep the reference fast reject)."""
+    return int(tx.split(b":", 1)[0])
+
+
+def test_priority_ordered_reap():
+    async def go():
+        pool = await make_pool(PriorityApp())
+        for i, prio in enumerate([0, 5, 2, 5, 0, 9]):
+            await pool.check_tx(ptx(prio, f"s{i}", f"p{i}"))
+        got = [bytes(t) for t in pool.reap_max_txs(-1)]
+        # priority desc, FIFO within a priority level
+        assert got == [
+            ptx(9, "s5", "p5"), ptx(5, "s1", "p1"), ptx(5, "s3", "p3"),
+            ptx(2, "s2", "p2"), ptx(0, "s0", "p0"), ptx(0, "s4", "p4"),
+        ]
+        # byte-capped reap takes the paid lane first
+        top = pool.reap_max_bytes_max_gas(len(got[0]) * 2, -1)
+        assert [bytes(t) for t in top] == got[:2]
+
+    run(go())
+
+
+def test_lane_aware_eviction_at_capacity():
+    async def go():
+        pool = await make_pool(PriorityApp(), priority_hint=ptx_hint, size=3)
+        await pool.check_tx(ptx(1, "a", "x"))
+        await pool.check_tx(ptx(5, "b", "x"))
+        await pool.check_tx(ptx(3, "c", "x"))
+        # full + newcomer outranks the floor: lowest-priority evicted
+        await pool.check_tx(ptx(9, "d", "x"))
+        got = {bytes(t) for t in pool.reap_max_txs(-1)}
+        assert got == {ptx(9, "d", "x"), ptx(5, "b", "x"), ptx(3, "c", "x")}
+        assert pool.lane_stats()["evicted"] == 1
+        # full + newcomer does NOT outrank: rejected, pool untouched
+        with pytest.raises(ErrMempoolIsFull):
+            await pool.check_tx(ptx(3, "e", "x"))
+        assert {bytes(t) for t in pool.reap_max_txs(-1)} == got
+        # evicted tx left the seen-cache: resubmission is allowed (and
+        # succeeds once capacity frees up)
+        await pool.update(1, Txs([ptx(9, "d", "x")]), [abci.ResponseDeliverTx()])
+        res = await pool.check_tx(ptx(1, "a", "x"))
+        assert res.is_ok()
+
+    run(go())
+
+
+def test_priority_reap_keeps_same_sender_seq_order():
+    """Nonce safety + no fee-elevation: a sender's txs reap in
+    admission order (a jumped nonce would bounce at deliver time and
+    silently drop the paying tx), and they rank at the sender's
+    RUNNING-MINIMUM fee — a later high fee must not drag earlier cheap
+    siblings past other senders' paid traffic."""
+
+    async def go():
+        pool = await make_pool(PriorityApp())
+        a0 = ptx(1, "alice", "nonce0")
+        a1 = ptx(9, "alice", "nonce1")  # later, pays more
+        b0 = ptx(5, "bob", "nonce0")
+        for tx in (a0, a1, b0):
+            await pool.check_tx(tx)
+        got = [bytes(t) for t in pool.reap_max_txs(-1)]
+        # bob's honest fee-5 outranks alice's min-fee-1 pair; alice's
+        # nonce order is preserved
+        assert got == [b0, a0, a1]
+        assert got.index(a0) < got.index(a1)
+
+    run(go())
+
+
+def test_priority_reap_free_flood_cannot_ride_one_fee():
+    """The QoS-inversion attack: N free txs + one max-fee tx from the
+    same sender must NOT fill the block ahead of other senders' paid
+    traffic — the group ranks at its minimum (zero) fee."""
+
+    async def go():
+        pool = await make_pool(PriorityApp())
+        flood = [ptx(0, "attacker", f"free{i}") for i in range(5)]
+        for tx in flood:
+            await pool.check_tx(tx)
+        await pool.check_tx(ptx(999, "attacker", "fee-rider"))
+        paid = ptx(3, "honest", "pay")
+        await pool.check_tx(paid)
+        assert bytes(pool.reap_max_txs(1)[0]) == paid
+
+    run(go())
+
+
+def test_infeasible_eviction_leaves_pool_untouched():
+    """Feasibility before mutation: a newcomer that outranks SOME
+    entries but cannot free enough room must be rejected WITHOUT
+    destroying the low-priority lane on its way out."""
+
+    async def go():
+        # byte-capped pool: a 100B prio-1 tx + a large prio-9 tx fill it
+        small = ptx(1, "a", "x" * 90)
+        big = ptx(9, "b", "y" * 800)
+        cap = len(small) + len(big) + 50  # mid tx can never fit
+        pool = await make_pool(
+            PriorityApp(), priority_hint=ptx_hint, max_txs_bytes=cap
+        )
+        await pool.check_tx(small)
+        await pool.check_tx(big)
+        mid = ptx(5, "c", "z" * 190)
+        with pytest.raises(ErrMempoolIsFull):
+            await pool.check_tx(mid)
+        # NOTHING was evicted: both residents intact, counters quiet
+        assert {bytes(t) for t in pool.reap_max_txs(-1)} == {small, big}
+        assert pool.lane_stats()["evicted"] == 0
+
+    run(go())
+
+
+def test_lanes_off_reap_keeps_insertion_order():
+    async def go():
+        pool = await make_pool(PriorityApp(), priority_lanes=False)
+        order = [ptx(p, f"s{i}", f"p{i}") for i, p in enumerate([0, 9, 3])]
+        for tx in order:
+            await pool.check_tx(tx)
+        # legacy reap: insertion order, priorities notwithstanding
+        assert [bytes(t) for t in pool.reap_max_txs(-1)] == order
+
+    run(go())
+
+
+def test_lane_eviction_respects_legacy_mode():
+    async def go():
+        pool = await make_pool(PriorityApp(), size=2, priority_lanes=False)
+        await pool.check_tx(ptx(0, "a", "x"))
+        await pool.check_tx(ptx(0, "b", "x"))
+        # legacy: full pool rejects BEFORE the app round trip, priority
+        # notwithstanding
+        with pytest.raises(ErrMempoolIsFull):
+            await pool.check_tx(ptx(9, "c", "x"))
+        # lanes ON but NO hint wired: fail closed — same fast reject (a
+        # full pool must not pay app round trips for apps that gave the
+        # mempool no cheap way to rank newcomers)
+        pool2 = await make_pool(PriorityApp(), size=2)
+        await pool2.check_tx(ptx(0, "a", "x"))
+        await pool2.check_tx(ptx(0, "b", "x"))
+        with pytest.raises(ErrMempoolIsFull):
+            await pool2.check_tx(ptx(9, "c", "x"))
+
+    run(go())
+
+
+def test_per_sender_flood_cap():
+    async def go():
+        pool = await make_pool(PriorityApp(), max_txs_per_sender=2)
+        await pool.check_tx(ptx(1, "spammer", "a"))
+        await pool.check_tx(ptx(1, "spammer", "b"))
+        with pytest.raises(ErrSenderFloodLimit):
+            await pool.check_tx(ptx(1, "spammer", "c"))
+        # other senders unaffected
+        assert (await pool.check_tx(ptx(1, "honest", "a"))).is_ok()
+        # the capped tx was NOT poisoned into the seen-cache: once the
+        # sender's pending txs commit, it may come back
+        await pool.update(
+            1,
+            Txs([ptx(1, "spammer", "a"), ptx(1, "spammer", "b")]),
+            [abci.ResponseDeliverTx(), abci.ResponseDeliverTx()],
+        )
+        assert (await pool.check_tx(ptx(1, "spammer", "c"))).is_ok()
+
+    run(go())
+
+
+def test_full_pool_hint_rejects_flood_without_app_roundtrip():
+    """The DoS guard on the lanes-on path: a full pool rejects txs whose
+    crypto-free priority hint cannot outrank the resident floor WITHOUT
+    paying the app round trip (and its signature verify); only txs that
+    could evict something proceed to the app."""
+
+    async def go():
+        calls = []
+
+        class CountingPriorityApp(PriorityApp):
+            def check_tx(self, req):
+                calls.append(req.tx)
+                return super().check_tx(req)
+
+        app = CountingPriorityApp()
+        client = LocalClient(app)
+        await client.start()
+        pool = Mempool(
+            MempoolConfig(size=3),
+            client,
+            priority_hint=lambda tx: int(tx.split(b":", 1)[0]),
+        )
+        for i in range(3):
+            await pool.check_tx(ptx(5, f"s{i}", f"p{i}"))
+        n_calls = len(calls)
+        # flood of hint-0 txs: rejected with ZERO app round trips
+        for i in range(10):
+            with pytest.raises(ErrMempoolIsFull):
+                await pool.check_tx(ptx(0, "spam", f"junk{i}"))
+        assert len(calls) == n_calls, "flood tx paid an app round trip"
+        # a tx whose hint outranks the floor still reaches the app and evicts
+        res = await pool.check_tx(ptx(9, "vip", "pay"))
+        assert res.is_ok() and len(calls) == n_calls + 1
+        # a LYING high hint pays the app check and gets the app's verdict
+        # (here the app honors the claimed priority, so it evicts too —
+        # the point is only that the hint alone never ADMITS anything)
+        assert pool.size() == 3
+
+    run(go())
+
+
+def test_paid_traffic_survives_spam_flood():
+    """The QoS headline: a full pool of zero-fee spam cannot starve paid
+    txs, and the paid lane reaps first."""
+
+    async def go():
+        pool = await make_pool(PriorityApp(), priority_hint=ptx_hint, size=8)
+        for i in range(8):
+            await pool.check_tx(ptx(0, f"spam{i}", f"junk{i}"))
+        paid = [ptx(7, f"user{i}", f"pay{i}") for i in range(4)]
+        for tx in paid:
+            assert (await pool.check_tx(tx)).is_ok()
+        reaped = [bytes(t) for t in pool.reap_max_txs(4)]
+        assert reaped == paid
+        stats = pool.lane_stats()
+        assert stats["lane_paid"] == 4 and stats["evicted"] == 4
+
+    run(go())
+
+
+def test_churned_resident_readmission_does_not_double_count():
+    """A resident tx whose seen-cache key fell off the LRU and is then
+    redelivered must be treated as the cache hit it would have been:
+    no double insert, no _txs_bytes drift, no second flood-cap count."""
+
+    async def go():
+        pool = await make_pool(PriorityApp(), cache_size=2, max_txs_per_sender=5)
+        tx = ptx(1, "alice", "payload")
+        await pool.check_tx(tx)
+        b0, s0 = pool.txs_bytes(), dict(pool._sender_counts)
+        # churn the 2-entry LRU until the resident tx's key falls out
+        for i in range(4):
+            pool._cache.push(b"", key=bytes([i]) * 32)
+        assert tx not in pool._cache
+        with pytest.raises(ErrTxInCache):
+            await pool.check_tx(tx, sender="peer2")
+        assert pool.size() == 1
+        assert pool.txs_bytes() == b0
+        assert pool._sender_counts == s0
+        # and the cache membership was repaired by the attempt
+        assert tx in pool._cache
 
     run(go())
 
