@@ -1,275 +1,38 @@
 #!/usr/bin/env python3
-"""Prometheus text-format lint for the node's /metrics endpoint.
+"""Prometheus exposition lint — thin wrapper.
 
-Validates the exposition the way a strict scraper would:
-
-- metric and label names match the Prometheus grammar;
-- every sample is preceded by a ``# TYPE`` for its family, and HELP/
-  TYPE appear at most once per family, HELP directly paired with TYPE;
-- TYPE values are legal; samples of a histogram family only use the
-  ``_bucket``/``_sum``/``_count`` suffixes (plus the base name for
-  quantile-less exporters);
-- label values are properly quoted with only legal escapes
-  (``\\``, ``\"``, ``\n``);
-- sample values parse as floats; counters are non-negative;
-- no duplicate series (same name + label set);
-- histogram buckets: ``le`` values ascend, cumulative counts are
-  monotonically non-decreasing, a ``+Inf`` bucket exists and equals
-  ``_count``.
+The validator moved into the tmlint rule registry as the
+``metrics-exposition`` rule (tendermint_tpu/analysis/
+metrics_exposition.py); this script keeps the original CLI and import
+surface (``validate_metrics_text`` / ``scrape`` / ``main``) so
+existing docs, rigs and tests/test_check_metrics.py keep working.
 
 Usage:
     python scripts/check_metrics.py [http://host:port/metrics]
 
-Exit code 0 when the exposition is clean, 1 with the violations listed
-otherwise. Also importable — tests/test_check_metrics.py runs
-``validate_metrics_text`` against a started MetricsServer inside
-tier-1.
+Exit code 0 when the exposition is clean, 1 with the violations
+listed, 2 when the scrape fails. Equivalent:
+``python scripts/tmlint.py --scrape URL``.
 """
 
 from __future__ import annotations
 
-import re
+import os
 import sys
-import urllib.request
-from typing import Dict, List, Optional, Tuple
 
-METRIC_NAME_RE = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
-LABEL_NAME_RE = re.compile(r"^[a-zA-Z_][a-zA-Z0-9_]*$")
-VALID_TYPES = ("counter", "gauge", "histogram", "summary", "untyped")
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
 
-# suffixes that belong to a histogram family's samples
-_HIST_SUFFIXES = ("_bucket", "_sum", "_count")
-
-
-class _ParseError(Exception):
-    pass
-
-
-def _parse_labels(s: str, lineno: int) -> Tuple[Tuple[str, str], ...]:
-    """Parse the inside of a ``{...}`` label block, honoring escapes."""
-    out: List[Tuple[str, str]] = []
-    i = 0
-    n = len(s)
-    while i < n:
-        m = re.match(r"[a-zA-Z_][a-zA-Z0-9_]*", s[i:])
-        if m is None:
-            raise _ParseError(f"line {lineno}: bad label name at ...{s[i:i+20]!r}")
-        name = m.group(0)
-        i += len(name)
-        if i >= n or s[i] != "=":
-            raise _ParseError(f"line {lineno}: expected '=' after label {name!r}")
-        i += 1
-        if i >= n or s[i] != '"':
-            raise _ParseError(f"line {lineno}: label {name!r} value not quoted")
-        i += 1
-        val = []
-        while i < n and s[i] != '"':
-            if s[i] == "\\":
-                if i + 1 >= n or s[i + 1] not in ('\\', '"', "n"):
-                    raise _ParseError(
-                        f"line {lineno}: illegal escape in label {name!r}"
-                    )
-                val.append({"\\": "\\", '"': '"', "n": "\n"}[s[i + 1]])
-                i += 2
-            else:
-                val.append(s[i])
-                i += 1
-        if i >= n:
-            raise _ParseError(f"line {lineno}: unterminated label value for {name!r}")
-        i += 1  # closing quote
-        out.append((name, "".join(val)))
-        if i < n:
-            if s[i] != ",":
-                raise _ParseError(f"line {lineno}: expected ',' between labels")
-            i += 1
-    return tuple(out)
-
-
-def _parse_sample(line: str, lineno: int) -> Tuple[str, Tuple[Tuple[str, str], ...], float]:
-    """(name, labels, value) for one sample line."""
-    if "{" in line:
-        name, rest = line.split("{", 1)
-        if "}" not in rest:
-            raise _ParseError(f"line {lineno}: unterminated label block")
-        # the closing brace is the LAST one before the value (label
-        # values may not contain an unescaped quote, so scanning from
-        # the right is safe for valid input; invalid input fails below)
-        lbl_s, val_s = rest.rsplit("}", 1)
-        labels = _parse_labels(lbl_s, lineno)
-    else:
-        parts = line.split()
-        if len(parts) < 2:
-            raise _ParseError(f"line {lineno}: sample has no value")
-        name, val_s = parts[0], " ".join(parts[1:])
-        labels = ()
-    name = name.strip()
-    val_s = val_s.strip().split()[0] if val_s.strip() else ""
-    if not METRIC_NAME_RE.match(name):
-        raise _ParseError(f"line {lineno}: invalid metric name {name!r}")
-    try:
-        value = float(val_s)
-    except ValueError:
-        raise _ParseError(f"line {lineno}: invalid sample value {val_s!r}")
-    return name, labels, value
-
-
-def _family(name: str, types: Dict[str, str]) -> Optional[str]:
-    """The declared family a sample name belongs to (histogram samples
-    carry suffixes)."""
-    if name in types:
-        return name
-    for suf in _HIST_SUFFIXES:
-        if name.endswith(suf) and name[: -len(suf)] in types:
-            return name[: -len(suf)]
-    return None
-
-
-def validate_metrics_text(text: str) -> List[str]:
-    """All format violations found in a /metrics body ([] = clean)."""
-    errors: List[str] = []
-    types: Dict[str, str] = {}
-    helps: Dict[str, int] = {}
-    last_help: Optional[str] = None
-    seen_series: set = set()
-    # histogram buckets: family -> labelset-without-le -> [(le, cum)]
-    buckets: Dict[str, Dict[tuple, List[Tuple[float, float]]]] = {}
-    hist_counts: Dict[str, Dict[tuple, float]] = {}
-
-    for lineno, raw in enumerate(text.splitlines(), 1):
-        line = raw.strip()
-        if not line:
-            continue
-        if line.startswith("# HELP"):
-            parts = line.split(None, 3)
-            if len(parts) < 3:
-                errors.append(f"line {lineno}: malformed HELP")
-                continue
-            name = parts[2]
-            if name in helps:
-                errors.append(f"line {lineno}: duplicate HELP for {name}")
-            helps[name] = lineno
-            last_help = name
-            continue
-        if line.startswith("# TYPE"):
-            parts = line.split()
-            if len(parts) != 4:
-                errors.append(f"line {lineno}: malformed TYPE")
-                continue
-            _, _, name, kind = parts
-            if kind not in VALID_TYPES:
-                errors.append(f"line {lineno}: invalid TYPE {kind!r} for {name}")
-            if name in types:
-                errors.append(f"line {lineno}: duplicate TYPE for {name}")
-            types[name] = kind
-            # HELP/TYPE pairing: the HELP immediately preceding must be
-            # for the same family
-            if last_help != name:
-                errors.append(
-                    f"line {lineno}: TYPE {name} not directly paired with its HELP"
-                )
-            continue
-        if line.startswith("#"):
-            continue  # comment
-        try:
-            name, labels, value = _parse_sample(line, lineno)
-        except _ParseError as e:
-            errors.append(str(e))
-            continue
-        for ln, _ in labels:
-            if not LABEL_NAME_RE.match(ln):
-                errors.append(f"line {lineno}: invalid label name {ln!r}")
-        fam = _family(name, types)
-        if fam is None:
-            errors.append(f"line {lineno}: sample {name} has no preceding TYPE")
-        else:
-            kind = types[fam]
-            if kind == "counter" and value < 0:
-                errors.append(f"line {lineno}: counter {name} is negative ({value})")
-            if kind != "histogram" and name != fam:
-                errors.append(
-                    f"line {lineno}: suffixed sample {name} under non-histogram {fam}"
-                )
-        key = (name, labels)
-        if key in seen_series:
-            errors.append(f"line {lineno}: duplicate series {name}{dict(labels)}")
-        seen_series.add(key)
-        # histogram bookkeeping
-        if fam is not None and types[fam] == "histogram":
-            base = tuple(kv for kv in labels if kv[0] != "le")
-            if name == fam + "_bucket":
-                le = dict(labels).get("le")
-                if le is None:
-                    errors.append(f"line {lineno}: bucket sample without le label")
-                else:
-                    lev = float("inf") if le == "+Inf" else None
-                    if lev is None:
-                        try:
-                            lev = float(le)
-                        except ValueError:
-                            errors.append(f"line {lineno}: bad le value {le!r}")
-                            lev = None
-                    if lev is not None:
-                        buckets.setdefault(fam, {}).setdefault(base, []).append(
-                            (lev, value)
-                        )
-            elif name == fam + "_count":
-                hist_counts.setdefault(fam, {})[base] = value
-
-    for fam, per_set in buckets.items():
-        for base, rows in per_set.items():
-            les = [le for le, _ in rows]
-            if les != sorted(les):
-                errors.append(f"{fam}{dict(base)}: bucket le values not ascending")
-            cums = [c for _, c in rows]
-            if any(b < a for a, b in zip(cums, cums[1:])):
-                errors.append(f"{fam}{dict(base)}: bucket counts not monotonic")
-            if not les or les[-1] != float("inf"):
-                errors.append(f"{fam}{dict(base)}: missing +Inf bucket")
-            else:
-                total = hist_counts.get(fam, {}).get(base)
-                if total is None:
-                    errors.append(f"{fam}{dict(base)}: histogram missing _count")
-                elif cums and cums[-1] != total:
-                    errors.append(
-                        f"{fam}{dict(base)}: +Inf bucket {cums[-1]} != _count {total}"
-                    )
-    # families declared but orphaned HELP (HELP without TYPE)
-    for name in helps:
-        if name not in types:
-            errors.append(f"HELP for {name} has no TYPE")
-    return errors
-
-
-def scrape(url: str, timeout_s: float = 10.0) -> str:
-    with urllib.request.urlopen(url, timeout=timeout_s) as r:
-        return r.read().decode()
-
-
-def main(argv: List[str]) -> int:
-    url = argv[1] if len(argv) > 1 else "http://127.0.0.1:26660/metrics"
-    if not url.startswith("http"):
-        url = "http://" + url
-    if not url.endswith("/metrics"):
-        url = url.rstrip("/") + "/metrics"
-    try:
-        text = scrape(url)
-    except Exception as e:
-        print(f"scrape failed: {e}", file=sys.stderr)
-        return 2
-    errors = validate_metrics_text(text)
-    if errors:
-        for e in errors:
-            print(f"FAIL: {e}", file=sys.stderr)
-        print(f"{len(errors)} violation(s) in {url}", file=sys.stderr)
-        return 1
-    n = sum(
-        1
-        for line in text.splitlines()
-        if line.strip() and not line.startswith("#")
-    )
-    print(f"OK: {n} samples, format clean ({url})")
-    return 0
-
+# tmlint: disable=unused-import -- thin wrapper: re-exports the moved validator's public surface
+from tendermint_tpu.analysis.metrics_exposition import (  # noqa: E402,F401
+    LABEL_NAME_RE,
+    METRIC_NAME_RE,
+    VALID_TYPES,
+    main,
+    scrape,
+    validate_metrics_text,
+)
 
 if __name__ == "__main__":
     sys.exit(main(sys.argv))

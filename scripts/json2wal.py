@@ -14,8 +14,6 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 from tendermint_tpu.consensus import messages as m
 from tendermint_tpu.consensus.wal import _frame
 from tendermint_tpu.types.block import BlockID, PartSetHeader
-from tendermint_tpu.types.part_set import Part
-from tendermint_tpu.types.proposal import Proposal
 from tendermint_tpu.types.vote import Vote
 
 

@@ -1,0 +1,152 @@
+#!/usr/bin/env python3
+"""tmlint CLI — run the repo's AST invariant linter.
+
+Usage:
+    python scripts/tmlint.py [paths...]        # default: tendermint_tpu tests scripts
+    python scripts/tmlint.py --changed         # only git-touched files (pre-commit)
+    python scripts/tmlint.py --json [paths...] # machine-readable output
+    python scripts/tmlint.py --list-rules      # the rule catalog
+    python scripts/tmlint.py --disable r1,r2   # skip named rules
+    python scripts/tmlint.py --scrape URL      # metrics-exposition rule on a live /metrics
+
+Exit codes: 0 clean, 1 violations found, 2 usage/internal error.
+
+The full project (tendermint_tpu + tests + scripts) is always parsed —
+cross-file rules (fault-site coverage, metrics/docs coherence) need the
+whole index — but with explicit paths or ``--changed`` only violations
+in those files are reported, which keeps the pre-commit loop fast and
+focused. Rule catalog + suppression grammar: docs/static-analysis.md.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+from tendermint_tpu.analysis import all_rules, load_project, run_lint  # noqa: E402
+
+DEFAULT_PATHS = ("tendermint_tpu", "tests", "scripts")
+
+
+def _changed_files() -> set:
+    """Repo-relative .py files touched vs HEAD (worktree + staged +
+    untracked) — the pre-commit surface. Raises RuntimeError when git
+    itself fails: a broken git environment must fail the gate loudly,
+    not report an empty change set as 'clean'."""
+    out = set()
+    for args in (
+        ["git", "diff", "--name-only", "HEAD"],
+        ["git", "ls-files", "--others", "--exclude-standard"],
+    ):
+        try:
+            proc = subprocess.run(
+                args, cwd=_REPO, capture_output=True, text=True, timeout=30
+            )
+        except (OSError, subprocess.TimeoutExpired) as e:
+            raise RuntimeError(f"{' '.join(args)} failed: {e}")
+        if proc.returncode != 0:
+            raise RuntimeError(
+                f"{' '.join(args)} exited {proc.returncode}: "
+                f"{proc.stderr.strip() or proc.stdout.strip()}"
+            )
+        for line in proc.stdout.splitlines():
+            line = line.strip()
+            if line.endswith(".py"):
+                out.add(line)
+    return out
+
+
+def main(argv) -> int:
+    ap = argparse.ArgumentParser(prog="tmlint", add_help=True)
+    ap.add_argument("paths", nargs="*", help="files/dirs to report on")
+    ap.add_argument("--changed", action="store_true", help="lint only git-touched files")
+    ap.add_argument("--json", action="store_true", dest="as_json")
+    ap.add_argument("--list-rules", action="store_true")
+    ap.add_argument("--disable", default="", help="comma-separated rule names to skip")
+    ap.add_argument("--scrape", default="", help="run metrics-exposition on a live /metrics URL")
+    args = ap.parse_args(argv[1:])
+
+    rules = all_rules()
+    if args.list_rules:
+        width = max(len(r.name) for r in rules)
+        for r in sorted(rules, key=lambda r: r.name):
+            print(f"{r.name:<{width}}  {r.summary}")
+        return 0
+
+    if args.scrape:
+        from tendermint_tpu.analysis import metrics_exposition
+        from tendermint_tpu.analysis.rules_exposition import MetricsExposition
+
+        url = args.scrape
+        if not url.startswith("http"):
+            url = "http://" + url
+        if not url.endswith("/metrics"):
+            url = url.rstrip("/") + "/metrics"
+        try:
+            text = metrics_exposition.scrape(url)
+        except Exception as e:
+            print(f"scrape failed: {e}", file=sys.stderr)
+            return 2
+        violations = MetricsExposition().check_text(text, source=url)
+    else:
+        disabled = {n.strip() for n in args.disable.split(",") if n.strip()}
+        unknown = disabled - {r.name for r in rules} - {"suppression-format"}
+        if unknown:
+            print(f"unknown rule(s) in --disable: {', '.join(sorted(unknown))}", file=sys.stderr)
+            return 2
+        project = load_project(_REPO, DEFAULT_PATHS)
+        targets = None
+        if args.changed:
+            try:
+                changed = _changed_files()
+            except RuntimeError as e:
+                print(f"tmlint: --changed needs a working git: {e}", file=sys.stderr)
+                return 2
+            targets = {p for p in changed if p in project.by_rel}
+            if not targets:
+                print("tmlint: no changed .py files under the lint roots")
+                return 0
+        elif args.paths:
+            requested = load_project(_REPO, args.paths)
+            targets = set(requested.by_rel)
+            if not targets:
+                # a typo'd / since-moved path must not read as "clean":
+                # that would silently disable the gate in CI forever
+                print(
+                    f"tmlint: no .py files found under: {' '.join(args.paths)}",
+                    file=sys.stderr,
+                )
+                return 2
+            # files outside the default roots still get linted: merge
+            # them into the project so rule context covers them
+            extra = [f for f in requested.files if f.rel not in project.by_rel]
+            if extra:
+                project.files.extend(extra)
+                project.by_rel.update({f.rel: f for f in extra})
+                project.by_module.update({f.module_name(): f for f in extra})
+        violations = run_lint(project, targets=targets, disabled=disabled)
+
+    if args.as_json:
+        print(json.dumps([v.as_dict() for v in violations], indent=2))
+    else:
+        for v in violations:
+            print(v.format())
+        if violations:
+            print(f"\n{len(violations)} violation(s)", file=sys.stderr)
+        else:
+            scope = "changed files" if args.changed else (
+                ", ".join(args.paths) if args.paths else ", ".join(DEFAULT_PATHS)
+            )
+            print(f"tmlint: clean ({scope})")
+    return 1 if violations else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv))

@@ -22,12 +22,17 @@ class LocalClient(ABCIClient):
         # shareable so multiple conns to one app serialize (local_client.go NewLocalClient)
         self._lock = lock or asyncio.Lock()
         self._pending = 0
+        # strong refs: asyncio holds tasks weakly, and a GC'd _run task
+        # would strand its ReqRes unresolved (mempool/reactor.py idiom)
+        self._bg: set = set()
 
     def send_async(self, req) -> ReqRes:
         # FIFO holds for every message type (flush included): tasks start in
         # creation order and the lock queue is fair.
         rr = ReqRes(req)
-        asyncio.ensure_future(self._run(rr))
+        task = asyncio.ensure_future(self._run(rr))
+        self._bg.add(task)
+        task.add_done_callback(self._bg.discard)
         return rr
 
     async def _run(self, rr: ReqRes) -> None:

@@ -52,6 +52,8 @@ class SocketClient(ABCIClient):
         self._writer: asyncio.StreamWriter = None
         self._sent: deque = deque()
         self._err: Exception = None
+        # strong refs for eager-flush tasks: asyncio holds tasks weakly
+        self._bg: set = set()
 
     async def on_start(self) -> None:
         kind, target = parse_addr(self._addr)
@@ -85,7 +87,9 @@ class SocketClient(ABCIClient):
         self._sent.append(rr)
         if isinstance(req, (t.RequestFlush, t.RequestCommit)):
             # eager flush on barriers; otherwise rely on transport buffering
-            asyncio.ensure_future(self._drain())
+            task = asyncio.ensure_future(self._drain())
+            self._bg.add(task)
+            task.add_done_callback(self._bg.discard)
         return rr
 
     async def _drain(self) -> None:

@@ -1,0 +1,34 @@
+"""tmlint: AST-based static analysis encoding this repo's hard-won
+review rules as machine-checked invariants (docs/static-analysis.md).
+
+Entry points: ``scripts/tmlint.py`` (CLI), :func:`run_lint` +
+:func:`load_project` (programmatic, used by tests/test_tmlint.py in
+tier-1), :func:`all_rules` (the registry — importing this package
+registers every built-in rule on first use).
+"""
+
+from tendermint_tpu.analysis.core import (
+    FileContext,
+    Project,
+    Rule,
+    Violation,
+    all_rules,
+    collect_py_files,
+    load_project,
+    register,
+    rule_names,
+    run_lint,
+)
+
+__all__ = [
+    "FileContext",
+    "Project",
+    "Rule",
+    "Violation",
+    "all_rules",
+    "collect_py_files",
+    "load_project",
+    "register",
+    "rule_names",
+    "run_lint",
+]

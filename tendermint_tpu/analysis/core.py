@@ -1,0 +1,467 @@
+"""tmlint core: the rule registry, suppression grammar and runner.
+
+Seven PRs of review rounds kept re-finding the same bug classes by
+hand — a breaker guard comparing a bound method to a string, asyncio
+tasks garbage-collected mid-flight, fault sites armed with no call
+point, permanent failure latches, metric families drifting out of
+docs/metrics.md. Each review rule that survived a round lives here as
+a machine-checked invariant (docs/static-analysis.md maps every rule
+back to the CHANGES.md incident it encodes), run repo-wide in tier-1
+by tests/test_tmlint.py and from the CLI by scripts/tmlint.py.
+
+Architecture:
+
+- :class:`Rule` — one invariant. ``check_file(ctx, project)`` yields
+  per-file violations; ``check_project(project)`` yields cross-file
+  ones (fault-site coverage, metrics/docs coherence). Rules register
+  themselves via :func:`register`; ``all_rules()`` is the registry.
+- :class:`FileContext` — a parsed source file: text, AST, and the
+  suppression table built from ``# tmlint:`` comments (tokenized, so
+  string literals that merely look like comments don't count).
+- :class:`Project` — every file in the lint set plus lazily-built
+  cross-file indices (class -> methods, module path -> file) and the
+  repo docs corpus.
+
+Suppression grammar (enforced, not advisory):
+
+    x = risky_code()  # tmlint: disable=rule-a,rule-b -- why this is fine
+    # tmlint: disable=rule-a -- standalone form covers the NEXT line
+    # tmlint: disable-file=rule-a -- whole-file, conventionally at top
+
+Every suppression MUST carry a ``-- justification``; one without it
+(or naming an unknown rule) is itself reported as a
+``suppression-format`` violation, which cannot be suppressed — the
+acceptance bar "every suppression carries a justification" is checked
+by the tool, not by reviewers.
+"""
+
+from __future__ import annotations
+
+import ast
+import io
+import os
+import tokenize
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+__all__ = [
+    "Violation",
+    "Rule",
+    "FileContext",
+    "Project",
+    "register",
+    "all_rules",
+    "rule_names",
+    "run_lint",
+]
+
+
+@dataclass
+class Violation:
+    rule: str
+    path: str  # repo-relative, '/'-separated
+    line: int
+    message: str
+    col: int = 0
+
+    def format(self) -> str:
+        return f"{self.path}:{self.line}:{self.col}: [{self.rule}] {self.message}"
+
+    def as_dict(self) -> dict:
+        return {
+            "rule": self.rule,
+            "path": self.path,
+            "line": self.line,
+            "col": self.col,
+            "message": self.message,
+        }
+
+
+class Rule:
+    """One machine-checked invariant. Subclasses set ``name`` (the
+    suppression/CLI identifier) and ``summary`` (one line, shown by
+    ``tmlint --list-rules``) and override one or both hooks."""
+
+    name: str = ""
+    summary: str = ""
+
+    def check_file(self, ctx: "FileContext", project: "Project") -> Iterable[Violation]:
+        return ()
+
+    def check_project(self, project: "Project") -> Iterable[Violation]:
+        return ()
+
+
+# -- suppressions -----------------------------------------------------------
+
+_MAGIC = "tmlint:"
+
+
+@dataclass
+class _Suppression:
+    line: int  # line the comment sits on
+    rules: Tuple[str, ...]
+    file_level: bool
+    standalone: bool  # comment is the only thing on its line
+    justified: bool
+    raw: str
+
+
+def _parse_suppressions(text: str) -> Tuple[List[_Suppression], List[Tuple[int, str]]]:
+    """All ``# tmlint:`` comments in `text` (via tokenize, so string
+    literals never match) plus (line, message) parse problems."""
+    sups: List[_Suppression] = []
+    problems: List[Tuple[int, str]] = []
+    if _MAGIC not in text:
+        return sups, problems  # fast path: no directives, skip tokenizing
+    try:
+        tokens = list(tokenize.generate_tokens(io.StringIO(text).readline))
+    except (tokenize.TokenError, IndentationError, SyntaxError):
+        return sups, problems  # the AST parse will report the real error
+    lines = text.splitlines()
+    for tok in tokens:
+        if tok.type != tokenize.COMMENT or _MAGIC not in tok.string:
+            continue
+        line = tok.start[0]
+        body = tok.string.split(_MAGIC, 1)[1].strip()
+        spec, sep, justification = body.partition("--")
+        spec = spec.strip()
+        file_level = False
+        if spec.startswith("disable-file="):
+            file_level = True
+            names = spec[len("disable-file="):]
+        elif spec.startswith("disable="):
+            names = spec[len("disable="):]
+        else:
+            problems.append(
+                (line, f"unrecognized tmlint directive {body!r} "
+                       "(want disable=<rule>[,..] or disable-file=<rule>[,..])")
+            )
+            continue
+        rules = tuple(n.strip() for n in names.split(",") if n.strip())
+        if not rules:
+            problems.append((line, "tmlint suppression names no rules"))
+            continue
+        src_line = lines[line - 1] if line <= len(lines) else ""
+        standalone = src_line.strip().startswith("#")
+        sups.append(
+            _Suppression(
+                line=line,
+                rules=rules,
+                file_level=file_level,
+                standalone=standalone,
+                justified=bool(sep) and bool(justification.strip()),
+                raw=tok.string,
+            )
+        )
+    return sups, problems
+
+
+# -- file / project contexts -----------------------------------------------
+
+
+class FileContext:
+    def __init__(self, path: str, rel: str, text: str):
+        self.path = path
+        self.rel = rel.replace(os.sep, "/")
+        self.text = text
+        self.lines = text.splitlines()
+        self.tree: Optional[ast.AST] = None
+        self._nodes: Optional[List[ast.AST]] = None
+        self.parse_error: Optional[str] = None
+        try:
+            self.tree = ast.parse(text)
+        except SyntaxError as e:
+            self.parse_error = f"syntax error: {e.msg} (line {e.lineno})"
+        self.suppressions, self.suppression_problems = _parse_suppressions(text)
+        # line -> rule names suppressed on that line
+        self._line_sup: Dict[int, Set[str]] = {}
+        self._file_sup: Set[str] = set()
+        for s in self.suppressions:
+            if s.file_level:
+                self._file_sup.update(s.rules)
+            else:
+                self._line_sup.setdefault(s.line, set()).update(s.rules)
+                if s.standalone:
+                    # standalone comment covers the next source line
+                    self._line_sup.setdefault(s.line + 1, set()).update(s.rules)
+
+    @property
+    def nodes(self) -> List[ast.AST]:
+        """Flat list of every AST node, computed once — rules doing
+        whole-tree scans iterate this instead of re-walking the tree
+        (a dozen rules × ast.walk dominated the lint wall clock)."""
+        if self._nodes is None:
+            self._nodes = list(ast.walk(self.tree)) if self.tree is not None else []
+        return self._nodes
+
+    @property
+    def is_test(self) -> bool:
+        return self.rel.startswith("tests/") or self.rel.startswith("test/")
+
+    @property
+    def in_package(self) -> bool:
+        return self.rel.startswith("tendermint_tpu/")
+
+    def suppressed(self, rule: str, line: int) -> bool:
+        if rule in self._file_sup:
+            return True
+        return rule in self._line_sup.get(line, ())
+
+    def module_name(self) -> str:
+        """Dotted module path ('tendermint_tpu.ops.sha256',
+        'tests.cs_harness', 'scripts.tmlint')."""
+        rel = self.rel[:-3] if self.rel.endswith(".py") else self.rel
+        if rel.endswith("/__init__"):
+            rel = rel[: -len("/__init__")]
+        return rel.replace("/", ".")
+
+
+@dataclass
+class ClassInfo:
+    name: str
+    module: str  # dotted
+    rel: str
+    line: int
+    methods: Set[str] = field(default_factory=set)  # plain callables only
+    properties: Set[str] = field(default_factory=set)
+    attributes: Set[str] = field(default_factory=set)  # assigned in class/self
+
+
+_PROPERTY_DECORATORS = {"property", "cached_property", "functools.cached_property"}
+
+
+def _decorator_name(d: ast.expr) -> str:
+    if isinstance(d, ast.Name):
+        return d.id
+    if isinstance(d, ast.Attribute):
+        base = _decorator_name(d.value)
+        return f"{base}.{d.attr}" if base else d.attr
+    if isinstance(d, ast.Call):
+        return _decorator_name(d.func)
+    return ""
+
+
+class Project:
+    def __init__(self, root: str, files: Sequence[FileContext]):
+        self.root = root
+        self.files = list(files)
+        self.by_rel: Dict[str, FileContext] = {f.rel: f for f in self.files}
+        self.by_module: Dict[str, FileContext] = {f.module_name(): f for f in self.files}
+        self._classes: Optional[Dict[str, List[ClassInfo]]] = None
+        self._docs_cache: Dict[str, str] = {}
+
+    # -- indices -----------------------------------------------------------
+
+    @property
+    def classes(self) -> Dict[str, List[ClassInfo]]:
+        """Unqualified class name -> every definition in the lint set
+        (method/property/attribute surfaces)."""
+        if self._classes is None:
+            idx: Dict[str, List[ClassInfo]] = {}
+            for f in self.files:
+                if f.tree is None:
+                    continue
+                mod = f.module_name()
+                for node in f.nodes:
+                    if not isinstance(node, ast.ClassDef):
+                        continue
+                    info = ClassInfo(node.name, mod, f.rel, node.lineno)
+                    for item in node.body:
+                        if isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                            decs = {_decorator_name(d) for d in item.decorator_list}
+                            if decs & _PROPERTY_DECORATORS:
+                                info.properties.add(item.name)
+                            else:
+                                info.methods.add(item.name)
+                            for sub in ast.walk(item):
+                                if (
+                                    isinstance(sub, ast.Attribute)
+                                    and isinstance(sub.ctx, ast.Store)
+                                    and isinstance(sub.value, ast.Name)
+                                    and sub.value.id == "self"
+                                ):
+                                    info.attributes.add(sub.attr)
+                        elif isinstance(item, ast.Assign):
+                            for t in item.targets:
+                                if isinstance(t, ast.Name):
+                                    info.attributes.add(t.id)
+                        elif isinstance(item, ast.AnnAssign) and isinstance(
+                            item.target, ast.Name
+                        ):
+                            info.attributes.add(item.target.id)
+                    idx.setdefault(node.name, []).append(info)
+            self._classes = idx
+        return self._classes
+
+    def unique_class(self, name: str) -> Optional[ClassInfo]:
+        """The ClassInfo for `name` iff exactly one class in the lint
+        set defines it (ambiguous names yield None — a wrong-class
+        match would produce noise, not signal)."""
+        infos = self.classes.get(name) or []
+        return infos[0] if len(infos) == 1 else None
+
+    def docs_text(self, *rel_paths: str) -> str:
+        """Concatenated text of repo files (docs corpora for the
+        coherence rules); missing files read as empty."""
+        key = "|".join(rel_paths)
+        if key not in self._docs_cache:
+            chunks = []
+            for rel in rel_paths:
+                p = os.path.join(self.root, rel)
+                if os.path.isdir(p):
+                    for name in sorted(os.listdir(p)):
+                        if name.endswith(".md"):
+                            with open(os.path.join(p, name), encoding="utf-8") as fp:
+                                chunks.append(fp.read())
+                elif os.path.exists(p):
+                    with open(p, encoding="utf-8") as fp:
+                        chunks.append(fp.read())
+            self._docs_cache[key] = "\n".join(chunks)
+        return self._docs_cache[key]
+
+
+# -- registry ---------------------------------------------------------------
+
+_REGISTRY: Dict[str, Rule] = {}
+
+
+def register(rule: Rule) -> Rule:
+    if not rule.name:
+        raise ValueError(f"rule {rule!r} has no name")
+    if rule.name in _REGISTRY:
+        raise ValueError(f"duplicate rule name {rule.name!r}")
+    _REGISTRY[rule.name] = rule
+    return rule
+
+
+def all_rules() -> List[Rule]:
+    _load_builtin_rules()
+    return list(_REGISTRY.values())
+
+
+def rule_names() -> List[str]:
+    return sorted(r.name for r in all_rules())
+
+
+_BUILTINS_LOADED = False
+
+
+def _load_builtin_rules() -> None:
+    """Import the rule modules exactly once (each registers itself)."""
+    global _BUILTINS_LOADED
+    if _BUILTINS_LOADED:
+        return
+    # tmlint: disable=unused-import -- importing IS the use: each module registers its rules
+    from tendermint_tpu.analysis import (  # noqa: F401
+        rules_concurrency,
+        rules_config,
+        rules_deadcode,
+        rules_exposition,
+        rules_faults,
+        rules_latch,
+        rules_metrics,
+        rules_purity,
+        rules_tests,
+        rules_truthiness,
+    )
+
+    _BUILTINS_LOADED = True
+
+
+# -- runner -----------------------------------------------------------------
+
+_SKIP_DIRS = {"__pycache__", ".git", "node_modules", ".venv", "venv"}
+
+
+def collect_py_files(root: str, paths: Sequence[str]) -> List[str]:
+    out: List[str] = []
+    for p in paths:
+        full = p if os.path.isabs(p) else os.path.join(root, p)
+        if os.path.isfile(full) and full.endswith(".py"):
+            out.append(full)
+        elif os.path.isdir(full):
+            for dirpath, dirnames, filenames in os.walk(full):
+                dirnames[:] = [d for d in dirnames if d not in _SKIP_DIRS]
+                for name in sorted(filenames):
+                    if name.endswith(".py"):
+                        out.append(os.path.join(dirpath, name))
+    return sorted(set(out))
+
+
+def load_project(root: str, paths: Sequence[str]) -> Project:
+    files = []
+    for full in collect_py_files(root, paths):
+        rel = os.path.relpath(full, root)
+        try:
+            with open(full, encoding="utf-8") as fp:
+                text = fp.read()
+        except (OSError, UnicodeDecodeError):
+            continue
+        files.append(FileContext(full, rel, text))
+    return Project(root, files)
+
+
+def run_lint(
+    project: Project,
+    targets: Optional[Set[str]] = None,
+    disabled: Optional[Set[str]] = None,
+    rules: Optional[Sequence[Rule]] = None,
+) -> List[Violation]:
+    """Run every rule over `project`, returning unsuppressed violations
+    in files named by `targets` (repo-relative; None = all files). The
+    whole project is always analyzed — cross-file rules need the full
+    index even when only a subset is reported (--changed mode)."""
+    rules = list(rules if rules is not None else all_rules())
+    disabled = disabled or set()
+    known = {r.name for r in rules} | {"suppression-format", "parse-error"}
+    raw: List[Violation] = []
+    for ctx in project.files:
+        if ctx.parse_error is not None:
+            raw.append(Violation("parse-error", ctx.rel, 1, ctx.parse_error))
+            continue
+        for rule in rules:
+            if rule.name in disabled:
+                continue
+            raw.extend(rule.check_file(ctx, project))
+    for rule in rules:
+        if rule.name in disabled:
+            continue
+        raw.extend(rule.check_project(project))
+
+    out: List[Violation] = []
+    for v in raw:
+        ctx = project.by_rel.get(v.path)
+        if ctx is not None and ctx.suppressed(v.rule, v.line):
+            continue
+        out.append(v)
+
+    # the suppression grammar is itself linted: a suppression must name
+    # known rules AND carry a `-- justification`; neither failure can be
+    # suppressed away
+    if "suppression-format" not in disabled:
+        for ctx in project.files:
+            for line, msg in ctx.suppression_problems:
+                out.append(Violation("suppression-format", ctx.rel, line, msg))
+            for s in ctx.suppressions:
+                if not s.justified:
+                    out.append(
+                        Violation(
+                            "suppression-format", ctx.rel, s.line,
+                            "suppression has no justification "
+                            "(grammar: # tmlint: disable=<rule> -- <why>)",
+                        )
+                    )
+                for name in s.rules:
+                    if name not in known:
+                        out.append(
+                            Violation(
+                                "suppression-format", ctx.rel, s.line,
+                                f"suppression names unknown rule {name!r}",
+                            )
+                        )
+
+    if targets is not None:
+        out = [v for v in out if v.path in targets]
+    out.sort(key=lambda v: (v.path, v.line, v.rule))
+    return out

@@ -1,0 +1,199 @@
+"""task-retention and async-hygiene: the event loop stays live.
+
+Two bug classes this repo has shipped:
+
+- **task-retention** — ``asyncio.create_task`` / ``ensure_future``
+  results discarded at statement level. asyncio holds tasks by WEAK
+  reference; a discarded task can be garbage-collected mid-flight and
+  silently vanish (the PR7 round-3 gossip fire-and-forget bug — fixed
+  by holding them in a strong-ref set). The result must be bound,
+  awaited, or added to a held collection.
+- **async-hygiene** — blocking calls inside ``async def`` in
+  ``tendermint_tpu/``: ``time.sleep`` freezes every peer connection,
+  consensus timer and RPC handler on the loop (the PR4 review rule
+  that produced ``faults.maybe_async``); ``Future.result()`` can
+  deadlock the loop against its own executor; a blocking
+  ``queue.get()`` with no timeout can hang a coroutine forever; and
+  ``subprocess`` calls stall the loop for the child's lifetime.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List, Set
+
+from tendermint_tpu.analysis.core import (
+    FileContext,
+    Project,
+    Rule,
+    Violation,
+    register,
+)
+
+_SPAWNERS = {"create_task", "ensure_future"}
+
+
+def _call_name(node: ast.Call) -> str:
+    fn = node.func
+    if isinstance(fn, ast.Attribute):
+        return fn.attr
+    if isinstance(fn, ast.Name):
+        return fn.id
+    return ""
+
+
+def _is_task_spawn(node: ast.Call) -> bool:
+    """asyncio.create_task(...) / loop.create_task(...) /
+    asyncio.ensure_future(...) / bare create_task/ensure_future."""
+    return _call_name(node) in _SPAWNERS
+
+
+class TaskRetention(Rule):
+    name = "task-retention"
+    summary = (
+        "create_task/ensure_future results must be bound or held — "
+        "asyncio keeps tasks by weak reference"
+    )
+
+    def check_file(self, ctx: FileContext, project: Project) -> Iterable[Violation]:
+        if ctx.tree is None:
+            return
+        for node in ctx.nodes:
+            if (
+                isinstance(node, ast.Expr)
+                and isinstance(node.value, ast.Call)
+                and _is_task_spawn(node.value)
+            ):
+                yield Violation(
+                    self.name, ctx.rel, node.lineno,
+                    f"{_call_name(node.value)}() result discarded — the task can "
+                    "be garbage-collected mid-flight; bind it or add it to a "
+                    "held collection (with add_done_callback(discard))",
+                    node.col_offset,
+                )
+
+
+_SUBPROCESS_FNS = {"run", "Popen", "check_output", "check_call", "call"}
+
+
+class _AsyncVisitor(ast.NodeVisitor):
+    def __init__(self, rule_name: str, ctx: FileContext):
+        self.rule = rule_name
+        self.ctx = ctx
+        self.violations: List[Violation] = []
+        self._async_depth = 0
+        self._awaited: Set[int] = set()
+
+    # -- function scoping --------------------------------------------------
+
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        depth, self._async_depth = self._async_depth, 0
+        self.generic_visit(node)
+        self._async_depth = depth
+
+    def visit_AsyncFunctionDef(self, node: ast.AsyncFunctionDef) -> None:
+        self._async_depth += 1
+        self.generic_visit(node)
+        self._async_depth -= 1
+
+    _WRAPPERS = {
+        "ensure_future", "create_task", "gather", "wait", "wait_for",
+        "shield", "run_coroutine_threadsafe",
+    }
+
+    def visit_Await(self, node: ast.Await) -> None:
+        if isinstance(node.value, ast.Call):
+            self._awaited.add(id(node.value))
+        self.generic_visit(node)
+
+    def _mark_wrapped(self, node: ast.Call) -> None:
+        """Calls handed to ensure_future/gather/... are coroutine
+        factories, not blocking calls — asyncio.Queue.get() wrapped in
+        ensure_future is the select-style idiom, not a hang."""
+        if _call_name(node) in self._WRAPPERS:
+            for arg in list(node.args) + [kw.value for kw in node.keywords]:
+                if isinstance(arg, ast.Call):
+                    self._awaited.add(id(arg))
+
+    # -- the checks --------------------------------------------------------
+
+    def _flag(self, node: ast.AST, msg: str) -> None:
+        self.violations.append(
+            Violation(self.rule, self.ctx.rel, node.lineno, msg, node.col_offset)
+        )
+
+    def visit_Call(self, node: ast.Call) -> None:
+        self._mark_wrapped(node)
+        if self._async_depth > 0 and id(node) not in self._awaited:
+            fn = node.func
+            if isinstance(fn, ast.Attribute):
+                base = fn.value
+                base_name = (
+                    base.id if isinstance(base, ast.Name)
+                    else base.attr if isinstance(base, ast.Attribute)
+                    else ""
+                )
+                if fn.attr == "sleep" and base_name == "time":
+                    self._flag(
+                        node,
+                        "time.sleep() inside async def blocks the whole event "
+                        "loop — use await asyncio.sleep()",
+                    )
+                elif base_name == "subprocess" and fn.attr in _SUBPROCESS_FNS:
+                    self._flag(
+                        node,
+                        f"subprocess.{fn.attr}() inside async def blocks the loop "
+                        "for the child's lifetime — use asyncio.create_subprocess_*",
+                    )
+                elif fn.attr == "result" and not node.args and not node.keywords:
+                    self._flag(
+                        node,
+                        ".result() inside async def can block the event loop on "
+                        "an unresolved future — await it (or wrap_future) instead",
+                    )
+                elif fn.attr == "get" and self._queueish(base_name):
+                    if not self._nonblocking_get(node):
+                        self._flag(
+                            node,
+                            f"{base_name}.get() with no timeout inside async def "
+                            "can hang the loop — pass timeout= or use an "
+                            "asyncio.Queue and await",
+                        )
+        self.generic_visit(node)
+
+    @staticmethod
+    def _queueish(name: str) -> bool:
+        # "queue" must appear in the name: short names like `q` are as
+        # often dicts (parse_qs) as queues, and a wrong flag here costs
+        # more trust than the missed corner earns
+        return "queue" in name.lower()
+
+    @staticmethod
+    def _nonblocking_get(node: ast.Call) -> bool:
+        for kw in node.keywords:
+            if kw.arg == "timeout":
+                return True
+            if kw.arg == "block" and isinstance(kw.value, ast.Constant):
+                return kw.value.value is False
+        if node.args and isinstance(node.args[0], ast.Constant):
+            return node.args[0].value is False  # get(False) = non-blocking
+        return False
+
+
+class AsyncHygiene(Rule):
+    name = "async-hygiene"
+    summary = (
+        "no time.sleep / blocking Future.result() / no-timeout queue.get / "
+        "subprocess calls inside async def in tendermint_tpu/"
+    )
+
+    def check_file(self, ctx: FileContext, project: Project) -> Iterable[Violation]:
+        if ctx.tree is None or not ctx.in_package:
+            return ()
+        v = _AsyncVisitor(self.name, ctx)
+        v.visit(ctx.tree)
+        return v.violations
+
+
+register(TaskRetention())
+register(AsyncHygiene())

@@ -1,0 +1,166 @@
+"""config-coherence: every config read resolves, every TM_* knob is
+documented.
+
+Two drift classes with the same shape — code reading configuration
+that nothing defines:
+
+- ``config.<section>.<key>`` reads anywhere in ``tendermint_tpu/``
+  must name a real field (or helper method) of that section's
+  dataclass in ``config/config.py``. A typo'd key raises
+  AttributeError only on the code path that reads it, which for ops
+  knobs is usually a production incident, not a test failure.
+- every ``TM_*`` environment variable the package reads
+  (``os.environ.get`` / ``os.environ[...]`` / ``os.getenv``) must be
+  documented somewhere under ``docs/`` or in README.md — an
+  undocumented kill switch might as well not exist, and PR5's
+  re-anchor review found nine of them.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, Iterable, Optional, Set
+
+from tendermint_tpu.analysis.core import (
+    FileContext,
+    Project,
+    Rule,
+    Violation,
+    register,
+)
+
+_CONFIG_MODULE = "tendermint_tpu/config/config.py"
+_CONFIG_RECEIVERS = {"config", "cfg", "conf", "_config", "_cfg"}
+_ENV_DOC_SOURCES = ("docs", "README.md")
+_ENV_RE = re.compile(r"^TM_[A-Z0-9_]+$")
+
+
+def _dataclass_surface(cls: ast.ClassDef) -> Set[str]:
+    """Field names + method names of a config dataclass."""
+    out: Set[str] = set()
+    for item in cls.body:
+        if isinstance(item, ast.AnnAssign) and isinstance(item.target, ast.Name):
+            out.add(item.target.id)
+        elif isinstance(item, ast.Assign):
+            for t in item.targets:
+                if isinstance(t, ast.Name):
+                    out.add(t.id)
+        elif isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            out.add(item.name)
+    return out
+
+
+class ConfigCoherence(Rule):
+    name = "config-coherence"
+    summary = (
+        "config.<section>.<key> reads must exist in config/config.py; "
+        "TM_* env reads must be documented in docs/ or README"
+    )
+
+    def _sections(self, project: Project) -> Dict[str, Set[str]]:
+        """section attr ('base', 'rpc', ...) -> legal key names, derived
+        from the Config dataclass's annotated fields."""
+        ctx = project.by_rel.get(_CONFIG_MODULE)
+        if ctx is None or ctx.tree is None:
+            return {}
+        classes: Dict[str, ast.ClassDef] = {
+            n.name: n for n in ast.walk(ctx.tree) if isinstance(n, ast.ClassDef)
+        }
+        cfg = classes.get("Config")
+        if cfg is None:
+            return {}
+        sections: Dict[str, Set[str]] = {}
+        for item in cfg.body:
+            if (
+                isinstance(item, ast.AnnAssign)
+                and isinstance(item.target, ast.Name)
+                and isinstance(item.annotation, ast.Name)
+                and item.annotation.id in classes
+            ):
+                sections[item.target.id] = _dataclass_surface(
+                    classes[item.annotation.id]
+                )
+        return sections
+
+    def check_project(self, project: Project) -> Iterable[Violation]:
+        sections = self._sections(project)
+        if sections:
+            for ctx in project.files:
+                if ctx.tree is None or not ctx.in_package:
+                    continue
+                if ctx.rel == _CONFIG_MODULE:
+                    continue
+                yield from self._check_reads(ctx, sections)
+        yield from self._check_env(project)
+
+    # -- config.<section>.<key> --------------------------------------------
+
+    def _check_reads(
+        self, ctx: FileContext, sections: Dict[str, Set[str]]
+    ) -> Iterable[Violation]:
+        for node in ctx.nodes:
+            if not isinstance(node, ast.Attribute):
+                continue
+            sec_attr = node.value
+            if not (
+                isinstance(sec_attr, ast.Attribute) and sec_attr.attr in sections
+            ):
+                continue
+            recv = sec_attr.value
+            recv_name = (
+                recv.id if isinstance(recv, ast.Name)
+                else recv.attr if isinstance(recv, ast.Attribute)
+                else ""
+            )
+            if recv_name not in _CONFIG_RECEIVERS:
+                continue
+            if node.attr not in sections[sec_attr.attr]:
+                yield Violation(
+                    self.name, ctx.rel, node.lineno,
+                    f"config read `.{sec_attr.attr}.{node.attr}` has no matching "
+                    f"field/method on the [{sec_attr.attr}] section in "
+                    "config/config.py — AttributeError waiting on this code path",
+                    node.col_offset,
+                )
+
+    # -- TM_* env vars -------------------------------------------------------
+
+    def _check_env(self, project: Project) -> Iterable[Violation]:
+        docs = project.docs_text(*_ENV_DOC_SOURCES)
+        for ctx in project.files:
+            if ctx.tree is None or not ctx.in_package:
+                continue
+            for node in ctx.nodes:
+                var = self._env_read(node)
+                if var and _ENV_RE.match(var) and var not in docs:
+                    yield Violation(
+                        self.name, ctx.rel, node.lineno,
+                        f"env var {var} is read here but documented nowhere under "
+                        "docs/ or README.md — an undocumented ops knob",
+                        node.col_offset,
+                    )
+
+    @staticmethod
+    def _env_read(node: ast.AST) -> Optional[str]:
+        """The TM_* name when `node` reads an environment variable."""
+        def lit(e: ast.expr) -> Optional[str]:
+            return e.value if isinstance(e, ast.Constant) and isinstance(e.value, str) else None
+
+        if isinstance(node, ast.Call) and node.args:
+            f = node.func
+            # os.environ.get("X") / os.getenv("X")
+            if isinstance(f, ast.Attribute) and f.attr == "get":
+                base = f.value
+                if isinstance(base, ast.Attribute) and base.attr == "environ":
+                    return lit(node.args[0])
+            if isinstance(f, ast.Attribute) and f.attr == "getenv":
+                return lit(node.args[0])
+        if isinstance(node, ast.Subscript) and isinstance(node.ctx, ast.Load):
+            base = node.value
+            if isinstance(base, ast.Attribute) and base.attr == "environ":
+                return lit(node.slice)
+        return None
+
+
+register(ConfigCoherence())

@@ -1,0 +1,116 @@
+"""unused-import and unreachable-code: pure-deletion dead code.
+
+Not style policing — both patterns have bitten this repo's reviews:
+an import kept "for later" hides a real dependency edge from the
+import-graph (and from the --changed fast path), and statements after
+an unconditional return/raise are usually a refactor leftover that
+silently stopped running.
+
+``unused-import`` is deliberately conservative: a name counts as used
+if it is loaded anywhere in the module (including as an attribute
+root) OR appears inside any string literal (string annotations,
+``__all__``, doctests). ``__init__.py`` files are skipped wholesale —
+re-export is their job.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Iterable, List, Set, Tuple
+
+from tendermint_tpu.analysis.core import (
+    FileContext,
+    Project,
+    Rule,
+    Violation,
+    register,
+)
+
+
+def _bindings(node: ast.stmt) -> Iterable[Tuple[str, str]]:
+    """(bound-name, display-name) for an import statement."""
+    if isinstance(node, ast.Import):
+        for alias in node.names:
+            if alias.asname:
+                yield alias.asname, alias.name
+            else:
+                yield alias.name.split(".")[0], alias.name
+    elif isinstance(node, ast.ImportFrom):
+        if node.module == "__future__":
+            return
+        for alias in node.names:
+            if alias.name == "*":
+                continue
+            yield alias.asname or alias.name, f"{node.module or ''}.{alias.name}"
+
+
+class UnusedImport(Rule):
+    name = "unused-import"
+    summary = "imported names must be used (string literals count; __init__.py exempt)"
+
+    def check_file(self, ctx: FileContext, project: Project) -> Iterable[Violation]:
+        if ctx.tree is None or ctx.rel.endswith("__init__.py"):
+            return ()
+        loaded: Set[str] = set()
+        strings: List[str] = []
+        import_nodes: List[ast.stmt] = []
+        for node in ctx.nodes:
+            if isinstance(node, (ast.Import, ast.ImportFrom)):
+                import_nodes.append(node)
+            elif isinstance(node, ast.Name) and not isinstance(node.ctx, ast.Store):
+                loaded.add(node.id)
+            elif isinstance(node, ast.Constant) and isinstance(node.value, str):
+                strings.append(node.value)
+            elif isinstance(node, ast.Global):
+                loaded.update(node.names)
+        blob = "\n".join(strings)
+        out: List[Violation] = []
+        for node in import_nodes:
+            for bound, display in _bindings(node):
+                if bound in loaded:
+                    continue
+                if re.search(rf"\b{re.escape(bound)}\b", blob):
+                    continue  # string annotation / __all__ / doc usage
+                out.append(
+                    Violation(
+                        self.name, ctx.rel, node.lineno,
+                        f"`{display}` imported as `{bound}` but never used",
+                        node.col_offset,
+                    )
+                )
+        return out
+
+
+_TERMINATORS = (ast.Return, ast.Raise, ast.Break, ast.Continue)
+
+
+class UnreachableCode(Rule):
+    name = "unreachable-code"
+    summary = "statements after an unconditional return/raise/break/continue"
+
+    def check_file(self, ctx: FileContext, project: Project) -> Iterable[Violation]:
+        if ctx.tree is None:
+            return ()
+        out: List[Violation] = []
+        for node in ctx.nodes:
+            for field in ("body", "orelse", "finalbody"):
+                block = getattr(node, field, None)
+                if not isinstance(block, list):
+                    continue
+                for stmt, nxt in zip(block, block[1:]):
+                    if isinstance(stmt, _TERMINATORS):
+                        out.append(
+                            Violation(
+                                self.name, ctx.rel, nxt.lineno,
+                                f"unreachable: the {type(stmt).__name__.lower()} on "
+                                f"line {stmt.lineno} always exits this block first",
+                                nxt.col_offset,
+                            )
+                        )
+                        break  # one report per block
+        return out
+
+
+register(UnusedImport())
+register(UnreachableCode())

@@ -1,0 +1,43 @@
+"""metrics-exposition: the Prometheus scrape-format lint as a
+registry rule.
+
+The validator itself lives in
+:mod:`tendermint_tpu.analysis.metrics_exposition` (it predates tmlint
+as ``scripts/check_metrics.py`` and keeps that CLI as a wrapper).
+This rule adapts it to the registry so it shares the suppression/
+reporting machinery and the ``--list-rules`` catalog: it has no
+source-file surface (Python ASTs aren't expositions) but is invoked
+with a scraped or rendered /metrics body via :meth:`check_text` —
+``scripts/tmlint.py --scrape URL`` and tests/test_check_metrics.py
+both route through here.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import List
+
+from tendermint_tpu.analysis import metrics_exposition
+from tendermint_tpu.analysis.core import Rule, Violation, register
+
+_LINE_RE = re.compile(r"line (\d+)")
+
+
+class MetricsExposition(Rule):
+    name = "metrics-exposition"
+    summary = (
+        "Prometheus text-format exposition is strict-scraper clean "
+        "(HELP/TYPE pairing, label escapes, histogram monotonicity)"
+    )
+
+    def check_text(self, text: str, source: str = "<metrics>") -> List[Violation]:
+        out: List[Violation] = []
+        for err in metrics_exposition.validate_metrics_text(text):
+            m = _LINE_RE.search(err)
+            out.append(
+                Violation(self.name, source, int(m.group(1)) if m else 1, err)
+            )
+        return out
+
+
+register(MetricsExposition())

@@ -1,0 +1,136 @@
+"""fault-site-coherence: the chaos-site taxonomy stays closed.
+
+Encodes the PR4 discipline (utils/faultinject.py, docs/robustness.md):
+every string literal passed to ``faults.maybe`` / ``maybe_async`` /
+``tear`` must name a registered KNOWN_SITES entry (a typo'd site is
+silently inert chaos config); every KNOWN_SITES entry must have at
+least one call point somewhere in ``tendermint_tpu/`` (an armed site
+nobody calls never fires); and ``tear`` call points may only consume
+TEAR_SITES (the round-3 review rule — a ``tear`` spec on a site whose
+caller never writes a truncated prefix is vacuous). This makes the
+dynamic call-point test in tests/test_faultinject.py a static check
+that runs on every file, not just the armed ones.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Set, Tuple
+
+from tendermint_tpu.analysis.core import (
+    FileContext,
+    Project,
+    Rule,
+    Violation,
+    register,
+)
+from tendermint_tpu.utils.faultinject import KNOWN_SITES, TEAR_SITES
+
+_ENTRYPOINTS = ("maybe", "maybe_async", "tear")
+# module aliases the repo uses for utils.faultinject; a bare-name call
+# (``from faultinject import maybe``) also counts via the import scan
+_MODULE_ALIASES = {"faults", "faultinject", "_faults"}
+
+
+def _fault_calls(ctx: FileContext) -> Iterable[Tuple[str, ast.Call]]:
+    """(entrypoint, call) for every faults.maybe/maybe_async/tear call.
+    The entrypoint is the ORIGINAL name even under an import alias
+    (``from ... import tear as t``) — the tear/TEAR_SITES check must
+    not be dodgeable by renaming."""
+    imported: Dict[str, str] = {}  # local alias -> original entrypoint
+    for node in ctx.nodes:
+        if isinstance(node, ast.ImportFrom) and node.module and node.module.endswith(
+            "faultinject"
+        ):
+            for alias in node.names:
+                if alias.name in _ENTRYPOINTS:
+                    imported[alias.asname or alias.name] = alias.name
+    for node in ctx.nodes:
+        if not isinstance(node, ast.Call):
+            continue
+        fn = node.func
+        if (
+            isinstance(fn, ast.Attribute)
+            and fn.attr in _ENTRYPOINTS
+            and isinstance(fn.value, ast.Name)
+            and fn.value.id in _MODULE_ALIASES
+        ):
+            yield fn.attr, node
+        elif isinstance(fn, ast.Name) and fn.id in imported:
+            yield imported[fn.id], node
+
+
+class FaultSiteCoherence(Rule):
+    name = "fault-site-coherence"
+    summary = (
+        "faults.maybe/maybe_async/tear sites must be registered in "
+        "KNOWN_SITES (tear: TEAR_SITES), and every registered site "
+        "must have a call point"
+    )
+
+    def check_file(self, ctx: FileContext, project: Project) -> Iterable[Violation]:
+        if ctx.tree is None:
+            return
+        for entry, call in _fault_calls(ctx):
+            if not call.args:
+                continue
+            site_arg = call.args[0]
+            if not (isinstance(site_arg, ast.Constant) and isinstance(site_arg.value, str)):
+                # dynamic site names (chaos plans iterating KNOWN_SITES)
+                # are the registry's own concern, not a literal typo
+                continue
+            site = site_arg.value
+            if site not in KNOWN_SITES:
+                yield Violation(
+                    self.name, ctx.rel, call.lineno,
+                    f"fault site {site!r} is not in KNOWN_SITES "
+                    "(utils/faultinject.py) — a typo here is silently inert chaos",
+                    call.col_offset,
+                )
+            elif entry == "tear" and site not in TEAR_SITES:
+                yield Violation(
+                    self.name, ctx.rel, call.lineno,
+                    f"faults.tear({site!r}): site is not in TEAR_SITES — "
+                    "register it there with this call point, or use maybe()",
+                    call.col_offset,
+                )
+
+    def check_project(self, project: Project) -> Iterable[Violation]:
+        # coverage: every registered site has >= 1 literal call point in
+        # package code (tests arming a dead site would never fire it)
+        called: Dict[str, Set[str]] = {}
+        for ctx in project.files:
+            if ctx.tree is None or not ctx.in_package:
+                continue
+            for entry, call in _fault_calls(ctx):
+                if call.args and isinstance(call.args[0], ast.Constant) and isinstance(
+                    call.args[0].value, str
+                ):
+                    called.setdefault(call.args[0].value, set()).add(entry)
+        anchor = project.by_rel.get("tendermint_tpu/utils/faultinject.py")
+        anchor_rel = anchor.rel if anchor else "tendermint_tpu/utils/faultinject.py"
+        lines: List[str] = anchor.lines if anchor else []
+
+        def _site_line(site: str) -> int:
+            for i, text in enumerate(lines, 1):
+                if f'"{site}"' in text:
+                    return i
+            return 1
+
+        for site in KNOWN_SITES:
+            if site not in called:
+                yield Violation(
+                    self.name, anchor_rel, _site_line(site),
+                    f"KNOWN_SITES entry {site!r} has no faults.maybe/maybe_async/"
+                    "tear call point in tendermint_tpu/ — arming it does nothing",
+                )
+        for site in TEAR_SITES:
+            if "tear" not in called.get(site, set()):
+                yield Violation(
+                    self.name, anchor_rel, _site_line(site),
+                    f"TEAR_SITES entry {site!r} has no faults.tear() call point — "
+                    "a tear spec on it is vacuous chaos config",
+                )
+
+
+register(FaultSiteCoherence())

@@ -1,0 +1,97 @@
+"""no-permanent-latch: failure flags must heal.
+
+The PR4 anti-latch rule: before the watchdog work, the device engines
+carried ``self.failed = True`` latches — one transient compile error
+and the engine never touched the device again for the process
+lifetime. PR4 replaced every one with a :class:`CircuitBreaker`
+(closed/open/half-open with a recovery probe). This rule keeps it
+that way: an assignment of ``True`` to an attribute whose name ends in
+``failed`` is only legal where a breaker governs the recovery — i.e.
+inside a class whose body references ``CircuitBreaker`` (constructs
+one, or names one in an attribute). Anywhere else it is a permanent
+latch and flags.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List, Optional
+
+from tendermint_tpu.analysis.core import (
+    FileContext,
+    Project,
+    Rule,
+    Violation,
+    register,
+)
+
+
+def _class_mentions_breaker(cls: ast.ClassDef) -> bool:
+    for node in ast.walk(cls):
+        if isinstance(node, ast.Name) and node.id == "CircuitBreaker":
+            return True
+        if isinstance(node, ast.Attribute) and node.attr == "CircuitBreaker":
+            return True
+        if isinstance(node, ast.Attribute) and "breaker" in node.attr.lower():
+            return True
+    return False
+
+
+class NoPermanentLatch(Rule):
+    name = "no-permanent-latch"
+    summary = (
+        "`*.failed = True` latches are only allowed in CircuitBreaker-"
+        "bearing classes — everything else must use a breaker"
+    )
+
+    def check_file(self, ctx: FileContext, project: Project) -> Iterable[Violation]:
+        if ctx.tree is None or not ctx.in_package:
+            return ()
+        out: List[Violation] = []
+        self._scan(ctx, ctx.tree, None, out)
+        return out
+
+    def _scan(
+        self,
+        ctx: FileContext,
+        node: ast.AST,
+        cls: Optional[ast.ClassDef],
+        out: List[Violation],
+    ) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.ClassDef):
+                self._scan(ctx, child, child, out)
+                continue
+            if isinstance(child, ast.Assign):
+                self._check_assign(ctx, child, cls, out)
+            self._scan(ctx, child, cls, out)
+
+    def _check_assign(
+        self,
+        ctx: FileContext,
+        node: ast.Assign,
+        cls: Optional[ast.ClassDef],
+        out: List[Violation],
+    ) -> None:
+        if not (isinstance(node.value, ast.Constant) and node.value.value is True):
+            return
+        for target in node.targets:
+            if not isinstance(target, ast.Attribute):
+                continue
+            if not target.attr.lower().endswith("failed"):
+                continue
+            if cls is not None and _class_mentions_breaker(cls):
+                continue  # breaker-governed: a half-open probe can heal it
+            where = f"class {cls.name}" if cls is not None else "module scope"
+            out.append(
+                Violation(
+                    self.name, ctx.rel, node.lineno,
+                    f".{target.attr} = True in {where} with no CircuitBreaker in "
+                    "sight — a permanent failure latch (the PR4 anti-latch rule); "
+                    "gate the path with utils/watchdog.CircuitBreaker instead",
+                    node.col_offset,
+                )
+            )
+
+
+register(NoPermanentLatch())

@@ -1,0 +1,185 @@
+"""jit-purity: traced functions stay pure.
+
+Functions handed to ``jax.jit`` (directly, via decorator, or through
+the AotJit wrapper in ``models/aot_cache.py``) execute ONCE at trace
+time; host-side effects inside them are silently baked into the
+compiled executable. A ``time.time()`` timestamp freezes at compile
+time, ``random.random()`` becomes a compile-time constant,
+``hashlib`` digests of traced arrays raise — and a ``global`` write
+means the function's output depends on state XLA can't see, so the
+executable cache (keyed by shapes, docs/merkle-acceleration.md) can
+serve stale results. This rule resolves every jitted callable to its
+definition (same module or across the ``ops``/``models`` import
+graph), closes over same-module helpers it calls, and flags
+``time.* / random.* / hashlib.* / secrets.*`` calls and ``global``
+statements inside the traced closure.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from tendermint_tpu.analysis.core import (
+    FileContext,
+    Project,
+    Rule,
+    Violation,
+    register,
+)
+
+_IMPURE_MODULES = {"time", "random", "hashlib", "secrets"}
+
+
+def _import_aliases(nodes) -> Dict[str, str]:
+    """local alias -> dotted module for project-module imports."""
+    out: Dict[str, str] = {}
+    for node in nodes:
+        if isinstance(node, ast.Import):
+            for alias in node.names:
+                out[alias.asname or alias.name.split(".")[0]] = alias.name
+        elif isinstance(node, ast.ImportFrom) and node.module:
+            for alias in node.names:
+                out[alias.asname or alias.name] = f"{node.module}.{alias.name}"
+    return out
+
+
+def _is_jit_callable(fn: ast.expr) -> bool:
+    """jax.jit / bare jit (imported from jax)."""
+    if isinstance(fn, ast.Attribute) and fn.attr == "jit":
+        return isinstance(fn.value, ast.Name) and fn.value.id == "jax"
+    return isinstance(fn, ast.Name) and fn.id == "jit"
+
+
+def _jitted_targets(nodes) -> Iterable[Tuple[ast.expr, int]]:
+    """(callable-expression, line) for everything passed to jax.jit."""
+    for node in nodes:
+        if isinstance(node, ast.Call) and _is_jit_callable(node.func) and node.args:
+            yield node.args[0], node.lineno
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for dec in node.decorator_list:
+                if _is_jit_callable(dec):
+                    yield ast.Name(id=node.name, lineno=node.lineno, col_offset=0), node.lineno
+                elif (
+                    isinstance(dec, ast.Call)
+                    and isinstance(dec.func, (ast.Name, ast.Attribute))
+                    and (
+                        (isinstance(dec.func, ast.Name) and dec.func.id == "partial")
+                        or (isinstance(dec.func, ast.Attribute) and dec.func.attr == "partial")
+                    )
+                    and dec.args
+                    and _is_jit_callable(dec.args[0])
+                ):
+                    yield ast.Name(id=node.name, lineno=node.lineno, col_offset=0), node.lineno
+
+
+def _top_level_functions(nodes) -> Dict[str, ast.FunctionDef]:
+    out: Dict[str, ast.FunctionDef] = {}
+    for node in nodes:
+        if isinstance(node, ast.FunctionDef):
+            out.setdefault(node.name, node)
+    return out
+
+
+class JitPurity(Rule):
+    name = "jit-purity"
+    summary = (
+        "functions traced by jax.jit must not call time/random/hashlib/"
+        "secrets or write globals — effects freeze into the executable"
+    )
+
+    def check_project(self, project: Project) -> Iterable[Violation]:
+        # function index per module (ops/models call across modules:
+        # models/hasher.py jits ops/sha256.py kernels)
+        fns_by_module: Dict[str, Dict[str, ast.FunctionDef]] = {}
+        for ctx in project.files:
+            if ctx.tree is not None and ctx.in_package:
+                fns_by_module[ctx.module_name()] = _top_level_functions(ctx.nodes)
+
+        checked: Set[Tuple[str, str]] = set()
+        for ctx in project.files:
+            if ctx.tree is None or not ctx.in_package:
+                continue
+            aliases = _import_aliases(ctx.nodes)
+            for target, line in _jitted_targets(ctx.nodes):
+                resolved = self._resolve(target, ctx, aliases, fns_by_module, project)
+                if resolved is None:
+                    continue
+                def_ctx, fn = resolved
+                key = (def_ctx.rel, fn.name)
+                if key in checked:
+                    continue
+                checked.add(key)
+                yield from self._check_closure(def_ctx, fn, fns_by_module, project)
+
+    def _resolve(
+        self,
+        target: ast.expr,
+        ctx: FileContext,
+        aliases: Dict[str, str],
+        fns_by_module: Dict[str, Dict[str, ast.FunctionDef]],
+        project: Project,
+    ) -> Optional[Tuple[FileContext, ast.FunctionDef]]:
+        mod = ctx.module_name()
+        if isinstance(target, ast.Name):
+            fn = fns_by_module.get(mod, {}).get(target.id)
+            if fn is not None:
+                return ctx, fn
+            dotted = aliases.get(target.id)
+            if dotted and "." in dotted:
+                owner, name = dotted.rsplit(".", 1)
+                fn = fns_by_module.get(owner, {}).get(name)
+                owner_ctx = project.by_module.get(owner)
+                if fn is not None and owner_ctx is not None:
+                    return owner_ctx, fn
+        elif isinstance(target, ast.Attribute) and isinstance(target.value, ast.Name):
+            owner = aliases.get(target.value.id, "")
+            fn = fns_by_module.get(owner, {}).get(target.attr)
+            owner_ctx = project.by_module.get(owner)
+            if fn is not None and owner_ctx is not None:
+                return owner_ctx, fn
+        return None
+
+    def _check_closure(
+        self,
+        ctx: FileContext,
+        root: ast.FunctionDef,
+        fns_by_module: Dict[str, Dict[str, ast.FunctionDef]],
+        project: Project,
+    ) -> Iterable[Violation]:
+        module_fns = fns_by_module.get(ctx.module_name(), {})
+        seen: Set[str] = set()
+        queue: List[ast.FunctionDef] = [root]
+        while queue:
+            fn = queue.pop()
+            if fn.name in seen:
+                continue
+            seen.add(fn.name)
+            for node in ast.walk(fn):
+                if isinstance(node, ast.Global):
+                    yield Violation(
+                        self.name, ctx.rel, node.lineno,
+                        f"`global` write inside jitted function {root.name}() "
+                        f"(via {fn.name}) — traced output would depend on host "
+                        "state XLA can't see",
+                        node.col_offset,
+                    )
+                elif isinstance(node, ast.Call):
+                    f = node.func
+                    if (
+                        isinstance(f, ast.Attribute)
+                        and isinstance(f.value, ast.Name)
+                        and f.value.id in _IMPURE_MODULES
+                    ):
+                        yield Violation(
+                            self.name, ctx.rel, node.lineno,
+                            f"{f.value.id}.{f.attr}() inside jitted function "
+                            f"{root.name}() (via {fn.name}) — evaluated once at "
+                            "trace time and baked into the executable",
+                            node.col_offset,
+                        )
+                    elif isinstance(f, ast.Name) and f.id in module_fns:
+                        queue.append(module_fns[f.id])
+
+
+register(JitPurity())

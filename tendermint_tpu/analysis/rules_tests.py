@@ -1,0 +1,118 @@
+"""slow-marker: live-consensus tests declare their cost.
+
+Tier-1 runs ``-m 'not slow'`` under a hard wall-clock budget
+(ROADMAP.md). A test that starts a live consensus net —
+``cs_harness.start_network`` (N consensus states actually started and
+committing) or a ``tests/persist_node.py`` child process — costs
+seconds of real block production; unmarked, it silently eats the
+budget of every fast test behind it. The repo's convention (PR1
+registered the marker) is that every such test carries
+``@pytest.mark.slow``; this rule makes the convention load-bearing.
+
+Helpers that merely BUILD consensus objects (``make_genesis``,
+``make_node``, ``wire_loopback``) are fine — they don't start block
+production on their own, and single-node ``make_node`` + ``cs.start``
+tests are bounded by their own height targets (the chaos suite relies
+on running inside tier-1). The rule draws the line at whole-net
+``start_network`` fan-outs and child-process nodes.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List
+
+from tendermint_tpu.analysis.core import (
+    FileContext,
+    Project,
+    Rule,
+    Violation,
+    register,
+)
+
+_LIVE_MARKERS = ("start_network",)
+_RUNNER_FRAGMENT = "persist_node"
+
+
+def _is_slow_decorator(dec: ast.expr) -> bool:
+    """pytest.mark.slow (possibly called, possibly aliased as mark.slow)."""
+    node = dec.func if isinstance(dec, ast.Call) else dec
+    return isinstance(node, ast.Attribute) and node.attr == "slow"
+
+
+def _module_marked_slow(tree: ast.AST) -> bool:
+    for node in ast.walk(tree):
+        if (
+            isinstance(node, ast.Assign)
+            and any(
+                isinstance(t, ast.Name) and t.id == "pytestmark" for t in node.targets
+            )
+        ):
+            if "slow" in ast.dump(node.value):
+                return True
+    return False
+
+
+def _runner_aliases(tree: ast.AST) -> set:
+    """Module-level names bound to a persist_node path (RUNNER = ...)."""
+    out = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign) and _RUNNER_FRAGMENT in ast.dump(node.value):
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    out.add(t.id)
+    return out
+
+
+def _starts_live_node(fn: ast.AST, runner_aliases: set) -> bool:
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Name) and (
+            node.id in _LIVE_MARKERS or node.id in runner_aliases
+        ):
+            return True
+        if isinstance(node, ast.Attribute) and node.attr in _LIVE_MARKERS:
+            return True
+        if (
+            isinstance(node, ast.Constant)
+            and isinstance(node.value, str)
+            and _RUNNER_FRAGMENT in node.value
+        ):
+            return True
+    return False
+
+
+class SlowMarker(Rule):
+    name = "slow-marker"
+    summary = (
+        "tests that start a live consensus net (start_network / "
+        "persist_node) must carry @pytest.mark.slow"
+    )
+
+    def check_file(self, ctx: FileContext, project: Project) -> Iterable[Violation]:
+        if ctx.tree is None or not ctx.is_test:
+            return ()
+        if _module_marked_slow(ctx.tree):
+            return ()
+        runner_aliases = _runner_aliases(ctx.tree)
+        out: List[Violation] = []
+        for node in ctx.nodes:
+            if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            if not node.name.startswith("test"):
+                continue
+            if any(_is_slow_decorator(d) for d in node.decorator_list):
+                continue
+            if _starts_live_node(node, runner_aliases):
+                out.append(
+                    Violation(
+                        self.name, ctx.rel, node.lineno,
+                        f"{node.name} starts a live consensus node "
+                        "(start_network/persist_node) without @pytest.mark.slow — "
+                        "it eats the tier-1 wall-clock budget",
+                        node.col_offset,
+                    )
+                )
+        return out
+
+
+register(SlowMarker())

@@ -1,0 +1,236 @@
+"""bound-method-truthiness: a method referenced without call in a
+condition or comparison.
+
+The PR7 round-8 bug, verbatim: the TxKeyHasher breaker guard read
+
+    if self.compile_breaker.state != "closed":   # ALWAYS TRUE
+
+comparing the bound method object to a string instead of calling it —
+the guard fired on every bundle. A bound method is always truthy and
+never equal to a constant, so any un-called method reference in an
+``if``/``while``/``assert`` test, boolean op, ``not``, ternary test or
+comparison is a bug, not a style choice.
+
+Detection is type-tracked, not name-matched (``fsm.state == S_DONE``
+on a plain data attribute must NOT flag): a receiver's class is known
+when (a) it is ``self`` inside the class, (b) it was assigned from a
+constructor call of a class defined in the lint set (``x = Foo()``,
+``self._b = CircuitBreaker(...)``), or (c) it carries an annotation
+naming such a class. Only then is ``recv.name`` checked against that
+class's real methods (properties excluded — they are data on access).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Optional
+
+from tendermint_tpu.analysis.core import (
+    ClassInfo,
+    FileContext,
+    Project,
+    Rule,
+    Violation,
+    register,
+)
+
+
+def _receiver_path(node: ast.expr) -> Optional[str]:
+    """'x' for Name, 'self.attr' / 'a.b' for one-level Attribute."""
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute) and isinstance(node.value, ast.Name):
+        return f"{node.value.id}.{node.attr}"
+    return None
+
+
+def _constructor_class(value: ast.expr, project: Project) -> Optional[ClassInfo]:
+    """ClassInfo when `value` is a call to a class defined (uniquely)
+    in the lint set: Foo(...) or mod.Foo(...)."""
+    if not isinstance(value, ast.Call):
+        return None
+    fn = value.func
+    name = fn.id if isinstance(fn, ast.Name) else fn.attr if isinstance(fn, ast.Attribute) else None
+    if not name or not name[:1].isupper():
+        return None
+    return project.unique_class(name)
+
+
+def _annotation_class(ann: ast.expr, project: Project) -> Optional[ClassInfo]:
+    if isinstance(ann, ast.Constant) and isinstance(ann.value, str):
+        name = ann.value.split(".")[-1].strip()
+    elif isinstance(ann, ast.Name):
+        name = ann.id
+    elif isinstance(ann, ast.Attribute):
+        name = ann.attr
+    else:
+        return None
+    return project.unique_class(name) if name[:1].isupper() else None
+
+
+class _Scope:
+    """Typed bindings visible at a point: receiver path -> ClassInfo."""
+
+    def __init__(self, bindings: Dict[str, ClassInfo], own_class: Optional[ClassInfo]):
+        self.bindings = bindings
+        self.own_class = own_class  # enclosing class (for bare self.<m>)
+
+
+def _collect_class_bindings(
+    cls: ast.ClassDef, project: Project
+) -> Dict[str, ClassInfo]:
+    """self.<attr> -> ClassInfo for attrs assigned from a known
+    constructor anywhere in the class (constructor wins over later
+    reassignment ambiguity by simply keeping the first match)."""
+    out: Dict[str, ClassInfo] = {}
+    for node in ast.walk(cls):
+        if isinstance(node, ast.Assign) and len(node.targets) == 1:
+            t = node.targets[0]
+            path = _receiver_path(t) if isinstance(t, (ast.Name, ast.Attribute)) else None
+            if path and path.startswith("self."):
+                info = _constructor_class(node.value, project)
+                if info is not None and path not in out:
+                    out[path] = info
+        elif isinstance(node, ast.AnnAssign):
+            path = (
+                _receiver_path(node.target)
+                if isinstance(node.target, (ast.Name, ast.Attribute))
+                else None
+            )
+            if path and path.startswith("self."):
+                info = _annotation_class(node.annotation, project)
+                if info is not None and path not in out:
+                    out[path] = info
+    return out
+
+
+class BoundMethodTruthiness(Rule):
+    name = "bound-method-truthiness"
+    summary = (
+        "a method referenced without () in a condition/comparison is "
+        "always truthy and never equal to a constant"
+    )
+
+    def check_file(self, ctx: FileContext, project: Project) -> Iterable[Violation]:
+        if ctx.tree is None:
+            return ()
+        out: List[Violation] = []
+        self._walk_body(ctx, project, ctx.tree, None, {}, out)
+        return out
+
+    # -- traversal ---------------------------------------------------------
+
+    def _walk_body(
+        self,
+        ctx: FileContext,
+        project: Project,
+        node: ast.AST,
+        own_class: Optional[ClassInfo],
+        bindings: Dict[str, ClassInfo],
+        out: List[Violation],
+    ) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.ClassDef):
+                infos = project.classes.get(child.name) or []
+                info = next(
+                    (i for i in infos if i.rel == ctx.rel and i.line == child.lineno),
+                    None,
+                )
+                cls_bindings = dict(bindings)
+                cls_bindings.update(_collect_class_bindings(child, project))
+                self._walk_body(ctx, project, child, info, cls_bindings, out)
+            elif isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                fn_bindings = dict(bindings)
+                self._scan_function(ctx, project, child, own_class, fn_bindings, out)
+            else:
+                self._walk_body(ctx, project, child, own_class, bindings, out)
+
+    def _scan_function(
+        self,
+        ctx: FileContext,
+        project: Project,
+        fn: ast.AST,
+        own_class: Optional[ClassInfo],
+        bindings: Dict[str, ClassInfo],
+        out: List[Violation],
+    ) -> None:
+        scope = _Scope(bindings, own_class)
+        nodes = list(ast.walk(fn))
+        for node in nodes:
+            # grow the local type environment (source order is close
+            # enough: a rebinding to an unknown type simply drops info)
+            if isinstance(node, ast.Assign) and len(node.targets) == 1:
+                t = node.targets[0]
+                path = _receiver_path(t) if isinstance(t, (ast.Name, ast.Attribute)) else None
+                if path:
+                    info = _constructor_class(node.value, project)
+                    if info is not None:
+                        scope.bindings[path] = info
+                    elif path in scope.bindings:
+                        del scope.bindings[path]
+            elif isinstance(node, ast.AnnAssign):
+                path = (
+                    _receiver_path(node.target)
+                    if isinstance(node.target, (ast.Name, ast.Attribute))
+                    else None
+                )
+                if path:
+                    info = _annotation_class(node.annotation, project)
+                    if info is not None:
+                        scope.bindings[path] = info
+        for node in nodes:
+            for operand in self._condition_operands(node):
+                self._check_operand(ctx, scope, operand, out)
+
+    # -- condition contexts ------------------------------------------------
+
+    @staticmethod
+    def _condition_operands(node: ast.AST) -> Iterable[ast.expr]:
+        if isinstance(node, (ast.If, ast.While, ast.IfExp)):
+            yield node.test
+        elif isinstance(node, ast.Assert):
+            yield node.test
+        elif isinstance(node, ast.BoolOp):
+            yield from node.values
+        elif isinstance(node, ast.UnaryOp) and isinstance(node.op, ast.Not):
+            yield node.operand
+        elif isinstance(node, ast.Compare):
+            yield node.left
+            yield from node.comparators
+        elif isinstance(node, ast.comprehension):
+            yield from node.ifs
+
+    def _check_operand(
+        self, ctx: FileContext, scope: _Scope, operand: ast.expr, out: List[Violation]
+    ) -> None:
+        if not isinstance(operand, ast.Attribute) or isinstance(operand.ctx, ast.Store):
+            return
+        recv = _receiver_path(operand.value)
+        info: Optional[ClassInfo] = None
+        if recv == "self" and scope.own_class is not None:
+            info = scope.own_class
+        elif recv is not None:
+            info = scope.bindings.get(recv)
+        if info is None:
+            return
+        if (
+            operand.attr in info.methods
+            and operand.attr not in info.properties
+            # a name that is ALSO assigned as an instance attribute is
+            # ambiguous (cs_harness swaps send_internal per instance) —
+            # only flag unambiguous method references
+            and operand.attr not in info.attributes
+        ):
+            out.append(
+                Violation(
+                    self.name, ctx.rel, operand.lineno,
+                    f"{recv}.{operand.attr} is a bound method of "
+                    f"{info.name} used without calling it — always truthy, "
+                    f"never equal to a constant; did you mean "
+                    f"{recv}.{operand.attr}()?",
+                    operand.col_offset,
+                )
+            )
+
+
+register(BoundMethodTruthiness())

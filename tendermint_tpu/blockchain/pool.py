@@ -14,7 +14,7 @@ reactor's ticker (make_next_requesters / expire take an explicit
 from __future__ import annotations
 
 import time
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from typing import Dict, List, Optional, Tuple
 
 MAX_PENDING_PER_PEER = 20  # reference maxPendingRequestsPerPeer

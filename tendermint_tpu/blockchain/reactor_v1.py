@@ -66,6 +66,9 @@ class BlockchainReactorV1(Reactor, ToReactor):
         self._consensus_reactor = consensus_reactor
         self.fsm = FsmV1(state.last_block_height + 1, self)
         self._switched = False
+        # strong refs for fire-and-forget tasks (peer-error stops,
+        # consensus switch): asyncio holds tasks weakly
+        self._bg: set = set()
         # None passes through as "wait forever" — the documented meaning
         # of watchdog_future_deadline_ms = 0, not a reset to the default
         self._verify_window = CommitVerifyWindow(
@@ -115,9 +118,11 @@ class BlockchainReactorV1(Reactor, ToReactor):
     def send_peer_error(self, err: Exception, peer_id: str) -> None:
         p = self.switch.peers.get(peer_id) if self.switch is not None else None
         if p is not None:
-            asyncio.ensure_future(
+            task = asyncio.ensure_future(
                 self.switch.stop_peer_for_error(p, f"fast sync: {err}")
             )
+            self._bg.add(task)
+            task.add_done_callback(self._bg.discard)
 
     def reset_state_timer(self, state_name: str, timeout_s: float) -> None:
         """One active FSM state timer; superseded timers die via the
@@ -147,9 +152,11 @@ class BlockchainReactorV1(Reactor, ToReactor):
             height=self.state.last_block_height,
         )
         if self._consensus_reactor is not None:
-            asyncio.ensure_future(
+            task = asyncio.ensure_future(
                 self._consensus_reactor.switch_to_consensus(self.state)
             )
+            self._bg.add(task)
+            task.add_done_callback(self._bg.discard)
 
     # -- peers -------------------------------------------------------------
 

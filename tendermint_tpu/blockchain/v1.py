@@ -20,7 +20,7 @@ wire protocol (blockchain/messages.py).
 from __future__ import annotations
 
 import time
-from typing import Callable, Dict, List, Optional, Tuple
+from typing import Dict, List, Optional, Tuple
 
 MAX_REQUESTS_PER_PEER = 20  # reference v1/reactor.go:39
 MAX_NUM_REQUESTS = 64  # reference v1/reactor.go:41

@@ -21,7 +21,6 @@ from tendermint_tpu.config import (
     Config,
     default_config,
     load_config,
-    test_config,
     write_config_file,
 )
 from tendermint_tpu.config.config import (

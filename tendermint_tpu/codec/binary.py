@@ -10,7 +10,7 @@ from __future__ import annotations
 
 import io
 import struct
-from typing import List, Optional
+from typing import Optional
 
 
 class DecodeError(Exception):

@@ -13,7 +13,7 @@ time.Duration fields; helpers return float seconds for asyncio.
 from __future__ import annotations
 
 import os
-from dataclasses import dataclass, field, fields, is_dataclass, replace
+from dataclasses import dataclass, field, fields, is_dataclass
 from typing import List, Optional
 
 # -- directory layout (reference config/config.go:25-40) -------------------

@@ -8,10 +8,10 @@ what to send.
 
 from __future__ import annotations
 
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from typing import Optional
 
-from tendermint_tpu.types.block import BlockID, PartSetHeader
+from tendermint_tpu.types.block import PartSetHeader
 from tendermint_tpu.utils.bits import BitArray
 
 

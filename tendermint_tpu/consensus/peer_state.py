@@ -22,7 +22,6 @@ from tendermint_tpu.consensus.messages import (
     VoteSetBitsMessage,
 )
 from tendermint_tpu.consensus.peer_round_state import PeerRoundState
-from tendermint_tpu.consensus.round_state import STEP_NEW_HEIGHT, STEP_PROPOSE
 from tendermint_tpu.types.block import Commit
 from tendermint_tpu.types.proposal import Proposal
 from tendermint_tpu.types.vote import Vote

@@ -25,7 +25,6 @@ from tendermint_tpu.consensus.round_state import (
     STEP_PROPOSE,
 )
 from tendermint_tpu.consensus.state import (
-    EVENT_COMMITTED,
     EVENT_NEW_ROUND_STEP,
     EVENT_VALID_BLOCK,
     EVENT_VOTE,

@@ -13,7 +13,6 @@ app; WAL catchup reconciles the in-flight height.
 
 from __future__ import annotations
 
-from typing import List, Optional
 
 from tendermint_tpu.abci import types as abci
 from tendermint_tpu.abci.application import Application
@@ -25,7 +24,6 @@ from tendermint_tpu.state.execution import (
     validator_updates_from_abci,
 )
 from tendermint_tpu.state.state import State
-from tendermint_tpu.types.block import BlockID
 from tendermint_tpu.types.validator_set import ValidatorSet
 from tendermint_tpu.utils.log import get_logger
 

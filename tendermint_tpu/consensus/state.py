@@ -27,7 +27,6 @@ import time
 from typing import Optional
 
 from tendermint_tpu.codec.signbytes import PRECOMMIT_TYPE, PREVOTE_TYPE
-from tendermint_tpu.consensus import round_state as rst
 from tendermint_tpu.consensus.height_vote_set import HeightVoteSet
 from tendermint_tpu.consensus.messages import (
     BlockPartMessage,
@@ -49,9 +48,8 @@ from tendermint_tpu.consensus.round_state import (
     RoundState,
     step_name,
 )
-from tendermint_tpu.consensus.wal import WAL, BaseWAL, NilWAL
+from tendermint_tpu.consensus.wal import WAL, NilWAL
 from tendermint_tpu.consensus.height_vote_set import ErrGotVoteFromUnwantedRound
-from tendermint_tpu.privval.file import ErrDoubleSign
 from tendermint_tpu.state.state import State as SMState
 from tendermint_tpu.types.block import Block, BlockID, Commit
 from tendermint_tpu.types.part_set import (
@@ -235,6 +233,9 @@ class ConsensusState(Service):
         self.do_wal_catchup = True
         self._done_first_block = asyncio.Event()
         self.n_steps = 0  # transitions counter (reference nSteps, for tests)
+        # strong refs for fire-and-forget event publishes: asyncio holds
+        # tasks weakly, and a GC'd publish would drop a subscriber event
+        self._bg: set = set()
 
         # pluggable seams (reference state.go:124-126)
         self.decide_proposal = self._default_decide_proposal
@@ -439,9 +440,12 @@ class ConsensusState(Service):
     def _publish_soon(self, coro) -> None:
         """Events are fire-and-forget; consensus never blocks on them."""
         try:
-            asyncio.get_running_loop().create_task(coro)
+            task = asyncio.get_running_loop().create_task(coro)
         except RuntimeError:
             coro.close()  # no loop (constructor path): drop silently
+            return
+        self._bg.add(task)
+        task.add_done_callback(self._bg.discard)
 
     # ------------------------------------------------------------------
     # scheduling

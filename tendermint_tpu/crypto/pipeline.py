@@ -47,7 +47,7 @@ import queue
 import threading
 from collections import OrderedDict, deque
 from concurrent.futures import Future
-from typing import Dict, List, Optional, Sequence, Tuple
+from typing import Dict, List, Optional
 
 import time
 
@@ -617,6 +617,7 @@ class PipelinedVerifier(BatchVerifier):
         if orphan is not None:
             self._inflight_bundle = None
             leftovers.extend(orphan.items)
+        # tmlint: disable=no-permanent-latch -- one-way stop() ordering flag, not a device-path latch: the pipeline is shutting down for good
         self._leftovers_failed = True  # before the drain: see below
         with self._cv:
             while self._q:

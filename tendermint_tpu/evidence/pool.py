@@ -15,7 +15,6 @@ from typing import List, Optional
 
 from tendermint_tpu.db.base import DB
 from tendermint_tpu.types.evidence import (
-    MAX_EVIDENCE_BYTES,
     CompositeEvidence,
     Evidence,
     LunaticValidatorEvidence,

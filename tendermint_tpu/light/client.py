@@ -12,7 +12,7 @@ from __future__ import annotations
 
 import time
 from fractions import Fraction
-from typing import List, Optional, Tuple
+from typing import List, Optional
 
 from tendermint_tpu.light import verifier
 from tendermint_tpu.light.provider import Provider

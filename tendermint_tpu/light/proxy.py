@@ -8,7 +8,7 @@ merkle proofs) and lite2/proxy/proxy.go (the RPC server exposing it).
 
 from __future__ import annotations
 
-from typing import Any, Dict, Optional
+from typing import Any, Dict
 
 from tendermint_tpu.light.client import LightClient
 from tendermint_tpu.utils.log import get_logger

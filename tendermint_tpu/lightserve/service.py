@@ -34,7 +34,7 @@ import threading
 import time
 from concurrent.futures import Future
 from fractions import Fraction
-from typing import Callable, Dict, List, Optional, Tuple
+from typing import Callable, Dict, Optional, Tuple
 
 from tendermint_tpu.light import verifier
 from tendermint_tpu.light.store import TrustedStore

@@ -10,12 +10,11 @@ from __future__ import annotations
 
 import asyncio
 import os
-from typing import List, Optional
+from typing import Optional
 
 from tendermint_tpu.abci.client.local import LocalClient
 from tendermint_tpu.blockchain.reactor import BlockchainReactor
 from tendermint_tpu.config import Config
-from tendermint_tpu.config.config import ensure_root
 from tendermint_tpu.consensus.reactor import ConsensusReactor
 from tendermint_tpu.consensus.replay import Handshaker
 from tendermint_tpu.consensus.state import ConsensusState

@@ -18,7 +18,6 @@ from __future__ import annotations
 from typing import List
 
 import jax.numpy as jnp
-import numpy as np
 
 SHIFT = 13
 MASK = (1 << SHIFT) - 1

@@ -12,7 +12,6 @@ Used for the ed25519 challenge hash k = SHA512(R || A || M).
 
 from __future__ import annotations
 
-from typing import Tuple
 
 import jax
 import jax.numpy as jnp

@@ -42,11 +42,10 @@ except ImportError:  # no OpenSSL wheel in this image: pure-Python fallback
 from tendermint_tpu.codec.binary import Reader, Writer
 from tendermint_tpu.crypto.keys import Ed25519PubKey, PrivKey, PubKey
 from tendermint_tpu.p2p.conn import native_frames
-from tendermint_tpu.p2p.conn.native_frames import (  # canonical definitions
+from tendermint_tpu.p2p.conn.native_frames import (
     DATA_LEN_SIZE,
     DATA_MAX_SIZE,
     SEALED_FRAME_SIZE,
-    TAG_SIZE,
     TOTAL_FRAME_SIZE,
 )
 

@@ -7,8 +7,8 @@ channel).
 
 from __future__ import annotations
 
-from dataclasses import dataclass, field
-from typing import List, Optional
+from dataclasses import dataclass
+from typing import Optional
 
 from tendermint_tpu.codec.binary import Reader, Writer
 from tendermint_tpu.version import BLOCK_PROTOCOL, P2P_PROTOCOL

@@ -7,12 +7,10 @@ TrySend route through the MConnection channel; per-peer key-value data
 
 from __future__ import annotations
 
-import asyncio
 from typing import Any, Dict, List, Optional
 
 from tendermint_tpu.p2p.conn.connection import ChannelDescriptor, MConnection
 from tendermint_tpu.p2p.netaddress import NetAddress
-from tendermint_tpu.p2p.node_info import NodeInfo
 from tendermint_tpu.p2p.transport import UpgradedConn
 from tendermint_tpu.utils.log import get_logger
 

@@ -22,7 +22,6 @@ from __future__ import annotations
 import asyncio
 import json
 import math
-import time
 from typing import Dict, List, Optional
 
 from tendermint_tpu.utils.log import get_logger

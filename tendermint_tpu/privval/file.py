@@ -19,8 +19,8 @@ from __future__ import annotations
 import json
 import os
 import tempfile
-from dataclasses import dataclass, field
-from typing import Optional, Tuple
+from dataclasses import dataclass
+from typing import Optional
 
 from tendermint_tpu.codec import signbytes
 from tendermint_tpu.crypto.keys import Ed25519PrivKey, Ed25519PubKey, PubKey

@@ -9,7 +9,7 @@ double-sign-refusal probes the real node depends on.
 
 from __future__ import annotations
 
-from typing import Callable, List, Optional, Tuple
+from typing import Callable, List
 
 from tendermint_tpu.codec.signbytes import PRECOMMIT_TYPE, PREVOTE_TYPE
 from tendermint_tpu.privval.signer import SignerClient

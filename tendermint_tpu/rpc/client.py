@@ -10,15 +10,14 @@ from __future__ import annotations
 
 import asyncio
 import base64
-import hashlib
 import json
 import os
 import struct
-from typing import Any, Dict, Optional
+from typing import Any, Dict
 
 from tendermint_tpu.p2p.netaddress import NetAddress
 from tendermint_tpu.rpc.core import RPCCore, RPCError
-from tendermint_tpu.rpc.server import _ws_frame, _ws_read_frame
+from tendermint_tpu.rpc.server import _ws_read_frame
 
 
 class HTTPClient:

@@ -87,6 +87,9 @@ def _as_bool(v) -> bool:
 class RPCCore:
     def __init__(self, node):
         self.node = node
+        # strong refs for broadcast_tx_async admissions: asyncio holds
+        # tasks weakly, and a GC'd task would silently drop the tx
+        self._bg: set = set()
         self._routes = {
             "health": self.health,
             "status": self.status,
@@ -396,7 +399,9 @@ class RPCCore:
     async def broadcast_tx_async(self, tx=None) -> Dict[str, Any]:
         """Reference mempool.go:23 — returns immediately."""
         raw = _bytes_arg(tx, "tx")
-        asyncio.ensure_future(self._checktx_quiet(raw))
+        task = asyncio.ensure_future(self._checktx_quiet(raw))
+        self._bg.add(task)
+        task.add_done_callback(self._bg.discard)
         from tendermint_tpu.state.txindex import tx_hash
 
         return {"code": 0, "data": "", "log": "", "hash": hx(tx_hash(raw))}

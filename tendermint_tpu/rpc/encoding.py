@@ -7,7 +7,7 @@ base64); heights/ints are JSON numbers.
 
 from __future__ import annotations
 
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, Optional
 
 
 def hx(b: Optional[bytes]) -> str:

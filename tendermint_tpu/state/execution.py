@@ -14,7 +14,7 @@ reference's (state/execution.go:142,147,178,184) for the crash matrix.
 from __future__ import annotations
 
 import time
-from typing import List, Optional, Tuple
+from typing import List, Tuple
 
 from tendermint_tpu.abci import types as abci
 from tendermint_tpu.abci.client.base import ABCIClient
@@ -24,7 +24,6 @@ from tendermint_tpu.state.validation import validate_block
 from tendermint_tpu.types.block import Block, BlockID
 from tendermint_tpu.types.tx import Txs
 from tendermint_tpu.types.validator import Validator
-from tendermint_tpu.types.validator_set import ValidatorSet
 from tendermint_tpu.utils import fail
 from tendermint_tpu.utils import faultinject as faults
 from tendermint_tpu.utils.log import get_logger

@@ -9,7 +9,7 @@ from __future__ import annotations
 
 import time
 from dataclasses import dataclass, field, replace
-from typing import List, Optional
+from typing import Optional
 
 from tendermint_tpu.codec.binary import Reader, Writer
 from tendermint_tpu.types.block import Block, BlockID, Commit, Data, EvidenceData, Header

@@ -14,7 +14,6 @@ from tendermint_tpu.codec import signbytes
 from tendermint_tpu.codec.binary import Reader, Writer
 from tendermint_tpu.codec.signbytes import PRECOMMIT_TYPE
 from tendermint_tpu.crypto import merkle
-from tendermint_tpu.crypto.hash import sha256
 from tendermint_tpu.types.tx import Txs
 from tendermint_tpu.version import BLOCK_PROTOCOL
 

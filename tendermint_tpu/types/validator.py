@@ -7,7 +7,6 @@ Reference: types/validator.go (Validator struct :13, CompareProposerPriority
 from __future__ import annotations
 
 from dataclasses import dataclass, field, replace
-from typing import Optional
 
 from tendermint_tpu.codec.binary import Reader, Writer
 from tendermint_tpu.crypto.keys import PubKey, decode_pubkey, encode_pubkey

@@ -9,7 +9,7 @@ rectangularization that lets commits batch onto the TPU.
 from __future__ import annotations
 
 import time
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from typing import TYPE_CHECKING, Optional
 
 from tendermint_tpu.codec import signbytes

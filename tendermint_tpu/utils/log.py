@@ -11,7 +11,7 @@ from __future__ import annotations
 import logging
 import sys
 import time
-from typing import Dict, Optional
+from typing import Dict
 
 _LEVELS = {
     "debug": logging.DEBUG,

@@ -252,6 +252,7 @@ class Subscription:
         done, _ = await asyncio.wait({get, cancel}, return_when=asyncio.FIRST_COMPLETED)
         if get in done:
             cancel.cancel()
+            # tmlint: disable=async-hygiene -- `get` is in asyncio.wait's done set: result() cannot block
             return get.result()
         get.cancel()
         raise asyncio.CancelledError(self.err or "subscription cancelled")

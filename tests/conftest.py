@@ -7,7 +7,6 @@ Must run before jax is imported anywhere.
 """
 
 import os
-import sys
 
 # FORCE cpu on a virtual 8-device mesh (not setdefault: the outer env
 # pins JAX_PLATFORMS=axon, and the axon sitecustomize hook's PJRT

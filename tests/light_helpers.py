@@ -11,6 +11,7 @@ this module keeps the historical test-facing names as thin aliases.
 
 from __future__ import annotations
 
+# tmlint: disable-file=unused-import -- compat shim: re-exports loadgen under the historical test-facing names
 from tendermint_tpu.lightserve.loadgen import (  # noqa: F401
     BLOCK_NS,
     CHAIN_ID,

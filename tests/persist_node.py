@@ -28,7 +28,6 @@ from tendermint_tpu.config import MempoolConfig, test_config
 from tendermint_tpu.consensus.replay import Handshaker
 from tendermint_tpu.consensus.state import ConsensusState
 from tendermint_tpu.consensus.wal import BaseWAL
-from tendermint_tpu.crypto.keys import Ed25519PrivKey
 from tendermint_tpu.db.sqlitedb import SQLiteDB
 from tendermint_tpu.mempool import Mempool
 from tendermint_tpu.privval import load_or_gen_file_pv
@@ -37,7 +36,6 @@ from tendermint_tpu.state.state import state_from_genesis_doc
 from tendermint_tpu.state.store import StateStore
 from tendermint_tpu.store.block_store import BlockStore
 from tendermint_tpu.types.genesis import GenesisDoc, GenesisValidator
-from tendermint_tpu.types.priv_validator import MockPV
 
 CHAIN_ID = "persist-chain"
 

@@ -3,9 +3,7 @@ run against the example apps and output compared byte-for-byte with
 checked-in .out files)."""
 
 import asyncio
-import io
 import os
-import sys
 
 GOLDEN = os.path.join(os.path.dirname(__file__), "golden", "abci_cli_counter.txt")
 

@@ -8,7 +8,6 @@ accept/reject identical to the per-call path.
 
 import asyncio
 
-import numpy as np
 import pytest
 
 from tendermint_tpu.blockchain.reactor import BlockchainReactor

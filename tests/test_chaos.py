@@ -20,7 +20,7 @@ import pytest
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-from tests.cs_harness import make_genesis, start_network, stop_network
+from tests.cs_harness import make_genesis
 from tendermint_tpu.consensus.wal import BaseWAL
 from tendermint_tpu.crypto.batch import (
     CPUBatchVerifier,

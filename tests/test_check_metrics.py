@@ -11,8 +11,6 @@ from tendermint_tpu.utils.metrics import (
     ConsensusMetrics,
     Counter,
     CryptoMetrics,
-    Gauge,
-    Histogram,
     IngestMetrics,
     LightServeMetrics,
     MerkleMetrics,

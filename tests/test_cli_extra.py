@@ -15,6 +15,7 @@ from tendermint_tpu.cli import main as cli_main
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
+@pytest.mark.slow
 def test_replay_command(tmp_path, capsys):
     home = str(tmp_path / "r0")
     # run a short chain with file-backed stores via persist_node

@@ -23,6 +23,7 @@ def run(coro):
     return asyncio.run(coro)
 
 
+@pytest.mark.slow
 def test_single_validator_makes_blocks():
     """A 1-validator chain commits blocks by itself (reference
     onlyValidatorIsUs path, node/node.go:314)."""
@@ -46,6 +47,7 @@ def test_single_validator_makes_blocks():
     run(go())
 
 
+@pytest.mark.slow
 def test_single_validator_commits_txs():
     async def go():
         nodes = await start_network(1)
@@ -70,6 +72,7 @@ def test_single_validator_commits_txs():
     run(go())
 
 
+@pytest.mark.slow
 def test_four_validators_advance_together():
     """4 nodes over the loopback switch all commit the same chain
     (reference consensus/reactor_test.go:97 TestReactorBasic)."""
@@ -92,6 +95,7 @@ def test_four_validators_advance_together():
     run(go())
 
 
+@pytest.mark.slow
 def test_unequal_powers_net():
     async def go():
         nodes = await start_network(4, powers=[1, 2, 3, 10])
@@ -103,6 +107,7 @@ def test_unequal_powers_net():
     run(go())
 
 
+@pytest.mark.slow
 def test_proposer_rotation():
     """Different validators propose over consecutive heights
     (reference TestProposerSelection flavor at the chain level)."""

@@ -19,9 +19,7 @@ from tendermint_tpu.consensus.round_state import (
     STEP_COMMIT,
     STEP_NEW_HEIGHT,
     STEP_PRECOMMIT,
-    STEP_PRECOMMIT_WAIT,
     STEP_PREVOTE,
-    STEP_PREVOTE_WAIT,
     STEP_PROPOSE,
 )
 from tendermint_tpu.types.block import BlockID

@@ -9,7 +9,7 @@ import pytest
 
 from tendermint_tpu.crypto.keys import Ed25519PrivKey, decode_pubkey, encode_pubkey
 from tendermint_tpu.crypto.multisig import MultisigBuilder, MultisigThresholdPubKey
-from tendermint_tpu.crypto.secp256k1 import Secp256k1PrivKey, Secp256k1PubKey
+from tendermint_tpu.crypto.secp256k1 import Secp256k1PrivKey
 from tendermint_tpu.crypto.symmetric import (
     DecryptError,
     armor,

@@ -12,12 +12,7 @@ import pytest
 from tendermint_tpu.blockchain.reactor import BlockchainReactor
 from tendermint_tpu.blockchain.scheduler import Scheduler
 from tendermint_tpu.consensus.reactor import ConsensusReactor
-from tendermint_tpu.p2p.test_util import (
-    connect_switches,
-    make_connected_switches,
-    make_switch,
-    stop_switches,
-)
+from tendermint_tpu.p2p.test_util import connect_switches, make_switch, stop_switches
 from tests.cs_harness import make_genesis, make_node
 
 CHAIN = "cs-harness-chain"

@@ -13,7 +13,6 @@ import pytest
 from tendermint_tpu.blockchain.v1 import (
     MAX_REQUESTS_PER_PEER,
     S_FINISHED,
-    S_UNKNOWN,
     S_WAIT_FOR_BLOCK,
     S_WAIT_FOR_PEER,
     ErrBadDataFromPeer,
@@ -24,7 +23,6 @@ from tendermint_tpu.blockchain.v1 import (
     ErrNoTallerPeer,
     ErrPeerLowersItsHeight,
     ErrPeerTooShort,
-    ErrSlowPeer,
     FsmV1,
     ToReactor,
 )

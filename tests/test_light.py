@@ -6,14 +6,12 @@ witness conflict).
 """
 
 import asyncio
-from fractions import Fraction
 
 import pytest
 
 from tendermint_tpu.db.memdb import MemDB
 from tendermint_tpu.light import (
     LightClient,
-    SignedHeader,
     TrustOptions,
     verify_adjacent,
     verify_backwards,

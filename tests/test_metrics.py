@@ -20,7 +20,6 @@ from tendermint_tpu.utils.metrics import (
     Gauge,
     Histogram,
     MerkleMetrics,
-    MetricsServer,
     Registry,
     TraceMetrics,
 )
@@ -168,6 +167,7 @@ def test_concurrent_writers_are_exact():
 def test_counter_rejects_decrease():
     c = Counter("n_total", "N.")
     with pytest.raises(ValueError):
+        # tmlint: disable=metrics-coherence -- negative inc is the point: proves the runtime rejects it
         c.inc(-1)
 
 

@@ -34,7 +34,6 @@ def test_bech32_reference_vector():
 def test_sr25519_is_live():
     # formerly a gated stub; the real implementation lives in
     # tests/test_sr25519.py — this guards the key type stays registered
-    from tendermint_tpu.crypto.keys import _PUBKEY_TYPES  # noqa
 
     from tendermint_tpu.crypto.sr25519 import Sr25519PrivKey
 

@@ -8,8 +8,6 @@ docker localnet rig (networks/local/).
 
 import asyncio
 import os
-import subprocess
-import sys
 
 import pytest
 

@@ -186,7 +186,6 @@ def test_signer_harness_passes_against_file_pv(tmp_path):
         pv.save()
 
         # start harness listener on an ephemeral port, then dial in
-        from tendermint_tpu.privval import harness as H
 
         results = {}
 

@@ -18,11 +18,10 @@ from tendermint_tpu.p2p.conn.secret_connection import SecretConnection
 from tendermint_tpu.p2p.key import NodeKey, node_id_from_pubkey
 from tendermint_tpu.p2p.netaddress import ErrNetAddressInvalid, NetAddress
 from tendermint_tpu.p2p.node_info import NodeInfo
-from tendermint_tpu.p2p.switch import Reactor, Switch
+from tendermint_tpu.p2p.switch import Reactor
 from tendermint_tpu.p2p.test_util import (
     make_connected_switches,
     make_node_key,
-    make_switch,
     stop_switches,
 )
 from tendermint_tpu.p2p.transport import ErrRejected, Transport

@@ -15,7 +15,7 @@ from tendermint_tpu.types.proposal import Proposal
 from tendermint_tpu.types.vote import Vote
 from tendermint_tpu.types.vote_set import ErrVoteConflictingVotes
 
-from tests.cs_harness import make_genesis, make_node, start_network, stop_network
+from tests.cs_harness import make_genesis, make_node
 
 
 def run(coro):

@@ -3,7 +3,7 @@ PeerState :840-1330): vote bit-arrays per (height, round, type),
 pick-send-vote de-duplication, round-step transitions carrying
 precommits into last_commit, and vote-set-bits merging."""
 
-from tendermint_tpu.codec.signbytes import PRECOMMIT_TYPE, PREVOTE_TYPE
+from tendermint_tpu.codec.signbytes import PREVOTE_TYPE
 from tendermint_tpu.consensus.messages import (
     HasVoteMessage,
     NewRoundStepMessage,

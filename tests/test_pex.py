@@ -6,12 +6,10 @@ Mirrors reference p2p/pex/addrbook_test.go and pex_reactor_test.go
 
 import asyncio
 
-import pytest
 
 from tendermint_tpu.p2p.netaddress import NetAddress
 from tendermint_tpu.p2p.pex import AddrBook, PEXReactor
 from tendermint_tpu.p2p.test_util import (
-    connect_switches,
     make_connected_switches,
     make_switch,
     stop_switches,

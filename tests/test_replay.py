@@ -5,7 +5,6 @@ test/persist/test_failure_indices.sh (fail-point crash matrix, run here
 as subprocesses against a file-backed single-validator node).
 """
 
-import asyncio
 import os
 import subprocess
 import sys

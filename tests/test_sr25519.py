@@ -6,7 +6,6 @@ appendix A.1 (ristretto255 generator multiples + invalid encodings),
 the merlin crate's "simple transcript" conformance test.
 """
 
-import pytest
 
 from tendermint_tpu.crypto.keys import decode_pubkey, encode_pubkey
 from tendermint_tpu.crypto.sr25519 import (
@@ -86,7 +85,6 @@ def test_sign_verify_roundtrip_and_rejections():
 
 
 def test_signatures_are_context_bound():
-    from tendermint_tpu.crypto.sr25519 import sr25519_sign
 
     pv = Sr25519PrivKey.from_seed(b"\x09" * 32)
     pk = pv.pub_key()

@@ -26,7 +26,6 @@ from tendermint_tpu.state.validation import ValidationError
 from tendermint_tpu.types.block import BlockID
 from tendermint_tpu.types.genesis import GenesisDoc, GenesisValidator
 from tendermint_tpu.types.tx import Txs
-from tendermint_tpu.types.validator import Validator
 from tendermint_tpu.types.vote import Vote
 from tendermint_tpu.types.vote_set import VoteSet
 

@@ -266,7 +266,6 @@ class TestEncoding:
 def test_sign_bytes_matrix_equals_scalar_path():
     """Commit.sign_bytes_matrix must be byte-identical to per-index
     vote_sign_bytes for every flag combination (commit/nil/absent)."""
-    import numpy as np
 
     from tests.light_helpers import CHAIN_ID, gen_chain
 
@@ -296,7 +295,6 @@ def test_sign_bytes_matrix_equals_scalar_path():
 def test_commit_batch_arrays_vectorized_equivalence():
     """The vectorized _commit_batch_arrays must produce exactly what the
     direct per-row construction would."""
-    import numpy as np
 
     from tests.light_helpers import CHAIN_ID, gen_chain
 

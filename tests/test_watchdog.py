@@ -41,7 +41,8 @@ def _clean():
 
 
 def make_batch(n, seed=7):
-    from tests.cs_harness import make_genesis  # noqa: F401  (path setup)
+    # tmlint: disable=unused-import -- imported for its side effect (repo-root path setup)
+    from tests.cs_harness import make_genesis  # noqa: F401
     from tendermint_tpu.crypto.keys import Ed25519PrivKey
 
     pks, msgs, sigs = [], [], []
