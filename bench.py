@@ -245,6 +245,8 @@ _GUARD_KEYS = [
     ("lightserve_speedup", "higher"),
     ("ingest_txs_per_sec", "higher"),
     ("ingest_speedup", "higher"),
+    ("bls_commit_bytes_ratio", "higher"),
+    ("bls_verify_speedup", "higher"),
     ("coldstart_first_verify_s", None),   # presence-only: timing varies
     ("coldstart_tabled_first_s", None),
 ]
@@ -353,6 +355,7 @@ def run_bench(platform: str, accelerator: bool = True):
             **lightserve_bench(cpu),
             **ingest_bench(cpu),
             **merkle_bench(),
+            **bls_bench(),
             **degraded_mode_bench(),
             **trace_overhead_bench(),
             **_last_tpu_extra(),
@@ -581,6 +584,9 @@ def run_bench(platform: str, accelerator: bool = True):
     # -- merkle engine: device vs host root + part-set split --------------
     merkle_extra = merkle_bench()
 
+    # -- BLS aggregation: bytes/commit + verify latency vs per-sig --------
+    bls_extra = bls_bench()
+
     # -- degraded mode: circuit-broken fallback + idle watchdog cost ------
     degraded_extra = degraded_mode_bench()
 
@@ -662,6 +668,7 @@ def run_bench(platform: str, accelerator: bool = True):
         **lightserve_extra,
         **ingest_extra,
         **merkle_extra,
+        **bls_extra,
         **degraded_extra,
         **trace_extra,
         **aot_extra,
@@ -1234,6 +1241,175 @@ def lightserve_bench(provider=None) -> dict:
         traceback.print_exc(file=sys.stderr)
         log(f"lightserve measurement failed: {ex!r}")
         return {"lightserve_error": repr(ex)[:200]}
+
+
+# -- BLS aggregation: one signature per commit vs per-signature ------------
+#
+# The signature-aggregation A/B (crypto/bls.py, types/aggregate.py,
+# docs/bls-aggregation.md; ROADMAP item 3 / arxiv 2302.00418), at >= 2
+# validator-set sizes:
+#
+# - bytes per commit: an encoded per-sig Commit (one CommitSig per
+#   validator) vs the encoded AggregatedCommit (one 96-byte signature +
+#   V-bit bitmap). The ratio at the LARGEST size is the guarded
+#   bls_commit_bytes_ratio — it grows ~linearly with V, so a regression
+#   means the wire format fattened.
+# - verify latency: per-signature BLS verification (one pairing check
+#   per row — what a BLS valset costs WITHOUT aggregation; measured on
+#   a row sample and scaled to V, the sample size is reported) vs ONE
+#   aggregate check (pubkey sum + single pairing). The ratio at the
+#   largest size is the guarded bls_verify_speedup — this is the
+#   aggregation win itself, independent of which backend (device
+#   kernels or the pure-Python oracle) runs the pairings, so the bench
+#   pins use_device=False for run-to-run comparability on this box.
+# - the ed25519 pipeline numbers for the same set sizes ride along
+#   unguarded (bls_vs_ed25519_*): on a CPU-fallback box the pure-Python
+#   pairing loses to OpenSSL ed25519 below ~200 validators — the
+#   honest crossover the paper predicts; the BYTES win holds at every
+#   size.
+
+BLS_VALSETS = [
+    int(x) for x in os.environ.get("TM_BENCH_BLS_VALS", "16,64").split(",")
+]
+BLS_PERSIG_SAMPLE = int(os.environ.get("TM_BENCH_BLS_SAMPLE", "6"))
+
+
+def bls_bench() -> dict:
+    """Returns the bls_* bench keys; never raises (the main line must
+    survive a broken subsystem — the guard then flags the missing keys
+    against the previous record)."""
+    import time as _time
+
+    try:
+        from tendermint_tpu.crypto.bls import BLSBatchVerifier, BLSPrivKey
+        from tendermint_tpu.ops import ref_bls12 as _ref
+        from tendermint_tpu.types.aggregate import aggregate_commit_votes
+        from tendermint_tpu.types.block import (
+            BLOCK_ID_FLAG_COMMIT,
+            BlockID,
+            Commit,
+            CommitSig,
+            PartSetHeader,
+        )
+        from tendermint_tpu.types.validator import Validator
+        from tendermint_tpu.types.validator_set import ValidatorSet
+
+        chain = "bls-bench"
+        bid = BlockID(hash=b"\x11" * 32, parts=PartSetHeader(1, b"\x22" * 32))
+        out = {"bls_valsets": list(BLS_VALSETS)}
+        provider = BLSBatchVerifier(use_device=False)
+        # guard keys come from the LARGEST size regardless of the env
+        # list's order (a non-ascending TM_BENCH_BLS_VALS must not
+        # record a small-set ratio as the guard baseline)
+        guard_size = max(BLS_VALSETS)
+        ratio = speedup = None
+        for v_count in BLS_VALSETS:
+            privs = [
+                BLSPrivKey.from_secret(b"bench-%d" % i) for i in range(v_count)
+            ]
+            for p in privs:
+                p.register_possession()  # the aggregation admission gate
+            vals = [
+                Validator(pub_key=p.pub_key(), voting_power=10) for p in privs
+            ]
+            vs = ValidatorSet(vals)
+            by_addr = {p.pub_key().address(): p for p in privs}
+
+            # the canonical aggregate message + one sig per validator
+            ts = 1_700_000_000 * 10**9
+            from tendermint_tpu.types.aggregate import AggregatedCommit
+            from tendermint_tpu.utils.bits import BitArray
+
+            msg = AggregatedCommit(
+                height=7, round=0, block_id=bid, timestamp_ns=ts,
+                signers=BitArray(v_count), agg_sig=b"\x00" * 96,
+            ).sign_bytes(chain)
+            hm = _ref.hash_to_curve_g2(msg, _ref.DST_SIG)
+            agg_sigs = []
+            for val in vs.validators:
+                sk = by_addr[val.address]._sk
+                agg_sigs.append(_ref.g2_compress(_ref.g2_mul(sk, hm)))
+            agg = aggregate_commit_votes(chain, 7, 0, bid, ts, v_count, agg_sigs)
+
+            # per-sig commit bytes (every row carries its own 96 B sig)
+            commit = Commit(
+                height=7, round=0, block_id=bid,
+                signatures=[
+                    CommitSig(
+                        block_id_flag=BLOCK_ID_FLAG_COMMIT,
+                        validator_address=val.address,
+                        timestamp_ns=ts,
+                        signature=sig,
+                    )
+                    for val, sig in zip(vs.validators, agg_sigs)
+                ],
+            )
+            persig_bytes = sum(len(cs.encode()) for cs in commit.signatures)
+            agg_bytes = agg.wire_bytes()
+
+            # verify latency: aggregate check vs per-row pairing sample
+            t0 = _time.perf_counter()
+            vs.verify_aggregated_commit(chain, bid, 7, agg, bls_provider=provider)
+            agg_s = _time.perf_counter() - t0
+            sample = min(BLS_PERSIG_SAMPLE, v_count)
+            import numpy as _np
+
+            pk_rows = _np.stack(
+                [
+                    _np.frombuffer(val.pub_key.bytes(), dtype=_np.uint8)
+                    for val in vs.validators[:sample]
+                ]
+            )
+            mg_rows = _np.broadcast_to(
+                _np.frombuffer(msg, dtype=_np.uint8), (sample, len(msg))
+            ).copy()
+            sg_rows = _np.stack(
+                [
+                    _np.frombuffer(s, dtype=_np.uint8)
+                    for s in agg_sigs[:sample]
+                ]
+            )
+            t0 = _time.perf_counter()
+            ok = provider.verify_batch(pk_rows, mg_rows, sg_rows)
+            persig_sample_s = _time.perf_counter() - t0
+            assert bool(ok.all()), "per-sig sample must verify"
+            persig_s = persig_sample_s / sample * v_count
+
+            out[f"bls_commit_bytes_persig_{v_count}"] = persig_bytes
+            out[f"bls_commit_bytes_agg_{v_count}"] = agg_bytes
+            out[f"bls_agg_verify_ms_{v_count}"] = round(agg_s * 1e3, 1)
+            out[f"bls_persig_verify_ms_{v_count}"] = round(persig_s * 1e3, 1)
+            size_ratio = round(persig_bytes / agg_bytes, 2)
+            size_speedup = round(persig_s / agg_s, 2)
+            if v_count == guard_size:
+                ratio, speedup = size_ratio, size_speedup
+
+            # the ed25519 pipeline at the same size (unguarded context)
+            epk, emsgs, esigs = make_batch(v_count)
+            from tendermint_tpu.crypto.batch import CPUBatchVerifier
+
+            ecpu = CPUBatchVerifier()
+            t0 = _time.perf_counter()
+            eok = ecpu.verify_batch(epk[:v_count], emsgs[:v_count], esigs[:v_count])
+            ed_s = _time.perf_counter() - t0
+            assert bool(_np.asarray(eok).all())
+            out[f"bls_vs_ed25519_verify_ms_{v_count}"] = round(ed_s * 1e3, 1)
+            log(
+                f"bls @{v_count} vals: bytes {persig_bytes} -> {agg_bytes} "
+                f"({size_ratio}x), verify per-sig {persig_s*1e3:.0f} ms "
+                f"(sample {sample}) vs aggregate {agg_s*1e3:.0f} ms "
+                f"({size_speedup}x); ed25519 pipeline {ed_s*1e3:.1f} ms"
+            )
+        out["bls_persig_sample"] = BLS_PERSIG_SAMPLE
+        out["bls_commit_bytes_ratio"] = ratio
+        out["bls_verify_speedup"] = speedup
+        return out
+    except Exception as ex:
+        import traceback
+
+        traceback.print_exc(file=sys.stderr)
+        log(f"bls measurement failed: {ex!r}")
+        return {"bls_error": repr(ex)[:200]}
 
 
 # -- ingest: batched mempool admission vs per-tx serial CheckTx ------------

@@ -60,17 +60,30 @@ def cmd_init(args) -> None:
         write_config_file(cfg_file, cfg)
 
     pv = load_or_gen_file_pv(
-        cfg.base.priv_validator_key_file(), cfg.base.priv_validator_state_file()
+        cfg.base.priv_validator_key_file(),
+        cfg.base.priv_validator_state_file(),
+        key_type=cfg.base.priv_validator_key_type,
     )
     load_or_gen_node_key(cfg.base.node_key_file())
 
     genesis_file = cfg.base.genesis_file()
     if not os.path.exists(genesis_file):
+        # BLS keys carry a proof-of-possession in genesis — the
+        # rogue-key admission gate for aggregated commits
+        # (docs/bls-aggregation.md)
+        pop = (
+            pv.key.priv_key.register_possession()
+            if pv.key.priv_key.type_name == "bls12-381"
+            else b""
+        )
         doc = GenesisDoc(
             chain_id=args.chain_id or f"test-chain-{os.urandom(3).hex()}",
             genesis_time_ns=time.time_ns(),
             validators=[
-                GenesisValidator(pub_key=pv.get_pub_key(), power=10, name="")
+                GenesisValidator(
+                    pub_key=pv.get_pub_key(), power=10, name="",
+                    proof_of_possession=pop,
+                )
             ],
         )
         doc.validate_and_complete()

@@ -128,6 +128,20 @@ class BaseConfig:
     ingest_bundle_txs: int = 256
     ingest_flush_ms: int = 2
     ingest_hash_threshold: int = 64
+    # BLS12-381 signature aggregation (crypto/bls.py, models/bls.py;
+    # docs/bls-aggregation.md): bls_device enables the batched device
+    # kernels (pairing checks, hash-to-G2 maps, aggregate-pubkey sums)
+    # behind the breaker-gated host-oracle fallback; buckets compile
+    # lazily on the first BLS row, so an all-ed25519 chain never pays a
+    # BLS compile. bls_device_rows is the minimum batch before the
+    # device path engages (below it, the pure-Python oracle wins on
+    # dispatch overhead). TM_BLS_DEVICE / TM_BLS_DEVICE_ROWS override
+    # without editing toml. priv_validator_key_type selects the scheme
+    # for a FRESHLY GENERATED validator key ("ed25519" | "bls12-381");
+    # existing key files keep their recorded type.
+    bls_device: bool = True
+    bls_device_rows: int = 2
+    priv_validator_key_type: str = "ed25519"
 
     def genesis_file(self) -> str:
         return _rootify(self.genesis_file_name, self.root_dir)
@@ -177,6 +191,10 @@ class BaseConfig:
             return "ingest_flush_ms can't be negative"
         if self.ingest_hash_threshold < 1:
             return "ingest_hash_threshold must be >= 1"
+        if self.bls_device_rows < 1:
+            return "bls_device_rows must be >= 1"
+        if self.priv_validator_key_type not in ("ed25519", "bls12-381"):
+            return f"unknown priv_validator_key_type {self.priv_validator_key_type!r}"
         return None
 
 
@@ -580,6 +598,16 @@ def load_config(path: str) -> Config:
     env_provider = os.environ.get("TM_CRYPTO_PROVIDER")
     if env_provider:
         cfg.base.crypto_provider = env_provider
+    # BLS device kill switch + batch floor (docs/running-in-production.md)
+    env_bls = os.environ.get("TM_BLS_DEVICE")
+    if env_bls is not None:
+        cfg.base.bls_device = env_bls not in ("0", "false", "")
+    env_bls_rows = os.environ.get("TM_BLS_DEVICE_ROWS")
+    if env_bls_rows:
+        try:
+            cfg.base.bls_device_rows = int(env_bls_rows)
+        except ValueError:
+            pass
     return cfg
 
 

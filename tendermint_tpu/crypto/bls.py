@@ -1,0 +1,489 @@
+"""BLS12-381 keys and the batched BLS verification provider.
+
+The signature-aggregation track (ROADMAP item 3, arxiv 2302.00418): at
+large validator counts every commit drags one ed25519 signature per
+validator through gossip, storage and verify; a BLS commit carries ONE
+96-byte aggregate signature plus a signer bitmap, and verification
+collapses to a pairing check against an aggregated pubkey.
+
+Scheme: min-pk (pubkeys in G1 — 48-byte compressed, signatures in G2 —
+96 bytes), with PROOF-OF-POSSESSION registration: aggregation is only
+sound over keys whose owner demonstrated knowledge of the secret
+(rogue-key defense — an adversary who registers pk' = pk_evil - pk_victim
+could otherwise forge the victim into aggregates). ``prove_possession``
+/ ``verify_possession`` wrap the repo ciphersuite's POP tag; the
+AggregatedCommit path refuses keys without a verified PoP.
+
+Layering mirrors ed25519 exactly:
+
+- host keys here (BLSPubKey/BLSPrivKey, registered with the pubkey
+  registry so validator sets, genesis and wire codecs carry them);
+- the pure-Python oracle in ops/ref_bls12.py is the reference verifier
+  and permanent fallback;
+- batched device kernels in ops/bls12.py behind models/bls.BLSEngine;
+- BLSBatchVerifier adapts the engine to the crypto/batch.BatchVerifier
+  seam — (N, 48) pubkeys, (N, L) messages, (N, 96) signatures — so
+  PipelinedVerifier micro-batching and the SigCache dedupe work on BLS
+  rows UNMODIFIED (the pipeline is shape-generic and the cache keys
+  raw bytes).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from tendermint_tpu.crypto.hash import address_hash
+from tendermint_tpu.crypto.keys import PrivKey, PubKey, register_pubkey_type
+from tendermint_tpu.ops import ref_bls12 as ref
+
+BLS_TYPE = "bls12-381"
+BLS_PUBKEY_SIZE = 48
+BLS_PRIVKEY_SIZE = 32
+BLS_SIGNATURE_SIZE = 96
+
+
+class BLSPubKey(PubKey):
+    """48-byte compressed G1 pubkey. Decoding (decompression + subgroup
+    check) is lazy and cached — construction from wire bytes stays
+    cheap, verification rejects invalid encodings as bad signatures."""
+
+    type_name = BLS_TYPE
+    __slots__ = ("_raw", "_pt", "_checked")
+
+    def __init__(self, raw: bytes):
+        if len(raw) != BLS_PUBKEY_SIZE:
+            raise ValueError(f"bls12-381 pubkey must be {BLS_PUBKEY_SIZE} bytes")
+        self._raw = bytes(raw)
+        self._pt = None
+        self._checked = False
+
+    def address(self) -> bytes:
+        return address_hash(self._raw)
+
+    def bytes(self) -> bytes:
+        return self._raw
+
+    def point(self):
+        """The decoded G1 point, or None when the encoding is invalid
+        (not on curve / not in the r-torsion subgroup / infinity)."""
+        if not self._checked:
+            self._checked = True
+            try:
+                pt = ref.g1_decompress(self._raw)
+            except ValueError:
+                pt = None
+            if pt is not None and not ref.g1_in_subgroup(pt):
+                pt = None
+            self._pt = pt
+        return self._pt
+
+    def verify(self, msg: bytes, sig: bytes) -> bool:
+        pt = self.point()
+        if pt is None:
+            return False
+        sig_pt = decode_signature(sig)
+        if sig_pt is None:
+            return False
+        return ref.verify(pt, msg, sig_pt)
+
+    def verify_possession(self, pop: bytes) -> bool:
+        """PoP over this key's compressed bytes (the aggregation
+        admission check)."""
+        pt = self.point()
+        if pt is None:
+            return False
+        pop_pt = decode_signature(pop)
+        if pop_pt is None:
+            return False
+        return ref.verify_possession(pt, pop_pt)
+
+    def __repr__(self) -> str:
+        return f"PubKeyBLS12_381{{{self._raw.hex()[:16]}…}}"
+
+
+class BLSPrivKey(PrivKey):
+    type_name = BLS_TYPE
+    __slots__ = ("_sk", "_pub")
+
+    def __init__(self, raw: bytes):
+        if len(raw) != BLS_PRIVKEY_SIZE:
+            raise ValueError(f"bls12-381 privkey must be {BLS_PRIVKEY_SIZE} bytes")
+        self._sk = ref.sk_from_bytes(raw)
+        self._pub = BLSPubKey(ref.g1_compress(ref.sk_to_pk(self._sk)))
+
+    @classmethod
+    def generate(cls) -> "BLSPrivKey":
+        return cls.from_secret(os.urandom(32))
+
+    @classmethod
+    def from_secret(cls, secret: bytes) -> "BLSPrivKey":
+        """Deterministic key from seed material (test fixtures and
+        CLI keygen; ref.keygen's uniform reduction)."""
+        sk = ref.keygen(secret)
+        return cls(sk.to_bytes(32, "big"))
+
+    def bytes(self) -> bytes:
+        return self._sk.to_bytes(32, "big")
+
+    def sign(self, msg: bytes) -> bytes:
+        return ref.g2_compress(ref.sign(self._sk, msg))
+
+    def prove_possession(self) -> bytes:
+        return ref.g2_compress(ref.prove_possession(self._sk))
+
+    def register_possession(self) -> bytes:
+        """Self-registration: prove possession of this key and record
+        it in the process-wide PoP registry (the aggregation admission
+        check). Returns the proof for transport (genesis field /
+        gossip)."""
+        pop = self.prove_possession()
+        register_possession(self._pub.bytes(), pop)
+        return pop
+
+    def pub_key(self) -> BLSPubKey:
+        return self._pub
+
+    def __eq__(self, other) -> bool:
+        import hmac
+
+        return isinstance(other, BLSPrivKey) and hmac.compare_digest(
+            self.bytes(), other.bytes()
+        )
+
+    def __repr__(self) -> str:
+        return "PrivKeyBLS12_381{…}"
+
+
+register_pubkey_type(BLS_TYPE, BLSPubKey)
+
+
+def is_batch_bls(pub_key) -> bool:
+    """True when `pub_key` can ride the batched BLS verifier (the
+    is_batch_ed25519 analogue — the single source of truth for routing
+    commit rows to the BLS provider vs per-key serial verify)."""
+    return isinstance(pub_key, BLSPubKey)
+
+
+def decode_signature(sig: bytes):
+    """96 bytes -> G2 point or None (malformed / off-curve / out of
+    subgroup / infinity — all rejected as invalid signatures)."""
+    if len(sig) != BLS_SIGNATURE_SIZE:
+        return None
+    try:
+        pt = ref.g2_decompress(sig)
+    except ValueError:
+        return None
+    if pt is None or not ref.g2_in_subgroup(pt):
+        return None
+    return pt
+
+
+def aggregate_signatures(sigs: Sequence[bytes]) -> Optional[bytes]:
+    """Sum of G2 signatures -> 96-byte aggregate (None when any input
+    is malformed or the list is empty)."""
+    if not sigs:
+        return None
+    pts = []
+    for s in sigs:
+        pt = decode_signature(s)
+        if pt is None:
+            return None
+        pts.append(pt)
+    return ref.g2_compress(ref.aggregate_sigs(pts))
+
+
+# -- proof-of-possession registry -------------------------------------------
+#
+# Aggregation is only sound over keys whose owner demonstrated
+# knowledge of the secret. This process-wide registry is the enforcement
+# point: ValidatorSet.verify_aggregated_commit REFUSES any flagged
+# signer whose key has no VERIFIED possession proof here, so a rogue
+# key (pk' = pk_atk - pk_victim — a perfectly valid subgroup point)
+# can be a validator but can never contribute to an aggregate: its
+# owner cannot produce a PoP for it. Registration happens wherever the
+# proof travels — the genesis validator's proof_of_possession field
+# (types/genesis.py) registers at load; key owners self-register via
+# BLSPrivKey.register_possession.
+
+_pop_lock = threading.Lock()
+_pop_verified: set = set()
+
+
+def register_possession(pk_bytes: bytes, pop: bytes) -> bool:
+    """Verify `pop` for the 48-byte pubkey and record it. Returns the
+    verification verdict; only TRUE verdicts are ever recorded."""
+    try:
+        pk = BLSPubKey(bytes(pk_bytes))
+    except ValueError:
+        return False
+    if not pk.verify_possession(pop):
+        return False
+    with _pop_lock:
+        _pop_verified.add(bytes(pk_bytes))
+    return True
+
+
+def has_possession(pk_bytes: bytes) -> bool:
+    with _pop_lock:
+        return bytes(pk_bytes) in _pop_verified
+
+
+def clear_possessions() -> None:
+    """Test isolation hook — production never unregisters."""
+    with _pop_lock:
+        _pop_verified.clear()
+
+
+# -- the BatchVerifier-seam provider ----------------------------------------
+
+
+class BLSBatchVerifier:
+    """Batched min-pk verification over rectangular u8 arrays:
+    pubkeys (N, 48), msgs (N, L), sigs (N, 96) -> (N,) bool.
+
+    Satisfies the crypto/batch.BatchVerifier contract (verify_batch /
+    verify_commit_batch) so PipelinedVerifier wraps it unmodified and
+    the SigCache dedupes BLS triples exactly like ed25519 ones. Rows
+    run in three stages: host decode (pubkey/signature points, cached
+    per raw bytes), hash-to-G2 (host expand_message_xmd feeding the
+    device map when warm, oracle otherwise), and the pairing checks
+    (device rows when the engine serves the shape, oracle fallback —
+    verdicts bit-identical either way, pinned by tests)."""
+
+    name = "bls"
+
+    def __init__(self, engine=None, use_device: bool = True,
+                 min_device_rows: int = 2):
+        self._engine = engine
+        self.use_device = use_device
+        self.min_device_rows = min_device_rows
+        self._pk_cache: Dict[bytes, object] = {}
+        self._pk_cache_cap = 1 << 14
+        self._lock = threading.Lock()
+        self.counters: Dict[str, int] = {
+            "rows": 0, "device_rows": 0, "host_rows": 0,
+            "device_maps": 0, "host_maps": 0,
+            "aggregate_checks": 0, "device_aggregates": 0,
+        }
+
+    @property
+    def engine(self):
+        if self._engine is None and self.use_device:
+            from tendermint_tpu.models.bls import BLSEngine
+
+            self._engine = BLSEngine(block_on_compile=False)
+        return self._engine
+
+    def warmup(self, sizes=(8,), background: bool = True, **_kw):
+        eng = self.engine
+        if eng is None:
+            return None
+        kinds = []
+        for s in sizes:
+            kinds += [("verify", s), ("map", s)]
+        kinds.append(("agg", 64))
+        return eng.warmup(kinds=kinds, background=background)
+
+    def _decode_pk(self, raw: bytes):
+        with self._lock:
+            if raw in self._pk_cache:
+                return self._pk_cache[raw]
+        try:
+            pt = ref.g1_decompress(raw)
+        except ValueError:
+            pt = None
+        if pt is not None and not ref.g1_in_subgroup(pt):
+            pt = None
+        with self._lock:
+            if len(self._pk_cache) >= self._pk_cache_cap:
+                self._pk_cache.clear()  # valsets are small; full reset is fine
+            self._pk_cache[raw] = pt
+        return pt
+
+    def _hash_rows(self, msgs: List[bytes]):
+        """Distinct messages -> G2 points, device map when available."""
+        uniq: Dict[bytes, int] = {}
+        order: List[bytes] = []
+        for m in msgs:
+            if m not in uniq:
+                uniq[m] = len(order)
+                order.append(m)
+        us = [ref.hash_to_field_fp2(m, ref.DST_SIG, 2) for m in order]
+        pts = None
+        eng = self.engine if self.use_device else None
+        if eng is not None and len(order) >= self.min_device_rows:
+            try:
+                pts = eng.map_rows([(u[0], u[1]) for u in us])
+            except Exception:
+                pts = None  # breaker recorded inside the engine
+        if pts is not None:
+            self.counters["device_maps"] += len(order)
+        else:
+            self.counters["host_maps"] += len(order)
+            pts = [
+                ref.clear_cofactor_g2(
+                    ref.g2_add(
+                        ref.map_to_curve_svdw(u[0]), ref.map_to_curve_svdw(u[1])
+                    )
+                )
+                for u in us
+            ]
+        return [pts[uniq[m]] for m in msgs]
+
+    def verify_batch(self, pubkeys, msgs, sigs, msg_lens=None) -> np.ndarray:
+        pubkeys = np.asarray(pubkeys, dtype=np.uint8)
+        msgs = np.asarray(msgs, dtype=np.uint8)
+        sigs = np.asarray(sigs, dtype=np.uint8)
+        n = len(pubkeys)
+        out = np.zeros(n, dtype=bool)
+        self.counters["rows"] += n
+        rows = []  # (row index, pk point, msg bytes, sig point)
+        for i in range(n):
+            pk = self._decode_pk(bytes(bytearray(pubkeys[i])))
+            if pk is None:
+                continue
+            sig = decode_signature(bytes(bytearray(sigs[i])))
+            if sig is None:
+                continue
+            m = bytes(bytearray(msgs[i]))
+            if msg_lens is not None:
+                m = m[: int(msg_lens[i])]
+            rows.append((i, pk, m, sig))
+        if not rows:
+            return out
+        hms = self._hash_rows([r[2] for r in rows])
+        ok = None
+        eng = self.engine if self.use_device else None
+        if eng is not None and len(rows) >= self.min_device_rows:
+            try:
+                ok = eng.verify_rows(
+                    [(r[1], hm, r[3]) for r, hm in zip(rows, hms)]
+                )
+            except Exception:
+                ok = None  # breaker recorded inside the engine
+        if ok is not None:
+            self.counters["device_rows"] += len(rows)
+        else:
+            self.counters["host_rows"] += len(rows)
+            ok = [
+                ref.pairing_product_is_one(
+                    [(r[1], hm), (ref.g1_neg(ref.G1_GEN), r[3])]
+                )
+                for r, hm in zip(rows, hms)
+            ]
+        for (i, *_), v in zip(rows, ok):
+            out[i] = bool(v)
+        return out
+
+    def stats(self) -> Dict[str, float]:
+        """Provider + engine counters for the tendermint_bls_* metric
+        family (utils/metrics.BLSMetrics; docs/metrics.md). Engine keys
+        are prefixed so the two sources can't collide."""
+        s: Dict[str, float] = dict(self.counters)
+        s["device_enabled"] = 1 if (self.use_device and self._engine is not None) else 0
+        eng = self._engine
+        if eng is not None:
+            for k, v in eng.stats.items():
+                s[f"engine_{k}"] = v
+        return s
+
+    def verify_commit_batch(self, pubkeys, msgs, sigs, powers, counted):
+        ok = self.verify_batch(pubkeys, msgs, sigs)
+        talled = int(np.sum(np.where(ok & np.asarray(counted, dtype=bool),
+                                     np.asarray(powers), 0)))
+        return ok, talled
+
+    # -- aggregate path (the one-signature-per-commit shape) ---------------
+
+    def aggregate_pubkey(
+        self, pk_table: Sequence[bytes], mask: np.ndarray
+    ):
+        """Sum the selected pubkeys: 48-byte rows + bool mask -> G1
+        point (None = empty selection or an invalid table row). Device
+        masked-tree when warm, oracle accumulation otherwise."""
+        mask = np.asarray(mask, dtype=bool)
+        pts = []
+        for raw in pk_table:
+            pt = self._decode_pk(bytes(raw))
+            pts.append(pt)
+        sel = [i for i in range(len(pts)) if i < len(mask) and mask[i]]
+        if not sel:
+            return None
+        if any(pts[i] is None for i in sel):
+            return None
+        eng = self.engine if self.use_device else None
+        if eng is not None and len(pts) >= self.min_device_rows:
+            try:
+                agg = eng.aggregate(
+                    [pt if pt is not None else ref.G1_GEN for pt in pts],
+                    np.asarray(mask, dtype=bool)[None, : len(pts)],
+                )
+            except Exception:
+                agg = None
+            if agg is not None:
+                self.counters["device_aggregates"] += 1
+                return agg[0]
+        return ref.aggregate_pubkeys([pts[i] for i in sel])
+
+    def verify_aggregate(
+        self, pk_table: Sequence[bytes], mask: np.ndarray, msg: bytes,
+        agg_sig: bytes,
+    ) -> bool:
+        """One-message aggregate check: e(sum pk_i, H(msg)) == e(G1, sig).
+        The AggregatedCommit verification core."""
+        self.counters["aggregate_checks"] += 1
+        apk = self.aggregate_pubkey(pk_table, mask)
+        if apk is None:
+            return False
+        sig_pt = decode_signature(agg_sig)
+        if sig_pt is None:
+            return False
+        hm = self._hash_rows([msg])[0]
+        eng = self.engine if self.use_device else None
+        if eng is not None:
+            try:
+                ok = eng.verify_rows([(apk, hm, sig_pt)])
+            except Exception:
+                ok = None
+            if ok is not None:
+                return bool(ok[0])
+        return ref.pairing_product_is_one(
+            [(apk, hm), (ref.g1_neg(ref.G1_GEN), sig_pt)]
+        )
+
+
+# -- default provider (the crypto/batch.py get/set shape) -------------------
+
+_lock = threading.Lock()
+_default: Optional[BLSBatchVerifier] = None
+
+
+def get_default_bls_provider() -> BLSBatchVerifier:
+    global _default
+    with _lock:
+        if _default is None:
+            # host-only until a node configures the device engine
+            _default = BLSBatchVerifier(use_device=False)
+        return _default
+
+
+def set_default_bls_provider(v: BLSBatchVerifier) -> None:
+    global _default
+    with _lock:
+        _default = v
+
+
+def make_bls_provider(
+    device: bool = True, block_on_compile: bool = False
+) -> BLSBatchVerifier:
+    if not device:
+        return BLSBatchVerifier(use_device=False)
+    from tendermint_tpu.models.bls import BLSEngine
+
+    return BLSBatchVerifier(
+        engine=BLSEngine(block_on_compile=block_on_compile), use_device=True
+    )

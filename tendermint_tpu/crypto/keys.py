@@ -166,11 +166,30 @@ class Ed25519PrivKey(PrivKey):
 
 # -- serialization of keys (type-prefixed, replaces amino registry) ---------
 
+
+class ErrUnknownPubKeyType(ValueError):
+    """decode_pubkey met a type name no scheme registered — a peer on a
+    newer protocol, or corrupted bytes that still framed as a string.
+    Distinct from malformed framing so callers can tell "upgrade
+    needed" apart from "garbage on the wire"."""
+
+
+class ErrMalformedPubKey(ValueError):
+    """decode_pubkey could not frame the payload (truncated/overlong
+    bytes, or a payload the scheme constructor rejects)."""
+
+
 _PUBKEY_TYPES = {}
 
 
 def register_pubkey_type(type_name: str, ctor) -> None:
     _PUBKEY_TYPES[type_name] = ctor
+
+
+def registered_pubkey_types() -> tuple:
+    """The registered type names (test surface for the encode/decode
+    round-trip property; order is registration order)."""
+    return tuple(_PUBKEY_TYPES)
 
 
 register_pubkey_type(ED25519_TYPE, Ed25519PubKey)
@@ -183,12 +202,28 @@ def encode_pubkey(pk: PubKey) -> bytes:
 
 
 def decode_pubkey(data: bytes) -> PubKey:
+    """Typed failure modes (ISSUE-10 registry hardening):
+    ErrUnknownPubKeyType for an unregistered type name,
+    ErrMalformedPubKey for truncated/trailing/rejected payloads. Both
+    subclass ValueError, so pre-existing callers that caught that keep
+    working."""
     from tendermint_tpu.codec.binary import Reader
 
     r = Reader(data)
-    type_name = r.read_str()
-    raw = r.read_bytes()
+    try:
+        type_name = r.read_str()
+        raw = r.read_bytes()
+        r.expect_done()
+    except Exception as e:
+        raise ErrMalformedPubKey(f"malformed pubkey encoding: {e!r}") from e
     ctor = _PUBKEY_TYPES.get(type_name)
     if ctor is None:
-        raise ValueError(f"unknown pubkey type {type_name!r}")
-    return ctor(raw)
+        raise ErrUnknownPubKeyType(f"unknown pubkey type {type_name!r}")
+    try:
+        return ctor(raw)
+    except ErrUnknownPubKeyType:
+        raise  # nested decode (multisig) already classified it
+    except Exception as e:
+        raise ErrMalformedPubKey(
+            f"invalid {type_name} pubkey payload: {e!r}"
+        ) from e

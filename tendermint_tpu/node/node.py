@@ -194,6 +194,20 @@ class Node(Service):
             mesh_devices=0 if mesh is None else mesh.devices.size,
         )
 
+        # -- BLS aggregation track (crypto/bls.py; docs/bls-aggregation.md)
+        # The provider behind every BLS validator row and every
+        # AggregatedCommit check. Device kernels compile LAZILY on the
+        # first BLS row, so an all-ed25519 chain pays nothing; the
+        # host oracle is the breaker-gated fallback either way.
+        from tendermint_tpu.crypto.bls import (
+            make_bls_provider,
+            set_default_bls_provider,
+        )
+
+        self.bls_provider = make_bls_provider(device=config.base.bls_device)
+        self.bls_provider.min_device_rows = config.base.bls_device_rows
+        set_default_bls_provider(self.bls_provider)
+
         # -- device merkle engine (crypto/merkle.py seam) --------------------
         # Tx roots / part-set roots / validator-set hashes with at least
         # merkle_device_threshold leaves batch onto the accelerator;
@@ -347,6 +361,7 @@ class Node(Service):
         )
 
         from tendermint_tpu.utils.metrics import (
+            BLSMetrics,
             CryptoMetrics,
             HealthMetrics,
             IngestMetrics,
@@ -367,6 +382,7 @@ class Node(Service):
         self.health_metrics = HealthMetrics(self.metrics_registry, ns)
         self.lightserve_metrics = LightServeMetrics(self.metrics_registry, ns)
         self.ingest_metrics = IngestMetrics(self.metrics_registry, ns)
+        self.bls_metrics = BLSMetrics(self.metrics_registry, ns)
         if self.ingest is not None:
             # direct handle for the bundle-size histogram (distributions
             # can't be rebuilt from snapshot deltas, the LightServe
@@ -469,6 +485,15 @@ class Node(Service):
             key, all_pk, ed = self._state_at_boot.validators.batch_cache()
             if bool(ed.all()) and len(all_pk):
                 self.crypto_provider.register_valset(key, all_pk)
+        # Warm the BLS device buckets only when this chain's validator
+        # set actually holds BLS keys — an all-ed25519 chain (and every
+        # test rig) never pays a BLS kernel compile.
+        if self.config.base.bls_device:
+            _, bls_mask = self._state_at_boot.validators.bls_cache()
+            if bool(bls_mask.any()):
+                self.bls_provider.warmup(
+                    sizes=(self.config.base.bls_device_rows,), background=True
+                )
         # Warm the merkle engine's bucket for THIS chain's validator-set
         # hash only when the set is big enough to ever ride the device —
         # small chains (and test rigs) never pay a merkle compile.
@@ -725,6 +750,7 @@ class Node(Service):
             )
             if self.lightserve is not None:
                 self.lightserve_metrics.update(self.lightserve.stats())
+            self.bls_metrics.update(self.bls_provider.stats())
             # lane counters move regardless of the ingest front-end —
             # the QoS lane lives in the mempool (docs/metrics.md)
             self.ingest_metrics.update(
@@ -789,7 +815,9 @@ def default_new_node(config: Config, app=None, logger=None) -> Node:
         pv = SignerClient(config.base.priv_validator_laddr)
     else:
         pv = load_or_gen_file_pv(
-            config.base.priv_validator_key_file(), config.base.priv_validator_state_file()
+            config.base.priv_validator_key_file(),
+            config.base.priv_validator_state_file(),
+            key_type=config.base.priv_validator_key_type,
         )
     genesis = GenesisDoc.from_file(config.base.genesis_file())
     return Node(config, genesis, pv, node_key, app=app, logger=logger)

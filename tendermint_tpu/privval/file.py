@@ -23,10 +23,25 @@ from dataclasses import dataclass
 from typing import Optional
 
 from tendermint_tpu.codec import signbytes
-from tendermint_tpu.crypto.keys import Ed25519PrivKey, Ed25519PubKey, PubKey
+from tendermint_tpu.crypto.keys import Ed25519PrivKey, Ed25519PubKey, PrivKey, PubKey
 from tendermint_tpu.types.priv_validator import PrivValidator
 from tendermint_tpu.types.proposal import Proposal
 from tendermint_tpu.types.vote import Vote
+
+# key-type registry for the on-disk priv_validator_key.json: the BLS
+# aggregation track (crypto/bls.py, docs/bls-aggregation.md) signs with
+# the same FilePV double-sign protection as ed25519 — the sign state is
+# key-type-agnostic.
+
+
+def _key_classes(key_type: str):
+    if key_type == "ed25519":
+        return Ed25519PrivKey, Ed25519PubKey
+    if key_type == "bls12-381":
+        from tendermint_tpu.crypto.bls import BLSPrivKey, BLSPubKey
+
+        return BLSPrivKey, BLSPubKey
+    raise ValueError(f"unknown priv validator key type {key_type!r}")
 
 STEP_NONE = 0
 STEP_PROPOSAL = 1
@@ -69,16 +84,17 @@ class FilePVKey:
 
     address: bytes
     pub_key: PubKey
-    priv_key: Ed25519PrivKey
+    priv_key: PrivKey
     file_path: str = ""
 
     def save(self) -> None:
         if not self.file_path:
             raise ValueError("cannot save PV key: filePath not set")
+        kt = self.priv_key.type_name
         doc = {
             "address": self.address.hex(),
-            "pub_key": {"type": "ed25519", "value": self.pub_key.bytes().hex()},
-            "priv_key": {"type": "ed25519", "value": self.priv_key.bytes().hex()},
+            "pub_key": {"type": kt, "value": self.pub_key.bytes().hex()},
+            "priv_key": {"type": kt, "value": self.priv_key.bytes().hex()},
         }
         _atomic_write(self.file_path, json.dumps(doc, indent=2))
 
@@ -86,8 +102,10 @@ class FilePVKey:
     def load(cls, path: str) -> "FilePVKey":
         with open(path) as fp:
             doc = json.load(fp)
-        priv = Ed25519PrivKey(bytes.fromhex(doc["priv_key"]["value"]))
-        pub = Ed25519PubKey(bytes.fromhex(doc["pub_key"]["value"]))
+        key_type = doc["priv_key"].get("type", "ed25519")
+        priv_cls, pub_cls = _key_classes(key_type)
+        priv = priv_cls(bytes.fromhex(doc["priv_key"]["value"]))
+        pub = pub_cls(bytes.fromhex(doc["pub_key"]["value"]))
         if pub.bytes() != priv.pub_key().bytes():
             raise ValueError("priv_validator key file: pub/priv key mismatch")
         return cls(
@@ -167,13 +185,16 @@ class FilePV(PrivValidator):
     # -- constructors ------------------------------------------------------
 
     @classmethod
-    def generate(cls, key_file_path: str, state_file_path: str) -> "FilePV":
-        priv = Ed25519PrivKey.generate()
+    def generate(
+        cls, key_file_path: str, state_file_path: str, key_type: str = "ed25519"
+    ) -> "FilePV":
+        priv_cls, _ = _key_classes(key_type)
+        priv = priv_cls.generate()
         return cls.from_priv_key(priv, key_file_path, state_file_path)
 
     @classmethod
     def from_priv_key(
-        cls, priv: Ed25519PrivKey, key_file_path: str, state_file_path: str
+        cls, priv: PrivKey, key_file_path: str, state_file_path: str
     ) -> "FilePV":
         pub = priv.pub_key()
         return cls(
@@ -315,10 +336,15 @@ def load_file_pv(key_file_path: str, state_file_path: str) -> FilePV:
     return FilePV(key, state)
 
 
-def load_or_gen_file_pv(key_file_path: str, state_file_path: str) -> FilePV:
-    """Reference LoadOrGenFilePV privval/file.go:199."""
+def load_or_gen_file_pv(
+    key_file_path: str, state_file_path: str, key_type: str = "ed25519"
+) -> FilePV:
+    """Reference LoadOrGenFilePV privval/file.go:199. ``key_type``
+    selects the scheme for a FRESH key ("ed25519" | "bls12-381",
+    config ``priv_validator_key_type``); an existing file keeps
+    whatever type it was generated with."""
     if os.path.exists(key_file_path):
         return load_file_pv(key_file_path, state_file_path)
-    pv = FilePV.generate(key_file_path, state_file_path)
+    pv = FilePV.generate(key_file_path, state_file_path, key_type=key_type)
     pv.save()
     return pv

@@ -19,6 +19,14 @@ from tendermint_tpu.version import BLOCK_PROTOCOL
 
 MAX_HEADER_BYTES = 653
 
+# Max signature width over the registered key schemes: ed25519/
+# secp256k1/sr25519 = 64, BLS12-381 G2 = 96 (crypto/bls.py). Reference
+# MaxSignatureSize, widened for the signature-aggregation track —
+# every sig-size bound (CommitSig/Vote/Proposal validate_basic, the
+# VoteSet byte cap, commit batch packing) derives from here so the
+# accepted wire language can never drift per call site.
+MAX_SIGNATURE_SIZE = 96
+
 # CommitSig BlockIDFlag (reference types/block.go:437-447)
 BLOCK_ID_FLAG_ABSENT = 1
 BLOCK_ID_FLAG_COMMIT = 2
@@ -147,7 +155,7 @@ class CommitSig:
                 return "expected ValidatorAddress size 20"
             if not self.signature:
                 return "signature is missing"
-            if len(self.signature) > 64:
+            if len(self.signature) > MAX_SIGNATURE_SIZE:
                 return "signature too big"
         return None
 

@@ -20,12 +20,27 @@ from tendermint_tpu.types.validator import Validator
 MAX_CHAIN_ID_LEN = 50
 
 
+def _parse_pop_hex(raw) -> bytes:
+    """Tolerant proof_of_possession decode: a malformed value (bad hex,
+    null, a number — anything a hand-edited genesis might hold) is an
+    unusable proof, not a genesis-load crash — the key simply never
+    registers and aggregated commits refuse that signer."""
+    try:
+        return bytes.fromhex(raw)
+    except (TypeError, ValueError):
+        return b""
+
+
 @dataclass
 class GenesisValidator:
     pub_key: PubKey
     power: int
     name: str = ""
     address: bytes = b""
+    # BLS12-381 proof-of-possession (crypto/bls.py; empty for other key
+    # types). Carried in genesis JSON and VERIFIED+registered at load —
+    # the rogue-key admission gate aggregated commits check against.
+    proof_of_possession: bytes = b""
 
     def __post_init__(self):
         if not self.address:
@@ -97,6 +112,11 @@ class GenesisDoc:
                     "pub_key": base64.b64encode(encode_pubkey(v.pub_key)).decode(),
                     "power": str(v.power),
                     "name": v.name,
+                    **(
+                        {"proof_of_possession": v.proof_of_possession.hex()}
+                        if v.proof_of_possession
+                        else {}
+                    ),
                 }
                 for v in self.validators
             ],
@@ -126,9 +146,34 @@ class GenesisDoc:
                 power=int(v["power"]),
                 name=v.get("name", ""),
                 address=bytes.fromhex(v.get("address", "")),
+                proof_of_possession=_parse_pop_hex(
+                    v.get("proof_of_possession", "")
+                ),
             )
             for v in doc.get("validators", [])
         ]
+        # register BLS proofs-of-possession at load (the aggregation
+        # admission gate, crypto/bls.py); a proof that fails to parse
+        # or verify simply never registers — verify_aggregated_commit
+        # then refuses that signer, it does not crash genesis loading.
+        # Already-registered keys short-circuit: a possession pairing
+        # costs ~0.4 s on host, and restarts re-load the same genesis.
+        if any(
+            v.proof_of_possession and v.pub_key.type_name == "bls12-381"
+            for v in vals
+        ):
+            from tendermint_tpu.crypto.bls import (
+                has_possession,
+                register_possession,
+            )
+
+            for v in vals:
+                if (
+                    v.proof_of_possession
+                    and v.pub_key.type_name == "bls12-381"
+                    and not has_possession(v.pub_key.bytes())
+                ):
+                    register_possession(v.pub_key.bytes(), v.proof_of_possession)
         gd = cls(
             chain_id=doc["chain_id"],
             genesis_time_ns=doc.get("genesis_time_ns", 0),

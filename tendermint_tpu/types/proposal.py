@@ -11,7 +11,7 @@ from typing import Optional
 from tendermint_tpu.codec import signbytes
 from tendermint_tpu.codec.binary import Reader, Writer
 from tendermint_tpu.codec.signbytes import PROPOSAL_TYPE
-from tendermint_tpu.types.block import BlockID
+from tendermint_tpu.types.block import MAX_SIGNATURE_SIZE, BlockID
 
 
 @dataclass
@@ -50,7 +50,7 @@ class Proposal:
             return "BlockID must be complete"
         if not self.signature:
             return "signature is missing"
-        if len(self.signature) > 64:
+        if len(self.signature) > MAX_SIGNATURE_SIZE:
             return "signature too big"
         return None
 

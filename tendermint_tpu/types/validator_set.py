@@ -26,6 +26,8 @@ from tendermint_tpu.crypto import merkle
 from tendermint_tpu.crypto.batch import BatchVerifier, get_default_provider
 from tendermint_tpu.types.validator import Validator
 
+from tendermint_tpu.types.block import MAX_SIGNATURE_SIZE
+
 MAX_TOTAL_VOTING_POWER = (1 << 63) // 8
 PRIORITY_WINDOW_SIZE_FACTOR = 2
 
@@ -101,6 +103,7 @@ class ValidatorSet:
         self._total_voting_power = total
         self._dev_arrays = None  # membership/power changed: drop the cache
         self._dev_key = None
+        self._bls_cache = None
 
     def copy(self) -> "ValidatorSet":
         new = ValidatorSet.__new__(ValidatorSet)
@@ -115,6 +118,7 @@ class ValidatorSet:
         # copies in state/execution.py
         new._dev_arrays = getattr(self, "_dev_arrays", None)
         new._dev_key = getattr(self, "_dev_key", None)
+        new._bls_cache = getattr(self, "_bls_cache", None)
         return new
 
     def hash(self) -> bytes:
@@ -272,7 +276,9 @@ class ValidatorSet:
         Rows whose key is not a 32-byte ed25519 key (e.g. secp256k1,
         crypto/secp256k1.py) are masked out: the batch kernel is
         ed25519-only, so those rows verify serially via their own key
-        type instead of being silently truncated into garbage."""
+        type instead of being silently truncated into garbage. BLS
+        rows get their own mask + (N,48) matrix (_bls_arrays) and ride
+        the BLS batch provider."""
         cached = getattr(self, "_dev_arrays", None)
         if cached is not None:
             return cached
@@ -289,6 +295,26 @@ class ValidatorSet:
         powers = np.asarray([v.voting_power for v in self.validators], dtype=np.int64)
         self._dev_arrays = (pk, powers, ed)
         return self._dev_arrays
+
+    def bls_cache(self) -> Tuple[np.ndarray, np.ndarray]:
+        """Cached (N,48) BLS pubkey matrix + (N,) BLS mask (the
+        batch_cache companion for the aggregation track; every set
+        mutation clears it in _update_total_voting_power, exactly like
+        _dev_arrays)."""
+        cached = getattr(self, "_bls_cache", None)
+        if cached is not None:
+            return cached
+        from tendermint_tpu.crypto.bls import is_batch_bls
+
+        n = len(self.validators)
+        pk = np.zeros((n, 48), dtype=np.uint8)
+        blsm = np.zeros(n, dtype=bool)
+        for i, v in enumerate(self.validators):
+            if is_batch_bls(v.pub_key):
+                pk[i] = np.frombuffer(v.pub_key.bytes(), dtype=np.uint8)
+                blsm[i] = True
+        self._bls_cache = (pk, blsm)
+        return self._bls_cache
 
     def batch_cache(self) -> Tuple[bytes, np.ndarray, np.ndarray]:
         """(cache key, pubkey matrix (V,32), ed mask) for providers with
@@ -335,9 +361,10 @@ class ValidatorSet:
         for i, cs in enumerate(commit.signatures):
             if cs.absent_():
                 continue
-            if len(cs.signature) > 64:
-                # reference MaxSignatureSize; must never be truncated into
-                # a valid 64-byte prefix (commit-hash malleability).
+            if len(cs.signature) > MAX_SIGNATURE_SIZE:
+                # reference MaxSignatureSize (widened to 96 for BLS G2
+                # rows); must never be truncated into a valid prefix
+                # (commit-hash malleability).
                 raise ErrInvalidCommit(f"signature #{i} too big ({len(cs.signature)})")
             if by_address:
                 vi, val = self.get_by_address(cs.validator_address)
@@ -347,7 +374,11 @@ class ValidatorSet:
                 vi = i
             idxs.append(i)
             vals_idx.append(vi)
-            sig_parts.append(cs.signature.ljust(64, b"\x00"))
+            # the (n, 64) matrix feeds the ed25519 kernel only; BLS /
+            # other-type rows re-read the full signature bytes from the
+            # commit (_serial_fill_non_ed), so clamping here cannot
+            # change any verdict
+            sig_parts.append(cs.signature[:64].ljust(64, b"\x00"))
             counted.append(cs.for_block())
         n = len(idxs)
         all_pk, all_powers, all_ed = self._device_arrays()
@@ -355,6 +386,15 @@ class ValidatorSet:
         pk = all_pk[vals_idx_arr] if n else np.zeros((0, 32), dtype=np.uint8)
         powers = all_powers[vals_idx_arr] if n else np.zeros(0, dtype=np.int64)
         ed = all_ed[vals_idx_arr] if n else np.zeros(0, dtype=bool)
+        if n:
+            # an ed25519 row with an oversized (>64B, <=MAX) signature
+            # must NOT ride the clamped batch matrix — the serial path
+            # rejects any non-64-byte ed25519 signature, and truncating
+            # could reconstitute a valid prefix (verdict divergence)
+            sig_lens = np.asarray(
+                [len(commit.signatures[i].signature) for i in idxs]
+            )
+            ed = ed & (sig_lens <= 64)
         idxs_arr = np.asarray(idxs, dtype=np.int64)
         # ONE sign_bytes_parts call feeds both forms: the templated
         # parts (what device providers consume) and the host-side
@@ -443,11 +483,45 @@ class ValidatorSet:
         return None if out is None else np.asarray(out)
 
     def _serial_fill_non_ed(self, ok, commit, idxs, vals_idx, mg, ed, mg_off=0) -> None:
-        """Fill ok[] for the non-ed25519 rows via each key's own verify.
-        A key type whose verify() raises on malformed input counts as an
-        invalid signature for that row (never aborts the batch)."""
+        """Fill ok[] for the non-ed25519 rows: BLS rows go to the BLS
+        batch provider in ONE call (device pairing checks when warm),
+        remaining key types (secp256k1, sr25519, multisig) verify
+        serially via their own PubKey.verify. A key type whose verify()
+        raises on malformed input counts as an invalid signature for
+        that row (never aborts the batch)."""
+        from tendermint_tpu.crypto.bls import (
+            BLS_SIGNATURE_SIZE,
+            get_default_bls_provider,
+            is_batch_bls,
+        )
+
+        rest = []
+        bls_rows = []
         for r in np.nonzero(~ed)[0]:
             v = self.validators[vals_idx[r]]
+            sig = commit.signatures[idxs[r]].signature
+            # only exact-width signatures ride the rectangular batch: a
+            # short sig zero-padded to 96 bytes could reconstitute a
+            # VALID encoding, diverging from the serial verdict (which
+            # rejects any non-96-byte sig) — pad-truncation malleability
+            if is_batch_bls(v.pub_key) and len(sig) == BLS_SIGNATURE_SIZE:
+                bls_rows.append((int(r), v))
+            else:
+                rest.append((int(r), v))
+        if bls_rows:
+            n = len(bls_rows)
+            pk = np.zeros((n, 48), dtype=np.uint8)
+            sg = np.zeros((n, BLS_SIGNATURE_SIZE), dtype=np.uint8)
+            bm = np.zeros((n, mg.shape[1]), dtype=np.uint8)
+            for j, (r, v) in enumerate(bls_rows):
+                pk[j] = np.frombuffer(v.pub_key.bytes(), dtype=np.uint8)
+                sig = commit.signatures[idxs[r]].signature
+                sg[j] = np.frombuffer(sig, dtype=np.uint8)
+                bm[j] = mg[mg_off + r]
+            res = np.asarray(get_default_bls_provider().verify_batch(pk, bm, sg))
+            for j, (r, _v) in enumerate(bls_rows):
+                ok[mg_off + r] = bool(res[j])
+        for r, v in rest:
             sig = commit.signatures[idxs[r]].signature
             try:
                 ok[mg_off + r] = bool(v.pub_key.verify(mg[mg_off + r].tobytes(), sig))
@@ -482,7 +556,16 @@ class ValidatorSet:
         signatures are verified in ONE device batch; the sequential
         early-return acceptance is then replayed over the result vectors,
         so the accepted language is identical.
+
+        An AggregatedCommit (types/aggregate.py — one BLS signature +
+        signer bitmap) dispatches to verify_aggregated_commit: same
+        accept/reject verdicts over the same vote sets, one pairing
+        check instead of N signature verifications.
         """
+        from tendermint_tpu.types.aggregate import AggregatedCommit
+
+        if isinstance(commit, AggregatedCommit):
+            return self.verify_aggregated_commit(chain_id, block_id, height, commit)
         self._check_commit_size(commit)
         self._verify_commit_basic(commit, height, block_id)
 
@@ -497,6 +580,78 @@ class ValidatorSet:
         if len(self.validators) != len(commit.signatures):
             raise ErrInvalidCommit(
                 f"wrong set size: {len(self.validators)} vs {len(commit.signatures)}"
+            )
+
+    def verify_aggregated_commit(
+        self,
+        chain_id: str,
+        block_id,
+        height: int,
+        agg_commit,
+        bls_provider=None,
+    ) -> None:
+        """Verify +2/3 of this set signed `block_id` at `height` as ONE
+        aggregate BLS signature over the canonical commit message
+        (types/aggregate.AggregatedCommit).
+
+        Verdict contract (pinned by tests/test_bls.py against per-sig
+        verify over the same vote fleets): quorum is tallied over the
+        signer bitmap EXACTLY like _replay_commit_full tallies for-block
+        rows; the signature check is one pairing against the aggregated
+        pubkey of the set bits. Raises the same error types as
+        verify_commit. Every flagged signer must hold a BLS key with a
+        VERIFIED proof-of-possession (crypto/bls.has_possession) — a
+        bitmap bit on a non-BLS or PoP-less validator is an invalid
+        commit, not a fallback. The PoP gate is what makes the single
+        aggregated pairing sound: without it a rogue key
+        pk' = pk_atk - pk_victim forges the victim into aggregates
+        (demonstrated in tests/test_bls.py)."""
+        from tendermint_tpu.crypto.bls import (
+            get_default_bls_provider,
+            has_possession,
+        )
+
+        err = agg_commit.validate_basic()
+        if err:
+            raise ErrInvalidCommit(err)
+        if height != agg_commit.height:
+            raise ErrInvalidCommit(
+                f"wrong height: {height} vs {agg_commit.height}"
+            )
+        if block_id != agg_commit.block_id:
+            raise ErrInvalidCommit(
+                f"wrong block ID: {block_id} vs {agg_commit.block_id}"
+            )
+        if len(agg_commit.signers) != len(self.validators):
+            raise ErrInvalidCommit(
+                f"wrong signer bitmap size: {len(self.validators)} vs "
+                f"{len(agg_commit.signers)}"
+            )
+        pk_table, bls_mask = self.bls_cache()
+        mask = agg_commit.signers.as_numpy()
+        if not bool(np.all(bls_mask[mask])):
+            raise ErrInvalidCommit(
+                "aggregated commit flags a validator without a BLS key"
+            )
+        for i in np.nonzero(mask)[0]:
+            if not has_possession(pk_table[i].tobytes()):
+                raise ErrInvalidCommit(
+                    f"aggregated commit flags validator {int(i)} without a "
+                    "verified proof-of-possession (rogue-key defense)"
+                )
+        _, all_powers, _ = self._device_arrays()
+        talled = int(all_powers[mask].sum())
+        voting_power_needed = self.total_voting_power() * 2 // 3
+        if talled <= voting_power_needed:
+            raise ErrNotEnoughVotingPower(
+                f"have {talled}, need > {voting_power_needed}"
+            )
+        v = bls_provider or get_default_bls_provider()
+        msg = agg_commit.sign_bytes(chain_id)
+        rows = [bytes(pk_table[i].tobytes()) for i in range(len(self.validators))]
+        if not v.verify_aggregate(rows, mask, msg, agg_commit.agg_sig):
+            raise ErrInvalidCommitSignature(
+                "aggregate signature does not verify against the signer set"
             )
 
     @staticmethod

@@ -90,7 +90,9 @@ class Vote:
             return "negative ValidatorIndex"
         if not self.signature:
             return "signature is missing"
-        if len(self.signature) > 64:
+        from tendermint_tpu.types.block import MAX_SIGNATURE_SIZE
+
+        if len(self.signature) > MAX_SIGNATURE_SIZE:
             return "signature too big"
         return None
 

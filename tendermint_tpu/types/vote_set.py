@@ -21,7 +21,7 @@ from tendermint_tpu.codec import signbytes
 from tendermint_tpu.crypto.batch import BatchVerifier, get_default_provider
 from tendermint_tpu.crypto.keys import is_batch_ed25519
 from tendermint_tpu.crypto.pipeline import SigCache, default_sig_cache
-from tendermint_tpu.types.block import BlockID
+from tendermint_tpu.types.block import MAX_SIGNATURE_SIZE, BlockID
 from tendermint_tpu.types.validator_set import ValidatorSet
 from tendermint_tpu.types.vote import Vote, is_vote_type_valid
 from tendermint_tpu.utils.bits import BitArray
@@ -234,10 +234,13 @@ class VoteSet:
             _, val = self.val_set.get_by_index(vote.validator_index)
             prepared[k] = (vote, val.voting_power)
             raw = val.pub_key.bytes()
-            if not is_batch_ed25519(val.pub_key):
-                # non-ed25519 validator key (e.g. secp256k1): the batch
+            if not is_batch_ed25519(val.pub_key) or len(vote.signature) > 64:
+                # non-ed25519 validator key (secp256k1, BLS, ...) — or an
+                # ed25519 row whose signature exceeds the scheme width,
+                # which the batch packing would truncate: the batch
                 # kernel is ed25519-only — verify through the key's own
-                # type (reference Vote.Verify calls the interface method)
+                # type (reference Vote.Verify calls the interface method;
+                # ed25519 rejects any non-64-byte signature there)
                 sb = vote.sign_bytes(self.chain_id)
                 try:
                     direct_ok[k] = bool(val.pub_key.verify(sb, vote.signature))
@@ -371,10 +374,12 @@ class VoteSet:
             return ErrVoteInvalidValidatorIndex("index < 0", vote=vote)
         if not vote.signature:
             return ErrVoteInvalidSignature("vote has no signature", vote=vote)
-        if len(vote.signature) > 64:
-            # reference MaxSignatureSize (Vote.ValidateBasic): an
-            # oversized signature must never be TRUNCATED into a valid
-            # 64-byte prefix by the batch packing below
+        if len(vote.signature) > MAX_SIGNATURE_SIZE:
+            # reference MaxSignatureSize, widened to 96 for BLS G2
+            # signatures (types/block.py); the ed25519 batch packing
+            # below additionally diverts any >64-byte row to the
+            # serial path so an oversized signature can never be
+            # TRUNCATED into a valid 64-byte prefix
             return ErrVoteInvalidSignature(
                 f"signature too big ({len(vote.signature)})", vote=vote
             )

@@ -82,6 +82,8 @@ KNOWN_SITES = (
     "lightserve.bundle",  # lightserve/aggregator.py bundle dispatch (fails the bundle, not the thread)
     "ingest.batch",       # ingest/batcher.py bundle dispatch (fails the bundle's callers, not the task)
     "mempool.admit",      # mempool/mempool.py check_tx admission (a raise is a failed admission)
+    "bls.pairing",        # models/bls.py device kernel dispatch (verify/map/aggregate; a raise trips the breaker and the call falls back to the host oracle)
+    "bls.compile",        # models/bls.py bucket compile (_warm)
 )
 
 _ACTIONS = ("raise", "delay", "tear")

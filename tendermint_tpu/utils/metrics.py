@@ -594,6 +594,52 @@ class IngestMetrics:
                 self._deltas.feed(getattr(self, attr), key, lane_stats)
 
 
+class BLSMetrics:
+    """BLS12-381 aggregation track (``tendermint_bls_*``,
+    crypto/bls.BLSBatchVerifier.stats(): provider row counters merged
+    with the models/bls.BLSEngine device counters): how many signature
+    rows / hash-to-G2 maps / aggregate checks ran, where they executed
+    (device kernels vs the pure-Python oracle fallback), and why the
+    device declined (cold bucket vs shape caps). Monotonic totals are
+    TRUE counters fed by snapshot deltas, like CryptoMetrics. See
+    docs/bls-aggregation.md and docs/metrics.md."""
+
+    _COUNTERS = (
+        ("rows", "rows"),
+        ("device_rows", "device_rows"),
+        ("host_rows", "host_rows"),
+        ("device_maps", "device_maps"),
+        ("host_maps", "host_maps"),
+        ("aggregate_checks", "aggregate_checks"),
+        ("device_aggregates", "device_aggregates"),
+        ("fallback_cold", "engine_fallback_cold"),
+        ("fallback_shape", "engine_fallback_shape"),
+    )
+
+    def __init__(self, registry: Optional[Registry] = None, namespace="tendermint"):
+        r = registry or Registry()
+        sub = "bls"
+        reg = r.register
+        self.device_enabled = reg(Gauge("device_enabled", "1 when the BLS device engine is configured on.", namespace, sub))
+        self.rows = reg(Counter("rows_total", "BLS signature rows submitted for verification.", namespace, sub))
+        self.device_rows = reg(Counter("device_rows_total", "Rows verified by the device pairing kernel.", namespace, sub))
+        self.host_rows = reg(Counter("host_rows_total", "Rows verified by the pure-Python oracle (fallback or below the device floor).", namespace, sub))
+        self.device_maps = reg(Counter("device_maps_total", "Hash-to-G2 maps computed by the device kernel.", namespace, sub))
+        self.host_maps = reg(Counter("host_maps_total", "Hash-to-G2 maps computed on host.", namespace, sub))
+        self.aggregate_checks = reg(Counter("aggregate_checks_total", "AggregatedCommit verifications (one pairing per commit).", namespace, sub))
+        self.device_aggregates = reg(Counter("device_aggregates_total", "Aggregate-pubkey sums computed by the device tree kernel.", namespace, sub))
+        self.fallback_cold = reg(Counter("fallback_cold_total", "Device-eligible calls served on host while a bucket compiled.", namespace, sub))
+        self.fallback_shape = reg(Counter("fallback_shape_total", "Device-eligible calls outside the kernel size caps.", namespace, sub))
+        self._deltas = _SnapshotCounters()
+
+    def update(self, stats: dict) -> None:
+        """Fold a BLSBatchVerifier.stats() snapshot into the
+        instruments."""
+        self.device_enabled.set(stats.get("device_enabled", 0))
+        for attr, key in self._COUNTERS:
+            self._deltas.feed(getattr(self, attr), key, stats)
+
+
 class StateMetrics:
     def __init__(self, registry: Optional[Registry] = None, namespace="tendermint"):
         r = registry or Registry()

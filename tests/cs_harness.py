@@ -32,16 +32,34 @@ from tendermint_tpu.types.priv_validator import MockPV
 CHAIN_ID = "cs-harness-chain"
 
 
-def make_genesis(n_vals: int, powers=None, time_ns: int = 1_700_000_000_000_000_000):
+def make_genesis(
+    n_vals: int,
+    powers=None,
+    time_ns: int = 1_700_000_000_000_000_000,
+    key_type: str = "ed25519",
+):
     """Deterministic genesis + priv validators (reference
-    randGenesisDoc common_test.go:617)."""
-    privs = [MockPV(Ed25519PrivKey.from_secret(f"cs-harness-{i}".encode())) for i in range(n_vals)]
+    randGenesisDoc common_test.go:617). ``key_type`` selects the
+    validator scheme — "bls12-381" builds a BLS chain
+    (docs/bls-aggregation.md)."""
+    if key_type == "bls12-381":
+        from tendermint_tpu.crypto.bls import BLSPrivKey
+
+        key_cls = BLSPrivKey
+    else:
+        key_cls = Ed25519PrivKey
+    privs = [MockPV(key_cls.from_secret(f"cs-harness-{i}".encode())) for i in range(n_vals)]
     powers = powers or [10] * n_vals
+    pops = [
+        pv.priv_key.register_possession() if key_type == "bls12-381" else b""
+        for pv in privs
+    ]
     gvs = [
         GenesisValidator(
-            address=pv.address(), pub_key=pv.get_pub_key(), power=p, name=f"v{i}"
+            address=pv.address(), pub_key=pv.get_pub_key(), power=p,
+            name=f"v{i}", proof_of_possession=pop,
         )
-        for i, (pv, p) in enumerate(zip(privs, powers))
+        for i, (pv, p, pop) in enumerate(zip(privs, powers, pops))
     ]
     doc = GenesisDoc(chain_id=CHAIN_ID, genesis_time_ns=time_ns, validators=gvs)
     # order privs to match the sorted validator set

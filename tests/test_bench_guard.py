@@ -187,6 +187,43 @@ def test_ingest_bench_batched_beats_serial_3x(bench, monkeypatch):
     assert out["ingest_bundles"] >= 1
 
 
+def test_guard_flags_bls_regression_and_disappearance(bench):
+    """The BLS aggregation keys ride the guard like replay_speedup: a
+    previously-measured bytes ratio or verify speedup that regresses or
+    goes missing must hard-fail the bench."""
+    _write_record(bench, bls_commit_bytes_ratio=40.0, bls_verify_speedup=30.0)
+    fails = bench._regression_guard(
+        {"bls_commit_bytes_ratio": 20.0, "bls_verify_speedup": 30.0}, "tpu"
+    )
+    assert len(fails) == 1 and "bls_commit_bytes_ratio" in fails[0]
+    fails = bench._regression_guard({"bls_error": "boom"}, "tpu")
+    assert any("bls_commit_bytes_ratio" in f and "missing" in f for f in fails)
+    assert any("bls_verify_speedup" in f for f in fails)
+    assert (
+        bench._regression_guard(
+            {"bls_commit_bytes_ratio": 38.0, "bls_verify_speedup": 28.0}, "tpu"
+        )
+        == []
+    )
+
+
+def test_bls_bench_aggregation_beats_per_sig_3x(bench, monkeypatch):
+    """The acceptance bar, enforced at test scale: ONE aggregate check
+    (pubkey sum + single pairing) beats per-signature BLS verification
+    by >= 3x at an 8-validator set, and the aggregated commit encoding
+    is >= 3x smaller than the per-sig commit. Both ratios grow with the
+    set size (the full-size sweep rides bench.py); the pure-Python
+    oracle backend is pinned for run-to-run comparability."""
+    monkeypatch.setattr(bench, "BLS_VALSETS", [8])
+    monkeypatch.setattr(bench, "BLS_PERSIG_SAMPLE", 3)
+    out = bench.bls_bench()
+    assert "bls_error" not in out, out
+    assert out["bls_verify_speedup"] >= 3.0, out
+    assert out["bls_commit_bytes_ratio"] >= 3.0, out
+    # the mechanism is real: one aggregate signature's worth of bytes
+    assert out["bls_commit_bytes_agg_8"] < out["bls_commit_bytes_persig_8"]
+
+
 def test_guard_env_kill_switch(bench, monkeypatch):
     _write_record(bench, tabled_p50_ms=100.0)
     monkeypatch.setenv("TM_BENCH_NO_GUARD", "1")

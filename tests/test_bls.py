@@ -1,0 +1,701 @@
+"""ISSUE-10 BLS12-381 aggregation track: oracle correctness, the min-pk
+scheme with proof-of-possession, AggregatedCommit verdict equivalence
+against per-signature verification over adversarial fleets, and the
+registry/multisig/mixed-valset satellites.
+
+The pure-Python oracle (ops/ref_bls12.py) is the verdict source of
+truth; the device kernels are differentially tested against it in
+tests/test_bls_device.py. Pairings cost ~0.4 s each on this box, so
+every test here budgets its pairing count explicitly.
+"""
+
+import os
+import sys
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from tendermint_tpu.crypto.bls import (
+    BLSBatchVerifier,
+    BLSPrivKey,
+    BLSPubKey,
+    aggregate_signatures,
+    decode_signature,
+)
+from tendermint_tpu.ops import ref_bls12 as ref
+from tendermint_tpu.types.aggregate import AggregatedCommit, aggregate_commit_votes
+from tendermint_tpu.types.block import (
+    BLOCK_ID_FLAG_ABSENT,
+    BLOCK_ID_FLAG_COMMIT,
+    BlockID,
+    Commit,
+    CommitSig,
+    PartSetHeader,
+)
+from tendermint_tpu.types.validator import Validator
+from tendermint_tpu.types.validator_set import (
+    ErrInvalidCommit,
+    ErrInvalidCommitSignature,
+    ErrNotEnoughVotingPower,
+    ValidatorSet,
+)
+from tendermint_tpu.utils.bits import BitArray
+
+CHAIN = "bls-test-chain"
+BID = BlockID(hash=b"\x11" * 32, parts=PartSetHeader(total=1, hash=b"\x22" * 32))
+TS = 1_700_000_000 * 10**9
+
+
+def _privs(n, tag=b"t"):
+    return [BLSPrivKey.from_secret(tag + bytes([i])) for i in range(n)]
+
+
+def _bls_valset(privs, power=10, register_pop=True):
+    """BLS valset; registers each key's proof-of-possession (the
+    aggregation admission gate) unless a test opts out to exercise the
+    PoP-less rejection."""
+    if register_pop:
+        for p in privs:
+            p.register_possession()
+    return ValidatorSet(
+        [Validator(pub_key=p.pub_key(), voting_power=power) for p in privs]
+    )
+
+
+def _canonical_msg(chain_id, height, valset_size):
+    return AggregatedCommit(
+        height=height, round=0, block_id=BID, timestamp_ns=TS,
+        signers=BitArray(valset_size), agg_sig=b"\x00" * 96,
+    ).sign_bytes(chain_id)
+
+
+def _agg_commit(vs, privs, height=5, absent=(), corrupt=()):
+    """AggregatedCommit over the canonical message; `absent` indices
+    contribute no signature, `corrupt` indices sign a WRONG message."""
+    by_addr = {p.pub_key().address(): p for p in privs}
+    msg = _canonical_msg(CHAIN, height, len(vs.validators))
+    sigs = []
+    for i, val in enumerate(vs.validators):
+        if i in absent:
+            sigs.append(None)
+            continue
+        priv = by_addr[val.address]
+        m = b"WRONG" + msg if i in corrupt else msg
+        sigs.append(priv.sign(m))
+    return aggregate_commit_votes(
+        CHAIN, height, 0, BID, TS, len(vs.validators), sigs
+    )
+
+
+# -- oracle fundamentals -----------------------------------------------------
+
+
+def test_derived_parameters_and_generators():
+    # the import-time asserts already pin p/r; re-check the relations here
+    assert ref.R == ref.X_PARAM**4 - ref.X_PARAM**2 + 1
+    assert ref.P == ((ref.X_PARAM - 1) ** 2 * ref.R) // 3 + ref.X_PARAM
+    assert ref.g1_on_curve(ref.G1_GEN) and ref.g1_in_subgroup(ref.G1_GEN)
+    assert ref.g2_on_curve(ref.G2_GEN) and ref.g2_in_subgroup(ref.G2_GEN)
+    # cofactor formulas produce subgroup points from arbitrary curve pts
+    assert ref.g1_mul(ref.R, ref.G1_GEN) is None
+    assert ref.g2_mul(ref.R, ref.G2_GEN) is None
+
+
+def test_field_tower_algebra():
+    import random
+
+    rng = random.Random(3)
+    for _ in range(3):
+        a = (rng.randrange(ref.P), rng.randrange(ref.P))
+        b = (rng.randrange(ref.P), rng.randrange(ref.P))
+        assert ref.f2_eq(ref.f2_mul(a, ref.f2_inv(a)), ref.F2_ONE)
+        assert ref.f2_eq(ref.f2_mul(a, b), ref.f2_mul(b, a))
+        assert ref.f2_eq(ref.f2_sqr(a), ref.f2_mul(a, a))
+        s = ref.f2_sqr(a)
+        r = ref.f2_sqrt(s)
+        assert r is not None and ref.f2_eq(ref.f2_sqr(r), s)
+    a6 = tuple(
+        (rng.randrange(ref.P), rng.randrange(ref.P)) for _ in range(3)
+    )
+    assert ref.f6_mul(a6, ref.f6_inv(a6)) == ref.F6_ONE
+    a12 = (a6, tuple((rng.randrange(ref.P), 1) for _ in range(3)))
+    prod = ref.f12_mul(a12, ref.f12_inv(a12))
+    assert ref.f12_eq(prod, ref.F12_ONE)
+    assert ref.f12_eq(ref.f12_frobenius(a12), ref.f12_pow(a12, ref.P))
+
+
+def test_pairing_bilinearity_and_order():
+    e1 = ref.pairing(ref.G1_GEN, ref.G2_GEN)
+    assert not ref.f12_is_one(e1), "pairing must be non-degenerate"
+    e2 = ref.pairing(ref.g1_mul(5, ref.G1_GEN), ref.g2_mul(7, ref.G2_GEN))
+    assert ref.f12_eq(e2, ref.f12_pow(e1, 35))
+    assert ref.f12_is_one(ref.f12_pow(e1, ref.R))
+
+
+def test_hash_to_curve_properties():
+    h1 = ref.hash_to_curve_g2(b"msg-a", ref.DST_SIG)
+    assert ref.g2_in_subgroup(h1)
+    assert ref.hash_to_curve_g2(b"msg-a", ref.DST_SIG) == h1  # deterministic
+    assert ref.hash_to_curve_g2(b"msg-b", ref.DST_SIG) != h1
+    # domain separation: same message, different tag, different point
+    assert ref.hash_to_curve_g2(b"msg-a", ref.DST_POP) != h1
+
+
+def test_expand_message_xmd_shape():
+    out = ref.expand_message_xmd(b"abc", b"DST", 96)
+    assert len(out) == 96
+    assert ref.expand_message_xmd(b"abc", b"DST", 96) == out
+    assert ref.expand_message_xmd(b"abc", b"DST2", 96) != out
+    with pytest.raises(ValueError):
+        ref.expand_message_xmd(b"abc", b"DST", 256 * 32 + 1)
+
+
+# -- scheme ------------------------------------------------------------------
+
+
+def test_sign_verify_and_negatives():
+    priv = BLSPrivKey.from_secret(b"k1")
+    pub = priv.pub_key()
+    sig = priv.sign(b"payload")
+    assert len(sig) == 96 and len(pub.bytes()) == 48
+    assert pub.verify(b"payload", sig)
+    assert not pub.verify(b"payload2", sig)
+    assert not pub.verify(b"payload", sig[:-1] + bytes([sig[-1] ^ 1]))
+    assert not pub.verify(b"payload", b"\x00" * 96)
+    assert not pub.verify(b"payload", b"short")
+
+
+def test_point_serialization_roundtrips():
+    priv = BLSPrivKey.from_secret(b"ser")
+    pk_pt = ref.g1_decompress(priv.pub_key().bytes())
+    assert pk_pt is not None
+    assert ref.g1_compress(pk_pt) == priv.pub_key().bytes()
+    neg = ref.g1_neg(pk_pt)
+    assert ref.g1_decompress(ref.g1_compress(neg)) == neg
+    sig_pt = ref.g2_decompress(priv.sign(b"m"))
+    assert ref.g2_compress(sig_pt) == priv.sign(b"m")
+    # infinity + malformed encodings
+    assert ref.g1_decompress(ref.g1_compress(None)) is None
+    assert ref.g2_decompress(ref.g2_compress(None)) is None
+    with pytest.raises(ValueError):
+        ref.g1_decompress(b"\x00" * 48)  # compression flag missing
+    with pytest.raises(ValueError):
+        ref.g1_decompress(b"\xff" * 48)  # x >= p
+    assert decode_signature(b"\x00" * 96) is None
+
+
+def test_pop_rejects_rogue_key():
+    """The rogue-key attack the PoP exists for: the attacker registers
+    pk_rogue = pk_atk - pk_victim, making (pk_victim + pk_rogue) a key
+    the attacker fully controls — the aggregate forges, but the
+    attacker cannot produce a PoP for pk_rogue."""
+    victim = BLSPrivKey.from_secret(b"victim")
+    atk = BLSPrivKey.from_secret(b"attacker")
+    pk_v = ref.g1_decompress(victim.pub_key().bytes())
+    pk_a = ref.sk_to_pk(atk._sk)
+    rogue_pt = ref.g1_add(pk_a, ref.g1_neg(pk_v))
+    rogue = BLSPubKey(ref.g1_compress(rogue_pt))
+    # WITHOUT PoP the forged aggregate verifies: sum = pk_atk, which
+    # the attacker can sign for — the vulnerability being closed
+    msg = b"forged-commit"
+    forged = ref.sign(atk._sk, msg)
+    assert ref.verify_aggregate_common(
+        [pk_v, rogue_pt], msg, forged
+    ), "sanity: rogue aggregation forges without PoP"
+    # ...and PoP rejects the rogue key at registration: the attacker
+    # does not know its secret, so any claimed proof fails
+    assert victim.pub_key().verify_possession(victim.prove_possession())
+    assert not rogue.verify_possession(atk.prove_possession())
+    assert not rogue.verify_possession(victim.prove_possession())
+
+
+def test_aggregate_signatures_common_message():
+    privs = _privs(3)
+    msg = b"common"
+    agg = aggregate_signatures([p.sign(msg) for p in privs])
+    v = BLSBatchVerifier(use_device=False)
+    table = [p.pub_key().bytes() for p in privs]
+    assert v.verify_aggregate(table, np.array([True] * 3), msg, agg)
+    # missing signer's key in the mask -> pairing mismatch
+    assert not v.verify_aggregate(table, np.array([True, True, False]), msg, agg)
+    assert aggregate_signatures([]) is None
+    assert aggregate_signatures([b"\x00" * 96]) is None
+
+
+def test_batch_verifier_verdicts_match_serial():
+    privs = _privs(4)
+    msgs = [b"m%d" % i for i in range(4)]
+    sigs = [p.sign(m) for p, m in zip(privs, msgs)]
+    sigs[2] = sigs[1]  # wrong message for key 2
+    pk = np.stack(
+        [np.frombuffer(p.pub_key().bytes(), dtype=np.uint8) for p in privs]
+    )
+    width = max(len(m) for m in msgs)
+    mg = np.zeros((4, width), dtype=np.uint8)
+    lens = np.zeros(4, dtype=np.int32)
+    for i, m in enumerate(msgs):
+        mg[i, : len(m)] = np.frombuffer(m, dtype=np.uint8)
+        lens[i] = len(m)
+    sg = np.stack([np.frombuffer(s, dtype=np.uint8) for s in sigs])
+    v = BLSBatchVerifier(use_device=False)
+    got = list(v.verify_batch(pk, mg, sg, msg_lens=lens))
+    want = [
+        p.pub_key().verify(m, s) for p, m, s in zip(privs, msgs, sigs)
+    ]
+    assert got == want == [True, True, False, True]
+    # malformed pubkey row can't abort the batch
+    pk2 = pk.copy()
+    pk2[0] = 0
+    got = list(v.verify_batch(pk2, mg, sg, msg_lens=lens))
+    assert got == [False, True, False, True]
+
+
+# -- AggregatedCommit verdict equivalence ------------------------------------
+
+
+def test_aggregated_commit_accepts_and_roundtrips():
+    privs = _privs(4)
+    vs = _bls_valset(privs)
+    agg = _agg_commit(vs, privs)
+    # dispatches through verify_commit (the aggregate-then-verify path)
+    vs.verify_commit(CHAIN, BID, 5, agg)
+    # wire round trip preserves the verdict
+    rt = AggregatedCommit.decode(agg.encode())
+    vs.verify_commit(CHAIN, BID, 5, rt)
+    assert rt.encode() == agg.encode()
+    # bytes: independent of validator count (one sig + bitmap)
+    assert agg.wire_bytes() < 250
+
+
+def test_aggregated_commit_verdicts_match_per_sig_fleet():
+    """The acceptance clause: over the same vote fleets, the aggregate
+    path accepts exactly when per-sig verification of the equivalent
+    Commit accepts. Fleet axes: full participation, minority absent,
+    sub-quorum, and a corrupted signer."""
+    privs = _privs(4)
+    vs = _bls_valset(privs)
+    by_addr = {p.pub_key().address(): p for p in privs}
+
+    def per_sig_commit(absent=(), corrupt=()):
+        msg = _canonical_msg(CHAIN, 5, 4)
+        sigs = []
+        for i, val in enumerate(vs.validators):
+            if i in absent:
+                sigs.append(CommitSig.absent())
+                continue
+            m = b"WRONG" + msg if i in corrupt else msg
+            sigs.append(
+                CommitSig(
+                    block_id_flag=BLOCK_ID_FLAG_COMMIT,
+                    validator_address=val.address,
+                    timestamp_ns=TS,
+                    signature=by_addr[val.address].sign(m),
+                )
+            )
+        return Commit(height=5, round=0, block_id=BID, signatures=sigs)
+
+    for absent, corrupt in [((), ()), ((3,), ()), ((1, 3), ()), ((), (0,))]:
+        agg_ok = True
+        try:
+            vs.verify_commit(CHAIN, BID, 5, _agg_commit(vs, privs, absent=absent, corrupt=corrupt))
+        except Exception:
+            agg_ok = False
+        per_ok = True
+        try:
+            vs.verify_commit(CHAIN, BID, 5, per_sig_commit(absent=absent, corrupt=corrupt))
+        except Exception:
+            per_ok = False
+        assert agg_ok == per_ok, (absent, corrupt, agg_ok, per_ok)
+    # expected shapes: full + one-absent accept; 2-of-4 power and a
+    # corrupted signer reject
+    vs.verify_commit(CHAIN, BID, 5, _agg_commit(vs, privs, absent=(3,)))
+    with pytest.raises(ErrNotEnoughVotingPower):
+        vs.verify_commit(CHAIN, BID, 5, _agg_commit(vs, privs, absent=(1, 3)))
+    with pytest.raises(ErrInvalidCommitSignature):
+        vs.verify_commit(CHAIN, BID, 5, _agg_commit(vs, privs, corrupt=(0,)))
+
+
+def test_aggregated_commit_adversarial_rejections():
+    privs = _privs(4)
+    vs = _bls_valset(privs)
+    agg = _agg_commit(vs, privs, absent=(3,))
+    # flipping an absent signer's bit on claims power the sig lacks
+    flipped = AggregatedCommit.decode(agg.encode())
+    flipped.signers.set_index(3, True)
+    with pytest.raises(ErrInvalidCommitSignature):
+        vs.verify_commit(CHAIN, BID, 5, flipped)
+    # clearing a real signer's bit breaks the pairing too
+    cleared = AggregatedCommit.decode(agg.encode())
+    cleared.signers.set_index(0, False)
+    with pytest.raises((ErrInvalidCommitSignature, ErrNotEnoughVotingPower)):
+        vs.verify_commit(CHAIN, BID, 5, cleared)
+    # garbage aggregate signature
+    bad = AggregatedCommit.decode(agg.encode())
+    bad.agg_sig = b"\x01" * 96
+    with pytest.raises(ErrInvalidCommitSignature):
+        vs.verify_commit(CHAIN, BID, 5, bad)
+    # wrong height / BlockID / bitmap size
+    with pytest.raises(ErrInvalidCommit):
+        vs.verify_commit(CHAIN, BID, 6, agg)
+    with pytest.raises(ErrInvalidCommit):
+        vs.verify_commit(CHAIN, BlockID(hash=b"\x33" * 32, parts=BID.parts), 5, agg)
+    short = AggregatedCommit(
+        height=5, round=0, block_id=BID, timestamp_ns=TS,
+        signers=BitArray.from_bools([True] * 3), agg_sig=agg.agg_sig,
+    )
+    with pytest.raises(ErrInvalidCommit):
+        vs.verify_commit(CHAIN, BID, 5, short)
+
+
+def test_bls_cache_invalidates_on_set_mutation():
+    """bls_cache follows the _dev_arrays invalidation discipline: a
+    membership change must rebuild the pubkey table (a stale table
+    would verify aggregates against departed validators)."""
+    privs = _privs(3, tag=b"inv")
+    vs = _bls_valset(privs)
+    pk0, mask0 = vs.bls_cache()
+    assert mask0.all() and pk0.shape == (3, 48)
+    newcomer = BLSPrivKey.from_secret(b"inv-new")
+    vs.update_with_change_set(
+        [Validator(pub_key=newcomer.pub_key(), voting_power=5)]
+    )
+    pk1, mask1 = vs.bls_cache()
+    assert pk1.shape == (4, 48) and mask1.all()
+    assert newcomer.pub_key().bytes() in {bytes(r.tobytes()) for r in pk1}
+
+
+def test_aggregated_commit_requires_pop():
+    """The rogue-key gate end to end: a signer whose key has no
+    VERIFIED proof-of-possession is refused by the aggregate path even
+    when the pairing would check out — and the concrete rogue-key
+    forgery (pk' = pk_atk - pk_victim) is rejected because its owner
+    cannot register a PoP for it."""
+    from tendermint_tpu.crypto.bls import clear_possessions, register_possession
+
+    privs = _privs(4, tag=b"pop")
+    vs = _bls_valset(privs, register_pop=False)
+    clear_possessions()
+    agg = _agg_commit(vs, privs)
+    with pytest.raises(ErrInvalidCommit, match="proof-of-possession"):
+        vs.verify_commit(CHAIN, BID, 5, agg)
+    # registering the proofs flips the verdict to accept
+    for p in privs:
+        p.register_possession()
+    vs.verify_commit(CHAIN, BID, 5, agg)
+    # a rogue key can never register: its "owner" has no secret for it
+    atk = BLSPrivKey.from_secret(b"pop-atk")
+    victim_pt = ref.g1_decompress(privs[0].pub_key().bytes())
+    rogue_raw = ref.g1_compress(
+        ref.g1_add(ref.sk_to_pk(atk._sk), ref.g1_neg(victim_pt))
+    )
+    assert not register_possession(rogue_raw, atk.prove_possession())
+    from tendermint_tpu.crypto.bls import has_possession
+
+    assert not has_possession(rogue_raw)
+
+
+def test_aggregated_commit_requires_bls_keys():
+    from tendermint_tpu.crypto.keys import Ed25519PrivKey
+
+    bls = _privs(3)
+    ed = Ed25519PrivKey.from_secret(b"ed")
+    vs = ValidatorSet(
+        [Validator(pub_key=p.pub_key(), voting_power=10) for p in bls]
+        + [Validator(pub_key=ed.pub_key(), voting_power=10)]
+    )
+    signers = BitArray(4)
+    for i in range(4):
+        signers.set_index(i, True)
+    agg = AggregatedCommit(
+        height=5, round=0, block_id=BID, timestamp_ns=TS,
+        signers=signers, agg_sig=b"\x01" * 96,
+    )
+    with pytest.raises(ErrInvalidCommit, match="without a BLS key"):
+        vs.verify_commit(CHAIN, BID, 5, agg)
+
+
+# -- per-signature BLS commits (the batched non-ed path) ---------------------
+
+
+def test_per_sig_bls_commit_via_batch_provider():
+    """A commit whose validators all hold BLS keys verifies through the
+    BLS batch provider (one call for all rows), with per-validator
+    timestamps — verdicts identical to serial PubKey.verify."""
+    privs = _privs(3, tag=b"p")
+    vs = _bls_valset(privs)
+    by_addr = {p.pub_key().address(): p for p in privs}
+    sigs = [
+        CommitSig(
+            block_id_flag=BLOCK_ID_FLAG_COMMIT,
+            validator_address=val.address,
+            timestamp_ns=TS + i,
+            signature=b"",
+        )
+        for i, val in enumerate(vs.validators)
+    ]
+    commit = Commit(height=5, round=0, block_id=BID, signatures=sigs)
+    for i, val in enumerate(vs.validators):
+        commit.signatures[i].signature = by_addr[val.address].sign(
+            commit.vote_sign_bytes(CHAIN, i)
+        )
+    vs.verify_commit(CHAIN, BID, 5, commit)
+    commit.signatures[1].signature = commit.signatures[0].signature
+    with pytest.raises(ErrInvalidCommitSignature):
+        vs.verify_commit(CHAIN, BID, 5, commit)
+
+
+def test_mixed_key_valset_per_row_fallback():
+    """ISSUE-10 satellite: commit verification over a valset mixing
+    ed25519, secp256k1 and BLS keys routes each row by key type (the
+    crypto/batch.py:79 caveat) — all three verify, and corrupting any
+    single row's signature rejects the commit."""
+    from tendermint_tpu.crypto.keys import Ed25519PrivKey
+    from tendermint_tpu.crypto.secp256k1 import Secp256k1PrivKey
+
+    ed = Ed25519PrivKey.from_secret(b"mixed-ed")
+    secp = Secp256k1PrivKey.from_secret(b"mixed-secp")
+    bls = BLSPrivKey.from_secret(b"mixed-bls")
+    signers = {k.pub_key().address(): k for k in (ed, secp, bls)}
+    vs = ValidatorSet(
+        [Validator(pub_key=k.pub_key(), voting_power=10) for k in (ed, secp, bls)]
+    )
+
+    def build():
+        sigs = [
+            CommitSig(
+                block_id_flag=BLOCK_ID_FLAG_COMMIT,
+                validator_address=val.address,
+                timestamp_ns=TS + i,
+                signature=b"",
+            )
+            for i, val in enumerate(vs.validators)
+        ]
+        c = Commit(height=5, round=0, block_id=BID, signatures=sigs)
+        for i, val in enumerate(vs.validators):
+            c.signatures[i].signature = signers[val.address].sign(
+                c.vote_sign_bytes(CHAIN, i)
+            )
+        return c
+
+    vs.verify_commit(CHAIN, BID, 5, build())
+    # corrupt each row in turn: every key type's verdict is enforced
+    for bad_row in range(3):
+        c = build()
+        sig = bytearray(c.signatures[bad_row].signature)
+        sig[-1] ^= 1
+        c.signatures[bad_row].signature = bytes(sig)
+        with pytest.raises(ErrInvalidCommitSignature):
+            vs.verify_commit(CHAIN, BID, 5, c)
+    # an absent row among mixed keys still tallies correctly (2/3 of 30
+    # power is NOT exceeded by 20 -- quorum needs > 20)
+    c = build()
+    c.signatures[0] = CommitSig.absent()
+    with pytest.raises(ErrNotEnoughVotingPower):
+        vs.verify_commit(CHAIN, BID, 5, c)
+
+
+def test_commitsig_validate_accepts_96_byte_sigs():
+    cs = CommitSig(
+        block_id_flag=BLOCK_ID_FLAG_COMMIT,
+        validator_address=b"\x01" * 20,
+        timestamp_ns=TS,
+        signature=b"\x02" * 96,
+    )
+    assert cs.validate_basic() is None
+    cs.signature = b"\x02" * 97
+    assert cs.validate_basic() == "signature too big"
+    assert CommitSig.absent().validate_basic() is None
+    _ = BLOCK_ID_FLAG_ABSENT  # imported for fleet builders above
+
+
+# -- registry hardening satellite -------------------------------------------
+
+
+def test_pubkey_registry_roundtrip_every_type():
+    """Encode/decode round-trip property over EVERY registered type:
+    ed25519, secp256k1, sr25519, multisig-threshold and bls12-381."""
+    from tendermint_tpu.crypto import sr25519 as sr
+    from tendermint_tpu.crypto.keys import (
+        Ed25519PrivKey,
+        decode_pubkey,
+        encode_pubkey,
+        registered_pubkey_types,
+    )
+    from tendermint_tpu.crypto.multisig import MultisigThresholdPubKey
+    from tendermint_tpu.crypto.secp256k1 import Secp256k1PrivKey
+
+    ed = Ed25519PrivKey.from_secret(b"rt-ed").pub_key()
+    secp = Secp256k1PrivKey.from_secret(b"rt-secp").pub_key()
+    srk = sr.Sr25519PrivKey.from_seed(b"rt-sr-seed-32-bytes-long-padded!").pub_key()
+    bls = BLSPrivKey.from_secret(b"rt-bls").pub_key()
+    multi = MultisigThresholdPubKey(2, [ed, secp, bls])
+    samples = {
+        "ed25519": ed,
+        "secp256k1": secp,
+        "sr25519": srk,
+        "multisig-threshold": multi,
+        "bls12-381": bls,
+    }
+    registered = set(registered_pubkey_types())
+    assert set(samples) <= registered, registered
+    for name, pk in samples.items():
+        enc = encode_pubkey(pk)
+        dec = decode_pubkey(enc)
+        assert dec.type_name == name == pk.type_name
+        assert dec.bytes() == pk.bytes()
+        assert encode_pubkey(dec) == enc
+        assert dec.address() == pk.address()
+
+
+def test_pubkey_registry_typed_errors():
+    from tendermint_tpu.crypto.keys import (
+        Ed25519PrivKey,
+        ErrMalformedPubKey,
+        ErrUnknownPubKeyType,
+        decode_pubkey,
+        encode_pubkey,
+    )
+
+    enc = encode_pubkey(Ed25519PrivKey.from_secret(b"te").pub_key())
+    with pytest.raises(ErrUnknownPubKeyType):
+        decode_pubkey(b"\x08unknown!\x00")
+    for bad in (enc[:5], enc[:-3], enc + b"xx", b"", b"\xff\xff"):
+        with pytest.raises(ErrMalformedPubKey):
+            decode_pubkey(bad)
+    # wrong payload width for a known type is malformed, not unknown
+    with pytest.raises(ErrMalformedPubKey):
+        decode_pubkey(b"\x07ed25519\x05abcde")
+    # both subclass ValueError: pre-existing callers keep working
+    assert issubclass(ErrUnknownPubKeyType, ValueError)
+    assert issubclass(ErrMalformedPubKey, ValueError)
+
+
+# -- multisig SigCache satellite --------------------------------------------
+
+
+def test_multisig_subsigs_ride_sigcache():
+    """ISSUE-10 satellite: MultisigThresholdPubKey.verify no longer
+    re-verifies ed25519 sub-sigs serially on each call — the second
+    verification of the same signature resolves from the shared
+    SigCache (cache-hit test), and verdicts are unchanged."""
+    from tendermint_tpu.crypto.keys import Ed25519PrivKey
+    from tendermint_tpu.crypto.multisig import (
+        MultisigBuilder,
+        MultisigThresholdPubKey,
+    )
+    from tendermint_tpu.crypto.pipeline import SigCache, set_default_sig_cache
+
+    cache = SigCache()
+    set_default_sig_cache(cache)
+    try:
+        privs = [Ed25519PrivKey.from_secret(bytes([i, 9])) for i in range(3)]
+        mpk = MultisigThresholdPubKey(2, [p.pub_key() for p in privs])
+        msg = b"multisig-msg"
+        b = MultisigBuilder(mpk)
+        b.add_signature(privs[0].pub_key(), privs[0].sign(msg))
+        b.add_signature(privs[2].pub_key(), privs[2].sign(msg))
+        sig = b.signature()
+        assert mpk.verify(msg, sig)
+        inserted = cache.insertions
+        assert inserted == 2, "both ed25519 sub-sigs must seed the cache"
+        h0 = cache.hits
+        assert mpk.verify(msg, sig)
+        assert cache.hits - h0 == 2, "second verify must be all cache hits"
+        assert cache.insertions == inserted
+        # verdicts unchanged: corrupted sub-sig and wrong message fail
+        bad = bytearray(sig)
+        bad[-1] ^= 1
+        assert not mpk.verify(msg, bytes(bad))
+        assert not mpk.verify(b"other", sig)
+        # a failed verify must never poison the cache
+        assert mpk.verify(msg, sig)
+    finally:
+        set_default_sig_cache(None)
+
+
+def test_multisig_mixed_subkeys_verdicts():
+    """Non-ed25519 sub-keys (BLS here) keep their own verify inside the
+    threshold check — mixed accounts stay correct."""
+    from tendermint_tpu.crypto.keys import Ed25519PrivKey
+    from tendermint_tpu.crypto.multisig import (
+        MultisigBuilder,
+        MultisigThresholdPubKey,
+    )
+
+    ed = Ed25519PrivKey.from_secret(b"mm-ed")
+    bls = BLSPrivKey.from_secret(b"mm-bls")
+    mpk = MultisigThresholdPubKey(2, [ed.pub_key(), bls.pub_key()])
+    msg = b"mixed-multisig"
+    b = MultisigBuilder(mpk)
+    b.add_signature(ed.pub_key(), ed.sign(msg))
+    b.add_signature(bls.pub_key(), bls.sign(msg))
+    sig = b.signature()
+    assert mpk.verify(msg, sig)
+    assert not mpk.verify(b"other", sig)
+
+
+# -- live consensus with a BLS validator -------------------------------------
+
+
+@pytest.mark.slow
+def test_live_node_bls_validator_commits(tmp_path):
+    """Full-stack acceptance: a single-node chain whose validator key
+    is bls12-381 proposes, votes (96-byte G2 signatures through the
+    privval + VoteSet paths) and commits consecutive heights."""
+    import asyncio
+
+    from tests.cs_harness import make_genesis, make_node
+
+    async def go():
+        genesis, privs = make_genesis(1, key_type="bls12-381")
+        assert isinstance(
+            genesis.validators[0].pub_key, BLSPubKey
+        ), "genesis must carry the BLS key type"
+        node = await make_node(genesis, privs[0])
+        await node.cs.start()
+        try:
+            await node.cs.wait_for_height(3, timeout_s=120)
+        finally:
+            await node.cs.stop()
+        assert node.cs.state.last_block_height >= 3
+
+    asyncio.run(go())
+
+
+# -- privval -----------------------------------------------------------------
+
+
+def test_privval_bls_keygen_sign_and_reload(tmp_path):
+    from tendermint_tpu.privval.file import FilePV, load_file_pv
+    from tendermint_tpu.types.vote import Vote
+    from tendermint_tpu.codec.signbytes import PRECOMMIT_TYPE
+
+    kf = str(tmp_path / "pv_key.json")
+    sf = str(tmp_path / "pv_state.json")
+    pv = FilePV.generate(kf, sf, key_type="bls12-381")
+    pv.save()
+    assert isinstance(pv.get_pub_key(), BLSPubKey)
+    vote = Vote(
+        vote_type=PRECOMMIT_TYPE, height=3, round=0, block_id=BID,
+        timestamp_ns=TS, validator_address=pv.address(), validator_index=0,
+        signature=b"",
+    )
+    pv.sign_vote(CHAIN, vote)
+    assert len(vote.signature) == 96
+    assert pv.get_pub_key().verify(vote.sign_bytes(CHAIN), vote.signature)
+    # reload keeps the recorded key type and double-sign state
+    pv2 = load_file_pv(kf, sf)
+    assert isinstance(pv2.get_pub_key(), BLSPubKey)
+    assert pv2.get_pub_key().bytes() == pv.get_pub_key().bytes()
+    from tendermint_tpu.privval.file import ErrDoubleSign
+
+    conflicting = Vote(
+        vote_type=PRECOMMIT_TYPE, height=3, round=0,
+        block_id=BlockID(hash=b"\x33" * 32, parts=BID.parts),
+        timestamp_ns=TS, validator_address=pv.address(), validator_index=0,
+        signature=b"",
+    )
+    with pytest.raises(ErrDoubleSign):
+        pv2.sign_vote(CHAIN, conflicting)
