@@ -220,6 +220,50 @@ def _last_tpu_result():
         return None
 
 
+# -- bench provenance ------------------------------------------------------
+#
+# The r04/r05 lesson: two rounds ran with the accelerator tunnel down
+# and the TPU numbers were carried forward from r04's measured run —
+# nothing in the json said WHICH backend produced each section, so a
+# CPU-fallback number could be compared against a TPU baseline without
+# complaint. Every section now stamps the JAX platform that actually
+# executed it (``<section>_platform``), the emitted line carries the
+# run-wide jax_platform/jax_device, and the regression guard refuses —
+# LOUDLY, via GUARD_SKIPS in the line — to compare a key across
+# mismatched platforms instead of silently judging apples by oranges.
+
+
+def _jax_platform() -> str:
+    try:
+        import jax
+
+        return jax.devices()[0].platform
+    except Exception:
+        return "unknown"
+
+
+def _jax_provenance() -> dict:
+    """Run-wide provenance keys for the emitted line."""
+    try:
+        import jax
+
+        d = jax.devices()[0]
+        return {
+            "jax_platform": d.platform,
+            "jax_device": str(d),
+            "jax_device_count": len(jax.devices()),
+        }
+    except Exception as e:
+        return {"jax_platform": "unknown", "jax_error": repr(e)[:120]}
+
+
+def _stamped(section: str, out: dict) -> dict:
+    """Stamp a section's result dict with the platform that ran it."""
+    out = dict(out)
+    out[f"{section}_platform"] = _jax_platform()
+    return out
+
+
 # -- regression guard ------------------------------------------------------
 #
 # Round-3 lesson: the flagship tabled path was broken by a last-minute
@@ -251,13 +295,47 @@ _GUARD_KEYS = [
     ("coldstart_tabled_first_s", None),
 ]
 
+# guard key -> the section-provenance key that must MATCH between the
+# recorded baseline and this run for the comparison to mean anything
+_KEY_SECTION_PLATFORM = {
+    "replay_speedup": "replay_platform",
+    "merkle_root_speedup": "merkle_platform",
+    "lightserve_clients_per_sec": "lightserve_platform",
+    "lightserve_speedup": "lightserve_platform",
+    "ingest_txs_per_sec": "ingest_platform",
+    "ingest_speedup": "ingest_platform",
+    "bls_commit_bytes_ratio": "bls_platform",
+    "bls_verify_speedup": "bls_platform",
+}
+
+# provenance-mismatch skip notes from the LAST _regression_guard call —
+# logged to stderr and attached to the emitted line as "guard_skips",
+# so a skipped comparison is loud in the artifact, never silent
+GUARD_SKIPS: list = []
+
 
 def _regression_guard(line: dict, platform: str) -> list:
     """Failure strings comparing `line` to the last recorded accelerator
-    result; empty when clean (or no comparable record)."""
-    if os.environ.get("TM_BENCH_NO_GUARD") == "1" or platform == "cpu":
+    result; empty when clean (or no comparable record). Comparisons
+    whose provenance doesn't match (a TPU-measured baseline vs a
+    CPU-fallback run, run-wide or per-section) are SKIPPED LOUDLY via
+    GUARD_SKIPS rather than judged."""
+    global GUARD_SKIPS
+    GUARD_SKIPS = []
+    if os.environ.get("TM_BENCH_NO_GUARD") == "1":
         return []
     last = _last_tpu_result()
+    if platform == "cpu":
+        if last and last.get("platform") not in (None, "cpu"):
+            msg = (
+                "guard skipped entirely: this run executed on the CPU "
+                f"fallback but the recorded baseline is {last.get('platform')} "
+                "— TPU-guarded keys are not comparable (the r04/r05 "
+                "carried-numbers trap)"
+            )
+            GUARD_SKIPS.append(msg)
+            log(f"GUARD SKIP: {msg}")
+        return []
     if not last or last.get("platform") == "cpu":
         return []
     if int(last.get("bench_n", 10000)) != BENCH_N:
@@ -267,6 +345,17 @@ def _regression_guard(line: dict, platform: str) -> list:
         prev, cur = last.get(key), line.get(key)
         if not isinstance(prev, (int, float)):
             continue
+        sec = _KEY_SECTION_PLATFORM.get(key)
+        if sec is not None:
+            prev_p, cur_p = last.get(sec), line.get(sec)
+            if prev_p and cur_p and prev_p != cur_p:
+                msg = (
+                    f"{key}: baseline measured on {prev_p}, this run's "
+                    f"section ran on {cur_p} — not comparable, skipping"
+                )
+                GUARD_SKIPS.append(msg)
+                log(f"GUARD SKIP: {msg}")
+                continue
         if not isinstance(cur, (int, float)):
             fails.append(f"{key}: previously {prev}, now missing/errored")
         elif direction == "lower" and cur > prev * (1 + _GUARD_TOL):
@@ -346,18 +435,23 @@ def run_bench(platform: str, accelerator: bool = True):
         assert ok.all() and talled == n * 10
         p50 = sorted(times)[len(times) // 2]
         log(f"host-fallback VerifyCommit@10k p50: {p50*1e3:.1f} ms")
+        # populate GUARD_SKIPS: a TPU baseline vs this CPU-fallback run
+        # is a LOUD skip carried in the line, not a silent pass
+        _regression_guard({}, "cpu")
         emit(
             round(p50 * 1e3, 3),
             round(baseline_10k / p50, 2),
             platform=platform,
             note="accelerator unavailable; measured the node's host fallback path",
-            **replay_bench(cpu),
-            **lightserve_bench(cpu),
-            **ingest_bench(cpu),
-            **merkle_bench(),
-            **bls_bench(),
-            **degraded_mode_bench(),
-            **trace_overhead_bench(),
+            **_jax_provenance(),
+            **_stamped("replay", replay_bench(cpu)),
+            **_stamped("lightserve", lightserve_bench(cpu)),
+            **_stamped("ingest", ingest_bench(cpu)),
+            **_stamped("merkle", merkle_bench()),
+            **_stamped("bls", bls_bench()),
+            **_stamped("degraded", degraded_mode_bench()),
+            **_stamped("trace", trace_overhead_bench()),
+            **({"guard_skips": GUARD_SKIPS} if GUARD_SKIPS else {}),
             **_last_tpu_extra(),
         )
         _deadline_done()
@@ -566,32 +660,32 @@ def run_bench(platform: str, accelerator: bool = True):
 
         tpv = TPUBatchVerifier()
         tpv._model = model  # reuse the warmed buckets from the sections above
-        replay_extra = replay_bench(tpv)
+        replay_extra = _stamped("replay", replay_bench(tpv))
     except Exception as ex:  # diagnostic only; never forfeit the main line
         log(f"replay provider setup failed: {ex!r}")
-        replay_extra = {"replay_error": repr(ex)[:200]}
+        replay_extra = _stamped("replay", {"replay_error": repr(ex)[:200]})
 
     # -- lightserve: batched client fleet vs per-client serial ------------
     try:
         _ls_provider = tpv  # the warmed device provider from the replay section
     except NameError:
         _ls_provider = None
-    lightserve_extra = lightserve_bench(_ls_provider)
+    lightserve_extra = _stamped("lightserve", lightserve_bench(_ls_provider))
 
     # -- ingest: batched mempool admission vs per-tx serial CheckTx -------
-    ingest_extra = ingest_bench(_ls_provider)
+    ingest_extra = _stamped("ingest", ingest_bench(_ls_provider))
 
     # -- merkle engine: device vs host root + part-set split --------------
-    merkle_extra = merkle_bench()
+    merkle_extra = _stamped("merkle", merkle_bench())
 
     # -- BLS aggregation: bytes/commit + verify latency vs per-sig --------
-    bls_extra = bls_bench()
+    bls_extra = _stamped("bls", bls_bench())
 
     # -- degraded mode: circuit-broken fallback + idle watchdog cost ------
-    degraded_extra = degraded_mode_bench()
+    degraded_extra = _stamped("degraded", degraded_mode_bench())
 
     # -- flight recorder: overhead + per-stage breakdown ------------------
-    trace_extra = trace_overhead_bench()
+    trace_extra = _stamped("trace", trace_overhead_bench())
 
     # -- AOT cold start: fresh process, warm AOT cache --------------------
     # VERDICT round 2 #2: a restarting validator must reach its first
@@ -658,6 +752,7 @@ def run_bench(platform: str, accelerator: bool = True):
         "unit": "ms",
         "vs_baseline": round(baseline_10k / best_p50, 2),
         "platform": platform,
+        **_jax_provenance(),
         "bench_n": n,
         "cold_compile_s": round(cold_s, 1),
         "host_baseline_ms": round(baseline_10k * 1e3, 1),
@@ -674,6 +769,8 @@ def run_bench(platform: str, accelerator: bool = True):
         **aot_extra,
     }
     regressions = _regression_guard(line, platform)
+    if GUARD_SKIPS:
+        line["guard_skips"] = list(GUARD_SKIPS)
     if regressions:
         # keep the PREVIOUS record as the baseline (recording the bad
         # run would mask the regression on the next comparison), emit
@@ -994,27 +1091,103 @@ def trace_overhead_bench() -> dict:
         items = [rng.bytes(45) for _ in range(TRACE_BENCH_LEAVES)]
         merkle.configure_device(False)
 
-        def workload():
-            t0 = time.perf_counter()
-            for _ in range(TRACE_BENCH_ITERS):
-                merkle.hash_from_byte_slices(items)
-            return time.perf_counter() - t0
-
         # explicit tracer object (set_tracer bypasses the TM_TRACE env
         # override on purpose: the bench must control both arms).
-        # The arms ALTERNATE and each takes its min: on a shared/busy
-        # host, back-to-back blocks differ by far more than the ~5us
-        # span cost, so a sequential A/B measures scheduler noise.
         tracer = _tr.set_tracer(_tr.Tracer(enabled=True, buffer_events=1 << 16))
-        workload()  # warm
-        on_times, off_times = [], []
+
+        def iteration(i: int) -> None:
+            # one instrumented-workload iteration: a host merkle root
+            # plus the cross-node propagation pair (origin = span-id
+            # alloc + flow-start, link = receiver-side flow-end), so
+            # the <3% budget covers tracing WITH propagation enabled —
+            # disabled, origin() is one flag check returning None and
+            # link(None) returns immediately
+            merkle.hash_from_byte_slices(items)
+            ctx = tracer.origin(height=i)
+            tracer.link(ctx, "consensus.proposal_link", height=i)
+
+        def arm_ms(iters: int) -> float:
+            t0 = time.perf_counter()
+            for i in range(iters):
+                iteration(i)
+            return (time.perf_counter() - t0) * 1e3
+
+        for i in range(3):
+            iteration(i)  # warm
+
+        # The budget check is an ATTRIBUTED ratio, not a differential
+        # A/B: on a shared host, back-to-back ~100ms blocks differ by
+        # 3-10x the true ~25us/iteration instrumentation cost (the
+        # measured sign even flips run to run), so a subtraction of two
+        # noisy walls can never hold a 3% threshold. The primitive
+        # costs ARE stable under a tight loop, and the recorder counts
+        # its own events exactly, so:
+        #     overhead = events-cost per iteration / iteration wall
+        # with the iteration wall taken from the uninstrumented arm's
+        # min (the only place min-of-N is still needed).
+        def _events() -> int:
+            return tracer.stats()["events_recorded"]
+
+        def _tight(fn, k: int):
+            # min over blocks: the first block absorbs cold-path costs
+            # (lazy inits, ring growth, branch warmup) that a single
+            # pass would bill to the steady-state per-call cost
+            block = max(k // 4, 1)
+            e0 = _events()
+            best = None
+            for _ in range(4):
+                t0 = time.perf_counter()
+                for i in range(block):
+                    fn(i)
+                dt = (time.perf_counter() - t0) / block
+                best = dt if best is None or dt < best else best
+            return best, (_events() - e0) / (block * 4)
+
+        probes = max(TRACE_BENCH_ITERS * 25, 500)
+
+        # span probe: a complete enter/exit pair per call
+        def _span_probe(i):
+            with tracer.span("bench.overhead_probe", height=i):
+                pass
+
+        span_cost, span_ev = _tight(_span_probe, probes)
+        ctx_holder = {}
+
+        def _origin_probe(i):
+            ctx_holder["ctx"] = tracer.origin(height=i)
+
+        origin_cost, origin_ev = _tight(_origin_probe, probes)
+
+        def _link_probe(i):
+            tracer.link(ctx_holder["ctx"], "consensus.proposal_link", height=i)
+
+        link_cost, link_ev = _tight(_link_probe, probes)
+
+        # exact instrumentation density of the workload iteration
+        e0 = _events()
+        on_ms = arm_ms(TRACE_BENCH_ITERS)
+        events_per_iter = (_events() - e0) / TRACE_BENCH_ITERS
+
+        # uninstrumented iteration wall (min over short blocks)
+        tracer.enabled = False
+        off_blocks = []
+        block = max(TRACE_BENCH_ITERS // 4, 1)
         for _ in range(8):
-            tracer.enabled = True
-            on_times.append(workload())
-            tracer.enabled = False
-            off_times.append(workload())
-        on_s, off_s = min(on_times), min(off_times)
+            off_blocks.append(arm_ms(block) / block)
         tracer.enabled = True
+        off_iter_ms = min(off_blocks)
+        off_ms = off_iter_ms * TRACE_BENCH_ITERS
+
+        # origin/link are costed per CALL; the remaining events are
+        # workload spans, costed per span-probe EVENT
+        span_events = max(events_per_iter - origin_ev - link_ev, 0.0)
+        per_span_event = span_cost / span_ev if span_ev else span_cost
+        instr_ms_per_iter = (
+            origin_cost + link_cost + per_span_event * span_events
+        ) * 1e3
+        overhead_pct = (
+            instr_ms_per_iter / off_iter_ms * 100 if off_iter_ms > 0 else None
+        )
 
         # drive the instrumented pipeline so the breakdown includes the
         # bundle lifecycle stages, not just merkle routing
@@ -1024,13 +1197,19 @@ def trace_overhead_bench() -> dict:
             for f in futs:
                 assert f.result().all()
 
-        # residual scheduler noise can leave on_s marginally below
-        # off_s; clamp at 0 — "no measurable overhead"
-        overhead_pct = max((on_s - off_s) / off_s * 100, 0.0) if off_s > 0 else None
         breakdown = tracer.timeline()["stages"]
         out = {
-            "trace_disabled_ms": round(off_s * 1e3, 2),
-            "trace_enabled_ms": round(on_s * 1e3, 2),
+            # informational differential reading (single pass per arm;
+            # noisy on shared hosts — the budget uses the attributed
+            # ratio below)
+            "trace_disabled_ms": round(off_ms, 2),
+            "trace_enabled_ms": round(on_ms, 2),
+            "trace_events_per_iter": round(events_per_iter, 2),
+            "trace_cost_us": {
+                "span_event": round(per_span_event * 1e6, 3),
+                "origin_call": round(origin_cost * 1e6, 3),
+                "link_call": round(link_cost * 1e6, 3),
+            },
             "trace_overhead_pct": round(overhead_pct, 2)
             if overhead_pct is not None
             else None,
@@ -1041,9 +1220,11 @@ def trace_overhead_bench() -> dict:
             "trace_stage_breakdown": breakdown,
         }
         log(
-            f"trace overhead: disabled {off_s*1e3:.1f} ms, enabled "
-            f"{on_s*1e3:.1f} ms ({out['trace_overhead_pct']}% for "
-            f"{out['trace_events_recorded']} events; "
+            f"trace overhead: {instr_ms_per_iter*1e3:.1f} us attributed per "
+            f"{off_iter_ms:.2f} ms iteration = {out['trace_overhead_pct']}% "
+            f"({events_per_iter:.1f} events/iter; span "
+            f"{per_span_event*1e6:.1f} us, origin {origin_cost*1e6:.1f} us, "
+            f"link {link_cost*1e6:.1f} us; "
             f"{len(breakdown)} stages in breakdown)"
         )
         if not out["trace_overhead_ok"]:

@@ -1,0 +1,225 @@
+#!/usr/bin/env python3
+"""traceview: summarize a Chrome trace into per-stage / per-height
+p50/p95 tables.
+
+The flight recorder's deep-dive view is perfetto (docs/tracing.md);
+this is the no-UI path for CI artifacts and ops triage — point it at a
+dumped trace file or a live node's ``dump_trace`` endpoint and get the
+latency attribution as text:
+
+    python scripts/traceview.py trace.json
+    python scripts/traceview.py --url http://127.0.0.1:26657
+    curl -s localhost:26657/dump_trace | python scripts/traceview.py -
+
+Accepts a raw Chrome trace document ({"traceEvents": [...]}), a
+JSON-RPC envelope around one ({"result": {...}}), or a merged
+multi-node document (tests/cs_harness.merged_trace) — per-node rows
+are labeled by process when process_name metadata is present.
+
+Output: a per-stage table (count, total, p50, p95, max over span
+durations) and a per-height table (wall + top stages per committed
+height, from spans carrying a ``height`` arg). ``--json`` emits the
+same numbers machine-readable for CI diffing; exit is 0 with spans, 2
+on unreadable input, 3 on a trace with no span events (an empty trace
+in CI usually means tracing was off — fail loudly, don't publish an
+empty artifact).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Any, Dict, List, Optional
+
+
+def _percentile(sorted_vals: List[float], q: float) -> float:
+    """Nearest-rank percentile over an already-sorted list."""
+    if not sorted_vals:
+        return 0.0
+    k = max(0, min(len(sorted_vals) - 1, int(round(q * (len(sorted_vals) - 1)))))
+    return sorted_vals[k]
+
+
+def load_doc(source: str, url: Optional[str], timeout_s: float = 10.0) -> dict:
+    """A Chrome trace document from a file path, '-' (stdin), or a
+    node's RPC base URL (fetches /dump_trace)."""
+    if url:
+        import urllib.request
+
+        target = url.rstrip("/")
+        if not target.endswith("dump_trace"):
+            target += "/dump_trace"
+        with urllib.request.urlopen(target, timeout=timeout_s) as resp:
+            raw = json.loads(resp.read().decode())
+    elif source == "-":
+        raw = json.load(sys.stdin)
+    else:
+        with open(source, encoding="utf-8") as fp:
+            raw = json.load(fp)
+    # unwrap a JSON-RPC envelope ({"result": {...}}) if present
+    if isinstance(raw, dict) and "traceEvents" not in raw:
+        inner = raw.get("result")
+        if isinstance(inner, dict) and "traceEvents" in inner:
+            raw = inner
+    if not isinstance(raw, dict) or "traceEvents" not in raw:
+        raise ValueError("input is not a Chrome trace document (no traceEvents)")
+    return raw
+
+
+def summarize(doc: dict) -> Dict[str, Any]:
+    """The per-stage and per-height aggregates over a trace document."""
+    procs: Dict[Any, str] = {}
+    stages: Dict[str, List[float]] = {}
+    heights: Dict[int, Dict[str, Any]] = {}
+    n_spans = n_instants = n_flows = 0
+    for ev in doc.get("traceEvents", []):
+        ph = ev.get("ph")
+        if ph == "M" and ev.get("name") == "process_name":
+            procs[ev.get("pid")] = ev.get("args", {}).get("name", "")
+            continue
+        if ph == "i":
+            n_instants += 1
+            continue
+        if ph in ("s", "f"):
+            n_flows += 1
+            continue
+        if ph != "X":
+            continue
+        n_spans += 1
+        dur_ms = float(ev.get("dur", 0.0)) / 1000.0
+        name = ev.get("name", "?")
+        stages.setdefault(name, []).append(dur_ms)
+        args = ev.get("args") or {}
+        h = args.get("height")
+        if isinstance(h, int):
+            rec = heights.setdefault(
+                h,
+                {"first_us": ev.get("ts", 0.0), "last_us": ev.get("ts", 0.0),
+                 "stages": {}},
+            )
+            t0 = float(ev.get("ts", 0.0))
+            rec["first_us"] = min(rec["first_us"], t0)
+            rec["last_us"] = max(rec["last_us"], t0 + float(ev.get("dur", 0.0)))
+            rec["stages"].setdefault(name, []).append(dur_ms)
+
+    def stats(vals: List[float]) -> Dict[str, float]:
+        s = sorted(vals)
+        return {
+            "count": len(s),
+            "total_ms": round(sum(s), 3),
+            "p50_ms": round(_percentile(s, 0.50), 3),
+            "p95_ms": round(_percentile(s, 0.95), 3),
+            "max_ms": round(s[-1], 3) if s else 0.0,
+        }
+
+    return {
+        "events": {"spans": n_spans, "instants": n_instants, "flows": n_flows},
+        "processes": {str(k): v for k, v in procs.items()},
+        "stages": {k: stats(v) for k, v in sorted(stages.items())},
+        "heights": {
+            h: {
+                "wall_ms": round((rec["last_us"] - rec["first_us"]) / 1000.0, 3),
+                "stages": {k: stats(v) for k, v in sorted(rec["stages"].items())},
+            }
+            for h, rec in sorted(heights.items())
+        },
+    }
+
+
+def _fmt_table(rows: List[List[str]], header: List[str]) -> str:
+    widths = [
+        max(len(str(r[i])) for r in [header] + rows) for i in range(len(header))
+    ]
+    def line(r):
+        return "  ".join(str(c).ljust(w) for c, w in zip(r, widths)).rstrip()
+    out = [line(header), line(["-" * w for w in widths])]
+    out.extend(line(r) for r in rows)
+    return "\n".join(out)
+
+
+def render_text(summary: Dict[str, Any], top: int, height: Optional[int]) -> str:
+    out: List[str] = []
+    ev = summary["events"]
+    out.append(
+        f"{ev['spans']} spans, {ev['instants']} instants, "
+        f"{ev['flows']} flow events"
+    )
+    if summary["processes"]:
+        out.append(
+            "processes: "
+            + ", ".join(f"{pid}={n}" for pid, n in summary["processes"].items())
+        )
+    out.append("")
+    out.append("== per-stage ==")
+    rows = [
+        [k, s["count"], s["total_ms"], s["p50_ms"], s["p95_ms"], s["max_ms"]]
+        for k, s in sorted(
+            summary["stages"].items(), key=lambda kv: -kv[1]["total_ms"]
+        )
+    ]
+    out.append(
+        _fmt_table(rows, ["stage", "count", "total_ms", "p50_ms", "p95_ms", "max_ms"])
+    )
+    out.append("")
+    out.append("== per-height ==")
+    for h, rec in summary["heights"].items():
+        if height is not None and h != height:
+            continue
+        out.append(f"height {h}  wall {rec['wall_ms']} ms")
+        rows = [
+            [k, s["count"], s["total_ms"], s["p50_ms"], s["p95_ms"], s["max_ms"]]
+            for k, s in sorted(
+                rec["stages"].items(), key=lambda kv: -kv[1]["total_ms"]
+            )[:top]
+        ]
+        out.append(
+            _fmt_table(
+                rows, ["  stage", "count", "total_ms", "p50_ms", "p95_ms", "max_ms"]
+            )
+        )
+    return "\n".join(out)
+
+
+def main(argv: List[str]) -> int:
+    p = argparse.ArgumentParser(
+        prog="traceview", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter,
+    )
+    p.add_argument("source", nargs="?", default=None,
+                   help="trace file path, or '-' for stdin")
+    p.add_argument("--url", default=None,
+                   help="node RPC base URL; fetches /dump_trace")
+    p.add_argument("--height", type=int, default=None,
+                   help="restrict the per-height table to one height")
+    p.add_argument("--top", type=int, default=12,
+                   help="stages per height in the text table (default 12)")
+    p.add_argument("--json", action="store_true", dest="as_json",
+                   help="emit the summary as JSON (CI artifact)")
+    args = p.parse_args(argv[1:])
+    if args.source is None and args.url is None:
+        p.print_usage(sys.stderr)
+        print("traceview: need a trace file, '-', or --url", file=sys.stderr)
+        return 2
+    try:
+        doc = load_doc(args.source or "", args.url)
+    except Exception as e:
+        print(f"traceview: cannot load trace: {e}", file=sys.stderr)
+        return 2
+    summary = summarize(doc)
+    if args.as_json:
+        print(json.dumps(summary, indent=2))
+    else:
+        print(render_text(summary, top=args.top, height=args.height))
+    if summary["events"]["spans"] == 0:
+        print(
+            "traceview: no span events — was tracing enabled "
+            "(trace_enabled / TM_TRACE=1)?",
+            file=sys.stderr,
+        )
+        return 3
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv))

@@ -363,6 +363,7 @@ def _load_builtin_rules() -> None:
         rules_metrics,
         rules_purity,
         rules_tests,
+        rules_trace,
         rules_truthiness,
     )
 

@@ -1,0 +1,107 @@
+"""trace-coherence: every span/instant name the tracer records is in
+the docs/tracing.md taxonomy.
+
+The tracing page promises a complete span taxonomy — it is how an
+operator staring at a perfetto view (or a traceview.py table) maps a
+slice name back to code and meaning. PR 12's cross-node propagation
+review found link/flow names that existed only in code; this rule is
+the metrics-coherence discipline applied to the flight recorder: a
+literal name passed to ``span()``/``instant()``/``flow_start()``/
+``flow_end()``/``link()`` — on the ``trace`` module or any tracer
+object — must appear in docs/tracing.md. Dynamically built names
+(``"consensus." + step``) are out of static reach and are skipped; the
+step-span names they produce are documented as the per-step rows.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Iterable, List, Optional, Set
+
+from tendermint_tpu.analysis.core import (
+    FileContext,
+    Project,
+    Rule,
+    Violation,
+    register,
+)
+
+_DOCS = "docs/tracing.md"
+_TRACE_MODULE = "tendermint_tpu.utils.trace"
+# method name -> index of the name argument
+_METHODS = {"span": 0, "instant": 0, "flow_start": 0, "flow_end": 0, "link": 1}
+# tracer span names are dotted lowercase ("pipeline.execute"); the
+# grammar gate keeps unrelated .span()/.instant() calls (re.Match.span,
+# datetimes) from false-positiving when the receiver isn't the module
+_NAME_RE = re.compile(r"^[a-z][a-z0-9_]*(\.[a-z0-9_]+)+$")
+
+
+def _trace_aliases(tree: ast.AST) -> Set[str]:
+    """Local names bound to the trace module in this file."""
+    out: Set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ImportFrom):
+            if node.module == "tendermint_tpu.utils":
+                for a in node.names:
+                    if a.name == "trace":
+                        out.add(a.asname or a.name)
+            elif node.module == _TRACE_MODULE:
+                pass  # direct-function imports handled by name grammar
+        elif isinstance(node, ast.Import):
+            for a in node.names:
+                if a.name == _TRACE_MODULE and a.asname:
+                    out.add(a.asname)
+    return out
+
+
+def _literal_name(call: ast.Call, idx: int) -> Optional[str]:
+    if len(call.args) <= idx:
+        return None
+    arg = call.args[idx]
+    if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+        return arg.value
+    return None
+
+
+class TraceCoherence(Rule):
+    name = "trace-coherence"
+    summary = (
+        "every literal span/instant/flow name recorded by the tracer "
+        "appears in the docs/tracing.md taxonomy"
+    )
+
+    def check_file(self, ctx: FileContext, project: Project) -> Iterable[Violation]:
+        if ctx.tree is None or not ctx.in_package:
+            return ()
+        docs = project.docs_text(_DOCS)
+        aliases = _trace_aliases(ctx.tree)
+        out: List[Violation] = []
+        for node in ctx.nodes:
+            if not (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr in _METHODS
+            ):
+                continue
+            name = _literal_name(node, _METHODS[node.func.attr])
+            if name is None:
+                continue
+            recv = node.func.value
+            on_module = isinstance(recv, ast.Name) and recv.id in aliases
+            if not on_module and not _NAME_RE.match(name):
+                continue  # not span-name shaped and not our module: skip
+            if name not in docs:
+                out.append(
+                    Violation(
+                        self.name, ctx.rel, node.lineno,
+                        f"trace name `{name}` is not in the {_DOCS} span "
+                        "taxonomy (the page promises to list every "
+                        "recorded name)",
+                        node.col_offset,
+                    )
+                )
+        return out
+
+
+register(TraceCoherence())

@@ -1,0 +1,305 @@
+"""Per-height latency ledger: where each committed height's wall time went.
+
+The flight recorder (utils/trace.py) answers "where did time go" when a
+human loads a trace; the e2e bench's open question (ROADMAP item 3:
+admission batches at 5-7x but consensus commits ~48 tx/s — WHICH of
+gossip, step transitions, ABCI round-trips, or verify waits eats the
+height?) needs the same attribution ALWAYS ON, machine-readable, and
+summing to the measured wall time so attribution gaps are visible
+rather than silent.
+
+Mechanics: the consensus receive routine is one asyncio task, so its
+time at a height partitions into (a) instrumented activity — the step
+transitions, vote-batch ingest, and the finalize sub-phases
+(save_block, WAL ENDHEIGHT fsync, apply_block with the ABCI deliver
+round-trip nested inside) — and (b) idle gaps between them, where the
+task waits on gossip/timeouts. ``push``/``pop`` calls from those sites
+maintain a nesting stack: each phase accumulates its EXCLUSIVE time
+(children subtracted), and every idle gap ending at a top-level push is
+attributed to what consensus was waiting for at that moment
+(``wait=``: gossip_block_parts / wait_prevotes / wait_precommits /
+wait_new_round). By construction the named phases tile the height
+window, so
+
+    wall_ms == sum(phases) + unaccounted_ms
+
+exactly (pinned by tests/test_height_ledger.py); ``unaccounted`` is
+whatever escaped instrumentation — unbalanced frames after an exception,
+time before the first instrumented site — and the acceptance bar keeps
+it under 10% of wall on a live net.
+
+Height close-out also captures cross-cutting DETAIL that overlaps the
+exclusive timeline (mempool residency of the committed txs, engine
+counter deltas over the height via ``engines_fn``) and feeds the
+always-on ``tendermint_consensus_height_phase_seconds{phase=...}``
+histogram family. The ``height_report`` RPC serves ``report()``.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import OrderedDict
+from typing import Any, Callable, Dict, List, Optional
+
+# Phases that appear in every report (so dashboards never 404 on a key);
+# others show up as recorded. Waits are gap attributions, the rest are
+# instrumented activity.
+KNOWN_PHASES = (
+    "new_round",
+    "propose",
+    "gossip_block_parts",
+    "prevote",
+    "wait_prevotes",
+    "precommit",
+    "wait_precommits",
+    "vote_ingest",
+    "commit",
+    "finalize_commit",
+    "save_block",
+    "wal_fsync",
+    "apply_block",
+    "abci_deliver",
+    "wait_new_round",
+    "unaccounted",
+)
+
+MAX_HEIGHTS = 128
+
+
+class _Record:
+    __slots__ = (
+        "height", "t_start", "t_done", "phases", "detail",
+        "engines_before", "engines", "txs", "rounds", "unbalanced", "closed",
+    )
+
+    def __init__(self, height: int, t_start: float):
+        self.height = height
+        self.t_start = t_start
+        self.t_done: Optional[float] = None
+        self.phases: Dict[str, float] = {}
+        self.detail: Dict[str, Any] = {}
+        self.engines_before: Optional[Dict[str, float]] = None
+        self.engines: Optional[Dict[str, float]] = None
+        self.txs = 0
+        self.rounds = 0
+        self.unbalanced = 0  # pop without matching push (exception paths)
+        self.closed = False
+
+
+class HeightLedger:
+    """Always-on exclusive phase attribution for committed heights.
+
+    push/pop are called only from the consensus task (single-threaded);
+    the lock protects ``report()`` readers on the RPC executor thread.
+    """
+
+    def __init__(
+        self,
+        metrics=None,
+        max_heights: int = MAX_HEIGHTS,
+        engines_fn: Optional[Callable[[], Dict[str, float]]] = None,
+    ):
+        self.metrics = metrics
+        self.max_heights = max(int(max_heights), 1)
+        # node-wired callable returning a FLAT numeric snapshot of the
+        # engine counters (node/node.py builds it from engine_stats());
+        # per-height deltas land in each record's "engines" section
+        self.engines_fn = engines_fn
+        self._lock = threading.Lock()
+        self._records: "OrderedDict[int, _Record]" = OrderedDict()
+        self._stack: List[list] = []  # [phase, t0, child_seconds]
+        self._cur: Optional[_Record] = None
+        self._last_t: Optional[float] = None  # last top-level activity edge
+
+    # -- recording (consensus task only) -----------------------------------
+
+    def _engines_snapshot(self) -> Optional[Dict[str, float]]:
+        if self.engines_fn is None:
+            return None
+        try:
+            snap = self.engines_fn()
+        except Exception:
+            return None
+        return {
+            k: float(v)
+            for k, v in (snap or {}).items()
+            if isinstance(v, (int, float))
+        }
+
+    def push(
+        self,
+        phase: str,
+        t: float,
+        height: Optional[int] = None,
+        wait: Optional[str] = None,
+    ) -> None:
+        """Enter an instrumented phase at perf_counter time ``t``. At a
+        TOP-LEVEL push, the idle gap since the last top-level edge is
+        attributed to ``wait`` (what consensus sat waiting for); nested
+        pushes just carve sub-phases out of their parent."""
+        if not self._stack:
+            cur = self._cur
+            if height is not None and (cur is None or cur.closed or cur.height != height):
+                cur = _Record(height, t)
+                cur.engines_before = self._engines_snapshot()
+                with self._lock:
+                    self._records[height] = cur
+                    while len(self._records) > self.max_heights:
+                        self._records.popitem(last=False)
+                self._cur = cur
+                self._last_t = None
+            if (
+                cur is not None
+                and not cur.closed
+                and self._last_t is not None
+                and wait
+            ):
+                gap = t - self._last_t
+                if gap > 0:
+                    with self._lock:
+                        cur.phases[wait] = cur.phases.get(wait, 0.0) + gap
+        self._stack.append([phase, t, 0.0])
+
+    def pop(self, phase: str, t: float) -> None:
+        """Exit a phase; accumulates its exclusive time. Tolerates a
+        mismatched stack (an exception unwound past a push) by
+        discarding inner frames and counting the imbalance."""
+        cur = self._cur
+        if not self._stack:
+            if cur is not None:
+                cur.unbalanced += 1
+            return
+        while self._stack and self._stack[-1][0] != phase:
+            self._stack.pop()
+            if cur is not None:
+                cur.unbalanced += 1
+        if not self._stack:
+            if cur is not None:
+                cur.unbalanced += 1
+            return
+        _, t0, child = self._stack.pop()
+        dur = max(t - t0, 0.0)
+        if self._stack:
+            self._stack[-1][2] += dur
+        else:
+            self._last_t = t
+        if cur is not None and not cur.closed:
+            excl = max(dur - child, 0.0)
+            with self._lock:
+                cur.phases[phase] = cur.phases.get(phase, 0.0) + excl
+
+    def height_done(
+        self,
+        height: int,
+        t: float,
+        txs: int = 0,
+        rounds: int = 0,
+        mempool_residency: Optional[dict] = None,
+    ) -> None:
+        """Close the record for ``height``: compute the wall window,
+        snapshot engine deltas, observe the phase histograms."""
+        cur = self._cur
+        if cur is None or cur.height != height or cur.closed:
+            return
+        with self._lock:
+            cur.t_done = t
+            cur.txs = int(txs)
+            cur.rounds = int(rounds)
+            cur.closed = True
+            if mempool_residency:
+                cur.detail["mempool_residency"] = dict(mempool_residency)
+            # Settle the still-open frames: commit fires while the
+            # receive-routine frame that triggered it is on the stack,
+            # so its elapsed-so-far sits inside THIS height's window —
+            # accumulate it now (exclusive of the open child above it)
+            # and restart each frame at ``t`` so the remainder falls
+            # outside the window instead of leaking into unaccounted.
+            open_child = 0.0
+            for frame in reversed(self._stack):
+                _phase, t0, child = frame
+                excl = max((t - t0) - child - open_child, 0.0)
+                if excl > 0:
+                    cur.phases[_phase] = cur.phases.get(_phase, 0.0) + excl
+                open_child = t - t0
+                frame[1] = t
+                frame[2] = 0.0
+        after = self._engines_snapshot()
+        if after is not None and cur.engines_before is not None:
+            cur.engines = {
+                k: round(v - cur.engines_before.get(k, 0.0), 6)
+                for k, v in after.items()
+                if v != cur.engines_before.get(k, 0.0)
+            }
+        self._last_t = None
+        self._observe_metrics(cur)
+
+    def _observe_metrics(self, rec: _Record) -> None:
+        m = self.metrics
+        hist = getattr(m, "height_phase_seconds", None) if m is not None else None
+        if hist is None:
+            return
+        wall = (rec.t_done or rec.t_start) - rec.t_start
+        accounted = 0.0
+        for phase, s in rec.phases.items():
+            accounted += s
+            hist.with_labels(phase=phase).observe(s)
+        hist.with_labels(phase="unaccounted").observe(max(wall - accounted, 0.0))
+
+    # -- reporting (any thread) --------------------------------------------
+
+    @staticmethod
+    def _record_json(rec: _Record) -> Dict[str, Any]:
+        wall = ((rec.t_done if rec.t_done is not None else rec.t_start) - rec.t_start)
+        phases = {k: round(v * 1e3, 6) for k, v in sorted(rec.phases.items())}
+        unaccounted = round(wall * 1e3 - sum(phases.values()), 6)
+        out: Dict[str, Any] = {
+            "height": rec.height,
+            "wall_ms": round(wall * 1e3, 6),
+            "phases": phases,
+            "unaccounted_ms": unaccounted,
+            "unaccounted_pct": round(unaccounted / (wall * 1e3) * 100, 2)
+            if wall > 0
+            else 0.0,
+            "txs": rec.txs,
+            "rounds": rec.rounds,
+        }
+        if rec.unbalanced:
+            out["unbalanced_frames"] = rec.unbalanced
+        if rec.detail:
+            out["detail"] = rec.detail
+        if rec.engines:
+            out["engines"] = rec.engines
+        return out
+
+    def report(self, height: Optional[int] = None) -> Dict[str, Any]:
+        """The height_report RPC payload: per-height phase breakdowns
+        (newest last; one height when ``height`` is given) plus a
+        cross-height aggregate of mean phase milliseconds."""
+        with self._lock:
+            recs = [
+                r
+                for h, r in self._records.items()
+                if r.closed and (height is None or h == height)
+            ]
+            heights = [self._record_json(r) for r in recs]
+        agg: Dict[str, float] = {}
+        walls: List[float] = []
+        for h in heights:
+            walls.append(h["wall_ms"])
+            for k, v in h["phases"].items():
+                agg[k] = agg.get(k, 0.0) + v
+            agg["unaccounted"] = agg.get("unaccounted", 0.0) + h["unaccounted_ms"]
+        n = len(heights)
+        return {
+            "heights": heights,
+            "count": n,
+            "known_phases": list(KNOWN_PHASES),
+            "aggregate": {
+                "mean_wall_ms": round(sum(walls) / n, 3) if n else 0.0,
+                "mean_phase_ms": {
+                    k: round(v / n, 4) for k, v in sorted(agg.items())
+                }
+                if n
+                else {},
+            },
+        }

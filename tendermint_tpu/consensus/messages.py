@@ -21,6 +21,7 @@ from tendermint_tpu.types.part_set import Part
 from tendermint_tpu.types.proposal import Proposal
 from tendermint_tpu.types.vote import Vote
 from tendermint_tpu.utils.bits import BitArray
+from tendermint_tpu.utils.trace import OriginContext
 
 # type tags
 T_NEW_ROUND_STEP = 0x01
@@ -52,6 +53,30 @@ def _r_bits(r: Reader) -> Optional[BitArray]:
         return None
     n = r.read_uvarint()
     return BitArray.from_bytes(r.read_bytes(), n)
+
+
+# -- cross-node trace origin (append-and-tolerate; docs/tracing.md) --------
+#
+# The gossip envelopes that CAUSE work on a peer (proposal, block part,
+# vote) may carry an OriginContext trailer: sender node id + span id +
+# height/round + wall-clock stamp. The encoding is the
+# ResponseCheckTx.priority precedent — appended after every existing
+# field, so an old decoder (which never calls expect_done on message
+# bodies) ignores it, and the new decoder treats absent/truncated/
+# malformed trailing bytes as "no origin", never a decode error a
+# byzantine peer could weaponize. With tracing disabled the trailer is
+# OMITTED entirely: the wire stays byte-identical to the untraced form.
+
+
+def _w_origin(w: Writer, origin: Optional[OriginContext]) -> None:
+    if origin is not None:
+        origin.encode(w)
+
+
+def _r_origin(r: Reader) -> Optional[OriginContext]:
+    if not r.remaining():
+        return None
+    return OriginContext.decode(r)
 
 
 @dataclass
@@ -101,13 +126,15 @@ class NewValidBlockMessage:
 @dataclass
 class ProposalMessage:
     proposal: Proposal
+    origin: Optional[OriginContext] = None
 
     def encode_body(self, w: Writer) -> None:
         w.write_bytes(self.proposal.encode())
+        _w_origin(w, self.origin)
 
     @classmethod
     def decode_body(cls, r: Reader) -> "ProposalMessage":
-        return cls(Proposal.decode(r.read_bytes()))
+        return cls(Proposal.decode(r.read_bytes()), _r_origin(r))
 
 
 @dataclass
@@ -132,26 +159,30 @@ class BlockPartMessage:
     height: int
     round: int
     part: Part
+    origin: Optional[OriginContext] = None
 
     def encode_body(self, w: Writer) -> None:
         w.write_u64(self.height).write_i64(self.round)
         w.write_bytes(self.part.encode())
+        _w_origin(w, self.origin)
 
     @classmethod
     def decode_body(cls, r: Reader) -> "BlockPartMessage":
-        return cls(r.read_u64(), r.read_i64(), Part.decode(r.read_bytes()))
+        return cls(r.read_u64(), r.read_i64(), Part.decode(r.read_bytes()), _r_origin(r))
 
 
 @dataclass
 class VoteMessage:
     vote: Vote
+    origin: Optional[OriginContext] = None
 
     def encode_body(self, w: Writer) -> None:
         w.write_bytes(self.vote.encode())
+        _w_origin(w, self.origin)
 
     @classmethod
     def decode_body(cls, r: Reader) -> "VoteMessage":
-        return cls(Vote.decode(r.read_bytes()))
+        return cls(Vote.decode(r.read_bytes()), _r_origin(r))
 
 
 @dataclass

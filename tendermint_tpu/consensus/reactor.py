@@ -256,6 +256,42 @@ class ConsensusReactor(Reactor):
 
     # -- gossip routines ---------------------------------------------------
 
+    def _proposal_origin(self):
+        """The propose-span OriginContext to re-attach when relaying
+        this round's proposal/parts over the wire (the reactor
+        re-encodes messages, so the state machine keeps the original
+        origin on ``_proposal_origin_tx``; docs/tracing.md). None while
+        tracing is off — the wire stays byte-identical untraced."""
+        if not self.cs._tr().enabled:
+            return None
+        return getattr(self.cs, "_proposal_origin_tx", None)
+
+    def _vote_origin(self, vote):
+        """A per-hop origin for a vote gossip send: votes live in
+        VoteSets stripped of their envelope, so each relay hop links
+        receiver-to-sender (the propose→vote link rides the step
+        spans). The tiny span gives perfetto a slice to anchor the
+        flow-start arrow to. Records through the node's OWN tracer
+        (``cs._tr()``) — the same one the step spans feed — so a
+        per-node-tracer net keeps each node's trace in one document."""
+        t = self.cs._tr()
+        if not t.enabled:
+            return None
+        # our own vote's first hop reuses the sign-time origin (the
+        # flow-start already recorded inside our prevote/precommit step
+        # span) so receivers link back to the step that signed it —
+        # and that flow-start never dangles
+        own = self.cs._my_vote_origins.get(
+            (vote.height, vote.round, vote.vote_type)
+        )
+        if own is not None and vote.validator_address == self.cs._priv_validator_addr:
+            return own
+        with t.span("consensus.gossip_vote", height=vote.height, round=vote.round):
+            origin = t.origin(height=vote.height, round_=vote.round)
+        if origin is not None and not origin.node_id:
+            origin.node_id = self.cs.node_id
+        return origin
+
     async def _gossip_data_routine(self, peer: Peer, ps: PeerState) -> None:
         """Reference gossipDataRoutine :467."""
         try:
@@ -293,13 +329,20 @@ class ConsensusReactor(Reactor):
             if idx is not None:
                 part = rs.proposal_block_parts.get_part(idx)
                 if part is not None:
-                    msg = m.BlockPartMessage(rs.height, rs.round, part)
+                    msg = m.BlockPartMessage(
+                        rs.height, rs.round, part, origin=self._proposal_origin()
+                    )
                     if peer.try_send(DATA_CHANNEL, m.encode_msg(msg)):
                         ps.set_has_proposal_block_part(prs.height, prs.round, idx)
                         return True
         # 2. send the proposal (+POL) if the peer doesn't have it
         if rs.proposal is not None and not prs.proposal:
-            if peer.try_send(DATA_CHANNEL, m.encode_msg(m.ProposalMessage(rs.proposal))):
+            if peer.try_send(
+                DATA_CHANNEL,
+                m.encode_msg(
+                    m.ProposalMessage(rs.proposal, origin=self._proposal_origin())
+                ),
+            ):
                 ps.set_has_proposal(rs.proposal)
                 if rs.proposal.pol_round >= 0 and rs.votes is not None:
                     pol = rs.votes.prevotes(rs.proposal.pol_round)
@@ -409,7 +452,10 @@ class ConsensusReactor(Reactor):
         vote = ps.pick_send_vote(votes)
         if vote is None:
             return False
-        return peer.try_send(VOTE_CHANNEL, m.encode_msg(m.VoteMessage(vote)))
+        return peer.try_send(
+            VOTE_CHANNEL,
+            m.encode_msg(m.VoteMessage(vote, origin=self._vote_origin(vote))),
+        )
 
     async def _query_maj23_routine(self, peer: Peer, ps: PeerState) -> None:
         """Reference queryMaj23Routine :738: periodically tell peers about

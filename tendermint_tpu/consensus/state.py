@@ -132,32 +132,40 @@ PEER_PUNISH_ERRORS = (
 
 class _StepSpan:
     """Trace span + ``consensus_step_duration_seconds{step=...}``
-    histogram around one step transition. The histogram is fed even
-    with tracing off (it is the cheap always-on summary; the trace is
-    the deep-dive), so timing runs unconditionally."""
+    histogram + height-ledger phase around one step transition. The
+    histogram and the ledger are fed even with tracing off (they are
+    the cheap always-on summary; the trace is the deep-dive), so timing
+    runs unconditionally. Spans record into the node's OWN tracer
+    (``cs.tracer``) when one is set — the cs_harness gives each
+    in-process node a distinct tracer so a merged multi-node trace has
+    per-node process rows — else the process-global one."""
 
-    __slots__ = ("_cs", "_step", "_span", "_t0")
+    __slots__ = ("_cs", "_step", "_height", "_span", "_t0")
 
     def __init__(self, cs: "ConsensusState", step: str, height: int, round_: int):
         self._cs = cs
         self._step = step
-        self._span = trace.span("consensus." + step, height=height, round=round_) \
-            if trace.enabled() else trace.NOOP_SPAN
+        self._height = height
+        self._span = cs._tr().span("consensus." + step, height=height, round=round_)
 
     def __enter__(self):
         self._t0 = time.perf_counter()
+        self._cs.ledger.push(
+            self._step, self._t0,
+            height=self._height, wait=self._cs._wait_context(),
+        )
         self._span.__enter__()
         return self._span
 
     def __exit__(self, *exc) -> bool:
         self._span.__exit__(*exc)
+        t1 = time.perf_counter()
+        self._cs.ledger.pop(self._step, t1)
         m = self._cs.metrics
         if m is not None:
             hist = getattr(m, "step_duration_seconds", None)
             if hist is not None:
-                hist.with_labels(step=self._step).observe(
-                    time.perf_counter() - self._t0
-                )
+                hist.with_labels(step=self._step).observe(t1 - self._t0)
         return False
 
 
@@ -205,9 +213,45 @@ class ConsensusState(Service):
         wal: Optional[WAL] = None,
         metrics=None,
         logger=None,
+        node_id: str = "",
+        tracer=None,
     ):
         super().__init__("consensus", logger=None)
         self.logger = logger or get_logger("consensus")
+        # cross-node trace identity (docs/tracing.md): stamps the
+        # OriginContext trailer on outgoing proposals/parts/votes so
+        # peers can link their spans back to ours. "" disables nothing
+        # — origins are only emitted while the tracer is enabled.
+        self.node_id = node_id
+        # per-node tracer override (cs_harness multi-node nets); None =
+        # the process-global tracer (live node)
+        self.tracer = tracer
+        # origin of the proposal we are acting on this round: the
+        # receive path stashes it, the prevote step span consumes it
+        # (flow-end inside the vote span = the cross-node link)
+        self._proposal_origin = None
+        # the same origin kept for RE-GOSSIP: the reactor re-encodes
+        # proposal/part messages when relaying, so the original
+        # propose-span origin must survive the consume above for peers
+        # further out (consensus/reactor.py attaches it per send)
+        self._proposal_origin_tx = None
+        # sign-time origins of OUR OWN votes, keyed (height, round,
+        # type): votes live in VoteSets stripped of their envelope, so
+        # the reactor re-reads the origin here for the first wire hop —
+        # without this the flow-start opened inside our prevote/
+        # precommit step span would dangle on a live node (internal
+        # delivery skips _note_origin)
+        self._my_vote_origins: dict = {}
+        # per-height latency ledger (consensus/ledger.py): always-on
+        # exclusive phase attribution behind the height_report RPC and
+        # the tendermint_consensus_height_phase_seconds family
+        from tendermint_tpu.consensus.ledger import HeightLedger
+
+        self.ledger = HeightLedger(metrics=metrics)
+        # thread the ledger into block execution so the ABCI deliver
+        # round-trip shows up as its own sub-phase under apply_block
+        if block_exec is not None:
+            block_exec.ledger = self.ledger
         self.config = config
         self._block_exec = block_exec
         self._block_store = block_store
@@ -247,6 +291,65 @@ class ConsensusState(Service):
 
         self.update_to_state(state)
         self._reconstruct_last_commit_if_needed(state)
+
+    # ------------------------------------------------------------------
+    # tracing / latency attribution helpers
+    # ------------------------------------------------------------------
+
+    def _tr(self):
+        """This node's tracer: the per-node instance when set (harness
+        multi-node nets), else the process-global one."""
+        return self.tracer if self.tracer is not None else trace.get_tracer()
+
+    def _wait_context(self) -> str:
+        """What consensus was WAITING FOR during the idle gap that just
+        ended (the ledger attributes the gap to this phase): named by
+        the round step we sat in — docs/tracing.md, height ledger."""
+        s = self.rs.step
+        if s == STEP_PROPOSE or s == STEP_COMMIT:
+            # propose done (or commit entered without the full block):
+            # idling for block-parts gossip
+            return "gossip_block_parts"
+        if s in (STEP_PREVOTE, STEP_PREVOTE_WAIT):
+            return "wait_prevotes"
+        if s in (STEP_PRECOMMIT, STEP_PRECOMMIT_WAIT):
+            return "wait_precommits"
+        # NEW_HEIGHT / NEW_ROUND: waiting to start proposing
+        return "wait_new_round"
+
+    def _note_origin(self, msg, peer_id: str) -> None:
+        """Receive-side half of cross-node trace propagation: stash a
+        peer proposal's origin for the prevote span to consume (the
+        propose→vote flow link), and link peer votes immediately. Free
+        when tracing is off (origins only ride the wire while the
+        SENDER traces; linking only records while WE trace)."""
+        origin = getattr(msg, "origin", None)
+        if origin is None or not peer_id:
+            return
+        if isinstance(msg, (ProposalMessage, BlockPartMessage)):
+            if self._proposal_origin is None and origin.height == self.rs.height:
+                self._proposal_origin = origin
+            if self._proposal_origin_tx is None and origin.height == self.rs.height:
+                self._proposal_origin_tx = origin  # survives for re-gossip
+        elif isinstance(msg, VoteMessage):
+            t = self._tr()
+            if t.enabled:
+                t.link(
+                    origin, "consensus.vote_link",
+                    height=origin.height, round=origin.round,
+                )
+
+    def _consume_proposal_origin(self, height: int) -> None:
+        """Inside the prevote step span: close the flow the proposer
+        opened inside its propose span — in a merged trace the arrow
+        lands here, in this peer's vote span."""
+        origin = self._proposal_origin
+        if origin is None or origin.height != height:
+            return
+        self._proposal_origin = None
+        t = self._tr()
+        if t.enabled:
+            t.link(origin, "consensus.proposal_link", height=height)
 
     # ------------------------------------------------------------------
     # lifecycle
@@ -370,6 +473,9 @@ class ConsensusState(Service):
         rs.proposal = None
         rs.proposal_block = None
         rs.proposal_block_parts = None
+        self._proposal_origin = None
+        self._proposal_origin_tx = None
+        self._my_vote_origins.clear()
         rs.locked_round = -1
         rs.locked_block = None
         rs.locked_block_parts = None
@@ -523,6 +629,16 @@ class ConsensusState(Service):
 
     async def _handle_msg(self, mi: MsgInfo) -> None:
         msg, peer_id = mi.msg, mi.peer_id
+        self._note_origin(msg, peer_id)
+        # Gossip ingest is ledger activity, not just a wait: proposal
+        # signature checks and part-proof verification would otherwise
+        # land in `unaccounted` (the step transitions they trigger are
+        # nested frames, subtracted as children).
+        phase = "vote_ingest" if isinstance(msg, VoteMessage) else "gossip_block_parts"
+        self.ledger.push(
+            phase, time.perf_counter(),
+            height=self.rs.height, wait=self._wait_context(),
+        )
         try:
             if isinstance(msg, ProposalMessage):
                 await self.set_proposal(msg.proposal)
@@ -551,6 +667,8 @@ class ConsensusState(Service):
                     "ignoring out-of-sync peer message",
                     peer=peer_id, msg_type=type(msg).__name__, err=repr(e),
                 )
+        finally:
+            self.ledger.pop(phase, time.perf_counter())
 
     def _punish_peer(self, peer_id: str, err: Exception) -> None:
         if peer_id and self.on_peer_error is not None:
@@ -566,8 +684,17 @@ class ConsensusState(Service):
         transitions are identical to one-at-a-time processing because
         the transition functions read only VoteSet aggregates."""
         rs = self.rs
-        with trace.span("consensus.vote_batch", height=rs.height, votes=len(batch)):
-            await self._do_handle_vote_batch(batch)
+        t0 = time.perf_counter()
+        self.ledger.push(
+            "vote_ingest", t0, height=rs.height, wait=self._wait_context()
+        )
+        try:
+            with self._tr().span(
+                "consensus.vote_batch", height=rs.height, votes=len(batch)
+            ):
+                await self._do_handle_vote_batch(batch)
+        finally:
+            self.ledger.pop("vote_ingest", time.perf_counter())
 
     async def _do_handle_vote_batch(self, batch) -> None:
         rs = self.rs
@@ -576,6 +703,10 @@ class ConsensusState(Service):
         for mi in batch:
             vote = mi.msg.vote
             if vote.height == rs.height and rs.votes is not None:
+                # "other" items get their origin noted inside
+                # _handle_msg below — noting here too would emit two
+                # flow-ends for one flow-start
+                self._note_origin(mi.msg, mi.peer_id)
                 current.append(mi)
             else:
                 other.append(mi)  # lastCommit votes / wrong height
@@ -680,8 +811,9 @@ class ConsensusState(Service):
         ):
             self.logger.debug("ignoring timeout for stale H/R/S", ti=repr(ti))
             return
-        if trace.enabled():
-            trace.instant(
+        t = self._tr()
+        if t.enabled:
+            t.instant(
                 "consensus.timeout",
                 height=ti.height, round=ti.round, step=step_name(ti.step),
             )
@@ -753,6 +885,8 @@ class ConsensusState(Service):
             rs.proposal = None
             rs.proposal_block = None
             rs.proposal_block_parts = None
+            self._proposal_origin = None
+            self._proposal_origin_tx = None
         rs.triggered_timeout_precommit = False
         rs.votes.set_round(round_ + 1)  # track next round too
 
@@ -834,9 +968,22 @@ class ConsensusState(Service):
             if not self.replay_mode:
                 self.logger.error("propose: error signing proposal", err=str(e))
             return
-        self.send_internal(ProposalMessage(proposal))
+        # cross-node trace origin: opened INSIDE our propose step span
+        # (we are called under _StepSpan("propose")), so the flow-start
+        # half of the link nests where the work happened; peers close it
+        # inside their prevote spans. None while tracing is off — the
+        # wire then stays byte-identical to the untraced encoding.
+        origin = self._tr().origin(height=height, round_=round_)
+        if origin is not None:
+            origin.node_id = origin.node_id or self.node_id
+        self._proposal_origin_tx = origin  # reactor re-gossip carries it
+        self.send_internal(ProposalMessage(proposal, origin=origin))
         for i in range(block_parts.total):
-            self.send_internal(BlockPartMessage(height, round_, block_parts.get_part(i)))
+            self.send_internal(
+                BlockPartMessage(
+                    height, round_, block_parts.get_part(i), origin=origin
+                )
+            )
         self.logger.info("signed proposal", height=height, round=round_, proposal=repr(proposal))
 
     def _create_proposal_block(self):
@@ -862,6 +1009,9 @@ class ConsensusState(Service):
             return
         self.logger.debug("enterPrevote", height=height, round=round_)
         with self._step_span("prevote", height, round_):
+            # the cross-node link: the proposer's propose-span flow ends
+            # HERE, inside the span our vote is signed under
+            self._consume_proposal_origin(height)
             rs.round = round_
             rs.step = STEP_PREVOTE
             self._new_step()
@@ -1059,6 +1209,7 @@ class ConsensusState(Service):
         if block is None or block.hash() != block_id.hash:
             raise ConsensusError("cannot finalize: no/wrong proposal block")
 
+        ledger = self.ledger
         with self._step_span("finalize_commit", height, rs.commit_round) as sp:
             sp.set(txs=len(block.data.txs))
             self._block_exec.validate_block(self.state, block)
@@ -1066,19 +1217,31 @@ class ConsensusState(Service):
 
             if self._block_store.height < block.header.height:
                 seen_commit = rs.votes.precommits(rs.commit_round).make_commit()
-                with trace.span("consensus.save_block", height=height):
-                    self._block_store.save_block(block, block_parts, seen_commit)
+                ledger.push("save_block", time.perf_counter())
+                try:
+                    with self._tr().span("consensus.save_block", height=height):
+                        self._block_store.save_block(block, block_parts, seen_commit)
+                finally:
+                    ledger.pop("save_block", time.perf_counter())
             fail.fail()  # crash point 2: block saved, no ENDHEIGHT
 
             # ENDHEIGHT marks this height fully input-complete (fsync'd).
-            self.wal.write_sync(EndHeightMessage(height))
+            ledger.push("wal_fsync", time.perf_counter())
+            try:
+                self.wal.write_sync(EndHeightMessage(height))
+            finally:
+                ledger.pop("wal_fsync", time.perf_counter())
             fail.fail()  # crash point 3: ENDHEIGHT written, not applied
 
             state_copy = self.state.copy()
-            with trace.span("consensus.apply_block", height=height):
-                new_state, retain_height = await self._block_exec.apply_block(
-                    state_copy, block_id, block
-                )
+            ledger.push("apply_block", time.perf_counter())
+            try:
+                with self._tr().span("consensus.apply_block", height=height):
+                    new_state, retain_height = await self._block_exec.apply_block(
+                        state_copy, block_id, block
+                    )
+            finally:
+                ledger.pop("apply_block", time.perf_counter())
             fail.fail()  # crash point 4: applied + state saved
 
         if retain_height > 0:
@@ -1097,6 +1260,16 @@ class ConsensusState(Service):
                 self.metrics.block_interval_seconds.observe(
                     max(block.header.time_ns - self.state.last_block_time_ns, 0) / 1e9
                 )
+        # close the height's ledger record: computes phase waits +
+        # unaccounted residual, observes the height-phase histograms,
+        # snapshots engine deltas (consensus/ledger.py)
+        self.ledger.height_done(
+            height,
+            time.perf_counter(),
+            txs=len(block.data.txs),
+            rounds=rs.commit_round + 1,
+            mempool_residency=getattr(self._mempool, "last_update_residency", None),
+        )
         self.evsw.fire_event(EVENT_COMMITTED, block)
         self.update_to_state(new_state)
         self._done_first_block.set()
@@ -1293,7 +1466,17 @@ class ConsensusState(Service):
             return None
         vote = await self._sign_vote(vote_type, block_hash, parts_header)
         if vote is not None:
-            self.send_internal(VoteMessage(vote))
+            # origin opened inside the prevote/precommit step span we
+            # are signing under — receivers link their vote processing
+            # back to this span in a merged trace
+            origin = self._tr().origin(height=rs.height, round_=rs.round)
+            if origin is not None:
+                origin.node_id = origin.node_id or self.node_id
+                # the reactor re-encodes wire sends; it re-reads this
+                # stash so real peers close THIS flow-start (the one
+                # inside our step span), not a fresh per-hop one
+                self._my_vote_origins[(rs.height, rs.round, vote_type)] = origin
+            self.send_internal(VoteMessage(vote, origin=origin))
             self.logger.info("signed and pushed vote", vote=repr(vote))
             return vote
         if not self.replay_mode:

@@ -134,6 +134,26 @@ def device_stats() -> Dict[str, int]:
     return out
 
 
+def engine_stats() -> Optional[Dict[str, object]]:
+    """The merkle engine's unified-telemetry view (models/telemetry.py)
+    with what the SEAM owns merged in: host-path tree counts and the
+    runtime ``merkle.device`` breaker sit here, not in the hasher.
+    None when the device engine never engaged (an all-host node has no
+    merkle engine to report)."""
+    h = _HASHER
+    if h is None:
+        return None
+    from tendermint_tpu.models.telemetry import breaker_view
+
+    st = h.engine_stats()
+    st["counters"] = {**st["counters"], **_HOST_STATS}
+    st["host_rows"] = float(_HOST_STATS["host_roots"] + _HOST_STATS["host_proof_sets"])
+    # _device_breaker() creates on first use: with a hasher built the
+    # runtime breaker is part of this engine's telemetry either way
+    st["breakers"] = {**st["breakers"], **breaker_view(_device_breaker())}
+    return st
+
+
 def hasher_warmup(sizes=(1024, 10240), background: bool = True):
     """Pre-compile device buckets (node-start path); no-op when the
     engine is disabled or unavailable."""

@@ -281,6 +281,15 @@ class PipelinedVerifier(BatchVerifier):
         self._watchdog = None
         self._deadline_s: Optional[float] = None
 
+        # submit→execute wait distribution (models/telemetry.py): the
+        # unified engine-telemetry protocol's queue_wait section, and
+        # the "verify-bundle queue+execute" signal the height ledger
+        # attributes per height. Observed unconditionally per bundle
+        # (one perf_counter read + a bucket increment).
+        from tendermint_tpu.models.telemetry import QueueWaitHist
+
+        self.queue_wait = QueueWaitHist()
+
         # bundle currently executing (or abandoned by a dead exec
         # thread) — what _fail_leftovers resolves that the queues can't
         self._inflight_bundle: Optional[_Bundle] = None
@@ -571,6 +580,63 @@ class PipelinedVerifier(BatchVerifier):
         for k, v in self.cache.stats().items():
             s[f"cache_{k}"] = v
         return s
+
+    def engine_stats(self) -> Dict[str, object]:
+        """The unified engine-telemetry protocol (models/telemetry.py):
+        bucket compile state comes from the wrapped verifier model's
+        executables + per-valset tables; ``host_rows`` counts the
+        sync-caller serial fallbacks (a liveness escape, each one a
+        whole request verified on the host path)."""
+        from tendermint_tpu.models.telemetry import breaker_view, bucket_entry
+
+        with self._cv:
+            device_rows = self.device_rows
+            counters = {
+                "submitted_calls": self.submitted_calls,
+                "submitted_rows": self.submitted_rows,
+                "dispatched_bundles": self.dispatched_bundles,
+                "coalesced_bundles": self.coalesced_bundles,
+                "bundle_dup_rows": self.bundle_dup_rows,
+                "fallback_serial": self.fallback_serial,
+                "worker_restarts": self.worker_restarts,
+            }
+            # instantaneous, NOT in counters: the protocol's counters
+            # section is monotonic extras (the height ledger diffs it;
+            # a draining queue would show up as a negative "delta")
+            queue_depth = len(self._q)
+        cache = self.cache.stats()
+        counters["cache_hits"] = cache["hits"]
+        counters["cache_misses"] = cache["misses"]
+        buckets: Dict[str, dict] = {}
+        breakers: Dict[str, dict] = {}
+        model = self.model  # the wrapped VerifierModel (None for CPU inner)
+        if model is not None:
+            entries = getattr(model, "_entries", None)
+            if entries:
+                # keys are (kind, n_pad, msg_len) for the plain buckets
+                # and (kind, n_pad, msg_len, tpl_pad, table_rows,
+                # n_shards) for tabled/templated ones — label by joining
+                # whatever arity the model used
+                for key, e in dict(entries).items():
+                    parts = key if isinstance(key, tuple) else (key,)
+                    label = "/".join(str(p) for p in parts)
+                    buckets[f"fn:{label}"] = bucket_entry(e)
+            tables = getattr(model, "_valset_tables", None)
+            if tables:
+                for key, e in dict(tables).items():
+                    label = key.hex()[:12] if isinstance(key, bytes) else str(key)
+                    buckets[f"tables:{label}"] = bucket_entry(e)
+            breakers = breaker_view(getattr(model, "tables_breaker", None))
+        return {
+            "engine": "pipeline",
+            "device_rows": float(device_rows),
+            "host_rows": float(counters["fallback_serial"]),
+            "buckets": buckets,
+            "breakers": breakers,
+            "queue_wait_ms": self.queue_wait.snapshot(),
+            "counters": counters,
+            "queue_depth": queue_depth,
+        }
 
     def stop(self, drain: bool = True, timeout: float = 30.0) -> None:
         """Drain and join. With ``drain`` (the node-stop path) every
@@ -919,15 +985,15 @@ class PipelinedVerifier(BatchVerifier):
             rows=rows,
         )
         with sp:
+            # dispatch-occupancy attribution: how long the oldest
+            # request waited from submit to device execution — always
+            # observed into the engine-telemetry histogram, attached to
+            # the span only while tracing
+            now = time.perf_counter_ns()
+            wait_ms = (now - min(i.t_enq for i in bundle.items)) / 1e6
+            self.queue_wait.observe_ms(wait_ms)
             if sp is not trace.NOOP_SPAN:
-                # dispatch-occupancy attribution: how long the oldest
-                # request waited from submit to device execution
-                now = time.perf_counter_ns()
-                sp.set(
-                    queue_wait_ms=round(
-                        (now - min(i.t_enq for i in bundle.items)) / 1e6, 3
-                    )
-                )
+                sp.set(queue_wait_ms=round(wait_ms, 3))
                 if "remap" in bundle.prep:
                     remap = bundle.prep["remap"]
                     sp.set(

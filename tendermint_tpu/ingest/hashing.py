@@ -234,6 +234,31 @@ class TxKeyHasher:
                 "hash_fallback_shape": self.fallback_shape,
             }
 
+    def engine_stats(self) -> Dict[str, object]:
+        """The unified engine-telemetry protocol (models/telemetry.py):
+        the one engine that owns BOTH sides of its device/host split
+        (keys_or_host routes internally)."""
+        from tendermint_tpu.models.telemetry import breaker_view, bucket_view
+
+        with self._lock:
+            buckets = bucket_view(dict(self._buckets))
+            counters = {
+                "device_bundles": self.device_bundles,
+                "host_bundles": self.host_bundles,
+                "fallback_cold": self.fallback_cold,
+                "fallback_shape": self.fallback_shape,
+            }
+            device_rows, host_rows = self.device_rows, self.host_rows
+        return {
+            "engine": "txhash",
+            "device_rows": float(device_rows),
+            "host_rows": float(host_rows),
+            "buckets": buckets,
+            "breakers": breaker_view(self.compile_breaker),
+            "queue_wait_ms": None,
+            "counters": counters,
+        }
+
     def keys_or_host(self, items: Sequence[bytes], threshold: int) -> List[bytes]:
         """The routing entry the batcher calls: device when the bundle
         clears ``threshold`` rows and the bucket is warm, else host —

@@ -17,6 +17,7 @@ from __future__ import annotations
 
 import asyncio
 import hashlib
+import time
 from collections import OrderedDict
 from typing import Callable, Dict, List, Optional
 
@@ -94,7 +95,10 @@ class _MempoolTx:
     drive the QoS lane (priority-ordered reap, lane-aware eviction,
     per-sender flood cap)."""
 
-    __slots__ = ("tx", "height", "gas_wanted", "seq", "senders", "key", "priority", "sender")
+    __slots__ = (
+        "tx", "height", "gas_wanted", "seq", "senders", "key", "priority",
+        "sender", "t_admit",
+    )
 
     def __init__(
         self,
@@ -114,6 +118,10 @@ class _MempoolTx:
         self.key = key
         self.priority = priority
         self.sender = sender  # flood-cap identity (app sender, else peer)
+        # admission timestamp (perf_counter): at commit, update() turns
+        # these into the committed block's mempool-residency numbers for
+        # the height ledger (consensus/ledger.py "detail" section)
+        self.t_admit = time.perf_counter()
 
 
 class Mempool:
@@ -149,6 +157,8 @@ class Mempool:
         self.evicted_total = 0
         self.sender_capped_total = 0
         self.recheck_cache_drops = 0
+        # committed-tx residency of the LAST update() (height ledger)
+        self.last_update_residency: Optional[Dict[str, float]] = None
         self._pre_check = pre_check
         self._post_check = post_check
         # crypto-free upper bound on the priority the app could assign
@@ -551,6 +561,8 @@ class Mempool:
             if isinstance(txs, Txs)
             else [tx_key(bytes(t)) for t in txs]
         )
+        now = time.perf_counter()
+        residency: List[float] = []
         for tx, key, res in zip(txs, keys, deliver_tx_responses):
             tx = bytes(tx)
             if res.is_ok():
@@ -561,7 +573,20 @@ class Mempool:
                 self._cache.remove(tx, key)
             entry = self._txs.get(key)
             if entry is not None:
+                residency.append(now - entry.t_admit)
                 self._drop_entry(entry, evict_cache=False)
+        # mempool residency of the committed txs (admission → commit),
+        # read by the height ledger at finalize (consensus/ledger.py);
+        # txs this node never admitted (gossip-late) don't contribute
+        self.last_update_residency = (
+            {
+                "n": len(residency),
+                "mean_ms": round(sum(residency) / len(residency) * 1e3, 3),
+                "max_ms": round(max(residency) * 1e3, 3),
+            }
+            if residency
+            else None
+        )
 
         if self._txs:
             if self.config.recheck:

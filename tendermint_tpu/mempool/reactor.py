@@ -10,31 +10,46 @@ gating (don't send txs validated at a height the peer hasn't reached).
 from __future__ import annotations
 
 import asyncio
-from typing import Dict
+from typing import Dict, Optional
 
 from tendermint_tpu.codec.binary import Reader, Writer
 from tendermint_tpu.mempool.mempool import ErrMempoolIsFull, ErrTxInCache, Mempool
 from tendermint_tpu.p2p.conn.connection import ChannelDescriptor
 from tendermint_tpu.p2p.peer import Peer
 from tendermint_tpu.p2p.switch import Reactor
+from tendermint_tpu.utils import trace
 from tendermint_tpu.utils.log import get_logger
+from tendermint_tpu.utils.trace import OriginContext
 
 MEMPOOL_CHANNEL = 0x30
 
 PEER_HEIGHT_KEY = "MempoolReactor.peerHeight"
 
 
-def encode_txs(txs) -> bytes:
+def encode_txs(txs, origin: Optional[OriginContext] = None) -> bytes:
+    """Tx gossip envelope; ``origin`` is the cross-node trace trailer
+    (same append-and-tolerate wire as the consensus envelopes,
+    consensus/messages.py) — omitted entirely while tracing is off, so
+    the untraced wire is byte-identical to the pre-trailer format."""
     w = Writer()
     w.write_uvarint(len(txs))
     for tx in txs:
         w.write_bytes(bytes(tx))
+    if origin is not None:
+        origin.encode(w)
     return w.bytes()
 
 
 def decode_txs(data: bytes):
     r = Reader(data)
     return [r.read_bytes() for _ in range(r.read_uvarint())]
+
+
+def decode_txs_origin(data: bytes):
+    """(txs, origin) — origin None when absent/malformed (tolerant)."""
+    r = Reader(data)
+    txs = [r.read_bytes() for _ in range(r.read_uvarint())]
+    return txs, (OriginContext.decode(r) if r.remaining() else None)
 
 
 class MempoolReactor(Reactor):
@@ -80,7 +95,12 @@ class MempoolReactor(Reactor):
         back-to-back single-tx messages from a busy peer) coalesce into
         shared admission bundles instead of 1-tx bundles that each pay
         the flush linger serially."""
-        txs = decode_txs(msg_bytes)
+        txs, origin = decode_txs_origin(msg_bytes)
+        t = trace.get_tracer()
+        if origin is not None and t.enabled:
+            # receiving half of the cross-node link: the sender's
+            # mempool.gossip_tx span flows into this delivery
+            t.link(origin, "mempool.gossip_rx", txs=len(txs))
         if self.ingest is not None:
             futs = []
             for tx in txs:
@@ -117,7 +137,16 @@ class MempoolReactor(Reactor):
                 seq = entry.seq
                 if peer.id in entry.senders:
                     continue  # don't echo a tx to its source (reference :230)
-                ok = await peer.send(MEMPOOL_CHANNEL, encode_txs([entry.tx]))
+                t = trace.get_tracer()
+                if t.enabled:
+                    # a tiny span so perfetto has a slice to anchor the
+                    # flow-start arrow to; origin rides the envelope
+                    with t.span("mempool.gossip_tx", txs=1):
+                        origin = t.origin()
+                    payload = encode_txs([entry.tx], origin=origin)
+                else:
+                    payload = encode_txs([entry.tx])
+                ok = await peer.send(MEMPOOL_CHANNEL, payload)
                 if not ok:
                     await asyncio.sleep(0.01)
         except asyncio.CancelledError:

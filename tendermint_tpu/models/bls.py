@@ -359,3 +359,26 @@ class BLSEngine:
                 for k, e in self._buckets.items()
                 if e.ready
             }
+
+    def engine_stats(self) -> Dict[str, object]:
+        """The unified engine-telemetry protocol (models/telemetry.py).
+        Host (oracle) row counts live in the provider
+        (crypto/bls.BLSBatchVerifier) — the engine reports what IT
+        executed."""
+        from tendermint_tpu.models.telemetry import breaker_view, bucket_entry
+
+        with self._lock:
+            buckets = {
+                f"{kind}/{n}": bucket_entry(e)
+                for (kind, n), e in self._buckets.items()
+            }
+            counters = dict(self.stats)
+        return {
+            "engine": "bls",
+            "device_rows": float(counters.get("device_rows", 0)),
+            "host_rows": 0.0,
+            "buckets": buckets,
+            "breakers": breaker_view(self.compile_breaker),
+            "queue_wait_ms": None,
+            "counters": counters,
+        }

@@ -377,3 +377,22 @@ class MerkleHasher:
     def compile_stats(self) -> Dict[int, Optional[float]]:
         with self._lock:
             return {k: e.compile_s for k, e in self._buckets.items() if e.ready}
+
+    def engine_stats(self) -> Dict[str, object]:
+        """The unified engine-telemetry protocol (models/telemetry.py).
+        Host-path counts live at the routing seam (crypto/merkle.py
+        merges them in via its module-level engine_stats wrapper)."""
+        from tendermint_tpu.models.telemetry import breaker_view, bucket_view
+
+        with self._lock:
+            buckets = bucket_view(dict(self._buckets))
+            counters = dict(self.stats)
+        return {
+            "engine": "merkle",
+            "device_rows": float(counters.get("device_leaves", 0)),
+            "host_rows": 0.0,
+            "buckets": buckets,
+            "breakers": breaker_view(self.compile_breaker),
+            "queue_wait_ms": None,
+            "counters": counters,
+        }

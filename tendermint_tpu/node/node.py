@@ -111,6 +111,9 @@ class Node(Service):
         _trace.configure(
             enabled=config.base.trace_enabled,
             buffer_events=config.base.trace_buffer_events,
+            # cross-node identity: stamps exported traces and every
+            # gossip OriginContext this node emits (docs/tracing.md)
+            node_id=node_key.id[:12],
         )
 
         # -- robustness layer (utils/faultinject.py + utils/watchdog.py) -----
@@ -363,6 +366,7 @@ class Node(Service):
         from tendermint_tpu.utils.metrics import (
             BLSMetrics,
             CryptoMetrics,
+            EngineMetrics,
             HealthMetrics,
             IngestMetrics,
             LightServeMetrics,
@@ -383,6 +387,9 @@ class Node(Service):
         self.lightserve_metrics = LightServeMetrics(self.metrics_registry, ns)
         self.ingest_metrics = IngestMetrics(self.metrics_registry, ns)
         self.bls_metrics = BLSMetrics(self.metrics_registry, ns)
+        # unified engine telemetry (models/telemetry.py protocol): the
+        # cross-engine tendermint_engine_* family + the engines RPC
+        self.engine_metrics = EngineMetrics(self.metrics_registry, ns)
         if self.ingest is not None:
             # direct handle for the bundle-size histogram (distributions
             # can't be rebuilt from snapshot deltas, the LightServe
@@ -426,6 +433,25 @@ class Node(Service):
 
     def _block_exec_metrics_attach(self) -> None:
         self.block_exec._metrics = self.state_metrics
+
+    def engine_telemetry(self) -> dict:
+        """{engine: engine_stats()} over every live device engine — the
+        unified telemetry protocol (models/telemetry.py). Feeds the
+        tendermint_engine_* family, the ``engines`` RPC route, and the
+        height ledger's per-height engine deltas. Engines that never
+        engaged (no merkle hasher built, no BLS row seen, ingest off)
+        simply don't appear."""
+        from tendermint_tpu.crypto import merkle as _merkle
+        from tendermint_tpu.models.telemetry import collect_engine_stats
+
+        engines = [
+            self.crypto_provider,
+            _merkle,  # module-level wrapper: hasher + host counts + seam breaker
+            getattr(self.bls_provider, "_engine", None),
+        ]
+        if self.ingest is not None:
+            engines.append(self.ingest.hasher)
+        return collect_engine_stats(engines)
 
     def _make_node_info(self) -> NodeInfo:
         from tendermint_tpu.blockchain.reactor import BLOCKCHAIN_CHANNEL
@@ -538,6 +564,17 @@ class Node(Service):
             event_bus=self.event_bus,
             wal=BaseWAL(self.config.consensus.wal_file()),
             metrics=self.consensus_metrics,
+            # cross-node trace identity: peers link their spans back to
+            # this id in a merged trace (docs/tracing.md)
+            node_id=self.node_key.id[:12],
+        )
+        # height ledger ← engine telemetry: each committed height's
+        # report carries the engine-counter deltas over that height
+        # ("verify-bundle queue+execute" attribution, consensus/ledger.py)
+        from tendermint_tpu.models.telemetry import flatten_engine_counters
+
+        self.consensus_state.ledger.engines_fn = (
+            lambda: flatten_engine_counters(self.engine_telemetry())
         )
         self.consensus_metrics.fast_syncing.set(1 if fast_sync else 0)
         if not self.config.consensus.create_empty_blocks:
@@ -751,6 +788,9 @@ class Node(Service):
             if self.lightserve is not None:
                 self.lightserve_metrics.update(self.lightserve.stats())
             self.bls_metrics.update(self.bls_provider.stats())
+            # unified engine family: one labeled view over every engine
+            # implementing the telemetry protocol (docs/metrics.md)
+            self.engine_metrics.update(self.engine_telemetry())
             # lane counters move regardless of the ingest front-end —
             # the QoS lane lives in the mempool (docs/metrics.md)
             self.ingest_metrics.update(

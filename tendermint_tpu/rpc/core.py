@@ -123,6 +123,8 @@ class RPCCore:
             "unsafe_write_heap_profile": self.unsafe_write_heap_profile,
             "dump_trace": self.dump_trace,
             "trace_timeline": self.trace_timeline,
+            "height_report": self.height_report,
+            "engines": self.engines,
             "lightserve_verify": self.lightserve_verify,
             "lightserve_status": self.lightserve_status,
         }
@@ -616,6 +618,33 @@ class RPCCore:
         )
         out["tracer"] = t.stats()
         return out
+
+    async def height_report(self, height=None) -> Dict[str, Any]:
+        """Per-height latency ledger (consensus/ledger.py): each
+        committed height's wall time decomposed into named phases —
+        step transitions, gossip/vote waits, WAL fsync, ABCI deliver,
+        apply — plus an explicit ``unaccounted`` residual that keeps
+        attribution honest (phases + unaccounted == wall, pinned by
+        test). ``height`` restricts to one height. Read-only like the
+        trace routes, so not unsafe-gated."""
+        cs = self.node.consensus_state
+        if cs is None:
+            raise RPCError("consensus not started")
+        h = _int_arg(height, "height", None)
+        return await asyncio.get_running_loop().run_in_executor(
+            None, lambda: cs.ledger.report(height=h)
+        )
+
+    async def engines(self) -> Dict[str, Any]:
+        """Unified device-engine telemetry (models/telemetry.py): one
+        engine_stats() stanza per live engine — per-bucket compile
+        state, breaker state, device-vs-host rows, queue-wait
+        distribution. The scrapeable summary is the
+        tendermint_engine_* family (docs/metrics.md)."""
+        fn = getattr(self.node, "engine_telemetry", None)
+        if fn is None:
+            raise RPCError("engine telemetry unavailable")
+        return {"engines": await asyncio.get_running_loop().run_in_executor(None, fn)}
 
     # -- lightserve routes (the batched light-client verify service,
     # lightserve/service.py; also servable on its own laddr via

@@ -26,6 +26,7 @@ from tendermint_tpu.types.tx import Txs
 from tendermint_tpu.types.validator import Validator
 from tendermint_tpu.utils import fail
 from tendermint_tpu.utils import faultinject as faults
+from tendermint_tpu.utils import trace
 from tendermint_tpu.utils.log import get_logger
 
 
@@ -52,6 +53,10 @@ class BlockExecutor:
         self._event_bus = event_bus
         self._verifier = verifier
         self._metrics = metrics
+        # per-height latency ledger (consensus/ledger.py), attached by
+        # ConsensusState so the ABCI deliver round trip shows up as its
+        # own phase; None for fast-sync-only executors
+        self.ledger = None
         self.logger = logger or get_logger("state")
 
     def store(self) -> StateStore:
@@ -88,9 +93,23 @@ class BlockExecutor:
         await faults.maybe_async("exec.apply")
         self.validate_block(state, block)
 
-        abci_responses = await exec_block_on_proxy_app(
-            self.logger, self._app, block, self._store, state.initial_height()
-        )
+        # height-ledger sub-phase (consensus/ledger.py, wired by
+        # ConsensusState): the full BeginBlock→DeliverTx×N→EndBlock
+        # round trip, nested under apply_block — the "is block
+        # execution the wall?" number ROADMAP item 3 turns on
+        ledger = getattr(self, "ledger", None)
+        if ledger is not None:
+            ledger.push("abci_deliver", time.perf_counter())
+        try:
+            with trace.span(
+                "exec.deliver", height=block.header.height, txs=len(block.data.txs)
+            ):
+                abci_responses = await exec_block_on_proxy_app(
+                    self.logger, self._app, block, self._store, state.initial_height()
+                )
+        finally:
+            if ledger is not None:
+                ledger.pop("abci_deliver", time.perf_counter())
 
         fail.fail()  # point: after exec, before saving responses
         self._store.save_abci_responses(block.header.height, abci_responses)

@@ -165,6 +165,28 @@ class Histogram(Metric):
                     return
             self.counts[-1] += 1
 
+    def add_raw(self, bucket_counts, sum_v: float, count_v: int) -> None:
+        """Merge per-bucket INCREMENTS from an external histogram
+        snapshot (the engine-telemetry queue-wait hists keep their own
+        counts; a distribution can't be rebuilt from observe() calls).
+        ``bucket_counts`` must match this histogram's bucket layout
+        (len(buckets)+1, the last being the +Inf overflow). Exposition
+        invariants (cumulative monotone, +Inf == _count) hold because
+        sum/count/buckets advance together."""
+        if len(bucket_counts) != len(self.counts):
+            raise ValueError(
+                f"histogram {self.name}: snapshot has {len(bucket_counts)} "
+                f"buckets, instrument has {len(self.counts)}"
+            )
+        if count_v < 0 or any(c < 0 for c in bucket_counts):
+            raise ValueError(f"histogram {self.name}: negative raw increment")
+        with self._lock:
+            self._touched = True
+            for i, c in enumerate(bucket_counts):
+                self.counts[i] += int(c)
+            self.sum += float(sum_v)
+            self.count += int(count_v)
+
     def _make_child(self) -> "Histogram":
         return Histogram("child", self.help, buckets=self.buckets)
 
@@ -258,6 +280,20 @@ class ConsensusMetrics:
                 "Wall seconds spent in each consensus step transition (label: step).",
                 namespace, sub,
                 buckets=[i / 1000 for i in (1, 5, 10, 25, 50, 100, 250, 500, 1000, 5000)],
+            )
+        )
+        # per-height phase decomposition (consensus/ledger.py): each
+        # committed height's wall time tiled into named phases + an
+        # explicit unaccounted residual — the always-on form of the
+        # height_report RPC (docs/tracing.md, height ledger)
+        self.height_phase_seconds = reg(
+            Histogram(
+                "height_phase_seconds",
+                "Wall seconds each committed height spent per named phase "
+                "(label: phase; includes an explicit 'unaccounted' residual "
+                "so attribution gaps are visible).",
+                namespace, sub,
+                buckets=[i / 1000 for i in (1, 5, 10, 25, 50, 100, 250, 500, 1000, 2500, 5000)],
             )
         )
 
@@ -638,6 +674,102 @@ class BLSMetrics:
         self.device_enabled.set(stats.get("device_enabled", 0))
         for attr, key in self._COUNTERS:
             self._deltas.feed(getattr(self, attr), key, stats)
+
+
+class EngineMetrics:
+    """Unified device-engine telemetry (``tendermint_engine_*``): ONE
+    labeled family over every engine implementing the
+    ``engine_stats()`` protocol (models/telemetry.py — the pipelined
+    verifier, merkle hasher, BLS engine, tx-key hasher), replacing
+    per-engine scrape vocabularies for the cross-engine questions:
+    where are rows executing (device vs host), which jit buckets are
+    warm/compiling/failed, is a breaker open, and how long does work
+    wait before the device sees it. Engine-specific detail keeps riding
+    the per-engine families (crypto/merkle/bls/ingest) and the
+    ``engines`` RPC route. Monotonic totals are TRUE counters fed by
+    snapshot deltas like CryptoMetrics; the queue-wait histogram merges
+    raw bucket deltas from each engine's own hist
+    (Histogram.add_raw)."""
+
+    def __init__(self, registry: Optional[Registry] = None, namespace="tendermint"):
+        r = registry or Registry()
+        sub = "engine"
+        reg = r.register
+        self.device_rows = reg(Counter("device_rows_total", "Rows executed on the device path (label: engine).", namespace, sub))
+        self.host_rows = reg(Counter("host_rows_total", "Rows/requests served by the host fallback path (label: engine).", namespace, sub))
+        self.buckets_ready = reg(Gauge("buckets_ready", "Jit buckets with a warm executable (label: engine).", namespace, sub))
+        self.buckets_compiling = reg(Gauge("buckets_compiling", "Jit buckets compiling in the background (label: engine).", namespace, sub))
+        self.buckets_failed = reg(Gauge("buckets_failed", "Jit buckets parked on the host path behind a breaker (label: engine).", namespace, sub))
+        self.breaker_state_max = reg(Gauge("breaker_state_max", "Worst breaker state across the engine's breakers: 0 closed, 1 half-open, 2 open (label: engine).", namespace, sub))
+        self.compile_seconds = reg(Counter("compile_seconds_total", "Cumulative jit compile seconds recorded on warm buckets (label: engine).", namespace, sub))
+        from tendermint_tpu.models.telemetry import QUEUE_WAIT_BUCKETS_MS
+
+        self.queue_wait_seconds = reg(
+            Histogram(
+                "queue_wait_seconds",
+                "Submit-to-execute wait of device work (label: engine; engines without a queue export nothing).",
+                namespace, sub,
+                buckets=[b / 1000.0 for b in QUEUE_WAIT_BUCKETS_MS],
+            )
+        )
+        self._deltas = _SnapshotCounters()
+        # per-engine last queue-wait snapshot, for raw bucket deltas
+        self._qw_last: Dict[str, dict] = {}
+
+    def update(self, stats_by_engine: Dict[str, dict]) -> None:
+        """Fold a models/telemetry.collect_engine_stats() collection
+        into the instruments."""
+        from tendermint_tpu.models.telemetry import bucket_counts
+
+        d = self._deltas
+        for name, st in (stats_by_engine or {}).items():
+            if not isinstance(st, dict) or "error" in st:
+                continue
+            d.feed(
+                self.device_rows.with_labels(engine=name),
+                f"dev/{name}", {f"dev/{name}": st.get("device_rows", 0)},
+            )
+            d.feed(
+                self.host_rows.with_labels(engine=name),
+                f"host/{name}", {f"host/{name}": st.get("host_rows", 0)},
+            )
+            tally = bucket_counts(st)
+            self.buckets_ready.with_labels(engine=name).set(tally["ready"])
+            self.buckets_compiling.with_labels(engine=name).set(tally["compiling"])
+            self.buckets_failed.with_labels(engine=name).set(tally["failed"])
+            # compile seconds feed PER BUCKET, not as a sum: bucket
+            # tables are LRU-evicted (models/verifier.py valset cap),
+            # and a shrinking sum would trip _SnapshotCounters' reset
+            # heuristic — re-adding the surviving buckets' compile time
+            # on every eviction.
+            for bkey, b in (st.get("buckets") or {}).items():
+                cs = b.get("compile_s") or 0.0
+                if cs:
+                    k = f"compile/{name}/{bkey}"
+                    d.feed(
+                        self.compile_seconds.with_labels(engine=name),
+                        k, {k: cs},
+                    )
+            worst = max(
+                (b.get("state_code", 0) for b in (st.get("breakers") or {}).values()),
+                default=0,
+            )
+            self.breaker_state_max.with_labels(engine=name).set(worst)
+            qw = st.get("queue_wait_ms")
+            if isinstance(qw, dict) and qw.get("counts"):
+                last = self._qw_last.get(name)
+                counts, s, c = qw["counts"], qw.get("sum_ms", 0.0), qw.get("count", 0)
+                if last is not None and c >= last.get("count", 0):
+                    dc = [a - b for a, b in zip(counts, last["counts"])]
+                    ds, dn = s - last.get("sum_ms", 0.0), c - last.get("count", 0)
+                else:
+                    # fresh/reset source: take the full new value
+                    dc, ds, dn = list(counts), s, c
+                if dn > 0 and all(x >= 0 for x in dc):
+                    self.queue_wait_seconds.with_labels(engine=name).add_raw(
+                        dc, ds / 1000.0, dn
+                    )
+                self._qw_last[name] = {"counts": list(counts), "sum_ms": s, "count": c}
 
 
 class StateMetrics:

@@ -38,12 +38,60 @@ from __future__ import annotations
 import os
 import threading
 import time
+import zlib
 from collections import deque
+from dataclasses import dataclass
 from typing import Any, Dict, List, Optional
 
 DEFAULT_BUFFER_EVENTS = 65536
 
 _PID = os.getpid()
+
+
+@dataclass
+class OriginContext:
+    """Cross-node trace origin: who emitted a gossip message, from which
+    span, at what wall-clock time. Carried as a TOLERANT trailer on the
+    consensus/mempool gossip envelopes (the ``ResponseCheckTx.priority``
+    append-and-tolerate precedent: old decoders ignore trailing bytes,
+    new decoders default to "absent" on anything short or malformed), so
+    a traced node interoperates with untraced and older peers byte-for-
+    byte. ``span_id`` keys the Chrome flow event pair ("s" at the sender
+    inside its propose/vote span, "f" at the receiver inside the span
+    the message caused) that makes a proposer's propose span visibly
+    flow into its peers' vote spans in a merged perfetto view
+    (docs/tracing.md, cross-node propagation)."""
+
+    node_id: str = ""
+    span_id: int = 0
+    height: int = 0
+    round: int = 0
+    ts_ns: int = 0  # sender wall clock (time_ns) at emission
+
+    def encode(self, w) -> None:
+        """Append onto a codec.binary.Writer (duck-typed so this module
+        stays dependency-free)."""
+        w.write_str(self.node_id)
+        w.write_uvarint(self.span_id)
+        w.write_u64(max(self.height, 0))
+        w.write_i64(self.round)
+        w.write_u64(max(self.ts_ns, 0))
+
+    @classmethod
+    def decode(cls, r) -> Optional["OriginContext"]:
+        """Tolerant read from a codec.binary.Reader: None (never a
+        raise) on truncated/malformed bytes — a byzantine trailer must
+        cost the sender its trace link, not the receiver its peer."""
+        try:
+            return cls(
+                node_id=r.read_str(max_len=256),
+                span_id=r.read_uvarint(),
+                height=r.read_u64(),
+                round=r.read_i64(),
+                ts_ns=r.read_u64(),
+            )
+        except Exception:
+            return None
 
 
 class _NoopSpan:
@@ -119,7 +167,10 @@ class Tracer:
     """Bounded, lock-protected ring buffer of trace events."""
 
     def __init__(
-        self, buffer_events: int = DEFAULT_BUFFER_EVENTS, enabled: bool = True
+        self,
+        buffer_events: int = DEFAULT_BUFFER_EVENTS,
+        enabled: bool = True,
+        node_id: str = "",
     ):
         self.enabled = bool(enabled)
         self._cap = max(int(buffer_events), 1)
@@ -127,11 +178,26 @@ class Tracer:
         self._lock = threading.Lock()
         self._origin_ns = time.perf_counter_ns()
         # wall-clock anchor so exported timestamps can be correlated
-        # with log lines (perf_counter has an arbitrary epoch)
+        # with log lines (perf_counter has an arbitrary epoch) and so
+        # merge_chrome_traces can rebase multiple nodes onto one axis
         self._origin_unix_ns = time.time_ns()
         self.recorded = 0
         self.dropped = 0
         self._thread_names: Dict[int, str] = {}
+        # span-id source for flow events (see set_node_id)
+        self._span_seq = 0
+        self.set_node_id(node_id)
+
+    def set_node_id(self, node_id: str) -> None:
+        """Cross-node trace identity: stamps exported traces
+        (process_name row in perfetto) and every OriginContext this
+        tracer emits; "" = anonymous single-node tracing. Also derives
+        the flow-id salt — the high bits of every span id carry a node
+        fingerprint so ids from different nodes never collide in a
+        merged trace; the low bits are a per-tracer counter. The ONE
+        place the salt formula lives (configure() reuses it)."""
+        self.node_id = str(node_id)
+        self._span_salt = (zlib.crc32(self.node_id.encode()) & 0xFFFFFFFF) << 20
 
     # -- recording ---------------------------------------------------------
 
@@ -149,6 +215,68 @@ class Tracer:
         self._record(
             "i", name, time.perf_counter_ns(), 0, threading.get_ident(), args
         )
+
+    # -- cross-node flow linking -------------------------------------------
+
+    def next_span_id(self) -> int:
+        """Process/node-unique id for a flow-event pair."""
+        with self._lock:
+            self._span_seq += 1
+            return self._span_salt | (self._span_seq & 0xFFFFF)
+
+    def flow_start(self, name: str, flow_id: int, **args) -> None:
+        """Chrome flow START ("s"): perfetto draws an arrow from the
+        enclosing slice to wherever the matching flow_end lands. Record
+        INSIDE the span the work originates from (the proposer's
+        propose span, a voter's prevote span)."""
+        if not self.enabled:
+            return
+        args["flow"] = int(flow_id)
+        self._record(
+            "s", name, time.perf_counter_ns(), 0, threading.get_ident(), args
+        )
+
+    def flow_end(self, name: str, flow_id: int, **args) -> None:
+        """Chrome flow END ("f", bp="e"): the receiving side of a link.
+        Record inside the span the message CAUSED (a peer's vote span)."""
+        if not self.enabled:
+            return
+        args["flow"] = int(flow_id)
+        self._record(
+            "f", name, time.perf_counter_ns(), 0, threading.get_ident(), args
+        )
+
+    def origin(self, height: int = 0, round_: int = 0) -> Optional[OriginContext]:
+        """An OriginContext for an outgoing gossip message, with the
+        flow-start half of its link already recorded. None while
+        disabled — senders then attach nothing and the wire stays
+        byte-identical to the untraced encoding."""
+        if not self.enabled:
+            return None
+        sid = self.next_span_id()
+        self.flow_start("gossip.origin", sid, height=height, round=round_)
+        return OriginContext(
+            node_id=self.node_id,
+            span_id=sid,
+            height=height,
+            round=round_,
+            ts_ns=time.time_ns(),
+        )
+
+    def link(self, ctx: Optional[OriginContext], name: str, **args) -> None:
+        """Record the receiving half of a cross-node link: a flow-end
+        carrying the origin's node id and the gossip propagation delay
+        (receiver wall clock minus sender stamp; meaningful to clock
+        skew, exact in the in-process harness)."""
+        if ctx is None or not self.enabled:
+            return
+        if ctx.node_id:
+            args.setdefault("origin_node", ctx.node_id)
+        if ctx.ts_ns:
+            args.setdefault(
+                "gossip_ms", round((time.time_ns() - ctx.ts_ns) / 1e6, 3)
+            )
+        self.flow_end(name, ctx.span_id, **args)
 
     def _record(
         self, ph: str, name: str, t0_ns: int, dur_ns: int, tid: int, args: dict
@@ -206,6 +334,13 @@ class Tracer:
         if limit is not None and limit >= 0:
             # explicit slice for 0: ring[-0:] is the FULL list
             ring = ring[-limit:] if limit > 0 else []
+        if self.node_id:
+            events.append(
+                {
+                    "ph": "M", "name": "process_name", "pid": _PID, "tid": 0,
+                    "args": {"name": self.node_id},
+                }
+            )
         for tid, tname in sorted(names.items()):
             events.append(
                 {
@@ -226,7 +361,21 @@ class Tracer:
             if ph == "i":
                 ev["s"] = "t"  # thread-scoped instant
             if args:
+                if ph in ("s", "f"):
+                    # flow events: the pair-matching id is a top-level
+                    # field, not an arg (Chrome trace format); "f" binds
+                    # to the enclosing slice via bp="e"
+                    args = dict(args)
+                    ev["id"] = args.pop("flow", 0)
+                    ev["cat"] = "gossip"
+                    if ph == "f":
+                        ev["bp"] = "e"
                 ev["args"] = args
+            elif ph in ("s", "f"):
+                ev["id"] = 0
+                ev["cat"] = "gossip"
+                if ph == "f":
+                    ev["bp"] = "e"
             events.append(ev)
         return {
             "traceEvents": events,
@@ -234,6 +383,7 @@ class Tracer:
             "otherData": {
                 "origin_unix_ns": self._origin_unix_ns,
                 "dropped_events": self.dropped,
+                "node_id": self.node_id,
             },
         }
 
@@ -299,6 +449,51 @@ class Tracer:
         }
 
 
+def merge_chrome_traces(docs: List[Dict[str, Any]]) -> Dict[str, Any]:
+    """Merge per-node Chrome trace documents into ONE perfetto-loadable
+    document: each input becomes its own process row (pid = input
+    index + 1, process_name from the tracer's node_id) and every
+    timestamp is rebased onto the earliest node's clock via the
+    ``origin_unix_ns`` wall-clock anchor — so a proposer's propose span
+    and the vote spans it caused on other nodes line up on one time
+    axis, with the flow-event pairs (shared ``id``) drawn as arrows
+    between them. Flow ids are node-salted at allocation
+    (``next_span_id``), so no rewriting is needed here."""
+    anchors = [
+        int(d.get("otherData", {}).get("origin_unix_ns", 0) or 0) for d in docs
+    ]
+    base = min((a for a in anchors if a), default=0)
+    events: List[Dict[str, Any]] = []
+    dropped = 0
+    for i, doc in enumerate(docs):
+        pid = i + 1
+        other = doc.get("otherData", {})
+        dropped += int(other.get("dropped_events", 0) or 0)
+        shift_us = ((anchors[i] - base) / 1000.0) if anchors[i] and base else 0.0
+        node = other.get("node_id") or f"node{i}"
+        seen_process_name = False
+        for ev in doc.get("traceEvents", []):
+            ev = dict(ev)
+            ev["pid"] = pid
+            if "ts" in ev:
+                ev["ts"] = ev["ts"] + shift_us
+            if ev.get("ph") == "M" and ev.get("name") == "process_name":
+                seen_process_name = True
+            events.append(ev)
+        if not seen_process_name:
+            events.append(
+                {
+                    "ph": "M", "name": "process_name", "pid": pid, "tid": 0,
+                    "args": {"name": node},
+                }
+            )
+    return {
+        "traceEvents": events,
+        "displayTimeUnit": "ms",
+        "otherData": {"origin_unix_ns": base, "dropped_events": dropped},
+    }
+
+
 # -- global tracer ----------------------------------------------------------
 #
 # One process-wide tracer (like the crypto provider and merkle engine
@@ -332,14 +527,20 @@ def set_tracer(t: Tracer) -> Tracer:
 
 
 def configure(
-    enabled: Optional[bool] = None, buffer_events: Optional[int] = None
+    enabled: Optional[bool] = None,
+    buffer_events: Optional[int] = None,
+    node_id: Optional[str] = None,
 ) -> Tracer:
     """Apply config to the global tracer (node wiring). ``TM_TRACE``
-    overrides ``enabled``."""
+    overrides ``enabled``. ``node_id`` is the cross-node trace identity
+    stamped on exported documents and every OriginContext this process
+    emits."""
     if buffer_events is not None:
         _tracer.set_capacity(buffer_events)
     if enabled is not None:
         _tracer.enabled = _env_enabled(bool(enabled))
+    if node_id is not None:
+        _tracer.set_node_id(node_id)
     return _tracer
 
 

@@ -86,6 +86,8 @@ async def make_node(
     config=None,
     app=None,
     wal=None,
+    node_id: str = "",
+    tracer=None,
 ) -> Node:
     config = config or test_config().consensus
     app = app or KVStoreApplication()
@@ -107,6 +109,8 @@ async def make_node(
         mempool=mempool,
         priv_validator=pv,
         wal=wal or NilWAL(),
+        node_id=node_id,
+        tracer=tracer,
     )
     return Node(cs, app, mempool, block_store, state_store)
 
@@ -126,20 +130,44 @@ def wire_loopback(nodes: List[Node]) -> None:
 
 
 async def start_network(
-    n_vals: int, config=None, app_factory=None, powers=None
+    n_vals: int, config=None, app_factory=None, powers=None, traced: bool = False
 ) -> List[Node]:
+    """``traced=True`` gives every node its OWN enabled Tracer (node id
+    ``node<i>``) so ``merged_trace`` can export one perfetto document
+    with per-node process rows and cross-node flow arrows
+    (docs/tracing.md, cross-node propagation)."""
     genesis, privs = make_genesis(n_vals, powers=powers)
     nodes = []
-    for pv in privs:
+    for i, pv in enumerate(privs):
+        tracer = None
+        if traced:
+            from tendermint_tpu.utils.trace import Tracer
+
+            tracer = Tracer(enabled=True, node_id=f"node{i}")
         nodes.append(
             await make_node(
-                genesis, pv, config=config, app=app_factory() if app_factory else None
+                genesis, pv,
+                config=config,
+                app=app_factory() if app_factory else None,
+                node_id=f"node{i}",
+                tracer=tracer,
             )
         )
     wire_loopback(nodes)
     for node in nodes:
         await node.cs.start()
     return nodes
+
+
+def merged_trace(nodes: List[Node]) -> dict:
+    """One Chrome trace document over a traced net: each node a process
+    row, flow arrows linking a proposer's propose span to the peers'
+    vote spans (utils/trace.merge_chrome_traces)."""
+    from tendermint_tpu.utils.trace import merge_chrome_traces
+
+    return merge_chrome_traces(
+        [n.cs.tracer.export_chrome() for n in nodes if n.cs.tracer is not None]
+    )
 
 
 async def stop_network(nodes: List[Node]) -> None:

@@ -224,6 +224,62 @@ def test_bls_bench_aggregation_beats_per_sig_3x(bench, monkeypatch):
     assert out["bls_commit_bytes_agg_8"] < out["bls_commit_bytes_persig_8"]
 
 
+def test_guard_cpu_fallback_skips_loudly(bench):
+    """The r04/r05 lesson: a CPU-fallback run must not be judged
+    against a TPU baseline — and the refusal must be LOUD (GUARD_SKIPS
+    lands in the emitted line), never a silent pass."""
+    _write_record(bench, tabled_p50_ms=100.0)
+    assert bench._regression_guard({}, "cpu") == []
+    assert bench.GUARD_SKIPS, "cpu-vs-tpu skip must be recorded loudly"
+    assert any("CPU" in s and "not comparable" in s for s in bench.GUARD_SKIPS)
+    # no baseline at all: nothing to skip, nothing to say
+    import os
+
+    os.unlink(bench._LAST_TPU_PATH)
+    assert bench._regression_guard({}, "cpu") == []
+    assert bench.GUARD_SKIPS == []
+
+
+def test_guard_section_provenance_mismatch_skips_loudly(bench):
+    """Per-section provenance: a key whose section ran on a different
+    platform than the recorded baseline is skipped with a loud note
+    instead of being flagged as a regression — while keys with MATCHING
+    provenance are still guarded in the same run."""
+    _write_record(
+        bench,
+        ingest_txs_per_sec=1200, ingest_platform="tpu",
+        merkle_root_speedup=8.0, merkle_platform="tpu",
+    )
+    # ingest section fell back to cpu this run (would read as a huge
+    # regression); merkle matched platforms and genuinely regressed
+    line = {
+        "ingest_txs_per_sec": 50, "ingest_platform": "cpu",
+        "merkle_root_speedup": 2.0, "merkle_platform": "tpu",
+    }
+    fails = bench._regression_guard(line, "tpu")
+    assert len(fails) == 1 and "merkle_root_speedup" in fails[0], fails
+    assert any(
+        "ingest_txs_per_sec" in s and "not comparable" in s
+        for s in bench.GUARD_SKIPS
+    ), bench.GUARD_SKIPS
+    # records without provenance stamps (pre-PR12 baselines) compare
+    # as before — the guard only skips on a POSITIVE mismatch
+    _write_record(bench, ingest_txs_per_sec=1200)
+    fails = bench._regression_guard(
+        {"ingest_txs_per_sec": 50, "ingest_platform": "cpu"}, "tpu"
+    )
+    assert len(fails) == 1 and "ingest_txs_per_sec" in fails[0]
+
+
+def test_sections_carry_platform_stamp(bench):
+    """Every section result is stamped with the JAX platform that ran
+    it, and the run-wide provenance keys resolve."""
+    out = bench._stamped("merkle", {"merkle_root_speedup": 2.0})
+    assert out["merkle_platform"] in ("cpu", "tpu", "gpu", "unknown")
+    prov = bench._jax_provenance()
+    assert "jax_platform" in prov
+
+
 def test_guard_env_kill_switch(bench, monkeypatch):
     _write_record(bench, tabled_p50_ms=100.0)
     monkeypatch.setenv("TM_BENCH_NO_GUARD", "1")

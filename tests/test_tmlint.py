@@ -205,6 +205,44 @@ def test_golden_metrics_coherence():
     assert any("negative" in x.message for x in v)  # counter decrement
 
 
+def test_golden_trace_coherence():
+    code = (
+        "from tendermint_tpu.utils import trace\n"
+        "def f(h):\n"
+        "    with trace.span('bogus.stage', height=h):\n"
+        "        trace.instant('another.bogus_marker')\n"
+    )
+    v = lint_snippet(code)
+    assert_only(v, "trace-coherence", 2)
+    assert any("bogus.stage" in x.message for x in v)
+
+
+def test_trace_coherence_documented_and_dynamic_names_pass():
+    # a documented name passes; a dynamically-built name ("consensus."
+    # + step) is out of static reach and is skipped; a tracer-OBJECT
+    # receiver with a span-shaped literal is still checked; an
+    # unrelated .span() call (re.Match.span) never fires
+    code = (
+        "from tendermint_tpu.utils import trace\n"
+        "import re\n"
+        "def f(t, step, m: 're.Match'):\n"
+        "    with trace.span('merkle.root', leaves=2):\n"
+        "        pass\n"
+        "    with trace.span('consensus.' + step):\n"
+        "        pass\n"
+        "    t.instant('pipeline.fallback_serial')\n"
+        "    return m.span(0)\n"
+    )
+    assert lint_snippet(code) == []
+    # same tracer-object receiver, undocumented name: fires
+    bad = (
+        "def f(t):\n"
+        "    t.instant('pipeline.some_new_marker')\n"
+    )
+    v = lint_snippet(bad)
+    assert_only(v, "trace-coherence", 1)
+
+
 def test_golden_jit_purity():
     code = (
         "import time\n"
@@ -392,6 +430,7 @@ EXPECTED_RULES = {
     "unused-import",
     "unreachable-code",
     "slow-marker",
+    "trace-coherence",
 }
 
 

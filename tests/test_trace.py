@@ -177,6 +177,239 @@ def test_set_capacity_trims():
     assert t.stats()["events_dropped"] == 40
 
 
+# -- cross-node propagation primitives --------------------------------------
+
+
+def test_flow_events_export_with_ids():
+    t = Tracer(node_id="nodeA")
+    with t.span("consensus.propose", height=4):
+        fid = t.next_span_id()
+        t.flow_start("gossip.origin", fid, height=4)
+    t.flow_end("consensus.proposal_link", fid, origin_node="nodeA")
+    evs = t.export_chrome()["traceEvents"]
+    s = [e for e in evs if e["ph"] == "s"]
+    f = [e for e in evs if e["ph"] == "f"]
+    assert len(s) == 1 and len(f) == 1
+    assert s[0]["id"] == fid == f[0]["id"]
+    assert f[0]["bp"] == "e"  # binds to the enclosing slice
+    assert s[0]["cat"] == "gossip"
+    # the flow id is NOT duplicated into args
+    assert "flow" not in s[0].get("args", {})
+    # process_name metadata carries the node id
+    assert any(
+        e["ph"] == "M" and e["name"] == "process_name"
+        and e["args"]["name"] == "nodeA"
+        for e in evs
+    )
+
+
+def test_span_ids_unique_across_node_tracers():
+    a, b = Tracer(node_id="node0"), Tracer(node_id="node1")
+    ids_a = {a.next_span_id() for _ in range(50)}
+    ids_b = {b.next_span_id() for _ in range(50)}
+    assert len(ids_a) == 50 and len(ids_b) == 50
+    assert not (ids_a & ids_b)  # node-salted: never collide in a merge
+
+
+def test_origin_and_link_lifecycle():
+    t = Tracer(node_id="prop")
+    # disabled tracer emits NO origin: the wire stays untraced
+    t.enabled = False
+    assert t.origin(height=3) is None
+    t.enabled = True
+    ctx = t.origin(height=3, round_=1)
+    assert ctx is not None and ctx.node_id == "prop" and ctx.height == 3
+    assert ctx.ts_ns > 0 and ctx.span_id > 0
+    rx = Tracer(node_id="peer")
+    rx.link(ctx, "consensus.proposal_link", height=3)
+    (f,) = [e for e in rx.export_chrome()["traceEvents"] if e["ph"] == "f"]
+    assert f["id"] == ctx.span_id
+    assert f["args"]["origin_node"] == "prop"
+    assert f["args"]["gossip_ms"] >= 0
+    # linking None (untraced sender) records nothing
+    rx.link(None, "consensus.proposal_link")
+    assert len([e for e in rx.export_chrome()["traceEvents"] if e["ph"] == "f"]) == 1
+
+
+def test_origin_context_wire_tolerance():
+    """The append-and-tolerate contract on the consensus envelopes: old
+    payloads (no trailer) and truncated/garbage trailers decode to
+    origin=None, never an error; a full trailer round-trips."""
+    from tendermint_tpu.consensus import messages as m
+    from tendermint_tpu.types.block import BlockID
+    from tendermint_tpu.types.vote import Vote
+    from tendermint_tpu.utils.trace import OriginContext
+
+    v = Vote(
+        vote_type=1, height=5, round=0, block_id=BlockID(), timestamp_ns=1,
+        validator_address=b"a" * 20, validator_index=0, signature=b"s" * 64,
+    )
+    ctx = OriginContext("nodeA", 12345, 5, 0, 999_000)
+    enc = m.encode_msg(m.VoteMessage(v, origin=ctx))
+    assert m.decode_msg(enc).origin == ctx
+    # absent trailer (the untraced wire) == the pre-trailer encoding
+    legacy = m.encode_msg(m.VoteMessage(v))
+    assert m.decode_msg(legacy).origin is None
+    # truncated trailer: tolerated, not a decode error
+    for cut in (1, 3, 7):
+        assert m.decode_msg(enc[:-cut]).origin is None
+    # mempool envelope: same contract
+    from tendermint_tpu.mempool.reactor import decode_txs, decode_txs_origin, encode_txs
+
+    data = encode_txs([b"tx1", b"tx2"], origin=ctx)
+    txs, got = decode_txs_origin(data)
+    assert txs == [b"tx1", b"tx2"] and got == ctx
+    assert decode_txs(data) == [b"tx1", b"tx2"]  # old decoder: ignores trailer
+    txs2, got2 = decode_txs_origin(encode_txs([b"tx1"]))
+    assert txs2 == [b"tx1"] and got2 is None
+
+
+def test_merge_chrome_traces_rebases_and_labels():
+    a = Tracer(node_id="node0")
+    b = Tracer(node_id="node1")
+    # force distinct wall anchors so the rebase is visible
+    b._origin_unix_ns = a._origin_unix_ns + 5_000_000  # node1 started 5ms later
+    b._origin_ns = a._origin_ns
+    with a.span("consensus.propose", height=1):
+        pass
+    with b.span("consensus.prevote", height=1):
+        pass
+    doc = trace.merge_chrome_traces([a.export_chrome(), b.export_chrome()])
+    evs = doc["traceEvents"]
+    assert {e["pid"] for e in evs} == {1, 2}
+    names = {
+        e["args"]["name"]
+        for e in evs
+        if e["ph"] == "M" and e["name"] == "process_name"
+    }
+    assert names == {"node0", "node1"}
+    ts_a = next(e["ts"] for e in evs if e.get("name") == "consensus.propose")
+    ts_b = next(e["ts"] for e in evs if e.get("name") == "consensus.prevote")
+    # node1's events rebased +5ms onto node0's axis
+    assert ts_b - ts_a >= 5000.0 - 1.0
+
+
+# -- traceview (scripts/traceview.py) ---------------------------------------
+
+
+def _traceview():
+    import importlib.util
+
+    path = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "scripts", "traceview.py",
+    )
+    spec = importlib.util.spec_from_file_location("traceview_cli", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_traceview_summarizes_stages_and_heights(tmp_path, capsys):
+    tv = _traceview()
+    t = Tracer(node_id="node0")
+    for h in (3, 4):
+        with t.span("consensus.propose", height=h):
+            time.sleep(0.002)
+        with t.span("consensus.finalize_commit", height=h):
+            time.sleep(0.001)
+    t.instant("consensus.timeout", height=3)
+    doc = t.export_chrome()
+    summary = tv.summarize(doc)
+    assert summary["events"]["spans"] == 4
+    st = summary["stages"]["consensus.propose"]
+    assert st["count"] == 2 and st["p50_ms"] >= 1.0 and st["p95_ms"] >= st["p50_ms"]
+    assert set(summary["heights"]) == {3, 4}
+    assert summary["heights"][3]["wall_ms"] > 0
+    # CLI: file + --json round trip; rpc-envelope unwrap; empty = exit 3
+    p = tmp_path / "trace.json"
+    p.write_text(json.dumps({"result": doc}))
+    assert tv.main(["traceview", str(p), "--json"]) == 0
+    out = json.loads(capsys.readouterr().out)
+    assert out["stages"]["consensus.propose"]["count"] == 2
+    assert tv.main(["traceview", str(p)]) == 0  # text table renders
+    capsys.readouterr()
+    empty = tmp_path / "empty.json"
+    empty.write_text(json.dumps({"traceEvents": []}))
+    assert tv.main(["traceview", str(empty)]) == 3
+    bogus = tmp_path / "bogus.json"
+    bogus.write_text("{}")
+    assert tv.main(["traceview", str(bogus)]) == 2
+
+
+# -- live multi-node harness: the cross-node acceptance path ----------------
+
+
+@pytest.mark.slow
+def test_harness_merged_trace_links_propose_to_votes():
+    """The ISSUE's acceptance shape: a traced cs_harness net exports
+    ONE merged perfetto document in which a proposer's propose span
+    flows (shared flow-event id) into OTHER nodes' prevote spans — and
+    every node's height ledger keeps unaccounted <= 10% of wall."""
+    import asyncio
+    import sys
+
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    import cs_harness as h
+
+    async def go():
+        nodes = await h.start_network(3, traced=True)
+        try:
+            await h.wait_for_height(nodes, 3, timeout_s=90)
+        finally:
+            await h.stop_network(nodes)
+        doc = h.merged_trace(nodes)
+        doc = json.loads(json.dumps(doc))  # JSON-serializable
+        evs = doc["traceEvents"]
+        assert {e["pid"] for e in evs} == {1, 2, 3}
+
+        # index flow starts by id -> (pid, ts)
+        starts = {e["id"]: e for e in evs if e["ph"] == "s"}
+        links = [
+            e for e in evs
+            if e["ph"] == "f" and e["name"] == "consensus.proposal_link"
+        ]
+        assert links, "no proposal links recorded"
+        # at least one link closes a flow OPENED ON A DIFFERENT NODE...
+        cross = [
+            e for e in links if e["id"] in starts and starts[e["id"]]["pid"] != e["pid"]
+        ]
+        assert cross, links
+        ln = cross[0]
+        st = starts[ln["id"]]
+        # ...whose start sits INSIDE the proposer's propose span and
+        # whose end sits INSIDE the peer's prevote span (the visible
+        # propose -> vote arrow)
+        def enclosing(ev, name):
+            return [
+                x for x in evs
+                if x["ph"] == "X" and x["name"] == name and x["pid"] == ev["pid"]
+                and x["ts"] <= ev["ts"] <= x["ts"] + x["dur"]
+            ]
+
+        assert enclosing(st, "consensus.propose"), "flow start outside propose span"
+        assert enclosing(ln, "consensus.prevote"), "flow end outside prevote span"
+        assert ln["args"]["origin_node"] != ""
+        assert ln["args"]["gossip_ms"] >= 0
+        # vote links flow too (voter's span -> receiver)
+        assert any(
+            e["ph"] == "f" and e["name"] == "consensus.vote_link" for e in evs
+        )
+
+        # the live-net height-ledger acceptance bar: named phases cover
+        # >= 90% of every committed height's wall time on every node
+        for n in nodes:
+            rep = n.cs.ledger.report()
+            assert rep["count"] >= 1
+            for rec in rep["heights"]:
+                assert rec["wall_ms"] == pytest.approx(
+                    sum(rec["phases"].values()) + rec["unaccounted_ms"], abs=1e-3
+                )
+                assert rec["unaccounted_pct"] <= 10.0, rec
+
+    asyncio.run(go())
+
+
 # -- live node: the acceptance-criteria path --------------------------------
 
 
@@ -210,9 +443,10 @@ def test_dump_trace_on_running_node(tmp_path):
             c = HTTPClient(f"{addr.host}:{addr.port}")
             doc = await c.call("dump_trace")
             # round-trips as JSON and is a Chrome trace-event document
+            # (incl. the cross-node flow pairs, "s"/"f")
             doc = json.loads(json.dumps(doc))
             evs = doc["traceEvents"]
-            assert all(e["ph"] in ("X", "i", "M") for e in evs)
+            assert all(e["ph"] in ("X", "i", "M", "s", "f") for e in evs)
             names = {e["name"] for e in evs if e["ph"] == "X"}
             # consensus steps for a committed height
             committed = {
