@@ -291,6 +291,7 @@ _GUARD_KEYS = [
     ("ingest_speedup", "higher"),
     ("bls_commit_bytes_ratio", "higher"),
     ("bls_verify_speedup", "higher"),
+    ("sim_heights_per_sec", "higher"),
     ("coldstart_first_verify_s", None),   # presence-only: timing varies
     ("coldstart_tabled_first_s", None),
 ]
@@ -306,6 +307,7 @@ _KEY_SECTION_PLATFORM = {
     "ingest_speedup": "ingest_platform",
     "bls_commit_bytes_ratio": "bls_platform",
     "bls_verify_speedup": "bls_platform",
+    "sim_heights_per_sec": "sim_platform",
 }
 
 # provenance-mismatch skip notes from the LAST _regression_guard call —
@@ -449,6 +451,7 @@ def run_bench(platform: str, accelerator: bool = True):
             **_stamped("ingest", ingest_bench(cpu)),
             **_stamped("merkle", merkle_bench()),
             **_stamped("bls", bls_bench()),
+            **_stamped("sim", sim_bench()),
             **_stamped("degraded", degraded_mode_bench()),
             **_stamped("trace", trace_overhead_bench()),
             **({"guard_skips": GUARD_SKIPS} if GUARD_SKIPS else {}),
@@ -681,6 +684,9 @@ def run_bench(platform: str, accelerator: bool = True):
     # -- BLS aggregation: bytes/commit + verify latency vs per-sig --------
     bls_extra = _stamped("bls", bls_bench())
 
+    # -- simulator: nodes x heights sweep on the deterministic net --------
+    sim_extra = _stamped("sim", sim_bench())
+
     # -- degraded mode: circuit-broken fallback + idle watchdog cost ------
     degraded_extra = _stamped("degraded", degraded_mode_bench())
 
@@ -764,6 +770,7 @@ def run_bench(platform: str, accelerator: bool = True):
         **ingest_extra,
         **merkle_extra,
         **bls_extra,
+        **sim_extra,
         **degraded_extra,
         **trace_extra,
         **aot_extra,
@@ -1794,6 +1801,65 @@ def _ingest_e2e(inner) -> dict:
     except Exception as ex:
         log(f"ingest e2e measurement failed: {ex!r}")
         return {"ingest_e2e_error": repr(ex)[:200]}
+
+
+# -- simulator: nodes x heights sweep on the deterministic net -------------
+#
+# The PR13 rig (docs/simulator.md): hundreds of real ConsensusState
+# instances under simulated time, all verify traffic through ONE shared
+# pipeline. The bench reports simulated-consensus throughput
+# (sim-heights per WALL second — simulated time is free, host work is
+# what's being measured) and the shared engine's bundled signature rate.
+# `sim_heights_per_sec` rides the regression guard like replay_speedup.
+
+SIM_SWEEP = [(16, 10), (64, 8), (128, 6)]  # (nodes, heights)
+SIM_VALIDATORS = int(os.environ.get("TM_BENCH_SIM_VALS", "8"))
+SIM_SCHEDULE = "link(*,*):delay:ms=10,jitter_ms=4"
+
+
+def sim_bench() -> dict:
+    """Returns the sim_* bench keys; never raises (the main line must
+    survive a broken simulator — the guard then flags the missing keys
+    against the previous record)."""
+    try:
+        from tendermint_tpu.sim.core import Simulation
+
+        out = {}
+        best = 0.0
+        sigs_rate = 0.0
+        for n, h in SIM_SWEEP:
+            sim = Simulation(
+                n_nodes=n,
+                validators=min(SIM_VALIDATORS, n),
+                heights=h,
+                schedule=SIM_SCHEDULE,
+                seed=1234,
+                record_events=False,
+            )
+            res = sim.run()
+            tag = f"sim_{n}x{h}"
+            if not res.completed:
+                out[f"{tag}_error"] = f"run wedged at {min(res.heights.values())}"
+                continue
+            hps = h / res.wall_seconds
+            best = max(best, hps)
+            eng = res.engine
+            sigs_rate = max(sigs_rate, eng["device_rows"] / res.wall_seconds)
+            out[f"{tag}_heights_per_sec"] = round(hps, 3)
+            out[f"{tag}_wall_s"] = round(res.wall_seconds, 3)
+            out[f"{tag}_deliveries"] = int(res.net["deliveries"])
+            out[f"{tag}_multi_source_bundles"] = int(
+                eng["counters"]["multi_source_bundles"]
+            )
+        if best > 0:
+            out["sim_heights_per_sec"] = round(best, 3)
+            out["sim_device_sigs_per_sec"] = round(sigs_rate, 1)
+        else:
+            out["sim_error"] = "no sweep configuration completed"
+        return out
+    except Exception as ex:
+        log(f"sim bench failed: {ex!r}")
+        return {"sim_error": repr(ex)[:200]}
 
 
 _STATE_PATH = os.environ.get("TM_BENCH_STATE", "")

@@ -362,6 +362,7 @@ def _load_builtin_rules() -> None:
         rules_latch,
         rules_metrics,
         rules_purity,
+        rules_scenario,
         rules_tests,
         rules_trace,
         rules_truthiness,

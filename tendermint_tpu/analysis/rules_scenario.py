@@ -1,0 +1,86 @@
+"""scenario-coherence: every tagged liveness/safety claim in docs/
+names a scenario file that exists in ``tendermint_tpu/sim/scenarios/``.
+
+PR 13's simulator exists so that robustness claims stop being prose:
+"never two commits at one height", "the minority recovers within N
+seconds of heal" are now replayable runs with pinned expected outcomes
+(sim/scenario.py). This rule is the trace-coherence discipline applied
+to those claims — a documented claim carries the claim marker
+
+    [claim:safety scenario=partition_commit.scn]
+    [claim:liveness scenario=flash_crowd.scn]
+
+and the named scenario must exist, so a claim can never outlive (or
+precede) its rig: deleting or renaming a scenario file fails tier-1
+until the doc is updated, and a new claim cannot land tagged without a
+scenario backing it. Markers are validated structurally too — a typo'd
+kind or a missing ``scenario=`` is a violation, not an ignored tag
+(the faultinject "silently inert config" lesson).
+"""
+
+from __future__ import annotations
+
+import os
+import re
+from typing import Iterable, List
+
+from tendermint_tpu.analysis.core import Project, Rule, Violation, register
+
+_SCENARIO_DIR = os.path.join("tendermint_tpu", "sim", "scenarios")
+_MARKER_RE = re.compile(r"\[claim:[^\]]*\]")
+_VALID_RE = re.compile(
+    r"^\[claim:(safety|liveness)\s+scenario=([A-Za-z0-9_\-]+\.scn)\]$"
+)
+_GRAMMAR = "[claim:<safety|liveness> scenario=<file>.scn]"
+
+
+class ScenarioCoherence(Rule):
+    name = "scenario-coherence"
+    summary = (
+        "every docs/ liveness/safety claim marker names a scenario file "
+        "that exists in tendermint_tpu/sim/scenarios/"
+    )
+
+    def check_project(self, project: Project) -> Iterable[Violation]:
+        out: List[Violation] = []
+        docs_dir = os.path.join(project.root, "docs")
+        if not os.path.isdir(docs_dir):
+            return out
+        scen_dir = os.path.join(project.root, _SCENARIO_DIR)
+        existing = (
+            {f for f in os.listdir(scen_dir) if f.endswith(".scn")}
+            if os.path.isdir(scen_dir)
+            else set()
+        )
+        for name in sorted(os.listdir(docs_dir)):
+            if not name.endswith(".md"):
+                continue
+            rel = f"docs/{name}"
+            with open(os.path.join(docs_dir, name), encoding="utf-8") as fp:
+                text = fp.read()
+            for lineno, line in enumerate(text.splitlines(), 1):
+                for tok in _MARKER_RE.findall(line):
+                    m = _VALID_RE.match(tok)
+                    if m is None:
+                        out.append(
+                            Violation(
+                                self.name, rel, lineno,
+                                f"malformed claim marker {tok!r} "
+                                f"(grammar: {_GRAMMAR})",
+                            )
+                        )
+                        continue
+                    scn = m.group(2)
+                    if scn not in existing:
+                        out.append(
+                            Violation(
+                                self.name, rel, lineno,
+                                f"claim names scenario {scn!r} which does not "
+                                f"exist in {_SCENARIO_DIR}/ (a claim must not "
+                                "outlive its rig)",
+                            )
+                        )
+        return out
+
+
+register(ScenarioCoherence())

@@ -115,3 +115,42 @@ def extract_timestamp_ns(sign_bytes: bytes) -> int:
     if len(sign_bytes) != SIGN_BYTES_LEN:
         raise ValueError(f"sign bytes must be {SIGN_BYTES_LEN} bytes")
     return struct.unpack_from(">q", sign_bytes, TIMESTAMP_OFFSET)[0]
+
+
+class TemplateCache:
+    """Bounded memo of zero-timestamp canonical sign-bytes templates.
+
+    One template per (msg_type, height, round, BlockID triple, chain
+    id); vote ingest and the simulator's pre-verifier both key their
+    SigCache probes off these 160-byte templates, and rebuilding the
+    struct pack per vote dominated the cache-hit path in large nets.
+    ``bound`` caps a byzantine flood of distinct BlockIDs: past it the
+    memo resets (correctness is unaffected — a miss just re-packs)."""
+
+    __slots__ = ("bound", "_d")
+
+    def __init__(self, bound: int = 256):
+        self.bound = int(bound)
+        self._d: dict = {}
+
+    def get(
+        self,
+        msg_type: int,
+        height: int,
+        round_: int,
+        block_hash: bytes,
+        parts_total: int,
+        parts_hash: bytes,
+        chain_id: str,
+    ) -> bytes:
+        key = (msg_type, height, round_, block_hash, parts_total, parts_hash, chain_id)
+        tpl = self._d.get(key)
+        if tpl is None:
+            if len(self._d) >= self.bound:
+                self._d.clear()
+            tpl = canonical_sign_bytes(
+                msg_type, height, round_, block_hash, parts_total, parts_hash,
+                0, chain_id,
+            )
+            self._d[key] = tpl
+        return tpl

@@ -69,6 +69,7 @@ from tendermint_tpu.types.vote_set import (
     VoteSet,
 )
 from tendermint_tpu.utils import fail, trace
+from tendermint_tpu.utils.clock import wall_clock
 from tendermint_tpu.utils.events import EventSwitch
 from tendermint_tpu.utils.log import get_logger
 from tendermint_tpu.utils.service import Service
@@ -172,18 +173,20 @@ class _StepSpan:
 class TimeoutTicker:
     """One pending timeout at a time; a new schedule replaces the old
     (reference consensus/ticker.go: timeoutRoutine overwrites the timer).
-    Fired timeouts land on the owner's input queue."""
+    Fired timeouts land on the owner's input queue. Timers resolve
+    against the owner's clock (utils/clock.py): the wall clock on a
+    live node, simulated time under ``tendermint_tpu/sim``."""
 
-    def __init__(self, queue: asyncio.Queue):
+    def __init__(self, queue: asyncio.Queue, clock=None):
         self._queue = queue
-        self._timer: Optional[asyncio.TimerHandle] = None
+        self._clock = clock if clock is not None else wall_clock()
+        self._timer = None  # clock timer handle
         self._pending: Optional[TimeoutInfo] = None
 
     def schedule(self, ti: TimeoutInfo) -> None:
         self.cancel()
         self._pending = ti
-        loop = asyncio.get_running_loop()
-        self._timer = loop.call_later(max(ti.duration_ms, 0) / 1000.0, self._fire)
+        self._timer = self._clock.call_later(max(ti.duration_ms, 0) / 1000.0, self._fire)
 
     def _fire(self) -> None:
         ti, self._pending, self._timer = self._pending, None, None
@@ -215,9 +218,23 @@ class ConsensusState(Service):
         logger=None,
         node_id: str = "",
         tracer=None,
+        clock=None,
+        sig_cache=None,
     ):
         super().__init__("consensus", logger=None)
         self.logger = logger or get_logger("consensus")
+        # the time seam (utils/clock.py): everything consensus WAITS on
+        # — round timeouts, vote/proposal timestamps, wait_for_height —
+        # reads this clock, so the simulator can run the protocol under
+        # deterministic simulated time. None = the process wall clock.
+        self.clock = clock if clock is not None else wall_clock()
+        # per-node signature dedupe cache (crypto/pipeline.SigCache):
+        # threaded into every HeightVoteSet and the proposal check.
+        # None = the process-wide default — correct for a live node
+        # (one node per process); the simulator gives each in-process
+        # node its OWN cache so node identity stays physical and the
+        # shared engine's cross-node warming is observable.
+        self.sig_cache = sig_cache
         # cross-node trace identity (docs/tracing.md): stamps the
         # OriginContext trailer on outgoing proposals/parts/votes so
         # peers can link their spans back to ours. "" disables nothing
@@ -249,9 +266,18 @@ class ConsensusState(Service):
 
         self.ledger = HeightLedger(metrics=metrics)
         # thread the ledger into block execution so the ABCI deliver
-        # round-trip shows up as its own sub-phase under apply_block
+        # round-trip shows up as its own sub-phase under apply_block,
+        # and the node's signature cache so validate_block's LastCommit
+        # check rides the votes already verified at ingest (the same
+        # commit is validated up to 3x per height)
         if block_exec is not None:
             block_exec.ledger = self.ledger
+            if self.sig_cache is not None:
+                block_exec.sig_cache = self.sig_cache
+            else:
+                from tendermint_tpu.crypto.pipeline import default_sig_cache
+
+                block_exec.sig_cache = default_sig_cache()
         self.config = config
         self._block_exec = block_exec
         self._block_store = block_store
@@ -270,7 +296,9 @@ class ConsensusState(Service):
 
         # single merged input queue (MsgInfo | TimeoutInfo)
         self._queue: asyncio.Queue = asyncio.Queue(maxsize=1000)
-        self.timeout_ticker = TimeoutTicker(self._queue)
+        self.timeout_ticker = TimeoutTicker(self._queue, clock=self.clock)
+        # wait_for_height waiters: (height, future), resolved at commit
+        self._height_waiters: list = []
 
         self.wal: WAL = wal or NilWAL()
         self.replay_mode = False  # catching up via WAL replay
@@ -300,6 +328,14 @@ class ConsensusState(Service):
         """This node's tracer: the per-node instance when set (harness
         multi-node nets), else the process-global one."""
         return self.tracer if self.tracer is not None else trace.get_tracer()
+
+    def _now_ns(self) -> int:
+        """Protocol time through the clock seam (vote/proposal/commit
+        timestamps, round scheduling). Wall clock on a live node,
+        simulated time in the simulator — NOT used for measurement
+        (ledger/trace durations stay on perf_counter: they measure
+        host work, which is real even under simulated time)."""
+        return self.clock.time_ns()
 
     def _wait_context(self) -> str:
         """What consensus was WAITING FOR during the idle gap that just
@@ -399,7 +435,7 @@ class ConsensusState(Service):
             return
         if self.rs.step == STEP_NEW_HEIGHT:
             # +1ms ensures we land after start_time
-            remaining_ms = max((self.rs.start_time_ns - now_ns()) // 1_000_000 + 1, 0)
+            remaining_ms = max((self.rs.start_time_ns - self._now_ns()) // 1_000_000 + 1, 0)
             self._schedule_timeout(remaining_ms, self.rs.height, 0, STEP_NEW_ROUND)
         elif self.rs.step == STEP_NEW_ROUND:
             # Enqueue a zero-duration timeout so the enter_propose
@@ -417,14 +453,43 @@ class ConsensusState(Service):
                 self.spawn(self._queue.put(ti))
 
     async def wait_for_height(self, height: int, timeout_s: float = 30.0) -> None:
-        """Test/tooling helper: block until a height is committed."""
-        deadline = time.monotonic() + timeout_s
-        while self.state.last_block_height < height:
-            if time.monotonic() > deadline:
-                raise TimeoutError(
-                    f"height {height} not reached (at {self.state.last_block_height})"
+        """Test/tooling helper: block until a height is committed.
+
+        Event-driven (the commit path resolves waiters) rather than the
+        old 10 ms wall-clock poll loop, and the timeout runs on the
+        node's clock seam — so it works under simulated time and a
+        slow-test waiter no longer burns real CPU polling."""
+        if self.state.last_block_height >= height:
+            return
+        fut = asyncio.get_running_loop().create_future()
+        entry = (height, fut)
+        self._height_waiters.append(entry)
+
+        def _timeout() -> None:
+            if not fut.done():
+                fut.set_exception(
+                    TimeoutError(
+                        f"height {height} not reached "
+                        f"(at {self.state.last_block_height})"
+                    )
                 )
-            await asyncio.sleep(0.01)
+
+        timer = self.clock.call_later(timeout_s, _timeout)
+        try:
+            await fut
+        finally:
+            timer.cancel()
+            if entry in self._height_waiters:
+                self._height_waiters.remove(entry)
+
+    def _resolve_height_waiters(self, height: int) -> None:
+        if not self._height_waiters:
+            return
+        ripe = [e for e in self._height_waiters if e[0] <= height]
+        for e in ripe:
+            self._height_waiters.remove(e)
+            if not e[1].done():
+                e[1].set_result(height)
 
     # ------------------------------------------------------------------
     # state reset between heights
@@ -466,7 +531,7 @@ class ConsensusState(Service):
         rs.round = 0
         rs.step = STEP_NEW_HEIGHT
         if rs.commit_time_ns == 0:
-            rs.start_time_ns = now_ns() + int(self.config.commit_s() * 1e9)
+            rs.start_time_ns = self._now_ns() + int(self.config.commit_s() * 1e9)
         else:
             rs.start_time_ns = rs.commit_time_ns + int(self.config.commit_s() * 1e9)
         rs.validators = validators
@@ -482,7 +547,9 @@ class ConsensusState(Service):
         rs.valid_round = -1
         rs.valid_block = None
         rs.valid_block_parts = None
-        rs.votes = HeightVoteSet(state.chain_id, height, validators)
+        rs.votes = HeightVoteSet(
+            state.chain_id, height, validators, dedupe_cache=self.sig_cache
+        )
         rs.commit_round = -1
         rs.last_commit = last_precommits
         rs.last_validators = state.last_validators
@@ -490,6 +557,11 @@ class ConsensusState(Service):
         rs.commit_time_ns = 0
 
         self.state = state
+        # any height reached counts for waiters — including heights
+        # reached via fast sync / switch_to_consensus, which land here
+        # without a local _finalize_commit (the old poll loop watched
+        # state.last_block_height directly; so must the event path)
+        self._resolve_height_waiters(state.last_block_height)
         if self.metrics is not None:
             self.metrics.height.set(height)
             self.metrics.validators.set(validators.size())
@@ -558,7 +630,7 @@ class ConsensusState(Service):
     # ------------------------------------------------------------------
 
     def _schedule_round0(self) -> None:
-        sleep_ms = max((self.rs.start_time_ns - now_ns()) // 1_000_000, 0)
+        sleep_ms = max((self.rs.start_time_ns - self._now_ns()) // 1_000_000, 0)
         self._schedule_timeout(sleep_ms, self.rs.height, 0, STEP_NEW_HEIGHT)
 
     def _schedule_timeout(self, duration_ms: int, height: int, round_: int, step: int) -> None:
@@ -956,7 +1028,7 @@ class ConsensusState(Service):
         block_id = BlockID(hash=block.hash(), parts=block_parts.header())
         proposal = Proposal(
             height=height, round=round_, pol_round=rs.valid_round,
-            block_id=block_id, timestamp_ns=now_ns(),
+            block_id=block_id, timestamp_ns=self._now_ns(),
         )
         try:
             import inspect
@@ -1166,7 +1238,7 @@ class ConsensusState(Service):
 
         rs.step = STEP_COMMIT
         rs.commit_round = commit_round
-        rs.commit_time_ns = now_ns()
+        rs.commit_time_ns = self._now_ns()
         self._new_step()
 
         if rs.locked_block is not None and rs.locked_block.hash() == block_id.hash:
@@ -1237,8 +1309,10 @@ class ConsensusState(Service):
             ledger.push("apply_block", time.perf_counter())
             try:
                 with self._tr().span("consensus.apply_block", height=height):
+                    # pre_validated: crash point 1 above validated this
+                    # exact (state, block) pair
                     new_state, retain_height = await self._block_exec.apply_block(
-                        state_copy, block_id, block
+                        state_copy, block_id, block, pre_validated=True
                     )
             finally:
                 ledger.pop("apply_block", time.perf_counter())
@@ -1271,7 +1345,7 @@ class ConsensusState(Service):
             mempool_residency=getattr(self._mempool, "last_update_residency", None),
         )
         self.evsw.fire_event(EVENT_COMMITTED, block)
-        self.update_to_state(new_state)
+        self.update_to_state(new_state)  # resolves height waiters too
         self._done_first_block.set()
         self._schedule_round0()
 
@@ -1291,8 +1365,16 @@ class ConsensusState(Service):
                 f"POLRound {proposal.pol_round} round {proposal.round}"
             )
         proposer = rs.validators.get_proposer()
-        if not proposer.pub_key.verify(
-            proposal.sign_bytes(self.state.chain_id), proposal.signature
+        # SigCache-fronted verify: a redelivered proposal (or the same
+        # proposal fanned out to hundreds of simulated nodes sharing one
+        # cache) costs a hash, not a scalar mult (crypto/pipeline.py)
+        from tendermint_tpu.crypto.pipeline import cached_verify
+
+        if not cached_verify(
+            proposer.pub_key,
+            proposal.sign_bytes(self.state.chain_id),
+            proposal.signature,
+            cache=self.sig_cache,
         ):
             raise ErrInvalidProposalSignature(repr(proposal))
         rs.proposal = proposal
@@ -1520,7 +1602,7 @@ class ConsensusState(Service):
     def _vote_time(self) -> int:
         """Monotonic vote time: > last block time (reference voteTime
         :1941 — minVoteTime = lastBlockTime + 1ms)."""
-        now = now_ns()
+        now = self._now_ns()
         min_vote_time = self.state.last_block_time_ns + 1_000_000
         return max(now, min_vote_time)
 

@@ -181,6 +181,33 @@ def set_default_sig_cache(c: Optional[SigCache]) -> None:
         _default_cache = c
 
 
+def cached_verify(pub_key, msg: bytes, sig: bytes, cache: Optional[SigCache] = None) -> bool:
+    """Host-verify one signature with the shared SigCache in front.
+
+    The single-signature analog of the pipeline's dedupe path, for call
+    sites that verify inline on the event loop (the consensus proposal
+    check): gossip redelivery — or, in the simulator, the same proposal
+    fanned out to hundreds of in-process nodes — costs one hash instead
+    of a full scalar-mult verify. Same safety argument as the pipeline:
+    only successful verifies are inserted, and the signature bytes are
+    part of the key, so a hit is equivalent to re-verifying."""
+    c = cache if cache is not None else default_sig_cache()
+    k = None
+    if c.capacity > 0:
+        try:
+            raw = pub_key.bytes()
+        except Exception:
+            raw = None
+        if raw is not None:
+            k = SigCache.key(raw, msg, sig)
+            if c.seen(k):
+                return True
+    ok = bool(pub_key.verify(msg, sig))
+    if ok and k is not None:
+        c.add(k)
+    return ok
+
+
 class _Item:
     """One submitted request awaiting dispatch."""
 
@@ -271,6 +298,11 @@ class PipelinedVerifier(BatchVerifier):
         self.bundle_dup_rows = 0  # in-bundle duplicate rows collapsed
         self.max_queue_depth = 0
         self._occupancy_sum = 0  # requests per bundle, summed
+        # cross-node coalescing telemetry (``sources`` row labels):
+        # bundles whose device rows carried labels from >1 node, and the
+        # running max of distinct labels in one bundle (both monotonic)
+        self.multi_source_bundles = 0
+        self.max_bundle_sources = 0
         self.worker_restarts = 0
         self.fallback_serial = 0  # sync callers that timed out + verified serially
 
@@ -365,11 +397,16 @@ class PipelinedVerifier(BatchVerifier):
     # -- submit API --------------------------------------------------------
 
     def submit_batch(
-        self, pubkeys, msgs, sigs, msg_lens=None, dedupe: bool = False
+        self, pubkeys, msgs, sigs, msg_lens=None, dedupe: bool = False, sources=None
     ) -> "Future[np.ndarray]":
         """Verify (N,32)/(N,L)/(N,64) rows; resolves to (N,) bool.
         ``dedupe=True`` routes rows through the SigCache (gossip
-        redelivery shape: commits/votes that may arrive repeatedly)."""
+        redelivery shape: commits/votes that may arrive repeatedly).
+        ``sources`` optionally labels each row with the logical node it
+        belongs to (the simulator's shared-engine workload): bundles
+        whose device rows span >1 source count into
+        ``multi_source_bundles`` / ``max_bundle_sources`` — the
+        telemetry that proves cross-node traffic actually coalesces."""
         fut: Future = Future()
         n = int(len(pubkeys))
         if n == 0:
@@ -379,7 +416,12 @@ class PipelinedVerifier(BatchVerifier):
         mg = np.asarray(msgs, dtype=np.uint8)
         sg = np.asarray(sigs, dtype=np.uint8)
         lens = None if msg_lens is None else np.asarray(msg_lens, dtype=np.int32)
-        self._enqueue(_Item("batch", fut, n, (pk, mg, sg, lens, bool(dedupe))))
+        src = None
+        if sources is not None:
+            src = tuple(str(s) for s in sources)
+            if len(src) != n:
+                raise ValueError(f"sources has {len(src)} labels for {n} rows")
+        self._enqueue(_Item("batch", fut, n, (pk, mg, sg, lens, bool(dedupe), src)))
         return fut
 
     def submit_rows(
@@ -574,6 +616,8 @@ class PipelinedVerifier(BatchVerifier):
                 "batch_occupancy_avg": (
                     self._occupancy_sum / bundles if bundles else 0.0
                 ),
+                "multi_source_bundles": self.multi_source_bundles,
+                "max_bundle_sources": self.max_bundle_sources,
                 "worker_restarts": self.worker_restarts,
                 "fallback_serial": self.fallback_serial,
             }
@@ -597,6 +641,8 @@ class PipelinedVerifier(BatchVerifier):
                 "dispatched_bundles": self.dispatched_bundles,
                 "coalesced_bundles": self.coalesced_bundles,
                 "bundle_dup_rows": self.bundle_dup_rows,
+                "multi_source_bundles": self.multi_source_bundles,
+                "max_bundle_sources": self.max_bundle_sources,
                 "fallback_serial": self.fallback_serial,
                 "worker_restarts": self.worker_restarts,
             }
@@ -853,6 +899,12 @@ class PipelinedVerifier(BatchVerifier):
             else:
                 lens = None
             prep.update(pk=pk, mg=mg, sg=sg, lens=lens)
+            if any(len(i.data) > 5 and i.data[5] is not None for i in group):
+                srcs: List[str] = []
+                for i in group:
+                    row_src = i.data[5] if len(i.data) > 5 else None
+                    srcs.extend(row_src if row_src is not None else ("",) * i.n)
+                prep["sources"] = srcs
             if any(i.data[4] for i in group):
                 self._prep_dedupe(group, prep)
         elif kind == "rows":
@@ -1006,12 +1058,24 @@ class PipelinedVerifier(BatchVerifier):
                 for it in bundle.items:
                     self._resolve(it.fut, exc=e)
                 return
+        srcs = bundle.prep.get("sources")
+        distinct = ()
+        if srcs:
+            if "unique" in bundle.prep:
+                # only rows that actually reached the device count: a
+                # row resolved from the cache is not bundle workload
+                distinct = {srcs[int(r)] for r in bundle.prep["unique"]} - {""}
+            else:
+                distinct = set(srcs) - {""}
         with self._cv:
             self.dispatched_bundles += 1
             self.dispatched_rows += rows
             self._occupancy_sum += len(bundle.items)
             if len(bundle.items) > 1:
                 self.coalesced_bundles += 1
+            if len(distinct) > 1:
+                self.multi_source_bundles += 1
+            self.max_bundle_sources = max(self.max_bundle_sources, len(distinct))
         with trace.span("pipeline.resolve", kind=bundle.kind, requests=len(bundle.items)):
             if bundle.kind == "commit":
                 for it, res in zip(bundle.items, ok):

@@ -94,7 +94,14 @@ class IngestBatcher:
         hash_threshold: int = 64,
         metrics=None,
         logger=None,
+        clock=None,
     ):
+        from tendermint_tpu.utils.clock import wall_clock
+
+        # the flush linger resolves against this clock (utils/clock.py):
+        # wall time on a live node, simulated time under sim/ — so a
+        # simulated flash crowd pays the linger in sim-seconds, not real
+        self._clock = clock if clock is not None else wall_clock()
         self.mempool = mempool
         self.verifier = verifier
         self.sig_extractor = sig_extractor
@@ -172,7 +179,6 @@ class IngestBatcher:
     # -- dispatch ----------------------------------------------------------
 
     async def _loop(self) -> None:
-        loop = asyncio.get_running_loop()
         while True:
             while not self._q and not self._stopped:
                 self._wake.clear()
@@ -181,18 +187,22 @@ class IngestBatcher:
                 return
             if self.flush_s > 0 and len(self._q) < self.bundle_txs:
                 # hold the door: concurrent submitters (each its own
-                # task on this loop) pile on; a full bundle cuts early
-                deadline = loop.time() + self.flush_s
+                # task on this loop) pile on; a full bundle cuts early.
+                # The wait is a clock-seam timer poking _wake (not
+                # asyncio.wait_for) so the linger elapses in the
+                # batcher's clock — simulated time under sim/.
+                deadline = self._clock.monotonic() + self.flush_s
                 while (
                     not self._stopped
                     and len(self._q) < self.bundle_txs
-                    and (remaining := deadline - loop.time()) > 0
+                    and (remaining := deadline - self._clock.monotonic()) > 0
                 ):
                     self._wake.clear()
+                    timer = self._clock.call_later(remaining, self._wake.set)
                     try:
-                        await asyncio.wait_for(self._wake.wait(), remaining)
-                    except asyncio.TimeoutError:
-                        break
+                        await self._wake.wait()
+                    finally:
+                        timer.cancel()
             bundle: List[_Pending] = []
             while self._q and len(bundle) < self.bundle_txs:
                 bundle.append(self._q.popleft())

@@ -1,0 +1,16 @@
+"""Deterministic in-process network simulator (docs/simulator.md).
+
+Hundreds of real :class:`ConsensusState` instances under simulated
+time (`utils/clock.SimClock`) and a seeded virtual network
+(:class:`sim.net.SimNet`) whose latency/loss/partition/churn behavior
+is pure data — the `sim/schedule.py` grammar. All simulated nodes
+share ONE device verify pipeline, so cross-node signature traffic
+coalesces into real shared bundles (the arxiv 2112.02229
+verifier-saturation workload in miniature). `sim/scenarios/` is the
+replayable corpus every docs liveness/safety claim pins against
+(`scenario-coherence` lint rule).
+"""
+
+from tendermint_tpu.sim.core import SimResult, Simulation  # noqa: F401
+from tendermint_tpu.sim.scenario import Scenario, load_scenario, run_scenario  # noqa: F401
+from tendermint_tpu.sim.schedule import Schedule, parse_schedule  # noqa: F401

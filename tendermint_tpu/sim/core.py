@@ -1,0 +1,511 @@
+"""Simulation driver: hundreds of real consensus nodes, one clock,
+one shared device pipeline.
+
+:func:`build_node` is the ONE in-process node constructor — the test
+harness (tests/cs_harness.py) delegates here, the simulator adds a
+:class:`~tendermint_tpu.utils.clock.SimClock` and a schedule-driven
+:class:`~tendermint_tpu.sim.net.SimNet` behind the same routing seam.
+
+:class:`Simulation` owns the determinism loop: let the asyncio loop
+run until quiescent (every task blocked on a queue or a sim timer),
+then pop the next scheduled event off the SimClock. Time jumps
+straight from event to event — a 256-node, 50-height run is seconds of
+wall time — and because nothing ever consults the wall clock, the run
+is a pure function of (seed, schedule, sizes): same inputs, bit-
+identical commit hashes and event trace (pinned by tests/test_sim.py).
+
+All nodes share ONE :class:`PipelinedVerifier` (installed as the
+process default provider for the duration of the run) and the
+process-global SigCache/MerkleHasher seams, so cross-node signature
+traffic coalesces into genuinely shared device bundles — the
+multi-node engine workload reported through ``engine_stats()``
+(models/telemetry.py protocol).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional
+
+from tendermint_tpu.codec.signbytes import PREVOTE_TYPE
+from tendermint_tpu.consensus.messages import BlockPartMessage, ProposalMessage
+from tendermint_tpu.consensus.state import EVENT_COMMITTED, ConsensusState
+from tendermint_tpu.consensus.wal import NilWAL
+from tendermint_tpu.crypto.batch import (
+    CPUBatchVerifier,
+    get_default_provider,
+    set_default_provider,
+)
+from tendermint_tpu.crypto.keys import Ed25519PrivKey
+from tendermint_tpu.crypto.pipeline import (
+    PipelinedVerifier,
+    SigCache,
+    default_sig_cache,
+    set_default_sig_cache,
+)
+from tendermint_tpu.sim.net import SimNet
+from tendermint_tpu.sim.schedule import Schedule, parse_schedule
+from tendermint_tpu.sim.transport import wire_mesh
+from tendermint_tpu.types.block import BlockID
+from tendermint_tpu.types.genesis import GenesisDoc, GenesisValidator
+from tendermint_tpu.types.priv_validator import MockPV
+from tendermint_tpu.types.proposal import Proposal
+from tendermint_tpu.types.tx import Tx, Txs
+from tendermint_tpu.utils.clock import SimClock
+from tendermint_tpu.utils.log import get_logger
+
+SIM_CHAIN_ID = "sim-chain"
+GENESIS_TIME_NS = 1_700_000_000_000_000_000
+
+
+def make_genesis(
+    n_vals: int,
+    powers=None,
+    time_ns: int = GENESIS_TIME_NS,
+    key_type: str = "ed25519",
+    chain_id: str = SIM_CHAIN_ID,
+    secret_prefix: str = "cs-harness",
+):
+    """Deterministic genesis + priv validators, ordered to match the
+    sorted validator set (reference randGenesisDoc common_test.go:617).
+    Shared by the cs_harness (its historical secret/chain-id defaults
+    are preserved there) and the simulator."""
+    from tendermint_tpu.state.state import state_from_genesis_doc
+
+    if key_type == "bls12-381":
+        from tendermint_tpu.crypto.bls import BLSPrivKey
+
+        key_cls = BLSPrivKey
+    else:
+        key_cls = Ed25519PrivKey
+    privs = [
+        MockPV(key_cls.from_secret(f"{secret_prefix}-{i}".encode()))
+        for i in range(n_vals)
+    ]
+    powers = powers or [10] * n_vals
+    pops = [
+        pv.priv_key.register_possession() if key_type == "bls12-381" else b""
+        for pv in privs
+    ]
+    gvs = [
+        GenesisValidator(
+            address=pv.address(), pub_key=pv.get_pub_key(), power=p,
+            name=f"v{i}", proof_of_possession=pop,
+        )
+        for i, (pv, p, pop) in enumerate(zip(privs, powers, pops))
+    ]
+    doc = GenesisDoc(chain_id=chain_id, genesis_time_ns=time_ns, validators=gvs)
+    state = state_from_genesis_doc(doc)
+    by_addr = {pv.address(): pv for pv in privs}
+    ordered = [by_addr[v.address] for v in state.validators.validators]
+    return doc, ordered
+
+
+@dataclass
+class SimNode:
+    """One in-process node (the harness Node shape)."""
+
+    cs: ConsensusState
+    app: object
+    mempool: object
+    block_store: object
+    state_store: object
+
+
+async def build_node(
+    genesis: GenesisDoc,
+    pv: Optional[MockPV],
+    config=None,
+    app=None,
+    wal=None,
+    node_id: str = "",
+    tracer=None,
+    clock=None,
+    sig_cache=None,
+) -> SimNode:
+    """The one in-process consensus-node constructor (harness make_node
+    delegates here): kvstore app over a LocalClient, MemDB stores, an
+    optional per-node tracer and the clock seam."""
+    from tendermint_tpu.abci.client.local import LocalClient
+    from tendermint_tpu.abci.examples.kvstore import KVStoreApplication
+    from tendermint_tpu.config import MempoolConfig, test_config
+    from tendermint_tpu.db.memdb import MemDB
+    from tendermint_tpu.mempool import Mempool
+    from tendermint_tpu.state.execution import BlockExecutor
+    from tendermint_tpu.state.state import state_from_genesis_doc
+    from tendermint_tpu.state.store import StateStore
+    from tendermint_tpu.store.block_store import BlockStore
+
+    config = config or test_config().consensus
+    app = app or KVStoreApplication()
+    client = LocalClient(app)
+    await client.start()
+    mempool = Mempool(MempoolConfig(), client)
+    state_store = StateStore(MemDB())
+    block_store = BlockStore(MemDB())
+    state = state_from_genesis_doc(genesis)
+    state_store.save(state)
+    block_exec = BlockExecutor(state_store, client, mempool=mempool)
+    cs = ConsensusState(
+        config=config,
+        state=state,
+        block_exec=block_exec,
+        block_store=block_store,
+        mempool=mempool,
+        priv_validator=pv,
+        wal=wal or NilWAL(),
+        node_id=node_id,
+        tracer=tracer,
+        clock=clock,
+        sig_cache=sig_cache,
+    )
+    return SimNode(cs, app, mempool, block_store, state_store)
+
+
+@dataclass
+class SimResult:
+    """What one run produced (docs/simulator.md, outcome section)."""
+
+    heights: Dict[int, int] = field(default_factory=dict)  # node -> committed h
+    commit_hashes: Dict[int, Dict[int, bytes]] = field(default_factory=dict)
+    trace_digest: str = ""
+    events: List[tuple] = field(default_factory=list)
+    engine: Dict[str, object] = field(default_factory=dict)
+    net: Dict[str, float] = field(default_factory=dict)
+    ledger_phases: Dict[int, List[tuple]] = field(default_factory=dict)
+    ledgers: Dict[int, dict] = field(default_factory=dict)
+    sim_seconds: float = 0.0
+    wall_seconds: float = 0.0
+    completed: bool = False
+    timed_out: bool = False
+    merged_trace: Optional[dict] = None
+
+    def chain_hashes(self) -> Dict[int, set]:
+        """height -> set of distinct committed block hashes across the
+        whole net. Safety == every value has exactly one element."""
+        out: Dict[int, set] = {}
+        for per_node in self.commit_hashes.values():
+            for h, bh in per_node.items():
+                out.setdefault(h, set()).add(bh)
+        return out
+
+    def safety_ok(self) -> bool:
+        return all(len(s) == 1 for s in self.chain_hashes().values())
+
+
+class Simulation:
+    """One deterministic run: N nodes (the first ``validators`` of them
+    validating), a seeded schedule, simulated time."""
+
+    def __init__(
+        self,
+        n_nodes: int,
+        validators: Optional[int] = None,
+        heights: int = 10,
+        schedule: str | Schedule = "",
+        seed: int = 0,
+        app_factory: Optional[Callable[[], object]] = None,
+        traced: bool = False,
+        record_events: bool = True,
+        max_sim_s: float = 600.0,
+        inner_verifier=None,
+        config=None,
+        on_built: Optional[Callable[["Simulation"], None]] = None,
+        logger=None,
+    ):
+        self.n_nodes = int(n_nodes)
+        self.validators = int(validators) if validators else self.n_nodes
+        if not 0 < self.validators <= self.n_nodes:
+            raise ValueError(f"validators {validators} out of range for {n_nodes} nodes")
+        self.heights = int(heights)
+        self.schedule = (
+            schedule if isinstance(schedule, Schedule) else parse_schedule(schedule)
+        )
+        self.seed = int(seed)
+        self.app_factory = app_factory
+        self.traced = traced
+        self.record_events = record_events
+        self.max_sim_s = float(max_sim_s)
+        self.inner_verifier = inner_verifier
+        self.config = config
+        self.on_built = on_built
+        self.logger = logger or get_logger("sim")
+        self.privs: List[MockPV] = []
+        self.nodes: List[SimNode] = []
+        self.net: Optional[SimNet] = None
+        self.clock = SimClock(GENESIS_TIME_NS)
+        self._bg: set = set()  # strong refs for injected-load tasks
+
+    # -- construction ------------------------------------------------------
+
+    async def _build(self, cache: SigCache, verifier: PipelinedVerifier) -> None:
+        from tendermint_tpu.config import test_config
+
+        config = self.config or test_config().consensus
+        genesis, privs = make_genesis(
+            self.validators, chain_id=SIM_CHAIN_ID, secret_prefix=f"sim-{self.seed}"
+        )
+        self.privs = privs
+        self.nodes = []
+        # each simulated node keeps its OWN signature cache (node
+        # identity stays physical); the shared engine's pre-verifier
+        # warms them per delivery (sim/net.py _preverify)
+        self.node_caches = [SigCache() for _ in range(self.n_nodes)]
+        for i in range(self.n_nodes):
+            tracer = None
+            if self.traced:
+                from tendermint_tpu.utils.trace import Tracer
+
+                tracer = Tracer(enabled=True, node_id=f"node{i}")
+            self.nodes.append(
+                await build_node(
+                    genesis,
+                    privs[i] if i < self.validators else None,
+                    config=config,
+                    app=self.app_factory() if self.app_factory else None,
+                    node_id=f"node{i}",
+                    tracer=tracer,
+                    clock=self.clock,
+                    sig_cache=self.node_caches[i],
+                )
+            )
+        cs_list = [n.cs for n in self.nodes]
+        self.net = SimNet(
+            self.clock,
+            self.schedule,
+            seed=self.seed,
+            chain_id=SIM_CHAIN_ID,
+            verifier=verifier,
+            cache=cache,
+            record_events=self.record_events,
+        )
+        self.net.attach(
+            cs_list,
+            [n.block_store for n in self.nodes],
+            self.validators,
+            node_caches=self.node_caches,
+        )
+        wire_mesh(cs_list, self.net)
+        for i, cs in enumerate(cs_list):
+            cs.evsw.add_listener(
+                EVENT_COMMITTED,
+                lambda block, _i=i: self.net.notify_commit(
+                    _i, block.header.height, block.hash(), len(block.data.txs)
+                ),
+            )
+        for b in self.schedule.byz:
+            self.net.add_height_hook(
+                b.at_h, lambda _b=b: self._install_byzantine(_b.node, _b.kind)
+            )
+        for ld in self.schedule.loads:
+            self.net.add_height_hook(ld.at_h, lambda _l=ld: self._inject_load(_l))
+        if self.on_built is not None:
+            self.on_built(self)
+
+    # -- byzantine overrides ----------------------------------------------
+
+    def _install_byzantine(self, idx: int, kind: str) -> None:
+        cs = self.nodes[idx].cs
+        self.net._event("byz", self.clock.time_ns(), idx, kind)
+        if kind == "double_sign":
+            self._install_double_sign(idx, cs)
+        elif kind == "amnesia":
+            self._install_amnesia(idx, cs)
+
+    def _install_double_sign(self, idx: int, cs: ConsensusState) -> None:
+        """Equivocating proposer (reference byzantineDecideProposalFunc,
+        byzantine_test.go:106): two different blocks, each half of the
+        net sees a different one."""
+        net = self.net
+
+        async def byz_decide(height: int, round_: int) -> None:
+            block_a, parts_a = cs._create_proposal_block()
+            if block_a is None:
+                return
+            commit = (
+                cs.rs.last_commit.make_commit()
+                if cs.rs.last_commit is not None
+                and cs.rs.last_commit.has_two_thirds_majority()
+                else None
+            )
+            if commit is None:
+                from tendermint_tpu.types.block import Commit
+
+                commit = Commit(height=0, round=0, block_id=BlockID(), signatures=[])
+            block_b = cs.state.make_block(
+                height, Txs([Tx(b"sim-equivocation")]), commit, [],
+                cs._priv_validator_addr,
+            )
+            parts_b = block_b.make_part_set()
+            for dst in range(len(net.nodes)):
+                if dst == idx:
+                    continue
+                block, parts = (block_a, parts_a) if dst % 2 == 0 else (block_b, parts_b)
+                block_id = BlockID(hash=block.hash(), parts=parts.header())
+                proposal = Proposal(
+                    height=height, round=round_, pol_round=cs.rs.valid_round,
+                    block_id=block_id, timestamp_ns=cs._now_ns(),
+                )
+                cs._priv_validator.sign_proposal(cs.state.chain_id, proposal)
+                net.unicast(idx, dst, ProposalMessage(proposal))
+                for i in range(parts.total):
+                    net.unicast(
+                        idx, dst, BlockPartMessage(height, round_, parts.get_part(i))
+                    )
+
+        cs.decide_proposal = byz_decide
+
+    def _install_amnesia(self, idx: int, cs: ConsensusState) -> None:
+        """Lock-forgetting prevoter: clears its lock every prevote step
+        and votes for whatever proposal is in front of it (the amnesia
+        attack shape — safety must hold through honest precommit
+        locking, which the scenario pins)."""
+
+        async def amnesia_prevote(height: int, round_: int) -> None:
+            rs = cs.rs
+            rs.locked_round = -1
+            rs.locked_block = None
+            rs.locked_block_parts = None
+            if rs.proposal_block is not None:
+                await cs._sign_add_vote(
+                    PREVOTE_TYPE, rs.proposal_block.hash(),
+                    rs.proposal_block_parts.header(),
+                )
+            else:
+                await cs._sign_add_vote(PREVOTE_TYPE, b"", None)
+
+        cs.do_prevote = amnesia_prevote
+
+    # -- load injection ----------------------------------------------------
+
+    def _inject_load(self, ld) -> None:
+        self.net._event("load", self.clock.time_ns(), ld.txs, ld.size)
+        task = asyncio.get_running_loop().create_task(self._do_load(ld))
+        self._bg.add(task)
+        task.add_done_callback(self._bg.discard)
+
+    async def _do_load(self, ld) -> None:
+        """Flash crowd: the same deterministic tx burst hits every
+        node's mempool (what a gossiped crowd converges to)."""
+        for i in range(ld.txs):
+            key = f"sim-load-{ld.at_h}-{i}"
+            tx = f"{key}={'x' * max(ld.size - len(key) - 1, 1)}".encode()
+            for node in self.nodes:
+                try:
+                    await node.mempool.check_tx(tx)
+                except Exception:
+                    pass  # full/duplicate: the burst is best-effort
+
+    # -- the determinism loop ----------------------------------------------
+
+    async def _drain(self) -> None:
+        """Let the event loop run until no callback is immediately
+        ready — every task parked on a queue or a sim timer."""
+        loop = asyncio.get_running_loop()
+        ready = getattr(loop, "_ready", None)
+        if ready is None:  # non-CPython loop: bounded settle
+            for _ in range(64):
+                await asyncio.sleep(0)
+            return
+        while True:
+            await asyncio.sleep(0)
+            if not ready:
+                return
+
+    def _done(self) -> bool:
+        target = self.heights
+        if self.net.net_height < target:
+            return False
+        crashed = self.net._crashed
+        return all(
+            n.cs.state.last_block_height >= target
+            for i, n in enumerate(self.nodes)
+            if i not in crashed
+        )
+
+    async def run_async(self) -> SimResult:
+        t0 = time.perf_counter()
+        prev_provider = get_default_provider()
+        prev_cache = default_sig_cache()
+        cache = SigCache()
+        verifier = PipelinedVerifier(
+            inner=self.inner_verifier or CPUBatchVerifier(), cache=cache
+        )
+        set_default_sig_cache(cache)
+        set_default_provider(verifier)
+        started: List[SimNode] = []
+        timed_out = False
+        try:
+            await self._build(cache, verifier)
+            for node in self.nodes:
+                await node.cs.start()
+                started.append(node)
+            deadline_ns = self.clock.time_ns() + int(self.max_sim_s * 1e9)
+            while True:
+                await self._drain()
+                if self._done():
+                    break
+                if self.clock.time_ns() >= deadline_ns:
+                    timed_out = True
+                    break
+                if not self.clock.advance():
+                    # nothing scheduled and nothing runnable: wedged
+                    timed_out = True
+                    self.logger.error(
+                        "sim deadlock: no pending events", **self.net.stats()
+                    )
+                    break
+            result = self._collect(verifier, timed_out, t0)
+        finally:
+            for node in started:
+                try:
+                    await node.cs.stop()
+                except Exception:
+                    pass
+            set_default_provider(prev_provider)
+            set_default_sig_cache(prev_cache)
+            verifier.stop(drain=False, timeout=5.0)
+        return result
+
+    def run(self) -> SimResult:
+        return asyncio.run(self.run_async())
+
+    # -- collection --------------------------------------------------------
+
+    def _collect(
+        self, verifier: PipelinedVerifier, timed_out: bool, t0: float
+    ) -> SimResult:
+        res = SimResult(
+            heights={
+                i: n.cs.state.last_block_height for i, n in enumerate(self.nodes)
+            },
+            commit_hashes={k: dict(v) for k, v in self.net.commit_hashes.items()},
+            trace_digest=self.net.trace_digest(),
+            events=list(self.net.events),
+            engine=verifier.engine_stats(),
+            net=self.net.stats(),
+            sim_seconds=(self.clock.time_ns() - GENESIS_TIME_NS) / 1e9,
+            wall_seconds=time.perf_counter() - t0,
+            completed=not timed_out,
+            timed_out=timed_out,
+        )
+        for i, n in enumerate(self.nodes):
+            report = n.cs.ledger.report()
+            res.ledgers[i] = report
+            res.ledger_phases[i] = [
+                (h["height"], tuple(sorted(h["phases"].keys())))
+                for h in report.get("heights", [])
+            ]
+        if self.traced:
+            from tendermint_tpu.utils.trace import merge_chrome_traces
+
+            res.merged_trace = merge_chrome_traces(
+                [
+                    n.cs.tracer.export_chrome()
+                    for n in self.nodes
+                    if n.cs.tracer is not None
+                ]
+            )
+        return res

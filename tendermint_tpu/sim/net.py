@@ -1,0 +1,593 @@
+"""SimNet: the seeded virtual network behind the routing seam.
+
+Implements the ``transport.broadcast`` interface (sim/transport.py)
+over a :class:`~tendermint_tpu.utils.clock.SimClock`: every message a
+node emits is scheduled for delivery at ``now + link delay (+ seeded
+jitter)``, quantized to the schedule's delivery quantum so messages
+landing in the same quantum flush together. Behavior — latency, loss,
+partitions, isolation-crashes — is pure data (sim/schedule.py), and
+every decision draws from one seeded RNG stream in delivery order, so
+the same seed + schedule reproduces the byte-identical event trace
+(``trace_digest()``), which tests/test_sim.py pins.
+
+Two pieces make hundreds of nodes affordable on one host:
+
+- **Shared-bundle pre-verification**: when a flush delivers signed
+  gossip, the unique not-yet-cached signature rows across ALL
+  recipients are verified in one ``submit_batch`` on the shared
+  :class:`PipelinedVerifier` — rows labeled per source node, the
+  multi-node device workload the accelerator thesis predicts (arxiv
+  2112.02229) — and successful rows warm the shared SigCache in the
+  exact templated keyspace vote ingest probes, so each node's inline
+  verification is a hash lookup. Pre-verification is an optimization
+  only: any row it cannot attribute (or a pipeline liveness failure)
+  simply falls through to the node's own serial verify.
+- **Catchup replay**: a node that missed a commit (partition,
+  isolation-crash) can never rejoin through live gossip alone — the
+  network has moved on. After a heal/restart the net replays, through
+  the normal delivery path, the stored seen-commit precommits and
+  block parts for each height the laggard is missing (the simulator's
+  stand-in for the fast-sync reactor; same mechanism as WAL-less
+  reconstructLastCommit).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import heapq
+import random
+from collections import deque
+from typing import Dict, List, Optional, Set, Tuple
+
+from tendermint_tpu.codec import signbytes
+from tendermint_tpu.consensus.messages import (
+    BlockPartMessage,
+    MsgInfo,
+    ProposalMessage,
+    VoteMessage,
+)
+from tendermint_tpu.crypto.pipeline import SigCache, default_sig_cache
+from tendermint_tpu.sim.schedule import Schedule
+from tendermint_tpu.types.vote import Vote
+from tendermint_tpu.utils.log import get_logger
+
+CATCHUP_TICK_S = 0.25  # sim-time between catchup feeds per laggard
+
+
+def _msg_kind(msg) -> Tuple[str, int, int]:
+    if isinstance(msg, VoteMessage):
+        v = msg.vote
+        return (f"vote{v.vote_type}", v.height, v.round)
+    if isinstance(msg, ProposalMessage):
+        return ("prop", msg.proposal.height, msg.proposal.round)
+    if isinstance(msg, BlockPartMessage):
+        return ("part", msg.height, msg.round)
+    return (type(msg).__name__, 0, 0)
+
+
+class SimNet:
+    """Schedule-driven transport + network-event state machine."""
+
+    # sim/transport.py wire_mesh: own messages ride the scheduled path
+    # too (one quantum, immune to loss/partition/crash, peer id "")
+    delivers_self = True
+
+    def __init__(
+        self,
+        clock,
+        schedule: Schedule,
+        seed: int = 0,
+        chain_id: str = "",
+        verifier=None,
+        cache: Optional[SigCache] = None,
+        record_events: bool = True,
+        logger=None,
+    ):
+        self.clock = clock
+        self.schedule = schedule
+        self.seed = int(seed)
+        self.chain_id = chain_id
+        self.verifier = verifier  # shared PipelinedVerifier (or None)
+        self.cache = cache if cache is not None else default_sig_cache()
+        self.record_events = record_events
+        self.logger = logger or get_logger("simnet")
+
+        self._rng = random.Random(self.seed ^ 0x51AE7)
+        self._quantum_ns = max(int(schedule.quantum_ms * 1e6), 1)
+
+        self.nodes: List = []  # ConsensusState per index
+        self.block_stores: List = []
+        self.n_validators = 0
+
+        # pending deliveries: (t_q_ns, seq, src, dst, msg)
+        self._heap: List[tuple] = []
+        self._seq = 0
+        self._flush_timers: Dict[int, object] = {}
+        # per-receiver overflow backlog (input queue full): drained in
+        # arrival order one quantum at a time so a full queue delays a
+        # link without ever reordering it
+        self._deferred: Dict[int, "deque"] = {}
+        self._drain_timers: Dict[int, object] = {}
+        # per-link FIFO horizon: gossip rides ordered streams (TCP
+        # MConnection), so jitter may stretch a link's latency but must
+        # never REORDER it — an early part overtaking its proposal
+        # would be silently dropped by consensus (reference ignores
+        # parts with no proposal) and a one-shot simulator never
+        # re-gossips. t_deliver = max(computed, link's last deliver).
+        self._link_last: Dict[Tuple[int, int], int] = {}
+
+        # network-event state
+        self.net_height = 0
+        self._cut: Set[int] = set()
+        self._crashed: Set[int] = set()
+        self._partitions = list(schedule.partitions)  # pending
+        self._active_partitions: List = []
+        self._crashes = list(schedule.crashes)  # pending
+        self._active_crashes: List = []
+        self._height_hooks: List[Tuple[int, object]] = []  # (at_h, fn)
+        self._catchup_timer = None
+        self._last_fed: Dict[int, Tuple[int, int]] = {}  # node -> (height, t_ns)
+
+        # event trace: full list (optional) + running digest (always)
+        self.events: List[tuple] = []
+        self._digest = hashlib.sha256()
+        self.deliveries = 0
+        self.drops = 0
+        self.preverified_rows = 0
+        self.preverify_skips = 0
+        self.commit_hashes: Dict[int, Dict[int, bytes]] = {}  # node -> h -> hash
+        # compact aggregates the scenario expectations evaluate against
+        # (independent of record_events, so giant runs stay cheap)
+        self.commit_times: Dict[int, Dict[int, int]] = {}  # node -> h -> t_ns
+        self.txs_committed = 0
+        self.partition_windows: List[dict] = []
+
+        # sim-wide: spans heights, so a larger bound than a VoteSet's
+        self._tpl_cache = signbytes.TemplateCache(bound=4096)
+
+    # -- wiring ------------------------------------------------------------
+
+    def attach(
+        self,
+        cs_list: List,
+        block_stores: List,
+        n_validators: int,
+        node_caches: Optional[List[SigCache]] = None,
+    ) -> None:
+        self.nodes = list(cs_list)
+        self.block_stores = list(block_stores)
+        self.n_validators = int(n_validators)
+        # per-node signature caches (each node's ConsensusState.sig_cache):
+        # the pre-verifier warms the DESTINATION node's cache for every
+        # verified delivery, so inline ingest at the receiver is a hash
+        # lookup. None disables warming (and with it pre-verification).
+        self.node_caches = list(node_caches) if node_caches else []
+        self.schedule.bind(len(self.nodes), self.n_validators)
+
+    def add_height_hook(self, at_h: int, fn) -> None:
+        """Run ``fn()`` once when the network height first reaches
+        ``at_h`` (byzantine activation, load bursts — sim/core.py)."""
+        self._height_hooks.append((int(at_h), fn))
+        self._height_hooks.sort(key=lambda e: e[0])
+
+    # -- event trace -------------------------------------------------------
+
+    def _event(self, *ev) -> None:
+        self._digest.update(repr(ev).encode())
+        if self.record_events:
+            self.events.append(ev)
+
+    def trace_digest(self) -> str:
+        return self._digest.hexdigest()
+
+    # -- transport interface (sim/transport.py wire_mesh) ------------------
+
+    def broadcast(self, src: int, msg) -> None:
+        for dst in range(len(self.nodes)):
+            self.unicast(src, dst, msg)
+
+    def unicast(self, src: int, dst: int, msg) -> None:
+        """Schedule one delivery, applying the schedule's partition /
+        crash / loss / latency rules at send time. Self-delivery
+        (``src == dst``, the node's own internal messages) is exempt
+        from all of them — an isolation-crashed node still hears
+        itself — and takes exactly one delivery quantum."""
+        now = self.clock.time_ns()
+        if src == dst:
+            self._schedule_delivery(now + self._quantum_ns, src, dst, msg)
+            return
+        kind, h, r = _msg_kind(msg)
+        if src in self._crashed or dst in self._crashed:
+            self._drop(now, src, dst, kind, h, r, "crashed")
+            return
+        if self._severed(src, dst):
+            self._drop(now, src, dst, kind, h, r, "partition")
+            return
+        delay_ms, jitter_ms, loss_p = self.schedule.link_params(src, dst)
+        if loss_p > 0.0 and self._rng.random() < loss_p:
+            self._drop(now, src, dst, kind, h, r, "loss")
+            return
+        if jitter_ms > 0.0:
+            delay_ms += self._rng.random() * jitter_ms
+        self._schedule_delivery(now + int(delay_ms * 1e6), src, dst, msg)
+
+    def _severed(self, a: int, b: int) -> bool:
+        if not self._cut:
+            return False
+        return (a in self._cut) != (b in self._cut)
+
+    def _drop(self, t: int, src: int, dst: int, kind: str, h: int, r: int, why: str) -> None:
+        self.drops += 1
+        self._event("drop", t, src, dst, kind, h, r, why)
+
+    def _schedule_delivery(self, t_ns: int, src: int, dst: int, msg) -> None:
+        link = (src, dst)
+        t_ns = max(t_ns, self._link_last.get(link, 0))
+        self._link_last[link] = t_ns
+        q = self._quantum_ns
+        t_q = max(((t_ns + q - 1) // q) * q, self.clock.time_ns())
+        heapq.heappush(self._heap, (t_q, self._seq, src, dst, msg))
+        self._seq += 1
+        if t_q not in self._flush_timers:
+            self._flush_timers[t_q] = self.clock.call_at_ns(t_q, self._flush, t_q)
+
+    # -- delivery flush ----------------------------------------------------
+
+    def _flush(self, t_q: int) -> None:
+        self._flush_timers.pop(t_q, None)
+        due: List[tuple] = []
+        while self._heap and self._heap[0][0] <= t_q:
+            due.append(heapq.heappop(self._heap))
+        if not due:
+            return
+        self._preverify(due)
+        for _t, _seq, src, dst, msg in due:
+            kind, h, r = _msg_kind(msg)
+            if dst in self._crashed and dst != src:
+                self._drop(t_q, src, dst, kind, h, r, "crashed")
+                continue
+            if self._deferred.get(dst):
+                # a backlog exists for this receiver: queue BEHIND it —
+                # jumping it would reorder the link (the FIFO invariant)
+                self._event("requeue", t_q, src, dst, kind, h, r)
+                self._deferred[dst].append((src, msg))
+                continue
+            if not self._put(t_q, src, dst, msg, kind, h, r):
+                # receiver's input queue is full (vote storm): open a
+                # per-receiver backlog drained in arrival order — a
+                # deterministic stand-in for a bounded socket buffer
+                # that never reorders and never loses a message
+                self._event("requeue", t_q, src, dst, kind, h, r)
+                self._deferred[dst] = deque([(src, msg)])
+                self._arm_drain(dst)
+
+    def _put(self, t: int, src: int, dst: int, msg, kind, h, r) -> bool:
+        try:
+            # own messages keep the internal peer id "" — the WAL
+            # fsync and own-message-halt semantics key off it
+            self.nodes[dst]._queue.put_nowait(
+                MsgInfo(msg, "" if dst == src else f"node{src}")
+            )
+        except Exception:
+            return False
+        self.deliveries += 1
+        self._event("deliver", t, src, dst, kind, h, r)
+        return True
+
+    def _arm_drain(self, dst: int) -> None:
+        if dst not in self._drain_timers:
+            self._drain_timers[dst] = self.clock.call_later(
+                self.schedule.quantum_ms / 1000.0, self._drain_deferred, dst
+            )
+
+    def _drain_deferred(self, dst: int) -> None:
+        self._drain_timers.pop(dst, None)
+        backlog = self._deferred.get(dst)
+        t = self.clock.time_ns()
+        while backlog:
+            src, msg = backlog[0]
+            kind, h, r = _msg_kind(msg)
+            if dst in self._crashed and dst != src:
+                backlog.popleft()
+                self._drop(t, src, dst, kind, h, r, "crashed")
+                continue
+            if not self._put(t, src, dst, msg, kind, h, r):
+                break
+            backlog.popleft()
+        if backlog:
+            self._arm_drain(dst)
+        else:
+            self._deferred.pop(dst, None)
+
+    # -- shared-bundle pre-verification ------------------------------------
+
+    def _vote_template(self, vote: Vote) -> bytes:
+        bid = vote.block_id
+        return self._tpl_cache.get(
+            vote.vote_type, vote.height, vote.round,
+            bid.hash, bid.parts.total, bid.parts.hash, self.chain_id,
+        )
+
+    def _sig_row(self, src: int, msg) -> Optional[Tuple[bytes, bytes, bytes, bytes]]:
+        """(cache_key, pubkey32, sign_bytes, sig) for a signed gossip
+        message, in the EXACT keyspace the receiver probes — templated
+        for votes (types/vote_set.py), raw for proposals
+        (crypto/pipeline.cached_verify). None = not attributable; the
+        receiver verifies inline (correctness never depends on this)."""
+        if isinstance(msg, VoteMessage):
+            vote = msg.vote
+            if not vote.signature or len(vote.signature) > 64:
+                return None
+            sender = self.nodes[src]
+            try:
+                _, val = sender.rs.validators.get_by_address(vote.validator_address)
+            except Exception:
+                val = None
+            if val is None:
+                return None
+            raw = val.pub_key.bytes()
+            if len(raw) != 32:
+                return None  # non-ed25519 row: receiver verifies inline
+            key = SigCache.key_templated(
+                raw,
+                self._vote_template(vote),
+                vote.timestamp_ns.to_bytes(8, "big", signed=True),
+                vote.signature,
+            )
+            # sign-bytes built LAZILY: most rows resolve from the cache
+            # and never need the 160-byte materialization
+            return key, raw, (lambda v=vote: v.sign_bytes(self.chain_id)), vote.signature
+        if isinstance(msg, ProposalMessage):
+            prop = msg.proposal
+            if not prop.signature or len(prop.signature) > 64:
+                return None
+            sender = self.nodes[src]
+            addr = sender._priv_validator_addr
+            if addr is None:
+                return None
+            try:
+                _, val = sender.rs.validators.get_by_address(addr)
+            except Exception:
+                val = None
+            if val is None:
+                return None
+            raw = val.pub_key.bytes()
+            if len(raw) != 32:
+                return None
+            sb = prop.sign_bytes(self.chain_id)
+            return SigCache.key(raw, sb, prop.signature), raw, (lambda _sb=sb: _sb), prop.signature
+        return None
+
+    def _preverify(self, due: List[tuple]) -> None:
+        """Shared device bundles for the flush's unique signature rows.
+
+        Every signed message due in this flush contributes one row per
+        unique (pubkey, sign bytes, sig) triple; rows the engine cache
+        hasn't seen verify in ONE ``submit_batch`` per sign-bytes width
+        — source-labeled per originating node, so a flush carrying
+        several validators' votes is a genuinely multi-node device
+        bundle (``multi_source_bundles`` in engine_stats). Verified
+        keys then warm each DESTINATION node's own cache, making the
+        receivers' inline verification a hash lookup."""
+        verifier = self.verifier
+        if verifier is None or not self.node_caches:
+            return
+        # key -> [pubkey, sign_bytes, sig, source_label, dests]
+        pend: Dict[bytes, list] = {}
+        # one _sig_row per MESSAGE, not per (message, destination): a
+        # 256-way broadcast would otherwise recompute the cache key —
+        # and a proposal's sign bytes — 255 times in one flush
+        row_memo: Dict[int, object] = {}
+        for _t, _seq, src, dst, msg in due:
+            if dst in self._crashed and dst != src:
+                continue
+            if not isinstance(msg, (VoteMessage, ProposalMessage)):
+                continue  # unsigned gossip (block parts)
+            mid = id(msg)
+            if mid in row_memo:
+                info = row_memo[mid]
+            else:
+                info = row_memo[mid] = self._sig_row(src, msg)
+            if info is None:
+                self.preverify_skips += 1
+                continue
+            key, raw, sb, sig = info
+            entry = pend.get(key)
+            if entry is None:
+                entry = pend[key] = [raw, sb, sig, f"node{src}", []]
+            entry[4].append(dst)
+        if not pend:
+            return
+        ok_keys: Set[bytes] = set()
+        to_verify: Dict[int, List[Tuple[bytes, list]]] = {}  # width -> rows
+        for key, entry in pend.items():
+            if self.cache.seen(key):
+                ok_keys.add(key)
+            else:
+                entry[1] = entry[1]()  # materialize sign bytes (miss rows only)
+                to_verify.setdefault(len(entry[1]), []).append((key, entry))
+        import numpy as np
+
+        for width, items in sorted(to_verify.items()):
+            n = len(items)
+            pk = np.frombuffer(
+                b"".join(e[0] for _k, e in items), dtype=np.uint8
+            ).reshape(n, 32)
+            mg = np.frombuffer(
+                b"".join(e[1] for _k, e in items), dtype=np.uint8
+            ).reshape(n, width)
+            sg = np.frombuffer(
+                b"".join(e[2][:64].ljust(64, b"\x00") for _k, e in items),
+                dtype=np.uint8,
+            ).reshape(n, 64)
+            try:
+                fut = verifier.submit_batch(
+                    pk, mg, sg, sources=[e[3] for _k, e in items]
+                )
+                ok = fut.result(timeout=120.0)
+            except Exception as e:
+                # liveness escape: receivers verify inline, nothing lost
+                self.preverify_skips += n
+                self.logger.debug("preverify bundle failed", err=repr(e))
+                continue
+            for (key, _e), good in zip(items, ok):
+                if bool(good):
+                    self.preverified_rows += 1
+                    self.cache.add(key)
+                    ok_keys.add(key)
+        for key in ok_keys:
+            for dst in pend[key][4]:
+                self.node_caches[dst].add(key)
+
+    # -- network-event state machine ---------------------------------------
+
+    def notify_commit(
+        self, node: int, height: int, block_hash: bytes, txs: int = 0
+    ) -> None:
+        """Called (synchronously, from the committing node's receive
+        routine) for every commit; drives the height-triggered schedule
+        events."""
+        t = self.clock.time_ns()
+        self.commit_hashes.setdefault(node, {})[height] = block_hash
+        self.commit_times.setdefault(node, {})[height] = t
+        self.txs_committed += int(txs)
+        self._event("commit", t, node, height, block_hash[:8].hex(), txs)
+        if height <= self.net_height:
+            return
+        self.net_height = height
+        # activate pending partitions / heal active ones
+        for p in list(self._partitions):
+            if height >= p.at_h:
+                self._partitions.remove(p)
+                self._active_partitions.append(p)
+                cut = p.cut_set(len(self.nodes), self.n_validators)
+                self._cut |= cut
+                self._event("partition", t, "on", tuple(sorted(cut)))
+                self.partition_windows.append(
+                    {"cut": sorted(cut), "t_on": t, "h_on": height,
+                     "t_heal": None, "h_heal": None}
+                )
+        for p in list(self._active_partitions):
+            if height >= p.heal_h:
+                self._active_partitions.remove(p)
+                cut = p.cut_set(len(self.nodes), self.n_validators)
+                self._cut -= cut
+                self._event("partition", t, "heal", tuple(sorted(cut)))
+                for w in self.partition_windows:
+                    if w["t_heal"] is None and w["cut"] == sorted(cut):
+                        w["t_heal"], w["h_heal"] = t, height
+                self._start_catchup()
+        for c in list(self._crashes):
+            if height >= c.at_h:
+                self._crashes.remove(c)
+                self._active_crashes.append(c)
+                self._crashed.add(c.node)
+                self._event("crash", t, c.node)
+        for c in list(self._active_crashes):
+            if height >= c.restart_h:
+                self._active_crashes.remove(c)
+                self._crashed.discard(c.node)
+                self._event("restart", t, c.node)
+                self._start_catchup()
+        while self._height_hooks and height >= self._height_hooks[0][0]:
+            _h, fn = self._height_hooks.pop(0)
+            fn()
+        # a reachable node falling behind (byzantine self-wedge, lossy
+        # links, a queue storm) is fed the committed heights it missed —
+        # the standing stand-in for the fast-sync reactor, not just a
+        # post-heal courtesy
+        if self._lagging():
+            self._start_catchup()
+
+    # -- catchup replay ----------------------------------------------------
+
+    def _lagging(self) -> List[int]:
+        out = []
+        for i, cs in enumerate(self.nodes):
+            if i in self._crashed or (self._cut and i in self._cut):
+                continue
+            if cs.state.last_block_height < self.net_height:
+                out.append(i)
+        return out
+
+    def _start_catchup(self) -> None:
+        if self._catchup_timer is None:
+            self._catchup_timer = self.clock.call_later(
+                self.schedule.quantum_ms / 1000.0, self._catchup_tick
+            )
+
+    def _catchup_tick(self) -> None:
+        self._catchup_timer = None
+        now = self.clock.time_ns()
+        laggards = self._lagging()
+        for i in laggards:
+            cs = self.nodes[i]
+            h = cs.state.last_block_height + 1
+            last = self._last_fed.get(i)
+            if last is not None and last[0] == h and now - last[1] < int(2e9):
+                continue  # already fed this height recently; let it chew
+            donor = next(
+                (
+                    j
+                    for j, store in enumerate(self.block_stores)
+                    if j != i and j not in self._crashed and store.height >= h
+                ),
+                None,
+            )
+            if donor is None:
+                continue
+            store = self.block_stores[donor]
+            seen = store.load_seen_commit(h)
+            if seen is None:
+                continue
+            self._last_fed[i] = (h, now)
+            self._event("catchup", now, i, h)
+            # precommits first (the laggard enters commit and allocates
+            # the PartSet from the majority header), then the parts
+            for idx, cs_sig in enumerate(seen.signatures):
+                if cs_sig.absent_():
+                    continue
+                vote = Vote(
+                    vote_type=signbytes.PRECOMMIT_TYPE,
+                    height=h,
+                    round=seen.round,
+                    block_id=cs_sig.block_id(seen.block_id),
+                    timestamp_ns=cs_sig.timestamp_ns,
+                    validator_address=cs_sig.validator_address,
+                    validator_index=idx,
+                    signature=cs_sig.signature,
+                )
+                # attributed to the validator's own node (validators are
+                # nodes 0..V-1): per-peer catchup-round quotas apply as
+                # they would to live gossip
+                self._schedule_delivery(
+                    now + self._quantum_ns, idx, i, VoteMessage(vote)
+                )
+            for k in range(seen.block_id.parts.total):
+                part = store.load_block_part(h, k)
+                if part is None:
+                    break
+                self._schedule_delivery(
+                    now + 2 * self._quantum_ns,
+                    donor,
+                    i,
+                    BlockPartMessage(h, seen.round, part),
+                )
+        if self._lagging():
+            self._catchup_timer = self.clock.call_later(
+                CATCHUP_TICK_S, self._catchup_tick
+            )
+
+    # -- stats -------------------------------------------------------------
+
+    def stats(self) -> Dict[str, float]:
+        return {
+            "deliveries": self.deliveries,
+            "drops": self.drops,
+            "preverified_rows": self.preverified_rows,
+            "preverify_skips": self.preverify_skips,
+            "net_height": self.net_height,
+            "pending": len(self._heap),
+            "crashed": len(self._crashed),
+            "cut": len(self._cut),
+        }

@@ -1,0 +1,329 @@
+"""The scenario corpus: replayable runs with pinned expected outcomes.
+
+A scenario is a DATA file (``sim/scenarios/*.scn``, ``key = value``
+lines) naming a network shape, a seeded schedule, and the outcomes the
+repo claims for it — safety ("never two commits at one height"),
+liveness ("every reachable node reaches the target"), recovery bounds
+in *simulated* seconds. ``run_scenario`` executes one and returns the
+failures (empty = the claim holds); tests/test_sim.py runs the corpus
+at small node counts in tier-1 and at 256–1000 nodes under ``slow``.
+
+docs/ liveness/safety claims pin to these files via the
+``scenario-coherence`` lint rule (docs/static-analysis.md): a tagged
+claim must name a file that exists here, so a claim can never outlive
+its rig.
+
+File format (docs/simulator.md, scenario-corpus section):
+
+    name       = partition-at-commit
+    nodes      = 8          # total node count (env-overridable)
+    validators = 8          # first V nodes validate
+    heights    = 12         # target committed height
+    seed       = 42
+    schedule   = partition:at_h=5,heal_h=8,frac=0.33
+    app        = kvstore    # or persistent_kvstore (valset rotation)
+    rotate     = at_h=4,validator=2,power=25   # optional val: tx burst
+    expect     = safety;liveness;recovery_within_s=30
+
+Size overrides: ``run_scenario(..., nodes=256)`` or the ``TM_SIM_*``
+env knobs (``TM_SIM_NODES``, ``TM_SIM_VALIDATORS``,
+``TM_SIM_HEIGHTS``, ``TM_SIM_SEED`` — docs/running-in-production.md)
+scale a scenario without editing it; expectations are evaluated the
+same way at every size.
+"""
+
+from __future__ import annotations
+
+import base64
+import os
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+from tendermint_tpu.sim.core import SimResult, Simulation
+from tendermint_tpu.sim.schedule import ScheduleError, parse_schedule
+
+_KNOWN_KEYS = {
+    "name", "nodes", "validators", "heights", "seed", "schedule",
+    "expect", "app", "rotate", "max_sim_s", "notes",
+}
+_KNOWN_EXPECT = {
+    "safety", "liveness", "majority_advances", "txs_committed",
+    "rotation_applied",
+}
+_APPS = {"kvstore", "persistent_kvstore"}
+
+
+def scenarios_dir() -> str:
+    return os.path.join(os.path.dirname(os.path.abspath(__file__)), "scenarios")
+
+
+def list_scenarios() -> List[str]:
+    d = scenarios_dir()
+    return sorted(f for f in os.listdir(d) if f.endswith(".scn"))
+
+
+@dataclass
+class Scenario:
+    name: str
+    nodes: int
+    validators: int
+    heights: int
+    seed: int
+    schedule: str
+    expect: List[str]
+    app: str = "kvstore"
+    rotate: Optional[Dict[str, int]] = None
+    max_sim_s: float = 600.0
+    path: str = ""
+    notes: str = ""
+    extras: Dict[str, str] = field(default_factory=dict)
+
+
+def load_scenario(path_or_name: str) -> Scenario:
+    """Parse + validate one scenario file; like the schedule grammar,
+    the whole file is validated before anything runs — an unknown key,
+    expectation, or schedule item is a ValueError here, not a silently
+    inert scenario."""
+    path = path_or_name
+    if not os.path.sep in path and not os.path.exists(path):
+        path = os.path.join(scenarios_dir(), path_or_name)
+    if not path.endswith(".scn"):
+        path += ".scn"
+    with open(path, encoding="utf-8") as fp:
+        raw = fp.read()
+    kv: Dict[str, str] = {}
+    for lineno, line in enumerate(raw.splitlines(), 1):
+        line = line.split("#", 1)[0].strip()
+        if not line:
+            continue
+        k, eq, v = line.partition("=")
+        k, v = k.strip(), v.strip()
+        if not eq or not k or not v:
+            raise ValueError(f"{path}:{lineno}: want 'key = value', got {line!r}")
+        if k not in _KNOWN_KEYS:
+            raise ValueError(f"{path}:{lineno}: unknown scenario key {k!r}")
+        if k in kv:
+            raise ValueError(f"{path}:{lineno}: duplicate key {k!r}")
+        kv[k] = v
+
+    def _int(key: str, default: Optional[int] = None) -> int:
+        if key not in kv:
+            if default is None:
+                raise ValueError(f"{path}: missing required key {key!r}")
+            return default
+        try:
+            return int(kv[key])
+        except ValueError:
+            raise ValueError(f"{path}: {key} is not an integer")
+
+    expect = [e.strip() for e in kv.get("expect", "").split(";") if e.strip()]
+    if not expect:
+        raise ValueError(f"{path}: a scenario must pin at least one expectation")
+    for e in expect:
+        base = e.split("=", 1)[0]
+        if base not in _KNOWN_EXPECT and base != "recovery_within_s":
+            raise ValueError(f"{path}: unknown expectation {e!r}")
+    app = kv.get("app", "kvstore")
+    if app not in _APPS:
+        raise ValueError(f"{path}: unknown app {app!r} (want one of {sorted(_APPS)})")
+    rotate = None
+    if "rotate" in kv:
+        rotate = {}
+        for pair in kv["rotate"].split(","):
+            k, eq, v = pair.partition("=")
+            if not eq:
+                raise ValueError(f"{path}: malformed rotate pair {pair!r}")
+            try:
+                rotate[k.strip()] = int(v)
+            except ValueError:
+                raise ValueError(f"{path}: rotate {k.strip()} is not an integer")
+        missing = {"at_h", "validator", "power"} - set(rotate)
+        if missing:
+            raise ValueError(f"{path}: rotate missing keys {sorted(missing)}")
+        if app != "persistent_kvstore":
+            raise ValueError(f"{path}: rotate requires app = persistent_kvstore")
+    sc = Scenario(
+        name=kv.get("name", os.path.basename(path)[:-4]),
+        nodes=_int("nodes"),
+        validators=_int("validators", _int("nodes")),
+        heights=_int("heights"),
+        seed=_int("seed", 0),
+        schedule=kv.get("schedule", ""),
+        expect=expect,
+        app=app,
+        rotate=rotate,
+        max_sim_s=float(kv.get("max_sim_s", 600.0)),
+        path=path,
+        notes=kv.get("notes", ""),
+    )
+    try:
+        parse_schedule(sc.schedule)
+    except ScheduleError as e:
+        raise ValueError(f"{path}: bad schedule: {e}") from e
+    if sc.rotate is not None and not 0 <= sc.rotate["validator"] < sc.validators:
+        raise ValueError(f"{path}: rotate validator index out of range")
+    return sc
+
+
+# -- execution ---------------------------------------------------------------
+
+
+def _env_int(name: str) -> Optional[int]:
+    v = os.environ.get(name)
+    return int(v) if v else None
+
+
+def build_simulation(
+    sc: Scenario,
+    nodes: Optional[int] = None,
+    validators: Optional[int] = None,
+    heights: Optional[int] = None,
+    seed: Optional[int] = None,
+    record_events: Optional[bool] = None,
+    max_sim_s: Optional[float] = None,
+    traced: bool = False,
+) -> Simulation:
+    """A Simulation for ``sc`` with explicit overrides beating the
+    ``TM_SIM_*`` env knobs beating the file."""
+    n_nodes = nodes or _env_int("TM_SIM_NODES") or sc.nodes
+    n_vals = validators or _env_int("TM_SIM_VALIDATORS") or min(sc.validators, n_nodes)
+    n_heights = heights or _env_int("TM_SIM_HEIGHTS") or sc.heights
+    if seed is None:
+        seed = _env_int("TM_SIM_SEED")  # 0 is a valid seed: None-check, not `or`
+    run_seed = seed if seed is not None else sc.seed
+    if record_events is None:
+        record_events = n_nodes <= 64  # big runs keep only the digest
+    app_factory = None
+    if sc.app == "persistent_kvstore":
+        from tendermint_tpu.abci.examples.kvstore import PersistentKVStoreApplication
+
+        app_factory = PersistentKVStoreApplication
+
+    on_built = None
+    if sc.rotate is not None:
+        rot = dict(sc.rotate)
+
+        def on_built(sim: Simulation) -> None:
+            sim.net.add_height_hook(rot["at_h"], lambda: _inject_rotation(sim, rot))
+
+    return Simulation(
+        n_nodes=n_nodes,
+        validators=n_vals,
+        heights=n_heights,
+        schedule=sc.schedule,
+        seed=run_seed,
+        app_factory=app_factory,
+        record_events=record_events,
+        max_sim_s=max_sim_s if max_sim_s is not None else sc.max_sim_s,
+        on_built=on_built,
+        traced=traced,
+    )
+
+
+def _inject_rotation(sim: Simulation, rot: Dict[str, int]) -> None:
+    """Broadcast the ``val:<pubkeyB64>!<power>`` tx (the
+    persistent_kvstore validator-update format) into every mempool."""
+    from tendermint_tpu.crypto.keys import encode_pubkey
+
+    pv = sim.privs[rot["validator"]]
+    # registry wire encoding (crypto/keys.encode_pubkey): EndBlock
+    # validator updates round-trip through decode_pubkey
+    pk_b64 = base64.b64encode(encode_pubkey(pv.get_pub_key())).decode()
+    tx = f"val:{pk_b64}!{rot['power']}".encode()
+    sim.net._event("rotate", sim.clock.time_ns(), rot["validator"], rot["power"])
+
+    async def _push() -> None:
+        for node in sim.nodes:
+            try:
+                await node.mempool.check_tx(tx)
+            except Exception:
+                pass
+
+    import asyncio
+
+    task = asyncio.get_running_loop().create_task(_push())
+    sim._bg.add(task)
+    task.add_done_callback(sim._bg.discard)
+
+
+def evaluate(sc: Scenario, sim: Simulation, res: SimResult) -> List[str]:
+    """The pinned expected outcomes. Returns failure strings (empty =
+    scenario holds)."""
+    fails: List[str] = []
+    net = sim.net
+    for e in sc.expect:
+        base, _, arg = e.partition("=")
+        if base == "safety":
+            if not res.safety_ok():
+                bad = {h: s for h, s in res.chain_hashes().items() if len(s) > 1}
+                fails.append(f"safety violated: conflicting commits at {sorted(bad)}")
+        elif base == "liveness":
+            if not res.completed:
+                fails.append(
+                    f"liveness violated: run {'timed out' if res.timed_out else 'wedged'} "
+                    f"at net height {net.net_height} (heights: {_spread(res)})"
+                )
+        elif base == "majority_advances":
+            for w in net.partition_windows:
+                t_end = w["t_heal"] if w["t_heal"] is not None else float("inf")
+                cut = set(w["cut"])
+                ok = any(
+                    h > w["h_on"] and w["t_on"] <= t <= t_end
+                    for node, per in net.commit_times.items()
+                    if node not in cut
+                    for h, t in per.items()
+                )
+                if not ok:
+                    fails.append(
+                        f"majority side committed nothing during partition at h{w['h_on']}"
+                    )
+        elif base == "recovery_within_s":
+            bound_ns = int(float(arg) * 1e9)
+            for w in net.partition_windows:
+                if w["t_heal"] is None:
+                    fails.append(f"partition at h{w['h_on']} never healed")
+                    continue
+                for node in w["cut"]:
+                    t_rec = net.commit_times.get(node, {}).get(w["h_heal"])
+                    if t_rec is None or t_rec > w["t_heal"] + bound_ns:
+                        got = (
+                            f"{(t_rec - w['t_heal']) / 1e9:.2f}s"
+                            if t_rec is not None
+                            else f"never (at h{res.heights.get(node)})"
+                        )
+                        fails.append(
+                            f"node{node} did not recover to h{w['h_heal']} within "
+                            f"{arg}s of heal: {got}"
+                        )
+        elif base == "txs_committed":
+            if net.txs_committed <= 0:
+                fails.append("no transactions were committed")
+        elif base == "rotation_applied":
+            rot = sc.rotate or {}
+            pv = sim.privs[rot.get("validator", 0)]
+            addr = pv.address()
+            want = rot.get("power")
+            for i, node in enumerate(sim.nodes):
+                _, val = node.cs.state.validators.get_by_address(addr)
+                got = val.voting_power if val is not None else 0
+                if got != want:
+                    fails.append(
+                        f"node{i}: rotated validator power {got} != {want}"
+                    )
+                    break
+    return fails
+
+
+def _spread(res: SimResult) -> str:
+    hs = sorted(res.heights.values())
+    return f"min {hs[0]} / max {hs[-1]}" if hs else "none"
+
+
+def run_scenario(
+    path_or_name: str, **overrides
+) -> Tuple[Scenario, Simulation, SimResult, List[str]]:
+    """Load, run, evaluate. Returns (scenario, sim, result, failures)."""
+    sc = load_scenario(path_or_name)
+    sim = build_simulation(sc, **overrides)
+    res = sim.run()
+    return sc, sim, res, evaluate(sc, sim, res)

@@ -1,0 +1,348 @@
+"""The fault-schedule grammar: network behavior as data.
+
+Same design as the ``TM_FAULTS`` grammar (utils/faultinject.py,
+docs/robustness.md): a one-line, ``;``-separated spec, parsed and
+validated UP FRONT — a typo is a ``ValueError`` at parse time, never a
+schedule item that silently does nothing — and fully deterministic:
+the same spec + seed + node count produces the same bound schedule,
+byte for byte.
+
+Grammar (documented with worked examples in docs/simulator.md):
+
+    item      = selector [":" verb] ":" kv ["," kv]* (":"-separated groups ok)
+    schedule  = item [";" item]*
+
+    link(A,B):delay:ms=80,jitter_ms=20   # latency for A->B traffic
+    link(*,*):loss:p=0.01                # seeded random drop
+    partition:at_h=12,heal_h=15,frac=0.33
+    partition:at_h=12,heal_h=15,cut=5-7|12
+    crash:node=7,at_h=20,restart_h=24    # isolation-crash + rejoin
+    byz:node=0,kind=double_sign,at_h=2   # or kind=amnesia
+    load:txs=64,at_h=3,size=32           # flash-crowd tx burst
+    quantum:ms=1                         # delivery-time quantization
+
+Node selectors: ``*`` (all), ``7`` (one), ``0-5`` (range, inclusive),
+unions with ``|`` (``0-2|7``). ``link`` rules are evaluated last-match-
+wins, over a built-in default of 10 ms / 0 jitter / 0 loss.
+
+``partition ... frac=F`` cuts a deterministic proportional slice: the
+LAST ``floor(F*V)`` validators plus the last ``round(F*(N-V))``
+non-validator nodes — no RNG, so "33% partition" can never cut a
+validator supermajority by seed luck (``floor(F*V) < V/3`` whenever
+``F < 1/3``).
+
+Height triggers (``at_h``/``heal_h``/``restart_h``) fire when the
+*network height* — the maximum committed height across nodes — first
+reaches the value: "partition at commit of height 12" in the ISSUE's
+sense.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Set, Tuple
+
+DEFAULT_DELAY_MS = 10.0
+DEFAULT_QUANTUM_MS = 1.0
+
+_VERBS = {"link", "partition", "crash", "byz", "load", "quantum"}
+_BYZ_KINDS = {"double_sign", "amnesia"}
+
+
+class ScheduleError(ValueError):
+    """Malformed or out-of-range schedule spec."""
+
+
+def _parse_float(item: str, kv: Dict[str, str], key: str, default: float) -> float:
+    try:
+        return float(kv.pop(key)) if key in kv else default
+    except ValueError:
+        raise ScheduleError(f"{item!r}: {key} is not a number")
+
+
+def _parse_int(item: str, kv: Dict[str, str], key: str, default: Optional[int]) -> Optional[int]:
+    if key not in kv:
+        if default is None:
+            raise ScheduleError(f"{item!r}: missing required key {key}=")
+        return default
+    try:
+        return int(kv.pop(key))
+    except ValueError:
+        raise ScheduleError(f"{item!r}: {key} is not an integer")
+
+
+def _parse_group(spec: str) -> Tuple[Tuple[int, int], ...]:
+    """``*`` | ``3`` | ``0-5`` | unions with ``|`` -> (lo, hi) ranges.
+    ``*`` is the open range (0, -1) resolved at bind time."""
+    spec = spec.strip()
+    if spec == "*":
+        return ((0, -1),)
+    out = []
+    for part in spec.split("|"):
+        part = part.strip()
+        if "-" in part:
+            lo_s, _, hi_s = part.partition("-")
+            try:
+                lo, hi = int(lo_s), int(hi_s)
+            except ValueError:
+                raise ScheduleError(f"bad node range {part!r}")
+        else:
+            try:
+                lo = hi = int(part)
+            except ValueError:
+                raise ScheduleError(f"bad node index {part!r}")
+        if lo < 0 or hi < lo:
+            raise ScheduleError(f"bad node range {part!r}")
+        out.append((lo, hi))
+    return tuple(out)
+
+
+def _resolve_group(ranges: Tuple[Tuple[int, int], ...], n: int, item: str) -> Set[int]:
+    out: Set[int] = set()
+    for lo, hi in ranges:
+        if hi == -1:  # '*'
+            out.update(range(n))
+            continue
+        if hi >= n:
+            raise ScheduleError(f"{item!r}: node index {hi} out of range (n={n})")
+        out.update(range(lo, hi + 1))
+    return out
+
+
+@dataclass
+class LinkRule:
+    src: Tuple[Tuple[int, int], ...]
+    dst: Tuple[Tuple[int, int], ...]
+    delay_ms: Optional[float] = None
+    jitter_ms: Optional[float] = None
+    loss_p: Optional[float] = None
+
+    def matches(self, a: int, b: int) -> bool:
+        return _in(self.src, a) and _in(self.dst, b)
+
+
+def _in(ranges: Tuple[Tuple[int, int], ...], i: int) -> bool:
+    return any(hi == -1 or lo <= i <= hi for lo, hi in ranges)
+
+
+@dataclass
+class PartitionEvent:
+    at_h: int
+    heal_h: int
+    frac: Optional[float] = None
+    cut: Optional[Tuple[Tuple[int, int], ...]] = None
+    item: str = ""
+
+    def cut_set(self, n_nodes: int, n_validators: int) -> Set[int]:
+        if self.cut is not None:
+            return _resolve_group(self.cut, n_nodes, self.item)
+        f = float(self.frac or 0.0)
+        v = n_validators
+        cut_v = int(f * v)  # floor: < v/3 whenever f < 1/3
+        cut_o = round(f * (n_nodes - v))
+        out = set(range(v - cut_v, v))
+        out.update(range(n_nodes - cut_o, n_nodes))
+        return out
+
+
+@dataclass
+class CrashEvent:
+    node: int
+    at_h: int
+    restart_h: int
+    item: str = ""
+
+
+@dataclass
+class ByzEvent:
+    node: int
+    kind: str
+    at_h: int = 1
+    item: str = ""
+
+
+@dataclass
+class LoadEvent:
+    txs: int
+    at_h: int
+    size: int = 32
+    item: str = ""
+
+
+@dataclass
+class Schedule:
+    """A parsed (unbound) schedule. ``bind(n_nodes, n_validators)``
+    validates node references against the actual run size."""
+
+    spec: str = ""
+    links: List[LinkRule] = field(default_factory=list)
+    partitions: List[PartitionEvent] = field(default_factory=list)
+    crashes: List[CrashEvent] = field(default_factory=list)
+    byz: List[ByzEvent] = field(default_factory=list)
+    loads: List[LoadEvent] = field(default_factory=list)
+    quantum_ms: float = DEFAULT_QUANTUM_MS
+
+    def bind(self, n_nodes: int, n_validators: int) -> None:
+        """Validate every node reference against the run size (raises
+        ScheduleError) — schedule problems surface before the first
+        simulated nanosecond."""
+        for p in self.partitions:
+            cut = p.cut_set(n_nodes, n_validators)
+            if not cut or len(cut) >= n_nodes:
+                raise ScheduleError(
+                    f"{p.item!r}: partition cuts {len(cut)}/{n_nodes} nodes"
+                )
+            if p.heal_h <= p.at_h:
+                raise ScheduleError(f"{p.item!r}: heal_h must be > at_h")
+        for i, a in enumerate(self.partitions):
+            for b in self.partitions[i + 1:]:
+                if a.at_h < b.heal_h and b.at_h < a.heal_h:
+                    # SimNet models ONE flat cut set; two concurrent
+                    # partitions would silently merge into the wrong
+                    # topology — reject up front instead
+                    raise ScheduleError(
+                        f"overlapping partition windows {a.item!r} and "
+                        f"{b.item!r}: concurrent partitions are not "
+                        "modeled (sequence them instead)"
+                    )
+        for c in self.crashes:
+            if c.node >= n_nodes:
+                raise ScheduleError(f"{c.item!r}: node {c.node} out of range")
+            if c.restart_h <= c.at_h:
+                raise ScheduleError(f"{c.item!r}: restart_h must be > at_h")
+        for b in self.byz:
+            if b.node >= n_validators:
+                raise ScheduleError(
+                    f"{b.item!r}: byzantine node {b.node} is not a validator "
+                    f"(validators are 0..{n_validators - 1})"
+                )
+        for rule in self.links:
+            for ranges in (rule.src, rule.dst):
+                _resolve_group(ranges, n_nodes, self.spec)
+
+    def link_params(self, a: int, b: int) -> Tuple[float, float, float]:
+        """(delay_ms, jitter_ms, loss_p) for a->b: defaults overridden
+        by matching rules in order (last match wins per field)."""
+        delay, jitter, loss = DEFAULT_DELAY_MS, 0.0, 0.0
+        for rule in self.links:
+            if rule.matches(a, b):
+                if rule.delay_ms is not None:
+                    delay = rule.delay_ms
+                if rule.jitter_ms is not None:
+                    jitter = rule.jitter_ms
+                if rule.loss_p is not None:
+                    loss = rule.loss_p
+        return delay, jitter, loss
+
+
+def parse_schedule(spec: str) -> Schedule:
+    """Parse a schedule spec; the WHOLE string is validated before
+    anything is returned (the faultinject.configure atomicity rule)."""
+    sched = Schedule(spec=spec or "")
+    if not spec or not spec.strip():
+        return sched
+    for raw in spec.split(";"):
+        item = raw.strip()
+        if not item:
+            continue
+        segs = [s.strip() for s in item.split(":")]
+        head = segs[0]
+        verb = head.split("(", 1)[0]
+        if verb not in _VERBS:
+            raise ScheduleError(
+                f"unknown schedule verb {verb!r} in {item!r} "
+                f"(known: {', '.join(sorted(_VERBS))})"
+            )
+        # collect k=v pairs from the remaining segments; a lone non-kv
+        # segment is the sub-verb (link's delay/loss)
+        sub = None
+        kv: Dict[str, str] = {}
+        for seg in segs[1:]:
+            if "=" not in seg:
+                if sub is not None or not seg:
+                    raise ScheduleError(f"malformed segment {seg!r} in {item!r}")
+                sub = seg
+                continue
+            for pair in seg.split(","):
+                k, eq, v = pair.partition("=")
+                k, v = k.strip(), v.strip()
+                if not eq or not k or not v:
+                    raise ScheduleError(f"malformed key=value {pair!r} in {item!r}")
+                if k in kv:
+                    raise ScheduleError(f"duplicate key {k!r} in {item!r}")
+                kv[k] = v
+
+        if verb == "link":
+            if not head.endswith(")") or "(" not in head:
+                raise ScheduleError(f"{item!r}: want link(SRC,DST)")
+            inner = head[len("link("):-1]
+            src_s, comma, dst_s = inner.partition(",")
+            if not comma:
+                raise ScheduleError(f"{item!r}: want link(SRC,DST)")
+            rule = LinkRule(src=_parse_group(src_s), dst=_parse_group(dst_s))
+            if sub == "delay":
+                rule.delay_ms = _parse_float(item, kv, "ms", DEFAULT_DELAY_MS)
+                rule.jitter_ms = _parse_float(item, kv, "jitter_ms", 0.0)
+            elif sub == "loss":
+                rule.loss_p = _parse_float(item, kv, "p", 0.0)
+                if not 0.0 <= rule.loss_p <= 1.0:
+                    raise ScheduleError(f"{item!r}: loss p must be in [0,1]")
+            else:
+                raise ScheduleError(
+                    f"{item!r}: link verb must be delay or loss, got {sub!r}"
+                )
+            sched.links.append(rule)
+        elif verb == "partition":
+            if sub is not None:
+                raise ScheduleError(f"{item!r}: partition takes no sub-verb")
+            ev = PartitionEvent(
+                at_h=_parse_int(item, kv, "at_h", None),
+                heal_h=_parse_int(item, kv, "heal_h", None),
+                item=item,
+            )
+            if "cut" in kv:
+                ev.cut = _parse_group(kv.pop("cut"))
+            else:
+                ev.frac = _parse_float(item, kv, "frac", 0.0)
+                if not 0.0 < ev.frac < 1.0:
+                    raise ScheduleError(f"{item!r}: partition needs frac in (0,1) or cut=")
+            sched.partitions.append(ev)
+        elif verb == "crash":
+            sched.crashes.append(
+                CrashEvent(
+                    node=_parse_int(item, kv, "node", None),
+                    at_h=_parse_int(item, kv, "at_h", None),
+                    restart_h=_parse_int(item, kv, "restart_h", None),
+                    item=item,
+                )
+            )
+        elif verb == "byz":
+            kind = kv.pop("kind", "")
+            if kind not in _BYZ_KINDS:
+                raise ScheduleError(
+                    f"{item!r}: byz kind must be one of {sorted(_BYZ_KINDS)}"
+                )
+            sched.byz.append(
+                ByzEvent(
+                    node=_parse_int(item, kv, "node", None),
+                    kind=kind,
+                    at_h=_parse_int(item, kv, "at_h", 1),
+                    item=item,
+                )
+            )
+        elif verb == "load":
+            sched.loads.append(
+                LoadEvent(
+                    txs=_parse_int(item, kv, "txs", None),
+                    at_h=_parse_int(item, kv, "at_h", None),
+                    size=_parse_int(item, kv, "size", 32),
+                    item=item,
+                )
+            )
+        elif verb == "quantum":
+            sched.quantum_ms = _parse_float(item, kv, "ms", DEFAULT_QUANTUM_MS)
+            if sched.quantum_ms <= 0:
+                raise ScheduleError(f"{item!r}: quantum ms must be positive")
+        if kv:
+            raise ScheduleError(f"unknown keys {sorted(kv)} in {item!r}")
+    return sched

@@ -1,0 +1,68 @@
+"""The message-routing seam: one wiring implementation for in-process
+consensus nets.
+
+``tests/cs_harness.py::wire_loopback`` and the simulator's
+:class:`~tendermint_tpu.sim.net.SimNet` used to be two copies of the
+same idea — intercept a node's ``send_internal`` and fan its messages
+out to peers. The seam lives here now: :func:`wire_mesh` installs the
+intercept, and a *transport* object decides what "fan out" means.
+:class:`LoopbackTransport` is the trivial zero-latency schedule (the
+harness behavior, byte-for-byte: synchronous ``put_nowait`` into every
+peer's input queue); ``SimNet`` is the same interface behind a seeded
+latency/loss/partition schedule and a clock.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, List, Optional
+
+from tendermint_tpu.consensus.messages import MsgInfo
+
+
+def default_peer_id(i: int) -> str:
+    """The harness convention: node ``i`` appears to peers as ``node<i>``."""
+    return f"node{i}"
+
+
+class LoopbackTransport:
+    """Zero-latency full mesh — every internal message a node emits is
+    delivered immediately to all other nodes (the reference
+    MakeConnectedSwitches stand-in, p2p/test_util.go:81)."""
+
+    def __init__(self, cs_list: List, peer_id: Optional[Callable[[int], str]] = None):
+        self.cs_list = list(cs_list)
+        self.peer_id = peer_id or default_peer_id
+
+    def broadcast(self, src: int, msg) -> None:
+        pid = self.peer_id(src)
+        for j, cs in enumerate(self.cs_list):
+            if j != src:
+                cs._queue.put_nowait(MsgInfo(msg, pid))
+
+
+def wire_mesh(cs_list: List, transport) -> None:
+    """Patch every node's ``send_internal`` so each internal message is
+    (1) delivered to the node itself and (2) handed to
+    ``transport.broadcast(src_index, msg)`` for the peers. The
+    transport owns delivery semantics — latency, loss, partitions, or
+    none at all.
+
+    A transport with ``delivers_self = True`` (SimNet) takes over the
+    self-delivery too: the node's own message rides the same scheduled
+    path (one delivery quantum, no loss/partition, peer id kept ``""``
+    so the internal fsync/halt semantics are untouched) — which lets
+    the net's shared pre-verification bundle cover the signer's own
+    inline verify as well."""
+    delivers_self = bool(getattr(transport, "delivers_self", False))
+    for i, cs in enumerate(cs_list):
+        orig = cs.send_internal
+
+        if delivers_self:
+            def send(msg, _i=i, _t=transport):
+                _t.broadcast(_i, msg)
+        else:
+            def send(msg, _orig=orig, _i=i, _t=transport):
+                _orig(msg)
+                _t.broadcast(_i, msg)
+
+        cs.send_internal = send

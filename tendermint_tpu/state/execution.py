@@ -57,6 +57,10 @@ class BlockExecutor:
         # ConsensusState so the ABCI deliver round trip shows up as its
         # own phase; None for fast-sync-only executors
         self.ledger = None
+        # signature dedupe cache fronting LastCommit verification in
+        # validate_block (attached by ConsensusState; None = verify
+        # every row, the fast-sync executors' behavior)
+        self.sig_cache = None
         self.logger = logger or get_logger("state")
 
     def store(self) -> StateStore:
@@ -80,18 +84,26 @@ class BlockExecutor:
         return block, block.make_part_set()
 
     def validate_block(self, state: State, block: Block) -> None:
-        validate_block(state, block, verifier=self._verifier)
+        validate_block(
+            state, block, verifier=self._verifier, sig_cache=self.sig_cache
+        )
 
     # -- apply (reference ApplyBlock state/execution.go:126) ---------------
 
     async def apply_block(
-        self, state: State, block_id: BlockID, block: Block
+        self, state: State, block_id: BlockID, block: Block,
+        pre_validated: bool = False,
     ) -> Tuple[State, int]:
         """Validate, execute and commit `block` against `state`. Returns
-        (new_state, retain_height). Raises on invalid blocks or app crash."""
+        (new_state, retain_height). Raises on invalid blocks or app crash.
+        ``pre_validated=True`` skips the validation pass — for callers
+        that just ran validate_block on the SAME (state, block) pair in
+        the same step (consensus finalize validates first as its own
+        crash point)."""
         t0 = time.perf_counter()
         await faults.maybe_async("exec.apply")
-        self.validate_block(state, block)
+        if not pre_validated:
+            self.validate_block(state, block)
 
         # height-ledger sub-phase (consensus/ledger.py, wired by
         # ConsensusState): the full BeginBlock→DeliverTx×N→EndBlock
@@ -105,7 +117,15 @@ class BlockExecutor:
                 "exec.deliver", height=block.header.height, txs=len(block.data.txs)
             ):
                 abci_responses = await exec_block_on_proxy_app(
-                    self.logger, self._app, block, self._store, state.initial_height()
+                    self.logger, self._app, block, self._store,
+                    state.initial_height(),
+                    # the LastCommit's voters ARE this state's
+                    # last_validators — saves a store decode per block
+                    last_validators=(
+                        state.last_validators
+                        if block.header.height == state.last_block_height + 1
+                        else None
+                    ),
                 )
         finally:
             if ledger is not None:
@@ -224,13 +244,16 @@ class BlockExecutor:
 
 
 async def exec_block_on_proxy_app(
-    logger, app_conn: ABCIClient, block: Block, store, initial_height: int
+    logger, app_conn: ABCIClient, block: Block, store, initial_height: int,
+    last_validators=None,
 ) -> ABCIResponses:
     """BeginBlock → pipelined DeliverTx×N → EndBlock (reference
     execBlockOnProxyApp state/execution.go:250-307). DeliverTx requests are
     submitted without awaiting -- the asyncio equivalent of the
     reference's async pipeline on the socket client."""
-    commit_info, byz_vals = get_begin_block_validator_info(block, store, initial_height)
+    commit_info, byz_vals = get_begin_block_validator_info(
+        block, store, initial_height, last_validators=last_validators
+    )
 
     begin = await app_conn.begin_block_sync(
         abci.RequestBeginBlock(
@@ -267,13 +290,20 @@ async def exec_block_on_proxy_app(
 
 
 def get_begin_block_validator_info(
-    block: Block, store, initial_height: int
+    block: Block, store, initial_height: int, last_validators=None
 ) -> Tuple[abci.LastCommitInfo, List[abci.EvidenceInfo]]:
     """Build LastCommitInfo + byzantine validators for BeginBlock
-    (reference getBeginBlockValidatorInfo state/execution.go:310)."""
+    (reference getBeginBlockValidatorInfo state/execution.go:310).
+    ``last_validators`` skips the store round trip when the caller
+    already holds the set that signed the LastCommit (apply_block's
+    state.last_validators — read-only use, never mutated here)."""
     votes: List[abci.VoteInfo] = []
     if block.header.height > initial_height and store is not None:
-        last_vals = store.load_validators(block.header.height - 1)
+        last_vals = (
+            last_validators
+            if last_validators is not None
+            else store.load_validators(block.header.height - 1)
+        )
         if last_vals is not None and block.last_commit is not None:
             for i, cs in enumerate(block.last_commit.signatures):
                 _, val = last_vals.get_by_index(i)
@@ -281,7 +311,8 @@ def get_begin_block_validator_info(
                     continue
                 votes.append(
                     abci.VoteInfo(
-                        validator=abci.Validator(val.pub_key.address(), val.voting_power),
+                        # val.address is the precomputed pubkey address
+                        validator=abci.Validator(val.address, val.voting_power),
                         signed_last_block=not cs.absent_(),
                     )
                 )

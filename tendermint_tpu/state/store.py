@@ -131,11 +131,34 @@ class StateStore:
         else:
             w.write_bool(False)
         self._db.set(_vals_key(height), w.bytes())
+        cache = getattr(self, "_valset_cache", None)
+        if cache is not None:
+            cache.pop(height, None)  # overwrite: drop any stale decode
 
     def load_validators(self, height: int) -> Optional[ValidatorSet]:
         """Validator set that validated block `height` (reference
         LoadValidators state/store.go:298 incl. pointer-chase +
-        proposer-priority recompute)."""
+        proposer-priority recompute).
+
+        A small decode cache fronts the DB: block execution loads the
+        previous height's set every block (BeginBlock vote info), and
+        the decode + sort + priority recompute dominated large-net
+        profiles. Callers get a fresh copy() so mutations never leak
+        into the cache; save_validators for a height invalidates it."""
+        cache = getattr(self, "_valset_cache", None)
+        if cache is None:
+            cache = self._valset_cache = {}
+        hit = cache.get(height)
+        if hit is not None:
+            return hit.copy()
+        out = self._load_validators_uncached(height)
+        if out is not None:
+            if len(cache) > 8:  # the pattern is "previous height": tiny window
+                cache.clear()
+            cache[height] = out.copy()
+        return out
+
+    def _load_validators_uncached(self, height: int) -> Optional[ValidatorSet]:
         raw = self._db.get(_vals_key(height))
         if raw is None:
             return None

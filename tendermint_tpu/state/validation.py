@@ -30,7 +30,7 @@ def max_evidence_per_block(max_bytes: int):
     return max_ev_bytes // MAX_EVIDENCE_BYTES, max_ev_bytes
 
 
-def validate_block(state: State, block: Block, verifier=None) -> None:
+def validate_block(state: State, block: Block, verifier=None, sig_cache=None) -> None:
     """Reference validateBlock state/validation.go:17. Raises
     ValidationError / commit-verification errors."""
     err = block.validate_basic()
@@ -85,13 +85,16 @@ def validate_block(state: State, block: Block, verifier=None) -> None:
                 f"invalid block commit size: expected {state.last_validators.size()}, "
                 f"got {len(block.last_commit.signatures)}"
             )
-        # ★ batched device verification (state/validation.go:92)
+        # ★ batched device verification (state/validation.go:92) with a
+        # SigCache front: the LastCommit's votes were already verified at
+        # ingest, and this validation runs up to 3x per height
         state.last_validators.verify_commit(
             state.chain_id,
             state.last_block_id,
             block.header.height - 1,
             block.last_commit,
             provider=verifier,
+            sig_cache=sig_cache,
         )
 
     # proposer must be in the current validator set (state/validation.go:141)

@@ -184,6 +184,22 @@ class Commit:
 
     _hash: Optional[bytes] = field(default=None, repr=False, compare=False)
 
+    def __deepcopy__(self, memo):
+        """Deep copies get a MEMO-FREE commit: the hash / encode /
+        validate / row-key caches assume immutability, and the one
+        legitimate reason to deep-copy a commit is to build a variant
+        (tests tamper with signatures; evidence construction mutates) —
+        a carried row-key cache on a then-mutated copy could otherwise
+        vouch for bytes that were never verified."""
+        import copy as _copy
+
+        return Commit(
+            height=self.height,
+            round=self.round,
+            block_id=_copy.deepcopy(self.block_id, memo),
+            signatures=_copy.deepcopy(self.signatures, memo),
+        )
+
     def vote_sign_bytes(self, chain_id: str, idx: int) -> bytes:
         """Canonical sign-bytes for signature `idx` (reference
         Commit.VoteSignBytes types/block.go:637). Fixed 160-byte layout --
@@ -215,7 +231,15 @@ class Commit:
         (ops/ed25519.materialize_sign_bytes) so per-row H2D carries 12
         bytes instead of 160; sign_bytes_matrix() is the host-side
         materialization of the same parts. Absent rows get tmpl_idx 1 —
-        callers filter them before verification."""
+        callers filter them before verification.
+
+        Memoized per chain id: the same commit is re-verified at every
+        validation pass (prevote / lock / finalize all validate the
+        block), and signatures are never mutated after construction —
+        hash() relies on the same immutability."""
+        cached = getattr(self, "_parts_cache", None)
+        if cached is not None and cached[0] == chain_id:
+            return cached[1]
         import numpy as np
 
         n = len(self.signatures)
@@ -244,7 +268,9 @@ class Commit:
             [cs.block_id_flag for cs in self.signatures], dtype=np.uint8
         )
         tmpl_idx = (flags != BLOCK_ID_FLAG_COMMIT).astype(np.int32)
-        return templates, tmpl_idx, ts8
+        out = (templates, tmpl_idx, ts8)
+        self._parts_cache = (chain_id, out)
+        return out
 
     def sign_bytes_matrix(self, chain_id: str) -> "np.ndarray":
         """Vectorized canonical sign-bytes for ALL signatures at once:
@@ -303,6 +329,17 @@ class Commit:
         return self._hash
 
     def validate_basic(self) -> Optional[str]:
+        # memoized (commit immutable once assembled — same contract as
+        # hash()): every verify_commit pass re-runs these per-signature
+        # structural checks
+        cached = getattr(self, "_vb_cache", None)
+        if cached is not None:
+            return cached[0]
+        err = self._validate_basic_uncached()
+        self._vb_cache = (err,)
+        return err
+
+    def _validate_basic_uncached(self) -> Optional[str]:
         if self.height < 0:
             return "negative Height"
         if self.round < 0:
@@ -319,13 +356,20 @@ class Commit:
         return None
 
     def encode(self) -> bytes:
+        # memoized: commits are immutable once assembled (hash() shares
+        # the contract); block/state saves re-encode the same commit
+        enc = getattr(self, "_enc_cache", None)
+        if enc is not None:
+            return enc
         w = Writer()
         w.write_u64(self.height).write_i64(self.round)
         w.write_bytes(self.block_id.encode())
         w.write_uvarint(len(self.signatures))
         for cs in self.signatures:
             w.write_bytes(cs.encode())
-        return w.bytes()
+        enc = w.bytes()
+        self._enc_cache = enc
+        return enc
 
     @classmethod
     def decode(cls, data: bytes) -> "Commit":
@@ -533,10 +577,22 @@ class Block:
     last_commit: Optional[Commit]
 
     def hash(self) -> Optional[bytes]:
+        # Memoized after the first complete hash: a block is immutable
+        # once assembled (the reference re-derives it per call, but a
+        # 256-node simulation hashes the same decoded block ~10x per
+        # node on the validate/commit path). fill_header() is keyed on
+        # the same completeness check, so a cached hash can only exist
+        # for a filled header.
+        h = getattr(self, "_hash_cache", None)
+        if h is not None:
+            return h
         if self.last_commit is None and self.header.height > 1:
             return None
         self.fill_header()
-        return self.header.hash()
+        h = self.header.hash()
+        if h is not None:
+            self._hash_cache = h
+        return h
 
     def fill_header(self) -> None:
         """Populate derived header hashes (reference Block.fillHeader
@@ -550,6 +606,16 @@ class Block:
             h.evidence_hash = self.evidence.hash()
 
     def validate_basic(self) -> Optional[str]:
+        # memoized like hash(): blocks are immutable once assembled, and
+        # validate_block re-runs this at every validation pass
+        cached = getattr(self, "_vb_cache", None)
+        if cached is not None:
+            return cached[0]
+        err = self._validate_basic_uncached()
+        self._vb_cache = (err,)
+        return err
+
+    def _validate_basic_uncached(self) -> Optional[str]:
         err = self.header.validate_basic()
         if err:
             return f"invalid header: {err}"

@@ -6,7 +6,7 @@ Reference: types/validator.go (Validator struct :13, CompareProposerPriority
 
 from __future__ import annotations
 
-from dataclasses import dataclass, field, replace
+from dataclasses import dataclass, field
 
 from tendermint_tpu.codec.binary import Reader, Writer
 from tendermint_tpu.crypto.keys import PubKey, decode_pubkey, encode_pubkey
@@ -24,7 +24,18 @@ class Validator:
             self.address = self.pub_key.address()
 
     def copy(self) -> "Validator":
-        return replace(self)
+        # direct construction, not dataclasses.replace: per-height state
+        # copies clone every validator 3x (validators/next/last), and
+        # replace()'s field introspection dominated large-net profiles
+        v = Validator.__new__(Validator)
+        v.pub_key = self.pub_key
+        v.voting_power = self.voting_power
+        v.proposer_priority = self.proposer_priority
+        v.address = self.address
+        enc = getattr(self, "_pk_enc", None)
+        if enc is not None:
+            v._pk_enc = enc
+        return v
 
     def compare_proposer_priority(self, other: "Validator") -> "Validator":
         """Return the validator with higher priority; ties break by lower
@@ -39,13 +50,23 @@ class Validator:
             return other
         raise AssertionError("same address in priority comparison")
 
+    def _pk_encoded(self) -> bytes:
+        """Registry wire encoding of the (immutable) pubkey, memoized —
+        every state save re-encodes all three validator sets, and the
+        pubkey bytes dominated that cost in large-net profiles."""
+        enc = getattr(self, "_pk_enc", None)
+        if enc is None:
+            enc = encode_pubkey(self.pub_key)
+            self._pk_enc = enc
+        return enc
+
     def hash_bytes(self) -> bytes:
         """Deterministic encoding for the validators merkle root
         (reference Validator.Bytes types/validator.go:102 -- pubkey +
         voting power only, NOT priority)."""
         return (
             Writer()
-            .write_bytes(encode_pubkey(self.pub_key))
+            .write_bytes(self._pk_encoded())
             .write_i64(self.voting_power)
             .bytes()
         )
@@ -53,7 +74,7 @@ class Validator:
     def encode(self) -> bytes:
         return (
             Writer()
-            .write_bytes(encode_pubkey(self.pub_key))
+            .write_bytes(self._pk_encoded())
             .write_i64(self.voting_power)
             .write_i64(self.proposer_priority)
             .bytes()

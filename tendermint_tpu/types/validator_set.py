@@ -104,6 +104,7 @@ class ValidatorSet:
         self._dev_arrays = None  # membership/power changed: drop the cache
         self._dev_key = None
         self._bls_cache = None
+        self._hash = None  # (pubkey, power) merkle root changed too
 
     def copy(self) -> "ValidatorSet":
         new = ValidatorSet.__new__(ValidatorSet)
@@ -118,13 +119,24 @@ class ValidatorSet:
         # copies in state/execution.py
         new._dev_arrays = getattr(self, "_dev_arrays", None)
         new._dev_key = getattr(self, "_dev_key", None)
+        new._hash = getattr(self, "_hash", None)
         new._bls_cache = getattr(self, "_bls_cache", None)
         return new
 
     def hash(self) -> bytes:
         """Merkle root over validator (pubkey, power) encodings
-        (reference ValidatorSet.Hash types/validator_set.go:307)."""
-        return merkle.hash_from_byte_slices([v.hash_bytes() for v in self.validators])
+        (reference ValidatorSet.Hash types/validator_set.go:307).
+        Memoized: covers only membership/power, which every mutation
+        path routes through _update_total_voting_power (the same
+        invalidation point as the device-array caches) — proposer
+        priorities are deliberately NOT part of the hash."""
+        h = getattr(self, "_hash", None)
+        if h is None:
+            h = merkle.hash_from_byte_slices(
+                [v.hash_bytes() for v in self.validators]
+            )
+            self._hash = h
+        return h
 
     # -- proposer rotation (reference :86-:189) ---------------------------
 
@@ -432,7 +444,8 @@ class ValidatorSet:
         )
 
     def _verify_rows(
-        self, commit, idxs, vals_idx, pk, mg, sg, ed, provider, tpl=None
+        self, commit, idxs, vals_idx, pk, mg, sg, ed, provider, tpl=None,
+        sig_cache=None, row_keys=None,
     ) -> np.ndarray:
         """Per-row signature validity: ed25519 rows go to the batch
         provider in one call; rows with other key types (secp256k1, ...)
@@ -443,10 +456,10 @@ class ValidatorSet:
         # discarded (the host replay recomputes it), and this kernel is
         # the one vote ingest already keeps warm.
         if ed.all():
-            cached = self._rows_cached(provider, vals_idx, mg, sg, tpl)
-            if cached is not None:
-                return cached
-            return np.asarray(provider.verify_batch(pk, mg, sg))
+            return self._ed_rows(
+                provider, np.asarray(vals_idx, dtype=np.int64), pk, mg, sg,
+                tpl, sig_cache, row_keys,
+            )
         ok = np.zeros(len(idxs), dtype=bool)
         sub = np.nonzero(ed)[0]
         if sub.size:
@@ -454,14 +467,71 @@ class ValidatorSet:
             sub_tpl = (
                 (tpl[0], tpl[1][sub], tpl[2][sub]) if tpl is not None else None
             )
-            cached = self._rows_cached(provider, sub_idx, mg[sub], sg[sub], sub_tpl)
-            ok[sub] = (
-                cached
-                if cached is not None
-                else np.asarray(provider.verify_batch(pk[sub], mg[sub], sg[sub]))
+            sub_keys = (
+                [row_keys[int(r)] for r in sub] if row_keys is not None else None
+            )
+            ok[sub] = self._ed_rows(
+                provider, sub_idx, pk[sub], mg[sub], sg[sub], sub_tpl,
+                sig_cache, sub_keys,
             )
         self._serial_fill_non_ed(ok, commit, idxs, vals_idx, mg, ed)
         return ok
+
+    def _ed_rows(
+        self, provider, vals_idx, pk, mg, sg, tpl, sig_cache, row_keys=None
+    ) -> np.ndarray:
+        """Ed25519 rows: SigCache front, then the provider's cached
+        tables, then the generic kernel.
+
+        The cache keys are the TEMPLATED form (crypto/pipeline.SigCache
+        .key_templated) — byte-identical to the keys vote ingest inserts
+        on every verified precommit (types/vote_set.py), so verifying a
+        block's LastCommit whose votes this node already ingested live
+        is a hash lookup per row, not a device round trip. The same
+        commit is validated up to three times per height (prevote
+        validate, lock validate, finalize validate); with the cache the
+        signatures are verified once. Only successful verifies are
+        inserted, and the signature is part of the key — the SigCache
+        soundness argument unchanged."""
+        n = pk.shape[0]
+        if sig_cache is None or sig_cache.capacity <= 0 or tpl is None or not n:
+            cached = self._rows_cached(provider, vals_idx, mg, sg, tpl)
+            if cached is not None:
+                return cached
+            return np.asarray(provider.verify_batch(pk, mg, sg))
+        templates, tmpl_idx, ts8 = tpl
+        if row_keys is not None:
+            # verify_commit already derived (and memoized on the commit)
+            # these exact keys in _commit_row_keys — never re-hash
+            keys = row_keys
+        else:
+            from tendermint_tpu.crypto.pipeline import SigCache
+
+            keys = [
+                SigCache.key_templated(
+                    pk[r].tobytes(),
+                    templates[int(tmpl_idx[r])].tobytes(),
+                    ts8[r].tobytes(),
+                    sg[r].tobytes(),
+                )
+                for r in range(n)
+            ]
+        miss = [r for r in range(n) if not sig_cache.seen(keys[r])]
+        if not miss:
+            return np.ones(n, dtype=bool)
+        m = np.asarray(miss, dtype=np.int64)
+        sub_tpl = (templates, np.asarray(tmpl_idx)[m], np.asarray(ts8)[m])
+        got = self._ed_rows(
+            provider, np.asarray(vals_idx)[m], pk[m], mg[m], sg[m], sub_tpl, None
+        )
+        for j, r in enumerate(miss):
+            if bool(got[j]):
+                sig_cache.add(keys[r])
+        if len(miss) == n:
+            return got
+        out = np.ones(n, dtype=bool)
+        out[m] = got
+        return out
 
     def _rows_cached(self, provider, vals_idx, mg, sg, tpl=None) -> Optional[np.ndarray]:
         """Try the provider's per-valset cached-table path (None = use
@@ -547,6 +617,7 @@ class ValidatorSet:
         height: int,
         commit,
         provider: Optional[BatchVerifier] = None,
+        sig_cache=None,
     ) -> None:
         """Verify +2/3 of this set signed `block_id` at `height`.
 
@@ -569,12 +640,89 @@ class ValidatorSet:
         self._check_commit_size(commit)
         self._verify_commit_basic(commit, height, block_id)
 
+        if self._cached_commit_replay(chain_id, commit, sig_cache):
+            return
         idxs, vals_idx, pk, mg, sg, powers, counted, ed, tpl = (
             self._commit_batch_arrays(chain_id, commit, by_address=False)
         )
         v = provider or get_default_provider()
-        ok = self._verify_rows(commit, idxs, vals_idx, pk, mg, sg, ed, v, tpl)
+        # reuse the memoized per-row keys the fast path just derived
+        # (None when any row is non-ed25519 or no cache is in play)
+        row_keys = None
+        if sig_cache is not None and sig_cache.capacity > 0:
+            all_keys = self._commit_row_keys(chain_id, commit)
+            if all_keys is not None:
+                row_keys = [all_keys[i] for i in idxs]
+        ok = self._verify_rows(
+            commit, idxs, vals_idx, pk, mg, sg, ed, v, tpl,
+            sig_cache=sig_cache, row_keys=row_keys,
+        )
         self._replay_commit_full(commit, ok, idxs, powers, counted)
+
+    def _commit_row_keys(self, chain_id: str, commit) -> Optional[list]:
+        """Per-signature SigCache keys for a commit whose rows map
+        straight to this set (by_address=False), memoized ON the commit
+        (immutable once assembled; the memo is keyed by chain id + this
+        set's pubkey-table digest so a different valset never reuses
+        it). None when any present row is non-ed25519 or has a
+        non-64-byte signature — those take the slow path."""
+        from tendermint_tpu.crypto.pipeline import SigCache
+
+        key, _all_pk, _ = self.batch_cache()
+        memo_key = (chain_id, key)
+        cached = getattr(commit, "_row_keys", None)
+        if cached is not None and cached[0] == memo_key:
+            return cached[1]
+        all_pk, _powers, all_ed = self._device_arrays()
+        templates, tmpl_idx, ts8 = commit.sign_bytes_parts(chain_id)
+        tpl_bytes = (templates[0].tobytes(), templates[1].tobytes())
+        keys: list = []
+        for i, cs in enumerate(commit.signatures):
+            if cs.absent_():
+                keys.append(None)
+                continue
+            if not all_ed[i] or len(cs.signature) != 64:
+                return None
+            keys.append(
+                SigCache.key_templated(
+                    all_pk[i].tobytes(),
+                    tpl_bytes[int(tmpl_idx[i])],
+                    ts8[i].tobytes(),
+                    cs.signature,
+                )
+            )
+        commit._row_keys = (memo_key, keys)
+        return keys
+
+    def _cached_commit_replay(self, chain_id: str, commit, sig_cache) -> bool:
+        """The zero-device-work validate path: when EVERY present
+        signature's templated key is already in ``sig_cache`` (its votes
+        were verified at ingest, or an earlier validation pass verified
+        this same commit), skip array packing entirely and run the
+        sequential quorum replay directly — the replay's verdict
+        (including ErrNotEnoughVotingPower) is identical to the slow
+        path's, whose ok-vector would be all-True for these rows.
+        Returns False when any row is uncached or unkeyable (caller
+        falls through to the full batched verification)."""
+        if sig_cache is None or sig_cache.capacity <= 0:
+            return False
+        keys = self._commit_row_keys(chain_id, commit)
+        if keys is None:
+            return False
+        idxs: List[int] = []
+        counted: List[bool] = []
+        for i, cs in enumerate(commit.signatures):
+            if cs.absent_():
+                continue
+            if not sig_cache.seen(keys[i]):
+                return False
+            idxs.append(i)
+            counted.append(cs.for_block())
+        _pk, all_powers, _ed = self._device_arrays()
+        powers = all_powers[np.asarray(idxs, dtype=np.int64)] if idxs else []
+        ok = np.ones(len(idxs), dtype=bool)
+        self._replay_commit_full(commit, ok, idxs, powers, counted)
+        return True
 
     def _check_commit_size(self, commit) -> None:
         if len(self.validators) != len(commit.signatures):

@@ -126,6 +126,12 @@ class VoteSet:
             dedupe_cache if dedupe_cache is not None else default_sig_cache()
         )
 
+        # canonical sign-bytes templates per BlockID, cached across add
+        # calls (one set sees the same one or two BlockIDs thousands of
+        # times in a large net; the 160-byte struct pack dominated the
+        # cache-hit ingest path)
+        self._tpl_cache = signbytes.TemplateCache(bound=256)
+
         n = val_set.size()
         self.votes_bit_array = BitArray(n)
         self.votes: List[Optional[Vote]] = [None] * n
@@ -260,14 +266,7 @@ class VoteSet:
             bid = vote.block_id
             tb = (bid.hash, bid.parts.total, bid.parts.hash)
             ti = tpl_map.get(tb)
-            tpl_bytes = (
-                tpl_list[ti]
-                if ti is not None
-                else signbytes.canonical_sign_bytes(
-                    self.signed_msg_type, self.height, self.round,
-                    tb[0], tb[1], tb[2], 0, self.chain_id,
-                )
-            )
+            tpl_bytes = tpl_list[ti] if ti is not None else self._template_for(tb)
             # gossip dedupe pre-filter: an exact triple that verified
             # before (this set, another round's set, another peer's
             # redelivery) is valid by construction — skip its row.
@@ -367,6 +366,12 @@ class VoteSet:
                 continue
             added[k] = True
         return added, errors
+
+    def _template_for(self, tb: Tuple[bytes, int, bytes]) -> bytes:
+        return self._tpl_cache.get(
+            self.signed_msg_type, self.height, self.round,
+            tb[0], tb[1], tb[2], self.chain_id,
+        )
 
     def _check_vote(self, vote: Vote) -> Optional[Exception]:
         """Host-side pre-checks (index, address, H/R/type, duplicates)."""

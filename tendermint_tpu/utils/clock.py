@@ -1,0 +1,158 @@
+"""The clock seam: wall time vs deterministic simulated time.
+
+Everything in consensus that *waits* — round timeouts
+(``TimeoutTicker``), ``wait_for_height``, the ingest flush linger,
+watchdog deadlines — used to reach straight for ``time.time_ns()`` /
+``loop.call_later`` / ``asyncio.sleep``. That hard-wires the wall
+clock into the protocol, which makes large-scale scenario testing
+impossible: a 200-height run pays 200 real commit timeouts, and a
+partition that heals "3 seconds later" costs 3 real seconds per
+experiment.
+
+:class:`WallClock` is those exact primitives behind one object.
+:class:`SimClock` is the deterministic replacement the simulator
+(``tendermint_tpu/sim``) injects: time is a number that only moves
+when the driver pops the next scheduled event off a heap, so a
+256-node, 50-height network runs in seconds of wall time and — with a
+seeded schedule — produces the byte-identical event sequence every
+run (docs/simulator.md, clock semantics).
+
+Determinism contract for SimClock: events fire strictly in
+(deadline, registration-order) order; registering a timer never reads
+the wall clock; ``sleep`` is just a timer resolving a future. Nothing
+here is thread-safe by design — a SimClock belongs to one event loop
+(the simulator blocks synchronously on any cross-thread work, e.g.
+device verify bundles, before advancing).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import heapq
+import time
+from typing import List, Optional
+
+
+class SimTimerHandle:
+    """Cancellable handle for one scheduled SimClock callback (the
+    ``loop.call_later`` handle shape: ``.cancel()`` and ``.cancelled()``)."""
+
+    __slots__ = ("deadline_ns", "seq", "fn", "args", "_cancelled")
+
+    def __init__(self, deadline_ns: int, seq: int, fn, args):
+        self.deadline_ns = deadline_ns
+        self.seq = seq
+        self.fn = fn
+        self.args = args
+        self._cancelled = False
+
+    def cancel(self) -> None:
+        self._cancelled = True
+        # drop refs so a cancelled timer can't keep a node graph alive
+        self.fn = None
+        self.args = ()
+
+    def cancelled(self) -> bool:
+        return self._cancelled
+
+    def __lt__(self, other: "SimTimerHandle") -> bool:
+        return (self.deadline_ns, self.seq) < (other.deadline_ns, other.seq)
+
+
+class WallClock:
+    """The process wall clock behind the seam — live-node behavior,
+    bit-for-bit: ``time_ns`` is ``time.time_ns``, ``call_later`` is the
+    running loop's, ``sleep`` is ``asyncio.sleep``."""
+
+    def time_ns(self) -> int:
+        return time.time_ns()
+
+    def monotonic(self) -> float:
+        return time.monotonic()
+
+    def call_later(self, delay_s: float, fn, *args):
+        return asyncio.get_running_loop().call_later(max(delay_s, 0.0), fn, *args)
+
+    async def sleep(self, delay_s: float) -> None:
+        await asyncio.sleep(delay_s)
+
+
+WALL = WallClock()
+
+
+def wall_clock() -> WallClock:
+    """The process-wide wall clock (default for every clock seam)."""
+    return WALL
+
+
+class SimClock:
+    """Deterministic event-driven time.
+
+    ``advance()`` pops the earliest pending timer, moves ``time_ns`` to
+    its deadline and fires it; the simulator alternates "drain the
+    event loop until quiescent" / "advance" (sim/core.py). Timers
+    registered at the same deadline fire in registration order (the
+    ``seq`` tiebreak), so the fire sequence is a pure function of the
+    schedule — never of host speed.
+    """
+
+    def __init__(self, start_ns: int = 1_700_000_000_000_000_000):
+        self._now_ns = int(start_ns)
+        self._heap: List[SimTimerHandle] = []
+        self._seq = 0
+        self.fired = 0  # timers fired (telemetry / loop-bound checks)
+
+    # -- Clock interface ---------------------------------------------------
+
+    def time_ns(self) -> int:
+        return self._now_ns
+
+    def monotonic(self) -> float:
+        return self._now_ns / 1e9
+
+    def call_later(self, delay_s: float, fn, *args) -> SimTimerHandle:
+        return self.call_at_ns(self._now_ns + max(int(delay_s * 1e9), 0), fn, *args)
+
+    async def sleep(self, delay_s: float) -> None:
+        fut = asyncio.get_running_loop().create_future()
+        self.call_later(delay_s, self._wake, fut)
+        await fut
+
+    @staticmethod
+    def _wake(fut) -> None:
+        if not fut.done():
+            fut.set_result(None)
+
+    # -- simulator driver API ----------------------------------------------
+
+    def call_at_ns(self, deadline_ns: int, fn, *args) -> SimTimerHandle:
+        h = SimTimerHandle(max(int(deadline_ns), self._now_ns), self._seq, fn, args)
+        self._seq += 1
+        heapq.heappush(self._heap, h)
+        return h
+
+    def _prune(self) -> None:
+        while self._heap and self._heap[0].cancelled():
+            heapq.heappop(self._heap)
+
+    def has_work(self) -> bool:
+        self._prune()
+        return bool(self._heap)
+
+    def next_deadline_ns(self) -> Optional[int]:
+        self._prune()
+        return self._heap[0].deadline_ns if self._heap else None
+
+    def advance(self) -> bool:
+        """Fire the earliest pending timer (advancing time to its
+        deadline). Returns False when nothing is scheduled."""
+        self._prune()
+        if not self._heap:
+            return False
+        h = heapq.heappop(self._heap)
+        self._now_ns = max(self._now_ns, h.deadline_ns)
+        self.fired += 1
+        fn, args = h.fn, h.args
+        h.fn, h.args = None, ()
+        fn(*args)
+        return True

@@ -237,13 +237,13 @@ class _Probe:
     __slots__ = ("name", "probe", "stall_after_s", "on_stall", "last_value",
                  "last_change", "stalls", "stalled")
 
-    def __init__(self, name, probe, stall_after_s, on_stall):
+    def __init__(self, name, probe, stall_after_s, on_stall, now: float):
         self.name = name
         self.probe = probe
         self.stall_after_s = float(stall_after_s)
         self.on_stall = on_stall
         self.last_value = object()  # sentinel: first tick always "changes"
-        self.last_change = time.monotonic()
+        self.last_change = now
         self.stalls = 0
         self.stalled = False
 
@@ -254,9 +254,16 @@ class Watchdog:
     callbacks must be thread-safe (PipelinedVerifier.restart_workers
     is; asyncio-side stall handlers should just schedule work)."""
 
-    def __init__(self, interval_s: float = 1.0, logger=None):
+    def __init__(self, interval_s: float = 1.0, logger=None, clock=None):
+        from tendermint_tpu.utils.clock import wall_clock
+
         self.interval_s = max(0.01, float(interval_s))
         self.logger = logger or get_logger("watchdog")
+        # deadline/stall arithmetic reads this clock (utils/clock.py) so
+        # the simulator can reason about watchdog deadlines in simulated
+        # time; the tick thread itself still sleeps on the wall — a
+        # SimClock-driven watchdog is driven via check_once()
+        self.clock = clock if clock is not None else wall_clock()
         self._lock = threading.Lock()
         self._workers: List[_Worker] = []
         self._probes: List[_Probe] = []
@@ -289,7 +296,9 @@ class Watchdog:
         """``probe()`` is sampled each tick; an unchanged value for
         ``stall_after_s`` records a stall (once per stall episode)."""
         with self._lock:
-            self._probes.append(_Probe(name, probe, stall_after_s, on_stall))
+            self._probes.append(
+                _Probe(name, probe, stall_after_s, on_stall, self.clock.monotonic())
+            )
 
     def register_heartbeat(
         self,
@@ -299,15 +308,14 @@ class Watchdog:
     ) -> None:
         """Push-style liveness: the worker calls ``heartbeat(name)``;
         silence for ``stall_after_s`` records a stall."""
-        p = _Probe(name, None, stall_after_s, on_stall)
-        p.last_change = time.monotonic()
+        p = _Probe(name, None, stall_after_s, on_stall, self.clock.monotonic())
         with self._lock:
             self._heartbeats[name] = p
 
     def heartbeat(self, name: str) -> None:
         p = self._heartbeats.get(name)
         if p is not None:
-            p.last_change = time.monotonic()
+            p.last_change = self.clock.monotonic()
             p.stalled = False
 
     def watch_future(self, fut: Future, deadline_s: float, name: str = "") -> Future:
@@ -315,7 +323,9 @@ class Watchdog:
         after ``deadline_s`` (tolerating a concurrent resolution race —
         set_exception on a completed future is swallowed)."""
         with self._lock:
-            self._futures.append((time.monotonic() + float(deadline_s), fut, name))
+            self._futures.append(
+                (self.clock.monotonic() + float(deadline_s), fut, name)
+            )
         return fut
 
     # -- lifecycle ---------------------------------------------------------
@@ -351,7 +361,7 @@ class Watchdog:
     # -- one tick (public so tests drive it synchronously) -----------------
 
     def check_once(self) -> None:
-        now = time.monotonic()
+        now = self.clock.monotonic()
         with self._lock:
             workers = list(self._workers)
             probes = list(self._probes)

@@ -13,20 +13,8 @@ from __future__ import annotations
 import asyncio
 from typing import List, Optional
 
-from tendermint_tpu.abci.client.local import LocalClient
-from tendermint_tpu.abci.examples.kvstore import KVStoreApplication
-from tendermint_tpu.config import test_config
-from tendermint_tpu.consensus.messages import MsgInfo
 from tendermint_tpu.consensus.state import ConsensusState
-from tendermint_tpu.consensus.wal import NilWAL
-from tendermint_tpu.crypto.keys import Ed25519PrivKey
-from tendermint_tpu.db.memdb import MemDB
-from tendermint_tpu.mempool import Mempool
-from tendermint_tpu.state.execution import BlockExecutor
-from tendermint_tpu.state.state import state_from_genesis_doc
-from tendermint_tpu.state.store import StateStore
-from tendermint_tpu.store.block_store import BlockStore
-from tendermint_tpu.types.genesis import GenesisDoc, GenesisValidator
+from tendermint_tpu.types.genesis import GenesisDoc
 from tendermint_tpu.types.priv_validator import MockPV
 
 CHAIN_ID = "cs-harness-chain"
@@ -41,32 +29,15 @@ def make_genesis(
     """Deterministic genesis + priv validators (reference
     randGenesisDoc common_test.go:617). ``key_type`` selects the
     validator scheme — "bls12-381" builds a BLS chain
-    (docs/bls-aggregation.md)."""
-    if key_type == "bls12-381":
-        from tendermint_tpu.crypto.bls import BLSPrivKey
+    (docs/bls-aggregation.md). Delegates to the shared builder in
+    tendermint_tpu/sim/core.py (the simulator uses the same one),
+    keeping this harness's historical chain id and key secrets."""
+    from tendermint_tpu.sim.core import make_genesis as _make
 
-        key_cls = BLSPrivKey
-    else:
-        key_cls = Ed25519PrivKey
-    privs = [MockPV(key_cls.from_secret(f"cs-harness-{i}".encode())) for i in range(n_vals)]
-    powers = powers or [10] * n_vals
-    pops = [
-        pv.priv_key.register_possession() if key_type == "bls12-381" else b""
-        for pv in privs
-    ]
-    gvs = [
-        GenesisValidator(
-            address=pv.address(), pub_key=pv.get_pub_key(), power=p,
-            name=f"v{i}", proof_of_possession=pop,
-        )
-        for i, (pv, p, pop) in enumerate(zip(privs, powers, pops))
-    ]
-    doc = GenesisDoc(chain_id=CHAIN_ID, genesis_time_ns=time_ns, validators=gvs)
-    # order privs to match the sorted validator set
-    state = state_from_genesis_doc(doc)
-    by_addr = {pv.address(): pv for pv in privs}
-    ordered = [by_addr[v.address] for v in state.validators.validators]
-    return doc, ordered
+    return _make(
+        n_vals, powers=powers, time_ns=time_ns, key_type=key_type,
+        chain_id=CHAIN_ID, secret_prefix="cs-harness",
+    )
 
 
 class Node:
@@ -88,45 +59,29 @@ async def make_node(
     wal=None,
     node_id: str = "",
     tracer=None,
+    clock=None,
 ) -> Node:
-    config = config or test_config().consensus
-    app = app or KVStoreApplication()
-    client = LocalClient(app)
-    await client.start()
-    from tendermint_tpu.config import MempoolConfig
+    """One in-process node — the shared constructor lives in
+    tendermint_tpu/sim/core.py (build_node); this wraps its result in
+    the harness Node type."""
+    from tendermint_tpu.sim.core import build_node
 
-    mempool = Mempool(MempoolConfig(), client)
-    state_store = StateStore(MemDB())
-    block_store = BlockStore(MemDB())
-    state = state_from_genesis_doc(genesis)
-    state_store.save(state)
-    block_exec = BlockExecutor(state_store, client, mempool=mempool)
-    cs = ConsensusState(
-        config=config,
-        state=state,
-        block_exec=block_exec,
-        block_store=block_store,
-        mempool=mempool,
-        priv_validator=pv,
-        wal=wal or NilWAL(),
-        node_id=node_id,
-        tracer=tracer,
+    sn = await build_node(
+        genesis, pv, config=config, app=app, wal=wal,
+        node_id=node_id, tracer=tracer, clock=clock,
     )
-    return Node(cs, app, mempool, block_store, state_store)
+    return Node(sn.cs, sn.app, sn.mempool, sn.block_store, sn.state_store)
 
 
 def wire_loopback(nodes: List[Node]) -> None:
-    """Deliver every node's internal messages to all other nodes."""
-    for i, node in enumerate(nodes):
-        others = [n for j, n in enumerate(nodes) if j != i]
-        orig = node.cs.send_internal
+    """Deliver every node's internal messages to all other nodes — the
+    zero-latency schedule of the shared routing seam
+    (tendermint_tpu/sim/transport.py; SimNet is the same seam behind a
+    latency/loss/partition schedule)."""
+    from tendermint_tpu.sim.transport import LoopbackTransport, wire_mesh
 
-        def send(msg, _orig=orig, _others=others, _pid=f"node{i}"):
-            _orig(msg)
-            for other in _others:
-                other.cs._queue.put_nowait(MsgInfo(msg, _pid))
-
-        node.cs.send_internal = send
+    cs_list = [n.cs for n in nodes]
+    wire_mesh(cs_list, LoopbackTransport(cs_list))
 
 
 async def start_network(

@@ -224,6 +224,32 @@ def test_bls_bench_aggregation_beats_per_sig_3x(bench, monkeypatch):
     assert out["bls_commit_bytes_agg_8"] < out["bls_commit_bytes_persig_8"]
 
 
+def test_guard_flags_sim_regression_and_disappearance(bench):
+    """The simulator throughput key rides the guard like
+    replay_speedup: a previously-measured sim-heights/s that regresses
+    or goes missing must hard-fail the bench."""
+    _write_record(bench, sim_heights_per_sec=12.0)
+    fails = bench._regression_guard({"sim_heights_per_sec": 6.0}, "tpu")
+    assert len(fails) == 1 and "sim_heights_per_sec" in fails[0]
+    fails = bench._regression_guard({"sim_error": "boom"}, "tpu")
+    assert any("sim_heights_per_sec" in f and "missing" in f for f in fails)
+    assert bench._regression_guard({"sim_heights_per_sec": 11.0}, "tpu") == []
+
+
+def test_sim_bench_heights_per_sec_floor(bench, monkeypatch):
+    """The floor at test scale: the simulator must push simulated
+    consensus at >= 2 heights per wall second on this box's CPU
+    fallback (full-size sweeps ride bench.py; typical runs measure
+    5-15 here). Also pins that the sweep's shared engine actually saw
+    multi-node bundles — the workload the section exists to measure."""
+    monkeypatch.setattr(bench, "SIM_SWEEP", [(12, 6)])
+    out = bench.sim_bench()
+    assert "sim_error" not in out, out
+    assert out["sim_heights_per_sec"] >= 2.0, out
+    assert out["sim_device_sigs_per_sec"] > 0
+    assert out["sim_12x6_multi_source_bundles"] >= 1, out
+
+
 def test_guard_cpu_fallback_skips_loudly(bench):
     """The r04/r05 lesson: a CPU-fallback run must not be judged
     against a TPU baseline — and the refusal must be LOUD (GUARD_SKIPS

@@ -415,6 +415,82 @@ def test_suppressions_only_match_real_comments():
     assert {x.rule for x in v} == {"unused-import"}, v
 
 
+# -- scenario-coherence ------------------------------------------------------
+
+
+def _scenario_project(tmp_path, doc_text, scenarios=("real.scn",)):
+    """A synthetic repo root: docs/claims.md + a scenarios dir; the
+    rule reads both from the project root, so golden cases never touch
+    the real corpus."""
+    from tendermint_tpu.analysis.rules_scenario import ScenarioCoherence
+
+    root = tmp_path / "repo"
+    (root / "docs").mkdir(parents=True)
+    scen = root / "tendermint_tpu" / "sim" / "scenarios"
+    scen.mkdir(parents=True)
+    for name in scenarios:
+        (scen / name).write_text("nodes = 4\nheights = 2\nexpect = safety\n")
+    (root / "docs" / "claims.md").write_text(doc_text)
+    project = Project(str(root), [])
+    return run_lint(project, rules=[ScenarioCoherence()])
+
+
+def test_golden_scenario_coherence_missing_scenario(tmp_path):
+    v = _scenario_project(
+        tmp_path,
+        "Safety holds. [claim:safety scenario=missing.scn]\n",
+    )
+    assert_only(v, "scenario-coherence", 1)
+    assert "missing.scn" in v[0].message and "does not exist" in v[0].message
+    assert v[0].path == "docs/claims.md" and v[0].line == 1
+
+
+def test_golden_scenario_coherence_malformed_marker(tmp_path):
+    v = _scenario_project(
+        tmp_path,
+        "ok line\n"
+        "[claim:vibes scenario=real.scn]\n"          # unknown kind
+        "[claim:safety]\n"                            # missing scenario=
+        "[claim:liveness scenario=no_suffix]\n",      # not a .scn name
+    )
+    assert_only(v, "scenario-coherence", 3)
+    assert all("malformed claim marker" in x.message for x in v)
+    assert [x.line for x in v] == [2, 3, 4]
+
+
+def test_scenario_coherence_clean_and_boundaries(tmp_path):
+    # valid markers against existing scenarios lint clean; prose that
+    # merely mentions claims (no [claim: token) is never matched
+    v = _scenario_project(
+        tmp_path,
+        "A claim: safety always holds (untagged prose, not a marker).\n"
+        "[claim:safety scenario=real.scn] and again "
+        "[claim:liveness scenario=real.scn]\n",
+    )
+    assert v == [], v
+
+
+def test_repo_scenario_claims_are_tagged():
+    """The backfill is real: the live docs tree carries at least one
+    tagged claim per corpus scenario, and the full-repo lint (below)
+    holds them coherent."""
+    import re
+
+    docs_dir = os.path.join(REPO, "docs")
+    text = "\n".join(
+        open(os.path.join(docs_dir, f), encoding="utf-8").read()
+        for f in sorted(os.listdir(docs_dir))
+        if f.endswith(".md")
+    )
+    tagged = set(re.findall(r"\[claim:(?:safety|liveness) scenario=([a-z0-9_]+\.scn)\]", text))
+    from tendermint_tpu.sim.scenario import list_scenarios
+
+    assert set(list_scenarios()) <= tagged, (
+        f"corpus scenarios without a tagged docs claim: "
+        f"{set(list_scenarios()) - tagged}"
+    )
+
+
 # -- registry / CLI surface -------------------------------------------------
 
 EXPECTED_RULES = {
@@ -431,6 +507,7 @@ EXPECTED_RULES = {
     "unreachable-code",
     "slow-marker",
     "trace-coherence",
+    "scenario-coherence",
 }
 
 
