@@ -292,6 +292,7 @@ _GUARD_KEYS = [
     ("bls_commit_bytes_ratio", "higher"),
     ("bls_verify_speedup", "higher"),
     ("sim_heights_per_sec", "higher"),
+    ("sim_recovery_s", "lower"),
     ("coldstart_first_verify_s", None),   # presence-only: timing varies
     ("coldstart_tabled_first_s", None),
 ]
@@ -308,6 +309,7 @@ _KEY_SECTION_PLATFORM = {
     "bls_commit_bytes_ratio": "bls_platform",
     "bls_verify_speedup": "bls_platform",
     "sim_heights_per_sec": "sim_platform",
+    "sim_recovery_s": "sim_platform",
 }
 
 # provenance-mismatch skip notes from the LAST _regression_guard call —
@@ -1815,6 +1817,20 @@ def _ingest_e2e(inner) -> dict:
 SIM_SWEEP = [(16, 10), (64, 8), (128, 6)]  # (nodes, heights)
 SIM_VALIDATORS = int(os.environ.get("TM_BENCH_SIM_VALS", "8"))
 SIM_SCHEDULE = "link(*,*):delay:ms=10,jitter_ms=4"
+# recovery drill: one TRUE crash (WAL-replay rebuild, sim/durability.py)
+# of a validator; sim_recovery_s = simulated seconds from the kill to
+# that node's first post-replay commit — the restart-latency number the
+# durable-node track guards (lower is better)
+SIM_RECOVERY = {
+    # seed chosen so the kill lands MID-HEIGHT: the rebuilt node has a
+    # real in-flight WAL tail to replay (replayed_msgs > 0), not just a
+    # clean post-commit boundary
+    "nodes": 8, "validators": 4, "heights": 10, "seed": 42,
+    "schedule": (
+        "link(*,*):delay:ms=10,jitter_ms=4;crash:node=1,at_h=3,restart_h=5"
+    ),
+    "crash_node": 1,
+}
 
 
 def sim_bench() -> dict:
@@ -1856,10 +1872,55 @@ def sim_bench() -> dict:
             out["sim_device_sigs_per_sec"] = round(sigs_rate, 1)
         else:
             out["sim_error"] = "no sweep configuration completed"
+        out.update(sim_recovery_bench())
         return out
     except Exception as ex:
         log(f"sim bench failed: {ex!r}")
         return {"sim_error": repr(ex)[:200]}
+
+
+def sim_recovery_bench() -> dict:
+    """The crash-recovery drill: kill a validator mid-run (true crash —
+    its ConsensusState dies, the durability domain survives), rebuild
+    via handshake + WAL replay at restart_h, and report the simulated
+    time from the kill event to the node's first commit after the
+    rebuild (``sim_recovery_s``). Guarded like sim_heights_per_sec."""
+    try:
+        from tendermint_tpu.sim.core import Simulation
+
+        cfg = SIM_RECOVERY
+        sim = Simulation(
+            n_nodes=cfg["nodes"],
+            validators=cfg["validators"],
+            heights=cfg["heights"],
+            schedule=cfg["schedule"],
+            seed=cfg["seed"],
+            record_events=True,
+        )
+        res = sim.run()
+        node = cfg["crash_node"]
+        if not res.completed:
+            return {"sim_recovery_error": "recovery run wedged"}
+        t_crash = next(
+            (e[1] for e in res.events if e[0] == "crash" and e[2] == node), None
+        )
+        restarts = sim.net.restart_times.get(node, [])
+        if t_crash is None or not restarts:
+            return {"sim_recovery_error": "crash/restart events missing"}
+        t_restart = restarts[0]
+        post = [
+            t for t in sim.net.commit_times.get(node, {}).values()
+            if t >= t_restart
+        ]
+        if not post:
+            return {"sim_recovery_error": "no post-replay commit"}
+        return {
+            "sim_recovery_s": round((min(post) - t_crash) / 1e9, 3),
+            "sim_recovery_replayed_msgs": int(sim.net.wal_replayed_msgs),
+        }
+    except Exception as ex:
+        log(f"sim recovery bench failed: {ex!r}")
+        return {"sim_recovery_error": repr(ex)[:200]}
 
 
 _STATE_PATH = os.environ.get("TM_BENCH_STATE", "")

@@ -255,6 +255,9 @@ async def catchup_replay(cs, cs_height: int) -> None:
         for msg in msgs or []:
             await _read_replay_message(cs, msg)
             count += 1
+        # surfaced for callers that report recovery (node startup logs,
+        # the simulator's wal_replay event) — start() swallows our return
+        cs.wal_replayed_count = count
         cs.logger.info("WAL catchup complete", height=cs_height, replayed_msgs=count)
     finally:
         cs.replay_mode = False

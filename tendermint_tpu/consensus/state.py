@@ -302,6 +302,7 @@ class ConsensusState(Service):
 
         self.wal: WAL = wal or NilWAL()
         self.replay_mode = False  # catching up via WAL replay
+        self.wal_replayed_count = 0  # messages re-driven by the last catchup
         self.do_wal_catchup = True
         self._done_first_block = asyncio.Event()
         self.n_steps = 0  # transitions counter (reference nSteps, for tests)
@@ -864,9 +865,14 @@ class ConsensusState(Service):
             _, val = self.rs.validators.get_by_address(offender)
             if val is None:
                 return
-            ev = DuplicateVoteEvidence(
-                pub_key=val.pub_key, vote_a=e.vote_a, vote_b=e.vote_b
+            # canonical vote order (reference NewDuplicateVoteEvidence
+            # sorts by BlockID): peers that saw the two votes in
+            # opposite arrival order must pool byte-identical evidence,
+            # or one committed copy leaves the other pending forever
+            va, vb = sorted(
+                (e.vote_a, e.vote_b), key=lambda v: (v.block_id.hash, v.signature)
             )
+            ev = DuplicateVoteEvidence(pub_key=val.pub_key, vote_a=va, vote_b=vb)
             try:
                 self._evpool.add_evidence(ev)
                 self.logger.info(

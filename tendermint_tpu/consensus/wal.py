@@ -35,13 +35,17 @@ class WALWriteError(Exception):
     pass
 
 
-def _frame(data: bytes) -> bytes:
+def frame_record(data: bytes) -> bytes:
+    """CRC32+length framing for one WAL record (WALEncoder wal.go:290).
+    Public: the simulator's in-memory WAL (sim/durability.SimWAL) uses
+    the identical on-"disk" format so its torn-tail repair exercises
+    the same decoder a live restart runs."""
     if len(data) > MAX_MSG_SIZE:
         raise WALWriteError(f"msg is too big: {len(data)} > {MAX_MSG_SIZE}")
     return _HEADER.pack(zlib.crc32(data) & 0xFFFFFFFF, len(data)) + data
 
 
-def _iter_records(fp) -> Iterator[Tuple[int, bytes]]:
+def iter_records(fp) -> Iterator[Tuple[int, bytes]]:
     """Yield (offset, payload). Raises DataCorruptionError on bad CRC or
     over-size; stops cleanly at EOF/truncated tail header."""
     while True:
@@ -58,6 +62,12 @@ def _iter_records(fp) -> Iterator[Tuple[int, bytes]]:
         if (zlib.crc32(data) & 0xFFFFFFFF) != crc:
             raise DataCorruptionError(f"crc mismatch at {offset}")
         yield offset, data
+
+
+# short internal aliases (also the names tests/tools imported before the
+# helpers went public for the simulator's durable-WAL layer)
+_frame = frame_record
+_iter_records = iter_records
 
 
 class WAL:

@@ -126,7 +126,10 @@ class EvidencePool:
                 continue
             self._seq += 1
             self._seqs[piece.hash()] = self._seq
-            self._db.set(_key(_PENDING, piece), encode_evidence(piece))
+            # synced: verified evidence must survive a crash (a restarted
+            # node re-proposes it from the store; the sim's durable-store
+            # layer drops un-synced writes exactly like a power cut)
+            self._db.set_sync(_key(_PENDING, piece), encode_evidence(piece))
             added = True
             self.logger.info(
                 "verified new evidence of byzantine behaviour", ev=repr(piece)
@@ -244,8 +247,12 @@ class EvidencePool:
                     del self.val_to_last_height[addr]
 
     def mark_evidence_as_committed(self, ev: Evidence) -> None:
-        self._db.set(_key(_COMMITTED, ev), b"\x01")
-        self._db.delete(_key(_PENDING, ev))
+        # one synced atomic batch: a crash can never leave evidence both
+        # committed-marked and still pending (it would be re-proposed)
+        batch = self._db.new_batch()
+        batch.set(_key(_COMMITTED, ev), b"\x01")
+        batch.delete(_key(_PENDING, ev))
+        batch.write_sync()
         self._seqs.pop(ev.hash(), None)
 
     def _remove_expired(self) -> None:

@@ -12,5 +12,11 @@ replayable corpus every docs liveness/safety claim pins against
 """
 
 from tendermint_tpu.sim.core import SimResult, Simulation  # noqa: F401
+from tendermint_tpu.sim.durability import (  # noqa: F401
+    DurableDB,
+    GuardedPV,
+    NodeDomain,
+    SimWAL,
+)
 from tendermint_tpu.sim.scenario import Scenario, load_scenario, run_scenario  # noqa: F401
 from tendermint_tpu.sim.schedule import Schedule, parse_schedule  # noqa: F401

@@ -20,6 +20,18 @@ process-global SigCache/MerkleHasher seams, so cross-node signature
 traffic coalesces into genuinely shared device bundles — the
 multi-node engine workload reported through ``engine_stats()``
 (models/telemetry.py protocol).
+
+Every node owns a per-node durability domain (sim/durability.py): an
+in-memory WAL and block/state/evidence stores with simulated fsync
+boundaries. The schedule's ``crash`` verb (default ``mode=replay``)
+kills a node for real — its ConsensusState, app, mempool and queues
+are destroyed; the domain drops writes past the last fsync (keeping a
+seeded, possibly-torn prefix of the volatile WAL tail) — and at
+``restart_h`` the node is rebuilt through the live recovery path:
+handshake replays committed blocks into a fresh app, ``SimWAL.start``
+repairs the torn tail, ``catchup_replay`` re-drives the in-flight
+height, and the net re-gossips the front round. Bit-identical under a
+fixed seed, crashed nodes included (tests/test_sim_durability.py).
 """
 
 from __future__ import annotations
@@ -45,9 +57,10 @@ from tendermint_tpu.crypto.pipeline import (
     default_sig_cache,
     set_default_sig_cache,
 )
+from tendermint_tpu.sim.durability import GuardedPV, NodeDomain
 from tendermint_tpu.sim.net import SimNet
 from tendermint_tpu.sim.schedule import Schedule, parse_schedule
-from tendermint_tpu.sim.transport import wire_mesh
+from tendermint_tpu.sim.transport import wire_mesh, wire_one
 from tendermint_tpu.types.block import BlockID
 from tendermint_tpu.types.genesis import GenesisDoc, GenesisValidator
 from tendermint_tpu.types.priv_validator import MockPV
@@ -112,6 +125,8 @@ class SimNode:
     mempool: object
     block_store: object
     state_store: object
+    client: object = None  # the ABCI LocalClient (stopped on sim crash)
+    evidence_pool: object = None
 
 
 async def build_node(
@@ -124,10 +139,25 @@ async def build_node(
     tracer=None,
     clock=None,
     sig_cache=None,
+    block_db=None,
+    state_db=None,
+    evidence_db=None,
+    restart: bool = False,
+    logger=None,
 ) -> SimNode:
     """The one in-process consensus-node constructor (harness make_node
-    delegates here): kvstore app over a LocalClient, MemDB stores, an
-    optional per-node tracer and the clock seam."""
+    delegates here): kvstore app over a LocalClient, MemDB stores (or
+    caller-owned DBs — the simulator passes its per-node durability
+    domain, sim/durability.py), an optional evidence pool, per-node
+    tracer and the clock seam.
+
+    ``restart=True`` is the recovery-path variant of the SAME assembly
+    (one constructor, so rebuilt nodes can never drift from first-boot
+    wiring): instead of bootstrapping genesis state, the state is
+    loaded from the caller's durable ``state_db`` and the fresh app is
+    reconciled with the stores by ``Handshaker`` (committed blocks
+    replayed into it) before the ConsensusState is built — whose
+    ``start()`` then runs the WAL catchup replay."""
     from tendermint_tpu.abci.client.local import LocalClient
     from tendermint_tpu.abci.examples.kvstore import KVStoreApplication
     from tendermint_tpu.config import MempoolConfig, test_config
@@ -143,17 +173,46 @@ async def build_node(
     client = LocalClient(app)
     await client.start()
     mempool = Mempool(MempoolConfig(), client)
-    state_store = StateStore(MemDB())
-    block_store = BlockStore(MemDB())
-    state = state_from_genesis_doc(genesis)
-    state_store.save(state)
-    block_exec = BlockExecutor(state_store, client, mempool=mempool)
+    # fresh store wrappers every time: on restart, in-memory caches
+    # (BlockStore height/base) re-read what actually survived
+    state_store = StateStore(state_db if state_db is not None else MemDB())
+    block_store = BlockStore(block_db if block_db is not None else MemDB())
+    from tendermint_tpu.consensus.replay import Handshaker
+
+    if restart:
+        if state_db is None:
+            raise ValueError("restart=True needs the node's durable state_db")
+        state = state_store.load()
+    else:
+        state = state_from_genesis_doc(genesis)
+        state_store.save(state)
+    # the SAME app handshake a live node boots through (node/node.py):
+    # InitChain on a fresh chain, replay of committed blocks into a
+    # fresh app on restart — and state.version_app reconciled with the
+    # app's Info either way. Skipping it at first boot while running it
+    # on restart left genesis-built headers carrying version_app 0 that
+    # the restart handshake (version_app from Info) then rejected in
+    # replay validation.
+    handshaker = Handshaker(
+        state_store, state, block_store, genesis, logger=logger
+    )
+    await handshaker.handshake(client)
+    state = state_store.load() or state
+    evpool = None
+    if evidence_db is not None:
+        from tendermint_tpu.evidence.pool import EvidencePool
+
+        evpool = EvidencePool(evidence_db, state_store, block_store)
+    block_exec = BlockExecutor(
+        state_store, client, mempool=mempool, evidence_pool=evpool
+    )
     cs = ConsensusState(
         config=config,
         state=state,
         block_exec=block_exec,
         block_store=block_store,
         mempool=mempool,
+        evidence_pool=evpool,
         priv_validator=pv,
         wal=wal or NilWAL(),
         node_id=node_id,
@@ -161,7 +220,7 @@ async def build_node(
         clock=clock,
         sig_cache=sig_cache,
     )
-    return SimNode(cs, app, mempool, block_store, state_store)
+    return SimNode(cs, app, mempool, block_store, state_store, client, evpool)
 
 
 @dataclass
@@ -232,11 +291,15 @@ class Simulation:
         self.config = config
         self.on_built = on_built
         self.logger = logger or get_logger("sim")
-        self.privs: List[MockPV] = []
+        self.privs: List[object] = []  # GuardedPV (raw MockPV for byz nodes)
         self.nodes: List[SimNode] = []
+        self.domains: List[NodeDomain] = []  # per-node durability domains
         self.net: Optional[SimNet] = None
         self.clock = SimClock(GENESIS_TIME_NS)
-        self._bg: set = set()  # strong refs for injected-load tasks
+        self._bg: set = set()  # strong refs for injected-load/crash tasks
+        self._genesis: Optional[GenesisDoc] = None
+        self._node_config = None
+        self.restarts_completed = 0
 
     # -- construction ------------------------------------------------------
 
@@ -244,11 +307,38 @@ class Simulation:
         from tendermint_tpu.config import test_config
 
         config = self.config or test_config().consensus
+        self._node_config = config
+        if self.schedule.churn and self.app_factory is None:
+            raise ValueError(
+                "churn requires an app with validator-update txs "
+                "(persistent_kvstore — set app_factory / scenario app)"
+            )
         genesis, privs = make_genesis(
             self.validators, chain_id=SIM_CHAIN_ID, secret_prefix=f"sim-{self.seed}"
         )
-        self.privs = privs
+        self._genesis = genesis
+        # every node holds a key (same secret scheme the genesis set
+        # uses) so churn can rotate ANY node into the validator set;
+        # non-validators simply never sign until a join lands. Signers
+        # ride FilePV's double-sign discipline (sim/durability.GuardedPV
+        # — the in-memory privval state file, which crashes do NOT
+        # wipe); nodes the schedule marks byzantine keep the raw signer,
+        # equivocation being their job.
+        extra = [
+            MockPV(Ed25519PrivKey.from_secret(f"sim-{self.seed}-{i}".encode()))
+            for i in range(self.validators, self.n_nodes)
+        ]
+        byz_nodes = {b.node for b in self.schedule.byz}
+        self.privs = [
+            pv if i in byz_nodes else GuardedPV(pv)
+            for i, pv in enumerate(list(privs) + extra)
+        ]
         self.nodes = []
+        # per-node durability domains: the WAL + store layer a simulated
+        # crash cannot erase (sim/durability.py)
+        self.domains = [
+            NodeDomain.create(self.seed, i) for i in range(self.n_nodes)
+        ]
         # each simulated node keeps its OWN signature cache (node
         # identity stays physical); the shared engine's pre-verifier
         # warms them per delivery (sim/net.py _preverify)
@@ -259,16 +349,21 @@ class Simulation:
                 from tendermint_tpu.utils.trace import Tracer
 
                 tracer = Tracer(enabled=True, node_id=f"node{i}")
+            dom = self.domains[i]
             self.nodes.append(
                 await build_node(
                     genesis,
-                    privs[i] if i < self.validators else None,
+                    self.privs[i],
                     config=config,
                     app=self.app_factory() if self.app_factory else None,
+                    wal=dom.wal,
                     node_id=f"node{i}",
                     tracer=tracer,
                     clock=self.clock,
                     sig_cache=self.node_caches[i],
+                    block_db=dom.block_db,
+                    state_db=dom.state_db,
+                    evidence_db=dom.evidence_db,
                 )
             )
         cs_list = [n.cs for n in self.nodes]
@@ -286,38 +381,181 @@ class Simulation:
             [n.block_store for n in self.nodes],
             self.validators,
             node_caches=self.node_caches,
+            heights=self.heights,
         )
+        self.net.on_crash = self._on_crash
+        self.net.on_restart = self._on_restart
         wire_mesh(cs_list, self.net)
         for i, cs in enumerate(cs_list):
-            cs.evsw.add_listener(
-                EVENT_COMMITTED,
-                lambda block, _i=i: self.net.notify_commit(
-                    _i, block.header.height, block.hash(), len(block.data.txs)
-                ),
-            )
+            self._register_commit_listener(i, cs)
         for b in self.schedule.byz:
             self.net.add_height_hook(
                 b.at_h, lambda _b=b: self._install_byzantine(_b.node, _b.kind)
             )
         for ld in self.schedule.loads:
             self.net.add_height_hook(ld.at_h, lambda _l=ld: self._inject_load(_l))
+        for ch in self.schedule.churn:
+            self.net.add_height_hook(ch.at_h, lambda _c=ch: self._inject_churn(_c))
         if self.on_built is not None:
             self.on_built(self)
 
+    def _register_commit_listener(self, idx: int, cs: ConsensusState) -> None:
+        cs.evsw.add_listener(
+            EVENT_COMMITTED,
+            lambda block, _i=idx: self.net.notify_commit(
+                _i, block.header.height, block.hash(), len(block.data.txs),
+                len(block.evidence.evidence),
+            ),
+        )
+
+    # -- true crash-restart (the durable recovery drill) -------------------
+
+    def _on_crash(self, idx: int) -> None:
+        """SimNet replay-crash hook. The power cut itself is SYNCHRONOUS
+        — the domain drops its un-fsynced state and the node's tasks are
+        cancelled RIGHT NOW, before any already-queued callback could
+        process more input and fsync new writes past the cut (a crashed
+        process executes nothing). Only the graceful teardown (awaiting
+        the cancelled tasks, stopping the app client) runs as a task —
+        still inside the current simulated instant."""
+        self.domains[idx].crash()
+        cs = self.nodes[idx].cs
+        cs.timeout_ticker.cancel()
+        for t in list(cs._tasks):
+            t.cancel()
+        self._spawn_bg(self._crash_node(idx))
+
+    def _on_restart(self, idx: int) -> None:
+        self._spawn_bg(self._restart_guarded(idx))
+
+    async def _restart_guarded(self, idx: int) -> None:
+        """A rebuild that dies must be LOUD: the node would otherwise
+        stay severed forever and the eventual liveness failure would
+        point nowhere (the same reasoning as the bind horizon check)."""
+        try:
+            await self._restart_node(idx)
+        except Exception as e:
+            self.logger.error(
+                "sim node rebuild FAILED; node stays down", node=idx, err=repr(e)
+            )
+            raise
+
+    def _spawn_bg(self, coro) -> None:
+        task = asyncio.get_running_loop().create_task(coro)
+        self._bg.add(task)
+        task.add_done_callback(self._bg.discard)
+
+    async def _crash_node(self, idx: int) -> None:
+        """Graceful half of the teardown (the cut already happened in
+        _on_crash): await the cancelled consensus tasks out and stop the
+        app client. The crashed SimWAL ignores writes and stop() on it
+        never flushes, so nothing here can resurrect lost state."""
+        node = self.nodes[idx]
+        try:
+            await node.cs.stop()
+        except Exception as e:
+            self.logger.debug("crash teardown: cs.stop", node=idx, err=repr(e))
+        try:
+            if node.client is not None:
+                await node.client.stop()
+        except Exception as e:
+            self.logger.debug("crash teardown: app stop", node=idx, err=repr(e))
+
+    async def _restart_node(self, idx: int) -> None:
+        """Rebuild a crashed node from its durability domain through the
+        LIVE restart path — the same ``build_node`` assembly as first
+        boot, in restart mode: fresh app reconciled by handshake, stores
+        reopened over the durable DBs, and a ConsensusState whose
+        start() repairs the torn WAL tail and replays the in-flight
+        height (consensus/replay.catchup_replay); then rejoin via the
+        net's catchup feed + front re-gossip."""
+        dom = self.domains[idx]
+        old = self.nodes[idx]
+        node = await build_node(
+            self._genesis,
+            self.privs[idx],
+            config=self._node_config,
+            app=self.app_factory() if self.app_factory else None,
+            wal=dom.wal,
+            node_id=f"node{idx}",
+            tracer=old.cs.tracer,  # same identity, one merged-trace row
+            clock=self.clock,
+            sig_cache=SigCache(),  # the node's memory died with it
+            block_db=dom.block_db,
+            state_db=dom.state_db,
+            evidence_db=dom.evidence_db,
+            restart=True,
+            logger=self.logger,
+        )
+        cs = node.cs
+        self.node_caches[idx] = cs.sig_cache
+        self.net.node_caches[idx] = cs.sig_cache
+        wire_one(cs, idx, self.net)
+        self._register_commit_listener(idx, cs)
+        self.nodes[idx] = node
+        self.net.nodes[idx] = cs
+        self.net.block_stores[idx] = node.block_store
+        # a byzantine override the schedule installed before the crash
+        # survives the restart (the adversary controls its own binary)
+        for b in self.schedule.byz:
+            if b.node == idx and b.at_h <= self.net.net_height:
+                self._install_byzantine(idx, b.kind, announce=False)
+        await cs.start()
+        self.restarts_completed += 1
+        # catchup_replay stashes how much in-flight WAL tail it re-drove
+        self.net.mark_restarted(idx, cs.wal_replayed_count)
+
+    # -- churn: valset entry/exit as data -----------------------------------
+
+    def _inject_churn(self, ch) -> None:
+        """Broadcast the ``val:<pubkeyB64>!<power>`` rotation tx for the
+        churning node's key into every mempool (join: its configured
+        power; leave: power 0 — the persistent_kvstore exit format)."""
+        import base64
+
+        from tendermint_tpu.crypto.keys import encode_pubkey
+
+        pv = self.privs[ch.node]
+        power = ch.power if ch.kind == "join" else 0
+        pk_b64 = base64.b64encode(encode_pubkey(pv.get_pub_key())).decode()
+        tx = f"val:{pk_b64}!{power}".encode()
+        self.net._event(
+            "churn", self.clock.time_ns(), ch.node, ch.kind, power
+        )
+        self._spawn_bg(self._push_tx_everywhere(tx))
+
+    async def _push_tx_everywhere(self, tx: bytes) -> None:
+        for node in self.nodes:
+            try:
+                await node.mempool.check_tx(tx)
+            except Exception:
+                pass  # full/duplicate: best-effort, like the load bursts
+
     # -- byzantine overrides ----------------------------------------------
 
-    def _install_byzantine(self, idx: int, kind: str) -> None:
+    def _install_byzantine(self, idx: int, kind: str, announce: bool = True) -> None:
         cs = self.nodes[idx].cs
-        self.net._event("byz", self.clock.time_ns(), idx, kind)
+        if announce:
+            self.net._event("byz", self.clock.time_ns(), idx, kind)
         if kind == "double_sign":
             self._install_double_sign(idx, cs)
         elif kind == "amnesia":
             self._install_amnesia(idx, cs)
 
     def _install_double_sign(self, idx: int, cs: ConsensusState) -> None:
-        """Equivocating proposer (reference byzantineDecideProposalFunc,
-        byzantine_test.go:106): two different blocks, each half of the
-        net sees a different one."""
+        """Equivocating proposer AND voter (reference
+        byzantineDecideProposalFunc, byzantine_test.go:106): as proposer
+        it sends two different blocks, each half of the net seeing one;
+        every prevote step it ALSO signs a second, conflicting prevote —
+        the double vote whose ``DuplicateVoteEvidence`` honest receivers
+        pool and commit into a block (evidence/pool.py)."""
+        import hashlib
+
+        from tendermint_tpu.codec.signbytes import PREVOTE_TYPE as _PREVOTE
+        from tendermint_tpu.consensus.messages import VoteMessage
+        from tendermint_tpu.types.block import PartSetHeader
+        from tendermint_tpu.types.vote import Vote
+
         net = self.net
 
         async def byz_decide(height: int, round_: int) -> None:
@@ -356,6 +594,37 @@ class Simulation:
                     )
 
         cs.decide_proposal = byz_decide
+
+        honest_prevote = cs.do_prevote
+
+        async def byz_prevote(height: int, round_: int) -> None:
+            # the honest prevote first (keeps the round machinery
+            # moving), then a conflicting one for a fabricated block —
+            # the unguarded byz signer happily signs both
+            await honest_prevote(height, round_)
+            if cs._priv_validator is None or not cs.rs.validators.has_address(
+                cs._priv_validator_addr
+            ):
+                return
+            vidx, _ = cs.rs.validators.get_by_address(cs._priv_validator_addr)
+            fake = hashlib.sha256(f"sim-equivocation-{height}".encode()).digest()
+            vote = Vote(
+                vote_type=_PREVOTE,
+                height=height,
+                round=round_,
+                block_id=BlockID(
+                    hash=fake, parts=PartSetHeader(total=1, hash=fake)
+                ),
+                timestamp_ns=cs._now_ns(),
+                validator_address=cs._priv_validator_addr,
+                validator_index=vidx,
+            )
+            cs._priv_validator.sign_vote(cs.state.chain_id, vote)
+            for dst in range(len(net.nodes)):
+                if dst != idx:
+                    net.unicast(idx, dst, VoteMessage(vote))
+
+        cs.do_prevote = byz_prevote
 
     def _install_amnesia(self, idx: int, cs: ConsensusState) -> None:
         """Lock-forgetting prevoter: clears its lock every prevote step
@@ -435,13 +704,11 @@ class Simulation:
         )
         set_default_sig_cache(cache)
         set_default_provider(verifier)
-        started: List[SimNode] = []
         timed_out = False
         try:
             await self._build(cache, verifier)
             for node in self.nodes:
                 await node.cs.start()
-                started.append(node)
             deadline_ns = self.clock.time_ns() + int(self.max_sim_s * 1e9)
             while True:
                 await self._drain()
@@ -459,7 +726,10 @@ class Simulation:
                     break
             result = self._collect(verifier, timed_out, t0)
         finally:
-            for node in started:
+            # self.nodes holds the CURRENT instances (replay-crashed
+            # nodes were rebuilt mid-run; their predecessors are already
+            # stopped, and Service.stop is a no-op the second time)
+            for node in self.nodes:
                 try:
                     await node.cs.stop()
                 except Exception:

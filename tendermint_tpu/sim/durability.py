@@ -1,0 +1,453 @@
+"""Per-node durability domains: what a simulated crash does NOT erase.
+
+PR 13's ``crash`` verb was isolation-only — a "crashed" node kept its
+full memory and rejoined via catchup, so the one recovery path
+production actually exercises (lose memory, replay a possibly-torn
+WAL, rejoin) was untested at scale. This module is the missing layer:
+each simulated node owns a :class:`NodeDomain` — an in-memory WAL and
+block/state/evidence stores with an explicit **simulated fsync
+boundary** — and the simulator's upgraded crash verb tears the node's
+``ConsensusState`` down and rebuilds it from these survivors through
+the SAME code path a live node restarts through (``Handshaker`` +
+``consensus.replay.catchup_replay``).
+
+Crash semantics, mirrored from the on-disk reality the live WAL
+(consensus/wal.py) models:
+
+- **fsync is the durability line.** ``SimWAL.flush_and_sync`` /
+  ``DurableDB.sync`` move the watermark; a crash drops everything past
+  it. ``BlockStore.save_block`` uses ``batch.write_sync`` and the WAL
+  fsyncs ENDHEIGHT, so the recovery invariant chain (block saved →
+  ENDHEIGHT fsync'd → applied → state saved, SURVEY §5.4) holds under
+  simulated crashes exactly as it does under ``SIGKILL``.
+- **Torn tails.** Of the un-fsynced WAL tail, a seeded prefix may have
+  reached the disk anyway (the page cache flushes what it pleases) —
+  possibly cutting a record mid-frame: the exact on-disk state
+  ``faultinject``'s ``tear`` action models for live nodes, and the
+  same repair (`start()` truncates at the first corrupt record) fixes
+  it. ``SimWAL.write`` also consumes ``faults.tear("wal.fsync")``
+  directly, so a ``TM_FAULTS`` chaos spec tears simulated nodes
+  byte-for-byte like live ones.
+- **The privval state file survives.** :class:`GuardedPV` wraps a test
+  signer with FilePV's last-sign-state discipline, kept in memory
+  ACROSS crash/rebuild — so WAL replay re-signs the identical payload
+  (same signature returned) and can never be tricked into equivocating,
+  which is what makes crash-restart of a validator safe.
+
+Everything here is deterministic: torn-cut offsets come from a
+per-domain ``random.Random`` seeded from (sim seed, node index), so
+the same seed reproduces the same torn tails and the same replays —
+the determinism contract (docs/simulator.md) covers crashed nodes too.
+"""
+
+from __future__ import annotations
+
+import io
+import random
+import zlib
+from dataclasses import dataclass, field
+from typing import Dict, Iterator, List, Optional, Tuple
+
+from tendermint_tpu.consensus.messages import (
+    EndHeightMessage,
+    MsgInfo,
+    decode_msg,
+    encode_msg,
+)
+from tendermint_tpu.consensus.wal import (
+    _HEADER,
+    DataCorruptionError,
+    WAL,
+    WALWriteError,
+    frame_record,
+    iter_records,
+)
+from tendermint_tpu.db.memdb import MemDB
+from tendermint_tpu.privval.file import FilePV, FilePVKey, FilePVLastSignState
+from tendermint_tpu.utils import faultinject as faults
+from tendermint_tpu.utils.log import get_logger
+
+# The truncation-offset taxonomy for torn WAL tails. A crash that keeps
+# `k` bytes of the volatile tail lands in exactly one class; replay must
+# succeed (repair + clean decode of the surviving prefix) in all four.
+TEAR_CLASS_NONE = "none"              # k == 0: clean fsync boundary
+TEAR_CLASS_BOUNDARY = "boundary"      # cut exactly between two records
+TEAR_CLASS_MID_HEADER = "mid_header"  # cut inside a record's 8-byte header
+TEAR_CLASS_MID_PAYLOAD = "mid_payload"  # cut inside a record's payload
+TEAR_CLASSES = (
+    TEAR_CLASS_NONE,
+    TEAR_CLASS_BOUNDARY,
+    TEAR_CLASS_MID_HEADER,
+    TEAR_CLASS_MID_PAYLOAD,
+)
+
+
+def classify_tear(frame_sizes: List[int], keep: int) -> str:
+    """Which taxonomy class a cut at ``keep`` bytes into a volatile tail
+    made of frames of the given sizes falls in (tests sweep every offset
+    and assert all classes are exercised)."""
+    if keep <= 0:
+        return TEAR_CLASS_NONE
+    off = 0
+    for size in frame_sizes:
+        if keep == off + size:
+            return TEAR_CLASS_BOUNDARY
+        if keep < off + size:
+            inside = keep - off
+            return (
+                TEAR_CLASS_MID_HEADER
+                if inside < _HEADER.size
+                else TEAR_CLASS_MID_PAYLOAD
+            )
+        off += size
+    return TEAR_CLASS_BOUNDARY  # past every frame: the whole tail survived
+
+
+class SimWAL(WAL):
+    """In-memory WAL with live-WAL crash semantics.
+
+    Same framing, same repair, same fault sites as ``BaseWAL``
+    (consensus/wal.py) — the file is a byte buffer and fsync is a
+    watermark instead of a syscall, so hundreds of instances are free.
+    ``crash()`` is the simulated power cut: fsynced bytes survive, a
+    seeded prefix of the volatile tail survives (possibly torn
+    mid-frame), the rest is gone; the next ``start()`` repairs the torn
+    tail exactly like a live restart does.
+
+    The buffer self-prunes to the previous ENDHEIGHT sentinel on every
+    height close (``BaseWAL.prune_to_height``'s bounded-slack behavior,
+    automatic) so long simulations stay O(heights-in-flight) per node,
+    while replay's contract — ``search_for_end_height(h-1)`` finds the
+    sentinel for the in-flight height h — always holds.
+    """
+
+    def __init__(self, logger=None, auto_prune: bool = True):
+        self._buf = bytearray()
+        self._durable = 0  # fsync watermark: bytes that survive any crash
+        self._open = False
+        self._crashed = False
+        self._end_offsets: Dict[int, int] = {}  # height -> ENDHEIGHT frame offset
+        self._auto_prune = auto_prune
+        self.torn_repairs = 0
+        self.crash_count = 0
+        self.records_written = 0
+        self.logger = logger or get_logger("simwal")
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self) -> None:
+        self._crashed = False
+        self._repair_torn_tail()
+        self._open = True
+        if not self._buf:
+            # a fresh log begins with ENDHEIGHT 0 (reference wal.go:108)
+            self.write_sync(EndHeightMessage(0))
+
+    def stop(self) -> None:
+        # a crashed WAL must NOT flush on stop: the un-fsynced tail is
+        # exactly what the crash is supposed to lose
+        if self._open and not self._crashed:
+            self.flush_and_sync()
+        self._open = False
+
+    def _repair_torn_tail(self) -> None:
+        good_end = 0
+        fp = io.BytesIO(bytes(self._buf))
+        try:
+            for _offset, _data in iter_records(fp):
+                good_end = fp.tell()
+        except DataCorruptionError as e:
+            self.logger.info(
+                "sim WAL torn tail, truncating", err=str(e), keep=good_end
+            )
+        if good_end < len(self._buf):
+            # truncated header (clean EOF to the decoder) or corrupt
+            # record — either way a torn tail was repaired
+            self.torn_repairs += 1
+            del self._buf[good_end:]
+        # everything that remains is, by definition, on disk
+        self._durable = len(self._buf)
+        self._end_offsets = {
+            h: o for h, o in self._end_offsets.items() if o < good_end
+        }
+
+    # -- writing -----------------------------------------------------------
+
+    def _framed(self, msg) -> bytes:
+        """Frame a record, memoizing on the shared inner message: one
+        broadcast gossip message is WAL-written by EVERY receiving node
+        (256 identical encodes per vote at fleet scale). The MsgInfo
+        wrapper itself is per-delivery, but its (inner msg, peer_id)
+        content is not — consensus messages are immutable once sent
+        (they are already aliased across nodes), so the frame is too."""
+        if type(msg) is MsgInfo:
+            inner = msg.msg
+            memo = getattr(inner, "_sim_wal_frames", None)
+            if memo is None:
+                try:
+                    memo = inner._sim_wal_frames = {}
+                except Exception:  # slotted/frozen message: just encode
+                    return frame_record(encode_msg(msg))
+            frame = memo.get(msg.peer_id)
+            if frame is None:
+                frame = memo[msg.peer_id] = frame_record(encode_msg(msg))
+            return frame
+        return frame_record(encode_msg(msg))
+
+    def write(self, msg) -> None:
+        if not self._open:
+            return
+        try:
+            faults.maybe("wal.write")
+            data = self._framed(msg)
+            # same torn-write injection contract as BaseWAL.write: the
+            # truncated prefix is written AND made durable, then the
+            # fault propagates like the crash would; start() repairs.
+            torn = faults.tear("wal.fsync", data)
+            if torn is not None:
+                self._buf += torn
+                self.flush_and_sync()
+                raise faults.InjectedFault(
+                    f"torn WAL write ({len(torn)}/{len(data)} bytes)"
+                )
+            if isinstance(msg, EndHeightMessage):
+                self._end_offsets[msg.height] = len(self._buf)
+            self._buf += data
+            self.records_written += 1
+        except (WALWriteError, faults.InjectedFault):
+            raise
+        except Exception as e:
+            raise WALWriteError(str(e))
+
+    def write_sync(self, msg) -> None:
+        self.write(msg)
+        self.flush_and_sync()
+        if self._auto_prune and isinstance(msg, EndHeightMessage):
+            self._prune_before(msg.height - 1)
+
+    def flush_and_sync(self) -> None:
+        if not self._open:
+            return
+        faults.maybe("wal.fsync")
+        self._durable = len(self._buf)
+
+    def _prune_before(self, height: int) -> None:
+        """Drop records before ENDHEIGHT(height) — one height of slack,
+        so replay of the in-flight height always finds its sentinel."""
+        off = self._end_offsets.get(height)
+        if not off:
+            return  # unknown or already at the front
+        del self._buf[:off]
+        self._durable = max(self._durable - off, 0)
+        self._end_offsets = {
+            h: o - off for h, o in self._end_offsets.items() if o >= off
+        }
+
+    # -- the simulated power cut -------------------------------------------
+
+    def crash(self, keep_volatile: Optional[int] = None, rng=None) -> int:
+        """Drop writes past the last fsync boundary, keeping a prefix of
+        the volatile tail (``keep_volatile`` bytes; seeded from ``rng``
+        when None — 0 without one). The kept prefix may cut a record
+        mid-frame: the torn tail ``start()`` repairs. Returns the number
+        of volatile bytes that survived."""
+        volatile = len(self._buf) - self._durable
+        if keep_volatile is None:
+            keep_volatile = rng.randint(0, volatile) if (rng and volatile) else 0
+        keep_volatile = max(0, min(volatile, keep_volatile))
+        del self._buf[self._durable + keep_volatile:]
+        # what survived the cut is on disk now
+        self._durable = len(self._buf)
+        self._open = False
+        self._crashed = True
+        self.crash_count += 1
+        return keep_volatile
+
+    # -- reading -----------------------------------------------------------
+
+    def iter_messages(self, strict: bool = True) -> Iterator[object]:
+        fp = io.BytesIO(bytes(self._buf))
+        it = iter_records(fp)
+        while True:
+            try:
+                _, data = next(it)
+            except StopIteration:
+                break
+            except DataCorruptionError:
+                if strict:
+                    raise
+                return
+            yield decode_msg(data)
+
+    def search_for_end_height(self, height: int) -> Tuple[Optional[list], bool]:
+        msgs_after: Optional[list] = None
+        for msg in self.iter_messages(strict=False):
+            if isinstance(msg, EndHeightMessage) and msg.height == height:
+                msgs_after = []
+            elif msgs_after is not None:
+                msgs_after.append(msg)
+        if msgs_after is None:
+            return None, False
+        return msgs_after, True
+
+    # -- introspection -----------------------------------------------------
+
+    @property
+    def size_bytes(self) -> int:
+        return len(self._buf)
+
+    @property
+    def durable_bytes(self) -> int:
+        return self._durable
+
+    @property
+    def volatile_bytes(self) -> int:
+        return len(self._buf) - self._durable
+
+    def frame_sizes(self, from_offset: int = 0) -> List[int]:
+        """Sizes of the well-formed frames from ``from_offset`` on (test
+        helper for the tear taxonomy sweep; stops at a torn frame)."""
+        out = []
+        pos = from_offset
+        while pos + _HEADER.size <= len(self._buf):
+            _crc, length = _HEADER.unpack(self._buf[pos:pos + _HEADER.size])
+            size = _HEADER.size + length
+            if pos + size > len(self._buf):
+                break
+            out.append(size)
+            pos += size
+        return out
+
+
+class DurableDB(MemDB):
+    """MemDB with a simulated fsync boundary.
+
+    Writes are volatile until ``sync()`` — which ``set_sync``,
+    ``delete_sync`` and ``batch.write_sync()`` call, i.e. exactly the
+    operations the stores already use for their durability points
+    (``BlockStore.save_block``'s atomic batch, ``StateStore.save``'s
+    state record). ``crash()`` rolls the journal back to the last sync.
+    The journal holds prior values, so a crash is O(writes since last
+    sync), never O(database)."""
+
+    def __init__(self):
+        super().__init__()
+        self._undo: List[Tuple[bytes, Optional[bytes]]] = []
+        self.sync_count = 0
+        self.crash_count = 0
+
+    def set(self, key: bytes, value: bytes) -> None:
+        with self._lock:
+            self._undo.append((bytes(key), self._data.get(key)))
+            super().set(key, value)
+
+    def delete(self, key: bytes) -> None:
+        with self._lock:
+            if key in self._data:
+                self._undo.append((bytes(key), self._data[key]))
+            super().delete(key)
+
+    def sync(self) -> None:
+        with self._lock:
+            self._undo.clear()
+            self.sync_count += 1
+
+    def crash(self) -> None:
+        """Roll back every write since the last sync (newest first, so
+        multiple writes to one key restore the pre-sync value)."""
+        with self._lock:
+            undo, self._undo = self._undo, []
+            for key, prior in reversed(undo):
+                if prior is None:
+                    MemDB.delete(self, key)
+                else:
+                    MemDB.set(self, key, prior)
+            self._undo.clear()  # the rollback's own journal entries
+            self.crash_count += 1
+
+    def volatile_writes(self) -> int:
+        with self._lock:
+            return len(self._undo)
+
+
+class _MemorySignState(FilePVLastSignState):
+    """FilePV's last-sign-state without the file: the NodeDomain keeps
+    the instance across crash/rebuild, which IS the persistence (a real
+    node's privval state file survives a crash too)."""
+
+    def save(self) -> None:
+        pass
+
+
+class GuardedPV:
+    """A test signer behind FilePV's double-sign protection.
+
+    WAL replay re-drives the transitions that signed our votes, so a
+    rebuilt node WILL ask to sign the same (height, round, step) again
+    — with a later timestamp. FilePV's discipline resolves this exactly
+    like production: identical payload → same signature back;
+    timestamp-only difference → the persisted timestamp+signature are
+    reused; genuinely conflicting payload → ``ErrDoubleSign`` (the
+    consensus signing path logs and proceeds without our vote). Nodes
+    the schedule marks byzantine keep their raw unguarded signer —
+    equivocation is their job."""
+
+    def __init__(self, inner):
+        self.inner = inner
+        self.priv_key = inner.priv_key
+        self._pv = FilePV(
+            FilePVKey(
+                address=inner.address(),
+                pub_key=inner.get_pub_key(),
+                priv_key=inner.priv_key,
+                file_path="",
+            ),
+            _MemorySignState(),
+        )
+
+    def get_pub_key(self):
+        return self.inner.get_pub_key()
+
+    def address(self) -> bytes:
+        return self.inner.address()
+
+    def sign_vote(self, chain_id: str, vote) -> None:
+        self._pv.sign_vote(chain_id, vote)
+
+    def sign_proposal(self, chain_id: str, proposal) -> None:
+        self._pv.sign_proposal(chain_id, proposal)
+
+
+@dataclass
+class NodeDomain:
+    """One simulated node's durability domain: the WAL, the block /
+    state / evidence store DBs, and the seeded RNG that decides torn-cut
+    offsets. Created once per node; survives every crash/rebuild cycle
+    (it IS the node's disk)."""
+
+    wal: SimWAL
+    block_db: DurableDB
+    state_db: DurableDB
+    evidence_db: DurableDB
+    rng: random.Random
+    crash_count: int = 0
+    torn_kept_bytes: List[int] = field(default_factory=list)
+
+    @classmethod
+    def create(cls, seed: int, idx: int) -> "NodeDomain":
+        # per-domain stream seeded like faultinject's per-site RNGs:
+        # (run seed, domain name) — crashes of OTHER nodes never shift
+        # this node's torn offsets
+        rng = random.Random(int(seed) ^ zlib.crc32(f"domain-{idx}".encode()))
+        return cls(SimWAL(), DurableDB(), DurableDB(), DurableDB(), rng)
+
+    def crash(self) -> int:
+        """The power cut: WAL loses its un-fsynced tail (a seeded torn
+        prefix survives), stores roll back to their last sync. Returns
+        the torn bytes kept (telemetry / determinism tests)."""
+        self.crash_count += 1
+        kept = self.wal.crash(rng=self.rng)
+        self.torn_kept_bytes.append(kept)
+        for db in (self.block_db, self.state_db, self.evidence_db):
+            db.crash()
+        return kept

@@ -22,8 +22,8 @@ Two pieces make hundreds of nodes affordable on one host:
   verification is a hash lookup. Pre-verification is an optimization
   only: any row it cannot attribute (or a pipeline liveness failure)
   simply falls through to the node's own serial verify.
-- **Catchup replay**: a node that missed a commit (partition,
-  isolation-crash) can never rejoin through live gossip alone — the
+- **Catchup replay**: a node that missed a commit (partition, crash)
+  can never rejoin through live gossip alone — the
   network has moved on. After a heal/restart the net replays, through
   the normal delivery path, the stored seen-commit precommits and
   block parts for each height the laggard is missing (the simulator's
@@ -127,6 +127,23 @@ class SimNet:
         self._height_hooks: List[Tuple[int, object]] = []  # (at_h, fn)
         self._catchup_timer = None
         self._last_fed: Dict[int, Tuple[int, int]] = {}  # node -> (height, t_ns)
+        # front-height gossip buffer: the live reactor RE-SENDS votes/
+        # parts to peers that are behind (gossipVotesRoutine); a one-shot
+        # simulator must too, or a node that rejoins mid-round (crash
+        # replay, catchup) has silently discarded the front's gossip as
+        # wrong-height and — when the quorum has no slack — wedges the
+        # whole net. Keyed by height; (ordered msgs, id-dedup set).
+        self._front_msgs: Dict[int, Tuple[List[Tuple[int, object]], Set[int]]] = {}
+        # nodes that recovered through catchup/restart: when one commits
+        # the current net height (reaches the front), the buffered front
+        # gossip is re-delivered to it once
+        self._regossip_pending: Set[int] = set()
+        # replay-crash hooks (sim/core.py): on_crash(node) tears the
+        # node's ConsensusState down (its durability domain survives);
+        # on_restart(node) rebuilds it from handshake + WAL replay and
+        # then calls mark_restarted. Isolation crashes bypass both.
+        self.on_crash = None
+        self.on_restart = None
 
         # event trace: full list (optional) + running digest (always)
         self.events: List[tuple] = []
@@ -141,6 +158,10 @@ class SimNet:
         self.commit_times: Dict[int, Dict[int, int]] = {}  # node -> h -> t_ns
         self.txs_committed = 0
         self.partition_windows: List[dict] = []
+        self.wal_replays = 0  # replay-crash rebuilds completed
+        self.wal_replayed_msgs = 0  # WAL messages re-driven across them
+        self.evidence_heights: Set[int] = set()  # heights with committed evidence
+        self.restart_times: Dict[int, List[int]] = {}  # node -> restart t_ns list
 
         # sim-wide: spans heights, so a larger bound than a VoteSet's
         self._tpl_cache = signbytes.TemplateCache(bound=4096)
@@ -153,6 +174,7 @@ class SimNet:
         block_stores: List,
         n_validators: int,
         node_caches: Optional[List[SigCache]] = None,
+        heights: Optional[int] = None,
     ) -> None:
         self.nodes = list(cs_list)
         self.block_stores = list(block_stores)
@@ -162,7 +184,7 @@ class SimNet:
         # verified delivery, so inline ingest at the receiver is a hash
         # lookup. None disables warming (and with it pre-verification).
         self.node_caches = list(node_caches) if node_caches else []
-        self.schedule.bind(len(self.nodes), self.n_validators)
+        self.schedule.bind(len(self.nodes), self.n_validators, heights=heights)
 
     def add_height_hook(self, at_h: int, fn) -> None:
         """Run ``fn()`` once when the network height first reaches
@@ -197,6 +219,11 @@ class SimNet:
             self._schedule_delivery(now + self._quantum_ns, src, dst, msg)
             return
         kind, h, r = _msg_kind(msg)
+        if h == self.net_height + 1:
+            # front-height consensus gossip: keep one copy per message
+            # for re-delivery to late joiners (loss/partition drops are
+            # buffered too — re-gossip IS the reactor's retransmission)
+            self._buffer_front(src, msg, h)
         if src in self._crashed or dst in self._crashed:
             self._drop(now, src, dst, kind, h, r, "crashed")
             return
@@ -210,6 +237,34 @@ class SimNet:
         if jitter_ms > 0.0:
             delay_ms += self._rng.random() * jitter_ms
         self._schedule_delivery(now + int(delay_ms * 1e6), src, dst, msg)
+
+    def _buffer_front(self, src: int, msg, h: int) -> None:
+        msgs, seen = self._front_msgs.setdefault(h, ([], set()))
+        # id-dedup is safe: the first occurrence keeps a strong ref, so
+        # a live id can never be reused by a different message
+        if id(msg) not in seen:
+            seen.add(id(msg))
+            msgs.append((src, msg))
+
+    def _regossip_front(self, dst: int) -> None:
+        """Re-deliver the buffered front-height gossip to a node that
+        just caught up to the net height — the deterministic stand-in
+        for the reactor's per-peer gossip routines. Duplicates are
+        benign (VoteSet/PartSet dedupe); partition/crash severing still
+        applies; loss does not (retransmission retries until it lands)."""
+        h = self.net_height + 1
+        entry = self._front_msgs.get(h)
+        if entry is None:
+            return
+        now = self.clock.time_ns()
+        n = 0
+        for src, msg in entry[0]:
+            if src == dst or src in self._crashed or self._severed(src, dst):
+                continue
+            self._schedule_delivery(now + self._quantum_ns, src, dst, msg)
+            n += 1
+        if n:
+            self._event("regossip", now, dst, h, n)
 
     def _severed(self, a: int, b: int) -> bool:
         if not self._cut:
@@ -442,7 +497,8 @@ class SimNet:
     # -- network-event state machine ---------------------------------------
 
     def notify_commit(
-        self, node: int, height: int, block_hash: bytes, txs: int = 0
+        self, node: int, height: int, block_hash: bytes, txs: int = 0,
+        evidence: int = 0,
     ) -> None:
         """Called (synchronously, from the committing node's receive
         routine) for every commit; drives the height-triggered schedule
@@ -451,10 +507,20 @@ class SimNet:
         self.commit_hashes.setdefault(node, {})[height] = block_hash
         self.commit_times.setdefault(node, {})[height] = t
         self.txs_committed += int(txs)
-        self._event("commit", t, node, height, block_hash[:8].hex(), txs)
+        if evidence:
+            self.evidence_heights.add(height)
+        self._event("commit", t, node, height, block_hash[:8].hex(), txs, evidence)
         if height <= self.net_height:
+            if height == self.net_height and node in self._regossip_pending:
+                # a recovering node reached the front: hand it the
+                # current round's gossip it missed while behind
+                self._regossip_pending.discard(node)
+                self._regossip_front(node)
             return
         self.net_height = height
+        self._regossip_pending.discard(node)  # the front itself needs nothing
+        for h in [h for h in self._front_msgs if h <= height]:
+            del self._front_msgs[h]
         # activate pending partitions / heal active ones
         for p in list(self._partitions):
             if height >= p.at_h:
@@ -482,13 +548,24 @@ class SimNet:
                 self._crashes.remove(c)
                 self._active_crashes.append(c)
                 self._crashed.add(c.node)
-                self._event("crash", t, c.node)
+                self._event("crash", t, c.node, c.mode)
+                if c.mode == "replay" and self.on_crash is not None:
+                    # the driver tears the ConsensusState down; until
+                    # mark_restarted the node is gone from the net
+                    self.on_crash(c.node)
         for c in list(self._active_crashes):
             if height >= c.restart_h:
                 self._active_crashes.remove(c)
-                self._crashed.discard(c.node)
-                self._event("restart", t, c.node)
-                self._start_catchup()
+                if c.mode == "replay" and self.on_restart is not None:
+                    # rebuild (handshake + WAL replay) happens in the
+                    # driver; it calls mark_restarted when the node is
+                    # live again — the node stays severed meanwhile
+                    self.on_restart(c.node)
+                else:
+                    self._crashed.discard(c.node)
+                    self._event("restart", t, c.node)
+                    self._regossip_pending.add(c.node)
+                    self._start_catchup()
         while self._height_hooks and height >= self._height_hooks[0][0]:
             _h, fn = self._height_hooks.pop(0)
             fn()
@@ -498,6 +575,21 @@ class SimNet:
         # post-heal courtesy
         if self._lagging():
             self._start_catchup()
+
+    def mark_restarted(self, node: int, replayed_msgs: int = 0) -> None:
+        """A replay-crashed node finished its rebuild (handshake + WAL
+        replay) and is reachable again — called by the driver's restart
+        task, still inside the same simulated instant the restart
+        triggered in (the rebuild is pure host work)."""
+        t = self.clock.time_ns()
+        self._crashed.discard(node)
+        self.wal_replays += 1
+        self.wal_replayed_msgs += int(replayed_msgs)
+        self.restart_times.setdefault(node, []).append(t)
+        self._event("wal_replay", t, node, replayed_msgs)
+        self._event("restart", t, node)
+        self._regossip_pending.add(node)
+        self._start_catchup()
 
     # -- catchup replay ----------------------------------------------------
 
@@ -541,6 +633,7 @@ class SimNet:
             if seen is None:
                 continue
             self._last_fed[i] = (h, now)
+            self._regossip_pending.add(i)
             self._event("catchup", now, i, h)
             # precommits first (the laggard enters commit and allocates
             # the PartSet from the majority header), then the parts
@@ -590,4 +683,7 @@ class SimNet:
             "pending": len(self._heap),
             "crashed": len(self._crashed),
             "cut": len(self._cut),
+            "wal_replays": self.wal_replays,
+            "wal_replayed_msgs": self.wal_replayed_msgs,
+            "evidence_heights": len(self.evidence_heights),
         }

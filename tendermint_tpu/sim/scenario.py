@@ -48,7 +48,8 @@ _KNOWN_KEYS = {
 }
 _KNOWN_EXPECT = {
     "safety", "liveness", "majority_advances", "txs_committed",
-    "rotation_applied",
+    "rotation_applied", "wal_replayed", "evidence_committed",
+    "churn_applied",
 }
 _APPS = {"kvstore", "persistent_kvstore"}
 
@@ -157,11 +158,16 @@ def load_scenario(path_or_name: str) -> Scenario:
         notes=kv.get("notes", ""),
     )
     try:
-        parse_schedule(sc.schedule)
+        parsed = parse_schedule(sc.schedule)
     except ScheduleError as e:
         raise ValueError(f"{path}: bad schedule: {e}") from e
     if sc.rotate is not None and not 0 <= sc.rotate["validator"] < sc.validators:
         raise ValueError(f"{path}: rotate validator index out of range")
+    if parsed.churn and app != "persistent_kvstore":
+        raise ValueError(
+            f"{path}: churn requires app = persistent_kvstore (valset "
+            "entry/exit rides the rotation-tx format)"
+        )
     return sc
 
 
@@ -298,6 +304,41 @@ def evaluate(sc: Scenario, sim: Simulation, res: SimResult) -> List[str]:
         elif base == "txs_committed":
             if net.txs_committed <= 0:
                 fails.append("no transactions were committed")
+        elif base == "wal_replayed":
+            # every replay-mode crash the schedule fired must have come
+            # back through a WAL-replay rebuild (not isolation rejoin)
+            want = sum(1 for c in sim.schedule.crashes if c.mode == "replay")
+            if net.wal_replays < want:
+                fails.append(
+                    f"only {net.wal_replays}/{want} scheduled crashes "
+                    "recovered via WAL replay"
+                )
+        elif base == "evidence_committed":
+            if not net.evidence_heights:
+                fails.append("no evidence was committed into any block")
+            elif arg and min(net.evidence_heights) > int(arg):
+                fails.append(
+                    f"first evidence committed at h{min(net.evidence_heights)}, "
+                    f"expected within h{arg}"
+                )
+        elif base == "churn_applied":
+            for ch in sim.schedule.churn:
+                addr = sim.privs[ch.node].address()
+                for i, node in enumerate(sim.nodes):
+                    _, val = node.cs.state.validators.get_by_address(addr)
+                    if ch.kind == "join":
+                        ok = val is not None and val.voting_power == ch.power
+                        want = f"power {ch.power}"
+                    else:
+                        ok = val is None
+                        want = "absent"
+                    if not ok:
+                        got = val.voting_power if val is not None else "absent"
+                        fails.append(
+                            f"node{i}: churn {ch.kind} of node {ch.node} not "
+                            f"applied (want {want}, got {got})"
+                        )
+                        break
         elif base == "rotation_applied":
             rot = sc.rotate or {}
             pv = sim.privs[rot.get("validator", 0)]

@@ -16,7 +16,10 @@ Grammar (documented with worked examples in docs/simulator.md):
     link(*,*):loss:p=0.01                # seeded random drop
     partition:at_h=12,heal_h=15,frac=0.33
     partition:at_h=12,heal_h=15,cut=5-7|12
-    crash:node=7,at_h=20,restart_h=24    # isolation-crash + rejoin
+    crash:node=7,at_h=20,restart_h=24    # true crash: WAL replay rebuild
+    crash:node=7,at_h=20,restart_h=24,mode=isolation  # memory survives
+    churn:node=9,kind=join,at_h=6,power=15  # valset entry via rotation tx
+    churn:node=2,kind=leave,at_h=10         # valset exit (power 0 tx)
     byz:node=0,kind=double_sign,at_h=2   # or kind=amnesia
     load:txs=64,at_h=3,size=32           # flash-crowd tx burst
     quantum:ms=1                         # delivery-time quantization
@@ -35,6 +38,21 @@ Height triggers (``at_h``/``heal_h``/``restart_h``) fire when the
 *network height* — the maximum committed height across nodes — first
 reaches the value: "partition at commit of height 12" in the ISSUE's
 sense.
+
+``crash`` defaults to ``mode=replay`` — a TRUE crash: the node's
+``ConsensusState`` (and app, mempool, input queue, signature cache) is
+torn down; only its durability domain survives (sim/durability.py:
+fsynced WAL prefix + possibly-torn tail, synced store writes, the
+privval sign state), and on ``restart_h`` the node is rebuilt through
+the live restart path (handshake + WAL replay) before rejoining via
+catchup. ``mode=isolation`` keeps the PR-13 behavior (memory intact,
+messages severed) for GC-pause/netsplit experiments.
+
+``churn`` expresses long-horizon validator-set drift as data: at
+``at_h`` a ``val:<pubkeyB64>!<power>`` rotation tx for the named
+node's key (``join`` with ``power``, ``leave`` with power 0) is
+broadcast into every mempool — requires the rotation-capable app
+(``persistent_kvstore``).
 """
 
 from __future__ import annotations
@@ -45,8 +63,10 @@ from typing import Dict, List, Optional, Set, Tuple
 DEFAULT_DELAY_MS = 10.0
 DEFAULT_QUANTUM_MS = 1.0
 
-_VERBS = {"link", "partition", "crash", "byz", "load", "quantum"}
+_VERBS = {"link", "partition", "crash", "churn", "byz", "load", "quantum"}
 _BYZ_KINDS = {"double_sign", "amnesia"}
+_CRASH_MODES = {"replay", "isolation"}
+_CHURN_KINDS = {"join", "leave"}
 
 
 class ScheduleError(ValueError):
@@ -150,6 +170,16 @@ class CrashEvent:
     node: int
     at_h: int
     restart_h: int
+    mode: str = "replay"  # replay (true crash + WAL rebuild) | isolation
+    item: str = ""
+
+
+@dataclass
+class ChurnEvent:
+    node: int
+    kind: str  # join | leave
+    at_h: int
+    power: int = 10
     item: str = ""
 
 
@@ -178,14 +208,20 @@ class Schedule:
     links: List[LinkRule] = field(default_factory=list)
     partitions: List[PartitionEvent] = field(default_factory=list)
     crashes: List[CrashEvent] = field(default_factory=list)
+    churn: List[ChurnEvent] = field(default_factory=list)
     byz: List[ByzEvent] = field(default_factory=list)
     loads: List[LoadEvent] = field(default_factory=list)
     quantum_ms: float = DEFAULT_QUANTUM_MS
 
-    def bind(self, n_nodes: int, n_validators: int) -> None:
+    def bind(
+        self, n_nodes: int, n_validators: int, heights: Optional[int] = None
+    ) -> None:
         """Validate every node reference against the run size (raises
         ScheduleError) — schedule problems surface before the first
-        simulated nanosecond."""
+        simulated nanosecond. When the run's height horizon is known
+        (``heights``), a crash whose ``restart_h`` lies beyond it is
+        rejected too: such a node would silently never restart, and the
+        eventual liveness failure gives no hint at the cause."""
         for p in self.partitions:
             cut = p.cut_set(n_nodes, n_validators)
             if not cut or len(cut) >= n_nodes:
@@ -210,6 +246,40 @@ class Schedule:
                 raise ScheduleError(f"{c.item!r}: node {c.node} out of range")
             if c.restart_h <= c.at_h:
                 raise ScheduleError(f"{c.item!r}: restart_h must be > at_h")
+            if heights is not None and c.restart_h > heights:
+                raise ScheduleError(
+                    f"{c.item!r}: restart_h {c.restart_h} is beyond the run's "
+                    f"height horizon ({heights}) — the node would never "
+                    "restart and a liveness expectation then fails with no "
+                    "hint at the cause"
+                )
+        by_node: Dict[int, List[CrashEvent]] = {}
+        for c in self.crashes:
+            by_node.setdefault(c.node, []).append(c)
+        for node, evs in by_node.items():
+            evs.sort(key=lambda c: c.at_h)
+            for a, b in zip(evs, evs[1:]):
+                # strictly after: at the SAME trigger height crashes
+                # activate before restarts (net state machine order), so
+                # b.at_h == a.restart_h would kill the node an instant
+                # before its rebuild fires and rebuild it into its own
+                # down window
+                if b.at_h <= a.restart_h:
+                    raise ScheduleError(
+                        f"overlapping crash windows for node {node}: "
+                        f"{a.item!r} and {b.item!r} (a node cannot crash "
+                        "while already down or at its own restart height "
+                        "— sequence them instead)"
+                    )
+        for ch in self.churn:
+            if ch.node >= n_nodes:
+                raise ScheduleError(f"{ch.item!r}: node {ch.node} out of range")
+            if heights is not None and ch.at_h > heights:
+                raise ScheduleError(
+                    f"{ch.item!r}: at_h {ch.at_h} is beyond the run's height "
+                    f"horizon ({heights}) — the churn would silently never "
+                    "fire and churn_applied then fails with no hint"
+                )
         for b in self.byz:
             if b.node >= n_validators:
                 raise ScheduleError(
@@ -308,11 +378,37 @@ def parse_schedule(spec: str) -> Schedule:
                     raise ScheduleError(f"{item!r}: partition needs frac in (0,1) or cut=")
             sched.partitions.append(ev)
         elif verb == "crash":
+            mode = kv.pop("mode", "replay")
+            if mode not in _CRASH_MODES:
+                raise ScheduleError(
+                    f"{item!r}: crash mode must be one of {sorted(_CRASH_MODES)}"
+                )
             sched.crashes.append(
                 CrashEvent(
                     node=_parse_int(item, kv, "node", None),
                     at_h=_parse_int(item, kv, "at_h", None),
                     restart_h=_parse_int(item, kv, "restart_h", None),
+                    mode=mode,
+                    item=item,
+                )
+            )
+        elif verb == "churn":
+            kind = kv.pop("kind", "")
+            if kind not in _CHURN_KINDS:
+                raise ScheduleError(
+                    f"{item!r}: churn kind must be one of {sorted(_CHURN_KINDS)}"
+                )
+            power = _parse_int(item, kv, "power", 10 if kind == "join" else 0)
+            if kind == "join" and power <= 0:
+                raise ScheduleError(f"{item!r}: join power must be positive")
+            if kind == "leave" and power != 0:
+                raise ScheduleError(f"{item!r}: leave takes no power (exit is power 0)")
+            sched.churn.append(
+                ChurnEvent(
+                    node=_parse_int(item, kv, "node", None),
+                    kind=kind,
+                    at_h=_parse_int(item, kv, "at_h", None),
+                    power=power,
                     item=item,
                 )
             )

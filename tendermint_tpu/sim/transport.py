@@ -53,16 +53,24 @@ def wire_mesh(cs_list: List, transport) -> None:
     so the internal fsync/halt semantics are untouched) — which lets
     the net's shared pre-verification bundle cover the signer's own
     inline verify as well."""
-    delivers_self = bool(getattr(transport, "delivers_self", False))
     for i, cs in enumerate(cs_list):
-        orig = cs.send_internal
+        wire_one(cs, i, transport)
 
-        if delivers_self:
-            def send(msg, _i=i, _t=transport):
-                _t.broadcast(_i, msg)
-        else:
-            def send(msg, _orig=orig, _i=i, _t=transport):
-                _orig(msg)
-                _t.broadcast(_i, msg)
 
-        cs.send_internal = send
+def wire_one(cs, index: int, transport) -> None:
+    """Wire ONE node into a transport under a fixed source index — the
+    per-node half of :func:`wire_mesh`, also used when the simulator
+    rebuilds a crashed node's ``ConsensusState`` mid-run (the new
+    instance must broadcast as the same node)."""
+    delivers_self = bool(getattr(transport, "delivers_self", False))
+    orig = cs.send_internal
+
+    if delivers_self:
+        def send(msg, _i=index, _t=transport):
+            _t.broadcast(_i, msg)
+    else:
+        def send(msg, _orig=orig, _i=index, _t=transport):
+            _orig(msg)
+            _t.broadcast(_i, msg)
+
+    cs.send_internal = send

@@ -236,6 +236,35 @@ def test_guard_flags_sim_regression_and_disappearance(bench):
     assert bench._regression_guard({"sim_heights_per_sec": 11.0}, "tpu") == []
 
 
+def test_guard_flags_sim_recovery_regression_and_disappearance(bench):
+    """The crash-recovery drill key rides the guard: a recovery time
+    that regresses (grows) beyond tolerance or goes missing must
+    hard-fail the bench — recovery latency is the number the durable
+    simulated-node track exists to hold down."""
+    _write_record(bench, sim_recovery_s=0.2)
+    fails = bench._regression_guard({"sim_recovery_s": 0.5}, "tpu")
+    assert len(fails) == 1 and "sim_recovery_s" in fails[0]
+    fails = bench._regression_guard({"sim_recovery_error": "wedged"}, "tpu")
+    assert any("sim_recovery_s" in f and "missing" in f for f in fails)
+    # within tolerance (lower-is-better: small growth ok, shrink ok)
+    assert bench._regression_guard({"sim_recovery_s": 0.22}, "tpu") == []
+    assert bench._regression_guard({"sim_recovery_s": 0.1}, "tpu") == []
+
+
+def test_sim_recovery_bench_measures_kill_to_commit(bench):
+    """The recovery drill itself: a true crash (WAL-replay rebuild) of
+    a validator yields a positive simulated kill-to-first-commit time,
+    bounded by the drill's own height horizon."""
+    out = bench.sim_recovery_bench()
+    assert "sim_recovery_error" not in out, out
+    assert out["sim_recovery_s"] > 0
+    # the whole drill spans ~10 heights of simulated time; recovery is
+    # a slice of it, not a runaway
+    assert out["sim_recovery_s"] < 60.0, out
+    # the drill's seed pins a MID-HEIGHT kill: actual WAL tail replayed
+    assert out["sim_recovery_replayed_msgs"] > 0, out
+
+
 def test_sim_bench_heights_per_sec_floor(bench, monkeypatch):
     """The floor at test scale: the simulator must push simulated
     consensus at >= 2 heights per wall second on this box's CPU
@@ -248,6 +277,8 @@ def test_sim_bench_heights_per_sec_floor(bench, monkeypatch):
     assert out["sim_heights_per_sec"] >= 2.0, out
     assert out["sim_device_sigs_per_sec"] > 0
     assert out["sim_12x6_multi_source_bundles"] >= 1, out
+    # the recovery drill rides the section: kill-to-commit measured
+    assert out.get("sim_recovery_s", 0) > 0, out
 
 
 def test_guard_cpu_fallback_skips_loudly(bench):

@@ -409,8 +409,11 @@ def test_traced_run_exports_merged_observatory_trace():
 
 
 def test_crash_restart_recovers():
-    """Isolation-crash + restart: the crashed node hears nothing while
-    down, then catches back up through the net's replay feed."""
+    """The crash verb (default mode=replay, ISSUE 14): the crashed
+    node's ConsensusState is torn down, rebuilt from its durability
+    domain via WAL replay, and catches back up through the net's
+    catchup feed. (mode=isolation keeps the PR-13 memory-intact path —
+    tests/test_sim_durability.py pins both.)"""
     sim = Simulation(
         n_nodes=5, validators=4, heights=10, seed=3,
         schedule="link(*,*):delay:ms=8;crash:node=4,at_h=3,restart_h=6",
@@ -420,6 +423,7 @@ def test_crash_restart_recovers():
     assert res.completed and res.safety_ok()
     kinds = [e[0] for e in res.events]
     assert "crash" in kinds and "restart" in kinds and "catchup" in kinds
+    assert "wal_replay" in kinds
     assert res.heights[4] >= 10
 
 
@@ -429,11 +433,19 @@ def test_crash_restart_recovers():
 @pytest.mark.slow
 def test_partition_256_nodes_50_heights_under_budget():
     """ISSUE 13 acceptance: a 256-node, 50-height run under the
-    33%-partition-at-commit schedule completes in <60 s wall on this
-    box's CPU fallback, commits on the majority side, recovers after
-    heal, and two same-seed runs are bit-identical (commit hashes +
-    event-trace digest). Verify traffic demonstrably batches across
-    nodes on the shared engine."""
+    33%-partition-at-commit schedule completes within the wall budget
+    on this box's CPU fallback, commits on the majority side, recovers
+    after heal, and two same-seed runs are bit-identical (commit hashes
+    + event-trace digest). Verify traffic demonstrably batches across
+    nodes on the shared engine.
+
+    Budget history: <60 s when nodes kept no durable state (PR 13,
+    measured ~40 s). PR 14 gave every node a real durability domain —
+    per-delivery WAL framing, store journaling, evidence pools, boot
+    handshake (~65 s measured idle on this box) — so the pin is 90 s:
+    still catches a structural regression (the pre-memo WAL encode bug
+    measured +25 s), without failing on the cost the durable-node
+    tentpole deliberately added."""
     runs = []
     for _ in range(2):
         sc, sim, res, fails = run_scenario(
@@ -441,7 +453,7 @@ def test_partition_256_nodes_50_heights_under_budget():
         )
         assert fails == [], fails
         assert res.completed and res.safety_ok()
-        assert res.wall_seconds < 60.0, f"wall {res.wall_seconds:.1f}s"
+        assert res.wall_seconds < 90.0, f"wall {res.wall_seconds:.1f}s"
         assert res.engine["counters"]["multi_source_bundles"] > 0
         assert res.engine["counters"]["max_bundle_sources"] > 1
         runs.append(res)

@@ -55,6 +55,18 @@ def test_env_override_pins_provider(tmp_path):
             os.environ["TM_CRYPTO_PROVIDER"] = old
 
 
+def _on_accelerator() -> bool:
+    import jax
+
+    return jax.default_backend() != "cpu"
+
+
+@pytest.mark.skipif(
+    not _on_accelerator(),
+    reason="needs the accelerator backend: conftest pins the suite's JAX "
+    "to the virtual-CPU mesh, and the live TPU-provider node path is "
+    "covered by bench.py / dryrun_multichip on device",
+)
 def test_node_installs_tpu_provider_and_commits(tmp_path):
     """A node configured with crypto_provider=tpu installs the batched
     device verifier as the process default and commits heights whose
