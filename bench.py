@@ -293,6 +293,8 @@ _GUARD_KEYS = [
     ("bls_verify_speedup", "higher"),
     ("sim_heights_per_sec", "higher"),
     ("sim_recovery_s", "lower"),
+    ("mesh_sigs_per_sec", "higher"),
+    ("mesh_speedup", "higher"),
     ("coldstart_first_verify_s", None),   # presence-only: timing varies
     ("coldstart_tabled_first_s", None),
 ]
@@ -310,6 +312,8 @@ _KEY_SECTION_PLATFORM = {
     "bls_verify_speedup": "bls_platform",
     "sim_heights_per_sec": "sim_platform",
     "sim_recovery_s": "sim_platform",
+    "mesh_sigs_per_sec": "mesh_platform",
+    "mesh_speedup": "mesh_platform",
 }
 
 # provenance-mismatch skip notes from the LAST _regression_guard call —
@@ -454,6 +458,7 @@ def run_bench(platform: str, accelerator: bool = True):
             **_stamped("merkle", merkle_bench()),
             **_stamped("bls", bls_bench()),
             **_stamped("sim", sim_bench()),
+            **_stamped("mesh", mesh_bench(device=False)),
             **_stamped("degraded", degraded_mode_bench()),
             **_stamped("trace", trace_overhead_bench()),
             **({"guard_skips": GUARD_SKIPS} if GUARD_SKIPS else {}),
@@ -689,6 +694,9 @@ def run_bench(platform: str, accelerator: bool = True):
     # -- simulator: nodes x heights sweep on the deterministic net --------
     sim_extra = _stamped("sim", sim_bench())
 
+    # -- mesh runtime: weak scaling across the local device inventory -----
+    mesh_extra = _stamped("mesh", mesh_bench())
+
     # -- degraded mode: circuit-broken fallback + idle watchdog cost ------
     degraded_extra = _stamped("degraded", degraded_mode_bench())
 
@@ -773,6 +781,7 @@ def run_bench(platform: str, accelerator: bool = True):
         **merkle_extra,
         **bls_extra,
         **sim_extra,
+        **mesh_extra,
         **degraded_extra,
         **trace_extra,
         **aot_extra,
@@ -894,6 +903,136 @@ def merkle_bench() -> dict:
             _m.configure_device(False)
         except Exception:
             pass
+
+
+# -- mesh runtime: weak scaling across the local device inventory ----------
+#
+# The ISSUE-16 headline: VerifyCommit sharded over 1/2/4/8-device
+# meshes from the local inventory (virtual on CPU via
+# XLA_FLAGS=--xla_force_host_platform_device_count=8 works too). Every
+# size must produce bit-identical verdicts; the throughput keys feed
+# the regression guard like any other section. A single-device or
+# no-accelerator run SKIPS the sweep LOUDLY and still runs the
+# chunked-seam parity drill — mesh_platform provenance keeps a TPU
+# baseline from ever being judged against a CPU run.
+
+MESH_BENCH_N = int(
+    os.environ.get("TM_BENCH_MESH_N", "0")
+)  # 0 = pick by platform below
+MESH_SIZES = (1, 2, 4, 8)  # sweep points, capped by the local inventory
+
+
+def mesh_bench(device: bool = True) -> dict:
+    """Returns the mesh_* bench keys; never raises (the main line must
+    survive a broken mesh runtime — the guard then flags the missing
+    keys against the previous record)."""
+    out: dict = {}
+    try:
+        import numpy as np
+
+        from tendermint_tpu.crypto.batch import (
+            CPUBatchVerifier,
+            MeshRoutedVerifier,
+        )
+        from tendermint_tpu.parallel import DeviceTopology, MeshRouter
+
+        # chunked-seam parity drill: runs on EVERY backend (logical
+        # lanes, no XLA) so even a CPU-fallback bench still proves the
+        # router's split/concat seam cannot flip a verdict
+        n_par = 512
+        pks, msgs, sigs = make_batch(n_par)
+        sigs = sigs.copy()
+        sigs[5, 0] ^= 1
+        sigs[443, 9] ^= 0x40
+        want = CPUBatchVerifier().verify_batch(pks, msgs, sigs)
+        router = MeshRouter(DeviceTopology.logical(4), min_rows=4)
+        got = MeshRoutedVerifier(CPUBatchVerifier(), router).verify_batch(
+            pks, msgs, sigs
+        )
+        assert (got == want).all(), "mesh chunked-seam parity diverged"
+        assert router.stats()["collective_bundles"] == 1
+        assert not want[5] and not want[443] and int(want.sum()) == n_par - 2
+        out["mesh_parity_ok"] = 1
+
+        import jax
+
+        devs = jax.devices()
+        if not device and os.environ.get("TM_BENCH_FORCE_DEVICE") != "1":
+            out["mesh_skipped"] = (
+                "no accelerator: weak-scaling sweep needs the device path"
+            )
+            log(f"MESH SKIP: {out['mesh_skipped']}")
+            return out
+        if len(devs) < 2:
+            out["mesh_skipped"] = (
+                f"single {devs[0].platform} device: no mesh to scale across "
+                "(set XLA_FLAGS=--xla_force_host_platform_device_count=8 "
+                "for a virtual sweep)"
+            )
+            log(f"MESH SKIP: {out['mesh_skipped']}")
+            return out
+
+        from tendermint_tpu.models.verifier import VerifierModel
+        from tendermint_tpu.parallel import make_mesh
+
+        # CPU XLA grinds for minutes at 10k rows (see the fallback note
+        # in run_bench); the virtual-device sweep drops to 2048 unless
+        # TM_BENCH_MESH_N pins a size
+        n = MESH_BENCH_N or (
+            BENCH_N if devs[0].platform != "cpu" else 2048
+        )
+        pks, msgs, sigs = make_batch(n)
+        powers = np.full(n, 10, dtype=np.int64)
+        counted = np.ones(n, dtype=bool)
+        sizes = [d for d in MESH_SIZES if d <= len(devs)]
+        base_rate = rate = None
+        ok_ref = tally_ref = None
+        for d in sizes:
+            model = VerifierModel(
+                mesh=make_mesh(devs[:d]) if d > 1 else None,
+                block_on_compile=True,
+            )
+            ok, tally = model.verify_commit(
+                pks, msgs, sigs, powers, counted
+            )  # compile + warm
+            times = []
+            for _ in range(5):
+                t0 = time.perf_counter()
+                ok, tally = model.verify_commit(pks, msgs, sigs, powers, counted)
+                times.append(time.perf_counter() - t0)
+            p50 = sorted(times)[len(times) // 2]
+            ok = np.asarray(ok)
+            if ok_ref is None:
+                ok_ref, tally_ref = ok.copy(), int(tally)
+                assert ok_ref.all() and tally_ref == n * 10
+            else:
+                assert (ok == ok_ref).all() and int(tally) == tally_ref, (
+                    f"mesh@{d}dev: verdicts diverged from single-device"
+                )
+            rate = n / p50
+            if d == 1:
+                base_rate = rate
+            out[f"mesh_p50_ms_{d}dev"] = round(p50 * 1e3, 3)
+            log(
+                f"mesh weak-scaling {d} dev @ {n} rows: {p50*1e3:.1f} ms/commit "
+                f"({rate:,.0f} rows/s)"
+            )
+        out["mesh_devices_measured"] = sizes[-1]
+        out["mesh_rows"] = n
+        out["mesh_sigs_per_sec"] = round(rate)
+        out["mesh_speedup"] = round(rate / base_rate, 2)
+        log(
+            f"mesh weak scaling 1 -> {sizes[-1]} devices: "
+            f"{out['mesh_speedup']}x ({out['mesh_sigs_per_sec']:,} rows/s)"
+        )
+        return out
+    except Exception as ex:
+        import traceback
+
+        traceback.print_exc(file=sys.stderr)
+        log(f"mesh measurement failed: {ex!r}")
+        out["mesh_error"] = repr(ex)[:200]
+        return out
 
 
 # -- degraded mode: circuit-broken device path + idle watchdog cost --------

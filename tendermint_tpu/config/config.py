@@ -67,6 +67,19 @@ class BaseConfig:
     # (models/verifier.py); on hosts with fewer devices the node falls
     # back to single-device and logs it.
     crypto_mesh_devices: int = 0
+    # The seam-level mesh runtime (parallel/topology.py): discover the
+    # local device topology at node start and route EVERY device engine
+    # — pipelined verifier, merkle leaf stage, BLS pairing rows, tx-key
+    # SHA-256 — across all admitted devices through one MeshRouter.
+    # Bundles below mesh_min_rows stay single-device (small commits
+    # never pay collective latency); per-device circuit breakers shed a
+    # sick chip's shard to the survivors and half-open probes re-admit
+    # it. crypto_mesh_devices (above) caps the discovered topology when
+    # > 0. TM_MESH=0/1 is the env kill switch overriding mesh_enabled
+    # without editing toml. The degenerate 1-device topology is
+    # bit-identical to the unmeshed path (tier-1 pinned).
+    mesh_enabled: bool = False
+    mesh_min_rows: int = 256
     # Device-batched SHA-256 merkle engine (models/hasher.py behind
     # crypto/merkle.py): tx roots, part-set roots, validator-set /
     # commit-sig / evidence hashes with at least merkle_device_threshold
@@ -167,6 +180,10 @@ class BaseConfig:
             return "crypto_pipeline_depth must be >= 1"
         if self.crypto_pipeline_flush_ms < 0:
             return "crypto_pipeline_flush_ms can't be negative"
+        if self.crypto_mesh_devices < 0:
+            return "crypto_mesh_devices can't be negative"
+        if self.mesh_min_rows < 1:
+            return "mesh_min_rows must be >= 1"
         if self.merkle_device_threshold < 2:
             return "merkle_device_threshold must be >= 2"
         if self.trace_buffer_events < 1:
@@ -608,6 +625,12 @@ def load_config(path: str) -> Config:
             cfg.base.bls_device_rows = int(env_bls_rows)
         except ValueError:
             pass
+    # Mesh runtime kill switch (docs/running-in-production.md): TM_MESH=0
+    # grounds every engine to single-device without editing toml;
+    # TM_MESH=1 force-enables the router on a node configured off.
+    env_mesh = os.environ.get("TM_MESH")
+    if env_mesh is not None:
+        cfg.base.mesh_enabled = env_mesh not in ("0", "false", "")
     return cfg
 
 

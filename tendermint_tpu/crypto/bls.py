@@ -478,12 +478,13 @@ def set_default_bls_provider(v: BLSBatchVerifier) -> None:
 
 
 def make_bls_provider(
-    device: bool = True, block_on_compile: bool = False
+    device: bool = True, block_on_compile: bool = False, router=None
 ) -> BLSBatchVerifier:
     if not device:
         return BLSBatchVerifier(use_device=False)
     from tendermint_tpu.models.bls import BLSEngine
 
     return BLSBatchVerifier(
-        engine=BLSEngine(block_on_compile=block_on_compile), use_device=True
+        engine=BLSEngine(block_on_compile=block_on_compile, router=router),
+        use_device=True,
     )

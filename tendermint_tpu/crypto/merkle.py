@@ -59,6 +59,7 @@ _DEVICE_LOCK = threading.Lock()
 _DEVICE_ENABLED = os.environ.get("TM_MERKLE_DEVICE", "") == "1"
 _DEVICE_THRESHOLD = max(2, int(os.environ.get("TM_MERKLE_DEVICE_THRESHOLD", "1024")))
 _DEVICE_BLOCK_ON_COMPILE = False
+_DEVICE_ROUTER = None  # MeshRouter handed in by configure_device
 _HASHER = None
 _HOST_STATS = {"host_roots": 0, "host_proof_sets": 0}
 # Runtime-failure circuit breaker for the device path: consecutive
@@ -84,11 +85,15 @@ def configure_device(
     enabled: bool = True,
     threshold: Optional[int] = None,
     block_on_compile: Optional[bool] = None,
+    router=None,
 ) -> None:
     """Enable/disable the device merkle engine process-wide. The hasher
     itself is created lazily on the first qualifying tree, so flipping
-    the flag never imports jax by itself."""
-    global _DEVICE_ENABLED, _DEVICE_THRESHOLD, _DEVICE_BLOCK_ON_COMPILE, _HASHER
+    the flag never imports jax by itself. ``router`` (a
+    parallel/topology.MeshRouter) makes the leaf stage of qualifying
+    trees shard across the admitted local devices."""
+    global _DEVICE_ENABLED, _DEVICE_THRESHOLD, _DEVICE_BLOCK_ON_COMPILE
+    global _DEVICE_ROUTER, _HASHER
     with _DEVICE_LOCK:
         _DEVICE_ENABLED = bool(enabled)
         if threshold is not None:
@@ -96,6 +101,9 @@ def configure_device(
         if block_on_compile is not None and block_on_compile != _DEVICE_BLOCK_ON_COMPILE:
             _DEVICE_BLOCK_ON_COMPILE = block_on_compile
             _HASHER = None  # rebuilt with the new compile discipline
+        if router is not _DEVICE_ROUTER:
+            _DEVICE_ROUTER = router
+            _HASHER = None  # rebuilt mesh-aware
 
 
 def _device_hasher():
@@ -111,7 +119,10 @@ def _device_hasher():
             try:
                 from tendermint_tpu.models.hasher import MerkleHasher
 
-                _HASHER = MerkleHasher(block_on_compile=_DEVICE_BLOCK_ON_COMPILE)
+                _HASHER = MerkleHasher(
+                    block_on_compile=_DEVICE_BLOCK_ON_COMPILE,
+                    router=_DEVICE_ROUTER,
+                )
             except Exception:
                 _DEVICE_ENABLED = False
                 return None

@@ -95,11 +95,14 @@ class TxKeyHasher:
     in a background thread when ``block_on_compile=False`` so admission
     never stalls on XLA."""
 
-    def __init__(self, block_on_compile: bool = True, logger=None):
+    def __init__(self, block_on_compile: bool = True, logger=None, router=None):
         from tendermint_tpu.utils.watchdog import CircuitBreaker
 
         self.block_on_compile = block_on_compile
         self.logger = logger or get_logger("ingest.hash")
+        # MeshRouter (parallel/topology.py): when set, qualifying
+        # bundles split into per-device row chunks at the seam
+        self.router = router
         self._lock = threading.Lock()
         self._buckets: Dict[Tuple[int, int], _Bucket] = {}
         # fail-stop per bundle, breaker-gated: a transient compile
@@ -115,15 +118,52 @@ class TxKeyHasher:
         self.fallback_cold = 0
         self.fallback_shape = 0
 
-    def _run(self, blocks: np.ndarray, counts: np.ndarray) -> np.ndarray:
-        from tendermint_tpu.ops.sha256 import state_to_digests
-
+    def _run_state(self, blocks, counts):
         state_fn, update_fn = _jit_fns()
-        faults.maybe("device.hash")
         st = state_fn(blocks[:, 0])
         for b in range(1, blocks.shape[1]):
             st = update_fn(st, blocks[:, b], counts > b)
-        return state_to_digests(np.asarray(st))
+        return st
+
+    def _run(self, blocks: np.ndarray, counts: np.ndarray) -> np.ndarray:
+        from tendermint_tpu.ops.sha256 import state_to_digests
+
+        faults.maybe("device.hash")
+        return state_to_digests(np.asarray(self._run_state(blocks, counts)))
+
+    def _run_meshed(self, blocks: np.ndarray, counts: np.ndarray) -> Optional[np.ndarray]:
+        """Rows split into contiguous per-device chunks, each chunk's
+        blocks committed to its device so the shared jitted kernels
+        dispatch concurrently (jit follows input placement). SHA-256
+        rows are independent, so concatenating the per-chunk states is
+        bit-identical to the single dispatch. None means the router
+        declined (or a shard failed) — take the single-device path."""
+        r = self.router
+        if r is None or not r.topology.has_placement:
+            return None
+        plan = r.plan(blocks.shape[0])
+        if not plan.collective:
+            return None
+        import jax
+
+        from tendermint_tpu.ops.sha256 import state_to_digests
+
+        def dispatch(s):
+            blk = jax.device_put(blocks[s.lo : s.hi], s.device)
+            return self._run_state(blk, counts[s.lo : s.hi])
+
+        def combine(outs):
+            return state_to_digests(
+                np.concatenate([np.asarray(o) for o in outs], axis=1)
+            )
+
+        try:
+            return r.run(plan, dispatch, combine)
+        except Exception as e:
+            self.logger.error(
+                "mesh tx-key shard failed; single-device fallback", err=repr(e)
+            )
+            return None
 
     def _ensure(self, key: Tuple[int, int]) -> bool:
         """True when the bucket's executables are warm; otherwise kicks
@@ -199,7 +239,9 @@ class TxKeyHasher:
         try:
             blocks, counts = pack_msg_blocks(items, n_pad, n_blocks)
             with trace.span("ingest.hash_keys", rows=n, blocks=n_blocks):
-                digests = self._run(blocks, counts)
+                digests = self._run_meshed(blocks, counts)
+                if digests is None:
+                    digests = self._run(blocks, counts)
         except Exception as e:
             # runtime failure on a warm bucket (backend lost, OOM, an
             # injected device.hash fault): fail-stop THIS bucket behind

@@ -119,9 +119,14 @@ class MerkleHasher:
     mode); callers fall back to the host path. ``root(items)`` is the
     root-only fast path (device keeps intermediate levels on device)."""
 
-    def __init__(self, block_on_compile: bool = True, logger=None):
+    def __init__(self, block_on_compile: bool = True, logger=None, router=None):
         self.block_on_compile = block_on_compile
         self.logger = logger or get_logger("merkle-hasher")
+        # MeshRouter (parallel/topology.py): when set, the leaf stage
+        # of qualifying trees shards across the admitted devices; the
+        # inner reduction stays on the default device (the tree narrows
+        # too fast for collectives to pay past the leaves)
+        self.router = router
         self._lock = threading.Lock()
         # readiness is per LEAF-COUNT bucket: every executable is keyed
         # by row width, so one warm pass at a width covers any leaf
@@ -264,19 +269,58 @@ class MerkleHasher:
 
     # -- device tree ------------------------------------------------------
 
+    def _mesh_leaf_state(self, blocks: np.ndarray, nb: np.ndarray, n_blocks: int):
+        """Leaf-level mesh reduction: padded leaf rows split into
+        contiguous per-device chunks, each chunk's blocks committed to
+        its device so the shared leaf executables dispatch
+        concurrently. Leaf digests are row-independent, so the
+        concatenated (8, n_pad) state is bit-identical to the single
+        dispatch; it re-lands on the default device for the inner
+        levels. None -> take the single-device leaf path."""
+        r = self.router
+        if r is None or not r.topology.has_placement:
+            return None
+        plan = r.plan(blocks.shape[0])
+        if not plan.collective:
+            return None
+
+        def dispatch(s):
+            blk = jax.device_put(np.ascontiguousarray(blocks[s.lo : s.hi]), s.device)
+            st = self._leaf_state(blk[:, 0])
+            nbs = nb[s.lo : s.hi]
+            for i in range(1, n_blocks):
+                # nbs > i rides along uncommitted and follows st's device
+                st = self._leaf_update(st, blk[:, i], nbs > i)
+            return st
+
+        def combine(outs):
+            return jnp.asarray(
+                np.concatenate([np.asarray(o) for o in outs], axis=1)
+            )
+
+        try:
+            return r.run(plan, dispatch, combine)
+        except Exception as e:
+            self.logger.error(
+                "mesh leaf shard failed; single-device fallback", err=repr(e)
+            )
+            return None
+
     def _device_levels(self, items: Sequence[bytes], n_pad: int, n_blocks: int):
         """Run the dispatch chain: returns (device_levels, counts) where
         device_levels[l] is the (8, C_l) u32 state array of level l and
         counts[l] its logical node count. Reduction stops once the
         width is <= HOST_TAIL_WIDTH (or one node)."""
         blocks, nb = ops_sha.pack_leaf_blocks(items, n_pad, n_blocks)
-        st = self._leaf_state(jnp.asarray(np.ascontiguousarray(blocks[:, 0])))
-        for i in range(1, n_blocks):
-            st = self._leaf_update(
-                st,
-                jnp.asarray(np.ascontiguousarray(blocks[:, i])),
-                jnp.asarray(nb > i),
-            )
+        st = self._mesh_leaf_state(blocks, nb, n_blocks)
+        if st is None:
+            st = self._leaf_state(jnp.asarray(np.ascontiguousarray(blocks[:, 0])))
+            for i in range(1, n_blocks):
+                st = self._leaf_update(
+                    st,
+                    jnp.asarray(np.ascontiguousarray(blocks[:, i])),
+                    jnp.asarray(nb > i),
+                )
         levels = [st]
         counts = [len(items)]
         cnt = len(items)

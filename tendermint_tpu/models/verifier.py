@@ -183,10 +183,13 @@ _GATHER_POLICY_MIN_TABLE = 16384
 # gathers in one program (ops_ed.verify_stage_scan_tabled_sharded).
 MAX_TABLED_VALSET = int(os.environ.get("TM_MAX_TABLED_VALSET", "16384"))
 
-# Largest valset for the sharded-table path (single device; HBM is the
-# bound: ~30KB/validator => ~2GB at 65536). Beyond it — or on a mesh,
-# where the tables would replicate per device — the generic pipeline
-# takes over.
+# Largest valset for the sharded-table path (HBM is the bound:
+# ~30KB/validator => ~2GB at 65536). The figure is SINGLE-device; on a
+# live N-device mesh the shard tables replicate to every chip while
+# each chip also works its 1/N row shard, so the per-device table
+# budget divides by N — VerifierModel.sharded_valset_cap() computes
+# the live cap from the mesh size (N=1 reproduces this constant
+# exactly). Beyond the cap the generic pipeline takes over.
 MAX_SHARDED_VALSET = int(os.environ.get("TM_MAX_SHARDED_VALSET", str(1 << 16)))
 
 
@@ -759,12 +762,15 @@ class VerifierModel:
             # replicate ONCE at build: the shard_map scan consumes the
             # tables with a replicated spec, and leaving them committed
             # to one device would re-broadcast ~30KB/validator to every
-            # device on every verify dispatch (sharded entries never
-            # reach the mesh path — _tables_entry gates them)
+            # device on every verify dispatch (sharded entries only
+            # reach a mesh when the set fits sharded_valset_cap())
             from jax.sharding import NamedSharding, PartitionSpec
 
             rep = NamedSharding(self.mesh, PartitionSpec())
-            tables = jax.device_put(tables, rep)
+            if sharded:
+                shards = tuple(jax.device_put(s, rep) for s in shards)
+            else:
+                tables = jax.device_put(tables, rep)
             a_ok = jax.device_put(a_ok, rep)
             pk_dev = jax.device_put(pk_dev, rep)
         if sharded:
@@ -793,15 +799,29 @@ class VerifierModel:
                 dir_path=tables_dir,
             )
 
+    def sharded_valset_cap(self) -> int:
+        """Largest valset the sharded-tables path serves on THIS model.
+
+        MAX_SHARDED_VALSET is the single-device HBM bound; on an
+        N-device mesh the shard tables replicate to every chip while
+        each chip also works its 1/N row shard, so the per-device
+        table budget divides by N. The degenerate 1-device mesh gets
+        exactly the single-device cap — the unmeshed path, pinned
+        bit-identical."""
+        if self.mesh is None:
+            return MAX_SHARDED_VALSET
+        n_dev = int(np.prod(list(self.mesh.shape.values())))
+        return MAX_SHARDED_VALSET // max(1, n_dev)
+
     def _tables_entry(self, key: bytes, pubkeys: np.ndarray) -> Optional[_TablesEntry]:
         """The ready tables entry for `key`, or None when still cold
         (async build kicked off in non-blocking mode) or the set is too
         large for the tabled path: past MAX_TABLED_VALSET the tables go
-        SHARDED (single device only — replicating multi-GB tables per
-        mesh device is not worth it), past MAX_SHARDED_VALSET the
-        generic pipeline takes over."""
+        SHARDED, past sharded_valset_cap() (the per-device HBM bound
+        — MAX_SHARDED_VALSET divided by the mesh size) the generic
+        pipeline takes over."""
         v = int(pubkeys.shape[0])
-        if v > MAX_TABLED_VALSET and (self.mesh is not None or v > MAX_SHARDED_VALSET):
+        if v > MAX_TABLED_VALSET and v > self.sharded_valset_cap():
             return None
         with self._lock:
             e = self._valset_tables.get(key)

@@ -15,3 +15,9 @@ from tendermint_tpu.parallel.mesh import (  # noqa: F401
     replicated_sharding,
     pad_to_multiple,
 )
+from tendermint_tpu.parallel.topology import (  # noqa: F401
+    DeviceTopology,
+    MeshRouter,
+    ShardPlan,
+    Slot,
+)

@@ -1,0 +1,330 @@
+"""DeviceTopology + MeshRouter: the mesh lives in the seam, not the engines.
+
+`parallel/mesh.py` + `models/verifier.py` proved bit-equal sharded
+VerifyCommit years of dryruns ago, but every live node still ran
+single-device because the mesh plumbing lived out of tree. This module
+is that plumbing, in tree and engine-agnostic:
+
+- :class:`DeviceTopology` — the local device inventory discovered once
+  at node start, one :class:`~tendermint_tpu.utils.watchdog.CircuitBreaker`
+  per device (``mesh.device<i>``). The degenerate 1-device topology is
+  pinned bit-identical to the unmeshed path by the tier-1 suite.
+- :class:`MeshRouter` — owns dynamic shard sizing (rows padded to a
+  device multiple via :func:`pad_to_multiple`, sub-``min_rows`` bundles
+  routed to a single device so small commits never pay collective
+  latency) and per-device breaker admission: a sick chip sheds its
+  shard to the survivors at the next bundle; the half-open probe
+  re-admits it when it recovers.
+
+All four device engines (the pipelined verifier, the merkle hasher,
+the BLS engine and the tx-key hasher) route through ONE router built in
+the node, so they share the same admitted set: a chip a chunked engine
+blamed is excluded from the verifier's shard_map mesh too.
+
+Every mesh path keeps the repo's None-means-fallback contract: any
+routing or shard failure falls back to the engine's unmeshed path with
+bit-identical results — the mesh can only make things faster, never
+different.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, List, Optional, Sequence
+
+from tendermint_tpu.parallel.mesh import BATCH_AXIS, make_mesh, pad_to_multiple
+from tendermint_tpu.utils import faultinject as faults
+from tendermint_tpu.utils import trace
+from tendermint_tpu.utils.watchdog import CircuitBreaker
+
+
+class DeviceTopology:
+    """The local device inventory plus one breaker per device.
+
+    ``devices`` holds jax Device objects for a real (or virtual XLA)
+    topology, or ``None`` placeholders for a *logical* topology — N
+    host lanes with full router/breaker semantics but no device
+    placement (the simulator's determinism rig and the degraded-
+    topology tests run on logical lanes, no XLA required).
+    """
+
+    def __init__(self, devices: Sequence, platform: str = "host"):
+        if not devices:
+            raise ValueError("DeviceTopology needs at least one device")
+        self.devices = list(devices)
+        self.platform = platform
+        self.breakers: List[CircuitBreaker] = [
+            CircuitBreaker(f"mesh.device{i}") for i in range(len(self.devices))
+        ]
+
+    def __len__(self) -> int:
+        return len(self.devices)
+
+    @property
+    def has_placement(self) -> bool:
+        """True when shards can be committed to real devices."""
+        return self.devices[0] is not None
+
+    @classmethod
+    def discover(cls, max_devices: int = 0) -> Optional["DeviceTopology"]:
+        """Topology over the locally visible jax devices (None if jax
+        is unavailable). ``max_devices`` > 0 caps the inventory."""
+        try:
+            import jax
+
+            devs = jax.devices()
+        except Exception:
+            return None
+        if not devs:
+            return None
+        if max_devices and max_devices > 0:
+            devs = devs[:max_devices]
+        return cls(devs, platform=devs[0].platform)
+
+    @classmethod
+    def logical(cls, n: int) -> "DeviceTopology":
+        """N host lanes: router semantics without device placement."""
+        return cls([None] * n, platform="logical")
+
+
+class Slot:
+    """One device's share of a bundle: rows ``[lo, hi)`` on device
+    ``device`` (topology index ``index``). ``probe`` marks that this
+    slot's admission consumed the breaker's half-open probe token."""
+
+    __slots__ = ("index", "device", "lo", "hi", "probe")
+
+    def __init__(self, index: int, device, lo: int, hi: int, probe: bool):
+        self.index = index
+        self.device = device
+        self.lo = lo
+        self.hi = hi
+        self.probe = probe
+
+    @property
+    def rows(self) -> int:
+        return self.hi - self.lo
+
+
+class ShardPlan:
+    """The router's verdict for one bundle. ``collective`` False means
+    take the engine's existing single-device path unchanged (the
+    sub-threshold / degenerate / all-shed route)."""
+
+    __slots__ = ("n", "slots", "collective")
+
+    def __init__(self, n: int, slots: List[Slot], collective: bool):
+        self.n = n
+        self.slots = slots
+        self.collective = collective
+
+
+class MeshRouter:
+    """Admission + shard sizing + per-device breaker bookkeeping.
+
+    Engines call :meth:`plan` per bundle and, when the plan is
+    collective, dispatch one chunk per slot via :meth:`run` (chunked
+    engines) or the whole bundle via :meth:`run_collective` (the
+    shard_map verifier, where one program spans every admitted device).
+    Any failure records against the owning breaker(s) and the engine
+    falls back to its unmeshed path for that bundle; the next
+    :meth:`plan` re-shards across the survivors.
+    """
+
+    def __init__(
+        self,
+        topology: DeviceTopology,
+        min_rows: int = 256,
+        logger=None,
+    ):
+        self.topology = topology
+        self.min_rows = max(1, int(min_rows))
+        self.logger = logger
+        self._lock = threading.Lock()
+        self._admitted: tuple = tuple(range(len(topology)))
+        self._device_rows = [0] * len(topology)
+        self._collective_bundles = 0
+        self._single_bundles = 0
+        self._shard_failures = 0
+        self._sheds = 0
+        self._readmits = 0
+        self._imbalance = 0.0
+
+    # -- admission --------------------------------------------------------
+
+    def plan(self, n_rows: int, min_rows: Optional[int] = None) -> ShardPlan:
+        """Shard ``n_rows`` across the admitted devices.
+
+        Sub-``min_rows`` bundles never touch the breakers (no probe
+        tokens consumed) and return a non-collective plan. With fewer
+        than two admitted devices the plan is non-collective too — the
+        engine's own single-device path IS the 1-device route.
+        ``min_rows`` overrides the router default for engines whose
+        rows cost wildly more than an ed25519 row (a BLS pairing pays
+        for a collective at a handful of rows)."""
+        n = int(n_rows)
+        floor = self.min_rows if min_rows is None else max(1, int(min_rows))
+        with self._lock:
+            if n < floor or len(self.topology) < 2:
+                self._single_bundles += 1
+                return ShardPlan(n, [], collective=False)
+            admitted: List[int] = []
+            probes: List[bool] = []
+            for i, b in enumerate(self.topology.breakers):
+                was_open = b.state() == "open"
+                if b.allow():
+                    admitted.append(i)
+                    probes.append(was_open)
+            self._note_admitted(tuple(admitted))
+            if len(admitted) < 2:
+                # Can't form a collective: hand back any probe token we
+                # took but won't exercise (only the holder releases).
+                for i, probed in zip(admitted, probes):
+                    if probed:
+                        self.topology.breakers[i].release_probe()
+                self._single_bundles += 1
+                return ShardPlan(n, [], collective=False)
+            chunk = pad_to_multiple(n, len(admitted)) // len(admitted)
+            slots: List[Slot] = []
+            for k, (i, probed) in enumerate(zip(admitted, probes)):
+                lo = k * chunk
+                hi = min(n, lo + chunk)
+                if lo >= hi:
+                    if probed:
+                        self.topology.breakers[i].release_probe()
+                    continue
+                slots.append(Slot(i, self.topology.devices[i], lo, hi, probed))
+                self._device_rows[i] += hi - lo
+            if len(slots) < 2:
+                for s in slots:
+                    if s.probe:
+                        self.topology.breakers[s.index].release_probe()
+                self._single_bundles += 1
+                return ShardPlan(n, [], collective=False)
+            self._collective_bundles += 1
+            rows = [s.rows for s in slots]
+            self._imbalance = (max(rows) - min(rows)) / float(chunk)
+        trace.instant("mesh.route", rows=n, devices=len(slots))
+        return ShardPlan(n, slots, collective=True)
+
+    def _note_admitted(self, admitted: tuple) -> None:
+        # lock held
+        prev = set(self._admitted)
+        cur = set(admitted)
+        shed = prev - cur
+        back = cur - prev
+        if shed:
+            self._sheds += len(shed)
+            trace.instant("mesh.shed", devices=sorted(shed), admitted=len(cur))
+            if self.logger:
+                self.logger.info(
+                    "mesh shed device(s) %s; %d admitted", sorted(shed), len(cur)
+                )
+        if back:
+            self._readmits += len(back)
+            trace.instant("mesh.readmit", devices=sorted(back), admitted=len(cur))
+            if self.logger:
+                self.logger.info(
+                    "mesh re-admitted device(s) %s; %d admitted", sorted(back), len(cur)
+                )
+        self._admitted = admitted
+
+    # -- bundle lifecycle -------------------------------------------------
+
+    def complete(self, plan: ShardPlan) -> None:
+        """Every slot served its chunk: close (or heal) the breakers."""
+        for s in plan.slots:
+            self.topology.breakers[s.index].record_success()
+
+    def fail(self, plan: ShardPlan, failed_pos: Optional[int] = None) -> None:
+        """A collective bundle failed.
+
+        ``failed_pos`` names the slot whose dispatch raised (chunked
+        engines attribute precisely); None means the failure surfaced
+        at combine/materialize time and every participant is blamed —
+        the honest semantics of a single sharded program."""
+        with self._lock:
+            self._shard_failures += 1
+        for pos, s in enumerate(plan.slots):
+            b = self.topology.breakers[s.index]
+            if failed_pos is None or pos == failed_pos:
+                b.record_failure()
+            elif pos < failed_pos:
+                # dispatched fine before the failure: the device worked
+                b.record_success()
+            elif s.probe:
+                # never exercised: return the half-open probe token
+                b.release_probe()
+
+    def release(self, plan: ShardPlan) -> None:
+        """Caller abandoned the plan before dispatch (e.g. no meshed
+        engine available): return unexercised probe tokens."""
+        for s in plan.slots:
+            if s.probe:
+                self.topology.breakers[s.index].release_probe()
+
+    def run(self, plan: ShardPlan, dispatch: Callable, combine: Callable):
+        """Chunked dispatch: ``dispatch(slot)`` once per slot (device
+        engines issue async device calls here), then ``combine(outs)``
+        materializes. Breaker bookkeeping and the ``mesh.shard`` fault
+        site live here so every seam shares one code path."""
+        outs = []
+        done = 0
+        try:
+            for s in plan.slots:
+                faults.maybe("mesh.shard")
+                outs.append(dispatch(s))
+                done += 1
+            res = combine(outs)
+        except Exception:
+            self.fail(plan, done if done < len(plan.slots) else None)
+            raise
+        self.complete(plan)
+        return res
+
+    def run_collective(self, plan: ShardPlan, thunk: Callable):
+        """One program spanning every slot (the shard_map verifier).
+        Failure is unattributable to a single chip, so all participants
+        record it; the cohort probes back in together after cooldown."""
+        try:
+            faults.maybe("mesh.shard")
+            res = thunk()
+        except Exception:
+            self.fail(plan, None)
+            raise
+        self.complete(plan)
+        return res
+
+    # -- shard_map support ------------------------------------------------
+
+    def jax_mesh(self, plan: ShardPlan):
+        """A jax Mesh over exactly the plan's devices (None for logical
+        topologies). Callers cache the returned mesh keyed by
+        :meth:`mesh_key` — same admitted set, same mesh, same compiled
+        executables."""
+        if not self.topology.has_placement or not plan.collective:
+            return None
+        return make_mesh([s.device for s in plan.slots], axis=BATCH_AXIS)
+
+    @staticmethod
+    def mesh_key(plan: ShardPlan) -> tuple:
+        return tuple(s.index for s in plan.slots)
+
+    # -- telemetry --------------------------------------------------------
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "devices": len(self.topology),
+                "platform": self.topology.platform,
+                "admitted": len(self._admitted),
+                "min_rows": self.min_rows,
+                "collective_bundles": self._collective_bundles,
+                "single_bundles": self._single_bundles,
+                "shard_failures": self._shard_failures,
+                "sheds": self._sheds,
+                "readmits": self._readmits,
+                "shard_imbalance": self._imbalance,
+                "device_rows": list(self._device_rows),
+                "breakers": [b.stats() for b in self.topology.breakers],
+            }

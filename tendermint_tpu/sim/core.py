@@ -37,6 +37,7 @@ fixed seed, crashed nodes included (tests/test_sim_durability.py).
 from __future__ import annotations
 
 import asyncio
+import os
 import time
 from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Optional
@@ -699,9 +700,28 @@ class Simulation:
         prev_provider = get_default_provider()
         prev_cache = default_sig_cache()
         cache = SigCache()
-        verifier = PipelinedVerifier(
-            inner=self.inner_verifier or CPUBatchVerifier(), cache=cache
-        )
+        inner = self.inner_verifier or CPUBatchVerifier()
+        # TM_SIM_MESH=<n>: route the shared inner verifier through a
+        # MeshRouter over <n> LOGICAL lanes (no XLA — parallel/topology
+        # host lanes). The acceptance rig for the mesh runtime: a
+        # same-seed run must be bit-identical with this on or off
+        # (tests/test_sim_mesh.py), proving the router's chunk/concat
+        # seam cannot change consensus results.
+        env_mesh = os.environ.get("TM_SIM_MESH")
+        if env_mesh not in (None, "", "0"):
+            from tendermint_tpu.crypto.batch import MeshRoutedVerifier
+            from tendermint_tpu.parallel import DeviceTopology, MeshRouter
+
+            lanes = max(2, int(env_mesh)) if env_mesh.isdigit() else 4
+            inner = MeshRoutedVerifier(
+                inner,
+                MeshRouter(
+                    DeviceTopology.logical(lanes),
+                    min_rows=2,  # sim bundles are small; exercise the seam
+                    logger=self.logger,
+                ),
+            )
+        verifier = PipelinedVerifier(inner=inner, cache=cache)
         set_default_sig_cache(cache)
         set_default_provider(verifier)
         timed_out = False

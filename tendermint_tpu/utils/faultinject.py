@@ -84,6 +84,7 @@ KNOWN_SITES = (
     "mempool.admit",      # mempool/mempool.py check_tx admission (a raise is a failed admission)
     "bls.pairing",        # models/bls.py device kernel dispatch (verify/map/aggregate; a raise trips the breaker and the call falls back to the host oracle)
     "bls.compile",        # models/bls.py bucket compile (_warm)
+    "mesh.shard",         # parallel/topology.py per-shard dispatch (run/run_collective); a raise trips the slot's mesh.device<i> breaker and the bundle falls back to the unmeshed path
 )
 
 _ACTIONS = ("raise", "delay", "tear")

@@ -676,6 +676,59 @@ class BLSMetrics:
             self._deltas.feed(getattr(self, attr), key, stats)
 
 
+class MeshMetrics:
+    """Mesh runtime (``tendermint_mesh_*``,
+    parallel/topology.MeshRouter.stats()): where bundles routed
+    (collective vs single-device), how rows spread across the local
+    devices, and the health of the per-device ``mesh.device<i>``
+    breakers — the shed/readmit story of a sick chip. Monotonic totals
+    are TRUE counters fed by snapshot deltas, like CryptoMetrics. See
+    docs/metrics.md and docs/verification-pipeline.md (Multi-chip)."""
+
+    _COUNTERS = (
+        ("collective_bundles", "collective_bundles"),
+        ("single_bundles", "single_bundles"),
+        ("shard_failures", "shard_failures"),
+        ("sheds", "sheds"),
+        ("readmits", "readmits"),
+    )
+
+    def __init__(self, registry: Optional[Registry] = None, namespace="tendermint"):
+        r = registry or Registry()
+        sub = "mesh"
+        reg = r.register
+        self.devices = reg(Gauge("devices", "Local devices in the mesh topology (0 when the mesh is off).", namespace, sub))
+        self.admitted = reg(Gauge("admitted", "Devices currently admitted by the per-device breakers.", namespace, sub))
+        self.collective_bundles = reg(Counter("collective_bundles_total", "Bundles sharded across two or more devices.", namespace, sub))
+        self.single_bundles = reg(Counter("single_bundles_total", "Bundles routed to the single-device path (sub-threshold or degraded).", namespace, sub))
+        self.shard_failures = reg(Counter("shard_failures_total", "Collective bundles that failed and fell back to the unmeshed path.", namespace, sub))
+        self.sheds = reg(Counter("sheds_total", "Devices shed from the admitted set by a tripped breaker.", namespace, sub))
+        self.readmits = reg(Counter("readmits_total", "Devices re-admitted after a successful half-open probe.", namespace, sub))
+        self.shard_imbalance = reg(Gauge("shard_imbalance", "Row imbalance of the last collective plan: (max-min)/chunk, 0 is even.", namespace, sub))
+        self.device_rows = reg(Counter("device_rows_total", "Rows routed to each device by collective plans (label: device).", namespace, sub))
+        self.breaker_state = reg(Gauge("breaker_state", "Per-device breaker state: 0 closed, 1 half-open, 2 open (label: device).", namespace, sub))
+        self._deltas = _SnapshotCounters()
+
+    def update(self, stats: dict) -> None:
+        """Fold a MeshRouter.stats() snapshot into the instruments."""
+        if not stats:
+            return
+        self.devices.set(stats.get("devices", 0))
+        self.admitted.set(stats.get("admitted", 0))
+        self.shard_imbalance.set(stats.get("shard_imbalance", 0.0))
+        for attr, key in self._COUNTERS:
+            self._deltas.feed(getattr(self, attr), key, stats)
+        for i, rows in enumerate(stats.get("device_rows") or []):
+            k = f"rows/{i}"
+            self._deltas.feed(
+                self.device_rows.with_labels(device=str(i)), k, {k: rows}
+            )
+        for i, b in enumerate(stats.get("breakers") or []):
+            self.breaker_state.with_labels(device=str(i)).set(
+                b.get("state_code", 0)
+            )
+
+
 class EngineMetrics:
     """Unified device-engine telemetry (``tendermint_engine_*``): ONE
     labeled family over every engine implementing the

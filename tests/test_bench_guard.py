@@ -343,6 +343,77 @@ def test_guard_env_kill_switch(bench, monkeypatch):
     assert bench._regression_guard({}, "tpu") == []
 
 
+def test_guard_flags_mesh_regression_and_disappearance(bench):
+    """The mesh weak-scaling keys ride the guard like replay_speedup:
+    a previously-measured mesh throughput or scaling factor that
+    regresses or goes missing must hard-fail the bench."""
+    _write_record(bench, mesh_sigs_per_sec=800000, mesh_speedup=4.0)
+    fails = bench._regression_guard(
+        {"mesh_sigs_per_sec": 400000, "mesh_speedup": 4.0}, "tpu"
+    )
+    assert len(fails) == 1 and "mesh_sigs_per_sec" in fails[0]
+    fails = bench._regression_guard({"mesh_error": "boom"}, "tpu")
+    assert any("mesh_sigs_per_sec" in f and "missing" in f for f in fails)
+    assert any("mesh_speedup" in f for f in fails)
+    assert (
+        bench._regression_guard(
+            {"mesh_sigs_per_sec": 750000, "mesh_speedup": 3.8}, "tpu"
+        )
+        == []
+    )
+
+
+def test_guard_mesh_provenance_mismatch_skips_loudly(bench):
+    """A TPU-measured mesh baseline vs a run whose mesh section fell
+    back to CPU devices is a LOUD skip, never a judged comparison."""
+    _write_record(bench, mesh_sigs_per_sec=800000, mesh_platform="tpu")
+    fails = bench._regression_guard(
+        {"mesh_sigs_per_sec": 9000, "mesh_platform": "cpu"}, "tpu"
+    )
+    assert fails == []
+    assert any(
+        "mesh_sigs_per_sec" in s and "not comparable" in s
+        for s in bench.GUARD_SKIPS
+    ), bench.GUARD_SKIPS
+
+
+def test_mesh_bench_skips_loudly_without_accelerator(bench):
+    """device=False (the node's host-fallback branch): the sweep is
+    skipped with an explicit note, but the chunked-seam parity drill
+    STILL runs — a CPU-only box keeps proving the router seam."""
+    out = bench.mesh_bench(device=False)
+    assert out.get("mesh_parity_ok") == 1
+    assert "mesh_skipped" in out and "mesh_sigs_per_sec" not in out
+
+
+def test_mesh_bench_weak_scaling_floor(bench, monkeypatch):
+    """The sweep itself at test scale, over the conftest's 8 virtual
+    CPU devices: every mesh size produces bit-identical verdicts
+    (asserted inside mesh_bench), the scaling keys land, and the
+    parity drill engaged. No speedup bar on CPU — virtual devices
+    share the same cores; the >=2x acceptance bar rides the real
+    multi-device bench run."""
+    import jax
+
+    if len(jax.devices()) < 2:
+        pytest.skip("needs multiple (virtual) devices")
+    monkeypatch.setattr(bench, "MESH_BENCH_N", 256)
+    # two sweep points keep the tier-1 wall cost down; the full
+    # 1/2/4/8 ladder rides bench.py
+    monkeypatch.setattr(bench, "MESH_SIZES", (1, 8))
+    monkeypatch.setenv("TM_BENCH_FORCE_DEVICE", "1")
+    out = bench.mesh_bench(device=False)  # FORCE_DEVICE overrides
+    assert "mesh_error" not in out, out
+    assert out["mesh_parity_ok"] == 1
+    assert out["mesh_rows"] == 256
+    assert out["mesh_devices_measured"] == len(jax.devices()[:8])
+    assert out["mesh_sigs_per_sec"] > 0
+    assert out["mesh_speedup"] > 0
+    for d in (1, 8):
+        if d <= len(jax.devices()):
+            assert out[f"mesh_p50_ms_{d}dev"] > 0
+
+
 def test_coldstart_carry_at_most_once(bench):
     """A failed cold-start probe carries the previous record's keys
     exactly once; a record that already carried leaves them out (the

@@ -72,6 +72,13 @@ CHAOS_PLAN = {
     # a live node.
     "bls.pairing": ("raise", dict(p=0.3)),
     "bls.compile": ("raise", dict(p=0.3)),
+    # the mesh absorbs raises by design: a shard fault trips only that
+    # device's breaker (survivors re-shard the next bundle) and the
+    # routed engine falls back to its single-device path for the bundle
+    # (parallel/topology.py). The single-device chaos node never plans
+    # a collective, so this stays armed-but-idle here;
+    # test_mesh_router.py drives the shed/readmit paths hot.
+    "mesh.shard": ("raise", dict(p=0.3)),
 }
 
 

@@ -1,0 +1,75 @@
+"""Mesh-parity acceptance rig (the ISSUE-16 mesh runtime).
+
+The simulator is the repo's determinism instrument: a same-seed
+scenario run must be byte-identical whether or not the shared verifier
+routes through a MeshRouter (``TM_SIM_MESH`` — logical host lanes, no
+XLA). Commit hashes AND the network event-trace digest are compared,
+so a mesh-induced verdict flip, reorder, or dropped row anywhere in
+the chunk/concat seam fails loudly. The slow leg repeats the proof at
+256 nodes, where bundles are large enough to shard every commit.
+"""
+
+import pytest
+
+import tendermint_tpu.crypto.batch as _batch
+from tendermint_tpu.sim.scenario import run_scenario
+
+
+def _run(monkeypatch, mesh: bool, **overrides):
+    """One scenario run; with ``mesh`` on, also capture the routers the
+    sim built so callers can assert the collective path engaged (a
+    parity proof over a path that never ran proves nothing)."""
+    routers = []
+    if mesh:
+        monkeypatch.setenv("TM_SIM_MESH", "4")
+        real = _batch.MeshRoutedVerifier
+
+        def spy(inner, router):
+            routers.append(router)
+            return real(inner, router)
+
+        monkeypatch.setattr(_batch, "MeshRoutedVerifier", spy)
+    else:
+        monkeypatch.delenv("TM_SIM_MESH", raising=False)
+    sc, sim, res, fails = run_scenario("mesh_parity.scn", **overrides)
+    assert fails == [], fails
+    assert res.completed and res.safety_ok()
+    if mesh:
+        assert routers, "TM_SIM_MESH set but the sim built no router"
+        assert sum(r.stats()["collective_bundles"] for r in routers) > 0, (
+            "mesh run never took the collective path — parity is vacuous"
+        )
+    return res
+
+
+def test_mesh_parity_bit_identical_at_tier1_scale(monkeypatch):
+    """Same seed, mesh on vs off: identical commit hashes at every
+    height on every node, identical event-trace digest."""
+    off = _run(monkeypatch, mesh=False)
+    on = _run(monkeypatch, mesh=True)
+    assert on.commit_hashes == off.commit_hashes
+    assert on.trace_digest == off.trace_digest
+    assert on.heights == off.heights
+
+
+def test_mesh_lanes_count_is_a_knob(monkeypatch):
+    """TM_SIM_MESH=<n> picks the logical lane count; any lane count
+    must still be bit-identical to the unmeshed run."""
+    off = _run(monkeypatch, mesh=False)
+    monkeypatch.setenv("TM_SIM_MESH", "2")
+    sc, sim, res, fails = run_scenario("mesh_parity.scn")
+    assert fails == [], fails
+    assert res.commit_hashes == off.commit_hashes
+    assert res.trace_digest == off.trace_digest
+
+
+@pytest.mark.slow
+def test_mesh_parity_256_nodes(monkeypatch):
+    """The scaled leg: 256 nodes sharing one meshed engine — bundles
+    big enough that every commit check rides the collective path — and
+    the run is still bit-identical to the unmeshed baseline."""
+    size = dict(nodes=256, validators=8, heights=12)
+    off = _run(monkeypatch, mesh=False, **size)
+    on = _run(monkeypatch, mesh=True, **size)
+    assert on.commit_hashes == off.commit_hashes
+    assert on.trace_digest == off.trace_digest
