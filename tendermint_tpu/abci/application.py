@@ -31,6 +31,18 @@ class Application:
     def deliver_tx(self, req: t.RequestDeliverTx) -> t.ResponseDeliverTx:
         return t.ResponseDeliverTx()
 
+    def deliver_batch(self, req: t.RequestDeliverBatch) -> t.ResponseDeliverBatch:
+        """Batched DeliverTx. The default is the serial loop, so every
+        app is batch-correct by construction; apps with a device fast
+        path (payments, kvproofs) override this. Implementations must be
+        atomic per request: apply all txs or raise before applying any —
+        the executor falls back to per-tx DeliverTx for the txs of a
+        FAILED chunk only, so a partially-applied chunk would double-apply."""
+        return t.ResponseDeliverBatch(
+            results=[self.deliver_tx(t.RequestDeliverTx(tx)) for tx in req.txs],
+            lane="host",
+        )
+
     def end_block(self, req: t.RequestEndBlock) -> t.ResponseEndBlock:
         return t.ResponseEndBlock()
 
@@ -62,6 +74,8 @@ def handle_request(app: Application, req):
         return app.begin_block(req)
     if isinstance(req, t.RequestDeliverTx):
         return app.deliver_tx(req)
+    if isinstance(req, t.RequestDeliverBatch):
+        return app.deliver_batch(req)
     if isinstance(req, t.RequestEndBlock):
         return app.end_block(req)
     if isinstance(req, t.RequestCommit):

@@ -83,6 +83,11 @@ class ABCIClient(Service):
     async def deliver_tx_sync(self, req: t.RequestDeliverTx) -> t.ResponseDeliverTx:
         return await self.send_async(req).wait()
 
+    async def deliver_batch_sync(
+        self, req: t.RequestDeliverBatch
+    ) -> t.ResponseDeliverBatch:
+        return await self.send_async(req).wait()
+
     async def end_block_sync(self, req: t.RequestEndBlock) -> t.ResponseEndBlock:
         return await self.send_async(req).wait()
 
@@ -94,4 +99,7 @@ class ABCIClient(Service):
         return self.send_async(req)
 
     def deliver_tx_async(self, req: t.RequestDeliverTx) -> ReqRes:
+        return self.send_async(req)
+
+    def deliver_batch_async(self, req: t.RequestDeliverBatch) -> ReqRes:
         return self.send_async(req)

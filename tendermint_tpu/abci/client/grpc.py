@@ -58,7 +58,8 @@ class GRPCClient(ABCIClient):
                 response_deserializer=bytes,
             )
             for m in ("Echo", "Info", "SetOption", "Query", "CheckTx",
-                      "InitChain", "BeginBlock", "DeliverTx", "EndBlock", "Commit")
+                      "InitChain", "BeginBlock", "DeliverTx", "DeliverBatch",
+                      "EndBlock", "Commit")
         }
         self._queue = asyncio.Queue()
         self.spawn(self._sender_routine(), name="abci-grpc-sender")
@@ -84,7 +85,21 @@ class GRPCClient(ABCIClient):
     async def _call(self, req):
         if isinstance(req, t.RequestFlush):
             return t.ResponseFlush()
-        return codec.decode_msg(await self._calls[_method_for(req)](encode_body(req)))
+        try:
+            return codec.decode_msg(
+                await self._calls[_method_for(req)](encode_body(req))
+            )
+        except grpc.RpcError as e:
+            # An old server that predates a method (DeliverBatch) answers
+            # UNIMPLEMENTED; surface it per-request like the socket path's
+            # "unknown request tag" so the caller can fall back instead of
+            # poisoning the transport.
+            code = e.code() if callable(getattr(e, "code", None)) else None
+            if code == grpc.StatusCode.UNIMPLEMENTED:
+                return t.ResponseException(
+                    f"unknown request tag: {_method_for(req)} unimplemented"
+                )
+            raise
 
     async def _sender_routine(self) -> None:
         while True:

@@ -106,6 +106,12 @@ _register(
     lambda r: t.RequestEndBlock(r.read_u64()),
 )
 _register(0x0B, t.RequestCommit, _enc_none, lambda r: t.RequestCommit())
+_register(
+    0x0C,
+    t.RequestDeliverBatch,
+    lambda w, m: w.write_raw(m.encode()),
+    lambda r: t.RequestDeliverBatch.decode(r.read_raw(r.remaining())),
+)
 
 _register(
     0x41,
@@ -211,6 +217,12 @@ _register(
     t.ResponseCommit,
     lambda w, m: w.write_bytes(m.data).write_u64(m.retain_height),
     lambda r: t.ResponseCommit(r.read_bytes(), r.read_u64()),
+)
+_register(
+    0x4D,
+    t.ResponseDeliverBatch,
+    lambda w, m: w.write_raw(m.encode()),
+    lambda r: t.ResponseDeliverBatch.decode(r.read_raw(r.remaining())),
 )
 
 

@@ -22,7 +22,7 @@ from tendermint_tpu.utils.service import Service
 
 _METHODS = (
     "Echo", "Flush", "Info", "SetOption", "Query", "CheckTx",
-    "InitChain", "BeginBlock", "DeliverTx", "EndBlock", "Commit",
+    "InitChain", "BeginBlock", "DeliverTx", "DeliverBatch", "EndBlock", "Commit",
 )
 
 

@@ -275,6 +275,30 @@ class RequestDeliverTx:
 
 
 @dataclass
+class RequestDeliverBatch:
+    """Batched DeliverTx (the PR-17 execution seam): one request carries
+    every tx of a block chunk so the app can execute them with a single
+    device round (batched signature bundle, vectorized state apply).
+    Apps that don't know the tag answer with ResponseException ("unknown
+    request tag") and the executor falls back to per-tx DeliverTx — the
+    wire stays compatible both ways."""
+
+    txs: List[bytes] = field(default_factory=list)
+
+    def encode(self) -> bytes:
+        w = Writer().write_uvarint(len(self.txs))
+        for tx in self.txs:
+            w.write_bytes(tx)
+        return w.bytes()
+
+    @classmethod
+    def decode(cls, data: bytes) -> "RequestDeliverBatch":
+        r = Reader(data)
+        n = r.read_uvarint()
+        return cls([r.read_bytes() for _ in range(n)])
+
+
+@dataclass
 class RequestEndBlock:
     height: int = 0
 
@@ -426,6 +450,46 @@ class ResponseDeliverTx(_TxResult):
         (reference types/results.go NewResults -- non-deterministic fields
         excluded)."""
         return Writer().write_u32(self.code).write_bytes(self.data).bytes()
+
+
+@dataclass
+class ResponseDeliverBatch:
+    """Per-tx DeliverTx results, in block order, plus an execution-stats
+    tail (lane taken, conflict/re-run counts, device vs host rows) so
+    remote apps can feed the node's ``tendermint_exec_*`` metrics. The
+    tail is appended after the results the same way
+    ResponseCheckTx.priority rides after the _TxResult fields: decode
+    tolerates the short frame, so a stats-unaware peer still interops."""
+
+    results: List[ResponseDeliverTx] = field(default_factory=list)
+    lane: str = ""  # "device" | "host" | "" (unreported)
+    conflicts: int = 0
+    serial_reruns: int = 0
+    device_rows: int = 0
+    host_rows: int = 0
+
+    def encode(self) -> bytes:
+        w = Writer().write_uvarint(len(self.results))
+        for res in self.results:
+            w.write_bytes(res.encode())
+        w.write_str(self.lane)
+        w.write_i64(self.conflicts).write_i64(self.serial_reruns)
+        w.write_i64(self.device_rows).write_i64(self.host_rows)
+        return w.bytes()
+
+    @classmethod
+    def decode(cls, data: bytes) -> "ResponseDeliverBatch":
+        r = Reader(data)
+        n = r.read_uvarint()
+        results = [ResponseDeliverTx.decode(r.read_bytes()) for _ in range(n)]
+        lane, conflicts, serial_reruns, device_rows, host_rows = "", 0, 0, 0, 0
+        if r.remaining():
+            lane = r.read_str()
+            conflicts = r.read_i64()
+            serial_reruns = r.read_i64()
+            device_rows = r.read_i64()
+            host_rows = r.read_i64()
+        return cls(results, lane, conflicts, serial_reruns, device_rows, host_rows)
 
 
 @dataclass

@@ -155,6 +155,15 @@ class BaseConfig:
     bls_device: bool = True
     bls_device_rows: int = 2
     priv_validator_key_type: str = "ed25519"
+    # Batched block execution (state/parallel_exec.py; docs/execution.md):
+    # exec_parallel delivers a block's txs as chunked DeliverBatch
+    # requests — batch-aware apps answer with ONE device signature
+    # bundle / hash bundle plus an optimistic-parallel apply whose
+    # results are bit-identical to the serial DeliverTx loop; any batch
+    # failure degrades to per-tx delivery. exec_batch_txs bounds the
+    # txs per request. TM_EXEC=0 is the kill switch (no toml edit).
+    exec_parallel: bool = True
+    exec_batch_txs: int = 256
 
     def genesis_file(self) -> str:
         return _rootify(self.genesis_file_name, self.root_dir)
@@ -212,6 +221,8 @@ class BaseConfig:
             return "bls_device_rows must be >= 1"
         if self.priv_validator_key_type not in ("ed25519", "bls12-381"):
             return f"unknown priv_validator_key_type {self.priv_validator_key_type!r}"
+        if self.exec_batch_txs < 1:
+            return "exec_batch_txs must be >= 1"
         return None
 
 
@@ -631,6 +642,11 @@ def load_config(path: str) -> Config:
     env_mesh = os.environ.get("TM_MESH")
     if env_mesh is not None:
         cfg.base.mesh_enabled = env_mesh not in ("0", "false", "")
+    # Batched-execution kill switch (docs/running-in-production.md):
+    # TM_EXEC=0 pins every block to the serial per-tx DeliverTx path.
+    env_exec = os.environ.get("TM_EXEC")
+    if env_exec is not None:
+        cfg.base.exec_parallel = env_exec not in ("0", "false", "")
     return cfg
 
 

@@ -588,12 +588,15 @@ class Mempool:
             else None
         )
 
+        if not self._txs:
+            # idle-height fast path: the block consumed the whole pool —
+            # no recheck walk, no lane bookkeeping, zero ABCI traffic
+            return
+        if self.config.recheck:
+            self.logger.debug("recheck txs", num=len(self._txs), height=height)
+            await self._recheck_txs()
         if self._txs:
-            if self.config.recheck:
-                self.logger.debug("recheck txs", num=len(self._txs), height=height)
-                await self._recheck_txs()
-            if self._txs:
-                self._notify_txs_available()
+            self._notify_txs_available()
 
     async def _recheck_txs(self) -> None:
         """Re-validate every pool tx at the new app state (reference
@@ -622,6 +625,11 @@ class Mempool:
         # them so the set stays operator-action-sized (a ban on a tx
         # that never showed up simply means full re-validation later)
         self._banned.intersection_update(self._txs.keys())
+        if not entries:
+            # every resident entry was a cache-invalidated drop — there
+            # is nothing to re-validate, so skip the ABCI flush round
+            # trip entirely
+            return
         reqres = [
             self._app.check_tx_async(
                 abci.RequestCheckTx(tx=e.tx, type=abci.CHECK_TX_RECHECK)

@@ -332,7 +332,15 @@ class Node(Service):
             mempool=self.mempool,
             evidence_pool=self.evidence_pool,
             event_bus=self.event_bus,
+            exec_parallel=config.base.exec_parallel,
+            exec_batch_txs=config.base.exec_batch_txs,
         )
+        # app-zoo device seams for DeliverBatch (docs/execution.md): a
+        # local app exposing batch_verifier gets the shared pipelined
+        # provider (SigCache-warm from admission); one exposing
+        # batch_hasher gets a device tx-key hasher for value digests
+        if getattr(self.app, "batch_verifier", False) is None:
+            self.app.batch_verifier = self.crypto_provider
 
         # -- batched ingest (ingest/batcher.py; docs/ingest.md) -------------
         # The mempool's admission front door: concurrent broadcast_tx_* /
@@ -360,6 +368,8 @@ class Node(Service):
                 hash_threshold=config.base.ingest_hash_threshold,
                 logger=self.logger,
             )
+        if getattr(self.app, "batch_hasher", False) is None and self.ingest is not None:
+            self.app.batch_hasher = self.ingest.hasher
 
         self.consensus_state: Optional[ConsensusState] = None
         self.consensus_reactor: Optional[ConsensusReactor] = None
@@ -411,6 +421,7 @@ class Node(Service):
             BLSMetrics,
             CryptoMetrics,
             EngineMetrics,
+            ExecMetrics,
             HealthMetrics,
             IngestMetrics,
             LightServeMetrics,
@@ -432,6 +443,12 @@ class Node(Service):
         self.lightserve_metrics = LightServeMetrics(self.metrics_registry, ns)
         self.ingest_metrics = IngestMetrics(self.metrics_registry, ns)
         self.bls_metrics = BLSMetrics(self.metrics_registry, ns)
+        # batched block-execution telemetry (state/execution.py
+        # exec_stats): tendermint_exec_* batches/conflicts/rows
+        self.exec_metrics = ExecMetrics(self.metrics_registry, ns)
+        # direct handle for the batch-size histogram (the ingest
+        # bundle-size pattern: distributions can't ride snapshot deltas)
+        self.block_exec.exec_metrics = self.exec_metrics
         # unified engine telemetry (models/telemetry.py protocol): the
         # cross-engine tendermint_engine_* family + the engines RPC
         self.engine_metrics = EngineMetrics(self.metrics_registry, ns)
@@ -847,6 +864,7 @@ class Node(Service):
                 self.ingest.stats() if self.ingest is not None else {},
                 getattr(self.mempool, "lane_stats", dict)(),
             )
+            self.exec_metrics.update(self.block_exec.exec_stats())
             if self.watchdog is not None:
                 self.watchdog.heartbeat("node.metrics_pump")
             await asyncio.sleep(2.0)

@@ -51,7 +51,7 @@ _KNOWN_EXPECT = {
     "rotation_applied", "wal_replayed", "evidence_committed",
     "churn_applied",
 }
-_APPS = {"kvstore", "persistent_kvstore"}
+_APPS = {"kvstore", "persistent_kvstore", "kvproofs"}
 
 
 def scenarios_dir() -> str:
@@ -204,6 +204,13 @@ def build_simulation(
         from tendermint_tpu.abci.examples.kvstore import PersistentKVStoreApplication
 
         app_factory = PersistentKVStoreApplication
+    elif sc.app == "kvproofs":
+        # merkle-committed KV app (same key=value tx wire as the sim's
+        # load generator) — the exec-parity rig's app: its DeliverBatch
+        # lane must be bit-identical to per-tx delivery
+        from tendermint_tpu.abci.examples.kvproofs import KVProofsApplication
+
+        app_factory = KVProofsApplication
 
     on_built = None
     if sc.rotate is not None:

@@ -18,6 +18,10 @@ from typing import List, Tuple
 
 from tendermint_tpu.abci import types as abci
 from tendermint_tpu.abci.client.base import ABCIClient
+from tendermint_tpu.state.parallel_exec import (
+    exec_batch_txs_default,
+    exec_parallel_default,
+)
 from tendermint_tpu.state.state import State
 from tendermint_tpu.state.store import ABCIResponses, StateStore
 from tendermint_tpu.state.validation import validate_block
@@ -45,6 +49,8 @@ class BlockExecutor:
         verifier=None,
         metrics=None,
         logger=None,
+        exec_parallel=None,
+        exec_batch_txs=None,
     ):
         self._store = state_store
         self._app = app_conn
@@ -53,6 +59,32 @@ class BlockExecutor:
         self._event_bus = event_bus
         self._verifier = verifier
         self._metrics = metrics
+        # batched DeliverBatch delivery (config.base.exec_parallel /
+        # exec_batch_txs; None = resolve from the TM_EXEC env kill
+        # switch, which is how sim-built executors pick the lane up)
+        self.exec_parallel = (
+            exec_parallel_default() if exec_parallel is None else bool(exec_parallel)
+        )
+        self.exec_batch_txs = (
+            exec_batch_txs_default() if exec_batch_txs is None else max(1, int(exec_batch_txs))
+        )
+        # latched after the app answers DeliverBatch with "unknown
+        # request tag" — every later block goes straight to per-tx
+        self._batch_unsupported = False
+        # tendermint_exec_* snapshot source (ExecMetrics.update reads
+        # this through node._metrics_pump; monotonic within a process)
+        self._exec_stats = {
+            "batches": 0,
+            "batch_txs": 0,
+            "fallbacks": 0,
+            "conflicts": 0,
+            "serial_reruns": 0,
+            "device_rows": 0,
+            "host_rows": 0,
+        }
+        # direct handle for the batch-size histogram (same pattern as
+        # IngestMetrics.observe_bundle_txs), attached by the node
+        self.exec_metrics = None
         # per-height latency ledger (consensus/ledger.py), attached by
         # ConsensusState so the ABCI deliver round trip shows up as its
         # own phase; None for fast-sync-only executors
@@ -65,6 +97,77 @@ class BlockExecutor:
 
     def store(self) -> StateStore:
         return self._store
+
+    def exec_stats(self) -> dict:
+        """Monotonic execution-lane counters for ExecMetrics.update."""
+        return dict(self._exec_stats)
+
+    async def _deliver_batched(self, app_conn: ABCIClient, txs) -> List[abci.ResponseDeliverTx]:
+        """Deliver `txs` via chunked DeliverBatch requests, falling back
+        to per-tx DeliverTx for the txs a failed chunk left undelivered.
+
+        Chunks are awaited SEQUENTIALLY on purpose: chunk k+1 is only
+        submitted after chunk k succeeded, so on failure exactly the
+        txs from the failed chunk onward are re-sent per-tx. Combined
+        with the apps' atomic-per-request contract (apply all txs or
+        raise before applying any), a fault can degrade throughput but
+        never double-apply a tx — the app hash stays serial-identical.
+        """
+        txs_b = [bytes(tx) for tx in txs]
+        out: List[abci.ResponseDeliverTx] = []
+        st = self._exec_stats
+        i = 0
+        ledger = getattr(self, "ledger", None)
+        if ledger is not None:
+            ledger.push("deliver_batch", time.perf_counter())
+        try:
+            # chaos site: fires before ANY chunk is dispatched, so an
+            # injected fault exercises the clean whole-block fallback
+            await faults.maybe_async("exec.batch")
+            while i < len(txs_b):
+                chunk = txs_b[i : i + self.exec_batch_txs]
+                with trace.span("exec.deliver_batch", txs=len(chunk)) as sp:
+                    res = await app_conn.deliver_batch_sync(
+                        abci.RequestDeliverBatch(chunk)
+                    )
+                    if len(res.results) != len(chunk):
+                        raise BlockExecutionError(
+                            f"DeliverBatch returned {len(res.results)} results "
+                            f"for {len(chunk)} txs"
+                        )
+                    sp.set(lane=res.lane, conflicts=res.conflicts)
+                out.extend(res.results)
+                i += len(chunk)
+                st["batches"] += 1
+                st["batch_txs"] += len(chunk)
+                st["conflicts"] += res.conflicts
+                st["serial_reruns"] += res.serial_reruns
+                st["device_rows"] += res.device_rows
+                st["host_rows"] += res.host_rows
+                if self.exec_metrics is not None:
+                    self.exec_metrics.observe_batch_txs(len(chunk))
+        except Exception as e:
+            st["fallbacks"] += 1
+            msg = str(e)
+            if "unknown request tag" in msg or "unimplemented" in msg.lower():
+                # batch-unaware app: latch so later blocks skip the probe
+                self._batch_unsupported = True
+            self.logger.info(
+                "DeliverBatch unavailable, delivering per-tx",
+                remaining=len(txs_b) - i,
+                err=msg,
+            )
+            trace.instant("exec.batch_fallback", remaining=len(txs_b) - i)
+            rrs = [
+                app_conn.deliver_tx_async(abci.RequestDeliverTx(b))
+                for b in txs_b[i:]
+            ]
+            for rr in rrs:
+                out.append(await rr.wait())
+        finally:
+            if ledger is not None:
+                ledger.pop("deliver_batch", time.perf_counter())
+        return out
 
     # -- proposal construction (reference CreateProposalBlock
     # state/execution.go:87) --------------------------------------------
@@ -119,6 +222,7 @@ class BlockExecutor:
                 abci_responses = await exec_block_on_proxy_app(
                     self.logger, self._app, block, self._store,
                     state.initial_height(),
+                    executor=self,
                     # the LastCommit's voters ARE this state's
                     # last_validators — saves a store decode per block
                     last_validators=(
@@ -245,12 +349,17 @@ class BlockExecutor:
 
 async def exec_block_on_proxy_app(
     logger, app_conn: ABCIClient, block: Block, store, initial_height: int,
-    last_validators=None,
+    last_validators=None, executor: "BlockExecutor" = None,
 ) -> ABCIResponses:
     """BeginBlock → pipelined DeliverTx×N → EndBlock (reference
     execBlockOnProxyApp state/execution.go:250-307). DeliverTx requests are
     submitted without awaiting -- the asyncio equivalent of the
-    reference's async pipeline on the socket client."""
+    reference's async pipeline on the socket client.
+
+    With an ``executor`` whose ``exec_parallel`` is on, delivery instead
+    goes through chunked DeliverBatch requests (one device round per
+    chunk in the batch-aware apps), degrading to the per-tx pipeline on
+    any batch failure — same responses either way."""
     commit_info, byz_vals = get_begin_block_validator_info(
         block, store, initial_height, last_validators=last_validators
     )
@@ -264,20 +373,24 @@ async def exec_block_on_proxy_app(
         )
     )
 
-    rrs = [
-        app_conn.deliver_tx_async(abci.RequestDeliverTx(bytes(tx)))
-        for tx in block.data.txs
-    ]
+    use_batch = (
+        executor is not None
+        and executor.exec_parallel
+        and not executor._batch_unsupported
+        and len(block.data.txs) > 0
+    )
+    if use_batch:
+        deliver_txs = await executor._deliver_batched(app_conn, block.data.txs)
+        end = await app_conn.end_block_sync(abci.RequestEndBlock(block.header.height))
+    else:
+        rrs = [
+            app_conn.deliver_tx_async(abci.RequestDeliverTx(bytes(tx)))
+            for tx in block.data.txs
+        ]
+        end = await app_conn.end_block_sync(abci.RequestEndBlock(block.header.height))
+        deliver_txs = [await rr.wait() for rr in rrs]
 
-    end = await app_conn.end_block_sync(abci.RequestEndBlock(block.header.height))
-
-    deliver_txs: List[abci.ResponseDeliverTx] = []
-    invalid = 0
-    for rr in rrs:
-        res = await rr.wait()
-        if not res.is_ok():
-            invalid += 1
-        deliver_txs.append(res)
+    invalid = sum(1 for res in deliver_txs if not res.is_ok())
     if invalid:
         logger.info("invalid txs", count=invalid)
     logger.info(

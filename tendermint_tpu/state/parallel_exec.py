@@ -1,0 +1,113 @@
+"""Optimistic-parallel block execution scheduler (Block-STM style).
+
+The trap named in arxiv 2112.02229: once admission verifies >1M sig/s,
+end-to-end throughput is pinned by host-serial execution — one ABCI
+round-trip per tx at commit. This module is the execution half of the
+fix: txs are executed *speculatively* against the block-start snapshot
+(so sig checks, parsing, and balance math batch across the whole block),
+then validated in block order against the keys earlier txs actually
+wrote. A tx whose read/write footprint is untouched keeps its
+speculative result; a conflicting tx is re-run serially against live
+state. Because validation walks txs in block order and re-runs use the
+exact serial code path, verdicts, per-tx results and the resulting app
+hash are bit-identical to serial execution by construction — parallelism
+is an implementation detail the wire never sees.
+
+The scheduler is app-agnostic: callers supply three closures
+(``speculate``, ``rerun``, ``apply_writes``) so payments can vectorize
+balance scatter/gather and kvproofs can batch key hashing without this
+module knowing either state model.
+
+Also home to the env-default helpers for the ``TM_EXEC`` kill switch so
+sim-built executors (no BaseConfig in sim/core.build_node) resolve the
+same knobs as full nodes.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Callable, Dict, Iterable, List, Set, Tuple
+
+#: default txs per DeliverBatch request (config.base.exec_batch_txs)
+DEFAULT_EXEC_BATCH_TXS = 256
+
+
+def exec_parallel_default() -> bool:
+    """Resolve the batched/parallel execution lane from the ``TM_EXEC``
+    kill switch (same idiom as TM_MESH/TM_BLS_DEVICE): unset or truthy
+    means on, ``0``/``false``/empty means off."""
+    env = os.environ.get("TM_EXEC")
+    if env is None:
+        return True
+    return env.strip().lower() not in ("0", "false", "")
+
+
+def exec_batch_txs_default() -> int:
+    env = os.environ.get("TM_EXEC_BATCH_TXS")
+    if env:
+        try:
+            return max(1, int(env))
+        except ValueError:
+            pass
+    return DEFAULT_EXEC_BATCH_TXS
+
+
+# speculate(tx)    -> (result, reads, writes) against the block-start snapshot
+# rerun(tx)        -> (result, written_keys) against LIVE state (serial path)
+# apply_writes(ws) -> apply {key: value} to live state (footprints of the txs
+#                     that reach one apply_writes call are pairwise disjoint,
+#                     so the caller may scatter them in any order / vectorized)
+Speculation = Tuple[object, Set, Dict]
+Rerun = Tuple[object, Iterable]
+
+
+def run_batch(
+    txs: List,
+    speculate: Callable[[object], Speculation],
+    rerun: Callable[[object], Rerun],
+    apply_writes: Callable[[Dict], None],
+) -> Tuple[List, Dict[str, int]]:
+    """Execute ``txs`` optimistically; return (results, stats).
+
+    Results are in tx order and bit-identical to running ``rerun`` on
+    every tx sequentially. Stats: ``conflicts`` (txs whose speculative
+    footprint intersected an earlier tx's writes), ``serial_reruns``
+    (conflicting txs re-executed serially), ``parallel_applied`` (txs
+    whose speculative result survived validation).
+
+    Correctness argument: the validation pass walks txs in block order
+    keeping ``dirty`` = every key written by an earlier tx (speculative
+    or re-run). A tx whose footprint (reads ∪ writes) misses ``dirty``
+    saw exactly the state serial execution would have shown it — its
+    speculative result IS the serial result, and because *writes* are in
+    the footprint too, the surviving write-sets are pairwise disjoint
+    (safe to apply unordered). Any overlap flushes the pending writes
+    (so live state reflects every earlier tx) and re-runs the tx on the
+    serial path itself.
+    """
+    specs = [speculate(tx) for tx in txs]
+
+    results: List = []
+    dirty: Set = set()
+    pending: Dict = {}
+    stats = {"conflicts": 0, "serial_reruns": 0, "parallel_applied": 0}
+
+    for tx, (result, reads, writes) in zip(txs, specs):
+        footprint = reads | set(writes)
+        if footprint & dirty:
+            stats["conflicts"] += 1
+            stats["serial_reruns"] += 1
+            if pending:
+                apply_writes(pending)
+                pending = {}
+            result, written = rerun(tx)
+            dirty.update(written)
+        else:
+            stats["parallel_applied"] += 1
+            pending.update(writes)
+            dirty.update(writes)
+        results.append(result)
+
+    if pending:
+        apply_writes(pending)
+    return results, stats

@@ -85,6 +85,7 @@ KNOWN_SITES = (
     "bls.pairing",        # models/bls.py device kernel dispatch (verify/map/aggregate; a raise trips the breaker and the call falls back to the host oracle)
     "bls.compile",        # models/bls.py bucket compile (_warm)
     "mesh.shard",         # parallel/topology.py per-shard dispatch (run/run_collective); a raise trips the slot's mesh.device<i> breaker and the bundle falls back to the unmeshed path
+    "exec.batch",         # state/execution.py DeliverBatch dispatch (a raise degrades the block to the serial per-tx path — never a wrong app hash)
 )
 
 _ACTIONS = ("raise", "delay", "tear")

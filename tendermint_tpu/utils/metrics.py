@@ -729,6 +729,58 @@ class MeshMetrics:
             )
 
 
+class ExecMetrics:
+    """Batched block execution (``tendermint_exec_*``,
+    state/execution.BlockExecutor.exec_stats()): how many DeliverBatch
+    requests ran and how many txs they carried, the optimistic-parallel
+    scheduler's conflict / serial-re-run pressure, where the apps'
+    batch work executed (device vs host rows), and how often a failed
+    batch degraded to the per-tx path. Monotonic totals are TRUE
+    counters fed by snapshot deltas, like CryptoMetrics; the batch-size
+    histogram is observed directly by the executor. See
+    docs/execution.md and docs/metrics.md."""
+
+    _COUNTERS = (
+        ("batches", "batches"),
+        ("batch_txs", "batch_txs"),
+        ("fallbacks", "fallbacks"),
+        ("conflicts", "conflicts"),
+        ("serial_reruns", "serial_reruns"),
+        ("device_rows", "device_rows"),
+        ("host_rows", "host_rows"),
+    )
+
+    def __init__(self, registry: Optional[Registry] = None, namespace="tendermint"):
+        r = registry or Registry()
+        sub = "exec"
+        reg = r.register
+        self.batches = reg(Counter("batches_total", "DeliverBatch requests executed.", namespace, sub))
+        self.batch_txs = reg(Counter("batch_txs_total", "Txs delivered via DeliverBatch requests.", namespace, sub))
+        self.fallbacks = reg(Counter("fallbacks_total", "Blocks (or block remainders) degraded to the per-tx DeliverTx path.", namespace, sub))
+        self.conflicts = reg(Counter("conflicts_total", "Speculative txs whose read/write footprint hit an earlier tx's writes.", namespace, sub))
+        self.serial_reruns = reg(Counter("serial_reruns_total", "Conflicting txs re-executed on the serial path.", namespace, sub))
+        self.device_rows = reg(Counter("device_rows_total", "App batch rows (signatures, hashes) executed on the device engines.", namespace, sub))
+        self.host_rows = reg(Counter("host_rows_total", "App batch rows executed on host (no engine injected or fallback).", namespace, sub))
+        self.batch_size = reg(
+            Histogram(
+                "batch_size_txs",
+                "Txs per DeliverBatch request.",
+                namespace, sub,
+                buckets=(1, 2, 4, 8, 16, 32, 64, 128, 256, 512),
+            )
+        )
+        self._deltas = _SnapshotCounters()
+
+    def observe_batch_txs(self, n: int) -> None:
+        self.batch_size.observe(n)
+
+    def update(self, stats: dict) -> None:
+        """Fold a BlockExecutor.exec_stats() snapshot into the
+        instruments."""
+        for attr, key in self._COUNTERS:
+            self._deltas.feed(getattr(self, attr), key, stats)
+
+
 class EngineMetrics:
     """Unified device-engine telemetry (``tendermint_engine_*``): ONE
     labeled family over every engine implementing the

@@ -440,3 +440,63 @@ def test_coldstart_carry_at_most_once(bench):
     assert bench._carry_coldstart(dict(fresh), "tpu") == fresh
     # cpu fallback never carries
     assert bench._carry_coldstart({}, "cpu") == {}
+
+
+def test_guard_flags_exec_regression_and_disappearance(bench):
+    """The execution-lane keys ride the guard like replay_speedup: a
+    previously-measured deliver_speedup or end-to-end tx/s that
+    regresses or goes missing must hard-fail the bench."""
+    _write_record(bench, deliver_speedup=50.0, e2e_txs_per_sec=5000.0)
+    fails = bench._regression_guard(
+        {"deliver_speedup": 20.0, "e2e_txs_per_sec": 5000.0}, "tpu"
+    )
+    assert len(fails) == 1 and "deliver_speedup" in fails[0]
+    # section errored entirely: both keys flagged missing
+    fails = bench._regression_guard({"exec_error": "boom"}, "tpu")
+    assert any("deliver_speedup" in f and "missing" in f for f in fails)
+    assert any("e2e_txs_per_sec" in f for f in fails)
+    # within tolerance: clean
+    assert (
+        bench._regression_guard(
+            {"deliver_speedup": 45.0, "e2e_txs_per_sec": 4200.0}, "tpu"
+        )
+        == []
+    )
+    # provenance mismatch (TPU baseline, CPU-fallback exec section):
+    # skipped loudly, not judged
+    _write_record(
+        bench, deliver_speedup=50.0, e2e_txs_per_sec=5000.0, exec_platform="tpu"
+    )
+    fails = bench._regression_guard(
+        {"deliver_speedup": 1.0, "e2e_txs_per_sec": 10.0, "exec_platform": "cpu"},
+        "tpu",
+    )
+    assert fails == []
+    assert any("deliver_speedup" in s for s in bench.GUARD_SKIPS)
+
+
+def test_exec_bench_deliver_batch_beats_serial_5x(bench, monkeypatch):
+    """The ISSUE-17 acceptance bar, enforced at test scale: the batched
+    DeliverBatch lane (SigCache-warm signature resolution + optimistic-
+    parallel schedule + bulk write scatter) delivers a block at least 5x
+    the per-tx serial DeliverTx arm, with bit-identical verdicts and app
+    hash (asserted inside exec_bench). The live-node e2e arm is skipped
+    here (it rides bench.py)."""
+    monkeypatch.setattr(bench, "EXEC_TXS", 48)
+    # best-of-2: a scheduler hiccup on a small shared box can eat one
+    # batched arm (the bench's own min-of-N discipline); typical runs
+    # measure 100x+ here
+    best = None
+    for _ in range(2):
+        out = bench.exec_bench(e2e=False)
+        assert "exec_error" not in out, out
+        if best is None or out["deliver_speedup"] > best["deliver_speedup"]:
+            best = out
+        if best["deliver_speedup"] >= 5.0:
+            break
+    out = best
+    assert out["deliver_speedup"] >= 5.0, out
+    # the mechanisms that produce the speedup actually engaged: the warm
+    # pass bundled every signature, the timed pass ran conflict-free
+    assert out["exec_warm_device_rows"] + out["exec_warm_host_rows"] == 48
+    assert out["exec_conflicts"] == 0 and out["exec_serial_reruns"] == 0

@@ -79,6 +79,12 @@ CHAOS_PLAN = {
     # a collective, so this stays armed-but-idle here;
     # test_mesh_router.py drives the shed/readmit paths hot.
     "mesh.shard": ("raise", dict(p=0.3)),
+    # the executor absorbs raises by design: a batch fault fires BEFORE
+    # any DeliverBatch chunk is dispatched, so the block degrades to the
+    # serial per-tx path with identical responses — never a wrong app
+    # hash. test_chaos_exec_batch_faults_node_still_commits drives it
+    # hot against a live node landing real transfers.
+    "exec.batch": ("raise", dict(p=0.3)),
 }
 
 
@@ -216,6 +222,77 @@ def test_chaos_admission_faults_node_still_commits(tmp_path):
         # the chaos was real AND transfers still committed through it
         assert st["ingest.batch"]["triggers"] + st["mempool.admit"]["triggers"] > 0
         assert app.tx_applied > 0, "no transfer survived the admission chaos"
+
+    asyncio.run(go())
+
+
+def test_chaos_exec_batch_faults_node_still_commits(tmp_path):
+    """ISSUE-17 chaos acceptance: a live node whose block EXECUTION runs
+    under injected exec.batch faults (p=0.3) still commits >= 5 heights
+    and still lands real payment transfers — every faulted block
+    degrades to the serial per-tx DeliverTx path with an identical app
+    hash, so batching chaos can cost throughput but never correctness."""
+
+    async def go():
+        from tendermint_tpu.abci.examples.payments import (
+            PaymentsApplication,
+            sig_rows,
+        )
+        from tendermint_tpu.crypto.pipeline import (
+            PipelinedVerifier as PV,
+            SigCache as SC,
+        )
+        from tendermint_tpu.ingest import IngestBatcher
+        from tendermint_tpu.ingest import loadgen as igen
+        from tests.cs_harness import make_genesis, make_node
+
+        faults.arm("exec.batch", "raise", p=0.3, seed=CHAOS_SEED)
+
+        privs, balances = igen.accounts(4)
+        txs = igen.make_transfers(privs, 24, amount=1, fee=1)
+        cache = SC()
+        app = PaymentsApplication(dict(balances), sig_cache=cache)
+        genesis, vals = make_genesis(1)
+        node = await make_node(genesis, vals[0], app=app)
+        pv = PV(CPUBatchVerifier(), cache=cache)
+        app.batch_verifier = pv
+        batcher = IngestBatcher(
+            node.mempool, verifier=pv, sig_extractor=sig_rows,
+            bundle_txs=8, hash_threshold=1 << 30,
+        )
+        await node.cs.start()
+        try:
+            async def submit_with_retry(tx):
+                from tendermint_tpu.mempool.mempool import ErrTxInCache
+
+                for _ in range(20):
+                    try:
+                        await batcher.check_tx(tx)
+                        return True
+                    except ErrTxInCache:
+                        return True
+                    except Exception:
+                        await asyncio.sleep(0.02)
+                return False
+
+            ok = await asyncio.gather(*(submit_with_retry(t) for t in txs))
+            assert all(ok), "admission starved a tx past 20 retries"
+            await node.cs.wait_for_height(5, timeout_s=90)
+        finally:
+            st = faults.stats()["sites"]
+            exec_stats = node.cs._block_exec.exec_stats()
+            await node.cs.stop()
+            await batcher.stop()
+            faults.disarm()
+            pv.stop(timeout=5.0)
+
+        assert node.cs.state.last_block_height >= 5
+        # the chaos was real: the batch site fired and the serial
+        # fallback absorbed it — and transfers still committed
+        assert st["exec.batch"]["evals"] > 0, "exec.batch never evaluated"
+        assert st["exec.batch"]["triggers"] > 0, "exec.batch chaos never fired"
+        assert exec_stats["fallbacks"] > 0, "no faulted block degraded to per-tx"
+        assert app.tx_applied > 0, "no transfer survived the execution chaos"
 
     asyncio.run(go())
 

@@ -1,0 +1,502 @@
+"""ISSUE-17 property suite: the optimistic-parallel execution lane
+(state/parallel_exec.py + the DeliverBatch ABCI seam) must be
+bit-identical to serial execution — per-tx codes AND logs, app hash,
+and every side-channel total (fees burned, txs applied) — across
+randomized payments workloads with conflicting sender/receiver
+interleavings, nonce gaps and zero-amount edge txs, forced-conflict
+re-run paths, and the DeliverBatch→DeliverTx executor fallback for a
+batch-unaware app. Also pins the mempool's idle-height fast path
+(zero ABCI traffic when a block consumes the whole pool)."""
+
+import asyncio
+import random
+
+import pytest
+
+from tendermint_tpu.abci import types as t
+from tendermint_tpu.abci.client.local import LocalClient
+from tendermint_tpu.abci.examples.kvproofs import KVProofsApplication
+from tendermint_tpu.abci.examples.payments import (
+    CODE_BAD_NONCE,
+    PaymentsApplication,
+    make_transfer,
+)
+from tendermint_tpu.crypto.keys import Ed25519PrivKey
+from tendermint_tpu.state.parallel_exec import run_batch
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+# -- scheduler unit tests ---------------------------------------------------
+
+
+def _counter_model():
+    """Toy state: {key: int}; a 'tx' is (reads, {key: delta}) applied as
+    value = base + delta — enough to distinguish base-snapshot reads
+    from live-state reads."""
+    state = {}
+    applies = []
+
+    def speculate(tx):
+        reads, deltas = tx
+        writes = {k: state.get(k, 0) + d for k, d in deltas.items()}
+        return dict(writes), set(reads), writes
+
+    def rerun(tx):
+        reads, deltas = tx
+        out = {}
+        for k, d in deltas.items():
+            state[k] = state.get(k, 0) + d
+            out[k] = state[k]
+        return out, set(deltas)
+
+    def apply_writes(pending):
+        applies.append(dict(pending))
+        state.update(pending)
+
+    return state, applies, speculate, rerun, apply_writes
+
+
+def test_run_batch_disjoint_txs_apply_speculatively():
+    state, applies, spec, rerun, apply_w = _counter_model()
+    txs = [((), {"a": 1}), ((), {"b": 2}), ((), {"c": 3})]
+    results, stats = run_batch(txs, spec, rerun, apply_w)
+    assert state == {"a": 1, "b": 2, "c": 3}
+    assert stats == {"conflicts": 0, "serial_reruns": 0, "parallel_applied": 3}
+    # disjoint block = ONE bulk scatter
+    assert len(applies) == 1 and applies[0] == {"a": 1, "b": 2, "c": 3}
+
+
+def test_run_batch_conflicting_txs_rerun_serially():
+    state, applies, spec, rerun, apply_w = _counter_model()
+    # all three hit "a": serial order must see 1, then 3, then 6
+    txs = [((), {"a": 1}), ((), {"a": 2}), (("a",), {"b": 1, "a": 3})]
+    results, stats = run_batch(txs, spec, rerun, apply_w)
+    assert state["a"] == 6 and state["b"] == 1
+    assert results[0]["a"] == 1 and results[1]["a"] == 3 and results[2]["a"] == 6
+    assert stats["conflicts"] == 2 and stats["serial_reruns"] == 2
+    assert stats["parallel_applied"] == 1
+
+
+def test_run_batch_flushes_pending_before_rerun():
+    """A re-run must observe every EARLIER tx's writes — including
+    speculative ones still pending — or serial equivalence breaks."""
+    state, applies, spec, rerun, apply_w = _counter_model()
+    txs = [((), {"a": 5}), (("a",), {"b": 1})]  # tx1 reads a
+    results, stats = run_batch(txs, spec, rerun, apply_w)
+    # tx1 conflicted (read "a" which tx0 wrote); the rerun ran against
+    # state where a=5 was already applied
+    assert applies[0] == {"a": 5}
+    assert state == {"a": 5, "b": 1}
+    assert stats["serial_reruns"] == 1
+
+
+def test_run_batch_write_write_conflicts_detected():
+    """Footprint includes WRITES, so two blind writers to one key still
+    serialize (surviving write-sets stay pairwise disjoint)."""
+    state, applies, spec, rerun, apply_w = _counter_model()
+    txs = [((), {"a": 1}), ((), {"a": 1})]
+    _, stats = run_batch(txs, spec, rerun, apply_w)
+    assert state["a"] == 2
+    assert stats["conflicts"] == 1
+
+
+def test_run_batch_empty():
+    state, applies, spec, rerun, apply_w = _counter_model()
+    results, stats = run_batch([], spec, rerun, apply_w)
+    assert results == [] and applies == []
+
+
+# -- payments parity property -----------------------------------------------
+
+
+def _keys(n, tag):
+    return [Ed25519PrivKey.from_secret(f"{tag}-{i}".encode()) for i in range(n)]
+
+
+def _random_workload(rng, privs, n_txs):
+    """Adversarially-shaped block: round-robin + same-sender bursts
+    (conflict chains), overlapping recipients, nonce gaps and repeats,
+    zero-amount / zero-fee edge txs, overspends, self-transfers,
+    malformed bytes and bad signatures."""
+    accounts = [p.pub_key().bytes() for p in privs]
+    nonces = {i: 0 for i in range(len(privs))}
+    txs = []
+    for _ in range(n_txs):
+        roll = rng.random()
+        if roll < 0.05:
+            txs.append(bytes(rng.getrandbits(8) for _ in range(rng.choice((3, 156)))))
+            continue
+        s = rng.randrange(len(privs))
+        p = privs[s]
+        recipient = accounts[rng.randrange(len(accounts))]  # self-transfers included
+        nonce = nonces[s]
+        if roll < 0.15:
+            nonce += rng.choice((-1, 1, 5))  # gap / stale
+        amount = rng.choice((0, 1, 7, 10**12))  # 10**12 overspends
+        fee = rng.choice((0, 1, 3))
+        tx = make_transfer(p, max(nonce, 0), recipient, amount, fee=fee)
+        if roll < 0.10:
+            tx = tx[:-1] + bytes([tx[-1] ^ 1])  # corrupt the signature
+        else:
+            # only count an expected-good nonce use when untampered
+            if nonce == nonces[s]:
+                nonces[s] += 1
+        txs.append(tx)
+    return txs
+
+
+def _serial_outcome(balances, txs):
+    app = PaymentsApplication(dict(balances), sig_cache=False)
+    results = [app.deliver_tx(t.RequestDeliverTx(tx)) for tx in txs]
+    return (
+        [(r.code, r.log) for r in results],
+        app.commit().data,
+        app._fees_burned,
+        app.tx_applied,
+    )
+
+
+def _batched_outcome(balances, txs, chunk=None):
+    app = PaymentsApplication(dict(balances), sig_cache=False)
+    results, stats_total = [], {"conflicts": 0, "serial_reruns": 0}
+    chunks = (
+        [txs]
+        if chunk is None
+        else [txs[i : i + chunk] for i in range(0, len(txs), chunk)]
+    )
+    for c in chunks:
+        res = app.deliver_batch(t.RequestDeliverBatch(c))
+        results.extend(res.results)
+        stats_total["conflicts"] += res.conflicts
+        stats_total["serial_reruns"] += res.serial_reruns
+    return (
+        [(r.code, r.log) for r in results],
+        app.commit().data,
+        app._fees_burned,
+        app.tx_applied,
+        stats_total,
+    )
+
+
+@pytest.mark.parametrize("seed", [1, 7, 23, 101, 9001])
+def test_payments_random_workload_parity(seed):
+    rng = random.Random(seed)
+    privs = _keys(5, f"pp-{seed}")
+    balances = {p.pub_key().bytes(): rng.choice((0, 5, 1000)) for p in privs}
+    txs = _random_workload(rng, privs, 120)
+    serial = _serial_outcome(balances, txs)
+    batched = _batched_outcome(balances, txs)
+    assert batched[:4] == serial, "parallel schedule diverged from serial"
+
+
+@pytest.mark.parametrize("chunk", [1, 7, 64])
+def test_payments_parity_any_chunking(chunk):
+    """Chunk boundaries are not allowed to be observable."""
+    rng = random.Random(42)
+    privs = _keys(4, "chunk")
+    balances = {p.pub_key().bytes(): 500 for p in privs}
+    txs = _random_workload(rng, privs, 60)
+    serial = _serial_outcome(balances, txs)
+    assert _batched_outcome(balances, txs, chunk=chunk)[:4] == serial
+
+
+def test_payments_forced_conflict_chain_reruns():
+    """A whole-block same-sender nonce chain is the worst case: every
+    tx after the first must conflict and re-run serially — and the
+    outcome is still bit-identical to serial."""
+    privs = _keys(2, "chain")
+    sender, other = privs
+    balances = {sender.pub_key().bytes(): 1000, other.pub_key().bytes(): 0}
+    txs = [
+        make_transfer(sender, n, other.pub_key().bytes(), 1, fee=1)
+        for n in range(12)
+    ]
+    serial = _serial_outcome(balances, txs)
+    codes, app_hash, fees, applied, stats = _batched_outcome(balances, txs)
+    assert (codes, app_hash, fees, applied) == serial
+    assert stats["serial_reruns"] == len(txs) - 1, "chain must force re-runs"
+    assert all(c == t.CODE_TYPE_OK for c, _ in codes)
+
+
+def test_payments_nonce_gap_filled_by_earlier_tx_in_block():
+    """A tx whose nonce only becomes valid AFTER an earlier in-block tx
+    advances the sender: speculation sees BAD_NONCE, the conflict
+    re-run must see OK — the exact case where skipping the conflict
+    check would flip a verdict."""
+    privs = _keys(2, "gap")
+    a, b = privs
+    balances = {a.pub_key().bytes(): 100, b.pub_key().bytes(): 100}
+    txs = [
+        make_transfer(a, 0, b.pub_key().bytes(), 1),
+        make_transfer(a, 1, b.pub_key().bytes(), 1),
+    ]
+    serial = _serial_outcome(balances, txs)
+    batched = _batched_outcome(balances, txs)
+    assert batched[:4] == serial
+    assert [c for c, _ in batched[0]] == [t.CODE_TYPE_OK, t.CODE_TYPE_OK]
+    # and a genuinely-bad nonce STAYS bad when nothing fills the gap
+    lone = [make_transfer(a, 5, b.pub_key().bytes(), 1)]
+    assert _batched_outcome(balances, lone)[:4] == _serial_outcome(balances, lone)
+    assert _batched_outcome(balances, lone)[0][0][0] == CODE_BAD_NONCE
+
+
+def test_payments_funds_arriving_mid_block():
+    """Receiver-then-spender ordering: an account funded by an earlier
+    in-block transfer spends it later in the same block."""
+    privs = _keys(2, "fund")
+    rich, poor = privs
+    balances = {rich.pub_key().bytes(): 100}  # poor has NO record
+    txs = [
+        make_transfer(rich, 0, poor.pub_key().bytes(), 50, fee=0),
+        make_transfer(poor, 0, rich.pub_key().bytes(), 30, fee=0),
+    ]
+    serial = _serial_outcome(balances, txs)
+    batched = _batched_outcome(balances, txs)
+    assert batched[:4] == serial
+    assert [c for c, _ in batched[0]] == [t.CODE_TYPE_OK, t.CODE_TYPE_OK]
+
+
+def test_payments_sigcache_warm_vs_cold_same_answer():
+    """The SigCache fast path (admission pre-warm) must not change any
+    batch verdict: warm-cache and no-cache runs agree bit-for-bit."""
+    from tendermint_tpu.crypto.pipeline import SigCache
+
+    privs = _keys(3, "warm")
+    balances = {p.pub_key().bytes(): 100 for p in privs}
+    rng = random.Random(5)
+    txs = _random_workload(rng, privs, 40)
+    cold = _batched_outcome(balances, txs)
+
+    cache = SigCache()
+    app = PaymentsApplication(dict(balances), sig_cache=cache)
+    for tx in txs:  # admission warms the cache
+        app.check_tx(t.RequestCheckTx(tx))
+    res = app.deliver_batch(t.RequestDeliverBatch(txs))
+    assert [(r.code, r.log) for r in res.results] == cold[0]
+    assert app.commit().data == cold[1]
+
+
+# -- kvproofs parity --------------------------------------------------------
+
+
+@pytest.mark.parametrize("seed", [3, 77])
+def test_kvproofs_random_parity(seed):
+    rng = random.Random(seed)
+    keys = [f"k{rng.randrange(6)}".encode() for _ in range(50)]
+    txs = []
+    for k in keys:
+        roll = rng.random()
+        if roll < 0.1:
+            txs.append(b"")  # empty tx -> code 1
+        elif roll < 0.3:
+            txs.append(k)  # bare key stores itself
+        else:
+            txs.append(k + b"=" + bytes(rng.getrandbits(8) for _ in range(8)))
+    a1 = KVProofsApplication()
+    r1 = [a1.deliver_tx(t.RequestDeliverTx(tx)) for tx in txs]
+    h1 = a1.commit().data
+    a2 = KVProofsApplication()
+    r2 = a2.deliver_batch(t.RequestDeliverBatch(txs))
+    h2 = a2.commit().data
+    assert [(r.code, r.log) for r in r1] == [(r.code, r.log) for r in r2.results]
+    assert h1 == h2
+    assert a2.batches_delivered == 1
+
+
+def test_kvproofs_batch_hasher_rows_counted():
+    """With a device hasher injected, the batch reports where the value
+    digests ran — and the digests agree with the host path."""
+    from tendermint_tpu.ingest.hashing import TxKeyHasher
+
+    app = KVProofsApplication()
+    app.batch_hasher = TxKeyHasher(block_on_compile=True)
+    app.hash_threshold = 1 << 30  # force host routing inside the hasher
+    res = app.deliver_batch(t.RequestDeliverBatch([b"a=1", b"b=2"]))
+    assert res.host_rows == 2 and res.device_rows == 0
+    ref = KVProofsApplication()
+    ref.deliver_batch(t.RequestDeliverBatch([b"a=1", b"b=2"]))
+    assert app.commit().data == ref.commit().data
+
+
+# -- executor: batched delivery + fallback ----------------------------------
+
+
+def _mk_executor(app, **kw):
+    from tendermint_tpu.state.execution import BlockExecutor
+
+    client = LocalClient(app)
+    executor = BlockExecutor(None, client, exec_parallel=True, **kw)
+    return client, executor
+
+
+def test_executor_chunked_delivery_matches_serial():
+    async def go():
+        privs = _keys(3, "exe")
+        balances = {p.pub_key().bytes(): 100 for p in privs}
+        rng = random.Random(11)
+        txs = _random_workload(rng, privs, 30)
+        serial = _serial_outcome(balances, txs)
+
+        app = PaymentsApplication(dict(balances), sig_cache=False)
+        client, executor = _mk_executor(app, exec_batch_txs=7)
+        await client.start()
+        try:
+            out = await executor._deliver_batched(client, txs)
+        finally:
+            await client.stop()
+        assert [(r.code, r.log) for r in out] == serial[0]
+        assert app.commit().data == serial[1]
+        st = executor.exec_stats()
+        assert st["batches"] == (len(txs) + 6) // 7
+        assert st["batch_txs"] == len(txs)
+        assert st["fallbacks"] == 0
+
+    run(go())
+
+
+def test_executor_falls_back_for_batch_unaware_app():
+    """An app that answers DeliverBatch with an exception (the old-app /
+    native-binary shape: "unknown request tag") degrades the block to
+    per-tx delivery with identical results, and the executor latches so
+    later blocks skip the probe."""
+
+    class BatchUnaware(KVProofsApplication):
+        def deliver_batch(self, req):
+            raise ValueError("unknown request tag 0x0c")
+
+    async def go():
+        txs = [b"a=1", b"b=2", b"a=3"]
+        ref = KVProofsApplication()
+        ref_results = [ref.deliver_tx(t.RequestDeliverTx(tx)) for tx in txs]
+
+        app = BatchUnaware()
+        client, executor = _mk_executor(app)
+        await client.start()
+        try:
+            out = await executor._deliver_batched(client, txs)
+        finally:
+            await client.stop()
+        assert [(r.code, r.log) for r in out] == [
+            (r.code, r.log) for r in ref_results
+        ]
+        assert app.commit().data == ref.commit().data
+        assert executor._batch_unsupported, "unknown-tag failure must latch"
+        assert executor.exec_stats()["fallbacks"] == 1
+
+    run(go())
+
+
+def test_executor_kill_switch_and_env_defaults(monkeypatch):
+    from tendermint_tpu.state.execution import BlockExecutor
+    from tendermint_tpu.state import parallel_exec as pe
+
+    monkeypatch.setenv("TM_EXEC", "0")
+    assert pe.exec_parallel_default() is False
+    ex = BlockExecutor(None, None)
+    assert ex.exec_parallel is False
+    monkeypatch.setenv("TM_EXEC", "1")
+    assert pe.exec_parallel_default() is True
+    monkeypatch.delenv("TM_EXEC", raising=False)
+    assert pe.exec_parallel_default() is True  # on by default
+    monkeypatch.setenv("TM_EXEC_BATCH_TXS", "17")
+    assert BlockExecutor(None, None).exec_batch_txs == 17
+    # explicit config wins over env
+    assert BlockExecutor(None, None, exec_batch_txs=9).exec_batch_txs == 9
+
+
+def test_config_exec_knobs_validated():
+    from tendermint_tpu.config import Config
+
+    cfg = Config()
+    assert cfg.base.exec_parallel is True
+    assert cfg.base.exec_batch_txs == 256
+    cfg.base.exec_batch_txs = 0
+    assert "exec_batch_txs" in cfg.base.validate_basic()
+
+
+# -- wire: tolerant stats tail ----------------------------------------------
+
+
+def test_response_deliver_batch_tolerates_short_frame():
+    """A stats-unaware peer's frame (results only) must decode with
+    zeroed tail — the ResponseCheckTx.priority compatibility rule."""
+    from tendermint_tpu.codec.binary import Writer
+
+    w = Writer().write_uvarint(2)
+    w.write_bytes(t.ResponseDeliverTx(code=0).encode())
+    w.write_bytes(t.ResponseDeliverTx(code=4, log="broke").encode())
+    res = t.ResponseDeliverBatch.decode(w.bytes())
+    assert [r.code for r in res.results] == [0, 4]
+    assert res.lane == "" and res.conflicts == 0 and res.device_rows == 0
+    # and the full frame round-trips
+    full = t.ResponseDeliverBatch(
+        results=[t.ResponseDeliverTx()], lane="device",
+        conflicts=1, serial_reruns=2, device_rows=3, host_rows=4,
+    )
+    assert t.ResponseDeliverBatch.decode(full.encode()) == full
+    req = t.RequestDeliverBatch([b"", b"xy"])
+    assert t.RequestDeliverBatch.decode(req.encode()) == req
+
+
+# -- mempool: idle-height fast path -----------------------------------------
+
+
+class _SpyClient(LocalClient):
+    def __init__(self, app):
+        super().__init__(app)
+        self.check_calls = 0
+        self.flush_calls = 0
+
+    def check_tx_async(self, req):
+        self.check_calls += 1
+        return super().check_tx_async(req)
+
+    async def flush(self):
+        self.flush_calls += 1
+        return await super().flush()
+
+
+def test_mempool_update_skips_recheck_when_pool_drained():
+    """ISSUE-17 satellite: a block that consumes the whole pool must
+    leave update() with ZERO recheck ABCI traffic — no CheckTx
+    round-trips, no flush — and an idle next height stays silent too."""
+    from tendermint_tpu.abci.examples.kvstore import KVStoreApplication
+    from tendermint_tpu.config import MempoolConfig
+    from tendermint_tpu.mempool import Mempool
+    from tendermint_tpu.types.tx import Txs
+
+    async def go():
+        client = _SpyClient(KVStoreApplication())
+        await client.start()
+        pool = Mempool(MempoolConfig(recheck=True), client)
+        txs = [b"a=1", b"b=2"]
+        for tx in txs:
+            await pool.check_tx(tx)
+        assert pool.size() == 2
+        client.check_calls = client.flush_calls = 0
+
+        await pool.update(
+            1, Txs(txs), [abci.ResponseDeliverTx() for _ in txs]
+        )
+        assert pool.size() == 0
+        assert client.check_calls == 0, "drained pool must not recheck"
+        assert client.flush_calls == 0, "drained pool must not flush"
+
+        # idle next height: still zero traffic
+        await pool.update(2, Txs([]), [])
+        assert client.check_calls == 0 and client.flush_calls == 0
+
+        # control: a RESIDENT tx still rechecks (the fast path must not
+        # swallow real rechecks)
+        await pool.check_tx(b"c=3")
+        await pool.update(3, Txs([b"a=1"]), [abci.ResponseDeliverTx()])
+        assert client.check_calls == 1 and client.flush_calls == 1
+        await client.stop()
+
+    run(go())
+
+
+from tendermint_tpu.abci import types as abci  # noqa: E402  (spy test above)

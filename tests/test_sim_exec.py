@@ -1,0 +1,71 @@
+"""Exec-parity acceptance rig (the ISSUE-17 batched execution lane).
+
+The simulator is the repo's determinism instrument: a same-seed
+scenario run must be byte-identical whether blocks execute through the
+serial per-tx DeliverTx loop (``TM_EXEC=0``) or the chunked
+DeliverBatch lane with the optimistic-parallel scheduler. Commit
+hashes AND the network event-trace digest are compared, so a batch
+apply that flips a verdict, misplaces a write, or reorders an
+observable event anywhere in the speculate/validate/scatter seam
+fails loudly — the kvproofs app commits a merkle root over delivered
+state, so one wrong write cascades into every later commit hash. The
+slow leg repeats the proof at 256 nodes under the same flash-crowd
+load.
+"""
+
+import pytest
+
+from tendermint_tpu.sim.scenario import run_scenario
+
+
+def _run(monkeypatch, batched: bool, **overrides):
+    """One scenario run; with ``batched`` on, also assert the
+    DeliverBatch lane actually engaged (a parity proof over a path that
+    never ran proves nothing)."""
+    monkeypatch.setenv("TM_EXEC", "1" if batched else "0")
+    sc, sim, res, fails = run_scenario("exec_parity.scn", **overrides)
+    assert fails == [], fails
+    assert res.completed and res.safety_ok()
+    batches = sum(getattr(n.app, "batches_delivered", 0) for n in sim.nodes)
+    if batched:
+        assert batches > 0, (
+            "batched run never took the DeliverBatch lane — parity is vacuous"
+        )
+    else:
+        assert batches == 0, "TM_EXEC=0 run still delivered batches"
+    return res
+
+
+def test_exec_parity_bit_identical_at_tier1_scale(monkeypatch):
+    """Same seed, batched execution on vs off: identical commit hashes
+    at every height on every node, identical event-trace digest."""
+    off = _run(monkeypatch, batched=False)
+    on = _run(monkeypatch, batched=True)
+    assert on.commit_hashes == off.commit_hashes
+    assert on.trace_digest == off.trace_digest
+    assert on.heights == off.heights
+
+
+def test_exec_batch_size_is_a_knob(monkeypatch):
+    """TM_EXEC_BATCH_TXS=<n> picks the chunk size; any chunking must
+    still be bit-identical to the serial run (chunk boundaries are not
+    allowed to be observable)."""
+    off = _run(monkeypatch, batched=False)
+    monkeypatch.setenv("TM_EXEC_BATCH_TXS", "7")
+    try:
+        on = _run(monkeypatch, batched=True)
+    finally:
+        monkeypatch.delenv("TM_EXEC_BATCH_TXS", raising=False)
+    assert on.commit_hashes == off.commit_hashes
+    assert on.trace_digest == off.trace_digest
+
+
+@pytest.mark.slow
+def test_exec_parity_256_nodes(monkeypatch):
+    """The scaled leg: 256 nodes, same flash-crowd load — the batched
+    lane is still bit-identical to the serial baseline."""
+    size = dict(nodes=256, validators=8, heights=12)
+    off = _run(monkeypatch, batched=False, **size)
+    on = _run(monkeypatch, batched=True, **size)
+    assert on.commit_hashes == off.commit_hashes
+    assert on.trace_digest == off.trace_digest
