@@ -1,0 +1,233 @@
+#!/usr/bin/env python3
+"""autopsy: render a node's debug dump into a human stall diagnosis.
+
+The diagnosis layer's no-UI path (docs/observability.md): point it at a
+saved ``dump_debug`` artifact, a live node's RPC base URL, or a
+crash-survivable flight-recorder tail file, and get the answer to "why
+is this node not committing?" as text:
+
+    python scripts/autopsy.py dump.json
+    python scripts/autopsy.py --url http://127.0.0.1:26657
+    python scripts/autopsy.py --tail ~/.tendermint/data/cs.wal.flightrec
+    curl -s localhost:26657/dump_debug | python scripts/autopsy.py -
+
+Output: the headline diagnosis (blocked step + reason), the quorum
+arithmetic (power present vs needed, exact missing validator indices),
+peer connectivity with last-gossip ages, breaker/engine state, and the
+newest flight-recorder events. ``--json`` emits the structured
+diagnosis for CI; exit is 0 on a readable dump, 2 on unreadable input.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+from typing import Any, Dict, List, Optional
+
+# --tail decodes WAL frames via the package; make the repo importable
+# when run as a loose script (the tmlint.py pattern)
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+
+def load_dump(source: str, url: Optional[str], timeout_s: float = 10.0) -> dict:
+    """A dump_debug document from a file path, '-' (stdin), or a node's
+    RPC base URL (fetches /dump_debug)."""
+    if url:
+        import urllib.request
+
+        target = url.rstrip("/")
+        if not target.endswith("dump_debug"):
+            target += "/dump_debug"
+        with urllib.request.urlopen(target, timeout=timeout_s) as resp:
+            raw = json.loads(resp.read().decode())
+    elif source == "-":
+        raw = json.load(sys.stdin)
+    else:
+        with open(source, encoding="utf-8") as fp:
+            raw = json.load(fp)
+    # unwrap a JSON-RPC envelope ({"result": {...}}) if present
+    if isinstance(raw, dict) and "diagnosis" not in raw:
+        inner = raw.get("result")
+        if isinstance(inner, dict) and "diagnosis" in inner:
+            raw = inner
+    if not isinstance(raw, dict) or "diagnosis" not in raw:
+        raise ValueError("input is not a dump_debug document (no diagnosis)")
+    return raw
+
+
+def load_tail_dump(path: str) -> dict:
+    """Wrap a crash-survivable recorder tail file (<wal>.flightrec) as
+    a minimal dump: events only, no live diagnosis — the black box of a
+    node that is no longer running."""
+    import os
+
+    from tendermint_tpu.consensus.flightrec import load_tail
+
+    if not os.path.exists(path):
+        # common slip: pointing at <wal> instead of <wal>/wal when the
+        # WAL is a directory — never render an empty dump for a typo
+        raise SystemExit(f"autopsy: no such tail file: {path}")
+    events = load_tail(path)
+    if not events:
+        raise SystemExit(f"autopsy: no complete frames in tail file: {path}")
+    return {
+        "node_id": "",
+        "flightrec": events,
+        "recorder": {"buffered": len(events), "events_recorded": len(events)},
+        "diagnosis": {"reason": "offline tail — no live state", "offline": True},
+    }
+
+
+def _fmt_table(rows: List[List[Any]], header: List[str]) -> str:
+    widths = [
+        max(len(str(r[i])) for r in [header] + rows) for i in range(len(header))
+    ]
+
+    def line(r):
+        return "  ".join(str(c).ljust(w) for c, w in zip(r, widths)).rstrip()
+
+    out = [line(header), line(["-" * w for w in widths])]
+    out.extend(line(r) for r in rows)
+    return "\n".join(out)
+
+
+def render_text(dump: Dict[str, Any], events: int) -> str:
+    out: List[str] = []
+    diag = dump.get("diagnosis") or {}
+    nid = dump.get("node_id") or diag.get("node_id") or "?"
+    out.append(f"== autopsy: node {nid} ==")
+    if diag.get("offline"):
+        out.append("offline flight-recorder tail (no live diagnosis)")
+    else:
+        out.append(
+            f"height {diag.get('height', '?')}  round {diag.get('round', '?')}  "
+            f"step {diag.get('step', '?')}  "
+            f"(last commit: {diag.get('last_commit_height', '?')})"
+        )
+        stalled = diag.get("stalled_for_s")
+        if stalled is not None:
+            out.append(f"STALLED for {stalled}s")
+        out.append(f"blocked step: {diag.get('blocked_step', '?')}")
+        out.append(f"reason: {diag.get('reason', '?')}")
+        prop = diag.get("proposal") or {}
+        out.append(
+            f"proposal: have={prop.get('have_proposal')} "
+            f"block={prop.get('have_block')} parts={prop.get('parts')}"
+        )
+        quorum = diag.get("quorum") or {}
+        if quorum:
+            out.append("")
+            out.append("== quorum ==")
+            rows = [
+                [
+                    k, q.get("round"), q.get("power_present"),
+                    q.get("power_needed"), q.get("power_total"),
+                    q.get("has_two_thirds"),
+                    ",".join(map(str, q.get("missing_validators", []))) or "-",
+                ]
+                for k, q in quorum.items()
+            ]
+            out.append(_fmt_table(
+                rows,
+                ["set", "round", "present", "needed", "total", "+2/3", "missing"],
+            ))
+        missing = diag.get("missing_validators")
+        if missing is not None:
+            out.append(
+                f"validators silent all height: "
+                f"{','.join(map(str, missing)) if missing else '(none)'}"
+                f"  (of {diag.get('validators', '?')})"
+            )
+        peers = diag.get("peers")
+        if peers:
+            out.append("")
+            out.append("== peers ==")
+            rows = [
+                [
+                    p.get("peer_id", "?")[:12],
+                    "out" if p.get("outbound") else "in",
+                    p.get("height", "?"), p.get("round", "?"),
+                    p.get("last_gossip_age_s", "?"),
+                ]
+                for p in peers
+            ]
+            out.append(_fmt_table(
+                rows, ["peer", "dir", "height", "round", "gossip_age_s"]
+            ))
+        breakers = diag.get("breakers") or dump.get("breakers")
+        if breakers:
+            tripped = {
+                k: v for k, v in breakers.items() if v.get("state") != "closed"
+            }
+            out.append("")
+            out.append(
+                "breakers: "
+                + (
+                    ", ".join(f"{k}={v.get('state')}" for k, v in tripped.items())
+                    if tripped else f"all {len(breakers)} closed"
+                )
+            )
+        if diag.get("mempool") is not None:
+            out.append(f"mempool: {diag['mempool'].get('size')} txs")
+
+    rec = dump.get("recorder") or {}
+    tail = dump.get("flightrec") or []
+    out.append("")
+    out.append(
+        f"== flight recorder: {rec.get('events_recorded', len(tail))} recorded, "
+        f"{len(tail)} in dump =="
+    )
+    rows = []
+    for ev in tail[-events:]:
+        t, kind, h, r, detail = (list(ev) + [None] * 5)[:5]
+        ts = time.strftime("%H:%M:%S", time.localtime(t)) if t else "?"
+        rows.append([ts, kind, h, r, "" if detail is None else detail])
+    if rows:
+        out.append(_fmt_table(rows, ["time", "event", "height", "round", "detail"]))
+    else:
+        out.append("(empty)")
+    return "\n".join(out)
+
+
+def main(argv: List[str]) -> int:
+    p = argparse.ArgumentParser(
+        prog="autopsy", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter,
+    )
+    p.add_argument("source", nargs="?", default=None,
+                   help="dump_debug JSON file path, or '-' for stdin")
+    p.add_argument("--url", default=None,
+                   help="node RPC base URL; fetches /dump_debug")
+    p.add_argument("--tail", default=None,
+                   help="crash-survivable recorder tail file (<wal>.flightrec)")
+    p.add_argument("--events", type=int, default=40,
+                   help="flight-recorder events in the text table (default 40)")
+    p.add_argument("--json", action="store_true", dest="as_json",
+                   help="emit the structured dump as JSON (CI artifact)")
+    args = p.parse_args(argv[1:])
+    if args.source is None and args.url is None and args.tail is None:
+        p.print_usage(sys.stderr)
+        print("autopsy: need a dump file, '-', --url, or --tail", file=sys.stderr)
+        return 2
+    try:
+        if args.tail is not None:
+            dump = load_tail_dump(args.tail)
+        else:
+            dump = load_dump(args.source or "", args.url)
+    except Exception as e:
+        print(f"autopsy: cannot load dump: {e}", file=sys.stderr)
+        return 2
+    if args.as_json:
+        print(json.dumps(dump, indent=2, default=repr))
+    else:
+        print(render_text(dump, events=args.events))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv))

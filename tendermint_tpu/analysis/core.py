@@ -359,6 +359,7 @@ def _load_builtin_rules() -> None:
         rules_deadcode,
         rules_exposition,
         rules_faults,
+        rules_flightrec,
         rules_latch,
         rules_metrics,
         rules_purity,

@@ -1,0 +1,102 @@
+"""flightrec-coherence: every event kind the flight recorder records
+is in the docs/observability.md taxonomy.
+
+The observability page promises a complete flight-recorder event
+taxonomy — it is how an operator reading an autopsy (scripts/
+autopsy.py) or a raw ``dump_debug`` tail maps an event kind back to
+code and meaning. This is trace-coherence (rules_trace.py) applied to
+the black box: a literal kind passed to ``<...>.flightrec.record()``
+must appear in docs/observability.md. Hook sites record through an
+attribute named ``flightrec`` by convention (consensus/state.py,
+node/node.py), which is what keys the match; unrelated ``.record()``
+calls on other receivers are never considered. Dynamically built
+kinds are out of static reach and are skipped.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Iterable, List, Optional
+
+from tendermint_tpu.analysis.core import (
+    FileContext,
+    Project,
+    Rule,
+    Violation,
+    register,
+)
+
+_DOCS = "docs/observability.md"
+# event kinds are dotted lowercase ("vote.in", "breaker.trip") — the
+# same grammar the tracer uses for span names
+_NAME_RE = re.compile(r"^[a-z][a-z0-9_]*(\.[a-z0-9_]+)+$")
+
+
+def _literal_kind(call: ast.Call) -> Optional[str]:
+    if not call.args:
+        return None
+    arg = call.args[0]
+    if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+        return arg.value
+    return None
+
+
+def _recv_is_flightrec(recv: ast.AST) -> bool:
+    """True when the receiver's rightmost identifier is ``flightrec``
+    (``self.flightrec``, ``cs.flightrec``, a bare ``flightrec``)."""
+    if isinstance(recv, ast.Attribute):
+        return recv.attr == "flightrec"
+    if isinstance(recv, ast.Name):
+        return recv.id == "flightrec"
+    return False
+
+
+class FlightrecCoherence(Rule):
+    name = "flightrec-coherence"
+    summary = (
+        "every literal event kind recorded into the consensus flight "
+        "recorder appears in the docs/observability.md taxonomy"
+    )
+
+    def check_file(self, ctx: FileContext, project: Project) -> Iterable[Violation]:
+        if ctx.tree is None or not ctx.in_package:
+            return ()
+        docs = project.docs_text(_DOCS)
+        out: List[Violation] = []
+        for node in ctx.nodes:
+            if not (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "record"
+                and _recv_is_flightrec(node.func.value)
+            ):
+                continue
+            kind = _literal_kind(node)
+            if kind is None:
+                continue
+            if not _NAME_RE.match(kind):
+                out.append(
+                    Violation(
+                        self.name, ctx.rel, node.lineno,
+                        f"flight-recorder kind `{kind}` is not dotted "
+                        "lowercase (`family.event`) — the grammar the "
+                        f"{_DOCS} taxonomy indexes by",
+                        node.col_offset,
+                    )
+                )
+                continue
+            if kind not in docs:
+                out.append(
+                    Violation(
+                        self.name, ctx.rel, node.lineno,
+                        f"flight-recorder kind `{kind}` is not in the "
+                        f"{_DOCS} event taxonomy (the page promises to "
+                        "list every recorded kind)",
+                        node.col_offset,
+                    )
+                )
+        return out
+
+
+register(FlightrecCoherence())

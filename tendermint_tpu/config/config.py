@@ -99,6 +99,15 @@ class BaseConfig:
     # the oldest events are evicted (and counted) once it fills.
     trace_enabled: bool = False
     trace_buffer_events: int = 65536
+    # Consensus flight recorder (consensus/flightrec.py): an ALWAYS-ON
+    # bounded ring of structured consensus events (step transitions,
+    # votes in/out, proposal/part arrivals, timeouts, WAL fsyncs,
+    # breaker trips, stall edges) per node — unlike the span tracer it
+    # cannot be disabled, because a black box that was off during the
+    # crash is useless. flightrec_events bounds the ring (the last N
+    # events are served by dump_debug and persisted to the WAL-adjacent
+    # .flightrec tail at every height fsync for offline autopsy).
+    flightrec_events: int = 4096
     # Self-healing supervision (utils/watchdog.py): a daemon thread that
     # restarts dead pipeline workers, flags stalled pumps/height
     # progress, and enforces resolution deadlines on pipeline /
@@ -197,6 +206,8 @@ class BaseConfig:
             return "merkle_device_threshold must be >= 2"
         if self.trace_buffer_events < 1:
             return "trace_buffer_events must be >= 1"
+        if self.flightrec_events < 1:
+            return "flightrec_events must be >= 1"
         if self.watchdog_interval_ms < 1:
             return "watchdog_interval_ms must be >= 1"
         if self.watchdog_future_deadline_ms < 0:

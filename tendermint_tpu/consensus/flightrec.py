@@ -1,0 +1,448 @@
+"""The consensus flight recorder + stall autopsy: the black box.
+
+A live node that stops committing (or a simulated net that wedges)
+used to offer only raw state — ``dump_consensus_state`` answers *what*
+but never *why*. This module is the diagnosis layer on top of the PR-12
+measurement rig:
+
+- :class:`FlightRecorder` — an always-on, bounded ring of cheap event
+  tuples per node: step transitions, votes in/out with the signing
+  validator and gossip hop, proposal/part arrivals, timeouts fired,
+  WAL fsync boundaries, breaker trips/readmits, catchup/replay events,
+  stall edges. Appended from the existing ``_StepSpan``/ledger/
+  watchdog branch points in consensus/state.py, so the hot path gains
+  no new branches; ``record()`` is one lock + one deque append. Unlike
+  the span tracer (utils/trace.py) it is ON by default — the last
+  ``capacity`` events are always available to ``dump_debug`` and to
+  the crash-survivable WAL-adjacent tail file (``attach_tail``).
+
+- :func:`diagnose` — a machine-readable stall autopsy assembled from
+  live ``ConsensusState`` internals: current height/round/step, quorum
+  arithmetic straight from the blocking :class:`VoteSet` (power
+  present vs needed, the exact missing validator indices), proposal/
+  block-part completeness, and whatever the caller attaches (peers,
+  breaker stats, engine telemetry, mempool). ``missing_validators`` is
+  computed across EVERY round of the wedged height — a validator
+  counts as missing only if it has been silent for the entire height,
+  so round skew between live peers never names a healthy validator.
+
+- :class:`StallTracker` — the consensus-aware stall detector: wired as
+  the watchdog height-probe's ``on_stall``/``on_recover`` callbacks
+  (utils/watchdog.py), it snapshots a diagnosis at the stall edge,
+  emits the ``consensus.stall``/``consensus.unstall`` trace instants,
+  and feeds the ``tendermint_stall_*`` metric family through the
+  node's metrics pump.
+
+Surfacing: the ``dump_debug`` RPC route (rpc/core.py) bundles recorder
+tail + diagnosis + height report + engines + breakers into one
+artifact; ``scripts/autopsy.py`` renders it for humans; the simulator
+auto-collects every node's autopsy when a scenario expectation fails
+(sim/core.py, sim/scenario.py). Event kinds recorded here and at the
+consensus hook sites are literal dotted names checked against the
+docs/observability.md taxonomy by the ``flightrec-coherence`` lint
+rule (analysis/rules_flightrec.py) — the trace-coherence discipline
+applied to the black box.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from collections import deque
+from typing import Any, Callable, Dict, List, Optional
+
+from tendermint_tpu.utils import trace
+from tendermint_tpu.utils.log import get_logger
+
+DEFAULT_CAPACITY = 4096
+
+# How many framed events may accumulate in the tail file (relative to
+# ring capacity) before it is rewritten from the live ring — bounds the
+# sidecar at a small multiple of the ring, like BaseWAL head rotation.
+TAIL_ROTATE_FACTOR = 8
+
+
+class FlightRecorder:
+    """Bounded ring of ``(t, kind, height, round, detail)`` tuples.
+
+    ``record()`` is called from the consensus task (and, for stall/
+    breaker edges, the watchdog thread); ``events()``/``tail()`` from
+    RPC executor threads. One lock covers both — uncontended acquire
+    is tens of nanoseconds, far below the <1% attributed-overhead bar
+    pinned by bench.py's ``flightrec_overhead_pct``.
+    """
+
+    __slots__ = (
+        "capacity", "node_id", "_buf", "_lock", "events_recorded",
+        "_tail_path", "_tail_fp", "_tail_pending", "_tail_framed",
+    )
+
+    def __init__(self, capacity: int = 0, node_id: str = ""):
+        self.capacity = int(capacity) if capacity and capacity > 0 else DEFAULT_CAPACITY
+        self.node_id = node_id
+        self._buf: deque = deque(maxlen=self.capacity)
+        self._lock = threading.Lock()
+        self.events_recorded = 0
+        self._tail_path: Optional[str] = None
+        self._tail_fp = None
+        self._tail_pending: List[tuple] = []  # recorded since last sync
+        self._tail_framed = 0  # events framed into the current tail file
+
+    # -- recording (hot path) ----------------------------------------------
+
+    def record(self, kind: str, height: int = 0, round_: int = 0, detail=None) -> None:
+        ev = (time.time(), kind, height, round_, detail)
+        with self._lock:
+            self._buf.append(ev)
+            self.events_recorded += 1
+            if self._tail_fp is not None:
+                self._tail_pending.append(ev)
+
+    # -- reading (any thread) ----------------------------------------------
+
+    def events(self, limit: Optional[int] = None) -> List[tuple]:
+        with self._lock:
+            out = list(self._buf)
+        if limit is not None and limit >= 0:
+            out = out[-limit:]
+        return out
+
+    def tail(self, limit: Optional[int] = None) -> List[list]:
+        """JSON-ready newest-last event rows for dump_debug."""
+        return [
+            [round(t, 6), kind, h, r, detail]
+            for t, kind, h, r, detail in self.events(limit)
+        ]
+
+    def stats(self) -> Dict[str, int]:
+        with self._lock:
+            return {
+                "events_recorded": self.events_recorded,
+                "buffered": len(self._buf),
+                "capacity": self.capacity,
+            }
+
+    # -- crash-survivable tail (WAL-adjacent sidecar) ----------------------
+
+    def attach_tail(self, path: str) -> None:
+        """Open the WAL-adjacent tail file; every ``sync_tail()`` (the
+        consensus ENDHEIGHT fsync boundary) appends the events recorded
+        since the last sync as one CRC-framed record, so a crashed
+        node's last moments survive for offline autopsy. Torn final
+        frames are tolerated by :func:`load_tail`, exactly like WAL
+        tail repair."""
+        with self._lock:
+            self._close_tail_locked()
+            os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+            self._tail_path = path
+            self._tail_fp = open(path, "ab")
+            self._tail_framed = 0
+            self._tail_pending = []
+
+    def sync_tail(self) -> None:
+        """Flush pending events to the tail file + fsync. Called at the
+        WAL ENDHEIGHT boundary — one extra small write per height,
+        never per event. Rotates (rewrites from the live ring) once the
+        file holds ``TAIL_ROTATE_FACTOR x capacity`` events."""
+        from tendermint_tpu.consensus.wal import frame_record
+
+        with self._lock:
+            fp = self._tail_fp
+            if fp is None:
+                return
+            pending, self._tail_pending = self._tail_pending, []
+            rotate = self._tail_framed + len(pending) > TAIL_ROTATE_FACTOR * self.capacity
+            if rotate:
+                pending = list(self._buf)
+                fp.close()
+                fp = self._tail_fp = open(self._tail_path, "wb")
+                self._tail_framed = 0
+            if not pending:
+                return
+            payload = json.dumps(
+                [[t, kind, h, r, detail] for t, kind, h, r, detail in pending],
+                separators=(",", ":"), default=repr,
+            ).encode()
+            try:
+                fp.write(frame_record(payload))
+                fp.flush()
+                os.fsync(fp.fileno())
+                self._tail_framed += len(pending)
+            except OSError:
+                return  # disk trouble must never take down consensus
+
+    def close_tail(self) -> None:
+        with self._lock:
+            self._close_tail_locked()
+
+    def _close_tail_locked(self) -> None:
+        if self._tail_fp is not None:
+            try:
+                self._tail_fp.close()
+            except OSError:
+                pass
+        self._tail_fp = None
+        self._tail_path = None
+        self._tail_pending = []
+
+
+def load_tail(path: str) -> List[list]:
+    """Read a recorder tail file back into event rows (newest last).
+    A torn final frame — the node died mid-write — truncates the read
+    instead of raising, mirroring WAL tail repair."""
+    from tendermint_tpu.consensus.wal import DataCorruptionError, iter_records
+
+    out: List[list] = []
+    try:
+        with open(path, "rb") as fp:
+            try:
+                for _off, payload in iter_records(fp):
+                    out.extend(json.loads(payload.decode()))
+            except (DataCorruptionError, ValueError):
+                pass  # torn tail: keep what decoded
+    except OSError:
+        return []
+    return out
+
+
+# -- stall autopsy -----------------------------------------------------------
+
+
+def _quorum_block(vs, kind: str) -> Dict[str, Any]:
+    """Quorum arithmetic from a live VoteSet: power present vs needed
+    and the exact validator indices still missing from THIS set."""
+    total = vs.val_set.total_voting_power()
+    return {
+        "type": kind,
+        "round": vs.round,
+        "power_present": vs.sum,
+        "power_needed": total * 2 // 3 + 1,
+        "power_total": total,
+        "has_two_thirds": vs.has_two_thirds_majority(),
+        "missing_validators": [i for i, v in enumerate(vs.votes) if v is None],
+    }
+
+
+def _missing_for_height(hvs) -> List[int]:
+    """Validator indices with NO vote in ANY round of the height: the
+    validators this node has never heard from since the height began.
+    Round skew between live peers (a healthy validator that simply has
+    not voted in the newest round yet) can never land here."""
+    n = hvs.val_set.size()
+    seen = [False] * n
+    for rvs in hvs._round_vote_sets.values():
+        for vs in (rvs.prevotes, rvs.precommits):
+            for i, v in enumerate(vs.votes):
+                if v is not None:
+                    seen[i] = True
+    return [i for i, s in enumerate(seen) if not s]
+
+
+def diagnose(
+    cs,
+    peers: Optional[list] = None,
+    breakers: Optional[dict] = None,
+    engines: Optional[dict] = None,
+    mempool_size: Optional[int] = None,
+    stalled_for_s: Optional[float] = None,
+) -> Dict[str, Any]:
+    """Structured stall diagnosis from live ConsensusState internals.
+
+    Read-only and defensive: called from the watchdog thread on a live
+    node and from the simulator after a wedge, against a state machine
+    that may be mid-transition — every section degrades to partial
+    data rather than raising."""
+    from tendermint_tpu.consensus.round_state import (
+        STEP_COMMIT,
+        STEP_PRECOMMIT,
+        STEP_PRECOMMIT_WAIT,
+        STEP_PREVOTE,
+        STEP_PREVOTE_WAIT,
+        STEP_PROPOSE,
+        step_name,
+    )
+
+    rs = cs.rs
+    step = step_name(rs.step)
+    out: Dict[str, Any] = {
+        "node_id": cs.node_id,
+        "height": rs.height,
+        "round": rs.round,
+        "step": step,
+        "blocked_step": step,
+        "last_commit_height": cs.state.last_block_height,
+        "validators": rs.validators.size() if rs.validators is not None else 0,
+    }
+    if stalled_for_s is not None:
+        out["stalled_for_s"] = round(float(stalled_for_s), 3)
+
+    # proposal / block-part completeness
+    parts = rs.proposal_block_parts
+    out["proposal"] = {
+        "have_proposal": rs.proposal is not None,
+        "have_block": rs.proposal_block is not None,
+        "parts": f"{parts.count}/{parts.total}" if parts is not None else None,
+    }
+
+    # quorum arithmetic for the current round + height-wide silence
+    reason = f"waiting to begin round {rs.round}"
+    try:
+        hvs = rs.votes
+        quorum: Dict[str, Any] = {}
+        prevotes = hvs.prevotes(rs.round) if hvs is not None else None
+        precommits = hvs.precommits(rs.round) if hvs is not None else None
+        if prevotes is not None:
+            quorum["prevote"] = _quorum_block(prevotes, "prevote")
+        if precommits is not None:
+            quorum["precommit"] = _quorum_block(precommits, "precommit")
+        out["quorum"] = quorum
+        out["missing_validators"] = _missing_for_height(hvs) if hvs is not None else []
+
+        if rs.step == STEP_PROPOSE and rs.proposal is None:
+            proposer = rs.validators.get_proposer() if rs.validators else None
+            pidx = -1
+            if proposer is not None:
+                pidx, _ = rs.validators.get_by_address(proposer.address)
+            reason = f"no proposal received (proposer: validator {pidx})"
+        elif rs.step in (STEP_PREVOTE, STEP_PREVOTE_WAIT) and prevotes is not None:
+            q = quorum["prevote"]
+            reason = (
+                f"short of prevote quorum: {q['power_present']}/"
+                f"{q['power_needed']} power, missing validators "
+                f"{q['missing_validators']}"
+            )
+        elif rs.step in (STEP_PRECOMMIT, STEP_PRECOMMIT_WAIT) and precommits is not None:
+            q = quorum["precommit"]
+            reason = (
+                f"short of precommit quorum: {q['power_present']}/"
+                f"{q['power_needed']} power, missing validators "
+                f"{q['missing_validators']}"
+            )
+        elif rs.step == STEP_COMMIT:
+            if rs.proposal_block is not None:
+                reason = "have +2/3 precommits and the full block: committing"
+            else:
+                reason = (
+                    "have +2/3 precommits but proposal block incomplete "
+                    f"(parts {out['proposal']['parts']})"
+                )
+    except Exception as e:  # mid-transition race: keep the partial dump
+        out["diagnosis_error"] = repr(e)
+    out["reason"] = reason
+
+    if peers is not None:
+        out["peers"] = peers
+    if breakers is not None:
+        out["breakers"] = breakers
+    if engines is not None:
+        out["engines"] = engines
+    if mempool_size is not None:
+        out["mempool"] = {"size": mempool_size}
+    out["wal"] = {"kind": type(cs.wal).__name__}
+    rec = getattr(cs, "flightrec", None)
+    if rec is not None:
+        out["recorder"] = rec.stats()
+    return out
+
+
+class StallTracker:
+    """Consensus-aware stall detector state: the watchdog height
+    probe's ``on_stall``/``on_recover`` land here. Snapshots a full
+    diagnosis at the stall edge (the moment the operator will ask
+    about), emits the trace instant pair, records the flight-recorder
+    stall events, and serves the ``tendermint_stall_*`` snapshot to
+    the metrics pump."""
+
+    def __init__(
+        self,
+        cs,
+        context_fn: Optional[Callable[[], Dict[str, Any]]] = None,
+        logger=None,
+    ):
+        self.cs = cs
+        # node-wired extras: peers / breakers / engines / mempool_size
+        # keyword arguments for diagnose()
+        self.context_fn = context_fn
+        self.logger = logger or get_logger("stall")
+        self._lock = threading.Lock()
+        self.stalled = False
+        self.stalls = 0
+        self.recoveries = 0
+        self.stalled_since: Optional[float] = None
+        self.last_diagnosis: Optional[Dict[str, Any]] = None
+
+    def _context(self) -> Dict[str, Any]:
+        if self.context_fn is None:
+            return {}
+        try:
+            return self.context_fn() or {}
+        except Exception:
+            return {}
+
+    def diagnose_now(self, stalled_for_s: Optional[float] = None) -> Dict[str, Any]:
+        return diagnose(self.cs, stalled_for_s=stalled_for_s, **self._context())
+
+    def on_stall(self, name: str, stalled_for: float) -> None:
+        """Watchdog ``on_stall`` callback (watchdog thread)."""
+        diag = self.diagnose_now(stalled_for_s=stalled_for)
+        with self._lock:
+            self.stalled = True
+            self.stalls += 1
+            self.stalled_since = time.monotonic() - stalled_for
+            self.last_diagnosis = diag
+        trace.instant(
+            "consensus.stall",
+            height=diag.get("height", 0), round=diag.get("round", 0),
+            step=diag.get("step", ""),
+        )
+        rec = getattr(self.cs, "flightrec", None)
+        if rec is not None:
+            rec.record(
+                "stall.detected", diag.get("height", 0), diag.get("round", 0),
+                diag.get("reason"),
+            )
+        self.logger.error("consensus stalled", probe=name, **{
+            k: diag.get(k) for k in ("height", "round", "step", "reason")
+        })
+
+    def on_recover(self, name: str, stalled_for: float) -> None:
+        """Watchdog ``on_recover`` callback: height advanced again."""
+        with self._lock:
+            if not self.stalled:
+                return
+            self.stalled = False
+            self.recoveries += 1
+            self.stalled_since = None
+        h = self.cs.rs.height
+        trace.instant("consensus.unstall", height=h, stalled_s=round(stalled_for, 1))
+        rec = getattr(self.cs, "flightrec", None)
+        if rec is not None:
+            rec.record("stall.cleared", h, 0, round(stalled_for, 1))
+        self.logger.info("consensus recovered", probe=name, height=h)
+
+    def stats(self) -> Dict[str, Any]:
+        """Snapshot for StallMetrics.update (utils/metrics.py)."""
+        with self._lock:
+            diag = self.last_diagnosis or {}
+            stalled_for = (
+                time.monotonic() - self.stalled_since
+                if self.stalled and self.stalled_since is not None
+                else 0.0
+            )
+            missing = diag.get("missing_validators") or []
+            q = (diag.get("quorum") or {}).get("precommit") or {}
+            shortfall = max(
+                int(q.get("power_needed", 0)) - int(q.get("power_present", 0)), 0
+            )
+            return {
+                "stalled": 1 if self.stalled else 0,
+                "stalls": self.stalls,
+                "recoveries": self.recoveries,
+                "stalled_seconds": round(stalled_for, 3),
+                "height": diag.get("height", 0),
+                "round": diag.get("round", 0),
+                "missing_validators": len(missing),
+                "missing_power": shortfall,
+            }

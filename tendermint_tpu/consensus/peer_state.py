@@ -32,6 +32,14 @@ class PeerState:
     def __init__(self, peer_id: str):
         self.peer_id = peer_id
         self.rs = PeerRoundState()
+        # wall time of the last consensus message from this peer: the
+        # stall autopsy reports last-gossip ages per peer — a peer that
+        # went quiet minutes ago reads very differently from one that
+        # is gossiping but short of quorum (consensus/flightrec.py)
+        self.last_msg_at: float = time.time()
+
+    def touch(self) -> None:
+        self.last_msg_at = time.time()
 
     # -- proposal tracking -------------------------------------------------
 

@@ -175,6 +175,7 @@ class ConsensusReactor(Reactor):
         ps: Optional[PeerState] = peer.get(PEER_STATE_KEY)
         if ps is None:
             return
+        ps.touch()  # last-gossip age for the stall autopsy
         cs = self.cs
 
         if ch_id == STATE_CHANNEL:

@@ -141,12 +141,13 @@ class _StepSpan:
     in-process node a distinct tracer so a merged multi-node trace has
     per-node process rows — else the process-global one."""
 
-    __slots__ = ("_cs", "_step", "_height", "_span", "_t0")
+    __slots__ = ("_cs", "_step", "_height", "_round", "_span", "_t0")
 
     def __init__(self, cs: "ConsensusState", step: str, height: int, round_: int):
         self._cs = cs
         self._step = step
         self._height = height
+        self._round = round_
         self._span = cs._tr().span("consensus." + step, height=height, round=round_)
 
     def __enter__(self):
@@ -155,6 +156,7 @@ class _StepSpan:
             self._step, self._t0,
             height=self._height, wait=self._cs._wait_context(),
         )
+        self._cs.flightrec.record("step.enter", self._height, self._round, self._step)
         self._span.__enter__()
         return self._span
 
@@ -162,6 +164,7 @@ class _StepSpan:
         self._span.__exit__(*exc)
         t1 = time.perf_counter()
         self._cs.ledger.pop(self._step, t1)
+        self._cs.flightrec.record("step.exit", self._height, self._round, self._step)
         m = self._cs.metrics
         if m is not None:
             hist = getattr(m, "step_duration_seconds", None)
@@ -220,6 +223,7 @@ class ConsensusState(Service):
         tracer=None,
         clock=None,
         sig_cache=None,
+        flightrec_events: int = 0,
     ):
         super().__init__("consensus", logger=None)
         self.logger = logger or get_logger("consensus")
@@ -265,6 +269,12 @@ class ConsensusState(Service):
         from tendermint_tpu.consensus.ledger import HeightLedger
 
         self.ledger = HeightLedger(metrics=metrics)
+        # always-on consensus flight recorder (consensus/flightrec.py):
+        # the bounded black box behind dump_debug and the stall autopsy.
+        # Unlike the tracer it has no off switch — 0 = default capacity.
+        from tendermint_tpu.consensus.flightrec import FlightRecorder
+
+        self.flightrec = FlightRecorder(capacity=flightrec_events, node_id=node_id)
         # thread the ledger into block execution so the ABCI deliver
         # round-trip shows up as its own sub-phase under apply_block,
         # and the node's signature cache so validate_block's LastCommit
@@ -408,6 +418,10 @@ class ConsensusState(Service):
                 self.logger.error(
                     "error on catchup replay; proceeding to start anyway", err=str(e)
                 )
+            self.flightrec.record(
+                "catchup.replay", self.rs.height, self.rs.round,
+                self.wal_replayed_count,
+            )
         self.spawn(self._receive_routine())
         self._schedule_round0()
 
@@ -714,10 +728,18 @@ class ConsensusState(Service):
         )
         try:
             if isinstance(msg, ProposalMessage):
+                self.flightrec.record(
+                    "proposal.in", msg.proposal.height, msg.proposal.round,
+                    peer_id or "self",
+                )
                 await self.set_proposal(msg.proposal)
             elif isinstance(msg, BlockPartMessage):
                 added = await self._add_proposal_block_part(msg, peer_id)
                 if added:
+                    self.flightrec.record(
+                        "part.in", msg.height, msg.round,
+                        (msg.part.index, peer_id or "self"),
+                    )
                     self.evsw.fire_event(EVENT_HAS_VOTE, None)  # wake gossip (block part)
             elif isinstance(msg, VoteMessage):
                 await self._try_add_vote(msg.vote, peer_id)
@@ -831,6 +853,10 @@ class ConsensusState(Service):
                     continue
                 any_added = True
                 vote = mi.msg.vote
+                self.flightrec.record(
+                    "vote.in", vote.height, vote.round,
+                    (vote.vote_type, vote.validator_index, mi.peer_id or "self"),
+                )
                 if self.event_bus is not None and not self.replay_mode:
                     self._publish_soon(self.event_bus.publish_event_vote(vote))
                 self.evsw.fire_event(EVENT_VOTE, vote)
@@ -895,6 +921,9 @@ class ConsensusState(Service):
                 "consensus.timeout",
                 height=ti.height, round=ti.round, step=step_name(ti.step),
             )
+        self.flightrec.record(
+            "timeout.fired", ti.height, ti.round, step_name(ti.step)
+        )
         if ti.step == STEP_NEW_HEIGHT:
             await self._enter_new_round(ti.height, 0)
         elif ti.step == STEP_NEW_ROUND:
@@ -1307,6 +1336,10 @@ class ConsensusState(Service):
             ledger.push("wal_fsync", time.perf_counter())
             try:
                 self.wal.write_sync(EndHeightMessage(height))
+                self.flightrec.record("wal.fsync", height, rs.commit_round, "endheight")
+                # persist the recorder tail at the same durability
+                # boundary the WAL just paid for (no-op when detached)
+                self.flightrec.sync_tail()
             finally:
                 ledger.pop("wal_fsync", time.perf_counter())
             fail.fail()  # crash point 3: ENDHEIGHT written, not applied
@@ -1349,6 +1382,9 @@ class ConsensusState(Service):
             txs=len(block.data.txs),
             rounds=rs.commit_round + 1,
             mempool_residency=getattr(self._mempool, "last_update_residency", None),
+        )
+        self.flightrec.record(
+            "height.commit", height, rs.commit_round, len(block.data.txs)
         )
         self.evsw.fire_event(EVENT_COMMITTED, block)
         self.update_to_state(new_state)  # resolves height waiters too
@@ -1465,6 +1501,10 @@ class ConsensusState(Service):
         added = rs.votes.add_vote(vote, peer_id)
         if not added:
             return False
+        self.flightrec.record(
+            "vote.in", vote.height, vote.round,
+            (vote.vote_type, vote.validator_index, peer_id or "self"),
+        )
         if self.event_bus is not None and not self.replay_mode:
             self._publish_soon(self.event_bus.publish_event_vote(vote))
         self.evsw.fire_event(EVENT_VOTE, vote)
@@ -1565,6 +1605,10 @@ class ConsensusState(Service):
                 # inside our step span), not a fresh per-hop one
                 self._my_vote_origins[(rs.height, rs.round, vote_type)] = origin
             self.send_internal(VoteMessage(vote, origin=origin))
+            self.flightrec.record(
+                "vote.out", vote.height, vote.round,
+                (vote_type, vote.validator_index),
+            )
             self.logger.info("signed and pushed vote", vote=repr(vote))
             return vote
         if not self.replay_mode:

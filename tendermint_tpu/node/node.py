@@ -427,6 +427,7 @@ class Node(Service):
             LightServeMetrics,
             MerkleMetrics,
             MeshMetrics,
+            StallMetrics,
             TraceMetrics,
         )
 
@@ -440,6 +441,11 @@ class Node(Service):
         self.merkle_metrics = MerkleMetrics(self.metrics_registry, ns)
         self.trace_metrics = TraceMetrics(self.metrics_registry, ns)
         self.health_metrics = HealthMetrics(self.metrics_registry, ns)
+        # consensus stall autopsy (consensus/flightrec.py StallTracker):
+        # fed from the watchdog height probe through the metrics pump
+        self.stall_metrics = StallMetrics(self.metrics_registry, ns)
+        self.stall_tracker = None  # built in on_start with the cs
+        self._breaker_last = {}  # (trips, recoveries) per breaker, pump-diffed
         self.lightserve_metrics = LightServeMetrics(self.metrics_registry, ns)
         self.ingest_metrics = IngestMetrics(self.metrics_registry, ns)
         self.bls_metrics = BLSMetrics(self.metrics_registry, ns)
@@ -632,6 +638,12 @@ class Node(Service):
             # cross-node trace identity: peers link their spans back to
             # this id in a merged trace (docs/tracing.md)
             node_id=self.node_key.id[:12],
+            flightrec_events=self.config.base.flightrec_events,
+        )
+        # crash-survivable recorder tail next to the WAL: the black box
+        # persists at every height's ENDHEIGHT fsync boundary
+        self.consensus_state.flightrec.attach_tail(
+            self.config.consensus.wal_file() + ".flightrec"
         )
         # height ledger ← engine telemetry: each committed height's
         # report carries the engine-counter deltas over that height
@@ -791,8 +803,19 @@ class Node(Service):
             )
             stall_ms = self.config.base.watchdog_height_stall_ms
             if stall_ms > 0:
+                # consensus-aware stall autopsy: the probe's stall edge
+                # snapshots a full diagnosis (quorum arithmetic, silent
+                # validators, peers/breakers/engines) served by the
+                # dump_debug RPC + tendermint_stall_* family
+                from tendermint_tpu.consensus.flightrec import StallTracker
+
+                self.stall_tracker = StallTracker(
+                    cs, context_fn=self._stall_context, logger=self.logger
+                )
                 self.watchdog.register_progress(
-                    "consensus.height", cs.height, stall_after_s=stall_ms / 1000.0
+                    "consensus.height", cs.height, stall_after_s=stall_ms / 1000.0,
+                    on_stall=self.stall_tracker.on_stall,
+                    on_recover=self.stall_tracker.on_recover,
                 )
             # metrics/trace pump: push-style heartbeat, stalled when
             # silent for 5 pump intervals
@@ -845,11 +868,28 @@ class Node(Service):
             from tendermint_tpu.utils import faultinject as _faults
             from tendermint_tpu.utils import watchdog as _watchdog
 
+            breaker_snap = _watchdog.breaker_stats()
             self.health_metrics.update(
                 self.watchdog.stats() if self.watchdog is not None else None,
-                _watchdog.breaker_stats(),
+                breaker_snap,
                 _faults.stats(),
             )
+            if self.stall_tracker is not None:
+                self.stall_metrics.update(self.stall_tracker.stats())
+            # breaker trip/readmit edges into the flight recorder: the
+            # breaker hot path gains no branch — the pump diffs the
+            # monotonic trip/recovery totals it already collects
+            if self.consensus_state is not None:
+                rec = self.consensus_state.flightrec
+                rs = self.consensus_state.rs
+                for name, bs in breaker_snap.items():
+                    prev = self._breaker_last.get(name, (0, 0))
+                    cur = (bs.get("trips", 0), bs.get("recoveries", 0))
+                    if cur[0] > prev[0]:
+                        rec.record("breaker.trip", rs.height, rs.round, name)
+                    if cur[1] > prev[1]:
+                        rec.record("breaker.readmit", rs.height, rs.round, name)
+                    self._breaker_last[name] = cur
             if self.lightserve is not None:
                 self.lightserve_metrics.update(self.lightserve.stats())
             self.bls_metrics.update(self.bls_provider.stats())
@@ -868,6 +908,38 @@ class Node(Service):
             if self.watchdog is not None:
                 self.watchdog.heartbeat("node.metrics_pump")
             await asyncio.sleep(2.0)
+
+    def peer_gossip_ages(self) -> list:
+        """Per-peer connectivity + last-gossip age (seconds since the
+        last consensus message) for the stall autopsy: distinguishes
+        'peers went silent' from 'peers gossiping but short of quorum'."""
+        import time as _time
+
+        from tendermint_tpu.consensus.reactor import PEER_STATE_KEY
+
+        now = _time.time()
+        out = []
+        for pid, peer in list(self.switch.peers.items()):
+            ps = peer.get(PEER_STATE_KEY)
+            row = {"peer_id": pid, "outbound": bool(getattr(peer, "outbound", False))}
+            if ps is not None:
+                row["last_gossip_age_s"] = round(now - ps.last_msg_at, 3)
+                row["height"] = ps.rs.height
+                row["round"] = ps.rs.round
+            out.append(row)
+        return out
+
+    def _stall_context(self) -> dict:
+        """Node-level extras attached to every stall diagnosis
+        (consensus/flightrec.py diagnose kwargs)."""
+        from tendermint_tpu.utils import watchdog as _watchdog
+
+        return {
+            "peers": self.peer_gossip_ages(),
+            "breakers": _watchdog.breaker_stats(),
+            "engines": self.engine_telemetry(),
+            "mempool_size": self.mempool.size() if self.mempool is not None else None,
+        }
 
     def _only_validator_is_us(self, state: State) -> bool:
         if self.priv_validator is None:
@@ -903,6 +975,11 @@ class Node(Service):
             await self.grpc_server.stop()
         if self.rpc_server is not None:
             await self.rpc_server.stop()
+        if self.consensus_state is not None:
+            # final black-box flush: whatever the ring holds beyond the
+            # last ENDHEIGHT boundary survives for offline autopsy
+            self.consensus_state.flightrec.sync_tail()
+            self.consensus_state.flightrec.close_tail()
         await self.indexer_service.stop()
         await self.event_bus.stop()
         await self.proxy_app.stop()

@@ -125,6 +125,7 @@ class RPCCore:
             "trace_timeline": self.trace_timeline,
             "height_report": self.height_report,
             "engines": self.engines,
+            "dump_debug": self.dump_debug,
             "lightserve_verify": self.lightserve_verify,
             "lightserve_status": self.lightserve_status,
         }
@@ -645,6 +646,48 @@ class RPCCore:
         if fn is None:
             raise RPCError("engine telemetry unavailable")
         return {"engines": await asyncio.get_running_loop().run_in_executor(None, fn)}
+
+    async def dump_debug(self, limit=None) -> Dict[str, Any]:
+        """One-shot debug artifact for offline autopsy (the reference's
+        ``tendermint debug dump`` as a route): the flight-recorder tail
+        (always on — last ``limit`` events, default the whole ring),
+        the structured stall diagnosis built from live VoteSet quorum
+        arithmetic + peer gossip ages + breaker/engine state
+        (consensus/flightrec.py diagnose), the per-height latency
+        ledger, engine telemetry and breaker stats. Feed the saved body
+        to ``scripts/autopsy.py`` (docs/observability.md). Read-only;
+        assembled in an executor like the other debug routes."""
+        cs = self.node.consensus_state
+        if cs is None:
+            raise RPCError("consensus not started")
+        lim = _int_arg(limit, "limit", None)
+
+        def _build():
+            from tendermint_tpu.consensus.flightrec import diagnose
+            from tendermint_tpu.utils import watchdog as _watchdog
+
+            tracker = getattr(self.node, "stall_tracker", None)
+            if tracker is not None:
+                diag = tracker.diagnose_now()
+                stall = tracker.stats()
+            else:
+                diag = diagnose(cs)
+                stall = None
+            wd = getattr(self.node, "watchdog", None)
+            return {
+                "node_id": cs.node_id,
+                "time": time.time(),
+                "flightrec": cs.flightrec.tail(lim),
+                "recorder": cs.flightrec.stats(),
+                "diagnosis": diag,
+                "stall": stall,
+                "height_report": cs.ledger.report(),
+                "engines": getattr(self.node, "engine_telemetry", dict)(),
+                "breakers": _watchdog.breaker_stats(),
+                "watchdog": wd.stats() if wd is not None else None,
+            }
+
+        return await asyncio.get_running_loop().run_in_executor(None, _build)
 
     # -- lightserve routes (the batched light-client verify service,
     # lightserve/service.py; also servable on its own laddr via

@@ -68,10 +68,10 @@ class RPCServer:
                 if headers.get("upgrade", "").lower() == "websocket":
                     await self._handle_websocket(reader, writer, headers)
                     break
-                resp = await self._dispatch_http(method, target, body)
+                ctype, resp = await self._dispatch_http(method, target, body)
                 keep = headers.get("connection", "keep-alive").lower() != "close"
                 writer.write(
-                    b"HTTP/1.1 200 OK\r\nContent-Type: application/json\r\n"
+                    b"HTTP/1.1 200 OK\r\nContent-Type: " + ctype + b"\r\n"
                     + f"Content-Length: {len(resp)}\r\n".encode()
                     + (b"" if keep else b"Connection: close\r\n")
                     + b"\r\n"
@@ -112,23 +112,49 @@ class RPCServer:
             body = await reader.readexactly(length)
         return method, target, headers, body
 
-    async def _dispatch_http(self, method: str, target: str, body: bytes) -> bytes:
+    _JSON = b"application/json"
+    # Prometheus text exposition format version (prometheus/common)
+    _PROM = b"text/plain; version=0.0.4; charset=utf-8"
+
+    async def _dispatch_http(self, method: str, target: str, body: bytes) -> tuple:
+        """Returns (content_type, body_bytes)."""
         if method == "POST":
             try:
                 doc = json.loads(body or b"{}")
             except json.JSONDecodeError as e:
-                return _rpc_response(None, error={"code": -32700, "message": f"parse error: {e}"})
+                return self._JSON, _rpc_response(
+                    None, error={"code": -32700, "message": f"parse error: {e}"}
+                )
             if isinstance(doc, list):  # batch
                 parts = [await self._call_one(d) for d in doc]
-                return b"[" + b",".join(parts) + b"]"
-            return await self._call_one(doc)
+                return self._JSON, b"[" + b",".join(parts) + b"]"
+            return self._JSON, await self._call_one(doc)
         # GET: /route?key=val  (reference handleURI)
         url = urlparse(target)
         name = url.path.strip("/")
         if not name:
-            return json.dumps({"routes": sorted(self.core.routes())}).encode()
+            routes = sorted(self.core.routes()) + ["metrics"]
+            return self._JSON, json.dumps({"routes": routes}).encode()
+        if name == "metrics":
+            return self._PROM, await self._expose_metrics()
         params = {k: _parse_uri_value(v) for k, v in parse_qsl(url.query)}
-        return await self._call_one({"id": -1, "method": name, "params": params})
+        return self._JSON, await self._call_one(
+            {"id": -1, "method": name, "params": params}
+        )
+
+    async def _expose_metrics(self) -> bytes:
+        """Prometheus scrape endpoint on the RPC port: renders every
+        family in the node's registry (utils/metrics.py expose_text).
+        The dedicated MetricsServer (instrumentation.prometheus) still
+        exists for a metrics-only listener; this route means a node
+        with plain RPC enabled is always scrapeable."""
+        reg = getattr(self.node, "metrics_registry", None)
+        if reg is None:
+            return b"# no metrics registry on this node\n"
+        text = await asyncio.get_running_loop().run_in_executor(
+            None, reg.expose_text
+        )
+        return text.encode()
 
     async def _call_one(self, doc: Dict[str, Any]) -> bytes:
         id_ = doc.get("id")

@@ -236,6 +236,7 @@ class SimResult:
     net: Dict[str, float] = field(default_factory=dict)
     ledger_phases: Dict[int, List[tuple]] = field(default_factory=dict)
     ledgers: Dict[int, dict] = field(default_factory=dict)
+    autopsies: Dict[int, dict] = field(default_factory=dict)
     sim_seconds: float = 0.0
     wall_seconds: float = 0.0
     completed: bool = False
@@ -764,6 +765,23 @@ class Simulation:
 
     # -- collection --------------------------------------------------------
 
+    def collect_autopsies(self) -> Dict[int, dict]:
+        """Every node's structured stall diagnosis (consensus/flightrec
+        ``diagnose``) — auto-attached to wedged results here and to any
+        scenario-expectation failure (sim/scenario.evaluate), so a dead
+        run names its blocked step and exact missing validators instead
+        of just "timed out"."""
+        from tendermint_tpu.consensus.flightrec import diagnose
+
+        crashed = self.net._crashed if self.net is not None else set()
+        out: Dict[int, dict] = {}
+        for i, n in enumerate(self.nodes):
+            d = diagnose(n.cs)
+            if i in crashed:
+                d["crashed"] = True
+            out[i] = d
+        return out
+
     def _collect(
         self, verifier: PipelinedVerifier, timed_out: bool, t0: float
     ) -> SimResult:
@@ -781,6 +799,9 @@ class Simulation:
             completed=not timed_out,
             timed_out=timed_out,
         )
+        if timed_out:
+            # the run wedged: capture why, while the round state is hot
+            res.autopsies = self.collect_autopsies()
         for i, n in enumerate(self.nodes):
             report = n.cs.ledger.report()
             res.ledgers[i] = report

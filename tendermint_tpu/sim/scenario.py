@@ -359,7 +359,31 @@ def evaluate(sc: Scenario, sim: Simulation, res: SimResult) -> List[str]:
                         f"node{i}: rotated validator power {got} != {want}"
                     )
                     break
+    if fails:
+        # any broken expectation gets the fleet-wide stall autopsy
+        # attached (docs/observability.md): the failure names each
+        # node's blocked step and exact missing validators, not just
+        # "timed out at height N"
+        if not res.autopsies:
+            res.autopsies = sim.collect_autopsies()
+        fails.append(_autopsy_summary(res.autopsies))
     return fails
+
+
+def _autopsy_summary(autopsies: Dict[int, dict]) -> str:
+    lines = ["stall autopsy (per node):"]
+    for i in sorted(autopsies):
+        d = autopsies[i]
+        if d.get("crashed"):
+            lines.append(f"  node{i}: crashed (down at collection time)")
+            continue
+        miss = d.get("missing_validators") or []
+        lines.append(
+            f"  node{i}: blocked at {d.get('blocked_step')} "
+            f"h{d.get('height')}/r{d.get('round')} — {d.get('reason')} "
+            f"(missing validators: {','.join(map(str, miss)) if miss else '-'})"
+        )
+    return "\n".join(lines)
 
 
 def _spread(res: SimResult) -> str:

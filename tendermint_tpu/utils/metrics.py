@@ -494,6 +494,47 @@ class HealthMetrics:
                 )
 
 
+class StallMetrics:
+    """Consensus stall autopsy (``tendermint_stall_*``,
+    consensus/flightrec.py StallTracker.stats()): is the node's height
+    probe currently stalled, for how long, at which height/round, and
+    the quorum shortfall from the live VoteSet (missing voting power +
+    silent validator count). Edge counters (stalls/recoveries) are
+    TRUE counters fed by snapshot deltas, like CryptoMetrics; the full
+    machine-readable diagnosis rides the dump_debug RPC.
+    See docs/observability.md."""
+
+    _COUNTERS = (
+        ("stalls", "stalls"),
+        ("recoveries", "recoveries"),
+    )
+
+    def __init__(self, registry: Optional[Registry] = None, namespace="tendermint"):
+        r = registry or Registry()
+        sub = "stall"
+        reg = r.register
+        self.stalled = reg(Gauge("stalled", "1 while the consensus height probe is stalled past the watchdog horizon.", namespace, sub))
+        self.stalled_seconds = reg(Gauge("stalled_seconds", "Seconds the current stall has lasted (0 when not stalled).", namespace, sub))
+        self.stalls = reg(Counter("stalls_total", "Consensus stall episodes detected.", namespace, sub))
+        self.recoveries = reg(Counter("recoveries_total", "Stall episodes that ended with the height advancing again.", namespace, sub))
+        self.height = reg(Gauge("height", "Height the last stall was diagnosed at.", namespace, sub))
+        self.round = reg(Gauge("round", "Round the last stall was diagnosed at.", namespace, sub))
+        self.missing_power = reg(Gauge("missing_power", "Voting power short of the +2/3 precommit quorum in the last diagnosis.", namespace, sub))
+        self.missing_validators = reg(Gauge("missing_validators", "Validators silent for the entire stalled height in the last diagnosis.", namespace, sub))
+        self._deltas = _SnapshotCounters()
+
+    def update(self, stats: dict) -> None:
+        """Fold a StallTracker.stats() snapshot into the instruments."""
+        self.stalled.set(stats.get("stalled", 0))
+        self.stalled_seconds.set(stats.get("stalled_seconds", 0))
+        self.height.set(stats.get("height", 0))
+        self.round.set(stats.get("round", 0))
+        self.missing_power.set(stats.get("missing_power", 0))
+        self.missing_validators.set(stats.get("missing_validators", 0))
+        for attr, key in self._COUNTERS:
+            self._deltas.feed(getattr(self, attr), key, stats)
+
+
 class LightServeMetrics:
     """Batched light-client verification service
     (``tendermint_lightserve_*``, lightserve/service.py +

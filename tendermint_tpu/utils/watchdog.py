@@ -234,14 +234,16 @@ class _Worker:
 
 
 class _Probe:
-    __slots__ = ("name", "probe", "stall_after_s", "on_stall", "last_value",
-                 "last_change", "stalls", "stalled")
+    __slots__ = ("name", "probe", "stall_after_s", "on_stall", "on_recover",
+                 "last_value", "last_change", "stalls", "stalled")
 
-    def __init__(self, name, probe, stall_after_s, on_stall, now: float):
+    def __init__(self, name, probe, stall_after_s, on_stall, now: float,
+                 on_recover=None):
         self.name = name
         self.probe = probe
         self.stall_after_s = float(stall_after_s)
         self.on_stall = on_stall
+        self.on_recover = on_recover
         self.last_value = object()  # sentinel: first tick always "changes"
         self.last_change = now
         self.stalls = 0
@@ -292,12 +294,16 @@ class Watchdog:
         probe: Callable[[], object],
         stall_after_s: float,
         on_stall: Optional[Callable[[str, float], None]] = None,
+        on_recover: Optional[Callable[[str, float], None]] = None,
     ) -> None:
         """``probe()`` is sampled each tick; an unchanged value for
-        ``stall_after_s`` records a stall (once per stall episode)."""
+        ``stall_after_s`` records a stall (once per stall episode).
+        ``on_recover(name, stalled_for_s)`` fires on the first change
+        after a recorded stall — the un-stall edge."""
         with self._lock:
             self._probes.append(
-                _Probe(name, probe, stall_after_s, on_stall, self.clock.monotonic())
+                _Probe(name, probe, stall_after_s, on_stall,
+                       self.clock.monotonic(), on_recover=on_recover)
             )
 
     def register_heartbeat(
@@ -401,9 +407,18 @@ class Watchdog:
                 self.logger.error("progress probe failed", probe=p.name, err=repr(e))
                 continue
             if v != p.last_value:
+                was_stalled_for = now - p.last_change
                 p.last_value = v
                 p.last_change = now
-                p.stalled = False
+                if p.stalled:
+                    p.stalled = False
+                    if p.on_recover is not None:
+                        try:
+                            p.on_recover(p.name, was_stalled_for)
+                        except Exception as e:
+                            self.logger.error(
+                                "recover callback failed", probe=p.name, err=repr(e)
+                            )
             elif not p.stalled and now - p.last_change >= p.stall_after_s:
                 self._record_stall(p, now)
         for p in beats:

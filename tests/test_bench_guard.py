@@ -377,6 +377,40 @@ def test_guard_mesh_provenance_mismatch_skips_loudly(bench):
     ), bench.GUARD_SKIPS
 
 
+def test_guard_flags_flightrec_regression_and_disappearance(bench):
+    """The always-on flight recorder's attributed overhead is a LOWER
+    guard key: a run where recording got materially more expensive
+    (or stopped being measured at all) must hard-fail the bench —
+    "always-on" is only defensible while it stays cheap."""
+    _write_record(bench, flightrec_overhead_pct=0.7)
+    fails = bench._regression_guard({"flightrec_overhead_pct": 1.5}, "tpu")
+    assert len(fails) == 1 and "flightrec_overhead_pct" in fails[0], fails
+    # within tolerance: noise, not a regression
+    assert (
+        bench._regression_guard({"flightrec_overhead_pct": 0.8}, "tpu") == []
+    )
+    # the key vanishing from a run is itself a failure
+    fails = bench._regression_guard({"overhead_pct": 0.2}, "tpu")
+    assert any(
+        "flightrec_overhead_pct" in f and "missing" in f for f in fails
+    ), fails
+
+
+def test_guard_flightrec_provenance_mismatch_skips_loudly(bench):
+    """flightrec_overhead_pct rides the trace section's platform stamp:
+    a TPU baseline vs a CPU-fallback trace section is a loud skip,
+    never a judged comparison."""
+    _write_record(bench, flightrec_overhead_pct=0.7, trace_platform="tpu")
+    fails = bench._regression_guard(
+        {"flightrec_overhead_pct": 2.5, "trace_platform": "cpu"}, "tpu"
+    )
+    assert fails == []
+    assert any(
+        "flightrec_overhead_pct" in s and "not comparable" in s
+        for s in bench.GUARD_SKIPS
+    ), bench.GUARD_SKIPS
+
+
 def test_mesh_bench_skips_loudly_without_accelerator(bench):
     """device=False (the node's host-fallback branch): the sweep is
     skipped with an explicit note, but the chunked-seam parity drill

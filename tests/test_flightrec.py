@@ -1,0 +1,283 @@
+"""Unit laws for the consensus flight recorder + stall autopsy
+(tendermint_tpu/consensus/flightrec.py): ring capacity/wrap, the
+crash-survivable WAL-adjacent tail (framing, torn-tail repair,
+rotation bound), diagnose() against a live-but-wedged ConsensusState,
+the StallTracker edges driven through a real Watchdog height probe on
+a manual clock, and the docs/observability.md taxonomy staying in
+lockstep with the kinds this code records.
+
+The end-to-end counterparts live in tests/test_observability.py (live
+node) and tests/test_sim.py::test_wedge_autopsy_names_cut_validators
+(fleet-wide autopsy on a wedged partition).
+"""
+
+import asyncio
+import os
+
+from tendermint_tpu.consensus.flightrec import (
+    TAIL_ROTATE_FACTOR,
+    FlightRecorder,
+    StallTracker,
+    diagnose,
+    load_tail,
+)
+from tendermint_tpu.utils.watchdog import Watchdog
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+# -- the ring ---------------------------------------------------------------
+
+
+def test_ring_capacity_and_wrap():
+    rec = FlightRecorder(capacity=8, node_id="n0")
+    for i in range(20):
+        rec.record("vote.in", height=i, round_=0, detail=(1, i, "peer"))
+    st = rec.stats()
+    assert st == {"events_recorded": 20, "buffered": 8, "capacity": 8}
+    evs = rec.events()
+    assert len(evs) == 8
+    # newest-last, oldest 12 evicted
+    assert [e[2] for e in evs] == list(range(12, 20))
+    # limit applies to the newest end
+    assert [e[2] for e in rec.events(limit=3)] == [17, 18, 19]
+    # JSON-ready rows: lists, timestamps rounded
+    row = rec.tail(limit=1)[0]
+    assert isinstance(row, list) and row[1] == "vote.in" and row[2] == 19
+
+
+def test_default_capacity_on_zero():
+    from tendermint_tpu.consensus.flightrec import DEFAULT_CAPACITY
+
+    assert FlightRecorder(capacity=0).capacity == DEFAULT_CAPACITY
+    assert FlightRecorder(capacity=-1).capacity == DEFAULT_CAPACITY
+
+
+# -- the crash-survivable tail ----------------------------------------------
+
+
+def test_tail_file_survives_and_appends(tmp_path):
+    path = str(tmp_path / "data" / "cs.wal.flightrec")
+    rec = FlightRecorder(capacity=64, node_id="n0")
+    rec.record("height.commit", 1, 0, 5)  # before attach: not in the tail
+    rec.attach_tail(path)
+    rec.record("step.enter", 2, 0, "Propose")
+    rec.record("height.commit", 2, 0, 7)
+    rec.sync_tail()
+    rec.record("step.enter", 3, 0, "Propose")
+    rec.sync_tail()  # second frame appends
+    rec.sync_tail()  # nothing pending: no-op, no empty frame
+    rec.close_tail()
+
+    rows = load_tail(path)
+    assert [(r[1], r[2]) for r in rows] == [
+        ("step.enter", 2), ("height.commit", 2), ("step.enter", 3),
+    ]
+
+
+def test_tail_tolerates_torn_final_frame(tmp_path):
+    path = str(tmp_path / "cs.wal.flightrec")
+    rec = FlightRecorder(capacity=64)
+    rec.attach_tail(path)
+    rec.record("height.commit", 1, 0, 1)
+    rec.sync_tail()
+    rec.record("height.commit", 2, 0, 2)
+    rec.sync_tail()
+    rec.close_tail()
+
+    whole = load_tail(path)
+    assert [r[2] for r in whole] == [1, 2]
+
+    # the node died mid-write: garbage after the last good frame
+    with open(path, "ab") as fp:
+        fp.write(b"\xde\xad\xbe\xef-torn")
+    assert [r[2] for r in load_tail(path)] == [1, 2]
+
+    # ... or mid-frame: cut the garbage AND into the second frame
+    size = os.path.getsize(path)
+    with open(path, "r+b") as fp:
+        fp.truncate(size - 9 - 5)
+    assert [r[2] for r in load_tail(path)] == [1]
+
+    # no file at all: empty, never a raise
+    assert load_tail(str(tmp_path / "nope.flightrec")) == []
+
+
+def test_tail_rotation_bounds_the_sidecar(tmp_path):
+    path = str(tmp_path / "cs.wal.flightrec")
+    rec = FlightRecorder(capacity=4)
+    rec.attach_tail(path)
+    for i in range(10):
+        rec.record("vote.in", i)
+    rec.sync_tail()  # framed: 10
+    assert len(load_tail(path)) == 10
+    for i in range(10, 10 + TAIL_ROTATE_FACTOR * 4):
+        rec.record("vote.in", i)
+    rec.sync_tail()  # 10 + 32 > 32: rewrite from the live ring
+    rec.close_tail()
+    rows = load_tail(path)
+    # the rotated file holds exactly the ring (the newest `capacity`)
+    assert len(rows) == 4
+    assert [r[2] for r in rows] == [38, 39, 40, 41]
+
+
+# -- diagnose() + StallTracker against a wedged ConsensusState --------------
+
+
+async def _lone_node():
+    """One started node of a 4-validator genesis with nobody else on
+    the wire: it can never reach +2/3, i.e. a genuinely wedged cs."""
+    from tests.cs_harness import make_genesis, make_node
+
+    genesis, privs = make_genesis(4)
+    node = await make_node(genesis, privs[0], node_id="lone0")
+    await node.cs.start()
+    # let it run its h1/r0 propose step (or lack thereof) briefly
+    await asyncio.sleep(0.3)
+    return node
+
+
+def test_diagnose_wedged_lone_node():
+    async def go():
+        node = await _lone_node()
+        try:
+            d = diagnose(
+                node.cs,
+                peers=[{"peer": "p1", "age_s": 9.9}],
+                breakers={"some.breaker": {"state": "closed"}},
+                engines={"verify": {"rows": 0}},
+                mempool_size=3,
+                stalled_for_s=12.5,
+            )
+        finally:
+            await node.cs.stop()
+        assert d["node_id"] == "lone0"
+        assert d["height"] == 1 and d["last_commit_height"] == 0
+        assert d["validators"] == 4
+        assert d["blocked_step"] == d["step"]
+        assert d["stalled_for_s"] == 12.5
+        # a lone validator of four can never hold quorum
+        if d["step"] == "Propose":
+            assert d["reason"].startswith("no proposal received")
+        else:
+            q = d["quorum"]["prevote"]
+            assert not q["has_two_thirds"]
+            assert q["power_present"] < q["power_needed"]
+            assert "short of prevote quorum" in d["reason"]
+            assert str(q["missing_validators"]) in d["reason"]
+        # the three silent validators are named height-wide; our own
+        # index only counts once we actually voted
+        assert {1, 2, 3} <= set(d["missing_validators"])
+        # caller context is attached verbatim
+        assert d["peers"][0]["peer"] == "p1"
+        assert "some.breaker" in d["breakers"]
+        assert d["engines"]["verify"] == {"rows": 0}
+        assert d["mempool"] == {"size": 3}
+        assert d["wal"]["kind"]
+        assert d["recorder"]["events_recorded"] > 0
+
+    run(go())
+
+
+class _ManualClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def monotonic(self) -> float:
+        return self.t
+
+
+def test_stall_tracker_through_watchdog_probe():
+    """The real wiring, on a manual clock: a Watchdog height-progress
+    probe whose on_stall/on_recover are the StallTracker's — the stall
+    edge snapshots a diagnosis, records stall.detected, and the metrics
+    snapshot flips; recovery flips it back and records stall.cleared."""
+
+    async def go():
+        node = await _lone_node()
+        try:
+            tracker = StallTracker(node.cs, context_fn=lambda: {"mempool_size": 1})
+            clock = _ManualClock()
+            wd = Watchdog(interval_s=3600, clock=clock)  # check_once-driven
+            height = [1]
+            wd.register_progress(
+                "consensus.height", lambda: height[0], stall_after_s=10.0,
+                on_stall=tracker.on_stall, on_recover=tracker.on_recover,
+            )
+            wd.check_once()  # baseline tick
+            assert not tracker.stalled
+
+            clock.t = 11.0
+            wd.check_once()  # height unchanged past the horizon: stall edge
+            assert tracker.stalled and tracker.stalls == 1
+            diag = tracker.last_diagnosis
+            assert diag is not None and diag["stalled_for_s"] == 11.0
+            assert diag["mempool"] == {"size": 1}
+            st = tracker.stats()
+            assert st["stalled"] == 1 and st["stalls"] == 1
+            assert st["height"] == diag["height"]
+            assert st["missing_validators"] == len(diag["missing_validators"])
+            kinds = [ev[1] for ev in node.cs.flightrec.events()]
+            assert kinds.count("stall.detected") == 1
+            detected = [ev for ev in node.cs.flightrec.events()
+                        if ev[1] == "stall.detected"][0]
+            assert detected[4] == diag["reason"]
+
+            clock.t = 12.0
+            wd.check_once()  # still stalled: the edge fired exactly once
+            assert tracker.stalls == 1
+
+            height[0] = 2
+            clock.t = 13.0
+            wd.check_once()  # progress again: recovery edge
+            assert not tracker.stalled and tracker.recoveries == 1
+            st = tracker.stats()
+            assert st["stalled"] == 0 and st["recoveries"] == 1
+            assert st["stalled_seconds"] == 0.0
+            kinds = [ev[1] for ev in node.cs.flightrec.events()]
+            assert kinds.count("stall.cleared") == 1
+            # a recover without a recorded stall is a no-op
+            tracker.on_recover("consensus.height", 1.0)
+            assert tracker.recoveries == 1
+        finally:
+            await node.cs.stop()
+
+    run(go())
+
+
+# -- the taxonomy contract ---------------------------------------------------
+
+ALL_KINDS = [
+    "step.enter", "step.exit", "vote.in", "vote.out", "proposal.in",
+    "part.in", "timeout.fired", "wal.fsync", "height.commit",
+    "breaker.trip", "breaker.readmit", "catchup.replay",
+    "stall.detected", "stall.cleared",
+]
+
+
+def test_taxonomy_documents_every_kind():
+    """docs/observability.md's event-taxonomy table (the one the
+    flightrec-coherence lint rule enforces against code) lists every
+    kind the recorder hooks emit."""
+    here = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    doc = open(os.path.join(here, "docs", "observability.md")).read()
+    for kind in ALL_KINDS:
+        assert f"`{kind}`" in doc, f"taxonomy missing {kind}"
+
+
+def test_live_lone_node_records_the_basics():
+    """Even a node that never commits records its step lifecycle —
+    always-on means always on."""
+
+    async def go():
+        node = await _lone_node()
+        try:
+            kinds = {ev[1] for ev in node.cs.flightrec.events()}
+        finally:
+            await node.cs.stop()
+        assert "step.enter" in kinds
+        assert kinds <= set(ALL_KINDS), kinds - set(ALL_KINDS)
+
+    run(go())

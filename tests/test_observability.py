@@ -1,0 +1,240 @@
+"""The stall-autopsy observability stack against a live node.
+
+dump_debug bundles the flight-recorder tail + structured diagnosis;
+scripts/autopsy.py renders it (file and --url); GET /metrics serves a
+scrape-clean Prometheus exposition on the RPC port; traceview --url
+summarizes a live dump_trace; the tendermint_health_* /
+tendermint_stall_* families move as TRUE counter deltas through the
+node's metrics pump. See docs/observability.md.
+"""
+
+import asyncio
+import json
+import subprocess
+import sys
+
+from tendermint_tpu.cli import main as cli_main
+from tendermint_tpu.config import load_config
+from tendermint_tpu.node import default_new_node
+from tendermint_tpu.rpc.client import HTTPClient
+from tendermint_tpu.rpc.server import RPCServer
+
+AUTOPSY = "scripts/autopsy.py"
+TRACEVIEW = "scripts/traceview.py"
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+async def start_node(tmp_path, trace=False):
+    import os
+
+    home = str(tmp_path / "obsnode")
+    cli_main(["--home", home, "init", "--chain-id", "obs-chain"])
+    cfg = load_config(os.path.join(home, "config/config.toml")).set_root(home)
+    cfg.base.db_backend = "memdb"
+    cfg.p2p.laddr = "tcp://127.0.0.1:0"
+    cfg.rpc.laddr = "tcp://127.0.0.1:0"
+    cfg.consensus.timeout_commit_ms = 80
+    cfg.consensus.skip_timeout_commit = True
+    if trace:
+        cfg.base.trace_enabled = True
+    node = default_new_node(cfg)
+    node.rpc_server = RPCServer(node)
+    await node.start()
+    await node.consensus_state.wait_for_height(2, timeout_s=30)
+    addr = node.rpc_server.listen_addr
+    return node, cfg, HTTPClient(f"{addr.host}:{addr.port}")
+
+
+def _run_script(script, *args):
+    return subprocess.run(
+        [sys.executable, script, *args],
+        capture_output=True, text=True, timeout=60,
+        env={**__import__("os").environ, "JAX_PLATFORMS": "cpu"},
+    )
+
+
+def test_dump_debug_autopsy_and_tail(tmp_path):
+    """dump_debug returns recorder tail + diagnosis; autopsy renders it
+    from a file AND a live --url; the crash-survivable .flightrec tail
+    next to the WAL replays after the node stops."""
+
+    async def go():
+        node, cfg, c = await start_node(tmp_path)
+        try:
+            dump = await c.call("dump_debug")
+            # always-on recorder: a committing node has the full event
+            # progression without any tracing/config opt-in
+            kinds = {ev[1] for ev in dump["flightrec"]}
+            for expected in ("step.enter", "step.exit", "vote.out", "vote.in",
+                            "wal.fsync", "height.commit"):
+                assert expected in kinds, (expected, sorted(kinds))
+            assert dump["recorder"]["events_recorded"] >= len(dump["flightrec"])
+            diag = dump["diagnosis"]
+            assert diag["height"] >= 2
+            assert diag["step"]
+            assert diag["blocked_step"] == diag["step"]
+            assert "reason" in diag
+            # live single-validator net: nobody is missing
+            assert diag["missing_validators"] == []
+            assert diag["validators"] == 1
+            assert dump["height_report"]["heights"] is not None
+            assert dump["breakers"] is not None
+            # limit applies to the tail
+            small = await c.call("dump_debug", limit=5)
+            assert len(small["flightrec"]) == 5
+
+            url = f"http://{c.host}:{c.port}"
+            dump_file = tmp_path / "dump.json"
+            dump_file.write_text(json.dumps(dump))
+            loop = asyncio.get_running_loop()
+            # file render + --json + live --url, off the event loop
+            r = await loop.run_in_executor(
+                None, lambda: _run_script(AUTOPSY, str(dump_file))
+            )
+            assert r.returncode == 0, r.stderr
+            assert "== autopsy: node" in r.stdout
+            assert "flight recorder" in r.stdout
+            assert "height.commit" in r.stdout
+            rj = await loop.run_in_executor(
+                None, lambda: _run_script(AUTOPSY, str(dump_file), "--json")
+            )
+            assert rj.returncode == 0, rj.stderr
+            assert json.loads(rj.stdout)["diagnosis"]["height"] >= 2
+            ru = await loop.run_in_executor(
+                None, lambda: _run_script(AUTOPSY, "--url", url)
+            )
+            assert ru.returncode == 0, ru.stderr
+            assert "blocked step:" in ru.stdout
+        finally:
+            await node.stop()
+        return cfg
+
+    cfg = run(go())
+
+    # the WAL-adjacent tail survives the stopped node
+    from tendermint_tpu.consensus.flightrec import load_tail
+
+    tail_path = cfg.consensus.wal_file() + ".flightrec"
+    events = load_tail(tail_path)
+    assert events, "recorder tail file is empty"
+    assert any(ev[1] == "height.commit" for ev in events)
+    # offline autopsy over the tail
+    r = _run_script(AUTOPSY, "--tail", tail_path)
+    assert r.returncode == 0, r.stderr
+    assert "offline flight-recorder tail" in r.stdout
+
+
+def test_metrics_exposition_on_rpc_port(tmp_path):
+    """GET /metrics on the RPC listener serves every registered family
+    in the Prometheus text format, clean under the exposition lint."""
+
+    async def go():
+        node, _cfg, c = await start_node(tmp_path)
+        try:
+            reader, writer = await asyncio.open_connection(c.host, c.port)
+            writer.write(b"GET /metrics HTTP/1.1\r\nHost: x\r\nConnection: close\r\n\r\n")
+            await writer.drain()
+            raw = await reader.read()
+            writer.close()
+            head, body = raw.split(b"\r\n\r\n", 1)
+            return head.decode(), body.decode()
+        finally:
+            await node.stop()
+
+    head, body = run(go())
+    assert "200 OK" in head
+    assert "text/plain; version=0.0.4" in head
+    from tendermint_tpu.analysis.metrics_exposition import validate_metrics_text
+
+    assert validate_metrics_text(body) == []
+    # the families the stall autopsy feeds are present
+    for family in (
+        "tendermint_consensus_height",
+        "tendermint_stall_stalled",
+        "tendermint_stall_stalls_total",
+        "tendermint_health_watchdog_enabled",
+    ):
+        assert family in body, family
+
+
+def test_traceview_live_url(tmp_path):
+    """traceview --url against a live traced node: non-empty stage
+    tables, and the --json artifact parses."""
+
+    async def go():
+        node, _cfg, c = await start_node(tmp_path, trace=True)
+        try:
+            url = f"http://{c.host}:{c.port}"
+            loop = asyncio.get_running_loop()
+            r = await loop.run_in_executor(
+                None, lambda: _run_script(TRACEVIEW, "--url", url)
+            )
+            assert r.returncode == 0, r.stderr
+            assert "== per-stage ==" in r.stdout
+            # a committing node traces its step spans
+            assert "consensus." in r.stdout
+            rj = await loop.run_in_executor(
+                None, lambda: _run_script(TRACEVIEW, "--url", url, "--json")
+            )
+            assert rj.returncode == 0, rj.stderr
+            doc = json.loads(rj.stdout)
+            assert doc["events"]["spans"] > 0
+            assert doc["stages"]
+        finally:
+            await node.stop()
+
+    run(go())
+
+
+def test_health_and_stall_metrics_through_pump(tmp_path):
+    """trip -> shed -> readmit observed as TRUE counter deltas in the
+    scraped tendermint_health_* family via the node's own metrics pump
+    (not breaker_stats() inspection), and the trip/readmit edges land
+    in the flight recorder as breaker.trip / breaker.readmit events."""
+    from tendermint_tpu.utils import watchdog as wd
+
+    async def go():
+        node, _cfg, c = await start_node(tmp_path)
+        name = "obs.test_breaker"
+        try:
+            br = wd.CircuitBreaker(name, failure_threshold=1, cooldown_s=0.0)
+            br.record_failure()          # trip (threshold 1)
+            assert br.allow()            # half-open probe (cooldown 0)
+            br.record_success()          # readmit
+            # let the pump fold the snapshot (2s interval)
+            await asyncio.sleep(3.0)
+
+            reader, writer = await asyncio.open_connection(c.host, c.port)
+            writer.write(b"GET /metrics HTTP/1.1\r\nHost: x\r\nConnection: close\r\n\r\n")
+            await writer.drain()
+            raw = await reader.read()
+            writer.close()
+            body = raw.split(b"\r\n\r\n", 1)[1].decode()
+            assert (
+                f'tendermint_health_breaker_trips_total{{breaker="{name}"}} 1' in body
+            ), body
+            assert (
+                f'tendermint_health_breaker_recoveries_total{{breaker="{name}"}} 1'
+                in body
+            )
+            # stall family is exposed and quiescent on a healthy node
+            assert "tendermint_stall_stalled 0" in body
+            assert "tendermint_stall_stalls_total 0" in body
+
+            # the pump also recorded the edges into the black box
+            dump = await c.call("dump_debug")
+            recorded = [
+                (ev[1], ev[4]) for ev in dump["flightrec"]
+                if ev[1] in ("breaker.trip", "breaker.readmit")
+            ]
+            assert ("breaker.trip", name) in recorded
+            assert ("breaker.readmit", name) in recorded
+        finally:
+            with wd._breakers_lock:
+                wd._breakers.pop(name, None)
+            await node.stop()
+
+    run(go())

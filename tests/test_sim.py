@@ -427,6 +427,44 @@ def test_crash_restart_recovers():
     assert res.heights[4] >= 10
 
 
+def test_wedge_autopsy_names_cut_validators():
+    """ISSUE 18 pin: a 50/50 validator partition wedges both sides, and
+    the sim auto-collects every node's stall autopsy — each side's
+    diagnosis names the blocked step and EXACTLY the validator indices
+    on the other side of the cut. A liveness evaluation over the same
+    run carries the per-node autopsy in its failure message, so a
+    wedged scenario fails with "who is missing", not just "timed out"."""
+    from tendermint_tpu.sim.scenario import evaluate
+
+    sc, sim, res, fails = run_scenario("wedge_autopsy.scn")
+    assert fails == [], fails          # safety holds on a wedged net
+    assert res.timed_out and not res.completed
+    cut = parse_schedule(sc.schedule).partitions[0].cut_set(
+        sc.nodes, sc.validators
+    )
+    cut_vals = sorted(i for i in cut if i < sc.validators)
+    assert cut_vals == [4, 5, 6, 7]    # frac=0.5 of 8 validators
+    assert set(res.autopsies) == set(range(sc.nodes))
+    for i, diag in res.autopsies.items():
+        other_side = (
+            cut_vals if i not in cut
+            else sorted(set(range(sc.validators)) - cut)
+        )
+        assert diag["blocked_step"] == "Prevote", (i, diag)
+        assert diag["missing_validators"] == other_side, (i, diag)
+        q = diag["quorum"]["prevote"]
+        assert not q["has_two_thirds"]
+        assert q["missing_validators"] == other_side
+        assert q["power_present"] < q["power_needed"]
+    # the enriched failure message names blocked step + missing set
+    sc.expect = ["liveness"]
+    blob = "\n".join(evaluate(sc, sim, res))
+    assert "liveness violated" in blob
+    assert "blocked at Prevote" in blob
+    assert "missing validators [4, 5, 6, 7]" in blob   # majority's view
+    assert "missing validators [0, 1, 2, 3]" in blob   # minority's view
+
+
 # -- the scaled acceptance runs (slow) --------------------------------------
 
 

@@ -243,6 +243,32 @@ def test_trace_coherence_documented_and_dynamic_names_pass():
     assert_only(v, "trace-coherence", 1)
 
 
+def test_golden_flightrec_coherence():
+    code = (
+        "def f(self, h, r):\n"
+        "    self.flightrec.record('bogus.event_kind', h, r)\n"
+        "    self.flightrec.record('NotDotted', h, r)\n"
+    )
+    v = lint_snippet(code)
+    assert_only(v, "flightrec-coherence", 2)
+    assert any("bogus.event_kind" in x.message for x in v)  # undocumented
+    assert any("NotDotted" in x.message for x in v)         # bad grammar
+
+
+def test_flightrec_coherence_documented_and_other_receivers_pass():
+    # a documented kind passes; a dynamically-built kind is out of
+    # static reach; record() on NON-flightrec receivers (metrics
+    # recorders, csv writers) never fires regardless of argument
+    code = (
+        "def f(self, cs, w, kind):\n"
+        "    self.flightrec.record('vote.in', 1, 0, (1, 2, 'peer'))\n"
+        "    cs.flightrec.record('height.commit', 5, 0, 3)\n"
+        "    self.flightrec.record('breaker.' + kind, 1, 0)\n"
+        "    w.record('totally.unknown_kind')\n"
+    )
+    assert lint_snippet(code) == []
+
+
 def test_golden_jit_purity():
     code = (
         "import time\n"
@@ -507,6 +533,7 @@ EXPECTED_RULES = {
     "unreachable-code",
     "slow-marker",
     "trace-coherence",
+    "flightrec-coherence",
     "scenario-coherence",
 }
 
