@@ -295,6 +295,7 @@ _GUARD_KEYS = [
     ("bls_verify_speedup", "higher"),
     ("sim_heights_per_sec", "higher"),
     ("sim_recovery_s", "lower"),
+    ("sim_byz_commit_rate", "higher"),
     ("mesh_sigs_per_sec", "higher"),
     ("mesh_speedup", "higher"),
     ("flightrec_overhead_pct", "lower"),
@@ -317,6 +318,7 @@ _KEY_SECTION_PLATFORM = {
     "bls_verify_speedup": "bls_platform",
     "sim_heights_per_sec": "sim_platform",
     "sim_recovery_s": "sim_platform",
+    "sim_byz_commit_rate": "sim_platform",
     "mesh_sigs_per_sec": "mesh_platform",
     "mesh_speedup": "mesh_platform",
     "flightrec_overhead_pct": "trace_platform",
@@ -2289,10 +2291,80 @@ def sim_bench() -> dict:
         else:
             out["sim_error"] = "no sweep configuration completed"
         out.update(sim_recovery_bench())
+        out.update(sim_byz_bench())
         return out
     except Exception as ex:
         log(f"sim bench failed: {ex!r}")
         return {"sim_error": repr(ex)[:200]}
+
+
+SIM_BYZ = {
+    # the adversary-tax drill: the same net twice — once clean, once
+    # with the playbook's noisiest attackers (wire garbling, 4x flood
+    # amplification, far-future probes) — and the ratio of commit
+    # throughput under attack to clean throughput is the guarded
+    # number. The defenses (typed rejects, duplicate shedding, height
+    # window, quarantine) are what keep the ratio from cratering, so a
+    # regression here means an attacker got more leverage per frame.
+    "nodes": 7, "validators": 7, "heights": 6, "seed": 77,
+    "clean_schedule": "link(*,*):delay:ms=8,jitter_ms=3",
+    "byz_schedule": (
+        "link(*,*):delay:ms=8,jitter_ms=3"
+        ";byz:node=0,kind=garble,at_h=2"
+        ";byz:node=1,kind=flood,at_h=2,rate=4"
+        ";byz:node=1,kind=future,at_h=2,rate=4"
+    ),
+}
+
+
+def sim_byz_bench() -> dict:
+    """Commit throughput under the byzantine playbook vs a clean twin
+    (``sim_byz_commit_rate``, higher is better — 1.0 would mean the
+    attack cost nothing). Guarded like sim_heights_per_sec."""
+    try:
+        from tendermint_tpu.sim.core import Simulation
+
+        cfg = SIM_BYZ
+
+        def _run(schedule):
+            sim = Simulation(
+                n_nodes=cfg["nodes"],
+                validators=cfg["validators"],
+                heights=cfg["heights"],
+                schedule=schedule,
+                seed=cfg["seed"],
+                record_events=False,
+            )
+            res = sim.run()
+            # SIMULATED time for every node to commit the final height:
+            # deterministic per seed, so the guarded ratio carries no
+            # wall-clock noise
+            done_ns = max(
+                (ts.get(cfg["heights"], 0) for ts in sim.net.commit_times.values()),
+                default=0,
+            )
+            return sim, res, done_ns
+
+        _, clean, clean_ns = _run(cfg["clean_schedule"])
+        byz_sim, byz, byz_ns = _run(cfg["byz_schedule"])
+        if not clean.completed or clean_ns <= 0:
+            return {"sim_byz_error": "clean twin wedged"}
+        if not byz.completed or byz_ns <= 0:
+            return {"sim_byz_error": "byz run wedged (liveness lost under attack)"}
+        net = byz_sim.net
+        if net.receive_crashes:
+            return {"sim_byz_error": f"{net.receive_crashes} receive crash(es) under attack"}
+        return {
+            "sim_byz_commit_rate": round(clean_ns / byz_ns, 3),
+            "sim_byz_heights_per_sec": round(cfg["heights"] / byz.wall_seconds, 3),
+            "sim_byz_malformed_rejected": int(sum(net.malformed_by_class.values())),
+            "sim_byz_floods_shed": int(net.floods_shed),
+            "sim_byz_future_drops": int(net.future_drops),
+            "sim_byz_quarantines": int(net.quarantines),
+        }
+    except Exception as ex:
+        log(f"sim byz bench failed: {ex!r}")
+        return {"sim_byz_error": repr(ex)[:200]}
 
 
 def sim_recovery_bench() -> dict:

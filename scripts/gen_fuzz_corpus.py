@@ -1,0 +1,81 @@
+#!/usr/bin/env python3
+"""Regenerate the golden malformed-frame corpus.
+
+One mutant per (decoder label, mutation class) — every registered
+consensus message (consensus/messages.py _TAG_TO_CLS) plus the
+mempool/evidence gossip envelopes, each corrupted by every class in
+sim/mutator.py MUTATION_CLASSES — preferring a mutant the decoder
+REJECTS with a typed error (DecodeError/ValueError), falling back to
+a surviving mutant when a frame shape absorbs the class.
+tests/test_fuzz_corpus.py replays the corpus asserting no decoder
+ever raises anything outside the typed-reject family.
+
+Entries are gzip-compressed (`<label>__<class>.bin.gz`): the oversize
+class pads frames past the 1 MiB decode cap, which compresses ~1000x.
+
+Usage: python scripts/gen_fuzz_corpus.py  (deterministic — reruns are
+byte-identical; a diff under tests/data/fuzz_corpus/ means the wire
+format or the mutator changed and the corpus was deliberately rebuilt)
+"""
+
+import gzip
+import pathlib
+import sys
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+
+from tendermint_tpu.sim.mutator import (  # noqa: E402
+    MUTATION_CLASSES,
+    REJECT_ERRORS,
+    WireMutator,
+    exemplar_frames,
+)
+
+CORPUS_DIR = pathlib.Path(__file__).resolve().parent.parent / "tests" / "data" / "fuzz_corpus"
+MAX_ATTEMPTS = 64  # seeds tried per (label, class) before giving up
+
+
+def pick_mutant(frame: bytes, decoder, label: str, klass: str) -> bytes:
+    """First mutant (over deterministic seeds) the decoder rejects with
+    a typed error; when no seed rejects (a fixed-width frame shape can
+    absorb some classes — e.g. a length lie on an all-ints body just
+    decodes to different values), the seed-0 survivor is kept instead:
+    the corpus guarantee is "typed reject or clean decode, NEVER a
+    crash", and a surviving mutant still pins the no-crash half."""
+    fallback = None
+    for attempt in range(MAX_ATTEMPTS):
+        mut = WireMutator(seed=attempt)
+        _, mutant = mut.mutate(frame, label, klass)
+        try:
+            decoder(mutant)
+        except REJECT_ERRORS:
+            return mutant
+        except Exception as e:  # noqa: BLE001 — corpus must not pin a crash
+            raise SystemExit(
+                f"FATAL: {label}/{klass} seed {attempt} CRASHED the decoder "
+                f"({type(e).__name__}: {e}) — fix the decoder, then regenerate"
+            )
+        if fallback is None:
+            fallback = mutant
+    return fallback
+
+
+def main() -> None:
+    CORPUS_DIR.mkdir(parents=True, exist_ok=True)
+    for stale in CORPUS_DIR.glob("*.bin.gz"):
+        stale.unlink()
+    n = 0
+    for label, frame, decoder in exemplar_frames():
+        for klass in MUTATION_CLASSES:
+            mutant = pick_mutant(frame, decoder, label, klass)
+            path = CORPUS_DIR / f"{label}__{klass}.bin.gz"
+            # mtime=0 keeps the gzip output byte-stable across reruns
+            with open(path, "wb") as fp:
+                with gzip.GzipFile(fileobj=fp, mode="wb", mtime=0) as gz:
+                    gz.write(mutant)
+            n += 1
+    print(f"wrote {n} corpus entries to {CORPUS_DIR}")
+
+
+if __name__ == "__main__":
+    main()

@@ -148,7 +148,12 @@ class Reader:
         return self._take(n)
 
     def read_str(self, max_len: int = 1 << 20) -> str:
-        return self.read_bytes(max_len).decode("utf-8")
+        try:
+            return self.read_bytes(max_len).decode("utf-8")
+        except UnicodeDecodeError as e:
+            # adversarial bytes in a string field are a malformed frame,
+            # not a codec crash (docs/robustness.md, receive hardening)
+            raise DecodeError(f"invalid utf-8 in string field: {e}") from e
 
     def read_opt_bytes(self) -> Optional[bytes]:
         if not self.read_bool():

@@ -247,6 +247,7 @@ def diagnose(
     engines: Optional[dict] = None,
     mempool_size: Optional[int] = None,
     stalled_for_s: Optional[float] = None,
+    quarantined: Optional[list] = None,
 ) -> Dict[str, Any]:
     """Structured stall diagnosis from live ConsensusState internals.
 
@@ -340,6 +341,18 @@ def diagnose(
         out["engines"] = engines
     if mempool_size is not None:
         out["mempool"] = {"size": mempool_size}
+    if quarantined:
+        # peers the byz defense stopped listening to — a stall with a
+        # quarantined validator in the missing set is self-explaining
+        out["quarantined_peers"] = list(quarantined)
+        missing = out.get("missing_validators") or []
+        if missing:
+            qset = {str(q) for q in quarantined}
+            overlap = [m for m in missing if str(m) in qset]
+            if overlap:
+                out["reason"] += (
+                    f" (quarantined for malformed traffic: {overlap})"
+                )
     out["wal"] = {"kind": type(cs.wal).__name__}
     rec = getattr(cs, "flightrec", None)
     if rec is not None:

@@ -15,7 +15,7 @@ from __future__ import annotations
 from dataclasses import dataclass
 from typing import Optional
 
-from tendermint_tpu.codec.binary import Reader, Writer
+from tendermint_tpu.codec.binary import DecodeError, Reader, Writer
 from tendermint_tpu.types.block import BlockID, PartSetHeader
 from tendermint_tpu.types.part_set import Part
 from tendermint_tpu.types.proposal import Proposal
@@ -322,10 +322,35 @@ def encode_msg(msg) -> bytes:
     return w.bytes()
 
 
+# Hard frame cap, checked BEFORE any decode allocation: the largest
+# legitimate frame is a BlockPartMessage (one 64 KiB part + proof), so
+# 1 MiB leaves generous headroom while making length-prefix lies and
+# oversized adversarial frames a cheap O(1) reject (docs/robustness.md,
+# receive hardening).
+MAX_MSG_BYTES = 1 << 20
+
+
 def decode_msg(data: bytes):
+    """Decode one tagged consensus frame.
+
+    This is the receive seam's typed-reject boundary: malformed input
+    of ANY shape raises ``DecodeError``/``ValueError`` — never
+    IndexError/struct.error/OverflowError or another crash a byzantine
+    peer could use to kill a receive routine. Pinned by
+    tests/test_fuzz_corpus.py over the golden malformed-frame corpus.
+    """
+    if len(data) > MAX_MSG_BYTES:
+        raise DecodeError(
+            f"oversized frame: {len(data)} bytes exceeds max {MAX_MSG_BYTES}"
+        )
     r = Reader(data)
-    tag = r.read_u8()
-    cls = _TAG_TO_CLS.get(tag)
-    if cls is None:
-        raise ValueError(f"unknown consensus message tag 0x{tag:02x}")
-    return cls.decode_body(r)
+    try:
+        tag = r.read_u8()
+        cls = _TAG_TO_CLS.get(tag)
+        if cls is None:
+            raise ValueError(f"unknown consensus message tag 0x{tag:02x}")
+        return cls.decode_body(r)
+    except (DecodeError, ValueError):
+        raise
+    except Exception as e:  # noqa: BLE001 — the typed-reject conversion
+        raise DecodeError(f"malformed frame: {type(e).__name__}: {e}") from e

@@ -15,6 +15,7 @@ import asyncio
 import time
 from typing import Dict, Optional
 
+from tendermint_tpu.codec.binary import DecodeError
 from tendermint_tpu.codec.signbytes import PRECOMMIT_TYPE, PREVOTE_TYPE
 from tendermint_tpu.consensus import messages as m
 from tendermint_tpu.consensus.peer_state import CommitVotes, PeerState
@@ -41,6 +42,11 @@ VOTE_CHANNEL = 0x22
 VOTE_SET_BITS_CHANNEL = 0x23
 
 PEER_STATE_KEY = "ConsensusReactor.peerState"
+
+# Heights further ahead than this are shed at the receive seam before
+# any buffering — the real-path twin of sim/net.py FUTURE_MSG_WINDOW
+# (the `future` attacker in the byzantine playbook probes exactly this).
+FUTURE_MSG_WINDOW = 64
 
 
 class ConsensusReactor(Reactor):
@@ -170,13 +176,50 @@ class ConsensusReactor(Reactor):
     # -- receive -----------------------------------------------------------
 
     async def receive(self, ch_id: int, peer: Peer, msg_bytes: bytes) -> None:
-        """Reference Receive :214."""
-        msg = m.decode_msg(msg_bytes)
+        """Reference Receive :214.
+
+        The receive seam: malformed frames surface as typed
+        DecodeError/ValueError only — recorded in the flight recorder
+        as ``byz.reject`` and re-raised for the switch's PeerGuard to
+        demerit (p2p/switch.py). Far-future messages are shed here
+        before they can grow any buffer (bounded-memory defense,
+        mirroring sim/net.py's window).
+        """
+        cs = self.cs
+        try:
+            msg = m.decode_msg(msg_bytes)
+        except (DecodeError, ValueError) as e:
+            cs.flightrec.record(
+                "byz.reject", cs.rs.height, cs.rs.round,
+                (f"ch{ch_id:#x}", peer.id[:12], type(e).__name__),
+            )
+            raise
         ps: Optional[PeerState] = peer.get(PEER_STATE_KEY)
         if ps is None:
             return
         ps.touch()  # last-gossip age for the stall autopsy
-        cs = self.cs
+
+        # far-future shed: a "valid-looking" vote/proposal/part way
+        # beyond our height probes for unbounded catch-up buffers. Only
+        # the queue-bearing kinds are shed (the ones that would reach
+        # cs._queue and allocate); NewRoundStep stays — it is the
+        # legitimate fixed-size "I am ahead" signal a lagging node
+        # needs to see.
+        h = None
+        if isinstance(msg, m.VoteMessage):
+            h = msg.vote.height
+        elif isinstance(msg, m.ProposalMessage):
+            h = msg.proposal.height
+        elif isinstance(msg, m.BlockPartMessage):
+            h = msg.height
+        if h is not None and h > cs.rs.height + FUTURE_MSG_WINDOW:
+            if self.switch is not None:
+                self.switch.guard.future_drop(peer.id)
+            cs.flightrec.record(
+                "byz.reject", cs.rs.height, cs.rs.round,
+                (type(msg).__name__, peer.id[:12], f"future h={h}"),
+            )
+            return
 
         if ch_id == STATE_CHANNEL:
             if isinstance(msg, m.NewRoundStepMessage):

@@ -327,6 +327,11 @@ class ConsensusState(Service):
         # reactor-installed callback: (peer_id, err) -> None, used to
         # punish peers whose queued messages fail validation
         self.on_peer_error = None
+        # peer messages rejected by the receive-seam backstop (an
+        # unclassified handler exception converted to reject-and-punish
+        # instead of a consensus halt) — pumped as
+        # tendermint_byz_handler_rejects_total (node/node.py)
+        self.byz_rejects = 0
 
         self.update_to_state(state)
         self._reconstruct_last_commit_if_needed(state)
@@ -762,6 +767,27 @@ class ConsensusState(Service):
                     "ignoring out-of-sync peer message",
                     peer=peer_id, msg_type=type(msg).__name__, err=repr(e),
                 )
+        except asyncio.CancelledError:
+            raise
+        except Exception as e:  # noqa: BLE001 — the receive-seam backstop
+            if not peer_id:
+                raise  # own message: internal invariant violation, halt
+            # An unclassified exception provoked by a PEER's message is a
+            # hostile or malformed frame the validation layer didn't
+            # anticipate (bit-flipped-but-decodable gossip, fabricated
+            # fields): reject-and-punish, never let it kill the receive
+            # routine — the halt stays reserved for OUR invariants
+            # (docs/robustness.md, attack playbook).
+            self.byz_rejects += 1
+            self.flightrec.record(
+                "byz.reject", self.rs.height, self.rs.round,
+                (type(msg).__name__, peer_id, type(e).__name__),
+            )
+            self.logger.error(
+                "unclassified peer message failure; rejecting",
+                peer=peer_id, msg_type=type(msg).__name__, err=repr(e),
+            )
+            self._punish_peer(peer_id, e)
         finally:
             self.ledger.pop(phase, time.perf_counter())
 

@@ -11,7 +11,7 @@ from __future__ import annotations
 import asyncio
 from typing import Dict, List
 
-from tendermint_tpu.codec.binary import Reader, Writer
+from tendermint_tpu.codec.binary import DecodeError, Reader, Writer
 from tendermint_tpu.evidence.pool import (
     ErrEvidenceAlreadySeen,
     ErrInvalidEvidence,
@@ -34,9 +34,35 @@ def encode_evidence_list(evs: List) -> bytes:
     return w.bytes()
 
 
+# Hard envelope cap (checked before decode): evidence items are small
+# (two votes + metadata), so 1 MiB is generous headroom while making
+# oversized adversarial envelopes an O(1) reject.
+MAX_ENVELOPE_BYTES = 1 << 20
+
+
 def decode_evidence_list(data: bytes) -> List:
+    """Typed-reject boundary for the evidence gossip envelope:
+    malformed bytes raise ``DecodeError``/``ValueError``, never another
+    crash (tests/test_fuzz_corpus.py)."""
+    if len(data) > MAX_ENVELOPE_BYTES:
+        raise DecodeError(
+            f"oversized evidence envelope: {len(data)} bytes exceeds max "
+            f"{MAX_ENVELOPE_BYTES}"
+        )
     r = Reader(data)
-    return [decode_evidence(r.read_bytes()) for _ in range(r.read_uvarint())]
+    try:
+        n = r.read_uvarint()
+        if n > len(data):  # each item costs >= 1 byte: count lie, reject
+            raise DecodeError(
+                f"evidence count {n} exceeds envelope size {len(data)}"
+            )
+        return [decode_evidence(r.read_bytes()) for _ in range(n)]
+    except (DecodeError, ValueError):
+        raise
+    except Exception as e:  # noqa: BLE001 — the typed-reject conversion
+        raise DecodeError(
+            f"malformed evidence envelope: {type(e).__name__}: {e}"
+        ) from e
 
 
 class EvidenceReactor(Reactor):

@@ -12,7 +12,7 @@ from __future__ import annotations
 import asyncio
 from typing import Dict, Optional
 
-from tendermint_tpu.codec.binary import Reader, Writer
+from tendermint_tpu.codec.binary import DecodeError, Reader, Writer
 from tendermint_tpu.mempool.mempool import ErrMempoolIsFull, ErrTxInCache, Mempool
 from tendermint_tpu.p2p.conn.connection import ChannelDescriptor
 from tendermint_tpu.p2p.peer import Peer
@@ -40,16 +40,56 @@ def encode_txs(txs, origin: Optional[OriginContext] = None) -> bytes:
     return w.bytes()
 
 
+# Hard envelope cap, checked BEFORE decode: a gossip message carries a
+# bounded batch of txs (mempool max_tx_bytes is far below this), so 4 MiB
+# makes oversized adversarial envelopes an O(1) reject with no
+# allocation driven by the claimed tx count.
+MAX_ENVELOPE_BYTES = 1 << 22
+
+
 def decode_txs(data: bytes):
+    """Typed-reject boundary for the tx gossip envelope: malformed
+    bytes raise ``DecodeError``/``ValueError``, never another crash
+    (tests/test_fuzz_corpus.py)."""
+    if len(data) > MAX_ENVELOPE_BYTES:
+        raise DecodeError(
+            f"oversized tx envelope: {len(data)} bytes exceeds max "
+            f"{MAX_ENVELOPE_BYTES}"
+        )
     r = Reader(data)
-    return [r.read_bytes() for _ in range(r.read_uvarint())]
+    try:
+        n = r.read_uvarint()
+        if n > len(data):  # each tx costs >= 1 byte: count lie, reject
+            raise DecodeError(f"tx count {n} exceeds envelope size {len(data)}")
+        return [r.read_bytes() for _ in range(n)]
+    except (DecodeError, ValueError):
+        raise
+    except Exception as e:  # noqa: BLE001 — the typed-reject conversion
+        raise DecodeError(f"malformed tx envelope: {type(e).__name__}: {e}") from e
 
 
 def decode_txs_origin(data: bytes):
     """(txs, origin) — origin None when absent/malformed (tolerant)."""
+    if len(data) > MAX_ENVELOPE_BYTES:
+        raise DecodeError(
+            f"oversized tx envelope: {len(data)} bytes exceeds max "
+            f"{MAX_ENVELOPE_BYTES}"
+        )
     r = Reader(data)
-    txs = [r.read_bytes() for _ in range(r.read_uvarint())]
-    return txs, (OriginContext.decode(r) if r.remaining() else None)
+    try:
+        n = r.read_uvarint()
+        if n > len(data):
+            raise DecodeError(f"tx count {n} exceeds envelope size {len(data)}")
+        txs = [r.read_bytes() for _ in range(n)]
+    except (DecodeError, ValueError):
+        raise
+    except Exception as e:  # noqa: BLE001
+        raise DecodeError(f"malformed tx envelope: {type(e).__name__}: {e}") from e
+    try:
+        origin = OriginContext.decode(r) if r.remaining() else None
+    except Exception:
+        origin = None  # trailer stays tolerant (append-and-tolerate wire)
+    return txs, origin
 
 
 class MempoolReactor(Reactor):

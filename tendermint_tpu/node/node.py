@@ -419,6 +419,7 @@ class Node(Service):
 
         from tendermint_tpu.utils.metrics import (
             BLSMetrics,
+            ByzMetrics,
             CryptoMetrics,
             EngineMetrics,
             ExecMetrics,
@@ -446,6 +447,10 @@ class Node(Service):
         self.stall_metrics = StallMetrics(self.metrics_registry, ns)
         self.stall_tracker = None  # built in on_start with the cs
         self._breaker_last = {}  # (trips, recoveries) per breaker, pump-diffed
+        # byzantine-defense family (p2p PeerGuard + consensus backstop):
+        # tendermint_byz_* malformed/floods/future-drops/quarantines
+        self.byz_metrics = ByzMetrics(self.metrics_registry, ns)
+        self._quarantines_last = 0  # pump-diffed into peer.quarantine events
         self.lightserve_metrics = LightServeMetrics(self.metrics_registry, ns)
         self.ingest_metrics = IngestMetrics(self.metrics_registry, ns)
         self.bls_metrics = BLSMetrics(self.metrics_registry, ns)
@@ -876,6 +881,26 @@ class Node(Service):
             )
             if self.stall_tracker is not None:
                 self.stall_metrics.update(self.stall_tracker.stats())
+            # byzantine-defense family: guard snapshot + the consensus
+            # handler backstop counter; quarantine edges become
+            # peer.quarantine flight-recorder events (same diffing
+            # discipline as the breaker edges below)
+            guard_stats = self.switch.guard.stats()
+            self.byz_metrics.update(
+                guard_stats,
+                self.consensus_state.byz_rejects if self.consensus_state is not None else 0,
+            )
+            if (
+                guard_stats["quarantines"] > self._quarantines_last
+                and self.consensus_state is not None
+            ):
+                self.consensus_state.flightrec.record(
+                    "peer.quarantine",
+                    self.consensus_state.rs.height,
+                    self.consensus_state.rs.round,
+                    tuple(guard_stats["quarantined_peers"][:4]),
+                )
+            self._quarantines_last = guard_stats["quarantines"]
             # breaker trip/readmit edges into the flight recorder: the
             # breaker hot path gains no branch — the pump diffs the
             # monotonic trip/recovery totals it already collects
@@ -939,6 +964,9 @@ class Node(Service):
             "breakers": _watchdog.breaker_stats(),
             "engines": self.engine_telemetry(),
             "mempool_size": self.mempool.size() if self.mempool is not None else None,
+            # quarantined-for-malformed-traffic peers distinguish "the
+            # net went hostile" from "peers went silent" in a diagnosis
+            "quarantined": self.switch.guard.stats()["quarantined_peers"],
         }
 
     def _only_validator_is_us(self, state: State) -> bool:

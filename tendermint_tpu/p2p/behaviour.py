@@ -9,7 +9,10 @@ its tests.
 from __future__ import annotations
 
 from dataclasses import dataclass
-from typing import Dict, List
+from typing import Dict, List, Tuple
+
+from tendermint_tpu.utils.log import get_logger
+from tendermint_tpu.utils.watchdog import CircuitBreaker
 
 
 # behaviour kinds (reference behaviour/peer_behaviour.go)
@@ -55,6 +58,147 @@ class SwitchReporter(Reporter):
             await self._switch.stop_peer_for_error(
                 peer, f"{behaviour.kind}: {behaviour.reason}"
             )
+
+
+# One malformed frame is weather (a buggy peer, a flaky link); a stream
+# of them is an attack. The guard separates the two with a per-peer
+# demerit breaker instead of the old policy (any decode error = instant
+# disconnect), which let a single corrupt frame from an honest peer
+# sever the link while doing nothing lasting about a hostile one that
+# reconnects and resumes.
+QUARANTINE_THRESHOLD = 8  # malformed frames before a peer is quarantined
+QUARANTINE_COOLDOWN_S = 300.0  # served before the peer may reconnect
+FLOOD_RUN_ALLOWANCE = 4  # consecutive identical frames tolerated per channel
+
+
+class PeerGuard:
+    """Per-peer malformed-traffic accounting + quarantine.
+
+    The switch feeds every typed decode reject into ``malformed()``;
+    each counts one demerit against the sending peer's CircuitBreaker
+    (utils/watchdog.py discipline — registered by name, so the node's
+    metrics pump and breaker flight-recorder edge-diff pick the
+    per-peer breakers up for free). At ``QUARANTINE_THRESHOLD``
+    consecutive demerits the breaker trips: the peer is quarantined —
+    disconnected, and ``quarantined()`` refuses readmission until the
+    cooldown has been served. The first check after the cooldown is the
+    half-open probe: the peer is readmitted with a clean slate, and a
+    still-hostile peer re-trips after another threshold's worth.
+
+    ``shed_duplicate()`` is the amplification defense: a peer
+    re-sending the exact same frame back-to-back on one channel buys
+    zero reactor work once the run exceeds ``FLOOD_RUN_ALLOWANCE``
+    (the allowance keeps legitimate spaced retries — blockchain
+    BlockRequest re-asks, pex re-requests — under the bar).
+
+    ``stats()`` feeds the ``tendermint_byz_*`` metrics family
+    (utils/metrics.py ByzMetrics) and the stall autopsy's
+    quarantined-peer context. See docs/robustness.md.
+    """
+
+    def __init__(
+        self,
+        threshold: int = QUARANTINE_THRESHOLD,
+        cooldown_s: float = QUARANTINE_COOLDOWN_S,
+        logger=None,
+    ):
+        self.threshold = threshold
+        self.cooldown_s = cooldown_s
+        self.logger = logger or get_logger("p2p.guard")
+        self._breakers: Dict[str, CircuitBreaker] = {}
+        self._last_frame: Dict[Tuple[str, int], Tuple[int, int]] = {}  # (peer, ch) -> (hash, run)
+        self.malformed_by_class: Dict[str, int] = {}
+        self.malformed_by_peer: Dict[str, int] = {}
+        self.floods_shed = 0
+        self.future_drops = 0
+        self.quarantines = 0
+
+    def _breaker(self, peer_id: str) -> CircuitBreaker:
+        b = self._breakers.get(peer_id)
+        if b is None:
+            b = CircuitBreaker(
+                f"peer.{peer_id[:12]}",
+                failure_threshold=self.threshold,
+                cooldown_s=self.cooldown_s,
+                register=True,
+            )
+            self._breakers[peer_id] = b
+        return b
+
+    def malformed(self, peer_id: str, klass: str) -> bool:
+        """Record one typed decode reject from ``peer_id``. Returns
+        True when THIS frame tripped the peer into quarantine (the
+        caller should disconnect it)."""
+        self.malformed_by_class[klass] = self.malformed_by_class.get(klass, 0) + 1
+        self.malformed_by_peer[peer_id] = self.malformed_by_peer.get(peer_id, 0) + 1
+        b = self._breaker(peer_id)
+        before = b.trips
+        b.record_failure()
+        if b.trips > before:
+            self.quarantines += 1
+            self.logger.info(
+                "peer quarantined for malformed traffic",
+                peer=peer_id[:12],
+                frames=self.malformed_by_peer[peer_id],
+                last_class=klass,
+            )
+            return True
+        return False
+
+    def shed_duplicate(self, peer_id: str, ch_id: int, msg: bytes) -> bool:
+        """True when this exact frame extends a back-to-back identical
+        run past the flood allowance on (peer, channel) — drop it."""
+        key = (peer_id, ch_id)
+        h = hash(msg)
+        last, run = self._last_frame.get(key, (None, 0))
+        if h == last:
+            run += 1
+            self._last_frame[key] = (h, run)
+            if run > FLOOD_RUN_ALLOWANCE:
+                self.floods_shed += 1
+                return True
+            return False
+        self._last_frame[key] = (h, 1)
+        return False
+
+    def future_drop(self, peer_id: str) -> None:
+        """Count a valid-looking but far-future message shed at the
+        seam (the bounded-buffer defense — consensus/reactor.py)."""
+        self.future_drops += 1
+
+    def quarantined(self, peer_id: str) -> bool:
+        """True while ``peer_id`` is serving its quarantine cooldown.
+        The first call after the cooldown readmits the peer with a
+        clean slate (the half-open probe resolved optimistically —
+        hostility re-trips the breaker on its own)."""
+        b = self._breakers.get(peer_id)
+        if b is None or b.state() == "closed":
+            return False
+        if b.allow():
+            b.record_success()  # cooldown served: readmit, clean slate
+            return False
+        return True
+
+    def forget(self, peer_id: str) -> None:
+        """Drop per-connection state when a peer is removed (bounds the
+        duplicate-run table). Breaker state survives — quarantine must
+        outlive the disconnect it causes."""
+        for key in [k for k in self._last_frame if k[0] == peer_id]:
+            del self._last_frame[key]
+
+    def stats(self) -> dict:
+        """Snapshot for the metrics pump and the stall autopsy."""
+        return {
+            "malformed_frames": sum(self.malformed_by_class.values()),
+            "malformed_by_class": dict(self.malformed_by_class),
+            "malformed_by_peer": dict(self.malformed_by_peer),
+            "floods_shed": self.floods_shed,
+            "future_drops": self.future_drops,
+            "quarantines": self.quarantines,
+            "quarantined_peers": sorted(
+                pid for pid, b in self._breakers.items() if b.state() != "closed"
+            ),
+        }
 
 
 class MockReporter(Reporter):

@@ -131,7 +131,16 @@ class MConnection:
         tasks = [t for t in self._tasks if t is not cur]
         for t in tasks:
             t.cancel()
-        await asyncio.gather(*tasks, return_exceptions=True)
+        # Python <= 3.10 wait_for can consume a cancellation that races
+        # its own timeout (CPython gh-86296) and surface TimeoutError
+        # instead — the send routine's 100ms flush-throttle wait hits
+        # that window often enough to hang a one-shot gather here for
+        # good. Re-deliver the cancel until every task actually ends.
+        pending = {t for t in tasks if not t.done()}
+        while pending:
+            _, pending = await asyncio.wait(pending, timeout=1.0)
+            for t in pending:
+                t.cancel()
         self._conn.close()
 
     # -- sending -----------------------------------------------------------
@@ -179,7 +188,10 @@ class MConnection:
         budget_window = 0.1  # refill send budget every 100ms
         budget = self._send_rate * budget_window
         try:
-            while True:
+            # not `while True`: the flush-throttle wait_for below can eat
+            # a stop()-time cancellation (gh-86296), so the loop condition
+            # is what guarantees this routine still terminates
+            while not self._stopped:
                 if self._pong_pending:
                     self._pong_pending = False
                     await self._conn.write(struct.pack(">B", _PKT_PONG))
@@ -220,7 +232,7 @@ class MConnection:
         """Reference recvRoutine :553."""
         recv_budget = float(self._recv_rate) * 0.1
         try:
-            while True:
+            while not self._stopped:
                 await faults.maybe_async("p2p.read")
                 (pkt_type,) = struct.unpack(">B", await self._conn.read_exactly(1))
                 if pkt_type == _PKT_PING:
@@ -265,7 +277,7 @@ class MConnection:
 
     async def _ping_routine(self) -> None:
         try:
-            while True:
+            while not self._stopped:
                 await asyncio.sleep(self._ping_interval_s)
                 if self._awaiting_pong_since is not None:
                     if time.monotonic() - self._awaiting_pong_since > self._pong_timeout_s:

@@ -201,11 +201,25 @@ class SecretConnection:
         """Read up to n plaintext bytes (at least 1 unless EOF)."""
         if not self._recv_buf:
             sealed = await self._reader.readexactly(SEALED_FRAME_SIZE)
-            frame = self._recv_aead.decrypt(self._recv_nonce.use(), sealed, None)
-            (length,) = struct.unpack_from(">I", frame, 0)
-            if length > DATA_MAX_SIZE:
-                raise AuthFailure(f"frame length {length} > max")
-            self._recv_buf = frame[DATA_LEN_SIZE : DATA_LEN_SIZE + length]
+            if self._native is not None:
+                # single-frame native open: every sub-frame message
+                # (votes, steps, pings) lands here, and the pure
+                # fallback's per-frame AEAD is ~180x slower on this
+                # path — slow enough to starve the event loop under
+                # gossip load when `cryptography` is absent
+                data, nxt = native_frames.open_frames(
+                    self._native, self._recv_key, self._recv_nonce.n, sealed
+                )
+                if data is None:
+                    raise AuthFailure("frame authentication failed")
+                self._recv_nonce.n = nxt
+                self._recv_buf = data
+            else:
+                frame = self._recv_aead.decrypt(self._recv_nonce.use(), sealed, None)
+                (length,) = struct.unpack_from(">I", frame, 0)
+                if length > DATA_MAX_SIZE:
+                    raise AuthFailure(f"frame length {length} > max")
+                self._recv_buf = frame[DATA_LEN_SIZE : DATA_LEN_SIZE + length]
         out, self._recv_buf = self._recv_buf[:n], self._recv_buf[n:]
         return out
 

@@ -11,10 +11,13 @@ import asyncio
 import random
 from typing import Dict, List, Optional
 
+from tendermint_tpu.codec.binary import DecodeError
+from tendermint_tpu.p2p.behaviour import PeerGuard
 from tendermint_tpu.p2p.conn.connection import ChannelDescriptor
 from tendermint_tpu.p2p.netaddress import NetAddress
 from tendermint_tpu.p2p.peer import Peer
 from tendermint_tpu.p2p.transport import Transport, UpgradedConn
+from tendermint_tpu.utils import trace
 from tendermint_tpu.utils.log import get_logger
 from tendermint_tpu.utils.service import Service
 
@@ -75,6 +78,9 @@ class Switch(Service):
         self.persistent_peers: List[NetAddress] = []
         self._max_inbound = config.max_num_inbound_peers if config else 40
         self._max_outbound = config.max_num_outbound_peers if config else 10
+        # malformed-traffic demerits + quarantine + flood shedding
+        # (p2p/behaviour.py PeerGuard; stats feed tendermint_byz_*)
+        self.guard = PeerGuard(logger=self.logger)
 
     # -- reactor registry --------------------------------------------------
 
@@ -139,6 +145,9 @@ class Switch(Service):
         if up.node_id in self.peers:
             self._discard_conn(up)
             raise DuplicatePeerError(f"duplicate peer {up.node_id[:12]}")
+        if self.guard.quarantined(up.node_id):
+            self._discard_conn(up)
+            raise ValueError(f"peer {up.node_id[:12]} is quarantined")
         cfg = self.config
         peer = Peer(
             up,
@@ -168,8 +177,35 @@ class Switch(Service):
         if reactor is None:
             await self.stop_peer_for_error(peer, f"unknown channel {ch_id:#x}")
             return
+        # amplification shed: a back-to-back identical-frame run past
+        # the allowance buys zero reactor work (docs/robustness.md)
+        if self.guard.shed_duplicate(peer.id, ch_id, msg):
+            return
         try:
             await reactor.receive(ch_id, peer, msg)
+        except asyncio.CancelledError:
+            raise
+        except (DecodeError, ValueError) as e:
+            # typed reject from the decode seam: a demerit, not an
+            # instant disconnect — one corrupt frame from an honest
+            # peer is weather. Repeats trip the per-peer breaker into
+            # quarantine, which DOES sever (and refuses reconnects
+            # until the cooldown is served).
+            self.logger.info(
+                "malformed frame rejected",
+                reactor=reactor.name,
+                peer=peer.id[:12],
+                err=str(e),
+            )
+            if self.guard.malformed(peer.id, type(e).__name__):
+                trace.instant(
+                    "p2p.peer_quarantine",
+                    peer=peer.id[:12],
+                    frames=self.guard.malformed_by_peer.get(peer.id, 0),
+                )
+                await self.stop_peer_for_error(
+                    peer, f"quarantined: repeated malformed frames ({e})"
+                )
         except Exception as e:
             self.logger.error(
                 "reactor receive error", reactor=reactor.name, err=repr(e)
@@ -185,7 +221,7 @@ class Switch(Service):
             return
         self.logger.info("stopping peer for error", peer=repr(peer), err=reason)
         await self._stop_and_remove_peer(peer, reason)
-        if peer.persistent:
+        if peer.persistent and not self.guard.quarantined(peer.id):
             addr = peer.listen_addr() or peer.socket_addr()
             self.spawn(self._reconnect_to_peer(addr))
 
@@ -195,6 +231,7 @@ class Switch(Service):
     async def _stop_and_remove_peer(self, peer: Peer, reason: str) -> None:
         if self.peers.pop(peer.id, None) is not None:
             self.transport.unregister_conn_ip(peer.socket_addr().host)
+        self.guard.forget(peer.id)
         await peer.stop()
         for reactor in self.reactors.values():
             await reactor.remove_peer(peer, reason)

@@ -392,7 +392,7 @@ class Simulation:
             self._register_commit_listener(i, cs)
         for b in self.schedule.byz:
             self.net.add_height_hook(
-                b.at_h, lambda _b=b: self._install_byzantine(_b.node, _b.kind)
+                b.at_h, lambda _b=b: self._install_byzantine(_b)
             )
         for ld in self.schedule.loads:
             self.net.add_height_hook(ld.at_h, lambda _l=ld: self._inject_load(_l))
@@ -501,7 +501,7 @@ class Simulation:
         # survives the restart (the adversary controls its own binary)
         for b in self.schedule.byz:
             if b.node == idx and b.at_h <= self.net.net_height:
-                self._install_byzantine(idx, b.kind, announce=False)
+                self._install_byzantine(b, announce=False)
         await cs.start()
         self.restarts_completed += 1
         # catchup_replay stashes how much in-flight WAL tail it re-drove
@@ -535,29 +535,37 @@ class Simulation:
 
     # -- byzantine overrides ----------------------------------------------
 
-    def _install_byzantine(self, idx: int, kind: str, announce: bool = True) -> None:
+    def _install_byzantine(self, b, announce: bool = True) -> None:
+        """Dispatch one armed ByzEvent to its attack install. The full
+        playbook lives in sim/schedule.py ``_BYZ_KINDS``; every install
+        composes with the others, so one node can run several kinds at
+        once (the kitchen_sink scenario's per-attacker stacks)."""
+        idx, kind = b.node, b.kind
         cs = self.nodes[idx].cs
         if announce:
             self.net._event("byz", self.clock.time_ns(), idx, kind)
         if kind == "double_sign":
-            self._install_double_sign(idx, cs)
+            self._install_equivocate(idx, cs)
+            self._install_double_vote(idx, cs)
+        elif kind == "equivocate":
+            self._install_equivocate(idx, cs)
         elif kind == "amnesia":
             self._install_amnesia(idx, cs)
+        elif kind == "withhold":
+            self._install_withhold(idx, cs)
+        elif kind == "flood":
+            self._install_flood(idx, cs, b.rate)
+        elif kind == "future":
+            self._install_future(idx, cs, b.rate)
+        elif kind == "garble":
+            self.net.arm_garble(idx)
 
-    def _install_double_sign(self, idx: int, cs: ConsensusState) -> None:
-        """Equivocating proposer AND voter (reference
-        byzantineDecideProposalFunc, byzantine_test.go:106): as proposer
-        it sends two different blocks, each half of the net seeing one;
-        every prevote step it ALSO signs a second, conflicting prevote —
-        the double vote whose ``DuplicateVoteEvidence`` honest receivers
-        pool and commit into a block (evidence/pool.py)."""
-        import hashlib
-
-        from tendermint_tpu.codec.signbytes import PREVOTE_TYPE as _PREVOTE
-        from tendermint_tpu.consensus.messages import VoteMessage
-        from tendermint_tpu.types.block import PartSetHeader
-        from tendermint_tpu.types.vote import Vote
-
+    def _install_equivocate(self, idx: int, cs: ConsensusState) -> None:
+        """Equivocating proposer (reference byzantineDecideProposalFunc,
+        byzantine_test.go:106): as proposer it sends two different
+        blocks, each half of the net seeing one. Honest prevote locking
+        keeps safety; ``double_sign`` stacks the conflicting-vote half
+        on top."""
         net = self.net
 
         async def byz_decide(height: int, round_: int) -> None:
@@ -597,6 +605,19 @@ class Simulation:
 
         cs.decide_proposal = byz_decide
 
+    def _install_double_vote(self, idx: int, cs: ConsensusState) -> None:
+        """The voting half of ``double_sign``: every prevote step ALSO
+        signs a second, conflicting prevote — the double vote whose
+        ``DuplicateVoteEvidence`` honest receivers pool and commit into
+        a block (evidence/pool.py)."""
+        import hashlib
+
+        from tendermint_tpu.codec.signbytes import PREVOTE_TYPE as _PREVOTE
+        from tendermint_tpu.consensus.messages import VoteMessage
+        from tendermint_tpu.types.block import PartSetHeader
+        from tendermint_tpu.types.vote import Vote
+
+        net = self.net
         honest_prevote = cs.do_prevote
 
         async def byz_prevote(height: int, round_: int) -> None:
@@ -648,6 +669,95 @@ class Simulation:
                 await cs._sign_add_vote(PREVOTE_TYPE, b"", None)
 
         cs.do_prevote = amnesia_prevote
+
+    def _install_withhold(self, idx: int, cs: ConsensusState) -> None:
+        """Precommit withholder: signs and WALs its precommits like an
+        honest node — self-delivery keeps its own round machinery
+        moving — but never gossips them. The silent-validator attack:
+        with an honest supermajority the quorum must close without its
+        signatures (the vote_withhold scenario's liveness pin)."""
+        from tendermint_tpu.codec.signbytes import PRECOMMIT_TYPE as _PRECOMMIT
+        from tendermint_tpu.consensus.messages import VoteMessage
+
+        net = self.net
+        orig = cs.send_internal
+
+        def withholding_send(msg):
+            if isinstance(msg, VoteMessage) and msg.vote.vote_type == _PRECOMMIT:
+                net.unicast(idx, idx, msg)  # hears itself; tells nobody
+                return
+            orig(msg)
+
+        cs.send_internal = withholding_send
+
+    def _install_flood(self, idx: int, cs: ConsensusState, rate: int) -> None:
+        """Replay/amplification spammer: every outbound message is
+        re-sent ``rate - 1`` extra times to every peer. The net's
+        consecutive-duplicate shedder (sim/net.py ``_put``) must absorb
+        the amplification in O(1) queue work per duplicate —
+        ``floods_shed`` accounts for every copy it eats."""
+        net = self.net
+        orig = cs.send_internal
+        n_nodes = len(net.nodes)
+
+        def flooding_send(msg, _rate=int(rate)):
+            orig(msg)
+            for _ in range(_rate - 1):
+                for dst in range(n_nodes):
+                    if dst != idx:
+                        net.unicast(idx, dst, msg)
+
+        cs.send_internal = flooding_send
+
+    def _install_future(self, idx: int, cs: ConsensusState, rate: int) -> None:
+        """Far-future probe: alongside every honest send, fabricate
+        ``rate`` valid-LOOKING precommits claiming heights ~10k ahead
+        (well-formed frames, junk signatures — they must be shed by the
+        seam's height window before any signature work or buffering,
+        sim/net.py ``FUTURE_MSG_WINDOW``). The attack that finds
+        unbounded buffers: ``future_drops`` must account for every one,
+        and the deferred backlog high-water must stay at its cap."""
+        import hashlib
+
+        from tendermint_tpu.codec.signbytes import PRECOMMIT_TYPE as _PRECOMMIT
+        from tendermint_tpu.consensus.messages import VoteMessage
+        from tendermint_tpu.types.block import PartSetHeader
+        from tendermint_tpu.types.vote import Vote
+
+        net = self.net
+        orig = cs.send_internal
+        counter = {"n": 0}
+
+        def future_send(msg, _rate=int(rate)):
+            orig(msg)
+            addr = cs._priv_validator_addr
+            if addr is None or not cs.rs.validators.has_address(addr):
+                return
+            vidx, _ = cs.rs.validators.get_by_address(addr)
+            for _ in range(_rate):
+                counter["n"] += 1
+                fake = hashlib.sha256(
+                    f"sim-future-{idx}-{counter['n']}".encode()
+                ).digest()
+                vote = Vote(
+                    vote_type=_PRECOMMIT,
+                    height=cs.rs.height + 10_000 + counter["n"],
+                    round=0,
+                    block_id=BlockID(
+                        hash=fake, parts=PartSetHeader(total=1, hash=fake)
+                    ),
+                    timestamp_ns=cs._now_ns(),
+                    validator_address=addr,
+                    validator_index=vidx,
+                    # junk signature on purpose: the seam must shed the
+                    # frame on its height claim alone, never verify it
+                    signature=b"\x07" * 64,
+                )
+                for dst in range(len(net.nodes)):
+                    if dst != idx:
+                        net.unicast(idx, dst, VoteMessage(vote))
+
+        cs.send_internal = future_send
 
     # -- load injection ----------------------------------------------------
 
@@ -774,11 +884,25 @@ class Simulation:
         from tendermint_tpu.consensus.flightrec import diagnose
 
         crashed = self.net._crashed if self.net is not None else set()
+        quarantined = self.net._quarantined if self.net is not None else set()
+        malformed = self.net.malformed_by_src if self.net is not None else {}
+        # schedule-armed attackers, by node: an autopsy must NAME the
+        # adversary — "node 3 is a garble+flood attacker, quarantined
+        # after 41 malformed frames" — not just report a missing quorum
+        byz_kinds: Dict[int, List[str]] = {}
+        for b in self.schedule.byz:
+            byz_kinds.setdefault(b.node, []).append(b.kind)
         out: Dict[int, dict] = {}
         for i, n in enumerate(self.nodes):
-            d = diagnose(n.cs)
+            d = diagnose(n.cs, quarantined=sorted(quarantined))
             if i in crashed:
                 d["crashed"] = True
+            if i in quarantined:
+                d["quarantined"] = True
+            if i in malformed:
+                d["malformed_frames_sent"] = malformed[i]
+            if i in byz_kinds:
+                d["byz_kinds"] = sorted(byz_kinds[i])
             out[i] = d
         return out
 

@@ -1,0 +1,223 @@
+"""Seeded wire mutation for the ``garble`` byzantine attack.
+
+The simulator's delivery seam normally moves message OBJECTS — the
+wire codec is never exercised in flight. A ``garble`` attacker
+(sim/schedule.py ``byz:kind=garble``) re-introduces the wire at the
+SimNet send seam: its outbound consensus frames are encoded
+(``consensus/messages.encode_msg``), corrupted by a seeded
+:class:`WireMutator`, then re-decoded under the receive seam's
+typed-reject guard — a surviving decode delivers the (possibly
+different) message, a typed reject drops it with accounting, and any
+OTHER exception is a receive-path crash that fails the scenario
+(sim/net.py ``receive_crashes``).
+
+Mutation classes (one registry, ``MUTATION_CLASSES``):
+
+- ``bit_flip``     1-3 seeded single-bit flips
+- ``truncate``     cut the frame at a seeded offset
+- ``tag_swap``     replace the leading type tag with another byte
+- ``length_lie``   overwrite a seeded offset with a huge uvarint
+                   claimed length (the allocation-driving lie)
+- ``oversize``     pad the frame past the decoder's hard size cap
+
+Coverage is accounted per (decoder label, mutation class): arming the
+attack also runs a deterministic sweep that feeds every registered
+consensus ``decode_body`` (``_TAG_TO_CLS``) and the mempool/evidence
+envelope decoders one mutant of EVERY class — the full matrix a test
+can assert (tests/test_sim_byzantine.py), so "every decoder survived
+every mutation class" is pinned, not hoped.
+"""
+
+from __future__ import annotations
+
+import random
+from typing import Callable, Dict, List, Optional, Set, Tuple
+
+from tendermint_tpu.codec.binary import DecodeError, Writer
+
+MUTATION_CLASSES: Tuple[str, ...] = (
+    "bit_flip", "truncate", "tag_swap", "length_lie", "oversize",
+)
+
+# the typed-reject family: what a hardened decoder may raise on a
+# malformed frame (tests/test_codec_fuzz.py ALLOWED)
+REJECT_ERRORS = (DecodeError, ValueError)
+
+
+def _exemplar_consensus_msgs() -> List[Tuple[str, object]]:
+    """One well-formed instance per registered consensus message class
+    (every ``decode_body`` in consensus/messages.py), label = class
+    name. Imports are local so the module stays cheap to import."""
+    from tendermint_tpu.consensus import messages as m
+    from tendermint_tpu.crypto import merkle
+    from tendermint_tpu.types.block import BlockID, PartSetHeader
+    from tendermint_tpu.types.part_set import Part
+    from tendermint_tpu.types.proposal import Proposal
+    from tendermint_tpu.types.vote import Vote
+    from tendermint_tpu.utils.bits import BitArray
+
+    bid = BlockID(b"\x01" * 32, PartSetHeader(3, b"\x02" * 32))
+    vote = Vote(
+        vote_type=2, height=7, round=1, block_id=bid, timestamp_ns=1234,
+        validator_address=b"\x03" * 20, validator_index=2,
+        signature=b"\x04" * 64,
+    )
+    proposal = Proposal(
+        height=7, round=1, pol_round=-1, block_id=bid, timestamp_ns=1234,
+        signature=b"\x05" * 64,
+    )
+    part = Part(
+        index=0, bytes_=b"exemplar-part-payload",
+        proof=merkle.SimpleProof(1, 0, b"\x06" * 32, []),
+    )
+    bits = BitArray(8)
+    bits.set_index(3, True)
+    msgs = [
+        m.NewRoundStepMessage(7, 1, 1, 12, 0),
+        m.NewValidBlockMessage(7, 1, PartSetHeader(3, b"\x02" * 32), bits, False),
+        m.ProposalMessage(proposal),
+        m.ProposalPOLMessage(7, 0, bits),
+        m.BlockPartMessage(7, 1, part),
+        m.VoteMessage(vote),
+        m.HasVoteMessage(7, 1, 1, 2),
+        m.VoteSetMaj23Message(7, 1, 1, bid),
+        m.VoteSetBitsMessage(7, 1, 1, bid, bits),
+        m.MsgInfo(m.HasVoteMessage(7, 1, 1, 2), "node1"),
+        m.TimeoutInfo(1000, 7, 1, 1),
+        m.EndHeightMessage(7),
+    ]
+    return [(type(msg).__name__, msg) for msg in msgs]
+
+
+def exemplar_frames() -> List[Tuple[str, bytes, Callable[[bytes], object]]]:
+    """(label, valid frame bytes, decoder) for every decoder the garble
+    attack must cover: all registered consensus messages plus the
+    mempool and evidence gossip envelopes."""
+    from tendermint_tpu.consensus import messages as m
+    from tendermint_tpu.evidence.reactor import (
+        decode_evidence_list,
+        encode_evidence_list,
+    )
+    from tendermint_tpu.mempool.reactor import decode_txs_origin, encode_txs
+
+    out: List[Tuple[str, bytes, Callable[[bytes], object]]] = [
+        (label, m.encode_msg(msg), m.decode_msg)
+        for label, msg in _exemplar_consensus_msgs()
+    ]
+    out.append(
+        ("mempool.txs", encode_txs([b"k=v", b"key2=value2"]), decode_txs_origin)
+    )
+    out.append(("evidence.list", encode_evidence_list([]), decode_evidence_list))
+    return out
+
+
+class WireMutator:
+    """Seeded frame corruptor with per-(decoder, class) coverage
+    accounting. One instance per SimNet; all randomness comes from its
+    own stream so arming garble never perturbs the net's delivery RNG."""
+
+    def __init__(self, seed: int, max_frame_bytes: int = 1 << 20):
+        self._rng = random.Random(seed ^ 0x6A5B1E)
+        self.max_frame_bytes = int(max_frame_bytes)
+        # decoder label -> mutation classes attempted against it
+        self.coverage: Dict[str, Set[str]] = {}
+        self.class_counts: Dict[str, int] = {c: 0 for c in MUTATION_CLASSES}
+        self.rejects = 0  # mutants the decoder rejected (typed)
+        self.survivors = 0  # mutants that still decoded
+        self.crashes = 0  # mutants that crashed a decoder (bug!)
+        self.crash_examples: List[Tuple[str, str, str]] = []
+        self._cycle = 0  # round-robin class pointer (deterministic mix)
+
+    # -- mutation ----------------------------------------------------------
+
+    def next_class(self) -> str:
+        klass = MUTATION_CLASSES[self._cycle % len(MUTATION_CLASSES)]
+        self._cycle += 1
+        return klass
+
+    def mutate(self, data: bytes, label: str, klass: Optional[str] = None) -> Tuple[str, bytes]:
+        """(mutation class, corrupted frame) for one valid frame."""
+        if klass is None:
+            klass = self.next_class()
+        rng = self._rng
+        out = bytearray(data) if data else bytearray(b"\x00")
+        if klass == "bit_flip":
+            for _ in range(rng.randint(1, 3)):
+                bit = rng.randrange(len(out) * 8)
+                out[bit // 8] ^= 1 << (bit % 8)
+            mutated = bytes(out)
+        elif klass == "truncate":
+            mutated = bytes(out[: rng.randrange(len(out))])
+        elif klass == "tag_swap":
+            swapped = rng.randrange(256)
+            if swapped == out[0]:
+                swapped = (swapped + 1) % 256
+            out[0] = swapped
+            mutated = bytes(out)
+        elif klass == "length_lie":
+            w = Writer()
+            w.write_uvarint(1 << 40)  # claims a ~1TB field follows
+            lie = w.bytes()
+            pos = rng.randrange(1, max(len(out) - len(lie), 1) + 1)
+            out[pos : pos + len(lie)] = lie
+            mutated = bytes(out)
+        elif klass == "oversize":
+            pad = self.max_frame_bytes + 1 - len(out)
+            mutated = bytes(out) + b"\xa5" * max(pad, 1)
+        else:
+            raise ValueError(f"unknown mutation class {klass!r}")
+        self.class_counts[klass] += 1
+        self.coverage.setdefault(label, set()).add(klass)
+        return klass, mutated
+
+    # -- decode probing ----------------------------------------------------
+
+    def probe(self, decoder: Callable[[bytes], object], data: bytes,
+              label: str, klass: str) -> str:
+        """Feed one mutant to a decoder. Returns ``"reject"`` (typed),
+        ``"survive"`` (still decoded) or ``"crash"`` (any other
+        exception — the hardening bug the scenario fails on)."""
+        try:
+            decoder(data)
+        except REJECT_ERRORS:
+            self.rejects += 1
+            return "reject"
+        except Exception as e:  # noqa: BLE001 — this IS the detector
+            self.crashes += 1
+            if len(self.crash_examples) < 8:
+                self.crash_examples.append((label, klass, repr(e)))
+            return "crash"
+        self.survivors += 1
+        return "survive"
+
+    def sweep(self) -> None:
+        """The deterministic coverage sweep: every registered decoder ×
+        every mutation class, one probe each. Run when a garble
+        attacker arms (sim/core.py) — the attacker crafting malformed
+        frames of every type is part of the attack, and it makes the
+        coverage matrix complete by construction."""
+        for label, frame, decoder in exemplar_frames():
+            for klass in MUTATION_CLASSES:
+                _, mutant = self.mutate(frame, label, klass)
+                self.probe(decoder, mutant, label, klass)
+
+    # -- reporting ---------------------------------------------------------
+
+    def coverage_gaps(self) -> List[str]:
+        """Registered decoders missing any mutation class — empty when
+        the matrix is complete."""
+        gaps = []
+        for label, _frame, _dec in exemplar_frames():
+            missing = set(MUTATION_CLASSES) - self.coverage.get(label, set())
+            if missing:
+                gaps.append(f"{label}: missing {sorted(missing)}")
+        return gaps
+
+    def stats(self) -> Dict[str, object]:
+        return {
+            "classes": dict(self.class_counts),
+            "rejects": self.rejects,
+            "survivors": self.survivors,
+            "crashes": self.crashes,
+            "decoders_covered": len(self.coverage),
+        }

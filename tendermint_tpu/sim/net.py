@@ -53,6 +53,20 @@ from tendermint_tpu.utils.log import get_logger
 
 CATCHUP_TICK_S = 0.25  # sim-time between catchup feeds per laggard
 
+# -- byzantine defense knobs (docs/robustness.md, attack playbook) ---------
+# messages claiming a height beyond this window past the net height are
+# shed at the seam — a `future` attacker pays for fabrication, the
+# receiver pays O(1) (the real-path analogue is the consensus reactor's
+# height window)
+FUTURE_MSG_WINDOW = 64
+# per-receiver overflow backlog cap: past it the oldest link semantics
+# is preserved (FIFO) but NEW arrivals drop-and-count, so a flood/future
+# attacker can't grow host memory without bound
+DEFERRED_CAP = 4096
+# malformed frames from one source before the net quarantines it
+# (mirrors the real path's per-peer demerit breaker, p2p/behaviour.py)
+QUARANTINE_THRESHOLD = 32
+
 
 def _msg_kind(msg) -> Tuple[str, int, int]:
     if isinstance(msg, VoteMessage):
@@ -163,6 +177,24 @@ class SimNet:
         self.evidence_heights: Set[int] = set()  # heights with committed evidence
         self.restart_times: Dict[int, List[int]] = {}  # node -> restart t_ns list
 
+        # -- byzantine seam state (docs/robustness.md) ---------------------
+        self.mutator = None  # WireMutator, created on first arm_garble
+        self._garbled: Set[int] = set()  # sources whose wire is corrupted
+        self._quarantined: Set[int] = set()  # sources the net stopped hearing
+        self.malformed_by_class: Dict[str, int] = {}
+        self.malformed_by_src: Dict[int, int] = {}
+        self.quarantines = 0
+        self.floods_shed = 0  # consecutive-duplicate deliveries shed
+        self.future_drops = 0  # far-future window sheds + backlog-cap drops
+        self.deferred_high_water = 0  # max per-receiver backlog ever held
+        # any non-typed exception escaping a decode on the delivery path:
+        # ZERO is a universal scenario expectation (sim/scenario.py)
+        self.receive_crashes = 0
+        self.crash_examples: List[tuple] = []
+        # per-dst (src, id(msg)) of the last queued delivery — the
+        # consecutive-duplicate flood shedder's memory (O(1) per node)
+        self._last_put: Dict[int, Tuple[int, int]] = {}
+
         # sim-wide: spans heights, so a larger bound than a VoteSet's
         self._tpl_cache = signbytes.TemplateCache(bound=4096)
 
@@ -192,6 +224,71 @@ class SimNet:
         self._height_hooks.append((int(at_h), fn))
         self._height_hooks.sort(key=lambda e: e[0])
 
+    # -- byzantine wire corruption (sim/mutator.py) ------------------------
+
+    def arm_garble(self, src: int) -> None:
+        """Arm the ``garble`` attack for ``src``: every consensus frame
+        it sends is encoded, corrupted by the seeded mutator, and
+        re-decoded under the receive seam's typed-reject guard. Arming
+        also runs the mutator's deterministic coverage sweep — every
+        registered decoder × every mutation class — so the scenario's
+        ``mutation_coverage`` expectation is complete by construction."""
+        from tendermint_tpu.sim.mutator import WireMutator
+
+        if self.mutator is None:
+            self.mutator = WireMutator(self.seed)
+            self.mutator.sweep()
+        self._garbled.add(src)
+        self._event("garble_armed", self.clock.time_ns(), src)
+
+    def _garble(self, src: int, dst: int, msg):
+        """Corrupt one outbound frame. Returns the re-decoded message
+        when the mutant survives decode (delivered as normal traffic),
+        or None when the receive seam rejected it (typed) — any OTHER
+        exception counts as a receive-path crash, the defect the
+        scenario fails on."""
+        from tendermint_tpu.consensus.messages import decode_msg, encode_msg
+        from tendermint_tpu.sim.mutator import REJECT_ERRORS
+
+        try:
+            frame = encode_msg(msg)
+        except TypeError:
+            return msg  # not a wire message: passes through untouched
+        label = type(msg).__name__
+        klass, mutant = self.mutator.mutate(frame, label)
+        t = self.clock.time_ns()
+        try:
+            decoded = decode_msg(mutant)
+        except REJECT_ERRORS:
+            self.mutator.rejects += 1
+            self._note_malformed(t, src, klass)
+            self._event("garble_reject", t, src, dst, label, klass)
+            return None
+        except Exception as e:  # noqa: BLE001 — this IS the detector
+            # counted in receive_crashes only (the mutator's own crash
+            # counter covers its arming sweep; evaluate() sums both)
+            self.receive_crashes += 1
+            if len(self.crash_examples) < 8:
+                self.crash_examples.append(("garble", label, klass, repr(e)))
+            self._event("garble_crash", t, src, dst, label, klass)
+            return None
+        self.mutator.survivors += 1
+        return decoded
+
+    def _note_malformed(self, t: int, src: int, klass: str) -> None:
+        self.malformed_by_class[klass] = self.malformed_by_class.get(klass, 0) + 1
+        self.malformed_by_src[src] = self.malformed_by_src.get(src, 0) + 1
+        if (
+            self.malformed_by_src[src] >= QUARANTINE_THRESHOLD
+            and src not in self._quarantined
+        ):
+            # the sim-global analogue of every honest peer's demerit
+            # breaker tripping (p2p/behaviour.py PeerGuard): the source
+            # keeps talking, nobody listens
+            self._quarantined.add(src)
+            self.quarantines += 1
+            self._event("quarantine", t, src, self.malformed_by_src[src])
+
     # -- event trace -------------------------------------------------------
 
     def _event(self, *ev) -> None:
@@ -218,7 +315,23 @@ class SimNet:
         if src == dst:
             self._schedule_delivery(now + self._quantum_ns, src, dst, msg)
             return
+        if src in self._quarantined:
+            kind, h, r = _msg_kind(msg)
+            self._drop(now, src, dst, kind, h, r, "quarantine")
+            return
+        if src in self._garbled:
+            msg = self._garble(src, dst, msg)
+            if msg is None:
+                self.drops += 1
+                return  # rejected at the seam (already evented)
         kind, h, r = _msg_kind(msg)
+        if h > self.net_height + FUTURE_MSG_WINDOW:
+            # far-future claim: shed before it can occupy any buffer —
+            # the `future` attack costs its sender fabrication and the
+            # receiver nothing (O(1) memory)
+            self.future_drops += 1
+            self._drop(now, src, dst, kind, h, r, "future")
+            return
         if h == self.net_height + 1:
             # front-height consensus gossip: keep one copy per message
             # for re-delivery to late joiners (loss/partition drops are
@@ -301,22 +414,44 @@ class SimNet:
             if dst in self._crashed and dst != src:
                 self._drop(t_q, src, dst, kind, h, r, "crashed")
                 continue
-            if self._deferred.get(dst):
+            backlog = self._deferred.get(dst)
+            if backlog is not None and len(backlog) > 0:
                 # a backlog exists for this receiver: queue BEHIND it —
-                # jumping it would reorder the link (the FIFO invariant)
+                # jumping it would reorder the link (the FIFO invariant).
+                # The backlog is CAPPED: past DEFERRED_CAP new arrivals
+                # drop-and-count, so a flood/future attacker buys drops,
+                # not host memory (docs/robustness.md)
+                if len(backlog) >= DEFERRED_CAP:
+                    self.future_drops += 1
+                    self._drop(t_q, src, dst, kind, h, r, "backlog_full")
+                    continue
                 self._event("requeue", t_q, src, dst, kind, h, r)
-                self._deferred[dst].append((src, msg))
+                backlog.append((src, msg))
+                if len(backlog) > self.deferred_high_water:
+                    self.deferred_high_water = len(backlog)
                 continue
             if not self._put(t_q, src, dst, msg, kind, h, r):
                 # receiver's input queue is full (vote storm): open a
                 # per-receiver backlog drained in arrival order — a
                 # deterministic stand-in for a bounded socket buffer
-                # that never reorders and never loses a message
+                # that never reorders and (below the cap) never loses
+                # a message
                 self._event("requeue", t_q, src, dst, kind, h, r)
                 self._deferred[dst] = deque([(src, msg)])
+                self.deferred_high_water = max(self.deferred_high_water, 1)
                 self._arm_drain(dst)
 
     def _put(self, t: int, src: int, dst: int, msg, kind, h, r) -> bool:
+        if dst != src and self._last_put.get(dst) == (src, id(msg)):
+            # consecutive identical delivery from the same source: the
+            # signature of a replay/amplification flood. One copy is
+            # enough (VoteSet/PartSet dedupe the payload); the rest is
+            # shed here so a `flood` attacker never multiplies queue
+            # work. Re-gossip and catchup interleave sources/messages,
+            # so legitimate duplicates are never back-to-back.
+            self.floods_shed += 1
+            self._event("flood_shed", t, src, dst, kind, h, r)
+            return True
         try:
             # own messages keep the internal peer id "" — the WAL
             # fsync and own-message-halt semantics key off it
@@ -325,6 +460,7 @@ class SimNet:
             )
         except Exception:
             return False
+        self._last_put[dst] = (src, id(msg))
         self.deliveries += 1
         self._event("deliver", t, src, dst, kind, h, r)
         return True
@@ -686,4 +822,10 @@ class SimNet:
             "wal_replays": self.wal_replays,
             "wal_replayed_msgs": self.wal_replayed_msgs,
             "evidence_heights": len(self.evidence_heights),
+            "malformed_frames": sum(self.malformed_by_class.values()),
+            "floods_shed": self.floods_shed,
+            "future_drops": self.future_drops,
+            "deferred_high_water": self.deferred_high_water,
+            "quarantines": self.quarantines,
+            "receive_crashes": self.receive_crashes,
         }

@@ -50,6 +50,16 @@ _KNOWN_EXPECT = {
     "safety", "liveness", "majority_advances", "txs_committed",
     "rotation_applied", "wal_replayed", "evidence_committed",
     "churn_applied",
+    # byzantine playbook outcomes (docs/robustness.md):
+    # mutation_coverage — the garble mutator hit every registered
+    #   decoder with every mutation class (and everything was rejected
+    #   typed, never crashed); quarantined[=N] — at least N sources
+    #   were quarantined for malformed traffic; attackers_named — the
+    #   stall autopsy names every scheduled attacker and its kinds;
+    #   byz_defended — each scheduled attack left its defense counter
+    #   nonzero (floods shed / future frames dropped / malformed
+    #   frames rejected)
+    "mutation_coverage", "quarantined", "attackers_named", "byz_defended",
 }
 _APPS = {"kvstore", "persistent_kvstore", "kvproofs"}
 
@@ -264,6 +274,20 @@ def evaluate(sc: Scenario, sim: Simulation, res: SimResult) -> List[str]:
     scenario holds)."""
     fails: List[str] = []
     net = sim.net
+    # universal invariant, ahead of any pinned expectation: NOTHING may
+    # crash a receive path. A malformed frame is a typed reject; any
+    # other exception escaping a decoder is exactly the defect the
+    # hardening exists to prevent, so every scenario fails on it.
+    crashes = net.receive_crashes
+    examples = list(net.crash_examples)
+    if net.mutator is not None:
+        crashes += net.mutator.crashes
+        examples.extend(net.mutator.crash_examples)
+    if crashes:
+        fails.append(
+            f"receive path crashed {crashes} time(s) on malformed input "
+            f"(must be typed rejects): {examples[:4]}"
+        )
     for e in sc.expect:
         base, _, arg = e.partition("=")
         if base == "safety":
@@ -346,6 +370,50 @@ def evaluate(sc: Scenario, sim: Simulation, res: SimResult) -> List[str]:
                             f"applied (want {want}, got {got})"
                         )
                         break
+        elif base == "mutation_coverage":
+            mut = net.mutator
+            if mut is None:
+                fails.append(
+                    "mutation_coverage expected but no garble attacker armed "
+                    "(schedule needs byz:kind=garble)"
+                )
+            else:
+                gaps = mut.coverage_gaps()
+                if gaps:
+                    fails.append("mutation coverage incomplete: " + "; ".join(gaps))
+                if mut.rejects <= 0:
+                    fails.append("garble mutator produced no rejected frames")
+        elif base == "quarantined":
+            want = int(arg) if arg else 1
+            if net.quarantines < want:
+                fails.append(
+                    f"expected >= {want} quarantined sources, got "
+                    f"{net.quarantines} (malformed by src: "
+                    f"{dict(net.malformed_by_src)})"
+                )
+        elif base == "attackers_named":
+            aut = sim.collect_autopsies()
+            for b in sim.schedule.byz:
+                named = aut.get(b.node, {}).get("byz_kinds") or []
+                if b.kind not in named:
+                    fails.append(
+                        f"autopsy does not name node{b.node} as a "
+                        f"{b.kind} attacker (got {named})"
+                    )
+        elif base == "byz_defended":
+            kinds = {b.kind for b in sim.schedule.byz}
+            if "flood" in kinds and net.floods_shed <= 0:
+                fails.append(
+                    "flood attacker scheduled but no duplicate deliveries shed"
+                )
+            if "future" in kinds and net.future_drops <= 0:
+                fails.append(
+                    "future attacker scheduled but no far-future frames dropped"
+                )
+            if "garble" in kinds and sum(net.malformed_by_class.values()) <= 0:
+                fails.append(
+                    "garble attacker scheduled but no malformed frames rejected"
+                )
         elif base == "rotation_applied":
             rot = sc.rotate or {}
             pv = sim.privs[rot.get("validator", 0)]
@@ -378,10 +446,19 @@ def _autopsy_summary(autopsies: Dict[int, dict]) -> str:
             lines.append(f"  node{i}: crashed (down at collection time)")
             continue
         miss = d.get("missing_validators") or []
+        tags = []
+        if d.get("byz_kinds"):
+            tags.append(f"ATTACKER[{'+'.join(d['byz_kinds'])}]")
+        if d.get("quarantined"):
+            tags.append(
+                f"QUARANTINED after {d.get('malformed_frames_sent', '?')} "
+                "malformed frames"
+            )
         lines.append(
             f"  node{i}: blocked at {d.get('blocked_step')} "
             f"h{d.get('height')}/r{d.get('round')} — {d.get('reason')} "
             f"(missing validators: {','.join(map(str, miss)) if miss else '-'})"
+            + (f" [{'; '.join(tags)}]" if tags else "")
         )
     return "\n".join(lines)
 

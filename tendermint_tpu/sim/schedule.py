@@ -20,7 +20,8 @@ Grammar (documented with worked examples in docs/simulator.md):
     crash:node=7,at_h=20,restart_h=24,mode=isolation  # memory survives
     churn:node=9,kind=join,at_h=6,power=15  # valset entry via rotation tx
     churn:node=2,kind=leave,at_h=10         # valset exit (power 0 tx)
-    byz:node=0,kind=double_sign,at_h=2   # or kind=amnesia
+    byz:node=0,kind=double_sign,at_h=2   # see _BYZ_KINDS for the playbook
+    byz:node=1,kind=flood,at_h=2,rate=16 # flood/future take rate=
     load:txs=64,at_h=3,size=32           # flash-crowd tx burst
     quantum:ms=1                         # delivery-time quantization
 
@@ -64,7 +65,22 @@ DEFAULT_DELAY_MS = 10.0
 DEFAULT_QUANTUM_MS = 1.0
 
 _VERBS = {"link", "partition", "crash", "churn", "byz", "load", "quantum"}
-_BYZ_KINDS = {"double_sign", "amnesia"}
+# the attacker playbook (docs/robustness.md, "Attack playbook"):
+#   double_sign  conflicting proposals AND conflicting prevotes
+#   equivocate   conflicting proposals only (the proposer-side half)
+#   amnesia      forgets its lock every prevote step
+#   withhold     signs precommits but never gossips them (lazy validator)
+#   flood        re-sends every outbound message rate= times (replay spam)
+#   future       emits valid-looking votes from far-future heights at
+#                rate= per outbound message (probes peer buffers)
+#   garble       seeded wire mutation of its outbound frames in flight
+#                (sim/mutator.py) plus a full decoder-coverage sweep
+_BYZ_KINDS = {
+    "double_sign", "amnesia", "equivocate", "withhold", "flood",
+    "future", "garble",
+}
+# kinds that take a rate= amplification factor
+_BYZ_RATED = {"flood", "future"}
 _CRASH_MODES = {"replay", "isolation"}
 _CHURN_KINDS = {"join", "leave"}
 
@@ -188,6 +204,7 @@ class ByzEvent:
     node: int
     kind: str
     at_h: int = 1
+    rate: int = 8  # flood/future amplification factor
     item: str = ""
 
 
@@ -286,6 +303,28 @@ class Schedule:
                     f"{b.item!r}: byzantine node {b.node} is not a validator "
                     f"(validators are 0..{n_validators - 1})"
                 )
+            if heights is not None and b.at_h > heights:
+                # the silently-never-activating attacker: the byz hook
+                # would never fire and the scenario tests nothing
+                raise ScheduleError(
+                    f"{b.item!r}: at_h {b.at_h} is beyond the run's height "
+                    f"horizon ({heights}) — the attack would silently never "
+                    "activate and the scenario would pin nothing"
+                )
+        byz_seen: Dict[Tuple[int, str], ByzEvent] = {}
+        for b in self.byz:
+            # byz installs are open windows ([at_h, end-of-run]): two
+            # specs of the SAME kind on the same node always overlap —
+            # the second install would silently re-wrap the first.
+            # Different kinds compose (the kitchen-sink attacker).
+            prev = byz_seen.get((b.node, b.kind))
+            if prev is not None:
+                raise ScheduleError(
+                    f"overlapping byz specs for node {b.node}: {prev.item!r} "
+                    f"and {b.item!r} both install {b.kind!r} (a byz window "
+                    "never closes — one spec per kind per node)"
+                )
+            byz_seen[(b.node, b.kind)] = b
         for rule in self.links:
             for ranges in (rule.src, rule.dst):
                 _resolve_group(ranges, n_nodes, self.spec)
@@ -418,11 +457,20 @@ def parse_schedule(spec: str) -> Schedule:
                 raise ScheduleError(
                     f"{item!r}: byz kind must be one of {sorted(_BYZ_KINDS)}"
                 )
+            if "rate" in kv and kind not in _BYZ_RATED:
+                raise ScheduleError(
+                    f"{item!r}: rate= only applies to kinds "
+                    f"{sorted(_BYZ_RATED)}, not {kind!r}"
+                )
+            rate = _parse_int(item, kv, "rate", 8)
+            if rate < 2:
+                raise ScheduleError(f"{item!r}: rate must be >= 2")
             sched.byz.append(
                 ByzEvent(
                     node=_parse_int(item, kv, "node", None),
                     kind=kind,
                     at_h=_parse_int(item, kv, "at_h", 1),
+                    rate=rate,
                     item=item,
                 )
             )

@@ -535,6 +535,46 @@ class StallMetrics:
             self._deltas.feed(getattr(self, attr), key, stats)
 
 
+class ByzMetrics:
+    """Byzantine-defense telemetry (``tendermint_byz_*``): what the
+    receive seam is shedding and who got quarantined for it. Fed from
+    two snapshot sources — the switch's PeerGuard (p2p/behaviour.py:
+    malformed frames by exception class, duplicate-run floods shed,
+    far-future drops, quarantine trips) and the consensus state's
+    ``byz_rejects`` backstop counter (consensus/state.py _handle_msg —
+    peer messages whose handler raised anything unclassified).
+    Monotonic totals are TRUE counters fed by snapshot deltas, like
+    CryptoMetrics. See docs/robustness.md (attack playbook) and
+    docs/metrics.md."""
+
+    def __init__(self, registry: Optional[Registry] = None, namespace="tendermint"):
+        r = registry or Registry()
+        sub = "byz"
+        reg = r.register
+        self.malformed_frames = reg(Counter("malformed_frames_total", "Malformed frames rejected at the decode seam (label: klass = exception class).", namespace, sub))
+        self.floods_shed = reg(Counter("floods_shed_total", "Frames shed by the duplicate-run flood defense before reactor dispatch.", namespace, sub))
+        self.future_drops = reg(Counter("future_buffer_drops_total", "Far-future consensus messages shed before any buffering.", namespace, sub))
+        self.quarantines = reg(Counter("peer_quarantines_total", "Peers quarantined for repeated malformed traffic.", namespace, sub))
+        self.handler_rejects = reg(Counter("handler_rejects_total", "Peer messages rejected by the consensus handler backstop (unclassified handler exception).", namespace, sub))
+        self.quarantined_peers = reg(Gauge("quarantined_peers", "Peers currently serving a quarantine cooldown.", namespace, sub))
+        self._deltas = _SnapshotCounters()
+
+    def update(self, guard_stats: dict, handler_rejects: int = 0) -> None:
+        """Fold a PeerGuard.stats() snapshot + the consensus backstop
+        counter into the instruments."""
+        d = self._deltas
+        for klass, n in guard_stats.get("malformed_by_class", {}).items():
+            d.feed(
+                self.malformed_frames.with_labels(klass=klass),
+                f"malformed/{klass}", {f"malformed/{klass}": n},
+            )
+        d.feed(self.floods_shed, "floods_shed", guard_stats)
+        d.feed(self.future_drops, "future_drops", guard_stats)
+        d.feed(self.quarantines, "quarantines", guard_stats)
+        d.feed(self.handler_rejects, "handler_rejects", {"handler_rejects": handler_rejects})
+        self.quarantined_peers.set(len(guard_stats.get("quarantined_peers", ())))
+
+
 class LightServeMetrics:
     """Batched light-client verification service
     (``tendermint_lightserve_*``, lightserve/service.py +

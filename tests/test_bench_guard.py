@@ -251,6 +251,37 @@ def test_guard_flags_sim_recovery_regression_and_disappearance(bench):
     assert bench._regression_guard({"sim_recovery_s": 0.1}, "tpu") == []
 
 
+def test_guard_flags_sim_byz_regression_and_disappearance(bench):
+    """The adversary-tax key rides the guard: a commit-rate ratio that
+    regresses (drops — the attacker gained leverage) beyond tolerance
+    or goes missing must hard-fail the bench."""
+    _write_record(bench, sim_byz_commit_rate=1.0)
+    fails = bench._regression_guard({"sim_byz_commit_rate": 0.5}, "tpu")
+    assert len(fails) == 1 and "sim_byz_commit_rate" in fails[0]
+    fails = bench._regression_guard({"sim_byz_error": "wedged"}, "tpu")
+    assert any("sim_byz_commit_rate" in f and "missing" in f for f in fails)
+    # within tolerance / improved
+    assert bench._regression_guard({"sim_byz_commit_rate": 0.9}, "tpu") == []
+    assert bench._regression_guard({"sim_byz_commit_rate": 1.3}, "tpu") == []
+
+
+def test_sim_byz_bench_measures_adversary_tax(bench):
+    """The byz drill itself: the playbook's noisiest attackers (garble
+    + 4x flood + future probes) must leave commit progress intact —
+    every defense engages (nonzero shed/reject/quarantine counters),
+    nothing crashes the receive path, and the simulated-time tax of
+    the attack stays bounded."""
+    out = bench.sim_byz_bench()
+    assert "sim_byz_error" not in out, out
+    # the attacked run must still commit within 3x the clean twin's
+    # simulated time (the ratio is clean/byz, higher = cheaper attack)
+    assert out["sim_byz_commit_rate"] > 1 / 3, out
+    assert out["sim_byz_malformed_rejected"] > 0, out
+    assert out["sim_byz_floods_shed"] > 0, out
+    assert out["sim_byz_future_drops"] > 0, out
+    assert out["sim_byz_quarantines"] >= 1, out
+
+
 def test_sim_recovery_bench_measures_kill_to_commit(bench):
     """The recovery drill itself: a true crash (WAL-replay rebuild) of
     a validator yields a positive simulated kill-to-first-commit time,

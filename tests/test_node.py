@@ -120,6 +120,71 @@ def test_testnet_localnet_commits(tmp_path):
     run(go())
 
 
+@pytest.mark.slow
+def test_testnet_commits_under_connection_fuzz(tmp_path):
+    """The p2p.test_fuzz chaos knob end to end: node 0's connections
+    ride a FuzzedConnection (p2p/fuzz.py) silently dropping 20% of its
+    writes, and the 4-validator net — the fuzzed node included — still
+    commits the same chain.
+
+    One fuzzed node, 4 validators: the three clean validators keep a
+    +2/3 quorum no matter what node 0's lossy writes do, and drop mode
+    never drops reads, so node 0 still hears all gossip and commits
+    too. Fuzzing EVERY node's writes at p >= 0.1 instead can starve
+    rounds for minutes at a stretch — silent drops are marked sent, so
+    repair waits on the periodic maj23 bit exchange; that fleet-wide
+    shape is covered deterministically by the simulator corpus, and
+    docs/running-in-production.md documents the sizing guidance."""
+    out = str(tmp_path / "fuzznet")
+    import socket
+
+    ports = []
+    socks = []
+    for _ in range(8):
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        ports.append(s.getsockname()[1])
+        socks.append(s)
+    for s in socks:
+        s.close()
+
+    cli_main(["testnet", "--v", "4", "--o", out, "--chain-id", "fuzz-chain",
+              "--starting-port", str(min(ports))])
+
+    async def go():
+        nodes = []
+        for i in range(4):
+            home = os.path.join(out, f"node{i}")
+            cfg = load_config(os.path.join(home, "config/config.toml")).set_root(home)
+            cfg.base.db_backend = "memdb"
+            cfg.base.fast_sync = False
+            cfg.consensus.timeout_commit_ms = 100
+            cfg.consensus.skip_timeout_commit = True
+            cfg.consensus.timeout_propose_ms = 2000
+            if i == 0:
+                cfg.p2p.test_fuzz = True
+                cfg.p2p.test_fuzz_config.mode = "drop"
+                cfg.p2p.test_fuzz_config.prob_drop_rw = 0.2
+            node = default_new_node(cfg)
+            nodes.append(node)
+        for node in nodes:
+            await node.start()
+        try:
+            await asyncio.gather(
+                *(n.consensus_state.wait_for_height(3, timeout_s=120) for n in nodes)
+            )
+            hashes = {n.block_store.load_block(2).hash() for n in nodes}
+            assert len(hashes) == 1
+            # the knob really engaged: node 0 wrapped its upgraded conns
+            assert nodes[0].transport._fuzz_count >= 1
+            assert all(n.transport._fuzz_count == 0 for n in nodes[1:])
+        finally:
+            for node in nodes:
+                await node.stop()
+
+    run(go())
+
+
 def test_unsafe_reset_all(tmp_path):
     home = init_home(tmp_path)
     data_file = os.path.join(home, "data", "junk.db")

@@ -352,3 +352,41 @@ def test_mconnection_malformed_packets_error_not_hang():
             await server.wait_closed()
 
     run(go())
+
+
+def test_mconnection_stop_survives_swallowed_cancel():
+    """stop() must terminate even when a routine eats its cancellation.
+
+    Python <= 3.10 asyncio.wait_for can consume a cancel that races its
+    own timeout (CPython gh-86296) and raise TimeoutError instead; the
+    send routine's 100ms flush-throttle wait sits in exactly that window
+    at teardown, which used to park the old one-shot gather in stop()
+    forever (node.stop() hung ~1 run in 10 on a loaded box). stop() now
+    re-delivers the cancel until the task actually ends. Reproduced
+    deterministically: a task that swallows the first CancelledError."""
+
+    async def go():
+        class NullConn:
+            def close(self):
+                pass
+
+        async def on_recv(ch, msg):
+            pass
+
+        async def on_err(e):
+            pass
+
+        m = MConnection(NullConn(), [], on_recv, on_err)
+
+        async def swallows_one_cancel():
+            try:
+                await asyncio.sleep(3600)
+            except asyncio.CancelledError:
+                pass  # the gh-86296 shape: cancel consumed, loop continues
+            await asyncio.sleep(3600)  # only a re-delivered cancel ends this
+
+        m._tasks = [asyncio.create_task(swallows_one_cancel())]
+        await asyncio.sleep(0)  # let the task reach its first await
+        await asyncio.wait_for(m.stop(), timeout=5)
+
+    run(go())
